@@ -1,0 +1,23 @@
+"""avenir-tpu: a TPU-native classical-ML framework.
+
+Re-implements the capabilities of the avenir toolkit (Hadoop/Spark/Storm;
+see /root/reference) as an idiomatic JAX/XLA framework: CSV in / CSV out,
+JSON schema metadata, properties-file configuration — but with sharded
+device arrays instead of HDFS records, GSPMD collectives instead of the
+shuffle, and jitted one-pass histogram/reduction kernels instead of
+mapper/reducer pairs.
+
+Layer map (mirrors SURVEY.md section 1, rebuilt TPU-first):
+
+    L1 core      avenir_tpu.core      schema / config / columnar tables / metrics / artifacts
+    L2 parallel  avenir_tpu.parallel  mesh + the five communication idioms over ICI/DCN
+    L3 ops       avenir_tpu.ops       pure array kernels (histograms, distances, scans)
+    L4 models    avenir_tpu.models    trainers/predictors (bayes, tree, knn, markov, ...)
+       explore   avenir_tpu.explore   feature engineering & selection pack
+       optimize  avenir_tpu.optimize  SA / GA stochastic optimization
+       reinforce avenir_tpu.reinforce multi-arm bandits (batch + online serving)
+       sequence  avenir_tpu.sequence  sequence mining
+    L5 cli       avenir_tpu.cli       .properties-driven job runner (replaces hadoop jar ...)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,144 @@
+"""Job registry: the ``hadoop jar avenir.jar <ClassName> -Dconf.path=... in out``
+entry points, rebuilt (SURVEY.md §1 L6->L5->L4 interface).
+
+Every reference job class name (and a short camelCase alias) maps to a Python
+job function ``job(config, in_path, out_path) -> Counters``.  Driver shell
+scripts keep working by swapping the ``hadoop jar``/``spark-submit`` line for
+``python -m avenir_tpu.cli.run <ClassName> -Dconf.path=<file> <in> <out>``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..core.config import Config
+from ..core.schema import FeatureSchema
+from ..core.table import load_csv
+from ..core.metrics import Counters, CostBasedArbitrator
+from ..core import artifacts
+from ..parallel.mesh import MeshContext
+
+JOBS: Dict[str, Callable] = {}
+
+
+def register(*names: str):
+    def deco(fn):
+        for n in names:
+            JOBS[n] = fn
+        return fn
+    return deco
+
+
+def resolve(name: str) -> Callable:
+    if name in JOBS:
+        return JOBS[name]
+    # allow bare class name for fully-qualified registrations
+    for k, v in JOBS.items():
+        if k.split(".")[-1] == name:
+            return v
+    raise KeyError(f"unknown job {name!r}; known: {sorted(JOBS)}")
+
+
+def _schema_path(cfg: Config, key: str) -> FeatureSchema:
+    return FeatureSchema.load(cfg.must_get(key))
+
+
+# --------------------------------------------------------------------------
+# org.avenir.bayesian
+# --------------------------------------------------------------------------
+
+@register("org.avenir.bayesian.BayesianDistribution", "bayesianDistribution")
+def bayesian_distribution(cfg: Config, in_path: str, out_path: str) -> Counters:
+    """Naive Bayes training job (bayesian/BayesianDistribution.java).
+
+    Config keys honored (same names as the reference): bad.feature.schema.file.path,
+    field.delim.regex, field.delim.out."""
+    from ..models import bayes
+    counters = Counters()
+    schema = _schema_path(cfg, "bad.feature.schema.file.path")
+    table = load_csv(in_path, schema, cfg.field_delim_regex)
+    ctx = MeshContext()
+    model = bayes.train(table, ctx, counters)
+    artifacts.write_text_output(out_path, model.to_lines(cfg.field_delim_out))
+    return counters
+
+
+@register("org.avenir.bayesian.BayesianPredictor", "bayesianPredictor")
+def bayesian_predictor(cfg: Config, in_path: str, out_path: str) -> Counters:
+    """Naive Bayes prediction job (bayesian/BayesianPredictor.java).
+
+    Keys: bap.feature.schema.file.path, bap.bayesian.model.file.path,
+    bap.predict.class, bap.predict.class.cost, bap.class.prob.diff.threshold,
+    bap.output.feature.prob.only."""
+    from ..models import bayes
+    counters = Counters()
+    schema = _schema_path(cfg, "bap.feature.schema.file.path")
+    delim = cfg.field_delim_regex
+    out_delim = cfg.field_delim_out
+    table = load_csv(in_path, schema, delim, keep_raw=True)
+    model_lines = artifacts.read_text_input(cfg.must_get("bap.bayesian.model.file.path"))
+    model = bayes.NaiveBayesModel.from_lines(model_lines, schema, delim)
+    res = bayes.predict(model, table)
+
+    # predicting classes default to the first two of the class cardinality
+    # (BayesianPredictor.java:151-159)
+    pred_classes = cfg.get_list("bap.predict.class") or model.class_values[:2]
+    neg_class, pos_class = pred_classes[0], pred_classes[1]
+    prob_diff_threshold = cfg.get_int("bap.class.prob.diff.threshold", -1)
+
+    arbitrator = None
+    if cfg.get("bap.predict.class.cost") is not None:
+        costs = cfg.must_get_list("bap.predict.class.cost", delim=out_delim)
+        arbitrator = CostBasedArbitrator(neg_class, pos_class,
+                                         int(costs[0]), int(costs[1]))
+
+    cls_index = {v: i for i, v in enumerate(model.class_values)}
+    actual_codes = table.class_codes()
+    lines: List[str] = []
+
+    if cfg.get_boolean("bap.output.feature.prob.only", False):
+        # feature-probability output mode (BayesianPredictor.outputFeatureProb
+        # :276-286): itemID, P(x), then (class, P(x|c)) pairs, then actual;
+        # no prediction, no validation counters.
+        id_ord = schema.id_fields[0].ordinal if schema.id_fields else 0
+        for i, raw in enumerate(table.raw_rows):
+            parts = [raw[id_ord], repr(float(res.feature_prior_prob[i]))]
+            for cv in pred_classes:
+                parts.append(cv)
+                parts.append(repr(float(res.feature_post_prob[i, cls_index[cv]])))
+            actual = (model.class_values[actual_codes[i]]
+                      if actual_codes[i] >= 0 else "?")
+            parts.append(actual)
+            lines.append(out_delim.join(parts))
+        artifacts.write_text_output(out_path, lines, role="m")
+        return counters
+
+    from ..core.metrics import ConfusionMatrix
+    cm = ConfusionMatrix(neg_class, pos_class)
+    for i, raw in enumerate(table.raw_rows):
+        record = out_delim.join(raw)
+        if arbitrator is not None:
+            pos_p = int(res.class_probs[i, cls_index[pos_class]])
+            neg_p = int(res.class_probs[i, cls_index[neg_class]])
+            pred = arbitrator.arbitrate(pos_p, neg_p)
+            prob = 100  # reference costArbitrate sets predProb=100 (:389-390)
+        else:
+            pred = res.pred_class[i]
+            prob = int(res.pred_prob[i])
+        parts = [record, pred, str(prob)]
+        if prob_diff_threshold > 0:
+            parts.append("classified" if res.class_prob_diff[i] > prob_diff_threshold
+                         else "ambiguous")
+        lines.append(out_delim.join(parts))
+        actual = model.class_values[actual_codes[i]] if actual_codes[i] >= 0 else "?"
+        cm.report(pred, actual)
+        if pred == actual:
+            counters.increment("Validation", "Correct")
+        else:
+            counters.increment("Validation", "Incorrect")
+    cm.export(counters)
+    artifacts.write_text_output(out_path, lines, role="m")  # map-only job
+    return counters

@@ -1,0 +1,71 @@
+"""Command-line runner: drop-in replacement for the reference's driver lines.
+
+Reference invocation (resource/detr.sh:52, resource/knn.sh:53):
+
+    hadoop jar avenir.jar org.avenir.tree.DecisionTreeBuilder \
+        -Dconf.path=detr.properties <inPath> <outPath>
+
+Here:
+
+    python -m avenir_tpu.cli.run org.avenir.tree.DecisionTreeBuilder \
+        -Dconf.path=detr.properties <inPath> <outPath>
+
+Also accepts the Spark-style ``<jobAlias> <inPath> <outPath> <conf.conf>``
+argument order used by resource/opt.sh.  Prints Hadoop-style counter dumps.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Optional
+
+from ..core.config import Config, load_config
+from . import jobs
+
+
+def parse_args(argv: List[str]):
+    job_name: Optional[str] = None
+    conf_path: Optional[str] = None
+    overrides = {}
+    positional: List[str] = []
+    for a in argv:
+        if a.startswith("-Dconf.path="):
+            conf_path = a.split("=", 1)[1]
+        elif a.startswith("-D"):
+            k, _, v = a[2:].partition("=")
+            overrides[k] = v
+        elif job_name is None:
+            job_name = a
+        else:
+            positional.append(a)
+    # spark style: <in> <out> <file.conf> as last positional
+    if conf_path is None and positional and positional[-1].endswith(".conf"):
+        conf_path = positional.pop()
+    return job_name, conf_path, overrides, positional
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    job_name, conf_path, overrides, positional = parse_args(argv)
+    if job_name is None:
+        print("usage: python -m avenir_tpu.cli.run <JobClassOrAlias> "
+              "-Dconf.path=<conf> [<inPath>] <outPath>", file=sys.stderr)
+        return 2
+    fn = jobs.resolve(job_name)
+    cfg = load_config(conf_path, app=job_name.split(".")[-1][0].lower() +
+                      job_name.split(".")[-1][1:]) if conf_path else Config()
+    cfg.update(overrides)
+    if len(positional) >= 2:
+        in_path, out_path = positional[0], positional[1]
+    elif len(positional) == 1:
+        in_path, out_path = None, positional[0]
+    else:
+        in_path = out_path = None
+    counters = fn(cfg, in_path, out_path)
+    if counters is not None:
+        print(counters.render())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

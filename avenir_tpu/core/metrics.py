@@ -1,0 +1,142 @@
+"""Metrics channel: counters, confusion matrix, cost-based arbitration.
+
+The reference uses Hadoop Counters / Spark accumulators as its metrics channel
+(SURVEY.md §5; bayesian/BayesianPredictor.java:170-180,
+spark SimulatedAnnealing.scala:88-92).  Here metrics are plain dicts of
+integers accumulated host-side (or psum'd scalars fetched from jitted steps via
+avenir_tpu.parallel.collectives.counter_sum) and rendered the same way Hadoop
+prints counter groups.
+
+ConfusionMatrix and CostBasedArbitrator keep the exact integer-percent
+semantics of util/ConfusionMatrix.java and util/CostBasedArbitrator.java so
+validation counters match the reference run for run.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, Iterable, Tuple
+
+import numpy as np
+
+
+class Counters:
+    """Hadoop-counter-style metrics: (group, name) -> int."""
+
+    def __init__(self):
+        self._c: Dict[Tuple[str, str], int] = defaultdict(int)
+
+    def increment(self, group: str, name: str, amount: int = 1) -> None:
+        self._c[(group, name)] += int(amount)
+
+    def set(self, group: str, name: str, value: int) -> None:
+        self._c[(group, name)] = int(value)
+
+    def get(self, group: str, name: str) -> int:
+        return self._c.get((group, name), 0)
+
+    def as_dict(self) -> Dict[str, Dict[str, int]]:
+        out: Dict[str, Dict[str, int]] = defaultdict(dict)
+        for (g, n), v in sorted(self._c.items()):
+            out[g][n] = v
+        return dict(out)
+
+    def render(self) -> str:
+        """Render like Hadoop's end-of-job counter dump."""
+        lines = []
+        for g, names in self.as_dict().items():
+            lines.append(f"{g}")
+            for n, v in names.items():
+                lines.append(f"\t{n}={v}")
+        return "\n".join(lines)
+
+
+class ConfusionMatrix:
+    """Binary confusion matrix with the reference's integer-percent metrics
+    (util/ConfusionMatrix.java:30-75).  Constructor arg order is
+    (negClass, posClass), as in the reference."""
+
+    def __init__(self, neg_class: str, pos_class: str):
+        self.neg_class = neg_class
+        self.pos_class = pos_class
+        self.true_pos = 0
+        self.false_pos = 0
+        self.true_neg = 0
+        self.false_neg = 0
+
+    def report(self, pred_class: str, actual_class: str) -> None:
+        if pred_class == self.pos_class:
+            if actual_class == self.pos_class:
+                self.true_pos += 1
+            else:
+                self.false_pos += 1
+        else:
+            if actual_class == self.neg_class:
+                self.true_neg += 1
+            else:
+                self.false_neg += 1
+
+    def report_batch(self, pred_is_pos: np.ndarray, actual_is_pos: np.ndarray,
+                     actual_is_neg: np.ndarray) -> None:
+        """Vectorized report: boolean arrays per record.  actual_is_neg is
+        passed separately because the reference treats 'not neg' (e.g. unknown
+        label) as a false negative when prediction is negative."""
+        pp = np.asarray(pred_is_pos, dtype=bool)
+        ap = np.asarray(actual_is_pos, dtype=bool)
+        an = np.asarray(actual_is_neg, dtype=bool)
+        self.true_pos += int(np.sum(pp & ap))
+        self.false_pos += int(np.sum(pp & ~ap))
+        self.true_neg += int(np.sum(~pp & an))
+        self.false_neg += int(np.sum(~pp & ~an))
+
+    # integer-percent metrics, matching reference integer division (plus a
+    # zero-denominator guard the reference lacks — it would throw
+    # ArithmeticException and kill the job)
+    def recall(self) -> int:
+        denom = self.true_pos + self.false_neg
+        return (100 * self.true_pos) // denom if denom else 0
+
+    def precision(self) -> int:
+        denom = self.true_pos + self.false_pos
+        return (100 * self.true_pos) // denom if denom else 0
+
+    def accuracy(self) -> int:
+        total = self.true_pos + self.true_neg + self.false_pos + self.false_neg
+        return (100 * (self.true_pos + self.true_neg)) // total if total else 0
+
+    def export(self, counters: Counters, group: str = "Validation") -> None:
+        """Export with the reference's counter names (including its
+        'TrueNagative' typo, bayesian/BayesianPredictor.java:174)."""
+        counters.increment(group, "TruePositive", self.true_pos)
+        counters.increment(group, "FalseNegative", self.false_neg)
+        counters.increment(group, "TrueNagative", self.true_neg)
+        counters.increment(group, "FalsePositive", self.false_pos)
+        counters.increment(group, "Accuracy", self.accuracy())
+        counters.increment(group, "Recall", self.recall())
+        counters.increment(group, "Precision", self.precision())
+
+
+class CostBasedArbitrator:
+    """Misclassification-cost arbitration (util/CostBasedArbitrator.java:25-65).
+    Probabilities are integer percents, as in the reference."""
+
+    def __init__(self, neg_class: str, pos_class: str,
+                 false_neg_cost: int, false_pos_cost: int):
+        self.neg_class = neg_class
+        self.pos_class = pos_class
+        self.false_neg_cost = false_neg_cost
+        self.false_pos_cost = false_pos_cost
+
+    def arbitrate(self, pos_prob: int, neg_prob: int) -> str:
+        neg_cost = self.false_neg_cost * pos_prob + neg_prob
+        pos_cost = self.false_pos_cost * neg_prob + pos_prob
+        return self.pos_class if pos_cost < neg_cost else self.neg_class
+
+    def classify(self, pos_prob: int) -> str:
+        threshold = (self.false_pos_cost * 100) // (self.false_pos_cost + self.false_neg_cost)
+        return self.pos_class if pos_prob > threshold else self.neg_class
+
+    def classify_batch(self, pos_prob: np.ndarray) -> np.ndarray:
+        """Vectorized classify(): boolean array 'is positive'."""
+        threshold = (self.false_pos_cost * 100) // (self.false_pos_cost + self.false_neg_cost)
+        return np.asarray(pos_prob) > threshold

@@ -1,0 +1,165 @@
+"""Columnar dataset: CSV text -> encoded numpy/JAX arrays.
+
+This replaces the record-at-a-time layer of the reference (chombo ``Tuple``
+Writables + per-mapper ``value.toString().split(fieldDelimRegex)``, e.g.
+bayesian/BayesianDistribution.java:140).  There is no record object in the new
+design: a dataset is a struct of columns, each encoded once on load:
+
+  * categorical columns  -> int32 vocabulary codes (schema cardinality order;
+    unknown values -> -1)
+  * numeric columns      -> float64 values
+  * binned-numeric view  -> int32 bin codes, ``value // bucketWidth - offset``
+    (reference binning: bayesian/BayesianDistribution.java:152)
+  * id/string columns    -> kept host-side as python lists (never on device)
+
+A table can be padded to a multiple of the mesh size; ``valid_mask`` marks real
+rows so padded rows never contribute to reductions.
+
+A fast native CSV tokenizer (avenir_tpu.io.native_csv, C++) is used when the
+shared library is available; the numpy path is the fallback and the oracle.
+"""
+
+from __future__ import annotations
+
+import io
+import re
+from dataclasses import dataclass, field as dc_field
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .schema import FeatureField, FeatureSchema
+
+
+@dataclass
+class ColumnarTable:
+    schema: FeatureSchema
+    n_rows: int
+    # ordinal -> encoded column; int32 codes for categorical, float64 for numeric
+    columns: Dict[int, np.ndarray]
+    # ordinal -> raw string column for id/string/text fields (host side)
+    str_columns: Dict[int, List[str]] = dc_field(default_factory=dict)
+    # raw tokenized rows, kept only when the caller needs record echo in outputs
+    raw_rows: Optional[List[List[str]]] = None
+
+    # ---- views ----
+    def column(self, ordinal: int) -> np.ndarray:
+        return self.columns[ordinal]
+
+    def class_codes(self) -> np.ndarray:
+        return self.columns[self.schema.class_attr_field.ordinal]
+
+    def binned_codes(self, ordinal: int) -> np.ndarray:
+        """int32 bin codes in [0, num_bins) for a binned field (categorical code
+        or value // bucketWidth - bin_offset)."""
+        f = self.schema.find_field_by_ordinal(ordinal)
+        col = self.columns[ordinal]
+        if f.is_categorical:
+            return col.astype(np.int32)
+        if f.bucket_width is None:
+            raise ValueError(f"field {ordinal} has no finite bin alphabet")
+        return (col // f.bucket_width).astype(np.int32) - f.bin_offset
+
+    def feature_matrix(self, fields: Optional[Sequence[FeatureField]] = None,
+                       dtype=np.float64) -> np.ndarray:
+        """(n_rows, F) dense matrix of feature values (categorical as codes)."""
+        fields = list(fields if fields is not None else self.schema.feature_fields)
+        if not fields:
+            return np.zeros((self.n_rows, 0), dtype=dtype)
+        return np.stack([self.columns[f.ordinal].astype(dtype) for f in fields], axis=1)
+
+    def binned_feature_matrix(self, fields: Optional[Sequence[FeatureField]] = None
+                              ) -> np.ndarray:
+        """(n_rows, F) int32 matrix of bin codes for binned feature fields."""
+        fields = list(fields if fields is not None else
+                      [f for f in self.schema.feature_fields if f.is_binned])
+        if not fields:
+            return np.zeros((self.n_rows, 0), dtype=np.int32)
+        return np.stack([self.binned_codes(f.ordinal) for f in fields], axis=1)
+
+    def pad_to_multiple(self, multiple: int) -> "PaddedTable":
+        """Pad all encoded columns with zeros to a row count divisible by
+        ``multiple`` (the mesh data-axis size) and return the padded view with
+        its validity mask."""
+        n = self.n_rows
+        n_pad = (-n) % multiple
+        total = n + n_pad
+        cols = {}
+        for k, v in self.columns.items():
+            pad_val = 0
+            cols[k] = np.concatenate([v, np.full((n_pad,), pad_val, dtype=v.dtype)])
+        mask = np.zeros((total,), dtype=bool)
+        mask[:n] = True
+        return PaddedTable(schema=self.schema, n_rows=total, columns=cols,
+                           str_columns=self.str_columns, raw_rows=self.raw_rows,
+                           valid_mask=mask, n_valid=n)
+
+
+@dataclass
+class PaddedTable(ColumnarTable):
+    valid_mask: np.ndarray = None  # type: ignore[assignment]
+    n_valid: int = 0
+
+
+def _tokenize(text: str, delim_regex: str) -> List[List[str]]:
+    """Split lines on the reference's field.delim.regex (usually a plain ',')."""
+    rows: List[List[str]] = []
+    plain = re.escape(delim_regex) == delim_regex  # fast path for literal delims
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        rows.append(line.split(delim_regex) if plain else re.split(delim_regex, line))
+    return rows
+
+
+def encode_rows(rows: List[List[str]], schema: FeatureSchema,
+                keep_raw: bool = False) -> ColumnarTable:
+    """Encode tokenized rows into a ColumnarTable per the schema."""
+    n = len(rows)
+    columns: Dict[int, np.ndarray] = {}
+    str_columns: Dict[int, List[str]] = {}
+    for f in schema.fields:
+        o = f.ordinal
+        if f.is_categorical:
+            vocab = {v: i for i, v in enumerate(f.cardinality or [])}
+            col = np.fromiter((vocab.get(r[o].strip(), -1) for r in rows),
+                              dtype=np.int32, count=n)
+            columns[o] = col
+        elif f.is_numeric:
+            col = np.fromiter((float(r[o]) for r in rows), dtype=np.float64, count=n)
+            columns[o] = col
+        else:  # id / string / text: host side only
+            str_columns[o] = [r[o] for r in rows]
+    return ColumnarTable(schema=schema, n_rows=n, columns=columns,
+                         str_columns=str_columns,
+                         raw_rows=rows if keep_raw else None)
+
+
+def load_csv(source: Union[str, io.TextIOBase], schema: FeatureSchema,
+             delim_regex: str = ",", keep_raw: bool = False,
+             use_native: bool = True) -> ColumnarTable:
+    """Load a CSV file (path or file object) into a ColumnarTable.
+
+    Uses the native C++ tokenizer/encoder when available and the delimiter is a
+    literal single character; otherwise the pure-python path.
+    """
+    if isinstance(source, str):
+        if use_native and len(delim_regex) == 1:
+            try:
+                from ..io.native_csv import native_load_csv
+                t = native_load_csv(source, schema, delim_regex, keep_raw=keep_raw)
+                if t is not None:
+                    return t
+            except Exception:
+                pass  # fall back to python path
+        with open(source, "r") as fh:
+            text = fh.read()
+    else:
+        text = source.read()
+    rows = _tokenize(text, delim_regex)
+    return encode_rows(rows, schema, keep_raw=keep_raw)
+
+
+def load_csv_text(text: str, schema: FeatureSchema, delim_regex: str = ",",
+                  keep_raw: bool = False) -> ColumnarTable:
+    return encode_rows(_tokenize(text, delim_regex), schema, keep_raw=keep_raw)

@@ -1,0 +1,379 @@
+"""Naive Bayes: the minimum end-to-end slice of the framework (SURVEY.md §7.3).
+
+Capability parity with org.avenir.bayesian (SURVEY.md §2.2):
+
+  * ``train``   == BayesianDistribution (bayesian/BayesianDistribution.java):
+    one pass computing class priors, feature priors and feature posteriors.
+    Categorical and bucketed-numeric features count (class, ord, bin) cells;
+    unbucketed numeric features accumulate (count, Σx, Σx²) per class and
+    overall -> integer mean/σ, exactly as the reference's reducer
+    (:263-327, cleanup :240-258).
+  * model CSV  == the reference's model file, line for line (format decoded
+    from the reducer emits :298-327 and the predictor's parser
+    BayesianPredictor.java:186-224):
+        class,ord,bin,count        feature posterior (binned)
+        class,ord,,mean,stdDev     feature posterior (continuous)
+        class,,,count              class prior (one line per posterior cell)
+        ,ord,bin,count             feature prior (binned, per class slice)
+        ,ord,,mean,stdDev          feature prior (continuous)
+  * ``predict`` == BayesianPredictor (:396-419): per class
+    P(c|x) = P(x|c)·P(c)/P(x) as integer percent (truncated), default argmax
+    or cost-based arbitration, confusion-matrix counters.
+
+TPU design: the whole training pass is two MXU contractions over row-sharded
+arrays (ops.histogram.class_bin_histogram / class_moments); XLA inserts the
+cross-shard all-reduce.  Prediction is a gather of per-feature log-probs plus
+a tiny (C,)-vector epilogue per record, all vmapped.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.schema import FeatureSchema, FeatureField
+from ..core.table import ColumnarTable
+from ..core.metrics import ConfusionMatrix, Counters, CostBasedArbitrator
+from ..parallel.mesh import MeshContext
+from ..ops.histogram import class_bin_histogram, class_moments
+
+
+# --------------------------------------------------------------------------
+# model container
+# --------------------------------------------------------------------------
+
+@dataclass
+class NaiveBayesModel:
+    schema: FeatureSchema
+    class_values: List[str]
+    binned_ordinals: List[int]          # feature ordinals with finite bins
+    cont_ordinals: List[int]            # unbucketed numeric feature ordinals
+    num_bins: List[int]                 # per binned ordinal
+    # counts
+    post_counts: np.ndarray             # (C, Fb, Bmax) float
+    class_counts: np.ndarray            # (C,) float   (true per-class record counts)
+    prior_counts: np.ndarray            # (Fb, Bmax) float
+    total: float                        # total record count
+    # continuous gaussian parameters, reference-rounded to integer longs
+    cont_post_mean: np.ndarray          # (C, Fc)
+    cont_post_std: np.ndarray           # (C, Fc)
+    cont_prior_mean: np.ndarray         # (Fc,)
+    cont_prior_std: np.ndarray          # (Fc,)
+
+    # ---- serialization: reference model CSV ----
+    def to_lines(self, delim: str = ",") -> List[str]:
+        """Emit the model file with the reference reducer's line set and order:
+        for each (class, ord, bin) cell in key-sort order a [posterior,
+        class-prior, feature-prior] triple, then continuous feature priors
+        (the reducer-cleanup lines) at the end."""
+        lines: List[str] = []
+        C = len(self.class_values)
+        # Hadoop shuffle sorts Tuple keys (classVal:str, ord:int, bin:str);
+        # bin sorts lexicographically because it is a string in the Tuple.
+        cells = []
+        for ci, cv in enumerate(self.class_values):
+            for fi, o in enumerate(self.binned_ordinals):
+                field = self.schema.find_field_by_ordinal(o)
+                for b in range(self.num_bins[fi]):
+                    cnt = int(round(self.post_counts[ci, fi, b]))
+                    if cnt > 0:
+                        cells.append((cv, o, field.bin_label(b), ci, fi, b, cnt))
+            for fi, o in enumerate(self.cont_ordinals):
+                cells.append((cv, o, None, ci, fi, None, None))
+        cells.sort(key=lambda t: (t[0], t[1], "" if t[2] is None else t[2]))
+        for cv, o, bin_label, ci, fi, b, cnt in cells:
+            if bin_label is not None:
+                lines.append(delim.join([cv, str(o), bin_label, str(cnt)]))
+                lines.append(delim.join([cv, "", "", str(cnt)]))
+                lines.append(delim.join(["", str(o), bin_label, str(cnt)]))
+            else:
+                mean = int(self.cont_post_mean[ci, fi])
+                std = int(self.cont_post_std[ci, fi])
+                lines.append(delim.join([cv, str(o), "", str(mean), str(std)]))
+                ccount = int(round(self.class_counts[ci]))
+                lines.append(delim.join([cv, "", "", str(ccount)]))
+        for fi, o in enumerate(self.cont_ordinals):
+            mean = int(self.cont_prior_mean[fi])
+            std = int(self.cont_prior_std[fi])
+            lines.append(delim.join(["", str(o), "", str(mean), str(std)]))
+        return lines
+
+    @classmethod
+    def from_lines(cls, lines: Sequence[str], schema: FeatureSchema,
+                   delim: str = ",") -> "NaiveBayesModel":
+        """Parse the reference model CSV (BayesianPredictor.loadModel
+        semantics: duplicate bin lines accumulate)."""
+        class_field = schema.class_attr_field
+        class_values = list(class_field.cardinality or [])
+        binned = [f for f in schema.feature_fields if f.is_binned]
+        cont = [f for f in schema.feature_fields if not f.is_binned]
+        b_ords = [f.ordinal for f in binned]
+        c_ords = [f.ordinal for f in cont]
+        nbins = [f.num_bins for f in binned]
+        bmax = max(nbins) if nbins else 1
+        C, Fb, Fc = len(class_values), len(b_ords), len(c_ords)
+        post = np.zeros((C, Fb, bmax))
+        prior = np.zeros((Fb, bmax))
+        cls_counts = np.zeros((C,))
+        cpm = np.zeros((C, Fc)); cps = np.ones((C, Fc))
+        cqm = np.zeros((Fc,)); cqs = np.ones((Fc,))
+        b_index = {o: i for i, o in enumerate(b_ords)}
+        c_index = {o: i for i, o in enumerate(c_ords)}
+        cls_index = {v: i for i, v in enumerate(class_values)}
+
+        def bin_code(field: FeatureField, label: str) -> int:
+            if field.is_categorical:
+                return field.cat_code(label)
+            return int(label) - field.bin_offset
+
+        for line in lines:
+            items = line.split(delim)
+            ord_s = items[1]
+            if items[0] == "":
+                if items[2] != "":       # feature prior binned
+                    f = schema.find_field_by_ordinal(int(ord_s))
+                    prior[b_index[int(ord_s)], bin_code(f, items[2])] += int(items[3])
+                else:                     # feature prior continuous
+                    ci2 = c_index[int(ord_s)]
+                    cqm[ci2] = float(items[3]); cqs[ci2] = float(items[4])
+            elif ord_s == "" and items[2] == "":  # class prior
+                ci = cls_index[items[0]]
+                cls_counts[ci] += int(items[3])
+            else:
+                ci = cls_index[items[0]]
+                f = schema.find_field_by_ordinal(int(ord_s))
+                if items[2] != "":        # posterior binned
+                    post[ci, b_index[int(ord_s)], bin_code(f, items[2])] += int(items[3])
+                else:                     # posterior continuous
+                    fi2 = c_index[int(ord_s)]
+                    cpm[ci, fi2] = float(items[3]); cps[ci, fi2] = float(items[4])
+        # class prior lines are emitted once per (class,ord,bin) cell, each
+        # carrying that cell's count; the per-class record count is the sum
+        # over ONE feature's bins.  With Fb binned features (+Fc cont), the
+        # accumulated value is (Fb+Fc) * classCount; undo the multiplicity.
+        mult = max(Fb + Fc, 1)
+        cls_counts = cls_counts / mult
+        total = cls_counts.sum()
+        return cls(schema=schema, class_values=class_values,
+                   binned_ordinals=b_ords, cont_ordinals=c_ords, num_bins=nbins,
+                   post_counts=post, class_counts=cls_counts, prior_counts=prior,
+                   total=float(total), cont_post_mean=cpm, cont_post_std=cps,
+                   cont_prior_mean=cqm, cont_prior_std=cqs)
+
+
+# --------------------------------------------------------------------------
+# training
+# --------------------------------------------------------------------------
+
+def train(table: ColumnarTable, ctx: Optional[MeshContext] = None,
+          counters: Optional[Counters] = None) -> NaiveBayesModel:
+    """One-pass distribution computation (== BayesianDistribution MR job).
+
+    Rows are padded to the mesh size and sharded over the data axis; the
+    histogram/moment contractions reduce over rows, so GSPMD emits per-shard
+    partials + all-reduce — the exact combiner+shuffle structure of the
+    reference job, in one XLA program.
+    """
+    ctx = ctx or MeshContext()
+    schema = table.schema
+    class_field = schema.class_attr_field
+    class_values = list(class_field.cardinality or [])
+    C = len(class_values)
+    binned = [f for f in schema.feature_fields if f.is_binned]
+    cont = [f for f in schema.feature_fields if not f.is_binned]
+    nbins = [f.num_bins for f in binned]
+    bmax = max(nbins) if nbins else 1
+
+    padded = table.pad_to_multiple(ctx.n_devices)
+    mask = ctx.shard_rows(padded.valid_mask)
+    cls_codes = ctx.shard_rows(padded.columns[class_field.ordinal])
+    if binned:
+        bin_codes = np.stack([padded.binned_codes(f.ordinal) for f in binned], axis=1)
+    else:
+        bin_codes = np.zeros((padded.n_rows, 0), dtype=np.int32)
+    bin_codes = ctx.shard_rows(bin_codes)
+    if cont:
+        # reference parses continuous values as integers (long)
+        cont_vals = np.stack(
+            [np.trunc(padded.columns[f.ordinal]) for f in cont], axis=1)
+    else:
+        cont_vals = np.zeros((padded.n_rows, 0), dtype=np.float64)
+    cont_vals = ctx.shard_rows(cont_vals.astype(np.float32))
+
+    @jax.jit
+    def kernel(cc, bc, cv, m):
+        counts = class_bin_histogram(cc, bc, C, bmax, m)
+        cls_counts = jax.nn.one_hot(cc, C, dtype=jnp.float32)
+        cls_counts = (cls_counts * m.astype(jnp.float32)[:, None]).sum(axis=0)
+        moments = class_moments(cc, cv, C, m)
+        return counts, cls_counts, moments
+
+    counts, cls_counts, moments = (
+        np.array(x) for x in kernel(cls_codes, bin_codes, cont_vals, mask))
+
+    # zero out bins beyond each field's alphabet (padding of Bmax)
+    for fi, nb in enumerate(nbins):
+        counts[:, fi, nb:] = 0.0
+    prior = counts.sum(axis=0)
+
+    # continuous gaussian params with the reference's integer rounding
+    # (mean = valSum/count integer division; std = (long)sqrt((Σx²-n·mean²)/(n-1)))
+    def gauss(mom):  # mom (..., 3)
+        cnt = np.maximum(mom[..., 0], 1.0)
+        mean = np.floor(mom[..., 1] / cnt)
+        var = (mom[..., 2] - cnt * mean * mean) / np.maximum(cnt - 1.0, 1.0)
+        std = np.floor(np.sqrt(np.maximum(var, 0.0)))
+        return mean, std
+
+    cpm, cps = gauss(moments)                       # (C, Fc)
+    prior_mom = moments.sum(axis=0)                 # (Fc, 3)
+    cqm, cqs = gauss(prior_mom)
+
+    if counters is not None:
+        counters.increment("Distribution Data", "Feature posterior binned ",
+                           int((counts > 0).sum()))
+        counters.increment("Distribution Data", "Class prior", C)
+
+    return NaiveBayesModel(
+        schema=schema, class_values=class_values,
+        binned_ordinals=[f.ordinal for f in binned],
+        cont_ordinals=[f.ordinal for f in cont], num_bins=nbins,
+        post_counts=counts, class_counts=cls_counts, prior_counts=prior,
+        total=float(cls_counts.sum()),
+        cont_post_mean=cpm, cont_post_std=cps,
+        cont_prior_mean=cqm, cont_prior_std=cqs)
+
+
+# --------------------------------------------------------------------------
+# prediction
+# --------------------------------------------------------------------------
+
+@dataclass
+class PredictionResult:
+    pred_class: List[str]           # per record
+    pred_prob: np.ndarray           # (n,) int percent
+    class_probs: np.ndarray         # (n, C) int percent
+    class_prob_diff: Optional[np.ndarray] = None
+    # raw doubles for bap.output.feature.prob.only mode
+    # (BayesianPredictor.outputFeatureProb :276-286)
+    feature_prior_prob: Optional[np.ndarray] = None    # (n,)   P(x)
+    feature_post_prob: Optional[np.ndarray] = None     # (n, C) P(x|c)
+
+
+def _log(x, eps=1e-30):
+    return jnp.log(jnp.clip(x, eps, None))
+
+
+def predict(model: NaiveBayesModel, table: ColumnarTable,
+            ctx: Optional[MeshContext] = None) -> PredictionResult:
+    """Per-record class posterior integer percents
+    (BayesianPredictor.predictClassValue :396-419).
+
+    classPostProb = (int)(P(x|c)·P(c)/P(x) · 100) with
+    P(x|c) = Π_f post[c,f,bin_f]/classCount_c (Gaussian density for
+    continuous), P(x) = Π_f prior[f,bin_f]/total.
+    """
+    ctx = ctx or MeshContext()
+    schema = model.schema
+    C = len(model.class_values)
+    binned_fields = [schema.find_field_by_ordinal(o) for o in model.binned_ordinals]
+    cont_fields = [schema.find_field_by_ordinal(o) for o in model.cont_ordinals]
+    bmax = model.post_counts.shape[2] if model.binned_ordinals else 1
+
+    padded = table.pad_to_multiple(ctx.n_devices)
+    if binned_fields:
+        bin_codes = np.stack(
+            [padded.binned_codes(f.ordinal) for f in binned_fields], axis=1)
+    else:
+        bin_codes = np.zeros((padded.n_rows, 0), dtype=np.int32)
+    if cont_fields:
+        cont_vals = np.stack(
+            [np.trunc(padded.columns[f.ordinal]) for f in cont_fields], axis=1)
+    else:
+        cont_vals = np.zeros((padded.n_rows, 0), dtype=np.float64)
+
+    # normalized log-prob tables (replicated small arrays)
+    post_p = model.post_counts / np.maximum(model.class_counts[:, None, None], 1.0)
+    prior_p = model.prior_counts / max(model.total, 1.0)
+    class_p = model.class_counts / max(model.total, 1.0)
+
+    log_post = ctx.replicate(_log(jnp.asarray(post_p, dtype=jnp.float32)))
+    log_prior = ctx.replicate(_log(jnp.asarray(prior_p, dtype=jnp.float32)))
+    log_class = ctx.replicate(_log(jnp.asarray(class_p, dtype=jnp.float32)))
+    bc = ctx.shard_rows(bin_codes)
+    cv = ctx.shard_rows(cont_vals.astype(np.float32))
+
+    cpm = ctx.replicate(jnp.asarray(model.cont_post_mean, dtype=jnp.float32))
+    cps = ctx.replicate(jnp.asarray(np.maximum(model.cont_post_std, 1e-6), dtype=jnp.float32))
+    cqm = ctx.replicate(jnp.asarray(model.cont_prior_mean, dtype=jnp.float32))
+    cqs = ctx.replicate(jnp.asarray(np.maximum(model.cont_prior_std, 1e-6), dtype=jnp.float32))
+
+    nbins_arr = jnp.asarray(model.num_bins if model.num_bins else [1], dtype=jnp.int32)
+
+    @jax.jit
+    def kernel(bc, cv, log_post, log_prior, log_class, cpm, cps, cqm, cqs):
+        safe = jnp.clip(bc, 0, bmax - 1)                      # (n, Fb)
+        # unknown categorical (-1) or out-of-alphabet bin: skip the feature
+        # entirely (contribute to neither P(x|c) nor P(x)); the reference's
+        # missing-BinCount lookup degenerates to 0/0, so skipping is the
+        # well-defined superset behavior.
+        known = (bc >= 0) & (bc < nbins_arr[None, :len(model.num_bins) or 1])
+        known_f = known.astype(jnp.float32)                   # (n, Fb)
+        # gather per-feature log probs: (n, C, Fb) from (C, Fb, B)
+        lp_post = jnp.take_along_axis(
+            log_post[None], safe[:, None, :, None].repeat(C, axis=1), axis=3
+        )[..., 0]                                             # (n, C, Fb)
+        lp_prior = jnp.take_along_axis(log_prior[None], safe[:, :, None], axis=2)[..., 0]
+        lp_post = lp_post * known_f[:, None, :]
+        lp_prior = lp_prior * known_f
+        # continuous gaussian log densities
+        def g(x, mu, sd):
+            return -0.5 * ((x - mu) / sd) ** 2 - jnp.log(sd * np.sqrt(2 * np.pi))
+        lg_post = g(cv[:, None, :], cpm[None], cps[None])     # (n, C, Fc)
+        lg_prior = g(cv, cqm[None], cqs[None])                # (n, Fc)
+        log_px_c = lp_post.sum(axis=2) + lg_post.sum(axis=2)  # (n, C)
+        log_px = lp_prior.sum(axis=1) + lg_prior.sum(axis=1)  # (n,)
+        log_ratio = log_px_c + log_class[None] - log_px[:, None]
+        probs = jnp.exp(log_ratio)
+        pct = jnp.floor(probs * 100.0).astype(jnp.int32)      # (n, C)
+        return pct, jnp.exp(log_px), jnp.exp(log_px_c)
+
+    pct, px, pxc = (np.asarray(x)[:table.n_rows] for x in kernel(
+        bc, cv, log_post, log_prior, log_class, cpm, cps, cqm, cqs))
+    best = np.argmax(pct, axis=1)
+    pred_prob = pct[np.arange(len(best)), best]
+    # difference with the next-highest class prob (defaultArbitrate :345-365)
+    if C > 1:
+        sorted_pct = np.sort(pct, axis=1)
+        diff = sorted_pct[:, -1] - sorted_pct[:, -2]
+    else:
+        diff = np.full(len(best), 100)
+    pred_class = [model.class_values[i] for i in best]
+    return PredictionResult(pred_class=pred_class, pred_prob=pred_prob,
+                            class_probs=pct, class_prob_diff=diff,
+                            feature_prior_prob=px, feature_post_prob=pxc)
+
+
+def evaluate(model: NaiveBayesModel, table: ColumnarTable,
+             result: PredictionResult,
+             neg_class: Optional[str] = None, pos_class: Optional[str] = None,
+             counters: Optional[Counters] = None) -> ConfusionMatrix:
+    """Validation-mode confusion matrix export (BayesianPredictor.cleanup
+    :170-180)."""
+    if neg_class is None or pos_class is None:
+        card = model.class_values
+        neg_class, pos_class = card[0], card[1]
+    cm = ConfusionMatrix(neg_class, pos_class)
+    actual_codes = table.class_codes()
+    actual = [model.class_values[c] if c >= 0 else "?" for c in actual_codes]
+    for p, a in zip(result.pred_class, actual):
+        cm.report(p, a)
+    if counters is not None:
+        cm.export(counters)
+    return cm

@@ -1,0 +1,113 @@
+"""Counting kernels: the universal primitive of the rebuilt framework.
+
+Almost every reducer in the reference is 'sum 1s (or moments) per composite
+key' (SURVEY.md §7 guiding translation).  On TPU that is a dense one-hot
+contraction that XLA tiles onto the MXU; under GSPMD with row-sharded inputs
+the per-shard partial sums + all-reduce reproduce the combiner+shuffle
+exactly (map-side combine for free).
+
+All kernels take a ``mask`` so padded rows (ColumnarTable.pad_to_multiple)
+contribute nothing.  Counts are accumulated in float32 by default — exact for
+counts < 2^24 per partial; callers that stream >16M rows per shard should use
+the chunked variants which accumulate in float32 across chunks of bounded
+one-hot materialization.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def class_bin_histogram(class_codes: jnp.ndarray,    # (n,) int
+                        bin_codes: jnp.ndarray,      # (n, F) int
+                        num_classes: int,
+                        num_bins: int,
+                        mask: Optional[jnp.ndarray] = None,
+                        dtype=jnp.float32) -> jnp.ndarray:
+    """counts[c, f, b] = #records with class c and feature f in bin b.
+
+    The one-shot kernel behind BayesianDistribution's mapper+reducer
+    (bayesian/BayesianDistribution.java:139-178, 263-327) and the per-node
+    class histograms of the tree builder.  Out-of-range / negative bin codes
+    (unknown categorical values) are dropped, as is anything with mask=False.
+    """
+    valid = (bin_codes >= 0) & (bin_codes < num_bins)
+    if mask is not None:
+        valid = valid & mask[:, None]
+    oh_c = jax.nn.one_hot(class_codes, num_classes, dtype=dtype)        # (n, C)
+    oh_b = jax.nn.one_hot(bin_codes, num_bins, dtype=dtype)             # (n, F, B)
+    oh_b = oh_b * valid.astype(dtype)[:, :, None]
+    # (n,C) x (n,F,B) -> (C,F,B): one big MXU contraction
+    return jnp.einsum("nc,nfb->cfb", oh_c, oh_b)
+
+
+def class_bin_histogram_chunked(class_codes, bin_codes, num_classes, num_bins,
+                                mask=None, chunk: int = 1 << 18,
+                                dtype=jnp.float32) -> jnp.ndarray:
+    """Streaming variant: scan over row chunks so the (chunk, F, B) one-hot is
+    the only large intermediate.  Used for big ingests where n*F*B floats
+    would blow HBM."""
+    n = class_codes.shape[0]
+    pad = (-n) % chunk
+    cc = jnp.pad(class_codes, (0, pad), constant_values=0)
+    bc = jnp.pad(bin_codes, ((0, pad), (0, 0)), constant_values=-1)
+    m = mask if mask is not None else jnp.ones((n,), dtype=bool)
+    m = jnp.pad(m, (0, pad), constant_values=False)
+    n_chunks = cc.shape[0] // chunk
+    cc = cc.reshape(n_chunks, chunk)
+    bc = bc.reshape(n_chunks, chunk, -1)
+    m = m.reshape(n_chunks, chunk)
+
+    def body(acc, xs):
+        c, b, mm = xs
+        return acc + class_bin_histogram(c, b, num_classes, num_bins, mm, dtype), None
+
+    init = jnp.zeros((num_classes, bin_codes.shape[1], num_bins), dtype=dtype)
+    acc, _ = jax.lax.scan(body, init, (cc, bc, m))
+    return acc
+
+
+def class_moments(class_codes: jnp.ndarray,   # (n,)
+                  values: jnp.ndarray,        # (n, F) float
+                  num_classes: int,
+                  mask: Optional[jnp.ndarray] = None,
+                  dtype=jnp.float32) -> jnp.ndarray:
+    """moments[c, f, :] = (count, sum x, sum x^2) per class for continuous
+    features (the unbinned-numeric path of BayesianDistribution.java:166-171)."""
+    oh_c = jax.nn.one_hot(class_codes, num_classes, dtype=dtype)  # (n, C)
+    if mask is not None:
+        oh_c = oh_c * mask.astype(dtype)[:, None]
+    v = values.astype(dtype)
+    stacked = jnp.stack([jnp.ones_like(v), v, v * v], axis=-1)    # (n, F, 3)
+    return jnp.einsum("nc,nfm->cfm", oh_c, stacked)
+
+
+def joint_histogram(a_codes: jnp.ndarray, b_codes: jnp.ndarray,
+                    num_a: int, num_b: int,
+                    mask: Optional[jnp.ndarray] = None,
+                    dtype=jnp.float32) -> jnp.ndarray:
+    """counts[a, b] joint histogram of two code columns (contingency matrix /
+    MutualInformation pair distributions, explore/MutualInformation.java)."""
+    valid = (a_codes >= 0) & (b_codes >= 0)
+    if mask is not None:
+        valid = valid & mask
+    oh_a = jax.nn.one_hot(a_codes, num_a, dtype=dtype) * valid.astype(dtype)[:, None]
+    oh_b = jax.nn.one_hot(b_codes, num_b, dtype=dtype)
+    return oh_a.T @ oh_b
+
+
+def entropy(p: jnp.ndarray, axis=-1, eps: float = 1e-12) -> jnp.ndarray:
+    """Shannon entropy of a probability vector along an axis (natural log?
+    no — the reference uses log2: util/InfoContentStat.java entropy via
+    Math.log(p)/Math.log(2))."""
+    p = jnp.clip(p, eps, 1.0)
+    return -(p * jnp.log2(p)).sum(axis=axis)
+
+
+def gini(p: jnp.ndarray, axis=-1) -> jnp.ndarray:
+    """Gini index 1 - sum p^2 (util/InfoContentStat.java:71 gini branch)."""
+    return 1.0 - (p * p).sum(axis=axis)

@@ -1,0 +1,148 @@
+"""The five communication idioms of the reference, TPU-native.
+
+SURVEY.md §2.10 inventories every distributed mechanism the reference uses and
+its TPU equivalent.  This module is that equivalence table as code:
+
+  | reference mechanism                  | here                                |
+  |--------------------------------------|-------------------------------------|
+  | map over HDFS blocks                 | row-sharded arrays + jit (GSPMD)    |
+  | shuffle groupBy -> reducer           | keyed_reduce / one-hot contraction  |
+  | combiner (map-side pre-aggregation)  | automatic: per-shard partial sums   |
+  |                                      | before the psum XLA inserts         |
+  | broadcast of model/callback          | replicated arrays                   |
+  | counters / accumulators              | counter_sum (psum'd scalar dict)    |
+  | mapPartitions independent chains     | chain_fanout (shard_map)            |
+
+Two styles are provided on purpose:
+
+  * **GSPMD style** (preferred): write plain jnp math over row-sharded inputs
+    and let XLA insert the collectives.  ``sharded_jit_reduce`` wraps that
+    pattern: in_shardings=P('data') for batch args, out replicated.
+  * **explicit style**: ``shard_map``-based wrappers for when the layout must
+    be pinned (independent chains with per-device state, psum'd counters).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+try:
+    from jax import shard_map  # jax >= 0.8
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+from .mesh import MeshContext
+
+
+# --------------------------------------------------------------------------
+# idiom 1+2+4: sharded map + keyed reduce + scalar aggregate, GSPMD style
+# --------------------------------------------------------------------------
+
+def sharded_jit_reduce(fn: Callable, ctx: MeshContext,
+                       n_batch_args: int = 1, donate: bool = False):
+    """jit ``fn(batch_arg0, ..., *replicated_args)`` with the first
+    ``n_batch_args`` arguments row-sharded over the data axis and everything
+    else replicated; outputs replicated.  XLA turns any full reduction inside
+    into per-shard partials + all-reduce (the combiner+shuffle of the
+    reference, e.g. MutualInformation.java:243's combiner, for free)."""
+    row = NamedSharding(ctx.mesh, P(ctx.axis))
+    rep = NamedSharding(ctx.mesh, P())
+    jitted_cache: Dict[int, Callable] = {}
+
+    @functools.wraps(fn)
+    def call(*args):
+        jitted = jitted_cache.get(len(args))
+        if jitted is None:
+            in_sh = tuple(row if i < n_batch_args else rep for i in range(len(args)))
+            jitted = jax.jit(fn, in_shardings=in_sh, out_shardings=rep,
+                             donate_argnums=tuple(range(n_batch_args)) if donate else ())
+            jitted_cache[len(args)] = jitted
+        return jitted(*args)
+
+    return call
+
+
+def keyed_reduce(values: jnp.ndarray, keys: jnp.ndarray, num_keys: int,
+                 mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """The shuffle: sum ``values`` (n, ...) into ``num_keys`` groups by key
+    (n,) int32.  Invalid/padded rows carry mask=False.  Dense one-hot matmul
+    formulation so XLA tiles it onto the MXU instead of scatter-adds.
+
+    Equivalent of every reducer-side 'sum values per Tuple key' in the
+    reference (e.g. bayesian/BayesianDistribution.java:273-281)."""
+    onehot = jax.nn.one_hot(keys, num_keys, dtype=values.dtype)  # (n, k)
+    if mask is not None:
+        onehot = onehot * mask.astype(values.dtype)[:, None]
+    # (k, n) @ (n, ...) -> (k, ...)
+    return jnp.tensordot(onehot, values, axes=[[0], [0]])
+
+
+def keyed_count(keys: jnp.ndarray, num_keys: int,
+                mask: Optional[jnp.ndarray] = None,
+                dtype=jnp.float32) -> jnp.ndarray:
+    """Histogram of keys: the degenerate keyed_reduce with values=1."""
+    onehot = jax.nn.one_hot(keys, num_keys, dtype=dtype)
+    if mask is not None:
+        onehot = onehot * mask.astype(dtype)[:, None]
+    return onehot.sum(axis=0)
+
+
+# --------------------------------------------------------------------------
+# idiom 3: broadcast
+# --------------------------------------------------------------------------
+
+def replicate(ctx: MeshContext, tree):
+    """Broadcast of a read-only model (SimulatedAnnealing.scala:85)."""
+    return jax.tree_util.tree_map(ctx.replicate, tree)
+
+
+# --------------------------------------------------------------------------
+# idiom 4 explicit: counters
+# --------------------------------------------------------------------------
+
+def counter_sum(ctx: MeshContext, fn: Callable):
+    """Wrap a per-shard fn returning a dict of scalar metrics; returns the
+    psum across shards (Hadoop counters / Spark accumulators)."""
+    def inner(*args):
+        out = fn(*args)
+        return jax.tree_util.tree_map(lambda x: jax.lax.psum(x, ctx.axis), out)
+
+    return shard_map(inner, mesh=ctx.mesh,
+                     in_specs=P(ctx.axis), out_specs=P())
+
+
+# --------------------------------------------------------------------------
+# idiom 5: independent-chain fan-out (mapPartitions)
+# --------------------------------------------------------------------------
+
+def chain_fanout(ctx: MeshContext, step_fn: Callable,
+                 state_specs: Any = None) -> Callable:
+    """Run independent per-chain computations with chains sharded over the
+    mesh: the analog of Spark mapPartitions running one SA/GA chain per
+    executor (SimulatedAnnealing.scala:109, GeneticAlgorithm.scala:69).
+
+    ``step_fn(state_tree)`` maps a pytree whose leaves have leading dim =
+    total chains (divisible by mesh size) to a pytree of the same leading dim.
+    Inside, each device sees only its chains; there is no cross-chain
+    communication, so no collectives are emitted at all."""
+    spec = P(ctx.axis) if state_specs is None else state_specs
+    return jax.jit(shard_map(step_fn, mesh=ctx.mesh, in_specs=spec,
+                             out_specs=spec))
+
+
+# --------------------------------------------------------------------------
+# segment top-k (secondary-sort replacement)
+# --------------------------------------------------------------------------
+
+def grouped_top_k(scores: jnp.ndarray, k: int, largest: bool = True):
+    """Per-row top-k of a (groups, candidates) score matrix: replaces the
+    reference's secondary sort (values arriving rank-sorted per key,
+    knn/NearestNeighbor.java:80-81) with lax.top_k.
+    Returns (values, indices), each (groups, k)."""
+    s = scores if largest else -scores
+    vals, idx = jax.lax.top_k(s, k)
+    return (vals if largest else -vals), idx

@@ -1,0 +1,81 @@
+"""Device mesh management: the execution substrate replacing the Hadoop/Spark
+cluster (SURVEY.md §2.10).
+
+Everything distributed in this framework runs over one `jax.sharding.Mesh`:
+
+  * ``data`` axis — row parallelism: the analog of HDFS-block map parallelism.
+    Batches are sharded over it; reductions psum across it (the shuffle).
+  * ``chain`` axis (optional, folded into data by default) — independent-chain
+    fan-out for optimizers/bandits (the analog of Spark mapPartitions).
+
+Multi-host/multi-slice: the mesh is built from `jax.devices()`, which under
+jax.distributed spans hosts; collectives ride ICI within a slice and DCN across
+slices with no code change here.  On CPU the same code paths are exercised with
+``XLA_FLAGS=--xla_force_host_platform_device_count=N`` (test conftest).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              axis_name: str = DATA_AXIS,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """1-D mesh over all (or the first n) devices."""
+    devs = list(devices if devices is not None else jax.devices())
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis_name,))
+
+
+_default_mesh: Optional[Mesh] = None
+
+
+def default_mesh() -> Mesh:
+    global _default_mesh
+    if _default_mesh is None or len(_default_mesh.devices.flat) != len(jax.devices()):
+        _default_mesh = make_mesh()
+    return _default_mesh
+
+
+class MeshContext:
+    """Convenience wrapper bundling a mesh with sharding helpers.
+
+    This is the runtime handle every job gets (the analog of the Hadoop
+    ``Configuration`` + cluster connection in reference job drivers, e.g.
+    tree/DecisionTreeBuilder.java:70-94).
+    """
+
+    def __init__(self, mesh: Optional[Mesh] = None):
+        self.mesh = mesh if mesh is not None else default_mesh()
+        self.axis = self.mesh.axis_names[0]
+
+    @property
+    def n_devices(self) -> int:
+        return self.mesh.devices.size
+
+    def row_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P(self.axis))
+
+    def replicated_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def shard_rows(self, arr) -> jax.Array:
+        """Place an array row-sharded over the data axis.  Row count must be a
+        multiple of the mesh size (use ColumnarTable.pad_to_multiple first)."""
+        return jax.device_put(arr, self.row_sharding())
+
+    def replicate(self, arr) -> jax.Array:
+        return jax.device_put(arr, self.replicated_sharding())
+
+    def shard_table(self, padded, arrays: dict) -> dict:
+        """Shard a dict of per-row arrays (all first-dim n_rows)."""
+        return {k: self.shard_rows(v) for k, v in arrays.items()}

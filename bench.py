@@ -1,0 +1,77 @@
+"""Benchmark: NaiveBayes training throughput (rows/sec/chip).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no numbers (BASELINE.md), so vs_baseline is measured
+in-process: a row-at-a-time pure-Python counting loop — the per-record work a
+reference Hadoop mapper+combiner performs (bayesian/BayesianDistribution.java
+:139-178) — timed on a sample and extrapolated, giving a conservative
+single-core stand-in for the JVM baseline.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def gen_data(n, n_feat=6, n_bins=12, n_classes=2, seed=0):
+    rng = np.random.default_rng(seed)
+    cls = rng.integers(0, n_classes, n).astype(np.int32)
+    bins = rng.integers(0, n_bins, (n, n_feat)).astype(np.int32)
+    return cls, bins
+
+
+def reference_rate(sample=200_000, n_feat=6, n_bins=12, n_classes=2):
+    """Pure-python mapper-equivalent: per record, per feature, bump a dict
+    counter keyed (class, ord, bin) — what the reference mapper emits and its
+    combiner folds."""
+    cls, bins = gen_data(sample)
+    counts = {}
+    t0 = time.perf_counter()
+    for i in range(sample):
+        c = cls[i]
+        row = bins[i]
+        for f in range(n_feat):
+            key = (c, f, row[f])
+            counts[key] = counts.get(key, 0) + 1
+    dt = time.perf_counter() - t0
+    return sample / dt
+
+
+def tpu_rate(n=8_000_000, n_feat=6, n_bins=12, n_classes=2):
+    import jax
+    import jax.numpy as jnp
+    from avenir_tpu.ops.histogram import class_bin_histogram_chunked
+
+    cls, bins = gen_data(n)
+    mask = np.ones((n,), dtype=bool)
+    d_cls, d_bins, d_mask = (jax.device_put(x) for x in (cls, bins, mask))
+
+    fn = jax.jit(lambda c, b, m: class_bin_histogram_chunked(
+        c, b, n_classes, n_bins, m, chunk=1 << 19))
+    np.asarray(fn(d_cls, d_bins, d_mask))  # compile + warm
+    # NOTE: time with a host readback of the (tiny) result each rep —
+    # block_until_ready is unreliable on the axon platform, and the readback
+    # of a (C,F,B) array adds negligible transfer.
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = np.asarray(fn(d_cls, d_bins, d_mask))
+    dt = (time.perf_counter() - t0) / reps
+    return n / dt
+
+
+def main():
+    ref = reference_rate()
+    ours = tpu_rate()
+    print(json.dumps({
+        "metric": "naive_bayes_train_rows_per_sec_per_chip",
+        "value": round(ours, 1),
+        "unit": "rows/sec/chip",
+        "vs_baseline": round(ours / ref, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+"""Test environment: force a virtual 8-device CPU mesh before JAX imports.
+
+Multi-chip hardware is not available in CI; sharding/collective paths are
+exercised on a fake 8-device CPU backend (SURVEY.md §4's 'fake backend'
+strategy).  Must run before any `import jax` — conftest is imported first by
+pytest, and env vars only take effect at backend init.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+# The container's sitecustomize imports jax at interpreter start (before this
+# conftest) with JAX_PLATFORMS=axon baked in, so the env var alone is too late;
+# the config update below still works because backends initialize lazily.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) >= 8, (
+    f"expected virtual 8-device CPU backend, got {jax.devices()}")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def mesh_ctx():
+    from avenir_tpu.parallel.mesh import MeshContext, make_mesh
+    return MeshContext(make_mesh())
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(42)

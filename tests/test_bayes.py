@@ -1,0 +1,144 @@
+"""Naive Bayes vs numpy oracle + model CSV round-trip + end-to-end."""
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core.schema import FeatureSchema
+from avenir_tpu.core.table import load_csv_text, encode_rows
+from avenir_tpu.core.metrics import Counters
+from avenir_tpu.models import bayes
+
+
+SCHEMA = FeatureSchema.from_dict({
+    "fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "plan", "ordinal": 1, "dataType": "categorical", "feature": True,
+         "cardinality": ["basic", "plus", "pro"]},
+        {"name": "usage", "ordinal": 2, "dataType": "int", "feature": True,
+         "bucketWidth": 50, "min": 0, "max": 500},
+        {"name": "tenure", "ordinal": 3, "dataType": "int", "feature": True},
+        {"name": "status", "ordinal": 4, "dataType": "categorical",
+         "cardinality": ["open", "closed"]},
+    ]
+})
+
+
+def make_rows(rng, n):
+    """Separable synthetic churn data: 'closed' skews pro/low-usage/short-tenure."""
+    rows = []
+    for i in range(n):
+        closed = rng.random() < 0.4
+        if closed:
+            plan = rng.choice(["pro", "plus", "basic"], p=[0.6, 0.3, 0.1])
+            usage = int(rng.integers(0, 150))
+            tenure = int(rng.normal(12, 4))
+        else:
+            plan = rng.choice(["pro", "plus", "basic"], p=[0.1, 0.3, 0.6])
+            usage = int(rng.integers(150, 500))
+            tenure = int(rng.normal(48, 10))
+        rows.append([f"u{i}", plan, str(usage), str(max(tenure, 1)),
+                     "closed" if closed else "open"])
+    return rows
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(7)
+    return encode_rows(make_rows(rng, 500), SCHEMA)
+
+
+def test_train_counts_match_numpy(data, mesh_ctx):
+    m = bayes.train(data, mesh_ctx)
+    cls = data.class_codes()
+    plan = data.column(1)
+    # oracle: crosstab of (class, plan)
+    fi = m.binned_ordinals.index(1)
+    for c in range(2):
+        for b in range(3):
+            assert m.post_counts[c, fi, b] == np.sum((cls == c) & (plan == b))
+    np.testing.assert_array_equal(m.class_counts,
+                                  [np.sum(cls == 0), np.sum(cls == 1)])
+    assert m.total == 500
+    # binned usage
+    fi_u = m.binned_ordinals.index(2)
+    ub = data.binned_codes(2)
+    for c in range(2):
+        for b in range(11):
+            assert m.post_counts[c, fi_u, b] == np.sum((cls == c) & (ub == b))
+    # continuous tenure: reference integer mean/std
+    ten = np.trunc(data.column(3))
+    for c in range(2):
+        xs = ten[cls == c]
+        mean = np.floor(xs.sum() / len(xs))
+        std = np.floor(np.sqrt((np.sum(xs * xs) - len(xs) * mean * mean) / (len(xs) - 1)))
+        assert m.cont_post_mean[c, 0] == mean
+        assert abs(m.cont_post_std[c, 0] - std) <= 1  # f32 moment accumulation
+
+
+def test_model_lines_format(data, mesh_ctx):
+    m = bayes.train(data, mesh_ctx)
+    lines = m.to_lines()
+    # posterior binned lines: class,ord,bin,count (4 tokens)
+    post = [l for l in lines if not l.startswith(",") and l.split(",")[1] != ""
+            and l.split(",")[2] != ""]
+    assert post and all(len(l.split(",")) == 4 for l in post)
+    # class prior: class,,,count
+    priors = [l for l in lines if l.split(",")[1] == "" and l.split(",")[2] == ""
+              and not l.startswith(",")]
+    assert priors
+    # continuous prior at end: ,ord,,mean,std
+    assert lines[-1].startswith(",3,,")
+
+
+def test_model_roundtrip(data, mesh_ctx):
+    m = bayes.train(data, mesh_ctx)
+    m2 = bayes.NaiveBayesModel.from_lines(m.to_lines(), SCHEMA)
+    np.testing.assert_allclose(m2.post_counts, m.post_counts)
+    np.testing.assert_allclose(m2.prior_counts, m.prior_counts)
+    np.testing.assert_allclose(m2.class_counts, m.class_counts)
+    np.testing.assert_allclose(m2.cont_post_mean, m.cont_post_mean)
+    np.testing.assert_allclose(m2.cont_prior_std, m.cont_prior_std)
+    assert m2.total == m.total
+
+
+def test_predict_matches_oracle(data, mesh_ctx):
+    m = bayes.train(data, mesh_ctx)
+    res = bayes.predict(m, data)
+    # numpy float64 oracle of the same math
+    cls = data.class_codes()
+    bin_codes = np.stack([data.binned_codes(1), data.binned_codes(2)], axis=1)
+    cont = np.trunc(data.column(3))[:, None]
+    post_p = m.post_counts / m.class_counts[:, None, None]
+    prior_p = m.prior_counts / m.total
+    class_p = m.class_counts / m.total
+    n = data.n_rows
+    pct_oracle = np.zeros((n, 2), dtype=int)
+    for i in range(n):
+        px = np.prod([prior_p[f, bin_codes[i, f]] for f in range(2)])
+        for c in range(2):
+            pxc = np.prod([post_p[c, f, bin_codes[i, f]] for f in range(2)])
+            # continuous gaussian
+            mu, sd = m.cont_post_mean[c, 0], max(m.cont_post_std[c, 0], 1e-6)
+            pxc *= np.exp(-0.5 * ((cont[i, 0] - mu) / sd) ** 2) / (sd * np.sqrt(2 * np.pi))
+            mu0, sd0 = m.cont_prior_mean[0], max(m.cont_prior_std[0], 1e-6)
+            px_c = px * np.exp(-0.5 * ((cont[i, 0] - mu0) / sd0) ** 2) / (sd0 * np.sqrt(2 * np.pi))
+            pct_oracle[i, c] = int((pxc * class_p[c] / px_c) * 100)
+    # f32 vs f64: allow off-by-one on the integer percent
+    assert np.mean(np.abs(res.class_probs - pct_oracle) <= 1) > 0.98
+    # classifications should agree nearly everywhere
+    agree = np.mean(np.argmax(res.class_probs, 1) == np.argmax(pct_oracle, 1))
+    assert agree > 0.99
+
+
+def test_end_to_end_accuracy(data, mesh_ctx, tmp_path):
+    m = bayes.train(data, mesh_ctx)
+    # round-trip through the model file like the reference two-job pipeline
+    from avenir_tpu.core import artifacts
+    store = artifacts.ArtifactStore(str(tmp_path))
+    store.write_lines("model", m.to_lines())
+    m2 = bayes.NaiveBayesModel.from_lines(store.read_lines("model"), SCHEMA)
+    res = bayes.predict(m2, data)
+    counters = Counters()
+    cm = bayes.evaluate(m2, data, res, counters=counters)
+    assert cm.accuracy() >= 85  # separable synthetic data
+    assert counters.get("Validation", "TruePositive") == cm.true_pos

@@ -1,0 +1,198 @@
+"""Unit tests for the L1 core: schema, config, table, metrics, artifacts."""
+
+import json
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core.schema import FeatureSchema
+from avenir_tpu.core.config import (Config, parse_properties, parse_hocon,
+                                    load_config, ConfigError)
+from avenir_tpu.core.table import load_csv_text
+from avenir_tpu.core.metrics import ConfusionMatrix, CostBasedArbitrator, Counters
+from avenir_tpu.core import artifacts
+
+
+CALL_HANGUP_SCHEMA = {
+    "fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "customer type", "ordinal": 1, "dataType": "categorical",
+         "feature": True, "maxSplit": 2, "cardinality": ["business", "residence"]},
+        {"name": "issue", "ordinal": 2, "dataType": "categorical", "feature": True,
+         "maxSplit": 2, "cardinality": ["internet", "cable", "billing", "other"]},
+        {"name": "hold time", "ordinal": 3, "dataType": "int", "feature": True,
+         "bucketWidth": 60, "min": 0, "max": 600, "splitScanInterval": 60},
+        {"name": "hungup", "ordinal": 4, "dataType": "categorical",
+         "cardinality": ["T", "F"]},
+    ]
+}
+
+
+def test_schema_parsing():
+    s = FeatureSchema.from_dict(CALL_HANGUP_SCHEMA)
+    assert len(s.fields) == 5
+    assert [f.ordinal for f in s.feature_fields] == [1, 2, 3]
+    assert s.class_attr_field.name == "hungup"
+    assert s.id_fields[0].ordinal == 0
+    hold = s.find_field_by_ordinal(3)
+    assert hold.is_numeric and hold.is_binned
+    assert hold.num_bins == 11  # 600//60 - 0//60 + 1
+    issue = s.find_field_by_ordinal(2)
+    assert issue.num_bins == 4
+    assert issue.cat_code("billing") == 2
+    assert issue.cat_code("nope") == -1
+    assert issue.bin_label(2) == "billing"
+    assert hold.bin_label(3) == "3"
+
+
+def test_schema_loads_reference_format(tmp_path):
+    p = tmp_path / "s.json"
+    p.write_text(json.dumps(CALL_HANGUP_SCHEMA))
+    s = FeatureSchema.load(str(p))
+    assert s.num_columns == 5
+
+
+def test_properties_parsing():
+    text = textwrap.dedent("""\
+        # comment
+        field.delim.regex=,
+        num.reducer=3
+        debug.on=true
+        dtb.max.depth.limit=2
+        dtb.min.info.gain.limit=
+        empty.key=
+    """)
+    cfg = Config(parse_properties(text))
+    assert cfg.get("field.delim.regex") == ","
+    assert cfg.get_int("num.reducer") == 3
+    assert cfg.get_boolean("debug.on") is True
+    assert cfg.get("dtb.min.info.gain.limit") is None  # empty -> missing
+    assert cfg.get_int("absent", 7) == 7
+    with pytest.raises(ConfigError):
+        cfg.must_get("absent")
+    sc = cfg.scoped("dtb")
+    assert sc.get_int("max.depth.limit") == 2
+    assert sc.get("field.delim.regex") == ","  # falls through to globals
+
+
+def test_hocon_parsing():
+    text = textwrap.dedent("""\
+        simulatedAnnealing {
+            field.delim.out = ","
+            max.num.iterations = 300
+            num.optimizers = 8
+            cooling.rate.geometric = true
+            domain.callback.class.name = "org.avenir.examples.TaskScheduleSearch"
+            // line comment
+            items = [a, b, c]
+        }
+    """)
+    flat = parse_hocon(text)
+    assert flat["simulatedAnnealing.max.num.iterations"] == "300"
+    assert flat["simulatedAnnealing.field.delim.out"] == ","
+    assert flat["simulatedAnnealing.domain.callback.class.name"] == \
+        "org.avenir.examples.TaskScheduleSearch"
+    assert flat["simulatedAnnealing.items"] == "a,b,c"
+
+
+def test_hocon_url_value_not_truncated():
+    # '//' inside a value (resource/atmTrans.conf style) must survive
+    flat = parse_hocon('app {\n  path = "file:///Users/x/y.txt"  // trailing\n}\n')
+    assert flat["app.path"] == "file:///Users/x/y.txt"
+
+
+def test_scoped_config_update_and_raw():
+    cfg = Config({"bap.a": "1"})
+    sc = cfg.scoped("bap")
+    sc.update({"predict.class": "open,closed"})
+    assert sc.get("predict.class") == "open,closed"
+    assert cfg.get("bap.predict.class") == "open,closed"
+    assert sc.raw() == {"a": "1", "predict.class": "open,closed"}
+
+
+def test_load_config_dispatch(tmp_path):
+    conf = tmp_path / "opt.conf"
+    conf.write_text("app {\n  k = 5\n}\n")
+    cfg = load_config(str(conf), app="app")
+    assert cfg.get_int("k") == 5
+    props = tmp_path / "job.properties"
+    props.write_text("a.b=1\n")
+    cfg2 = load_config(str(props))
+    assert cfg2.get_int("a.b") == 1
+
+
+def test_table_encoding():
+    s = FeatureSchema.from_dict(CALL_HANGUP_SCHEMA)
+    csv = textwrap.dedent("""\
+        u1,business,internet,120,T
+        u2,residence,billing,30,F
+        u3,residence,unknownval,600,T
+    """)
+    t = load_csv_text(csv, s)
+    assert t.n_rows == 3
+    np.testing.assert_array_equal(t.column(1), [0, 1, 1])
+    np.testing.assert_array_equal(t.column(2), [0, 2, -1])
+    np.testing.assert_array_equal(t.column(3), [120.0, 30.0, 600.0])
+    np.testing.assert_array_equal(t.class_codes(), [0, 1, 0])
+    np.testing.assert_array_equal(t.binned_codes(3), [2, 0, 10])
+    assert t.str_columns[0] == ["u1", "u2", "u3"]
+    m = t.binned_feature_matrix()
+    assert m.shape == (3, 3)
+
+
+def test_table_padding():
+    s = FeatureSchema.from_dict(CALL_HANGUP_SCHEMA)
+    csv = "u1,business,internet,120,T\nu2,residence,billing,30,F\nu3,business,cable,0,T\n"
+    t = load_csv_text(csv, s)
+    p = t.pad_to_multiple(8)
+    assert p.n_rows == 8 and p.n_valid == 3
+    assert p.valid_mask.sum() == 3
+    assert p.column(1).shape == (8,)
+
+
+def test_confusion_matrix_reference_semantics():
+    cm = ConfusionMatrix("F", "T")
+    for pred, actual in [("T", "T"), ("T", "F"), ("F", "F"), ("F", "T"), ("T", "T")]:
+        cm.report(pred, actual)
+    assert (cm.true_pos, cm.false_pos, cm.true_neg, cm.false_neg) == (2, 1, 1, 1)
+    assert cm.accuracy() == 60  # integer percent, 3/5
+    assert cm.recall() == 66    # 200//3
+    assert cm.precision() == 66
+    c = Counters()
+    cm.export(c)
+    assert c.get("Validation", "TruePositive") == 2
+    assert c.get("Validation", "TrueNagative") == 1  # reference typo preserved
+
+
+def test_confusion_matrix_batch_matches_scalar():
+    rng = np.random.default_rng(0)
+    pred = rng.integers(0, 2, 100).astype(bool)
+    actual = rng.integers(0, 2, 100).astype(bool)
+    cm1 = ConfusionMatrix("F", "T")
+    for p, a in zip(pred, actual):
+        cm1.report("T" if p else "F", "T" if a else "F")
+    cm2 = ConfusionMatrix("F", "T")
+    cm2.report_batch(pred, actual, ~actual)
+    assert (cm1.true_pos, cm1.false_pos, cm1.true_neg, cm1.false_neg) == \
+           (cm2.true_pos, cm2.false_pos, cm2.true_neg, cm2.false_neg)
+
+
+def test_cost_arbitrator():
+    arb = CostBasedArbitrator("F", "T", false_neg_cost=3, false_pos_cost=1)
+    # threshold = 100*1//4 = 25
+    assert arb.classify(30) == "T"
+    assert arb.classify(20) == "F"
+    assert arb.arbitrate(60, 40) in ("T", "F")
+
+
+def test_artifacts_roundtrip(tmp_path):
+    store = artifacts.ArtifactStore(str(tmp_path))
+    store.write_lines("out", ["a,1", "b,2"])
+    assert os.path.exists(store.path("out", "part-r-00000"))
+    assert store.read_lines("out") == ["a,1", "b,2"]
+    store.write_json("model.json", {"x": 1})
+    assert store.read_json("model.json") == {"x": 1}
+    store.rotate("model.json", "model_in.json")
+    assert store.exists("model_in.json") and not store.exists("model.json")
