@@ -47,6 +47,129 @@ def _schema_path(cfg: Config, key: str) -> FeatureSchema:
 
 
 # --------------------------------------------------------------------------
+# org.avenir.tree
+# --------------------------------------------------------------------------
+
+def _tree_params(cfg: Config):
+    """Map the dtb.* keys (resource/detr.properties, rafo.properties) onto
+    TreeParams."""
+    from ..models.tree import TreeParams
+    # defaults match the reference job's (DecisionTreeBuilder.java:169,179,
+    # 434,442,448): giniIndex / notUsedYet / best / minInfoGain / withReplace
+    return TreeParams(
+        split_algorithm=cfg.get("dtb.split.algorithm", "giniIndex"),
+        attr_select_strategy=cfg.get("dtb.split.attribute.selection.strategy",
+                                     "notUsedYet"),
+        random_split_set_size=cfg.get_int("dtb.random.split.set.size", 3),
+        split_select_strategy=cfg.get("dtb.split.select.strategy", "best"),
+        top_split_count=cfg.get_int("dtb.top.split.count", 3),
+        stopping_strategy=cfg.get("dtb.path.stopping.strategy", "minInfoGain"),
+        max_depth=cfg.get_int("dtb.max.depth.limit", 3),
+        min_info_gain=cfg.get_float("dtb.min.info.gain.limit", -1.0),
+        min_population=cfg.get_int("dtb.min.population.limit", -1),
+        sub_sampling=cfg.get("dtb.sub.sampling.strategy", "withReplace"),
+        sub_sampling_rate=cfg.get_float("dtb.sub.sampling.rate", 100.0),
+        seed=cfg.get_int("dtb.random.seed"),
+    )
+
+
+@register("org.avenir.tree.DecisionTreeBuilder", "decisionTreeBuilder")
+def decision_tree_builder(cfg: Config, in_path: str, out_path: str) -> Counters:
+    """One level of tree growth per invocation — the reference job contract
+    (tree/DecisionTreeBuilder.java, driven by resource/detr.sh's rotation of
+    dtb.decision.file.path.out -> .in between runs).
+
+    Differences from the reference noted: the job does not write re-tagged
+    record files; records are routed by re-evaluating the decision paths, so
+    the output dir just carries the input records forward for script compat."""
+    from ..models import tree as T
+    counters = Counters()
+    schema = _schema_path(cfg, "dtb.feature.schema.file.path")
+    table = load_csv(in_path, schema, cfg.field_delim_regex, keep_raw=True)
+    params = _tree_params(cfg)
+    builder = T.TreeBuilder(table, params, MeshContext())
+    dec_in = cfg.get("dtb.decision.file.path.in")
+    dpl = T.DecisionPathList.from_json(open(dec_in).read()) if dec_in else None
+    new_dpl = builder.build_one_level(table, dpl)
+    with open(cfg.must_get("dtb.decision.file.path.out"), "w") as fh:
+        fh.write(new_dpl.to_json())
+    if out_path:
+        artifacts.write_text_output(
+            out_path, (cfg.field_delim_out.join(r) for r in table.raw_rows))
+    counters.increment("Decision tree", "Paths", len(new_dpl.decision_paths))
+    return counters
+
+
+@register("org.avenir.tree.RandomForestBuilder", "randomForestBuilder")
+def random_forest_builder(cfg: Config, in_path: str, out_path: str) -> Counters:
+    """Full in-process random forest: the rafo.sh per-tree rerun loop
+    (resource/rafo.sh:34-43) collapsed into one job.  Writes one decision-path
+    JSON per tree into the output dir (tree_<i>.json)."""
+    from ..models.forest import ForestParams, build_forest
+    counters = Counters()
+    schema = _schema_path(cfg, "dtb.feature.schema.file.path")
+    table = load_csv(in_path, schema, cfg.field_delim_regex)
+    params = ForestParams(tree=_tree_params(cfg),
+                          num_trees=cfg.get_int("dtb.num.trees", 5),
+                          seed=cfg.get_int("dtb.random.seed", 0))
+    models = build_forest(table, params, MeshContext())
+    os.makedirs(out_path, exist_ok=True)
+    for i, dpl in enumerate(models):
+        with open(os.path.join(out_path, f"tree_{i}.json"), "w") as fh:
+            fh.write(dpl.to_json())
+    counters.increment("Random forest", "Trees", len(models))
+    return counters
+
+
+@register("org.avenir.model.ModelPredictor", "modelPredictor")
+def model_predictor_job(cfg: Config, in_path: str, out_path: str) -> Counters:
+    """Generic map-only predictor (model/ModelPredictor.java:46-82): loads N
+    decision-path model files (mop.model.dir.path + mop.model.file.names) and
+    predicts via single model or weighted ensemble vote
+    (mop.ensemble.memeber.weights — reference key name, typo included)."""
+    from ..models.tree import DecisionPathList
+    from ..models.forest import model_predictor
+    counters = Counters()
+    schema = _schema_path(cfg, "mop.feature.schema.file.path")
+    table = load_csv(in_path, schema, cfg.field_delim_regex, keep_raw=True)
+    model_dir = cfg.get("mop.model.dir.path", "")
+    names = cfg.must_get_list("mop.model.file.names")
+    path_lists = []
+    for nm in names:
+        p = os.path.join(model_dir, nm) if model_dir else nm
+        with open(p) as fh:
+            path_lists.append(DecisionPathList.from_json(fh.read()))
+    weights = cfg.get_float_list("mop.ensemble.memeber.weights")
+    output_mode = cfg.get("mop.output.mode", "withRecord")
+    # per-mode mandatory ordinals (ModelPredictor.java:165-172); error
+    # counting also requires the class ordinal (:116)
+    error_counting = cfg.get_boolean("mop.error.counting.enabled", False)
+    class_ord = None
+    if output_mode == "withActualClassAttr" or error_counting:
+        class_ord = cfg.must_get_int(
+            "mop.rec.class.attr.ordinal",
+            "missing class attribute ordinal") if \
+            "mop.rec.class.attr.ordinal" in cfg else \
+            cfg.must_get_int("mop.class.attr.ord",
+                             "missing class attribute ordinal")
+    id_ord = cfg.get_int("mop.rec.id.ordinal", 0) \
+        if output_mode != "withKId" else \
+        cfg.must_get_int("mop.rec.id.ordinal", "missing id ordinal")
+    lines = model_predictor(
+        table, schema, path_lists,
+        output_mode=output_mode,
+        id_ordinal=id_ord,
+        class_attr_ordinal=class_ord,
+        class_attr_values=cfg.get_list("mop.class.attr.values"),
+        error_counting=error_counting,
+        weights=weights,
+        min_odds_ratio=cfg.get_float("mop.min.odds.ratio", 1.0),
+        out_delim=cfg.field_delim_out, counters=counters)
+    artifacts.write_text_output(out_path, lines, role="m")
+    return counters
+
+
+# --------------------------------------------------------------------------
 # org.avenir.bayesian
 # --------------------------------------------------------------------------
 

@@ -1,0 +1,802 @@
+"""Decision tree: level-synchronous distributed builder, TPU-native.
+
+Capability parity with org.avenir.tree (SURVEY.md §2.1, call stack §3.1):
+
+  * candidate splits from schema knobs — numeric attrs scanned at
+    splitScanInterval with up to maxSplit-1 thresholds per split
+    (tree/SplitManager.java:292-330), categorical attrs partitioned into
+    2..maxSplit groups (:405-575);
+  * one pass grows the whole frontier one level: per (node, split, branch)
+    class histograms -> weighted entropy/gini -> best (or random-among-top)
+    split per node (tree/DecisionTreeBuilder.java:499-616);
+  * attribute selection strategies all/notUsedYet/randomAll/randomNotUsedYet
+    (:365-381), stopping maxDepth/minPopulation/minInfoGain
+    (tree/DecisionPathStoppingStrategy.java:57-69);
+  * sub-sampling none/withReplace/withoutReplace for the first pass
+    (:125-127,208-244) — expressed as per-record weights;
+  * the model is a DecisionPathList serialized to the reference's exact
+    Jackson JSON (tree/DecisionPathList.java; format sample
+    resource/dec_tree_rules.json).
+
+TPU design: records never move.  Each level is one jitted pass over
+row-sharded arrays computing, for every (node, candidate-split, branch,
+class), a weighted count via two one-hot MXU contractions — the exact
+mapper x shuffle x reducer of the reference collapsed into one matmul.
+The per-record node id is a dense int32 vector updated on device after the
+host picks winners (a gather per level).  All shapes are static per level.
+
+Known deliberate divergence: for multi-threshold splits the reference emits
+a record into EVERY matching predicate, and its unbounded last 'le'
+predicate overlaps the earlier segments (SplitManager.java:644-657 — records
+with x<=t0 also match 'le t1'), inflating middle-branch counts.  We implement
+the disjoint segmentation the bounded predicates intend: branch i holds
+t_{i-1} < x <= t_i.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import math
+import random as pyrandom
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.schema import FeatureSchema, FeatureField
+from ..core.table import ColumnarTable
+from ..parallel.mesh import MeshContext
+
+ROOT_PATH = "$root"
+SPLIT_DELIM = ":"          # splitId:predicate in shuffle keys (not in model)
+PRED_DELIM = ";"           # dtb.dec.path.delim default
+
+
+# --------------------------------------------------------------------------
+# predicates
+# --------------------------------------------------------------------------
+
+@dataclass
+class Predicate:
+    """One arm of a split; serializes to the reference predicate string
+    '<attr> le <v> [<lower>]' / '<attr> gt <v>' / '<attr> in a:b'."""
+    attribute: int
+    operator: str                      # 'le' | 'gt' | 'in' | None for root
+    value_int: int = 0
+    value_dbl: float = 0.0
+    categorical_values: Optional[List[str]] = None
+    other_bound_int: Optional[int] = None
+    other_bound_dbl: Optional[float] = None
+    is_int: bool = True
+    pred_str: str = ""
+
+    @classmethod
+    def root(cls) -> "Predicate":
+        return cls(attribute=0, operator=None, pred_str=ROOT_PATH)
+
+    @classmethod
+    def num(cls, attr: int, op: str, value, other=None, is_int=True) -> "Predicate":
+        p = cls(attribute=attr, operator=op, is_int=is_int)
+        if is_int:
+            p.value_int = int(value)
+            p.other_bound_int = None if other is None else int(other)
+            s = f"{attr} {op} {int(value)}"
+            if other is not None:
+                s += f" {int(other)}"
+        else:
+            p.value_dbl = float(value)
+            p.other_bound_dbl = None if other is None else float(other)
+            s = f"{attr} {op} {p.value_dbl}"
+            if other is not None:
+                s += f" {p.other_bound_dbl}"
+        p.pred_str = s
+        return p
+
+    @classmethod
+    def cat(cls, attr: int, values: Sequence[str]) -> "Predicate":
+        vals = list(values)
+        return cls(attribute=attr, operator="in", categorical_values=vals,
+                   pred_str=f"{attr} in {':'.join(vals)}")
+
+    def to_dict(self) -> Dict[str, Any]:
+        """Jackson field layout of DecisionPathList.DecisionPathPredicate
+        (see resource/dec_tree_rules.json)."""
+        return {
+            "attribute": self.attribute,
+            "predicateStr": self.pred_str,
+            "operator": self.operator,
+            "valueInt": self.value_int,
+            "valueDbl": self.value_dbl,
+            "categoricalValues": self.categorical_values,
+            "otherBoundInt": self.other_bound_int,
+            "otherBoundDbl": self.other_bound_dbl,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Predicate":
+        return cls(attribute=d.get("attribute", 0),
+                   operator=d.get("operator"),
+                   value_int=d.get("valueInt", 0) or 0,
+                   value_dbl=d.get("valueDbl", 0.0) or 0.0,
+                   categorical_values=d.get("categoricalValues"),
+                   other_bound_int=d.get("otherBoundInt"),
+                   other_bound_dbl=d.get("otherBoundDbl"),
+                   pred_str=d.get("predicateStr", ""))
+
+    @property
+    def threshold(self) -> float:
+        """Numeric comparison value: valueDbl wins when set (Jackson leaves the
+        unused slot at 0, mirroring DecisionPathPredicate's int/dbl pair)."""
+        return self.value_dbl if self.value_dbl != 0.0 else float(self.value_int)
+
+    @property
+    def lower_bound(self) -> Optional[float]:
+        if self.other_bound_int is not None:
+            return float(self.other_bound_int)
+        return self.other_bound_dbl
+
+    # host-side evaluation (predict path); device evaluation lives in SplitSet
+    def evaluate(self, value) -> bool:
+        if self.pred_str == ROOT_PATH or self.operator is None:
+            return True
+        if self.operator == "in":
+            return str(value) in (self.categorical_values or [])
+        v = float(value)
+        if self.operator == "le":
+            ok = v <= self.threshold
+            if self.lower_bound is not None:
+                ok = ok and v > self.lower_bound
+            return ok
+        if self.operator == "gt":
+            return v > self.threshold
+        raise ValueError(f"bad operator {self.operator}")
+
+
+# --------------------------------------------------------------------------
+# candidate split generation (host, from schema — static shapes)
+# --------------------------------------------------------------------------
+
+@dataclass
+class CandidateSplit:
+    attr: int
+    predicates: List[Predicate]        # branch order
+    thresholds: Optional[List[float]] = None     # numeric
+    groups: Optional[List[List[str]]] = None     # categorical
+
+    @property
+    def n_branches(self) -> int:
+        return len(self.predicates)
+
+
+def _set_partitions(items: List[str], n_groups: int):
+    """All partitions of items into exactly n_groups non-empty groups
+    (restricted-growth enumeration; same partition set as
+    SplitManager.createCategoricalPartitions, canonical order)."""
+    n = len(items)
+    if n_groups > n or n_groups < 1:
+        return
+
+    def rec(i, groups):
+        if i == n:
+            if len(groups) == n_groups:
+                yield [list(g) for g in groups]
+            return
+        remaining = n - i - 1  # items left after placing items[i]
+        # join an existing group (still need n_groups-len(groups) new groups)
+        if remaining >= n_groups - len(groups):
+            for g in groups:
+                g.append(items[i])
+                yield from rec(i + 1, groups)
+                g.pop()
+        # open a new group
+        if len(groups) < n_groups and remaining >= n_groups - len(groups) - 1:
+            groups.append([items[i]])
+            yield from rec(i + 1, groups)
+            groups.pop()
+
+    yield from rec(0, [])
+
+
+def _numeric_threshold_sets(field: FeatureField) -> List[List[float]]:
+    """All increasing threshold tuples on the scan grid with 1..maxSplit-1
+    points (SplitManager.createIntPartitions :292-330)."""
+    lo, hi = float(field.min), float(field.max)
+    interval = float(field.split_scan_interval or 0)
+    if interval <= 0 or int((hi - lo) / interval) == 0:
+        interval = (hi - lo) / 2
+    points = []
+    p = lo + interval
+    while p < hi:
+        points.append(int(p) if field.is_integer else p)
+        p += interval
+    max_split = field.max_split or 2
+    out: List[List[float]] = []
+    max_len = max(1, max_split - 1)
+    for k in range(1, max_len + 1):
+        for combo in itertools.combinations(points, k):
+            out.append(list(combo))
+    return out
+
+
+def _numeric_split_predicates(field: FeatureField, thresholds: List[float]
+                              ) -> List[Predicate]:
+    attr = field.ordinal
+    is_int = field.is_integer
+    preds = []
+    for i, t in enumerate(thresholds):
+        if i == 0:
+            preds.append(Predicate.num(attr, "le", t, is_int=is_int))
+        else:
+            preds.append(Predicate.num(attr, "le", t, thresholds[i - 1], is_int=is_int))
+    preds.append(Predicate.num(attr, "gt", thresholds[-1], is_int=is_int))
+    return preds
+
+
+def generate_candidate_splits(schema: FeatureSchema,
+                              attrs: Optional[Sequence[int]] = None
+                              ) -> List[CandidateSplit]:
+    """All candidate splits for the given attrs (default: all feature attrs)."""
+    out: List[CandidateSplit] = []
+    fields = [schema.find_field_by_ordinal(a) for a in attrs] if attrs is not None \
+        else schema.feature_fields
+    for f in fields:
+        if f.is_categorical:
+            card = [str(c) for c in (f.cardinality or [])]
+            max_split = f.max_split or 2
+            for g in range(2, max_split + 1):
+                for groups in _set_partitions(card, g):
+                    preds = [Predicate.cat(f.ordinal, grp) for grp in groups]
+                    out.append(CandidateSplit(attr=f.ordinal, predicates=preds,
+                                              groups=groups))
+        elif f.is_numeric:
+            for thresholds in _numeric_threshold_sets(f):
+                preds = _numeric_split_predicates(f, thresholds)
+                out.append(CandidateSplit(attr=f.ordinal, predicates=preds,
+                                          thresholds=[float(t) for t in thresholds]))
+    return out
+
+
+class SplitSet:
+    """Device-side branch evaluator for a fixed list of candidate splits.
+
+    Precomputes (host, once):
+      * thresholds  (S, Tmax) float32, +inf padded  — numeric branch =
+        sum(x > t), giving branch i == t_{i-1} < x <= t_i
+      * cat_table   (S, CardMax) int32              — categorical branch =
+        table[split, value_code]
+      * attr column index per split into the stacked feature matrix
+
+    ``branch_codes`` then evaluates all splits for all records in one
+    vectorized pass — the replacement for the reference's per-record
+    predicate loop (DecisionTreeBuilder.java:323-357, HOT LOOP #1).
+    """
+
+    def __init__(self, splits: List[CandidateSplit], schema: FeatureSchema):
+        self.splits = splits
+        self.schema = schema
+        feat_fields = schema.feature_fields
+        self.feat_ordinals = [f.ordinal for f in feat_fields]
+        col_of = {o: i for i, o in enumerate(self.feat_ordinals)}
+        S = len(splits)
+        tmax = max([len(s.thresholds) for s in splits if s.thresholds] + [1])
+        cmax = max([len(f.cardinality or []) for f in feat_fields
+                    if f.is_categorical] + [1])
+        self.max_branches = max((s.n_branches for s in splits), default=2)
+        thr = np.full((S, tmax), np.inf, dtype=np.float32)
+        cat_tab = np.zeros((S, cmax), dtype=np.int32)
+        is_cat = np.zeros((S,), dtype=bool)
+        attr_col = np.zeros((S,), dtype=np.int32)
+        for si, s in enumerate(splits):
+            attr_col[si] = col_of[s.attr]
+            f = schema.find_field_by_ordinal(s.attr)
+            if s.groups is not None:
+                is_cat[si] = True
+                for gi, grp in enumerate(s.groups):
+                    for v in grp:
+                        cat_tab[si, f.cat_code(v)] = gi
+            else:
+                thr[si, :len(s.thresholds)] = s.thresholds
+        self.thresholds = thr
+        self.cat_table = cat_tab
+        self.is_cat = is_cat
+        self.attr_col = attr_col
+        self.n_splits = S
+
+    def feature_matrix(self, table: ColumnarTable) -> np.ndarray:
+        """(n, F) float32: numeric values; categorical as codes."""
+        cols = [table.columns[o].astype(np.float32) for o in self.feat_ordinals]
+        return np.stack(cols, axis=1) if cols else np.zeros((table.n_rows, 0), np.float32)
+
+    def branch_codes(self, X: jnp.ndarray) -> jnp.ndarray:
+        """(n, S) int32 branch index of every record under every split."""
+        vals = X[:, self.attr_col]                               # (n, S)
+        num_branch = (vals[:, :, None] > jnp.asarray(self.thresholds)[None]
+                      ).sum(axis=2).astype(jnp.int32)            # (n, S)
+        codes = vals.astype(jnp.int32)
+        safe = jnp.clip(codes, 0, self.cat_table.shape[1] - 1)
+        cat_branch = jnp.asarray(self.cat_table)[
+            jnp.arange(self.n_splits)[None, :], safe]            # (n, S)
+        return jnp.where(jnp.asarray(self.is_cat)[None, :], cat_branch, num_branch)
+
+
+# --------------------------------------------------------------------------
+# decision path list (the model artifact)
+# --------------------------------------------------------------------------
+
+@dataclass
+class DecisionPath:
+    predicates: List[Predicate]
+    population: int
+    info_content: float
+    stopped: bool
+    class_val_pr: Dict[str, float]
+
+    @property
+    def path_str(self) -> str:
+        return PRED_DELIM.join(p.pred_str for p in self.predicates)
+
+    def predicted_class(self) -> Tuple[str, float]:
+        best = max(self.class_val_pr.items(), key=lambda kv: kv[1])
+        return best
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "stopped": self.stopped,
+            "classValPr": self.class_val_pr,
+            "infoContent": self.info_content,
+            "predicates": [p.to_dict() for p in self.predicates],
+            "population": self.population,
+        }
+
+
+@dataclass
+class DecisionPathList:
+    decision_paths: List[DecisionPath] = dc_field(default_factory=list)
+
+    def to_json(self) -> str:
+        return json.dumps({"decisionPaths": [p.to_dict() for p in self.decision_paths]},
+                          indent=3)
+
+    @classmethod
+    def from_json(cls, text: str) -> "DecisionPathList":
+        d = json.loads(text)
+        paths = []
+        for pd in d.get("decisionPaths", []):
+            paths.append(DecisionPath(
+                predicates=[Predicate.from_dict(x) for x in pd.get("predicates", [])],
+                population=pd.get("population", 0),
+                info_content=pd.get("infoContent", 0.0),
+                stopped=pd.get("stopped", False),
+                class_val_pr=pd.get("classValPr", {})))
+        return cls(decision_paths=paths)
+
+    def find(self, path_str: str) -> Optional[DecisionPath]:
+        for p in self.decision_paths:
+            if p.path_str == path_str:
+                return p
+        return None
+
+
+# --------------------------------------------------------------------------
+# builder
+# --------------------------------------------------------------------------
+
+@dataclass
+class TreeParams:
+    """The dtb.* knobs (resource/detr.properties / rafo.properties)."""
+    split_algorithm: str = "entropy"            # entropy | giniIndex
+    attr_select_strategy: str = "notUsedYet"    # all|notUsedYet|randomAll|randomNotUsedYet
+    random_split_set_size: int = 3              # dtb.random.split.set.size
+    split_select_strategy: str = "best"         # best | randomAmongTop
+    top_split_count: int = 3                    # dtb.custom.base.attribute.ordinals? no: top count
+    stopping_strategy: str = "maxDepth"         # maxDepth|minPopulation|minInfoGain
+    max_depth: int = 3
+    min_info_gain: float = -1.0
+    min_population: int = -1
+    sub_sampling: str = "none"                  # none|withReplace|withoutReplace
+    sub_sampling_rate: float = 100.0            # percent
+    seed: Optional[int] = None
+
+    def should_stop(self, population: float, info_content: float,
+                    parent_info: float, depth: int) -> bool:
+        """DecisionPathStoppingStrategy.shouldStop :57-69."""
+        if self.stopping_strategy == "minPopulation":
+            return population < self.min_population
+        if self.stopping_strategy == "minInfoGain":
+            return (parent_info - info_content) < self.min_info_gain
+        if self.stopping_strategy == "maxDepth":
+            return depth >= self.max_depth
+        raise ValueError(f"invalid stopping strategy {self.stopping_strategy}")
+
+
+def _info(counts: np.ndarray, algo: str, axis=-1) -> np.ndarray:
+    """entropy (log2) or gini of count vectors along axis
+    (util/InfoContentStat.java:71-95)."""
+    total = counts.sum(axis=axis, keepdims=True)
+    p = counts / np.maximum(total, 1e-12)
+    if algo == "entropy":
+        with np.errstate(divide="ignore", invalid="ignore"):
+            logp = np.where(p > 0, np.log2(np.maximum(p, 1e-300)), 0.0)
+        return -(p * logp).sum(axis=axis)
+    # giniIndex
+    return 1.0 - (p * p).sum(axis=axis)
+
+
+class _LeafState:
+    __slots__ = ("predicates", "depth", "info_content", "population",
+                 "class_val_pr", "used_attrs", "stopped")
+
+    def __init__(self, predicates, depth, info_content, population,
+                 class_val_pr, used_attrs, stopped):
+        self.predicates = predicates
+        self.depth = depth
+        self.info_content = info_content
+        self.population = population
+        self.class_val_pr = class_val_pr
+        self.used_attrs = used_attrs
+        self.stopped = stopped
+
+
+def sampling_weights(n: int, params: TreeParams,
+                     rng: np.random.Generator) -> Optional[np.ndarray]:
+    """First-iteration sub-sampling as per-record weights
+    (DecisionTreeBuilder rootMapHelper :208-244): withReplace -> bootstrap
+    multinomial counts at rate% of n; withoutReplace -> Bernoulli(rate%);
+    none -> None."""
+    if params.sub_sampling == "withReplace":
+        m = int(n * params.sub_sampling_rate / 100.0)
+        counts = rng.multinomial(m, np.full(n, 1.0 / n))
+        return counts.astype(np.float32)
+    if params.sub_sampling == "withoutReplace":
+        keep = rng.random(n) < (params.sub_sampling_rate / 100.0)
+        return keep.astype(np.float32)
+    return None
+
+
+class TreeBuilder:
+    """Level-synchronous tree growth over a device mesh.
+
+    One instance holds the device-resident encoded features and branch codes;
+    ``build()`` runs the whole iterative loop (the reference's shell-script
+    rotation detr.sh:35-41 collapsed into Python), ``run_level()`` exposes a
+    single level for the per-level job parity mode.
+    """
+
+    def __init__(self, table: ColumnarTable, params: TreeParams,
+                 ctx: Optional[MeshContext] = None,
+                 splits: Optional[List[CandidateSplit]] = None):
+        self.ctx = ctx or MeshContext()
+        self.params = params
+        self.schema = table.schema
+        self.class_field = self.schema.class_attr_field
+        self.class_values = list(self.class_field.cardinality or [])
+        self.C = len(self.class_values)
+        self.splits = splits if splits is not None else \
+            generate_candidate_splits(self.schema)
+        self.split_set = SplitSet(self.splits, self.schema)
+        self.rng = np.random.default_rng(params.seed)
+        self.pyrng = pyrandom.Random(params.seed)
+
+        padded = table.pad_to_multiple(self.ctx.n_devices)
+        self.n_rows = table.n_rows
+        self.n_padded = padded.n_rows
+        X = self.split_set.feature_matrix(padded)
+        self.X = self.ctx.shard_rows(X)
+        self.cls_codes = self.ctx.shard_rows(
+            padded.columns[self.class_field.ordinal].astype(np.int32))
+        self.base_mask = self.ctx.shard_rows(padded.valid_mask)
+        # branch codes computed once; (n, S) int32 on device
+        self._branch_fn = jax.jit(self.split_set.branch_codes)
+        self.branches = self._branch_fn(self.X)
+
+        S, B, C = self.split_set.n_splits, self.split_set.max_branches, self.C
+        self._count_kernel = jax.jit(self._make_count_kernel(S, B, C),
+                                     static_argnums=4)
+        self._reassign_kernel = jax.jit(self._reassign)
+
+        # splits grouped by attr for selection strategies
+        self.splits_by_attr: Dict[int, List[int]] = {}
+        for i, s in enumerate(self.splits):
+            self.splits_by_attr.setdefault(s.attr, []).append(i)
+
+    def with_params(self, params: TreeParams) -> "TreeBuilder":
+        """Shallow copy sharing the device-resident encoded data and compiled
+        kernels, with fresh params/RNG — one bootstrap tree of a forest."""
+        b = TreeBuilder.__new__(TreeBuilder)
+        b.__dict__.update(self.__dict__)
+        b.params = params
+        b.rng = np.random.default_rng(params.seed)
+        b.pyrng = pyrandom.Random(params.seed)
+        return b
+
+    # ---- kernels ----
+    def _make_count_kernel(self, S, B, C):
+        def kernel(node_ids, branches, cls_codes, weights, n_nodes):
+            """counts[node, split, branch, class] for active records
+            (node_id >= 0).  n_nodes is static per level."""
+            active = (node_ids >= 0)
+            w = weights * active.astype(jnp.float32)
+            nc = jnp.where(active, node_ids, 0) * C + cls_codes       # (n,)
+            oh_nc = jax.nn.one_hot(nc, n_nodes * C, dtype=jnp.float32) * w[:, None]
+            oh_b = jax.nn.one_hot(branches, B, dtype=jnp.float32)     # (n, S, B)
+            counts = jnp.einsum("na,nsb->asb", oh_nc, oh_b)           # (N*C, S, B)
+            return counts.reshape(n_nodes, C, S, B).transpose(0, 2, 3, 1)
+        return kernel
+
+    @staticmethod
+    def _reassign(node_ids, branches, sel_split, child_table):
+        """new node id = child_table[node, branch of selected split]
+        (the reducer's re-tagging of records :764-765, as a device gather)."""
+        active = node_ids >= 0
+        node_safe = jnp.where(active, node_ids, 0)
+        sel = sel_split[node_safe]                                    # (n,)
+        br = jnp.take_along_axis(branches, sel[:, None], axis=1)[:, 0]
+        new_ids = child_table[node_safe, br]
+        return jnp.where(active & (sel >= 0), new_ids,
+                         jnp.where(active, -2, node_ids))  # -2: stopped leaf member
+
+    # ---- level counts ----
+    def level_counts(self, node_ids, weights, n_nodes: int,
+                     chunk: int = 1 << 19) -> np.ndarray:
+        """(N, S, B, C) float64 counts for the level, chunked over rows."""
+        S, B, C = self.split_set.n_splits, self.split_set.max_branches, self.C
+        total = np.zeros((n_nodes, S, B, C), dtype=np.float64)
+        n = self.n_padded
+        # chunking keeps the (chunk, N*C) one-hot bounded; for typical levels
+        # a single chunk suffices
+        for start in range(0, n, chunk):
+            end = min(start + chunk, n)
+            c = self._count_kernel(node_ids[start:end], self.branches[start:end],
+                                   self.cls_codes[start:end], weights[start:end],
+                                   n_nodes)
+            total += np.asarray(c, dtype=np.float64)
+        return total
+
+    # ---- attribute selection (DecisionTreeBuilder.getSplitAttributes :365-381)
+    def _allowed_attrs(self, leaf: _LeafState) -> List[int]:
+        strategy = self.params.attr_select_strategy
+        all_attrs = list(self.splits_by_attr.keys())
+        if strategy == "all":
+            return all_attrs
+        if strategy == "notUsedYet":
+            return [a for a in all_attrs if a not in leaf.used_attrs] or all_attrs
+        if strategy == "randomAll":
+            k = min(self.params.random_split_set_size, len(all_attrs))
+            return self.pyrng.sample(all_attrs, k)
+        if strategy == "randomNotUsedYet":
+            cand = [a for a in all_attrs if a not in leaf.used_attrs] or all_attrs
+            k = min(self.params.random_split_set_size, len(cand))
+            return self.pyrng.sample(cand, k)
+        raise ValueError(f"invalid attr selection strategy {strategy}")
+
+    # ---- the full build loop ----
+    def build(self, max_levels: Optional[int] = None) -> DecisionPathList:
+        p = self.params
+        weights_np = sampling_weights(self.n_padded, p, self.rng)
+        if weights_np is None:
+            weights_np = np.ones((self.n_padded,), dtype=np.float32)
+        weights_np *= np.asarray(jax.device_get(self.base_mask), dtype=np.float32)
+        weights = self.ctx.shard_rows(weights_np.astype(np.float32))
+
+        # root pass (generateRoot :478-494)
+        node_ids = self.ctx.shard_rows(np.zeros((self.n_padded,), dtype=np.int32))
+        counts = self.level_counts(node_ids, weights, 1)
+        root_class = counts[0].sum(axis=(0, 1)) / max(self.split_set.n_splits, 1)
+        root_pop = float(root_class.sum())
+        root_info = float(_info(root_class[None], p.split_algorithm)[0])
+        root_pr = {cv: float(root_class[i] / max(root_pop, 1e-12))
+                   for i, cv in enumerate(self.class_values)}
+        leaves = [_LeafState([Predicate.root()], 0, root_info, root_pop,
+                             root_pr, set(), False)]
+        final_paths: List[DecisionPath] = []
+
+        levels = max_levels if max_levels is not None else \
+            (p.max_depth if p.stopping_strategy == "maxDepth" else 64)
+        for level in range(levels):
+            active = [l for l in leaves if not l.stopped]
+            if not active:
+                break
+            leaves, stopped_paths, node_ids = self._grow(active, node_ids, weights)
+            final_paths.extend(stopped_paths)
+            if not leaves:
+                break
+
+        # any leaves still active at the end become stopped paths
+        for leaf in leaves:
+            final_paths.append(DecisionPath(
+                predicates=leaf.predicates, population=int(round(leaf.population)),
+                info_content=leaf.info_content, stopped=True,
+                class_val_pr=leaf.class_val_pr))
+        if not final_paths:
+            final_paths.append(DecisionPath(
+                predicates=[Predicate.root()], population=int(round(root_pop)),
+                info_content=root_info, stopped=True, class_val_pr=root_pr))
+        return DecisionPathList(decision_paths=final_paths)
+
+    def _grow(self, active: List[_LeafState], node_ids, weights):
+        """One level of frontier expansion (the expandTree epilogue
+        :499-616): compute counts, choose per-node winning split, derive
+        children + stopping, reassign records on device.
+        Returns (new_active_leaves, newly_stopped_DecisionPaths, new_node_ids)."""
+        p = self.params
+        n_nodes = len(active)
+        counts = self.level_counts(node_ids, weights, n_nodes)
+        sel_split = np.full((n_nodes,), -1, dtype=np.int32)
+        child_table = np.full((n_nodes, self.split_set.max_branches), -1,
+                              dtype=np.int32)
+        new_leaves: List[_LeafState] = []
+        stopped_paths: List[DecisionPath] = []
+        for ni, leaf in enumerate(active):
+            attrs = self._allowed_attrs(leaf)
+            cand_splits = [si for a in attrs for si in self.splits_by_attr[a]]
+            if not cand_splits:
+                leaf.stopped = True
+                stopped_paths.append(DecisionPath(
+                    predicates=leaf.predicates,
+                    population=int(round(leaf.population)),
+                    info_content=leaf.info_content, stopped=True,
+                    class_val_pr=leaf.class_val_pr))
+                continue
+            node_counts = counts[ni]                       # (S, B, C)
+            br_tot = node_counts.sum(axis=2)               # (S, B)
+            info = _info(node_counts, p.split_algorithm)   # (S, B)
+            tot = br_tot.sum(axis=1)                       # (S,)
+            weighted = (info * br_tot).sum(axis=1) / np.maximum(tot, 1e-12)
+            order = sorted(cand_splits, key=lambda si: weighted[si])
+            if p.split_select_strategy == "randomAmongTop":
+                top = order[:max(1, p.top_split_count)]
+                chosen = self.pyrng.choice(top)
+            else:
+                chosen = order[0]
+            sel_split[ni] = chosen
+            split = self.splits[chosen]
+            # children: only branches that received records (the reducer only
+            # sees keys that were emitted)
+            for b in range(split.n_branches):
+                pop = float(br_tot[chosen, b])
+                if pop <= 0:
+                    continue
+                cdist = node_counts[chosen, b]
+                cinfo = float(_info(cdist[None], p.split_algorithm)[0])
+                cpr = {cv: float(cdist[i] / pop)
+                       for i, cv in enumerate(self.class_values)}
+                preds = leaf.predicates + [split.predicates[b]]
+                stopped = p.should_stop(pop, cinfo, leaf.info_content,
+                                        len(preds) - 1)
+                child = _LeafState(preds, leaf.depth + 1, cinfo, pop, cpr,
+                                   leaf.used_attrs | {split.attr}, stopped)
+                if stopped:
+                    stopped_paths.append(DecisionPath(
+                        predicates=preds, population=int(round(pop)),
+                        info_content=cinfo, stopped=True, class_val_pr=cpr))
+                else:
+                    child_table[ni, b] = len(new_leaves)
+                    new_leaves.append(child)
+        node_ids = self._reassign_kernel(
+            node_ids, self.branches,
+            self.ctx.replicate(jnp.asarray(sel_split)),
+            self.ctx.replicate(jnp.asarray(child_table)))
+        return new_leaves, stopped_paths, node_ids
+
+    # ---- per-level job parity mode (detr.sh rotation contract) ----
+    @staticmethod
+    def _leaf_from_path(path: DecisionPath) -> _LeafState:
+        used = {pr.attribute for pr in path.predicates if pr.operator is not None}
+        return _LeafState(path.predicates, len(path.predicates) - 1,
+                          path.info_content, path.population, path.class_val_pr,
+                          used, path.stopped)
+
+    def assign_node_ids(self, table: ColumnarTable,
+                        active: List[_LeafState]) -> np.ndarray:
+        """Route records to active leaves by evaluating predicate chains
+        (what the reference gets for free from its re-tagged record files)."""
+        model_like = DecisionTreeModel(DecisionPathList([]), self.schema)
+        ids = np.full((self.n_padded,), -1, dtype=np.int32)
+        for ni, leaf in enumerate(active):
+            mask = np.ones((table.n_rows,), dtype=bool)
+            for pr in leaf.predicates:
+                mask &= model_like._pred_mask(pr, table)
+            ids[:table.n_rows][mask] = ni
+        return ids
+
+    def build_one_level(self, table: ColumnarTable,
+                        dpl: Optional[DecisionPathList]) -> DecisionPathList:
+        """One invocation of the reference DecisionTreeBuilder job: iteration 0
+        (dpl None) writes the root path; otherwise expands every non-stopped
+        path one level.  Stopped paths are carried forward so the output file
+        is always a complete tree."""
+        weights_np = np.ones((self.n_padded,), dtype=np.float32)
+        weights_np *= np.asarray(jax.device_get(self.base_mask), dtype=np.float32)
+        weights = self.ctx.shard_rows(weights_np)
+        if dpl is None or not dpl.decision_paths:
+            node_ids = self.ctx.shard_rows(np.zeros((self.n_padded,), np.int32))
+            counts = self.level_counts(node_ids, weights, 1)
+            root_class = counts[0].sum(axis=(0, 1)) / max(self.split_set.n_splits, 1)
+            pop = float(root_class.sum())
+            info = float(_info(root_class[None], self.params.split_algorithm)[0])
+            pr = {cv: float(root_class[i] / max(pop, 1e-12))
+                  for i, cv in enumerate(self.class_values)}
+            return DecisionPathList([DecisionPath(
+                predicates=[Predicate.root()], population=int(round(pop)),
+                info_content=info, stopped=False, class_val_pr=pr)])
+        carried = [p for p in dpl.decision_paths if p.stopped]
+        active = [self._leaf_from_path(p) for p in dpl.decision_paths
+                  if not p.stopped]
+        if not active:
+            return dpl
+        node_ids = self.ctx.shard_rows(self.assign_node_ids(table, active))
+        new_leaves, stopped_paths, _ = self._grow(active, node_ids, weights)
+        paths = carried + stopped_paths + [
+            DecisionPath(predicates=l.predicates,
+                         population=int(round(l.population)),
+                         info_content=l.info_content, stopped=False,
+                         class_val_pr=l.class_val_pr)
+            for l in new_leaves]
+        return DecisionPathList(paths)
+
+
+# --------------------------------------------------------------------------
+# prediction over a DecisionPathList (tree/DecisionTreeModel.java)
+# --------------------------------------------------------------------------
+
+class DecisionTreeModel:
+    """Vectorized evaluator: every path's predicate chain becomes a boolean
+    mask over records; records take the class of the (unique) matching path."""
+
+    def __init__(self, path_list: DecisionPathList, schema: FeatureSchema):
+        self.paths = path_list.decision_paths
+        self.schema = schema
+
+    def _pred_mask(self, pred: Predicate, table: ColumnarTable) -> np.ndarray:
+        n = table.n_rows
+        if pred.pred_str == ROOT_PATH or pred.operator is None:
+            return np.ones((n,), dtype=bool)
+        f = self.schema.find_field_by_ordinal(pred.attribute)
+        if pred.operator == "in":
+            codes = table.columns[pred.attribute]
+            want = {f.cat_code(v) for v in (pred.categorical_values or [])}
+            return np.isin(codes, list(want))
+        vals = table.columns[pred.attribute].astype(np.float64)
+        if pred.operator == "le":
+            m = vals <= pred.threshold
+            if pred.lower_bound is not None:
+                m &= vals > pred.lower_bound
+            return m
+        if pred.operator == "gt":
+            return vals > pred.threshold
+        raise ValueError(f"bad operator {pred.operator}")
+
+    def predict(self, table: ColumnarTable) -> Tuple[List[str], np.ndarray]:
+        """(pred_class per record, prob).  Records matching no path get the
+        globally most probable class (population-weighted)."""
+        n = table.n_rows
+        pred_class = [""] * n
+        prob = np.zeros((n,))
+        assigned = np.zeros((n,), dtype=bool)
+        for path in self.paths:
+            mask = np.ones((n,), dtype=bool)
+            for p in path.predicates:
+                mask &= self._pred_mask(p, table)
+            mask &= ~assigned
+            if not mask.any():
+                continue
+            cv, pr = path.predicted_class()
+            for i in np.nonzero(mask)[0]:
+                pred_class[i] = cv
+                prob[i] = pr
+            assigned |= mask
+        if not assigned.all():
+            # fallback: population-weighted class distribution
+            agg: Dict[str, float] = {}
+            for path in self.paths:
+                for cv, pr in path.class_val_pr.items():
+                    agg[cv] = agg.get(cv, 0.0) + pr * path.population
+            cv = max(agg.items(), key=lambda kv: kv[1])[0] if agg else ""
+            for i in np.nonzero(~assigned)[0]:
+                pred_class[i] = cv
+                prob[i] = 0.5
+        return pred_class, prob

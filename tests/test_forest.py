@@ -1,0 +1,158 @@
+"""Random forest + ensemble + tree CLI job tests."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core.schema import FeatureSchema
+from avenir_tpu.core.table import encode_rows
+from avenir_tpu.models import tree as T
+from avenir_tpu.models.forest import (ForestParams, build_forest, EnsembleModel,
+                                      model_predictor)
+from avenir_tpu.models.tree import DecisionTreeModel, TreeParams
+from avenir_tpu.cli import run as cli_run
+from tests.test_tree import SCHEMA, make_table
+
+
+def test_forest_learns(mesh_ctx):
+    table = make_table(2000)
+    params = ForestParams(num_trees=5, seed=3)
+    params.tree.max_depth = 3
+    models = build_forest(table, params, mesh_ctx)
+    assert len(models) == 5
+    # trees differ (random attrs + bootstrap)
+    jsons = {m.to_json() for m in models}
+    assert len(jsons) > 1
+    ens = EnsembleModel([DecisionTreeModel(m, SCHEMA) for m in models])
+    pred = ens.predict(table)
+    actual = ["T" if c == 0 else "F" for c in table.class_codes()]
+    acc = np.mean([p == a for p, a in zip(pred, actual)])
+    assert acc > 0.8, acc
+
+
+def test_ensemble_odd_check():
+    with pytest.raises(ValueError):
+        EnsembleModel([None, None])  # even count, unweighted
+
+
+def test_ensemble_min_odds_veto(mesh_ctx):
+    table = make_table(300)
+    params = ForestParams(num_trees=3, seed=1)
+    params.tree.max_depth = 2
+    models = [DecisionTreeModel(m, SCHEMA)
+              for m in build_forest(table, params, mesh_ctx)]
+    ens = EnsembleModel(models, min_odds_ratio=5.0, require_odd=False)
+    pred = ens.predict(table)
+    # with 3 trees and odds threshold 5, any 2-1 vote is ambiguous (None)
+    assert any(p is None for p in pred) or all(p is not None for p in pred)
+
+
+def test_per_level_job_rotation(tmp_path, mesh_ctx):
+    """Drive the detr.sh contract: repeated single-level jobs with
+    decPathOut -> decPathIn rotation."""
+    table = make_table(800)
+    csv = tmp_path / "in.csv"
+    with open(csv, "w") as fh:
+        for r in range(table.n_rows):
+            row = [table.str_columns[0][r],
+                   SCHEMA.find_field_by_ordinal(1).cardinality[table.columns[1][r]],
+                   SCHEMA.find_field_by_ordinal(2).cardinality[table.columns[2][r]],
+                   str(int(table.columns[3][r])),
+                   SCHEMA.find_field_by_ordinal(4).cardinality[table.columns[4][r]]]
+            fh.write(",".join(row) + "\n")
+    schema_path = tmp_path / "s.json"
+    schema_path.write_text(json.dumps({"fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "custType", "ordinal": 1, "dataType": "categorical",
+         "feature": True, "maxSplit": 2, "cardinality": ["business", "residence"]},
+        {"name": "issue", "ordinal": 2, "dataType": "categorical", "feature": True,
+         "maxSplit": 2, "cardinality": ["internet", "cable", "billing", "other"]},
+        {"name": "holdTime", "ordinal": 3, "dataType": "int", "feature": True,
+         "min": 0, "max": 600, "splitScanInterval": 120},
+        {"name": "hungup", "ordinal": 4, "dataType": "categorical",
+         "cardinality": ["T", "F"]}]}))
+    props = tmp_path / "detr.properties"
+    props.write_text(
+        "field.delim.regex=,\nfield.delim.out=,\n"
+        f"dtb.feature.schema.file.path={schema_path}\n"
+        f"dtb.decision.file.path.out={tmp_path}/decPathOut.json\n"
+        "dtb.split.algorithm=giniIndex\n"
+        "dtb.path.stopping.strategy=maxDepth\n"
+        "dtb.max.depth.limit=2\n")
+    # iteration 0: root
+    rc = cli_run.main(["org.avenir.tree.DecisionTreeBuilder",
+                       f"-Dconf.path={props}", str(csv), str(tmp_path / "o0")])
+    assert rc == 0
+    d0 = json.loads((tmp_path / "decPathOut.json").read_text())
+    assert len(d0["decisionPaths"]) == 1
+    # iterations 1..2 with rotation
+    for it in range(1, 3):
+        os.replace(tmp_path / "decPathOut.json", tmp_path / "decPathIn.json")
+        rc = cli_run.main([
+            "decisionTreeBuilder", f"-Dconf.path={props}",
+            f"-Ddtb.decision.file.path.in={tmp_path}/decPathIn.json",
+            str(csv), str(tmp_path / f"o{it}")])
+        assert rc == 0
+    final = T.DecisionPathList.from_json((tmp_path / "decPathOut.json").read_text())
+    assert len(final.decision_paths) > 2
+    assert all(p.stopped for p in final.decision_paths)  # depth limit reached
+    # predict with ModelPredictor job
+    pred_props = tmp_path / "mop.properties"
+    pred_props.write_text(
+        "field.delim.regex=,\n"
+        f"mop.feature.schema.file.path={schema_path}\n"
+        f"mop.model.file.names={tmp_path}/decPathOut.json\n"
+        "mop.output.mode=withRecord\n"
+        "mop.error.counting.enabled=true\n"
+        "mop.class.attr.ord=4\n")
+    rc = cli_run.main(["modelPredictor", f"-Dconf.path={pred_props}",
+                       str(csv), str(tmp_path / "pred")])
+    assert rc == 0
+    lines = (tmp_path / "pred" / "part-m-00000").read_text().splitlines()
+    assert len(lines) == 800
+    acc = np.mean([l.split(",")[5] == l.split(",")[4] for l in lines])
+    assert acc > 0.8
+
+
+def test_random_forest_builder_job(tmp_path, mesh_ctx):
+    from tests.test_forest import SCHEMA as _s  # reuse
+    table = make_table(600)
+    csv = tmp_path / "in.csv"
+    with open(csv, "w") as fh:
+        for r in range(table.n_rows):
+            row = [table.str_columns[0][r],
+                   SCHEMA.find_field_by_ordinal(1).cardinality[table.columns[1][r]],
+                   SCHEMA.find_field_by_ordinal(2).cardinality[table.columns[2][r]],
+                   str(int(table.columns[3][r])),
+                   SCHEMA.find_field_by_ordinal(4).cardinality[table.columns[4][r]]]
+            fh.write(",".join(row) + "\n")
+    schema_path = tmp_path / "s.json"
+    import tests.test_tree as tt
+    schema_path.write_text(json.dumps({"fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "custType", "ordinal": 1, "dataType": "categorical",
+         "feature": True, "maxSplit": 2, "cardinality": ["business", "residence"]},
+        {"name": "issue", "ordinal": 2, "dataType": "categorical", "feature": True,
+         "maxSplit": 2, "cardinality": ["internet", "cable", "billing", "other"]},
+        {"name": "holdTime", "ordinal": 3, "dataType": "int", "feature": True,
+         "min": 0, "max": 600, "splitScanInterval": 120},
+        {"name": "hungup", "ordinal": 4, "dataType": "categorical",
+         "cardinality": ["T", "F"]}]}))
+    props = tmp_path / "rafo.properties"
+    props.write_text(
+        "field.delim.regex=,\n"
+        f"dtb.feature.schema.file.path={schema_path}\n"
+        "dtb.split.algorithm=giniIndex\n"
+        "dtb.split.attribute.selection.strategy=randomNotUsedYet\n"
+        "dtb.split.select.strategy=randomAmongTop\n"
+        "dtb.sub.sampling.strategy=withReplace\n"
+        "dtb.sub.sampling.rate=90\n"
+        "dtb.max.depth.limit=2\n"
+        "dtb.num.trees=3\n")
+    rc = cli_run.main(["randomForestBuilder", f"-Dconf.path={props}",
+                       str(csv), str(tmp_path / "forest")])
+    assert rc == 0
+    files = sorted(os.listdir(tmp_path / "forest"))
+    assert files == ["tree_0.json", "tree_1.json", "tree_2.json"]

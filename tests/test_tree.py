@@ -1,0 +1,187 @@
+"""Decision tree builder tests: split enumeration, JSON parity, level growth,
+prediction accuracy on learnable synthetic data."""
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core.schema import FeatureSchema
+from avenir_tpu.core.table import encode_rows
+from avenir_tpu.models import tree as T
+
+
+SCHEMA = FeatureSchema.from_dict({
+    "fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "custType", "ordinal": 1, "dataType": "categorical", "feature": True,
+         "maxSplit": 2, "cardinality": ["business", "residence"]},
+        {"name": "issue", "ordinal": 2, "dataType": "categorical", "feature": True,
+         "maxSplit": 2, "cardinality": ["internet", "cable", "billing", "other"]},
+        {"name": "holdTime", "ordinal": 3, "dataType": "int", "feature": True,
+         "min": 0, "max": 600, "splitScanInterval": 120},
+        {"name": "hungup", "ordinal": 4, "dataType": "categorical",
+         "cardinality": ["T", "F"]},
+    ]
+})
+
+
+def test_set_partitions_counts():
+    # Stirling numbers S(n,k): S(4,2)=7, S(4,3)=6, S(3,2)=3
+    assert len(list(T._set_partitions(list("abcd"), 2))) == 7
+    assert len(list(T._set_partitions(list("abcd"), 3))) == 6
+    assert len(list(T._set_partitions(list("abc"), 2))) == 3
+    # each partition covers all items disjointly
+    for p in T._set_partitions(list("abcd"), 2):
+        flat = [x for g in p for x in g]
+        assert sorted(flat) == list("abcd")
+
+
+def test_numeric_threshold_sets():
+    f = SCHEMA.find_field_by_ordinal(3)
+    # scan points 120,240,360,480; maxSplit default 2 -> single-threshold splits
+    sets = T._numeric_threshold_sets(f)
+    assert sets == [[120], [240], [360], [480]]
+    f2 = FeatureSchema.from_dict({"fields": [
+        {"name": "x", "ordinal": 0, "dataType": "int", "feature": True,
+         "min": 0, "max": 100, "splitScanInterval": 25, "maxSplit": 3}]}
+    ).find_field_by_ordinal(0)
+    sets2 = T._numeric_threshold_sets(f2)
+    # single thresholds [25],[50],[75] + pairs (25,50),(25,75),(50,75)
+    assert [s for s in sets2 if len(s) == 1] == [[25], [50], [75]]
+    assert [s for s in sets2 if len(s) == 2] == [[25, 50], [25, 75], [50, 75]]
+
+
+def test_candidate_splits():
+    splits = T.generate_candidate_splits(SCHEMA)
+    by_attr = {}
+    for s in splits:
+        by_attr.setdefault(s.attr, []).append(s)
+    assert len(by_attr[1]) == 1           # 2 values into 2 groups: 1 partition
+    assert len(by_attr[2]) == 7           # S(4,2)
+    assert len(by_attr[3]) == 4           # single-threshold splits
+    num = by_attr[3][0]
+    assert [p.pred_str for p in num.predicates] == ["3 le 120", "3 gt 120"]
+
+
+def test_predicate_json_roundtrip():
+    p = T.Predicate.num(3, "le", 240, 120)
+    d = p.to_dict()
+    assert d["predicateStr"] == "3 le 240 120"
+    assert d["valueInt"] == 240 and d["otherBoundInt"] == 120
+    p2 = T.Predicate.from_dict(d)
+    assert p2.evaluate(200) and not p2.evaluate(100) and not p2.evaluate(250)
+    c = T.Predicate.cat(2, ["internet", "cable"])
+    assert c.pred_str == "2 in internet:cable"
+    assert c.evaluate("cable") and not c.evaluate("billing")
+
+
+def make_table(n=2000, seed=5):
+    """hungup=T iff (issue in {internet,cable} and holdTime>240) or
+    (custType=business and holdTime>480) + small noise: tree-learnable."""
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(n):
+        ct = rng.choice(["business", "residence"])
+        issue = rng.choice(["internet", "cable", "billing", "other"])
+        ht = int(rng.integers(0, 600))
+        hung = (issue in ("internet", "cable") and ht > 240) or \
+               (ct == "business" and ht > 480)
+        if rng.random() < 0.05:
+            hung = not hung
+        rows.append([f"r{i}", ct, issue, str(ht), "T" if hung else "F"])
+    return encode_rows(rows, SCHEMA)
+
+
+def test_branch_codes_match_predicates(mesh_ctx):
+    table = make_table(200)
+    splits = T.generate_candidate_splits(SCHEMA)
+    ss = T.SplitSet(splits, SCHEMA)
+    import jax.numpy as jnp
+    X = ss.feature_matrix(table)
+    codes = np.asarray(ss.branch_codes(jnp.asarray(X)))
+    # oracle: evaluate predicates host-side
+    for si in [0, 1, 5, len(splits) - 1]:
+        s = splits[si]
+        f = SCHEMA.find_field_by_ordinal(s.attr)
+        for ri in range(0, 200, 17):
+            if f.is_categorical:
+                value = f.cardinality[int(table.columns[s.attr][ri])]
+            else:
+                value = table.columns[s.attr][ri]
+            matches = [bi for bi, p in enumerate(s.predicates) if p.evaluate(value)]
+            assert len(matches) == 1, f"split {si} not disjoint"
+            assert codes[ri, si] == matches[0]
+
+
+def test_root_only_build(mesh_ctx):
+    table = make_table(500)
+    params = T.TreeParams(stopping_strategy="maxDepth", max_depth=0)
+    b = T.TreeBuilder(table, params, mesh_ctx)
+    dpl = b.build(max_levels=0)
+    assert len(dpl.decision_paths) == 1
+    root = dpl.decision_paths[0]
+    assert root.predicates[0].pred_str == T.ROOT_PATH
+    assert root.population == 500
+    assert abs(sum(root.class_val_pr.values()) - 1.0) < 1e-6
+
+
+def test_level_counts_match_oracle(mesh_ctx):
+    table = make_table(300)
+    params = T.TreeParams(max_depth=2, split_algorithm="giniIndex", seed=1)
+    b = T.TreeBuilder(table, params, mesh_ctx)
+    import jax.numpy as jnp
+    node_ids = mesh_ctx.shard_rows(np.zeros((b.n_padded,), dtype=np.int32))
+    w = mesh_ctx.shard_rows(
+        np.asarray(np.arange(b.n_padded) < b.n_rows, dtype=np.float32))
+    counts = b.level_counts(node_ids, w, 1)
+    codes = np.asarray(b.branches)[:b.n_rows]
+    cls = table.class_codes()
+    si = 2  # arbitrary split
+    s = b.splits[si]
+    for bi in range(s.n_branches):
+        for c in range(2):
+            expect = np.sum((codes[:, si] == bi) & (cls == c))
+            assert counts[0, si, bi, c] == expect
+
+
+def test_full_build_accuracy(mesh_ctx):
+    table = make_table(3000)
+    params = T.TreeParams(split_algorithm="entropy", max_depth=3,
+                          attr_select_strategy="notUsedYet", seed=0)
+    b = T.TreeBuilder(table, params, mesh_ctx)
+    dpl = b.build()
+    # model JSON round trip
+    dpl2 = T.DecisionPathList.from_json(dpl.to_json())
+    assert len(dpl2.decision_paths) == len(dpl.decision_paths)
+    model = T.DecisionTreeModel(dpl2, SCHEMA)
+    pred, prob = model.predict(table)
+    actual = ["T" if c == 0 else "F" for c in table.class_codes()]
+    acc = np.mean([p == a for p, a in zip(pred, actual)])
+    assert acc > 0.85, f"tree should learn the rule, acc={acc}"
+    # populations partition the dataset
+    assert sum(p.population for p in dpl.decision_paths) == 3000
+
+
+def test_json_matches_reference_field_names(mesh_ctx):
+    table = make_table(300)
+    b = T.TreeBuilder(table, T.TreeParams(max_depth=1), mesh_ctx)
+    d = json.loads(b.build().to_json())
+    path = d["decisionPaths"][0]
+    assert set(path.keys()) == {"stopped", "classValPr", "infoContent",
+                                "predicates", "population"}
+    pred = path["predicates"][0]
+    assert set(pred.keys()) == {"attribute", "predicateStr", "operator",
+                                "valueInt", "valueDbl", "categoricalValues",
+                                "otherBoundInt", "otherBoundDbl"}
+
+
+def test_min_population_stopping(mesh_ctx):
+    table = make_table(1000)
+    params = T.TreeParams(stopping_strategy="minPopulation", min_population=400,
+                          max_depth=8)
+    dpl = T.TreeBuilder(table, params, mesh_ctx).build(max_levels=6)
+    # all paths with population < 400 must be stopped
+    for p in dpl.decision_paths:
+        assert p.stopped
