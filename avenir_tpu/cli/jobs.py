@@ -170,6 +170,239 @@ def model_predictor_job(cfg: Config, in_path: str, out_path: str) -> Counters:
 
 
 # --------------------------------------------------------------------------
+# org.avenir.knn (+ the sifarish distance job the pipeline shells out to)
+# --------------------------------------------------------------------------
+
+@register("org.sifarish.feature.SameTypeSimilarity", "sameTypeSimilarity",
+          "recordSimilarity")
+def same_type_similarity(cfg: Config, in_path: str, out_path: str) -> Counters:
+    """All-pairs record distance (the external sifarish job of
+    resource/knn.sh:47, and avenir-spark RecordSimilarity.scala:65-103).
+
+    Inter-set mode: files in the input dir starting with
+    sts.base.set.split.prefix are the train/base set, the rest are test.
+    Output lines: trainId,testId,distance,trainClass[,testClass]
+    with distance scaled by sts.distance.scale (default 1000).
+    Divergence: accepts our FeatureSchema JSON (sts.same.schema.file.path)
+    rather than sifarish's rich schema."""
+    import glob as _glob
+    from ..ops.distance import DistanceComputer
+    counters = Counters()
+    schema = _schema_path(cfg, "sts.same.schema.file.path")
+    delim = cfg.field_delim_regex
+    prefix = cfg.get("sts.base.set.split.prefix", "tr")
+    scale = cfg.get_int("sts.distance.scale", 1000)
+    metric = cfg.get("sts.distance.metric", "euclidean")
+
+    intra_set = False
+    if os.path.isdir(in_path):
+        files = sorted(p for p in _glob.glob(os.path.join(in_path, "*"))
+                       if os.path.isfile(p))
+        base = [p for p in files if os.path.basename(p).startswith(prefix)]
+        other = [p for p in files if not os.path.basename(p).startswith(prefix)]
+        if not base or not other:
+            base = other = files
+            intra_set = True
+    else:
+        base = other = [in_path]
+        intra_set = True
+
+    def load_many(paths):
+        lines = []
+        for p in paths:
+            lines.extend(artifacts.read_text_input(p))
+        from ..core.table import load_csv_text
+        return load_csv_text("\n".join(lines), schema, delim)
+
+    train = load_many(base)
+    test = train if intra_set else load_many(other)
+    comp = DistanceComputer(schema, metric=metric, scale=scale)
+    dmat = comp.pairwise(test, train)
+    id_ord = schema.id_fields[0].ordinal if schema.id_fields else 0
+    train_ids = train.str_columns.get(id_ord, [str(i) for i in range(train.n_rows)])
+    test_ids = test.str_columns.get(id_ord, [str(i) for i in range(test.n_rows)])
+    # class columns are optional: pure similarity mode (sifarish's normal use)
+    # has no class notion at all
+    try:
+        cls_field = schema.class_attr_field
+        cvals = cls_field.cardinality or []
+        train_cls = [cvals[c] if c >= 0 else "?" for c in train.class_codes()]
+        test_cls = [cvals[c] if c >= 0 else "?" for c in test.class_codes()]
+    except ValueError:
+        train_cls = test_cls = None
+    od = cfg.field_delim_out
+    lines = []
+    for ti in range(test.n_rows):
+        # intra-set mode emits each unordered pair once (i < j), like
+        # sifarish's within-set matching — never a self-pair, which would
+        # leak labels into a downstream KNN validation
+        for ri in range(ti + 1 if intra_set else 0, train.n_rows):
+            parts = [train_ids[ri], test_ids[ti], str(int(dmat[ti, ri]))]
+            if train_cls is not None:
+                parts.append(train_cls[ri])
+                parts.append(test_cls[ti])
+            lines.append(od.join(parts))
+    artifacts.write_text_output(out_path, lines)
+    counters.increment("Similarity", "Pairs", len(lines))
+    return counters
+
+
+def _knn_params(cfg: Config):
+    from ..models.knn import KnnParams
+    params = KnnParams(
+        top_match_count=cfg.get_int("nen.top.match.count", 10),
+        kernel_function=cfg.get("nen.kernel.function", "none"),
+        kernel_param=cfg.get_int("nen.kernel.param", -1),
+        # the reference uses BOTH spellings: mapper reads
+        # nen.class.condition.weighted (NearestNeighbor.java:120), reducer the
+        # typo'd nen.class.condtion.weighted (:239); accept either
+        class_cond_weighted=cfg.get_boolean("nen.class.condtion.weighted", False)
+        or cfg.get_boolean("nen.class.condition.weighted", False),
+        inverse_distance_weighted=cfg.get_boolean("nen.inverse.distance.weighted",
+                                                  False),
+        decision_threshold=cfg.get_float("nen.decision.threshold", -1.0),
+        use_cost_based_classifier=cfg.get_boolean("nen.use.cost.based.classifier",
+                                                  False),
+        prediction_mode=cfg.get("nen.prediction.mode", "classification"),
+        regression_method=cfg.get("nen.regression.method", "average"),
+    )
+    cav = cfg.get_list("nen.class.attribute.values")
+    if cav:
+        params.pos_class, params.neg_class = cav[0], cav[1]
+    if params.use_cost_based_classifier:
+        costs = cfg.must_get_list("nen.misclassification.cost")
+        params.false_pos_cost, params.false_neg_cost = int(costs[0]), int(costs[1])
+    return params
+
+
+@register("org.avenir.knn.NearestNeighbor", "nearestNeighbor", "knnClassifier")
+def nearest_neighbor(cfg: Config, in_path: str, out_path: str) -> Counters:
+    """KNN classification/regression over precomputed neighbor lines
+    (knn/NearestNeighbor.java; the knn.sh 'knnClassifier' step).
+
+    Input layout (TopMatchesMapper :130-183):
+      normal:            trainId,testId,distance,trainClass[,testClassActual]
+      classCondWeighted: testId,testClassActual,trainId,distance,trainClass,postProb
+    Output: testId[,classDistr...][,actualClass],predicted  + Validation
+    counters in validation mode."""
+    import numpy as _np
+    from ..models import knn as K
+    counters = Counters()
+    params = _knn_params(cfg)
+    validation = cfg.get_boolean("nen.validation.mode", True)
+    output_class_distr = cfg.get_boolean("nen.output.class.distr", False)
+    delim = cfg.field_delim_regex
+    od = cfg.field_delim_out
+    lines_in = artifacts.read_text_input(in_path)
+
+    is_linreg = (params.prediction_mode == "regression" and
+                 params.regression_method == "linearRegression")
+
+    # group neighbor candidates per test entity (TopMatchesMapper layouts)
+    groups: Dict[str, Dict] = {}
+    for line in lines_in:
+        it = line.split(delim)
+        train_regr = test_regr = 0.0
+        if params.class_cond_weighted:
+            test_id, actual, train_id = it[0], it[1], it[2]
+            dist, tclass, fpp = int(it[3]), it[4], float(it[5])
+        else:
+            idx = 0
+            train_id = it[idx]; idx += 1
+            test_id = it[idx]; idx += 1
+            dist = int(it[idx]); idx += 1
+            tclass = it[idx]; idx += 1
+            actual = ""
+            if validation:
+                actual = it[idx]; idx += 1
+            if is_linreg:
+                train_regr = float(it[idx]); idx += 1
+                test_regr = float(it[idx]); idx += 1
+            fpp = -1.0
+        g = groups.setdefault(test_id, {"actual": actual, "d": [], "c": [],
+                                        "fpp": [], "trv": [], "tev": test_regr})
+        g["d"].append(dist)
+        g["c"].append(tclass)
+        g["fpp"].append(fpp)
+        g["trv"].append(train_regr)
+
+    class_values = sorted({c for g in groups.values() for c in g["c"]})
+    cls_code = {c: i for i, c in enumerate(class_values)}
+    test_ids = sorted(groups.keys())
+    max_n = max(len(groups[t]["d"]) for t in test_ids)
+    dmat = _np.full((len(test_ids), max_n), K.PAD_DISTANCE, dtype=_np.int64)
+    cmat = _np.zeros((len(test_ids), max_n), dtype=_np.int32)
+    fmat = _np.full((len(test_ids), max_n), -1.0, dtype=_np.float32)
+    for i, t in enumerate(test_ids):
+        g = groups[t]
+        m = len(g["d"])
+        dmat[i, :m] = g["d"]
+        cmat[i, :m] = [cls_code[c] for c in g["c"]]
+        fmat[i, :m] = g["fpp"]
+
+    if params.prediction_mode == "regression":
+        vals = _np.array([[float(class_values[c]) for c in row] for row in cmat])
+        if is_linreg:
+            nin = _np.zeros_like(dmat, dtype=_np.float64)
+            x0 = _np.zeros((len(test_ids),))
+            for i, t in enumerate(test_ids):
+                m = len(groups[t]["trv"])
+                nin[i, :m] = groups[t]["trv"]
+                x0[i] = groups[t]["tev"]
+            pred_vals = K.regress_grouped(dmat, vals, params,
+                                          regr_input=x0, neighbor_input=nin)
+        else:
+            pred_vals = K.regress_grouped(dmat, vals, params)
+        out_lines = []
+        for i, t in enumerate(test_ids):
+            parts = [t]
+            if validation:
+                parts.append(groups[t]["actual"])
+            parts.append(str(int(pred_vals[i])))
+            out_lines.append(od.join(parts))
+        artifacts.write_text_output(out_path, out_lines)
+        return counters
+
+    res = K.classify_grouped(dmat, cmat, class_values, params, fmat)
+
+    from ..core.metrics import ConfusionMatrix
+    cm = None
+    if validation:
+        # the reference builds the matrix from the schema's class cardinality:
+        # ConfusionMatrix(cardinality[0], cardinality[1]) = (neg, pos)
+        # (NearestNeighbor.java:287-292)
+        if "nen.feature.schema.file.path" in cfg:
+            card = _schema_path(cfg, "nen.feature.schema.file.path") \
+                .class_attr_field.cardinality
+            neg, pos = card[0], card[1]
+        elif params.pos_class:
+            neg, pos = params.neg_class, params.pos_class
+        else:
+            cvs = class_values if len(class_values) >= 2 else class_values * 2
+            neg, pos = cvs[0], cvs[1]
+        cm = ConfusionMatrix(neg, pos)
+    out_lines = []
+    for i, t in enumerate(test_ids):
+        parts = [t]
+        if output_class_distr:
+            distr = res.weighted_class_distr[i] if params.class_cond_weighted \
+                else res.class_distr[i]
+            for ci, cv in enumerate(class_values):
+                parts.append(cv)
+                parts.append(str(distr[ci]))
+        if validation:
+            parts.append(groups[t]["actual"])
+        parts.append(res.pred_class[i])
+        out_lines.append(od.join(parts))
+        if cm is not None:
+            cm.report(res.pred_class[i], groups[t]["actual"])
+    if cm is not None:
+        cm.export(counters)
+    artifacts.write_text_output(out_path, out_lines)
+    return counters
+
+
+# --------------------------------------------------------------------------
 # org.avenir.bayesian
 # --------------------------------------------------------------------------
 

@@ -1,0 +1,264 @@
+"""K nearest neighbor: batched top-k classification / regression.
+
+Capability parity with org.avenir.knn (SURVEY.md §2.3, call stack §3.4):
+
+  * top-k neighbors per test record == the secondary-sorted shuffle +
+    reducer truncation (knn/NearestNeighbor.java:80-81, 345-349), here a
+    single ``lax.top_k`` over the distance matrix;
+  * kernels none / linearMultiplicative / linearAdditive / gaussian with the
+    reference's integer score arithmetic (knn/Neighborhood.java:150-200:
+    KERNEL_SCALE=100, d==0 -> 2*scale, integer division for
+    linearMultiplicative); the reference's 'sigmoid' branch is an empty stub
+    (:195) — we raise instead of silently classifying nothing;
+  * class-conditional probability weighting (score x featurePostProb,
+    optional x 1/distance — Neighborhood.Neighbor.setScore :393-403);
+  * decision threshold on pos/neg score ratio (:272-290) and cost-based
+    arbitration via integer class probability (:300-320,
+    NearestNeighbor.java:383-387);
+  * KNN regression: average / median / per-test-record simple linear
+    regression (Neighborhood.doRegression :223-250) vectorized closed-form.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.metrics import ConfusionMatrix, CostBasedArbitrator, Counters
+
+KERNEL_SCALE = 100
+PROB_SCALE = 100
+# sentinel distance for ragged per-test neighbor lists (rows padded to the
+# max candidate count); entries at/above it contribute nothing
+PAD_DISTANCE = 1 << 30
+
+
+@dataclass
+class KnnParams:
+    """The nen.* knobs (resource/knn.properties)."""
+    top_match_count: int = 10
+    kernel_function: str = "none"    # none|linearMultiplicative|linearAdditive|gaussian
+    kernel_param: int = -1
+    class_cond_weighted: bool = False
+    inverse_distance_weighted: bool = False
+    decision_threshold: float = -1.0
+    pos_class: Optional[str] = None
+    neg_class: Optional[str] = None
+    use_cost_based_classifier: bool = False
+    false_pos_cost: int = 1
+    false_neg_cost: int = 1
+    prediction_mode: str = "classification"   # classification | regression
+    regression_method: str = "average"        # average|median|linearRegression
+
+
+def kernel_scores(distances: jnp.ndarray, kernel: str,
+                  kernel_param: int) -> jnp.ndarray:
+    """Integer neighbor scores per the reference kernels (d is the scaled int
+    distance)."""
+    d = distances.astype(jnp.int32)
+    if kernel == "none":
+        return jnp.ones_like(d)
+    if kernel == "linearMultiplicative":
+        return jnp.where(d == 0, 2 * KERNEL_SCALE,
+                         KERNEL_SCALE // jnp.maximum(d, 1))
+    if kernel == "linearAdditive":
+        return KERNEL_SCALE - d
+    if kernel == "gaussian":
+        t = d.astype(jnp.float32) / float(kernel_param)
+        return (KERNEL_SCALE * jnp.exp(-0.5 * t * t)).astype(jnp.int32)
+    if kernel == "sigmoid":
+        raise NotImplementedError(
+            "kernel 'sigmoid' is an empty stub in the reference "
+            "(knn/Neighborhood.java:195) and is not supported")
+    raise ValueError(f"unknown kernel function {kernel!r}")
+
+
+@dataclass
+class KnnResult:
+    pred_class: Optional[List[str]] = None           # classification
+    pred_value: Optional[np.ndarray] = None          # regression (int)
+    class_distr: Optional[np.ndarray] = None         # (n, C) int scores
+    weighted_class_distr: Optional[np.ndarray] = None  # (n, C) float
+    pos_class_prob: Optional[np.ndarray] = None      # (n,) int percent
+
+
+def classify(distances: np.ndarray,            # (n_test, n_train) int
+             train_classes: np.ndarray,        # (n_train,) int codes
+             class_values: Sequence[str],
+             params: KnnParams,
+             feature_post_prob: Optional[np.ndarray] = None,  # (n_train,)
+             ) -> KnnResult:
+    """Vectorized Neighborhood over a SHARED train set: every test row draws
+    neighbors from the same train vectors."""
+    fpp = feature_post_prob if feature_post_prob is not None else \
+        np.full((distances.shape[1],), -1.0, dtype=np.float32)
+    k = min(params.top_match_count, distances.shape[1])
+
+    @jax.jit
+    def kern(d, cls, fpp):
+        neg_topv, idx = jax.lax.top_k(-d, k)
+        return -neg_topv, cls[idx], fpp[idx]
+
+    nd, ncls, nfpp = (np.asarray(x) for x in kern(
+        jnp.asarray(distances), jnp.asarray(train_classes),
+        jnp.asarray(fpp, dtype=jnp.float32)))
+    return _classify_topk(nd, ncls, nfpp, class_values, params)
+
+
+def classify_grouped(dmat: np.ndarray, cmat: np.ndarray,
+                     class_values: Sequence[str], params: KnnParams,
+                     fmat: Optional[np.ndarray] = None) -> KnnResult:
+    """Per-row neighbor lists (the NearestNeighbor job's input layout, where
+    each test entity carries its own candidate set): top-k within each row."""
+    k = min(params.top_match_count, dmat.shape[1])
+    idx = np.argsort(dmat, axis=1, kind="stable")[:, :k]
+    nd = np.take_along_axis(dmat, idx, axis=1)
+    ncls = np.take_along_axis(cmat, idx, axis=1)
+    nfpp = np.take_along_axis(fmat, idx, axis=1) if fmat is not None else \
+        np.full_like(nd, -1.0, dtype=np.float32)
+    return _classify_topk(nd, ncls, nfpp, class_values, params)
+
+
+def _classify_topk(nd: np.ndarray, ncls: np.ndarray, nfpp: np.ndarray,
+                   class_values: Sequence[str], params: KnnParams) -> KnnResult:
+    """Kernel scores -> per-class sums -> classify/arbitrate, given the
+    already-selected top-k neighbors per test row."""
+    C = len(class_values)
+    if params.kernel_function == "sigmoid":
+        raise NotImplementedError(
+            "kernel 'sigmoid' is an empty stub in the reference "
+            "(knn/Neighborhood.java:195) and is not supported")
+    if params.kernel_function not in ("none", "linearMultiplicative",
+                                      "linearAdditive", "gaussian"):
+        raise ValueError(f"unknown kernel function {params.kernel_function!r}")
+
+    @jax.jit
+    def kern(nd, ncls, nfpp):
+        valid = nd < PAD_DISTANCE
+        scores = kernel_scores(nd, params.kernel_function, params.kernel_param)
+        scores = scores * valid.astype(scores.dtype)
+        oh = jax.nn.one_hot(ncls, C, dtype=jnp.int32)   # (n, k, C)
+        class_distr = (scores[:, :, None] * oh).sum(axis=1)     # (n, C)
+        wscores = jnp.where(nfpp > 0, scores * nfpp, scores.astype(jnp.float32))
+        if params.inverse_distance_weighted:
+            wscores = wscores / jnp.maximum(nd.astype(jnp.float32), 1e-9)
+        weighted = (wscores[:, :, None] * oh.astype(jnp.float32)).sum(axis=1)
+        return class_distr, weighted
+
+    class_distr, weighted = (np.asarray(x) for x in kern(
+        jnp.asarray(nd.astype(np.int32)), jnp.asarray(ncls),
+        jnp.asarray(nfpp, dtype=jnp.float32)))
+
+    if params.prediction_mode == "regression":
+        vals = np.asarray(
+            [[float(class_values[c]) for c in row] for row in ncls])
+        return KnnResult(pred_value=_regress(vals, nd, params,
+                                             valid=nd < PAD_DISTANCE))
+
+    cls_index = {v: i for i, v in enumerate(class_values)}
+    if params.class_cond_weighted:
+        best = np.argmax(weighted, axis=1)
+        pred = [class_values[b] for b in best]
+        totals = weighted.sum(axis=1)
+        pos_prob = None
+        if params.pos_class is not None:
+            pi = cls_index[params.pos_class]
+            pos_prob = ((weighted[:, pi] * PROB_SCALE) /
+                        np.maximum(totals, 1e-12)).astype(np.int32)
+    else:
+        pos_prob = None
+        if params.pos_class is not None:
+            pi = cls_index[params.pos_class]
+            totals = class_distr.sum(axis=1)
+            pos_prob = ((class_distr[:, pi] * PROB_SCALE) //
+                        np.maximum(totals, 1)).astype(np.int32)
+        if params.decision_threshold > 0:
+            pi = cls_index[params.pos_class]
+            ni = cls_index[params.neg_class]
+            with np.errstate(divide="ignore"):
+                ratio = class_distr[:, pi] / np.maximum(class_distr[:, ni], 1e-12)
+            pred = [params.pos_class if r > params.decision_threshold
+                    else params.neg_class for r in ratio]
+        else:
+            best = np.argmax(class_distr, axis=1)
+            pred = [class_values[b] for b in best]
+
+    if params.use_cost_based_classifier:
+        arb = CostBasedArbitrator(params.neg_class, params.pos_class,
+                                  params.false_neg_cost, params.false_pos_cost)
+        pred = [arb.classify(int(p)) for p in pos_prob]
+
+    return KnnResult(pred_class=pred, class_distr=class_distr,
+                     weighted_class_distr=weighted, pos_class_prob=pos_prob)
+
+
+def _regress(vals: np.ndarray, dists: np.ndarray, params: KnnParams,
+             regr_input: Optional[np.ndarray] = None,
+             neighbor_input: Optional[np.ndarray] = None,
+             valid: Optional[np.ndarray] = None) -> np.ndarray:
+    """Regression over neighbor values (integer results like the reference,
+    which divides by neighbors.size() — the count of REAL neighbors).
+    ``valid`` masks ragged-padding entries out of every statistic."""
+    v = valid if valid is not None else np.ones(vals.shape, dtype=bool)
+    cnt = np.maximum(v.sum(axis=1), 1)
+    if params.regression_method == "average":
+        return ((vals * v).sum(axis=1) / cnt).astype(np.int64)
+    if params.regression_method == "median":
+        out = np.zeros((vals.shape[0],), dtype=np.int64)
+        for i in range(vals.shape[0]):
+            s = np.sort(vals[i][v[i]]).astype(np.int64)
+            mid = len(s) // 2
+            out[i] = s[mid] if len(s) % 2 == 1 else (s[mid - 1] + s[mid]) // 2
+        return out
+    if params.regression_method == "linearRegression":
+        # per-test-row simple regression y ~ x over neighbors
+        # (Neighborhood.doRegression :241-246, SimpleRegression closed form),
+        # evaluated at the test record's regression input var
+        if neighbor_input is None:
+            raise ValueError(
+                "linearRegression requires per-neighbor regression input "
+                "values (the trainRegrNumFld column of the reference layout)")
+        x = np.where(v, neighbor_input, 0.0).astype(np.float64)
+        y = np.where(v, vals, 0.0)
+        xm = (x.sum(axis=1) / cnt)[:, None]
+        ym = (y.sum(axis=1) / cnt)[:, None]
+        cov = (((x - xm) * (y - ym)) * v).sum(axis=1)
+        var = (((x - xm) ** 2) * v).sum(axis=1)
+        slope = np.where(var > 0, cov / np.maximum(var, 1e-12), 0.0)
+        intercept = ym[:, 0] - slope * xm[:, 0]
+        x0 = regr_input if regr_input is not None else np.zeros(len(slope))
+        return (intercept + slope * x0).astype(np.int64)
+    raise ValueError(f"unknown regression method {params.regression_method!r}")
+
+
+def regress_grouped(dmat: np.ndarray, vals: np.ndarray, params: KnnParams,
+                    regr_input: Optional[np.ndarray] = None,
+                    neighbor_input: Optional[np.ndarray] = None) -> np.ndarray:
+    """KNN regression over per-row neighbor lists: top-k then _regress.
+    ``vals`` (n, m) neighbor target values; PAD_DISTANCE rows are masked."""
+    k = min(params.top_match_count, dmat.shape[1])
+    idx = np.argsort(dmat, axis=1, kind="stable")[:, :k]
+    nd = np.take_along_axis(dmat, idx, axis=1)
+    nv = np.take_along_axis(vals.astype(np.float64), idx, axis=1)
+    ni = np.take_along_axis(neighbor_input, idx, axis=1) \
+        if neighbor_input is not None else None
+    return _regress(nv, nd, params, regr_input=regr_input, neighbor_input=ni,
+                    valid=nd < PAD_DISTANCE)
+
+
+def regress(distances: np.ndarray, train_values: np.ndarray, params: KnnParams,
+            regr_input: Optional[np.ndarray] = None,
+            train_regr_input: Optional[np.ndarray] = None) -> np.ndarray:
+    """KNN regression over a shared train set: top-k then _regress."""
+    k = min(params.top_match_count, distances.shape[1])
+    idx = np.argsort(distances, axis=1)[:, :k]
+    nd = np.take_along_axis(distances, idx, axis=1)
+    vals = train_values[idx].astype(np.float64)
+    ni = train_regr_input[idx] if train_regr_input is not None else None
+    return _regress(vals, nd, params, regr_input=regr_input, neighbor_input=ni,
+                    valid=nd < PAD_DISTANCE)

@@ -1,0 +1,114 @@
+"""Mixed-type record distance + tiled all-pairs computation.
+
+Replaces the sifarish ``SameTypeSimilarity`` MR job of the reference KNN
+pipeline (resource/knn.sh:47) and avenir-spark's ``RecordSimilarity`` bucket-
+pair replication join (spark/.../similarity/RecordSimilarity.scala:65-103).
+chombo's ``InterRecordDistance`` (not vendored in the reference) defines the
+per-attribute semantics we reproduce: numeric attrs contribute
+|a-b| / (max-min) in [0,1]; categorical attrs contribute 0/1 mismatch;
+aggregation is euclidean sqrt(mean of squares) or manhattan mean.  Distances
+are emitted as ints scaled by ``distance scale`` (sts.distance.scale=1000 in
+resource/knn.properties).
+
+TPU design (SURVEY.md §2.10 'bucket-pair replication join' row): all-pairs
+distance is a matmul problem, not a join problem —
+
+  * euclidean numeric part:  |a'-b'|^2 summed over attrs = |a'|^2 + |b'|^2
+    - 2 a'·b'  with a' = a/range  -> one (n_test, n_train) GEMM;
+  * categorical mismatch count = F_cat - matches, matches = block-one-hot
+    GEMM  A(n_test, sum_card) @ B(n_train, sum_card)^T;
+  * manhattan falls back to a broadcast-tiled pass (bandwidth-bound).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.schema import FeatureSchema, FeatureField
+from ..core.table import ColumnarTable
+
+
+class DistanceComputer:
+    """Precomputes per-attr normalization + categorical one-hot layout for a
+    schema, then computes all-pairs int distances on device."""
+
+    def __init__(self, schema: FeatureSchema, metric: str = "euclidean",
+                 scale: int = 1000):
+        self.schema = schema
+        self.metric = metric
+        self.scale = scale
+        self.num_fields = [f for f in schema.feature_fields if f.is_numeric]
+        self.cat_fields = [f for f in schema.feature_fields if f.is_categorical]
+        self.n_attrs = len(self.num_fields) + len(self.cat_fields)
+        self.ranges = np.array(
+            [max(float(f.max) - float(f.min), 1e-12) if f.max is not None
+             and f.min is not None else 1.0 for f in self.num_fields],
+            dtype=np.float32)
+        self.cards = [len(f.cardinality or []) for f in self.cat_fields]
+
+    # ---- encode a table into (numeric matrix, categorical block one-hot) ----
+    def encode(self, table: ColumnarTable) -> Tuple[np.ndarray, np.ndarray]:
+        n = table.n_rows
+        if self.num_fields:
+            num = np.stack([table.columns[f.ordinal] / r for f, r in
+                            zip(self.num_fields, self.ranges)], axis=1
+                           ).astype(np.float32)
+        else:
+            num = np.zeros((n, 0), dtype=np.float32)
+        total_card = sum(self.cards)
+        oh = np.zeros((n, total_card), dtype=np.float32)
+        off = 0
+        for f, card in zip(self.cat_fields, self.cards):
+            codes = table.columns[f.ordinal]
+            valid = codes >= 0
+            oh[np.arange(n)[valid], off + codes[valid]] = 1.0
+            off += card
+        return num, oh
+
+    def pairwise(self, test: ColumnarTable, train: ColumnarTable,
+                 tile: int = 4096) -> np.ndarray:
+        """(n_test, n_train) int32 scaled distances."""
+        tn, toh = self.encode(test)
+        rn, roh = self.encode(train)
+        if self.metric == "euclidean":
+            d = self._euclidean(jnp.asarray(tn), jnp.asarray(toh),
+                                jnp.asarray(rn), jnp.asarray(roh))
+        elif self.metric == "manhattan":
+            d = self._manhattan_tiled(tn, toh, rn, roh, tile)
+        else:
+            raise ValueError(f"unknown metric {self.metric!r}")
+        return np.asarray(d).astype(np.int32)
+
+    def _euclidean(self, tn, toh, rn, roh):
+        @jax.jit
+        def kernel(tn, toh, rn, roh):
+            sq = (tn * tn).sum(1)[:, None] + (rn * rn).sum(1)[None, :] \
+                - 2.0 * tn @ rn.T                                  # (nt, nr)
+            cat_match = toh @ roh.T                                # matches
+            cat_mismatch = float(len(self.cat_fields)) - cat_match
+            total = jnp.maximum(sq, 0.0) + cat_mismatch            # d in {0,1}: d^2=d
+            mean = total / max(self.n_attrs, 1)
+            return jnp.floor(jnp.sqrt(jnp.maximum(mean, 0.0)) * self.scale)
+        return kernel(tn, toh, rn, roh)
+
+    def _manhattan_tiled(self, tn, toh, rn, roh, tile):
+        out = np.zeros((tn.shape[0], rn.shape[0]), dtype=np.float32)
+
+        @jax.jit
+        def kernel(tn_tile, toh_tile, rn, roh):
+            num = jnp.abs(tn_tile[:, None, :] - rn[None, :, :]).sum(2)
+            cat_match = toh_tile @ roh.T
+            cat = float(len(self.cat_fields)) - cat_match
+            mean = (num + cat) / max(self.n_attrs, 1)
+            return jnp.floor(mean * self.scale)
+
+        for s in range(0, tn.shape[0], tile):
+            e = min(s + tile, tn.shape[0])
+            out[s:e] = np.asarray(kernel(jnp.asarray(tn[s:e]), jnp.asarray(toh[s:e]),
+                                         jnp.asarray(rn), jnp.asarray(roh)))
+        return out

@@ -1,0 +1,233 @@
+"""KNN tests: distance oracle, kernel integer semantics, classification
+accuracy, regression, end-to-end two-job pipeline via CLI."""
+
+import json
+import numpy as np
+import pytest
+
+from avenir_tpu.core.schema import FeatureSchema
+from avenir_tpu.core.table import encode_rows
+from avenir_tpu.ops.distance import DistanceComputer
+from avenir_tpu.models import knn as K
+from avenir_tpu.cli import run as cli_run
+
+
+SCHEMA = FeatureSchema.from_dict({
+    "fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "x", "ordinal": 1, "dataType": "double", "feature": True,
+         "min": 0, "max": 10},
+        {"name": "y", "ordinal": 2, "dataType": "double", "feature": True,
+         "min": 0, "max": 10},
+        {"name": "color", "ordinal": 3, "dataType": "categorical", "feature": True,
+         "cardinality": ["red", "green"]},
+        {"name": "label", "ordinal": 4, "dataType": "categorical",
+         "cardinality": ["A", "B"]},
+    ]
+})
+
+
+def two_cluster_rows(n, seed=0):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(n):
+        if i % 2 == 0:
+            x, y, col, lab = rng.normal(2, 0.7), rng.normal(2, 0.7), "red", "A"
+        else:
+            x, y, col, lab = rng.normal(8, 0.7), rng.normal(8, 0.7), "green", "B"
+        rows.append([f"e{i}", f"{min(max(x,0),10):.3f}", f"{min(max(y,0),10):.3f}",
+                     col, lab])
+    return rows
+
+
+def test_distance_euclidean_oracle():
+    t = encode_rows(two_cluster_rows(40), SCHEMA)
+    comp = DistanceComputer(SCHEMA, metric="euclidean", scale=1000)
+    d = comp.pairwise(t, t)
+    assert d.shape == (40, 40)
+    assert np.all(np.diag(d) == 0)
+    # oracle for a pair
+    for (i, j) in [(0, 1), (3, 10), (5, 5)]:
+        xi = [t.columns[1][i] / 10, t.columns[2][i] / 10]
+        xj = [t.columns[1][j] / 10, t.columns[2][j] / 10]
+        num = sum((a - b) ** 2 for a, b in zip(xi, xj))
+        cat = 0 if t.columns[3][i] == t.columns[3][j] else 1
+        expect = int(np.floor(np.sqrt((num + cat) / 3) * 1000))
+        assert abs(int(d[i, j]) - expect) <= 1  # float32 rounding at the floor edge
+
+
+def test_distance_manhattan():
+    t = encode_rows(two_cluster_rows(20), SCHEMA)
+    comp = DistanceComputer(SCHEMA, metric="manhattan", scale=1000)
+    d = comp.pairwise(t, t)
+    i, j = 0, 1
+    num = abs(t.columns[1][i] - t.columns[1][j]) / 10 + \
+        abs(t.columns[2][i] - t.columns[2][j]) / 10
+    cat = 0 if t.columns[3][i] == t.columns[3][j] else 1
+    expect = int(np.floor((num + cat) / 3 * 1000))
+    assert abs(int(d[i, j]) - expect) <= 1
+
+
+def test_kernel_scores_reference_semantics():
+    import jax.numpy as jnp
+    d = jnp.asarray([[0, 3, 50, 100]])
+    assert np.asarray(K.kernel_scores(d, "none", -1)).tolist() == [[1, 1, 1, 1]]
+    # linearMultiplicative: d==0 -> 200; else 100//d (integer division)
+    assert np.asarray(K.kernel_scores(d, "linearMultiplicative", -1)
+                      ).tolist() == [[200, 33, 2, 1]]
+    assert np.asarray(K.kernel_scores(d, "linearAdditive", -1)
+                      ).tolist() == [[100, 97, 50, 0]]
+    g = np.asarray(K.kernel_scores(d, "gaussian", 50))
+    assert g[0, 0] == 100 and g[0, 2] == int(100 * np.exp(-0.5))
+    with pytest.raises(NotImplementedError):
+        K.kernel_scores(d, "sigmoid", -1)
+
+
+def test_classify_shared_train(mesh_ctx):
+    train = encode_rows(two_cluster_rows(200, seed=1), SCHEMA)
+    test = encode_rows(two_cluster_rows(60, seed=2), SCHEMA)
+    comp = DistanceComputer(SCHEMA)
+    d = comp.pairwise(test, train)
+    params = K.KnnParams(top_match_count=5)
+    res = K.classify(d, train.class_codes(), ["A", "B"], params)
+    actual = ["A" if c == 0 else "B" for c in test.class_codes()]
+    acc = np.mean([p == a for p, a in zip(res.pred_class, actual)])
+    assert acc > 0.95
+
+
+def test_classify_grouped_padding():
+    # two test rows with different numbers of candidates
+    dmat = np.array([[1, 2, K.PAD_DISTANCE, K.PAD_DISTANCE],
+                     [5, 1, 2, 3]], dtype=np.int64)
+    cmat = np.array([[0, 0, 0, 0], [1, 1, 1, 0]], dtype=np.int32)
+    res = K.classify_grouped(dmat, cmat, ["A", "B"],
+                             K.KnnParams(top_match_count=3))
+    assert res.pred_class == ["A", "B"]
+    # row 0 has only 2 real neighbors; padded one must not count
+    assert res.class_distr[0].sum() == 2
+
+
+def test_decision_threshold_and_cost():
+    dmat = np.array([[1, 1, 1, 1, 1]], dtype=np.int64)
+    cmat = np.array([[0, 0, 1, 1, 1]], dtype=np.int32)  # 2 A vs 3 B
+    p = K.KnnParams(top_match_count=5, pos_class="A", neg_class="B",
+                    decision_threshold=0.5)
+    res = K.classify_grouped(dmat, cmat, ["A", "B"], p)
+    # ratio pos/neg = 2/3 > 0.5 -> positive
+    assert res.pred_class == ["A"]
+    p2 = K.KnnParams(top_match_count=5, pos_class="A", neg_class="B",
+                     use_cost_based_classifier=True,
+                     false_pos_cost=1, false_neg_cost=9)
+    res2 = K.classify_grouped(dmat, cmat, ["A", "B"], p2)
+    # posProb = 2*100//5 = 40 > threshold 100*1//10=10 -> A
+    assert res2.pred_class == ["A"]
+
+
+def test_regression_modes():
+    dmat = np.array([[1, 2, 3, 4, K.PAD_DISTANCE]], dtype=np.int64)
+    vals = ["10", "20", "30", "40", "50"]
+    cmat = np.array([[0, 1, 2, 3, 4]], dtype=np.int32)
+    p = K.KnnParams(top_match_count=4, prediction_mode="regression",
+                    regression_method="average")
+    res = K.classify_grouped(dmat, cmat, vals, p)
+    assert int(res.pred_value[0]) == 25
+    p.regression_method = "median"
+    res = K.classify_grouped(dmat, cmat, vals, p)
+    assert int(res.pred_value[0]) == 25  # (20+30)//2
+
+
+def test_regression_padding_excluded():
+    # row has only 2 real neighbors but top_match_count=4: average over the
+    # REAL neighbors only (the reference divides by neighbors.size())
+    dmat = np.array([[1, 2, K.PAD_DISTANCE, K.PAD_DISTANCE]], dtype=np.int64)
+    cmat = np.array([[0, 1, 0, 0]], dtype=np.int32)
+    p = K.KnnParams(top_match_count=4, prediction_mode="regression",
+                    regression_method="average")
+    res = K.classify_grouped(dmat, cmat, ["10", "20"], p)
+    assert int(res.pred_value[0]) == 15
+    p.regression_method = "median"
+    res = K.classify_grouped(dmat, cmat, ["10", "20"], p)
+    assert int(res.pred_value[0]) == 15
+
+
+def test_linear_regression_grouped():
+    # neighbors on the line y = 2x + 1; predict at x0=10 -> 21
+    dmat = np.array([[1, 2, 3, K.PAD_DISTANCE]], dtype=np.int64)
+    vals = np.array([[3.0, 5.0, 7.0, 999.0]])
+    nin = np.array([[1.0, 2.0, 3.0, 0.0]])
+    p = K.KnnParams(top_match_count=4, prediction_mode="regression",
+                    regression_method="linearRegression")
+    out = K.regress_grouped(dmat, vals, p, regr_input=np.array([10.0]),
+                            neighbor_input=nin)
+    assert int(out[0]) == 21
+
+
+def test_intra_set_no_self_pairs(tmp_path):
+    rows = two_cluster_rows(30, seed=9)
+    f = tmp_path / "all.csv"
+    f.write_text("\n".join(",".join(r) for r in rows))
+    schema_path = tmp_path / "s.json"
+    schema_path.write_text(json.dumps({"fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "x", "ordinal": 1, "dataType": "double", "feature": True,
+         "min": 0, "max": 10},
+        {"name": "y", "ordinal": 2, "dataType": "double", "feature": True,
+         "min": 0, "max": 10},
+        {"name": "color", "ordinal": 3, "dataType": "categorical",
+         "feature": True, "cardinality": ["red", "green"]},
+        {"name": "label", "ordinal": 4, "dataType": "categorical",
+         "cardinality": ["A", "B"]}]}))
+    props = tmp_path / "p.properties"
+    props.write_text(f"sts.same.schema.file.path={schema_path}\n")
+    rc = cli_run.main(["sameTypeSimilarity", f"-Dconf.path={props}",
+                       str(f), str(tmp_path / "d")])
+    assert rc == 0
+    lines = (tmp_path / "d" / "part-r-00000").read_text().splitlines()
+    assert len(lines) == 30 * 29 // 2  # each unordered pair once, no self
+    for l in lines:
+        a, b = l.split(",")[:2]
+        assert a != b
+
+
+def test_knn_pipeline_via_cli(tmp_path):
+    """sifarish-equivalent distance job -> nearestNeighbor job, as knn.sh."""
+    train_rows = two_cluster_rows(150, seed=3)
+    test_rows = two_cluster_rows(50, seed=4)
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    (data_dir / "tr_train.csv").write_text(
+        "\n".join(",".join(r) for r in train_rows))
+    (data_dir / "test.csv").write_text(
+        "\n".join(",".join(r) for r in test_rows))
+    schema_path = tmp_path / "s.json"
+    import avenir_tpu.core.schema as S
+    schema_path.write_text(json.dumps({"fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "x", "ordinal": 1, "dataType": "double", "feature": True,
+         "min": 0, "max": 10},
+        {"name": "y", "ordinal": 2, "dataType": "double", "feature": True,
+         "min": 0, "max": 10},
+        {"name": "color", "ordinal": 3, "dataType": "categorical",
+         "feature": True, "cardinality": ["red", "green"]},
+        {"name": "label", "ordinal": 4, "dataType": "categorical",
+         "cardinality": ["A", "B"]}]}))
+    props = tmp_path / "knn.properties"
+    props.write_text(
+        "field.delim.regex=,\nfield.delim.out=,\n"
+        f"sts.same.schema.file.path={schema_path}\n"
+        "sts.distance.scale=1000\n"
+        "sts.base.set.split.prefix=tr\n"
+        "nen.top.match.count=7\n"
+        "nen.kernel.function=none\n"
+        "nen.validation.mode=true\n")
+    rc = cli_run.main(["org.sifarish.feature.SameTypeSimilarity",
+                       f"-Dconf.path={props}", str(data_dir),
+                       str(tmp_path / "dist")])
+    assert rc == 0
+    rc = cli_run.main(["knnClassifier", f"-Dconf.path={props}",
+                       str(tmp_path / "dist"), str(tmp_path / "out")])
+    assert rc == 0
+    lines = (tmp_path / "out" / "part-r-00000").read_text().splitlines()
+    assert len(lines) == 50
+    acc = np.mean([l.split(",")[2] == l.split(",")[1] for l in lines])
+    assert acc > 0.9
