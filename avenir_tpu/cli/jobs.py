@@ -46,6 +46,16 @@ def _schema_path(cfg: Config, key: str) -> FeatureSchema:
     return FeatureSchema.load(cfg.must_get(key))
 
 
+def _splitter(delim_regex: str):
+    """Line splitter honoring field.delim.regex semantics: literal fast path,
+    re.split otherwise (mirrors core.table._tokenize)."""
+    import re as _re
+    if _re.escape(delim_regex) == delim_regex:
+        return lambda line: line.split(delim_regex)
+    pat = _re.compile(delim_regex)
+    return lambda line: pat.split(line)
+
+
 # --------------------------------------------------------------------------
 # org.avenir.tree
 # --------------------------------------------------------------------------
@@ -299,9 +309,10 @@ def nearest_neighbor(cfg: Config, in_path: str, out_path: str) -> Counters:
                  params.regression_method == "linearRegression")
 
     # group neighbor candidates per test entity (TopMatchesMapper layouts)
+    split_line = _splitter(delim)
     groups: Dict[str, Dict] = {}
     for line in lines_in:
-        it = line.split(delim)
+        it = split_line(line)
         train_regr = test_regr = 0.0
         if params.class_cond_weighted:
             test_id, actual, train_id = it[0], it[1], it[2]
@@ -325,6 +336,10 @@ def nearest_neighbor(cfg: Config, in_path: str, out_path: str) -> Counters:
         g["c"].append(tclass)
         g["fpp"].append(fpp)
         g["trv"].append(train_regr)
+
+    if not groups:
+        artifacts.write_text_output(out_path, [])
+        return counters
 
     class_values = sorted({c for g in groups.values() for c in g["c"]})
     cls_code = {c: i for i, c in enumerate(class_values)}

@@ -21,6 +21,7 @@ from typing import List, Optional
 
 from ..core.config import Config, load_config
 from . import jobs
+from . import explore_jobs  # noqa: F401  (registers explore-pack jobs)
 
 
 def parse_args(argv: List[str]):

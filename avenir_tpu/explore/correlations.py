@@ -1,0 +1,166 @@
+"""Correlation / association measures between attributes.
+
+Parity targets (SURVEY.md §2.4):
+  * ContingencyMatrix measures — cramerIndex, concentrationCoeff
+    (Goodman-Kruskal tau), uncertaintyCoeff — with the reference's exact
+    formulas including its quirks (util/ContingencyMatrix.java:86-186:
+    cramer has no sqrt; uncertainty uses log10 and multiplies by the column
+    marginal where the textbook divides — parity over propriety).
+  * CramerCorrelation job (explore/CramerCorrelation.java): categorical
+    attr pairs -> contingency matrix -> cramer index.
+  * NumericalCorrelation (explore/NumericalCorrelation.java:87-179):
+    Pearson via (n, Σx, Σy, Σxy, Σx², Σy²) tuple algebra; the combiner is
+    the per-shard partial sum XLA already does.
+  * HeterogeneityReductionCorrelation: concentration ('gini') or
+    uncertainty ('entropy') coefficient per attr pair.
+  * CategoricalClassAffinity (explore/CategoricalClassAffinity.java):
+    per categorical value, affinity to each class value.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.schema import FeatureSchema
+from ..core.table import ColumnarTable
+from ..ops.histogram import joint_histogram
+from ..parallel.mesh import MeshContext
+
+
+class ContingencyMatrix:
+    """Exact port of the measures of util/ContingencyMatrix.java (the counts
+    themselves come from a device joint histogram)."""
+
+    def __init__(self, table: np.ndarray):
+        self.table = np.asarray(table, dtype=np.float64)
+
+    def _aggregates(self):
+        row = self.table.sum(axis=1)
+        col = self.table.sum(axis=0)
+        total = self.table.sum()
+        row = np.where(row == 0, 1, row)
+        col = np.where(col == 0, 1, col)
+        return row, col, total
+
+    def cramer_index(self) -> float:
+        """(sum n_ij^2/(r_i c_j) - 1) / (min(R,C)-1)  (:86-124; no sqrt)."""
+        row, col, _ = self._aggregates()
+        pearson = (self.table ** 2 / (row[:, None] * col[None, :])).sum() - 1.0
+        smaller = min(self.table.shape)
+        return float(pearson / (smaller - 1))
+
+    def concentration_coeff(self) -> float:
+        """Goodman-Kruskal tau (:141-163)."""
+        row, col, total = self._aggregates()
+        rp = row / total
+        cp = col / total
+        p = self.table / total
+        sum_one = ((p ** 2).sum(axis=1) / rp).sum()
+        sum_two = (cp ** 2).sum()
+        return float((sum_one - sum_two) / (1.0 - sum_two))
+
+    def uncertainty_coeff(self) -> float:
+        """Theil's U with the reference's formula verbatim (:165-186):
+        log10, and the joint term is p_ij*log10(p_ij * c_j / r_i)."""
+        row, col, total = self._aggregates()
+        rp = row / total
+        cp = col / total
+        p = self.table / total
+        with np.errstate(divide="ignore", invalid="ignore"):
+            inner = p * np.log10(np.where(p > 0, p * cp[None, :] / rp[:, None], 1.0))
+        sum_one = np.where(p > 0, inner, 0.0).sum()
+        sum_two = (cp * np.log10(np.where(cp > 0, cp, 1.0))).sum()
+        return float(sum_one / sum_two)
+
+
+def categorical_pair_matrix(table: ColumnarTable, ord_a: int, ord_b: int,
+                            ctx: Optional[MeshContext] = None) -> ContingencyMatrix:
+    """Joint histogram of two categorical columns on device."""
+    fa = table.schema.find_field_by_ordinal(ord_a)
+    fb = table.schema.find_field_by_ordinal(ord_b)
+    counts = joint_histogram(jnp.asarray(table.columns[ord_a]),
+                             jnp.asarray(table.columns[ord_b]),
+                             len(fa.cardinality or []), len(fb.cardinality or []))
+    return ContingencyMatrix(np.asarray(counts))
+
+
+def cramer_correlations(table: ColumnarTable, ordinals: Sequence[int],
+                        ctx: Optional[MeshContext] = None
+                        ) -> List[Tuple[int, int, float]]:
+    out = []
+    for i, a in enumerate(ordinals):
+        for b in ordinals[i + 1:]:
+            out.append((a, b, categorical_pair_matrix(table, a, b, ctx)
+                        .cramer_index()))
+    return out
+
+
+def heterogeneity_correlations(table: ColumnarTable, ordinals: Sequence[int],
+                               algorithm: str = "gini",
+                               ctx: Optional[MeshContext] = None
+                               ) -> List[Tuple[int, int, float]]:
+    """'gini' -> concentration coeff, 'entropy' -> uncertainty coeff
+    (HeterogeneityReductionCorrelation.java:76-86)."""
+    out = []
+    for i, a in enumerate(ordinals):
+        for b in ordinals[i + 1:]:
+            m = categorical_pair_matrix(table, a, b, ctx)
+            v = m.concentration_coeff() if algorithm == "gini" \
+                else m.uncertainty_coeff()
+            out.append((a, b, v))
+    return out
+
+
+def numerical_correlations(table: ColumnarTable, ordinals: Sequence[int],
+                           ctx: Optional[MeshContext] = None
+                           ) -> List[Tuple[int, int, float]]:
+    """Pearson r per pair via a single device moment pass
+    (NumericalCorrelation.java:87-179's (n,Σx,Σy,Σxy,Σx²,Σy²) algebra)."""
+    ctx = ctx or MeshContext()
+    padded = table.pad_to_multiple(ctx.n_devices)
+    X = np.stack([padded.columns[o] for o in ordinals], axis=1).astype(np.float64)
+    mask = padded.valid_mask.astype(np.float64)
+
+    @jax.jit
+    def kernel(X, m):
+        Xm = X * m[:, None]
+        n = m.sum()
+        s1 = Xm.sum(axis=0)                      # Σx per attr
+        s2 = (Xm * X).sum(axis=0)                # Σx²
+        cross = jnp.einsum("ni,nj->ij", Xm, X)   # Σ x_i x_j
+        return n, s1, s2, cross
+
+    n, s1, s2, cross = (np.asarray(x) for x in kernel(
+        ctx.shard_rows(X.astype(np.float32)), ctx.shard_rows(mask.astype(np.float32))))
+    out = []
+    for i in range(len(ordinals)):
+        for j in range(i + 1, len(ordinals)):
+            num = n * cross[i, j] - s1[i] * s1[j]
+            den = np.sqrt(n * s2[i] - s1[i] ** 2) * np.sqrt(n * s2[j] - s1[j] ** 2)
+            out.append((ordinals[i], ordinals[j],
+                        float(num / den) if den > 0 else 0.0))
+    return out
+
+
+def class_affinity(table: ColumnarTable, ordinals: Sequence[int],
+                   ctx: Optional[MeshContext] = None
+                   ) -> Dict[int, np.ndarray]:
+    """Per categorical attr: P(class | value) matrix (value, class) —
+    the value->class affinity scores of CategoricalClassAffinity.java."""
+    schema = table.schema
+    cls_field = schema.class_attr_field
+    C = len(cls_field.cardinality or [])
+    out = {}
+    for o in ordinals:
+        f = schema.find_field_by_ordinal(o)
+        counts = np.asarray(joint_histogram(
+            jnp.asarray(table.columns[o]), jnp.asarray(table.class_codes()),
+            len(f.cardinality or []), C))
+        row = counts.sum(axis=1, keepdims=True)
+        out[o] = counts / np.maximum(row, 1.0)
+    return out

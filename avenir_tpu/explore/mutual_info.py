@@ -1,0 +1,237 @@
+"""Mutual information distributions + feature-selection scores.
+
+Capability parity with explore/MutualInformation.java (SURVEY.md §2.4): one
+pass computes class / feature / feature-pair / feature-class / pair-class
+distributions, then entropies, mutual informations (natural log, matching
+Math.log at MutualInformation.java:730,765,813) and the selection scores of
+explore/MutualInformationScore.java:
+
+  * MIM   — rank by I(X;C)                                   (:98)
+  * MIFS  — greedy I(X;C) - beta * sum I(X;X_sel)            (:116)
+  * JMI   — greedy sum I(X,X_sel;C)                          (:177)
+  * DISR  — greedy sum I(X,X_sel;C)/H(X,X_sel,C)             (:185)
+  * mRMR  — greedy I(X;C) - mean I(X;X_sel)                  (:265)
+
+TPU design: ALL pairwise joint histograms in one einsum over the per-feature
+one-hot tensor — counts[i,j,b,d] = sum_n oh[n,i,b] oh[n,j,d] (and the
+class-augmented variant) — instead of the reference's per-pair shuffle keys.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.schema import FeatureSchema
+from ..core.table import ColumnarTable
+from ..parallel.mesh import MeshContext
+
+
+def _entropy(p: np.ndarray) -> float:
+    """Natural-log entropy over the flattened distribution."""
+    p = p[p > 0]
+    return float(-(p * np.log(p)).sum())
+
+
+def _mi(joint: np.ndarray, pa: np.ndarray, pb: np.ndarray) -> float:
+    """I(A;B) = sum p(a,b) ln(p(a,b)/(p(a)p(b)))."""
+    with np.errstate(divide="ignore", invalid="ignore"):
+        ratio = joint / (pa[:, None] * pb[None, :])
+        term = np.where(joint > 0, joint * np.log(np.where(joint > 0, ratio, 1.0)),
+                        0.0)
+    return float(term.sum())
+
+
+@dataclass
+class MutualInfoStats:
+    feature_ordinals: List[int]
+    class_values: List[str]
+    # distributions (normalized)
+    class_p: np.ndarray                      # (C,)
+    feature_p: np.ndarray                    # (F, B)  (padded bins are 0)
+    feature_class_p: np.ndarray              # (F, B, C)
+    pair_p: np.ndarray                       # (F, F, B, B)
+    pair_class_p: np.ndarray                 # (F, F, B, B, C)
+    num_bins: List[int]
+
+    # ---- entropies / MI ----
+    def class_entropy(self) -> float:
+        return _entropy(self.class_p)
+
+    def feature_entropy(self, fi: int) -> float:
+        return _entropy(self.feature_p[fi])
+
+    def feature_class_mi(self, fi: int) -> float:
+        return _mi(self.feature_class_p[fi], self.feature_p[fi], self.class_p)
+
+    def pair_mi(self, fi: int, fj: int) -> float:
+        return _mi(self.pair_p[fi, fj], self.feature_p[fi], self.feature_p[fj])
+
+    def pair_class_mi(self, fi: int, fj: int) -> float:
+        """I(Xi,Xj;C): joint (B*B, C) vs marginal pair dist and class dist."""
+        joint = self.pair_class_p[fi, fj].reshape(-1, len(self.class_p))
+        pair = self.pair_p[fi, fj].reshape(-1)
+        return _mi(joint, pair, self.class_p)
+
+    def pair_class_entropy(self, fi: int, fj: int) -> float:
+        """H(Xi,Xj,C) (MutualInformation.java:815)."""
+        return _entropy(self.pair_class_p[fi, fj])
+
+
+def compute_stats(table: ColumnarTable, ctx: Optional[MeshContext] = None,
+                  chunk: int = 1 << 18) -> MutualInfoStats:
+    """All distributions in one (chunked) jitted pass over row-sharded data."""
+    ctx = ctx or MeshContext()
+    schema = table.schema
+    fields = [f for f in schema.feature_fields if f.is_binned]
+    F = len(fields)
+    nbins = [f.num_bins for f in fields]
+    B = max(nbins) if nbins else 1
+    class_field = schema.class_attr_field
+    class_values = list(class_field.cardinality or [])
+    C = len(class_values)
+
+    padded = table.pad_to_multiple(ctx.n_devices)
+    bin_codes = np.stack([padded.binned_codes(f.ordinal) for f in fields], axis=1) \
+        if fields else np.zeros((padded.n_rows, 0), np.int32)
+    cls = padded.columns[class_field.ordinal].astype(np.int32)
+    mask = padded.valid_mask
+
+    d_bins = ctx.shard_rows(bin_codes)
+    d_cls = ctx.shard_rows(cls)
+    d_mask = ctx.shard_rows(mask)
+
+    @jax.jit
+    def kernel(bc, cc, m):
+        mf = m.astype(jnp.float32)
+        oh = jax.nn.one_hot(bc, B, dtype=jnp.float32) * mf[:, None, None]  # (n,F,B)
+        ohc = jax.nn.one_hot(cc, C, dtype=jnp.float32) * mf[:, None]       # (n,C)
+        feat = oh.sum(axis=0)                                   # (F, B)
+        cls_counts = ohc.sum(axis=0)                            # (C,)
+        feat_cls = jnp.einsum("nfb,nc->fbc", oh, ohc)           # (F, B, C)
+        pair = jnp.einsum("nib,njd->ijbd", oh, oh)              # (F, F, B, B)
+        pair_cls = jnp.einsum("nib,njd,nc->ijbdc", oh, oh, ohc)
+        return feat, cls_counts, feat_cls, pair, pair_cls
+
+    n = padded.n_rows
+    feat = np.zeros((F, B)); cls_counts = np.zeros((C,))
+    feat_cls = np.zeros((F, B, C)); pair = np.zeros((F, F, B, B))
+    pair_cls = np.zeros((F, F, B, B, C))
+    for s in range(0, n, chunk):
+        e = min(s + chunk, n)
+        out = kernel(d_bins[s:e], d_cls[s:e], d_mask[s:e])
+        feat += np.asarray(out[0]); cls_counts += np.asarray(out[1])
+        feat_cls += np.asarray(out[2]); pair += np.asarray(out[3])
+        pair_cls += np.asarray(out[4])
+
+    total = max(cls_counts.sum(), 1e-12)
+    return MutualInfoStats(
+        feature_ordinals=[f.ordinal for f in fields],
+        class_values=class_values,
+        class_p=cls_counts / total, feature_p=feat / total,
+        feature_class_p=feat_cls / total, pair_p=pair / total,
+        pair_class_p=pair_cls / total, num_bins=nbins)
+
+
+# --------------------------------------------------------------------------
+# selection scores (host-side greedy loops over the small MI tables)
+# --------------------------------------------------------------------------
+
+def mim_score(stats: MutualInfoStats) -> List[Tuple[int, float]]:
+    """Mutual information maximization: rank I(X;C) descending."""
+    F = len(stats.feature_ordinals)
+    scores = [(stats.feature_ordinals[i], stats.feature_class_mi(i))
+              for i in range(F)]
+    return sorted(scores, key=lambda t: -t[1])
+
+
+def _greedy(stats: MutualInfoStats, score_fn) -> List[Tuple[int, float]]:
+    F = len(stats.feature_ordinals)
+    selected: List[int] = []
+    out: List[Tuple[int, float]] = []
+    while len(selected) < F:
+        best, best_score = None, -np.inf
+        for i in range(F):
+            if i in selected:
+                continue
+            s = score_fn(i, selected)
+            if s > best_score:
+                best, best_score = i, s
+        selected.append(best)
+        out.append((stats.feature_ordinals[best], best_score))
+    return out
+
+
+def mifs_score(stats: MutualInfoStats, redundancy_factor: float = 1.0
+               ) -> List[Tuple[int, float]]:
+    """MIFS: greedy I(X;C) - beta * sum_sel I(X;X_s)."""
+    rel = [stats.feature_class_mi(i) for i in range(len(stats.feature_ordinals))]
+
+    def score(i, selected):
+        red = sum(stats.pair_mi(i, j) for j in selected)
+        return rel[i] - redundancy_factor * red
+
+    return _greedy(stats, score)
+
+
+def jmi_score(stats: MutualInfoStats) -> List[Tuple[int, float]]:
+    """JMI: first pick = max relevance, then greedy sum I(X,X_sel;C)."""
+    return _jmi_helper(stats, disr=False)
+
+
+def disr_score(stats: MutualInfoStats) -> List[Tuple[int, float]]:
+    """DISR: like JMI but each term normalized by H(X,X_sel,C)."""
+    return _jmi_helper(stats, disr=True)
+
+
+def _jmi_helper(stats: MutualInfoStats, disr: bool) -> List[Tuple[int, float]]:
+    F = len(stats.feature_ordinals)
+    ranked = mim_score(stats)
+    first_ord, first_score = ranked[0]
+    first = stats.feature_ordinals.index(first_ord)
+    selected = [first]
+    out = [(first_ord, first_score)]
+    while len(selected) < F:
+        best, best_score = None, -np.inf
+        for i in range(F):
+            if i in selected:
+                continue
+            s = 0.0
+            for j in selected:
+                v = stats.pair_class_mi(i, j)
+                if disr:
+                    h = stats.pair_class_entropy(i, j)
+                    v = v / h if h > 0 else 0.0
+                s += v
+            if s > best_score:
+                best, best_score = i, s
+        selected.append(best)
+        out.append((stats.feature_ordinals[best], best_score))
+    return out
+
+
+def mrmr_score(stats: MutualInfoStats) -> List[Tuple[int, float]]:
+    """mRMR: greedy I(X;C) - mean_sel I(X;X_s)."""
+    rel = [stats.feature_class_mi(i) for i in range(len(stats.feature_ordinals))]
+
+    def score(i, selected):
+        if not selected:
+            return rel[i]
+        red = sum(stats.pair_mi(i, j) for j in selected) / len(selected)
+        return rel[i] - red
+
+    return _greedy(stats, score)
+
+
+SCORE_ALGORITHMS = {
+    "mutual.info.maximization": lambda s, rf: mim_score(s),
+    "mutual.info.feature.selection": lambda s, rf: mifs_score(s, rf),
+    "joint.mutual.info": lambda s, rf: jmi_score(s),
+    "double.input.symmetrical.relevance": lambda s, rf: disr_score(s),
+    "min.redundancy.max.relevance": lambda s, rf: mrmr_score(s),
+}

@@ -1,0 +1,152 @@
+"""Class-imbalance samplers + Relief feature relevance.
+
+Parity targets (SURVEY.md §2.4):
+  * TopMatchesByClass (explore/TopMatchesByClass.java) — per-class top-k
+    nearest records; here one masked top-k over the device distance matrix.
+  * ClassBasedOverSampler (explore/ClassBasedOverSampler.java) — SMOTE:
+    synthetic minority records interpolated toward a random one of the k
+    nearest same-class neighbors.
+  * UnderSamplingBalancer (explore/UnderSamplingBalancer.java) — subsample
+    the majority class at a rate (or to balance).
+  * BaggingSampler (explore/BaggingSampler.java) — bootstrap batches.
+  * ReliefFeatureRelevance (explore/ReliefFeatureRelevance.java:199-247):
+    score[attr] += diff(nearest miss) - diff(nearest hit), normalized
+    range-scaled numeric / 0-1 categorical diffs, divided by sample count.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.schema import FeatureSchema
+from ..core.table import ColumnarTable
+from ..ops.distance import DistanceComputer
+from ..parallel.mesh import MeshContext
+
+
+def top_matches_by_class(table: ColumnarTable, k: int,
+                         metric: str = "euclidean",
+                         ctx: Optional[MeshContext] = None) -> np.ndarray:
+    """(n, k) indices of each record's k nearest SAME-class neighbors
+    (self excluded).  Missing neighbors (tiny classes) are -1."""
+    comp = DistanceComputer(table.schema, metric=metric)
+    d = comp.pairwise(table, table).astype(np.int64)
+    cls = table.class_codes()
+    same = cls[:, None] == cls[None, :]
+    big = np.int64(1) << 40
+    d = np.where(same, d, big)
+    np.fill_diagonal(d, big)
+    idx = np.argsort(d, axis=1, kind="stable")[:, :k]
+    dist = np.take_along_axis(d, idx, axis=1)
+    return np.where(dist < big, idx, -1)
+
+
+def smote_oversample(table: ColumnarTable, minority_class: str,
+                     k: int = 5, multiplier: int = 1,
+                     seed: int = 0) -> List[List[str]]:
+    """Synthetic minority rows (as string records): numeric attrs
+    interpolated x + u*(neighbor - x), categorical attrs picked from either
+    parent — the ClassBasedOverSampler construction."""
+    rng = np.random.default_rng(seed)
+    schema = table.schema
+    cls_field = schema.class_attr_field
+    code = cls_field.cat_code(minority_class)
+    neighbors = top_matches_by_class(table, k)
+    minority = np.nonzero(table.class_codes() == code)[0]
+    id_ord = schema.id_fields[0].ordinal if schema.id_fields else None
+    out: List[List[str]] = []
+    for rep in range(multiplier):
+        for i in minority:
+            cand = neighbors[i][neighbors[i] >= 0]
+            if len(cand) == 0:
+                continue
+            j = int(rng.choice(cand))
+            u = rng.random()
+            row: List[str] = []
+            for f in schema.fields:
+                o = f.ordinal
+                if f.id_field:
+                    row.append(f"syn_{rep}_{i}")
+                elif f.is_numeric:
+                    a, b = table.columns[o][i], table.columns[o][j]
+                    v = a + u * (b - a)
+                    row.append(str(int(round(v))) if f.is_integer else f"{v:.6f}")
+                elif f.is_categorical:
+                    if o == cls_field.ordinal:
+                        row.append(minority_class)
+                    else:
+                        src = i if rng.random() < 0.5 else j
+                        codev = table.columns[o][src]
+                        row.append(f.cardinality[codev] if codev >= 0 else "?")
+                else:
+                    row.append(table.str_columns.get(o, [""] * table.n_rows)[i])
+            out.append(row)
+    return out
+
+
+def under_sample(table: ColumnarTable, majority_class: str,
+                 rate: float, seed: int = 0) -> np.ndarray:
+    """Boolean keep-mask: majority-class rows kept with probability rate,
+    everything else kept (UnderSamplingBalancer)."""
+    rng = np.random.default_rng(seed)
+    code = table.schema.class_attr_field.cat_code(majority_class)
+    cls = table.class_codes()
+    keep = np.ones((table.n_rows,), dtype=bool)
+    maj = cls == code
+    keep[maj] = rng.random(int(maj.sum())) < rate
+    return keep
+
+
+def bagging_sample(n: int, rate: float, with_replacement: bool = True,
+                   seed: int = 0) -> np.ndarray:
+    """Indices of one bagging batch (BaggingSampler)."""
+    rng = np.random.default_rng(seed)
+    m = int(n * rate)
+    if with_replacement:
+        return rng.integers(0, n, m)
+    return rng.permutation(n)[:m]
+
+
+def relief_relevance(table: ColumnarTable, attr_ordinals: Sequence[int],
+                     sample_count: Optional[int] = None,
+                     metric: str = "euclidean", seed: int = 0,
+                     ctx: Optional[MeshContext] = None) -> Dict[int, float]:
+    """Relief scores per attr: mean over samples of
+    diff(x, nearest miss) - diff(x, nearest hit)
+    (ReliefFeatureRelevance.java:199-247 with 1 hit + 1 miss per sample)."""
+    rng = np.random.default_rng(seed)
+    schema = table.schema
+    comp = DistanceComputer(schema, metric=metric)
+    d = comp.pairwise(table, table).astype(np.int64)
+    cls = table.class_codes()
+    n = table.n_rows
+    big = np.int64(1) << 40
+    np.fill_diagonal(d, big)
+    same = cls[:, None] == cls[None, :]
+    d_hit = np.where(same, d, big)
+    d_miss = np.where(~same, d, big)
+    hit_idx = np.argmin(d_hit, axis=1)
+    miss_idx = np.argmin(d_miss, axis=1)
+
+    samples = np.arange(n) if sample_count is None or sample_count >= n else \
+        rng.permutation(n)[:sample_count]
+    scores = {o: 0.0 for o in attr_ordinals}
+    for o in attr_ordinals:
+        f = schema.find_field_by_ordinal(o)
+        col = table.columns[o]
+        if f.is_numeric:
+            rng_width = max(float(f.max) - float(f.min), 1e-12) \
+                if f.max is not None and f.min is not None else \
+                max(float(col.max() - col.min()), 1e-12)
+            dh = np.abs(col[samples] - col[hit_idx[samples]]) / rng_width
+            dm = np.abs(col[samples] - col[miss_idx[samples]]) / rng_width
+        else:
+            dh = (col[samples] != col[hit_idx[samples]]).astype(np.float64)
+            dm = (col[samples] != col[miss_idx[samples]]).astype(np.float64)
+        scores[o] = float((dm - dh).sum() / len(samples))
+    return scores

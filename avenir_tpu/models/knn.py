@@ -110,17 +110,25 @@ def classify(distances: np.ndarray,            # (n_test, n_train) int
     return _classify_topk(nd, ncls, nfpp, class_values, params)
 
 
+def _topk_rows(dmat: np.ndarray, k: int, *mats: Optional[np.ndarray]):
+    """Stable nearest-k selection within each row; returns (nd, gathered mats)
+    where a None mat stays None."""
+    k = min(k, dmat.shape[1])
+    idx = np.argsort(dmat, axis=1, kind="stable")[:, :k]
+    nd = np.take_along_axis(dmat, idx, axis=1)
+    out = [np.take_along_axis(m, idx, axis=1) if m is not None else None
+           for m in mats]
+    return (nd, *out)
+
+
 def classify_grouped(dmat: np.ndarray, cmat: np.ndarray,
                      class_values: Sequence[str], params: KnnParams,
                      fmat: Optional[np.ndarray] = None) -> KnnResult:
     """Per-row neighbor lists (the NearestNeighbor job's input layout, where
     each test entity carries its own candidate set): top-k within each row."""
-    k = min(params.top_match_count, dmat.shape[1])
-    idx = np.argsort(dmat, axis=1, kind="stable")[:, :k]
-    nd = np.take_along_axis(dmat, idx, axis=1)
-    ncls = np.take_along_axis(cmat, idx, axis=1)
-    nfpp = np.take_along_axis(fmat, idx, axis=1) if fmat is not None else \
-        np.full_like(nd, -1.0, dtype=np.float32)
+    nd, ncls, nfpp = _topk_rows(dmat, params.top_match_count, cmat, fmat)
+    if nfpp is None:
+        nfpp = np.full_like(nd, -1.0, dtype=np.float32)
     return _classify_topk(nd, ncls, nfpp, class_values, params)
 
 
@@ -241,12 +249,8 @@ def regress_grouped(dmat: np.ndarray, vals: np.ndarray, params: KnnParams,
                     neighbor_input: Optional[np.ndarray] = None) -> np.ndarray:
     """KNN regression over per-row neighbor lists: top-k then _regress.
     ``vals`` (n, m) neighbor target values; PAD_DISTANCE rows are masked."""
-    k = min(params.top_match_count, dmat.shape[1])
-    idx = np.argsort(dmat, axis=1, kind="stable")[:, :k]
-    nd = np.take_along_axis(dmat, idx, axis=1)
-    nv = np.take_along_axis(vals.astype(np.float64), idx, axis=1)
-    ni = np.take_along_axis(neighbor_input, idx, axis=1) \
-        if neighbor_input is not None else None
+    nd, nv, ni = _topk_rows(dmat, params.top_match_count,
+                            vals.astype(np.float64), neighbor_input)
     return _regress(nv, nd, params, regr_input=regr_input, neighbor_input=ni,
                     valid=nd < PAD_DISTANCE)
 
@@ -255,10 +259,10 @@ def regress(distances: np.ndarray, train_values: np.ndarray, params: KnnParams,
             regr_input: Optional[np.ndarray] = None,
             train_regr_input: Optional[np.ndarray] = None) -> np.ndarray:
     """KNN regression over a shared train set: top-k then _regress."""
-    k = min(params.top_match_count, distances.shape[1])
-    idx = np.argsort(distances, axis=1)[:, :k]
-    nd = np.take_along_axis(distances, idx, axis=1)
-    vals = train_values[idx].astype(np.float64)
-    ni = train_regr_input[idx] if train_regr_input is not None else None
-    return _regress(vals, nd, params, regr_input=regr_input, neighbor_input=ni,
-                    valid=nd < PAD_DISTANCE)
+    n_train = distances.shape[1]
+    vals = np.broadcast_to(train_values.astype(np.float64),
+                           (distances.shape[0], n_train))
+    ni = np.broadcast_to(train_regr_input, distances.shape) \
+        if train_regr_input is not None else None
+    return regress_grouped(distances, vals, params, regr_input=regr_input,
+                           neighbor_input=ni)

@@ -1,0 +1,221 @@
+"""Explore pack tests: MI + selection scores, correlations, encoders,
+samplers, adaboost, relief — each vs small numpy/analytic oracles."""
+
+import math
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core.schema import FeatureSchema
+from avenir_tpu.core.table import encode_rows
+from avenir_tpu.explore import mutual_info as MI
+from avenir_tpu.explore import correlations as CO
+from avenir_tpu.explore import encoders as EN
+from avenir_tpu.explore import samplers as SA
+
+
+SCHEMA = FeatureSchema.from_dict({
+    "fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "a", "ordinal": 1, "dataType": "categorical", "feature": True,
+         "cardinality": ["x", "y"]},
+        {"name": "b", "ordinal": 2, "dataType": "categorical", "feature": True,
+         "cardinality": ["p", "q"]},
+        {"name": "noise", "ordinal": 3, "dataType": "categorical", "feature": True,
+         "cardinality": ["u", "v"]},
+        {"name": "cls", "ordinal": 4, "dataType": "categorical",
+         "cardinality": ["0", "1"]},
+    ]
+})
+
+
+@pytest.fixture(scope="module")
+def mi_table():
+    """a == class exactly; b correlates with a; noise independent."""
+    rng = np.random.default_rng(1)
+    rows = []
+    for i in range(1000):
+        c = int(rng.random() < 0.5)
+        a = "x" if c == 0 else "y"
+        b = ("p" if c == 0 else "q") if rng.random() < 0.8 else \
+            ("q" if c == 0 else "p")
+        noise = "u" if rng.random() < 0.5 else "v"
+        rows.append([f"r{i}", a, b, noise, str(c)])
+    return encode_rows(rows, SCHEMA)
+
+
+def test_mutual_info_ranks_features(mi_table, mesh_ctx):
+    stats = MI.compute_stats(mi_table, mesh_ctx)
+    mim = MI.mim_score(stats)
+    # a (ordinal 1) is a perfect predictor -> highest MI; noise last
+    assert mim[0][0] == 1
+    assert mim[-1][0] == 3
+    # I(a;C) should equal H(C) (perfect dependence), natural log
+    hc = stats.class_entropy()
+    assert abs(stats.feature_class_mi(0) - hc) < 1e-6
+    assert stats.feature_class_mi(2) < 0.01  # noise
+
+
+def test_mi_oracle_small(mesh_ctx):
+    rows = [["i", "x", "p", "u", "0"], ["j", "x", "q", "u", "0"],
+            ["k", "y", "p", "v", "1"], ["l", "y", "q", "v", "1"]]
+    t = encode_rows(rows, SCHEMA)
+    stats = MI.compute_stats(t, mesh_ctx)
+    # exact: I(a;C)=ln2, I(b;C)=0
+    assert abs(stats.feature_class_mi(0) - math.log(2)) < 1e-6
+    assert abs(stats.feature_class_mi(1)) < 1e-9
+    # pair MI I(a;b)=0 (independent in this set)
+    assert abs(stats.pair_mi(0, 1)) < 1e-9
+
+
+def test_selection_scores_run(mi_table, mesh_ctx):
+    stats = MI.compute_stats(mi_table, mesh_ctx)
+    for fn in (MI.mifs_score, MI.jmi_score, MI.disr_score, MI.mrmr_score):
+        if fn is MI.mifs_score:
+            ranked = fn(stats, 1.0)
+        else:
+            ranked = fn(stats)
+        assert len(ranked) == 3
+        assert ranked[0][0] == 1  # perfect predictor first everywhere
+
+
+def test_contingency_measures():
+    # perfectly dependent 2x2
+    m = CO.ContingencyMatrix(np.array([[50, 0], [0, 50]]))
+    assert abs(m.cramer_index() - 1.0) < 1e-9
+    assert abs(m.concentration_coeff() - 1.0) < 1e-9
+    # independent
+    m2 = CO.ContingencyMatrix(np.array([[25, 25], [25, 25]]))
+    assert abs(m2.cramer_index()) < 1e-9
+    assert abs(m2.concentration_coeff()) < 1e-9
+
+
+def test_cramer_and_heterogeneity_jobs(mi_table, mesh_ctx):
+    cr = CO.cramer_correlations(mi_table, [1, 2, 3], mesh_ctx)
+    d = {(a, b): v for a, b, v in cr}
+    assert d[(1, 2)] > 0.2      # correlated
+    assert d[(1, 3)] < 0.05     # independent
+    het = CO.heterogeneity_correlations(mi_table, [1, 2], "gini", mesh_ctx)
+    assert het[0][2] > 0.2
+
+
+def test_numerical_correlation(mesh_ctx):
+    schema = FeatureSchema.from_dict({"fields": [
+        {"name": "x", "ordinal": 0, "dataType": "double", "feature": True},
+        {"name": "y", "ordinal": 1, "dataType": "double", "feature": True},
+        {"name": "z", "ordinal": 2, "dataType": "double", "feature": True},
+        {"name": "c", "ordinal": 3, "dataType": "categorical",
+         "cardinality": ["a", "b"]}]})
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=500)
+    y = 2 * x + rng.normal(scale=0.1, size=500)
+    z = rng.normal(size=500)
+    rows = [[f"{x[i]:.5f}", f"{y[i]:.5f}", f"{z[i]:.5f}", "a"] for i in range(500)]
+    t = encode_rows(rows, schema)
+    corr = CO.numerical_correlations(t, [0, 1, 2], mesh_ctx)
+    d = {(a, b): v for a, b, v in corr}
+    assert d[(0, 1)] > 0.99
+    assert abs(d[(0, 2)]) < 0.15
+    # numpy oracle
+    assert abs(d[(0, 1)] - np.corrcoef(x, y)[0, 1]) < 1e-3
+
+
+def test_class_affinity(mi_table):
+    aff = CO.class_affinity(mi_table, [1])
+    # value 'x' (code 0) maps to class '0' (code 0) with prob 1
+    assert aff[1][0, 0] == 1.0 and aff[1][1, 1] == 1.0
+
+
+def test_supervised_ratio_encoding(mi_table):
+    enc = EN.categorical_continuous_encoding(
+        mi_table, [1], 4, pos_class_value="1", strategy=EN.SUPERVISED_RATIO,
+        scale=100)
+    d = {(o, v): e for o, v, e in enc}
+    assert d[(1, "x")] == 0 and d[(1, "y")] == 100
+
+
+def test_woe_encoding(mi_table):
+    enc = EN.categorical_continuous_encoding(
+        mi_table, [2], 4, pos_class_value="1", strategy=EN.WEIGHT_OF_EVIDENCE,
+        scale=100)
+    d = {(o, v): e for o, v, e in enc}
+    # q is positively associated, p negatively
+    assert d[(2, "q")] > 0 > d[(2, "p")]
+
+
+def test_adaboost_cycle():
+    actual = ["a", "a", "b", "b"]
+    pred = ["a", "b", "b", "b"]  # one error (idx 1)
+    w = np.full(4, 0.25)
+    err = EN.adaboost_error(actual, pred, w, weight_normalized=True)
+    assert abs(err - 0.25) < 1e-12
+    alpha = EN.adaboost_alpha(err)
+    assert abs(alpha - 0.5 * math.log(3)) < 1e-12
+    w2 = EN.adaboost_update(w, actual, pred, err)
+    assert w2[1] > w2[0]  # misclassified upweighted
+    # error >= 0.5 resets
+    w3 = EN.adaboost_update(w, actual, pred, 0.6, initial_weight=1.0)
+    assert np.all(w3 == 1.0)
+
+
+NUM_SCHEMA = FeatureSchema.from_dict({"fields": [
+    {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+    {"name": "x", "ordinal": 1, "dataType": "double", "feature": True,
+     "min": 0, "max": 10},
+    {"name": "junk", "ordinal": 2, "dataType": "double", "feature": True,
+     "min": 0, "max": 10},
+    {"name": "cls", "ordinal": 3, "dataType": "categorical",
+     "cardinality": ["A", "B"]}]})
+
+
+def num_cluster_table(n=200, seed=5):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(n):
+        if i % 4 == 0:  # minority class A at x~2
+            rows.append([f"m{i}", f"{rng.normal(2, 0.3):.4f}",
+                         f"{rng.uniform(0, 10):.4f}", "A"])
+        else:
+            rows.append([f"M{i}", f"{rng.normal(8, 0.3):.4f}",
+                         f"{rng.uniform(0, 10):.4f}", "B"])
+    return encode_rows(rows, NUM_SCHEMA)
+
+
+def test_top_matches_by_class():
+    t = num_cluster_table()
+    nb = SA.top_matches_by_class(t, 3)
+    cls = t.class_codes()
+    for i in range(0, 40, 7):
+        for j in nb[i]:
+            if j >= 0:
+                assert cls[j] == cls[i] and j != i
+
+
+def test_smote_oversample():
+    t = num_cluster_table()
+    syn = SA.smote_oversample(t, "A", k=3, multiplier=2)
+    n_minority = int((t.class_codes() == 0).sum())
+    assert len(syn) == 2 * n_minority
+    for row in syn[:10]:
+        assert row[3] == "A"
+        x = float(row[1])
+        assert 0.5 < x < 3.5  # interpolations stay within minority cluster
+
+
+def test_under_sample_and_bagging():
+    t = num_cluster_table()
+    keep = SA.under_sample(t, "B", rate=0.3, seed=1)
+    cls = t.class_codes()
+    assert keep[cls == 0].all()                    # minority untouched
+    frac = keep[cls == 1].mean()
+    assert 0.15 < frac < 0.45
+    idx = SA.bagging_sample(100, 0.5, True, seed=2)
+    assert len(idx) == 50 and idx.max() < 100
+
+
+def test_relief_relevance():
+    t = num_cluster_table()
+    scores = SA.relief_relevance(t, [1, 2])
+    # x separates classes -> high positive; junk ~ 0
+    assert scores[1] > 0.3
+    assert abs(scores[2]) < 0.15

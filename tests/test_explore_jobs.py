@@ -1,0 +1,127 @@
+"""CLI smoke tests for the explore-pack jobs."""
+
+import json
+import numpy as np
+
+from avenir_tpu.cli import run as cli_run
+
+
+def write_fixture(tmp_path):
+    schema = {"fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "a", "ordinal": 1, "dataType": "categorical", "feature": True,
+         "cardinality": ["x", "y"]},
+        {"name": "b", "ordinal": 2, "dataType": "categorical", "feature": True,
+         "cardinality": ["p", "q"]},
+        {"name": "v", "ordinal": 3, "dataType": "double", "feature": True,
+         "min": 0, "max": 10},
+        {"name": "cls", "ordinal": 4, "dataType": "categorical",
+         "cardinality": ["0", "1"]}]}
+    sp = tmp_path / "s.json"
+    sp.write_text(json.dumps(schema))
+    rng = np.random.default_rng(2)
+    lines = []
+    for i in range(300):
+        c = int(rng.random() < 0.4)
+        a = "x" if c == 0 else "y"
+        b = "p" if rng.random() < 0.5 else "q"
+        v = rng.normal(3 if c == 0 else 7, 0.5)
+        lines.append(f"r{i},{a},{b},{v:.3f},{c}")
+    csv = tmp_path / "in.csv"
+    csv.write_text("\n".join(lines))
+    return sp, csv
+
+
+def test_mutual_information_job(tmp_path):
+    sp, csv = write_fixture(tmp_path)
+    props = tmp_path / "p.properties"
+    props.write_text(
+        f"mut.feature.schema.file.path={sp}\n"
+        "mut.mutual.info.score.algorithms=mutual.info.maximization,"
+        "min.redundancy.max.relevance\n")
+    rc = cli_run.main(["mutualInformation", f"-Dconf.path={props}",
+                       str(csv), str(tmp_path / "out")])
+    assert rc == 0
+    lines = (tmp_path / "out" / "part-r-00000").read_text().splitlines()
+    assert any(l.startswith("classEntropy") for l in lines)
+    assert any(l.startswith("score,mutual.info.maximization,1,") for l in lines)
+    assert any(l.startswith("score,min.redundancy.max.relevance") for l in lines)
+
+
+def test_cramer_and_encoding_jobs(tmp_path):
+    sp, csv = write_fixture(tmp_path)
+    props = tmp_path / "p.properties"
+    props.write_text(
+        f"crc.feature.schema.file.path={sp}\n"
+        "crc.source.attributes=1,2\ncrc.dest.attributes=4\n"
+        f"coe.feature.schema.file.path={sp}\n"
+        "coe.cat.attribute.ordinals=1,2\ncoe.class.attr.ordinal=4\n"
+        "coe.pos.class.attr.value=1\ncoe.encoding.strategy=supervisedRatio\n"
+        "coe.output.scale=100\n")
+    rc = cli_run.main(["cramerCorrelation", f"-Dconf.path={props}",
+                       str(csv), str(tmp_path / "cr")])
+    assert rc == 0
+    cr = {tuple(l.split(",")[:2]): int(l.split(",")[2])
+          for l in (tmp_path / "cr" / "part-r-00000").read_text().splitlines()}
+    assert cr[("1", "4")] > 900   # a == cls (scaled by 1000)
+    assert cr[("2", "4")] < 100
+    rc = cli_run.main(["categoricalContinuousEncoding", f"-Dconf.path={props}",
+                       str(csv), str(tmp_path / "enc")])
+    assert rc == 0
+    enc = {tuple(l.split(",")[:2]): int(l.split(",")[2])
+           for l in (tmp_path / "enc" / "part-r-00000").read_text().splitlines()}
+    assert enc[("1", "y")] == 100 and enc[("1", "x")] == 0
+
+
+def test_relief_and_adaboost_jobs(tmp_path):
+    sp, csv = write_fixture(tmp_path)
+    props = tmp_path / "p.properties"
+    props.write_text(
+        f"ffr.attr.schema.file.path={sp}\n"
+        "ffr.attr.ordinals=1,3\n")
+    rc = cli_run.main(["reliefFeatureRelevance", f"-Dconf.path={props}",
+                       str(csv), str(tmp_path / "rel")])
+    assert rc == 0
+    rel = {l.split(",")[0]: float(l.split(",")[1])
+           for l in (tmp_path / "rel" / "part-r-00000").read_text().splitlines()}
+    assert rel["1"] > 0.3 and rel["3"] > 0.2
+
+    # adaboost: build a pred file with one wrong out of 4
+    pred_csv = tmp_path / "pred.csv"
+    pred_csv.write_text("a,a,0.25\na,b,0.25\nb,b,0.25\nb,b,0.25")
+    props2 = tmp_path / "ab.properties"
+    props2.write_text(
+        "abe.actual.class.attr.ordinal=0\nabe.pred.class.attr.ordinal=1\n"
+        "abe.boost.attr.ordinal=2\n"
+        "abu.actual.class.attr.ordinal=0\nabu.pred.class.attr.ordinal=1\n"
+        "abu.boost.attr.ordinal=2\nabu.iteration.error=0.25\n")
+    rc = cli_run.main(["adaBoostError", f"-Dconf.path={props2}",
+                       str(pred_csv), str(tmp_path / "err")])
+    assert rc == 0
+    assert (tmp_path / "err" / "part-r-00000").read_text().startswith("error=0.25")
+    rc = cli_run.main(["adaBoostUpdate", f"-Dconf.path={props2}",
+                       str(pred_csv), str(tmp_path / "upd")])
+    assert rc == 0
+    rows = [l.split(",") for l in
+            (tmp_path / "upd" / "part-r-00000").read_text().splitlines()]
+    assert float(rows[1][2]) > float(rows[0][2])  # misclassified upweighted
+
+
+def test_sampler_jobs(tmp_path):
+    sp, csv = write_fixture(tmp_path)
+    props = tmp_path / "p.properties"
+    props.write_text(
+        f"cbos.feature.schema.file.path={sp}\n"
+        "cbos.minority.class.value=0\ncbos.over.sampling.multiplier=1\n"
+        f"usb.feature.schema.file.path={sp}\n"
+        "usb.majority.class.value=1\nusb.sampling.rate=0.5\n")
+    rc = cli_run.main(["classBasedOverSampler", f"-Dconf.path={props}",
+                       str(csv), str(tmp_path / "over")])
+    assert rc == 0
+    n_out = len((tmp_path / "over" / "part-r-00000").read_text().splitlines())
+    assert n_out > 300  # originals + synthetics
+    rc = cli_run.main(["underSamplingBalancer", f"-Dconf.path={props}",
+                       str(csv), str(tmp_path / "under")])
+    assert rc == 0
+    n_under = len((tmp_path / "under" / "part-r-00000").read_text().splitlines())
+    assert n_under < 300
