@@ -1,0 +1,103 @@
+"""Optimize-pack jobs: simulatedAnnealing / geneticAlgorithm.
+
+Invocation matches the Spark driver convention (resource/opt.sh:9-16):
+``python -m avenir_tpu.cli.run simulatedAnnealing <outputPath> <opt.conf>``
+with the HOCON block keys of resource/opt.conf.  The domain callback class
+name maps to our domain registry (org.avenir.examples.TaskScheduleSearch ->
+TaskScheduleDomain).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict
+
+import numpy as np
+
+from ..core.config import Config
+from ..core.metrics import Counters
+from ..core import artifacts
+from .jobs import register
+
+DOMAIN_REGISTRY: Dict[str, str] = {
+    "org.avenir.examples.TaskScheduleSearch":
+        "avenir_tpu.optimize.task_schedule:TaskScheduleDomain",
+    "taskSchedule":
+        "avenir_tpu.optimize.task_schedule:TaskScheduleDomain",
+}
+
+
+def load_domain(class_name: str, config_file: str):
+    target = DOMAIN_REGISTRY.get(class_name)
+    if target is None:
+        raise KeyError(f"unknown domain callback {class_name!r}; known: "
+                       f"{sorted(DOMAIN_REGISTRY)}")
+    mod_name, _, cls_name = target.partition(":")
+    import importlib
+    mod = importlib.import_module(mod_name)
+    return getattr(mod, cls_name).load(config_file)
+
+
+@register("org.avenir.spark.optimize.SimulatedAnnealing", "simulatedAnnealing")
+def simulated_annealing_job(cfg: Config, in_path: str, out_path: str) -> Counters:
+    """SA over the configured domain (opt.conf keys; SURVEY.md §3.3).
+    in_path may hold starting solutions (one per line, reference component
+    format); otherwise num.optimizers random starts are generated."""
+    from ..optimize.annealing import AnnealingParams, simulated_annealing
+    counters = Counters()
+    params = AnnealingParams(
+        max_num_iterations=cfg.get_int("max.num.iterations", 300),
+        num_optimizers=cfg.get_int("num.optimizers", 8),
+        initial_temp=cfg.get_float("initial.temp", 30.0),
+        cooling_rate=cfg.get_float("cooling.rate.value", 0.99),
+        cooling_rate_geometric=cfg.get_boolean("cooling.rate.geometric", True),
+        temp_update_interval=cfg.get_int("temp.update.interval", 2),
+        max_step_size=cfg.get_int("max.step.size", 1),
+        locally_optimize=cfg.get_boolean("locally.optimize", False),
+        max_num_local_iterations=cfg.get_int("max.num.local.iterations", 50),
+        seed=cfg.get_int("random.seed", 0),
+    )
+    domain = load_domain(cfg.must_get("domain.callback.class.name"),
+                         cfg.must_get("domain.callback.config.file"))
+    starts = None
+    if in_path and os.path.exists(in_path):
+        lines = artifacts.read_text_input(in_path)
+        if lines:
+            starts = np.stack([domain.from_string(l) for l in lines])
+            params.num_optimizers = len(lines)
+    res = simulated_annealing(domain, params, start_solutions=starts)
+    od = cfg.field_delim_out
+    order = np.argsort(res.best_costs)
+    out_lines = [f"{domain.to_string(res.best_solutions[i])}{od}"
+                 f"{res.best_costs[i]:.3f}" for i in order]
+    artifacts.write_text_output(out_path, out_lines)
+    for k, v in res.counters.items():
+        counters.set("Annealing", k, int(v))
+    counters.set("Annealing", "estimatedInitialTemp",
+                 int(res.estimated_initial_temp))
+    return counters
+
+
+@register("org.avenir.spark.optimize.GeneticAlgorithm", "geneticAlgorithm")
+def genetic_algorithm_job(cfg: Config, in_path: str, out_path: str) -> Counters:
+    """GA over the configured domain (GeneticAlgorithm.scala:69-176)."""
+    from ..optimize.genetic import GeneticParams, genetic_algorithm
+    counters = Counters()
+    params = GeneticParams(
+        num_generations=cfg.get_int("num.generations", 100),
+        population_size=cfg.get_int("population.size", 32),
+        num_islands=cfg.get_int("num.partitions", 4),
+        crossover_prob=cfg.get_float("crossover.prob", 0.8),
+        mutation_prob=cfg.get_float("mutation.prob", 0.2),
+        seed=cfg.get_int("random.seed", 0),
+    )
+    domain = load_domain(cfg.must_get("domain.callback.class.name"),
+                         cfg.must_get("domain.callback.config.file"))
+    res = genetic_algorithm(domain, params)
+    od = cfg.field_delim_out
+    out_lines = [f"{domain.to_string(res.island_best[i])}{od}"
+                 f"{res.island_best_costs[i]:.3f}"
+                 for i in np.argsort(res.island_best_costs)]
+    artifacts.write_text_output(out_path, out_lines)
+    counters.set("Genetic", "bestCost", int(res.best_cost))
+    return counters

@@ -22,6 +22,9 @@ from typing import List, Optional
 from ..core.config import Config, load_config
 from . import jobs
 from . import explore_jobs  # noqa: F401  (registers explore-pack jobs)
+from . import sequence_jobs  # noqa: F401  (registers sequence-pack jobs)
+from . import optimize_jobs  # noqa: F401  (registers optimize-pack jobs)
+from . import reinforce_jobs  # noqa: F401  (registers reinforce-pack jobs)
 
 
 def parse_args(argv: List[str]):

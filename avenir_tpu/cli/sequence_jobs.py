@@ -1,0 +1,189 @@
+"""Sequence-pack job registrations (org.avenir.markov.*, org.avenir.sequence.*).
+
+Input convention (matching the reference jobs' mappers): each line is
+``id fields... [classLabel,] state,state,state,...`` with
+``skip.field.count`` leading fields ignored (mst.skip.field.count etc.).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List
+
+import numpy as np
+
+from ..core.config import Config
+from ..core.metrics import Counters
+from ..core import artifacts
+from .jobs import register, _splitter
+
+
+def _parse_sequences(lines, split_line, skip: int, class_ord: int = -1):
+    """Returns (sequences, labels, ids).  With a class label ordinal, that
+    field is excluded from the sequence and skip is bumped like the reference
+    mapper (MarkovStateTransitionModel.java:106-110)."""
+    seqs, labels, ids = [], [], []
+    eff_skip = skip + (1 if class_ord >= 0 else 0)
+    for line in lines:
+        it = split_line(line)
+        ids.append(it[0] if it else "")
+        labels.append(it[class_ord] if class_ord >= 0 else None)
+        seqs.append(it[eff_skip:])
+    return seqs, labels, ids
+
+
+@register("org.avenir.markov.MarkovStateTransitionModel",
+          "markovStateTransitionModel")
+def markov_state_transition_model(cfg: Config, in_path: str,
+                                  out_path: str) -> Counters:
+    """Markov transition-matrix trainer (mst.* keys: skip.field.count,
+    class.label.field.ord, model.states, trans.prob.scale)."""
+    from ..sequence import markov as MK
+    counters = Counters()
+    split_line = _splitter(cfg.field_delim_regex)
+    lines = artifacts.read_text_input(in_path)
+    skip = cfg.get_int("mst.skip.field.count", 0)
+    class_ord = cfg.get_int("mst.class.label.field.ord", -1)
+    states = cfg.must_get_list("mst.model.states")
+    scale = cfg.get_int("mst.trans.prob.scale", 1000)
+    seqs, labels, _ = _parse_sequences(lines, split_line, skip, class_ord)
+    if class_ord >= 0:
+        model = MK.build_model(seqs, states, labels=labels, scale=scale)
+    else:
+        model = MK.build_model(seqs, states, scale=scale)
+    out_lines = model.to_lines(cfg.field_delim_out)
+    if not cfg.get_boolean("mst.output.states", True):
+        out_lines = out_lines[1:]
+    artifacts.write_text_output(out_path, out_lines)
+    counters.increment("Markov", "Sequences", len(seqs))
+    return counters
+
+
+@register("org.avenir.markov.MarkovModelClassifier", "markovModelClassifier")
+def markov_model_classifier(cfg: Config, in_path: str, out_path: str) -> Counters:
+    """Log-odds sequence classifier (mmc.* keys; output
+    id[,actual],predClass,logOdds — MarkovModelClassifier.java:140-148)."""
+    from ..sequence import markov as MK
+    counters = Counters()
+    split_line = _splitter(cfg.field_delim_regex)
+    od = cfg.field_delim_out
+    lines = artifacts.read_text_input(in_path)
+    skip = cfg.get_int("mmc.skip.field.count", 1)
+    id_ord = cfg.get_int("mmc.id.field.ord", 0)
+    validation = cfg.get_boolean("mmc.validation.mode", False)
+    class_ord = cfg.get_int("mmc.class.label.field.ord", -1)
+    if validation and class_ord < 0:
+        raise ValueError("In validation mode actual class labels must be provided")
+    class_labels = cfg.must_get_list("mmc.class.labels")
+    threshold = cfg.get_float("mmc.log.odds.threshold", 0.0)
+    model_lines = artifacts.read_text_input(cfg.must_get("mmc.mm.model.path"))
+    # the log-odds classifier always needs per-class matrices
+    model = MK.MarkovModel.from_lines(model_lines, class_based=True)
+    eff_skip = skip + (1 if validation else 0)
+    seqs, ids, actuals = [], [], []
+    for line in lines:
+        it = split_line(line)
+        ids.append(it[id_ord])
+        actuals.append(it[class_ord] if validation else None)
+        seqs.append(it[eff_skip:])
+    pred, log_odds = MK.classify(model, seqs, class_labels, threshold)
+    out = []
+    for i in range(len(seqs)):
+        parts = [ids[i]]
+        if validation:
+            parts.append(actuals[i])
+        parts.extend([pred[i], str(float(log_odds[i]))])
+        out.append(od.join(parts))
+        if validation:
+            counters.increment("Validation",
+                               "Correct" if pred[i] == actuals[i] else "Incorrect")
+    artifacts.write_text_output(out_path, out, role="m")
+    return counters
+
+
+@register("org.avenir.markov.HiddenMarkovModelBuilder", "hiddenMarkovModelBuilder")
+def hidden_markov_model_builder(cfg: Config, in_path: str,
+                                out_path: str) -> Counters:
+    """Supervised HMM builder (hmmb.* keys).  Input lines alternate
+    observation and state tokens after the skipped fields:
+    obs,state,obs,state,... (the tagged-sequence convention)."""
+    from ..sequence import markov as MK
+    counters = Counters()
+    split_line = _splitter(cfg.field_delim_regex)
+    lines = artifacts.read_text_input(in_path)
+    skip = cfg.get_int("hmmb.skip.field.count", 0)
+    states = cfg.must_get_list("hmmb.model.states")
+    observations = cfg.must_get_list("hmmb.model.observations")
+    scale = cfg.get_int("hmmb.trans.prob.scale", 1000)
+    tagged = []
+    for line in lines:
+        it = split_line(line)[skip:]
+        tagged.append([(it[i], it[i + 1]) for i in range(0, len(it) - 1, 2)])
+    hmm = MK.build_hmm(tagged, states, observations, scale=scale)
+    artifacts.write_text_output(out_path, hmm.to_lines(cfg.field_delim_out))
+    counters.increment("HMM", "Sequences", len(tagged))
+    return counters
+
+
+@register("org.avenir.markov.ViterbiStatePredictor", "viterbiStatePredictor")
+def viterbi_state_predictor(cfg: Config, in_path: str, out_path: str) -> Counters:
+    """Viterbi decode of observation sequences (vsp.* keys; output
+    id,state,state,... — markov/ViterbiStatePredictor.java:77)."""
+    from ..sequence import markov as MK
+    counters = Counters()
+    split_line = _splitter(cfg.field_delim_regex)
+    od = cfg.field_delim_out
+    lines = artifacts.read_text_input(in_path)
+    skip = cfg.get_int("vsp.skip.field.count", 1)
+    model_lines = artifacts.read_text_input(cfg.must_get("vsp.hmm.model.path"))
+    hmm = MK.HiddenMarkovModel.from_lines(model_lines)
+    ids, seqs = [], []
+    for line in lines:
+        it = split_line(line)
+        ids.append(it[0])
+        seqs.append(it[skip:])
+    decoded = MK.viterbi_decode(hmm, seqs)
+    out = [od.join([ids[i]] + decoded[i]) for i in range(len(ids))]
+    artifacts.write_text_output(out_path, out, role="m")
+    return counters
+
+
+@register("org.avenir.markov.ProbabilisticSuffixTreeGenerator",
+          "probabilisticSuffixTreeGenerator")
+def probabilistic_suffix_tree_generator(cfg: Config, in_path: str,
+                                        out_path: str) -> Counters:
+    """PST counts up to pstg.max.depth (markov/ProbabilisticSuffixTree
+    Generator.java:88-295); output 'context,symbol,count' lines."""
+    from ..sequence.pst import ProbabilisticSuffixTree
+    counters = Counters()
+    split_line = _splitter(cfg.field_delim_regex)
+    lines = artifacts.read_text_input(in_path)
+    skip = cfg.get_int("pstg.skip.field.count", 0)
+    tree = ProbabilisticSuffixTree(max_depth=cfg.get_int("pstg.max.depth", 3))
+    seqs = [split_line(l)[skip:] for l in lines]
+    tree.add_sequences(seqs)
+    artifacts.write_text_output(out_path, tree.to_lines(cfg.field_delim_out))
+    counters.increment("PST", "Contexts", len(tree.counts))
+    return counters
+
+
+@register("org.avenir.sequence.CandidateGenerationWithSelfJoin",
+          "candidateGenerationWithSelfJoin")
+def candidate_generation_with_self_join(cfg: Config, in_path: str,
+                                        out_path: str) -> Counters:
+    """GSP candidate generation from (k-1)-frequent sequence lines
+    'item,item,...[,support]' (sequence/CandidateGenerationWithSelfJoin.java)."""
+    from ..sequence.pst import gsp_candidates
+    counters = Counters()
+    split_line = _splitter(cfg.field_delim_regex)
+    lines = artifacts.read_text_input(in_path)
+    has_support = cfg.get_boolean("cgs.support.in.input", False)
+    freq = []
+    for l in lines:
+        it = split_line(l)
+        freq.append(it[:-1] if has_support else it)
+    cands = gsp_candidates(freq)
+    od = cfg.field_delim_out
+    artifacts.write_text_output(out_path, (od.join(c) for c in cands))
+    counters.increment("GSP", "Candidates", len(cands))
+    return counters

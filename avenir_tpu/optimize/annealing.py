@@ -1,0 +1,169 @@
+"""Simulated annealing: vmapped Metropolis chains under one jitted scan.
+
+Parity target: spark/.../optimize/SimulatedAnnealing.scala:96-255
+(SURVEY.md §3.3).  The reference runs numOptimizers independent annealing
+chains via mapPartitions; here every chain is a row of a batched state and
+the whole run is ONE ``lax.scan`` over iterations with all chains advancing
+per step (vmapped Metropolis), sharded over the mesh via the chain-fanout
+idiom.  Semantics preserved:
+
+  * accept better always; accept worse with prob exp((cur-next)/temp)
+    (:139-170);
+  * temperature updated every temp.update.interval iterations, geometric
+    temp *= rate, or the reference's linear form temp -= initial - i*rate
+    clamped at 0 (:172-184);
+  * accumulators better/best/worse/accepted + cost-increase sum (:88-92);
+  * optional greedy local-descent pass (:197-232);
+  * estimated initial temperature diagnostic = mean cost increase of worse
+    moves (:244-249).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .domain import SearchDomain
+from ..parallel.mesh import MeshContext
+
+
+@dataclass
+class AnnealingParams:
+    """The simulatedAnnealing block knobs (resource/opt.conf)."""
+    max_num_iterations: int = 300
+    num_optimizers: int = 8
+    initial_temp: float = 30.0
+    cooling_rate: float = 0.99
+    cooling_rate_geometric: bool = True
+    temp_update_interval: int = 2
+    max_step_size: int = 1
+    locally_optimize: bool = False
+    max_num_local_iterations: int = 50
+    seed: int = 0
+
+
+@dataclass
+class AnnealingResult:
+    best_solutions: np.ndarray        # (chains, L)
+    best_costs: np.ndarray            # (chains,)
+    counters: Dict[str, float]
+    estimated_initial_temp: float
+
+
+def simulated_annealing(domain: SearchDomain, params: AnnealingParams,
+                        ctx: Optional[MeshContext] = None,
+                        start_solutions: Optional[np.ndarray] = None
+                        ) -> AnnealingResult:
+    ctx = ctx or MeshContext()
+    rng = np.random.default_rng(params.seed)
+    k = params.num_optimizers
+    cur = start_solutions if start_solutions is not None else \
+        domain.initial_solutions(rng, k)
+    cur = jnp.asarray(cur, dtype=jnp.int32)
+    key = jax.random.PRNGKey(params.seed)
+
+    cur_cost = domain.cost_batch(cur)
+
+    def step(carry, i):
+        (cur, cur_cost, best, best_cost, temp, upd_counter, key,
+         n_better, n_best, n_worse, n_accept, cost_inc) = carry
+        key, k_mut, k_acc = jax.random.split(key, 3)
+        nxt = domain.mutate(k_mut, cur, params.max_step_size)
+        nxt_cost = domain.cost_batch(nxt)
+
+        better = nxt_cost < cur_cost
+        is_best = nxt_cost < best_cost
+        u = jax.random.uniform(k_acc, cur_cost.shape)
+        accept_worse = (~better) & (jnp.exp((cur_cost - nxt_cost) / temp) > u)
+        take = better | accept_worse
+
+        new_cur = jnp.where(take[:, None], nxt, cur)
+        new_cur_cost = jnp.where(take, nxt_cost, cur_cost)
+        new_best = jnp.where(is_best[:, None], nxt, best)
+        new_best_cost = jnp.where(is_best, nxt_cost, best_cost)
+
+        n_better += better.sum()
+        n_best += is_best.sum()
+        n_worse += (~better).sum()
+        n_accept += accept_worse.sum()
+        cost_inc += jnp.where(~better, nxt_cost - cur_cost, 0.0).sum()
+
+        upd_counter = upd_counter + 1
+        do_update = upd_counter == params.temp_update_interval
+        if params.cooling_rate_geometric:
+            new_temp = jnp.where(do_update, temp * params.cooling_rate, temp)
+        else:
+            # reference linear form (:176-181), clamped at zero
+            new_temp = jnp.where(
+                do_update,
+                jnp.maximum(temp - (params.initial_temp -
+                                    (i + 1.0) * params.cooling_rate), 0.0),
+                temp)
+        upd_counter = jnp.where(do_update, 0, upd_counter)
+
+        return (new_cur, new_cur_cost, new_best, new_best_cost, new_temp,
+                upd_counter, key, n_better, n_best, n_worse, n_accept,
+                cost_inc), None
+
+    init = (cur, cur_cost, cur, cur_cost,
+            jnp.asarray(params.initial_temp, dtype=jnp.float32),
+            jnp.asarray(0, dtype=jnp.int32), key,
+            jnp.asarray(0, dtype=jnp.int32), jnp.asarray(0, dtype=jnp.int32),
+            jnp.asarray(0, dtype=jnp.int32), jnp.asarray(0, dtype=jnp.int32),
+            jnp.asarray(0.0, dtype=jnp.float32))
+
+    @jax.jit
+    def run(init):
+        carry, _ = jax.lax.scan(step, init,
+                                jnp.arange(params.max_num_iterations,
+                                           dtype=jnp.float32))
+        return carry
+
+    carry = run(init)
+    (_, _, best, best_cost, _, _, key,
+     n_better, n_best, n_worse, n_accept, cost_inc) = carry
+
+    if params.locally_optimize:
+        best, best_cost = local_descent(domain, best, best_cost,
+                                        params.max_num_local_iterations, key)
+
+    n_worse_v = float(n_worse)
+    counters = {
+        "betterSolnCount": float(n_better), "bestSolnCount": float(n_best),
+        "worseSolnCount": n_worse_v, "worseSolnAcceptCount": float(n_accept),
+        "costIncreaseAcum": float(cost_inc),
+    }
+    est_temp = float(cost_inc) / n_worse_v if n_worse_v > 0 else 0.0
+    return AnnealingResult(best_solutions=np.asarray(best),
+                           best_costs=np.asarray(best_cost),
+                           counters=counters,
+                           estimated_initial_temp=est_temp)
+
+
+def local_descent(domain: SearchDomain, solutions, costs,
+                  iterations: int, key):
+    """Greedy pass: accept only improvements (the optional second
+    mapPartitions of the reference, :197-232)."""
+
+    def step(carry, _):
+        cur, cur_cost, key = carry
+        key, k_mut = jax.random.split(key)
+        nxt = domain.mutate(k_mut, cur, 1)
+        nxt_cost = domain.cost_batch(nxt)
+        better = nxt_cost < cur_cost
+        return (jnp.where(better[:, None], nxt, cur),
+                jnp.where(better, nxt_cost, cur_cost), key), None
+
+    @jax.jit
+    def run(solutions, costs, key):
+        carry, _ = jax.lax.scan(step, (solutions, costs, key), None,
+                                length=iterations)
+        return carry[0], carry[1]
+
+    out, out_cost = run(solutions, costs, key)
+    return out, out_cost

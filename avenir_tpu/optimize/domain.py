@@ -1,0 +1,124 @@
+"""Search-domain protocol for stochastic optimization.
+
+Parity target: optimize/BasicSearchDomain.java (SURVEY.md §2.7) — the
+Strategy interface between optimizers and business domains.  In the
+reference a solution is a delimited string of components with scalar
+callbacks (cost / validity / mutation / crossover).  TPU-first redesign:
+
+  * a solution is an int32 vector ``(n_components,)`` of choice indices;
+  * a POPULATION is a matrix ``(k, n_components)`` and every callback is
+    batched: ``cost_batch`` maps (k, L) -> (k,) under jit, so thousands of
+    SA chains / GA members evaluate in one device pass;
+  * mutation = random component resample (createNeighborhoodSolution's
+    single-component replacement, BasicSearchDomain.java:175), crossover =
+    single point (:328-411) — both implemented here generically as jnp ops.
+
+String serialization round-trips the reference's component format
+('taskId:employeeId' items joined by the solution delimiter) so artifacts
+stay interchangeable.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+class SearchDomain:
+    """Base class: subclasses define n_components, n_choices and cost."""
+
+    #: number of positions in a solution
+    n_components: int
+    #: number of choices per position (uniform alphabet)
+    n_choices: int
+
+    # ---- batched device callbacks ----
+    def cost_batch(self, solutions: jnp.ndarray) -> jnp.ndarray:
+        """(k, L) int32 -> (k,) float32 cost.  Must be jit-traceable."""
+        raise NotImplementedError
+
+    # ---- host helpers ----
+    def initial_solutions(self, rng: np.random.Generator, k: int) -> np.ndarray:
+        return rng.integers(0, self.n_choices, (k, self.n_components),
+                            dtype=np.int32)
+
+    # ---- generic neighborhood / crossover (jit-traceable) ----
+    def mutate(self, key, solutions: jnp.ndarray,
+               n_mutations: int = 1) -> jnp.ndarray:
+        """Replace n random components with random choices per solution."""
+        k, L = solutions.shape
+        out = solutions
+        for m in range(n_mutations):
+            key, k1, k2 = jax.random.split(key, 3)
+            pos = jax.random.randint(k1, (k,), 0, L)
+            val = jax.random.randint(k2, (k,), 0, self.n_choices)
+            out = out.at[jnp.arange(k), pos].set(val.astype(out.dtype))
+        return out
+
+    def crossover(self, key, parents_a: jnp.ndarray,
+                  parents_b: jnp.ndarray) -> jnp.ndarray:
+        """Single-point crossover per pair (BasicSearchDomain:328-411)."""
+        k, L = parents_a.shape
+        point = jax.random.randint(key, (k, 1), 1, L)
+        idx = jnp.arange(L)[None, :]
+        return jnp.where(idx < point, parents_a, parents_b)
+
+    # ---- serialization ----
+    def component_str(self, position: int, choice: int) -> str:
+        return f"{position}:{choice}"
+
+    def parse_component(self, comp: str) -> Tuple[int, int]:
+        a, b = comp.split(":")
+        return int(a), int(b)
+
+    def to_string(self, solution: np.ndarray, delim: str = ";") -> str:
+        return delim.join(self.component_str(i, int(c))
+                          for i, c in enumerate(solution))
+
+    def from_string(self, text: str, delim: str = ";") -> np.ndarray:
+        out = np.zeros((self.n_components,), dtype=np.int32)
+        for comp in text.split(delim):
+            pos, choice = self.parse_component(comp)
+            out[pos] = choice
+        return out
+
+
+@dataclass
+class MatrixCostDomain(SearchDomain):
+    """Domain whose cost is sum of per-(position, choice) costs plus an
+    optional pairwise penalty — covers assignment-style problems (the
+    TaskSchedule example) with one gather per evaluation."""
+
+    cost_matrix: np.ndarray                    # (L, n_choices)
+    # optional conflicts: conflict[l1, l2] == 1 means positions l1 != l2 may
+    # not share a choice (e.g. overlapping tasks, same employee)
+    conflict: Optional[np.ndarray] = None
+    # cost assigned to an invalid solution (the reference replaces the whole
+    # solution cost with inavlidSolutionCost rather than adding a penalty)
+    conflict_penalty: float = 0.0
+    invalid_replaces_cost: bool = True
+    average: bool = True
+
+    def __post_init__(self):
+        self.n_components, self.n_choices = self.cost_matrix.shape
+        self._cm = jnp.asarray(self.cost_matrix, dtype=jnp.float32)
+        self._conf = None if self.conflict is None else \
+            jnp.asarray(self.conflict, dtype=jnp.float32)
+
+    def cost_batch(self, solutions: jnp.ndarray) -> jnp.ndarray:
+        L = self.n_components
+        base = self._cm[jnp.arange(L)[None, :], solutions]     # (k, L)
+        total = base.mean(axis=1) if self.average else base.sum(axis=1)
+        if self._conf is not None:
+            same = (solutions[:, :, None] == solutions[:, None, :])
+            pen = (same * self._conf[None]).sum(axis=(1, 2))
+            if self.invalid_replaces_cost:
+                total = jnp.where(pen > 0, self.conflict_penalty, total)
+            else:
+                total = total + self.conflict_penalty * pen
+        return total

@@ -1,0 +1,165 @@
+"""Group-wise batch bandit decisioning + the vectorized device path.
+
+Parity targets (SURVEY.md §2.6):
+  * Spark MultiArmBandit (spark/.../reinforce/MultiArmBandit.scala:61-146):
+    per group, build a learner from saved model state, apply reward
+    feedback, emit a batch of actions, save state back out.  GroupedBandits
+    is that combineByKey/cogroup flow with plain dicts.
+  * Hadoop GreedyRandomBandit / SoftMaxBandit etc. batch jobs: covered by
+    the same flow with the matching algorithm.
+  * The device path (VectorBandits) is the TPU-native scale story: state as
+    (groups, actions) arrays, one jitted pass selecting actions for every
+    group at once — the reference's per-group JVM loops become gathers.
+
+State file lines:   group,<learner state line>
+Reward file lines:  group,action,reward
+Action out lines:   group,action[,action...]
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .learners import MultiArmBanditLearner, create_learner
+
+
+class GroupedBandits:
+    def __init__(self, algorithm: str, actions: Sequence[str],
+                 config: Optional[Dict] = None):
+        self.algorithm = algorithm
+        self.actions = list(actions)
+        self.config = dict(config or {})
+        self.learners: Dict[str, MultiArmBanditLearner] = {}
+
+    def learner(self, group: str) -> MultiArmBanditLearner:
+        if group not in self.learners:
+            cfg = dict(self.config)
+            if cfg.get("random.seed") is not None:
+                # distinct deterministic stream per group: string seeds hash
+                # via sha512 inside random.Random — stable across processes
+                # (builtin hash() is salted per process and must not be used)
+                cfg["random.seed"] = f"{cfg['random.seed']}:{group}"
+            self.learners[group] = create_learner(self.algorithm, self.actions,
+                                                  cfg)
+        return self.learners[group]
+
+    # ---- state round trip (MultiArmBandit.scala:57-58,133-146) ----
+    def load_state(self, lines: Sequence[str], delim: str = ",") -> None:
+        per_group: Dict[str, List[str]] = {}
+        for line in lines:
+            group, _, rest = line.partition(delim)
+            per_group.setdefault(group, []).append(rest)
+        for group, state in per_group.items():
+            learner = self.learner(group)
+            learner.build_model(state)
+            # advance the per-group stream past prior rounds so a restarted
+            # job doesn't replay the identical random draws each round
+            trials = sum(s.count for s in learner.stats.values())
+            learner.total_trial_count = max(learner.total_trial_count, trials)
+            if self.config.get("random.seed") is not None:
+                learner.rng.seed(
+                    f"{self.config['random.seed']}:{group}:{trials}")
+
+    def save_state(self, delim: str = ",") -> List[str]:
+        out = []
+        for group in sorted(self.learners):
+            for line in self.learners[group].get_model():
+                out.append(f"{group}{delim}{line}")
+        return out
+
+    # ---- reward feedback ----
+    def apply_rewards(self, lines: Sequence[str], delim: str = ",") -> None:
+        for line in lines:
+            group, action, reward = line.split(delim)[:3]
+            self.learner(group).set_reward(action, float(reward))
+
+    # ---- decisions ----
+    def next_actions(self, groups: Optional[Sequence[str]] = None,
+                     delim: str = ",") -> List[str]:
+        groups = list(groups) if groups is not None else sorted(self.learners)
+        out = []
+        for g in groups:
+            acts = self.learner(g).next_actions()
+            out.append(delim.join([g] + acts))
+        return out
+
+
+class VectorBandits:
+    """Device-vectorized bandits over (groups, actions) state arrays.
+
+    Supported algorithms (the ones whose selection is a pure array op):
+    randomGreedy (epsilon-greedy), ucb1, softMax, sampsonSampler (gaussian
+    Thompson), intervalEstimator.  One jitted call selects an action for
+    every group simultaneously.
+    """
+
+    def __init__(self, algorithm: str, n_groups: int, n_actions: int,
+                 config: Optional[Dict] = None, seed: int = 0):
+        self.algorithm = algorithm
+        cfg = config or {}
+        self.G, self.A = n_groups, n_actions
+        self.counts = np.zeros((n_groups, n_actions), dtype=np.float32)
+        self.sums = np.zeros((n_groups, n_actions), dtype=np.float32)
+        self.sum_sqs = np.zeros((n_groups, n_actions), dtype=np.float32)
+        self.epsilon = float(cfg.get("random.selection.prob", 0.1))
+        self.temp = float(cfg.get("temp.constant", 0.1))
+        self.bias = float(cfg.get("confidence.factor", 2.0))
+        self.key = jax.random.PRNGKey(seed)
+        self._select = jax.jit(self._make_select())
+
+    def _make_select(self):
+        algo = self.algorithm
+        eps, temp, bias = self.epsilon, self.temp, self.bias
+
+        def select(key, counts, sums, sum_sqs):
+            mean = sums / jnp.maximum(counts, 1.0)
+            untried = counts == 0
+            if algo == "randomGreedy":
+                k1, k2 = jax.random.split(key)
+                greedy = jnp.argmax(jnp.where(untried, jnp.inf, mean), axis=1)
+                rand = jax.random.randint(k1, (counts.shape[0],), 0,
+                                          counts.shape[1])
+                explore = jax.random.uniform(k2, (counts.shape[0],)) < eps
+                return jnp.where(explore, rand, greedy)
+            if algo == "ucb1":
+                N = jnp.maximum(counts.sum(axis=1, keepdims=True), 1.0)
+                ub = mean + jnp.sqrt(2.0 * jnp.log(N) /
+                                     jnp.maximum(counts, 1.0))
+                return jnp.argmax(jnp.where(untried, jnp.inf, ub), axis=1)
+            if algo == "softMax":
+                logits = mean / temp
+                return jax.random.categorical(key, logits, axis=1)
+            if algo == "sampsonSampler":
+                var = (sum_sqs - counts * mean * mean) / \
+                    jnp.maximum(counts - 1.0, 1.0)
+                sd = jnp.sqrt(jnp.maximum(var, 1e-12))
+                z = jax.random.normal(key, counts.shape)
+                sample = mean + z * sd / jnp.sqrt(jnp.maximum(counts, 1.0))
+                return jnp.argmax(jnp.where(untried, jnp.inf, sample), axis=1)
+            if algo == "intervalEstimator":
+                var = (sum_sqs - counts * mean * mean) / \
+                    jnp.maximum(counts - 1.0, 1.0)
+                sd = jnp.sqrt(jnp.maximum(var, 0.0))
+                ub = mean + bias * sd / jnp.sqrt(jnp.maximum(counts, 1.0))
+                return jnp.argmax(jnp.where(untried, jnp.inf, ub), axis=1)
+            raise ValueError(f"algorithm {algo!r} has no vectorized form")
+
+        return select
+
+    def next_actions(self) -> np.ndarray:
+        """(G,) action indices for every group."""
+        self.key, sub = jax.random.split(self.key)
+        return np.asarray(self._select(sub, jnp.asarray(self.counts),
+                                       jnp.asarray(self.sums),
+                                       jnp.asarray(self.sum_sqs)))
+
+    def set_rewards(self, group_idx: np.ndarray, action_idx: np.ndarray,
+                    rewards: np.ndarray) -> None:
+        np.add.at(self.counts, (group_idx, action_idx), 1.0)
+        np.add.at(self.sums, (group_idx, action_idx), rewards)
+        np.add.at(self.sum_sqs, (group_idx, action_idx), rewards ** 2)

@@ -1,0 +1,62 @@
+"""Online bandit serving loop: the Storm topology, in-process.
+
+Parity target (SURVEY.md §2.6, §3.5): storm/ReinforcementLearnerTopology
+.java:46-87 + ReinforcementLearnerBolt.java:97-135 — a spout feeding event
+and reward messages from Redis queues into a bolt wrapping any factory
+learner, actions written back to an action queue.
+
+Here the queues are in-process (queue.Queue) with the same message
+semantics; swap them for any transport (the reference's Redis contract is
+just strings).  Message formats:
+  event:  'round,<roundNum>'  -> respond with next_actions on action queue
+  reward: 'reward,<action>,<value>' -> learner.set_reward
+Processing is synchronous per message like the bolt's execute()."""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, Optional, Sequence
+
+from .learners import MultiArmBanditLearner, create_learner
+
+
+class ReinforcementLearnerService:
+    def __init__(self, algorithm: str, actions: Sequence[str],
+                 config: Optional[Dict] = None):
+        self.learner = create_learner(algorithm, actions, config)
+        self.event_queue: "queue.Queue[str]" = queue.Queue()
+        self.action_queue: "queue.Queue[str]" = queue.Queue()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.delim = ","
+
+    # ---- the bolt's execute() (:97-135) ----
+    def process(self, message: str) -> Optional[str]:
+        parts = message.split(self.delim)
+        if parts[0] == "round":
+            actions = self.learner.next_actions()
+            out = self.delim.join([parts[1]] + actions)
+            self.action_queue.put(out)
+            return out
+        if parts[0] == "reward":
+            self.learner.set_reward(parts[1], float(parts[2]))
+            return None
+        raise ValueError(f"unknown message type {parts[0]!r}")
+
+    # ---- async loop (the topology submit) ----
+    def start(self) -> None:
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    msg = self.event_queue.get(timeout=0.05)
+                except queue.Empty:
+                    continue
+                self.process(msg)
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)

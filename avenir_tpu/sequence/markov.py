@@ -1,0 +1,309 @@
+"""Markov-chain models: transition counting, classification, HMM, Viterbi.
+
+Parity targets (SURVEY.md §2.5):
+  * MarkovStateTransitionModel (markov/MarkovStateTransitionModel.java:50-110)
+    — count (fromState, toState) pairs, optionally per class label, normalize
+    rows to a scaled transition matrix; model file = states line + matrix
+    rows (+ 'classLabel:<v>' separators in class-based mode), the exact
+    layout MarkovModel's parser reads (markov/MarkovModel.java:38-66).
+  * MarkovModelClassifier (markov/MarkovModelClassifier.java:130-150) —
+    per-sequence cumulative log odds
+    sum ln(P_c0(fr,to)/P_c1(fr,to)), threshold -> class;
+    output id[,actual],predClass,logOdds.
+  * HiddenMarkovModelBuilder (markov/HiddenMarkovModelBuilder.java:268-360)
+    — supervised counts from (observation, state)-tagged sequences ->
+    state-transition, state-observation (emission), initial-state matrices.
+  * ViterbiStatePredictor / ViterbiDecoder (markov/ViterbiDecoder.java:31) —
+    max-likelihood hidden path; here a lax.scan DP batched over sequences.
+
+TPU design: transition counting is a joint histogram of (from, to[, class])
+code pairs (MXU contraction); the classifier is a gather of log-ratio terms
+over padded sequence arrays; Viterbi is a vmapped lax.scan over the padded
+batch with per-sequence length masks.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.histogram import joint_histogram
+from ..parallel.mesh import MeshContext
+
+
+# --------------------------------------------------------------------------
+# transition counting + model
+# --------------------------------------------------------------------------
+
+@dataclass
+class MarkovModel:
+    states: List[str]
+    # class label -> (S, S) scaled transition prob matrix; the label is None
+    # for a single-matrix model
+    matrices: Dict[Optional[str], np.ndarray]
+    scale: int = 1000
+
+    @property
+    def state_index(self) -> Dict[str, int]:
+        return {s: i for i, s in enumerate(self.states)}
+
+    def prob(self, label: Optional[str], fr: str, to: str) -> float:
+        si = self.state_index
+        return float(self.matrices[label][si[fr], si[to]])
+
+    # ---- serialization (MarkovModel.java:38-66 layout) ----
+    def to_lines(self, delim: str = ",") -> List[str]:
+        lines = [delim.join(self.states)]
+        if list(self.matrices.keys()) == [None]:
+            for row in self.matrices[None]:
+                lines.append(delim.join(_fmt(v) for v in row))
+        else:
+            for label, mat in self.matrices.items():
+                lines.append(f"classLabel:{label}")
+                for row in mat:
+                    lines.append(delim.join(_fmt(v) for v in row))
+        return lines
+
+    @classmethod
+    def from_lines(cls, lines: Sequence[str], class_based: bool,
+                   delim: str = ",") -> "MarkovModel":
+        states = lines[0].split(delim)
+        n = len(states)
+        matrices: Dict[Optional[str], np.ndarray] = {}
+        i = 1
+        if class_based:
+            label = None
+            while i < len(lines):
+                if lines[i].startswith("classLabel"):
+                    label = lines[i].split(":")[1]
+                    i += 1
+                mat = np.array([[float(v) for v in lines[i + r].split(delim)]
+                                for r in range(n)])
+                matrices[label] = mat
+                i += n
+        else:
+            mat = np.array([[float(v) for v in lines[i + r].split(delim)]
+                            for r in range(n)])
+            matrices[None] = mat
+        return cls(states=states, matrices=matrices)
+
+
+def _fmt(v: float) -> str:
+    return str(int(v)) if float(v).is_integer() else f"{v:.3f}"
+
+
+def encode_sequences(sequences: Sequence[Sequence[str]],
+                     states: Sequence[str]) -> Tuple[np.ndarray, np.ndarray]:
+    """Pad string sequences to (n, Lmax) int codes + lengths; unknown -> -1."""
+    idx = {s: i for i, s in enumerate(states)}
+    n = len(sequences)
+    L = max((len(s) for s in sequences), default=1)
+    codes = np.full((n, L), -1, dtype=np.int32)
+    lens = np.zeros((n,), dtype=np.int32)
+    for i, seq in enumerate(sequences):
+        lens[i] = len(seq)
+        for j, s in enumerate(seq):
+            codes[i, j] = idx.get(s, -1)
+    return codes, lens
+
+
+def count_transitions(codes: np.ndarray, lens: np.ndarray, n_states: int,
+                      class_codes: Optional[np.ndarray] = None,
+                      n_classes: int = 1) -> np.ndarray:
+    """(n_classes, S, S) transition counts over padded sequence batch —
+    the mapper's (fromState, toState) pair emission + shuffle sum as one
+    device histogram over all adjacent pairs."""
+    n, L = codes.shape
+    fr = codes[:, :-1]
+    to = codes[:, 1:]
+    pos = np.arange(L - 1)[None, :]
+    valid = (pos < (lens[:, None] - 1)) & (fr >= 0) & (to >= 0)
+    cls = np.zeros((n,), dtype=np.int32) if class_codes is None else class_codes
+    cls_b = np.broadcast_to(cls[:, None], fr.shape)
+    # joint key: class*S*S + fr*S + to over valid pairs
+    key = (cls_b.astype(np.int64) * n_states + fr) * n_states + to
+    key = key[valid]
+    counts = np.bincount(key, minlength=n_classes * n_states * n_states)
+    return counts.reshape(n_classes, n_states, n_states).astype(np.float64)
+
+
+def build_model(sequences: Sequence[Sequence[str]], states: Sequence[str],
+                labels: Optional[Sequence[str]] = None,
+                class_labels: Optional[Sequence[str]] = None,
+                scale: int = 1000, laplace: float = 1.0) -> MarkovModel:
+    """Count + row-normalize to scaled probabilities.  Rows with no mass get
+    uniform probabilities; Laplace smoothing keeps the classifier's log
+    ratios finite (the reference's scaled-int matrix effectively floors at
+    whatever its normalization emits — zeros there would crash its log)."""
+    codes, lens = encode_sequences(sequences, states)
+    S = len(states)
+    if labels is None:
+        counts = count_transitions(codes, lens, S)
+        mats = {None: _normalize(counts[0], scale, laplace)}
+    else:
+        cl = list(class_labels or sorted(set(labels)))
+        cidx = {c: i for i, c in enumerate(cl)}
+        ccodes = np.array([cidx[l] for l in labels], dtype=np.int32)
+        counts = count_transitions(codes, lens, S, ccodes, len(cl))
+        mats = {c: _normalize(counts[i], scale, laplace)
+                for i, c in enumerate(cl)}
+    return MarkovModel(states=list(states), matrices=mats, scale=scale)
+
+
+def _normalize(counts: np.ndarray, scale: int, laplace: float) -> np.ndarray:
+    c = counts + laplace
+    rows = c.sum(axis=1, keepdims=True)
+    return c / rows * scale
+
+
+def classify(model: MarkovModel, sequences: Sequence[Sequence[str]],
+             class_labels: Sequence[str],
+             log_odds_threshold: float = 0.0) -> Tuple[List[str], np.ndarray]:
+    """Log-odds classification (MarkovModelClassifier.java:130-150):
+    logOdds = sum ln(P_c0/P_c1) over adjacent pairs; > threshold -> c0."""
+    codes, lens = encode_sequences(sequences, model.states)
+    m0 = jnp.asarray(model.matrices[class_labels[0]])
+    m1 = jnp.asarray(model.matrices[class_labels[1]])
+
+    @jax.jit
+    def kernel(codes, lens):
+        fr = jnp.clip(codes[:, :-1], 0, None)
+        to = jnp.clip(codes[:, 1:], 0, None)
+        pos = jnp.arange(codes.shape[1] - 1)[None, :]
+        valid = (pos < (lens[:, None] - 1)) & (codes[:, :-1] >= 0) & \
+            (codes[:, 1:] >= 0)
+        # guard the gathered ratio BEFORE multiplying by the mask: clipped
+        # padding positions can hit zero matrix cells, and inf * 0 = NaN
+        # would otherwise poison every short sequence's row sum
+        ratio = jnp.log(jnp.clip(m0[fr, to], 1e-12, None) /
+                        jnp.clip(m1[fr, to], 1e-12, None))
+        return jnp.where(valid, ratio, 0.0).sum(axis=1)
+
+    log_odds = np.asarray(kernel(jnp.asarray(codes), jnp.asarray(lens)))
+    pred = [class_labels[0] if lo > log_odds_threshold else class_labels[1]
+            for lo in log_odds]
+    return pred, log_odds
+
+
+# --------------------------------------------------------------------------
+# HMM
+# --------------------------------------------------------------------------
+
+@dataclass
+class HiddenMarkovModel:
+    states: List[str]
+    observations: List[str]
+    transition: np.ndarray      # (S, S) scaled row-normalized
+    emission: np.ndarray        # (S, O)
+    initial: np.ndarray         # (S,)
+    scale: int = 1000
+
+    def to_lines(self, delim: str = ",") -> List[str]:
+        """states line, observations line, S transition rows, S emission
+        rows, initial row (HiddenMarkovModelBuilder emits the three
+        matrices in cleanup :268-360)."""
+        lines = [delim.join(self.states), delim.join(self.observations)]
+        for row in self.transition:
+            lines.append(delim.join(_fmt(v) for v in row))
+        for row in self.emission:
+            lines.append(delim.join(_fmt(v) for v in row))
+        lines.append(delim.join(_fmt(v) for v in self.initial))
+        return lines
+
+    @classmethod
+    def from_lines(cls, lines: Sequence[str], delim: str = ","
+                   ) -> "HiddenMarkovModel":
+        states = lines[0].split(delim)
+        obs = lines[1].split(delim)
+        S, O = len(states), len(obs)
+        tr = np.array([[float(v) for v in lines[2 + i].split(delim)]
+                       for i in range(S)])
+        em = np.array([[float(v) for v in lines[2 + S + i].split(delim)]
+                       for i in range(S)])
+        init = np.array([float(v) for v in lines[2 + 2 * S].split(delim)])
+        return cls(states=states, observations=obs, transition=tr,
+                   emission=em, initial=init)
+
+
+def build_hmm(tagged: Sequence[Sequence[Tuple[str, str]]],
+              states: Sequence[str], observations: Sequence[str],
+              scale: int = 1000, laplace: float = 1.0) -> HiddenMarkovModel:
+    """Supervised HMM from (observation, state)-tagged sequences."""
+    sidx = {s: i for i, s in enumerate(states)}
+    oidx = {o: i for i, o in enumerate(observations)}
+    S, O = len(states), len(observations)
+    tr = np.zeros((S, S)); em = np.zeros((S, O)); init = np.zeros((S,))
+    for seq in tagged:
+        prev = None
+        for pos, (obs, st) in enumerate(seq):
+            si = sidx[st]
+            em[si, oidx[obs]] += 1
+            if pos == 0:
+                init[si] += 1
+            if prev is not None:
+                tr[prev, si] += 1
+            prev = si
+    def norm(m):
+        c = m + laplace
+        return c / c.sum(axis=-1, keepdims=True) * scale
+    return HiddenMarkovModel(states=list(states), observations=list(observations),
+                             transition=norm(tr), emission=norm(em),
+                             initial=norm(init), scale=scale)
+
+
+def viterbi_decode(model: HiddenMarkovModel,
+                   obs_sequences: Sequence[Sequence[str]]) -> List[List[str]]:
+    """Batched Viterbi (markov/ViterbiDecoder.java:31): DP as lax.scan over
+    the padded observation batch; backpointers unwound host-side."""
+    # unknown observation symbols encode to -1 (same convention as
+    # encode_sequences) and contribute a uniform (zero) emission term, so a
+    # stray symbol degrades that position instead of crashing the job
+    codes, lens = encode_sequences(obs_sequences, model.observations)
+    n, L = codes.shape
+    unknown = codes < 0
+    obs = np.clip(codes, 0, None)
+
+    log_tr = jnp.log(jnp.asarray(model.transition) + 1e-12)
+    log_em = jnp.log(jnp.asarray(model.emission) + 1e-12)
+    log_init = jnp.log(jnp.asarray(model.initial) + 1e-12)
+
+    @jax.jit
+    def kernel(obs, unknown, lens):
+        def step(carry, xs):
+            score = carry                        # (n, S)
+            ob, unk, pos = xs                    # ob (n,)
+            em = jnp.where(unk[:, None], 0.0, log_em[:, ob].T)
+            cand = score[:, :, None] + log_tr[None]          # (n, S, S)
+            best_prev = jnp.argmax(cand, axis=1)             # (n, S)
+            best = jnp.max(cand, axis=1) + em                # (n, S)
+            active = (pos < lens)[:, None]
+            new_score = jnp.where(active, best, score)
+            return new_score, best_prev
+
+        first_em = jnp.where(unknown[:, 0][:, None], 0.0,
+                             log_em[:, obs[:, 0]].T)
+        first = log_init[None] + first_em                    # (n, S)
+        xs = (obs[:, 1:].T, unknown[:, 1:].T, jnp.arange(1, obs.shape[1]))
+        final, backptr = jax.lax.scan(step, first, xs)
+        return final, backptr
+
+    final, backptr = (np.asarray(x) for x in kernel(
+        jnp.asarray(obs), jnp.asarray(unknown), jnp.asarray(lens)))
+    out: List[List[str]] = []
+    for i in range(n):
+        T = int(lens[i])
+        if T == 0:
+            out.append([])
+            continue
+        path = np.zeros((T,), dtype=np.int64)
+        # the scan's final score reflects position lens-1 for this row
+        path[T - 1] = int(np.argmax(final[i]))
+        for t in range(T - 1, 0, -1):
+            path[t - 1] = backptr[t - 1, i, path[t]]
+        out.append([model.states[s] for s in path])
+    return out

@@ -1,0 +1,127 @@
+"""Probabilistic suffix tree + GSP candidate generation + CTMC.
+
+Parity targets (SURVEY.md §2.5):
+  * ProbabilisticSuffixTreeGenerator (markov/ProbabilisticSuffixTreeGenerator
+    .java:88-295) — higher-order Markov via suffix/context counts up to a
+    max depth; conditional next-symbol distributions per context.
+  * CandidateGenerationWithSelfJoin (sequence/CandidateGenerationWithSelfJoin
+    .java) — GSP k-candidate generation: join (k-1)-frequent sequences whose
+    tail/head (k-2)-prefixes match.
+  * ContTimeStateTransitionStats (spark/.../markov/ContTimeStateTransition
+    Stats.scala:90-113) — CTMC uniformization: P(t) via the Poisson-weighted
+    power series of M = I + Q/q, here a lax.scan over matrix powers.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import defaultdict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+class ProbabilisticSuffixTree:
+    """Context -> next-symbol counts for contexts up to max_depth symbols."""
+
+    def __init__(self, max_depth: int = 3):
+        self.max_depth = max_depth
+        # context tuple (possibly empty) -> {symbol: count}
+        self.counts: Dict[Tuple[str, ...], Dict[str, int]] = defaultdict(
+            lambda: defaultdict(int))
+
+    def add_sequences(self, sequences: Sequence[Sequence[str]]) -> None:
+        for seq in sequences:
+            for i, sym in enumerate(seq):
+                for d in range(0, self.max_depth + 1):
+                    if i - d < 0:
+                        break
+                    ctx = tuple(seq[i - d:i])
+                    self.counts[ctx][sym] += 1
+
+    def prob(self, context: Sequence[str], symbol: str) -> float:
+        """P(symbol | longest known suffix of context)."""
+        ctx = tuple(context[-self.max_depth:]) if context else ()
+        while True:
+            if ctx in self.counts:
+                dist = self.counts[ctx]
+                total = sum(dist.values())
+                if total > 0:
+                    return dist.get(symbol, 0) / total
+            if not ctx:
+                return 0.0
+            ctx = ctx[1:]
+
+    def sequence_log_prob(self, seq: Sequence[str], eps: float = 1e-12) -> float:
+        lp = 0.0
+        for i, sym in enumerate(seq):
+            p = self.prob(seq[max(0, i - self.max_depth):i], sym)
+            lp += math.log(max(p, eps))
+        return lp
+
+    def to_lines(self, delim: str = ",") -> List[str]:
+        """One line per (context, symbol): 'ctx1:ctx2,symbol,count'."""
+        lines = []
+        for ctx in sorted(self.counts.keys()):
+            for sym, cnt in sorted(self.counts[ctx].items()):
+                lines.append(delim.join([":".join(ctx), sym, str(cnt)]))
+        return lines
+
+    @classmethod
+    def from_lines(cls, lines: Sequence[str], max_depth: int = 3,
+                   delim: str = ",") -> "ProbabilisticSuffixTree":
+        t = cls(max_depth)
+        for line in lines:
+            ctx_s, sym, cnt = line.split(delim)
+            ctx = tuple(ctx_s.split(":")) if ctx_s else ()
+            t.counts[ctx][sym] += int(cnt)
+        return t
+
+
+def gsp_candidates(frequent: Sequence[Sequence[str]]) -> List[List[str]]:
+    """GSP self-join: for (k-1)-sequences a, b where a[1:] == b[:-1], emit
+    a + b[-1:] (CandidateGenerationWithSelfJoin's join condition)."""
+    out: List[List[str]] = []
+    seen = set()
+    by_prefix: Dict[Tuple[str, ...], List[Sequence[str]]] = defaultdict(list)
+    for b in frequent:
+        by_prefix[tuple(b[:-1])].append(b)
+    for a in frequent:
+        for b in by_prefix.get(tuple(a[1:]), []):
+            cand = tuple(list(a) + [b[-1]])
+            if cand not in seen:
+                seen.add(cand)
+                out.append(list(cand))
+    return out
+
+
+def ctmc_transition_probabilities(rate_matrix: np.ndarray, t: float,
+                                  n_terms: int = 64) -> np.ndarray:
+    """CTMC P(t) by uniformization: q = max |Q_ii|, M = I + Q/q,
+    P(t) = sum_k e^{-qt} (qt)^k / k! * M^k — the matrix-power scan of the
+    Spark CTMC job, jitted."""
+    Q = np.asarray(rate_matrix, dtype=np.float64)
+    q = float(np.max(-np.diag(Q)))
+    if q <= 0:
+        return np.eye(Q.shape[0])
+    M = jnp.asarray(np.eye(Q.shape[0]) + Q / q, dtype=jnp.float32)
+    qt = q * t
+
+    # Poisson weights computed in log space to avoid overflow
+    ks = np.arange(n_terms)
+    log_w = -qt + ks * math.log(max(qt, 1e-300)) - \
+        np.array([math.lgamma(k + 1) for k in ks])
+    w = jnp.asarray(np.exp(log_w), dtype=jnp.float32)
+
+    @jax.jit
+    def kernel(M, w):
+        def step(carry, wk):
+            Mk = carry
+            return Mk @ M, wk * Mk
+        _, terms = jax.lax.scan(step, jnp.eye(M.shape[0], dtype=M.dtype), w)
+        return terms.sum(axis=0)
+
+    return np.asarray(kernel(M, w), dtype=np.float64)

@@ -1,0 +1,131 @@
+"""Optimize pack tests: SA/GA convergence on known optima, TaskSchedule
+domain parity pieces, CLI job with the reference's own taskSched.json shape."""
+
+import json
+import math
+import shutil
+
+import numpy as np
+import pytest
+
+from avenir_tpu.optimize.domain import MatrixCostDomain
+from avenir_tpu.optimize.annealing import AnnealingParams, simulated_annealing
+from avenir_tpu.optimize.genetic import GeneticParams, genetic_algorithm
+from avenir_tpu.optimize import task_schedule as TS
+from avenir_tpu.cli import run as cli_run
+
+
+def toy_domain(L=10, C=6, seed=0):
+    """Known optimum: per-position argmin of a random cost matrix."""
+    rng = np.random.default_rng(seed)
+    cm = rng.uniform(1, 10, (L, C))
+    return MatrixCostDomain(cost_matrix=cm), cm.min(axis=1).mean()
+
+
+def test_sa_converges_to_optimum(mesh_ctx):
+    domain, opt = toy_domain()
+    params = AnnealingParams(max_num_iterations=2000, num_optimizers=16,
+                             initial_temp=5.0, cooling_rate=0.995, seed=1)
+    res = simulated_annealing(domain, params)
+    assert res.best_costs.min() < opt + 0.3
+    assert res.counters["betterSolnCount"] > 0
+    assert res.counters["worseSolnCount"] > 0
+    assert res.estimated_initial_temp > 0
+
+
+def test_sa_with_start_solutions(mesh_ctx):
+    domain, opt = toy_domain()
+    starts = domain.initial_solutions(np.random.default_rng(0), 4)
+    res = simulated_annealing(domain, AnnealingParams(
+        max_num_iterations=500, num_optimizers=4, seed=2),
+        start_solutions=starts)
+    assert res.best_solutions.shape == (4, 10)
+
+
+def test_sa_local_descent(mesh_ctx):
+    domain, opt = toy_domain()
+    p = AnnealingParams(max_num_iterations=300, num_optimizers=8,
+                        locally_optimize=True, max_num_local_iterations=200,
+                        seed=3)
+    res = simulated_annealing(domain, p)
+    assert res.best_costs.min() < opt + 0.5
+
+
+def test_ga_converges(mesh_ctx):
+    domain, opt = toy_domain(seed=4)
+    params = GeneticParams(num_generations=150, population_size=32,
+                           num_islands=4, seed=4)
+    res = genetic_algorithm(domain, params)
+    assert res.best_cost < opt + 0.3
+    assert res.island_best.shape == (4, 10)
+
+
+def test_invalid_solution_cost_replaces():
+    cm = np.ones((3, 2))
+    conflict = np.zeros((3, 3))
+    conflict[0, 1] = conflict[1, 0] = 1.0
+    d = MatrixCostDomain(cost_matrix=cm, conflict=conflict,
+                         conflict_penalty=150.0)
+    import jax.numpy as jnp
+    sols = jnp.asarray([[0, 0, 1],    # tasks 0,1 share employee 0 -> invalid
+                        [0, 1, 1]])   # valid
+    costs = np.asarray(d.cost_batch(sols))
+    assert costs[0] == 150.0
+    assert abs(costs[1] - 1.0) < 1e-6
+
+
+def test_geo_distance():
+    # NYC to Boston ~ 190 miles
+    d = TS.geo_distance(40.7128, -74.0060, 42.3601, -71.0589)
+    assert 180 < d < 200
+
+
+def test_task_schedule_from_reference_json(tmp_path):
+    """Load the reference's own taskSched.json (trailing commas included)."""
+    src = "/root/reference/resource/taskSched.json"
+    domain = TS.TaskScheduleDomain.load(src)
+    assert domain.n_components == len(domain.task_ids) > 0
+    assert domain.n_choices == len(domain.employee_ids) > 0
+    # cost matrix sane: all finite, skill+travel+hotel+perdiem avg in scale
+    assert np.isfinite(domain.cost_matrix).all()
+    assert domain.cost_matrix.min() >= 0
+    # component round trip in reference format
+    sol = domain.initial_solutions(np.random.default_rng(0), 1)[0]
+    s = domain.to_string(sol)
+    assert ":" in s and ";" in s
+    np.testing.assert_array_equal(domain.from_string(s), sol)
+
+
+def test_sa_cli_job_with_reference_conf(tmp_path):
+    """Drive the simulatedAnnealing job exactly like opt.sh: HOCON conf +
+    output path, using the reference taskSched.json."""
+    conf = tmp_path / "opt.conf"
+    conf.write_text(
+        'simulatedAnnealing {\n'
+        '  field.delim.out = ","\n'
+        '  max.num.iterations = 400\n'
+        '  num.optimizers = 8\n'
+        '  max.step.size = 1\n'
+        '  initial.temp = 30.0\n'
+        '  cooling.rate.value = 0.97\n'
+        '  cooling.rate.geometric = true\n'
+        '  temp.update.interval = 2\n'
+        '  domain.callback.class.name = "org.avenir.examples.TaskScheduleSearch"\n'
+        f'  domain.callback.config.file = '
+        f'"/root/reference/resource/taskSched.json"\n'
+        '  locally.optimize = false\n'
+        '}\n')
+    rc = cli_run.main(["simulatedAnnealing", str(tmp_path / "out"), str(conf)])
+    assert rc == 0
+    lines = (tmp_path / "out" / "part-r-00000").read_text().splitlines()
+    assert len(lines) == 8
+    best = float(lines[0].rsplit(",", 1)[1])
+    worst = float(lines[-1].rsplit(",", 1)[1])
+    assert best <= worst
+    domain = TS.TaskScheduleDomain.load("/root/reference/resource/taskSched.json")
+    # a random solution baseline: SA best should beat the random average
+    rng = np.random.default_rng(9)
+    import jax.numpy as jnp
+    rand = domain.initial_solutions(rng, 64)
+    rand_costs = np.asarray(domain.cost_batch(jnp.asarray(rand)))
+    assert best < np.mean(rand_costs)

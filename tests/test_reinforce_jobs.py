@@ -1,0 +1,71 @@
+"""CLI test: iterative bandit batch job with state rotation (the Spark
+MultiArmBandit save/reload cycle)."""
+
+import os
+
+import numpy as np
+
+from avenir_tpu.cli import run as cli_run
+
+
+def test_multi_arm_bandit_iterations(tmp_path):
+    props = tmp_path / "mab.properties"
+    props.write_text(
+        "mab.action.list=x,y,z\n"
+        "mab.algorithm=randomGreedy\n"
+        "mab.random.selection.prob=0.3\n"
+        "mab.decision.batch.size=4\n"
+        "mab.random.seed=11\n"
+        f"mab.model.state.file.in={tmp_path}/state_in\n"
+        f"mab.model.state.file.out={tmp_path}/state_out\n"
+        "mab.group.list=g1,g2\n")
+    rng = np.random.default_rng(4)
+    best = {"g1": "z", "g2": "x"}
+    rewards_dir = tmp_path / "rewards"
+    rewards_dir.mkdir()
+    (rewards_dir / "part-r-00000").write_text("")  # first round: no feedback
+
+    for it in range(12):
+        rc = cli_run.main(["multiArmBandit", f"-Dconf.path={props}",
+                           str(rewards_dir), str(tmp_path / "decisions")])
+        assert rc == 0
+        decisions = (tmp_path / "decisions" / "part-r-00000"
+                     ).read_text().splitlines()
+        # simulate rewards for chosen actions
+        lines = []
+        for d in decisions:
+            parts = d.split(",")
+            g, acts = parts[0], parts[1:]
+            for a in acts:
+                r = 0.9 if a == best[g] else 0.1
+                lines.append(f"{g},{a},{r + rng.normal(0, 0.05):.4f}")
+        (rewards_dir / "part-r-00000").write_text("\n".join(lines))
+        # rotate state
+        os.replace(tmp_path / "state_out" / "part-r-00000",
+                   tmp_path / "state_in")
+
+    # after iterations the state should prefer the best arms
+    state = (tmp_path / "state_in").read_text().splitlines()
+    means = {}
+    for l in state:
+        if ",#" in l or l.split(",")[1].startswith("#"):
+            continue
+        g, a, c, t, tsq = l.split(",")
+        if int(c) > 0:
+            means.setdefault(g, {})[a] = float(t) / int(c)
+    assert max(means["g1"], key=means["g1"].get) == "z"
+    assert max(means["g2"], key=means["g2"].get) == "x"
+
+
+def test_named_bandit_jobs(tmp_path):
+    props = tmp_path / "p.properties"
+    props.write_text("mab.action.list=a,b\nmab.group.list=g\n"
+                     "mab.random.seed=1\n")
+    for job in ("greedyRandomBandit", "softMaxBandit", "auerDeterministic",
+                "randomFirstGreedyBandit"):
+        out = tmp_path / job
+        rc = cli_run.main([job, f"-Dconf.path={props}",
+                           str(tmp_path / "nonexistent"), str(out)])
+        assert rc == 0
+        lines = (out / "part-r-00000").read_text().splitlines()
+        assert len(lines) == 1 and lines[0].startswith("g,")

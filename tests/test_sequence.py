@@ -1,0 +1,193 @@
+"""Sequence pack tests: Markov counting/classify vs oracle, model round trip,
+HMM + Viterbi vs brute force, PST, GSP, CTMC vs expm."""
+
+import itertools
+import math
+
+import numpy as np
+import pytest
+
+from avenir_tpu.sequence import markov as MK
+from avenir_tpu.sequence import pst as PS
+
+
+STATES = ["S", "M", "L"]
+
+
+def gen_sequences(rng, n, trans, length=12):
+    out = []
+    S = len(STATES)
+    for _ in range(n):
+        seq = [int(rng.integers(0, S))]
+        for _ in range(length - 1):
+            seq.append(int(rng.choice(S, p=trans[seq[-1]])))
+        out.append([STATES[s] for s in seq])
+    return out
+
+
+def test_count_transitions_oracle():
+    seqs = [["S", "M", "L", "M"], ["M", "M"]]
+    codes, lens = MK.encode_sequences(seqs, STATES)
+    counts = MK.count_transitions(codes, lens, 3)
+    assert counts.shape == (1, 3, 3)
+    assert counts[0, 0, 1] == 1  # S->M
+    assert counts[0, 1, 2] == 1  # M->L
+    assert counts[0, 2, 1] == 1  # L->M
+    assert counts[0, 1, 1] == 1  # M->M
+    assert counts.sum() == 4
+
+
+def test_model_roundtrip_single():
+    seqs = [["S", "M", "L"], ["L", "M", "S"]]
+    m = MK.build_model(seqs, STATES)
+    lines = m.to_lines()
+    assert lines[0] == "S,M,L"
+    m2 = MK.MarkovModel.from_lines(lines, class_based=False)
+    np.testing.assert_allclose(m2.matrices[None], m.matrices[None], atol=0.002)
+
+
+def test_class_based_model_and_classifier():
+    rng = np.random.default_rng(0)
+    # class A: sticky chain; class B: anti-sticky
+    tA = np.array([[.8, .1, .1], [.1, .8, .1], [.1, .1, .8]])
+    tB = np.array([[.1, .45, .45], [.45, .1, .45], [.45, .45, .1]])
+    seqA = gen_sequences(rng, 60, tA)
+    seqB = gen_sequences(rng, 60, tB)
+    m = MK.build_model(seqA + seqB, STATES,
+                       labels=["A"] * 60 + ["B"] * 60, class_labels=["A", "B"])
+    lines = m.to_lines()
+    assert any(l.startswith("classLabel:A") for l in lines)
+    m2 = MK.MarkovModel.from_lines(lines, class_based=True)
+    pred, lo = MK.classify(m2, seqA[:20] + seqB[:20], ["A", "B"])
+    acc = np.mean([p == a for p, a in
+                   zip(pred, ["A"] * 20 + ["B"] * 20)])
+    assert acc > 0.9
+    # oracle: recompute log odds for one sequence by hand
+    seq = seqA[0]
+    expect = sum(math.log(m2.prob("A", seq[i - 1], seq[i]) /
+                          m2.prob("B", seq[i - 1], seq[i]))
+                 for i in range(1, len(seq)))
+    assert abs(lo[0] - expect) < 1e-3
+
+
+def brute_force_viterbi(model, obs):
+    oidx = {o: i for i, o in enumerate(model.observations)}
+    S = len(model.states)
+    best, best_p = None, -np.inf
+    for path in itertools.product(range(S), repeat=len(obs)):
+        p = math.log(model.initial[path[0]] + 1e-12) + \
+            math.log(model.emission[path[0], oidx[obs[0]]] + 1e-12)
+        for t in range(1, len(obs)):
+            p += math.log(model.transition[path[t - 1], path[t]] + 1e-12)
+            p += math.log(model.emission[path[t], oidx[obs[t]]] + 1e-12)
+        if p > best_p:
+            best, best_p = path, p
+    return [model.states[s] for s in best]
+
+
+def test_hmm_build_and_viterbi_vs_bruteforce():
+    states = ["H", "C"]
+    obs_syms = ["1", "2", "3"]
+    rng = np.random.default_rng(2)
+    # hot emits high numbers, cold low; sticky states
+    tagged = []
+    for _ in range(200):
+        seq = []
+        st = rng.integers(0, 2)
+        for _ in range(10):
+            if rng.random() > 0.8:
+                st = 1 - st
+            if st == 0:
+                ob = str(1 + rng.choice(3, p=[.1, .3, .6]))
+            else:
+                ob = str(1 + rng.choice(3, p=[.6, .3, .1]))
+            seq.append((ob, states[st]))
+        tagged.append(seq)
+    hmm = MK.build_hmm(tagged, states, obs_syms)
+    # round trip
+    hmm2 = MK.HiddenMarkovModel.from_lines(hmm.to_lines())
+    np.testing.assert_allclose(hmm2.transition, hmm.transition, atol=0.002)
+    # viterbi vs brute force on short sequences
+    tests = [["3", "3", "2", "1"], ["1", "1", "3"], ["2"],
+             ["1", "3", "1", "3", "2"]]
+    got = MK.viterbi_decode(hmm2, tests)
+    for seq, g in zip(tests, got):
+        assert g == brute_force_viterbi(hmm2, seq), seq
+
+
+def test_viterbi_ragged_batch():
+    states = ["A", "B"]
+    hmm = MK.HiddenMarkovModel(
+        states=states, observations=["x", "y"],
+        transition=np.array([[800., 200.], [200., 800.]]),
+        emission=np.array([[950., 50.], [50., 950.]]),
+        initial=np.array([500., 500.]))
+    out = MK.viterbi_decode(hmm, [["x", "x", "y"], ["y"], []])
+    assert out[0] == ["A", "A", "B"]
+    assert out[1] == ["B"]
+    assert out[2] == []
+
+
+def test_viterbi_unknown_observation():
+    hmm = MK.HiddenMarkovModel(
+        states=["A", "B"], observations=["x", "y"],
+        transition=np.array([[800., 200.], [200., 800.]]),
+        emission=np.array([[950., 50.], [50., 950.]]),
+        initial=np.array([500., 500.]))
+    # '?' is not in the model: must not crash; neighbors drive that position
+    out = MK.viterbi_decode(hmm, [["x", "?", "x"]])
+    assert out[0] == ["A", "A", "A"]
+
+
+def test_classify_no_nan_with_zero_cells_and_short_sequences():
+    """Scaled-int reference models contain zeros; padded short sequences must
+    not produce NaN log odds (regression)."""
+    m = MK.MarkovModel(states=STATES, matrices={
+        "A": np.array([[0.0, 500., 500.], [250., 500., 250.],
+                       [100., 100., 800.]]),
+        "B": np.array([[0.0, 800., 200.], [800., 100., 100.],
+                       [300., 300., 400.]])})
+    pred, lo = MK.classify(m, [["S", "M"], ["S", "M", "L", "L", "L"]],
+                           ["A", "B"])
+    assert np.isfinite(lo).all()
+
+
+def test_pst_probabilities():
+    t = PS.ProbabilisticSuffixTree(max_depth=2)
+    t.add_sequences([["a", "b", "a", "b", "a", "c"]])
+    # after context (a,) : b twice, c once
+    assert abs(t.prob(["a"], "b") - 2 / 3) < 1e-9
+    # context (b,) -> always a
+    assert t.prob(["b"], "a") == 1.0
+    # unseen context falls back to shorter suffix
+    assert t.prob(["z"], "a") == t.prob([], "a")
+    lines = t.to_lines()
+    t2 = PS.ProbabilisticSuffixTree.from_lines(lines, max_depth=2)
+    assert abs(t2.prob(["a"], "b") - 2 / 3) < 1e-9
+
+
+def test_gsp_candidates():
+    freq = [["a", "b"], ["b", "c"], ["b", "d"], ["c", "a"]]
+    cands = PS.gsp_candidates(freq)
+    assert ["a", "b", "c"] in cands
+    assert ["a", "b", "d"] in cands
+    assert ["b", "c", "a"] in cands
+    assert ["c", "a", "b"] in cands
+    # no join when tails don't match heads
+    assert ["b", "d", "x"] not in cands
+
+
+def test_ctmc_vs_expm():
+    Q = np.array([[-0.3, 0.2, 0.1],
+                  [0.1, -0.4, 0.3],
+                  [0.2, 0.2, -0.4]])
+    P = PS.ctmc_transition_probabilities(Q, t=1.5)
+    # oracle: scipy-free expm via dense series on Q*t (small matrix)
+    A = Q * 1.5
+    E = np.eye(3)
+    term = np.eye(3)
+    for k in range(1, 40):
+        term = term @ A / k
+        E = E + term
+    np.testing.assert_allclose(P, E, atol=1e-4)
+    np.testing.assert_allclose(P.sum(axis=1), np.ones(3), atol=1e-4)

@@ -1,0 +1,105 @@
+"""CLI tests for the sequence-pack jobs: full train->classify and
+HMM->viterbi pipelines."""
+
+import numpy as np
+
+from avenir_tpu.cli import run as cli_run
+
+
+def test_markov_train_classify_pipeline(tmp_path):
+    rng = np.random.default_rng(5)
+    states = ["S", "M", "L"]
+    tA = np.array([[.8, .1, .1], [.1, .8, .1], [.1, .1, .8]])
+    tB = np.array([[.1, .45, .45], [.45, .1, .45], [.45, .45, .1]])
+
+    def seq(t):
+        s = [int(rng.integers(0, 3))]
+        for _ in range(11):
+            s.append(int(rng.choice(3, p=t[s[-1]])))
+        return [states[i] for i in s]
+
+    train_lines, test_lines = [], []
+    for i in range(80):
+        lab = "A" if i % 2 == 0 else "B"
+        t = tA if lab == "A" else tB
+        train_lines.append(f"c{i},{lab}," + ",".join(seq(t)))
+    for i in range(40):
+        lab = "A" if i % 2 == 0 else "B"
+        t = tA if lab == "A" else tB
+        test_lines.append(f"v{i},{lab}," + ",".join(seq(t)))
+    (tmp_path / "train.csv").write_text("\n".join(train_lines))
+    (tmp_path / "test.csv").write_text("\n".join(test_lines))
+    props = tmp_path / "mk.properties"
+    props.write_text(
+        "mst.skip.field.count=1\n"
+        "mst.class.label.field.ord=1\n"
+        "mst.model.states=S,M,L\n"
+        "mmc.skip.field.count=1\n"
+        "mmc.validation.mode=true\n"
+        "mmc.class.label.field.ord=1\n"
+        "mmc.class.labels=A,B\n"
+        f"mmc.mm.model.path={tmp_path}/model\n")
+    rc = cli_run.main(["markovStateTransitionModel", f"-Dconf.path={props}",
+                       str(tmp_path / "train.csv"), str(tmp_path / "model")])
+    assert rc == 0
+    model_lines = (tmp_path / "model" / "part-r-00000").read_text().splitlines()
+    assert model_lines[0] == "S,M,L"
+    assert "classLabel:A" in model_lines
+    rc = cli_run.main(["markovModelClassifier", f"-Dconf.path={props}",
+                       str(tmp_path / "test.csv"), str(tmp_path / "pred")])
+    assert rc == 0
+    lines = (tmp_path / "pred" / "part-m-00000").read_text().splitlines()
+    assert len(lines) == 40
+    acc = np.mean([l.split(",")[2] == l.split(",")[1] for l in lines])
+    assert acc > 0.85
+
+
+def test_hmm_viterbi_pipeline(tmp_path):
+    rng = np.random.default_rng(7)
+    # tagged training data: obs,state pairs
+    lines = []
+    for i in range(150):
+        pairs = []
+        st = rng.integers(0, 2)
+        for _ in range(8):
+            if rng.random() > 0.8:
+                st = 1 - st
+            ob = str(1 + rng.choice(3, p=[.1, .2, .7] if st == 0 else [.7, .2, .1]))
+            pairs += [ob, "H" if st == 0 else "C"]
+        lines.append(f"t{i}," + ",".join(pairs))
+    (tmp_path / "tagged.csv").write_text("\n".join(lines))
+    props = tmp_path / "hmm.properties"
+    props.write_text(
+        "hmmb.skip.field.count=1\n"
+        "hmmb.model.states=H,C\n"
+        "hmmb.model.observations=1,2,3\n"
+        "vsp.skip.field.count=1\n"
+        f"vsp.hmm.model.path={tmp_path}/hmm\n")
+    rc = cli_run.main(["hiddenMarkovModelBuilder", f"-Dconf.path={props}",
+                       str(tmp_path / "tagged.csv"), str(tmp_path / "hmm")])
+    assert rc == 0
+    (tmp_path / "obs.csv").write_text("o1,3,3,3,1,1\no2,1,1,2\n")
+    rc = cli_run.main(["viterbiStatePredictor", f"-Dconf.path={props}",
+                       str(tmp_path / "obs.csv"), str(tmp_path / "decoded")])
+    assert rc == 0
+    out = (tmp_path / "decoded" / "part-m-00000").read_text().splitlines()
+    d1 = out[0].split(",")
+    assert d1[0] == "o1" and d1[1:4] == ["H", "H", "H"] and d1[4:6] == ["C", "C"]
+
+
+def test_pst_and_gsp_jobs(tmp_path):
+    (tmp_path / "seq.csv").write_text("s1,a,b,a,b,a,c\ns2,b,a,b,a\n")
+    props = tmp_path / "p.properties"
+    props.write_text("pstg.skip.field.count=1\npstg.max.depth=2\n")
+    rc = cli_run.main(["probabilisticSuffixTreeGenerator", f"-Dconf.path={props}",
+                       str(tmp_path / "seq.csv"), str(tmp_path / "pst")])
+    assert rc == 0
+    pst_lines = (tmp_path / "pst" / "part-r-00000").read_text().splitlines()
+    assert any(l.startswith("a:b,") for l in pst_lines)
+
+    (tmp_path / "freq.csv").write_text("a,b\nb,c\nc,a\n")
+    rc = cli_run.main(["candidateGenerationWithSelfJoin", f"-Dconf.path={props}",
+                       str(tmp_path / "freq.csv"), str(tmp_path / "cand")])
+    assert rc == 0
+    cands = (tmp_path / "cand" / "part-r-00000").read_text().splitlines()
+    assert "a,b,c" in cands and "b,c,a" in cands and "c,a,b" in cands
