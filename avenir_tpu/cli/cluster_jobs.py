@@ -1,0 +1,84 @@
+"""Cluster-pack job registrations (org.avenir.cluster.*).
+
+Config-key namespaces follow the reference setup() methods:
+kmc.* (cluster/KmeansCluster.java:104-127, including the reference's
+``kmc.attr.odinals`` typo) and agg.* (cluster/AgglomerativeGraphical.java:39-46).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..core.config import Config
+from ..core.metrics import Counters
+from ..core import artifacts
+from ..core.table import load_csv
+from .jobs import register, _schema_path, _splitter
+
+
+@register("org.avenir.cluster.KmeansCluster", "kmeansCluster")
+def kmeans_cluster(cfg: Config, in_path: str, out_path: str) -> Counters:
+    """One Lloyd iteration over every active cluster group (one reference MR
+    pass, cluster/KmeansCluster.java).  Keys: kmc.schema.file.path,
+    kmc.attr.odinals, kmc.movement.threshold, kmc.cluster.file.path,
+    kmc.num.iterations (extension: loop in-process instead of re-running the
+    job; default 1 = reference behavior), nads.output.precision."""
+    from ..cluster import kmeans as KM
+    counters = Counters()
+    schema = _schema_path(cfg, "kmc.schema.file.path")
+    ordinals = cfg.get_int_list("kmc.attr.odinals",
+                                cfg.get_int_list("kmc.attr.ordinals"))
+    if not ordinals:
+        raise ValueError("missing attribute ordinals (kmc.attr.odinals)")
+    threshold = cfg.must_get_float("kmc.movement.threshold",
+                                   "missing movement threshold")
+    precision = cfg.get_int("nads.output.precision", 3)
+    iters = cfg.get_int("kmc.num.iterations", 1)
+    table = load_csv(in_path, schema, cfg.field_delim_regex)
+    lines = artifacts.read_text_input(cfg.must_get("kmc.cluster.file.path",
+                                                   "missing cluster file"))
+    engine = KM.KMeansEngine(schema, ordinals,
+                             cfg.get("kmc.distance.metric", "euclidean"))
+    groups = KM.parse_cluster_lines(lines, schema.num_columns, threshold,
+                                    cfg.field_delim_out)
+    for _ in range(max(iters, 1)):
+        if not any(g.active for g in groups):
+            break
+        KM.kmeans_one_pass(table, groups, engine, precision)
+        counters.increment("Clustering", "iterations")
+    out_lines = KM.format_cluster_lines(groups, cfg.field_delim_out, precision)
+    artifacts.write_text_output(out_path, out_lines)
+    for g in groups:
+        counters.increment("Clustering", "activeGroups", int(g.active))
+    return counters
+
+
+@register("org.avenir.cluster.AgglomerativeGraphical", "agglomerativeGraphical")
+def agglomerative_graphical(cfg: Config, in_path: str, out_path: str
+                            ) -> Counters:
+    """Greedy edge-weighted agglomerative pass
+    (cluster/AgglomerativeGraphical.java).  Keys:
+    agg.min.av.edge.weight.threshold, agg.map.file.dir.path (distance-store
+    lines; MapFile equivalent), agg.dist.scale (set when the store holds
+    distances rather than similarities)."""
+    from ..cluster import agglomerative as AG
+    counters = Counters()
+    threshold = cfg.must_get_float("agg.min.av.edge.weight.threshold",
+                                   "missing min average edge weight")
+    store = AG.EntityDistanceStore.from_lines(
+        artifacts.read_text_input(cfg.must_get("agg.map.file.dir.path",
+                                               "missing distance map file")),
+        cfg.field_delim_out)
+    dist_scale = cfg.get_float("agg.dist.scale")
+    split = _splitter(cfg.field_delim_regex)
+    entity_ids: List[str] = []
+    for line in artifacts.read_text_input(in_path):
+        line = line.strip()
+        if line:
+            entity_ids.append(split(line)[0])
+    clusters = AG.agglomerative_cluster(entity_ids, store, threshold,
+                                        dist_scale)
+    artifacts.write_text_output(
+        out_path, [c.to_line(cfg.field_delim_out) for c in clusters])
+    counters.increment("Clustering", "clusters", len(clusters))
+    return counters

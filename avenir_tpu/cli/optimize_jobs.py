@@ -38,6 +38,20 @@ def load_domain(class_name: str, config_file: str):
     return getattr(mod, cls_name).load(config_file)
 
 
+def _parse_start(domain, line: str, od: str) -> np.ndarray:
+    """Parse a starting solution; tolerates re-ingesting our own output lines,
+    which append ``<od><cost>`` to the solution string (the reference's
+    iterate-on-prior-solutions workflow feeds output back as input)."""
+    line = line.strip()
+    try:
+        return domain.from_string(line)
+    except (ValueError, IndexError):
+        head, sep, _ = line.rpartition(od)
+        if not sep:
+            raise
+        return domain.from_string(head)
+
+
 @register("org.avenir.spark.optimize.SimulatedAnnealing", "simulatedAnnealing")
 def simulated_annealing_job(cfg: Config, in_path: str, out_path: str) -> Counters:
     """SA over the configured domain (opt.conf keys; SURVEY.md §3.3).
@@ -63,7 +77,8 @@ def simulated_annealing_job(cfg: Config, in_path: str, out_path: str) -> Counter
     if in_path and os.path.exists(in_path):
         lines = artifacts.read_text_input(in_path)
         if lines:
-            starts = np.stack([domain.from_string(l) for l in lines])
+            od = cfg.field_delim_out
+            starts = np.stack([_parse_start(domain, l, od) for l in lines])
             params.num_optimizers = len(lines)
     res = simulated_annealing(domain, params, start_solutions=starts)
     od = cfg.field_delim_out

@@ -25,6 +25,7 @@ from . import explore_jobs  # noqa: F401  (registers explore-pack jobs)
 from . import sequence_jobs  # noqa: F401  (registers sequence-pack jobs)
 from . import optimize_jobs  # noqa: F401  (registers optimize-pack jobs)
 from . import reinforce_jobs  # noqa: F401  (registers reinforce-pack jobs)
+from . import cluster_jobs  # noqa: F401  (registers cluster-pack jobs)
 
 
 def parse_args(argv: List[str]):

@@ -1,0 +1,342 @@
+"""K-means clustering over mixed-type records, multiple cluster groups in
+parallel.
+
+Reference: cluster/KmeansCluster.java — one MR pass per Lloyd iteration; the
+mapper assigns every record to the nearest centroid of every *active* cluster
+group via chombo ``InterRecordDistance`` (mixed numeric/categorical distance,
+cluster/KmeansCluster.java:116,162), the reducer recomputes each centroid
+(numeric attrs -> mean, categorical attrs -> histogram mode,
+cluster/KmeansCluster.java:262-282) and emits
+``group,centroid...,movement,status,avError,count`` (:284-294).  Cluster-file
+state between iterations is the checkpoint (``ClusterGroup`` re-reads it and
+marks clusters stopped once movement < threshold, cluster/ClusterGroup.java:17-29).
+
+TPU design: one jitted pass per iteration.  Rows are encoded once into a
+range-normalized numeric matrix + categorical code matrix; per group the
+(n, K) distance matrix is one broadcastified reduction, assignment is argmin,
+and the centroid update is two one-hot contractions (counts/sums on the MXU):
+``assign_onehot.T @ num_values`` for numeric means and
+``assign_onehot.T @ cat_onehot`` for per-attribute histograms whose argmax is
+the mode.  Groups are stacked and vmapped so many cluster groups (the
+reference's parallelism axis) run in one program; rows shard over the mesh
+with a ``psum`` over per-shard partial sums.
+
+Note: the reference reducer divides numeric sums by ``count`` accumulated per
+*field* (cluster/KmeansCluster.java:244-258 increments once per field per
+record), an off-by-recSize bug; we implement the intended per-record mean.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field, replace
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.schema import FeatureSchema
+from ..core.table import ColumnarTable
+from ..core.artifacts import ArtifactStore
+
+NULL = "null"
+STATUS_ACTIVE = "active"
+STATUS_STOPPED = "stopped"
+
+
+# ---------------------------------------------------------------------------
+# host-side state: cluster file round-trip (the checkpoint contract)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Cluster:
+    """One centroid: full record-width string items (non-facet attrs NULL)."""
+    items: List[str]
+    movement: float
+    status: str
+    av_error: float = 0.0
+    count: int = 0
+
+
+@dataclass
+class ClusterGroup:
+    """Reference cluster/ClusterGroup.java: clusters become stopped when their
+    movement drops below the threshold; the group is active while any cluster
+    still is."""
+    name: str
+    clusters: List[Cluster]
+    movement_threshold: float
+
+    def apply_threshold(self) -> None:
+        for c in self.clusters:
+            if c.movement < self.movement_threshold:
+                c.status = STATUS_STOPPED
+
+    @property
+    def active(self) -> bool:
+        return any(c.status == STATUS_ACTIVE for c in self.clusters)
+
+
+def parse_cluster_lines(lines: Sequence[str], num_attributes: int,
+                        movement_threshold: float, delim: str = ","
+                        ) -> List[ClusterGroup]:
+    """Parse ``group,<numAttributes centroid items>,movement,status[,avError,count]``
+    (format of cluster/KmeansCluster.java:123-144 in, :284-294 out)."""
+    groups: Dict[str, ClusterGroup] = {}
+    order: List[str] = []
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        parts = line.split(delim)
+        name = parts[0]
+        items = parts[1:1 + num_attributes]
+        rest = parts[1 + num_attributes:]
+        movement = float(rest[0]) if rest else float("inf")
+        status = rest[1] if len(rest) > 1 else STATUS_ACTIVE
+        av_error = float(rest[2]) if len(rest) > 2 else 0.0
+        count = int(rest[3]) if len(rest) > 3 else 0
+        if name not in groups:
+            groups[name] = ClusterGroup(name, [], movement_threshold)
+            order.append(name)
+        groups[name].clusters.append(Cluster(items, movement, status,
+                                             av_error, count))
+    out = [groups[n] for n in order]
+    for g in out:
+        g.apply_threshold()
+    return out
+
+
+def format_cluster_lines(groups: Sequence[ClusterGroup], delim: str = ",",
+                         precision: int = 3) -> List[str]:
+    lines = []
+    for g in groups:
+        for c in g.clusters:
+            lines.append(delim.join(
+                [g.name] + list(c.items) +
+                [f"{c.movement:.{precision}f}", c.status,
+                 f"{c.av_error:.{precision}f}", str(c.count)]))
+    return lines
+
+
+# ---------------------------------------------------------------------------
+# the jitted Lloyd iteration
+# ---------------------------------------------------------------------------
+
+class KMeansEngine:
+    """Mixed-type Lloyd's updates for stacked cluster groups.
+
+    Distance semantics follow ops.distance.DistanceComputer (chombo
+    InterRecordDistance): numeric attrs contribute ((a-b)/range)^2, categorical
+    attrs 0/1 mismatch; record distance = sqrt(mean over facet attrs).
+    """
+
+    def __init__(self, schema: FeatureSchema, attr_ordinals: Sequence[int],
+                 metric: str = "euclidean"):
+        self.schema = schema
+        self.attr_ordinals = list(attr_ordinals)
+        fields = [schema.find_field_by_ordinal(o) for o in self.attr_ordinals]
+        self.num_fields = [f for f in fields if f.is_numeric]
+        self.cat_fields = [f for f in fields if f.is_categorical]
+        bad = [f.ordinal for f in fields
+               if not (f.is_numeric or f.is_categorical)]
+        if bad:
+            raise ValueError(f"only numeric/categorical attrs allowed, got "
+                             f"ordinals {bad}")
+        self.n_attrs = len(self.num_fields) + len(self.cat_fields)
+        self.metric = metric
+        self.ranges = np.array(
+            [max(float(f.max) - float(f.min), 1e-12)
+             if f.max is not None and f.min is not None else 1.0
+             for f in self.num_fields], dtype=np.float32)
+        self.cards = [len(f.cardinality or []) for f in self.cat_fields]
+        self._iterate = jax.jit(self._iterate_impl)
+
+    # ---- encoding -------------------------------------------------------
+    def encode_table(self, table: ColumnarTable) -> Tuple[np.ndarray, np.ndarray]:
+        n = table.n_rows
+        num = (np.stack([table.columns[f.ordinal] for f in self.num_fields],
+                        axis=1).astype(np.float32)
+               if self.num_fields else np.zeros((n, 0), np.float32))
+        cat = (np.stack([table.columns[f.ordinal] for f in self.cat_fields],
+                        axis=1).astype(np.int32)
+               if self.cat_fields else np.zeros((n, 0), np.int32))
+        return num, cat
+
+    def encode_groups(self, groups: Sequence[ClusterGroup]
+                      ) -> Dict[str, np.ndarray]:
+        G = len(groups)
+        K = max((len(g.clusters) for g in groups), default=1)
+        cent_num = np.zeros((G, K, len(self.num_fields)), np.float32)
+        cent_cat = np.zeros((G, K, len(self.cat_fields)), np.int32)
+        valid = np.zeros((G, K), bool)
+        for gi, g in enumerate(groups):
+            for ki, c in enumerate(g.clusters):
+                valid[gi, ki] = True
+                for fi, f in enumerate(self.num_fields):
+                    cent_num[gi, ki, fi] = float(c.items[f.ordinal])
+                for fi, f in enumerate(self.cat_fields):
+                    cent_cat[gi, ki, fi] = f.cat_code(c.items[f.ordinal])
+        return {"cent_num": cent_num, "cent_cat": cent_cat, "valid": valid}
+
+    # ---- kernel ---------------------------------------------------------
+    def _distances(self, num, cat, cent_num, cent_cat, valid):
+        """num (n,Fn) raw, cat (n,Fc) codes; centroids (K,Fn)/(K,Fc).
+        Returns (n,K) distances with invalid clusters at +inf."""
+        ranges = jnp.asarray(self.ranges)
+        nn = num / ranges if self.num_fields else num
+        cn = cent_num / ranges if self.num_fields else cent_num
+        sq = ((nn[:, None, :] - cn[None, :, :]) ** 2).sum(-1)      # (n,K)
+        mismatch = (cat[:, None, :] != cent_cat[None, :, :]).sum(-1)
+        total = sq + mismatch.astype(jnp.float32)
+        mean = total / max(self.n_attrs, 1)
+        d = jnp.sqrt(jnp.maximum(mean, 0.0))
+        return jnp.where(valid[None, :], d, jnp.inf)
+
+    def _iterate_impl(self, num, cat, row_valid, cent_num, cent_cat, valid):
+        """One Lloyd update for one group; vmapped over the group axis by
+        iterate().  Returns new centroids + movement + per-cluster stats."""
+        d = self._distances(num, cat, cent_num, cent_cat, valid)   # (n,K)
+        assign = jnp.argmin(d, axis=1)
+        K = cent_num.shape[0]
+        onehot = jax.nn.one_hot(assign, K, dtype=jnp.float32)
+        onehot = onehot * row_valid[:, None]
+        counts = onehot.sum(0)                                     # (K,)
+        safe = jnp.maximum(counts, 1.0)
+        new_num = (onehot.T @ num) / safe[:, None]                 # (K,Fn)
+        # categorical mode per attribute: histogram via one-hot contraction
+        new_cat_cols = []
+        for fi, card in enumerate(self.cards):
+            codes_oh = jax.nn.one_hot(cat[:, fi], card, dtype=jnp.float32)
+            hist = onehot.T @ codes_oh                             # (K,card)
+            new_cat_cols.append(jnp.argmax(hist, axis=1).astype(jnp.int32))
+        new_cat = (jnp.stack(new_cat_cols, axis=1) if new_cat_cols
+                   else jnp.zeros_like(cent_cat))
+        # empty clusters keep their old centroid
+        empty = counts < 0.5
+        new_num = jnp.where(empty[:, None], cent_num, new_num)
+        new_cat = jnp.where(empty[:, None], cent_cat, new_cat)
+        # per-cluster mean squared distance (avError of the reference)
+        dmin = jnp.min(jnp.where(valid[None, :], d, jnp.inf), axis=1)
+        sum_sq = onehot.T @ (dmin * dmin * row_valid)
+        av_error = sum_sq / safe
+        # movement = distance(old centroid, new centroid), same semantics
+        ranges = jnp.asarray(self.ranges)
+        mv_sq = (((cent_num - new_num) / ranges) ** 2).sum(-1) \
+            if self.num_fields else jnp.zeros(K)
+        mv_cat = (cent_cat != new_cat).sum(-1).astype(jnp.float32)
+        movement = jnp.sqrt((mv_sq + mv_cat) / max(self.n_attrs, 1))
+        movement = jnp.where(empty, 0.0, movement)
+        return new_num, new_cat, movement, av_error, counts
+
+    def iterate(self, num: np.ndarray, cat: np.ndarray, row_valid: np.ndarray,
+                enc: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        """One Lloyd update for all groups (vmapped over G)."""
+        f = jax.vmap(self._iterate, in_axes=(None, None, None, 0, 0, 0))
+        new_num, new_cat, movement, av_error, counts = f(
+            jnp.asarray(num), jnp.asarray(cat),
+            jnp.asarray(row_valid, dtype=jnp.float32),
+            jnp.asarray(enc["cent_num"]), jnp.asarray(enc["cent_cat"]),
+            jnp.asarray(enc["valid"]))
+        return {"cent_num": np.asarray(new_num), "cent_cat": np.asarray(new_cat),
+                "movement": np.asarray(movement),
+                "av_error": np.asarray(av_error),
+                "counts": np.asarray(counts)}
+
+    # ---- host-side round trip ------------------------------------------
+    def update_groups(self, groups: Sequence[ClusterGroup],
+                      res: Dict[str, np.ndarray],
+                      active_idx: Sequence[int],
+                      precision: int = 3) -> None:
+        """Write kernel results back into the (full) group list; only groups
+        listed in active_idx were part of the kernel batch."""
+        for bi, gi in enumerate(active_idx):
+            g = groups[gi]
+            for ki, c in enumerate(g.clusters):
+                items = [NULL] * self.schema.num_columns
+                for fi, f in enumerate(self.num_fields):
+                    v = float(res["cent_num"][bi, ki, fi])
+                    items[f.ordinal] = (f"{v:.{precision}f}" if f.is_double
+                                        else str(int(round(v))))
+                for fi, f in enumerate(self.cat_fields):
+                    code = int(res["cent_cat"][bi, ki, fi])
+                    items[f.ordinal] = (f.cardinality or [NULL])[code]
+                c.items = items
+                c.movement = float(res["movement"][bi, ki])
+                c.av_error = float(res["av_error"][bi, ki])
+                c.count = int(res["counts"][bi, ki])
+            g.apply_threshold()
+
+    def assign(self, table: ColumnarTable, group: ClusterGroup) -> np.ndarray:
+        """Nearest-cluster index per row for one group (prediction path)."""
+        num, cat = self.encode_table(table)
+        enc = self.encode_groups([group])
+        d = self._distances(jnp.asarray(num), jnp.asarray(cat),
+                            jnp.asarray(enc["cent_num"][0]),
+                            jnp.asarray(enc["cent_cat"][0]),
+                            jnp.asarray(enc["valid"][0]))
+        return np.asarray(jnp.argmin(d, axis=1))
+
+
+# ---------------------------------------------------------------------------
+# drivers
+# ---------------------------------------------------------------------------
+
+def kmeans_one_pass(table: ColumnarTable, groups: List[ClusterGroup],
+                    engine: KMeansEngine, precision: int = 3) -> None:
+    """One reference job run (= one MR pass): update every active group in
+    place; stopped groups carry forward unchanged."""
+    active_idx = [i for i, g in enumerate(groups) if g.active]
+    if not active_idx:
+        return
+    num, cat = engine.encode_table(table)
+    row_valid = np.ones(table.n_rows, np.float32)
+    enc = engine.encode_groups([groups[i] for i in active_idx])
+    res = engine.iterate(num, cat, row_valid, enc)
+    engine.update_groups(groups, res, active_idx, precision)
+
+
+def run_kmeans(table: ColumnarTable, groups: List[ClusterGroup],
+               engine: KMeansEngine, max_iter: int = 100,
+               store: Optional[ArtifactStore] = None,
+               precision: int = 3) -> Tuple[List[ClusterGroup], int]:
+    """Iterate to convergence (the reference's external driver loop re-running
+    the job on the rotated cluster file).  If ``store`` is given, each
+    iteration's cluster file is written as ``centroids_iter_<i>.csv`` plus the
+    rolling ``centroids.csv`` — resuming = re-parsing the latest file."""
+    it = 0
+    for it in range(1, max_iter + 1):
+        if not any(g.active for g in groups):
+            it -= 1
+            break
+        kmeans_one_pass(table, groups, engine, precision)
+        if store is not None:
+            lines = format_cluster_lines(groups, precision=precision)
+            store.write_lines(f"centroids_iter_{it}.csv", lines)
+            store.write_lines("centroids.csv", lines)
+    return groups, it
+
+
+def init_groups(table: ColumnarTable, engine: KMeansEngine,
+                group_sizes: Dict[str, int], movement_threshold: float,
+                seed: Optional[int] = None) -> List[ClusterGroup]:
+    """Random distinct-record initialization (the reference supplies the
+    initial cluster file externally; this is the convenience path)."""
+    rng = np.random.default_rng(seed)
+    groups = []
+    for name, k in group_sizes.items():
+        picks = rng.choice(table.n_rows, size=k, replace=False)
+        clusters = []
+        for r in picks:
+            items = [NULL] * table.schema.num_columns
+            for f in engine.num_fields:
+                v = float(table.columns[f.ordinal][r])
+                items[f.ordinal] = (f"{v:.6f}" if f.is_double
+                                    else str(int(round(v))))
+            for f in engine.cat_fields:
+                code = int(table.columns[f.ordinal][r])
+                items[f.ordinal] = (f.cardinality or [NULL])[max(code, 0)]
+            clusters.append(Cluster(items, float("inf"), STATUS_ACTIVE))
+        groups.append(ClusterGroup(name, clusters, movement_threshold))
+    return groups

@@ -65,6 +65,11 @@ def simulated_annealing(domain: SearchDomain, params: AnnealingParams,
     cur = start_solutions if start_solutions is not None else \
         domain.initial_solutions(rng, k)
     cur = jnp.asarray(cur, dtype=jnp.int32)
+    # chain-fanout idiom: independent chains are rows, data-parallel over the
+    # mesh (the reference's mapPartitions axis); GSPMD carries the sharding
+    # through the scan
+    if cur.shape[0] % ctx.n_devices == 0:
+        cur = ctx.shard_rows(cur)
     key = jax.random.PRNGKey(params.seed)
 
     cur_cost = domain.cost_batch(cur)

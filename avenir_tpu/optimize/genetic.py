@@ -46,6 +46,9 @@ def genetic_algorithm(domain: SearchDomain, params: GeneticParams,
     I, P = params.num_islands, params.population_size
     pop = domain.initial_solutions(rng, I * P).reshape(I, P, -1)
     pop = jnp.asarray(pop, dtype=jnp.int32)
+    # islands are independent (mapPartitions axis): shard island dim over mesh
+    if I % ctx.n_devices == 0:
+        pop = ctx.shard_rows(pop)
     key = jax.random.PRNGKey(params.seed)
     L = domain.n_components
 

@@ -115,7 +115,9 @@ class TaskScheduleDomain(MatrixCostDomain):
                 gap = max(starts[j] - ends[i], starts[i] - ends[j])
                 if gap < min_gap_ms:
                     conflict[i, j] = conflict[j, i] = 1.0
-        invalid_cost = float(config.get("inavlidSolutionCost", 0))
+        # missing key must not make invalid solutions the optimum: default to
+        # +inf so constraint violations always lose to any valid schedule
+        invalid_cost = float(config.get("inavlidSolutionCost", math.inf))
 
         super().__init__(cost_matrix=cost, conflict=conflict,
                          conflict_penalty=invalid_cost, average=True)

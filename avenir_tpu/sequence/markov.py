@@ -33,7 +33,6 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.histogram import joint_histogram
-from ..parallel.mesh import MeshContext
 
 
 # --------------------------------------------------------------------------
@@ -125,11 +124,15 @@ def count_transitions(codes: np.ndarray, lens: np.ndarray, n_states: int,
     valid = (pos < (lens[:, None] - 1)) & (fr >= 0) & (to >= 0)
     cls = np.zeros((n,), dtype=np.int32) if class_codes is None else class_codes
     cls_b = np.broadcast_to(cls[:, None], fr.shape)
-    # joint key: class*S*S + fr*S + to over valid pairs
-    key = (cls_b.astype(np.int64) * n_states + fr) * n_states + to
-    key = key[valid]
-    counts = np.bincount(key, minlength=n_classes * n_states * n_states)
-    return counts.reshape(n_classes, n_states, n_states).astype(np.float64)
+    # combined (class, fromState) code vs toState code -> one one-hot MXU
+    # contraction over all adjacent pairs
+    a = np.where(valid, cls_b.astype(np.int64) * n_states + fr, -1)
+    counts = joint_histogram(jnp.asarray(a.reshape(-1), jnp.int32),
+                             jnp.asarray(to.reshape(-1), jnp.int32),
+                             n_classes * n_states, n_states,
+                             mask=jnp.asarray(valid.reshape(-1)))
+    return np.asarray(counts, dtype=np.float64).reshape(
+        n_classes, n_states, n_states)
 
 
 def build_model(sequences: Sequence[Sequence[str]], states: Sequence[str],

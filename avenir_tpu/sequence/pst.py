@@ -99,16 +99,20 @@ def gsp_candidates(frequent: Sequence[Sequence[str]]) -> List[List[str]]:
 
 
 def ctmc_transition_probabilities(rate_matrix: np.ndarray, t: float,
-                                  n_terms: int = 64) -> np.ndarray:
+                                  n_terms: Optional[int] = None) -> np.ndarray:
     """CTMC P(t) by uniformization: q = max |Q_ii|, M = I + Q/q,
     P(t) = sum_k e^{-qt} (qt)^k / k! * M^k — the matrix-power scan of the
-    Spark CTMC job, jitted."""
+    Spark CTMC job, jitted.  The series length adapts to q*t (the Poisson
+    mass above qt + 10*sqrt(qt) is negligible), so large horizons stay
+    correct instead of silently truncating."""
     Q = np.asarray(rate_matrix, dtype=np.float64)
     q = float(np.max(-np.diag(Q)))
     if q <= 0:
         return np.eye(Q.shape[0])
-    M = jnp.asarray(np.eye(Q.shape[0]) + Q / q, dtype=jnp.float32)
     qt = q * t
+    if n_terms is None:
+        n_terms = max(32, int(math.ceil(qt + 10.0 * math.sqrt(qt) + 20.0)))
+    M = jnp.asarray(np.eye(Q.shape[0]) + Q / q, dtype=jnp.float32)
 
     # Poisson weights computed in log space to avoid overflow
     ks = np.arange(n_terms)

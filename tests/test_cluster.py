@@ -1,0 +1,262 @@
+"""Cluster-pack tests: k-means Lloyd oracle vs numpy/sklearn, mixed-type
+centroid updates, multi-group stop/carry-forward, cluster-file round trip,
+agglomerative clustering, CLI jobs."""
+
+import os
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core.schema import FeatureSchema
+from avenir_tpu.core.table import encode_rows
+from avenir_tpu.core.artifacts import ArtifactStore
+from avenir_tpu.cluster import kmeans as KM
+from avenir_tpu.cluster import agglomerative as AG
+from avenir_tpu.cli import run as cli_run
+
+
+NUM_SCHEMA = FeatureSchema.from_dict({
+    "fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "x", "ordinal": 1, "dataType": "double", "feature": True,
+         "min": 0, "max": 10},
+        {"name": "y", "ordinal": 2, "dataType": "double", "feature": True,
+         "min": 0, "max": 10},
+    ]
+})
+
+MIX_SCHEMA = FeatureSchema.from_dict({
+    "fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "x", "ordinal": 1, "dataType": "double", "feature": True,
+         "min": 0, "max": 10},
+        {"name": "color", "ordinal": 2, "dataType": "categorical",
+         "feature": True, "cardinality": ["red", "green", "blue"]},
+    ]
+})
+
+
+def blob_rows(n=60, seed=3):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(n):
+        c = i % 3
+        mu = [(2, 2), (8, 8), (2, 8)][c]
+        x = np.clip(rng.normal(mu[0], 0.5), 0, 10)
+        y = np.clip(rng.normal(mu[1], 0.5), 0, 10)
+        rows.append([f"e{i}", f"{x:.4f}", f"{y:.4f}"])
+    return rows
+
+
+def make_groups(centers, threshold=0.01, name="g1"):
+    clusters = [KM.Cluster([KM.NULL, f"{cx:.3f}", f"{cy:.3f}"],
+                           float("inf"), KM.STATUS_ACTIVE)
+                for cx, cy in centers]
+    return [KM.ClusterGroup(name, clusters, threshold)]
+
+
+def test_one_pass_matches_numpy_oracle():
+    rows = blob_rows()
+    t = encode_rows(rows, NUM_SCHEMA)
+    eng = KM.KMeansEngine(NUM_SCHEMA, [1, 2])
+    centers = [(2.0, 2.0), (8.0, 8.0), (2.0, 8.0)]
+    groups = make_groups(centers)
+    KM.kmeans_one_pass(t, groups, eng)
+    # numpy oracle: assignment on range-normalized coords, mean on raw coords
+    pts = np.stack([t.columns[1], t.columns[2]], axis=1)
+    cent = np.array(centers)
+    d = ((pts[:, None, :] / 10 - cent[None, :, :] / 10) ** 2).sum(-1)
+    assign = d.argmin(1)
+    for k, c in enumerate(groups[0].clusters):
+        want = pts[assign == k].mean(0)
+        got = np.array([float(c.items[1]), float(c.items[2])])
+        np.testing.assert_allclose(got, want, atol=2e-3)
+        assert c.count == int((assign == k).sum())
+        assert c.status in (KM.STATUS_ACTIVE, KM.STATUS_STOPPED)
+
+
+def test_convergence_matches_sklearn():
+    sklearn = pytest.importorskip("sklearn.cluster")
+    rows = blob_rows(120)
+    t = encode_rows(rows, NUM_SCHEMA)
+    eng = KM.KMeansEngine(NUM_SCHEMA, [1, 2])
+    centers = [(1.0, 1.0), (9.0, 9.0), (1.0, 9.0)]
+    groups = make_groups(centers, threshold=1e-5)
+    groups, iters = KM.run_kmeans(t, groups, eng, max_iter=50, precision=8)
+    assert iters < 50 and not groups[0].active
+    pts = np.stack([t.columns[1], t.columns[2]], axis=1)
+    km = sklearn.KMeans(n_clusters=3, init=np.array(centers) / 10.0, n_init=1,
+                        max_iter=100).fit(pts / 10.0)  # same normalized space
+    ours = sorted((float(c.items[1]), float(c.items[2]))
+                  for c in groups[0].clusters)
+    theirs = sorted((x * 10, y * 10) for x, y in km.cluster_centers_)
+    np.testing.assert_allclose(np.array(ours), np.array(theirs), atol=1e-2)
+
+
+def test_mixed_type_mode_update():
+    rows = [["a", "1.0", "red"], ["b", "1.2", "red"], ["c", "0.8", "green"],
+            ["d", "9.0", "blue"], ["e", "9.2", "blue"], ["f", "8.8", "blue"]]
+    t = encode_rows(rows, MIX_SCHEMA)
+    eng = KM.KMeansEngine(MIX_SCHEMA, [1, 2])
+    clusters = [KM.Cluster([KM.NULL, "1.0", "red"], np.inf, "active"),
+                KM.Cluster([KM.NULL, "9.0", "blue"], np.inf, "active")]
+    groups = [KM.ClusterGroup("g", clusters, 0.001)]
+    KM.kmeans_one_pass(t, groups, eng)
+    c0, c1 = groups[0].clusters
+    assert c0.items[2] == "red" and c1.items[2] == "blue"
+    np.testing.assert_allclose(float(c0.items[1]), 1.0, atol=1e-3)
+    np.testing.assert_allclose(float(c1.items[1]), 9.0, atol=1e-3)
+
+
+def test_multi_group_and_stopped_carry_forward():
+    rows = blob_rows(60)
+    t = encode_rows(rows, NUM_SCHEMA)
+    eng = KM.KMeansEngine(NUM_SCHEMA, [1, 2])
+    g_active = make_groups([(1.0, 1.0), (9.0, 9.0)], name="gA")[0]
+    g_stopped = make_groups([(5.0, 5.0)], name="gB")[0]
+    for c in g_stopped.clusters:
+        c.status = KM.STATUS_STOPPED
+        c.movement = 0.0
+    before = [list(c.items) for c in g_stopped.clusters]
+    groups = [g_active, g_stopped]
+    KM.kmeans_one_pass(t, groups, eng)
+    assert [list(c.items) for c in g_stopped.clusters] == before
+    assert all(c.count > 0 for c in g_active.clusters)
+
+
+def test_cluster_file_round_trip(tmp_path):
+    groups = make_groups([(2.0, 3.0), (7.0, 1.0)])
+    groups[0].clusters[0].movement = 0.5
+    groups[0].clusters[1].movement = 0.002
+    lines = KM.format_cluster_lines(groups)
+    back = KM.parse_cluster_lines(lines, NUM_SCHEMA.num_columns, 0.01)
+    assert len(back) == 1 and len(back[0].clusters) == 2
+    assert back[0].clusters[0].status == KM.STATUS_ACTIVE
+    assert back[0].clusters[1].status == KM.STATUS_STOPPED  # below threshold
+    assert back[0].clusters[0].items[1] == "2.000"
+
+
+def test_run_kmeans_checkpoints(tmp_path):
+    rows = blob_rows(60)
+    t = encode_rows(rows, NUM_SCHEMA)
+    eng = KM.KMeansEngine(NUM_SCHEMA, [1, 2])
+    store = ArtifactStore(str(tmp_path))
+    groups = make_groups([(1.0, 1.0), (9.0, 9.0), (1.0, 9.0)], threshold=1e-4)
+    groups, iters = KM.run_kmeans(t, groups, eng, max_iter=30, store=store)
+    assert store.exists("centroids.csv")
+    assert store.exists(f"centroids_iter_{iters}.csv")
+    # resume from checkpoint: already converged, zero additional iterations
+    resumed = KM.parse_cluster_lines(store.read_lines("centroids.csv"),
+                                     NUM_SCHEMA.num_columns, 1e-4)
+    _, more = KM.run_kmeans(t, resumed, eng, max_iter=30)
+    assert more == 0
+
+
+def test_init_groups():
+    t = encode_rows(blob_rows(30), NUM_SCHEMA)
+    eng = KM.KMeansEngine(NUM_SCHEMA, [1, 2])
+    groups = KM.init_groups(t, eng, {"3means": 3, "5means": 5}, 0.01, seed=7)
+    assert [len(g.clusters) for g in groups] == [3, 5]
+    assert groups[0].clusters[0].items[0] == KM.NULL
+
+
+def test_assign_prediction():
+    t = encode_rows(blob_rows(30), NUM_SCHEMA)
+    eng = KM.KMeansEngine(NUM_SCHEMA, [1, 2])
+    groups = make_groups([(2.0, 2.0), (8.0, 8.0), (2.0, 8.0)])
+    a = eng.assign(t, groups[0])
+    pts = np.stack([t.columns[1], t.columns[2]], axis=1)
+    cent = np.array([(2.0, 2.0), (8.0, 8.0), (2.0, 8.0)])
+    want = (((pts[:, None] - cent[None]) / 10) ** 2).sum(-1).argmin(1)
+    np.testing.assert_array_equal(a, want)
+
+
+# ---------------------------------------------------------------------------
+# agglomerative
+# ---------------------------------------------------------------------------
+
+def test_entity_distance_store_round_trip():
+    ids = ["a", "b", "c"]
+    d = np.array([[0, 1.0, 5.0], [1.0, 0, 5.5], [5.0, 5.5, 0]])
+    store = AG.EntityDistanceStore.from_matrix(ids, d)
+    lines = store.to_lines()
+    back = AG.EntityDistanceStore.from_lines(lines)
+    assert back.read("a")["b"] == pytest.approx(1.0)
+    assert back.read("c")["b"] == pytest.approx(5.5)
+
+
+def test_agglomerative_two_clusters():
+    ids = ["a", "b", "c", "d"]
+    # a-b close, c-d close, cross pairs far; store distances, scale 10
+    d = np.array([[0, 1, 9, 9], [1, 0, 9, 9], [9, 9, 0, 1], [9, 9, 1, 0]],
+                 dtype=float)
+    store = AG.EntityDistanceStore.from_matrix(ids, d)
+    clusters = AG.agglomerative_cluster(ids, store, min_av_edge_weight=5.0,
+                                        dist_scale=10.0)
+    assert sorted(sorted(c.members) for c in clusters) == [["a", "b"],
+                                                           ["c", "d"]]
+
+
+# ---------------------------------------------------------------------------
+# CLI jobs
+# ---------------------------------------------------------------------------
+
+def test_kmeans_cli_job(tmp_path):
+    import json
+    schema_path = tmp_path / "schema.json"
+    schema_path.write_text(json.dumps({
+        "fields": [
+            {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+            {"name": "x", "ordinal": 1, "dataType": "double", "feature": True,
+             "min": 0, "max": 10},
+            {"name": "y", "ordinal": 2, "dataType": "double", "feature": True,
+             "min": 0, "max": 10},
+        ]}))
+    rows = blob_rows(60)
+    in_path = tmp_path / "in.csv"
+    in_path.write_text("\n".join(",".join(r) for r in rows) + "\n")
+    clf = tmp_path / "clusters.csv"
+    clf.write_text("\n".join([
+        "g1,null,1.0,1.0,inf,active",
+        "g1,null,9.0,9.0,inf,active",
+        "g1,null,1.0,9.0,inf,active"]) + "\n")
+    props = tmp_path / "job.properties"
+    props.write_text("\n".join([
+        f"kmc.schema.file.path={schema_path}",
+        "kmc.attr.odinals=1,2",
+        "kmc.movement.threshold=0.0001",
+        f"kmc.cluster.file.path={clf}",
+        "kmc.num.iterations=40"]) + "\n")
+    out = tmp_path / "out"
+    rc = cli_run.main(["kmeansCluster", f"-Dconf.path={props}",
+                       str(in_path), str(out)])
+    assert rc == 0
+    out_lines = open(os.path.join(str(out), "part-r-00000")).read().splitlines()
+    assert len(out_lines) == 3
+    for line in out_lines:
+        parts = line.split(",")
+        assert parts[0] == "g1" and parts[5] == "stopped"
+
+
+def test_agglomerative_cli_job(tmp_path):
+    ids = ["a", "b", "c", "d"]
+    d = np.array([[0, 1, 9, 9], [1, 0, 9, 9], [9, 9, 0, 1], [9, 9, 1, 0]],
+                 dtype=float)
+    store = AG.EntityDistanceStore.from_matrix(ids, d)
+    dist_path = tmp_path / "dist.csv"
+    dist_path.write_text("\n".join(store.to_lines()) + "\n")
+    in_path = tmp_path / "in.csv"
+    in_path.write_text("\n".join(ids) + "\n")
+    props = tmp_path / "job.properties"
+    props.write_text("\n".join([
+        "agg.min.av.edge.weight.threshold=5.0",
+        f"agg.map.file.dir.path={dist_path}",
+        "agg.dist.scale=10.0"]) + "\n")
+    out = tmp_path / "out"
+    rc = cli_run.main(["agglomerativeGraphical", f"-Dconf.path={props}",
+                       str(in_path), str(out)])
+    assert rc == 0
+    lines = open(os.path.join(str(out), "part-r-00000")).read().splitlines()
+    assert len(lines) == 2
+    members = sorted(sorted(l.split(",")[1:-1]) for l in lines)
+    assert members == [["a", "b"], ["c", "d"]]
