@@ -1,0 +1,130 @@
+"""Discriminant-pack jobs (org.avenir.discriminant.*).
+
+Config keys follow the reference setup() methods: svm.* incl. the reference's
+``svm.pnalty.factor`` typo (SupportVectorMachine.java:61-66) and the Fisher
+job's reuse of the numeric-stats pipeline (FisherDiscriminant.java).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from ..core.config import Config
+from ..core.metrics import Counters, ConfusionMatrix
+from ..core import artifacts
+from ..core.table import load_csv
+from .jobs import register, _schema_path
+
+
+def _svm_xy(cfg: Config, table, schema):
+    """Features + ±1 targets.  The reference expects the class column already
+    numeric ±1 (parsed as double, SupportVectorMachine.java:97-100); we also
+    accept a categorical class with svm.positive.class.value."""
+    X = table.feature_matrix(dtype=np.float64)
+    cf = schema.class_attr_field
+    if cf.is_categorical:
+        pos = cfg.must_get("svm.positive.class.value",
+                           "categorical class needs svm.positive.class.value")
+        y = np.where(table.class_codes() == cf.cat_code(pos), 1.0, -1.0)
+    else:
+        y = np.where(table.columns[cf.ordinal] > 0, 1.0, -1.0)
+    return X, y
+
+
+@register("org.avenir.discriminant.SupportVectorMachine",
+          "supportVectorMachine")
+def support_vector_machine(cfg: Config, in_path: str, out_path: str
+                           ) -> Counters:
+    """SMO training; emits support-vector rows (features..., target, alpha)
+    plus a 'weights' model line for the linear predictor.  Keys:
+    svm.feature.schema.file.path, svm.pnalty.factor, svm.tolerance, svm.eps,
+    svm.kernel.type, svm.positive.class.value."""
+    from ..discriminant import smo as S
+    counters = Counters()
+    schema = _schema_path(cfg, "svm.feature.schema.file.path")
+    table = load_csv(in_path, schema, cfg.field_delim_regex)
+    params = S.SMOParams(
+        penalty_factor=cfg.get_float("svm.pnalty.factor",
+                                     cfg.get_float("svm.penalty.factor", 0.05)),
+        tolerance=cfg.get_float("svm.tolerance", 1e-3),
+        eps=cfg.get_float("svm.eps", 1e-3),
+        kernel_type=cfg.get("svm.kernel.type", S.KERNEL_LINEAR),
+        seed=cfg.get_int("svm.random.seed", 0),
+    )
+    X, y = _svm_xy(cfg, table, schema)
+    model = S.SMOTrainer(params).train(X, y)
+    od = cfg.field_delim_out
+    lines: List[str] = model.support_vector_lines(od)
+    lines.append(od.join(["weights"] +
+                         [f"{w:.9g}" for w in model.weights] +
+                         [f"{model.threshold:.9g}"]))
+    artifacts.write_text_output(out_path, lines)
+    counters.set("SVM", "supportVectors", len(model.sup_vec_idx))
+    counters.set("SVM", "rows", table.n_rows)
+    return counters
+
+
+@register("org.avenir.discriminant.SupportVectorPredictor",
+          "supportVectorPredictor")
+def support_vector_predictor(cfg: Config, in_path: str, out_path: str
+                             ) -> Counters:
+    """Map-only linear-SVM prediction from the trained model's weights line;
+    validation mode exports a confusion matrix.  Keys: svm.model.file.path
+    plus the training keys."""
+    from ..discriminant import smo as S
+    counters = Counters()
+    schema = _schema_path(cfg, "svm.feature.schema.file.path")
+    table = load_csv(in_path, schema, cfg.field_delim_regex, keep_raw=True)
+    od = cfg.field_delim_out
+    w = b = None
+    for line in artifacts.read_text_input(cfg.must_get("svm.model.file.path")):
+        parts = line.strip().split(od)
+        if parts and parts[0] == "weights":
+            vals = [float(v) for v in parts[1:]]
+            w, b = np.array(vals[:-1]), vals[-1]
+    if w is None:
+        raise ValueError("model file has no weights line")
+    model = S.SVMModel(weights=w, threshold=b,
+                       sup_vec_idx=np.zeros(0, int),
+                       alphas=np.zeros(0), X=np.zeros((0, len(w))),
+                       y=np.zeros(0))
+    X, _ = _svm_xy(cfg, table, schema)
+    pred = S.predict(model, X)
+    cf = schema.class_attr_field
+    pos = cfg.get("svm.positive.class.value")
+    card = cf.cardinality or []
+    neg = next((c for c in card if c != pos), "-1")
+    labels = np.where(pred > 0, pos if pos else "1", neg)
+    lines = [od.join(row + [str(labels[i])])
+             for i, row in enumerate(table.raw_rows)]
+    artifacts.write_text_output(out_path, lines, role="m")
+    if cfg.get_boolean("validation.mode", False) and pos:
+        cm = ConfusionMatrix(neg_class=neg, pos_class=pos)
+        actual = [row[cf.ordinal] for row in table.raw_rows]
+        cm.report_batch(pred > 0,
+                        np.array([a == pos for a in actual]),
+                        np.array([a == neg for a in actual]))
+        cm.export(counters)
+    return counters
+
+
+@register("org.avenir.discriminant.FisherDiscriminant", "fisherDiscriminant")
+def fisher_discriminant_job(cfg: Config, in_path: str, out_path: str
+                            ) -> Counters:
+    """Per-attribute two-class boundary lines
+    ``attr,logOddsPrior,pooledVariance,discrimValue``
+    (FisherDiscriminant.java:44-55).  Key: fid.feature.schema.file.path
+    (falls back to feature.schema.file.path)."""
+    from ..discriminant import fisher as F
+    counters = Counters()
+    key = ("fid.feature.schema.file.path"
+           if cfg.get("fid.feature.schema.file.path")
+           else "feature.schema.file.path")
+    schema = _schema_path(cfg, key)
+    table = load_csv(in_path, schema, cfg.field_delim_regex)
+    res = F.fisher_discriminant(table)
+    artifacts.write_text_output(out_path, res.to_lines(cfg.field_delim_out))
+    counters.set("Fisher", "attributes", len(res.attr_ordinals))
+    return counters

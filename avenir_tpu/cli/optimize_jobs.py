@@ -38,6 +38,14 @@ def load_domain(class_name: str, config_file: str):
     return getattr(mod, cls_name).load(config_file)
 
 
+def _safe_int(v: float) -> int:
+    """Counter-safe conversion: inf/nan (e.g. every chain stuck on invalid
+    solutions) clamp instead of raising OverflowError/ValueError."""
+    if np.isnan(v):
+        return 0
+    return int(np.clip(v, -(2 ** 62), 2 ** 62))
+
+
 def _parse_start(domain, line: str, od: str) -> np.ndarray:
     """Parse a starting solution; tolerates re-ingesting our own output lines,
     which append ``<od><cost>`` to the solution string (the reference's
@@ -87,9 +95,9 @@ def simulated_annealing_job(cfg: Config, in_path: str, out_path: str) -> Counter
                  f"{res.best_costs[i]:.3f}" for i in order]
     artifacts.write_text_output(out_path, out_lines)
     for k, v in res.counters.items():
-        counters.set("Annealing", k, int(v))
+        counters.set("Annealing", k, _safe_int(v))
     counters.set("Annealing", "estimatedInitialTemp",
-                 int(res.estimated_initial_temp))
+                 _safe_int(res.estimated_initial_temp))
     return counters
 
 
@@ -114,5 +122,5 @@ def genetic_algorithm_job(cfg: Config, in_path: str, out_path: str) -> Counters:
                  f"{res.island_best_costs[i]:.3f}"
                  for i in np.argsort(res.island_best_costs)]
     artifacts.write_text_output(out_path, out_lines)
-    counters.set("Genetic", "bestCost", int(res.best_cost))
+    counters.set("Genetic", "bestCost", _safe_int(res.best_cost))
     return counters

@@ -26,6 +26,8 @@ from . import sequence_jobs  # noqa: F401  (registers sequence-pack jobs)
 from . import optimize_jobs  # noqa: F401  (registers optimize-pack jobs)
 from . import reinforce_jobs  # noqa: F401  (registers reinforce-pack jobs)
 from . import cluster_jobs  # noqa: F401  (registers cluster-pack jobs)
+from . import regress_jobs  # noqa: F401  (registers regress-pack jobs)
+from . import discriminant_jobs  # noqa: F401  (registers discriminant-pack jobs)
 
 
 def parse_args(argv: List[str]):

@@ -28,7 +28,7 @@ record), an off-by-recSize bug; we implement the intended per-record mean.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field as dc_field, replace
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -284,14 +284,20 @@ class KMeansEngine:
 # ---------------------------------------------------------------------------
 
 def kmeans_one_pass(table: ColumnarTable, groups: List[ClusterGroup],
-                    engine: KMeansEngine, precision: int = 3) -> None:
+                    engine: KMeansEngine, precision: int = 3,
+                    encoded: Optional[Tuple[np.ndarray, np.ndarray,
+                                            np.ndarray]] = None) -> None:
     """One reference job run (= one MR pass): update every active group in
-    place; stopped groups carry forward unchanged."""
+    place; stopped groups carry forward unchanged.  ``encoded`` lets driver
+    loops hoist the loop-invariant row encoding/upload."""
     active_idx = [i for i, g in enumerate(groups) if g.active]
     if not active_idx:
         return
-    num, cat = engine.encode_table(table)
-    row_valid = np.ones(table.n_rows, np.float32)
+    if encoded is None:
+        num, cat = engine.encode_table(table)
+        row_valid = np.ones(table.n_rows, np.float32)
+    else:
+        num, cat, row_valid = encoded
     enc = engine.encode_groups([groups[i] for i in active_idx])
     res = engine.iterate(num, cat, row_valid, enc)
     engine.update_groups(groups, res, active_idx, precision)
@@ -305,12 +311,14 @@ def run_kmeans(table: ColumnarTable, groups: List[ClusterGroup],
     the job on the rotated cluster file).  If ``store`` is given, each
     iteration's cluster file is written as ``centroids_iter_<i>.csv`` plus the
     rolling ``centroids.csv`` — resuming = re-parsing the latest file."""
+    num, cat = engine.encode_table(table)
+    encoded = (num, cat, np.ones(table.n_rows, np.float32))
     it = 0
     for it in range(1, max_iter + 1):
         if not any(g.active for g in groups):
             it -= 1
             break
-        kmeans_one_pass(table, groups, engine, precision)
+        kmeans_one_pass(table, groups, engine, precision, encoded=encoded)
         if store is not None:
             lines = format_cluster_lines(groups, precision=precision)
             store.write_lines(f"centroids_iter_{it}.csv", lines)

@@ -1,0 +1,111 @@
+"""Univariate Fisher linear discriminant.
+
+Reference: discriminant/FisherDiscriminant.java — reuses the chombo
+``NumericalAttrStats`` MR (per-(attr, classValue) count/mean/variance) and in
+the reducer cleanup emits, per attribute, the two-class boundary:
+
+  pooledVariance = (var0*n0 + var1*n1) / (n0+n1)
+  logOddsPrior   = ln(n0/n1)
+  discrimValue   = (mean0+mean1)/2 - logOddsPrior * pooledVariance / meanDiff
+
+(FisherDiscriminant.java:44-55).  Class order follows first-seen in the
+reference reducer; here it is the schema cardinality order, which is
+deterministic.
+
+TPU design: the class-conditional moments for ALL attributes are two one-hot
+contractions — onehot(class).T @ X and onehot(class).T @ X² — one jitted
+pass over the sharded rows (the NumericalAttrStats MR + combiner collapse
+into a psum of per-shard partials).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import List, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.schema import FeatureSchema
+from ..core.table import ColumnarTable
+
+
+@dataclass
+class FisherResult:
+    attr_ordinals: List[int]
+    counts: np.ndarray        # (2,) per-class record counts
+    means: np.ndarray         # (2, F)
+    variances: np.ndarray     # (2, F)
+
+    def boundary(self, fi: int) -> Tuple[float, float, float]:
+        """(logOddsPrior, pooledVariance, discrimValue) for feature index."""
+        n0, n1 = float(self.counts[0]), float(self.counts[1])
+        v0, v1 = float(self.variances[0, fi]), float(self.variances[1, fi])
+        m0, m1 = float(self.means[0, fi]), float(self.means[1, fi])
+        pooled = (v0 * n0 + v1 * n1) / (n0 + n1)
+        log_odds = math.log(n0 / n1)
+        mean_diff = m0 - m1
+        # a constant feature (equal class means) has no prior-shift term; the
+        # midpoint is the only defensible boundary rather than a div-by-zero
+        discrim = (m0 + m1) / 2 - \
+            (log_odds * pooled / mean_diff if mean_diff != 0.0 else 0.0)
+        return log_odds, pooled, discrim
+
+    def to_lines(self, delim: str = ",") -> List[str]:
+        lines = []
+        for fi, o in enumerate(self.attr_ordinals):
+            lo, pv, dv = self.boundary(fi)
+            lines.append(f"{o}{delim}{lo:.9g}{delim}{pv:.9g}{delim}{dv:.9g}")
+        return lines
+
+
+@jax.jit
+def _class_moments(X, cls_onehot):
+    counts = cls_onehot.sum(0)                       # (2,)
+    s1 = cls_onehot.T @ X                            # (2, F)
+    s2 = cls_onehot.T @ (X * X)
+    safe = jnp.maximum(counts, 1.0)[:, None]
+    mean = s1 / safe
+    var = s2 / safe - mean * mean
+    return counts, mean, var
+
+
+def fisher_discriminant(table: ColumnarTable) -> FisherResult:
+    schema = table.schema
+    num_fields = [f for f in schema.feature_fields if f.is_numeric]
+    if not num_fields:
+        raise ValueError("Fisher discriminant needs numeric feature fields")
+    card = schema.class_attr_field.cardinality or []
+    if len(card) != 2:
+        raise ValueError("Fisher discriminant is two-class "
+                         f"(class cardinality = {len(card)})")
+    X = np.stack([table.columns[f.ordinal] for f in num_fields],
+                 axis=1).astype(np.float64)
+    cls = table.class_codes()
+    onehot = np.zeros((table.n_rows, 2))
+    valid = cls >= 0
+    onehot[np.arange(table.n_rows)[valid], cls[valid]] = 1.0
+    counts, mean, var = _class_moments(jnp.asarray(X, jnp.float32),
+                                       jnp.asarray(onehot, jnp.float32))
+    counts_np = np.asarray(counts, np.float64)
+    if counts_np.min() <= 0:
+        missing = card[int(np.argmin(counts_np))]
+        raise ValueError(f"class {missing!r} has no rows; Fisher boundary "
+                         "needs both classes present")
+    return FisherResult(attr_ordinals=[f.ordinal for f in num_fields],
+                        counts=counts_np,
+                        means=np.asarray(mean, np.float64),
+                        variances=np.asarray(var, np.float64))
+
+
+def classify(result: FisherResult, table: ColumnarTable, fi: int) -> np.ndarray:
+    """Classify by the univariate boundary on feature index fi: class 0 when
+    the value is on mean0's side of discrimValue."""
+    _, _, dv = result.boundary(fi)
+    x = table.columns[result.attr_ordinals[fi]].astype(np.float64)
+    m0, m1 = result.means[0, fi], result.means[1, fi]
+    side0 = x >= dv if m0 >= m1 else x < dv
+    return np.where(side0, 0, 1)

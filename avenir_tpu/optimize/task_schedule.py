@@ -115,9 +115,10 @@ class TaskScheduleDomain(MatrixCostDomain):
                 gap = max(starts[j] - ends[i], starts[i] - ends[j])
                 if gap < min_gap_ms:
                     conflict[i, j] = conflict[j, i] = 1.0
-        # missing key must not make invalid solutions the optimum: default to
-        # +inf so constraint violations always lose to any valid schedule
-        invalid_cost = float(config.get("inavlidSolutionCost", math.inf))
+        # missing key must not make invalid solutions the optimum; a large
+        # FINITE penalty keeps Metropolis deltas and counter sums arithmetic-
+        # safe (inf would propagate into cost accumulators and overflow int())
+        invalid_cost = float(config.get("inavlidSolutionCost", 1e9))
 
         super().__init__(cost_matrix=cost, conflict=conflict,
                          conflict_penalty=invalid_cost, average=True)

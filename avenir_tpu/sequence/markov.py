@@ -124,15 +124,22 @@ def count_transitions(codes: np.ndarray, lens: np.ndarray, n_states: int,
     valid = (pos < (lens[:, None] - 1)) & (fr >= 0) & (to >= 0)
     cls = np.zeros((n,), dtype=np.int32) if class_codes is None else class_codes
     cls_b = np.broadcast_to(cls[:, None], fr.shape)
-    # combined (class, fromState) code vs toState code -> one one-hot MXU
-    # contraction over all adjacent pairs
-    a = np.where(valid, cls_b.astype(np.int64) * n_states + fr, -1)
-    counts = joint_histogram(jnp.asarray(a.reshape(-1), jnp.int32),
-                             jnp.asarray(to.reshape(-1), jnp.int32),
-                             n_classes * n_states, n_states,
-                             mask=jnp.asarray(valid.reshape(-1)))
-    return np.asarray(counts, dtype=np.float64).reshape(
-        n_classes, n_states, n_states)
+    # combined (class, fromState) code vs toState code -> one-hot MXU
+    # contractions over all adjacent pairs.  Chunked so each float32 partial
+    # stays below 2^24 (exact integer range); host accumulation is float64,
+    # exact to 2^53 — np.bincount exactness at device-histogram speed.
+    a = np.where(valid, cls_b.astype(np.int64) * n_states + fr, -1).reshape(-1)
+    to_flat = to.reshape(-1)
+    valid_flat = valid.reshape(-1)
+    total = np.zeros((n_classes * n_states, n_states), np.float64)
+    chunk = 8 << 20
+    for s in range(0, a.shape[0], chunk):
+        e = s + chunk
+        total += np.asarray(joint_histogram(
+            jnp.asarray(a[s:e], jnp.int32), jnp.asarray(to_flat[s:e], jnp.int32),
+            n_classes * n_states, n_states,
+            mask=jnp.asarray(valid_flat[s:e])), np.float64)
+    return total.reshape(n_classes, n_states, n_states)
 
 
 def build_model(sequences: Sequence[Sequence[str]], states: Sequence[str],

@@ -1,0 +1,187 @@
+"""Discriminant-pack tests: SMO vs sklearn LinearSVC/SVC oracle, KKT
+conditions, per-group training, Fisher boundary formula oracle, CLI round
+trips."""
+
+import json
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core.schema import FeatureSchema
+from avenir_tpu.core.table import encode_rows
+from avenir_tpu.discriminant import smo as S
+from avenir_tpu.discriminant import fisher as F
+from avenir_tpu.cli import run as cli_run
+
+
+def sep_data(n=80, seed=2, margin=1.5):
+    rng = np.random.default_rng(seed)
+    y = np.where(np.arange(n) % 2 == 0, 1.0, -1.0)
+    X = rng.normal(0, 0.6, (n, 2)) + margin * y[:, None]
+    return X, y
+
+
+def test_smo_separable_accuracy_and_kkt():
+    X, y = sep_data(100)
+    params = S.SMOParams(penalty_factor=1.0, seed=4)
+    model = S.SMOTrainer(params).train(X, y)
+    pred = S.predict(model, X)
+    assert (pred == y).mean() >= 0.97
+    # KKT: alphas in [0, C]; non-bound SVs lie near the margin |f(x)|≈1
+    C = params.penalty_factor
+    assert np.all(model.alphas >= -1e-9) and np.all(model.alphas <= C + 1e-9)
+    nb = (model.alphas > 1e-6) & (model.alphas < C - 1e-6)
+    if nb.any():
+        f = S.decision_function(model, X[nb])
+        np.testing.assert_allclose(f * y[nb], 1.0, atol=0.05)
+    # dual constraint sum alpha_i y_i = 0
+    assert abs(float(model.alphas @ y)) < 1e-6
+
+
+def test_smo_matches_sklearn_decision():
+    svm = pytest.importorskip("sklearn.svm")
+    X, y = sep_data(120, seed=9, margin=1.2)
+    model = S.SMOTrainer(S.SMOParams(penalty_factor=1.0)).train(X, y)
+    sk = svm.SVC(kernel="linear", C=1.0).fit(X, y)
+    # hyperplanes agree up to small tolerance
+    w_ours = np.append(model.weights, -model.threshold)
+    w_sk = np.append(sk.coef_[0], sk.intercept_[0])
+    cos = w_ours @ w_sk / (np.linalg.norm(w_ours) * np.linalg.norm(w_sk))
+    assert cos > 0.99
+    agree = (S.predict(model, X) == sk.predict(X)).mean()
+    assert agree >= 0.98
+
+
+def test_smo_soft_margin_overlapping():
+    X, y = sep_data(100, seed=7, margin=0.5)   # heavy overlap
+    model = S.SMOTrainer(S.SMOParams(penalty_factor=0.5)).train(X, y)
+    assert (S.predict(model, X) == y).mean() > 0.7
+    assert len(model.sup_vec_idx) > 2
+
+
+def test_train_groups():
+    Xa, ya = sep_data(60, seed=1)
+    Xb, yb = sep_data(60, seed=2)
+    models = S.train_groups({"a": (Xa, ya), "b": (Xb, yb)},
+                            S.SMOParams(penalty_factor=1.0))
+    assert set(models) == {"a", "b"}
+    assert (S.predict(models["a"], Xa) == ya).mean() > 0.95
+
+
+def test_invalid_kernel():
+    with pytest.raises(ValueError):
+        S.SMOTrainer(S.SMOParams(kernel_type="radial"))
+
+
+# ---------------------------------------------------------------------------
+# Fisher
+# ---------------------------------------------------------------------------
+
+FISHER_SCHEMA = FeatureSchema.from_dict({
+    "fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "x", "ordinal": 1, "dataType": "double", "feature": True},
+        {"name": "z", "ordinal": 2, "dataType": "double", "feature": True},
+        {"name": "label", "ordinal": 3, "dataType": "categorical",
+         "cardinality": ["c0", "c1"]},
+    ]
+})
+
+
+def fisher_rows(n=200, seed=6):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(n):
+        c = 0 if i % 4 else 1               # 3:1 class imbalance
+        x = rng.normal(2.0 if c == 0 else 5.0, 1.0)
+        z = rng.normal(0.0, 1.0)
+        rows.append([f"r{i}", f"{x:.4f}", f"{z:.4f}", f"c{c}"])
+    return rows
+
+
+def test_fisher_formula_oracle():
+    rows = fisher_rows()
+    t = encode_rows(rows, FISHER_SCHEMA)
+    res = F.fisher_discriminant(t)
+    x = t.columns[1]
+    cls = t.class_codes()
+    n0, n1 = (cls == 0).sum(), (cls == 1).sum()
+    m0, m1 = x[cls == 0].mean(), x[cls == 1].mean()
+    v0, v1 = x[cls == 0].var(), x[cls == 1].var()
+    pooled = (v0 * n0 + v1 * n1) / (n0 + n1)
+    log_odds = np.log(n0 / n1)
+    want_dv = (m0 + m1) / 2 - log_odds * pooled / (m0 - m1)
+    lo, pv, dv = res.boundary(0)
+    np.testing.assert_allclose(lo, log_odds, rtol=1e-5)
+    np.testing.assert_allclose(pv, pooled, rtol=1e-3)
+    np.testing.assert_allclose(dv, want_dv, rtol=1e-3)
+
+
+def test_fisher_classify():
+    t = encode_rows(fisher_rows(400), FISHER_SCHEMA)
+    res = F.fisher_discriminant(t)
+    pred = F.classify(res, t, 0)
+    acc = (pred == t.class_codes()).mean()
+    assert acc > 0.85
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def test_svm_cli_train_predict(tmp_path):
+    schema_path = tmp_path / "schema.json"
+    schema_path.write_text(json.dumps({
+        "fields": [
+            {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+            {"name": "x1", "ordinal": 1, "dataType": "double", "feature": True},
+            {"name": "x2", "ordinal": 2, "dataType": "double", "feature": True},
+            {"name": "label", "ordinal": 3, "dataType": "categorical",
+             "cardinality": ["no", "yes"]},
+        ]}))
+    X, y = sep_data(100, seed=3)
+    rows = [[f"r{i}", f"{X[i,0]:.4f}", f"{X[i,1]:.4f}",
+             "yes" if y[i] > 0 else "no"] for i in range(len(y))]
+    (tmp_path / "train.csv").write_text(
+        "\n".join(",".join(r) for r in rows) + "\n")
+    props = tmp_path / "svm.properties"
+    props.write_text("\n".join([
+        f"svm.feature.schema.file.path={schema_path}",
+        "svm.pnalty.factor=1.0",
+        "svm.positive.class.value=yes",
+        f"svm.model.file.path={tmp_path}/model/part-r-00000",
+        "validation.mode=true"]) + "\n")
+    rc = cli_run.main(["supportVectorMachine", f"-Dconf.path={props}",
+                       str(tmp_path / "train.csv"), str(tmp_path / "model")])
+    assert rc == 0
+    model_lines = (tmp_path / "model" / "part-r-00000").read_text().splitlines()
+    assert any(l.startswith("weights,") for l in model_lines)
+    rc = cli_run.main(["supportVectorPredictor", f"-Dconf.path={props}",
+                       str(tmp_path / "train.csv"), str(tmp_path / "pred")])
+    assert rc == 0
+    lines = (tmp_path / "pred" / "part-m-00000").read_text().splitlines()
+    correct = sum(1 for l in lines if l.split(",")[3] == l.split(",")[4])
+    assert correct / len(lines) > 0.95
+
+
+def test_fisher_cli(tmp_path):
+    schema_path = tmp_path / "schema.json"
+    schema_path.write_text(json.dumps({
+        "fields": [
+            {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+            {"name": "x", "ordinal": 1, "dataType": "double", "feature": True},
+            {"name": "z", "ordinal": 2, "dataType": "double", "feature": True},
+            {"name": "label", "ordinal": 3, "dataType": "categorical",
+             "cardinality": ["c0", "c1"]},
+        ]}))
+    rows = fisher_rows(100)
+    (tmp_path / "in.csv").write_text("\n".join(",".join(r) for r in rows) + "\n")
+    props = tmp_path / "f.properties"
+    props.write_text(f"fid.feature.schema.file.path={schema_path}\n")
+    rc = cli_run.main(["fisherDiscriminant", f"-Dconf.path={props}",
+                       str(tmp_path / "in.csv"), str(tmp_path / "out")])
+    assert rc == 0
+    lines = (tmp_path / "out" / "part-r-00000").read_text().splitlines()
+    assert len(lines) == 2  # two numeric attrs
+    ords = [int(l.split(",")[0]) for l in lines]
+    assert ords == [1, 2]

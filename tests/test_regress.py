@@ -1,0 +1,154 @@
+"""Regress-pack tests: gradient-step oracle, convergence criteria, history
+resume, sklearn parity on separable data, CLI train+predict round trip."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core.schema import FeatureSchema
+from avenir_tpu.core.table import encode_rows
+from avenir_tpu.regress import logistic as LR
+from avenir_tpu.cli import run as cli_run
+
+
+SCHEMA = FeatureSchema.from_dict({
+    "fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "x1", "ordinal": 1, "dataType": "double", "feature": True,
+         "min": -5, "max": 5},
+        {"name": "x2", "ordinal": 2, "dataType": "double", "feature": True,
+         "min": -5, "max": 5},
+        {"name": "label", "ordinal": 3, "dataType": "categorical",
+         "cardinality": ["neg", "pos"]},
+    ]
+})
+
+
+def sep_rows(n=200, seed=11):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(n):
+        y = i % 2
+        x1 = rng.normal(1.5 if y else -1.5, 1.0)
+        x2 = rng.normal(1.0 if y else -1.0, 1.0)
+        rows.append([f"r{i}", f"{x1:.4f}", f"{x2:.4f}", "pos" if y else "neg"])
+    return rows
+
+
+def test_gradient_step_oracle():
+    rows = sep_rows(50)
+    t = encode_rows(rows, SCHEMA)
+    params = LR.LogisticParams(pos_class_value="pos", learning_rate=0.5)
+    tr = LR.LogisticTrainer(SCHEMA, params)
+    X, y = tr.design_matrix(t)
+    w0 = np.array([0.1, -0.2, 0.3])
+    w1, ll = tr.step(w0, X, y)
+    p = 1 / (1 + np.exp(-(X @ w0)))
+    grad = X.T @ (y - p)
+    want = w0 + 0.5 * grad / len(y)
+    np.testing.assert_allclose(w1, want, rtol=1e-4)
+    assert ll > 0
+
+
+def test_percent_diff_and_criteria():
+    params = LR.LogisticParams(pos_class_value="pos",
+                               convergence_criteria=LR.ALL_BELOW_THRESHOLD,
+                               convergence_threshold=5.0)
+    h = [np.array([1.0, 2.0]), np.array([1.04, 2.06])]
+    assert LR.check_convergence(h, params)           # 4% and 3%
+    h2 = [np.array([1.0, 2.0]), np.array([1.2, 2.01])]
+    assert not LR.check_convergence(h2, params)      # 20% breaks 'all'
+    params_avg = LR.LogisticParams(
+        pos_class_value="pos",
+        convergence_criteria=LR.AVERAGE_BELOW_THRESHOLD,
+        convergence_threshold=11.0)
+    assert LR.check_convergence(h2, params_avg)      # mean(20, 0.5) = 10.25
+    params_iter = LR.LogisticParams(pos_class_value="pos",
+                                    convergence_criteria=LR.ITER_LIMIT,
+                                    iteration_limit=2)
+    assert LR.check_convergence(h, params_iter)
+    assert not LR.check_convergence(h[:1], params_iter)
+    with pytest.raises(ValueError):
+        LR.check_convergence(h, LR.LogisticParams(
+            pos_class_value="pos", convergence_criteria="bogus"))
+
+
+def test_train_resume_from_history():
+    t = encode_rows(sep_rows(100), SCHEMA)
+    params = LR.LogisticParams(pos_class_value="pos", learning_rate=1.0,
+                               convergence_criteria=LR.ITER_LIMIT,
+                               iteration_limit=6)
+    tr = LR.LogisticTrainer(SCHEMA, params)
+    w_all, hist_all, _ = tr.train(t)
+    # run 3, then resume with the saved history: identical trajectory
+    params3 = LR.LogisticParams(pos_class_value="pos", learning_rate=1.0,
+                                convergence_criteria=LR.ITER_LIMIT,
+                                iteration_limit=3)
+    w3, hist3, _ = LR.LogisticTrainer(SCHEMA, params3).train(t)
+    lines = [LR.format_coefficients(h) for h in hist3]
+    resumed_hist = LR.parse_history(lines)
+    w_res, hist_res, extra = tr.train(t, resumed_hist)
+    assert extra == 3 and len(hist_res) == 6
+    np.testing.assert_allclose(w_res, w_all, rtol=1e-5)
+
+
+def test_sklearn_parity_accuracy():
+    sklearn = pytest.importorskip("sklearn.linear_model")
+    t = encode_rows(sep_rows(300), SCHEMA)
+    params = LR.LogisticParams(pos_class_value="pos", learning_rate=2.0,
+                               convergence_criteria=LR.AVERAGE_BELOW_THRESHOLD,
+                               convergence_threshold=0.01)
+    tr = LR.LogisticTrainer(SCHEMA, params)
+    w, hist, iters = tr.train(t, max_extra_iterations=5000)
+    codes = tr.predict(t, w)
+    acc_ours = (codes == t.class_codes()).mean()
+    X = np.stack([t.columns[1], t.columns[2]], axis=1)
+    y = t.class_codes()
+    sk = sklearn.LogisticRegression(C=1e6).fit(X, y)
+    acc_sk = sk.score(X, y)
+    assert acc_ours >= acc_sk - 0.02
+    # coefficient direction agrees
+    assert np.sign(w[1]) == np.sign(sk.coef_[0][0])
+    assert np.sign(w[2]) == np.sign(sk.coef_[0][1])
+
+
+def test_cli_train_predict_round_trip(tmp_path):
+    schema_path = tmp_path / "schema.json"
+    schema_path.write_text(json.dumps({
+        "fields": [
+            {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+            {"name": "x1", "ordinal": 1, "dataType": "double", "feature": True,
+             "min": -5, "max": 5},
+            {"name": "x2", "ordinal": 2, "dataType": "double", "feature": True,
+             "min": -5, "max": 5},
+            {"name": "label", "ordinal": 3, "dataType": "categorical",
+             "cardinality": ["neg", "pos"]},
+        ]}))
+    rows = sep_rows(200)
+    (tmp_path / "train.csv").write_text(
+        "\n".join(",".join(r) for r in rows) + "\n")
+    coeff = tmp_path / "coeff.csv"
+    props = tmp_path / "lr.properties"
+    props.write_text("\n".join([
+        f"feature.schema.file.path={schema_path}",
+        f"coeff.file.path={coeff}",
+        "positive.class.value=pos",
+        "learning.rate=2.0",
+        "convergence.criteria=averageBelowThreshold",
+        "convergence.threshold=0.05",
+        "validation.mode=true"]) + "\n")
+    rc = cli_run.main(["logisticRegression", f"-Dconf.path={props}",
+                       str(tmp_path / "train.csv"), str(tmp_path / "model")])
+    assert rc == 0
+    hist = coeff.read_text().splitlines()
+    assert len(hist) >= 2
+    rc = cli_run.main(["logisticRegressionPredictor", f"-Dconf.path={props}",
+                       str(tmp_path / "train.csv"), str(tmp_path / "pred")])
+    assert rc == 0
+    lines = (tmp_path / "pred" / "part-m-00000").read_text().splitlines()
+    assert len(lines) == 200
+    correct = sum(1 for l in lines
+                  if l.split(",")[3] == l.split(",")[4])
+    assert correct / len(lines) > 0.85
