@@ -1,0 +1,20 @@
+"""Association-rule pack: frequent itemset mining + rule mining.
+
+Parity targets (SURVEY.md §2.8 `association`):
+  * FrequentItemsApriori  (association/FrequentItemsApriori.java:89-343)
+  * InfrequentItemMarker  (association/InfrequentItemMarker.java:77-141)
+  * AssociationRuleMiner  (association/AssociationRuleMiner.java:87-197)
+  * ItemSetList           (association/ItemSetList.java:34-102)
+"""
+
+from .itemsets import (ItemSet, TransactionMatrix, apriori_level,
+                       format_itemset_lines, frequent_itemsets,
+                       mark_infrequent, parse_itemset_lines,
+                       read_transactions)
+from .rules import generate_sublists, mine_rules
+
+__all__ = [
+    "ItemSet", "TransactionMatrix", "apriori_level", "format_itemset_lines",
+    "frequent_itemsets", "mark_infrequent", "parse_itemset_lines",
+    "read_transactions", "generate_sublists", "mine_rules",
+]

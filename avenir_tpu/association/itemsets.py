@@ -1,0 +1,274 @@
+"""Frequent itemset mining — level-wise Apriori, TPU formulation.
+
+Reference behavior (association/FrequentItemsApriori.java):
+  * level 1: count each item's transactions (mapper :138-150, reducer :306-341)
+  * level k: extend each frequent (k-1)-itemset with every co-occurring item
+    of a transaction that contains it, dedup by sorted item tuple, count
+    distinct supporting transactions (mapper :151-218, reducer :306-341)
+  * emit only itemsets with support strictly above ``fia.support.threshold``
+    (reducer :331); support printed with 3 decimals (:334-338)
+  * itemset file format parsed back by ItemSetList (ItemSetList.java:73-84):
+    ``item...,transId...,support`` (trans ids optional)
+
+TPU design: instead of a shuffle keyed on item tuples, transactions are
+encoded once as a boolean membership matrix ``M (n_trans, n_items)`` over the
+item vocabulary.  The support count of a k-item candidate set ``C`` is
+
+    count(C) = sum_t  prod_{j<k} M[t, C_j]
+
+computed for ALL candidates at once as k gathered column blocks multiplied
+elementwise and summed over transactions — a dense batched reduction that XLA
+tiles onto the VPU/MXU, no host-side hashing in the hot path.  Candidate
+generation (combinatorial, data-dependent shapes) stays host-side, exactly as
+the reference keeps it in the mapper.
+
+Note on count-mode parity: with ``fia.emit.trans.id=false`` the reference
+counts *emissions*, which double-counts a transaction that reaches the same
+k-itemset via several (k-1)-subsets (mapper :160-194 has no per-transaction
+dedup).  We always compute the exact distinct-transaction support — identical
+to the reference's transaction-id mode, which is its accurate path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclass
+class ItemSet:
+    """One frequent itemset (ItemSetList.java:65-101)."""
+    items: Tuple[str, ...]
+    trans_ids: List[str] = dc_field(default_factory=list)
+    support: float = 0.0
+    count: int = 0
+
+    def contains_item(self, item: str) -> bool:
+        return item in self.items
+
+    def contains_trans(self, trans_id: str) -> bool:
+        return trans_id in self.trans_ids
+
+
+def parse_itemset_lines(lines: Sequence[str], itemset_length: int,
+                        contains_trans_ids: bool, delim: str = ","
+                        ) -> List[ItemSet]:
+    """Parse the per-level itemset file (ItemSetList.java:45-55): first
+    ``itemset_length`` tokens are items; if ``contains_trans_ids`` the tokens
+    up to the last are transaction ids; the last token is the support."""
+    out: List[ItemSet] = []
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        tokens = line.split(delim)
+        items = tuple(tokens[:itemset_length])
+        trans: List[str] = []
+        if contains_trans_ids:
+            trans = list(tokens[itemset_length:-1])
+        try:
+            support = float(tokens[-1])
+        except ValueError:
+            support = 0.0
+        out.append(ItemSet(items, trans, support))
+    return out
+
+
+def _fmt_support(v: float) -> str:
+    """Utility.formatDouble(support, 3)."""
+    return f"{v:.3f}"
+
+
+def format_itemset_lines(itemsets: Sequence[ItemSet], emit_trans_id: bool,
+                         trans_id_output: bool, delim: str = ","
+                         ) -> List[str]:
+    """Reducer output layout (FrequentItemsApriori.java:331-340):
+    trans-id mode w/ ids: ``items...,transIds...,support``;
+    trans-id mode w/o ids: ``items...,support``;
+    count mode: ``items...,count,support``."""
+    lines = []
+    for s in itemsets:
+        parts = list(s.items)
+        if emit_trans_id:
+            if trans_id_output:
+                parts.extend(s.trans_ids)
+        else:
+            parts.append(str(s.count))
+        parts.append(_fmt_support(s.support))
+        lines.append(delim.join(parts))
+    return lines
+
+
+def read_transactions(rows: Sequence[Sequence[str]], trans_id_ord: int = 0,
+                      skip_field_count: int = 1,
+                      infreq_item_marker: Optional[str] = None
+                      ) -> List[Tuple[str, List[str]]]:
+    """Tokenized CSV rows -> (trans_id, items) with the mapper's field
+    conventions (FrequentItemsApriori.java:135-140,163-167): transaction id at
+    ``trans_id_ord``, items from ``skip_field_count`` on, marked-infrequent
+    tokens dropped."""
+    out = []
+    for row in rows:
+        tid = row[trans_id_ord]
+        items = [t for t in row[skip_field_count:]
+                 if infreq_item_marker is None or t != infreq_item_marker]
+        out.append((tid, items))
+    return out
+
+
+class TransactionMatrix:
+    """Boolean membership matrix over the item vocabulary — the device-side
+    representation of a transaction set."""
+
+    def __init__(self, transactions: Sequence[Tuple[str, List[str]]]):
+        self.trans_ids = [t for t, _ in transactions]
+        vocab: Dict[str, int] = {}
+        for _, items in transactions:
+            for it in items:
+                if it not in vocab:
+                    vocab[it] = len(vocab)
+        self.vocab = vocab
+        self.items = list(vocab)
+        n, m = len(transactions), max(len(vocab), 1)
+        mat = np.zeros((n, m), dtype=np.float32)
+        for r, (_, items) in enumerate(transactions):
+            for it in items:
+                mat[r, vocab[it]] = 1.0
+        self.matrix = mat
+
+    @property
+    def n_trans(self) -> int:
+        return len(self.trans_ids)
+
+    def support_counts(self, cand_idx: np.ndarray,
+                       chunk: int = 1 << 22) -> np.ndarray:
+        """Exact support counts for candidate sets ``cand_idx (n_cand, k)``
+        of vocab indices: a jitted gather-product-reduce on device.
+        Transactions are processed in chunks with float64 host accumulation
+        so counts stay exact past float32's 2^24 integer ceiling."""
+        import jax
+        import jax.numpy as jnp
+
+        if cand_idx.size == 0:
+            return np.zeros((0,), dtype=np.int64)
+
+        @jax.jit
+        def kernel(M, C):
+            acc = jnp.ones((M.shape[0], C.shape[0]), dtype=jnp.float32)
+            for j in range(C.shape[1]):        # k is tiny and static
+                acc = acc * M[:, C[:, j]]
+            return acc.sum(axis=0)
+
+        C = jnp.asarray(cand_idx)
+        total = np.zeros((cand_idx.shape[0],), dtype=np.float64)
+        for lo in range(0, self.matrix.shape[0], chunk):
+            part = kernel(jnp.asarray(self.matrix[lo:lo + chunk]), C)
+            total += np.asarray(part, dtype=np.float64)
+        return np.rint(total).astype(np.int64)
+
+    def supporting_trans(self, item_idx: Sequence[int]) -> List[str]:
+        mask = self.matrix[:, list(item_idx)].all(axis=1)
+        return [tid for tid, m in zip(self.trans_ids, mask) if m]
+
+
+def _level1_candidates(tm: TransactionMatrix) -> np.ndarray:
+    return np.arange(len(tm.items), dtype=np.int32)[:, None]
+
+
+def _extend_candidates(tm: TransactionMatrix, prior: Sequence[ItemSet]
+                       ) -> List[Tuple[str, ...]]:
+    """Candidate k-itemsets: each frequent (k-1)-itemset joined with every
+    item co-occurring in some supporting transaction (mapper :160-194),
+    dedup'd by sorted tuple.  Items absent from the vocabulary (e.g. pruned
+    by the infrequent marker) cannot extend anything."""
+    cands = set()
+    vocab = tm.vocab
+    for s in prior:
+        if any(it not in vocab for it in s.items):
+            continue
+        base_idx = [vocab[it] for it in s.items]
+        sub = tm.matrix[:, base_idx].all(axis=1)          # trans ⊇ itemset
+        co = tm.matrix[sub].any(axis=0)                   # co-occurring items
+        base = set(s.items)
+        for j in np.nonzero(co)[0]:
+            it = tm.items[j]
+            if it not in base:
+                cands.add(tuple(sorted(base | {it})))
+    return sorted(cands)
+
+
+def apriori_level(transactions: Sequence[Tuple[str, List[str]]],
+                  itemset_length: int, total_trans_count: int,
+                  support_threshold: float,
+                  prior: Optional[Sequence[ItemSet]] = None,
+                  emit_trans_id: bool = True) -> List[ItemSet]:
+    """One reference MR pass: frequent itemsets of exactly
+    ``itemset_length`` given the previous level's output (``prior``;
+    required for length > 1).  Support must be strictly above the
+    threshold (reducer :331)."""
+    tm = TransactionMatrix(transactions)
+    if itemset_length == 1:
+        cand_idx = _level1_candidates(tm)
+        cand_items: List[Tuple[str, ...]] = [(it,) for it in tm.items]
+    else:
+        if prior is None:
+            # convenience: chain the lower levels in-process (the reference
+            # re-runs the job per level with the previous output file,
+            # freq_items_apriori_tutorial.txt:33-41)
+            prior = apriori_level(transactions, itemset_length - 1,
+                                  total_trans_count, support_threshold,
+                                  None, emit_trans_id)
+        cand_items = _extend_candidates(tm, prior)
+        cand_idx = np.array(
+            [[tm.vocab[it] for it in items] for items in cand_items],
+            dtype=np.int32).reshape(len(cand_items), itemset_length)
+    counts = tm.support_counts(cand_idx)
+    out: List[ItemSet] = []
+    for items, cnt in zip(cand_items, counts):
+        support = float(cnt) / total_trans_count
+        if support > support_threshold:
+            trans = (tm.supporting_trans([tm.vocab[i] for i in items])
+                     if emit_trans_id else [])
+            out.append(ItemSet(items, trans, support, int(cnt)))
+    out.sort(key=lambda s: s.items)
+    return out
+
+
+def frequent_itemsets(transactions: Sequence[Tuple[str, List[str]]],
+                      support_threshold: float, max_length: int,
+                      total_trans_count: Optional[int] = None,
+                      emit_trans_id: bool = True
+                      ) -> Dict[int, List[ItemSet]]:
+    """Full level-wise run 1..max_length — what ``fit.sh freqItems`` achieves
+    by re-running the job with fia.item.set.length = 1,2,3,...
+    (freq_items_apriori_tutorial.txt:33-41)."""
+    total = (total_trans_count if total_trans_count is not None
+             else len(transactions))
+    levels: Dict[int, List[ItemSet]] = {}
+    prior: Optional[List[ItemSet]] = None
+    for k in range(1, max_length + 1):
+        level = apriori_level(transactions, k, total, support_threshold,
+                              prior, emit_trans_id)
+        if not level:
+            break
+        levels[k] = level
+        prior = level
+    return levels
+
+
+def mark_infrequent(rows: Sequence[Sequence[str]],
+                    frequent_items: Iterable[str], marker: str = "*",
+                    skip_field_count: int = 1) -> List[List[str]]:
+    """Map-only infrequent-item masking (InfrequentItemMarker.java:128-140):
+    every item field not in the frequent level-1 set becomes ``marker``."""
+    freq = set(frequent_items)
+    out = []
+    for row in rows:
+        row = list(row)
+        for i in range(skip_field_count, len(row)):
+            if row[i] not in freq:
+                row[i] = marker
+        out.append(row)
+    return out

@@ -1,0 +1,128 @@
+"""Association-pack job registrations (org.avenir.association.*).
+
+Config-key namespaces follow the reference setup() methods: fia.*
+(FrequentItemsApriori.java:109-128, sample resource/fit.properties:17-24),
+iim.* (InfrequentItemMarker.java:92-123), arm.*
+(AssociationRuleMiner.java:99-106,167-175).
+"""
+
+from __future__ import annotations
+
+from ..core.config import Config
+from ..core.metrics import Counters
+from ..core import artifacts
+from .jobs import register, _splitter
+
+
+def _read_rows(path: str, delim_regex: str):
+    split = _splitter(delim_regex)
+    return [split(line.strip()) for line in artifacts.read_text_input(path)
+            if line.strip()]
+
+
+@register("org.avenir.association.FrequentItemsApriori",
+          "frequentItemsApriori")
+def frequent_items_apriori(cfg: Config, in_path: str, out_path: str
+                           ) -> Counters:
+    """One Apriori level (FrequentItemsApriori.java).  Keys:
+    fia.item.set.length, fia.tans.id.ord, fia.skip.field.count,
+    fia.emit.trans.id, fia.trans.id.output, fia.support.threshold,
+    fia.total.tans.count, fia.item.set.file.path (level > 1),
+    fia.infreq.item.marker."""
+    from ..association import itemsets as IT
+    counters = Counters()
+    length = cfg.must_get_int("fia.item.set.length",
+                              "missing item set length")
+    trans_ord = cfg.must_get_int("fia.tans.id.ord",
+                                 "missing transaction id ordinal")
+    skip = cfg.get_int("fia.skip.field.count", 1)
+    emit_tid = cfg.get_boolean("fia.emit.trans.id", True)
+    tid_out = cfg.get_boolean("fia.trans.id.output", True)
+    threshold = cfg.must_get_float("fia.support.threshold",
+                                   "missing support threshold")
+    total = cfg.must_get_int("fia.total.tans.count",
+                             "missing total transaction count")
+    marker = cfg.get("fia.infreq.item.marker")
+
+    rows = _read_rows(in_path, cfg.field_delim_regex)
+    transactions = IT.read_transactions(rows, trans_ord, skip, marker)
+    prior = None
+    if length > 1:
+        prior = IT.parse_itemset_lines(
+            artifacts.read_text_input(
+                cfg.must_get("fia.item.set.file.path",
+                             "missing item set file")),
+            length - 1, emit_tid,
+            cfg.get("fia.itemset.delim", cfg.field_delim_out))
+    level = IT.apriori_level(transactions, length, total, threshold, prior,
+                             emit_tid)
+    artifacts.write_text_output(
+        out_path,
+        IT.format_itemset_lines(level, emit_tid, tid_out,
+                                cfg.field_delim_out))
+    counters.increment("Apriori", "frequentItemSets", len(level))
+    counters.increment("Apriori", "transactions", len(transactions))
+    return counters
+
+
+@register("org.avenir.association.InfrequentItemMarker",
+          "infrequentItemMarker")
+def infrequent_item_marker(cfg: Config, in_path: str, out_path: str
+                           ) -> Counters:
+    """Map-only infrequent-item masking (InfrequentItemMarker.java).  Keys:
+    iim.item.set.file.path (level-1 itemsets), iim.item.set.length (must be
+    1), iim.contains.trans.id, iim.skip.field.count, iim.infreq.item.marker,
+    iim.itemset.delim."""
+    from ..association import itemsets as IT
+    counters = Counters()
+    length = cfg.must_get_int("iim.item.set.length",
+                              "missing item set length")
+    if length != 1:
+        raise ValueError("expecting item set of length 1")
+    contains_tid = cfg.get_boolean("iim.contains.trans.id", True)
+    skip = cfg.get_int("iim.skip.field.count", 1)
+    marker = cfg.get("iim.infreq.item.marker", "*")
+    itemsets = IT.parse_itemset_lines(
+        artifacts.read_text_input(
+            cfg.must_get("iim.item.set.file.path", "missing item set file")),
+        1, contains_tid, cfg.get("iim.itemset.delim", ","))
+    freq = [s.items[0] for s in itemsets]
+    rows = _read_rows(in_path, cfg.get("iim.field.delim.regex",
+                                       cfg.field_delim_regex))
+    marked = IT.mark_infrequent(rows, freq, marker, skip)
+    delim_out = cfg.get("iim.field.delim.out", cfg.field_delim_out)
+    artifacts.write_text_output(out_path,
+                                [delim_out.join(r) for r in marked])
+    counters.increment("Apriori", "frequentItems", len(freq))
+    return counters
+
+
+@register("org.avenir.association.AssociationRuleMiner",
+          "associationRuleMiner")
+def association_rule_miner(cfg: Config, in_path: str, out_path: str
+                           ) -> Counters:
+    """Rule mining from frequent itemsets (AssociationRuleMiner.java).
+    Keys: arm.conf.threshold, arm.max.ante.size, arm.input.has.count (set
+    when the input is count-mode Apriori output with a count column),
+    arm.input.itemset.length (set when the input is a single-level
+    trans-id-mode Apriori output: first N fields are items, the rest
+    transaction ids + support), arm.output.confidence (extension).
+
+    The standard chained pipeline feeds this job Apriori output produced
+    with ``fia.trans.id.output=false`` (items...,support lines), matching
+    the reference's expected input (RuleMinerMapper :113-118)."""
+    from ..association import rules as RU
+    counters = Counters()
+    threshold = cfg.must_get_float("arm.conf.threshold",
+                                   "missing confidence threshold")
+    max_ante = cfg.get_int("arm.max.ante.size", 3)
+    frequent = RU.parse_frequent_lines(
+        artifacts.read_text_input(in_path), cfg.field_delim_out,
+        cfg.get_boolean("arm.input.has.count", False),
+        cfg.get_int("arm.input.itemset.length"))
+    lines = RU.mine_rules(frequent, threshold, max_ante,
+                          cfg.field_delim_out,
+                          cfg.get_boolean("arm.output.confidence", False))
+    artifacts.write_text_output(out_path, lines)
+    counters.increment("Apriori", "rules", len(lines))
+    return counters

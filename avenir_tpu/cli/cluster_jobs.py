@@ -9,8 +9,6 @@ from __future__ import annotations
 
 from typing import List
 
-import numpy as np
-
 from ..core.config import Config
 from ..core.metrics import Counters
 from ..core import artifacts
@@ -43,13 +41,9 @@ def kmeans_cluster(cfg: Config, in_path: str, out_path: str) -> Counters:
                              cfg.get("kmc.distance.metric", "euclidean"))
     groups = KM.parse_cluster_lines(lines, schema.num_columns, threshold,
                                     cfg.field_delim_out)
-    num, cat = engine.encode_table(table)
-    encoded = (num, cat, np.ones(table.n_rows, np.float32))
-    for _ in range(max(iters, 1)):
-        if not any(g.active for g in groups):
-            break
-        KM.kmeans_one_pass(table, groups, engine, precision, encoded=encoded)
-        counters.increment("Clustering", "iterations")
+    groups, it = KM.run_kmeans(table, groups, engine,
+                               max_iter=max(iters, 1), precision=precision)
+    counters.increment("Clustering", "iterations", it)
     out_lines = KM.format_cluster_lines(groups, cfg.field_delim_out, precision)
     artifacts.write_text_output(out_path, out_lines)
     for g in groups:

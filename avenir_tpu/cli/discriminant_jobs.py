@@ -27,7 +27,7 @@ def _svm_xy(cfg: Config, table, schema):
     if cf.is_categorical:
         pos = cfg.must_get("svm.positive.class.value",
                            "categorical class needs svm.positive.class.value")
-        y = np.where(table.class_codes() == cf.cat_code(pos), 1.0, -1.0)
+        y = np.where(table.class_codes() == cf.must_cat_code(pos), 1.0, -1.0)
     else:
         y = np.where(table.columns[cf.ordinal] > 0, 1.0, -1.0)
     return X, y

@@ -28,6 +28,7 @@ from . import reinforce_jobs  # noqa: F401  (registers reinforce-pack jobs)
 from . import cluster_jobs  # noqa: F401  (registers cluster-pack jobs)
 from . import regress_jobs  # noqa: F401  (registers regress-pack jobs)
 from . import discriminant_jobs  # noqa: F401  (registers discriminant-pack jobs)
+from . import association_jobs  # noqa: F401  (registers association-pack jobs)
 
 
 def parse_args(argv: List[str]):

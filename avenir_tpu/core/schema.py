@@ -107,6 +107,17 @@ class FeatureField:
         except (ValueError, AttributeError):
             return -1
 
+    def must_cat_code(self, value: str) -> int:
+        """Vocabulary code of a categorical value; raises on unknown — for
+        config-supplied values (e.g. positive.class.value) where a typo must
+        not silently become an impossible code of -1."""
+        code = self.cat_code(value)
+        if code < 0:
+            raise ValueError(
+                f"value {value!r} not in cardinality {self.cardinality!r} "
+                f"of field {self.name!r}")
+        return code
+
     def bin_label(self, code: int) -> str:
         """Inverse of encoding: the bin string the reference would emit."""
         if self.is_categorical:

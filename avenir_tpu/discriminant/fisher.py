@@ -88,8 +88,14 @@ def fisher_discriminant(table: ColumnarTable) -> FisherResult:
     onehot = np.zeros((table.n_rows, 2))
     valid = cls >= 0
     onehot[np.arange(table.n_rows)[valid], cls[valid]] = 1.0
-    counts, mean, var = _class_moments(jnp.asarray(X, jnp.float32),
+    # shift by the global per-feature mean before the one-pass moment
+    # contraction: E[x²]-E[x]² in float32 cancels catastrophically for
+    # features with large means; variance is shift-invariant, so centering
+    # first keeps the f32 device path accurate
+    shift = X.mean(axis=0)
+    counts, mean, var = _class_moments(jnp.asarray(X - shift, jnp.float32),
                                        jnp.asarray(onehot, jnp.float32))
+    mean = np.asarray(mean, np.float64) + shift
     counts_np = np.asarray(counts, np.float64)
     if counts_np.min() <= 0:
         missing = card[int(np.argmin(counts_np))]
@@ -97,7 +103,7 @@ def fisher_discriminant(table: ColumnarTable) -> FisherResult:
                          "needs both classes present")
     return FisherResult(attr_ordinals=[f.ordinal for f in num_fields],
                         counts=counts_np,
-                        means=np.asarray(mean, np.float64),
+                        means=mean,
                         variances=np.asarray(var, np.float64))
 
 

@@ -109,7 +109,7 @@ class LogisticTrainer:
         X = np.concatenate([np.ones((table.n_rows, 1), np.float32), feats],
                            axis=1)
         cls = table.class_codes()
-        pos_code = self.schema.class_attr_field.cat_code(
+        pos_code = self.schema.class_attr_field.must_cat_code(
             self.params.pos_class_value)
         y = (cls == pos_code).astype(np.float32)
         return X, y
@@ -161,7 +161,7 @@ class LogisticTrainer:
                 threshold: float = 0.5) -> np.ndarray:
         """Returns class codes: pos_class code where p > threshold."""
         p = self.predict_proba(table, w)
-        pos_code = self.schema.class_attr_field.cat_code(
+        pos_code = self.schema.class_attr_field.must_cat_code(
             self.params.pos_class_value)
         card = self.schema.class_attr_field.cardinality or []
         neg_code = next((c for c in range(len(card)) if c != pos_code),

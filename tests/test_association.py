@@ -1,0 +1,205 @@
+"""Association pack: Apriori levels, infrequent marking, rule mining.
+
+Oracle: brute-force itemset counting over small transaction sets; the
+three-job pipeline mirrors resource/freq_items_apriori_tutorial.txt and
+resource/call_data_rule_mining_tutorial.txt flows.
+"""
+
+from itertools import combinations
+
+import numpy as np
+import pytest
+
+from avenir_tpu.association import (apriori_level, format_itemset_lines,
+                                    frequent_itemsets, generate_sublists,
+                                    mark_infrequent, mine_rules,
+                                    parse_itemset_lines, read_transactions)
+from avenir_tpu.association.rules import parse_frequent_lines
+
+
+def brute_force(transactions, k, threshold, total):
+    """All k-item sets with support strictly above threshold."""
+    items = sorted({it for _, its in transactions for it in its})
+    out = {}
+    for combo in combinations(items, k):
+        cnt = sum(1 for _, its in transactions if set(combo) <= set(its))
+        sup = cnt / total
+        if sup > threshold:
+            out[combo] = cnt
+    return out
+
+
+TRANS = [
+    ("t1", ["milk", "bread", "butter"]),
+    ("t2", ["milk", "bread"]),
+    ("t3", ["milk", "eggs"]),
+    ("t4", ["bread", "butter"]),
+    ("t5", ["milk", "bread", "butter", "eggs"]),
+    ("t6", ["coffee"]),
+]
+
+
+def test_level1_counts_match_bruteforce():
+    level = apriori_level(TRANS, 1, len(TRANS), 0.2)
+    oracle = brute_force(TRANS, 1, 0.2, len(TRANS))
+    got = {s.items: s.count for s in level}
+    assert got == oracle
+    # support strictly above threshold: coffee (1/6 = 0.167) excluded at 0.2
+    assert ("coffee",) not in got
+
+
+@pytest.mark.parametrize("k", [2, 3])
+def test_levelk_matches_bruteforce(k):
+    levels = frequent_itemsets(TRANS, 0.15, k)
+    oracle = brute_force(TRANS, k, 0.15, len(TRANS))
+    got = {s.items: s.count for s in levels.get(k, [])}
+    assert got == oracle
+
+
+def test_trans_ids_tracked():
+    level = apriori_level(TRANS, 2, len(TRANS), 0.15)
+    by_items = {s.items: s for s in level}
+    assert set(by_items[("bread", "milk")].trans_ids) == {"t1", "t2", "t5"}
+    sup = by_items[("bread", "milk")].support
+    assert sup == pytest.approx(3 / 6)
+
+
+def test_itemset_line_roundtrip():
+    level = apriori_level(TRANS, 2, len(TRANS), 0.15)
+    lines = format_itemset_lines(level, emit_trans_id=True,
+                                 trans_id_output=True)
+    parsed = parse_itemset_lines(lines, 2, contains_trans_ids=True)
+    assert [p.items for p in parsed] == [s.items for s in level]
+    assert [set(p.trans_ids) for p in parsed] == \
+        [set(s.trans_ids) for s in level]
+    # count-mode layout: items,count,support
+    cl = format_itemset_lines(level, emit_trans_id=False,
+                              trans_id_output=False)
+    first = cl[0].split(",")
+    assert first[2] == str(level[0].count)
+    assert first[3] == f"{level[0].support:.3f}"
+
+
+def test_random_transactions_vs_bruteforce():
+    rng = np.random.default_rng(7)
+    vocab = [f"i{j}" for j in range(12)]
+    trans = []
+    for t in range(60):
+        n = rng.integers(1, 6)
+        items = list(rng.choice(vocab, size=n, replace=False))
+        trans.append((f"t{t}", items))
+    for k in (1, 2, 3):
+        levels = frequent_itemsets(trans, 0.05, k)
+        oracle = brute_force(trans, k, 0.05, len(trans))
+        got = {s.items: s.count for s in levels.get(k, [])}
+        assert got == oracle, f"level {k} mismatch"
+
+
+def test_mark_infrequent():
+    rows = [["t1", "milk", "caviar"], ["t2", "truffle", "bread"]]
+    marked = mark_infrequent(rows, {"milk", "bread"}, "*",
+                             skip_field_count=1)
+    assert marked == [["t1", "milk", "*"], ["t2", "*", "bread"]]
+
+
+def test_generate_sublists():
+    subs = generate_sublists(["a", "b", "c"], 3)
+    # proper subsets only, sizes 1..2, order preserved
+    assert ("a", "b", "c") not in subs
+    assert ("a",) in subs and ("a", "c") in subs
+    assert len(subs) == 6
+
+
+def test_mine_rules_confidence():
+    frequent = [
+        (("bread",), 4 / 6), (("milk",), 4 / 6), (("butter",), 3 / 6),
+        (("bread", "milk"), 3 / 6), (("bread", "butter"), 3 / 6),
+        (("bread", "butter", "milk"), 2 / 6),
+    ]
+    rules = mine_rules(frequent, confidence_threshold=0.7)
+    # conf(butter -> bread) = (3/6)/(3/6) = 1.0 > 0.7
+    assert "butter -> bread" in rules
+    # conf(bread -> milk) = (3/6)/(4/6) = 0.75 > 0.7
+    assert "bread -> milk" in rules
+    # conf(milk -> bread,butter) = (2/6)/(4/6) = 0.5 — excluded
+    assert all("-> bread,butter" != r.split(" ", 1)[-1] for r in rules)
+    with_conf = mine_rules(frequent, 0.7, with_confidence=True)
+    assert any(r.endswith("1.000") for r in with_conf)
+
+
+def test_rule_pipeline_from_apriori_output(tmp_path):
+    """frequent-itemsets output -> rule miner input, like the tutorial's
+    chained jobs."""
+    all_levels = frequent_itemsets(TRANS, 0.15, 3)
+    lines = []
+    for k, level in all_levels.items():
+        lines += format_itemset_lines(level, emit_trans_id=True,
+                                      trans_id_output=False)
+    frequent = parse_frequent_lines(lines)
+    rules = mine_rules(frequent, 0.9)
+    assert "butter -> bread" in rules      # butter always with bread
+
+
+def test_read_transactions_skip_and_marker():
+    rows = [["t1", "x", "milk", "*"], ["t2", "y", "*", "bread"]]
+    trans = read_transactions(rows, trans_id_ord=0, skip_field_count=2,
+                              infreq_item_marker="*")
+    assert trans == [("t1", ["milk"]), ("t2", ["bread"])]
+
+
+def test_cli_association_jobs(tmp_path):
+    from avenir_tpu.cli import run as cli_run
+    from avenir_tpu.core import artifacts
+
+    csv = tmp_path / "xactions.csv"
+    csv.write_text("\n".join(
+        f"{tid},{','.join(items)}" for tid, items in TRANS))
+    props = tmp_path / "fit.properties"
+    lvl1 = tmp_path / "lvl1"
+    props.write_text(
+        "field.delim.regex=,\nfield.delim.out=,\n"
+        "fia.item.set.length=1\nfia.tans.id.ord=0\n"
+        "fia.skip.field.count=1\nfia.support.threshold=0.2\n"
+        f"fia.total.tans.count={len(TRANS)}\n"
+        f"fia.item.set.file.path={lvl1}/part-r-00000\n"
+        f"iim.item.set.file.path={lvl1}/part-r-00000\n"
+        "iim.item.set.length=1\n"
+        "arm.conf.threshold=0.9\n")
+    rc = cli_run.main(["org.avenir.association.FrequentItemsApriori",
+                       f"-Dconf.path={props}", str(csv), str(lvl1)])
+    assert rc == 0
+    lvl1_lines = artifacts.read_text_input(str(lvl1))
+    assert any(line.startswith("milk") for line in lvl1_lines)
+
+    # mark infrequent items, then level-2 on the marked data
+    marked = tmp_path / "marked"
+    rc = cli_run.main(["org.avenir.association.InfrequentItemMarker",
+                       f"-Dconf.path={props}", str(csv), str(marked)])
+    assert rc == 0
+    marked_lines = artifacts.read_text_input(str(marked))
+    assert any("*" in line for line in marked_lines)   # coffee masked
+
+    props2 = tmp_path / "fit2.properties"
+    props2.write_text(props.read_text().replace(
+        "fia.item.set.length=1", "fia.item.set.length=2")
+        + "fia.infreq.item.marker=*\nfia.trans.id.output=false\n")
+    lvl2 = tmp_path / "lvl2"
+    rc = cli_run.main(["org.avenir.association.FrequentItemsApriori",
+                       f"-Dconf.path={props2}", str(marked), str(lvl2)])
+    assert rc == 0
+    lvl2_lines = artifacts.read_text_input(str(lvl2))
+    assert any(line.startswith("bread,milk") for line in lvl2_lines)
+
+    # rules from the union of level outputs
+    allsets = tmp_path / "allsets"
+    allsets.mkdir()
+    (allsets / "part-r-00000").write_text("\n".join(
+        [ln.rsplit(",", 1)[0].split(",")[0] + "," + ln.rsplit(",", 1)[1]
+         for ln in lvl1_lines] + lvl2_lines))
+    rules_out = tmp_path / "rules"
+    rc = cli_run.main(["org.avenir.association.AssociationRuleMiner",
+                       f"-Dconf.path={props}", str(allsets / "part-r-00000"),
+                       str(rules_out)])
+    assert rc == 0
+    rule_lines = artifacts.read_text_input(str(rules_out))
+    assert any("->" in line for line in rule_lines)

@@ -185,3 +185,33 @@ def test_fisher_cli(tmp_path):
     assert len(lines) == 2  # two numeric attrs
     ords = [int(l.split(",")[0]) for l in lines]
     assert ords == [1, 2]
+
+
+def test_fisher_large_mean_no_cancellation():
+    """float32 one-pass moments cancel for features with large means; the
+    shifted formulation must recover the true variances."""
+    import json
+    from avenir_tpu.core.schema import FeatureSchema
+    from avenir_tpu.core.table import load_csv_text
+    from avenir_tpu.discriminant.fisher import fisher_discriminant
+
+    rng = np.random.default_rng(0)
+    n = 2000
+    schema = FeatureSchema.from_dict({"fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "x", "ordinal": 1, "dataType": "double", "feature": True},
+        {"name": "cls", "ordinal": 2, "dataType": "categorical",
+         "cardinality": ["a", "b"]},
+    ]})
+    lines = []
+    for i in range(n):
+        is_a = i % 2 == 0
+        mu = 10000.0 if is_a else 10003.0
+        lines.append(f"r{i},{rng.normal(mu, 1.0):.6f},{'a' if is_a else 'b'}")
+    table = load_csv_text("\n".join(lines), schema)
+    res = fisher_discriminant(table)
+    assert res.variances[0, 0] == pytest.approx(1.0, rel=0.15)
+    assert res.variances[1, 0] == pytest.approx(1.0, rel=0.15)
+    _, pooled, dv = res.boundary(0)
+    assert pooled == pytest.approx(1.0, rel=0.15)
+    assert 10000.0 < dv < 10003.0
