@@ -70,14 +70,14 @@ def mine_rules(frequent: Sequence[Tuple[Tuple[str, ...], float]],
     for items, total_support in frequent:
         if len(items) <= 1:
             continue
-        item_set = set(items)
         for ante in generate_sublists(list(items), max_antecedent_size):
             ante_support = support.get(tuple(sorted(ante)))
             if ante_support is None or ante_support <= 0.0:
                 continue
             confidence = total_support / ante_support
             if confidence > confidence_threshold:
-                cons = [it for it in items if it not in set(ante)]
+                ante_set = set(ante)
+                cons = [it for it in items if it not in ante_set]
                 line = f"{delim.join(ante)} -> {delim.join(cons)}"
                 if with_confidence:
                     line += f"{delim}{confidence:.3f}"

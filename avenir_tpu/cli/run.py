@@ -29,6 +29,8 @@ from . import cluster_jobs  # noqa: F401  (registers cluster-pack jobs)
 from . import regress_jobs  # noqa: F401  (registers regress-pack jobs)
 from . import discriminant_jobs  # noqa: F401  (registers discriminant-pack jobs)
 from . import association_jobs  # noqa: F401  (registers association-pack jobs)
+from . import text_jobs  # noqa: F401  (registers text-pack + rule jobs)
+from . import partition_jobs  # noqa: F401  (registers split/partition jobs)
 
 
 def parse_args(argv: List[str]):

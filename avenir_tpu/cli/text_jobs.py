@@ -1,0 +1,78 @@
+"""Text-pack + rule-evaluation job registrations.
+
+Namespaces: text.* (text/WordCounter.java:92-96), rue.*
+(explore/RuleEvaluator.java:99-119,210-226).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.config import Config
+from ..core.metrics import Counters
+from ..core import artifacts
+from .jobs import register, _splitter
+
+
+@register("org.avenir.text.WordCounter", "wordCounter")
+def word_counter(cfg: Config, in_path: str, out_path: str) -> Counters:
+    """Word-count MR (text/WordCounter.java).  Keys: text.field.ordinal
+    (whole line when not positive, mapper :102-106)."""
+    from ..text import word_count
+    counters = Counters()
+    ordinal = cfg.get_int("text.field.ordinal", 0)
+    split = _splitter(cfg.field_delim_regex)
+    texts = []
+    for line in artifacts.read_text_input(in_path):
+        line = line.rstrip("\n")
+        if not line:
+            continue
+        texts.append(split(line)[ordinal] if ordinal > 0 else line)
+    pairs = word_count(texts)
+    delim = cfg.field_delim_out
+    artifacts.write_text_output(out_path,
+                                [f"{w}{delim}{c}" for w, c in pairs])
+    counters.increment("WordCount", "distinctWords", len(pairs))
+    counters.increment("WordCount", "totalWords", sum(c for _, c in pairs))
+    return counters
+
+
+@register("org.avenir.explore.RuleEvaluator", "ruleEvaluator")
+def rule_evaluator(cfg: Config, in_path: str, out_path: str) -> Counters:
+    """Rule confidence/support evaluation (explore/RuleEvaluator.java).
+    Keys: rue.rule.names (list), rue.rule.<name> (each ``condition >
+    consequent``), rue.class.attr.ord, rue.conf.strategy
+    (confAccuracy|confEntropy), rue.data.size, rue.class.values,
+    rue.cond.delim (conjunct separator override)."""
+    from ..explore import rules as RU
+    counters = Counters()
+    sep = cfg.get("rue.cond.delim", RU.DEFAULT_CONJUNCT_SEP)
+    names = cfg.must_get_list("rue.rule.names", "missing rule list")
+    rules = {}
+    for name in names:
+        rule = cfg.must_get(f"rue.rule.{name}", "missing rule definition")
+        rules[name] = RU.RuleExpression.create(rule, sep)
+    class_ord = cfg.must_get_int("rue.class.attr.ord",
+                                 "missing class attribute ordinal")
+    strategy = cfg.must_get("rue.conf.strategy",
+                            "missing confidence strategy list")
+    data_size = cfg.must_get_int("rue.data.size", "missing data size")
+    class_values = cfg.must_get_list("rue.class.values",
+                                     "missing class values")
+
+    split = _splitter(cfg.field_delim_regex)
+    rows = [split(line.rstrip("\n"))
+            for line in artifacts.read_text_input(in_path)
+            if line.strip()]
+    n_cols = max(len(r) for r in rows) if rows else 0
+    columns = [np.asarray([r[i] if i < len(r) else "" for r in rows],
+                          dtype=object) for i in range(n_cols)]
+    results = RU.evaluate_rules(rules, columns, class_ord, data_size,
+                                strategy, class_values)
+    delim = cfg.field_delim_out
+    artifacts.write_text_output(
+        out_path,
+        [f"{name}{delim}{conf:.3f}{delim}{sup:.3f}"
+         for name, conf, sup in results])
+    counters.increment("Rules", "evaluated", len(results))
+    return counters

@@ -1,0 +1,6 @@
+"""Text pack (SURVEY.md §2.8 `text`): word counting with analyzer-style
+tokenization (text/WordCounter.java)."""
+
+from .wordcount import STANDARD_STOPWORDS, tokenize, word_count
+
+__all__ = ["STANDARD_STOPWORDS", "tokenize", "word_count"]

@@ -1,0 +1,55 @@
+"""Word counting with analyzer-style tokenization.
+
+Parity target: text/WordCounter.java — mapper tokenizes a text field (or the
+whole line when the ordinal is not positive, :101-106) with Lucene's
+StandardAnalyzer (:93, lowercasing + English stop-word removal), reducer
+counts occurrences and emits ``word<delim>count`` (:135-146).
+
+TPU note: tokenization is host-side string work (as in the reference's
+mapper); the count itself is a vectorized ``np.unique`` over the token array
+— word counting is IO-bound, not a device workload, so no device round-trip
+is forced here.  The Bayesian text mode reuses ``tokenize``.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+# Lucene's ENGLISH_STOP_WORDS_SET, the default for StandardAnalyzer
+# (what text/WordCounter.java:93 instantiates)
+STANDARD_STOPWORDS = frozenset((
+    "a", "an", "and", "are", "as", "at", "be", "but", "by", "for", "if",
+    "in", "into", "is", "it", "no", "not", "of", "on", "or", "such", "that",
+    "the", "their", "then", "there", "these", "they", "this", "to", "was",
+    "will", "with",
+))
+
+_TOKEN_RE = re.compile(r"[a-z0-9']+")
+
+
+def tokenize(text: str, stopwords: frozenset = STANDARD_STOPWORDS
+             ) -> List[str]:
+    """StandardAnalyzer-equivalent tokenization: lowercase, split on
+    non-alphanumeric runs, drop stop words.  (The reference's comment says
+    'stemming' but StandardAnalyzer does not stem; neither do we.)"""
+    tokens = _TOKEN_RE.findall(text.lower())
+    return [t.strip("'") for t in tokens
+            if t.strip("'") and t.strip("'") not in stopwords]
+
+
+def word_count(texts: Sequence[str],
+               stopwords: frozenset = STANDARD_STOPWORDS
+               ) -> List[Tuple[str, int]]:
+    """(word, count) sorted by word — the shuffle's key order, so output
+    lines match the reference reducer's emission order."""
+    all_tokens: List[str] = []
+    for text in texts:
+        all_tokens.extend(tokenize(text, stopwords))
+    if not all_tokens:
+        return []
+    words, counts = np.unique(np.asarray(all_tokens, dtype=object),
+                              return_counts=True)
+    return [(str(w), int(c)) for w, c in zip(words, counts)]

@@ -1,0 +1,122 @@
+"""Text pack (word count) + rule expression/evaluator tests."""
+
+import math
+
+import numpy as np
+import pytest
+
+from avenir_tpu.text import tokenize, word_count
+from avenir_tpu.explore.rules import (Conjunct, RuleExpression,
+                                      evaluate_rules)
+
+
+def test_tokenize_lowercase_and_stopwords():
+    toks = tokenize("The quick Brown FOX, and the lazy dog!")
+    assert toks == ["quick", "brown", "fox", "lazy", "dog"]
+
+
+def test_word_count_sorted_and_counted():
+    pairs = word_count(["apple banana apple", "banana Cherry"])
+    assert pairs == [("apple", 2), ("banana", 2), ("cherry", 1)]
+
+
+def test_rule_parse_and_row_eval():
+    r = RuleExpression.create("1 gt 30 and 2 eq high > churn")
+    assert r.consequent == "churn"
+    assert len(r.conjuncts) == 2
+    assert r.evaluate(["id", "42", "high", "x"])
+    assert not r.evaluate(["id", "42", "low", "x"])
+    assert not r.evaluate(["id", "10", "high", "x"])
+
+
+def test_rule_in_notin_ops():
+    r = RuleExpression.create("1 in a:b:c > yes")
+    assert r.evaluate(["x", "b"])
+    assert not r.evaluate(["x", "d"])
+    r2 = RuleExpression.create("1 notin a:b > yes")
+    assert r2.evaluate(["x", "z"])
+
+
+def test_rule_bad_syntax():
+    with pytest.raises(ValueError):
+        RuleExpression.create("1 resembles 30 > y")
+    with pytest.raises(ValueError):
+        RuleExpression.create(" > y")
+
+
+def test_extract_consequent_splits_on_first():
+    assert RuleExpression.extract_consequent("0 gt 1 > big") == "big"
+
+
+def _columns(rows):
+    n = max(len(r) for r in rows)
+    return [np.asarray([r[i] for r in rows], dtype=object)
+            for i in range(n)]
+
+
+ROWS = [
+    ["r1", "40", "high", "churn"],
+    ["r2", "45", "high", "churn"],
+    ["r3", "50", "high", "stay"],
+    ["r4", "10", "low", "stay"],
+    ["r5", "35", "low", "stay"],
+]
+
+
+def test_evaluate_rules_accuracy():
+    rules = {"highUse": RuleExpression.create("1 gt 30 and 2 eq high > churn")}
+    out = evaluate_rules(rules, _columns(ROWS), class_ordinal=3,
+                         data_size=len(ROWS), conf_strategy="confAccuracy",
+                         class_values=["churn", "stay"])
+    name, conf, sup = out[0]
+    assert name == "highUse"
+    assert conf == pytest.approx(2 / 3)     # 2 churn of 3 matched
+    assert sup == pytest.approx(3 / 5)
+
+
+def test_evaluate_rules_entropy():
+    rules = {"r": RuleExpression.create("1 gt 30 and 2 eq high > churn")}
+    out = evaluate_rules(rules, _columns(ROWS), 3, len(ROWS),
+                         "confEntropy", ["churn", "stay"])
+    _, conf, _ = out[0]
+    p, q = 2 / 3, 1 / 3
+    expect = (p * math.log(p) + q * math.log(q)) / math.log(2) + 1.0
+    assert conf == pytest.approx(expect)
+
+
+def test_evaluate_rules_no_match():
+    rules = {"r": RuleExpression.create("1 gt 1000 > churn")}
+    out = evaluate_rules(rules, _columns(ROWS), 3, len(ROWS),
+                         "confAccuracy", ["churn", "stay"])
+    assert out[0][1] == 0.0 and out[0][2] == 0.0
+
+
+def test_cli_wordcount_and_rules(tmp_path):
+    from avenir_tpu.cli import run as cli_run
+    from avenir_tpu.core import artifacts
+
+    doc = tmp_path / "doc.txt"
+    doc.write_text("the cat sat on the mat\ncat and dog\n")
+    props = tmp_path / "t.properties"
+    props.write_text(
+        "field.delim.regex=,\nfield.delim.out=,\n"
+        "rue.rule.names=highUse\n"
+        "rue.rule.highUse=1 gt 30 and 2 eq high > churn\n"
+        "rue.class.attr.ord=3\nrue.conf.strategy=confAccuracy\n"
+        f"rue.data.size={len(ROWS)}\nrue.class.values=churn,stay\n")
+    out = tmp_path / "wc"
+    rc = cli_run.main(["org.avenir.text.WordCounter",
+                       f"-Dconf.path={props}", str(doc), str(out)])
+    assert rc == 0
+    lines = artifacts.read_text_input(str(out))
+    assert "cat,2" in lines
+    assert not any(line.startswith("the,") for line in lines)  # stopword
+
+    data = tmp_path / "data.csv"
+    data.write_text("\n".join(",".join(r) for r in ROWS))
+    rules_out = tmp_path / "rules"
+    rc = cli_run.main(["ruleEvaluator", f"-Dconf.path={props}",
+                       str(data), str(rules_out)])
+    assert rc == 0
+    lines = artifacts.read_text_input(str(rules_out))
+    assert lines == ["highUse,0.667,0.600"]
