@@ -187,3 +187,152 @@ def candidate_generation_with_self_join(cfg: Config, in_path: str,
     artifacts.write_text_output(out_path, (od.join(c) for c in cands))
     counters.increment("GSP", "Candidates", len(cands))
     return counters
+
+
+@register("org.avenir.sequence.SequencePositionalCluster",
+          "sequencePositionalCluster")
+def sequence_positional_cluster(cfg: Config, in_path: str, out_path: str
+                                ) -> Counters:
+    """Event-locality scoring in sliding time windows
+    (sequence/SequencePositionalCluster.java; window analyzer is a
+    re-specified hoidla equivalent, see sequence/positional.py).  Keys
+    (reference setup :105-140, typos preserved): window.time.span,
+    processing.time.step, quant.field.ordinal, seq.num..field.ordinal,
+    wejghter.strategy, weighted.strategies (name=weight list),
+    preferred.strategies, any.cond, min.occurence, max.interval.average,
+    max.interval.max, min.range.length, min.event.time.interval,
+    score.threshold, cond.expression."""
+    from ..sequence.positional import LocalityConfig, positional_cluster
+    counters = Counters()
+    quant_ord = cfg.must_get_int("quant.field.ordinal",
+                                 "missing quantity field ordinal")
+    seq_ord = cfg.get_int("seq.num..field.ordinal",
+                          cfg.get_int("seq.num.field.ordinal"))
+    if seq_ord is None:
+        raise ValueError("missing sequence field ordinal")
+    weighted = cfg.get_boolean("wejghter.strategy",
+                               cfg.get_boolean("weighted.strategy", False))
+    wmap = {}
+    for item in cfg.get_list("weighted.strategies", []):
+        if "=" in item:
+            name, w = item.split("=", 1)
+            wmap[name.strip()] = float(w)
+    config = LocalityConfig(
+        window_time_span=cfg.must_get_int("window.time.span",
+                                          "wondow time span must be specified"),
+        time_step=cfg.must_get_int("processing.time.step",
+                                   "missing window processing time step"),
+        min_event_time_interval=cfg.get_int("min.event.time.interval", 100),
+        weighted=weighted,
+        weighted_strategies=wmap,
+        preferred_strategies=cfg.get_list("preferred.strategies", ["count"]),
+        any_cond=cfg.get_boolean("any.cond", True),
+        min_occurence=cfg.get_int("min.occurence", 2),
+        max_interval_average=cfg.get_float("max.interval.average", 0.0),
+        max_interval_max=cfg.get_float("max.interval.max", 0.0),
+        min_range_length=cfg.get_float("min.range.length", 0.0))
+    threshold = cfg.must_get_float("score.threshold",
+                                   "missing score threshold")
+    rule = None
+    cond_expr = cfg.get("cond.expression")
+    if cond_expr:
+        from ..explore.rules import RuleExpression
+        # condition ordinals are absolute field ordinals over the raw row,
+        # same convention as ruleEvaluator
+        rule = RuleExpression.create(cond_expr + " > _",
+                                     cfg.get("cond.delim", " and "))
+
+    split_line = _splitter(cfg.field_delim_regex)
+    records = []
+    flags = []
+    quants = {}
+    for line in artifacts.read_text_input(in_path):
+        line = line.strip()
+        if not line:
+            continue
+        items = split_line(line)
+        ts = int(items[seq_ord])
+        records.append((ts, float(items[quant_ord])))
+        flags.append(rule.evaluate(items) if rule is not None else True)
+        quants[ts] = items[quant_ord]
+    results = positional_cluster(records, config, threshold,
+                                 condition_flags=flags)
+    od = cfg.field_delim_out
+    artifacts.write_text_output(
+        out_path,
+        [f"{ts}{od}{quants[ts]}{od}{score}" for ts, _, score in results])
+    counters.increment("Locality", "scoredAboveThreshold", len(results))
+    return counters
+
+
+@register("org.avenir.spark.markov.ContTimeStateTransitionStats",
+          "contTimeStateTransitionStats")
+def cont_time_state_transition_stats(cfg: Config, in_path: str,
+                                     out_path: str) -> Counters:
+    """CTMC uniformization statistics (spark/.../markov/ContTimeState
+    TransitionStats.scala).  Rate matrices per key are read from
+    state.trans.file.path (lines: key fields, then row-major rate matrix);
+    input lines are key fields + initial state [+ end state]; output is
+    key + the statistic.  Keys: key.field.len, state.values, time.horizon,
+    state.trans.stat (stateDwellTime|StateTransitionCount), target.states."""
+    import numpy as np
+    from ..sequence.pst import (ctmc_state_dwell_time,
+                                ctmc_transition_count)
+    counters = Counters()
+    key_len = cfg.must_get_int("key.field.len", "missing key field length")
+    states = cfg.must_get_list("state.values", "missing state values")
+    n = len(states)
+    horizon = cfg.must_get_float("time.horizon", "missing time horizon")
+    stat_kind = cfg.must_get("state.trans.stat", "missing stat kind")
+    targets = [states.index(s) for s in
+               cfg.get_list("target.states", [])]
+    need = 2 if stat_kind == "StateTransitionCount" else 1
+    if len(targets) < need:
+        raise ValueError(f"target.states needs {need} state(s) for "
+                         f"{stat_kind}, got {len(targets)}")
+
+    split_line = _splitter(cfg.field_delim_regex)
+    rates = {}
+    for line in artifacts.read_text_input(
+            cfg.must_get("state.trans.file.path",
+                         "missing state transition rate file")):
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("[") and line.endswith("]"):
+            line = line[1:-1]
+        items = [t.strip() for t in split_line(line)]
+        key = tuple(items[:key_len])
+        mat = np.asarray([float(v) for v in items[key_len:key_len + n * n]]
+                         ).reshape(n, n)
+        rates[key] = mat
+
+    from ..sequence.pst import _uniformization_powers
+    power_cache = {}
+    out_lines = []
+    od = cfg.field_delim_out
+    for line in artifacts.read_text_input(in_path):
+        line = line.strip()
+        if not line:
+            continue
+        items = split_line(line)
+        key = tuple(items[:key_len])
+        init = states.index(items[key_len])
+        end = (states.index(items[key_len + 1])
+               if len(items) > key_len + 1 else None)
+        Q = rates[key]
+        if key not in power_cache:           # one power series per matrix
+            power_cache[key] = _uniformization_powers(Q, horizon)
+        pre = power_cache[key]
+        if stat_kind == "stateDwellTime":
+            stat = ctmc_state_dwell_time(Q, horizon, init, targets[0], end,
+                                         precomputed=pre)
+        elif stat_kind == "StateTransitionCount":
+            stat = ctmc_transition_count(Q, horizon, init, targets[0],
+                                         targets[1], end, precomputed=pre)
+        else:
+            raise ValueError(f"unknown state.trans.stat {stat_kind!r}")
+        out_lines.append(od.join(list(key) + [f"{stat:.6f}"]))
+        counters.increment("CTMC", "records")
+    artifacts.write_text_output(out_path, out_lines)
+    return counters

@@ -1,0 +1,196 @@
+"""Candidate-split scoring (ClassPartitionGenerator) + DataPartitioner."""
+
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core.schema import FeatureSchema
+from avenir_tpu.core.table import load_csv_text
+from avenir_tpu.models import partition as PT
+from avenir_tpu.models.tree import CandidateSplit, Predicate
+
+SCHEMA_DICT = {"fields": [
+    {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+    {"name": "age", "ordinal": 1, "dataType": "int", "feature": True,
+     "min": 0, "max": 90, "splitScanInterval": 30},
+    {"name": "plan", "ordinal": 2, "dataType": "categorical", "feature": True,
+     "cardinality": ["basic", "plus", "pro"], "maxSplit": 2},
+    {"name": "cls", "ordinal": 3, "dataType": "categorical",
+     "cardinality": ["no", "yes"]},
+]}
+SCHEMA = FeatureSchema.from_dict(SCHEMA_DICT)
+
+
+def make_table(n=300, seed=5):
+    rng = np.random.default_rng(seed)
+    lines = []
+    for i in range(n):
+        age = int(rng.integers(0, 91))
+        plan = rng.choice(["basic", "plus", "pro"])
+        # class correlates strongly with age > 45
+        cls = "yes" if (age > 45) == (rng.random() < 0.9) else "no"
+        lines.append(f"r{i},{age},{plan},{cls}")
+    return load_csv_text("\n".join(lines), SCHEMA), lines
+
+
+def test_split_key_formats():
+    num = CandidateSplit(attr=1, predicates=[], thresholds=[30.0, 60.0])
+    assert PT.split_key(num) == "30:60"
+    cat = CandidateSplit(attr=2, predicates=[],
+                         groups=[["basic", "plus"], ["pro"]])
+    assert PT.split_key(cat) == "[basic, plus]:[pro]"
+
+
+def test_parse_split_key_roundtrip():
+    f_num = SCHEMA.find_field_by_ordinal(1)
+    seg, n = PT.parse_split_key(f_num, "30:60")
+    assert n == 3
+    np.testing.assert_array_equal(
+        seg(np.asarray(["10", "30", "31", "60", "75"], dtype=object)),
+        [0, 0, 1, 1, 2])
+    f_cat = SCHEMA.find_field_by_ordinal(2)
+    seg, n = PT.parse_split_key(f_cat, "[basic, plus]:[pro]")
+    assert n == 2
+    np.testing.assert_array_equal(
+        seg(np.asarray(["basic", "pro", "plus"], dtype=object)), [0, 1, 0])
+    with pytest.raises(ValueError):
+        seg(np.asarray(["unknown"], dtype=object))
+
+
+def test_root_info_matches_formula():
+    table, _ = make_table()
+    cls = table.class_codes()
+    p = (cls == 1).mean()     # code 1 == "yes"
+    expect = -(p * math.log2(p) + (1 - p) * math.log2(1 - p))
+    assert PT.root_info(table, "entropy") == pytest.approx(expect, abs=1e-9)
+    assert PT.root_info(table, "giniIndex") == \
+        pytest.approx(1 - p * p - (1 - p) ** 2, abs=1e-9)
+
+
+def oracle_stat(table, attr, seg_fn, n_seg, algo):
+    """Brute-force per-segment class histograms + weighted info."""
+    f = SCHEMA.find_field_by_ordinal(attr)
+    if f.is_categorical:
+        card = f.cardinality
+        vals = np.asarray([card[int(c)] for c in table.columns[attr]],
+                          dtype=object)
+    else:
+        vals = np.asarray([str(v) for v in table.columns[attr]],
+                          dtype=object)
+    segs = seg_fn(vals)
+    cls = table.class_codes()
+    counts = np.zeros((n_seg, 2))
+    for s, c in zip(segs, cls):
+        counts[s, int(c)] += 1
+    tot = counts.sum()
+    stat = 0.0
+    for s in range(n_seg):
+        seg_tot = counts[s].sum()
+        if seg_tot == 0:
+            continue
+        p = counts[s] / seg_tot
+        if algo == "entropy":
+            ent = -sum(pi * math.log2(pi) for pi in p if pi > 0)
+        else:
+            ent = 1 - (p * p).sum()
+        stat += ent * seg_tot / tot
+    return counts, stat
+
+
+def test_scored_splits_match_oracle():
+    table, _ = make_table()
+    parent = PT.root_info(table, "giniIndex")
+    scored = PT.score_candidate_splits(table, [1, 2], "giniIndex", parent)
+    assert scored, "no candidate splits generated"
+    by_key = {(s.attr, s.key): s for s in scored}
+    # check one numeric and one categorical split against brute force
+    for attr, key in [(1, "60"), (2, "[basic, plus]:[pro]")]:
+        f = SCHEMA.find_field_by_ordinal(attr)
+        seg_fn, n_seg = PT.parse_split_key(f, key)
+        counts, stat = oracle_stat(table, attr, seg_fn, n_seg, "giniIndex")
+        seg_tot = counts.sum(axis=1)
+        pr = seg_tot / seg_tot.sum()
+        iv = -sum(p * math.log2(p) for p in pr if p > 0)
+        expect = (parent - stat) / iv
+        assert by_key[(attr, key)].score == pytest.approx(expect, rel=1e-5), \
+            f"{attr} {key}"
+    # the age>45-correlated class should make an age split the winner
+    best = max(scored, key=lambda s: s.score)
+    assert best.attr == 1
+
+
+def test_hellinger_and_class_conf():
+    counts = np.array([[30.0, 5.0], [10.0, 55.0]])
+    n0, n1 = counts.sum(axis=0)
+    expect_h = math.sqrt(
+        (math.sqrt(30 / n0) - math.sqrt(5 / n1)) ** 2 +
+        (math.sqrt(10 / n0) - math.sqrt(55 / n1)) ** 2)
+    assert PT.split_stat(counts, 2, "hellingerDistance") == \
+        pytest.approx(expect_h)
+    ccr = PT.split_stat(counts, 2, "classConfidenceRatio")
+    assert 0.0 <= ccr <= 1.0
+    with pytest.raises(ValueError):
+        PT.split_stat(np.ones((2, 3)), 2, "hellingerDistance")
+
+
+def test_choose_split_best_and_random():
+    lines = ["1;30:60;0.2", "2;[basic, plus]:[pro];0.5", "1;45;0.3"]
+    best = PT.choose_split(lines, SCHEMA, "best")
+    assert best.attr == 2 and best.n_segments == 2 and best.index == 1
+    rnd = PT.choose_split(lines, SCHEMA, "randomFromTop", num_top=2, seed=0)
+    assert rnd.key in ("[basic, plus]:[pro]", "45")
+
+
+def test_partition_rows_routing():
+    table, lines = make_table(50)
+    chosen = PT.ChosenSplit(0, 1, "30:60", 1.0, 3)
+    segments = PT.partition_rows(lines, SCHEMA, chosen)
+    assert sum(len(s) for s in segments) == 50
+    for line in segments[0]:
+        assert int(line.split(",")[1]) <= 30
+    for line in segments[2]:
+        assert int(line.split(",")[1]) > 60
+
+
+def test_cli_partition_pipeline(tmp_path):
+    from avenir_tpu.cli import run as cli_run
+    from avenir_tpu.core import artifacts
+
+    table, lines = make_table(200)
+    schema_path = tmp_path / "schema.json"
+    schema_path.write_text(json.dumps(SCHEMA_DICT))
+    data = tmp_path / "data.csv"
+    data.write_text("\n".join(lines))
+    parent = PT.root_info(table, "giniIndex")
+    props = tmp_path / "p.properties"
+    props.write_text(
+        "field.delim.regex=,\nfield.delim.out=;\n"
+        f"cpg.feature.schema.file.path={schema_path}\n"
+        "cpg.split.algorithm=giniIndex\n"
+        "cpg.split.attributes=1,2\n"
+        f"cpg.parent.info={parent}\n"
+        f"dap.feature.schema.file.path={schema_path}\n"
+        f"dap.candidate.splits.path={tmp_path}/splits/part-r-00000\n")
+    rc = cli_run.main(["org.avenir.explore.ClassPartitionGenerator",
+                       f"-Dconf.path={props}", str(data),
+                       str(tmp_path / "splits")])
+    assert rc == 0
+    split_lines = artifacts.read_text_input(str(tmp_path / "splits"))
+    assert all(len(l.split(";")) == 3 for l in split_lines)
+
+    rc = cli_run.main(["org.avenir.tree.DataPartitioner",
+                       f"-Dconf.path={props}", str(data),
+                       str(tmp_path / "parts")])
+    assert rc == 0
+    split_dirs = os.listdir(tmp_path / "parts")
+    assert len(split_dirs) == 1 and split_dirs[0].startswith("split=")
+    seg_dirs = sorted(os.listdir(tmp_path / "parts" / split_dirs[0]))
+    assert all(d.startswith("segment=") for d in seg_dirs)
+    total = 0
+    for d in seg_dirs:
+        p = tmp_path / "parts" / split_dirs[0] / d / "data" / "partition.txt"
+        total += sum(1 for l in p.read_text().splitlines() if l)
+    assert total == 200

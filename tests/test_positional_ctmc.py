@@ -1,0 +1,175 @@
+"""SequencePositionalCluster window analyzer + CTMC uniformization stats."""
+
+import math
+
+import numpy as np
+import pytest
+from scipy.linalg import expm as _expm  # scipy ships with the image
+
+from avenir_tpu.sequence.positional import (LocalityConfig,
+                                            TimeBoundEventLocalityAnalyzer,
+                                            positional_cluster)
+from avenir_tpu.sequence.pst import (ctmc_state_dwell_time,
+                                     ctmc_transition_count,
+                                     ctmc_transition_probabilities)
+
+
+def burst_records():
+    """Sparse events, then a tight burst."""
+    recs = [(t, 1.0) for t in range(0, 5000, 1000)]
+    recs += [(6000 + i * 150, 5.0) for i in range(8)]
+    return recs
+
+
+def test_burst_scores_above_sparse():
+    cfg = LocalityConfig(window_time_span=2000, time_step=100,
+                         min_event_time_interval=50,
+                         preferred_strategies=["count"], any_cond=True,
+                         min_occurence=4)
+    out = positional_cluster(burst_records(), cfg, 0.5)
+    # only burst-era records reach count>=4 within the window
+    assert out, "burst not detected"
+    assert all(ts >= 6000 for ts, _, _ in out)
+
+
+def test_condition_filters_events():
+    cfg = LocalityConfig(window_time_span=2000, time_step=100,
+                         min_event_time_interval=50,
+                         preferred_strategies=["count"], min_occurence=4)
+    # condition only matches quant > 2 -> sparse 1.0 events never count
+    out = positional_cluster(burst_records(), cfg, 0.5,
+                             condition=lambda q: q > 2)
+    assert out and all(ts >= 6000 for ts, _, _ in out)
+    out_none = positional_cluster(burst_records(), cfg, 0.5,
+                                  condition=lambda q: q > 100)
+    assert out_none == []
+
+
+def test_debounce_and_eviction():
+    cfg = LocalityConfig(window_time_span=1000, time_step=1,
+                         min_event_time_interval=100,
+                         preferred_strategies=["count"], min_occurence=3)
+    a = TimeBoundEventLocalityAnalyzer(cfg)
+    a.add(0, True)
+    a.add(50, True)       # debounced (gap < 100)
+    a.add(200, True)
+    assert a.score == 0.0  # only 2 events counted
+    a.add(400, True)
+    assert a.score == 1.0
+    # 2000 evicts everything older than 1000
+    a.add(2000, True)
+    assert a.score == 0.0
+
+
+def test_weighted_strategy_scores():
+    cfg = LocalityConfig(window_time_span=1000, time_step=1,
+                         min_event_time_interval=10, weighted=True,
+                         weighted_strategies={"count": 0.5,
+                                              "rangeLength": 0.5})
+    a = TimeBoundEventLocalityAnalyzer(cfg)
+    for t in range(0, 1000, 100):
+        a.add(t, True)
+    assert 0.0 < a.score <= 1.0
+
+
+RATE = np.array([
+    [-0.4, 0.3, 0.1],
+    [0.2, -0.5, 0.3],
+    [0.1, 0.2, -0.3],
+])
+
+
+def test_uniformization_matches_expm():
+    for t in (0.5, 2.0, 10.0):
+        P = ctmc_transition_probabilities(RATE, t)
+        np.testing.assert_allclose(P, _expm(RATE * t), atol=2e-4)
+
+
+def test_dwell_time_matches_numerical_integral():
+    """E[time in state s over (0,T) | X0=i] = ∫ P(t)[i,s] dt."""
+    T = 5.0
+    ts = np.linspace(0, T, 2001)
+    pv = np.array([_expm(RATE * t)[0, 1] for t in ts])
+    expect = np.trapezoid(pv, ts)
+    got = ctmc_state_dwell_time(RATE, T, init_state=0, target_state=1)
+    assert got == pytest.approx(expect, rel=0.05)
+
+
+def test_dwell_time_total_is_horizon():
+    """Dwell times over all target states sum to the horizon."""
+    T = 4.0
+    total = sum(ctmc_state_dwell_time(RATE, T, 0, s) for s in range(3))
+    assert total == pytest.approx(T, rel=0.02)
+
+
+def test_transition_count_matches_simulation():
+    """Expected #(1->2) transitions over (0,T) from state 0 ≈ q·T·E[...]
+    validated by Monte Carlo CTMC simulation."""
+    T = 4.0
+    rng = np.random.default_rng(0)
+    n_sim = 4000
+    counts = []
+    for _ in range(n_sim):
+        t, s, c = 0.0, 0, 0
+        while True:
+            rate = -RATE[s, s]
+            t += rng.exponential(1.0 / rate)
+            if t >= T:
+                break
+            probs = RATE[s].copy()
+            probs[s] = 0.0
+            probs = probs / probs.sum()
+            nxt = rng.choice(3, p=probs)
+            if s == 1 and nxt == 2:
+                c += 1
+            s = nxt
+        counts.append(c)
+    expect = float(np.mean(counts))
+    got = ctmc_transition_count(RATE, T, init_state=0, target_one=1,
+                                target_two=2)
+    assert got == pytest.approx(expect, rel=0.15)
+
+
+def test_cli_positional_and_ctmc(tmp_path):
+    from avenir_tpu.cli import run as cli_run
+    from avenir_tpu.core import artifacts
+
+    # positional cluster job
+    data = tmp_path / "events.csv"
+    data.write_text("\n".join(f"{t},{q}" for t, q in burst_records()))
+    props = tmp_path / "s.properties"
+    props.write_text(
+        "field.delim.regex=,\nfield.delim.out=,\n"
+        "window.time.span=2000\nprocessing.time.step=100\n"
+        "quant.field.ordinal=1\nseq.num..field.ordinal=0\n"
+        "wejghter.strategy=false\npreferred.strategies=count\n"
+        "any.cond=true\nmin.occurence=4\nmin.event.time.interval=50\n"
+        "score.threshold=0.5\ncond.expression=1 gt 0\n")
+    out = tmp_path / "bursts"
+    rc = cli_run.main(["org.avenir.sequence.SequencePositionalCluster",
+                       f"-Dconf.path={props}", str(data), str(out)])
+    assert rc == 0
+    lines = artifacts.read_text_input(str(out))
+    assert lines and all(int(l.split(",")[0]) >= 6000 for l in lines)
+
+    # CTMC stats job
+    rates = tmp_path / "rates.csv"
+    flat = ",".join(f"{v}" for v in RATE.flatten())
+    rates.write_text(f"g1,{flat}\n")
+    inp = tmp_path / "init.csv"
+    inp.write_text("g1,up\n")
+    props2 = tmp_path / "c.properties"
+    props2.write_text(
+        "field.delim.regex=,\nfield.delim.out=,\n"
+        "key.field.len=1\nstate.values=up,degraded,down\n"
+        "time.horizon=5.0\nstate.trans.stat=stateDwellTime\n"
+        f"state.trans.file.path={rates}\n"
+        "target.states=degraded\n")
+    out2 = tmp_path / "dwell"
+    rc = cli_run.main(["contTimeStateTransitionStats",
+                       f"-Dconf.path={props2}", str(inp), str(out2)])
+    assert rc == 0
+    lines = artifacts.read_text_input(str(out2))
+    assert len(lines) == 1 and lines[0].startswith("g1,")
+    dwell = float(lines[0].split(",")[1])
+    assert 0.0 < dwell < 5.0
