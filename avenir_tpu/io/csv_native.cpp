@@ -1,0 +1,196 @@
+// Native CSV -> columnar ingest fast path.
+//
+// Replaces the reference's record-at-a-time JVM tokenization (chombo
+// Utility.toStringArray / value.toString().split(fieldDelimRegex) in every
+// mapper, e.g. reference bayesian/BayesianDistribution.java:140) with a
+// single-pass C++ tokenizer feeding preallocated numpy buffers through a
+// minimal C ABI (ctypes on the Python side; no pybind11 in this image).
+//
+// Design: one parse pass indexes every field of every row (pointer + length
+// into the file buffer); column extraction is then a cache-friendly strided
+// walk per requested ordinal.  This matches the columnar table contract of
+// avenir_tpu/core/table.py: numeric -> float64, categorical -> int32 vocab
+// codes (-1 unknown), id/string -> newline-joined byte blob.
+//
+// Build: g++ -O3 -std=c++17 -shared -fPIC (driven by native_csv.py).
+
+#include <cctype>
+#include <charconv>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <string_view>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Parsed {
+    std::string buf;                 // whole file
+    std::vector<const char*> fptr;   // field start pointers
+    std::vector<int32_t> flen;       // field lengths
+    std::vector<int64_t> row_start;  // index into fptr/flen; size n_rows+1
+    int max_fields = 0;
+    std::string scratch;             // joined string-column output, per call
+};
+
+inline bool is_space(char c) {
+    return c == ' ' || c == '\t' || c == '\r' || c == '\n' || c == '\v' || c == '\f';
+}
+
+inline std::string_view trimmed(const char* p, int32_t len) {
+    while (len > 0 && is_space(p[0])) { ++p; --len; }
+    while (len > 0 && is_space(p[len - 1])) --len;
+    return std::string_view(p, static_cast<size_t>(len));
+}
+
+inline bool blank_line(const char* p, const char* end) {
+    for (; p < end; ++p)
+        if (!is_space(*p)) return false;
+    return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Parse the whole file, indexing every field. Returns an opaque handle
+// (nullptr on IO or allocation failure — C++ exceptions must not cross the
+// ctypes boundary).  Blank lines are skipped and '\n', '\r\n' and bare '\r'
+// all terminate lines, matching the python tokenizer (core/table.py
+// _tokenize, which uses str.splitlines).
+void* avt_parse(const char* path, char delim) try {
+    FILE* fh = std::fopen(path, "rb");
+    if (!fh) return nullptr;
+    auto* ps = new Parsed();
+    std::fseek(fh, 0, SEEK_END);
+    long size = std::ftell(fh);
+    std::fseek(fh, 0, SEEK_SET);
+    ps->buf.resize(static_cast<size_t>(size));
+    if (size > 0 && std::fread(ps->buf.data(), 1, static_cast<size_t>(size), fh)
+                        != static_cast<size_t>(size)) {
+        std::fclose(fh);
+        delete ps;
+        return nullptr;
+    }
+    std::fclose(fh);
+
+    const char* p = ps->buf.data();
+    const char* end = p + ps->buf.size();
+    ps->row_start.push_back(0);
+    while (p < end) {
+        const char* line_end = p;
+        while (line_end < end && *line_end != '\n' && *line_end != '\r') ++line_end;
+        if (!blank_line(p, line_end)) {
+            int nf = 0;
+            const char* fs = p;
+            for (const char* q = p;; ++q) {
+                if (q == line_end || *q == delim) {
+                    ps->fptr.push_back(fs);
+                    ps->flen.push_back(static_cast<int32_t>(q - fs));
+                    ++nf;
+                    if (q == line_end) break;
+                    fs = q + 1;
+                }
+            }
+            if (nf > ps->max_fields) ps->max_fields = nf;
+            ps->row_start.push_back(static_cast<int64_t>(ps->fptr.size()));
+        }
+        if (line_end < end && *line_end == '\r'
+            && line_end + 1 < end && line_end[1] == '\n')
+            ++line_end;  // CRLF counts as one terminator
+        p = (line_end < end) ? line_end + 1 : end;
+    }
+    return ps;
+} catch (...) {
+    return nullptr;
+}
+
+int64_t avt_n_rows(void* h) {
+    auto* ps = static_cast<Parsed*>(h);
+    return static_cast<int64_t>(ps->row_start.size()) - 1;
+}
+
+int avt_max_fields(void* h) { return static_cast<Parsed*>(h)->max_fields; }
+
+// Fill out[n_rows] with float64 values of field `ord`.  A trailing '\r' or
+// surrounding blanks are trimmed.  Returns the number of rows that failed to
+// parse (missing field or non-numeric text); caller treats >0 as fatal to
+// match the python path's ValueError.
+int64_t avt_fill_numeric(void* h, int ord, double* out) {
+    auto* ps = static_cast<Parsed*>(h);
+    int64_t n = avt_n_rows(h);
+    int64_t bad = 0;
+    for (int64_t r = 0; r < n; ++r) {
+        int64_t s = ps->row_start[r], e = ps->row_start[r + 1];
+        if (ord >= e - s) { out[r] = 0.0; ++bad; continue; }
+        std::string_view v = trimmed(ps->fptr[s + ord], ps->flen[s + ord]);
+        if (!v.empty() && v[0] == '+')  // python float() accepts a leading '+'
+            v.remove_prefix(1);
+        double d = 0.0;
+        auto res = std::from_chars(v.data(), v.data() + v.size(), d);
+        if (res.ec != std::errc() || res.ptr != v.data() + v.size()) {
+            out[r] = 0.0;
+            ++bad;
+        } else {
+            out[r] = d;
+        }
+    }
+    return bad;
+}
+
+// Fill out[n_rows] with int32 vocab codes of categorical field `ord`
+// (-1 for values not in the vocab, matching table.encode_rows).  vocab is an
+// array of n_vocab NUL-terminated strings.  Returns number of missing-field
+// rows (>0 fatal).
+int64_t avt_fill_categorical(void* h, int ord, const char** vocab, int n_vocab,
+                             int32_t* out) try {
+    auto* ps = static_cast<Parsed*>(h);
+    std::unordered_map<std::string_view, int32_t> map;
+    map.reserve(static_cast<size_t>(n_vocab) * 2);
+    for (int i = 0; i < n_vocab; ++i)
+        map.emplace(std::string_view(vocab[i]), i);
+    int64_t n = avt_n_rows(h);
+    int64_t bad = 0;
+    for (int64_t r = 0; r < n; ++r) {
+        int64_t s = ps->row_start[r], e = ps->row_start[r + 1];
+        if (ord >= e - s) { out[r] = -1; ++bad; continue; }
+        std::string_view v = trimmed(ps->fptr[s + ord], ps->flen[s + ord]);
+        auto it = map.find(v);
+        out[r] = (it == map.end()) ? -1 : it->second;
+    }
+    return bad;
+} catch (...) {
+    return -1;  // allocation failure: caller falls back to the python path
+}
+
+// Join string column `ord` with '\n' into an internal buffer; returns its
+// pointer and writes the byte length to *len_out.  Valid until the next call
+// on this handle.  Missing fields become empty strings ("" rows), counted in
+// *bad_out.
+const char* avt_string_col(void* h, int ord, int64_t* len_out, int64_t* bad_out) try {
+    auto* ps = static_cast<Parsed*>(h);
+    int64_t n = avt_n_rows(h);
+    ps->scratch.clear();
+    int64_t bad = 0;
+    for (int64_t r = 0; r < n; ++r) {
+        if (r) ps->scratch.push_back('\n');
+        int64_t s = ps->row_start[r], e = ps->row_start[r + 1];
+        if (ord >= e - s) { ++bad; continue; }
+        ps->scratch.append(ps->fptr[s + ord],
+                           static_cast<size_t>(ps->flen[s + ord]));
+    }
+    *len_out = static_cast<int64_t>(ps->scratch.size());
+    *bad_out = bad;
+    return ps->scratch.data();
+} catch (...) {
+    *len_out = -1;
+    *bad_out = -1;
+    return nullptr;
+}
+
+void avt_free(void* h) { delete static_cast<Parsed*>(h); }
+
+}  // extern "C"

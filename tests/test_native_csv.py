@@ -1,0 +1,102 @@
+"""Native C++ CSV ingest vs. the pure-python oracle (core/table.py)."""
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core.schema import FeatureSchema
+from avenir_tpu.core.table import load_csv, load_csv_text
+from avenir_tpu.io.native_csv import get_lib, native_load_csv
+
+SCHEMA = FeatureSchema.from_dict({"fields": [
+    {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+    {"name": "plan", "ordinal": 1, "dataType": "categorical", "feature": True,
+     "cardinality": ["basic", "plus", "premium"]},
+    {"name": "minutes", "ordinal": 2, "dataType": "int", "feature": True,
+     "min": 0, "max": 1000, "bucketWidth": 100},
+    {"name": "spend", "ordinal": 3, "dataType": "double", "feature": True},
+    {"name": "status", "ordinal": 4, "dataType": "categorical",
+     "cardinality": ["active", "churned"]},
+]})
+
+pytestmark = pytest.mark.skipif(get_lib() is None,
+                                reason="native csv library unavailable")
+
+
+def _make_csv(n=500, seed=3):
+    rng = np.random.default_rng(seed)
+    plans = ["basic", "plus", "premium", "unknownplan"]
+    stats = ["active", "churned"]
+    lines = []
+    for i in range(n):
+        plan = plans[rng.integers(0, len(plans))]
+        mins = int(rng.integers(0, 1000))
+        spend = round(float(rng.normal(50, 20)), 4)
+        st = stats[rng.integers(0, 2)]
+        lines.append(f"C{i:05d},{plan},{mins},{spend},{st}")
+    lines.insert(7, "   ")  # blank-ish line must be skipped
+    return "\n".join(lines) + "\n"
+
+
+def test_native_matches_python_oracle(tmp_path):
+    text = _make_csv()
+    p = tmp_path / "data.csv"
+    p.write_text(text)
+    native = native_load_csv(str(p), SCHEMA, ",")
+    assert native is not None
+    oracle = load_csv_text(text, SCHEMA)
+    assert native.n_rows == oracle.n_rows == 500
+    for o in (1, 2, 3, 4):
+        np.testing.assert_array_equal(native.columns[o], oracle.columns[o])
+    assert native.str_columns[0] == oracle.str_columns[0]
+    assert (native.columns[1] == -1).any()  # unknown categorical -> -1
+
+
+def test_load_csv_dispatches_to_native(tmp_path, monkeypatch):
+    p = tmp_path / "d.csv"
+    p.write_text(_make_csv(50))
+    called = {}
+    import avenir_tpu.io.native_csv as mod
+    orig = mod.native_load_csv
+
+    def spy(*a, **k):
+        called["yes"] = True
+        return orig(*a, **k)
+
+    monkeypatch.setattr(mod, "native_load_csv", spy)
+    t = load_csv(str(p), SCHEMA)
+    assert called.get("yes") and t.n_rows == 50
+
+
+def test_native_crlf_and_whitespace(tmp_path):
+    p = tmp_path / "crlf.csv"
+    p.write_text("a1, plus ,30,1.5,active\r\na2,basic,40,2.5,churned\r\n")
+    t = native_load_csv(str(p), SCHEMA, ",")
+    oracle = load_csv(str(p), SCHEMA, use_native=False)
+    np.testing.assert_array_equal(t.columns[1], oracle.columns[1])
+    np.testing.assert_array_equal(t.columns[3], oracle.columns[3])
+    assert t.columns[1].tolist() == [1, 0]
+    assert t.str_columns[0] == ["a1", "a2"]
+
+
+def test_native_cr_only_and_plus_sign(tmp_path):
+    p = tmp_path / "cr.csv"
+    p.write_bytes(b"a1,plus,30,+1.5,active\ra2,basic,40,2.5,churned\r")
+    t = native_load_csv(str(p), SCHEMA, ",")
+    oracle = load_csv(str(p), SCHEMA, use_native=False)
+    assert t.n_rows == oracle.n_rows == 2
+    np.testing.assert_array_equal(t.columns[3], oracle.columns[3])
+    assert t.columns[3].tolist() == [1.5, 2.5]
+
+
+def test_native_bad_numeric_raises(tmp_path):
+    p = tmp_path / "bad.csv"
+    p.write_text("a1,plus,notanint,1.5,active\n")
+    with pytest.raises(ValueError):
+        native_load_csv(str(p), SCHEMA, ",")
+
+
+def test_native_short_row_raises(tmp_path):
+    p = tmp_path / "short.csv"
+    p.write_text("a1,plus,30,1.5,active\na2,basic\n")
+    with pytest.raises(ValueError):
+        native_load_csv(str(p), SCHEMA, ",")
