@@ -122,8 +122,11 @@ def encode_rows(rows: List[List[str]], schema: FeatureSchema,
         o = f.ordinal
         if f.is_categorical:
             vocab = {v: i for i, v in enumerate(f.cardinality or [])}
-            col = np.fromiter((vocab.get(r[o].strip(), -1) for r in rows),
-                              dtype=np.int32, count=n)
+            # Contract: categorical values are trimmed of ASCII whitespace
+            # only (not unicode), so the native C++ path is bit-identical.
+            col = np.fromiter(
+                (vocab.get(r[o].strip(" \t\r\n\v\f"), -1) for r in rows),
+                dtype=np.int32, count=n)
             columns[o] = col
         elif f.is_numeric:
             col = np.fromiter((float(r[o]) for r in rows), dtype=np.float64, count=n)
@@ -150,8 +153,10 @@ def load_csv(source: Union[str, io.TextIOBase], schema: FeatureSchema,
                 t = native_load_csv(source, schema, delim_regex, keep_raw=keep_raw)
                 if t is not None:
                     return t
+            except ValueError:
+                raise  # malformed data: surface it, same as the python path
             except Exception:
-                pass  # fall back to python path
+                pass  # infra failure (no toolchain, bad .so): python fallback
         with open(source, "r") as fh:
             text = fh.read()
     else:

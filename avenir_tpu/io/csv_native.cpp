@@ -20,6 +20,7 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <memory>
 #include <string>
 #include <string_view>
 #include <unordered_map>
@@ -62,20 +63,18 @@ extern "C" {
 // all terminate lines, matching the python tokenizer (core/table.py
 // _tokenize, which uses str.splitlines).
 void* avt_parse(const char* path, char delim) try {
-    FILE* fh = std::fopen(path, "rb");
+    std::unique_ptr<FILE, int (*)(FILE*)> fh(std::fopen(path, "rb"), std::fclose);
     if (!fh) return nullptr;
-    auto* ps = new Parsed();
-    std::fseek(fh, 0, SEEK_END);
-    long size = std::ftell(fh);
-    std::fseek(fh, 0, SEEK_SET);
+    auto ps = std::make_unique<Parsed>();
+    std::fseek(fh.get(), 0, SEEK_END);
+    long size = std::ftell(fh.get());
+    if (size < 0) return nullptr;  // pipe/special file: no fast path
+    std::fseek(fh.get(), 0, SEEK_SET);
     ps->buf.resize(static_cast<size_t>(size));
-    if (size > 0 && std::fread(ps->buf.data(), 1, static_cast<size_t>(size), fh)
-                        != static_cast<size_t>(size)) {
-        std::fclose(fh);
-        delete ps;
+    if (size > 0 && std::fread(ps->buf.data(), 1, static_cast<size_t>(size),
+                               fh.get()) != static_cast<size_t>(size))
         return nullptr;
-    }
-    std::fclose(fh);
+    fh.reset();
 
     const char* p = ps->buf.data();
     const char* end = p + ps->buf.size();
@@ -103,7 +102,7 @@ void* avt_parse(const char* path, char delim) try {
             ++line_end;  // CRLF counts as one terminator
         p = (line_end < end) ? line_end + 1 : end;
     }
-    return ps;
+    return ps.release();
 } catch (...) {
     return nullptr;
 }

@@ -1,0 +1,139 @@
+"""Pallas TPU kernels for the framework's hot counting ops.
+
+The universal primitive of the rebuild is the coded histogram: every Hadoop
+reducer in the reference is "sum 1s per composite key" (SURVEY.md §2.10), and
+ops/histogram.py expresses that as XLA one-hot contractions.  Those
+materialize an (n, F, K) one-hot in HBM between fusion boundaries; the Pallas
+version here streams row tiles HBM->VMEM and keeps the (F, K) accumulator
+resident in VMEM across the whole grid, so HBM traffic is just the codes read
+once — the op is bandwidth-bound and this is its roofline.
+
+Everything degrades gracefully: on non-TPU backends the kernel runs in
+interpreter mode (tests), and callers fall back to the XLA path if pallas is
+unavailable.
+
+NOTE on this dev environment: the tunneled 'axon' TPU platform cannot compile
+Mosaic kernels (even a trivial pallas_call hangs), so production code paths
+default to the XLA one-hot formulation (ops/histogram.py) and the pallas path
+is opt-in via use_pallas flags / AVENIR_TPU_USE_PALLAS=1 for real TPU
+deployments, where the VMEM-resident accumulator avoids the HBM round trip of
+the one-hot intermediate.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+try:  # pallas is part of jax, but guard for exotic builds
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    HAVE_PALLAS = True
+except Exception:  # pragma: no cover
+    HAVE_PALLAS = False
+
+# VMEM budget for the per-tile one-hot intermediate (float32 words).
+_ONEHOT_BUDGET = 2 << 20  # 2M f32 = 8 MB
+
+
+def _auto_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _pick_tile(n_cols: int, num_codes: int, requested: Optional[int]) -> int:
+    if requested is not None:
+        return requested
+    tile = _ONEHOT_BUDGET // max(n_cols * num_codes, 1)
+    tile = max(256, min(4096, tile))
+    return (tile // 8) * 8  # sublane-aligned
+
+
+@partial(jax.jit, static_argnames=("num_codes", "tile", "interpret"))
+def coded_histogram(codes: jnp.ndarray, num_codes: int,
+                    tile: Optional[int] = None,
+                    interpret: Optional[bool] = None) -> jnp.ndarray:
+    """counts[f, k] = #rows with codes[row, f] == k, for k in [0, num_codes).
+
+    ``codes`` is (n, F) int32 with invalid/masked entries already set to a
+    negative value (they count toward nothing).  This is the shared kernel
+    behind class-bin histograms (codes = class*B + bin), tree node
+    histograms (codes = (node*C + class)*B + bin), and contingency tables.
+    """
+    if interpret is None:
+        interpret = _auto_interpret()
+    n, F = codes.shape
+    if n == 0:  # grid=(0,) would never run the zero-init step
+        return jnp.zeros((F, num_codes), dtype=jnp.float32)
+    tile = _pick_tile(F, num_codes, tile)
+    pad = (-n) % tile
+    codes = jnp.pad(codes, ((0, pad), (0, 0)), constant_values=-1)
+    n_tiles = codes.shape[0] // tile
+
+    def kernel(codes_ref, out_ref):
+        @pl.when(pl.program_id(0) == 0)
+        def _():
+            out_ref[:] = jnp.zeros_like(out_ref)
+        c = codes_ref[:]                                           # (tile, F)
+        k = jax.lax.broadcasted_iota(jnp.int32, (tile, F, num_codes), 2)
+        oh = (c[:, :, None] == k).astype(jnp.float32)              # (tile,F,K)
+        out_ref[:] += oh.sum(axis=0)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(n_tiles,),
+        in_specs=[pl.BlockSpec((tile, F), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((F, num_codes), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((F, num_codes), jnp.float32),
+        interpret=interpret,
+    )(codes)
+
+
+def class_bin_histogram_pallas(class_codes: jnp.ndarray,  # (n,)
+                               bin_codes: jnp.ndarray,    # (n, F)
+                               num_classes: int, num_bins: int,
+                               mask: Optional[jnp.ndarray] = None,
+                               tile: Optional[int] = None,
+                               interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Drop-in pallas fast path for ops.histogram.class_bin_histogram:
+    counts[c, f, b] of shape (C, F, B)."""
+    valid = (bin_codes >= 0) & (bin_codes < num_bins)
+    if mask is not None:
+        valid = valid & mask[:, None]
+    combined = class_codes[:, None].astype(jnp.int32) * num_bins \
+        + bin_codes.astype(jnp.int32)
+    combined = jnp.where(valid, combined, -1)
+    flat = coded_histogram(combined, num_classes * num_bins,
+                           tile=tile, interpret=interpret)     # (F, C*B)
+    F = bin_codes.shape[1]
+    return flat.reshape(F, num_classes, num_bins).transpose(1, 0, 2)
+
+
+def node_class_bin_histogram_pallas(node_codes: jnp.ndarray,   # (n,)
+                                    class_codes: jnp.ndarray,  # (n,)
+                                    bin_codes: jnp.ndarray,    # (n, F)
+                                    num_nodes: int, num_classes: int,
+                                    num_bins: int,
+                                    mask: Optional[jnp.ndarray] = None,
+                                    tile: Optional[int] = None,
+                                    interpret: Optional[bool] = None
+                                    ) -> jnp.ndarray:
+    """counts[node, c, f, b] — the decision-tree frontier histogram (one
+    level of DecisionTreeBuilder's reducer accumulation, reference
+    tree/DecisionTreeBuilder.java:730-767) in a single kernel launch.
+    Negative node codes (records that left the frontier) count nowhere."""
+    valid = (bin_codes >= 0) & (bin_codes < num_bins) \
+        & (node_codes >= 0)[:, None] & (class_codes >= 0)[:, None]
+    if mask is not None:
+        valid = valid & mask[:, None]
+    base = (node_codes.astype(jnp.int32) * num_classes
+            + class_codes.astype(jnp.int32)) * num_bins
+    combined = jnp.where(valid, base[:, None] + bin_codes.astype(jnp.int32), -1)
+    K = num_nodes * num_classes * num_bins
+    flat = coded_histogram(combined, K, tile=tile, interpret=interpret)
+    F = bin_codes.shape[1]
+    return flat.reshape(F, num_nodes, num_classes, num_bins).transpose(1, 2, 0, 3)
