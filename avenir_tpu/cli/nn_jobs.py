@@ -1,0 +1,104 @@
+"""Neural-net pack jobs: the reference's single-node NN trainer
+(python/supv/basic_nn.py, invoked as ``basic_nn.py <num_hidden_units>
+<data_set_size> <noise> <iteration_count> <learning_rate> <training_mode>``)
+rebuilt as schema-driven CSV-in/CSV-out jobs with a saved model artifact.
+
+Config keys (nn.* namespace, mirroring the script's arguments):
+nn.hidden.units, nn.iteration.count, nn.learning.rate, nn.reg.lambda,
+nn.training.mode (batch|incr|minibatch), nn.batch.size,
+nn.validation.interval, nn.model.file.path, nn.validation.data.file.path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.config import Config
+from ..core.metrics import Counters, ConfusionMatrix
+from ..core import artifacts
+from ..core.table import load_csv
+from .jobs import register, _schema_path
+
+
+def _xy(table, schema):
+    X = table.feature_matrix(dtype=np.float32)
+    y = table.class_codes().astype(np.int32)
+    return X, y
+
+
+@register("org.avenir.supv.NeuralNetworkTrainer", "neuralNetwork")
+def neural_network_trainer(cfg: Config, in_path: str, out_path: str) -> Counters:
+    from ..nn import mlp
+    counters = Counters()
+    schema = _schema_path(cfg, "feature.schema.file.path")
+    table = load_csv(in_path, schema, cfg.field_delim_regex)
+    X, y = _xy(table, schema)
+    n_classes = len(schema.class_attr_field.cardinality or []) or int(y.max()) + 1
+    mcfg = mlp.MLPConfig(
+        hidden_dim=cfg.get_int("nn.hidden.units", 3),
+        n_classes=n_classes,
+        learning_rate=cfg.get_float("nn.learning.rate", 0.01),
+        reg_lambda=cfg.get_float("nn.reg.lambda", 0.01),
+        mode=cfg.get("nn.training.mode", "batch"),
+        iterations=cfg.get_int("nn.iteration.count", 1000),
+        batch_size=cfg.get_int("nn.batch.size", 64),
+        seed=cfg.get_int("nn.random.seed", 0),
+        validation_interval=cfg.get_int("nn.validation.interval", 50),
+    )
+    val_path = cfg.get("nn.validation.data.file.path")
+    Xv = yv = None
+    if val_path:
+        vt = load_csv(val_path, schema, cfg.field_delim_regex)
+        Xv, yv = _xy(vt, schema)
+    params, losses = mlp.train(X, y, mcfg, X_val=Xv, y_val=yv)
+    od = cfg.field_delim_out
+    lines = mlp.to_lines(params, od)
+    artifacts.write_text_output(out_path, lines)
+    model_path = cfg.get("nn.model.file.path")
+    if model_path:
+        with open(model_path, "w") as fh:
+            fh.write("\n".join(lines) + "\n")
+    acc = float((np.asarray(mlp.predict(params, X)) == y).mean())
+    counters.set("NeuralNetwork", "trainAccuracyPct", int(round(acc * 100)))
+    counters.set("NeuralNetwork", "finalLossE6",
+                 int(round(float(losses[-1]) * 1e6)))
+    counters.set("NeuralNetwork", "lossEvaluations", len(losses))
+    return counters
+
+
+@register("org.avenir.supv.NeuralNetworkPredictor", "neuralNetworkPredictor")
+def neural_network_predictor(cfg: Config, in_path: str, out_path: str) -> Counters:
+    from ..nn import mlp
+    counters = Counters()
+    schema = _schema_path(cfg, "feature.schema.file.path")
+    od = cfg.field_delim_out
+    params = mlp.from_lines(
+        artifacts.read_text_input(cfg.must_get("nn.model.file.path")), od)
+    table = load_csv(in_path, schema, cfg.field_delim_regex, keep_raw=True)
+    X = table.feature_matrix(dtype=np.float32)
+    pred = np.asarray(mlp.predict(params, X))
+    probs = np.asarray(mlp.predict_proba(params, X))
+    class_field = schema.class_attr_field
+    values = class_field.cardinality or [str(i) for i in
+                                         range(probs.shape[1])]
+    lines = []
+    for i, raw in enumerate(table.raw_rows):
+        p = int(round(float(probs[i, pred[i]]) * 100))
+        lines.append(od.join(raw + [values[pred[i]], str(p)]))
+    artifacts.write_text_output(out_path, lines, role="m")
+    if class_field.ordinal in table.columns:
+        actual = np.asarray(table.class_codes())
+        known = actual >= 0
+        correct = int((pred[known] == actual[known]).sum())
+        total = int(known.sum())
+        counters.set("Validation", "Correct", correct)
+        counters.set("Validation", "Incorrect", total - correct)
+        if total:
+            counters.set("Validation", "Accuracy",
+                         int(100 * correct / total))
+        if len(values) == 2:
+            cm = ConfusionMatrix(values[0], values[1])
+            cm.report_batch(pred[known] == 1, actual[known] == 1,
+                            actual[known] == 0)
+            cm.export(counters)
+    return counters

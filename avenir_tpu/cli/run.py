@@ -31,6 +31,7 @@ from . import discriminant_jobs  # noqa: F401  (registers discriminant-pack jobs
 from . import association_jobs  # noqa: F401  (registers association-pack jobs)
 from . import text_jobs  # noqa: F401  (registers text-pack + rule jobs)
 from . import partition_jobs  # noqa: F401  (registers split/partition jobs)
+from . import nn_jobs  # noqa: F401  (registers neural-net jobs)
 
 
 def parse_args(argv: List[str]):

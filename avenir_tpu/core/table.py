@@ -153,10 +153,13 @@ def load_csv(source: Union[str, io.TextIOBase], schema: FeatureSchema,
                 t = native_load_csv(source, schema, delim_regex, keep_raw=keep_raw)
                 if t is not None:
                     return t
-            except ValueError:
-                raise  # malformed data: surface it, same as the python path
             except Exception:
-                pass  # infra failure (no toolchain, bad .so): python fallback
+                # Includes ValueError: the C++ float grammar is stricter than
+                # python's (no '1_0', no unicode digits), so re-parse with the
+                # python oracle — behavior must not depend on whether the .so
+                # built.  Genuinely malformed fields then raise from the
+                # python path below; infra failures just take the slow path.
+                pass
         with open(source, "r") as fh:
             text = fh.read()
     else:

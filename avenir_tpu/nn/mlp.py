@@ -1,0 +1,235 @@
+"""Single-hidden-layer MLP classifier (the reference's neural-net component,
+python/supv/basic_nn.py): tanh hidden layer, softmax output, cross-entropy
+loss with L2 regularization on the weight matrices (not biases), trained by
+plain gradient descent in either full-batch mode ("batch",
+basic_nn.py build_model_batch) or shuffled per-example SGD ("incr",
+build_model_incr), plus a TPU-friendly "minibatch" mode the reference lacks.
+
+TPU-first redesign: parameters are a pytree, the update step is jitted and
+`lax.scan`ned so an entire training run is one XLA program; the incremental
+mode scans over a fresh random permutation per epoch instead of a Python
+loop; `train_ensemble` vmaps whole training runs across seeds to train N
+replicas in parallel on one chip (the reference trains one model per process
+invocation)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+Params = Dict[str, jnp.ndarray]
+
+
+@dataclass
+class MLPConfig:
+    hidden_dim: int = 3
+    n_classes: int = 2
+    learning_rate: float = 0.01      # epsilon (basic_nn.py:31)
+    reg_lambda: float = 0.01         # reg_lambda (basic_nn.py:85)
+    mode: str = "batch"              # batch | incr | minibatch
+    iterations: int = 1000           # num_passes
+    batch_size: int = 64             # minibatch mode only
+    seed: int = 0
+    validation_interval: int = 50    # loss recorded every this many passes
+
+
+def init_params(n_features: int, cfg: MLPConfig, key=None) -> Params:
+    """randn/sqrt(fan_in) init, zero biases (basic_nn.py:126-129)."""
+    key = key if key is not None else jax.random.PRNGKey(cfg.seed)
+    k1, k2 = jax.random.split(key)
+    return {
+        "W1": jax.random.normal(k1, (n_features, cfg.hidden_dim))
+        / jnp.sqrt(n_features),
+        "b1": jnp.zeros((cfg.hidden_dim,)),
+        "W2": jax.random.normal(k2, (cfg.hidden_dim, cfg.n_classes))
+        / jnp.sqrt(cfg.hidden_dim),
+        "b2": jnp.zeros((cfg.n_classes,)),
+    }
+
+
+def forward_logits(params: Params, X: jnp.ndarray) -> jnp.ndarray:
+    a1 = jnp.tanh(X @ params["W1"] + params["b1"])
+    return a1 @ params["W2"] + params["b2"]
+
+
+def predict_proba(params: Params, X: jnp.ndarray) -> jnp.ndarray:
+    return jax.nn.softmax(forward_logits(params, X), axis=-1)
+
+
+def predict(params: Params, X: jnp.ndarray) -> jnp.ndarray:
+    return jnp.argmax(forward_logits(params, X), axis=-1)
+
+
+def loss_fn(params: Params, X: jnp.ndarray, y: jnp.ndarray,
+            reg_lambda: float) -> jnp.ndarray:
+    """Mean cross-entropy + (lambda/2)(|W1|^2+|W2|^2)/n, matching the
+    reference's calculate_loss normalization (basic_nn.py:87-103: total
+    data loss plus full reg term, all divided by n)."""
+    logits = forward_logits(params, X)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    n = X.shape[0]
+    ce = -logp[jnp.arange(n), y].sum()
+    reg = 0.5 * reg_lambda * ((params["W1"] ** 2).sum()
+                              + (params["W2"] ** 2).sum())
+    return (ce + reg) / n
+
+
+def _grad_step(params: Params, X, y, lr: float, reg_lambda: float) -> Params:
+    """One GD step on the UN-normalized loss with reg gradient lambda*W —
+    exactly the reference's batch update (basic_nn.py:141-160: summed
+    delta3, dW += reg_lambda*W, W -= epsilon*dW)."""
+    def raw_loss(p):
+        logits = forward_logits(p, X)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ce = -logp[jnp.arange(X.shape[0]), y].sum()
+        reg = 0.5 * reg_lambda * ((p["W1"] ** 2).sum() + (p["W2"] ** 2).sum())
+        return ce + reg
+
+    grads = jax.grad(raw_loss)(params)
+    return jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
+
+
+@partial(jax.jit, static_argnames=("cfg_iters", "interval"))
+def _train_batch(params: Params, X, y, Xv, yv, lr, reg_lambda,
+                 cfg_iters: int, interval: int):
+    def step(p, _):
+        p = _grad_step(p, X, y, lr, reg_lambda)
+        return p, loss_fn(p, Xv, yv, reg_lambda)
+
+    params, losses = jax.lax.scan(step, params, None, length=cfg_iters)
+    return params, losses[::max(interval, 1)]
+
+
+@partial(jax.jit, static_argnames=("cfg_iters", "interval"))
+def _train_incr(params: Params, X, y, Xv, yv, lr, reg_lambda, key,
+                cfg_iters: int, interval: int):
+    n = X.shape[0]
+
+    def epoch(carry, _):
+        p, key = carry
+        key, sub = jax.random.split(key)
+        order = jax.random.permutation(sub, n)
+
+        def ex_step(p, j):
+            return _grad_step(p, X[j][None], y[j][None], lr, reg_lambda), 0.0
+
+        p, _ = jax.lax.scan(ex_step, p, order)
+        return (p, key), loss_fn(p, Xv, yv, reg_lambda)
+
+    (params, _), losses = jax.lax.scan(epoch, (params, key), None,
+                                       length=cfg_iters)
+    return params, losses[::max(interval, 1)]
+
+
+@partial(jax.jit, static_argnames=("cfg_iters", "interval", "batch_size"))
+def _train_minibatch(params: Params, X, y, Xv, yv, lr, reg_lambda, key,
+                     cfg_iters: int, interval: int, batch_size: int):
+    n = X.shape[0]
+
+    def epoch(carry, _):
+        p, key = carry
+        key, sub = jax.random.split(key)
+        order = jax.random.permutation(sub, n)
+        n_batches = n // batch_size
+        batches = order[:n_batches * batch_size].reshape(n_batches, batch_size)
+
+        def mb_step(p, idx):
+            return _grad_step(p, X[idx], y[idx], lr, reg_lambda), 0.0
+
+        p, _ = jax.lax.scan(mb_step, p, batches)
+        return (p, key), loss_fn(p, Xv, yv, reg_lambda)
+
+    (params, _), losses = jax.lax.scan(epoch, (params, key), None,
+                                       length=cfg_iters)
+    return params, losses[::max(interval, 1)]
+
+
+def train(X: np.ndarray, y: np.ndarray, cfg: MLPConfig,
+          X_val: Optional[np.ndarray] = None,
+          y_val: Optional[np.ndarray] = None
+          ) -> Tuple[Params, np.ndarray]:
+    """Train per cfg.mode; returns (params, validation-loss history sampled
+    every cfg.validation_interval passes).  Falls back to training loss when
+    no validation split is given (basic_nn.py use_validation_data)."""
+    X = jnp.asarray(X, jnp.float32)
+    y = jnp.asarray(y, jnp.int32)
+    Xv = jnp.asarray(X_val, jnp.float32) if X_val is not None else X
+    yv = jnp.asarray(y_val, jnp.int32) if y_val is not None else y
+    params = init_params(X.shape[1], cfg)
+    key = jax.random.PRNGKey(cfg.seed + 1)
+    if cfg.mode == "batch":
+        params, losses = _train_batch(params, X, y, Xv, yv, cfg.learning_rate,
+                                      cfg.reg_lambda, cfg.iterations,
+                                      cfg.validation_interval)
+    elif cfg.mode == "incr":
+        params, losses = _train_incr(params, X, y, Xv, yv, cfg.learning_rate,
+                                     cfg.reg_lambda, key, cfg.iterations,
+                                     cfg.validation_interval)
+    elif cfg.mode == "minibatch":
+        params, losses = _train_minibatch(
+            params, X, y, Xv, yv, cfg.learning_rate, cfg.reg_lambda, key,
+            cfg.iterations, cfg.validation_interval, cfg.batch_size)
+    else:
+        raise ValueError(f"invalid training mode {cfg.mode!r} "
+                         "(batch | incr | minibatch)")
+    return params, np.asarray(losses)
+
+
+def train_ensemble(X: np.ndarray, y: np.ndarray, cfg: MLPConfig,
+                   seeds: Sequence[int]) -> Params:
+    """vmap full batch-mode training runs over seeds: returns stacked params
+    with a leading replica axis.  N independent models in one XLA program."""
+    X = jnp.asarray(X, jnp.float32)
+    y = jnp.asarray(y, jnp.int32)
+
+    def one(seed):
+        p = init_params(X.shape[1], cfg, key=jax.random.PRNGKey(seed))
+        p, _ = _train_batch(p, X, y, X, y, cfg.learning_rate, cfg.reg_lambda,
+                            cfg.iterations, cfg.validation_interval)
+        return p
+
+    return jax.vmap(one)(jnp.asarray(list(seeds), dtype=jnp.uint32))
+
+
+def ensemble_predict(stacked: Params, X: np.ndarray) -> jnp.ndarray:
+    """Majority vote over the replica axis of train_ensemble output."""
+    X = jnp.asarray(X, jnp.float32)
+    probs = jax.vmap(lambda p: predict_proba(p, X))(stacked)   # (R, n, C)
+    return jnp.argmax(probs.mean(axis=0), axis=-1)
+
+
+# ---- model artifact (CSV lines, core.artifacts contract) ----
+
+def to_lines(params: Params, delim: str = ",") -> List[str]:
+    lines = []
+    for name in ("W1", "b1", "W2", "b2"):
+        arr = np.asarray(params[name])
+        arr2 = arr.reshape(1, -1) if arr.ndim == 1 else arr
+        lines.append(f"#{name}{delim}{arr2.shape[0]}{delim}{arr2.shape[1]}")
+        for row in arr2:
+            lines.append(delim.join(repr(float(v)) for v in row))
+    return lines
+
+
+def from_lines(lines: Sequence[str], delim: str = ",") -> Params:
+    params: Params = {}
+    i = 0
+    while i < len(lines):
+        head = lines[i].strip()
+        if not head.startswith("#"):
+            i += 1
+            continue
+        name, r, c = head[1:].split(delim)
+        r, c = int(r), int(c)
+        rows = [[float(v) for v in lines[i + 1 + k].split(delim)]
+                for k in range(r)]
+        arr = jnp.asarray(np.asarray(rows))
+        params[name] = arr[0] if name.startswith("b") else arr
+        i += 1 + r
+    return params

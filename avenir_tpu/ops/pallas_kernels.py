@@ -46,9 +46,11 @@ def _auto_interpret() -> bool:
 def _pick_tile(n_cols: int, num_codes: int, requested: Optional[int]) -> int:
     if requested is not None:
         return requested
+    # the (tile, F, K) one-hot must fit the VMEM budget; the budget wins over
+    # the efficiency floor, never the other way around (large K shrinks tile)
     tile = _ONEHOT_BUDGET // max(n_cols * num_codes, 1)
-    tile = max(256, min(4096, tile))
-    return (tile // 8) * 8  # sublane-aligned
+    tile = min(4096, tile)
+    return max(8, (tile // 8) * 8)  # sublane-aligned
 
 
 @partial(jax.jit, static_argnames=("num_codes", "tile", "interpret"))

@@ -1,0 +1,115 @@
+"""MLP pack vs. the reference NN's behavior (python/supv/basic_nn.py):
+tanh hidden layer + softmax, batch and incremental GD, L2 on weights."""
+
+import numpy as np
+import pytest
+
+from avenir_tpu.nn import mlp
+
+
+def make_moons(n=200, noise=0.15, seed=0):
+    rng = np.random.default_rng(seed)
+    n2 = n // 2
+    t = rng.random(n2) * np.pi
+    x_outer = np.c_[np.cos(t), np.sin(t)]
+    x_inner = np.c_[1.0 - np.cos(t), 0.5 - np.sin(t)]
+    X = np.vstack([x_outer, x_inner]) + rng.normal(0, noise, (n, 2))
+    y = np.r_[np.zeros(n2, int), np.ones(n2, int)]
+    return X.astype(np.float32), y
+
+
+def _accuracy(params, X, y):
+    return float((np.asarray(mlp.predict(params, X)) == y).mean())
+
+
+def test_batch_mode_learns_moons():
+    X, y = make_moons(240)
+    cfg = mlp.MLPConfig(hidden_dim=6, learning_rate=0.01, iterations=800,
+                        validation_interval=100)
+    params, losses = mlp.train(X, y, cfg)
+    assert _accuracy(params, X, y) > 0.9
+    assert losses[-1] < losses[0]  # loss decreased
+
+
+def test_incr_mode_learns():
+    X, y = make_moons(80, noise=0.08)
+    cfg = mlp.MLPConfig(hidden_dim=8, learning_rate=0.1, reg_lambda=0.001,
+                        iterations=50, mode="incr", validation_interval=5)
+    params, _ = mlp.train(X, y, cfg)
+    assert _accuracy(params, X, y) > 0.9
+
+
+def test_minibatch_mode_learns():
+    X, y = make_moons(200)
+    cfg = mlp.MLPConfig(hidden_dim=6, learning_rate=0.02, iterations=40,
+                        mode="minibatch", batch_size=32)
+    params, _ = mlp.train(X, y, cfg)
+    assert _accuracy(params, X, y) > 0.9
+
+
+def test_validation_split_used():
+    X, y = make_moons(200)
+    Xv, yv = make_moons(60, seed=9)
+    cfg = mlp.MLPConfig(hidden_dim=4, iterations=100, validation_interval=10)
+    _, losses = mlp.train(X, y, cfg, X_val=Xv, y_val=yv)
+    assert len(losses) == 10
+
+
+def test_serialization_roundtrip(tmp_path):
+    X, y = make_moons(100)
+    cfg = mlp.MLPConfig(hidden_dim=3, iterations=50)
+    params, _ = mlp.train(X, y, cfg)
+    lines = mlp.to_lines(params)
+    back = mlp.from_lines(lines)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(params[k]), np.asarray(back[k]))
+    np.testing.assert_array_equal(np.asarray(mlp.predict(params, X)),
+                                  np.asarray(mlp.predict(back, X)))
+
+
+def test_ensemble_votes():
+    X, y = make_moons(160)
+    cfg = mlp.MLPConfig(hidden_dim=6, learning_rate=0.01, iterations=500)
+    stacked = mlp.train_ensemble(X, y, cfg, seeds=[0, 1, 2])
+    assert np.asarray(stacked["W1"]).shape[0] == 3
+    pred = np.asarray(mlp.ensemble_predict(stacked, X))
+    assert (pred == y).mean() > 0.9
+
+
+def test_invalid_mode_raises():
+    X, y = make_moons(40)
+    with pytest.raises(ValueError):
+        mlp.train(X, y, mlp.MLPConfig(mode="bogus"))
+
+
+def test_matches_numpy_oracle_one_step():
+    """One batch GD step must equal the reference's hand-written backprop
+    (basic_nn.py:134-160) computed in numpy."""
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(16, 2)).astype(np.float32)
+    y = rng.integers(0, 2, 16)
+    cfg = mlp.MLPConfig(hidden_dim=3, learning_rate=0.05, reg_lambda=0.02)
+    p0 = mlp.init_params(2, cfg)
+    W1, b1 = np.asarray(p0["W1"], np.float64), np.asarray(p0["b1"], np.float64)
+    W2, b2 = np.asarray(p0["W2"], np.float64), np.asarray(p0["b2"], np.float64)
+    # reference forward/backward
+    z1 = X @ W1 + b1
+    a1 = np.tanh(z1)
+    scores = np.exp(a1 @ W2 + b2)
+    probs = scores / scores.sum(axis=1, keepdims=True)
+    d3 = probs.copy()
+    d3[np.arange(16), y] -= 1
+    dW2 = a1.T @ d3 + cfg.reg_lambda * W2
+    db2 = d3.sum(axis=0)
+    d2 = (d3 @ W2.T) * (1 - a1 ** 2)
+    dW1 = X.T @ d2 + cfg.reg_lambda * W1
+    db1 = d2.sum(axis=0)
+    p1 = mlp._grad_step(p0, X, y, cfg.learning_rate, cfg.reg_lambda)
+    np.testing.assert_allclose(np.asarray(p1["W1"]),
+                               W1 - cfg.learning_rate * dW1, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(p1["W2"]),
+                               W2 - cfg.learning_rate * dW2, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(p1["b1"]),
+                               b1 - cfg.learning_rate * db1, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(p1["b2"]),
+                               b2 - cfg.learning_rate * db2, atol=1e-5)

@@ -1,0 +1,83 @@
+"""End-to-end NN job pipeline: train -> model artifact -> predict via the CLI
+job registry (neural-net equivalent of the reference's basic_nn.py run)."""
+
+import json
+
+import numpy as np
+
+from avenir_tpu.cli import run as cli_run
+
+SCHEMA = {
+    "fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "x1", "ordinal": 1, "dataType": "double", "feature": True},
+        {"name": "x2", "ordinal": 2, "dataType": "double", "feature": True},
+        {"name": "label", "ordinal": 3, "dataType": "categorical",
+         "cardinality": ["neg", "pos"]},
+    ]
+}
+
+
+def gen_csv(path, n=240, seed=0):
+    """Two gaussian blobs, linearly separable-ish."""
+    rng = np.random.default_rng(seed)
+    lines = []
+    for i in range(n):
+        pos = rng.random() < 0.5
+        cx = 1.5 if pos else -1.5
+        x1, x2 = rng.normal(cx, 1.0), rng.normal(cx, 1.0)
+        lines.append(f"r{i},{x1:.4f},{x2:.4f},{'pos' if pos else 'neg'}")
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines))
+
+
+def test_nn_train_predict_pipeline(tmp_path):
+    schema = tmp_path / "nn.json"
+    schema.write_text(json.dumps(SCHEMA))
+    train_csv = tmp_path / "train.csv"
+    gen_csv(str(train_csv))
+    model_file = tmp_path / "nn_model.csv"
+    props = tmp_path / "nn.properties"
+    props.write_text(f"""
+field.delim.regex=,
+feature.schema.file.path={schema}
+nn.hidden.units=4
+nn.iteration.count=300
+nn.learning.rate=0.01
+nn.training.mode=batch
+nn.model.file.path={model_file}
+""")
+    rc = cli_run.main(["neuralNetwork", f"-Dconf.path={props}",
+                       str(train_csv), str(tmp_path / "model_out")])
+    assert rc == 0
+    assert model_file.exists()
+
+    rc = cli_run.main(["neuralNetworkPredictor", f"-Dconf.path={props}",
+                       str(train_csv), str(tmp_path / "pred_out")])
+    assert rc == 0
+    out_lines = (tmp_path / "pred_out" / "part-m-00000").read_text().splitlines()
+    assert len(out_lines) == 240
+    correct = sum(1 for ln in out_lines
+                  if ln.split(",")[3] == ln.split(",")[4])
+    assert correct / len(out_lines) > 0.9
+
+
+def test_nn_incr_mode_via_cli(tmp_path):
+    schema = tmp_path / "nn.json"
+    schema.write_text(json.dumps(SCHEMA))
+    train_csv = tmp_path / "train.csv"
+    gen_csv(str(train_csv), n=100)
+    props = tmp_path / "nn.properties"
+    props.write_text(f"""
+field.delim.regex=,
+feature.schema.file.path={schema}
+nn.hidden.units=3
+nn.iteration.count=5
+nn.learning.rate=0.02
+nn.training.mode=incr
+""")
+    rc = cli_run.main(["org.avenir.supv.NeuralNetworkTrainer",
+                       f"-Dconf.path={props}", str(train_csv),
+                       str(tmp_path / "out")])
+    assert rc == 0
+    assert (tmp_path / "out" / "part-r-00000").exists()
