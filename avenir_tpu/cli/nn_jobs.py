@@ -20,10 +20,14 @@ from ..core.table import load_csv
 from .jobs import register, _schema_path
 
 
-def _xy(table, schema):
+def _xy(table):
+    """Feature matrix + class codes, with unknown-label rows (code -1, e.g.
+    typos outside the schema cardinality) dropped rather than silently
+    trained as the last class (negative jnp indexing wraps)."""
     X = table.feature_matrix(dtype=np.float32)
-    y = table.class_codes().astype(np.int32)
-    return X, y
+    y = np.asarray(table.class_codes()).astype(np.int32)
+    known = y >= 0
+    return X[known], y[known]
 
 
 @register("org.avenir.supv.NeuralNetworkTrainer", "neuralNetwork")
@@ -32,8 +36,12 @@ def neural_network_trainer(cfg: Config, in_path: str, out_path: str) -> Counters
     counters = Counters()
     schema = _schema_path(cfg, "feature.schema.file.path")
     table = load_csv(in_path, schema, cfg.field_delim_regex)
-    X, y = _xy(table, schema)
+    X, y = _xy(table)
+    if len(y) == 0:
+        raise ValueError("no trainable rows: every class label is unknown")
     n_classes = len(schema.class_attr_field.cardinality or []) or int(y.max()) + 1
+    if n_classes < 2:
+        raise ValueError(f"need >= 2 classes, got {n_classes}")
     mcfg = mlp.MLPConfig(
         hidden_dim=cfg.get_int("nn.hidden.units", 3),
         n_classes=n_classes,
@@ -49,7 +57,7 @@ def neural_network_trainer(cfg: Config, in_path: str, out_path: str) -> Counters
     Xv = yv = None
     if val_path:
         vt = load_csv(val_path, schema, cfg.field_delim_regex)
-        Xv, yv = _xy(vt, schema)
+        Xv, yv = _xy(vt)
     params, losses = mlp.train(X, y, mcfg, X_val=Xv, y_val=yv)
     od = cfg.field_delim_out
     lines = mlp.to_lines(params, od)
@@ -93,12 +101,13 @@ def neural_network_predictor(cfg: Config, in_path: str, out_path: str) -> Counte
         total = int(known.sum())
         counters.set("Validation", "Correct", correct)
         counters.set("Validation", "Incorrect", total - correct)
-        if total:
-            counters.set("Validation", "Accuracy",
-                         int(100 * correct / total))
         if len(values) == 2:
+            # export() owns the Accuracy/Precision/Recall counters
             cm = ConfusionMatrix(values[0], values[1])
             cm.report_batch(pred[known] == 1, actual[known] == 1,
                             actual[known] == 0)
             cm.export(counters)
+        elif total:
+            counters.set("Validation", "Accuracy",
+                         int(100 * correct / total))
     return counters

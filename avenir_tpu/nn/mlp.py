@@ -98,12 +98,22 @@ def _grad_step(params: Params, X, y, lr: float, reg_lambda: float) -> Params:
 @partial(jax.jit, static_argnames=("cfg_iters", "interval"))
 def _train_batch(params: Params, X, y, Xv, yv, lr, reg_lambda,
                  cfg_iters: int, interval: int):
-    def step(p, _):
-        p = _grad_step(p, X, y, lr, reg_lambda)
+    # nested scan: validation loss is computed once per interval, not per
+    # step (the reference's validation_interval throttling)
+    interval = max(interval, 1)
+    n_outer, rem = divmod(cfg_iters, interval)
+
+    def inner(p, _):
+        return _grad_step(p, X, y, lr, reg_lambda), None
+
+    def outer(p, _):
+        p, _ = jax.lax.scan(inner, p, None, length=interval)
         return p, loss_fn(p, Xv, yv, reg_lambda)
 
-    params, losses = jax.lax.scan(step, params, None, length=cfg_iters)
-    return params, losses[::max(interval, 1)]
+    params, losses = jax.lax.scan(outer, params, None, length=n_outer)
+    if rem:
+        params, _ = jax.lax.scan(inner, params, None, length=rem)
+    return params, losses
 
 
 @partial(jax.jit, static_argnames=("cfg_iters", "interval"))
@@ -198,7 +208,8 @@ def train_ensemble(X: np.ndarray, y: np.ndarray, cfg: MLPConfig,
 
 
 def ensemble_predict(stacked: Params, X: np.ndarray) -> jnp.ndarray:
-    """Majority vote over the replica axis of train_ensemble output."""
+    """Soft vote over the replica axis of train_ensemble output: argmax of
+    the replica-mean class probabilities."""
     X = jnp.asarray(X, jnp.float32)
     probs = jax.vmap(lambda p: predict_proba(p, X))(stacked)   # (R, n, C)
     return jnp.argmax(probs.mean(axis=0), axis=-1)

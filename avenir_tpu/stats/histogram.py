@@ -1,0 +1,84 @@
+"""Host-side histogram utility (reference python/lib/stats.py Histogram and
+the chombo HistogramStat surface the bandit learners use): fixed-width bins
+over [min, min + binWidth*k], with normalize / cumulative distribution /
+percentile / density lookup.  Vectorized over numpy; small and host-side by
+design — device-side counting is ops/histogram.py."""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import numpy as np
+
+
+class Histogram:
+    def __init__(self, xmin: float, bin_width: float, bins: np.ndarray):
+        self.xmin = float(xmin)
+        self.bin_width = float(bin_width)
+        self.bins = np.asarray(bins, dtype=np.float64)
+        self.normalized = False
+
+    # ---- constructors (stats.py:18,33) ----
+    @classmethod
+    def create_initialized(cls, xmin: float, bin_width: float,
+                           values: Sequence[float]) -> "Histogram":
+        return cls(xmin, bin_width, np.asarray(values, dtype=np.float64))
+
+    @classmethod
+    def create_uninitialized(cls, xmin: float, xmax: float,
+                             bin_width: float) -> "Histogram":
+        n = int((xmax - xmin) / bin_width) + 1
+        return cls(xmin, bin_width, np.zeros((n,), dtype=np.float64))
+
+    @property
+    def xmax(self) -> float:
+        return self.xmin + self.bin_width * (len(self.bins) - 1)
+
+    # ---- accumulation (stats.py:44) ----
+    def add(self, value: float) -> None:
+        self.add_many([value])
+
+    def add_many(self, values: Sequence[float]) -> None:
+        idx = ((np.asarray(values, dtype=np.float64) - self.xmin)
+               / self.bin_width).astype(np.int64)
+        idx = np.clip(idx, 0, len(self.bins) - 1)
+        np.add.at(self.bins, idx, 1.0)
+
+    # ---- distribution views (stats.py:52-87) ----
+    def normalize(self) -> None:
+        total = self.bins.sum()
+        if total > 0:
+            self.bins = self.bins / total
+        self.normalized = True
+
+    def cum_distr(self) -> np.ndarray:
+        c = np.cumsum(self.bins)
+        return c / c[-1] if c[-1] > 0 else c
+
+    def percentile(self, percent: float) -> float:
+        """Smallest bin upper edge whose cumulative share >= percent/100."""
+        cum = self.cum_distr()
+        k = int(np.searchsorted(cum, percent / 100.0))
+        k = min(k, len(self.bins) - 1)
+        return self.xmin + self.bin_width * (k + 1)
+
+    def value(self, x: float) -> float:
+        """Density/count of the bin containing x (0 outside range)."""
+        if x < self.xmin:  # int() truncates toward zero: guard explicitly
+            return 0.0
+        k = int((x - self.xmin) / self.bin_width)
+        if k >= len(self.bins):
+            return 0.0
+        return float(self.bins[k])
+
+    def cum_value(self, x: float) -> float:
+        if x < self.xmin:
+            return 0.0
+        k = min(int((x - self.xmin) / self.bin_width), len(self.bins) - 1)
+        return float(self.cum_distr()[k])
+
+    def get_min_max(self) -> Tuple[float, float]:
+        return self.xmin, self.xmax
+
+    def bounded_value(self, x: float) -> float:
+        return min(max(x, self.xmin), self.xmax)

@@ -1,0 +1,165 @@
+"""Samplers (reference python/lib/sampler.py): Gaussian and non-parametric
+rejection samplers and the Metropolis sampler over a histogram target.
+
+TPU-first redesign: the reference draws one value per python-loop iteration;
+here each sampler draws a whole batch per jitted call.  Rejection sampling is
+vectorized as propose-everywhere + mask + gather (a fixed oversampling factor
+with a host retry loop for the rare shortfall), and the Metropolis sampler
+runs N independent chains in parallel (vmap-free — the chains are just a
+batch axis), with a lax.scan over steps."""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .histogram import Histogram
+
+
+# -------------------- rejection samplers --------------------
+
+@partial(jax.jit, static_argnames=("n_draw",))
+def _gauss_reject_batch(key, mean, std, n_draw: int):
+    """Candidates over [mean±3σ] × [0, 1.05 fmax], accept y < f(x)
+    (sampler.py:33-53 GaussianRejectSampler, batched)."""
+    kx, ky = jax.random.split(key)
+    xmin, xmax = mean - 3.0 * std, mean + 3.0 * std
+    fmax = 1.0 / (jnp.sqrt(2.0 * jnp.pi) * std)
+    x = jax.random.uniform(kx, (n_draw,), minval=xmin, maxval=xmax)
+    y = jax.random.uniform(ky, (n_draw,), minval=0.0, maxval=1.05 * fmax)
+    f = fmax * jnp.exp(-((x - mean) ** 2) / (2.0 * std * std))
+    return x, y < f
+
+
+def gaussian_reject_sample(key, mean: float, std: float, n: int) -> np.ndarray:
+    """n samples from N(mean, std) truncated to ±3σ via rejection sampling."""
+    out = np.empty((0,), dtype=np.float64)
+    # acceptance rate is ~1/(1.05*3*sqrt(2/pi)) ≈ 0.38; oversample 3x
+    while len(out) < n:
+        key, sub = jax.random.split(key)
+        x, ok = _gauss_reject_batch(sub, float(mean), float(std), 3 * n)
+        out = np.concatenate([out, np.asarray(x)[np.asarray(ok)]])
+    return out[:n]
+
+
+@partial(jax.jit, static_argnames=("n_draw",))
+def _nonparam_reject_batch(key, xmin, bin_width, values, n_draw: int):
+    kx, ky = jax.random.split(key)
+    n_bins = values.shape[0]
+    xmax = xmin + bin_width * (n_bins - 1)
+    fmax = values.max()
+    x = jax.random.uniform(kx, (n_draw,), minval=xmin, maxval=xmax + bin_width)
+    y = jax.random.uniform(ky, (n_draw,), minval=0.0, maxval=fmax)
+    k = jnp.clip(((x - xmin) / bin_width).astype(jnp.int32), 0, n_bins - 1)
+    return x, y < values[k]
+
+
+def nonparam_reject_sample(key, xmin: float, bin_width: float,
+                           values: Sequence[float], n: int) -> np.ndarray:
+    """n samples from the piecewise-constant density given by per-bin weights
+    (sampler.py:58-83 NonParamRejectSampler, batched; continuous within
+    bins rather than integer-valued)."""
+    vals = jnp.asarray(np.asarray(values, dtype=np.float64))
+    out = np.empty((0,), dtype=np.float64)
+    while len(out) < n:
+        key, sub = jax.random.split(key)
+        x, ok = _nonparam_reject_batch(sub, float(xmin), float(bin_width),
+                                       vals, 4 * n)
+        out = np.concatenate([out, np.asarray(x)[np.asarray(ok)]])
+    return out[:n]
+
+
+def weighted_indices(key, weights: Sequence[float], n: int) -> np.ndarray:
+    """Sample n record indices with probability proportional to weight
+    (python/lib/weighted_rec_sampler.py sample()): the Gumbel-top-1 trick
+    per draw — one (n, len(w)) argmax on device, no rejection loop."""
+    w = jnp.asarray(np.asarray(weights, dtype=np.float64))
+    logw = jnp.log(jnp.maximum(w, 1e-300))
+    g = jax.random.gumbel(key, (n, w.shape[0]))
+    return np.asarray(jnp.argmax(logw[None, :] + g, axis=1))
+
+
+# -------------------- Metropolis sampler --------------------
+
+class MetropolisSampler:
+    """Metropolis chains over a histogram target (sampler.py:86-157
+    MetropolitanSampler): proposal = current + N(0, prop_std) (optionally a
+    mixture with a wider global proposal), clamp to the target's support,
+    accept with min(1, f(next)/f(cur)).
+
+    Runs ``n_chains`` independent chains as a batch; ``sample()`` advances
+    every chain one step, ``sub_sample(skip)`` advances ``skip`` proposal
+    steps before the accept test (the reference's thinning)."""
+
+    def __init__(self, prop_std: float, xmin: float, bin_width: float,
+                 values: Sequence[float], n_chains: int = 1, seed: int = 0):
+        self.hist = Histogram.create_initialized(xmin, bin_width, values)
+        self.prop_std = float(prop_std)
+        self.n_chains = n_chains
+        self.key = jax.random.PRNGKey(seed)
+        self.mixture_threshold: Optional[float] = None
+        self.global_prop_std: Optional[float] = None
+        self._vals = jnp.asarray(self.hist.bins)
+        self._xmin = float(xmin)
+        self._bw = float(bin_width)
+        self._xmax = float(self.hist.xmax)
+        self.initialize()
+
+    def initialize(self) -> None:
+        self.key, sub = jax.random.split(self.key)
+        self.cur = jnp.asarray(jax.random.uniform(
+            sub, (self.n_chains,), minval=self._xmin, maxval=self._xmax))
+        self.trans_count = 0
+
+    def set_global_proposal(self, global_std: float, threshold: float) -> None:
+        """Mixture proposal (sampler.py:110-114): with prob threshold use the
+        local proposal, else the wider global one."""
+        self.global_prop_std = float(global_std)
+        self.mixture_threshold = float(threshold)
+
+    def sample(self) -> np.ndarray:
+        return self.sub_sample(1)
+
+    def sub_sample(self, skip: int) -> np.ndarray:
+        self.key, sub = jax.random.split(self.key)
+        mix = self.mixture_threshold is not None
+        self.cur, n_acc = _metropolis_step(
+            sub, self.cur, self._vals, self._xmin, self._bw, self._xmax,
+            self.prop_std,
+            self.global_prop_std if mix else 0.0,
+            self.mixture_threshold if mix else 1.0,
+            skip, mix)
+        self.trans_count += int(n_acc)
+        return np.asarray(self.cur)
+
+    def run(self, steps: int, skip: int = 1) -> np.ndarray:
+        """(steps, n_chains) trace."""
+        return np.stack([self.sub_sample(skip) for _ in range(steps)])
+
+
+@partial(jax.jit, static_argnames=("skip", "mixture"))
+def _metropolis_step(key, cur, vals, xmin, bw, xmax, prop_std,
+                     global_std, threshold, skip: int, mixture: bool):
+    def density(x):
+        k = jnp.clip(((x - xmin) / bw).astype(jnp.int32), 0, vals.shape[0] - 1)
+        return vals[k]
+
+    def proposal(x, k):
+        kp, km = jax.random.split(k)
+        eps = jax.random.normal(kp, x.shape) * prop_std
+        if mixture:
+            eps_g = jax.random.normal(km, x.shape) * global_std
+            use_local = jax.random.uniform(
+                jax.random.fold_in(km, 1), x.shape) < threshold
+            eps = jnp.where(use_local, eps, eps_g)
+        return jnp.clip(x + eps, xmin, xmax), None
+
+    keys = jax.random.split(key, skip + 1)
+    nxt, _ = jax.lax.scan(proposal, cur, keys[:-1])
+    ratio = density(nxt) / jnp.maximum(density(cur), 1e-300)
+    accept = jax.random.uniform(keys[-1], cur.shape) < jnp.minimum(ratio, 1.0)
+    return jnp.where(accept, nxt, cur), accept.sum()

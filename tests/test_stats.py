@@ -1,0 +1,109 @@
+"""Stats pack: histogram utility, batched rejection/Metropolis samplers,
+MCMC convergence diagnostics (reference python/lib/{stats,sampler,
+mcconverge,weighted_rec_sampler}.py)."""
+
+import numpy as np
+import jax
+
+from avenir_tpu.stats.histogram import Histogram
+from avenir_tpu.stats.mcconverge import GewekeConvergence, RafteryLewisConvergence
+from avenir_tpu.stats import samplers
+
+
+def test_histogram_roundtrip():
+    h = Histogram.create_uninitialized(0.0, 10.0, 1.0)
+    rng = np.random.default_rng(0)
+    vals = rng.uniform(0, 10, 10_000)
+    h.add_many(vals)
+    assert h.bins.sum() == 10_000
+    h.normalize()
+    # uniform data: each of 11 bins ~ uniform except the last edge bin
+    assert abs(h.cum_value(4.9) - 0.5) < 0.05
+    p50 = h.percentile(50)
+    assert 4.0 <= p50 <= 6.0
+    assert h.get_min_max() == (0.0, 10.0)
+    assert h.bounded_value(42.0) == 10.0
+    assert h.value(-5.0) == 0.0
+    assert h.value(-0.5) == 0.0  # int() truncation must not map to bin 0
+    assert h.cum_value(-0.5) == 0.0
+
+
+def test_gaussian_reject_sampler_moments():
+    key = jax.random.PRNGKey(0)
+    s = samplers.gaussian_reject_sample(key, mean=5.0, std=2.0, n=20_000)
+    assert len(s) == 20_000
+    assert abs(s.mean() - 5.0) < 0.1
+    # truncation at ±3σ shaves a little off the std
+    assert abs(s.std() - 2.0) < 0.15
+    assert s.min() >= 5.0 - 6.0 - 1e-9 and s.max() <= 5.0 + 6.0 + 1e-9
+
+
+def test_nonparam_reject_sampler_distribution():
+    key = jax.random.PRNGKey(1)
+    weights = [1.0, 3.0, 6.0, 3.0, 1.0]  # peaked at bin 2
+    s = samplers.nonparam_reject_sample(key, 0.0, 1.0, weights, 30_000)
+    bins = np.clip(s.astype(int), 0, 4)
+    counts = np.bincount(bins, minlength=5).astype(float)
+    frac = counts / counts.sum()
+    expect = np.asarray(weights) / np.sum(weights)
+    np.testing.assert_allclose(frac, expect, atol=0.03)
+
+
+def test_weighted_indices_proportional():
+    key = jax.random.PRNGKey(2)
+    w = [1.0, 2.0, 7.0]
+    idx = samplers.weighted_indices(key, w, 30_000)
+    frac = np.bincount(idx, minlength=3) / 30_000
+    np.testing.assert_allclose(frac, np.asarray(w) / 10.0, atol=0.02)
+
+
+def test_metropolis_converges_to_target():
+    target = [1.0, 2.0, 4.0, 8.0, 4.0, 2.0, 1.0]  # peaked at bin 3
+    m = samplers.MetropolisSampler(prop_std=1.5, xmin=0.0, bin_width=1.0,
+                                   values=target, n_chains=64, seed=3)
+    m.run(300, skip=1)                    # burn-in
+    trace = m.run(400, skip=2)            # thinned sampling
+    bins = np.clip(trace.reshape(-1).astype(int), 0, 6)
+    frac = np.bincount(bins, minlength=7) / bins.size
+    expect = np.asarray(target) / np.sum(target)
+    np.testing.assert_allclose(frac, expect, atol=0.06)
+    assert m.trans_count > 0
+
+
+def test_metropolis_mixture_proposal_runs():
+    m = samplers.MetropolisSampler(1.0, 0.0, 1.0, [1, 2, 3, 2, 1],
+                                   n_chains=8, seed=4)
+    m.set_global_proposal(global_std=4.0, threshold=0.8)
+    out = m.run(50)
+    assert out.shape == (50, 8)
+    assert (out >= 0.0).all() and (out <= 4.0).all()
+
+
+def test_geweke_flags_trend_vs_stationary():
+    rng = np.random.default_rng(5)
+    stationary = rng.normal(0, 1, 4000)
+    trending = np.linspace(0, 3, 4000) + rng.normal(0, 1, 4000)
+    g1 = GewekeConvergence([100])
+    (_, _, z_stat), = g1.calculate_zscore(stationary)
+    g2 = GewekeConvergence([100])
+    (_, _, z_trend), = g2.calculate_zscore(trending)
+    assert abs(z_stat) < 3.0
+    assert abs(z_trend) > 10.0
+
+
+def test_raftery_lewis_sizes():
+    rng = np.random.default_rng(6)
+    # AR(1)-ish chain: correlated, so requires more samples than iid
+    x = np.zeros(20_000)
+    for i in range(1, len(x)):
+        x[i] = 0.7 * x[i - 1] + rng.normal()
+    rl = RafteryLewisConvergence(thinning_interval=1, percent_value_prob=0.95,
+                                 percent_value_conf_interval=0.01,
+                                 trans_prob_conf_limit=0.01)
+    burn_in, n = rl.find_sample_size(x)
+    assert burn_in >= 0
+    assert n > 1000  # 2.5% quantile at r=0.01 needs thousands of draws
+    # thinning scales both linearly
+    rl2 = RafteryLewisConvergence(2, 0.95, 0.01, 0.01)
+    b2, n2 = rl2.find_sample_size(x)
+    assert abs(n2 - 2 * n) < 1e-6
