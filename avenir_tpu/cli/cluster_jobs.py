@@ -7,6 +7,7 @@ kmc.* (cluster/KmeansCluster.java:104-127, including the reference's
 
 from __future__ import annotations
 
+import os
 from typing import List
 
 from ..core.config import Config
@@ -63,10 +64,24 @@ def agglomerative_graphical(cfg: Config, in_path: str, out_path: str
     counters = Counters()
     threshold = cfg.must_get_float("agg.min.av.edge.weight.threshold",
                                    "missing min average edge weight")
-    store = AG.EntityDistanceStore.from_lines(
-        artifacts.read_text_input(cfg.must_get("agg.map.file.dir.path",
-                                               "missing distance map file")),
-        cfg.field_delim_out)
+    map_path = cfg.must_get("agg.map.file.dir.path",
+                            "missing distance map file")
+    ps = None
+    if os.path.isdir(map_path) and os.path.exists(
+            os.path.join(map_path, "index.json")):
+        # persistent MapFile-equivalent store (io.diststore) built by the
+        # entityDistanceStore job: seek-per-key, nothing preloaded
+        from ..io.diststore import EntityDistanceStore as _PStore
+        ps = _PStore(map_path)
+
+        class _LazyStore:
+            def read(self, key):
+                return dict(ps.read(key) or [])
+
+        store = _LazyStore()
+    else:
+        store = AG.EntityDistanceStore.from_lines(
+            artifacts.read_text_input(map_path), cfg.field_delim_out)
     dist_scale = cfg.get_float("agg.dist.scale")
     split = _splitter(cfg.field_delim_regex)
     entity_ids: List[str] = []
@@ -74,9 +89,28 @@ def agglomerative_graphical(cfg: Config, in_path: str, out_path: str
         line = line.strip()
         if line:
             entity_ids.append(split(line)[0])
-    clusters = AG.agglomerative_cluster(entity_ids, store, threshold,
-                                        dist_scale)
+    try:
+        clusters = AG.agglomerative_cluster(entity_ids, store, threshold,
+                                            dist_scale)
+    finally:
+        if ps is not None:
+            ps.close()
     artifacts.write_text_output(
         out_path, [c.to_line(cfg.field_delim_out) for c in clusters])
     counters.increment("Clustering", "clusters", len(clusters))
+    return counters
+
+
+@register("org.avenir.util.EntityDistanceMapFileAccessor",
+          "entityDistanceStore")
+def entity_distance_store(cfg: Config, in_path: str, out_path: str
+                          ) -> Counters:
+    """Build the persistent random-access distance store from entity-distance
+    lines (util/EntityDistanceMapFileAccessor.write :69-92: key = first
+    field, value = the rest).  out_path becomes the store directory."""
+    from ..io.diststore import EntityDistanceStore as _PStore
+    counters = Counters()
+    store = _PStore.write(artifacts.read_text_input(in_path), out_path,
+                          cfg.field_delim_out)
+    counters.set("DistanceStore", "entities", len(store.keys()))
     return counters

@@ -58,6 +58,9 @@ def neural_network_trainer(cfg: Config, in_path: str, out_path: str) -> Counters
     if val_path:
         vt = load_csv(val_path, schema, cfg.field_delim_regex)
         Xv, yv = _xy(vt)
+        if len(yv) == 0:
+            raise ValueError(
+                f"validation file {val_path!r} has no known class labels")
     params, losses = mlp.train(X, y, mcfg, X_val=Xv, y_val=yv)
     od = cfg.field_delim_out
     lines = mlp.to_lines(params, od)

@@ -113,6 +113,8 @@ def _train_batch(params: Params, X, y, Xv, yv, lr, reg_lambda,
     params, losses = jax.lax.scan(outer, params, None, length=n_outer)
     if rem:
         params, _ = jax.lax.scan(inner, params, None, length=rem)
+    if n_outer == 0:  # iterations < interval: still record one final loss
+        losses = loss_fn(params, Xv, yv, reg_lambda)[None]
     return params, losses
 
 

@@ -30,6 +30,7 @@ class GewekeConvergence:
     def calculate_zscore(self, data: Sequence[float]) -> List[Tuple[int, int, float]]:
         x = np.asarray(data, dtype=np.float64)
         n = len(x)
+        self.zscores = []  # one chain per call; no cross-chain mixing
         for bi in self.burn_in_sizes:
             if bi >= n:
                 continue

@@ -92,8 +92,10 @@ class MetropolisSampler:
     accept with min(1, f(next)/f(cur)).
 
     Runs ``n_chains`` independent chains as a batch; ``sample()`` advances
-    every chain one step, ``sub_sample(skip)`` advances ``skip`` proposal
-    steps before the accept test (the reference's thinning)."""
+    every chain one Metropolis transition, ``sub_sample(skip)`` advances
+    ``skip`` full transitions and returns the last state (standard thinning;
+    the reference's subSample re-proposes from the unchanged current sample
+    and keeps only the last proposal, which is a no-op loop — fixed here)."""
 
     def __init__(self, prop_std: float, xmin: float, bin_width: float,
                  values: Sequence[float], n_chains: int = 1, seed: int = 0):
@@ -144,22 +146,27 @@ class MetropolisSampler:
 @partial(jax.jit, static_argnames=("skip", "mixture"))
 def _metropolis_step(key, cur, vals, xmin, bw, xmax, prop_std,
                      global_std, threshold, skip: int, mixture: bool):
+    """``skip`` full Metropolis transitions (propose + accept each), i.e.
+    standard thinning; acceptance count accumulates across all of them."""
     def density(x):
         k = jnp.clip(((x - xmin) / bw).astype(jnp.int32), 0, vals.shape[0] - 1)
         return vals[k]
 
-    def proposal(x, k):
-        kp, km = jax.random.split(k)
+    def transition(carry, k):
+        x, n_acc = carry
+        kp, km, ka = jax.random.split(k, 3)
         eps = jax.random.normal(kp, x.shape) * prop_std
         if mixture:
             eps_g = jax.random.normal(km, x.shape) * global_std
             use_local = jax.random.uniform(
                 jax.random.fold_in(km, 1), x.shape) < threshold
             eps = jnp.where(use_local, eps, eps_g)
-        return jnp.clip(x + eps, xmin, xmax), None
+        nxt = jnp.clip(x + eps, xmin, xmax)
+        ratio = density(nxt) / jnp.maximum(density(x), 1e-300)
+        accept = jax.random.uniform(ka, x.shape) < jnp.minimum(ratio, 1.0)
+        return (jnp.where(accept, nxt, x), n_acc + accept.sum()), None
 
-    keys = jax.random.split(key, skip + 1)
-    nxt, _ = jax.lax.scan(proposal, cur, keys[:-1])
-    ratio = density(nxt) / jnp.maximum(density(cur), 1e-300)
-    accept = jax.random.uniform(keys[-1], cur.shape) < jnp.minimum(ratio, 1.0)
-    return jnp.where(accept, nxt, cur), accept.sum()
+    keys = jax.random.split(key, skip)
+    (cur, n_acc), _ = jax.lax.scan(transition, (cur, jnp.zeros((), jnp.int32)),
+                                   keys)
+    return cur, n_acc

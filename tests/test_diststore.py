@@ -1,0 +1,84 @@
+"""EntityDistanceStore: MapFile-equivalent random access (reference
+util/EntityDistanceMapFileAccessor.java)."""
+
+import pytest
+
+from avenir_tpu.io.diststore import EntityDistanceStore
+
+
+LINES = [
+    "e1,e2,10.5,e3,20.0",
+    "e2,e1,10.5,e3,7.25",
+    "e3,e1,20.0,e2,7.25",
+]
+
+
+def test_write_and_read(tmp_path):
+    store = EntityDistanceStore.write(LINES, str(tmp_path / "store"))
+    with store:
+        assert store.read("e2") == [("e1", 10.5), ("e3", 7.25)]
+        assert store.read("e1") == [("e2", 10.5), ("e3", 20.0)]
+        assert store.read("missing") is None
+        assert sorted(store.keys()) == ["e1", "e2", "e3"]
+
+
+def test_reopen_fresh_handle(tmp_path):
+    EntityDistanceStore.write(LINES, str(tmp_path / "s"))
+    with EntityDistanceStore(str(tmp_path / "s")) as store:
+        assert store.read("e3") == [("e1", 20.0), ("e2", 7.25)]
+        assert store.read_raw("e3") == "e1,20.0,e2,7.25"
+
+
+def test_write_from_file_and_blank_lines(tmp_path):
+    src = tmp_path / "dist.txt"
+    src.write_text("\n".join(LINES + ["", "   "]) + "\n")
+    store = EntityDistanceStore.write_from_file(str(src), str(tmp_path / "s2"))
+    assert len(store.keys()) == 3
+
+
+def test_bad_line_raises(tmp_path):
+    with pytest.raises(ValueError):
+        EntityDistanceStore.write(["nodelimiter"], str(tmp_path / "s3"))
+
+
+def test_custom_delim(tmp_path):
+    store = EntityDistanceStore.write(["a|b|1.0"], str(tmp_path / "s4"),
+                                      delim="|")
+    with EntityDistanceStore(str(tmp_path / "s4")) as s:
+        assert s.read("a") == [("b", 1.0)]
+
+
+def test_store_job_feeds_agglomerative(tmp_path):
+    """CLI pipeline: entityDistanceStore -> agglomerativeGraphical reading
+    the persistent store (reference AgglomerativeGraphical + MapFile)."""
+    from avenir_tpu.cli import run as cli_run
+    dist_file = tmp_path / "dist.txt"
+    # two tight pairs far from each other; similarity weights
+    dist_file.write_text("\n".join([
+        "e1,e2,0.9,e3,0.1,e4,0.1",
+        "e2,e1,0.9,e3,0.1,e4,0.1",
+        "e3,e4,0.9,e1,0.1,e2,0.1",
+        "e4,e3,0.9,e1,0.1,e2,0.1",
+    ]))
+    entities = tmp_path / "entities.csv"
+    entities.write_text("e1\ne2\ne3\ne4\n")
+    props = tmp_path / "agg.properties"
+    store_dir = tmp_path / "store"
+    props.write_text(f"""
+field.delim.regex=,
+agg.min.av.edge.weight.threshold=0.5
+agg.map.file.dir.path={store_dir}
+""")
+    rc = cli_run.main(["entityDistanceStore", f"-Dconf.path={props}",
+                       str(dist_file), str(store_dir)])
+    assert rc == 0
+    assert (store_dir / "index.json").exists()
+    rc = cli_run.main(["agglomerativeGraphical", f"-Dconf.path={props}",
+                       str(entities), str(tmp_path / "out")])
+    assert rc == 0
+    out = (tmp_path / "out" / "part-r-00000").read_text().splitlines()
+    joined = [set(ln.split(",")[:-1]) if ln.split(",")[-1][0].isdigit()
+              else set(ln.split(",")) for ln in out]
+    # e1/e2 together, e3/e4 together
+    assert any({"e1", "e2"} <= g for g in joined)
+    assert any({"e3", "e4"} <= g for g in joined)
