@@ -75,8 +75,16 @@ def agglomerative_graphical(cfg: Config, in_path: str, out_path: str
         ps = _PStore(map_path)
 
         class _LazyStore:
+            # memo: try_membership probes the same entities repeatedly, so
+            # parse each distance line at most once
+            _cache: dict = {}
+
             def read(self, key):
-                return dict(ps.read(key) or [])
+                hit = self._cache.get(key)
+                if hit is None:
+                    hit = dict(ps.read(key) or [])
+                    self._cache[key] = hit
+                return hit
 
         store = _LazyStore()
     else:

@@ -143,7 +143,21 @@ def model_predictor_job(cfg: Config, in_path: str, out_path: str) -> Counters:
     schema = _schema_path(cfg, "mop.feature.schema.file.path")
     table = load_csv(in_path, schema, cfg.field_delim_regex, keep_raw=True)
     model_dir = cfg.get("mop.model.dir.path", "")
-    names = cfg.must_get_list("mop.model.file.names")
+    names = cfg.get_list("mop.model.file.names")
+    if not names:
+        # extension: default to the forest builder's tree_<i>.json files in
+        # numeric order, so rafo.sh needs no name list; other JSONs in the
+        # dir (schemas, decision paths) are not treated as models
+        import re as _re
+        if not model_dir or not os.path.isdir(model_dir):
+            cfg.must_get_list("mop.model.file.names")  # raise with key name
+        matches = [(int(m.group(1)), f) for f in os.listdir(model_dir)
+                   if (m := _re.fullmatch(r"tree_(\d+)\.json", f))]
+        names = [f for _, f in sorted(matches)]
+        if not names:
+            raise FileNotFoundError(
+                f"no tree_<i>.json models in {model_dir!r}; set "
+                "mop.model.file.names explicitly for other layouts")
     path_lists = []
     for nm in names:
         p = os.path.join(model_dir, nm) if model_dir else nm

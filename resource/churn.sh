@@ -1,0 +1,24 @@
+#!/bin/bash
+# Naive Bayes churn driver (reference: cust_churn_bayesian_prediction.txt).
+#   ./churn.sh train   <train.csv>    <model_dir>
+#   ./churn.sh predict <validate.csv> <pred_dir>   (needs model at
+#                                                   churn_model or -D override)
+set -e
+DIR=$(cd "$(dirname "$0")" && pwd)
+RUN="python -m avenir_tpu.cli.run"
+PROPS="$DIR/churn.properties"
+
+case "$1" in
+train)
+  $RUN org.avenir.bayesian.BayesianDistribution -Dconf.path=$PROPS \
+      -Dbad.feature.schema.file.path=$DIR/churn.json "$2" "$3"
+  ;;
+predict)
+  $RUN org.avenir.bayesian.BayesianPredictor -Dconf.path=$PROPS \
+      -Dbap.feature.schema.file.path=$DIR/churn.json \
+      -Dbap.bayesian.model.file.path=${MODEL:-churn_model/part-r-00000} \
+      "$2" "$3"
+  ;;
+*)
+  echo "usage: $0 train|predict <in> <out>" >&2; exit 2 ;;
+esac

@@ -1,0 +1,25 @@
+#!/bin/bash
+# Decision-tree driver: grows the tree level by level, rotating the
+# decision-path JSON between iterations (the reference detr.sh mvDecFiles
+# loop, resource/detr.sh:35-41 in the reference tree).
+#   ./detr.sh <train.csv> <work_dir> [num_levels]
+set -e
+DIR=$(cd "$(dirname "$0")" && pwd)
+RUN="python -m avenir_tpu.cli.run"
+PROPS="$DIR/detr.properties"
+IN=$1; WORK=$2; LEVELS=${3:-4}
+mkdir -p "$WORK"
+
+for ((i = 1; i <= LEVELS; i++)); do
+  echo "== tree level $i"
+  EXTRA=""
+  if [ -f "$WORK/dec_path_in.json" ]; then
+    EXTRA="-Ddtb.decision.file.path.in=$WORK/dec_path_in.json"
+  fi
+  $RUN org.avenir.tree.DecisionTreeBuilder -Dconf.path=$PROPS \
+      -Ddtb.feature.schema.file.path=$DIR/call_hangup.json \
+      -Ddtb.decision.file.path.out=$WORK/dec_path_out.json \
+      $EXTRA "$IN" "$WORK/level_$i"
+  mv "$WORK/dec_path_out.json" "$WORK/dec_path_in.json"
+done
+echo "final decision paths: $WORK/dec_path_in.json"

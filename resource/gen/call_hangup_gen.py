@@ -1,0 +1,36 @@
+#!/usr/bin/env python3
+"""Synthetic call-center data for call_hangup.json: hangup probability rises
+with queue time, transfers and repeat calls; outage calls are most patient.
+Usage: call_hangup_gen.py <n_rows> [seed] > calls.csv
+"""
+
+import sys
+
+import numpy as np
+
+ISSUES = ["billing", "outage", "upgrade", "other"]
+ISSUE_P = [0.35, 0.2, 0.25, 0.2]
+PATIENCE = {"billing": 500.0, "outage": 900.0, "upgrade": 420.0, "other": 380.0}
+
+
+def generate(n: int, seed: int = 1):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(n):
+        issue = ISSUES[rng.choice(len(ISSUES), p=ISSUE_P)]
+        queue = int(np.clip(rng.exponential(420), 0, 1800))
+        transfers = int(np.clip(rng.poisson(0.7), 0, 4))
+        prior = int(np.clip(rng.poisson(1.0), 0, 9))
+        annoyance = queue / PATIENCE[issue] + 0.5 * transfers + 0.3 * prior
+        # steep logistic: strong signal, ~15% label noise at the extremes
+        p_hang = 1.0 / (1.0 + np.exp(-3.5 * (annoyance - 1.1)))
+        hung = rng.random() < p_hang
+        rows.append(f"K{i:07d},{issue},{queue},{transfers},{prior},"
+                    f"{'T' if hung else 'F'}")
+    return rows
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
+    seed = int(sys.argv[2]) if len(sys.argv) > 2 else 1
+    print("\n".join(generate(n, seed)))

@@ -1,0 +1,30 @@
+#!/usr/bin/env python3
+"""Synthetic e-learning engagement data for elearn.json: pass/fail outcome
+driven by a latent diligence factor behind all four engagement features.
+Usage: elearn_gen.py <n_rows> [seed] > elearn.csv
+"""
+
+import sys
+
+import numpy as np
+
+
+def generate(n: int, seed: int = 1):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(n):
+        diligence = rng.beta(2.2, 2.2)
+        video = max(0.0, rng.normal(18 * diligence, 3.0))
+        quiz = float(np.clip(rng.normal(35 + 60 * diligence, 8.0), 0, 100))
+        posts = int(np.clip(rng.poisson(8 * diligence), 0, 49))
+        assign = int(np.clip(rng.binomial(20, 0.3 + 0.65 * diligence), 0, 20))
+        p_pass = 1.0 / (1.0 + np.exp(-(quiz / 10.0 + assign / 4.0 - 8.5)))
+        outcome = "pass" if rng.random() < p_pass else "fail"
+        rows.append(f"S{i:06d},{video:.2f},{quiz:.1f},{posts},{assign},{outcome}")
+    return rows
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 500
+    seed = int(sys.argv[2]) if len(sys.argv) > 2 else 1
+    print("\n".join(generate(n, seed)))

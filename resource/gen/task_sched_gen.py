@@ -1,0 +1,75 @@
+#!/usr/bin/env python3
+"""Generate a task-schedule optimization domain JSON (the shape consumed by
+optimize.task_schedule.TaskScheduleDomain / the reference's
+TaskScheduleSearch): random US-ish locations, tasks with date windows and
+skill requirements, employees with home locations and skills.
+Usage: task_sched_gen.py <n_tasks> <n_employees> [seed] > taskSched.json
+"""
+
+import json
+import sys
+from datetime import date, timedelta
+
+import numpy as np
+
+SKILLS = ["java", "python", "network", "dbms", "security", "cloud"]
+CITIES = [
+    ("STL", 38.63, -90.20), ("DEN", 39.74, -104.99), ("ATL", 33.75, -84.39),
+    ("SEA", 47.61, -122.33), ("BOS", 42.36, -71.06), ("PHX", 33.45, -112.07),
+]
+
+
+def generate(n_tasks: int, n_emps: int, seed: int = 1):
+    rng = np.random.default_rng(seed)
+    locations = []
+    for cid, lat, lon in CITIES:
+        locations.append({
+            "id": cid,
+            "gps": [lat + float(rng.normal(0, 0.05)),
+                    lon + float(rng.normal(0, 0.05))],
+            "perDiemCost": int(rng.integers(45, 90)),
+            "hotelCost": int(rng.integers(90, 240)),
+        })
+    base = date(2026, 3, 2)
+    tasks = []
+    for i in range(n_tasks):
+        start = base + timedelta(days=int(rng.integers(0, 90)))
+        end = start + timedelta(days=int(rng.integers(3, 15)))
+        tasks.append({
+            "id": f"T{i:03d}",
+            "location": CITIES[int(rng.integers(0, len(CITIES)))][0],
+            "startDate": start.strftime("%m-%d-%Y"),
+            "endDate": end.strftime("%m-%d-%Y"),
+            "skills": sorted(rng.choice(SKILLS, size=int(rng.integers(1, 4)),
+                                        replace=False).tolist()),
+        })
+    employees = []
+    for i in range(n_emps):
+        employees.append({
+            "id": f"E{i:03d}",
+            "location": CITIES[int(rng.integers(0, len(CITIES)))][0],
+            "skills": sorted(rng.choice(SKILLS, size=int(rng.integers(2, 5)),
+                                        replace=False).tolist()),
+        })
+    return {
+        "dateFormat": "MM-dd-yyyy",
+        "costScale": 100,
+        "airTravelDistThreshold": 150,
+        "perMileDriveCost": 0.6,
+        "airFareEstimator": [0.00004, 0.12, 80.0],
+        "maxTravelCost": 900.0,
+        "maxPerDiemRate": 90.0,
+        "maxHotelRate": 240.0,
+        "minDaysGap": 2,
+        "inavlidSolutionCost": 1000000.0,
+        "locations": locations,
+        "tasks": tasks,
+        "employees": employees,
+    }
+
+
+if __name__ == "__main__":
+    nt = int(sys.argv[1]) if len(sys.argv) > 1 else 12
+    ne = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+    seed = int(sys.argv[3]) if len(sys.argv) > 3 else 1
+    print(json.dumps(generate(nt, ne, seed), indent=2))

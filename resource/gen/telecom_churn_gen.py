@@ -1,0 +1,48 @@
+#!/usr/bin/env python3
+"""Synthetic telecom-churn data for churn.json (the fixture role of the
+reference's churn generator, SURVEY.md §4.1): per-plan usage distributions
+with churn driven by low usage, poor payment history and many service calls.
+Usage: telecom_churn_gen.py <n_rows> [seed] > churn.csv
+"""
+
+import sys
+
+import numpy as np
+
+PLANS = ["prepaid", "standard", "family", "business"]
+PLAN_P = [0.25, 0.4, 0.2, 0.15]
+# per-plan (minutes mean, data mean)
+PLAN_USAGE = {"prepaid": (250, 1200), "standard": (600, 3000),
+              "family": (900, 5000), "business": (1300, 7000)}
+PAYMENTS = ["poor", "average", "good"]
+
+
+def generate(n: int, seed: int = 1):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(n):
+        plan = PLANS[rng.choice(len(PLANS), p=PLAN_P)]
+        churn_risk = 0.15
+        mmean, dmean = PLAN_USAGE[plan]
+        usage_factor = rng.lognormal(0.0, 0.5)
+        minutes = int(np.clip(mmean * usage_factor, 0, 1999))
+        data = int(np.clip(dmean * usage_factor * rng.lognormal(0, 0.3),
+                           0, 9999))
+        if usage_factor < 0.6:
+            churn_risk += 0.25
+        pay = PAYMENTS[rng.choice(3, p=[0.2, 0.4, 0.4])]
+        if pay == "poor":
+            churn_risk += 0.25
+        calls = int(np.clip(rng.poisson(1.2), 0, 9))
+        if calls >= 4:
+            churn_risk += 0.25
+        churned = rng.random() < churn_risk
+        rows.append(f"C{i:07d},{plan},{minutes},{data},{calls},{pay},"
+                    f"{'churned' if churned else 'active'}")
+    return rows
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
+    seed = int(sys.argv[2]) if len(sys.argv) > 2 else 1
+    print("\n".join(generate(n, seed)))

@@ -1,0 +1,21 @@
+#!/bin/bash
+# KNN pipeline driver (reference knn.sh: sifarish distance job then
+# NearestNeighbor classification).
+#   ./knn.sh distance <data_dir> <dist_dir>   # data_dir: tr* = train files
+#   ./knn.sh classify <dist_dir> <pred_dir>
+set -e
+DIR=$(cd "$(dirname "$0")" && pwd)
+RUN="python -m avenir_tpu.cli.run"
+PROPS="$DIR/knn.properties"
+
+case "$1" in
+distance)
+  $RUN org.sifarish.feature.SameTypeSimilarity -Dconf.path=$PROPS \
+      -Dsts.same.schema.file.path=$DIR/elearn.json "$2" "$3"
+  ;;
+classify)
+  $RUN org.avenir.knn.NearestNeighbor -Dconf.path=$PROPS "$2" "$3"
+  ;;
+*)
+  echo "usage: $0 distance|classify <in> <out>" >&2; exit 2 ;;
+esac

@@ -1,0 +1,23 @@
+#!/bin/bash
+# Stochastic-optimization driver (reference opt.sh: spark-submit
+# SimulatedAnnealing OUTPUT opt.conf).
+#   ./opt.sh sa <out_dir>    # simulated annealing
+#   ./opt.sh ga <out_dir>    # genetic algorithm
+# Generate the domain first if needed:
+#   python gen/task_sched_gen.py 12 8 > taskSched.json
+set -e
+DIR=$(cd "$(dirname "$0")" && pwd)
+RUN="python -m avenir_tpu.cli.run"
+
+case "$1" in
+sa)
+  (cd "$DIR" && $RUN org.avenir.spark.optimize.SimulatedAnnealing \
+      "$2" "$DIR/opt.conf")
+  ;;
+ga)
+  (cd "$DIR" && $RUN org.avenir.spark.optimize.GeneticAlgorithm \
+      "$2" "$DIR/opt.conf")
+  ;;
+*)
+  echo "usage: $0 sa|ga <out_dir>" >&2; exit 2 ;;
+esac

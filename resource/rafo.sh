@@ -1,0 +1,24 @@
+#!/bin/bash
+# Random-forest driver (reference rafo.sh reruns the tree build per tree;
+# the rebuilt job grows the whole forest at once, then the generic
+# modelPredictor ensembles the per-tree decision paths).
+#   ./rafo.sh build   <train.csv> <model_dir>
+#   ./rafo.sh predict <data.csv>  <pred_dir>   (MODEL=<model_dir>)
+set -e
+DIR=$(cd "$(dirname "$0")" && pwd)
+RUN="python -m avenir_tpu.cli.run"
+PROPS="$DIR/rafo.properties"
+
+case "$1" in
+build)
+  $RUN org.avenir.tree.RandomForestBuilder -Dconf.path=$PROPS \
+      -Ddtb.feature.schema.file.path=$DIR/call_hangup.json "$2" "$3"
+  ;;
+predict)
+  $RUN org.avenir.model.ModelPredictor -Dconf.path=$PROPS \
+      -Dmop.feature.schema.file.path=$DIR/call_hangup.json \
+      -Dmop.model.dir.path=${MODEL:-rafo_model} "$2" "$3"
+  ;;
+*)
+  echo "usage: $0 build|predict <in> <out>" >&2; exit 2 ;;
+esac
