@@ -149,8 +149,10 @@ def model_predictor_job(cfg: Config, in_path: str, out_path: str) -> Counters:
         # numeric order, so rafo.sh needs no name list; other JSONs in the
         # dir (schemas, decision paths) are not treated as models
         import re as _re
-        if not model_dir or not os.path.isdir(model_dir):
+        if not model_dir:
             cfg.must_get_list("mop.model.file.names")  # raise with key name
+        if not os.path.isdir(model_dir):
+            raise FileNotFoundError(f"model dir {model_dir!r} not found")
         matches = [(int(m.group(1)), f) for f in os.listdir(model_dir)
                    if (m := _re.fullmatch(r"tree_(\d+)\.json", f))]
         names = [f for _, f in sorted(matches)]

@@ -9,6 +9,7 @@ RUN="python -m avenir_tpu.cli.run"
 PROPS="$DIR/detr.properties"
 IN=$1; WORK=$2; LEVELS=${3:-4}
 mkdir -p "$WORK"
+rm -f "$WORK/dec_path_in.json"  # never seed level 1 from a previous run
 
 for ((i = 1; i <= LEVELS; i++)); do
   echo "== tree level $i"

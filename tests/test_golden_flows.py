@@ -123,12 +123,11 @@ def test_knn_elearning_flow(tmp_path):
 
 def test_sa_task_assignment_flow(tmp_path):
     """opt.sh sa: HOCON conf + generated domain; SA beats random baseline."""
-    import importlib
-    mod = importlib.import_module("gen.task_sched_gen")
     domain_json = tmp_path / "taskSched.json"
-    domain_json.write_text(json.dumps(mod.generate(10, 6, 5)))
+    domain_json.write_text(json.dumps(_gen("task_sched_gen", 10, 6, 5)))
     conf = tmp_path / "opt.conf"
-    src = open(os.path.join(RES, "opt.conf")).read()
+    from pathlib import Path
+    src = Path(RES, "opt.conf").read_text()
     conf.write_text(src.replace('"taskSched.json"', f'"{domain_json}"')
                     .replace("max.num.iterations = 2000",
                              "max.num.iterations = 500"))
