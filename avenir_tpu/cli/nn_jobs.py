@@ -61,7 +61,54 @@ def neural_network_trainer(cfg: Config, in_path: str, out_path: str) -> Counters
         if len(yv) == 0:
             raise ValueError(
                 f"validation file {val_path!r} has no known class labels")
-    params, losses = mlp.train(X, y, mcfg, X_val=Xv, y_val=yv)
+
+    ckpt_dir = cfg.get("nn.checkpoint.dir.path")
+    ckpt_interval = cfg.get_int("nn.checkpoint.interval", 0)
+    if ckpt_dir and ckpt_interval > 0:
+        # chunked training with durable per-chunk state: resume from the
+        # latest checkpoint (the reference's iterate-via-durable-artifact
+        # contract, SURVEY.md §5 checkpoint/resume)
+        from ..core.checkpoint import CheckpointManager
+        mgr = CheckpointManager(ckpt_dir)
+        arch = {"hidden_dim": mcfg.hidden_dim, "n_classes": mcfg.n_classes,
+                "n_features": int(X.shape[1]), "mode": mcfg.mode}
+        done, params0 = 0, None
+        latest = mgr.latest_step()
+        if latest is not None:
+            done, arrays, meta = mgr.restore(latest)
+            saved_arch = meta.get("arch")
+            if saved_arch is not None and saved_arch != arch:
+                raise ValueError(
+                    f"checkpoint in {ckpt_dir!r} was trained with "
+                    f"{saved_arch}, current config is {arch}; use a fresh "
+                    "checkpoint dir")
+            params0 = dict(arrays)
+        if done >= mcfg.iterations and params0 is None:
+            raise ValueError("nn.checkpoint.dir.path has no state yet "
+                             "but nn.iteration.count is 0")
+        params = params0  # already-complete resume: nothing left to train
+        losses = np.zeros((0,))
+        import dataclasses
+        # align chunks to the validation grid so the recorded loss history
+        # matches an unchunked run of the same config
+        interval = max(mcfg.validation_interval, 1)
+        ckpt_interval = max((ckpt_interval // interval) * interval, interval)
+        while done < mcfg.iterations:
+            chunk = min(ckpt_interval, mcfg.iterations - done)
+            # fold progress into the seed: each chunk must continue the
+            # PRNG stream, not replay the first chunk's shuffles
+            ccfg = dataclasses.replace(mcfg, iterations=chunk,
+                                       seed=mcfg.seed + done)
+            params, chunk_losses = mlp.train(X, y, ccfg, X_val=Xv, y_val=yv,
+                                             params0=params0)
+            if chunk < interval and len(losses):
+                chunk_losses = chunk_losses[:0]  # tail: unchunked records none
+            done += chunk
+            params0 = {k: np.asarray(v) for k, v in params.items()}
+            mgr.save(done, params0, {"iterations": done, "arch": arch})
+            losses = np.concatenate([losses, chunk_losses])
+    else:
+        params, losses = mlp.train(X, y, mcfg, X_val=Xv, y_val=yv)
     od = cfg.field_delim_out
     lines = mlp.to_lines(params, od)
     artifacts.write_text_output(out_path, lines)
@@ -71,8 +118,9 @@ def neural_network_trainer(cfg: Config, in_path: str, out_path: str) -> Counters
             fh.write("\n".join(lines) + "\n")
     acc = float((np.asarray(mlp.predict(params, X)) == y).mean())
     counters.set("NeuralNetwork", "trainAccuracyPct", int(round(acc * 100)))
-    counters.set("NeuralNetwork", "finalLossE6",
-                 int(round(float(losses[-1]) * 1e6)))
+    if len(losses):
+        counters.set("NeuralNetwork", "finalLossE6",
+                     int(round(float(losses[-1]) * 1e6)))
     counters.set("NeuralNetwork", "lossEvaluations", len(losses))
     return counters
 

@@ -1,0 +1,86 @@
+"""Step-indexed checkpoint/resume for iterative jobs.
+
+The reference's checkpointing is structural: every iteration writes a durable
+HDFS artifact and any job resumes from the last one (SURVEY.md §5 —
+decision-path JSON per tree level, LR coefficient history, k-means centroid
+files, bandit model state).  This manager gives the rebuilt iterative drivers
+one uniform version of that contract: numbered step directories holding an
+npz of array state plus a JSON sidecar for metadata, atomic via
+write-then-rename, with retention and latest-step discovery.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class CheckpointManager:
+    def __init__(self, base_dir: str, keep: int = 3):
+        """keep: retain at most this many newest steps (0 = keep all)."""
+        self.base_dir = base_dir
+        self.keep = keep
+        os.makedirs(base_dir, exist_ok=True)
+
+    # ---- paths ----
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.base_dir, f"step_{step:08d}")
+
+    def steps(self) -> List[int]:
+        out = []
+        for name in os.listdir(self.base_dir):
+            if name.startswith("step_") and not name.endswith(".tmp"):
+                try:
+                    out.append(int(name[5:]))
+                except ValueError:
+                    pass
+        return sorted(out)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.steps()
+        return steps[-1] if steps else None
+
+    # ---- save/restore ----
+    def save(self, step: int, arrays: Dict[str, np.ndarray],
+             meta: Optional[Dict[str, Any]] = None) -> str:
+        """Atomically write arrays (+ JSON-serializable meta) as ``step``."""
+        final = self._step_dir(step)
+        tmp = final + ".tmp"
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        np.savez(os.path.join(tmp, "state.npz"),
+                 **{k: np.asarray(v) for k, v in arrays.items()})
+        with open(os.path.join(tmp, "meta.json"), "w") as fh:
+            json.dump(meta or {}, fh)
+        if os.path.exists(final):
+            shutil.rmtree(final)
+        os.replace(tmp, final)
+        self._retain()
+        return final
+
+    def restore(self, step: Optional[int] = None
+                ) -> Tuple[int, Dict[str, np.ndarray], Dict[str, Any]]:
+        """(step, arrays, meta) for ``step`` or the latest; raises
+        FileNotFoundError when nothing is saved."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(f"no checkpoints in {self.base_dir!r}")
+        d = self._step_dir(step)
+        with np.load(os.path.join(d, "state.npz")) as z:
+            arrays = {k: z[k] for k in z.files}
+        with open(os.path.join(d, "meta.json")) as fh:
+            meta = json.load(fh)
+        return step, arrays, meta
+
+    def _retain(self) -> None:
+        if self.keep <= 0:
+            return
+        steps = self.steps()
+        for s in steps[:-self.keep]:
+            shutil.rmtree(self._step_dir(s), ignore_errors=True)

@@ -164,16 +164,19 @@ def _train_minibatch(params: Params, X, y, Xv, yv, lr, reg_lambda, key,
 
 def train(X: np.ndarray, y: np.ndarray, cfg: MLPConfig,
           X_val: Optional[np.ndarray] = None,
-          y_val: Optional[np.ndarray] = None
+          y_val: Optional[np.ndarray] = None,
+          params0: Optional[Params] = None
           ) -> Tuple[Params, np.ndarray]:
     """Train per cfg.mode; returns (params, validation-loss history sampled
     every cfg.validation_interval passes).  Falls back to training loss when
-    no validation split is given (basic_nn.py use_validation_data)."""
+    no validation split is given (basic_nn.py use_validation_data).
+    ``params0`` warm-starts from an earlier run (checkpoint resume)."""
     X = jnp.asarray(X, jnp.float32)
     y = jnp.asarray(y, jnp.int32)
     Xv = jnp.asarray(X_val, jnp.float32) if X_val is not None else X
     yv = jnp.asarray(y_val, jnp.int32) if y_val is not None else y
-    params = init_params(X.shape[1], cfg)
+    params = ({k: jnp.asarray(v) for k, v in params0.items()}
+              if params0 is not None else init_params(X.shape[1], cfg))
     key = jax.random.PRNGKey(cfg.seed + 1)
     if cfg.mode == "batch":
         params, losses = _train_batch(params, X, y, Xv, yv, cfg.learning_rate,

@@ -1,0 +1,110 @@
+"""Tracing/profiling utilities (SURVEY.md §5: the reference has only log4j
+with a ``debug.on`` gate and the Hadoop/Spark UIs; the rebuild's contract is
+per-step timing metrics + optional XLA profiler capture).
+
+- :class:`StepTimer` — named wall-clock step accounting that exports into
+  the job Counters channel (millisecond totals/counts, like Hadoop's
+  job counters view).
+- :func:`device_sync` — sync point that works on the tunneled axon platform
+  where ``block_until_ready`` can return early: reads one leaf back.
+- :func:`trace` — context manager around ``jax.profiler.trace`` when the
+  backend supports it, silently a no-op otherwise.
+- :func:`get_logger` — the ``debug.on`` gate
+  (e.g. reference bayesian/BayesianPredictor.java:127-129).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from collections import defaultdict
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+
+def device_sync(*arrays) -> None:
+    """Force completion of device work feeding ``arrays`` (tiny readback —
+    block_until_ready is unreliable on the axon tunnel)."""
+    import jax
+    for a in arrays:
+        for leaf in jax.tree_util.tree_leaves(a):
+            np.asarray(leaf)
+
+
+class StepTimer:
+    """Accumulate wall time per named step.
+
+    >>> t = StepTimer()
+    >>> with t.step("train"):
+    ...     ...
+    >>> t.export(counters)   # Profiling/train.timeMs, Profiling/train.calls
+    """
+
+    def __init__(self, sync: bool = False):
+        self.totals: Dict[str, float] = defaultdict(float)
+        self.calls: Dict[str, int] = defaultdict(int)
+        self.sync = sync
+
+    @contextlib.contextmanager
+    def step(self, name: str, *sync_arrays) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            if self.sync and sync_arrays:
+                device_sync(*sync_arrays)
+            self.totals[name] += time.perf_counter() - t0
+            self.calls[name] += 1
+
+    def mean_ms(self, name: str) -> float:
+        c = self.calls.get(name, 0)
+        return (self.totals[name] / c * 1000.0) if c else 0.0
+
+    def export(self, counters, group: str = "Profiling") -> None:
+        for name, total in sorted(self.totals.items()):
+            counters.set(group, f"{name}.timeMs", int(round(total * 1000)))
+            counters.set(group, f"{name}.calls", self.calls[name])
+
+    def summary(self) -> str:
+        return "; ".join(
+            f"{n}: {self.totals[n]*1000:.1f}ms/{self.calls[n]}x"
+            for n in sorted(self.totals))
+
+
+@contextlib.contextmanager
+def trace(log_dir: Optional[str]) -> Iterator[bool]:
+    """XLA profiler capture into ``log_dir`` (viewable with tensorboard /
+    xprof).  Yields whether capture is actually active; a None dir or an
+    unsupported backend degrades to a no-op."""
+    if not log_dir:
+        yield False
+        return
+    try:
+        import jax
+        jax.profiler.start_trace(log_dir)
+        active = True
+    except Exception:
+        yield False
+        return
+    try:
+        yield active
+    finally:
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+
+
+def get_logger(name: str = "avenir_tpu", debug_on: bool = False
+               ) -> logging.Logger:
+    """The reference's debug.on gate: DEBUG level when set, WARN otherwise."""
+    logger = logging.getLogger(name)
+    logger.setLevel(logging.DEBUG if debug_on else logging.WARNING)
+    if not logger.handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter(
+            "%(asctime)s %(name)s %(levelname)s %(message)s"))
+        logger.addHandler(h)
+    return logger

@@ -1,0 +1,99 @@
+"""Aux subsystems (SURVEY.md §5): step timing/tracing, checkpoint/resume."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core.checkpoint import CheckpointManager
+from avenir_tpu.core.metrics import Counters
+from avenir_tpu.utils.tracing import StepTimer, get_logger, trace
+
+
+def test_step_timer_accumulates_and_exports():
+    t = StepTimer()
+    for _ in range(3):
+        with t.step("work"):
+            time.sleep(0.01)
+    with t.step("other"):
+        pass
+    assert t.calls["work"] == 3
+    assert t.totals["work"] >= 0.03
+    assert t.mean_ms("work") >= 10.0
+    c = Counters()
+    t.export(c)
+    assert c.get("Profiling", "work.calls") == 3
+    assert c.get("Profiling", "work.timeMs") >= 30
+    assert "work" in t.summary()
+
+
+def test_trace_noop_without_dir():
+    with trace(None) as active:
+        assert active is False
+
+
+def test_logger_debug_gate(capsys):
+    lg = get_logger("avenir_tpu.test", debug_on=False)
+    assert not lg.isEnabledFor(10)  # DEBUG off
+    lg = get_logger("avenir_tpu.test", debug_on=True)
+    assert lg.isEnabledFor(10)
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    assert mgr.latest_step() is None
+    with pytest.raises(FileNotFoundError):
+        mgr.restore()
+    mgr.save(1, {"w": np.arange(4.0)}, {"note": "first"})
+    mgr.save(5, {"w": np.arange(4.0) * 2})
+    step, arrays, meta = mgr.restore()
+    assert step == 5
+    np.testing.assert_allclose(arrays["w"], np.arange(4.0) * 2)
+    step, arrays, meta = mgr.restore(1)
+    assert meta == {"note": "first"}
+
+
+def test_checkpoint_retention(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep=2)
+    for s in (1, 2, 3, 4):
+        mgr.save(s, {"x": np.zeros(1)})
+    assert mgr.steps() == [3, 4]
+
+
+def test_nn_trainer_checkpoint_resume(tmp_path):
+    """Checkpointed chunked training resumes exactly where it stopped."""
+    from avenir_tpu.cli import run as cli_run
+    from tests.test_nn_jobs import SCHEMA, gen_csv
+    schema = tmp_path / "nn.json"
+    schema.write_text(json.dumps(SCHEMA))
+    train_csv = tmp_path / "train.csv"
+    gen_csv(str(train_csv), n=150)
+    ck = tmp_path / "ck"
+    props = tmp_path / "nn.properties"
+    props.write_text(f"""
+field.delim.regex=,
+feature.schema.file.path={schema}
+nn.hidden.units=4
+nn.iteration.count=200
+nn.learning.rate=0.01
+nn.checkpoint.dir.path={ck}
+nn.checkpoint.interval=80
+""")
+    rc = cli_run.main(["neuralNetwork", f"-Dconf.path={props}",
+                       str(train_csv), str(tmp_path / "out1")])
+    assert rc == 0
+    mgr = CheckpointManager(str(ck))
+    # interval 80 aligns down to the validation grid (50): 4 chunks of 50
+    assert mgr.latest_step() == 200
+    # rerun: resumes at 200, trains nothing, still succeeds
+    rc = cli_run.main(["neuralNetwork", f"-Dconf.path={props}",
+                       str(train_csv), str(tmp_path / "out2")])
+    assert rc == 0
+    assert mgr.latest_step() == 200
+    # changing the architecture against the same checkpoint dir must fail
+    props.write_text(props.read_text().replace("nn.hidden.units=4",
+                                               "nn.hidden.units=9"))
+    with pytest.raises(ValueError, match="checkpoint"):
+        cli_run.main(["neuralNetwork", f"-Dconf.path={props}",
+                      str(train_csv), str(tmp_path / "out3")])
