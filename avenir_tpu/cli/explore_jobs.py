@@ -8,7 +8,7 @@ classes under explore/)."""
 from __future__ import annotations
 
 import os
-from typing import List
+from typing import Dict, List
 
 import numpy as np
 
@@ -273,4 +273,72 @@ def adaboost_update_job(cfg: Config, in_path: str, out_path: str) -> Counters:
         r[b_ord] = f"{nw:.{prec}f}"
         out.append(delim.join(r))
     artifacts.write_text_output(out_path, out)
+    return counters
+
+
+@register("org.avenir.explore.BaggingSampler", "baggingSampler")
+def bagging_sampler(cfg: Config, in_path: str, out_path: str) -> Counters:
+    """Per-batch bagging (explore/BaggingSampler.java:90-124): stream rows in
+    batches of bas.batch.size, emit batchSize uniform with-replacement draws
+    from each batch (whole-dataset sampling would need global state the
+    streaming reference cannot hold)."""
+    from ..explore.samplers import bagging_sample
+    counters = Counters()
+    batch_size = cfg.get_int("bas.batch.size", 10000)
+    seed = cfg.get_int("bas.random.seed", 0)
+    lines_in = artifacts.read_text_input(in_path)
+    out = []
+    for b, start in enumerate(range(0, len(lines_in), batch_size)):
+        batch = lines_in[start:start + batch_size]
+        idx = bagging_sample(len(batch), 1.0, with_replacement=True,
+                             seed=seed + b)
+        out.extend(batch[i] for i in idx)
+    artifacts.write_text_output(out_path, out)
+    counters.set("Bagging", "inputRows", len(lines_in))
+    counters.set("Bagging", "sampledRows", len(out))
+    return counters
+
+
+@register("org.avenir.explore.TopMatchesByClass", "topMatchesByClass")
+def top_matches_by_class(cfg: Config, in_path: str, out_path: str) -> Counters:
+    """Per-record top-k nearest SAME-class neighbors, the SMOTE precursor
+    (explore/TopMatchesByClass.java).  Input: pair-distance lines from the
+    sameTypeSimilarity job (id1,id2,distance,class1,class2 — divergence: the
+    reference reads sifarish's rank-last layout); each unordered pair feeds
+    both directions (TopMatchesByClass.java:183-209).  Keys:
+    tmc.top.match.count, tmc.nearest.by.count (false -> keep matches within
+    tmc.match.distance), tmc.filer.class.value (reference key spelling)."""
+    counters = Counters()
+    delim = cfg.field_delim_regex
+    od = cfg.field_delim_out
+    by_count = cfg.get_boolean("tmc.nearest.by.count", True)
+    top_k = cfg.get_int("tmc.top.match.count", 10)
+    max_dist = cfg.get_int("tmc.match.distance", 200)
+    filter_class = cfg.get("tmc.filer.class.value")
+    split = _splitter(delim)
+    neighbors: Dict[str, list] = {}
+    classes: Dict[str, str] = {}
+    for line in artifacts.read_text_input(in_path):
+        it = split(line)
+        id1, id2, dist, cls1, cls2 = it[0], it[1], int(it[2]), it[3], it[4]
+        if cls1 != cls2:
+            continue
+        if filter_class is not None and cls1 != filter_class:
+            continue
+        classes[id1] = cls1
+        classes[id2] = cls2
+        neighbors.setdefault(id1, []).append((dist, id2))
+        neighbors.setdefault(id2, []).append((dist, id1))
+    out = []
+    for src in sorted(neighbors):
+        ranked = sorted(neighbors[src])
+        if by_count:
+            kept = ranked[:top_k]
+        else:
+            kept = [r for r in ranked if r[0] <= max_dist]
+        for dist, trg in kept:
+            out.append(od.join([src, classes[src], trg, str(dist)]))
+        counters.increment("TopMatches", "records")
+    artifacts.write_text_output(out_path, out)
+    counters.set("TopMatches", "pairsEmitted", len(out))
     return counters

@@ -273,6 +273,58 @@ def same_type_similarity(cfg: Config, in_path: str, out_path: str) -> Counters:
     return counters
 
 
+@register("org.avenir.knn.FeatureCondProbJoiner", "featureCondProbJoiner")
+def feature_cond_prob_joiner(cfg: Config, in_path: str, out_path: str
+                             ) -> Counters:
+    """Join Bayesian feature posterior probabilities onto nearest-neighbor
+    lines (knn/FeatureCondProbJoiner.java; knn.sh joinFeatureDistr step).
+
+    Input dir holds two kinds of files: those starting with
+    fcb.feature.cond.prob.split.prefix (default 'condProb') are the
+    BayesianPredictor feature-prob output (itemID, P(x), class, P(x|c) pairs,
+    actualClass — :111-118 mapper), the rest are neighbor lines
+    (trainId,testId,distance,trainClass,testClass).  Output = the
+    class-conditional-weighted layout NearestNeighbor consumes:
+    testId, testClassActual, trainId, distance, trainClass, postProb
+    (JoinerReducer :170-177)."""
+    import glob as _glob
+    counters = Counters()
+    prefix = cfg.get("fcb.feature.cond.prob.split.prefix", "condProb")
+    split = _splitter(cfg.field_delim_regex)
+    od = cfg.field_delim_out
+    prob_lines: List[List[str]] = []
+    neigh_lines: List[List[str]] = []
+    files = sorted(_glob.glob(os.path.join(in_path, "*"))) \
+        if os.path.isdir(in_path) else [in_path]
+    for p in files:
+        base = os.path.basename(p)
+        if not os.path.isfile(p) or base.startswith(("_", ".")):
+            continue  # skip Hadoop-style markers (_SUCCESS, .crc)
+        bucket = prob_lines if base.startswith(prefix) else neigh_lines
+        bucket.extend(split(l) for l in artifacts.read_text_input(p))
+    # train item -> (actual class, P(x|actual class))
+    cls_prob: Dict[str, str] = {}
+    for it in prob_lines:
+        actual = it[-1]
+        pairs = it[2:-1]
+        for i in range(0, len(pairs) - 1, 2):
+            if pairs[i] == actual:
+                cls_prob[it[0]] = f"{actual}{od}{pairs[i + 1]}"
+                break
+    out = []
+    for it in neigh_lines:
+        train_id, test_id, dist = it[0], it[1], it[2]
+        test_class = it[4] if len(it) > 4 else "?"
+        joined = cls_prob.get(train_id)
+        if joined is None:
+            counters.increment("Join", "unmatchedNeighbors")
+            continue
+        out.append(od.join([test_id, test_class, train_id, dist, joined]))
+    artifacts.write_text_output(out_path, out)
+    counters.set("Join", "joinedLines", len(out))
+    return counters
+
+
 def _knn_params(cfg: Config):
     from ..models.knn import KnnParams
     params = KnnParams(

@@ -83,6 +83,11 @@ def neural_network_trainer(cfg: Config, in_path: str, out_path: str) -> Counters
                     f"{saved_arch}, current config is {arch}; use a fresh "
                     "checkpoint dir")
             params0 = dict(arrays)
+        if done > mcfg.iterations:
+            raise ValueError(
+                f"checkpoint in {ckpt_dir!r} has {done} completed iterations "
+                f"but nn.iteration.count is {mcfg.iterations}; use a fresh "
+                "checkpoint dir to train a shorter run")
         if done >= mcfg.iterations and params0 is None:
             raise ValueError("nn.checkpoint.dir.path has no state yet "
                              "but nn.iteration.count is 0")
@@ -101,8 +106,11 @@ def neural_network_trainer(cfg: Config, in_path: str, out_path: str) -> Counters
                                        seed=mcfg.seed + done)
             params, chunk_losses = mlp.train(X, y, ccfg, X_val=Xv, y_val=yv,
                                              params0=params0)
-            if chunk < interval and len(losses):
-                chunk_losses = chunk_losses[:0]  # tail: unchunked records none
+            if chunk < interval and len(losses) and mcfg.mode == "batch":
+                # batch mode records interval-end losses, so an unchunked run
+                # never records the tail; incr/minibatch record epoch-start
+                # samples ([::interval] from 0), so their tail entry matches
+                chunk_losses = chunk_losses[:0]
             done += chunk
             params0 = {k: np.asarray(v) for k, v in params.items()}
             mgr.save(done, params0, {"iterations": done, "arch": arch})

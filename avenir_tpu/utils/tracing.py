@@ -25,12 +25,16 @@ import numpy as np
 
 
 def device_sync(*arrays) -> None:
-    """Force completion of device work feeding ``arrays`` (tiny readback —
-    block_until_ready is unreliable on the axon tunnel)."""
+    """Force completion of device work feeding ``arrays`` (one-element
+    readback per leaf — block_until_ready is unreliable on the axon tunnel,
+    and a full copy would dominate what's being timed)."""
     import jax
     for a in arrays:
         for leaf in jax.tree_util.tree_leaves(a):
-            np.asarray(leaf)
+            if hasattr(leaf, "ravel") and getattr(leaf, "size", 0) > 0:
+                np.asarray(leaf.ravel()[:1])
+            else:
+                np.asarray(leaf)
 
 
 class StepTimer:
@@ -102,6 +106,7 @@ def get_logger(name: str = "avenir_tpu", debug_on: bool = False
     """The reference's debug.on gate: DEBUG level when set, WARN otherwise."""
     logger = logging.getLogger(name)
     logger.setLevel(logging.DEBUG if debug_on else logging.WARNING)
+    logger.propagate = False  # our handler only: no doubling via root
     if not logger.handlers:
         h = logging.StreamHandler()
         h.setFormatter(logging.Formatter(

@@ -1,0 +1,127 @@
+"""Full 4-job KNN pipeline (reference knn.sh): distance -> bayesian
+feature-prob -> featureCondProbJoiner -> class-conditional-weighted
+NearestNeighbor; plus the new bagging/top-matches explore jobs."""
+
+import json
+import shutil
+
+import numpy as np
+
+from avenir_tpu.cli import run as cli_run
+
+SCHEMA = {
+    "fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "score", "ordinal": 1, "dataType": "int", "feature": True,
+         "min": 0, "max": 99, "bucketWidth": 20},
+        {"name": "hours", "ordinal": 2, "dataType": "int", "feature": True,
+         "min": 0, "max": 39, "bucketWidth": 8},
+        {"name": "outcome", "ordinal": 3, "dataType": "categorical",
+         "cardinality": ["fail", "pass"]},
+    ]
+}
+
+
+def _gen(path, n, seed, prefix):
+    rng = np.random.default_rng(seed)
+    lines = []
+    for i in range(n):
+        good = rng.random() < 0.5
+        score = int(np.clip(rng.normal(75 if good else 35, 10), 0, 99))
+        hours = int(np.clip(rng.normal(28 if good else 12, 5), 0, 39))
+        lines.append(f"{prefix}{i:04d},{score},{hours},"
+                     f"{'pass' if good else 'fail'}")
+    path.write_text("\n".join(lines))
+    return lines
+
+
+def test_full_knn_class_cond_weighted_pipeline(tmp_path):
+    schema = tmp_path / "s.json"
+    schema.write_text(json.dumps(SCHEMA))
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    _gen(data_dir / "tr_part", 260, 0, "tr")
+    _gen(data_dir / "test_part", 60, 1, "te")
+    props = tmp_path / "knn.properties"
+    props.write_text(f"""
+field.delim.regex=,
+sts.same.schema.file.path={schema}
+sts.distance.scale=1000
+bad.feature.schema.file.path={schema}
+bap.feature.schema.file.path={schema}
+bap.bayesian.model.file.path={tmp_path}/bayes_model/part-r-00000
+bap.output.feature.prob.only=true
+nen.top.match.count=7
+nen.class.condition.weighted=true
+nen.class.attribute.values=fail,pass
+nen.validation.mode=true
+""")
+    # 1. distance job
+    assert cli_run.main(["sameTypeSimilarity", f"-Dconf.path={props}",
+                         str(data_dir), str(tmp_path / "dist")]) == 0
+    # 2. bayesian distributions on the train split
+    assert cli_run.main(["bayesianDistribution", f"-Dconf.path={props}",
+                         str(data_dir / "tr_part"),
+                         str(tmp_path / "bayes_model")]) == 0
+    # 3. feature-prob-only predictor over train records
+    assert cli_run.main(["bayesianPredictor", f"-Dconf.path={props}",
+                         str(data_dir / "tr_part"),
+                         str(tmp_path / "cond_prob")]) == 0
+    # 4. join: dir with condProb* and neighbor files
+    join_in = tmp_path / "join_in"
+    join_in.mkdir()
+    shutil.copy(tmp_path / "cond_prob" / "part-m-00000",
+                join_in / "condProb_part")
+    shutil.copy(next((tmp_path / "dist").glob("part-*")),
+                join_in / "neighbors")
+    assert cli_run.main(["featureCondProbJoiner", f"-Dconf.path={props}",
+                         str(join_in), str(tmp_path / "joined")]) == 0
+    joined = (tmp_path / "joined").glob("part-*")
+    lines = next(joined).read_text().splitlines()
+    assert lines and all(len(l.split(",")) == 6 for l in lines)
+    # 5. class-conditional-weighted KNN classification
+    assert cli_run.main(["nearestNeighbor", f"-Dconf.path={props}",
+                         str(tmp_path / "joined"), str(tmp_path / "pred")]) == 0
+    out = next((tmp_path / "pred").glob("part-*")).read_text().splitlines()
+    assert len(out) == 60
+    acc = np.mean([ln.split(",")[-1] == ln.split(",")[1] for ln in out])
+    assert acc > 0.8
+
+
+def test_bagging_sampler_job(tmp_path):
+    src = tmp_path / "in.csv"
+    rows = [f"r{i},{i}" for i in range(250)]
+    src.write_text("\n".join(rows))
+    props = tmp_path / "p.properties"
+    props.write_text("field.delim.regex=,\nbas.batch.size=100\n")
+    assert cli_run.main(["baggingSampler", f"-Dconf.path={props}",
+                         str(src), str(tmp_path / "out")]) == 0
+    out = next((tmp_path / "out").glob("part-*")).read_text().splitlines()
+    assert len(out) == 250          # every batch emits its own size
+    assert set(out) <= set(rows)    # only input rows
+    assert len(set(out)) < 250      # with replacement -> duplicates
+
+
+def test_top_matches_by_class_job(tmp_path):
+    src = tmp_path / "pairs.csv"
+    # same-class pairs with distances + one cross-class pair to be dropped
+    src.write_text("\n".join([
+        "a,b,10,x,x",
+        "a,c,30,x,x",
+        "a,d,20,x,x",
+        "a,e,5,x,y",   # cross-class: dropped
+        "b,c,15,x,x",
+    ]))
+    props = tmp_path / "p.properties"
+    props.write_text("field.delim.regex=,\ntmc.top.match.count=2\n")
+    assert cli_run.main(["topMatchesByClass", f"-Dconf.path={props}",
+                         str(src), str(tmp_path / "out")]) == 0
+    out = next((tmp_path / "out").glob("part-*")).read_text().splitlines()
+    per_src = {}
+    for ln in out:
+        s, cls, t, d = ln.split(",")
+        per_src.setdefault(s, []).append((t, int(d)))
+        assert cls == "x"
+    assert per_src["a"] == [("b", 10), ("d", 20)]     # top-2 nearest
+    assert ("a", 10) in per_src["b"]                  # both directions
+    assert all(len(v) <= 2 for v in per_src.values())
