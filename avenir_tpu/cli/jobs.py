@@ -317,6 +317,8 @@ def feature_cond_prob_joiner(cfg: Config, in_path: str, out_path: str
         test_class = it[4] if len(it) > 4 else "?"
         joined = cls_prob.get(train_id)
         if joined is None:
+            # a train item whose actual class had no (class, prob) pair —
+            # bap.predict.class did not cover every class value
             counters.increment("Join", "unmatchedNeighbors")
             continue
         out.append(od.join([test_id, test_class, train_id, dist, joined]))
@@ -525,6 +527,12 @@ def bayesian_predictor(cfg: Config, in_path: str, out_path: str) -> Counters:
     # predicting classes default to the first two of the class cardinality
     # (BayesianPredictor.java:151-159)
     pred_classes = cfg.get_list("bap.predict.class") or model.class_values[:2]
+    if cfg.get_boolean("bap.output.feature.prob.only", False) \
+            and not cfg.get_list("bap.predict.class"):
+        # feature-prob mode feeds featureCondProbJoiner, which needs every
+        # class's posterior (a record whose actual class is missing from the
+        # pair list would silently drop all its neighbors downstream)
+        pred_classes = list(model.class_values)
     neg_class, pos_class = pred_classes[0], pred_classes[1]
     prob_diff_threshold = cfg.get_int("bap.class.prob.diff.threshold", -1)
 

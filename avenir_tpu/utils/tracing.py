@@ -31,8 +31,10 @@ def device_sync(*arrays) -> None:
     import jax
     for a in arrays:
         for leaf in jax.tree_util.tree_leaves(a):
-            if hasattr(leaf, "ravel") and getattr(leaf, "size", 0) > 0:
-                np.asarray(leaf.ravel()[:1])
+            if getattr(leaf, "ndim", None) and getattr(leaf, "size", 0) > 0:
+                # first-element index: O(1) readback with no reshape (ravel
+                # of a sharded array would all-gather it first)
+                np.asarray(leaf[(0,) * leaf.ndim])
             else:
                 np.asarray(leaf)
 
