@@ -457,6 +457,24 @@ def sampling_weights(n: int, params: TreeParams,
     return None
 
 
+def make_level_count_kernel(S: int, B: int, C: int):
+    """The tree builder's hot kernel: one frontier pass of histogramming
+    (the reference reducer accumulation, tree/DecisionTreeBuilder.java
+    :730-767, as a single one-hot contraction).  Module-level so the driver
+    compile-check (__graft_entry__) exercises the exact production kernel."""
+    def kernel(node_ids, branches, cls_codes, weights, n_nodes):
+        """counts[node, split, branch, class] for active records
+        (node_id >= 0).  n_nodes is static per level."""
+        active = (node_ids >= 0)
+        w = weights * active.astype(jnp.float32)
+        nc = jnp.where(active, node_ids, 0) * C + cls_codes       # (n,)
+        oh_nc = jax.nn.one_hot(nc, n_nodes * C, dtype=jnp.float32) * w[:, None]
+        oh_b = jax.nn.one_hot(branches, B, dtype=jnp.float32)     # (n, S, B)
+        counts = jnp.einsum("na,nsb->asb", oh_nc, oh_b)           # (N*C, S, B)
+        return counts.reshape(n_nodes, C, S, B).transpose(0, 2, 3, 1)
+    return kernel
+
+
 class TreeBuilder:
     """Level-synchronous tree growth over a device mesh.
 
@@ -515,17 +533,7 @@ class TreeBuilder:
 
     # ---- kernels ----
     def _make_count_kernel(self, S, B, C):
-        def kernel(node_ids, branches, cls_codes, weights, n_nodes):
-            """counts[node, split, branch, class] for active records
-            (node_id >= 0).  n_nodes is static per level."""
-            active = (node_ids >= 0)
-            w = weights * active.astype(jnp.float32)
-            nc = jnp.where(active, node_ids, 0) * C + cls_codes       # (n,)
-            oh_nc = jax.nn.one_hot(nc, n_nodes * C, dtype=jnp.float32) * w[:, None]
-            oh_b = jax.nn.one_hot(branches, B, dtype=jnp.float32)     # (n, S, B)
-            counts = jnp.einsum("na,nsb->asb", oh_nc, oh_b)           # (N*C, S, B)
-            return counts.reshape(n_nodes, C, S, B).transpose(0, 2, 3, 1)
-        return kernel
+        return make_level_count_kernel(S, B, C)
 
     @staticmethod
     def _reassign(node_ids, branches, sel_split, child_table):

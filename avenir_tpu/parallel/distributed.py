@@ -26,13 +26,21 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 def initialize(coordinator_address: Optional[str] = None,
                num_processes: Optional[int] = None,
-               process_id: Optional[int] = None) -> bool:
+               process_id: Optional[int] = None,
+               auto: Optional[bool] = None) -> bool:
     """Join (or skip joining) a multi-host run.
 
-    Arguments default to the standard env vars (JAX_COORDINATOR_ADDRESS,
-    JAX_NUM_PROCESSES, JAX_PROCESS_ID — also set by TPU pod runtimes
-    automatically).  Returns True when a multi-process runtime was
-    initialized, False for the single-process fallback."""
+    Three modes:
+      * explicit: pass the full (coordinator, num_processes, process_id)
+        triple, or set JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
+        JAX_PROCESS_ID;
+      * auto-detect (``auto=True`` or AVENIR_TPU_DISTRIBUTED=1): bare
+        ``jax.distributed.initialize()`` — on TPU pod runtimes the cluster
+        is discovered from the environment;
+      * neither: single-process no-op, returns False.
+    A partially-specified explicit config raises instead of silently
+    running single-process (each host computing 'global' results over only
+    its own shard is the worst failure mode of this module)."""
     coordinator_address = coordinator_address or os.environ.get(
         "JAX_COORDINATOR_ADDRESS")
     if num_processes is None:
@@ -41,12 +49,23 @@ def initialize(coordinator_address: Optional[str] = None,
     if process_id is None:
         pid_env = os.environ.get("JAX_PROCESS_ID")
         process_id = int(pid_env) if pid_env else None
-    if not coordinator_address or not num_processes or num_processes <= 1:
-        return False
-    jax.distributed.initialize(coordinator_address=coordinator_address,
-                               num_processes=num_processes,
-                               process_id=process_id)
-    return True
+    if coordinator_address and num_processes and num_processes > 1:
+        if process_id is None:
+            raise ValueError("coordinator + num_processes set but no "
+                             "process id (JAX_PROCESS_ID)")
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+        return True
+    if coordinator_address and num_processes is None:
+        raise ValueError("JAX_COORDINATOR_ADDRESS set without "
+                         "JAX_NUM_PROCESSES; refusing to run single-process")
+    if auto is None:
+        auto = os.environ.get("AVENIR_TPU_DISTRIBUTED") == "1"
+    if auto:
+        jax.distributed.initialize()  # pod runtimes self-discover
+        return jax.process_count() > 1
+    return False
 
 
 def make_hybrid_mesh(data_axis: str = "data", host_axis: str = "hosts",
@@ -55,30 +74,18 @@ def make_hybrid_mesh(data_axis: str = "data", host_axis: str = "hosts",
     the host axis spans DCN.  Single-host: a 1 x n mesh with the same axis
     names, so shardings written against it are portable."""
     devs = list(devices if devices is not None else jax.devices())
-    n_hosts = max(getattr(jax, "process_count", lambda: 1)(), 1)
-    per_host = len(devs) // n_hosts
-    if per_host == 0:
-        raise ValueError(f"{len(devs)} devices across {n_hosts} hosts: "
-                         "fewer devices than hosts")
-    if per_host * n_hosts != len(devs):
-        # uneven layout: use the largest even grid, dropping the remainder
-        # loudly rather than crashing in a reshape
+    # group by owning process so a mesh row NEVER mixes hosts (each row =
+    # one host's ICI domain; the column axis is the only one crossing DCN)
+    by_host: dict = {}
+    for d in devs:
+        by_host.setdefault(getattr(d, "process_index", 0), []).append(d)
+    per_host = min(len(g) for g in by_host.values())
+    if any(len(g) != per_host for g in by_host.values()):
         import warnings
-        warnings.warn(f"{len(devs)} devices not divisible by {n_hosts} "
-                      f"hosts; using {per_host * n_hosts} devices")
-        devs = devs[:per_host * n_hosts]
-    if n_hosts > 1 and per_host * n_hosts == len(devs):
-        try:
-            from jax.experimental import mesh_utils
-            arr = mesh_utils.create_hybrid_device_mesh(
-                (per_host,), (n_hosts,), devices=devs)
-            # create_hybrid_device_mesh returns (dcn, ici)-ordered axes
-            return Mesh(arr.reshape(n_hosts, per_host),
-                        (host_axis, data_axis))
-        except Exception:
-            pass
-    grid = np.array(devs).reshape(1, len(devs)) if n_hosts == 1 else \
-        np.array(devs).reshape(n_hosts, per_host)
+        warnings.warn(
+            f"uneven devices per host {sorted(len(g) for g in by_host.values())}; "
+            f"truncating every host to {per_host}")
+    grid = np.array([by_host[h][:per_host] for h in sorted(by_host)])
     return Mesh(grid, (host_axis, data_axis))
 
 

@@ -1,0 +1,84 @@
+"""Validation/model-selection layer (reference python/supv/svm.py k-fold /
+random-fold / bagging) over framework trainers."""
+
+import numpy as np
+
+from avenir_tpu.models import validation as V
+
+
+def _blobs(n=300, seed=0):
+    rng = np.random.default_rng(seed)
+    y = (rng.random(n) < 0.5).astype(np.int32)
+    X = rng.normal(0, 1, (n, 2)).astype(np.float32) + np.where(
+        y[:, None] == 1, 1.8, -1.8)
+    return X, y
+
+
+def _centroid_train(X, y):
+    return {c: X[y == c].mean(axis=0) for c in np.unique(y)}
+
+
+def _centroid_predict(model, X):
+    classes = sorted(model)
+    d = np.stack([np.linalg.norm(X - model[c], axis=1) for c in classes])
+    return np.asarray(classes)[np.argmin(d, axis=0)]
+
+
+def test_kfold_validation():
+    X, y = _blobs()
+    res = V.kfold_validation(X, y, 5, _centroid_train, _centroid_predict)
+    assert len(res.scores) == 5
+    assert res.mean > 0.9
+    assert res.std < 0.1
+
+
+def test_random_fold_validation():
+    X, y = _blobs(seed=1)
+    res = V.random_fold_validation(X, y, n_folds=5, n_iter=7,
+                                   train_fn=_centroid_train,
+                                   predict_fn=_centroid_predict)
+    assert len(res.scores) == 7
+    assert res.mean > 0.9
+
+
+def test_bagging_and_vote():
+    X, y = _blobs(seed=2)
+    models = V.bagging_train(X, y, 5, _centroid_train)
+    assert len(models) == 5
+    pred = V.majority_vote(models, X, _centroid_predict)
+    assert (pred == y).mean() > 0.9
+
+
+def test_kfold_vmapped_matches_loop():
+    """Masked nearest-centroid trainer under vmap: one XLA program, k folds."""
+    import jax.numpy as jnp
+    X, y = _blobs(seed=3)
+
+    def train_fold(Xj, yj, train_mask):
+        w = train_mask.astype(jnp.float32)
+        sums = jnp.stack([
+            (Xj * (w * (yj == c))[:, None]).sum(0)
+            / jnp.maximum((w * (yj == c)).sum(), 1.0) for c in (0, 1)])
+        d = jnp.linalg.norm(Xj[:, None, :] - sums[None], axis=2)  # (n, 2)
+        pred = jnp.argmin(d, axis=1)
+        val = 1.0 - w
+        return ((pred == yj) * val).sum() / jnp.maximum(val.sum(), 1.0)
+
+    res = V.kfold_validation_vmapped(X, y, 5, train_fold)
+    assert len(res.scores) == 5
+    assert res.mean > 0.9
+    loop = V.kfold_validation(X, y, 5, _centroid_train, _centroid_predict)
+    assert abs(res.mean - loop.mean) < 0.05
+
+
+def test_kfold_with_mlp():
+    """The validation layer composes with the NN pack trainer."""
+    from avenir_tpu.nn import mlp
+    X, y = _blobs(seed=4, n=200)
+    cfg = mlp.MLPConfig(hidden_dim=4, iterations=150, learning_rate=0.02)
+
+    res = V.kfold_validation(
+        X, y, 4,
+        train_fn=lambda Xt, yt: mlp.train(Xt, yt, cfg)[0],
+        predict_fn=lambda m, Xv: mlp.predict(m, Xv))
+    assert res.mean > 0.9
