@@ -35,6 +35,7 @@ t_{i-1} < x <= t_i.
 
 from __future__ import annotations
 
+import functools
 import itertools
 import json
 import math
@@ -457,6 +458,7 @@ def sampling_weights(n: int, params: TreeParams,
     return None
 
 
+@functools.lru_cache(maxsize=None)
 def make_level_count_kernel(S: int, B: int, C: int):
     """The tree builder's hot kernel: one frontier pass of histogramming
     (the reference reducer accumulation, tree/DecisionTreeBuilder.java
@@ -473,6 +475,11 @@ def make_level_count_kernel(S: int, B: int, C: int):
         counts = jnp.einsum("na,nsb->asb", oh_nc, oh_b)           # (N*C, S, B)
         return counts.reshape(n_nodes, C, S, B).transpose(0, 2, 3, 1)
     return kernel
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_level_count_kernel(S: int, B: int, C: int):
+    return jax.jit(make_level_count_kernel(S, B, C), static_argnums=4)
 
 
 class TreeBuilder:
@@ -509,12 +516,14 @@ class TreeBuilder:
         self.base_mask = self.ctx.shard_rows(padded.valid_mask)
         # branch codes computed once; (n, S) int32 on device
         self._branch_fn = jax.jit(self.split_set.branch_codes)
+        # kernels jitted once per (S, B, C) PROCESS-wide (lru_cache + the
+        # module-level jit below), so a new builder per forest/bench run
+        # reuses the compiled code
         self.branches = self._branch_fn(self.X)
 
         S, B, C = self.split_set.n_splits, self.split_set.max_branches, self.C
-        self._count_kernel = jax.jit(self._make_count_kernel(S, B, C),
-                                     static_argnums=4)
-        self._reassign_kernel = jax.jit(self._reassign)
+        self._count_kernel = _jitted_level_count_kernel(S, B, C)
+        self._reassign_kernel = _REASSIGN_JIT
 
         # splits grouped by attr for selection strategies
         self.splits_by_attr: Dict[int, List[int]] = {}
@@ -745,6 +754,11 @@ class TreeBuilder:
                          class_val_pr=l.class_val_pr)
             for l in new_leaves]
         return DecisionPathList(paths)
+
+
+# process-wide jit of the (pure, static) reassignment kernel: every builder
+# shares one compiled version per shape signature
+_REASSIGN_JIT = jax.jit(TreeBuilder._reassign)
 
 
 # --------------------------------------------------------------------------

@@ -50,6 +50,28 @@ class DistanceComputer:
              and f.min is not None else 1.0 for f in self.num_fields],
             dtype=np.float32)
         self.cards = [len(f.cardinality or []) for f in self.cat_fields]
+        # jit once per computer: a fresh closure per pairwise() call would
+        # retrace + recompile every invocation
+        n_cat = float(len(self.cat_fields))
+        denom = float(max(self.n_attrs, 1))
+        fscale = float(self.scale)
+
+        def _euclid(tn, toh, rn, roh):
+            sq = (tn * tn).sum(1)[:, None] + (rn * rn).sum(1)[None, :] \
+                - 2.0 * tn @ rn.T                                  # (nt, nr)
+            cat_match = toh @ roh.T                                # matches
+            cat_mismatch = n_cat - cat_match
+            total = jnp.maximum(sq, 0.0) + cat_mismatch            # d in {0,1}: d^2=d
+            mean = total / denom
+            return jnp.floor(jnp.sqrt(jnp.maximum(mean, 0.0)) * fscale)
+
+        def _manh(tn_tile, toh_tile, rn, roh):
+            num = jnp.abs(tn_tile[:, None, :] - rn[None, :, :]).sum(2)
+            cat = n_cat - toh_tile @ roh.T
+            return jnp.floor((num + cat) / denom * fscale)
+
+        self._euclid_jit = jax.jit(_euclid)
+        self._manh_jit = jax.jit(_manh)
 
     # ---- encode a table into (numeric matrix, categorical block one-hot) ----
     def encode(self, table: ColumnarTable) -> Tuple[np.ndarray, np.ndarray]:
@@ -85,30 +107,13 @@ class DistanceComputer:
         return np.asarray(d).astype(np.int32)
 
     def _euclidean(self, tn, toh, rn, roh):
-        @jax.jit
-        def kernel(tn, toh, rn, roh):
-            sq = (tn * tn).sum(1)[:, None] + (rn * rn).sum(1)[None, :] \
-                - 2.0 * tn @ rn.T                                  # (nt, nr)
-            cat_match = toh @ roh.T                                # matches
-            cat_mismatch = float(len(self.cat_fields)) - cat_match
-            total = jnp.maximum(sq, 0.0) + cat_mismatch            # d in {0,1}: d^2=d
-            mean = total / max(self.n_attrs, 1)
-            return jnp.floor(jnp.sqrt(jnp.maximum(mean, 0.0)) * self.scale)
-        return kernel(tn, toh, rn, roh)
+        return self._euclid_jit(tn, toh, rn, roh)
 
     def _manhattan_tiled(self, tn, toh, rn, roh, tile):
         out = np.zeros((tn.shape[0], rn.shape[0]), dtype=np.float32)
-
-        @jax.jit
-        def kernel(tn_tile, toh_tile, rn, roh):
-            num = jnp.abs(tn_tile[:, None, :] - rn[None, :, :]).sum(2)
-            cat_match = toh_tile @ roh.T
-            cat = float(len(self.cat_fields)) - cat_match
-            mean = (num + cat) / max(self.n_attrs, 1)
-            return jnp.floor(mean * self.scale)
-
         for s in range(0, tn.shape[0], tile):
             e = min(s + tile, tn.shape[0])
-            out[s:e] = np.asarray(kernel(jnp.asarray(tn[s:e]), jnp.asarray(toh[s:e]),
-                                         jnp.asarray(rn), jnp.asarray(roh)))
+            out[s:e] = np.asarray(self._manh_jit(
+                jnp.asarray(tn[s:e]), jnp.asarray(toh[s:e]),
+                jnp.asarray(rn), jnp.asarray(roh)))
         return out

@@ -1,0 +1,197 @@
+#!/usr/bin/env python3
+"""Measure the five BASELINE.json configs end-to-end and print one JSON line
+per config (plus a markdown table to stderr for BASELINE.md).  Run with
+JAX_PLATFORMS=cpu for the CPU fallback numbers, or on the TPU chip.
+
+Workloads (scaled-down row counts; scale with --scale):
+  naive_bayes   train-distribution throughput (rows/sec)
+  random_forest full forest build (rows*trees/sec)
+  knn           distance matrix + top-k classify (test rows/sec)
+  sa            simulated-annealing chain throughput (chain-steps/sec)
+  logistic      full-batch LR iterations (rows*iters/sec)
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def _force_platform():
+    import jax
+    want = os.environ.get("JAX_PLATFORMS")
+    if want and want != jax.config.jax_platforms:
+        jax.config.update("jax_platforms", want)
+    return jax
+
+
+def bench_naive_bayes(scale):
+    jax = _force_platform()
+    from avenir_tpu.ops.histogram import class_bin_histogram_chunked
+    n = int(2_000_000 * scale)
+    rng = np.random.default_rng(0)
+    cls = jax.device_put(rng.integers(0, 2, n).astype(np.int32))
+    bins = jax.device_put(rng.integers(0, 12, (n, 6)).astype(np.int32))
+    mask = jax.device_put(np.ones(n, dtype=bool))
+    fn = jax.jit(lambda c, b, m: class_bin_histogram_chunked(
+        c, b, 2, 12, m, chunk=1 << 19))
+    np.asarray(fn(cls, bins, mask))
+    t0 = time.perf_counter()
+    reps = 3
+    for _ in range(reps):
+        np.asarray(fn(cls, bins, mask))
+    dt = (time.perf_counter() - t0) / reps
+    return {"metric": "naive_bayes_rows_per_sec", "value": round(n / dt, 1),
+            "n_rows": n}
+
+
+def bench_random_forest(scale):
+    _force_platform()
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "resource"))
+    from gen.call_hangup_gen import generate
+    from avenir_tpu.core.schema import FeatureSchema
+    from avenir_tpu.core.table import load_csv_text
+    from avenir_tpu.models.forest import ForestParams, build_forest
+    from avenir_tpu.parallel.mesh import MeshContext
+    schema = FeatureSchema.load(os.path.join(
+        os.path.dirname(__file__), "..", "resource", "call_hangup.json"))
+    n = int(200_000 * scale)
+    table = load_csv_text("\n".join(generate(n, 1)), schema)
+    params = ForestParams(num_trees=5, seed=1)
+    params.tree.max_depth = 4
+    ctx = MeshContext()
+    warm = ForestParams(num_trees=1, seed=0)
+    warm.tree.max_depth = 4  # identical shapes: kernel caches hit in the timed run
+    build_forest(table, warm, ctx)
+    t0 = time.perf_counter()
+    models = build_forest(table, params, ctx)
+    dt = time.perf_counter() - t0
+    return {"metric": "random_forest_rows_x_trees_per_sec",
+            "value": round(n * len(models) / dt, 1), "n_rows": n,
+            "trees": len(models), "build_s": round(dt, 2)}
+
+
+def bench_knn(scale):
+    jax = _force_platform()
+    from avenir_tpu.core.schema import FeatureSchema
+    from avenir_tpu.core.table import load_csv_text
+    from avenir_tpu.ops.distance import DistanceComputer
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "resource"))
+    from gen.elearn_gen import generate
+    schema = FeatureSchema.load(os.path.join(
+        os.path.dirname(__file__), "..", "resource", "elearn.json"))
+    n_train, n_test = int(20_000 * scale), int(2_000 * scale)
+    rows = generate(n_train + n_test, 2)
+    train = load_csv_text("\n".join(rows[:n_train]), schema)
+    test = load_csv_text("\n".join(rows[n_train:]), schema)
+    comp = DistanceComputer(schema, scale=1000)
+    comp.pairwise(test, train)  # warm
+    t0 = time.perf_counter()
+    dmat = comp.pairwise(test, train)
+    k = min(10, n_train)
+    idx = np.argpartition(dmat, k, axis=1)[:, :k]
+    dt = time.perf_counter() - t0
+    assert idx.shape[0] == n_test
+    return {"metric": "knn_test_rows_per_sec", "value": round(n_test / dt, 1),
+            "n_train": n_train, "n_test": n_test}
+
+
+def bench_sa(scale):
+    _force_platform()
+    from avenir_tpu.optimize.annealing import AnnealingParams, simulated_annealing
+    from avenir_tpu.optimize.domain import MatrixCostDomain
+    rng = np.random.default_rng(0)
+    L, C = 40, 12
+    domain = MatrixCostDomain(cost_matrix=rng.random((L, C)),
+                              conflict=np.zeros((L, L)))
+    iters, opts = int(2000 * scale), 32
+    # simulated_annealing compiles per call (its scan closes over the
+    # domain), so estimate steady-state throughput by differencing two runs
+    # of different lengths: compile cost cancels, leaving the extra steps
+    def timed(n_it):
+        params = AnnealingParams(num_optimizers=opts, max_num_iterations=n_it,
+                                 initial_temp=10.0, seed=0)
+        t0 = time.perf_counter()
+        simulated_annealing(domain, params)
+        return time.perf_counter() - t0
+
+    t_short = timed(5 * iters)
+    t_long = timed(55 * iters)
+    extra = t_long - t_short
+    if extra > 0.05:  # differencing is only meaningful above timer noise
+        value = round(50 * iters * opts / extra, 1)
+        note = "compile-cancelled via run differencing"
+    else:
+        value = round(55 * iters * opts / t_long, 1)
+        note = "includes one-time compile (execution below timer resolution)"
+    return {"metric": "sa_chain_steps_per_sec", "value": value,
+            "chains": opts, "iters": iters, "note": note}
+
+
+def bench_logistic(scale):
+    _force_platform()
+    from avenir_tpu.regress.logistic import LogisticParams, LogisticTrainer
+    from avenir_tpu.core.schema import FeatureSchema
+    from avenir_tpu.core.table import load_csv_text
+    n = int(200_000 * scale)
+    rng = np.random.default_rng(0)
+    schema = FeatureSchema.from_dict({"fields": [
+        {"name": "x1", "ordinal": 0, "dataType": "double", "feature": True},
+        {"name": "x2", "ordinal": 1, "dataType": "double", "feature": True},
+        {"name": "y", "ordinal": 2, "dataType": "categorical",
+         "cardinality": ["n", "p"]}]})
+    X = rng.normal(size=(n, 2))
+    yb = (X.sum(axis=1) + rng.normal(0, 0.5, n)) > 0
+    text = "\n".join(f"{a:.4f},{b:.4f},{'p' if c else 'n'}"
+                     for (a, b), c in zip(X, yb))
+    table = load_csv_text(text, schema)
+    iters = 20
+    params = LogisticParams(pos_class_value="p", learning_rate=0.1,
+                            convergence_criteria="iterLimit",
+                            iteration_limit=iters)
+    trainer = LogisticTrainer(schema, params)
+    trainer.train(table, [])  # warm
+    t0 = time.perf_counter()
+    trainer.train(table, [])
+    dt = time.perf_counter() - t0
+    return {"metric": "logistic_rows_x_iters_per_sec",
+            "value": round(n * iters / dt, 1), "n_rows": n, "iters": iters}
+
+
+BENCHES = {
+    "naive_bayes": bench_naive_bayes,
+    "random_forest": bench_random_forest,
+    "knn": bench_knn,
+    "sa": bench_sa,
+    "logistic": bench_logistic,
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=float, default=1.0)
+    ap.add_argument("--only", choices=sorted(BENCHES), default=None)
+    args = ap.parse_args()
+    jax = _force_platform()  # BEFORE any backend touch (axon may be wedged)
+    backend = jax.default_backend()
+    rows = []
+    for name, fn in BENCHES.items():
+        if args.only and name != args.only:
+            continue
+        r = fn(args.scale)
+        r["workload"] = name
+        r["backend"] = backend
+        rows.append(r)
+        print(json.dumps(r))
+    print("\n| workload | metric | value | backend |", file=sys.stderr)
+    print("|---|---|---|---|", file=sys.stderr)
+    for r in rows:
+        print(f"| {r['workload']} | {r['metric']} | {r['value']:,} | "
+              f"{r['backend']} |", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
