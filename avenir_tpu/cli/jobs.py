@@ -492,13 +492,60 @@ def nearest_neighbor(cfg: Config, in_path: str, out_path: str) -> Counters:
 # --------------------------------------------------------------------------
 
 @register("org.avenir.bayesian.BayesianDistribution", "bayesianDistribution")
+def _bayesian_predict_text(cfg: Config, in_path: str, out_path: str
+                           ) -> Counters:
+    """Text-mode prediction: tokenize each line's text, classify by summed
+    token log-posteriors, echo record + prediction (+ validation counters
+    when the class label column is present)."""
+    from ..models import bayes_text
+    counters = Counters()
+    od = cfg.field_delim_out
+    delim = cfg.field_delim_regex
+    model = bayes_text.TextBayesModel.from_lines(
+        artifacts.read_text_input(cfg.must_get("bap.bayesian.model.file.path")),
+        od)
+    lines_in = [l for l in artifacts.read_text_input(in_path) if l.strip()]
+    texts, actuals = [], []
+    for line in lines_in:
+        text, _, label = line.rpartition(delim)
+        if label.strip() in model.class_values and text:
+            texts.append(text)
+            actuals.append(label.strip())
+        else:
+            texts.append(line)
+            actuals.append(None)
+    pred, _scores = bayes_text.classify_text(model, texts)
+    out = [f"{raw}{od}{p}" for raw, p in zip(lines_in, pred)]
+    artifacts.write_text_output(out_path, out, role="m")
+    known = [(a, p) for a, p in zip(actuals, pred) if a is not None]
+    if known:
+        correct = sum(1 for a, p in known if a == p)
+        counters.set("Validation", "Correct", correct)
+        counters.set("Validation", "Incorrect", len(known) - correct)
+        counters.set("Validation", "Accuracy",
+                     int(100 * correct / len(known)))
+    return counters
+
+
 def bayesian_distribution(cfg: Config, in_path: str, out_path: str) -> Counters:
     """Naive Bayes training job (bayesian/BayesianDistribution.java).
 
     Config keys honored (same names as the reference): bad.feature.schema.file.path,
-    field.delim.regex, field.delim.out."""
+    field.delim.regex, field.delim.out.  With NO schema file configured the
+    input is text mode — ``text,classLabel`` lines, the token stream being
+    the single feature (BayesianDistribution.java:117-130)."""
     from ..models import bayes
     counters = Counters()
+    if cfg.get("bad.feature.schema.file.path") is None:
+        from ..models import bayes_text
+        model_t = bayes_text.train_text(artifacts.read_text_input(in_path),
+                                        cfg.field_delim_regex)
+        artifacts.write_text_output(out_path,
+                                    model_t.to_lines(cfg.field_delim_out))
+        counters.set("Distribution Data", "Class prior",
+                     len(model_t.class_values))
+        counters.set("Distribution Data", "Vocabulary", len(model_t.vocab))
+        return counters
     schema = _schema_path(cfg, "bad.feature.schema.file.path")
     table = load_csv(in_path, schema, cfg.field_delim_regex)
     ctx = MeshContext()
@@ -513,8 +560,11 @@ def bayesian_predictor(cfg: Config, in_path: str, out_path: str) -> Counters:
 
     Keys: bap.feature.schema.file.path, bap.bayesian.model.file.path,
     bap.predict.class, bap.predict.class.cost, bap.class.prob.diff.threshold,
-    bap.output.feature.prob.only."""
+    bap.output.feature.prob.only.  With NO schema file configured the input
+    is text mode: ``text[,classLabel]`` lines classified by token stream."""
     from ..models import bayes
+    if cfg.get("bap.feature.schema.file.path") is None:
+        return _bayesian_predict_text(cfg, in_path, out_path)
     counters = Counters()
     schema = _schema_path(cfg, "bap.feature.schema.file.path")
     delim = cfg.field_delim_regex

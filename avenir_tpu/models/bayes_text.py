@@ -1,0 +1,145 @@
+"""Text-mode Naive Bayes (the reference's Lucene-analyzed text path of
+BayesianDistribution: when no schema file is configured the input is
+``text,classLabel`` lines and the single feature is the token stream —
+bayesian/BayesianDistribution.java:124-130 setup, :186-195 mapText).
+
+TPU design: tokens become vocabulary codes host-side; counting is the same
+device one-hot contraction as the tabular path over the flattened
+(doc -> token) arrays, and scoring is a gather of per-token class log-probs
+summed per document with a segment reduction — both static-shape programs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..text.wordcount import STANDARD_STOPWORDS, tokenize
+
+TEXT_FEATURE_ORDINAL = 1  # featureAttrOrdinal in text mode (:126)
+
+
+@dataclass
+class TextBayesModel:
+    class_values: List[str]
+    vocab: List[str]                 # token id -> token
+    token_counts: np.ndarray         # (C, V) float
+    class_counts: np.ndarray         # (C,) docs per class
+
+    # ---- model CSV (same layout as the tabular model: class, ord, bin, count
+    #      with the token string as the bin label) ----
+    def to_lines(self, delim: str = ",") -> List[str]:
+        lines = []
+        for ci, cv in enumerate(self.class_values):
+            lines.append(f"{cv}{delim}{delim}{delim}{int(self.class_counts[ci])}")
+        for ci, cv in enumerate(self.class_values):
+            for ti, tok in enumerate(self.vocab):
+                c = int(self.token_counts[ci, ti])
+                if c > 0:
+                    lines.append(f"{cv}{delim}{TEXT_FEATURE_ORDINAL}{delim}"
+                                 f"{tok}{delim}{c}")
+        return lines
+
+    @classmethod
+    def from_lines(cls, lines: Sequence[str], delim: str = ","
+                   ) -> "TextBayesModel":
+        class_counts: Dict[str, int] = {}
+        token_counts: Dict[Tuple[str, str], int] = {}
+        vocab_set = {}
+        for line in lines:
+            line = line.rstrip("\n")
+            if not line.strip():
+                continue
+            items = line.split(delim)
+            if items[1] == "" and items[2] == "":
+                class_counts[items[0]] = int(items[3])
+            elif items[0] != "":
+                tok = items[2]
+                token_counts[(items[0], tok)] = int(items[3])
+                vocab_set.setdefault(tok, len(vocab_set))
+        class_values = sorted(class_counts)
+        vocab = sorted(vocab_set, key=vocab_set.get)
+        tc = np.zeros((len(class_values), len(vocab)))
+        for (cv, tok), n in token_counts.items():
+            tc[class_values.index(cv), vocab_set[tok]] = n
+        return cls(class_values=class_values, vocab=vocab, token_counts=tc,
+                   class_counts=np.array([class_counts[c]
+                                          for c in class_values], dtype=float))
+
+
+def _flatten(docs_tokens: List[List[int]]) -> Tuple[np.ndarray, np.ndarray]:
+    """(token_codes, doc_ids) flattened over all documents."""
+    codes = np.fromiter((t for doc in docs_tokens for t in doc),
+                        dtype=np.int32)
+    doc_ids = np.fromiter((i for i, doc in enumerate(docs_tokens)
+                           for _ in doc), dtype=np.int32)
+    return codes, doc_ids
+
+
+def train_text(lines: Sequence[str], delim: str = ",",
+               stopwords: frozenset = STANDARD_STOPWORDS) -> TextBayesModel:
+    """Count (class, token) occurrences over ``text<delim>class`` lines (the
+    text-mode mapper/reducer collapsed into one one-hot contraction)."""
+    texts, labels = [], []
+    for line in lines:
+        line = line.rstrip("\n")
+        if not line.strip():
+            continue
+        text, _, label = line.rpartition(delim)
+        texts.append(text)
+        labels.append(label.strip())
+    class_values = sorted(set(labels))
+    cls_index = {c: i for i, c in enumerate(class_values)}
+    vocab: Dict[str, int] = {}
+    docs_tokens: List[List[int]] = []
+    for t in texts:
+        toks = tokenize(t, stopwords)
+        docs_tokens.append([vocab.setdefault(tok, len(vocab)) for tok in toks])
+    V, C = max(len(vocab), 1), len(class_values)
+    codes, doc_ids = _flatten(docs_tokens)
+    tok_cls = np.array([cls_index[labels[d]] for d in doc_ids], dtype=np.int32)
+    # same device kernel as the tabular path: counts[c, v]
+    combined = jnp.asarray(tok_cls) * V + jnp.asarray(codes)
+    counts = jax.jit(
+        lambda x: jnp.zeros((C * V,), jnp.float32).at[x].add(1.0)
+    )(combined).reshape(C, V)
+    class_counts = np.bincount([cls_index[l] for l in labels], minlength=C)
+    inv = [None] * len(vocab)
+    for tok, i in vocab.items():
+        inv[i] = tok
+    return TextBayesModel(class_values=class_values, vocab=inv,
+                          token_counts=np.asarray(counts),
+                          class_counts=class_counts.astype(float))
+
+
+def classify_text(model: TextBayesModel, texts: Sequence[str],
+                  laplace: float = 1.0,
+                  stopwords: frozenset = STANDARD_STOPWORDS
+                  ) -> Tuple[List[str], np.ndarray]:
+    """(predicted labels, (n, C) class log-posteriors): per-token class
+    log-probs gathered and segment-summed per document."""
+    C, V = model.token_counts.shape
+    vocab_index = {t: i for i, t in enumerate(model.vocab)}
+    docs_tokens = [[vocab_index[t] for t in tokenize(x, stopwords)
+                    if t in vocab_index] for x in texts]
+    codes, doc_ids = _flatten(docs_tokens)
+    totals = model.token_counts.sum(axis=1, keepdims=True)
+    log_post = np.log((model.token_counts + laplace)
+                      / (totals + laplace * V))             # (C, V)
+    log_prior = np.log(np.maximum(model.class_counts, 1e-12)
+                       / max(model.class_counts.sum(), 1.0))
+    n = len(texts)
+    if len(codes):
+        per_token = jnp.asarray(log_post)[:, jnp.asarray(codes)]   # (C, T)
+        sums = jax.vmap(lambda row: jax.ops.segment_sum(
+            row, jnp.asarray(doc_ids), num_segments=n))(per_token)  # (C, n)
+        scores = np.asarray(sums).T + log_prior[None, :]
+    else:
+        scores = np.tile(log_prior, (n, 1))
+    pred = [model.class_values[i] for i in np.argmax(scores, axis=1)]
+    return pred, scores
