@@ -491,7 +491,6 @@ def nearest_neighbor(cfg: Config, in_path: str, out_path: str) -> Counters:
 # org.avenir.bayesian
 # --------------------------------------------------------------------------
 
-@register("org.avenir.bayesian.BayesianDistribution", "bayesianDistribution")
 def _bayesian_predict_text(cfg: Config, in_path: str, out_path: str
                            ) -> Counters:
     """Text-mode prediction: tokenize each line's text, classify by summed
@@ -527,6 +526,7 @@ def _bayesian_predict_text(cfg: Config, in_path: str, out_path: str
     return counters
 
 
+@register("org.avenir.bayesian.BayesianDistribution", "bayesianDistribution")
 def bayesian_distribution(cfg: Config, in_path: str, out_path: str) -> Counters:
     """Naive Bayes training job (bayesian/BayesianDistribution.java).
 

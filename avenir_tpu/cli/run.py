@@ -19,6 +19,10 @@ from __future__ import annotations
 import sys
 from typing import List, Optional
 
+from ..core.platform import force_platform
+
+force_platform()  # AVENIR_TPU_PLATFORM=cpu escape hatch, before any backend init
+
 from ..core.config import Config, load_config
 from . import jobs
 from . import explore_jobs  # noqa: F401  (registers explore-pack jobs)
@@ -62,6 +66,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("usage: python -m avenir_tpu.cli.run <JobClassOrAlias> "
               "-Dconf.path=<conf> [<inPath>] <outPath>", file=sys.stderr)
         return 2
+    if "platform" in overrides:
+        force_platform(overrides["platform"])
     fn = jobs.resolve(job_name)
     cfg = load_config(conf_path, app=job_name.split(".")[-1][0].lower() +
                       job_name.split(".")[-1][1:]) if conf_path else Config()

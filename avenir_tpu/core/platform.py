@@ -1,0 +1,30 @@
+"""Backend/platform selection for processes whose interpreter pre-imports jax.
+
+The dev container's sitecustomize imports jax at interpreter start pinned to
+the tunneled TPU ("axon"); when that tunnel is wedged, every device call hangs
+forever.  Because jax is already imported, setting JAX_PLATFORMS in the
+environment is not enough — ``jax.config.update`` must run before any backend
+initializes.  This is the single shared escape hatch for the CLI
+(``AVENIR_TPU_PLATFORM=cpu`` / ``-Dplatform=cpu``), the benchmark harness, and
+tests (conftest applies the same recipe).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def force_platform(name: Optional[str] = None) -> Optional[str]:
+    """Pin jax to ``name`` (or $AVENIR_TPU_PLATFORM / $JAX_PLATFORMS when
+    ``name`` is None).  No-op when nothing is requested or jax already agrees.
+    Returns the platform applied, if any."""
+    name = name or os.environ.get("AVENIR_TPU_PLATFORM") \
+        or os.environ.get("JAX_PLATFORMS")
+    if not name:
+        return None
+    os.environ["JAX_PLATFORMS"] = name
+    import jax
+    if jax.config.jax_platforms != name:
+        jax.config.update("jax_platforms", name)
+    return name

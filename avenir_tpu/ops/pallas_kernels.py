@@ -44,13 +44,15 @@ def _auto_interpret() -> bool:
 
 
 def _pick_tile(n_cols: int, num_codes: int, requested: Optional[int]) -> int:
+    """Sublane-aligned tile such that the (tile, F, K) one-hot fits the VMEM
+    budget; the budget wins over the efficiency floor, never the other way
+    around (large K shrinks tile).  Returns 0 when even an 8-row tile would
+    blow the budget — the caller must fail over to the XLA path."""
     if requested is not None:
         return requested
-    # the (tile, F, K) one-hot must fit the VMEM budget; the budget wins over
-    # the efficiency floor, never the other way around (large K shrinks tile)
     tile = _ONEHOT_BUDGET // max(n_cols * num_codes, 1)
-    tile = min(4096, tile)
-    return max(8, (tile // 8) * 8)  # sublane-aligned
+    tile = min(4096, (tile // 8) * 8)
+    return tile if tile >= 8 else 0
 
 
 @partial(jax.jit, static_argnames=("num_codes", "tile", "interpret"))
@@ -70,6 +72,11 @@ def coded_histogram(codes: jnp.ndarray, num_codes: int,
     if n == 0:  # grid=(0,) would never run the zero-init step
         return jnp.zeros((F, num_codes), dtype=jnp.float32)
     tile = _pick_tile(F, num_codes, tile)
+    if tile == 0:  # F*K too large for any VMEM-safe tile: XLA scatter-add
+        # (O(n*F), no (n, F, K) intermediate; out-of-range codes drop)
+        return jnp.zeros((F, num_codes), jnp.float32).at[
+            jnp.arange(F)[None, :], codes
+        ].add((codes >= 0).astype(jnp.float32), mode="drop")
     pad = (-n) % tile
     codes = jnp.pad(codes, ((0, pad), (0, 0)), constant_values=-1)
     n_tiles = codes.shape[0] // tile

@@ -60,6 +60,10 @@ def initialize(coordinator_address: Optional[str] = None,
     if coordinator_address and num_processes is None:
         raise ValueError("JAX_COORDINATOR_ADDRESS set without "
                          "JAX_NUM_PROCESSES; refusing to run single-process")
+    if num_processes and num_processes > 1 and not coordinator_address:
+        raise ValueError("JAX_NUM_PROCESSES > 1 without a coordinator "
+                         "address; refusing to run single-process (each host "
+                         "would compute 'global' results over its own shard)")
     if auto is None:
         auto = os.environ.get("AVENIR_TPU_DISTRIBUTED") == "1"
     if auto:

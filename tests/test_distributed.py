@@ -14,6 +14,19 @@ def test_initialize_noop_without_coordinator(monkeypatch):
     assert D.initialize() is False
 
 
+def test_initialize_refuses_partial_config(monkeypatch):
+    import pytest
+    monkeypatch.delenv("JAX_NUM_PROCESSES", raising=False)
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:1234")
+    with pytest.raises(ValueError):
+        D.initialize()
+    # and the other direction: a process count with nowhere to rendezvous
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "4")
+    with pytest.raises(ValueError):
+        D.initialize()
+
+
 def test_hybrid_mesh_single_host_shape():
     mesh = D.make_hybrid_mesh()
     assert mesh.axis_names == ("hosts", "data")

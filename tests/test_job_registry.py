@@ -1,0 +1,108 @@
+"""Registry self-check: every registered Hadoop/Spark class name must resolve
+to the job function it was designed for, so a decorator can never silently
+migrate onto a neighboring helper again (the round-1 regression: the
+``bayesianDistribution`` registration attached itself to an inserted
+text-prediction helper, killing the NaiveBayes training path).
+
+The expected map below is the contract — adding a job means adding a line
+here, which is the point.
+"""
+
+from avenir_tpu.cli import run  # noqa: F401 -- imports all job modules
+from avenir_tpu.cli.jobs import JOBS, resolve
+
+# Fully-qualified reference class name -> implementing function name.
+# Folded jobs (two class names, one function) are intentional and noted.
+EXPECTED = {
+    "org.avenir.association.AssociationRuleMiner": "association_rule_miner",
+    "org.avenir.association.FrequentItemsApriori": "frequent_items_apriori",
+    "org.avenir.association.InfrequentItemMarker": "infrequent_item_marker",
+    "org.avenir.bayesian.BayesianDistribution": "bayesian_distribution",
+    "org.avenir.bayesian.BayesianPredictor": "bayesian_predictor",
+    "org.avenir.cluster.AgglomerativeGraphical": "agglomerative_graphical",
+    "org.avenir.cluster.KmeansCluster": "kmeans_cluster",
+    "org.avenir.discriminant.FisherDiscriminant": "fisher_discriminant_job",
+    "org.avenir.discriminant.SupportVectorMachine": "support_vector_machine",
+    "org.avenir.discriminant.SupportVectorPredictor": "support_vector_predictor",
+    "org.avenir.explore.AdaBoostError": "adaboost_error_job",
+    "org.avenir.explore.AdaBoostUpdate": "adaboost_update_job",
+    "org.avenir.explore.BaggingSampler": "bagging_sampler",
+    "org.avenir.explore.CategoricalClassAffinity": "categorical_class_affinity",
+    "org.avenir.explore.CategoricalContinuousEncoding":
+        "categorical_continuous_encoding_job",
+    "org.avenir.explore.ClassBasedOverSampler": "class_based_over_sampler",
+    "org.avenir.explore.ClassPartitionGenerator": "class_partition_generator",
+    "org.avenir.explore.CramerCorrelation": "cramer_correlation",
+    "org.avenir.explore.HeterogeneityReductionCorrelation":
+        "heterogeneity_correlation",
+    "org.avenir.explore.MutualInformation": "mutual_information",
+    "org.avenir.explore.NumericalCorrelation": "numerical_correlation",
+    "org.avenir.explore.ReliefFeatureRelevance": "relief_feature_relevance",
+    "org.avenir.explore.RuleEvaluator": "rule_evaluator",
+    "org.avenir.explore.TopMatchesByClass": "top_matches_by_class",
+    "org.avenir.explore.UnderSamplingBalancer": "under_sampling_balancer",
+    "org.avenir.knn.FeatureCondProbJoiner": "feature_cond_prob_joiner",
+    "org.avenir.knn.NearestNeighbor": "nearest_neighbor",
+    "org.avenir.markov.HiddenMarkovModelBuilder": "hidden_markov_model_builder",
+    "org.avenir.markov.MarkovModelClassifier": "markov_model_classifier",
+    "org.avenir.markov.MarkovStateTransitionModel":
+        "markov_state_transition_model",
+    "org.avenir.markov.ProbabilisticSuffixTreeGenerator":
+        "probabilistic_suffix_tree_generator",
+    "org.avenir.markov.ViterbiStatePredictor": "viterbi_state_predictor",
+    "org.avenir.model.ModelPredictor": "model_predictor_job",
+    "org.avenir.regress.LogisticRegressionJob": "logistic_regression",
+    "org.avenir.regress.LogisticRegressionPredictor":
+        "logistic_regression_predictor",
+    "org.avenir.reinforce.AuerDeterministic": "auer_deterministic",
+    "org.avenir.reinforce.GreedyRandomBandit": "greedy_random_bandit",
+    "org.avenir.reinforce.RandomFirstGreedyBandit": "random_first_greedy_bandit",
+    "org.avenir.reinforce.SoftMaxBandit": "soft_max_bandit",
+    "org.avenir.sequence.CandidateGenerationWithSelfJoin":
+        "candidate_generation_with_self_join",
+    "org.avenir.sequence.SequencePositionalCluster":
+        "sequence_positional_cluster",
+    "org.avenir.spark.markov.ContTimeStateTransitionStats":
+        "cont_time_state_transition_stats",
+    "org.avenir.spark.optimize.GeneticAlgorithm": "genetic_algorithm_job",
+    "org.avenir.spark.optimize.SimulatedAnnealing": "simulated_annealing_job",
+    "org.avenir.spark.reinforce.MultiArmBandit": "multi_arm_bandit",
+    "org.avenir.supv.NeuralNetworkPredictor": "neural_network_predictor",
+    "org.avenir.supv.NeuralNetworkTrainer": "neural_network_trainer",
+    "org.avenir.text.WordCounter": "word_counter",
+    "org.avenir.tree.DataPartitioner": "data_partitioner",
+    "org.avenir.tree.DecisionTreeBuilder": "decision_tree_builder",
+    "org.avenir.tree.RandomForestBuilder": "random_forest_builder",
+    # folded: SplitGenerator shares ClassPartitionGenerator's implementation
+    "org.avenir.tree.SplitGenerator": "class_partition_generator",
+    "org.avenir.util.EntityDistanceMapFileAccessor": "entity_distance_store",
+    "org.sifarish.feature.SameTypeSimilarity": "same_type_similarity",
+}
+
+
+def test_every_fqcn_resolves_to_its_function():
+    actual = {k: fn.__name__ for k, fn in JOBS.items() if "." in k}
+    assert actual == EXPECTED
+
+
+def test_no_private_helper_is_registered():
+    offenders = {k: fn.__name__ for k, fn in JOBS.items()
+                 if fn.__name__.startswith("_")}
+    assert offenders == {}
+
+
+def test_aliases_agree_with_fqcn():
+    """Each camelCase alias must dispatch to the same function as its
+    fully-qualified counterpart (lowerCamel of the class simple name)."""
+    fq = {k: fn for k, fn in JOBS.items() if "." in k}
+    for k, fn in fq.items():
+        simple = k.split(".")[-1]
+        alias = simple[0].lower() + simple[1:]
+        if alias in JOBS:
+            assert JOBS[alias] is fn, (
+                f"alias {alias!r} dispatches to {JOBS[alias].__name__}, "
+                f"but {k} dispatches to {fn.__name__}")
+
+
+def test_resolve_bare_class_name():
+    assert resolve("BayesianDistribution").__name__ == "bayesian_distribution"
