@@ -76,28 +76,26 @@ class EnsembleModel:
         self.min_odds_ratio = min_odds_ratio
 
     def predict(self, table: ColumnarTable) -> List[Optional[str]]:
+        """Weighted vote as one (n, K) reduction: each member contributes its
+        weight at its predicted class index (no per-record Python)."""
         n = table.n_rows
-        votes: Dict[str, np.ndarray] = {}
+        classes = sorted({c for m in self.models for c in m.matrix.classes}
+                         | {""})
+        cls_arr = np.array(classes)
+        mat = np.zeros((n, len(classes)), dtype=np.float64)
+        rows = np.arange(n)
         for model, w in zip(self.models, self.weights):
             pred, _ = model.predict(table)
-            for i, cv in enumerate(pred):
-                if cv not in votes:
-                    votes[cv] = np.zeros((n,))
-                votes[cv][i] += w
-        classes = sorted(votes.keys())
-        mat = np.stack([votes[c] for c in classes], axis=1)   # (n, K)
+            idx = np.searchsorted(cls_arr, np.asarray(pred))
+            np.add.at(mat, (rows, idx), w)
         order = np.argsort(-mat, axis=1)
-        best = order[:, 0]
-        out: List[Optional[str]] = []
-        for i in range(n):
-            if self.min_odds_ratio > 1.0 and mat.shape[1] > 1:
-                top = mat[i, order[i, 0]]
-                second = mat[i, order[i, 1]]
-                ratio = top / max(second, 1e-12)
-                out.append(classes[best[i]] if ratio > self.min_odds_ratio else None)
-            else:
-                out.append(classes[best[i]])
-        return out
+        best = cls_arr[order[:, 0]]
+        out = best.astype(object)
+        if self.min_odds_ratio > 1.0 and mat.shape[1] > 1:
+            top = mat[rows, order[:, 0]]
+            second = np.maximum(mat[rows, order[:, 1]], 1e-12)
+            out[top / second <= self.min_odds_ratio] = None
+        return list(out)
 
 
 OUTPUT_WITH_RECORD = "withRecord"

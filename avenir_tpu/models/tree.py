@@ -765,13 +765,236 @@ _REASSIGN_JIT = jax.jit(TreeBuilder._reassign)
 # prediction over a DecisionPathList (tree/DecisionTreeModel.java)
 # --------------------------------------------------------------------------
 
+@jax.jit
+def _match_paths(vals: jnp.ndarray,        # (n, F) float
+                 codes: jnp.ndarray,       # (n, F) int32 (cat codes, -1 unk)
+                 lo: jnp.ndarray,          # (P, F) interval lower (exclusive)
+                 hi: jnp.ndarray,          # (P, F) interval upper (inclusive)
+                 num_restricted: jnp.ndarray,  # (P, F) bool numeric pred exists
+                 cat_mask: jnp.ndarray,    # (P, F, Cmax) bool allowed codes
+                 cat_restricted: jnp.ndarray,  # (P, F) bool 'in' pred exists
+                 path_cls: jnp.ndarray,    # (P,) int32 class idx per path
+                 path_prob: jnp.ndarray,   # (P,) float32
+                 fallback_cls: jnp.ndarray,   # () int32
+                 fallback_prob: jnp.ndarray,  # () float32
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """All paths x all records in one fused pass: a record matches a path iff
+    its value lies in every *numerically restricted* feature's (lo, hi]
+    interval and its code is in every *'in'-restricted* categorical mask;
+    unrestricted features never veto (so NaN/garbage in a column a path does
+    not test cannot block the match — same as the reference's per-predicate
+    walk, tree/DecisionTreeModel.java:37-42).  First matching path wins."""
+    P, F = lo.shape
+    interval = (vals[:, None, :] > lo[None]) & (vals[:, None, :] <= hi[None])
+    num_ok = jnp.where(num_restricted[None], interval, True)      # (n, P, F)
+    safe = jnp.clip(codes, 0, cat_mask.shape[2] - 1)
+    gathered = cat_mask[jnp.arange(P)[None, :, None],
+                        jnp.arange(F)[None, None, :],
+                        safe[:, None, :]]                         # (n, P, F)
+    cat_ok = jnp.where(cat_restricted[None],
+                       gathered & (codes >= 0)[:, None, :], True)
+    ok = (num_ok & cat_ok).all(axis=2)
+    matched = ok.any(axis=1)
+    first = jnp.argmax(ok, axis=1)          # first True along path axis
+    cls = jnp.where(matched, path_cls[first], fallback_cls)
+    prob = jnp.where(matched, path_prob[first], fallback_prob)
+    return cls, prob
+
+
+def _match_paths_np(vals, codes, lo, hi, num_restricted, cat_mask,
+                    cat_restricted, path_cls, path_prob,
+                    fallback_cls, fallback_prob):
+    """Host float64 twin of ``_match_paths`` — used when the data does not
+    round-trip float32 exactly (a boundary value near a split threshold could
+    flip branches under f32 rounding) and the jax backend has x64 disabled."""
+    P, F = lo.shape
+    interval = (vals[:, None, :] > lo[None]) & (vals[:, None, :] <= hi[None])
+    num_ok = np.where(num_restricted[None], interval, True)
+    safe = np.clip(codes, 0, cat_mask.shape[2] - 1)
+    gathered = cat_mask[np.arange(P)[None, :, None],
+                        np.arange(F)[None, None, :],
+                        safe[:, None, :]]
+    cat_ok = np.where(cat_restricted[None],
+                      gathered & (codes >= 0)[:, None, :], True)
+    ok = (num_ok & cat_ok).all(axis=2)
+    matched = ok.any(axis=1)
+    first = np.argmax(ok, axis=1)
+    cls = np.where(matched, path_cls[first], fallback_cls)
+    prob = np.where(matched, path_prob[first], fallback_prob)
+    return cls.astype(np.int32), prob.astype(np.float32)
+
+
+class PathMatrix:
+    """A DecisionPathList compiled to dense predicate tensors (SURVEY.md §7.5
+    'tree paths as predicate matrices -> batched evaluation').
+
+    Per path and feature column the predicate chain collapses to
+      * numeric: one (lo, hi] interval — 'le t' chains intersect to
+        (lower_bound, t], 'gt t' to (t, +inf) (Predicate.evaluate semantics);
+      * categorical: an allowed-code bitmask (intersection of 'in' sets).
+    Evaluation of all paths over all records is then a single jitted
+    gather/compare/reduce — the batched replacement for the reference's
+    per-record predicate walk (model/ModelPredictor.java:46-82)."""
+
+    def __init__(self, path_list: DecisionPathList, schema: FeatureSchema):
+        paths = path_list.decision_paths
+        feat_fields = schema.feature_fields
+        self.feat_ordinals = [f.ordinal for f in feat_fields]
+        col_of = {o: i for i, o in enumerate(self.feat_ordinals)}
+        P, F = len(paths), len(feat_fields)
+        cmax = max([len(f.cardinality or []) for f in feat_fields
+                    if f.is_categorical] + [1])
+        lo = np.full((P, F), -np.inf, dtype=np.float64)
+        hi = np.full((P, F), np.inf, dtype=np.float64)
+        cat_mask = np.ones((P, F, cmax), dtype=bool)
+        num_restricted = np.zeros((P, F), dtype=bool)
+        cat_restricted = np.zeros((P, F), dtype=bool)
+        for pi, path in enumerate(paths):
+            for pred in path.predicates:
+                if pred.pred_str == ROOT_PATH or pred.operator is None:
+                    continue
+                ci = col_of[pred.attribute]
+                f = schema.find_field_by_ordinal(pred.attribute)
+                if pred.operator == "in":
+                    m = np.zeros((cmax,), dtype=bool)
+                    for v in pred.categorical_values or []:
+                        code = f.cat_code(v)
+                        if code >= 0:
+                            m[code] = True
+                    cat_mask[pi, ci] &= m
+                    # explicit flag: even an all-values 'in' must still reject
+                    # unknown codes, so restriction is tracked independently
+                    # of whether the intersected mask happens to be all-true
+                    cat_restricted[pi, ci] = True
+                elif pred.operator == "le":
+                    hi[pi, ci] = min(hi[pi, ci], pred.threshold)
+                    if pred.lower_bound is not None:
+                        lo[pi, ci] = max(lo[pi, ci], pred.lower_bound)
+                    num_restricted[pi, ci] = True
+                elif pred.operator == "gt":
+                    lo[pi, ci] = max(lo[pi, ci], pred.threshold)
+                    num_restricted[pi, ci] = True
+                else:
+                    raise ValueError(f"bad operator {pred.operator}")
+        self.lo, self.hi = lo, hi
+        self.cat_mask = cat_mask
+        self.num_restricted = num_restricted
+        self.cat_restricted = cat_restricted
+        self.is_cat_col = np.array([f.is_categorical for f in feat_fields],
+                                   dtype=bool)
+        # bounds survive float32 exactly? (decides device-f32 eligibility)
+        fin = np.isfinite(lo)
+        self._bounds_f32_exact = bool(
+            (lo[fin].astype(np.float32).astype(np.float64) == lo[fin]).all())
+        fin = np.isfinite(hi)
+        self._bounds_f32_exact &= bool(
+            (hi[fin].astype(np.float32).astype(np.float64) == hi[fin]).all())
+        self._dev_consts = None  # lazily-built device-resident constants
+        # per-path predicted class / prob, over the union class vocabulary
+        self.classes: List[str] = sorted(
+            {cv for p in paths for cv in p.class_val_pr})
+        cls_idx = {c: i for i, c in enumerate(self.classes)}
+        self.path_cls = np.array(
+            [cls_idx[p.predicted_class()[0]] if p.class_val_pr else 0
+             for p in paths], dtype=np.int32)
+        self.path_prob = np.array(
+            [p.predicted_class()[1] if p.class_val_pr else 0.0 for p in paths],
+            dtype=np.float32)
+        # fallback for unmatched records: population-weighted class vote
+        agg: Dict[str, float] = {}
+        for p in paths:
+            for cv, pr in p.class_val_pr.items():
+                agg[cv] = agg.get(cv, 0.0) + pr * p.population
+        self.fallback_cls = np.int32(
+            cls_idx[max(agg.items(), key=lambda kv: kv[1])[0]]) if agg \
+            else np.int32(0)
+        self.n_paths = P
+
+    def feature_arrays(self, table: ColumnarTable
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+        """(vals float64, codes int32), both (n, F).  Only the columns a
+        comparison kind actually reads are cast: categorical slots in ``vals``
+        (and numeric slots in ``codes``) stay zero."""
+        n = table.n_rows
+        F = len(self.feat_ordinals)
+        vals = np.zeros((n, F), dtype=np.float64)
+        codes = np.zeros((n, F), dtype=np.int32)
+        for i, o in enumerate(self.feat_ordinals):
+            if self.is_cat_col[i]:
+                codes[:, i] = table.columns[o].astype(np.int32)
+            else:
+                vals[:, i] = table.columns[o].astype(np.float64)
+        return vals, codes
+
+    def _device_consts(self):
+        if self._dev_consts is None:
+            self._dev_consts = tuple(jnp.asarray(a) for a in (
+                self.lo.astype(np.float32), self.hi.astype(np.float32),
+                self.num_restricted, self.cat_mask, self.cat_restricted,
+                self.path_cls, self.path_prob))
+        return self._dev_consts
+
+    def predict_codes(self, table: ColumnarTable,
+                      chunk: int = 1 << 20) -> Tuple[np.ndarray, np.ndarray]:
+        """(class idx per record, prob) as arrays; row-chunked so the
+        (n, P, F) match intermediate stays bounded.
+
+        Backend choice: the jitted f32 device kernel runs when every value
+        and bound round-trips float32 exactly (always true for the integer
+        scan grids the split manager produces); otherwise the float64 host
+        twin runs so a value half-an-ulp from a threshold cannot flip
+        branches relative to the reference's double math."""
+        vals, codes = self.feature_arrays(table)
+        n = table.n_rows
+        if self.n_paths == 0 or not self.classes:
+            return (np.zeros((n,), np.int32) - 1, np.zeros((n,), np.float32))
+        fin = np.isfinite(vals)
+        f32_safe = self._bounds_f32_exact and bool(
+            (vals[fin].astype(np.float32).astype(np.float64) == vals[fin])
+            .all())
+        # keep chunk * P * F around the 2^26-element mark
+        per_row = max(self.n_paths * max(len(self.feat_ordinals), 1), 1)
+        chunk = max(1024, min(chunk, (1 << 26) // per_row))
+        out_cls, out_prob = [], []
+        for s in range(0, n, chunk):
+            if f32_safe:
+                lo, hi, num_r, cat_m, cat_r, pc, pp = self._device_consts()
+                c, p = _match_paths(
+                    jnp.asarray(vals[s:s + chunk].astype(np.float32)),
+                    jnp.asarray(codes[s:s + chunk]),
+                    lo, hi, num_r, cat_m, cat_r, pc, pp,
+                    self.fallback_cls, jnp.float32(0.5))
+                out_cls.append(np.asarray(c))
+                out_prob.append(np.asarray(p))
+            else:
+                c, p = _match_paths_np(
+                    vals[s:s + chunk], codes[s:s + chunk],
+                    self.lo, self.hi, self.num_restricted,
+                    self.cat_mask, self.cat_restricted,
+                    self.path_cls, self.path_prob,
+                    self.fallback_cls, np.float32(0.5))
+                out_cls.append(c)
+                out_prob.append(p)
+        return np.concatenate(out_cls), np.concatenate(out_prob)
+
+
 class DecisionTreeModel:
-    """Vectorized evaluator: every path's predicate chain becomes a boolean
-    mask over records; records take the class of the (unique) matching path."""
+    """Vectorized evaluator: the path list is compiled once into a PathMatrix
+    and every batch is classified in one jitted pass."""
 
     def __init__(self, path_list: DecisionPathList, schema: FeatureSchema):
         self.paths = path_list.decision_paths
         self.schema = schema
+        self.matrix = PathMatrix(path_list, schema)
+
+    def predict(self, table: ColumnarTable) -> Tuple[List[str], np.ndarray]:
+        """(pred_class per record, prob).  Records matching no path get the
+        globally most probable class (population-weighted)."""
+        cls_idx, prob = self.matrix.predict_codes(table)
+        if self.matrix.n_paths == 0 or not self.matrix.classes:
+            return [""] * table.n_rows, np.zeros((table.n_rows,))
+        lut = np.array(self.matrix.classes, dtype=object)
+        return list(lut[cls_idx]), prob.astype(np.float64)
 
     def _pred_mask(self, pred: Predicate, table: ColumnarTable) -> np.ndarray:
         n = table.n_rows
@@ -792,9 +1015,10 @@ class DecisionTreeModel:
             return vals > pred.threshold
         raise ValueError(f"bad operator {pred.operator}")
 
-    def predict(self, table: ColumnarTable) -> Tuple[List[str], np.ndarray]:
-        """(pred_class per record, prob).  Records matching no path get the
-        globally most probable class (population-weighted)."""
+    def _predict_loop(self, table: ColumnarTable
+                      ) -> Tuple[List[str], np.ndarray]:
+        """Reference implementation (per-path host loop) kept as the parity
+        oracle for PathMatrix tests; production code uses ``predict``."""
         n = table.n_rows
         pred_class = [""] * n
         prob = np.zeros((n,))

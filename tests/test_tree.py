@@ -185,3 +185,141 @@ def test_min_population_stopping(mesh_ctx):
     # all paths with population < 400 must be stopped
     for p in dpl.decision_paths:
         assert p.stopped
+
+
+def test_pathmatrix_parity_with_loop_oracle(mesh_ctx):
+    """The compiled PathMatrix predictor must agree exactly with the
+    per-path host-loop oracle on trees of every depth, including records
+    that match no path (fallback class)."""
+    for depth, n in [(0, 200), (1, 500), (3, 1500)]:
+        table = make_table(n, seed=depth + 7)
+        b = T.TreeBuilder(table, T.TreeParams(max_depth=depth,
+                                              seed=depth), mesh_ctx)
+        dpl = T.DecisionPathList.from_json(b.build().to_json())
+        model = T.DecisionTreeModel(dpl, SCHEMA)
+        pred_v, prob_v = model.predict(table)
+        pred_l, prob_l = model._predict_loop(table)
+        assert pred_v == pred_l
+        np.testing.assert_allclose(prob_v, prob_l, rtol=1e-6)
+
+
+def test_pathmatrix_unknown_categorical_and_unmatched(mesh_ctx):
+    """Unknown categorical codes must fail 'in' predicates (not crash, not
+    false-match), sending the record to the fallback class."""
+    table = make_table(300, seed=3)
+    b = T.TreeBuilder(table, T.TreeParams(max_depth=2, seed=1), mesh_ctx)
+    dpl = b.build()
+    model = T.DecisionTreeModel(dpl, SCHEMA)
+    # corrupt some categorical codes to the unknown marker -1
+    table.columns[1] = table.columns[1].copy()
+    table.columns[1][:50] = -1
+    table.columns[2] = table.columns[2].copy()
+    table.columns[2][:50] = -1
+    pred_v, prob_v = model.predict(table)
+    pred_l, prob_l = model._predict_loop(table)
+    assert pred_v == pred_l
+    np.testing.assert_allclose(prob_v, prob_l, rtol=1e-6)
+
+
+def test_pathmatrix_predict_throughput(mesh_ctx):
+    """VERDICT r1 #3 acceptance: 1M-row predict in about a second on the CPU
+    backend (was minutes of per-record Python)."""
+    import time
+    table = make_table(2000, seed=9)
+    b = T.TreeBuilder(table, T.TreeParams(max_depth=3, seed=0), mesh_ctx)
+    model = T.DecisionTreeModel(b.build(), SCHEMA)
+    n = 1_000_000
+    rng = np.random.default_rng(0)
+    big = type(table)(
+        schema=SCHEMA, n_rows=n,
+        columns={1: rng.integers(0, 2, n).astype(np.int32),
+                 2: rng.integers(0, 4, n).astype(np.int32),
+                 3: rng.integers(0, 600, n).astype(np.float64),
+                 4: rng.integers(0, 2, n).astype(np.int32)})
+    model.predict(big)  # warm the jit cache
+    t0 = time.perf_counter()
+    pred, _ = model.predict(big)
+    dt = time.perf_counter() - t0
+    assert len(pred) == n
+    assert dt < 5.0, f"vectorized predict took {dt:.2f}s for 1M rows"
+
+
+def test_pathmatrix_nan_in_unrestricted_column(mesh_ctx):
+    """NaN in a numeric feature no path tests must not veto matching
+    (the oracle never evaluates untested features)."""
+    schema = FeatureSchema.from_dict({"fields": [
+        {"name": "a", "ordinal": 0, "dataType": "categorical", "feature": True,
+         "maxSplit": 2, "cardinality": ["x", "y"]},
+        {"name": "junk", "ordinal": 1, "dataType": "double", "feature": True,
+         "min": 0, "max": 1, "splitScanInterval": 0.5},
+        {"name": "cls", "ordinal": 2, "dataType": "categorical",
+         "cardinality": ["T", "F"]},
+    ]})
+    dpl = T.DecisionPathList([
+        T.DecisionPath([T.Predicate.cat(0, ["x"])], 10, 0.0, True,
+                       {"T": 0.9, "F": 0.1}),
+        T.DecisionPath([T.Predicate.cat(0, ["y"])], 10, 0.0, True,
+                       {"T": 0.2, "F": 0.8}),
+    ])
+    from avenir_tpu.core.table import ColumnarTable
+    table = ColumnarTable(schema=schema, n_rows=4, columns={
+        0: np.array([0, 1, 0, 1], dtype=np.int32),
+        1: np.array([np.nan, np.nan, 0.5, 0.5]),
+        2: np.array([0, 1, 0, 1], dtype=np.int32)})
+    model = T.DecisionTreeModel(dpl, schema)
+    pred_v, _ = model.predict(table)
+    pred_l, _ = model._predict_loop(table)
+    assert pred_v == pred_l == ["T", "F", "T", "F"]
+
+
+def test_pathmatrix_all_values_in_still_rejects_unknown(mesh_ctx):
+    """An 'in' predicate listing every category is still a restriction:
+    unknown codes (-1) must not match it (parity with np.isin)."""
+    schema = FeatureSchema.from_dict({"fields": [
+        {"name": "a", "ordinal": 0, "dataType": "categorical", "feature": True,
+         "maxSplit": 2, "cardinality": ["x", "y"]},
+        {"name": "cls", "ordinal": 1, "dataType": "categorical",
+         "cardinality": ["T", "F"]},
+    ]})
+    dpl = T.DecisionPathList([
+        T.DecisionPath([T.Predicate.cat(0, ["x", "y"])], 10, 0.0, True,
+                       {"T": 0.9, "F": 0.1}),
+        T.DecisionPath([T.Predicate.cat(0, [])], 0, 0.0, True,
+                       {"F": 1.0}),
+    ])
+    from avenir_tpu.core.table import ColumnarTable
+    table = ColumnarTable(schema=schema, n_rows=3, columns={
+        0: np.array([0, 1, -1], dtype=np.int32),
+        1: np.array([0, 0, 1], dtype=np.int32)})
+    model = T.DecisionTreeModel(dpl, schema)
+    pred_v, _ = model.predict(table)
+    pred_l, _ = model._predict_loop(table)
+    assert pred_v == pred_l
+    assert pred_v[2] == "T"  # fallback (population-weighted), not a match
+
+
+def test_pathmatrix_f64_boundary_values(mesh_ctx):
+    """Values that do not round-trip float32 near a threshold must take the
+    float64 host path and route exactly like the double-math oracle."""
+    schema = FeatureSchema.from_dict({"fields": [
+        {"name": "v", "ordinal": 0, "dataType": "double", "feature": True,
+         "min": 0, "max": 4e7, "splitScanInterval": 2e7},
+        {"name": "cls", "ordinal": 1, "dataType": "categorical",
+         "cardinality": ["T", "F"]},
+    ]})
+    thr = 16777216.0  # exactly representable in f32
+    dpl = T.DecisionPathList([
+        T.DecisionPath([T.Predicate.num(0, "le", thr)], 10, 0.0, True,
+                       {"T": 1.0}),
+        T.DecisionPath([T.Predicate.num(0, "gt", thr)], 10, 0.0, True,
+                       {"F": 1.0}),
+    ])
+    from avenir_tpu.core.table import ColumnarTable
+    # 16777217.0 is NOT representable in f32 (rounds down to the threshold)
+    table = ColumnarTable(schema=schema, n_rows=3, columns={
+        0: np.array([16777215.0, 16777217.0, 16777218.0]),
+        1: np.array([0, 1, 1], dtype=np.int32)})
+    model = T.DecisionTreeModel(dpl, schema)
+    pred_v, _ = model.predict(table)
+    pred_l, _ = model._predict_loop(table)
+    assert pred_v == pred_l == ["T", "F", "F"]
