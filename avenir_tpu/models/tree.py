@@ -450,7 +450,9 @@ def sampling_weights(n: int, params: TreeParams,
     none -> None."""
     if params.sub_sampling == "withReplace":
         m = int(n * params.sub_sampling_rate / 100.0)
-        counts = rng.multinomial(m, np.full(n, 1.0 / n))
+        # uniform multinomial == histogram of m uniform draws (much faster
+        # than rng.multinomial's per-category walk at bootstrap sizes)
+        counts = np.bincount(rng.integers(0, n, size=m), minlength=n)
         return counts.astype(np.float32)
     if params.sub_sampling == "withoutReplace":
         keep = rng.random(n) < (params.sub_sampling_rate / 100.0)
@@ -602,13 +604,10 @@ class TreeBuilder:
         # root pass (generateRoot :478-494)
         node_ids = self.ctx.shard_rows(np.zeros((self.n_padded,), dtype=np.int32))
         counts = self.level_counts(node_ids, weights, 1)
-        root_class = counts[0].sum(axis=(0, 1)) / max(self.split_set.n_splits, 1)
-        root_pop = float(root_class.sum())
-        root_info = float(_info(root_class[None], p.split_algorithm)[0])
-        root_pr = {cv: float(root_class[i] / max(root_pop, 1e-12))
-                   for i, cv in enumerate(self.class_values)}
-        leaves = [_LeafState([Predicate.root()], 0, root_info, root_pop,
-                             root_pr, set(), False)]
+        root = self._root_state(counts[0])
+        root_pop, root_info, root_pr = \
+            root.population, root.info_content, root.class_val_pr
+        leaves = [root]
         final_paths: List[DecisionPath] = []
 
         levels = max_levels if max_levels is not None else \
@@ -634,14 +633,39 @@ class TreeBuilder:
                 info_content=root_info, stopped=True, class_val_pr=root_pr))
         return DecisionPathList(decision_paths=final_paths)
 
+    def _root_state(self, counts0: np.ndarray) -> _LeafState:
+        """Root leaf from a (S, B, C) root-level count block
+        (generateRoot :478-494; every split partitions the full population,
+        so averaging over splits recovers the root class histogram)."""
+        root_class = counts0.sum(axis=(0, 1)) / max(self.split_set.n_splits, 1)
+        pop = float(root_class.sum())
+        info = float(_info(root_class[None], self.params.split_algorithm)[0])
+        pr = {cv: float(root_class[i] / max(pop, 1e-12))
+              for i, cv in enumerate(self.class_values)}
+        return _LeafState([Predicate.root()], 0, info, pop, pr, set(), False)
+
     def _grow(self, active: List[_LeafState], node_ids, weights):
         """One level of frontier expansion (the expandTree epilogue
         :499-616): compute counts, choose per-node winning split, derive
         children + stopping, reassign records on device.
         Returns (new_active_leaves, newly_stopped_DecisionPaths, new_node_ids)."""
+        counts = self.level_counts(node_ids, weights, len(active))
+        new_leaves, stopped_paths, sel_split, child_table = \
+            self._choose_splits(active, counts)
+        node_ids = self._reassign_kernel(
+            node_ids, self.branches,
+            self.ctx.replicate(jnp.asarray(sel_split)),
+            self.ctx.replicate(jnp.asarray(child_table)))
+        return new_leaves, stopped_paths, node_ids
+
+    def _choose_splits(self, active: List[_LeafState], counts: np.ndarray):
+        """Host epilogue of one level: per active node pick the winning split
+        from its (S, B, C) counts, derive children + stopping.  Shared by the
+        single-tree path and ForestBuilder (which batches the count kernel
+        across trees and calls this once per tree).
+        Returns (new_leaves, stopped_paths, sel_split (N,), child_table (N,B))."""
         p = self.params
         n_nodes = len(active)
-        counts = self.level_counts(node_ids, weights, n_nodes)
         sel_split = np.full((n_nodes,), -1, dtype=np.int32)
         child_table = np.full((n_nodes, self.split_set.max_branches), -1,
                               dtype=np.int32)
@@ -693,11 +717,7 @@ class TreeBuilder:
                 else:
                     child_table[ni, b] = len(new_leaves)
                     new_leaves.append(child)
-        node_ids = self._reassign_kernel(
-            node_ids, self.branches,
-            self.ctx.replicate(jnp.asarray(sel_split)),
-            self.ctx.replicate(jnp.asarray(child_table)))
-        return new_leaves, stopped_paths, node_ids
+        return new_leaves, stopped_paths, sel_split, child_table
 
     # ---- per-level job parity mode (detr.sh rotation contract) ----
     @staticmethod
@@ -732,14 +752,12 @@ class TreeBuilder:
         if dpl is None or not dpl.decision_paths:
             node_ids = self.ctx.shard_rows(np.zeros((self.n_padded,), np.int32))
             counts = self.level_counts(node_ids, weights, 1)
-            root_class = counts[0].sum(axis=(0, 1)) / max(self.split_set.n_splits, 1)
-            pop = float(root_class.sum())
-            info = float(_info(root_class[None], self.params.split_algorithm)[0])
-            pr = {cv: float(root_class[i] / max(pop, 1e-12))
-                  for i, cv in enumerate(self.class_values)}
+            root = self._root_state(counts[0])
             return DecisionPathList([DecisionPath(
-                predicates=[Predicate.root()], population=int(round(pop)),
-                info_content=info, stopped=False, class_val_pr=pr)])
+                predicates=[Predicate.root()],
+                population=int(round(root.population)),
+                info_content=root.info_content, stopped=False,
+                class_val_pr=root.class_val_pr)])
         carried = [p for p in dpl.decision_paths if p.stopped]
         active = [self._leaf_from_path(p) for p in dpl.decision_paths
                   if not p.stopped]
@@ -946,7 +964,7 @@ class PathMatrix:
         branches relative to the reference's double math."""
         vals, codes = self.feature_arrays(table)
         n = table.n_rows
-        if self.n_paths == 0 or not self.classes:
+        if n == 0 or self.n_paths == 0 or not self.classes:
             return (np.zeros((n,), np.int32) - 1, np.zeros((n,), np.float32))
         fin = np.isfinite(vals)
         f32_safe = self._bounds_f32_exact and bool(
@@ -991,7 +1009,8 @@ class DecisionTreeModel:
         """(pred_class per record, prob).  Records matching no path get the
         globally most probable class (population-weighted)."""
         cls_idx, prob = self.matrix.predict_codes(table)
-        if self.matrix.n_paths == 0 or not self.matrix.classes:
+        if table.n_rows == 0 or self.matrix.n_paths == 0 \
+                or not self.matrix.classes:
             return [""] * table.n_rows, np.zeros((table.n_rows,))
         lut = np.array(self.matrix.classes, dtype=object)
         return list(lut[cls_idx]), prob.astype(np.float64)

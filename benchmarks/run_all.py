@@ -59,18 +59,25 @@ def bench_random_forest(scale):
         os.path.dirname(__file__), "..", "resource", "call_hangup.json"))
     n = int(200_000 * scale)
     table = load_csv_text("\n".join(generate(n, 1)), schema)
-    params = ForestParams(num_trees=5, seed=1)
+    params = ForestParams(num_trees=16, seed=1)
     params.tree.max_depth = 4
     ctx = MeshContext()
-    warm = ForestParams(num_trees=1, seed=0)
-    warm.tree.max_depth = 4  # identical shapes: kernel caches hit in the timed run
-    build_forest(table, warm, ctx)
+    build_forest(table, params, ctx)  # warm the batched kernels
     t0 = time.perf_counter()
     models = build_forest(table, params, ctx)
     dt = time.perf_counter() - t0
+    # sequential per-tree loop (the r1 design) for the speedup column
+    build_forest(table, ForestParams(num_trees=2, seed=1), ctx, batched=False)
+    t0 = time.perf_counter()
+    models_seq = build_forest(table, params, ctx, batched=False)
+    dt_seq = time.perf_counter() - t0
+    assert [m.to_json() for m in models] == [m.to_json() for m in models_seq], \
+        "batched forest diverged from sequential"
     return {"metric": "random_forest_rows_x_trees_per_sec",
             "value": round(n * len(models) / dt, 1), "n_rows": n,
-            "trees": len(models), "build_s": round(dt, 2)}
+            "trees": len(models), "build_s": round(dt, 2),
+            "sequential_s": round(dt_seq, 2),
+            "speedup_vs_sequential": round(dt_seq / dt, 2)}
 
 
 def bench_knn(scale):

@@ -156,3 +156,33 @@ def test_random_forest_builder_job(tmp_path, mesh_ctx):
     assert rc == 0
     files = sorted(os.listdir(tmp_path / "forest"))
     assert files == ["tree_0.json", "tree_1.json", "tree_2.json"]
+
+
+def test_batched_forest_identical_to_sequential(mesh_ctx):
+    """ForestBuilder (all trees one level per launch) must produce
+    bit-identical models to the sequential per-tree loop: same bootstraps,
+    same RNG streams, same split choices."""
+    from avenir_tpu.models.forest import ForestParams, build_forest
+    table = make_table(1200)
+    for num_trees, depth in [(3, 3), (5, 2)]:
+        params = ForestParams(num_trees=num_trees, seed=7)
+        params.tree.max_depth = depth
+        batched = build_forest(table, params, mesh_ctx, batched=True)
+        seq = build_forest(table, params, mesh_ctx, batched=False)
+        assert [m.to_json() for m in batched] == [m.to_json() for m in seq]
+
+
+def test_predict_empty_table(mesh_ctx):
+    """0-row tables (an empty partition in a predict job) must round-trip."""
+    from avenir_tpu.core.table import ColumnarTable
+    table = make_table(500)
+    params = ForestParams(num_trees=3, seed=2)
+    params.tree.max_depth = 2
+    models = [DecisionTreeModel(m, SCHEMA)
+              for m in build_forest(table, params, mesh_ctx)]
+    empty = ColumnarTable(schema=SCHEMA, n_rows=0,
+                          columns={o: np.zeros((0,), dtype=c.dtype)
+                                   for o, c in table.columns.items()})
+    pred, prob = models[0].predict(empty)
+    assert pred == [] and prob.shape == (0,)
+    assert EnsembleModel(models).predict(empty) == []
