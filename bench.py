@@ -1,6 +1,9 @@
-"""Benchmark: NaiveBayes training throughput (rows/sec/chip).
+"""Benchmark: NaiveBayes train throughput (rows/sec/chip) + RF build + KNN.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "backend",
+"extra_metrics": [...]} — the primary metric stays NaiveBayes training
+(rows/sec/chip, vs a pure-Python mapper-equivalent baseline); random-forest
+build and KNN classify ride along in "extra_metrics".
 
 The reference publishes no numbers (BASELINE.md), so vs_baseline is measured
 in-process: a row-at-a-time pure-Python counting loop — the per-record work a
@@ -8,10 +11,13 @@ reference Hadoop mapper+combiner performs (bayesian/BayesianDistribution.java
 :139-178) — timed on a sample and extrapolated, giving a conservative
 single-core stand-in for the JVM baseline.
 
-Robustness: the device measurement runs in a child process with a watchdog
-(the tunneled axon TPU can wedge and hang any jax call indefinitely); on
-timeout the bench retries on the CPU backend so the driver always gets its
-JSON line, with "backend" recording what actually ran.
+Robustness (the tunneled axon TPU can wedge and hang ANY jax call forever):
+  1. a 120 s PROBE child compiles a trivial kernel first; if it hangs, no
+     device attempt is made at all (a wedged tunnel would otherwise eat the
+     full budget before the CPU fallback ran);
+  2. each workload runs in its own watchdog child, largest size first,
+     scaling N down before giving up;
+  3. a device timeout mid-run flips all remaining work to the CPU backend.
 """
 
 import json
@@ -22,9 +28,9 @@ import time
 
 import numpy as np
 
-N_ROWS = 8_000_000
 N_FEAT, N_BINS, N_CLASSES = 6, 12, 2
-DEVICE_TIMEOUT_S = int(os.environ.get("BENCH_TIMEOUT_S", "900"))
+PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "120"))
+DEVICE_TIMEOUT_S = int(os.environ.get("BENCH_TIMEOUT_S", "600"))
 
 
 def gen_data(n, n_feat=N_FEAT, n_bins=N_BINS, n_classes=N_CLASSES, seed=0):
@@ -51,14 +57,18 @@ def reference_rate(sample=200_000):
     return sample / dt
 
 
-def tpu_rate(n=N_ROWS):
+# ---------------------------------------------------------------------------
+# workloads (run inside the watchdog child; see run_workload)
+# ---------------------------------------------------------------------------
+
+def nb_rate(n):
+    """NaiveBayes training kernel: class-conditional binned histogram."""
     import jax
     from avenir_tpu.ops.histogram import class_bin_histogram_chunked
 
     cls, bins = gen_data(n)
     mask = np.ones((n,), dtype=bool)
     d_cls, d_bins, d_mask = (jax.device_put(x) for x in (cls, bins, mask))
-
     fn = jax.jit(lambda c, b, m: class_bin_histogram_chunked(
         c, b, N_CLASSES, N_BINS, m, chunk=1 << 19))
     np.asarray(fn(d_cls, d_bins, d_mask))  # compile + warm
@@ -70,21 +80,115 @@ def tpu_rate(n=N_ROWS):
     for _ in range(reps):
         np.asarray(fn(d_cls, d_bins, d_mask))
     dt = (time.perf_counter() - t0) / reps
-    return n / dt
+    return {"metric": "naive_bayes_train_rows_per_sec_per_chip",
+            "value": round(n / dt, 1), "unit": "rows/sec/chip", "n": n}
 
 
-def _measure_in_child(env_extra, timeout_s):
-    """Run tpu_rate in a child process (watchdog against a wedged device
-    backend); returns rows/sec or None on timeout/failure."""
-    # honor a JAX_PLATFORMS override even though sitecustomize imports jax
-    # with the axon platform frozen in (see __graft_entry__.dryrun_multichip)
-    code = (
-        "import os, jax\n"
-        "want = os.environ.get('JAX_PLATFORMS')\n"
-        "if want and want != jax.config.jax_platforms:\n"
-        "    jax.config.update('jax_platforms', want)\n"
-        "import json, bench\n"
-        "print(json.dumps({'rate': bench.tpu_rate()}))\n")
+_BENCH_SCHEMA = {
+    "fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "c1", "ordinal": 1, "dataType": "categorical", "feature": True,
+         "maxSplit": 2, "cardinality": ["a", "b", "c"]},
+        {"name": "c2", "ordinal": 2, "dataType": "categorical", "feature": True,
+         "maxSplit": 2, "cardinality": ["x", "y", "z", "w"]},
+        {"name": "n1", "ordinal": 3, "dataType": "int", "feature": True,
+         "min": 0, "max": 600, "splitScanInterval": 120},
+        {"name": "n2", "ordinal": 4, "dataType": "int", "feature": True,
+         "min": 0, "max": 100, "splitScanInterval": 25},
+        {"name": "cls", "ordinal": 5, "dataType": "categorical",
+         "cardinality": ["T", "F"]},
+    ]
+}
+
+
+def _bench_table(n, seed=1):
+    from avenir_tpu.core.schema import FeatureSchema
+    from avenir_tpu.core.table import ColumnarTable
+    schema = FeatureSchema.from_dict(_BENCH_SCHEMA)
+    rng = np.random.default_rng(seed)
+    n1 = rng.integers(0, 600, n)
+    c1 = rng.integers(0, 3, n)
+    label = ((n1 > 300) ^ (c1 == 2)) | (rng.random(n) < 0.05)
+    return ColumnarTable(schema=schema, n_rows=n, columns={
+        1: c1.astype(np.int32),
+        2: rng.integers(0, 4, n).astype(np.int32),
+        3: n1.astype(np.float64),
+        4: rng.integers(0, 100, n).astype(np.float64),
+        5: np.where(label, 0, 1).astype(np.int32),
+    })
+
+
+def rf_rate(n):
+    """16-tree random-forest build (tree-batched level kernel)."""
+    from avenir_tpu.models.forest import ForestParams, build_forest
+    from avenir_tpu.parallel.mesh import MeshContext
+    table = _bench_table(n)
+    params = ForestParams(num_trees=16, seed=1)
+    params.tree.max_depth = 4
+    ctx = MeshContext()
+    build_forest(table, params, ctx)  # compile + warm
+    t0 = time.perf_counter()
+    models = build_forest(table, params, ctx)
+    dt = time.perf_counter() - t0
+    return {"metric": "random_forest_rows_x_trees_per_sec",
+            "value": round(n * len(models) / dt, 1),
+            "unit": "rows*trees/sec", "n": n, "trees": len(models)}
+
+
+def knn_rate(n):
+    """KNN classify: pairwise mixed-type distance + top-k, n test rows
+    against 10x train rows."""
+    import jax
+    from avenir_tpu.core.schema import FeatureSchema
+    from avenir_tpu.ops.distance import DistanceComputer
+    n_train = 10 * n
+    train = _bench_table(n_train, seed=1)
+    test = _bench_table(n, seed=2)
+    schema = FeatureSchema.from_dict(_BENCH_SCHEMA)
+    comp = DistanceComputer(schema, scale=1000)
+    comp.pairwise(test, train)  # compile + warm
+    t0 = time.perf_counter()
+    dmat = np.asarray(comp.pairwise(test, train))
+    k = min(10, n_train)
+    np.argpartition(dmat, k - 1, axis=1)[:, :k]
+    dt = time.perf_counter() - t0
+    return {"metric": "knn_test_rows_per_sec", "value": round(n / dt, 1),
+            "unit": "rows/sec", "n_test": n, "n_train": n_train}
+
+
+WORKLOADS = {
+    "nb": (nb_rate, [8_000_000, 1_000_000]),
+    "rf": (rf_rate, [400_000, 50_000]),
+    # 8k x 80k keeps the full (nt, nr) f32 distance matrix ~2.5 GB (the
+    # euclidean path is untiled; 20k x 200k would need 16 GB)
+    "knn": (knn_rate, [8_000, 4_000]),
+}
+
+
+def run_workload(name, n):
+    fn, _ = WORKLOADS[name]
+    return fn(n)
+
+
+# ---------------------------------------------------------------------------
+# watchdog harness
+# ---------------------------------------------------------------------------
+
+_CHILD_PRELUDE = (
+    "import os, jax\n"
+    "want = os.environ.get('JAX_PLATFORMS')\n"
+    "if want and want != jax.config.jax_platforms:\n"
+    "    jax.config.update('jax_platforms', want)\n")
+
+
+TIMEOUT = "timeout"  # _run_child sentinel: wedge/hang (vs crash -> None)
+
+
+def _run_child(code, env_extra, timeout_s):
+    """Returns the child's JSON dict, None on crash/bad output, or the
+    TIMEOUT sentinel on a hang — callers treat a hang as a likely wedge
+    (abandon the backend) but a crash as workload-specific (e.g. OOM at this
+    size: retrying smaller is worthwhile, the device is probably fine)."""
     env = dict(os.environ, **env_extra)
     try:
         out = subprocess.run(
@@ -92,35 +196,88 @@ def _measure_in_child(env_extra, timeout_s):
             timeout=timeout_s, env=env,
             cwd=os.path.dirname(os.path.abspath(__file__)))
         if out.returncode != 0:
-            print(f"bench child failed (rc={out.returncode}):\n{out.stderr}",
-                  file=sys.stderr)
+            print(f"bench child failed (rc={out.returncode}):\n"
+                  f"{out.stderr[-2000:]}", file=sys.stderr)
             return None
-        return float(json.loads(out.stdout.strip().splitlines()[-1])["rate"])
+        return json.loads(out.stdout.strip().splitlines()[-1])
     except subprocess.TimeoutExpired:
         print(f"bench child timed out after {timeout_s}s (wedged device?)",
               file=sys.stderr)
-        return None
+        return TIMEOUT
     except Exception as exc:
         print(f"bench child output unusable: {exc}", file=sys.stderr)
         return None
 
 
+def probe_device(timeout_s=PROBE_TIMEOUT_S):
+    """Tiny compile+execute in a child: proves the backend is alive before
+    any real workload commits to it.  Honors the same JAX_PLATFORMS
+    override as the workload children (so an exported CPU override is
+    probed AS cpu, never mislabeled as a device run).  Returns the live
+    platform name or None."""
+    code = (
+        _CHILD_PRELUDE +
+        "import jax.numpy as jnp, numpy as np, json\n"
+        "d = jax.devices()\n"
+        "x = jax.jit(lambda a: (a * 2).sum())(jnp.ones((128, 128)))\n"
+        "print(json.dumps({'ok': float(np.asarray(x)) == 32768.0,\n"
+        "                  'platform': d[0].platform}))\n")
+    out = _run_child(code, {}, timeout_s)
+    if isinstance(out, dict) and out.get("ok"):
+        return out.get("platform")
+    return None
+
+
+def measure(name, env_extra, timeout_s):
+    """Run one workload in a watchdog child, largest size first.
+    Returns (result_dict_or_None, wedged: bool).  A hang aborts the size
+    ladder (a wedge won't finish at any size); a crash tries the next
+    smaller size (OOM territory)."""
+    for i, n in enumerate(WORKLOADS[name][1]):
+        code = (_CHILD_PRELUDE +
+                f"import json, bench\n"
+                f"print(json.dumps(bench.run_workload({name!r}, {n})))\n")
+        out = _run_child(code, env_extra, timeout_s if i == 0
+                         else min(timeout_s, 240))
+        if out is TIMEOUT:
+            return None, True
+        if out is not None:
+            return out, False
+    return None, False
+
+
 def main():
     ref = reference_rate()
-    backend = "device"
-    ours = _measure_in_child({}, DEVICE_TIMEOUT_S)
-    if ours is None:
-        backend = "cpu-fallback"
-        ours = _measure_in_child({"JAX_PLATFORMS": "cpu"}, DEVICE_TIMEOUT_S)
-    if ours is None:  # last resort: never leave the driver without a line
-        backend = "python"
-        ours = ref
+    platform = probe_device()
+    if platform is None:
+        print("device probe failed; skipping device attempts", file=sys.stderr)
+    device_ok = platform is not None and platform != "cpu"
+    results, backends = {}, {}
+    for name in ("nb", "rf", "knn"):
+        if device_ok:
+            r, wedged = measure(name, {}, DEVICE_TIMEOUT_S)
+            if r is not None:
+                results[name], backends[name] = r, "device"
+                continue
+            if wedged:
+                device_ok = False  # wedged mid-run: stop risking the budget
+        r, _ = measure(name, {"JAX_PLATFORMS": "cpu"}, DEVICE_TIMEOUT_S)
+        if r is not None:
+            results[name], backends[name] = r, "cpu-fallback"
+    nb = results.get("nb")
+    if nb is None:  # last resort: never leave the driver without a line
+        nb = {"metric": "naive_bayes_train_rows_per_sec_per_chip",
+              "value": round(ref, 1), "unit": "rows/sec/chip"}
+        backends["nb"] = "python"
+    extras = [dict(results[k], backend=backends[k])
+              for k in ("rf", "knn") if k in results]
     print(json.dumps({
-        "metric": "naive_bayes_train_rows_per_sec_per_chip",
-        "value": round(ours, 1),
-        "unit": "rows/sec/chip",
-        "vs_baseline": round(ours / ref, 2),
-        "backend": backend,
+        "metric": nb["metric"],
+        "value": nb["value"],
+        "unit": nb["unit"],
+        "vs_baseline": round(nb["value"] / ref, 2),
+        "backend": backends["nb"],
+        "extra_metrics": extras,
     }))
 
 
