@@ -113,3 +113,20 @@ def from_process_local(local_rows: np.ndarray, mesh: Mesh):
     if getattr(jax, "process_count", lambda: 1)() <= 1:
         return jax.device_put(local_rows, sharding)
     return jax.make_array_from_process_local_data(sharding, local_rows)
+
+
+def all_reduce_counters(counters):
+    """Sum a Counters object across all processes (Hadoop counters are
+    global; host-side tallies — validation counts, emitted-line counts —
+    are per-process under multi-host and must be reduced before rendering).
+    Single-process: identity.  Keys must match across processes (they do:
+    every process runs the same job)."""
+    if getattr(jax, "process_count", lambda: 1)() <= 1:
+        return counters
+    from jax.experimental import multihost_utils
+    items = sorted(counters._c.items())
+    vals = np.array([v for _, v in items], dtype=np.int64)
+    summed = np.asarray(multihost_utils.process_allgather(vals)).sum(axis=0)
+    for (key, _), v in zip(items, summed):
+        counters._c[key] = int(v)
+    return counters

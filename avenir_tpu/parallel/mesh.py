@@ -52,11 +52,19 @@ class MeshContext:
     This is the runtime handle every job gets (the analog of the Hadoop
     ``Configuration`` + cluster connection in reference job drivers, e.g.
     tree/DecisionTreeBuilder.java:70-94).
+
+    Works over a 1-D data mesh (the default) or the multi-host hybrid
+    (hosts, data) mesh from ``distributed.make_hybrid_mesh`` — rows shard
+    over ALL axes, reductions psum over all axes, so job code is portable
+    between the two.
     """
 
     def __init__(self, mesh: Optional[Mesh] = None):
         self.mesh = mesh if mesh is not None else default_mesh()
-        self.axis = self.mesh.axis_names[0]
+        axes = tuple(self.mesh.axis_names)
+        # single string for a 1-D mesh (back-compat), tuple for hybrid —
+        # both forms are accepted by PartitionSpec and lax.psum
+        self.axis = axes[0] if len(axes) == 1 else axes
 
     @property
     def n_devices(self) -> int:
@@ -69,8 +77,13 @@ class MeshContext:
         return NamedSharding(self.mesh, P())
 
     def shard_rows(self, arr) -> jax.Array:
-        """Place an array row-sharded over the data axis.  Row count must be a
-        multiple of the mesh size (use ColumnarTable.pad_to_multiple first)."""
+        """Place an array row-sharded over the mesh.  Row count must be a
+        multiple of the mesh size (use ColumnarTable.pad_to_multiple first).
+        Multi-process: ``arr`` is this process's equalized local block and
+        the result is the global row-sharded array (multi-host ingest)."""
+        if jax.process_count() > 1:
+            from .distributed import from_process_local
+            return from_process_local(np.asarray(arr), self.mesh)
         return jax.device_put(arr, self.row_sharding())
 
     def replicate(self, arr) -> jax.Array:
@@ -79,3 +92,26 @@ class MeshContext:
     def shard_table(self, padded, arrays: dict) -> dict:
         """Shard a dict of per-row arrays (all first-dim n_rows)."""
         return {k: self.shard_rows(v) for k, v in arrays.items()}
+
+
+# ---------------------------------------------------------------------------
+# process-wide runtime context: set once by the CLI (distributed mode builds
+# it over the hybrid mesh); everything else picks it up lazily
+# ---------------------------------------------------------------------------
+
+_runtime_ctx: Optional[MeshContext] = None
+
+
+def set_runtime_context(ctx: Optional[MeshContext]) -> None:
+    global _runtime_ctx
+    _runtime_ctx = ctx
+
+
+def runtime_context() -> MeshContext:
+    """The process-global MeshContext.  Defaults to a 1-D mesh over all
+    devices; ``cli.run`` replaces it with a hybrid-mesh context under
+    -Ddistributed.mode= / AVENIR_TPU_DISTRIBUTED=1."""
+    global _runtime_ctx
+    if _runtime_ctx is None:
+        _runtime_ctx = MeshContext()
+    return _runtime_ctx
