@@ -16,11 +16,13 @@ slices with no code change here.  On CPU the same code paths are exercised with
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Sequence
 
 import numpy as np
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
@@ -89,9 +91,25 @@ class MeshContext:
     def replicate(self, arr) -> jax.Array:
         return jax.device_put(arr, self.replicated_sharding())
 
+    def zeros_rows(self, shape, dtype=np.float32) -> jax.Array:
+        """Row-sharded zeros materialized ON DEVICE — no host transfer (a
+        (100M, T) node-id init would otherwise ship gigabytes through the
+        host link).  ``shape[0]`` follows the shard_rows contract: it is the
+        per-process local row count, so multi-process runs produce a global
+        array of process_count * shape[0] rows (matching what shard_rows
+        returns for same-shaped local blocks)."""
+        if jax.process_count() > 1:
+            shape = (shape[0] * jax.process_count(),) + tuple(shape[1:])
+        return _zeros_jit(tuple(shape), np.dtype(dtype), self.row_sharding())()
+
     def shard_table(self, padded, arrays: dict) -> dict:
         """Shard a dict of per-row arrays (all first-dim n_rows)."""
         return {k: self.shard_rows(v) for k, v in arrays.items()}
+
+
+@functools.lru_cache(maxsize=None)
+def _zeros_jit(shape, dtype, sharding):
+    return jax.jit(lambda: jnp.zeros(shape, dtype), out_shardings=sharding)
 
 
 # ---------------------------------------------------------------------------
