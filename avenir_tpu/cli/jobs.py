@@ -199,27 +199,12 @@ def model_predictor_job(cfg: Config, in_path: str, out_path: str) -> Counters:
 # org.avenir.knn (+ the sifarish distance job the pipeline shells out to)
 # --------------------------------------------------------------------------
 
-@register("org.sifarish.feature.SameTypeSimilarity", "sameTypeSimilarity",
-          "recordSimilarity")
-def same_type_similarity(cfg: Config, in_path: str, out_path: str) -> Counters:
-    """All-pairs record distance (the external sifarish job of
-    resource/knn.sh:47, and avenir-spark RecordSimilarity.scala:65-103).
-
-    Inter-set mode: files in the input dir starting with
-    sts.base.set.split.prefix are the train/base set, the rest are test.
-    Output lines: trainId,testId,distance,trainClass[,testClass]
-    with distance scaled by sts.distance.scale (default 1000).
-    Divergence: accepts our FeatureSchema JSON (sts.same.schema.file.path)
-    rather than sifarish's rich schema."""
+def _load_train_test(in_path: str, prefix: str, schema: FeatureSchema,
+                     delim: str):
+    """Split a similarity-job input into (train, test, intra_set): files in
+    a dir starting with ``prefix`` are the train/base set, the rest test; a
+    single file (or a dir with only one kind) is intra-set."""
     import glob as _glob
-    from ..ops.distance import DistanceComputer
-    counters = Counters()
-    schema = _schema_path(cfg, "sts.same.schema.file.path")
-    delim = cfg.field_delim_regex
-    prefix = cfg.get("sts.base.set.split.prefix", "tr")
-    scale = cfg.get_int("sts.distance.scale", 1000)
-    metric = cfg.get("sts.distance.metric", "euclidean")
-
     intra_set = False
     if os.path.isdir(in_path):
         files = sorted(p for p in _glob.glob(os.path.join(in_path, "*"))
@@ -242,6 +227,29 @@ def same_type_similarity(cfg: Config, in_path: str, out_path: str) -> Counters:
 
     train = load_many(base)
     test = train if intra_set else load_many(other)
+    return train, test, intra_set
+
+
+@register("org.sifarish.feature.SameTypeSimilarity", "sameTypeSimilarity",
+          "recordSimilarity")
+def same_type_similarity(cfg: Config, in_path: str, out_path: str) -> Counters:
+    """All-pairs record distance (the external sifarish job of
+    resource/knn.sh:47, and avenir-spark RecordSimilarity.scala:65-103).
+
+    Inter-set mode: files in the input dir starting with
+    sts.base.set.split.prefix are the train/base set, the rest are test.
+    Output lines: trainId,testId,distance,trainClass[,testClass]
+    with distance scaled by sts.distance.scale (default 1000).
+    Divergence: accepts our FeatureSchema JSON (sts.same.schema.file.path)
+    rather than sifarish's rich schema."""
+    from ..ops.distance import DistanceComputer
+    counters = Counters()
+    schema = _schema_path(cfg, "sts.same.schema.file.path")
+    delim = cfg.field_delim_regex
+    prefix = cfg.get("sts.base.set.split.prefix", "tr")
+    scale = cfg.get_int("sts.distance.scale", 1000)
+    metric = cfg.get("sts.distance.metric", "euclidean")
+    train, test, intra_set = _load_train_test(in_path, prefix, schema, delim)
     comp = DistanceComputer(schema, metric=metric, scale=scale)
     dmat = comp.pairwise(test, train)
     id_ord = schema.id_fields[0].ordinal if schema.id_fields else 0
@@ -270,6 +278,94 @@ def same_type_similarity(cfg: Config, in_path: str, out_path: str) -> Counters:
             lines.append(od.join(parts))
     artifacts.write_text_output(out_path, lines)
     counters.increment("Similarity", "Pairs", len(lines))
+    return counters
+
+
+@register("org.avenir.knn.KnnPipeline", "knnPipeline", "knnInProcess")
+def knn_pipeline(cfg: Config, in_path: str, out_path: str) -> Counters:
+    """The whole knn.sh pipeline fused in process: tiled device
+    distance + running top-k (ops/distance.pairwise_topk) feeding the
+    Neighborhood vote directly — the all-pairs CSV between jobs
+    (resource/knn.sh:47,53) never exists.  sameTypeSimilarity +
+    nearestNeighbor remain for file-level parity with the reference.
+
+    Input like sameTypeSimilarity: a dir whose sts.base.set.split.prefix
+    files are the train set and the rest test; a single file (or dir with
+    only one kind) is intra-set — self-pairs are excluded like the
+    reference's within-set matching.  Output + validation counters match
+    the nearestNeighbor job.  Class-conditional posterior weighting needs
+    the Bayesian-join file flow; this job rejects it (and regression mode,
+    which needs the file layout's target columns) loudly."""
+    from ..ops.distance import DistanceComputer
+    from ..models import knn as K
+    from ..core.metrics import ConfusionMatrix
+    counters = Counters()
+    params = _knn_params(cfg)
+    if params.class_cond_weighted:
+        raise ValueError(
+            "knnPipeline has no Bayesian posterior join; run the file "
+            "pipeline (sameTypeSimilarity -> featureCondProbJoiner -> "
+            "nearestNeighbor) for class-conditional weighting")
+    if params.prediction_mode == "regression":
+        raise ValueError(
+            "knnPipeline is classification-only; KNN regression needs the "
+            "nearestNeighbor file layout's target columns")
+    schema = _schema_path(cfg, "sts.same.schema.file.path")
+    delim = cfg.field_delim_regex
+    od = cfg.field_delim_out
+    prefix = cfg.get("sts.base.set.split.prefix", "tr")
+    scale = cfg.get_int("sts.distance.scale", 1000)
+    metric = cfg.get("sts.distance.metric", "euclidean")
+    validation = cfg.get_boolean("nen.validation.mode", True)
+    output_class_distr = cfg.get_boolean("nen.output.class.distr", False)
+
+    train, test, intra_set = _load_train_test(in_path, prefix, schema, delim)
+    comp = DistanceComputer(schema, metric=metric, scale=scale)
+    k = min(params.top_match_count, train.n_rows - (1 if intra_set else 0))
+    # intra-set: fetch one extra neighbor, then drop each row's self-match
+    nd, idx = comp.pairwise_topk(test, train, k + 1 if intra_set else k)
+    if intra_set:
+        self_col = np.arange(test.n_rows)[:, None]
+        keep_last = np.argsort(idx == self_col, axis=1, kind="stable")[:, :k]
+        nd = np.take_along_axis(nd, keep_last, axis=1)
+        idx = np.take_along_axis(idx, keep_last, axis=1)
+
+    cardinality = list(schema.class_attr_field.cardinality or [])
+    # vote over SORTED class values like the nearestNeighbor job (which
+    # sorts the classes observed in its input) so argmax tie-breaks match
+    # the file pipeline even for unsorted schema cardinality
+    class_values = sorted(cardinality)
+    remap = np.array([class_values.index(c) for c in cardinality],
+                     dtype=np.int32)
+    ncls = remap[train.class_codes()][idx]        # (n_test, k)
+    res = K.classify_topk(nd, ncls, class_values, params)
+
+    id_ord = schema.id_fields[0].ordinal if schema.id_fields else 0
+    test_ids = test.str_columns.get(
+        id_ord, [str(i) for i in range(test.n_rows)])
+    actual = None
+    if validation:
+        actual = [cardinality[c] if c >= 0 else "?"
+                  for c in test.class_codes()]
+        # the reference builds the matrix as (cardinality[0], cardinality[1])
+        # = (neg, pos) — NearestNeighbor.java:287-292
+        cm = ConfusionMatrix(cardinality[0], cardinality[1])
+    out_lines = []
+    for i in range(test.n_rows):
+        parts = [test_ids[i]]
+        if output_class_distr:
+            for ci, cv in enumerate(class_values):
+                parts.append(cv)
+                parts.append(str(res.class_distr[i][ci]))
+        if validation:
+            parts.append(actual[i])
+            cm.report(res.pred_class[i], actual[i])
+        parts.append(res.pred_class[i])
+        out_lines.append(od.join(parts))
+    if validation:
+        cm.export(counters)
+    counters.increment("Neighborhood", "Test records", test.n_rows)
+    artifacts.write_text_output(out_path, out_lines)
     return counters
 
 

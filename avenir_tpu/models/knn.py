@@ -110,6 +110,18 @@ def classify(distances: np.ndarray,            # (n_test, n_train) int
     return _classify_topk(nd, ncls, nfpp, class_values, params)
 
 
+def classify_topk(nd: np.ndarray, ncls: np.ndarray,
+                  class_values: Sequence[str], params: KnnParams,
+                  fpp: Optional[np.ndarray] = None) -> KnnResult:
+    """Classify from already-selected top-k neighbors per test row (the
+    public entry for fused device pipelines: ops/distance.pairwise_topk
+    feeds (distances, neighbor class codes) straight in, no all-pairs
+    matrix)."""
+    if fpp is None:
+        fpp = np.full(nd.shape, -1.0, dtype=np.float32)
+    return _classify_topk(nd, ncls, fpp, class_values, params)
+
+
 def _topk_rows(dmat: np.ndarray, k: int, *mats: Optional[np.ndarray]):
     """Stable nearest-k selection within each row; returns (nd, gathered mats)
     where a None mat stays None."""

@@ -22,6 +22,7 @@ distance is a matmul problem, not a join problem —
 
 from __future__ import annotations
 
+import functools
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -31,6 +32,28 @@ import jax.numpy as jnp
 
 from ..core.schema import FeatureSchema, FeatureField
 from ..core.table import ColumnarTable
+
+
+@functools.lru_cache(maxsize=None)
+def _topk_merge_kernel(k: int):
+    """Merge a fresh distance tile into the running best-k per test row:
+    reduce the tile to its own best-k with ``lax.top_k`` (ties -> lowest
+    position), then one stable 2k-wide multi-operand sort against the
+    running list.  Sorting the raw (k + tile)-wide concat instead was the
+    dominant cost of the whole KNN pass; a row gather (top_k + take) is
+    not an option — gathers lower to scalar loops on this TPU.  Stability
+    + tile order makes ties resolve to the lowest global train index,
+    matching a stable argsort over the full matrix."""
+    def merge(best_d, best_i, d_tile, base):
+        kk = min(k, d_tile.shape[1])
+        neg_v, pos = jax.lax.top_k(-d_tile.astype(jnp.float32), kk)
+        tile_i = base + pos.astype(jnp.int32)
+        cand_d = jnp.concatenate([best_d, -neg_v], axis=1)
+        cand_i = jnp.concatenate([best_i, tile_i], axis=1)
+        d_sorted, i_sorted = jax.lax.sort((cand_d, cand_i), dimension=1,
+                                          num_keys=1)
+        return d_sorted[:, :k], i_sorted[:, :k]
+    return jax.jit(merge)
 
 
 class DistanceComputer:
@@ -108,6 +131,54 @@ class DistanceComputer:
 
     def _euclidean(self, tn, toh, rn, roh):
         return self._euclid_jit(tn, toh, rn, roh)
+
+    def pairwise_topk(self, test: ColumnarTable, train: ColumnarTable,
+                      k: int, train_tile: int = 1 << 14,
+                      test_chunk: int = 1 << 13
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+        """Fused all-pairs distance + nearest-k, tiled over the train axis:
+        the (n_test, n_train) matrix never exists — each train tile's
+        distances merge into a running (n_test, k) device-resident best list
+        (one stable sort per tile), and only ids + distances come back to
+        host.  Replaces the all-pairs-file -> secondary-sort-reducer pipeline
+        of the reference (knn/NearestNeighbor.java:80-81, resource/knn.sh:47)
+        and lifts the full-matrix memory ceiling (20k x 200k needed 16 GB
+        through ``pairwise``; here it is ~170 MB per in-flight tile).
+
+        Returns (distances (n_test, k) int32, train indices (n_test, k)
+        int32), rows sorted nearest-first, ties to the lowest train index."""
+        tn, toh = self.encode(test)
+        rn, roh = self.encode(train)
+        n_test, n_train = tn.shape[0], rn.shape[0]
+        k = min(k, n_train)
+        merge = _topk_merge_kernel(k)
+        # keep each (test_chunk, train_tile) tile around 2^27 f32 elements
+        train_tile = max(1024, min(train_tile, (1 << 27) // max(test_chunk, 1)))
+        rn_d, roh_d = jnp.asarray(rn), jnp.asarray(roh)
+        if self.metric == "euclidean":
+            dist_fn = self._euclid_jit
+        elif self.metric == "manhattan":
+            dist_fn = None
+        else:
+            raise ValueError(f"unknown metric {self.metric!r}")
+        out_d: List[np.ndarray] = []
+        out_i: List[np.ndarray] = []
+        for ts in range(0, n_test, test_chunk):
+            te = min(ts + test_chunk, n_test)
+            tn_c, toh_c = jnp.asarray(tn[ts:te]), jnp.asarray(toh[ts:te])
+            best_d = jnp.full((te - ts, k), np.inf, dtype=jnp.float32)
+            best_i = jnp.full((te - ts, k), -1, dtype=jnp.int32)
+            for s in range(0, n_train, train_tile):
+                e = min(s + train_tile, n_train)
+                if dist_fn is not None:
+                    d = dist_fn(tn_c, toh_c, rn_d[s:e], roh_d[s:e])
+                else:
+                    d = self._manh_jit(tn_c, toh_c, rn_d[s:e], roh_d[s:e])
+                best_d, best_i = merge(best_d, best_i, d, s)
+            out_d.append(np.asarray(best_d))
+            out_i.append(np.asarray(best_i))
+        return (np.concatenate(out_d).astype(np.int32),
+                np.concatenate(out_i))
 
     def _manhattan_tiled(self, tn, toh, rn, roh, tile):
         out = np.zeros((tn.shape[0], rn.shape[0]), dtype=np.float32)

@@ -42,6 +42,7 @@ EXPECTED = {
     "org.avenir.explore.TopMatchesByClass": "top_matches_by_class",
     "org.avenir.explore.UnderSamplingBalancer": "under_sampling_balancer",
     "org.avenir.knn.FeatureCondProbJoiner": "feature_cond_prob_joiner",
+    "org.avenir.knn.KnnPipeline": "knn_pipeline",
     "org.avenir.knn.NearestNeighbor": "nearest_neighbor",
     "org.avenir.markov.HiddenMarkovModelBuilder": "hidden_markov_model_builder",
     "org.avenir.markov.MarkovModelClassifier": "markov_model_classifier",

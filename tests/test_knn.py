@@ -231,3 +231,114 @@ def test_knn_pipeline_via_cli(tmp_path):
     assert len(lines) == 50
     acc = np.mean([l.split(",")[2] == l.split(",")[1] for l in lines])
     assert acc > 0.9
+
+
+def test_pairwise_topk_matches_full_matrix():
+    """Fused tiled distance+top-k == full matrix + stable argsort, including
+    across tile boundaries and for both metrics."""
+    train = encode_rows(two_cluster_rows(300, seed=1), SCHEMA)
+    test = encode_rows(two_cluster_rows(50, seed=2), SCHEMA)
+    for metric in ("euclidean", "manhattan"):
+        comp = DistanceComputer(SCHEMA, metric=metric, scale=1000)
+        full = comp.pairwise(test, train)
+        k = 7
+        d, idx = comp.pairwise_topk(test, train, k, train_tile=64,
+                                    test_chunk=16)
+        assert d.shape == (50, k) and idx.shape == (50, k)
+        order = np.argsort(full, axis=1, kind="stable")[:, :k]
+        expect_d = np.take_along_axis(full, order, axis=1)
+        assert (d == expect_d).all()
+        # gathered distances must match what the index claims
+        assert (np.take_along_axis(full, idx, axis=1) == d).all()
+        # rows sorted nearest-first
+        assert (np.diff(d, axis=1) >= 0).all()
+
+
+def test_pairwise_topk_k_exceeds_train():
+    train = encode_rows(two_cluster_rows(5, seed=1), SCHEMA)
+    test = encode_rows(two_cluster_rows(4, seed=2), SCHEMA)
+    comp = DistanceComputer(SCHEMA)
+    d, idx = comp.pairwise_topk(test, train, 50)
+    assert d.shape == (4, 5)
+    assert set(idx[0]) == set(range(5))
+
+
+def test_knn_in_process_matches_file_pipeline(tmp_path):
+    """knnPipeline (fused device top-k, no all-pairs file) predicts the same
+    classes as the sameTypeSimilarity -> nearestNeighbor file pipeline."""
+    train_rows = two_cluster_rows(150, seed=3)
+    test_rows = two_cluster_rows(50, seed=4)
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    (data_dir / "tr_train.csv").write_text(
+        "\n".join(",".join(r) for r in train_rows))
+    (data_dir / "test.csv").write_text(
+        "\n".join(",".join(r) for r in test_rows))
+    schema_path = tmp_path / "s.json"
+    schema_path.write_text(json.dumps({"fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "x", "ordinal": 1, "dataType": "double", "feature": True,
+         "min": 0, "max": 10},
+        {"name": "y", "ordinal": 2, "dataType": "double", "feature": True,
+         "min": 0, "max": 10},
+        {"name": "color", "ordinal": 3, "dataType": "categorical",
+         "feature": True, "cardinality": ["red", "green"]},
+        {"name": "label", "ordinal": 4, "dataType": "categorical",
+         "cardinality": ["A", "B"]}]}))
+    props = tmp_path / "knn.properties"
+    props.write_text(
+        "field.delim.regex=,\nfield.delim.out=,\n"
+        f"sts.same.schema.file.path={schema_path}\n"
+        "sts.distance.scale=1000\n"
+        "sts.base.set.split.prefix=tr\n"
+        "nen.top.match.count=7\n"
+        "nen.kernel.function=none\n"
+        "nen.validation.mode=true\n")
+    rc = cli_run.main(["org.sifarish.feature.SameTypeSimilarity",
+                       f"-Dconf.path={props}", str(data_dir),
+                       str(tmp_path / "dist")])
+    assert rc == 0
+    rc = cli_run.main(["knnClassifier", f"-Dconf.path={props}",
+                       str(tmp_path / "dist"), str(tmp_path / "out_file")])
+    assert rc == 0
+    rc = cli_run.main(["knnPipeline", f"-Dconf.path={props}",
+                       str(data_dir), str(tmp_path / "out_fused")])
+    assert rc == 0
+    file_pred = {}
+    for l in (tmp_path / "out_file" / "part-r-00000").read_text().splitlines():
+        tid, actual, pred = l.split(",")
+        file_pred[tid] = (actual, pred)
+    fused_lines = (tmp_path / "out_fused" / "part-r-00000"
+                   ).read_text().splitlines()
+    assert len(fused_lines) == 50
+    for l in fused_lines:
+        tid, actual, pred = l.split(",")
+        assert file_pred[tid] == (actual, pred)
+
+
+def test_knn_in_process_intra_set_excludes_self(tmp_path):
+    rows = two_cluster_rows(40, seed=9)
+    f = tmp_path / "all.csv"
+    f.write_text("\n".join(",".join(r) for r in rows))
+    schema_path = tmp_path / "s.json"
+    schema_path.write_text(json.dumps({"fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "x", "ordinal": 1, "dataType": "double", "feature": True,
+         "min": 0, "max": 10},
+        {"name": "y", "ordinal": 2, "dataType": "double", "feature": True,
+         "min": 0, "max": 10},
+        {"name": "color", "ordinal": 3, "dataType": "categorical",
+         "feature": True, "cardinality": ["red", "green"]},
+        {"name": "label", "ordinal": 4, "dataType": "categorical",
+         "cardinality": ["A", "B"]}]}))
+    props = tmp_path / "p.properties"
+    props.write_text(f"sts.same.schema.file.path={schema_path}\n"
+                     "nen.top.match.count=5\n")
+    rc = cli_run.main(["knnPipeline", f"-Dconf.path={props}",
+                       str(f), str(tmp_path / "out")])
+    assert rc == 0
+    lines = (tmp_path / "out" / "part-r-00000").read_text().splitlines()
+    assert len(lines) == 40
+    # self-exclusion: with clean clusters, leave-one-out accuracy stays high
+    acc = np.mean([l.split(",")[2] == l.split(",")[1] for l in lines])
+    assert acc > 0.9
