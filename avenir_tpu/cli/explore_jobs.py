@@ -16,7 +16,7 @@ from ..core.config import Config
 from ..core.metrics import Counters
 from ..core import artifacts
 from ..core.table import load_csv
-from ..parallel.mesh import MeshContext
+from ..parallel.mesh import MeshContext, runtime_context
 from .jobs import register, _schema_path, _splitter
 
 
@@ -29,7 +29,7 @@ def mutual_information(cfg: Config, in_path: str, out_path: str) -> Counters:
     counters = Counters()
     schema = _schema_path(cfg, "mut.feature.schema.file.path")
     table = load_csv(in_path, schema, cfg.field_delim_regex)
-    stats = MI.compute_stats(table, MeshContext())
+    stats = MI.compute_stats(table, runtime_context())
     od = cfg.field_delim_out
     lines: List[str] = []
     if cfg.get_boolean("mut.output.mutual.info", True):
@@ -95,7 +95,7 @@ def numerical_correlation(cfg: Config, in_path: str, out_path: str) -> Counters:
     else:
         ordinals = [f.ordinal for f in schema.feature_fields if f.is_numeric]
         pairs = None
-    corr = numerical_correlations(table, ordinals, MeshContext())
+    corr = numerical_correlations(table, ordinals, runtime_context())
     lines = []
     for a, b, v in corr:
         if pairs is None or (a, b) in pairs or (b, a) in pairs:

@@ -19,7 +19,7 @@ from ..core.schema import FeatureSchema
 from ..core.table import load_csv
 from ..core.metrics import Counters, CostBasedArbitrator
 from ..core import artifacts
-from ..parallel.mesh import MeshContext
+from ..parallel.mesh import MeshContext, runtime_context
 
 JOBS: Dict[str, Callable] = {}
 
@@ -97,7 +97,7 @@ def decision_tree_builder(cfg: Config, in_path: str, out_path: str) -> Counters:
     schema = _schema_path(cfg, "dtb.feature.schema.file.path")
     table = load_csv(in_path, schema, cfg.field_delim_regex, keep_raw=True)
     params = _tree_params(cfg)
-    builder = T.TreeBuilder(table, params, MeshContext())
+    builder = T.TreeBuilder(table, params, runtime_context())
     dec_in = cfg.get("dtb.decision.file.path.in")
     dpl = T.DecisionPathList.from_json(open(dec_in).read()) if dec_in else None
     new_dpl = builder.build_one_level(table, dpl)
@@ -122,7 +122,7 @@ def random_forest_builder(cfg: Config, in_path: str, out_path: str) -> Counters:
     params = ForestParams(tree=_tree_params(cfg),
                           num_trees=cfg.get_int("dtb.num.trees", 5),
                           seed=cfg.get_int("dtb.random.seed", 0))
-    models = build_forest(table, params, MeshContext())
+    models = build_forest(table, params, runtime_context())
     os.makedirs(out_path, exist_ok=True)
     for i, dpl in enumerate(models):
         with open(os.path.join(out_path, f"tree_{i}.json"), "w") as fh:
@@ -290,12 +290,16 @@ def knn_pipeline(cfg: Config, in_path: str, out_path: str) -> Counters:
     nearestNeighbor remain for file-level parity with the reference.
 
     Input like sameTypeSimilarity: a dir whose sts.base.set.split.prefix
-    files are the train set and the rest test; a single file (or dir with
-    only one kind) is intra-set — self-pairs are excluded like the
-    reference's within-set matching.  Output + validation counters match
-    the nearestNeighbor job.  Class-conditional posterior weighting needs
-    the Bayesian-join file flow; this job rejects it (and regression mode,
-    which needs the file layout's target columns) loudly."""
+    files are the train set and the rest test; inter-set output +
+    validation counters match the nearestNeighbor job.  A single file (or
+    dir with only one kind) is intra-set, where this job deliberately
+    diverges from the file pipeline: every row gets its k nearest among
+    ALL other rows (proper leave-one-out), whereas sameTypeSimilarity's
+    once-per-unordered-pair emission gives the file flow asymmetric,
+    shrinking candidate sets (row i only ever sees rows > i).
+    Class-conditional posterior weighting needs the Bayesian-join file
+    flow; this job rejects it (and regression mode, which needs the file
+    layout's target columns) loudly."""
     from ..ops.distance import DistanceComputer
     from ..models import knn as K
     from ..core.metrics import ConfusionMatrix
@@ -333,11 +337,18 @@ def knn_pipeline(cfg: Config, in_path: str, out_path: str) -> Counters:
     cardinality = list(schema.class_attr_field.cardinality or [])
     # vote over SORTED class values like the nearestNeighbor job (which
     # sorts the classes observed in its input) so argmax tie-breaks match
-    # the file pipeline even for unsorted schema cardinality
-    class_values = sorted(cardinality)
+    # the file pipeline even for unsorted schema cardinality; train rows
+    # with labels outside the cardinality (code -1) vote as "?" — the
+    # file pipeline emits "?" for them and treats it as its own class
+    train_codes = train.class_codes()
+    unknown = bool((train_codes < 0).any())
+    class_values = sorted(set(cardinality) | ({"?"} if unknown else set()))
     remap = np.array([class_values.index(c) for c in cardinality],
                      dtype=np.int32)
-    ncls = remap[train.class_codes()][idx]        # (n_test, k)
+    mapped = np.where(
+        train_codes >= 0, remap[np.clip(train_codes, 0, None)],
+        class_values.index("?") if unknown else 0).astype(np.int32)
+    ncls = mapped[idx]                            # (n_test, k)
     res = K.classify_topk(nd, ncls, class_values, params)
 
     id_ord = schema.id_fields[0].ordinal if schema.id_fields else 0
@@ -347,9 +358,17 @@ def knn_pipeline(cfg: Config, in_path: str, out_path: str) -> Counters:
     if validation:
         actual = [cardinality[c] if c >= 0 else "?"
                   for c in test.class_codes()]
-        # the reference builds the matrix as (cardinality[0], cardinality[1])
-        # = (neg, pos) — NearestNeighbor.java:287-292
-        cm = ConfusionMatrix(cardinality[0], cardinality[1])
+        # (neg, pos) like the nearestNeighbor job: schema cardinality first
+        # (NearestNeighbor.java:287-292), then the nen.class.attribute.values
+        # override, then a degenerate-cardinality fallback
+        if len(cardinality) >= 2:
+            neg, pos = cardinality[0], cardinality[1]
+        elif params.pos_class:
+            neg, pos = params.neg_class, params.pos_class
+        else:
+            cvs = class_values if len(class_values) >= 2 else class_values * 2
+            neg, pos = cvs[0], cvs[1]
+        cm = ConfusionMatrix(neg, pos)
     out_lines = []
     for i in range(test.n_rows):
         parts = [test_ids[i]]
@@ -644,7 +663,7 @@ def bayesian_distribution(cfg: Config, in_path: str, out_path: str) -> Counters:
         return counters
     schema = _schema_path(cfg, "bad.feature.schema.file.path")
     table = load_csv(in_path, schema, cfg.field_delim_regex)
-    ctx = MeshContext()
+    ctx = runtime_context()
     model = bayes.train(table, ctx, counters)
     artifacts.write_text_output(out_path, model.to_lines(cfg.field_delim_out))
     return counters

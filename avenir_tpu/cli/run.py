@@ -59,7 +59,28 @@ def parse_args(argv: List[str]):
     return job_name, conf_path, overrides, positional
 
 
+def _enter_distributed_mode(mode: str) -> None:
+    """-Ddistributed.mode= / AVENIR_TPU_DISTRIBUTED=1 entry: join the
+    multi-process run (env-driven; mode 'auto' additionally lets TPU pod
+    runtimes self-discover), build the hybrid (hosts, data) mesh, and
+    install it as the process-wide runtime context so every job (they all
+    resolve MeshContext through ``runtime_context()``) runs sharded over
+    it.  Single-process with the flag set still gets the 1 x n hybrid mesh
+    — same axis names, so shardings are portable."""
+    from ..parallel import distributed
+    from ..parallel.mesh import MeshContext, set_runtime_context
+    # 'auto' and the env flag both attempt pod self-discovery (the env
+    # var's documented contract in parallel/distributed.py — downgrading
+    # it to a local no-join would be the silent shard-local failure mode
+    # that module refuses).  -Ddistributed.mode=1 on a single host joins
+    # only via an explicit JAX_* triple, else runs the 1 x n hybrid mesh.
+    distributed.initialize(auto=(mode in ("auto", "env")))
+    set_runtime_context(MeshContext(distributed.make_hybrid_mesh()))
+
+
 def main(argv: Optional[List[str]] = None) -> int:
+    import os
+
     argv = list(sys.argv[1:] if argv is None else argv)
     job_name, conf_path, overrides, positional = parse_args(argv)
     if job_name is None:
@@ -68,6 +89,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
     if "platform" in overrides:
         force_platform(overrides["platform"])
+    dist_mode = overrides.get("distributed.mode") or (
+        "env" if os.environ.get("AVENIR_TPU_DISTRIBUTED") == "1" else "")
+    if dist_mode and dist_mode.lower() not in ("0", "false", "off"):
+        _enter_distributed_mode(dist_mode)
     fn = jobs.resolve(job_name)
     cfg = load_config(conf_path, app=job_name.split(".")[-1][0].lower() +
                       job_name.split(".")[-1][1:]) if conf_path else Config()
@@ -80,7 +105,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         in_path = out_path = None
     counters = fn(cfg, in_path, out_path)
     if counters is not None:
-        print(counters.render())
+        # Hadoop counters are cluster-global: under multi-host the per
+        # -process host-side tallies are all-reduced, and only process 0
+        # renders (matching the reference driver's single counter dump)
+        from ..parallel.distributed import all_reduce_counters
+        import jax
+        counters = all_reduce_counters(counters)
+        if jax.process_index() == 0:
+            print(counters.render())
     return 0
 
 

@@ -29,7 +29,7 @@ import jax.numpy as jnp
 from ..core.schema import FeatureSchema
 from ..core.table import ColumnarTable
 from ..ops.histogram import joint_histogram
-from ..parallel.mesh import MeshContext
+from ..parallel.mesh import MeshContext, runtime_context
 
 
 class ContingencyMatrix:
@@ -121,7 +121,7 @@ def numerical_correlations(table: ColumnarTable, ordinals: Sequence[int],
                            ) -> List[Tuple[int, int, float]]:
     """Pearson r per pair via a single device moment pass
     (NumericalCorrelation.java:87-179's (n,Σx,Σy,Σxy,Σx²,Σy²) algebra)."""
-    ctx = ctx or MeshContext()
+    ctx = ctx or runtime_context()
     padded = table.pad_to_multiple(ctx.n_devices)
     X = np.stack([padded.columns[o] for o in ordinals], axis=1).astype(np.float64)
     mask = padded.valid_mask.astype(np.float64)

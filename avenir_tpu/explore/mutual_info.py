@@ -29,7 +29,7 @@ import jax.numpy as jnp
 
 from ..core.schema import FeatureSchema
 from ..core.table import ColumnarTable
-from ..parallel.mesh import MeshContext
+from ..parallel.mesh import MeshContext, runtime_context
 
 
 def _entropy(p: np.ndarray) -> float:
@@ -86,7 +86,7 @@ class MutualInfoStats:
 def compute_stats(table: ColumnarTable, ctx: Optional[MeshContext] = None,
                   chunk: int = 1 << 18) -> MutualInfoStats:
     """All distributions in one (chunked) jitted pass over row-sharded data."""
-    ctx = ctx or MeshContext()
+    ctx = ctx or runtime_context()
     schema = table.schema
     fields = [f for f in schema.feature_fields if f.is_binned]
     F = len(fields)

@@ -40,7 +40,7 @@ import jax.numpy as jnp
 from ..core.schema import FeatureSchema, FeatureField
 from ..core.table import ColumnarTable
 from ..core.metrics import ConfusionMatrix, Counters, CostBasedArbitrator
-from ..parallel.mesh import MeshContext
+from ..parallel.mesh import MeshContext, runtime_context
 from ..ops.histogram import class_bin_histogram, class_moments
 
 
@@ -180,7 +180,7 @@ def train(table: ColumnarTable, ctx: Optional[MeshContext] = None,
     partials + all-reduce — the exact combiner+shuffle structure of the
     reference job, in one XLA program.
     """
-    ctx = ctx or MeshContext()
+    ctx = ctx or runtime_context()
     schema = table.schema
     class_field = schema.class_attr_field
     class_values = list(class_field.cardinality or [])
@@ -279,7 +279,7 @@ def predict(model: NaiveBayesModel, table: ColumnarTable,
     P(x|c) = Π_f post[c,f,bin_f]/classCount_c (Gaussian density for
     continuous), P(x) = Π_f prior[f,bin_f]/total.
     """
-    ctx = ctx or MeshContext()
+    ctx = ctx or runtime_context()
     schema = model.schema
     C = len(model.class_values)
     binned_fields = [schema.find_field_by_ordinal(o) for o in model.binned_ordinals]

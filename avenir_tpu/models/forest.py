@@ -34,7 +34,7 @@ import jax.numpy as jnp
 from ..core.schema import FeatureSchema
 from ..core.table import ColumnarTable
 from ..core.metrics import Counters
-from ..parallel.mesh import MeshContext
+from ..parallel.mesh import MeshContext, runtime_context
 from .tree import (DecisionPath, DecisionPathList, DecisionTreeModel,
                    Predicate, TreeBuilder, TreeParams, level_chunk,
                    sampling_weights)
@@ -145,7 +145,7 @@ class ForestBuilder:
                  ctx: Optional[MeshContext] = None):
         self.params = params
         self.base = TreeBuilder(table, replace(params.tree, seed=params.seed),
-                                ctx or MeshContext())
+                                ctx or runtime_context())
         self.tree_builders = [
             self.base.with_params(
                 replace(params.tree, seed=params.seed + 1000 * (t + 1)))
@@ -297,7 +297,7 @@ def build_forest(table: ColumnarTable, params: ForestParams,
     default) advances all trees level-by-level through one shared kernel;
     ``batched=False`` is the sequential per-tree loop kept as the parity and
     benchmark baseline — both produce identical models."""
-    ctx = ctx or MeshContext()
+    ctx = ctx or runtime_context()
     if batched:
         return ForestBuilder(table, params, ctx).build_all()
     models: List[DecisionPathList] = []

@@ -50,7 +50,7 @@ import jax.numpy as jnp
 
 from ..core.schema import FeatureSchema, FeatureField
 from ..core.table import ColumnarTable
-from ..parallel.mesh import MeshContext
+from ..parallel.mesh import MeshContext, runtime_context
 
 ROOT_PATH = "$root"
 SPLIT_DELIM = ":"          # splitId:predicate in shuffle keys (not in model)
@@ -530,7 +530,7 @@ class TreeBuilder:
     def __init__(self, table: ColumnarTable, params: TreeParams,
                  ctx: Optional[MeshContext] = None,
                  splits: Optional[List[CandidateSplit]] = None):
-        self.ctx = ctx or MeshContext()
+        self.ctx = ctx or runtime_context()
         self.params = params
         self.schema = table.schema
         self.class_field = self.schema.class_attr_field

@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 
 from .domain import SearchDomain
-from ..parallel.mesh import MeshContext
+from ..parallel.mesh import MeshContext, runtime_context
 
 
 @dataclass
@@ -59,7 +59,7 @@ def simulated_annealing(domain: SearchDomain, params: AnnealingParams,
                         ctx: Optional[MeshContext] = None,
                         start_solutions: Optional[np.ndarray] = None
                         ) -> AnnealingResult:
-    ctx = ctx or MeshContext()
+    ctx = ctx or runtime_context()
     rng = np.random.default_rng(params.seed)
     k = params.num_optimizers
     cur = start_solutions if start_solutions is not None else \

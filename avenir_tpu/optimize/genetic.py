@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from .domain import SearchDomain
-from ..parallel.mesh import MeshContext
+from ..parallel.mesh import MeshContext, runtime_context
 
 
 @dataclass
@@ -41,7 +41,7 @@ class GeneticResult:
 
 def genetic_algorithm(domain: SearchDomain, params: GeneticParams,
                       ctx: Optional[MeshContext] = None) -> GeneticResult:
-    ctx = ctx or MeshContext()
+    ctx = ctx or runtime_context()
     rng = np.random.default_rng(params.seed)
     I, P = params.num_islands, params.population_size
     pop = domain.initial_solutions(rng, I * P).reshape(I, P, -1)

@@ -33,7 +33,7 @@ import jax.numpy as jnp
 
 from ..core.schema import FeatureSchema
 from ..core.table import ColumnarTable
-from ..parallel.mesh import MeshContext
+from ..parallel.mesh import MeshContext, runtime_context
 
 ITER_LIMIT = "iterLimit"
 ALL_BELOW_THRESHOLD = "allBelowThreshold"
@@ -98,7 +98,7 @@ class LogisticTrainer:
                  ctx: Optional[MeshContext] = None):
         self.schema = schema
         self.params = params
-        self.ctx = ctx or MeshContext()
+        self.ctx = ctx or runtime_context()
         self._step = jax.jit(self._step_impl)
 
     def design_matrix(self, table: ColumnarTable

@@ -65,3 +65,50 @@ def test_histogram_reduction_over_hybrid_mesh():
         for f in range(3):
             expect[cls[i], f, bins[i, f]] += 1
     np.testing.assert_allclose(out, expect)
+
+
+def test_cli_distributed_mode_installs_hybrid_context(tmp_path, monkeypatch):
+    """-Ddistributed.mode=1 routes the job through a hybrid-mesh runtime
+    context, and the model + counters match a default (1-D mesh) run."""
+    import os
+    import sys
+
+    from avenir_tpu.cli import run as cli_run
+    from avenir_tpu.parallel import mesh as M
+
+    res = os.path.abspath(
+        os.path.join(os.path.dirname(__file__), "..", "resource"))
+    sys.path.insert(0, res)
+    from gen import telecom_churn_gen
+
+    train = tmp_path / "train.csv"
+    train.write_text("\n".join(telecom_churn_gen.generate(512, 3)))
+
+    def run(extra, out):
+        rc = cli_run.main([
+            "org.avenir.bayesian.BayesianDistribution",
+            f"-Dconf.path={res}/churn.properties",
+            f"-Dbad.feature.schema.file.path={res}/churn.json",
+            *extra, str(train), str(tmp_path / out)])
+        assert rc == 0
+        return (tmp_path / out / "part-r-00000").read_text()
+
+    try:
+        default_model = run([], "m_default")
+        dist_model = run(["-Ddistributed.mode=1"], "m_dist")
+        # the distributed entry replaced the runtime context with one over
+        # the (hosts, data) hybrid mesh
+        ctx = M.runtime_context()
+        assert ctx.mesh.axis_names == ("hosts", "data")
+        assert ctx.n_devices == len(jax.devices())
+        assert dist_model == default_model
+    finally:
+        M.set_runtime_context(None)
+
+
+def test_all_reduce_counters_single_process_identity():
+    from avenir_tpu.core.metrics import Counters
+    c = Counters()
+    c.increment("G", "a", 3)
+    out = D.all_reduce_counters(c)
+    assert out is c
