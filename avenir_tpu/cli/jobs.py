@@ -343,11 +343,14 @@ def knn_pipeline(cfg: Config, in_path: str, out_path: str) -> Counters:
     train_codes = train.class_codes()
     unknown = bool((train_codes < 0).any())
     class_values = sorted(set(cardinality) | ({"?"} if unknown else set()))
-    remap = np.array([class_values.index(c) for c in cardinality],
-                     dtype=np.int32)
-    mapped = np.where(
-        train_codes >= 0, remap[np.clip(train_codes, 0, None)],
-        class_values.index("?") if unknown else 0).astype(np.int32)
+    if cardinality:
+        remap = np.array([class_values.index(c) for c in cardinality],
+                         dtype=np.int32)
+        mapped = np.where(
+            train_codes >= 0, remap[np.clip(train_codes, 0, None)],
+            class_values.index("?") if unknown else 0).astype(np.int32)
+    else:  # no cardinality: every label is unknown, all votes are "?"
+        mapped = np.zeros_like(train_codes)
     ncls = mapped[idx]                            # (n_test, k)
     res = K.classify_topk(nd, ncls, class_values, params)
 

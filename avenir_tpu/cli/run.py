@@ -91,8 +91,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         force_platform(overrides["platform"])
     dist_mode = overrides.get("distributed.mode") or (
         "env" if os.environ.get("AVENIR_TPU_DISTRIBUTED") == "1" else "")
+    entered_distributed = False
     if dist_mode and dist_mode.lower() not in ("0", "false", "off"):
         _enter_distributed_mode(dist_mode)
+        entered_distributed = True
     fn = jobs.resolve(job_name)
     cfg = load_config(conf_path, app=job_name.split(".")[-1][0].lower() +
                       job_name.split(".")[-1][1:]) if conf_path else Config()
@@ -103,16 +105,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         in_path, out_path = None, positional[0]
     else:
         in_path = out_path = None
-    counters = fn(cfg, in_path, out_path)
-    if counters is not None:
-        # Hadoop counters are cluster-global: under multi-host the per
-        # -process host-side tallies are all-reduced, and only process 0
-        # renders (matching the reference driver's single counter dump)
-        from ..parallel.distributed import all_reduce_counters
-        import jax
-        counters = all_reduce_counters(counters)
-        if jax.process_index() == 0:
-            print(counters.render())
+    try:
+        counters = fn(cfg, in_path, out_path)
+        if counters is not None:
+            # Hadoop counters are cluster-global: under multi-host the per
+            # -process host-side tallies are all-reduced, and only process 0
+            # renders (matching the reference driver's single counter dump)
+            from ..parallel.distributed import all_reduce_counters
+            import jax
+            counters = all_reduce_counters(counters)
+            if jax.process_index() == 0:
+                print(counters.render())
+    finally:
+        if entered_distributed:
+            # don't leak the hybrid context into later in-process runs
+            from ..parallel.mesh import set_runtime_context
+            set_runtime_context(None)
     return 0
 
 

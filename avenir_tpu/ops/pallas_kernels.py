@@ -12,12 +12,13 @@ Everything degrades gracefully: on non-TPU backends the kernel runs in
 interpreter mode (tests), and callers fall back to the XLA path if pallas is
 unavailable.
 
-NOTE on this dev environment: the tunneled 'axon' TPU platform cannot compile
-Mosaic kernels (even a trivial pallas_call hangs), so production code paths
-default to the XLA one-hot formulation (ops/histogram.py) and the pallas path
-is opt-in via use_pallas flags / AVENIR_TPU_USE_PALLAS=1 for real TPU
-deployments, where the VMEM-resident accumulator avoids the HBM round trip of
-the one-hot intermediate.
+MEASURED VERDICT (round 3, TPU v5e via bench.pallas_probe — reps chained on
+device, one readback): coded_histogram 154M rows/s vs the XLA one-hot's
+515M rows/s at (4M, 6, 24) — the XLA formulation is 3.3x FASTER than this
+hand-written kernel on real hardware, so it stays the production default
+(ops/histogram.py) and pallas remains opt-in (AVENIR_TPU_USE_PALLAS=1) +
+interpret-mode tested.  bench.py re-measures the ratio every round in
+extra_metrics, so the decision tracks future runtime/kernel changes.
 """
 
 from __future__ import annotations

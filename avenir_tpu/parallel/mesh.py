@@ -118,18 +118,26 @@ def _zeros_jit(shape, dtype, sharding):
 # ---------------------------------------------------------------------------
 
 _runtime_ctx: Optional[MeshContext] = None
+_runtime_ctx_explicit = False
 
 
 def set_runtime_context(ctx: Optional[MeshContext]) -> None:
-    global _runtime_ctx
+    global _runtime_ctx, _runtime_ctx_explicit
     _runtime_ctx = ctx
+    _runtime_ctx_explicit = ctx is not None
 
 
 def runtime_context() -> MeshContext:
     """The process-global MeshContext.  Defaults to a 1-D mesh over all
     devices; ``cli.run`` replaces it with a hybrid-mesh context under
-    -Ddistributed.mode= / AVENIR_TPU_DISTRIBUTED=1."""
+    -Ddistributed.mode= / AVENIR_TPU_DISTRIBUTED=1 (and resets it after the
+    job).  The lazy default is rebuilt when the backend's device count
+    changes (e.g. a -Dplatform= switch between in-process runs), matching
+    default_mesh()'s staleness rule; an explicitly-set context is never
+    second-guessed."""
     global _runtime_ctx
-    if _runtime_ctx is None:
+    if _runtime_ctx is None or (
+            not _runtime_ctx_explicit
+            and _runtime_ctx.n_devices != len(jax.devices())):
         _runtime_ctx = MeshContext()
     return _runtime_ctx

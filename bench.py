@@ -252,6 +252,65 @@ def measure(name, env_extra, timeout_s):
     return None, False
 
 
+def pallas_probe(timeout_s=None, device_ok=True):
+    """VERDICT r2 #5 'prove or prune': time the pallas coded_histogram
+    against the XLA one-hot formulation on the live backend, inside a
+    watchdog child — Mosaic HANGS at compile on the tunneled axon platform
+    (see ops/pallas_kernels.py), so the child's timeout converts that hang
+    into a recorded verdict instead of a wedged bench.  Returns an
+    extra_metrics entry either way: a measured ratio, or the documented
+    unsupported status."""
+    timeout_s = timeout_s or int(os.environ.get("BENCH_PALLAS_TIMEOUT_S",
+                                                "120"))
+    code = (
+        _CHILD_PRELUDE +
+        "import json, time\n"
+        "import numpy as np, jax.numpy as jnp\n"
+        "from avenir_tpu.ops.pallas_kernels import coded_histogram\n"
+        "n, F, K, reps = 4_000_000, 6, 24, 10\n"
+        "rng = np.random.default_rng(0)\n"
+        "codes = jnp.asarray(rng.integers(0, K, (n, F)).astype(np.int32))\n"
+        "# reps chained ON DEVICE (shifted codes defeat CSE) with one final\n"
+        "# readback: per-call readbacks would only measure the ~60ms tunnel\n"
+        "# round trip, not the kernels\n"
+        "def many(fn):\n"
+        "    def body(c):\n"
+        "        acc = None\n"
+        "        for i in range(reps):\n"
+        "            h = fn((c + i) % K)\n"
+        "            acc = h if acc is None else acc + h\n"
+        "        return acc\n"
+        "    return jax.jit(body)\n"
+        "xla_one = lambda c: jax.nn.one_hot(c, K, dtype=jnp.float32).sum(0)\n"
+        "def rate(fn):\n"
+        "    j = many(fn)\n"
+        "    np.asarray(j(codes))\n"
+        "    t0 = time.perf_counter()\n"
+        "    np.asarray(j(codes))\n"
+        "    return n * reps / (time.perf_counter() - t0)\n"
+        "p = rate(lambda c: coded_histogram(c, K, interpret=False))\n"
+        "x = rate(xla_one)\n"
+        "print(json.dumps({'pallas_rows_per_sec': round(p, 1),\n"
+        "                  'xla_rows_per_sec': round(x, 1),\n"
+        "                  'pallas_vs_xla': round(p / x, 3)}))\n")
+    env = {} if device_ok else {"JAX_PLATFORMS": "cpu"}
+    out = _run_child(code, env, timeout_s)
+    if out is TIMEOUT:
+        return {"metric": "pallas_coded_histogram", "value": 0,
+                "unit": "status",
+                "status": "pallas child timed out (wedged device or Mosaic "
+                          "compile hang); XLA one-hot path is the "
+                          "production default (ops/pallas_kernels.py)"}
+    if out is None:
+        return {"metric": "pallas_coded_histogram", "value": 0,
+                "unit": "status", "status": "pallas child crashed; XLA "
+                "one-hot path is the production default"}
+    return {"metric": "pallas_coded_histogram_rows_per_sec",
+            "value": out["pallas_rows_per_sec"], "unit": "rows/sec",
+            "xla_rows_per_sec": out["xla_rows_per_sec"],
+            "pallas_vs_xla": out["pallas_vs_xla"]}
+
+
 def main():
     ref = reference_rate()
     platform = probe_device()
@@ -277,6 +336,8 @@ def main():
         backends["nb"] = "python"
     extras = [dict(results[k], backend=backends[k])
               for k in ("rf", "knn", "knn_big") if k in results]
+    extras.append(dict(pallas_probe(device_ok=device_ok),
+                       backend="device" if device_ok else "cpu-fallback"))
     print(json.dumps({
         "metric": nb["metric"],
         "value": nb["value"],

@@ -1,0 +1,149 @@
+"""Shared runners for the golden BYTE-fixture flows (VERDICT r2 #4 /
+BASELINE.md acceptance: 'output CSV byte-identical in format').
+
+Each flow runs one BASELINE.json use case with small fixed-seed data and
+returns {relative_path: file_text} for every artifact whose byte layout is
+part of the format contract (model CSVs, prediction lines, tree JSON,
+all-pairs distance lines, SA solution lines).  ``regen.py`` freezes these
+under fixtures/; ``tests/test_golden_bytes.py`` re-runs the flows and
+asserts byte equality, so a delimiter, column-order, float-format, or
+JSON-layout regression fails CI.
+
+Intentional fixture change (a deliberate format fix): run
+``python tests/golden/regen.py`` and commit the diff with the reason.
+"""
+
+import json
+import os
+import sys
+
+RES = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..",
+                                   "resource"))
+sys.path.insert(0, RES)
+
+from avenir_tpu.cli import run as cli_run  # noqa: E402
+
+
+def _gen(mod_name, *args):
+    import importlib
+    mod = importlib.import_module(f"gen.{mod_name}")
+    return mod.generate(*args)
+
+
+def _read(path):
+    with open(path) as fh:
+        return fh.read()
+
+
+def nb_flow(base):
+    d = os.path.join(base, "nb")
+    os.makedirs(d, exist_ok=True)
+    train = os.path.join(d, "train.csv")
+    with open(train, "w") as fh:
+        fh.write("\n".join(_gen("telecom_churn_gen", 400, 11)))
+    props = os.path.join(RES, "churn.properties")
+    assert cli_run.main([
+        "org.avenir.bayesian.BayesianDistribution", f"-Dconf.path={props}",
+        f"-Dbad.feature.schema.file.path={RES}/churn.json",
+        train, os.path.join(d, "model")]) == 0
+    assert cli_run.main([
+        "org.avenir.bayesian.BayesianPredictor", f"-Dconf.path={props}",
+        f"-Dbap.feature.schema.file.path={RES}/churn.json",
+        f"-Dbap.bayesian.model.file.path={d}/model/part-r-00000",
+        train, os.path.join(d, "pred")]) == 0
+    return {"nb/model.csv": _read(f"{d}/model/part-r-00000"),
+            "nb/pred.csv": _read(f"{d}/pred/part-m-00000")}
+
+
+def dt_flow(base):
+    d = os.path.join(base, "dt")
+    os.makedirs(d, exist_ok=True)
+    train = os.path.join(d, "train.csv")
+    with open(train, "w") as fh:
+        fh.write("\n".join(_gen("call_hangup_gen", 400, 12)))
+    props = os.path.join(RES, "detr.properties")
+    dec_in = None
+    for level in range(1, 4):
+        args = ["org.avenir.tree.DecisionTreeBuilder", f"-Dconf.path={props}",
+                f"-Ddtb.feature.schema.file.path={RES}/call_hangup.json",
+                f"-Ddtb.decision.file.path.out={d}/dec_out.json"]
+        if dec_in:
+            args.append(f"-Ddtb.decision.file.path.in={dec_in}")
+        args += [train, os.path.join(d, f"level_{level}")]
+        assert cli_run.main(args) == 0
+        dec_in = os.path.join(d, "dec_in.json")
+        os.replace(os.path.join(d, "dec_out.json"), dec_in)
+    return {"dt/decision_paths.json": _read(dec_in)}
+
+
+def rf_flow(base):
+    d = os.path.join(base, "rf")
+    os.makedirs(d, exist_ok=True)
+    train = os.path.join(d, "train.csv")
+    with open(train, "w") as fh:
+        fh.write("\n".join(_gen("call_hangup_gen", 400, 13)))
+    props = os.path.join(RES, "rafo.properties")
+    model = os.path.join(d, "model")
+    assert cli_run.main([
+        "org.avenir.tree.RandomForestBuilder", f"-Dconf.path={props}",
+        f"-Ddtb.feature.schema.file.path={RES}/call_hangup.json",
+        "-Ddtb.num.trees=3", train, model]) == 0
+    assert cli_run.main([
+        "org.avenir.model.ModelPredictor", f"-Dconf.path={props}",
+        f"-Dmop.model.dir.path={model}",
+        f"-Dmop.feature.schema.file.path={RES}/call_hangup.json",
+        train, os.path.join(d, "pred")]) == 0
+    out = {f"rf/tree_{i}.json": _read(f"{model}/tree_{i}.json")
+           for i in range(3)}
+    out["rf/pred.csv"] = _read(f"{d}/pred/part-m-00000")
+    return out
+
+
+def knn_flow(base):
+    d = os.path.join(base, "knn")
+    data = os.path.join(d, "data")
+    os.makedirs(data, exist_ok=True)
+    rows = _gen("elearn_gen", 130, 14)
+    with open(os.path.join(data, "tr_part"), "w") as fh:
+        fh.write("\n".join(rows[:100]))
+    with open(os.path.join(data, "test_part"), "w") as fh:
+        fh.write("\n".join(rows[100:]))
+    props = os.path.join(RES, "knn.properties")
+    assert cli_run.main([
+        "org.sifarish.feature.SameTypeSimilarity", f"-Dconf.path={props}",
+        f"-Dsts.same.schema.file.path={RES}/elearn.json",
+        data, os.path.join(d, "dist")]) == 0
+    assert cli_run.main([
+        "org.avenir.knn.NearestNeighbor", f"-Dconf.path={props}",
+        os.path.join(d, "dist"), os.path.join(d, "pred")]) == 0
+    pred = next(f for f in sorted(os.listdir(os.path.join(d, "pred")))
+                if f.startswith("part-"))
+    return {"knn/dist.csv": _read(f"{d}/dist/part-r-00000"),
+            "knn/pred.csv": _read(os.path.join(d, "pred", pred))}
+
+
+def sa_flow(base):
+    d = os.path.join(base, "sa")
+    os.makedirs(d, exist_ok=True)
+    domain = os.path.join(d, "taskSched.json")
+    with open(domain, "w") as fh:
+        fh.write(json.dumps(_gen("task_sched_gen", 8, 5, 4)))
+    conf = os.path.join(d, "opt.conf")
+    src = _read(os.path.join(RES, "opt.conf"))
+    with open(conf, "w") as fh:
+        fh.write(src.replace('"taskSched.json"', f'"{domain}"')
+                 .replace("max.num.iterations = 2000",
+                          "max.num.iterations = 200"))
+    assert cli_run.main(["org.avenir.spark.optimize.SimulatedAnnealing",
+                         os.path.join(d, "out"), conf]) == 0
+    return {"sa/solutions.csv": _read(f"{d}/out/part-r-00000")}
+
+
+FLOWS = (nb_flow, dt_flow, rf_flow, knn_flow, sa_flow)
+
+
+def run_all(base):
+    out = {}
+    for flow in FLOWS:
+        out.update(flow(base))
+    return out

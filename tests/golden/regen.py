@@ -1,0 +1,41 @@
+"""Regenerate the golden byte fixtures (run from the repo root on the CPU
+test backend so fixtures match what CI compares against):
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python tests/golden/regen.py
+
+Commit the resulting fixtures/ diff together with the format change that
+motivated it.
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+
+if jax.config.jax_platforms != os.environ.get("JAX_PLATFORMS",
+                                              jax.config.jax_platforms):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import flows  # noqa: E402
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures")
+
+
+def main():
+    with tempfile.TemporaryDirectory() as td:
+        artifacts = flows.run_all(td)
+    for rel, text in sorted(artifacts.items()):
+        path = os.path.join(FIXTURES, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as fh:
+            fh.write(text)
+        print(f"wrote {rel} ({len(text)} bytes)")
+
+
+if __name__ == "__main__":
+    main()

@@ -84,6 +84,17 @@ def test_cli_distributed_mode_installs_hybrid_context(tmp_path, monkeypatch):
     train = tmp_path / "train.csv"
     train.write_text("\n".join(telecom_churn_gen.generate(512, 3)))
 
+    from avenir_tpu.cli import jobs as J
+    captured = {}
+    orig = J.JOBS["org.avenir.bayesian.BayesianDistribution"]
+
+    def spy(cfg, i, o):
+        captured["ctx"] = M.runtime_context()
+        return orig(cfg, i, o)
+
+    monkeypatch.setitem(J.JOBS, "org.avenir.bayesian.BayesianDistribution",
+                        spy)
+
     def run(extra, out):
         rc = cli_run.main([
             "org.avenir.bayesian.BayesianDistribution",
@@ -93,17 +104,15 @@ def test_cli_distributed_mode_installs_hybrid_context(tmp_path, monkeypatch):
         assert rc == 0
         return (tmp_path / out / "part-r-00000").read_text()
 
-    try:
-        default_model = run([], "m_default")
-        dist_model = run(["-Ddistributed.mode=1"], "m_dist")
-        # the distributed entry replaced the runtime context with one over
-        # the (hosts, data) hybrid mesh
-        ctx = M.runtime_context()
-        assert ctx.mesh.axis_names == ("hosts", "data")
-        assert ctx.n_devices == len(jax.devices())
-        assert dist_model == default_model
-    finally:
-        M.set_runtime_context(None)
+    default_model = run([], "m_default")
+    dist_model = run(["-Ddistributed.mode=1"], "m_dist")
+    # the job ran over the (hosts, data) hybrid mesh...
+    ctx = captured["ctx"]
+    assert ctx.mesh.axis_names == ("hosts", "data")
+    assert ctx.n_devices == len(jax.devices())
+    assert dist_model == default_model
+    # ...and main() reset the context afterwards (no leak into later runs)
+    assert M.runtime_context().mesh.axis_names != ("hosts", "data")
 
 
 def test_all_reduce_counters_single_process_identity():

@@ -1,0 +1,46 @@
+"""Byte-format pinning (VERDICT r2 #4): every golden-flow artifact must be
+BYTE-identical to its committed fixture — a delimiter, column-order, float
+-format, or JSON-layout drift fails here.  Regenerate deliberately with
+tests/golden/regen.py and commit the diff alongside the format change."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "golden"))
+
+import flows
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "golden", "fixtures")
+
+
+@pytest.fixture(scope="module")
+def artifacts(tmp_path_factory):
+    return flows.run_all(str(tmp_path_factory.mktemp("golden")))
+
+
+@pytest.mark.parametrize("flow_idx", range(len(flows.FLOWS)),
+                         ids=[f.__name__ for f in flows.FLOWS])
+def test_flow_bytes_match_fixtures(artifacts, flow_idx):
+    prefix = flows.FLOWS[flow_idx].__name__.split("_")[0]
+    rels = [r for r in artifacts if r.startswith(prefix + "/")]
+    assert rels, f"flow produced no artifacts under {prefix}/"
+    for rel in rels:
+        fixture = os.path.join(FIXTURES, rel)
+        assert os.path.exists(fixture), (
+            f"missing fixture {rel}; run tests/golden/regen.py and commit")
+        with open(fixture) as fh:
+            expect = fh.read()
+        assert artifacts[rel] == expect, (
+            f"{rel} differs from its committed fixture — byte format "
+            f"drifted; if intentional, regenerate via tests/golden/regen.py")
+
+
+def test_no_orphan_fixtures(artifacts):
+    on_disk = set()
+    for root, _, files in os.walk(FIXTURES):
+        for f in files:
+            on_disk.add(os.path.relpath(os.path.join(root, f), FIXTURES))
+    assert on_disk == set(artifacts), (
+        "fixtures/ and flow outputs disagree; run tests/golden/regen.py")
