@@ -281,6 +281,59 @@ def same_type_similarity(cfg: Config, in_path: str, out_path: str) -> Counters:
     return counters
 
 
+@register("org.avenir.spark.similarity.GroupedRecordSimilarity",
+          "groupedRecordSimilarity")
+def grouped_record_similarity(cfg: Config, in_path: str, out_path: str
+                              ) -> Counters:
+    """Per-group all-pairs record distance
+    (spark/.../similarity/GroupedRecordSimilarity.scala:34-103): records
+    grouped by grs.group.field.ordinals; within each group every unordered
+    pair (i < j) gets a mixed-type distance.  The reference's
+    groupByKey + per-group O(n^2) JVM loop becomes, per group, one tiled
+    device distance matrix (groups padded to power-of-two row counts so the
+    jitted kernel compiles O(log max-group) variants, not one per size).
+
+    Output: group..., firstId, secondId, distance."""
+    from ..ops.distance import DistanceComputer
+    counters = Counters()
+    schema = _schema_path(cfg, "sts.same.schema.file.path")
+    delim = cfg.field_delim_regex
+    od = cfg.field_delim_out
+    scale = cfg.get_int("sts.distance.scale", 1000)
+    metric = cfg.get("sts.distance.metric", "euclidean")
+    group_ords = [int(x) for x in cfg.must_get_list("grs.group.field.ordinals")]
+    from ..core.table import load_csv_text
+    lines = artifacts.read_text_input(in_path)
+    split_line = _splitter(delim)
+    groups: Dict[str, List[str]] = {}
+    for line in lines:
+        items = split_line(line)
+        groups.setdefault(od.join(items[o] for o in group_ords),
+                          []).append(line)
+    comp = DistanceComputer(schema, metric=metric, scale=scale)
+    id_ord = schema.id_fields[0].ordinal if schema.id_fields else 0
+    out_lines: List[str] = []
+    for gkey in sorted(groups):
+        glines = groups[gkey]
+        n = len(glines)
+        if n < 2:
+            continue
+        # pad to the next power of two: bounded compile count across groups
+        padded = 1 << (n - 1).bit_length()
+        table = load_csv_text(
+            "\n".join(glines + glines[:1] * (padded - n)), schema, delim)
+        dmat = comp.pairwise(table, table)[:n, :n]
+        ids = table.str_columns.get(id_ord, [str(i) for i in range(n)])
+        for i in range(n):
+            for j in range(i + 1, n):
+                out_lines.append(od.join(
+                    [gkey, ids[i], ids[j], str(int(dmat[i, j]))]))
+        counters.increment("Similarity", "Groups", 1)
+    counters.increment("Similarity", "Pairs", len(out_lines))
+    artifacts.write_text_output(out_path, out_lines)
+    return counters
+
+
 @register("org.avenir.knn.KnnPipeline", "knnPipeline", "knnInProcess")
 def knn_pipeline(cfg: Config, in_path: str, out_path: str) -> Counters:
     """The whole knn.sh pipeline fused in process: tiled device

@@ -75,6 +75,9 @@ def simulated_annealing_job(cfg: Config, in_path: str, out_path: str) -> Counter
         cooling_rate_geometric=cfg.get_boolean("cooling.rate.geometric", True),
         temp_update_interval=cfg.get_int("temp.update.interval", 2),
         max_step_size=cfg.get_int("max.step.size", 1),
+        step_size_strategy=cfg.get("step.size.strategy", "constant"),
+        step_size_mean=cfg.get_float("step.size.mean", 1.0),
+        step_size_std_dev=cfg.get_float("step.size.std.dev", 1.0),
         locally_optimize=cfg.get_boolean("locally.optimize", False),
         max_num_local_iterations=cfg.get_int("max.num.local.iterations", 50),
         seed=cfg.get_int("random.seed", 0),

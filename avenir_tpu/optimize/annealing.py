@@ -28,7 +28,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .domain import SearchDomain
+from .domain import SearchDomain, StepSize
 from ..parallel.mesh import MeshContext, runtime_context
 
 
@@ -42,6 +42,11 @@ class AnnealingParams:
     cooling_rate_geometric: bool = True
     temp_update_interval: int = 2
     max_step_size: int = 1
+    # neighborhood step-size strategy (optimize/StepSize.java:28-101):
+    # constant | uniform | gaussian — how many components one move replaces
+    step_size_strategy: str = "constant"
+    step_size_mean: float = 1.0
+    step_size_std_dev: float = 1.0
     locally_optimize: bool = False
     max_num_local_iterations: int = 50
     seed: int = 0
@@ -71,14 +76,27 @@ def simulated_annealing(domain: SearchDomain, params: AnnealingParams,
     if cur.shape[0] % ctx.n_devices == 0:
         cur = ctx.shard_rows(cur)
     key = jax.random.PRNGKey(params.seed)
+    step_size = StepSize(max_step_size=params.max_step_size,
+                         strategy=params.step_size_strategy,
+                         mean=params.step_size_mean,
+                         std_dev=params.step_size_std_dev)
 
     cur_cost = domain.cost_batch(cur)
 
     def step(carry, i):
         (cur, cur_cost, best, best_cost, temp, upd_counter, key,
          n_better, n_best, n_worse, n_accept, cost_inc) = carry
-        key, k_mut, k_acc = jax.random.split(key, 3)
-        nxt = domain.mutate(k_mut, cur, params.max_step_size)
+        # the constant (default) strategy draws no step key, so its RNG
+        # stream — and the golden SA fixture — is unchanged by the
+        # StepSize feature
+        if step_size.strategy != "constant":
+            key, k_mut, k_step, k_acc = jax.random.split(key, 4)
+            steps = step_size.sample(k_step, cur.shape[0])
+        else:
+            key, k_mut, k_acc = jax.random.split(key, 3)
+            steps = None
+        nxt = domain.mutate(k_mut, cur, params.max_step_size,
+                            step_sizes=steps)
         nxt_cost = domain.cost_batch(nxt)
 
         better = nxt_cost < cur_cost

@@ -29,6 +29,35 @@ import jax
 import jax.numpy as jnp
 
 
+@dataclass
+class StepSize:
+    """Neighborhood step-size sampling strategies
+    (optimize/StepSize.java:28-101): how many solution components one
+    neighborhood move replaces.  constant -> always max; uniform ->
+    U[1, max]; gaussian -> round(N(mean, std)) clipped to [1, max].
+
+    Reference bug noted: StepSize.java:93-97 tests ``Strategy.Constant``
+    twice, so its Uniform branch is dead and Gaussian falls through to 1;
+    we implement the strategies the API names intend."""
+
+    max_step_size: int = 1
+    strategy: str = "constant"        # constant | uniform | gaussian
+    mean: float = 1.0
+    std_dev: float = 1.0
+
+    def sample(self, key, k: int) -> jnp.ndarray:
+        """(k,) int32 per-solution step sizes in [1, max_step_size]."""
+        if self.strategy == "constant":
+            return jnp.full((k,), self.max_step_size, dtype=jnp.int32)
+        if self.strategy == "uniform":
+            return jax.random.randint(key, (k,), 1, self.max_step_size + 1)
+        if self.strategy == "gaussian":
+            s = self.mean + self.std_dev * jax.random.normal(key, (k,))
+            return jnp.clip(jnp.round(s), 1,
+                            self.max_step_size).astype(jnp.int32)
+        raise ValueError(f"unknown step-size strategy {self.strategy!r}")
+
+
 class SearchDomain:
     """Base class: subclasses define n_components, n_choices and cost."""
 
@@ -49,15 +78,23 @@ class SearchDomain:
 
     # ---- generic neighborhood / crossover (jit-traceable) ----
     def mutate(self, key, solutions: jnp.ndarray,
-               n_mutations: int = 1) -> jnp.ndarray:
-        """Replace n random components with random choices per solution."""
+               n_mutations: int = 1,
+               step_sizes: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        """Replace random components with random choices per solution
+        (createNeighborhoodSolution).  ``n_mutations`` is the static upper
+        bound; ``step_sizes`` (k,) optionally varies the count per solution
+        (StepSize strategies) — mutation m applies only where
+        step_sizes > m."""
         k, L = solutions.shape
         out = solutions
         for m in range(n_mutations):
             key, k1, k2 = jax.random.split(key, 3)
             pos = jax.random.randint(k1, (k,), 0, L)
             val = jax.random.randint(k2, (k,), 0, self.n_choices)
-            out = out.at[jnp.arange(k), pos].set(val.astype(out.dtype))
+            nxt = out.at[jnp.arange(k), pos].set(val.astype(out.dtype))
+            if step_sizes is not None:
+                nxt = jnp.where((step_sizes > m)[:, None], nxt, out)
+            out = nxt
         return out
 
     def crossover(self, key, parents_a: jnp.ndarray,

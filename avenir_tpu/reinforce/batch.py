@@ -90,33 +90,87 @@ class GroupedBandits:
 
 
 class VectorBandits:
-    """Device-vectorized bandits over (groups, actions) state arrays.
+    """Device-vectorized bandits over (groups, actions) state arrays —
+    ALL 11 factory algorithms (MultiArmBanditLearnerFactory.java:30-41).
+    One jitted call selects an action for every group simultaneously; the
+    stateful algorithms (ucb2 epochs, pursuit probabilities, exp3/exp4
+    weights, rewardComparison preferences) carry their extra state as
+    (G, A)/(G, E) arrays updated by the same call or by ``set_rewards``.
 
-    Supported algorithms (the ones whose selection is a pure array op):
-    randomGreedy (epsilon-greedy), ucb1, softMax, sampsonSampler (gaussian
-    Thompson), intervalEstimator.  One jitted call selects an action for
-    every group simultaneously.
+    This is the scale path (the reference's per-group JVM loops become one
+    array program); it shares algorithm structure, not RNG streams, with
+    the scalar ``learners`` module.  Reward updates that are order
+    -sensitive within a batch (rewardComparison's moving reference, exp3/
+    exp4's importance weights) are applied in event order on host —
+    selection is the per-round hot path, updates are O(batch).
     """
+
+    ALGORITHMS = ("randomGreedy", "ucb1", "ucb2", "softMax",
+                  "sampsonSampler", "optimisticSampsonSampler",
+                  "intervalEstimator", "actionPursuit", "rewardComparison",
+                  "exponentialWeight", "exponentialWeightExpert")
 
     def __init__(self, algorithm: str, n_groups: int, n_actions: int,
                  config: Optional[Dict] = None, seed: int = 0):
+        if algorithm not in self.ALGORITHMS:
+            raise ValueError(f"unknown bandit algorithm {algorithm!r}; "
+                             f"known: {sorted(self.ALGORITHMS)}")
         self.algorithm = algorithm
         cfg = config or {}
-        self.G, self.A = n_groups, n_actions
-        self.counts = np.zeros((n_groups, n_actions), dtype=np.float32)
-        self.sums = np.zeros((n_groups, n_actions), dtype=np.float32)
-        self.sum_sqs = np.zeros((n_groups, n_actions), dtype=np.float32)
+        self.G, self.A = G, A = n_groups, n_actions
+        self.counts = np.zeros((G, A), dtype=np.float32)
+        self.sums = np.zeros((G, A), dtype=np.float32)
+        self.sum_sqs = np.zeros((G, A), dtype=np.float32)
         self.epsilon = float(cfg.get("random.selection.prob", 0.1))
         self.temp = float(cfg.get("temp.constant", 0.1))
         self.bias = float(cfg.get("confidence.factor", 2.0))
+        self.alpha = float(cfg.get("alpha", 0.1))
+        self.learning_rate = float(cfg.get("learning.rate", 0.05))
+        self.pref_step = float(cfg.get("preference.step", 0.1))
+        self.ref_step = float(cfg.get("reference.reward.step", 0.1))
+        self.distr_constant = float(cfg.get("distr.constant", 0.1))
+        # per-algorithm extra state
+        if algorithm == "ucb2":
+            self.epochs = np.zeros((G, A), dtype=np.float32)
+            self.remaining = np.zeros((G,), dtype=np.float32)
+            self.current = np.zeros((G,), dtype=np.int32)
+        elif algorithm == "actionPursuit":
+            self.probs = np.full((G, A), 1.0 / A, dtype=np.float32)
+        elif algorithm == "rewardComparison":
+            self.prefs = np.zeros((G, A), dtype=np.float32)
+            self.ref_reward = np.full(
+                (G,), float(cfg.get("initial.reference.reward", 0.0)),
+                dtype=np.float32)
+        elif algorithm == "exponentialWeight":
+            self.weights = np.ones((G, A), dtype=np.float32)
+            self.last_probs = np.full((G, A), 1.0 / A, dtype=np.float32)
+        elif algorithm == "exponentialWeightExpert":
+            experts = cfg.get("experts")
+            if experts is None:  # same default panel as the scalar learner
+                experts = [[1.0 / A] * A]
+                experts += [[1.0 if j == i else 0.0 for j in range(A)]
+                            for i in range(A)]
+            self.experts = np.asarray(experts, dtype=np.float32)   # (E, A)
+            self.expert_weights = np.ones((G, self.experts.shape[0]),
+                                          dtype=np.float32)
+            self.last_probs = np.full((G, A), 1.0 / A, dtype=np.float32)
         self.key = jax.random.PRNGKey(seed)
         self._select = jax.jit(self._make_select())
 
     def _make_select(self):
         algo = self.algorithm
         eps, temp, bias = self.epsilon, self.temp, self.bias
+        alpha, lr, g = self.alpha, self.learning_rate, self.distr_constant
 
-        def select(key, counts, sums, sum_sqs):
+        def posterior_sample(key, counts, sums, sum_sqs):
+            mean = sums / jnp.maximum(counts, 1.0)
+            var = (sum_sqs - counts * mean * mean) / \
+                jnp.maximum(counts - 1.0, 1.0)
+            sd = jnp.sqrt(jnp.maximum(var, 1e-12))
+            z = jax.random.normal(key, counts.shape)
+            return mean, mean + z * sd / jnp.sqrt(jnp.maximum(counts, 1.0))
+
+        def select(key, counts, sums, sum_sqs, extra):
             mean = sums / jnp.maximum(counts, 1.0)
             untried = counts == 0
             if algo == "randomGreedy":
@@ -125,41 +179,146 @@ class VectorBandits:
                 rand = jax.random.randint(k1, (counts.shape[0],), 0,
                                           counts.shape[1])
                 explore = jax.random.uniform(k2, (counts.shape[0],)) < eps
-                return jnp.where(explore, rand, greedy)
+                return jnp.where(explore, rand, greedy), ()
             if algo == "ucb1":
                 N = jnp.maximum(counts.sum(axis=1, keepdims=True), 1.0)
                 ub = mean + jnp.sqrt(2.0 * jnp.log(N) /
                                      jnp.maximum(counts, 1.0))
-                return jnp.argmax(jnp.where(untried, jnp.inf, ub), axis=1)
+                return jnp.argmax(jnp.where(untried, jnp.inf, ub), axis=1), ()
+            if algo == "ucb2":
+                # epoch-committed UCB (UpperConfidenceBoundTwoLearner):
+                # while remaining > 0 replay the committed arm; else pick by
+                # the (1+a) bonus and commit for tau(r+1)-tau(r)-1 rounds
+                epochs, remaining, current = extra
+                tau = jnp.ceil((1 + alpha) ** epochs)
+                N = jnp.maximum(counts.sum(axis=1, keepdims=True), 2.0)
+                bonus = jnp.sqrt((1 + alpha) *
+                                 jnp.log(jnp.e * N / tau) / (2.0 * tau))
+                ub = jnp.where(untried, jnp.inf, mean + bonus)
+                best = jnp.argmax(ub, axis=1).astype(jnp.int32)
+                sticky = remaining > 0
+                action = jnp.where(sticky, current, best)
+                r_best = jnp.take_along_axis(
+                    epochs, best[:, None], axis=1)[:, 0]
+                span = jnp.ceil((1 + alpha) ** (r_best + 1)) - \
+                    jnp.ceil((1 + alpha) ** r_best) - 1.0
+                new_remaining = jnp.where(sticky, remaining - 1.0,
+                                          jnp.maximum(span, 0.0))
+                bump = jax.nn.one_hot(best, counts.shape[1],
+                                      dtype=jnp.float32) * \
+                    (~sticky)[:, None].astype(jnp.float32)
+                return action, (epochs + bump, new_remaining,
+                                action.astype(jnp.int32))
             if algo == "softMax":
-                logits = mean / temp
-                return jax.random.categorical(key, logits, axis=1)
-            if algo == "sampsonSampler":
-                var = (sum_sqs - counts * mean * mean) / \
-                    jnp.maximum(counts - 1.0, 1.0)
-                sd = jnp.sqrt(jnp.maximum(var, 1e-12))
-                z = jax.random.normal(key, counts.shape)
-                sample = mean + z * sd / jnp.sqrt(jnp.maximum(counts, 1.0))
-                return jnp.argmax(jnp.where(untried, jnp.inf, sample), axis=1)
+                return jax.random.categorical(key, mean / temp, axis=1), ()
+            if algo in ("sampsonSampler", "optimisticSampsonSampler"):
+                mean, sample = posterior_sample(key, counts, sums, sum_sqs)
+                if algo == "optimisticSampsonSampler":
+                    sample = jnp.maximum(sample, mean)  # floored at the mean
+                return jnp.argmax(jnp.where(untried, jnp.inf, sample),
+                                  axis=1), ()
             if algo == "intervalEstimator":
                 var = (sum_sqs - counts * mean * mean) / \
                     jnp.maximum(counts - 1.0, 1.0)
                 sd = jnp.sqrt(jnp.maximum(var, 0.0))
                 ub = mean + bias * sd / jnp.sqrt(jnp.maximum(counts, 1.0))
-                return jnp.argmax(jnp.where(untried, jnp.inf, ub), axis=1)
+                return jnp.argmax(jnp.where(untried, jnp.inf, ub), axis=1), ()
+            if algo == "actionPursuit":
+                # pursue the greedy arm toward probability 1, then sample
+                (probs,) = extra
+                greedy = jnp.argmax(jnp.where(untried, jnp.inf, mean), axis=1)
+                oh = jax.nn.one_hot(greedy, counts.shape[1],
+                                    dtype=jnp.float32)
+                new_probs = probs + lr * (oh - probs)
+                action = jax.random.categorical(
+                    key, jnp.log(jnp.maximum(new_probs, 1e-30)), axis=1)
+                return action, (new_probs,)
+            if algo == "rewardComparison":
+                # softmax over preferences (prefs updated in set_rewards)
+                (prefs,) = extra
+                return jax.random.categorical(
+                    key, jnp.minimum(prefs, 700.0), axis=1), ()
+            if algo == "exponentialWeight":
+                (weights,) = extra
+                sw = weights.sum(axis=1, keepdims=True)
+                K = counts.shape[1]
+                probs = (1 - g) * weights / sw + g / K
+                action = jax.random.categorical(key, jnp.log(probs), axis=1)
+                return action, (probs,)
+            if algo == "exponentialWeightExpert":
+                expert_weights, experts = extra
+                sw = expert_weights.sum(axis=1, keepdims=True)
+                mixed = (expert_weights / sw) @ experts          # (G, A)
+                K = counts.shape[1]
+                probs = (1 - g) * mixed + g / K
+                action = jax.random.categorical(key, jnp.log(probs), axis=1)
+                return action, (probs,)
             raise ValueError(f"algorithm {algo!r} has no vectorized form")
 
         return select
 
+    def _extra(self):
+        a = self.algorithm
+        if a == "ucb2":
+            return (jnp.asarray(self.epochs), jnp.asarray(self.remaining),
+                    jnp.asarray(self.current))
+        if a == "actionPursuit":
+            return (jnp.asarray(self.probs),)
+        if a == "rewardComparison":
+            return (jnp.asarray(self.prefs),)
+        if a == "exponentialWeight":
+            return (jnp.asarray(self.weights),)
+        if a == "exponentialWeightExpert":
+            return (jnp.asarray(self.expert_weights),
+                    jnp.asarray(self.experts))
+        return ()
+
     def next_actions(self) -> np.ndarray:
         """(G,) action indices for every group."""
         self.key, sub = jax.random.split(self.key)
-        return np.asarray(self._select(sub, jnp.asarray(self.counts),
-                                       jnp.asarray(self.sums),
-                                       jnp.asarray(self.sum_sqs)))
+        action, new_extra = self._select(
+            sub, jnp.asarray(self.counts), jnp.asarray(self.sums),
+            jnp.asarray(self.sum_sqs), self._extra())
+        a = self.algorithm
+        if a == "ucb2":
+            self.epochs, self.remaining, self.current = \
+                (np.asarray(x) for x in new_extra)
+        elif a == "actionPursuit":
+            self.probs = np.asarray(new_extra[0])
+        elif a in ("exponentialWeight", "exponentialWeightExpert"):
+            self.last_probs = np.asarray(new_extra[0])
+        return np.asarray(action)
 
     def set_rewards(self, group_idx: np.ndarray, action_idx: np.ndarray,
                     rewards: np.ndarray) -> None:
         np.add.at(self.counts, (group_idx, action_idx), 1.0)
         np.add.at(self.sums, (group_idx, action_idx), rewards)
         np.add.at(self.sum_sqs, (group_idx, action_idx), rewards ** 2)
+        a = self.algorithm
+        if a == "rewardComparison":
+            # moving reference: order within the batch matters, like the
+            # scalar learner's per-event updates
+            for gi, ai, r in zip(group_idx, action_idx, rewards):
+                delta = r - self.ref_reward[gi]
+                self.prefs[gi, ai] += self.pref_step * delta
+                self.ref_reward[gi] += self.ref_step * delta
+        elif a == "exponentialWeight":
+            g, K = self.distr_constant, self.A
+            for gi, ai, r in zip(group_idx, action_idx, rewards):
+                p = max(float(self.last_probs[gi, ai]), 1e-12)
+                self.weights[gi, ai] *= np.exp(min(g * (r / p) / K, 60.0))
+            # EXP3 sampling is invariant under per-group weight scaling;
+            # renormalize so f32 weights can never overflow to inf over a
+            # long serving run (they otherwise hit inf in ~2.5k rounds)
+            self.weights /= np.maximum(
+                self.weights.max(axis=1, keepdims=True), 1e-30)
+        elif a == "exponentialWeightExpert":
+            g, K = self.distr_constant, self.A
+            for gi, ai, r in zip(group_idx, action_idx, rewards):
+                p = max(float(self.last_probs[gi, ai]), 1e-12)
+                xhat = r / p
+                yhat = self.experts[:, ai] * xhat                # (E,)
+                self.expert_weights[gi] *= np.exp(
+                    np.minimum(g * yhat / K, 60.0))
+            self.expert_weights /= np.maximum(
+                self.expert_weights.max(axis=1, keepdims=True), 1e-30)

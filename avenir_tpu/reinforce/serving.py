@@ -18,6 +18,8 @@ import queue
 import threading
 from typing import Dict, Optional, Sequence
 
+import numpy as np
+
 from .learners import MultiArmBanditLearner, create_learner
 
 
@@ -60,3 +62,46 @@ class ReinforcementLearnerService:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=2)
+
+
+class VectorLearnerService:
+    """Many-group real-time serving over the device-vectorized path: where
+    the reference topology distributes one bolt per learner across Storm
+    workers, one instance here serves EVERY group per round message with a
+    single jitted selection (reinforce/batch.VectorBandits, all 11
+    algorithms).  Action names map through ``actions`` like the scalar
+    service.
+
+    Messages:
+      event:  'round,<roundNum>' -> one '<roundNum>,<group>,<action>' line
+              per group on the action queue (returned joined by newlines)
+      reward: 'reward,<groupIdx>,<action>,<value>'
+    """
+
+    def __init__(self, algorithm: str, actions: Sequence[str],
+                 n_groups: int, config: Optional[Dict] = None,
+                 seed: int = 0):
+        from .batch import VectorBandits
+        self.actions = list(actions)
+        self.bandits = VectorBandits(algorithm, n_groups, len(self.actions),
+                                     config, seed=seed)
+        self.action_queue: "queue.Queue[str]" = queue.Queue()
+        self.delim = ","
+
+    def process(self, message: str) -> Optional[str]:
+        parts = message.split(self.delim)
+        if parts[0] == "round":
+            acts = self.bandits.next_actions()
+            lines = [self.delim.join([parts[1], str(g), self.actions[a]])
+                     for g, a in enumerate(acts)]
+            out = "\n".join(lines)
+            for line in lines:
+                self.action_queue.put(line)
+            return out
+        if parts[0] == "reward":
+            g = np.array([int(parts[1])])
+            a = np.array([self.actions.index(parts[2])])
+            r = np.array([float(parts[3])], dtype=np.float32)
+            self.bandits.set_rewards(g, a, r)
+            return None
+        raise ValueError(f"unknown message type {parts[0]!r}")

@@ -66,6 +66,10 @@ EXPECTED = {
     "org.avenir.spark.markov.ContTimeStateTransitionStats":
         "cont_time_state_transition_stats",
     "org.avenir.spark.optimize.GeneticAlgorithm": "genetic_algorithm_job",
+    "org.avenir.spark.sequence.EventTimeDistribution":
+        "event_time_distribution",
+    "org.avenir.spark.similarity.GroupedRecordSimilarity":
+        "grouped_record_similarity",
     "org.avenir.spark.optimize.SimulatedAnnealing": "simulated_annealing_job",
     "org.avenir.spark.reinforce.MultiArmBandit": "multi_arm_bandit",
     "org.avenir.supv.NeuralNetworkPredictor": "neural_network_predictor",

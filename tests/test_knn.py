@@ -342,3 +342,46 @@ def test_knn_in_process_intra_set_excludes_self(tmp_path):
     # self-exclusion: with clean clusters, leave-one-out accuracy stays high
     acc = np.mean([l.split(",")[2] == l.split(",")[1] for l in lines])
     assert acc > 0.9
+
+
+def test_grouped_record_similarity(tmp_path):
+    """Per-group all-pairs distance (GroupedRecordSimilarity.scala parity):
+    pairs only within a group, distances equal the ungrouped computer's."""
+    rows = two_cluster_rows(12, seed=7)
+    # group column appended as ordinal 5? schema only knows 0-4; group by
+    # the color column (ordinal 3) instead — two groups, red/green
+    f = tmp_path / "recs.csv"
+    f.write_text("\n".join(",".join(r) for r in rows))
+    schema_path = tmp_path / "s.json"
+    schema_path.write_text(json.dumps({"fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "x", "ordinal": 1, "dataType": "double", "feature": True,
+         "min": 0, "max": 10},
+        {"name": "y", "ordinal": 2, "dataType": "double", "feature": True,
+         "min": 0, "max": 10},
+        {"name": "color", "ordinal": 3, "dataType": "categorical",
+         "feature": True, "cardinality": ["red", "green"]},
+        {"name": "label", "ordinal": 4, "dataType": "categorical",
+         "cardinality": ["A", "B"]}]}))
+    props = tmp_path / "p.properties"
+    props.write_text(f"sts.same.schema.file.path={schema_path}\n"
+                     "grs.group.field.ordinals=3\n")
+    rc = cli_run.main(["groupedRecordSimilarity", f"-Dconf.path={props}",
+                       str(f), str(tmp_path / "out")])
+    assert rc == 0
+    lines = (tmp_path / "out" / "part-r-00000").read_text().splitlines()
+    # 6 reds + 6 greens -> 2 * C(6,2) = 30 pairs, none cross-group
+    assert len(lines) == 30
+    by_group = {}
+    for l in lines:
+        g, a, b, d = l.split(",")
+        by_group.setdefault(g, []).append((a, b, int(d)))
+    assert set(by_group) == {"red", "green"}
+    # distances match the ungrouped computer on the same records
+    table = encode_rows(rows, SCHEMA)
+    comp = DistanceComputer(SCHEMA, scale=1000)
+    full = comp.pairwise(table, table)
+    ids = {f"e{i}": i for i in range(12)}
+    for g, pairs in by_group.items():
+        for a, b, d in pairs:
+            assert d == int(full[ids[a], ids[b]])

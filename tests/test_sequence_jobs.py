@@ -103,3 +103,55 @@ def test_pst_and_gsp_jobs(tmp_path):
     assert rc == 0
     cands = (tmp_path / "cand" / "part-r-00000").read_text().splitlines()
     assert "a,b,c" in cands and "b,c,a" in cands and "c,a,b" in cands
+
+
+def test_event_time_distribution(tmp_path):
+    """Per-key event-time histograms (EventTimeDistribution.scala parity)."""
+    from avenir_tpu.cli import run as cli_run
+    MS_H = 3600 * 1000
+    lines = []
+    # user u1: two events at hour 3, one at hour 20; u2: one at hour 3
+    for uid, hour in [("u1", 3), ("u1", 3), ("u1", 20), ("u2", 3)]:
+        ts = 5 * 24 * MS_H * 7 + hour * MS_H + 123  # arbitrary whole days
+        lines.append(f"{uid},evt,{ts}")
+    f = tmp_path / "events.csv"
+    f.write_text("\n".join(lines))
+    props = tmp_path / "p.properties"
+    props.write_text("id.field.ordinals=0\ntime.field.ordinal=2\n"
+                     "time.resolution=hourOfDay\n")
+    rc = cli_run.main(["eventTimeDistribution", f"-Dconf.path={props}",
+                       str(f), str(tmp_path / "out")])
+    assert rc == 0
+    out = dict(l.split(",", 1) for l in
+               (tmp_path / "out" / "part-r-00000").read_text().splitlines())
+    assert out["u1"] == "3:2,20:1"
+    assert out["u2"] == "3:1"
+
+
+def test_event_time_distribution_day_of_week_and_granularity(tmp_path):
+    from avenir_tpu.cli import run as cli_run
+    MS_H = 3600 * 1000
+    MS_D = 24 * MS_H
+    f = tmp_path / "events.csv"
+    # days 1, 1, 6 of the epoch week
+    f.write_text("\n".join([f"k,{1 * MS_D + 5}", f"k,{1 * MS_D + 9}",
+                            f"k,{6 * MS_D + 1}"]))
+    props = tmp_path / "p.properties"
+    props.write_text("id.field.ordinals=0\ntime.field.ordinal=1\n"
+                     "time.resolution=dayOfWeek\n")
+    rc = cli_run.main(["eventTimeDistribution", f"-Dconf.path={props}",
+                       str(f), str(tmp_path / "out")])
+    assert rc == 0
+    line = (tmp_path / "out" / "part-r-00000").read_text().strip()
+    assert line == "k,1:2,6:1"
+    # hour granularity: hours 3 and 5 fold into bin 1 at granularity 4
+    f2 = tmp_path / "e2.csv"
+    f2.write_text("\n".join([f"k,{3 * MS_H}", f"k,{5 * MS_H}"]))
+    props2 = tmp_path / "p2.properties"
+    props2.write_text("id.field.ordinals=0\ntime.field.ordinal=1\n"
+                      "time.resolution=hourOfDay\nhour.granularity=4\n")
+    rc = cli_run.main(["eventTimeDistribution", f"-Dconf.path={props2}",
+                       str(f2), str(tmp_path / "out2")])
+    assert rc == 0
+    line = (tmp_path / "out2" / "part-r-00000").read_text().strip()
+    assert line == "k,0:1,1:1"
