@@ -394,12 +394,20 @@ def event_time_distribution(cfg: Config, in_path: str, out_path: str
         artifacts.write_text_output(out_path, [])
         return counters
     n_bins = max(cycles) + 1
-    onehot_bins = np.zeros((len(cycles), n_bins), dtype=np.float32)
-    onehot_bins[np.arange(len(cycles)), cycles] = 1.0
-    hist = np.asarray(keyed_reduce(jnp.asarray(onehot_bins),
-                                   jnp.asarray(np.array(key_codes,
-                                                        dtype=np.int32)),
-                                   len(keys)))                 # (K, n_bins)
+    # tile events through the keyed_reduce so the (chunk, n_bins) one-hot
+    # stays bounded regardless of event count (a 10M-event input would
+    # otherwise materialize ~GB of dense one-hot at once)
+    key_arr = np.asarray(key_codes, dtype=np.int32)
+    cyc_arr = np.asarray(cycles, dtype=np.int64)
+    hist = np.zeros((len(keys), n_bins), dtype=np.float64)
+    chunk = max((1 << 22) // max(n_bins, 1), 1024)
+    for s in range(0, len(cyc_arr), chunk):
+        e = min(s + chunk, len(cyc_arr))
+        onehot = np.zeros((e - s, n_bins), dtype=np.float32)
+        onehot[np.arange(e - s), cyc_arr[s:e]] = 1.0
+        hist += np.asarray(keyed_reduce(jnp.asarray(onehot),
+                                        jnp.asarray(key_arr[s:e]),
+                                        len(keys)))            # (K, n_bins)
     out_lines = []
     for ki, key in enumerate(keys):
         bins = [f"{b}:{int(hist[ki, b])}" for b in range(n_bins)

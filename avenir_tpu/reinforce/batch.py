@@ -134,6 +134,10 @@ class VectorBandits:
             self.epochs = np.zeros((G, A), dtype=np.float32)
             self.remaining = np.zeros((G,), dtype=np.float32)
             self.current = np.zeros((G,), dtype=np.int32)
+            # per-group trial counter: like the scalar learner's
+            # total_trial_count, N grows with SELECTIONS, not rewards, so
+            # tau can never outrun e*N under delayed feedback
+            self.trials = np.zeros((G,), dtype=np.float32)
         elif algorithm == "actionPursuit":
             self.probs = np.full((G, A), 1.0 / A, dtype=np.float32)
         elif algorithm == "rewardComparison":
@@ -188,12 +192,16 @@ class VectorBandits:
             if algo == "ucb2":
                 # epoch-committed UCB (UpperConfidenceBoundTwoLearner):
                 # while remaining > 0 replay the committed arm; else pick by
-                # the (1+a) bonus and commit for tau(r+1)-tau(r)-1 rounds
-                epochs, remaining, current = extra
+                # the (1+a) bonus and commit for tau(r+1)-tau(r)-1 rounds.
+                # N counts SELECTIONS (the scalar learner's
+                # total_trial_count) and the log argument is clamped >= 1,
+                # so delayed rewards can never drive the bonus NaN.
+                epochs, remaining, current, trials = extra
                 tau = jnp.ceil((1 + alpha) ** epochs)
-                N = jnp.maximum(counts.sum(axis=1, keepdims=True), 2.0)
+                N = jnp.maximum(trials, 2.0)[:, None]
                 bonus = jnp.sqrt((1 + alpha) *
-                                 jnp.log(jnp.e * N / tau) / (2.0 * tau))
+                                 jnp.log(jnp.maximum(jnp.e * N / tau, 1.0))
+                                 / (2.0 * tau))
                 ub = jnp.where(untried, jnp.inf, mean + bonus)
                 best = jnp.argmax(ub, axis=1).astype(jnp.int32)
                 sticky = remaining > 0
@@ -208,7 +216,7 @@ class VectorBandits:
                                       dtype=jnp.float32) * \
                     (~sticky)[:, None].astype(jnp.float32)
                 return action, (epochs + bump, new_remaining,
-                                action.astype(jnp.int32))
+                                action.astype(jnp.int32), trials + 1.0)
             if algo == "softMax":
                 return jax.random.categorical(key, mean / temp, axis=1), ()
             if algo in ("sampsonSampler", "optimisticSampsonSampler"):
@@ -261,7 +269,7 @@ class VectorBandits:
         a = self.algorithm
         if a == "ucb2":
             return (jnp.asarray(self.epochs), jnp.asarray(self.remaining),
-                    jnp.asarray(self.current))
+                    jnp.asarray(self.current), jnp.asarray(self.trials))
         if a == "actionPursuit":
             return (jnp.asarray(self.probs),)
         if a == "rewardComparison":
@@ -281,7 +289,7 @@ class VectorBandits:
             jnp.asarray(self.sum_sqs), self._extra())
         a = self.algorithm
         if a == "ucb2":
-            self.epochs, self.remaining, self.current = \
+            self.epochs, self.remaining, self.current, self.trials = \
                 (np.asarray(x) for x in new_extra)
         elif a == "actionPursuit":
             self.probs = np.asarray(new_extra[0])

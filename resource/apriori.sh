@@ -1,0 +1,35 @@
+#!/bin/bash
+# Apriori driver: level-wise frequent itemsets, then rule mining.
+#   ./apriori.sh mine <xactions.csv> <out_dir> <total_trans> [max_len]
+set -e
+DIR=$(cd "$(dirname "$0")" && pwd)
+RUN="python -m avenir_tpu.cli.run"
+PROPS="$DIR/apriori.properties"
+IN="$2"; OUT="$3"; TOTAL="$4"; MAXLEN="${5:-2}"
+
+case "$1" in
+mine)
+  mkdir -p "$OUT/rules_in"
+  : > "$OUT/rules_in/part-r-00000"
+  for LEN in $(seq 1 $MAXLEN); do
+    ARGS="-Dfia.item.set.length=$LEN -Dfia.total.tans.count=$TOTAL"
+    if [ $LEN -gt 1 ]; then
+      # levels > 1 read the previous level's itemsets (trans-id mode)
+      ARGS="$ARGS -Dfia.item.set.file.path=$OUT/level_$((LEN-1))/part-r-00000"
+    fi
+    # trans-id mode output feeds the next level...
+    $RUN org.avenir.association.FrequentItemsApriori -Dconf.path=$PROPS \
+        $ARGS -Dfia.trans.id.output=true "$IN" "$OUT/level_$LEN"
+    # ...and the items,support form of EVERY level feeds the rule miner
+    # (antecedent supports are the confidence denominators)
+    $RUN org.avenir.association.FrequentItemsApriori -Dconf.path=$PROPS \
+        $ARGS "$IN" "$OUT/freq_$LEN"
+    cat "$OUT/freq_$LEN/part-r-00000" >> "$OUT/rules_in/part-r-00000"
+  done
+  $RUN org.avenir.association.AssociationRuleMiner -Dconf.path=$PROPS \
+      "$OUT/rules_in" "$OUT/rules"
+  ;;
+*)
+  echo "usage: $0 mine <xactions.csv> <out_dir> <total_trans> [max_len]" >&2
+  exit 2 ;;
+esac

@@ -1,0 +1,18 @@
+#!/bin/bash
+# Batch bandit driver: each invocation is one decisioning round —
+# apply the round's reward feedback, emit per-group actions, save state.
+#   ./bandit.sh round <rewards.csv> <out_dir>   (STATE_IN= STATE_OUT= override)
+set -e
+DIR=$(cd "$(dirname "$0")" && pwd)
+RUN="python -m avenir_tpu.cli.run"
+PROPS="$DIR/bandit.properties"
+
+case "$1" in
+round)
+  $RUN org.avenir.spark.reinforce.MultiArmBandit -Dconf.path=$PROPS \
+      ${STATE_IN:+-Dmab.model.state.file.in=$STATE_IN} \
+      ${STATE_OUT:+-Dmab.model.state.file.out=$STATE_OUT} "$2" "$3"
+  ;;
+*)
+  echo "usage: $0 round <rewards.csv> <out_dir>" >&2; exit 2 ;;
+esac

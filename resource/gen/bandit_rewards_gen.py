@@ -1,0 +1,38 @@
+#!/usr/bin/env python3
+"""Synthetic per-group reward feedback for the bandit use case: each ad
+placement group has a hidden best creative paying ~0.8; others pay ~0.2.
+The best arms are fixed by arm_seed (independent of the per-round seed),
+so multi-round flows reward consistent arms.
+Line: group,action,reward
+Usage: bandit_rewards_gen.py <n_rows> [seed] [n_groups] > rewards.csv
+"""
+
+import sys
+
+import numpy as np
+
+ACTIONS = ["creativeA", "creativeB", "creativeC", "creativeD"]
+
+
+def generate(n: int, seed: int = 1, n_groups: int = 4, arm_seed: int = 0):
+    """seed varies the event noise per round; arm_seed fixes the hidden
+    best arms so successive rounds reward the SAME arms."""
+    arm_rng = np.random.default_rng(arm_seed)
+    best = {f"g{g}": int(arm_rng.integers(0, len(ACTIONS)))
+            for g in range(n_groups)}
+    rng = np.random.default_rng(seed)
+    rows = []
+    for _ in range(n):
+        g = f"g{rng.integers(0, n_groups)}"
+        a = int(rng.integers(0, len(ACTIONS)))
+        p = 0.8 if a == best[g] else 0.2
+        reward = float(rng.random() < p)
+        rows.append(f"{g},{ACTIONS[a]},{reward:.1f}")
+    return rows
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 2000
+    seed = int(sys.argv[2]) if len(sys.argv) > 2 else 1
+    ng = int(sys.argv[3]) if len(sys.argv) > 3 else 4
+    print("\n".join(generate(n, seed, ng)))

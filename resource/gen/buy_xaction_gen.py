@@ -1,0 +1,37 @@
+#!/usr/bin/env python3
+"""Synthetic retail transactions for the Apriori use case (the reference's
+buy_xaction.rb role): baskets of 2-6 items with planted frequent bundles
+(milk+bread, beer+chips) over a catalog tail.
+Line: transId,item1,item2,...
+Usage: buy_xaction_gen.py <n_rows> [seed] > xactions.csv
+"""
+
+import sys
+
+import numpy as np
+
+CATALOG = ["milk", "bread", "beer", "chips", "eggs", "butter", "soda",
+           "candy", "soap", "paper", "pasta", "sauce"]
+BUNDLES = [("milk", "bread"), ("beer", "chips"), ("pasta", "sauce")]
+
+
+def generate(n: int, seed: int = 1):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(n):
+        basket = set()
+        for a, b in BUNDLES:
+            if rng.random() < 0.35:
+                basket.add(a)
+                basket.add(b)
+                break
+        while len(basket) < rng.integers(2, 7):
+            basket.add(CATALOG[rng.integers(0, len(CATALOG))])
+        rows.append(",".join([f"T{i:06d}"] + sorted(basket)))
+    return rows
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
+    seed = int(sys.argv[2]) if len(sys.argv) > 2 else 1
+    print("\n".join(generate(n, seed)))

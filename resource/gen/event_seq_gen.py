@@ -1,0 +1,53 @@
+#!/usr/bin/env python3
+"""Synthetic per-customer event sequences for the Markov use case (the
+reference's event_seq.rb role): normal customers mostly browse->buy cycles,
+fraudulent accounts churn through login/support/transfer loops.
+Line: custId,label,event1,event2,...
+Usage: event_seq_gen.py <n_rows> [seed] > sequences.csv
+"""
+
+import sys
+
+import numpy as np
+
+STATES = ["login", "browse", "cart", "buy", "support", "transfer"]
+
+# transition matrices (rows/cols in STATES order)
+NORMAL = np.array([
+    [0.05, 0.60, 0.20, 0.05, 0.08, 0.02],
+    [0.02, 0.40, 0.40, 0.10, 0.06, 0.02],
+    [0.02, 0.20, 0.20, 0.50, 0.06, 0.02],
+    [0.30, 0.40, 0.10, 0.10, 0.08, 0.02],
+    [0.10, 0.40, 0.15, 0.10, 0.20, 0.05],
+    [0.20, 0.30, 0.10, 0.10, 0.20, 0.10],
+])
+FRAUD = np.array([
+    [0.30, 0.10, 0.05, 0.02, 0.23, 0.30],
+    [0.25, 0.15, 0.10, 0.02, 0.18, 0.30],
+    [0.20, 0.10, 0.10, 0.05, 0.25, 0.30],
+    [0.30, 0.10, 0.05, 0.05, 0.20, 0.30],
+    [0.25, 0.05, 0.05, 0.02, 0.28, 0.35],
+    [0.35, 0.05, 0.03, 0.02, 0.25, 0.30],
+])
+
+
+def generate(n: int, seed: int = 1, min_len: int = 8, max_len: int = 20):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(n):
+        fraud = rng.random() < 0.3
+        mat = FRAUD if fraud else NORMAL
+        length = int(rng.integers(min_len, max_len + 1))
+        state = int(rng.integers(0, len(STATES)))
+        seq = [STATES[state]]
+        for _ in range(length - 1):
+            state = int(rng.choice(len(STATES), p=mat[state]))
+            seq.append(STATES[state])
+        rows.append(",".join([f"C{i:06d}", "F" if fraud else "N"] + seq))
+    return rows
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
+    seed = int(sys.argv[2]) if len(sys.argv) > 2 else 1
+    print("\n".join(generate(n, seed)))

@@ -1,0 +1,21 @@
+#!/bin/bash
+# Markov fraud-detection driver (train the per-class transition model,
+# then classify sequences by log-odds).
+#   ./markov.sh train    <sequences.csv> <model_dir>
+#   ./markov.sh classify <sequences.csv> <pred_dir>   (MODEL=<model_dir>)
+set -e
+DIR=$(cd "$(dirname "$0")" && pwd)
+RUN="python -m avenir_tpu.cli.run"
+PROPS="$DIR/markov.properties"
+
+case "$1" in
+train)
+  $RUN org.avenir.markov.MarkovStateTransitionModel -Dconf.path=$PROPS "$2" "$3"
+  ;;
+classify)
+  $RUN org.avenir.markov.MarkovModelClassifier -Dconf.path=$PROPS \
+      -Dmmc.mm.model.path=${MODEL:-markov_model}/part-r-00000 "$2" "$3"
+  ;;
+*)
+  echo "usage: $0 train|classify <in> <out>" >&2; exit 2 ;;
+esac

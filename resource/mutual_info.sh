@@ -1,0 +1,16 @@
+#!/bin/bash
+# Mutual-information feature selection driver.
+#   ./mutual_info.sh analyze <data.csv> <out_dir>
+set -e
+DIR=$(cd "$(dirname "$0")" && pwd)
+RUN="python -m avenir_tpu.cli.run"
+PROPS="$DIR/mutual_info.properties"
+
+case "$1" in
+analyze)
+  $RUN org.avenir.explore.MutualInformation -Dconf.path=$PROPS \
+      -Dmut.feature.schema.file.path=$DIR/call_hangup.json "$2" "$3"
+  ;;
+*)
+  echo "usage: $0 analyze <data.csv> <out_dir>" >&2; exit 2 ;;
+esac

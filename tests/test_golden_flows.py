@@ -149,3 +149,120 @@ def test_driver_scripts_exist_and_are_executable():
     for sh in ("churn.sh", "detr.sh", "rafo.sh", "knn.sh", "opt.sh"):
         p = os.path.join(RES, sh)
         assert os.path.exists(p) and os.access(p, os.X_OK)
+
+
+def test_markov_fraud_flow(tmp_path):
+    """markov.sh: per-class transition model -> log-odds classifier."""
+    seqs = tmp_path / "sequences.csv"
+    seqs.write_text("\n".join(_gen("event_seq_gen", 1500, 1)))
+    props = os.path.join(RES, "markov.properties")
+    model = tmp_path / "markov_model"
+    rc = cli_run.main([
+        "org.avenir.markov.MarkovStateTransitionModel",
+        f"-Dconf.path={props}", str(seqs), str(model)])
+    assert rc == 0
+    rc = cli_run.main([
+        "org.avenir.markov.MarkovModelClassifier", f"-Dconf.path={props}",
+        f"-Dmmc.mm.model.path={model}/part-r-00000",
+        str(seqs), str(tmp_path / "pred")])
+    assert rc == 0
+    out = list((tmp_path / "pred").glob("part-*"))[0].read_text().splitlines()
+    assert len(out) == 1500
+    acc = np.mean([l.split(",")[2] == l.split(",")[1] for l in out])
+    assert acc > 0.85
+
+
+def test_bandit_campaign_flow(tmp_path):
+    """bandit.sh: reward feedback -> per-group decisions -> state rotation;
+    groups converge to their hidden best creative."""
+    import importlib
+    gen = importlib.import_module("gen.bandit_rewards_gen")
+    props = os.path.join(RES, "bandit.properties")
+    state_in = None
+    for rnd in range(1, 4):
+        rewards = tmp_path / f"rewards_r{rnd}.csv"
+        rewards.write_text("\n".join(gen.generate(2000, rnd, 4)))
+        args = ["org.avenir.spark.reinforce.MultiArmBandit",
+                f"-Dconf.path={props}",
+                f"-Dmab.model.state.file.out={tmp_path}/state_r{rnd}/part"]
+        if state_in:
+            args.append(f"-Dmab.model.state.file.in={state_in}")
+        else:
+            args.append("-Dmab.model.state.file.in=/nonexistent")
+        args += [str(rewards), str(tmp_path / f"actions_r{rnd}")]
+        assert cli_run.main(args) == 0
+        state_in = f"{tmp_path}/state_r{rnd}/part"
+    actions = list((tmp_path / "actions_r3").glob("part-*"))[0] \
+        .read_text().splitlines()
+    assert len(actions) == 4
+    # the generator's hidden best arms (fixed by arm_seed=0)
+    arm_rng = np.random.default_rng(0)
+    best = {f"g{g}": gen.ACTIONS[int(arm_rng.integers(0, 4))]
+            for g in range(4)}
+    hits = sum(1 for l in actions
+               if l.split(",")[1] == best[l.split(",")[0]])
+    assert hits >= 3  # sampling algorithms may still explore one group
+
+
+def test_mutual_info_flow(tmp_path):
+    """mutual_info.sh: MI analysis ranks queue time as the top feature."""
+    data = tmp_path / "calls.csv"
+    data.write_text("\n".join(_gen("call_hangup_gen", 4000, 5)))
+    props = os.path.join(RES, "mutual_info.properties")
+    rc = cli_run.main([
+        "org.avenir.explore.MutualInformation", f"-Dconf.path={props}",
+        f"-Dmut.feature.schema.file.path={RES}/call_hangup.json",
+        str(data), str(tmp_path / "mi")])
+    assert rc == 0
+    lines = list((tmp_path / "mi").glob("part-*"))[0].read_text().splitlines()
+    scores = {}
+    for l in lines:
+        parts = l.split(",")
+        if parts[0] == "score" and parts[1] == "mutual.info.maximization":
+            scores[int(parts[2])] = float(parts[3])
+    assert scores, "no MIM scores emitted"
+    assert max(scores, key=scores.get) == 2  # queue time drives hangup
+
+
+def test_apriori_flow(tmp_path):
+    """apriori.sh: two Apriori levels -> rules find the planted bundles."""
+    data = tmp_path / "xactions.csv"
+    data.write_text("\n".join(_gen("buy_xaction_gen", 1500, 1)))
+    props = os.path.join(RES, "apriori.properties")
+    common = [f"-Dconf.path={props}", "-Dfia.total.tans.count=1500"]
+    rc = cli_run.main(["org.avenir.association.FrequentItemsApriori",
+                       *common, "-Dfia.item.set.length=1",
+                       "-Dfia.trans.id.output=true",
+                       str(data), str(tmp_path / "level_1")])
+    assert rc == 0
+    rc = cli_run.main(["org.avenir.association.FrequentItemsApriori",
+                       *common, "-Dfia.item.set.length=1",
+                       str(data), str(tmp_path / "freq_1")])
+    assert rc == 0
+    rc = cli_run.main(["org.avenir.association.FrequentItemsApriori",
+                       *common, "-Dfia.item.set.length=2",
+                       f"-Dfia.item.set.file.path={tmp_path}/level_1/part-r-00000",
+                       str(data), str(tmp_path / "freq_2")])
+    assert rc == 0
+    # rule mining needs every level's supports (antecedent confidence
+    # denominators): concatenate the no-tid outputs
+    rules_in = tmp_path / "rules_in"
+    rules_in.mkdir()
+    (rules_in / "part-r-00000").write_text(
+        (tmp_path / "freq_1" / "part-r-00000").read_text() + "\n" +
+        (tmp_path / "freq_2" / "part-r-00000").read_text())
+    rc = cli_run.main(["org.avenir.association.AssociationRuleMiner",
+                       f"-Dconf.path={props}",
+                       str(rules_in), str(tmp_path / "rules")])
+    assert rc == 0
+    rules = list((tmp_path / "rules").glob("part-*"))[0] \
+        .read_text().splitlines()
+    text = "\n".join(rules)
+    assert "milk" in text and "bread" in text
+    assert "beer" in text and "chips" in text
+
+
+def test_all_driver_scripts_exist_and_are_executable():
+    for sh in ("markov.sh", "bandit.sh", "mutual_info.sh", "apriori.sh"):
+        p = os.path.join(RES, sh)
+        assert os.path.exists(p) and os.access(p, os.X_OK)

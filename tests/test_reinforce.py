@@ -235,3 +235,22 @@ def test_vector_exp3_no_overflow_long_run(mesh_ctx):
     assert np.isfinite(vb.weights).all()
     probs = vb.last_probs
     assert np.isfinite(probs).all() and (probs > 0).all()
+
+
+def test_vector_ucb2_survives_delayed_rewards(mesh_ctx):
+    """ucb2 selection must stay finite when rounds outpace rewards (the
+    serving pattern): epochs advance per pick but N tracks trials, so the
+    bonus can never go NaN and later rewards still steer the arm."""
+    vb = VectorBandits("ucb2", 1, 2, seed=7)
+    vb.set_rewards(np.zeros(2, int), np.array([0, 1]),
+                   np.array([0.5, 0.5], dtype=np.float32))
+    for _ in range(80):  # many unrewarded selections
+        acts = vb.next_actions()
+    assert np.isfinite(vb.epochs).all()
+    # arm 1 becomes clearly better; the learner must switch to it
+    for _ in range(60):
+        acts = vb.next_actions()
+        vb.set_rewards(np.zeros(1, int), acts,
+                       np.where(acts == 1, 1.0, 0.0).astype(np.float32))
+    picks = [int(vb.next_actions()[0]) for _ in range(10)]
+    assert 1 in picks
