@@ -417,3 +417,41 @@ def event_time_distribution(cfg: Config, in_path: str, out_path: str
     counters.increment("EventTime", "Keys", len(keys))
     counters.increment("EventTime", "Events", len(cycles))
     return counters
+
+
+@register("org.avenir.spark.sequence.SequenceGenerator", "sequenceGenerator")
+def sequence_generator(cfg: Config, in_path: str, out_path: str) -> Counters:
+    """Event-stream -> per-entity ordered sequences
+    (spark/.../sequence/SequenceGenerator.scala:25-81): records grouped by
+    id.field.ordinals, ordered by seq.field (numeric when parseable, else
+    lexicographic — the reference sorts chombo Records, which compare
+    typed), emitting the val.field.ordinals fields of each event in order.
+
+    Output: keyFields..., then the ordered events' value fields flattened.
+    This is the standard preparation step feeding the Markov/PST trainers
+    (an event log becomes markovStateTransitionModel input)."""
+    counters = Counters()
+    delim = cfg.field_delim_regex
+    od = cfg.field_delim_out
+    key_ords = [int(x) for x in cfg.must_get_list("id.field.ordinals")]
+    val_ords = [int(x) for x in cfg.must_get_list("val.field.ordinals")]
+    seq_ord = int(cfg.must_get("seq.field"))
+    split_line = _splitter(delim)
+    groups: Dict[str, List] = {}
+    for line in artifacts.read_text_input(in_path):
+        items = split_line(line)
+        key = od.join(items[o] for o in key_ords)
+        raw = items[seq_ord]
+        try:
+            sk = (0, float(raw), "")
+        except ValueError:
+            sk = (1, 0.0, raw)
+        groups.setdefault(key, []).append((sk, [items[o] for o in val_ords]))
+    out_lines = []
+    for key in sorted(groups):
+        events = sorted(groups[key], key=lambda e: e[0])
+        flat = [f for _, vals in events for f in vals]
+        out_lines.append(od.join([key] + flat))
+    artifacts.write_text_output(out_path, out_lines)
+    counters.increment("SequenceGenerator", "Entities", len(groups))
+    return counters

@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import math
 import random
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -539,3 +539,37 @@ def create_learner(algorithm: str, actions: Sequence[str],
         raise ValueError(f"unknown bandit algorithm {algorithm!r}; known: "
                          f"{sorted(LEARNERS)}")
     return cls(actions, config)
+
+
+class ExplorationCounter:
+    """Round-based exploration scheduling (reinforce/ExplorationCounter
+    .java:27-118): a group of ``count`` items is force-explored for the
+    first ``exploration_count`` selections, ``batch_size`` per round,
+    sweeping item-index windows (wrapping at the group boundary) until the
+    budget is spent."""
+
+    def __init__(self, group_id: str, count: int, exploration_count: int,
+                 batch_size: int):
+        self.group_id = group_id
+        self.count = count
+        self.exploration_count = exploration_count
+        self.batch_size = batch_size
+        self.selections: List[Tuple[int, int]] = []
+
+    def select_next_round(self, round_num: int) -> None:
+        remaining = self.exploration_count - (round_num - 1) * self.batch_size
+        self.selections = []
+        if remaining > 0:
+            beg = remaining % self.count
+            end = beg + self.batch_size - 1
+            if end >= self.count:  # batch wraps the item-set boundary
+                self.selections.append((beg, self.count - 1))
+                self.selections.append((0, end - self.count))
+            else:
+                self.selections.append((beg, end))
+
+    def is_in_exploration(self) -> bool:
+        return bool(self.selections)
+
+    def should_explore(self, item_index: int) -> bool:
+        return any(lo <= item_index <= hi for lo, hi in self.selections)

@@ -68,6 +68,7 @@ EXPECTED = {
     "org.avenir.spark.optimize.GeneticAlgorithm": "genetic_algorithm_job",
     "org.avenir.spark.sequence.EventTimeDistribution":
         "event_time_distribution",
+    "org.avenir.spark.sequence.SequenceGenerator": "sequence_generator",
     "org.avenir.spark.similarity.GroupedRecordSimilarity":
         "grouped_record_similarity",
     "org.avenir.spark.optimize.SimulatedAnnealing": "simulated_annealing_job",

@@ -254,3 +254,20 @@ def test_vector_ucb2_survives_delayed_rewards(mesh_ctx):
                        np.where(acts == 1, 1.0, 0.0).astype(np.float32))
     picks = [int(vb.next_actions()[0]) for _ in range(10)]
     assert 1 in picks
+
+
+def test_exploration_counter_reference_semantics():
+    """ExplorationCounter.java:52-98: windowed forced exploration with
+    wrap-around, inactive once the budget is spent."""
+    from avenir_tpu.reinforce.learners import ExplorationCounter
+    ec = ExplorationCounter("g", count=5, exploration_count=12, batch_size=4)
+    ec.select_next_round(1)   # remaining 12 -> beg 12%5=2, end 5 -> wraps
+    assert ec.is_in_exploration()
+    assert ec.should_explore(2) and ec.should_explore(4)
+    assert ec.should_explore(0)  # wrapped segment 0..0
+    assert not ec.should_explore(1)
+    ec.select_next_round(3)   # remaining 12-8=4 -> beg 4, end 7 -> wraps
+    assert ec.should_explore(4) and ec.should_explore(2)
+    ec.select_next_round(4)   # remaining 0 -> exploration over
+    assert not ec.is_in_exploration()
+    assert not ec.should_explore(0)

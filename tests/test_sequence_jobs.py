@@ -155,3 +155,43 @@ def test_event_time_distribution_day_of_week_and_granularity(tmp_path):
     assert rc == 0
     line = (tmp_path / "out2" / "part-r-00000").read_text().strip()
     assert line == "k,0:1,1:1"
+
+
+def test_sequence_generator(tmp_path):
+    """Event log -> per-entity time-ordered sequences
+    (SequenceGenerator.scala parity)."""
+    from avenir_tpu.cli import run as cli_run
+    f = tmp_path / "events.csv"
+    f.write_text("\n".join([
+        "u2,300,login", "u1,200,browse", "u1,100,login",
+        "u1,300,buy", "u2,100,support"]))
+    props = tmp_path / "p.properties"
+    props.write_text("id.field.ordinals=0\nval.field.ordinals=2\n"
+                     "seq.field=1\n")
+    rc = cli_run.main(["sequenceGenerator", f"-Dconf.path={props}",
+                       str(f), str(tmp_path / "out")])
+    assert rc == 0
+    lines = (tmp_path / "out" / "part-r-00000").read_text().splitlines()
+    assert lines == ["u1,login,browse,buy", "u2,support,login"]
+
+
+def test_sequence_generator_feeds_markov(tmp_path):
+    """The generated sequences are valid markovStateTransitionModel input."""
+    from avenir_tpu.cli import run as cli_run
+    rows = []
+    for uid in range(20):
+        for t, ev in enumerate(["login", "browse", "buy", "browse", "buy"]):
+            rows.append(f"u{uid:02d},{t},{ev}")
+    f = tmp_path / "events.csv"
+    f.write_text("\n".join(rows))
+    props = tmp_path / "p.properties"
+    props.write_text("id.field.ordinals=0\nval.field.ordinals=2\n"
+                     "seq.field=1\n"
+                     "mst.skip.field.count=1\n"
+                     "mst.model.states=login,browse,buy\n")
+    assert cli_run.main(["sequenceGenerator", f"-Dconf.path={props}",
+                         str(f), str(tmp_path / "seqs")]) == 0
+    assert cli_run.main(["markovStateTransitionModel", f"-Dconf.path={props}",
+                         str(tmp_path / "seqs"), str(tmp_path / "mm")]) == 0
+    model = (tmp_path / "mm" / "part-r-00000").read_text().splitlines()
+    assert model[0].split(",") == ["login", "browse", "buy"]
