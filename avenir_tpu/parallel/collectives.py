@@ -20,6 +20,25 @@ Two styles are provided on purpose:
     pattern: in_shardings=P('data') for batch args, out replicated.
   * **explicit style**: ``shard_map``-based wrappers for when the layout must
     be pinned (independent chains with per-device state, psum'd counters).
+
+Where each idiom runs in production:
+
+  * shard (map):        every model — ``MeshContext.shard_rows`` feeds the
+                        tree/forest/bayes/KNN kernels
+  * keyed reduce:       ``keyed_reduce`` in the eventTimeDistribution job;
+                        the tree/bayes histograms are its one-hot-matmul
+                        specialization inside their fused kernels
+  * replicate:          split winners / child tables / model constants
+                        (forest level loop, PathMatrix device consts)
+  * scalar aggregate:   job counters all-reduce across processes in
+                        ``cli.run`` (distributed.all_reduce_counters);
+                        ``counter_sum`` is the in-program psum variant for
+                        metrics that must not leave the device
+  * chain fan-out:      SA/GA shard their independent chains/islands as a
+                        leading array axis under GSPMD (optimize/annealing,
+                        optimize/genetic) — the preferred form of this
+                        idiom; ``chain_fanout`` is the explicit shard_map
+                        alternative for per-device host state
 """
 
 from __future__ import annotations

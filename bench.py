@@ -188,12 +188,35 @@ def rf_big_rate(n):
     return dict(rf_rate(n), metric="random_forest_2m_rows_x_trees_per_sec")
 
 
+def sa_rate(n_chains):
+    """Simulated annealing: n_chains independent Metropolis chains over a
+    matrix-cost assignment domain, 2000 iterations in one lax.scan — the
+    BASELINE 'pod-scale pmap' config's single-chip point."""
+    from avenir_tpu.optimize.annealing import (AnnealingParams,
+                                               simulated_annealing)
+    from avenir_tpu.optimize.domain import MatrixCostDomain
+    rng = np.random.default_rng(3)
+    dom = MatrixCostDomain(cost_matrix=rng.random((24, 8)).astype(np.float32))
+    iters = 2000
+    params = AnnealingParams(max_num_iterations=iters,
+                             num_optimizers=n_chains, seed=3)
+    simulated_annealing(dom, params)  # compile + warm
+    t0 = time.perf_counter()
+    res = simulated_annealing(dom, params)
+    dt = time.perf_counter() - t0
+    assert res.best_costs.shape == (n_chains,)
+    return {"metric": "sa_chain_steps_per_sec",
+            "value": round(n_chains * iters / dt, 1),
+            "unit": "chain*steps/sec", "chains": n_chains, "iters": iters}
+
+
 WORKLOADS = {
     "nb": (nb_rate, [8_000_000, 1_000_000]),
     "rf": (rf_rate, [400_000, 50_000]),
     "rf_big": (rf_big_rate, [2_000_000]),
     "knn": (knn_rate, [8_000, 4_000]),
     "knn_big": (knn_big_rate, [20_000]),
+    "sa": (sa_rate, [4_096, 512]),
 }
 
 
