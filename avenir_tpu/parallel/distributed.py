@@ -11,6 +11,19 @@ Everything degrades to single-process: ``initialize()`` is a no-op without
 coordinator info, and ``from_process_local`` falls back to ``device_put``
 when there is one process, so the same job code runs on a laptop CPU mesh,
 one TPU chip, or a multi-host pod.
+
+Multi-host contract (validated by a true 2-process CPU test,
+tests/test_distributed.py): each process loads its own EQUAL-SIZE input
+shard; device reductions over the resulting global arrays give every
+process the identical global result (models computed this way are
+bit-identical across processes), and host-side tallies go through
+``all_reduce_counters`` before rendering (cli.run does this, printing on
+process 0 only).  KNOWN LIMITATION (round-4 work): per-record OUTPUTS
+(prediction part files) are written by every process over its local shard
+view into the same part name — a multi-host predict job needs per-process
+part numbering (part-m-<process_index>) before it is production-correct
+on a pod; training jobs whose artifact is the global model are correct
+today since every process writes identical bytes.
 """
 
 from __future__ import annotations
@@ -108,10 +121,25 @@ def from_process_local(local_rows: np.ndarray, mesh: Mesh):
     the multi-host ingest path (each host reads its own CSV shard, the
     global array is the concatenation; reference analog: HDFS blocks feeding
     data-local mappers).  Single-process: device_put with the same
-    sharding."""
+    sharding.
+
+    Local blocks MUST have equal row counts across processes: with unequal
+    blocks jax.make_array_from_process_local_data builds a DIFFERENT global
+    shape on each process and reductions return garbage with no error
+    (verified on a 2-process CPU run).  The guard allgathers the row count
+    (one tiny collective per ingest) and fails loudly instead."""
     sharding = row_sharding(mesh)
     if getattr(jax, "process_count", lambda: 1)() <= 1:
         return jax.device_put(local_rows, sharding)
+    from jax.experimental import multihost_utils
+    shapes = np.asarray(multihost_utils.process_allgather(
+        np.array(local_rows.shape, dtype=np.int64)))   # (P, ndim)
+    if not (shapes == shapes[0]).all():
+        raise ValueError(
+            f"per-process local shapes differ: {shapes.tolist()} — equalize "
+            f"the input shards (pad or rebalance rows; fix column-count "
+            f"drift) before ingest; mismatched blocks silently corrupt the "
+            f"global array")
     return jax.make_array_from_process_local_data(sharding, local_rows)
 
 

@@ -1,0 +1,40 @@
+"""Worker for the true 2-process distributed test (spawned by
+tests/test_distributed.py): joins the coordinator, runs the NaiveBayes
+train job through the CLI distributed mode on THIS process's input shard,
+and prints the model file path + captured counter output for the parent to
+compare."""
+
+import contextlib
+import io
+import os
+import sys
+
+
+def main():
+    pid = int(sys.argv[1])
+    port = sys.argv[2]
+    shard = sys.argv[3]
+    out = sys.argv[4]
+    res = sys.argv[5]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    os.environ["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+    os.environ["JAX_NUM_PROCESSES"] = "2"
+    os.environ["JAX_PROCESS_ID"] = str(pid)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from avenir_tpu.cli import run as cli_run
+    cap = io.StringIO()
+    with contextlib.redirect_stdout(cap):
+        rc = cli_run.main([
+            "org.avenir.bayesian.BayesianDistribution",
+            f"-Dconf.path={res}/churn.properties",
+            f"-Dbad.feature.schema.file.path={res}/churn.json",
+            "-Ddistributed.mode=1", shard, out])
+    assert rc == 0
+    sys.stdout.write(f"COUNTERS_BEGIN\n{cap.getvalue()}COUNTERS_END\n")
+    print("WORKER_OK")
+
+
+if __name__ == "__main__":
+    main()
