@@ -147,3 +147,84 @@ def run_all(base):
     for flow in FLOWS:
         out.update(flow(base))
     return out
+
+
+def markov_flow(base):
+    d = os.path.join(base, "markov")
+    os.makedirs(d, exist_ok=True)
+    seqs = os.path.join(d, "sequences.csv")
+    with open(seqs, "w") as fh:
+        fh.write("\n".join(_gen("event_seq_gen", 300, 21)))
+    props = os.path.join(RES, "markov.properties")
+    assert cli_run.main([
+        "org.avenir.markov.MarkovStateTransitionModel",
+        f"-Dconf.path={props}", seqs, os.path.join(d, "model")]) == 0
+    assert cli_run.main([
+        "org.avenir.markov.MarkovModelClassifier", f"-Dconf.path={props}",
+        f"-Dmmc.mm.model.path={d}/model/part-r-00000",
+        seqs, os.path.join(d, "pred")]) == 0
+    return {"markov/model.csv": _read(f"{d}/model/part-r-00000"),
+            "markov/pred.csv": _read(f"{d}/pred/part-m-00000")}
+
+
+def bandit_flow(base):
+    d = os.path.join(base, "bandit")
+    os.makedirs(d, exist_ok=True)
+    props = os.path.join(RES, "bandit.properties")
+    rewards = os.path.join(d, "rewards.csv")
+    with open(rewards, "w") as fh:
+        fh.write("\n".join(_gen("bandit_rewards_gen", 600, 22, 4)))
+    assert cli_run.main([
+        "org.avenir.spark.reinforce.MultiArmBandit", f"-Dconf.path={props}",
+        "-Dmab.model.state.file.in=/nonexistent",
+        f"-Dmab.model.state.file.out={d}/state/part",
+        rewards, os.path.join(d, "actions")]) == 0
+    return {"bandit/actions.csv": _read(f"{d}/actions/part-r-00000"),
+            "bandit/state.csv": _read(f"{d}/state/part/part-r-00000")}
+
+
+def mi_flow(base):
+    d = os.path.join(base, "mi")
+    os.makedirs(d, exist_ok=True)
+    data = os.path.join(d, "calls.csv")
+    with open(data, "w") as fh:
+        fh.write("\n".join(_gen("call_hangup_gen", 500, 23)))
+    props = os.path.join(RES, "mutual_info.properties")
+    assert cli_run.main([
+        "org.avenir.explore.MutualInformation", f"-Dconf.path={props}",
+        f"-Dmut.feature.schema.file.path={RES}/call_hangup.json",
+        data, os.path.join(d, "out")]) == 0
+    return {"mi/scores.csv": _read(f"{d}/out/part-r-00000")}
+
+
+def apriori_flow(base):
+    d = os.path.join(base, "apriori")
+    os.makedirs(d, exist_ok=True)
+    data = os.path.join(d, "xactions.csv")
+    with open(data, "w") as fh:
+        fh.write("\n".join(_gen("buy_xaction_gen", 500, 24)))
+    props = os.path.join(RES, "apriori.properties")
+    common = [f"-Dconf.path={props}", "-Dfia.total.tans.count=500"]
+    assert cli_run.main(["org.avenir.association.FrequentItemsApriori",
+                         *common, "-Dfia.item.set.length=1",
+                         "-Dfia.trans.id.output=true",
+                         data, os.path.join(d, "level_1")]) == 0
+    for length, out in ((1, "freq_1"), (2, "freq_2")):
+        args = ["org.avenir.association.FrequentItemsApriori", *common,
+                f"-Dfia.item.set.length={length}"]
+        if length > 1:
+            args.append(f"-Dfia.item.set.file.path={d}/level_1/part-r-00000")
+        assert cli_run.main(args + [data, os.path.join(d, out)]) == 0
+    rules_in = os.path.join(d, "rules_in")
+    os.makedirs(rules_in, exist_ok=True)
+    with open(os.path.join(rules_in, "part-r-00000"), "w") as fh:
+        fh.write(_read(f"{d}/freq_1/part-r-00000") + "\n" +
+                 _read(f"{d}/freq_2/part-r-00000"))
+    assert cli_run.main(["org.avenir.association.AssociationRuleMiner",
+                         f"-Dconf.path={props}",
+                         rules_in, os.path.join(d, "rules")]) == 0
+    return {"apriori/freq_pairs.csv": _read(f"{d}/freq_2/part-r-00000"),
+            "apriori/rules.csv": _read(f"{d}/rules/part-r-00000")}
+
+
+FLOWS = FLOWS + (markov_flow, bandit_flow, mi_flow, apriori_flow)

@@ -141,7 +141,7 @@ def _spawn_two_workers(tmp_path, res, shard_names):
                         "JAX_NUM_PROCESSES", "JAX_PROCESS_ID")}
     env["PYTHONPATH"] = os.pathsep.join(
         [os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))]
-        + env.get("PYTHONPATH", "").split(os.pathsep))
+        + [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p])
     procs = [subprocess.Popen(
         [sys.executable, worker, str(i), port,
          str(tmp_path / shard_names[i]), str(tmp_path / f"out{i}"), res],
@@ -166,7 +166,6 @@ def test_true_two_process_nb_train(tmp_path):
     the model of the CONCATENATED data (bit-identical to a single-process
     run), and the all-reduced counters render on process 0 only."""
     import os
-    import subprocess
     import sys
 
     from avenir_tpu.cli import run as cli_run
@@ -213,7 +212,6 @@ def test_true_two_process_unequal_shards_fail_loudly(tmp_path):
     corrupt otherwise (verified on hardware... well, on a real 2-process
     run)."""
     import os
-    import subprocess
     import sys
 
     res = os.path.abspath(
