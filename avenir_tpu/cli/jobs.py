@@ -440,7 +440,7 @@ def knn_pipeline(cfg: Config, in_path: str, out_path: str) -> Counters:
     if validation:
         cm.export(counters)
     counters.increment("Neighborhood", "Test records", test.n_rows)
-    artifacts.write_text_output(out_path, out_lines)
+    artifacts.write_text_output(out_path, out_lines, local_shard=True)
     return counters
 
 

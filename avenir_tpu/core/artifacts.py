@@ -22,8 +22,26 @@ from typing import Any, Iterable, List, Optional
 
 
 def write_text_output(dir_path: str, lines: Iterable[str],
-                      part: int = 0, role: str = "r") -> str:
-    """Write lines as ``<dir>/part-{role}-{part:05d}`` (Hadoop output layout)."""
+                      part: Optional[int] = None, role: str = "r",
+                      local_shard: Optional[bool] = None) -> str:
+    """Write lines as ``<dir>/part-{role}-{part:05d}`` (Hadoop output layout).
+
+    ``local_shard=True`` marks per-record outputs computed over THIS
+    process's input shard (prediction lines etc.): under multi-process the
+    part number defaults to the process index, so every process contributes
+    its own part file — the Hadoop one-part-per-task layout — instead of
+    all processes clobbering part 0.  Default: map-only outputs
+    (role "m", the reference's per-record predictor jobs) are shard-local;
+    reducer-style artifacts (role "r": model files, which every process
+    computes identically from the sharded global arrays) keep part 0."""
+    if part is None:
+        if local_shard is None:
+            local_shard = role == "m"
+        part = 0
+        if local_shard:
+            import jax
+            if getattr(jax, "process_count", lambda: 1)() > 1:
+                part = jax.process_index()
     os.makedirs(dir_path, exist_ok=True)
     path = os.path.join(dir_path, f"part-{role}-{part:05d}")
     with open(path, "w") as fh:

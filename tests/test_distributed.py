@@ -228,3 +228,19 @@ def test_true_two_process_unequal_shards_fail_loudly(tmp_path):
     assert any(rc != 0 for rc, _, _ in results), "unequal shards must fail"
     combined_err = "".join(err for _, _, err in results)
     assert "local shapes differ" in combined_err
+
+
+def test_write_text_output_per_process_parts(tmp_path, monkeypatch):
+    """Map-only (shard-local) outputs get per-process part numbers under
+    multi-process; reducer-style global artifacts keep part 0."""
+    from avenir_tpu.core import artifacts
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    p = artifacts.write_text_output(str(tmp_path / "pred"), ["a"], role="m")
+    assert p.endswith("part-m-00001")
+    p = artifacts.write_text_output(str(tmp_path / "model"), ["b"], role="r")
+    assert p.endswith("part-r-00000")
+    # explicit override wins either way
+    p = artifacts.write_text_output(str(tmp_path / "x"), ["c"], role="r",
+                                    local_shard=True)
+    assert p.endswith("part-r-00001")
