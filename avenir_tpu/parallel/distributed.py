@@ -18,12 +18,16 @@ shard; device reductions over the resulting global arrays give every
 process the identical global result (models computed this way are
 bit-identical across processes), and host-side tallies go through
 ``all_reduce_counters`` before rendering (cli.run does this, printing on
-process 0 only).  KNOWN LIMITATION (round-4 work): per-record OUTPUTS
-(prediction part files) are written by every process over its local shard
-view into the same part name — a multi-host predict job needs per-process
-part numbering (part-m-<process_index>) before it is production-correct
-on a pod; training jobs whose artifact is the global model are correct
-today since every process writes identical bytes.
+process 0 only).  Per-record outputs (prediction part files) are written
+per process as part-m-<process_index> — the Hadoop one-part-per-task
+layout (core/artifacts.write_text_output); training jobs whose artifact
+is the global model write identical bytes on every process.  KNOWN
+LIMITATION (round-4 work): jobs whose computation is host-side over the
+local lines (apriori, rule mining, the file-based KNN grouping) produce
+shard-local results under multi-process — they need either device
+formulations or an explicit gather step before they are pod-correct;
+the device-reduction jobs (NB, trees/forest, MI, correlations, KNN
+fused pipeline) are global-correct today.
 """
 
 from __future__ import annotations
