@@ -106,14 +106,25 @@ def main(argv: Optional[List[str]] = None) -> int:
     else:
         in_path = out_path = None
     try:
-        counters = fn(cfg, in_path, out_path)
+        # job-level step accounting into the counters channel (the rebuild's
+        # replacement for the Hadoop UI's job timing; SURVEY §5), plus an
+        # optional XLA profiler capture dir
+        from ..utils.tracing import StepTimer, trace
+        timer = StepTimer()
+        with trace(cfg.get("profile.trace.dir") or
+                   os.environ.get("AVENIR_TPU_TRACE_DIR")):
+            with timer.step("job"):
+                counters = fn(cfg, in_path, out_path)
         if counters is not None:
             # Hadoop counters are cluster-global: under multi-host the per
             # -process host-side tallies are all-reduced, and only process 0
-            # renders (matching the reference driver's single counter dump)
+            # renders (matching the reference driver's single counter dump).
+            # Profiling times are exported AFTER the reduce — per-process
+            # wall clock must not be summed across the pod.
             from ..parallel.distributed import all_reduce_counters
             import jax
             counters = all_reduce_counters(counters)
+            timer.export(counters)
             if jax.process_index() == 0:
                 print(counters.render())
     finally:
