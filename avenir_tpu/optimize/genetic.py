@@ -17,7 +17,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .domain import SearchDomain
+from .domain import SearchDomain, set_components
 from ..parallel.mesh import MeshContext, runtime_context
 
 
@@ -72,7 +72,7 @@ def genetic_algorithm(domain: SearchDomain, params: GeneticParams,
         # must not be correlated)
         mpos = jax.random.randint(k_mut, (P,), 0, L)
         mval = jax.random.randint(k_mutv, (P,), 0, domain.n_choices)
-        mutated = child.at[jnp.arange(P), mpos].set(mval.astype(child.dtype))
+        mutated = set_components(child, mpos, mval)
         do_mut = jax.random.uniform(k_mutp, (P, 1)) < params.mutation_prob
         return jnp.where(do_mut, mutated, child)
 
