@@ -309,10 +309,49 @@ def build_forest(table: ColumnarTable, params: ForestParams,
     return models
 
 
+@functools.lru_cache(maxsize=None)
+def _jitted_ensemble_vote_kernel(T: int, P: int, F: int, C: int, K: int):
+    """One fused launch for the WHOLE ensemble: every member's path tensors
+    stacked on a leading member axis, per-member first-match, weighted vote,
+    argmax + min-odds veto — all on device, one (n,) readback.  A trailing
+    always-match sentinel path per member carries its fallback class, so
+    first-match == the member's predict-with-fallback semantics."""
+    from .tree import _match_ok
+
+    def kernel(vals, codes, lo, hi, num_r, cat_m, cat_r, cls_oh, wvec,
+               min_odds):
+        # the per-member matcher IS tree._match_ok, vmapped over the member
+        # axis — one predicate-semantics implementation for both paths
+        ok = jax.vmap(
+            lambda l, h, nr, cm, cr: _match_ok(vals, codes, l, h, nr, cm,
+                                               cr, jnp)
+        )(lo, hi, num_r, cat_m, cat_r)                    # (T, n, P)
+        ok = ok.transpose(1, 0, 2)                        # (n, T, P)
+        first = jnp.argmax(ok, axis=2)                    # (n, T)
+        foh = jax.nn.one_hot(first, P, dtype=jnp.float32)
+        votes = jnp.einsum("ntp,tpk,t->nk", foh, cls_oh, wvec,
+                           precision=jax.lax.Precision.HIGHEST)  # (n, K)
+        best = jnp.argmax(votes, axis=1)
+        top = votes.max(axis=1)
+        second = jnp.where(jax.nn.one_hot(best, K, dtype=bool), -jnp.inf,
+                           votes).max(axis=1)
+        veto = (min_odds > 1.0) & \
+            (top / jnp.maximum(second, 1e-12) <= min_odds)
+        return jnp.where(veto, K, best).astype(jnp.int32)
+    return jax.jit(kernel)
+
+
 class EnsembleModel:
     """Weighted-vote ensemble with min-odds veto
     (model/EnsemblePredictiveModel.java:69-113).  The reference requires an
-    odd number of models for unweighted votes; we keep that check."""
+    odd number of models for unweighted votes; we keep that check.
+
+    Device path: all members' predicate tensors are stacked (padded to the
+    widest member, plus one always-match fallback sentinel path each) and
+    the entire vote happens in one fused launch per row chunk — per-member
+    prediction uploads/readbacks made ensemble predict transfer-bound on
+    the chip tunnel.  Falls back to the per-member host path when a member
+    is degenerate or the features are not f32-exact."""
 
     def __init__(self, models: List[DecisionTreeModel],
                  weights: Optional[Sequence[float]] = None,
@@ -328,16 +367,92 @@ class EnsembleModel:
         self.classes = sorted({c for m in models for c in m.matrix.classes}
                               | {""})
         self._cls_arr = np.array(self.classes)
+        self._stacked = self._stack_members()
+
+    def _stack_members(self):
+        """(T, Pmax, ...) stacked predicate tensors, or None when any member
+        is degenerate (no paths/classes), bounds are not f32-exact, or the
+        vote weights are not small integers — fractional weights must
+        accumulate in the host path's float64 (f32 vote sums could flip
+        argmax/veto decisions near ties)."""
+        mats = [m.matrix for m in self.models]
+        if not mats or any(m.n_paths == 0 or not m.classes or
+                           not m._bounds_f32_exact for m in mats):
+            return None
+        if any(w != round(w) or abs(w) >= float(1 << 24)
+               for w in self.weights):
+            return None
+        F = len(mats[0].feat_ordinals)
+        cmax = max(m.cat_mask.shape[2] for m in mats)
+        P = max(m.n_paths for m in mats) + 1          # + fallback sentinel
+        T, K = len(mats), len(self.classes)
+        cls_idx = {c: i for i, c in enumerate(self.classes)}
+        lo = np.full((T, P, F), np.inf, dtype=np.float32)   # pad: never match
+        hi = np.full((T, P, F), -np.inf, dtype=np.float32)
+        num_r = np.ones((T, P, F), dtype=bool)
+        cat_m = np.zeros((T, P, F, cmax), dtype=bool)
+        cat_r = np.zeros((T, P, F), dtype=bool)
+        cls_oh = np.zeros((T, P, K), dtype=np.float32)
+        for t, m in enumerate(mats):
+            p = m.n_paths
+            lo[t, :p] = m.lo.astype(np.float32)
+            hi[t, :p] = m.hi.astype(np.float32)
+            num_r[t, :p] = m.num_restricted
+            cat_m[t, :p, :, :m.cat_mask.shape[2]] = m.cat_mask
+            cat_r[t, :p] = m.cat_restricted
+            for pi in range(p):
+                cls_oh[t, pi, cls_idx[m.classes[m.path_cls[pi]]]] = 1.0
+            # sentinel: always matches, votes the member's fallback class
+            lo[t, p] = -np.inf
+            hi[t, p] = np.inf
+            num_r[t, p] = False
+            cls_oh[t, p, cls_idx[m.classes[int(m.fallback_cls)]]] = 1.0
+        dev = tuple(jnp.asarray(a) for a in
+                    (lo, hi, num_r, cat_m, cat_r, cls_oh))
+        return dev + (jnp.asarray(np.asarray(self.weights, np.float32)),
+                      _jitted_ensemble_vote_kernel(T, P, F, cmax, K))
 
     def predict(self, table: ColumnarTable) -> List[Optional[str]]:
-        """Weighted vote as one (n, K) reduction: each member contributes its
-        weight at its predicted class index (no per-record Python)."""
+        """Weighted vote; fused device path when available, else one
+        (n, K) host reduction over per-member predictions (members still
+        share one feature build/upload via FeatureCache)."""
+        from .tree import FeatureCache
+        cache = FeatureCache()
+        n = table.n_rows
+        if self._stacked is not None and n > 0:
+            m0 = self.models[0].matrix
+            vals, codes = cache.host(m0, table)
+            if m0._f32_safe(vals):
+                return self._predict_device(vals, codes, cache)
+        return self._predict_host(table, cache)
+
+    def _predict_device(self, vals, codes, cache) -> List[Optional[str]]:
+        *consts, wvec, kernel = self._stacked
+        T, P, F = consts[0].shape
+        C = consts[3].shape[3]
+        n = vals.shape[0]
+        d_vals, d_codes = cache.device(vals, codes)
+        # budget covers both the (n, T, P, F) match intermediate and the
+        # (n, F, C) categorical one-hot (dominant for high cardinality)
+        per_row = max(T * P * F, F * C, 1)
+        chunk = max(1024, (1 << 26) // per_row)
+        K = len(self.classes)
+        out = []
+        for s in range(0, n, chunk):
+            idx = kernel(d_vals[s:s + chunk], d_codes[s:s + chunk],
+                         *consts, wvec, jnp.float32(self.min_odds_ratio))
+            out.append(np.asarray(idx))
+        idx = np.concatenate(out)
+        lut = np.concatenate([self._cls_arr.astype(object), [None]])
+        return list(lut[idx])
+
+    def _predict_host(self, table: ColumnarTable, cache) -> List[Optional[str]]:
         n = table.n_rows
         cls_arr = self._cls_arr
         mat = np.zeros((n, len(cls_arr)), dtype=np.float64)
         rows = np.arange(n)
         for model, w in zip(self.models, self.weights):
-            pred, _ = model.predict(table)
+            pred, _ = model.predict(table, features=cache)
             idx = np.searchsorted(cls_arr, np.asarray(pred))
             # (rows, idx) pairs are unique within one model's votes, so plain
             # fancy-index += is exact (and much faster than np.add.at)

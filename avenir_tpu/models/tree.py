@@ -859,14 +859,25 @@ def _match_ok(vals, codes, lo, hi, num_restricted, cat_mask, cat_restricted,
               xp):
     """(n, P) bool match matrix shared by the jnp and numpy backends (xp is
     the array namespace): record matches path iff every restricted feature
-    passes its interval / allowed-code mask."""
+    passes its interval / allowed-code mask.
+
+    The device backend computes categorical membership as a one-hot einsum
+    — the (n, P, F) advanced-index gather lowers to a scalar loop on TPU
+    and throttled predict to ~0.6M rows/sec; exact because each (n, f) row
+    of the one-hot selects a single 0/1 mask cell."""
     P, F = lo.shape
     interval = (vals[:, None, :] > lo[None]) & (vals[:, None, :] <= hi[None])
     num_ok = xp.where(num_restricted[None], interval, True)
-    safe = xp.clip(codes, 0, cat_mask.shape[2] - 1)
-    gathered = cat_mask[xp.arange(P)[None, :, None],
-                        xp.arange(F)[None, None, :],
-                        safe[:, None, :]]                      # (n, P, F)
+    C = cat_mask.shape[2]
+    safe = xp.clip(codes, 0, C - 1)
+    if xp is jnp:
+        oh = jax.nn.one_hot(safe, C, dtype=jnp.float32)        # (n, F, C)
+        gathered = jnp.einsum("nfc,pfc->npf", oh,
+                              cat_mask.astype(jnp.float32)) > 0
+    else:
+        gathered = cat_mask[xp.arange(P)[None, :, None],
+                            xp.arange(F)[None, None, :],
+                            safe[:, None, :]]                  # (n, P, F)
     cat_ok = xp.where(cat_restricted[None],
                       gathered & (codes >= 0)[:, None, :], True)
     return (num_ok & cat_ok).all(axis=2)
@@ -923,6 +934,35 @@ def _match_paths_np(vals, codes, lo, hi, num_restricted, cat_mask,
     cls = np.where(matched, path_cls[first], fallback_cls)
     prob = np.where(matched, path_prob[first], fallback_prob)
     return cls.astype(np.int32), prob.astype(np.float32)
+
+
+class FeatureCache:
+    """Per-table feature arrays shared across ensemble members: host build
+    once, host->device upload once (ensemble predict was uploading the same
+    ~32 MB per member on the tunneled chip).  Valid for PathMatrix instances
+    over the same schema — their feature layout (feat_ordinals order) is
+    identical by construction.  A cache is bound to the FIRST table it sees
+    and fails loudly on reuse with a different one."""
+
+    def __init__(self):
+        self._host = None
+        self._dev = None
+        self._table_id = None
+
+    def host(self, matrix: "PathMatrix", table: ColumnarTable):
+        if self._host is None:
+            self._host = matrix.feature_arrays(table)
+            self._table_id = id(table)
+        elif self._table_id != id(table):
+            raise ValueError("FeatureCache reused across tables; create one "
+                             "cache per table")
+        return self._host
+
+    def device(self, vals: np.ndarray, codes: np.ndarray):
+        if self._dev is None:
+            self._dev = (jnp.asarray(vals.astype(np.float32)),
+                         jnp.asarray(codes))
+        return self._dev
 
 
 class PathMatrix:
@@ -1047,28 +1087,41 @@ class PathMatrix:
             .all())
 
     def _row_chunk(self, chunk: int) -> int:
-        """Shared clamp: keep chunk * P * F around the 2^26-element mark so
-        the (n, P, F) match intermediate stays bounded."""
-        per_row = max(self.n_paths * max(len(self.feat_ordinals), 1), 1)
+        """Shared clamp: keep the per-chunk device intermediates around the
+        2^26-element mark — both the (n, P, F) match matrix and the
+        (n, F, Cmax) categorical one-hot (the latter dominates for
+        high-cardinality features)."""
+        F = max(len(self.feat_ordinals), 1)
+        per_row = max(self.n_paths * F, F * self.cat_mask.shape[2], 1)
         return max(1024, min(chunk, (1 << 26) // per_row))
 
     def predict_codes(self, table: ColumnarTable,
-                      chunk: int = 1 << 20) -> Tuple[np.ndarray, np.ndarray]:
+                      chunk: int = 1 << 20,
+                      features: Optional[Tuple] = None
+                      ) -> Tuple[np.ndarray, np.ndarray]:
         """(class idx per record, prob) as arrays; row-chunked, f32 device
-        kernel or f64 host twin per the shared ``_f32_safe`` gate."""
-        vals, codes = self.feature_arrays(table)
+        kernel or f64 host twin per the shared ``_f32_safe`` gate.
+
+        ``features`` optionally carries a FeatureCache so ensemble members
+        share ONE feature build + host->device upload per table — the
+        upload dominates predict wall time on the tunneled chip, and every
+        member reads the identical arrays."""
+        cache = features if features is not None else FeatureCache()
+        vals, codes = cache.host(self, table)
         n = table.n_rows
         if n == 0 or self.n_paths == 0 or not self.classes:
             return (np.zeros((n,), np.int32) - 1, np.zeros((n,), np.float32))
         f32_safe = self._f32_safe(vals)
         chunk = self._row_chunk(chunk)
         out_cls, out_prob = [], []
+        d_vals = d_codes = None
+        if f32_safe:
+            d_vals, d_codes = cache.device(vals, codes)
         for s in range(0, n, chunk):
             if f32_safe:
                 lo, hi, num_r, cat_m, cat_r, pc, pp = self._device_consts()
                 c, p = _match_paths(
-                    jnp.asarray(vals[s:s + chunk].astype(np.float32)),
-                    jnp.asarray(codes[s:s + chunk]),
+                    d_vals[s:s + chunk], d_codes[s:s + chunk],
                     lo, hi, num_r, cat_m, cat_r, pc, pp,
                     self.fallback_cls, jnp.float32(0.5))
                 out_cls.append(np.asarray(c))
@@ -1127,10 +1180,13 @@ class DecisionTreeModel:
         self.schema = schema
         self.matrix = PathMatrix(path_list, schema)
 
-    def predict(self, table: ColumnarTable) -> Tuple[List[str], np.ndarray]:
+    def predict(self, table: ColumnarTable,
+                features: Optional["FeatureCache"] = None
+                ) -> Tuple[List[str], np.ndarray]:
         """(pred_class per record, prob).  Records matching no path get the
-        globally most probable class (population-weighted)."""
-        cls_idx, prob = self.matrix.predict_codes(table)
+        globally most probable class (population-weighted).  ``features``
+        shares one feature build/upload across ensemble members."""
+        cls_idx, prob = self.matrix.predict_codes(table, features=features)
         if table.n_rows == 0 or self.matrix.n_paths == 0 \
                 or not self.matrix.classes:
             return [""] * table.n_rows, np.zeros((table.n_rows,))

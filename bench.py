@@ -188,6 +188,29 @@ def rf_big_rate(n):
     return dict(rf_rate(n), metric="random_forest_2m_rows_x_trees_per_sec")
 
 
+def rf_predict_rate(n):
+    """Flagship predict half: 9-tree ensemble vote over n rows, one fused
+    device launch per chunk (models byte-identical to the host vote)."""
+    from avenir_tpu.models.forest import (EnsembleModel, ForestParams,
+                                          build_forest)
+    from avenir_tpu.models.tree import DecisionTreeModel
+    from avenir_tpu.parallel.mesh import MeshContext
+    table = _bench_table(n)
+    params = ForestParams(num_trees=9, seed=1)
+    params.tree.max_depth = 4
+    models = [DecisionTreeModel(m, table.schema)
+              for m in build_forest(table, params, MeshContext())]
+    ens = EnsembleModel(models)
+    ens.predict(table)  # compile + warm
+    t0 = time.perf_counter()
+    pred = ens.predict(table)
+    dt = time.perf_counter() - t0
+    assert len(pred) == n
+    return {"metric": "rf_ensemble_predict_rows_x_trees_per_sec",
+            "value": round(n * len(models) / dt, 1),
+            "unit": "rows*trees/sec", "n": n, "trees": len(models)}
+
+
 def sa_rate(n_chains):
     """Simulated annealing: n_chains independent Metropolis chains over a
     matrix-cost assignment domain, 2000 iterations in one lax.scan — the
@@ -216,6 +239,7 @@ WORKLOADS = {
     "rf_big": (rf_big_rate, [2_000_000]),
     "knn": (knn_rate, [8_000, 4_000]),
     "knn_big": (knn_big_rate, [20_000]),
+    "rf_predict": (rf_predict_rate, [1_000_000, 200_000]),
     "sa": (sa_rate, [4_096, 512]),
 }
 

@@ -186,3 +186,31 @@ def test_predict_empty_table(mesh_ctx):
     pred, prob = models[0].predict(empty)
     assert pred == [] and prob.shape == (0,)
     assert EnsembleModel(models).predict(empty) == []
+
+
+def test_ensemble_fused_device_vote_matches_host(mesh_ctx):
+    """The stacked one-launch ensemble vote == the per-member host path,
+    including weighted votes and the min-odds veto."""
+    import bench
+    from avenir_tpu.models.forest import (EnsembleModel, ForestParams,
+                                          build_forest)
+    from avenir_tpu.models.tree import DecisionTreeModel
+    table = bench._bench_table(3000, seed=4)
+    params = ForestParams(num_trees=5, seed=2)
+    params.tree.max_depth = 3
+    models = [DecisionTreeModel(m, table.schema)
+              for m in build_forest(table, params)]
+    for kwargs in ({}, {"weights": [1.0, 2.0, 1.0, 3.0, 1.0]},
+                   {"min_odds_ratio": 1.5}):
+        ens = EnsembleModel(models, **kwargs)
+        assert ens._stacked is not None
+        from avenir_tpu.models.tree import FeatureCache
+        cache = FeatureCache()
+        vals, codes = cache.host(models[0].matrix, table)
+        dev = ens._predict_device(vals, codes, cache)
+        host = ens._predict_host(table, FeatureCache())
+        assert dev == host, f"mismatch for {kwargs}"
+    # fractional weights must take the f64 host path (f32 vote sums could
+    # flip ties), degenerate nothing else: stacked is None
+    assert EnsembleModel(models,
+                         weights=[1.0, 0.5, 1.0, 1.0, 1.0])._stacked is None
