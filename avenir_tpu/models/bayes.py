@@ -28,6 +28,7 @@ a tiny (C,)-vector epilogue per record, all vmapped.
 
 from __future__ import annotations
 
+import functools
 import math
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -171,6 +172,16 @@ class NaiveBayesModel:
 # training
 # --------------------------------------------------------------------------
 
+@functools.partial(jax.jit, static_argnums=(4, 5))
+def _train_kernel(cc, bc, cv, m, C, bmax):
+    """Module-level jit: the per-call closure recompiled on every train."""
+    counts = class_bin_histogram(cc, bc, C, bmax, m)
+    cls_counts = jax.nn.one_hot(cc, C, dtype=jnp.float32)
+    cls_counts = (cls_counts * m.astype(jnp.float32)[:, None]).sum(axis=0)
+    moments = class_moments(cc, cv, C, m)
+    return counts, cls_counts, moments
+
+
 def train(table: ColumnarTable, ctx: Optional[MeshContext] = None,
           counters: Optional[Counters] = None) -> NaiveBayesModel:
     """One-pass distribution computation (== BayesianDistribution MR job).
@@ -206,16 +217,9 @@ def train(table: ColumnarTable, ctx: Optional[MeshContext] = None,
         cont_vals = np.zeros((padded.n_rows, 0), dtype=np.float64)
     cont_vals = ctx.shard_rows(cont_vals.astype(np.float32))
 
-    @jax.jit
-    def kernel(cc, bc, cv, m):
-        counts = class_bin_histogram(cc, bc, C, bmax, m)
-        cls_counts = jax.nn.one_hot(cc, C, dtype=jnp.float32)
-        cls_counts = (cls_counts * m.astype(jnp.float32)[:, None]).sum(axis=0)
-        moments = class_moments(cc, cv, C, m)
-        return counts, cls_counts, moments
-
     counts, cls_counts, moments = (
-        np.array(x) for x in kernel(cls_codes, bin_codes, cont_vals, mask))
+        np.array(x) for x in _train_kernel(cls_codes, bin_codes, cont_vals,
+                                           mask, C, bmax))
 
     # zero out bins beyond each field's alphabet (padding of Bmax)
     for fi, nb in enumerate(nbins):

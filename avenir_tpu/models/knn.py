@@ -21,6 +21,7 @@ Capability parity with org.avenir.knn (SURVEY.md §2.3, call stack §3.4):
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -87,6 +88,32 @@ class KnnResult:
     pos_class_prob: Optional[np.ndarray] = None      # (n,) int percent
 
 
+@functools.partial(jax.jit, static_argnums=3)
+def _topk_kernel(d, cls, fpp, k):
+    """Module-level jit (per-call closures recompiled on every classify)."""
+    neg_topv, idx = jax.lax.top_k(-d, k)
+    return -neg_topv, cls[idx], fpp[idx]
+
+
+@functools.partial(jax.jit, static_argnames=("kernel_function",
+                                             "kernel_param", "C",
+                                             "inverse_distance_weighted"))
+def _distr_kernel(nd, ncls, nfpp, kernel_function, kernel_param, C,
+                  inverse_distance_weighted):
+    """Neighbor scores -> (class_distr, weighted) per test row; module-level
+    jit keyed on the scalar knobs."""
+    valid = nd < PAD_DISTANCE
+    scores = kernel_scores(nd, kernel_function, kernel_param)
+    scores = scores * valid.astype(scores.dtype)
+    oh = jax.nn.one_hot(ncls, C, dtype=jnp.int32)   # (n, k, C)
+    class_distr = (scores[:, :, None] * oh).sum(axis=1)     # (n, C)
+    wscores = jnp.where(nfpp > 0, scores * nfpp, scores.astype(jnp.float32))
+    if inverse_distance_weighted:
+        wscores = wscores / jnp.maximum(nd.astype(jnp.float32), 1e-9)
+    weighted = (wscores[:, :, None] * oh.astype(jnp.float32)).sum(axis=1)
+    return class_distr, weighted
+
+
 def classify(distances: np.ndarray,            # (n_test, n_train) int
              train_classes: np.ndarray,        # (n_train,) int codes
              class_values: Sequence[str],
@@ -98,15 +125,9 @@ def classify(distances: np.ndarray,            # (n_test, n_train) int
     fpp = feature_post_prob if feature_post_prob is not None else \
         np.full((distances.shape[1],), -1.0, dtype=np.float32)
     k = min(params.top_match_count, distances.shape[1])
-
-    @jax.jit
-    def kern(d, cls, fpp):
-        neg_topv, idx = jax.lax.top_k(-d, k)
-        return -neg_topv, cls[idx], fpp[idx]
-
-    nd, ncls, nfpp = (np.asarray(x) for x in kern(
+    nd, ncls, nfpp = (np.asarray(x) for x in _topk_kernel(
         jnp.asarray(distances), jnp.asarray(train_classes),
-        jnp.asarray(fpp, dtype=jnp.float32)))
+        jnp.asarray(fpp, dtype=jnp.float32), k))
     return _classify_topk(nd, ncls, nfpp, class_values, params)
 
 
@@ -157,22 +178,12 @@ def _classify_topk(nd: np.ndarray, ncls: np.ndarray, nfpp: np.ndarray,
                                       "linearAdditive", "gaussian"):
         raise ValueError(f"unknown kernel function {params.kernel_function!r}")
 
-    @jax.jit
-    def kern(nd, ncls, nfpp):
-        valid = nd < PAD_DISTANCE
-        scores = kernel_scores(nd, params.kernel_function, params.kernel_param)
-        scores = scores * valid.astype(scores.dtype)
-        oh = jax.nn.one_hot(ncls, C, dtype=jnp.int32)   # (n, k, C)
-        class_distr = (scores[:, :, None] * oh).sum(axis=1)     # (n, C)
-        wscores = jnp.where(nfpp > 0, scores * nfpp, scores.astype(jnp.float32))
-        if params.inverse_distance_weighted:
-            wscores = wscores / jnp.maximum(nd.astype(jnp.float32), 1e-9)
-        weighted = (wscores[:, :, None] * oh.astype(jnp.float32)).sum(axis=1)
-        return class_distr, weighted
-
-    class_distr, weighted = (np.asarray(x) for x in kern(
+    class_distr, weighted = (np.asarray(x) for x in _distr_kernel(
         jnp.asarray(nd.astype(np.int32)), jnp.asarray(ncls),
-        jnp.asarray(nfpp, dtype=jnp.float32)))
+        jnp.asarray(nfpp, dtype=jnp.float32),
+        kernel_function=params.kernel_function,
+        kernel_param=params.kernel_param, C=C,
+        inverse_distance_weighted=params.inverse_distance_weighted))
 
     if params.prediction_mode == "regression":
         vals = np.asarray(
