@@ -171,6 +171,31 @@ def _normalize(counts: np.ndarray, scale: int, laplace: float) -> np.ndarray:
     return c / rows * scale
 
 
+@jax.jit
+def _log_odds_kernel(codes, lens, m0, m1):
+    """Module-level jit (a per-call closure recompiled each classify).
+
+    The per-pair log ratio is computed once as an (S, S) table and looked
+    up via a two-sided one-hot einsum at HIGHEST precision — the
+    (n, T) 2-D gather it replaces lowers to a scalar loop on TPU; each
+    output selects exactly one table cell, so values are bit-identical.
+    The table is guarded BEFORE masking: zero matrix cells would give
+    inf, and inf * 0 = NaN would poison every short sequence's row sum."""
+    S = m0.shape[0]
+    fr = jnp.clip(codes[:, :-1], 0, None)
+    to = jnp.clip(codes[:, 1:], 0, None)
+    pos = jnp.arange(codes.shape[1] - 1)[None, :]
+    valid = (pos < (lens[:, None] - 1)) & (codes[:, :-1] >= 0) & \
+        (codes[:, 1:] >= 0)
+    lr = jnp.log(jnp.clip(m0, 1e-12, None) /
+                 jnp.clip(m1, 1e-12, None))                 # (S, S)
+    oh_fr = jax.nn.one_hot(fr, S, dtype=jnp.float32)        # (n, T, S)
+    oh_to = jax.nn.one_hot(to, S, dtype=jnp.float32)
+    ratio = jnp.einsum("nts,ntu,su->nt", oh_fr, oh_to, lr,
+                       precision=jax.lax.Precision.HIGHEST)
+    return jnp.where(valid, ratio, 0.0).sum(axis=1)
+
+
 def classify(model: MarkovModel, sequences: Sequence[Sequence[str]],
              class_labels: Sequence[str],
              log_odds_threshold: float = 0.0) -> Tuple[List[str], np.ndarray]:
@@ -179,22 +204,8 @@ def classify(model: MarkovModel, sequences: Sequence[Sequence[str]],
     codes, lens = encode_sequences(sequences, model.states)
     m0 = jnp.asarray(model.matrices[class_labels[0]])
     m1 = jnp.asarray(model.matrices[class_labels[1]])
-
-    @jax.jit
-    def kernel(codes, lens):
-        fr = jnp.clip(codes[:, :-1], 0, None)
-        to = jnp.clip(codes[:, 1:], 0, None)
-        pos = jnp.arange(codes.shape[1] - 1)[None, :]
-        valid = (pos < (lens[:, None] - 1)) & (codes[:, :-1] >= 0) & \
-            (codes[:, 1:] >= 0)
-        # guard the gathered ratio BEFORE multiplying by the mask: clipped
-        # padding positions can hit zero matrix cells, and inf * 0 = NaN
-        # would otherwise poison every short sequence's row sum
-        ratio = jnp.log(jnp.clip(m0[fr, to], 1e-12, None) /
-                        jnp.clip(m1[fr, to], 1e-12, None))
-        return jnp.where(valid, ratio, 0.0).sum(axis=1)
-
-    log_odds = np.asarray(kernel(jnp.asarray(codes), jnp.asarray(lens)))
+    log_odds = np.asarray(_log_odds_kernel(jnp.asarray(codes),
+                                           jnp.asarray(lens), m0, m1))
     pred = [class_labels[0] if lo > log_odds_threshold else class_labels[1]
             for lo in log_odds]
     return pred, log_odds
