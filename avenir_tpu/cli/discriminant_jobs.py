@@ -7,7 +7,7 @@ job's reuse of the numeric-stats pipeline (FisherDiscriminant.java).
 
 from __future__ import annotations
 
-from typing import Dict, List, Tuple
+from typing import List
 
 import numpy as np
 

@@ -16,7 +16,7 @@ from ..core.config import Config
 from ..core.metrics import Counters
 from ..core import artifacts
 from ..core.table import load_csv
-from ..parallel.mesh import MeshContext, runtime_context
+from ..parallel.mesh import runtime_context
 from .jobs import register, _schema_path, _splitter
 
 

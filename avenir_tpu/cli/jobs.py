@@ -10,7 +10,7 @@ scripts keep working by swapping the ``hadoop jar``/``spark-submit`` line for
 from __future__ import annotations
 
 import os
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List
 
 import numpy as np
 
@@ -19,7 +19,7 @@ from ..core.schema import FeatureSchema
 from ..core.table import load_csv
 from ..core.metrics import Counters, CostBasedArbitrator
 from ..core import artifacts
-from ..parallel.mesh import MeshContext, runtime_context
+from ..parallel.mesh import runtime_context
 
 JOBS: Dict[str, Callable] = {}
 

@@ -29,7 +29,6 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..core.schema import FeatureSchema
 from ..core.table import ColumnarTable
 
 

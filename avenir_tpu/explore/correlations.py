@@ -26,7 +26,6 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..core.schema import FeatureSchema
 from ..core.table import ColumnarTable
 from ..ops.histogram import joint_histogram
 from ..parallel.mesh import MeshContext, runtime_context

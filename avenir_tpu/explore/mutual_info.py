@@ -20,14 +20,13 @@ class-augmented variant) — instead of the reference's per-pair shuffle keys.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import List, Optional, Tuple
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 
-from ..core.schema import FeatureSchema
 from ..core.table import ColumnarTable
 from ..parallel.mesh import MeshContext, runtime_context
 

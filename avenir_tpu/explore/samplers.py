@@ -16,14 +16,12 @@ Parity targets (SURVEY.md §2.4):
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
 import jax
-import jax.numpy as jnp
 
-from ..core.schema import FeatureSchema
 from ..core.table import ColumnarTable
 from ..ops.distance import DistanceComputer
 from ..parallel.mesh import MeshContext

@@ -29,9 +29,8 @@ a tiny (C,)-vector epilogue per record, all vmapped.
 from __future__ import annotations
 
 import functools
-import math
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -40,7 +39,7 @@ import jax.numpy as jnp
 
 from ..core.schema import FeatureSchema, FeatureField
 from ..core.table import ColumnarTable
-from ..core.metrics import ConfusionMatrix, Counters, CostBasedArbitrator
+from ..core.metrics import ConfusionMatrix, Counters
 from ..parallel.mesh import MeshContext, runtime_context
 from ..ops.histogram import class_bin_histogram, class_moments
 

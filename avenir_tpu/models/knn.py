@@ -23,14 +23,14 @@ from __future__ import annotations
 
 import functools
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 
-from ..core.metrics import ConfusionMatrix, CostBasedArbitrator, Counters
+from ..core.metrics import CostBasedArbitrator
 
 KERNEL_SCALE = 100
 PROB_SCALE = 100

@@ -9,7 +9,7 @@ Scores are accuracies per fold (the reference prints sklearn cv scores).
 
 from __future__ import annotations
 
-from typing import Callable, List, NamedTuple, Optional, Sequence, Tuple
+from typing import Callable, List, NamedTuple, Sequence, Tuple
 
 import numpy as np
 

@@ -23,14 +23,14 @@ distance is a matmul problem, not a join problem —
 from __future__ import annotations
 
 import functools
-from typing import List, Optional, Tuple
+from typing import List, Tuple
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 
-from ..core.schema import FeatureSchema, FeatureField
+from ..core.schema import FeatureSchema
 from ..core.table import ColumnarTable
 
 

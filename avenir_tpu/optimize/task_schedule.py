@@ -27,11 +27,10 @@ import json
 import math
 import re
 from datetime import datetime
-from typing import Dict, List, Optional
+from typing import Dict
 
 import numpy as np
 
-import jax.numpy as jnp
 
 from .domain import MatrixCostDomain
 

@@ -24,7 +24,6 @@ import math
 import random
 from typing import Dict, List, Optional, Sequence, Tuple
 
-import numpy as np
 
 
 class ActionStat:

@@ -20,7 +20,7 @@ from typing import Dict, Optional, Sequence
 
 import numpy as np
 
-from .learners import MultiArmBanditLearner, create_learner
+from .learners import create_learner
 
 
 class ReinforcementLearnerService:

@@ -14,7 +14,7 @@ is forced here.  The Bayesian text mode reuses ``tokenize``.
 from __future__ import annotations
 
 import re
-from typing import Dict, List, Sequence, Tuple
+from typing import List, Sequence, Tuple
 
 import numpy as np
 
