@@ -22,8 +22,8 @@ Capability parity with org.avenir.bayesian (SURVEY.md §2.2):
 
 TPU design: the whole training pass is two MXU contractions over row-sharded
 arrays (ops.histogram.class_bin_histogram / class_moments); XLA inserts the
-cross-shard all-reduce.  Prediction is a gather of per-feature log-probs plus
-a tiny (C,)-vector epilogue per record, all vmapped.
+cross-shard all-reduce.  Prediction selects per-feature log-probs via one-hot
+einsums plus a tiny (C,)-vector epilogue per record, all in one jitted pass.
 """
 
 from __future__ import annotations

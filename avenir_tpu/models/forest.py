@@ -139,7 +139,7 @@ class ForestBuilder:
     bit-identical to ``build_forest(..., batched=False)`` — but the level
     histogram runs once for the whole forest ((n, T) node/weight arrays,
     counts (T, N, S, B, C) in one einsum) and records are re-tagged for all
-    trees in one vmapped gather."""
+    trees by the fused one-hot reassign inside the level kernel."""
 
     def __init__(self, table: ColumnarTable, params: ForestParams,
                  ctx: Optional[MeshContext] = None):

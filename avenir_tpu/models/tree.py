@@ -23,7 +23,8 @@ row-sharded arrays computing, for every (node, candidate-split, branch,
 class), a weighted count via two one-hot MXU contractions — the exact
 mapper x shuffle x reducer of the reference collapsed into one matmul.
 The per-record node id is a dense int32 vector updated on device after the
-host picks winners (a gather per level).  All shapes are static per level.
+host picks winners (a one-hot-select reassign fused into the next level's
+kernel).  All shapes are static per level.
 
 Known deliberate divergence: for multi-threshold splits the reference emits
 a record into EVERY matching predicate, and its unbounded last 'le'

@@ -139,7 +139,7 @@ class SearchDomain:
 class MatrixCostDomain(SearchDomain):
     """Domain whose cost is sum of per-(position, choice) costs plus an
     optional pairwise penalty — covers assignment-style problems (the
-    TaskSchedule example) with one gather per evaluation."""
+    TaskSchedule example) with one masked-select lookup per evaluation."""
 
     cost_matrix: np.ndarray                    # (L, n_choices)
     # optional conflicts: conflict[l1, l2] == 1 means positions l1 != l2 may

@@ -17,8 +17,8 @@ Parity targets (SURVEY.md §2.5):
     max-likelihood hidden path; here a lax.scan DP batched over sequences.
 
 TPU design: transition counting is a joint histogram of (from, to[, class])
-code pairs (MXU contraction); the classifier is a gather of log-ratio terms
-over padded sequence arrays; Viterbi is a vmapped lax.scan over the padded
+code pairs (MXU contraction); the classifier selects log-ratio terms from an
+(S, S) table via one-hot einsums; Viterbi is a vmapped lax.scan over the padded
 batch with per-sequence length masks.
 """
 
