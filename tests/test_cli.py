@@ -89,3 +89,25 @@ def test_cli_arg_parsing():
     # spark style trailing conf
     name2, conf2, _, pos2 = cli_run.parse_args(["simulatedAnnealing", "/out", "/x/opt.conf"])
     assert conf2 == "/x/opt.conf" and pos2 == ["/out"]
+
+
+def test_cli_exports_profiling_counters(tmp_path, capsys):
+    """Every job's counter dump carries the StepTimer's job timing
+    (SURVEY §5 step-timing contract)."""
+    import os
+    import sys
+    from avenir_tpu.cli import run as cli_run
+    res = os.path.abspath(
+        os.path.join(os.path.dirname(__file__), "..", "resource"))
+    sys.path.insert(0, res)
+    from gen import telecom_churn_gen
+    train = tmp_path / "t.csv"
+    train.write_text("\n".join(telecom_churn_gen.generate(128, 2)))
+    rc = cli_run.main([
+        "org.avenir.bayesian.BayesianDistribution",
+        f"-Dconf.path={res}/churn.properties",
+        f"-Dbad.feature.schema.file.path={res}/churn.json",
+        str(train), str(tmp_path / "m")])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Profiling" in out and "job.timeMs" in out and "job.calls=1" in out

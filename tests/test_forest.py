@@ -214,3 +214,18 @@ def test_ensemble_fused_device_vote_matches_host(mesh_ctx):
     # flip ties), degenerate nothing else: stacked is None
     assert EnsembleModel(models,
                          weights=[1.0, 0.5, 1.0, 1.0, 1.0])._stacked is None
+
+
+def test_feature_cache_rejects_cross_table_reuse(mesh_ctx):
+    import bench
+    import pytest
+    from avenir_tpu.models.forest import ForestParams, build_forest
+    from avenir_tpu.models.tree import DecisionTreeModel, FeatureCache
+    t1 = bench._bench_table(200, seed=1)
+    t2 = bench._bench_table(200, seed=2)
+    m = DecisionTreeModel(build_forest(t1, ForestParams(num_trees=1))[0],
+                          t1.schema)
+    cache = FeatureCache()
+    m.predict(t1, features=cache)
+    with pytest.raises(ValueError, match="reused across tables"):
+        m.predict(t2, features=cache)
