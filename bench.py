@@ -257,7 +257,18 @@ _CHILD_PRELUDE = (
     "import os, jax\n"
     "want = os.environ.get('JAX_PLATFORMS')\n"
     "if want and want != jax.config.jax_platforms:\n"
-    "    jax.config.update('jax_platforms', want)\n")
+    "    jax.config.update('jax_platforms', want)\n"
+    # persistent compilation cache shared across the watchdog children:
+    # each child is a fresh process, and without this every workload
+    # re-pays the 20-75s per-shape compile bill (backends that cannot
+    # serialize executables silently skip caching)
+    "try:\n"
+    "    jax.config.update('jax_compilation_cache_dir',\n"
+    "                      os.environ.get('AVENIR_TPU_JAX_CACHE',\n"
+    "                                     '/tmp/avenir_tpu_jax_cache'))\n"
+    "    jax.config.update('jax_persistent_cache_min_compile_time_secs', 2)\n"
+    "except Exception:\n"
+    "    pass\n")
 
 
 TIMEOUT = "timeout"  # _run_child sentinel: wedge/hang (vs crash -> None)
