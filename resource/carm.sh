@@ -1,0 +1,22 @@
+#!/bin/bash
+# Call-data rule-mining driver (reference resource/carm.sh flow:
+# mutual-information feature ranking, then per-value class affinity).
+#   ./carm.sh mutualInfo <calls.csv> <out_dir>
+#   ./carm.sh affinity   <calls.csv> <out_dir>
+set -e
+DIR=$(cd "$(dirname "$0")" && pwd)
+RUN="python -m avenir_tpu.cli.run"
+PROPS="$DIR/carm.properties"
+
+case "$1" in
+mutualInfo)
+  $RUN org.avenir.explore.MutualInformation -Dconf.path=$PROPS \
+      -Dmut.feature.schema.file.path=$DIR/cust_call.json "$2" "$3"
+  ;;
+affinity)
+  $RUN org.avenir.explore.CategoricalClassAffinity -Dconf.path=$PROPS \
+      -Dcca.feature.schema.file.path=$DIR/cust_call.json "$2" "$3"
+  ;;
+*)
+  echo "usage: $0 mutualInfo|affinity <in> <out>" >&2; exit 2 ;;
+esac

@@ -1,0 +1,47 @@
+#!/usr/bin/env python3
+"""Synthetic customer-service call records for the carm (call-data rule
+mining) use case — the role of the reference's call_hangup.py data
+generator for carm.properties / call_data_rule_mining_tutorial.txt.
+Resolution depends strongly on issue type and hold time, weakly on time
+of day, and not at all on area code, so mutual-information ranking and
+class-affinity odds have real structure to find.
+Line: callId,custType,areaCode,issue,timeOfDay,holdTime,resolved
+Usage: cust_call_gen.py <n_rows> [seed] > calls.csv
+"""
+
+import sys
+
+import numpy as np
+
+CUST_TYPES = ["residential", "smallBusiness", "enterprise"]
+AREA_CODES = ["408", "415", "510", "650", "925"]
+ISSUES = ["billing", "outage", "upgrade", "cancellation", "other"]
+TIMES = ["morning", "afternoon", "evening", "night"]
+
+# base probability a call resolves, by issue
+ISSUE_RESOLVE = {"billing": 0.85, "outage": 0.45, "upgrade": 0.90,
+                 "cancellation": 0.30, "other": 0.70}
+TIME_SHIFT = {"morning": 0.05, "afternoon": 0.02, "evening": -0.03,
+              "night": -0.10}
+
+
+def generate(n: int, seed: int = 1):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(n):
+        ct = CUST_TYPES[rng.integers(len(CUST_TYPES))]
+        ac = AREA_CODES[rng.integers(len(AREA_CODES))]
+        issue = ISSUES[rng.integers(len(ISSUES))]
+        tod = TIMES[rng.integers(len(TIMES))]
+        hold = int(rng.gamma(2.0, 150.0))
+        hold = min(hold, 1799)
+        p = ISSUE_RESOLVE[issue] + TIME_SHIFT[tod] - 0.0002 * hold
+        resolved = "T" if rng.random() < np.clip(p, 0.02, 0.98) else "F"
+        rows.append(f"C{i:06d},{ct},{ac},{issue},{tod},{hold},{resolved}")
+    return rows
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
+    seed = int(sys.argv[2]) if len(sys.argv) > 2 else 1
+    print("\n".join(generate(n, seed)))

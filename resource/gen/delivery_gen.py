@@ -1,0 +1,40 @@
+#!/usr/bin/env python3
+"""Synthetic supply-chain delivery records for the hica (high-cardinality
+categorical encoding) use case — the reference's
+high_cardinality_supply_chain_data_tutorial.txt data, where each of ~50
+product ids carries its own latent on-time delivery rate and the point of
+the flow is to learn a supervised continuous encoding of prodId.
+Line: orderId,prodId,quantity,month,onTime
+Usage: delivery_gen.py <n_rows> [seed] > deliveries.csv
+"""
+
+import sys
+
+import numpy as np
+
+N_PRODUCTS = 50
+MONTHS = ["Jan", "Feb", "Mar", "Apr", "May", "Jun", "Jul", "Aug",
+          "Sep", "Oct", "Nov", "Dec"]
+
+
+def generate(n: int, seed: int = 1):
+    rng = np.random.default_rng(seed)
+    # latent per-product on-time rate, spread over [0.25, 0.95]
+    prod_rate = rng.uniform(0.25, 0.95, N_PRODUCTS)
+    rows = []
+    for i in range(n):
+        p = int(rng.integers(N_PRODUCTS))
+        qty = int(rng.integers(1, 100))
+        month = MONTHS[rng.integers(12)]
+        # holiday season and big orders slip more often
+        rate = prod_rate[p] - (0.10 if month in ("Nov", "Dec") else 0.0) \
+            - 0.001 * qty
+        on_time = "T" if rng.random() < np.clip(rate, 0.05, 0.98) else "F"
+        rows.append(f"O{i:06d},P{p:03d},{qty},{month},{on_time}")
+    return rows
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
+    seed = int(sys.argv[2]) if len(sys.argv) > 2 else 1
+    print("\n".join(generate(n, seed)))

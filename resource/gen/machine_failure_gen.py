@@ -1,0 +1,39 @@
+#!/usr/bin/env python3
+"""Synthetic machine sensor records for the ovsa (SMOTE oversampling) use
+case — the reference's machine_op.py role for ovsa.properties /
+over_sampling_by_smote_for_machine_failure_data_tutorial.txt.  Failures
+are a rare class (~8%) concentrated at high temperature/vibration/age, so
+class-based SMOTE has a genuine minority manifold to interpolate.
+Line: machineId,temperature,vibration,pressure,runtimeHours,ageYears,failed
+Usage: machine_failure_gen.py <n_rows> [seed] > machines.csv
+"""
+
+import sys
+
+import numpy as np
+
+
+def generate(n: int, seed: int = 1):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(n):
+        fail = rng.random() < 0.08
+        if fail:
+            temp = int(np.clip(rng.normal(95, 12), 20, 120))
+            vib = int(np.clip(rng.normal(70, 15), 0, 100))
+            age = int(np.clip(rng.normal(16, 5), 0, 25))
+        else:
+            temp = int(np.clip(rng.normal(60, 15), 20, 120))
+            vib = int(np.clip(rng.normal(30, 15), 0, 100))
+            age = int(np.clip(rng.normal(7, 5), 0, 25))
+        pres = int(np.clip(rng.normal(150, 35), 50, 250))
+        hours = int(np.clip(rng.gamma(2.0, 3000.0), 0, 20000))
+        rows.append(f"M{i:05d},{temp},{vib},{pres},{hours},{age},"
+                    f"{'T' if fail else 'F'}")
+    return rows
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
+    seed = int(sys.argv[2]) if len(sys.argv) > 2 else 1
+    print("\n".join(generate(n, seed)))

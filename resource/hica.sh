@@ -1,0 +1,23 @@
+#!/bin/bash
+# High-cardinality encoding driver (reference resource/hica.sh flow:
+# supervised continuous encoding of a high-cardinality categorical).
+#   ./hica.sh encode <deliveries.csv> <out_dir>
+#   ./hica.sh woe    <deliveries.csv> <out_dir>   (weight-of-evidence variant)
+set -e
+DIR=$(cd "$(dirname "$0")" && pwd)
+RUN="python -m avenir_tpu.cli.run"
+PROPS="$DIR/hica.properties"
+
+case "$1" in
+encode)
+  $RUN org.avenir.explore.CategoricalContinuousEncoding -Dconf.path=$PROPS \
+      -Dcoe.feature.schema.file.path=$DIR/delivery.json "$2" "$3"
+  ;;
+woe)
+  $RUN org.avenir.explore.CategoricalContinuousEncoding -Dconf.path=$PROPS \
+      -Dcoe.feature.schema.file.path=$DIR/delivery.json \
+      -Dcoe.encoding.strategy=weightOfEvidence "$2" "$3"
+  ;;
+*)
+  echo "usage: $0 encode|woe <in> <out>" >&2; exit 2 ;;
+esac

@@ -1,0 +1,26 @@
+#!/bin/bash
+# SMOTE oversampling driver (reference resource/ovsa.sh flow: all-pairs
+# distances, same-class top-k neighbors, then synthetic minority records).
+#   ./ovsa.sh distance   <machines.csv|dir> <pairs_dir>
+#   ./ovsa.sh neighbors  <pairs_dir> <matches_dir>
+#   ./ovsa.sh oversample <machines.csv> <balanced_dir>
+set -e
+DIR=$(cd "$(dirname "$0")" && pwd)
+RUN="python -m avenir_tpu.cli.run"
+PROPS="$DIR/ovsa.properties"
+
+case "$1" in
+distance)
+  $RUN org.sifarish.feature.SameTypeSimilarity -Dconf.path=$PROPS \
+      -Dsts.same.schema.file.path=$DIR/machine_failure.json "$2" "$3"
+  ;;
+neighbors)
+  $RUN org.avenir.explore.TopMatchesByClass -Dconf.path=$PROPS "$2" "$3"
+  ;;
+oversample)
+  $RUN org.avenir.explore.ClassBasedOverSampler -Dconf.path=$PROPS \
+      -Dcbos.feature.schema.file.path=$DIR/machine_failure.json "$2" "$3"
+  ;;
+*)
+  echo "usage: $0 distance|neighbors|oversample <in> <out>" >&2; exit 2 ;;
+esac

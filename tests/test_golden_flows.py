@@ -262,7 +262,119 @@ def test_apriori_flow(tmp_path):
     assert "beer" in text and "chips" in text
 
 
+def test_carm_rule_mining_flow(tmp_path):
+    """carm.sh: mutual-info feature ranking -> per-value class affinity
+    (reference carm.properties + call_data_rule_mining_tutorial.txt)."""
+    data = tmp_path / "calls.csv"
+    data.write_text("\n".join(_gen("cust_call_gen", 3000, 1)))
+    props = os.path.join(RES, "carm.properties")
+    rc = cli_run.main([
+        "org.avenir.explore.MutualInformation", f"-Dconf.path={props}",
+        f"-Dmut.feature.schema.file.path={RES}/cust_call.json",
+        str(data), str(tmp_path / "mi")])
+    assert rc == 0
+    lines = list((tmp_path / "mi").glob("part-*"))[0].read_text().splitlines()
+    mi = {l.split(",")[1]: float(l.split(",")[2])
+          for l in lines if l.startswith("mutualInfo,")}
+    # issue (ord 3) drives resolution; areaCode (ord 2) is pure noise
+    assert mi["3"] > mi["2"]
+    # both configured selection algorithms emitted scores for every feature
+    for alg in ("joint.mutual.info", "min.redundancy.max.relevance"):
+        assert sum(1 for l in lines if l.startswith(f"score,{alg},")) == 5
+    rc = cli_run.main([
+        "org.avenir.explore.CategoricalClassAffinity", f"-Dconf.path={props}",
+        f"-Dcca.feature.schema.file.path={RES}/cust_call.json",
+        str(data), str(tmp_path / "aff")])
+    assert rc == 0
+    aff = list((tmp_path / "aff").glob("part-*"))[0].read_text().splitlines()
+    # one line per (attr, value) over ordinals 1-4: 3+5+5+4 values
+    assert len(aff) == 17
+    by_val = {(l.split(",")[0], l.split(",")[1]): l.split(",") for l in aff}
+    # cancellations resolve far less often than upgrades
+    t_col = lambda parts: float(parts[parts.index("T") + 1])
+    assert t_col(by_val[("3", "upgrade")]) > t_col(by_val[("3", "cancellation")])
+
+
+def test_hica_encoding_flow(tmp_path):
+    """hica.sh: supervised continuous encoding of a 50-value categorical
+    (reference hica.properties + high-cardinality tutorial)."""
+    data = tmp_path / "deliveries.csv"
+    data.write_text("\n".join(_gen("delivery_gen", 6000, 2)))
+    props = os.path.join(RES, "hica.properties")
+    rc = cli_run.main([
+        "org.avenir.explore.CategoricalContinuousEncoding",
+        f"-Dconf.path={props}",
+        f"-Dcoe.feature.schema.file.path={RES}/delivery.json",
+        str(data), str(tmp_path / "enc")])
+    assert rc == 0
+    lines = list((tmp_path / "enc").glob("part-*"))[0].read_text().splitlines()
+    enc = {l.split(",")[1]: int(l.split(",")[2]) for l in lines}
+    assert len(enc) == 50  # every product got an encoding
+    # encodings are supervised target rates in [0, 100] with real spread
+    vals = np.array(list(enc.values()))
+    assert vals.min() >= 0 and vals.max() <= 100
+    assert vals.max() - vals.min() > 30
+    # weight-of-evidence variant runs on the same config
+    rc = cli_run.main([
+        "org.avenir.explore.CategoricalContinuousEncoding",
+        f"-Dconf.path={props}",
+        f"-Dcoe.feature.schema.file.path={RES}/delivery.json",
+        "-Dcoe.encoding.strategy=weightOfEvidence",
+        str(data), str(tmp_path / "woe")])
+    assert rc == 0
+    woe_lines = list((tmp_path / "woe").glob("part-*"))[0] \
+        .read_text().splitlines()
+    woe = np.array([int(l.split(",")[2]) for l in woe_lines])
+    # log-odds encodings: every product present, spanning both signs
+    assert len(woe) == 50 and woe.min() < 0 < woe.max()
+
+
+def test_ovsa_smote_flow(tmp_path):
+    """ovsa.sh: all-pairs distances -> same-class top-k -> SMOTE synthesis
+    (reference ovsa.properties + machine-failure SMOTE tutorial)."""
+    data = tmp_path / "machines.csv"
+    rows = _gen("machine_failure_gen", 600, 3)
+    data.write_text("\n".join(rows))
+    props = os.path.join(RES, "ovsa.properties")
+    rc = cli_run.main([
+        "org.sifarish.feature.SameTypeSimilarity", f"-Dconf.path={props}",
+        f"-Dsts.same.schema.file.path={RES}/machine_failure.json",
+        str(data), str(tmp_path / "pairs")])
+    assert rc == 0
+    rc = cli_run.main([
+        "org.avenir.explore.TopMatchesByClass", f"-Dconf.path={props}",
+        str(tmp_path / "pairs"), str(tmp_path / "matches")])
+    assert rc == 0
+    matches = list((tmp_path / "matches").glob("part-*"))[0] \
+        .read_text().splitlines()
+    # minority-only filter: every neighbor pair is class T, at most k=5 each
+    assert matches and all(l.split(",")[1] == "T" for l in matches)
+    per_src: dict = {}
+    for l in matches:
+        per_src[l.split(",")[0]] = per_src.get(l.split(",")[0], 0) + 1
+    assert max(per_src.values()) <= 5
+    rc = cli_run.main([
+        "org.avenir.explore.ClassBasedOverSampler", f"-Dconf.path={props}",
+        f"-Dcbos.feature.schema.file.path={RES}/machine_failure.json",
+        str(data), str(tmp_path / "balanced")])
+    assert rc == 0
+    out = list((tmp_path / "balanced").glob("part-*"))[0] \
+        .read_text().splitlines()
+    n_fail_in = sum(1 for r in rows if r.endswith(",T"))
+    n_fail_out = sum(1 for l in out if l.endswith(",T"))
+    assert len(out) > len(rows)  # originals + synthetics
+    assert n_fail_out == n_fail_in * 5  # multiplier=4 adds 4x synthetics
+    # synthetic records stay inside the observed minority feature ranges
+    fail_rows = np.array([[float(v) for v in r.split(",")[1:6]]
+                          for r in rows if r.endswith(",T")])
+    syn = np.array([[float(v) for v in l.split(",")[1:6]]
+                    for l in out[len(rows):]])
+    assert (syn >= fail_rows.min(0) - 1).all()
+    assert (syn <= fail_rows.max(0) + 1).all()
+
+
 def test_all_driver_scripts_exist_and_are_executable():
-    for sh in ("markov.sh", "bandit.sh", "mutual_info.sh", "apriori.sh"):
+    for sh in ("markov.sh", "bandit.sh", "mutual_info.sh", "apriori.sh",
+               "carm.sh", "hica.sh", "ovsa.sh"):
         p = os.path.join(RES, sh)
         assert os.path.exists(p) and os.access(p, os.X_OK)
