@@ -1,0 +1,22 @@
+#!/bin/bash
+# K-means segmentation driver (reference flow: seed centroids externally,
+# then iterate KmeansCluster until movement stops).
+#   ./cluster.sh seed    <customers.csv> <clusters.csv>   (3 seed centroids)
+#   ./cluster.sh cluster <customers.csv> <out_dir>        (CLUSTERS=<file>)
+set -e
+DIR=$(cd "$(dirname "$0")" && pwd)
+RUN="python -m avenir_tpu.cli.run"
+PROPS="$DIR/cluster.properties"
+
+case "$1" in
+seed)
+  python "$DIR/gen/cust_seg_gen.py" seeds 3 "$2" > "$3"
+  ;;
+cluster)
+  $RUN org.avenir.cluster.KmeansCluster -Dconf.path=$PROPS \
+      -Dkmc.schema.file.path=$DIR/cust_seg.json \
+      -Dkmc.cluster.file.path=${CLUSTERS:-clusters.csv} "$2" "$3"
+  ;;
+*)
+  echo "usage: $0 seed|cluster <in> <out>" >&2; exit 2 ;;
+esac

@@ -1,0 +1,40 @@
+#!/usr/bin/env python3
+"""Synthetic churn data for the svm use case (reference svm.properties +
+cust_churn_svm_scikit_tutorial.txt).  Unlike telecom_churn_gen (whose
+signal is categorical-heavy for the Naive Bayes flow), churn here is a
+noisy linear function of the numeric usage features, so a linear SMO
+margin is the right model class.
+Line: custId,plan,avgDailyMinutes,dataGb,custServiceCalls,paymentHistory,status
+Usage: churn_svm_gen.py <n_rows> [seed] > churn.csv
+"""
+
+import sys
+
+import numpy as np
+
+PLANS = ["prepaid", "standard", "family", "business"]
+PAYMENTS = ["poor", "average", "good"]
+
+
+def generate(n: int, seed: int = 1):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(n):
+        minutes = int(np.clip(rng.normal(90, 40), 0, 199))
+        data_gb = int(np.clip(rng.normal(40, 20), 0, 99))
+        calls = int(np.clip(rng.poisson(2.0), 0, 9))
+        # linear churn score: heavy users stay, complainers leave
+        score = 1.2 * calls - 0.03 * minutes - 0.05 * data_gb + 1.5 \
+            + rng.normal(0, 1.0)
+        churned = score > 0
+        plan = PLANS[rng.integers(len(PLANS))]
+        pay = PAYMENTS[rng.integers(len(PAYMENTS))]
+        rows.append(f"C{i:07d},{plan},{minutes},{data_gb},{calls},{pay},"
+                    f"{'churned' if churned else 'active'}")
+    return rows
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
+    seed = int(sys.argv[2]) if len(sys.argv) > 2 else 1
+    print("\n".join(generate(n, seed)))

@@ -1,0 +1,58 @@
+#!/usr/bin/env python3
+"""Synthetic customer-behavior records for the cluster (k-means customer
+segmentation) use case — the reference's cust_seg.py role for
+cluster.properties / cust_seg_kmeans_scikit_tutorial.txt.  Three latent
+segments (loyal high-spenders, bargain hunters, lapsed occasionals) give
+Lloyd's iteration real structure to recover.
+Line: custId,visitsPerMonth,avgSpend,recencyDays,basketSize,discountShare
+Usage: cust_seg_gen.py <n_rows> [seed] > customers.csv
+       cust_seg_gen.py seeds <k> <customers.csv> > clusters.csv
+"""
+
+import sys
+
+import numpy as np
+
+# segment means: visits, spend, recency, basket, discountShare
+SEGMENTS = [
+    (40, 350, 12, 25, 10),   # loyal high-spenders
+    (25, 80, 30, 8, 70),     # bargain hunters
+    (4, 120, 200, 12, 25),   # lapsed occasionals
+]
+SPREAD = (6, 40, 20, 4, 12)
+CLIP = [(0, 60), (0, 500), (0, 365), (1, 40), (0, 100)]
+
+
+def generate(n: int, seed: int = 1):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(n):
+        seg = SEGMENTS[rng.integers(len(SEGMENTS))]
+        vals = [int(np.clip(rng.normal(m, s), lo, hi))
+                for m, s, (lo, hi) in zip(seg, SPREAD, CLIP)]
+        rows.append(f"U{i:05d}," + ",".join(map(str, vals)))
+    return rows
+
+
+def seed_lines(data_lines, k: int, group: str = "custSeg"):
+    """Initial cluster file: k centroids from rows spread through the data
+    (format of cluster/KmeansCluster.java:123-144 — group, record-shaped
+    centroid with null id, movement, status)."""
+    step = max(len(data_lines) // k, 1)
+    out = []
+    for j in range(k):
+        parts = data_lines[j * step].split(",")
+        out.append(",".join([group, "null"] + parts[1:] + ["inf", "active"]))
+    return out
+
+
+if __name__ == "__main__":
+    if sys.argv[1:2] == ["seeds"]:
+        k = int(sys.argv[2])
+        with open(sys.argv[3]) as fh:
+            data = [l.strip() for l in fh if l.strip()]
+        print("\n".join(seed_lines(data, k)))
+    else:
+        n = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
+        seed = int(sys.argv[2]) if len(sys.argv) > 2 else 1
+        print("\n".join(generate(n, seed)))

@@ -1,0 +1,33 @@
+#!/bin/bash
+# Abandoned-cart retargeting driver (reference resource/retarget flow:
+# dataset info content at the root, candidate splits scored against it,
+# then physical partitioning into retargeting segments).
+#   ./retarget.sh rootInfo  <visits.csv> <root_dir>
+#   ./retarget.sh splits    <visits.csv> <splits_dir>   (PARENT_INFO=<v>)
+#   ./retarget.sh partition <visits.csv> <out_dir>      (SPLITS=<splits_dir>)
+set -e
+DIR=$(cd "$(dirname "$0")" && pwd)
+RUN="python -m avenir_tpu.cli.run"
+PROPS="$DIR/retarget.properties"
+
+case "$1" in
+rootInfo)
+  $RUN org.avenir.explore.ClassPartitionGenerator -Dconf.path=$PROPS \
+      -Dcpg.feature.schema.file.path=$DIR/campaign.json "$2" "$3"
+  ;;
+splits)
+  $RUN org.avenir.explore.ClassPartitionGenerator -Dconf.path=$PROPS \
+      -Dcpg.feature.schema.file.path=$DIR/campaign.json \
+      -Dcpg.split.attributes=1,2,3,4 \
+      -Dcpg.parent.info=${PARENT_INFO:?set PARENT_INFO from rootInfo output} \
+      "$2" "$3"
+  ;;
+partition)
+  $RUN org.avenir.tree.DataPartitioner -Dconf.path=$PROPS \
+      -Ddap.feature.schema.file.path=$DIR/campaign.json \
+      -Ddap.candidate.splits.path=${SPLITS:?set SPLITS dir}/part-r-00000 \
+      "$2" "$3"
+  ;;
+*)
+  echo "usage: $0 rootInfo|splits|partition <in> <out>" >&2; exit 2 ;;
+esac

@@ -1,0 +1,23 @@
+#!/bin/bash
+# Churn SVM driver (SMO train, then linear predict with validation
+# counters).
+#   ./svm.sh train   <churn.csv> <model_dir>
+#   ./svm.sh predict <churn.csv> <pred_dir>    (MODEL=<model_dir>)
+set -e
+DIR=$(cd "$(dirname "$0")" && pwd)
+RUN="python -m avenir_tpu.cli.run"
+PROPS="$DIR/svm.properties"
+
+case "$1" in
+train)
+  $RUN org.avenir.discriminant.SupportVectorMachine -Dconf.path=$PROPS \
+      -Dsvm.feature.schema.file.path=$DIR/churn_svm.json "$2" "$3"
+  ;;
+predict)
+  $RUN org.avenir.discriminant.SupportVectorPredictor -Dconf.path=$PROPS \
+      -Dsvm.feature.schema.file.path=$DIR/churn_svm.json \
+      -Dsvm.model.file.path=${MODEL:-svm_model}/part-r-00000 "$2" "$3"
+  ;;
+*)
+  echo "usage: $0 train|predict <in> <out>" >&2; exit 2 ;;
+esac

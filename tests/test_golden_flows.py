@@ -373,8 +373,105 @@ def test_ovsa_smote_flow(tmp_path):
     assert (syn <= fail_rows.max(0) + 1).all()
 
 
+def test_cluster_segmentation_flow(tmp_path):
+    """cluster.sh: seed centroids -> Lloyd iterations recover the three
+    planted customer segments (reference cluster.properties +
+    cust_seg_kmeans_scikit_tutorial.txt)."""
+    import importlib
+    gen = importlib.import_module("gen.cust_seg_gen")
+    rows = gen.generate(900, 1)
+    data = tmp_path / "customers.csv"
+    data.write_text("\n".join(rows))
+    seeds = tmp_path / "clusters.csv"
+    seeds.write_text("\n".join(gen.seed_lines(rows, 3)))
+    props = os.path.join(RES, "cluster.properties")
+    rc = cli_run.main([
+        "org.avenir.cluster.KmeansCluster", f"-Dconf.path={props}",
+        f"-Dkmc.schema.file.path={RES}/cust_seg.json",
+        f"-Dkmc.cluster.file.path={seeds}",
+        str(data), str(tmp_path / "out")])
+    assert rc == 0
+    lines = list((tmp_path / "out").glob("part-*"))[0].read_text().splitlines()
+    assert len(lines) == 3
+    # line = group, 6 record-shaped centroid items, movement, status,
+    # avError, count — all clusters converged, every record assigned
+    assert all(l.split(",")[8] == "stopped" for l in lines)
+    counts = [int(l.split(",")[-1]) for l in lines]
+    assert sum(counts) == 900
+    # centroid recencyDays (ordinal 3 -> item 4) separates lapsed from active
+    recency = sorted(float(l.split(",")[4]) for l in lines)
+    assert recency[-1] > 120 and recency[0] < 60
+
+
+def test_svm_churn_flow(tmp_path):
+    """svm.sh: SMO train -> linear predict with validation counters
+    (reference svm.properties + cust_churn_svm_scikit_tutorial.txt)."""
+    data = tmp_path / "churn.csv"
+    data.write_text("\n".join(_gen("churn_svm_gen", 500, 4)))
+    props = os.path.join(RES, "svm.properties")
+    model = tmp_path / "svm_model"
+    rc = cli_run.main([
+        "org.avenir.discriminant.SupportVectorMachine",
+        f"-Dconf.path={props}",
+        f"-Dsvm.feature.schema.file.path={RES}/churn_svm.json",
+        str(data), str(model)])
+    assert rc == 0
+    model_lines = (model / "part-r-00000").read_text().splitlines()
+    assert any(l.startswith("weights,") for l in model_lines)
+    rc = cli_run.main([
+        "org.avenir.discriminant.SupportVectorPredictor",
+        f"-Dconf.path={props}",
+        f"-Dsvm.feature.schema.file.path={RES}/churn_svm.json",
+        f"-Dsvm.model.file.path={model}/part-r-00000",
+        str(data), str(tmp_path / "pred")])
+    assert rc == 0
+    out = list((tmp_path / "pred").glob("part-*"))[0].read_text().splitlines()
+    assert len(out) == 500
+    acc = np.mean([l.split(",")[7] == l.split(",")[6] for l in out])
+    assert acc > 0.7
+
+
+def test_retarget_partition_flow(tmp_path):
+    """retarget.sh: root info -> scored candidate splits -> physical
+    partition into retargeting segments (reference retarget.properties +
+    abandoned_shopping_cart_retarget_tutorial.txt)."""
+    data = tmp_path / "visits.csv"
+    data.write_text("\n".join(_gen("campaign_gen", 2000, 5)))
+    props = os.path.join(RES, "retarget.properties")
+    rc = cli_run.main([
+        "org.avenir.explore.ClassPartitionGenerator", f"-Dconf.path={props}",
+        f"-Dcpg.feature.schema.file.path={RES}/campaign.json",
+        str(data), str(tmp_path / "root")])
+    assert rc == 0
+    root_info = float(
+        list((tmp_path / "root").glob("part-*"))[0].read_text().strip())
+    assert 0.0 < root_info <= 0.5  # gini of a binary class
+    rc = cli_run.main([
+        "org.avenir.explore.ClassPartitionGenerator", f"-Dconf.path={props}",
+        f"-Dcpg.feature.schema.file.path={RES}/campaign.json",
+        "-Dcpg.split.attributes=1,2,3,4",
+        f"-Dcpg.parent.info={root_info}",
+        str(data), str(tmp_path / "splits")])
+    assert rc == 0
+    split_lines = list((tmp_path / "splits").glob("part-*"))[0] \
+        .read_text().splitlines()
+    assert len(split_lines) > 5  # numeric scans + categorical partitions
+    rc = cli_run.main([
+        "org.avenir.tree.DataPartitioner", f"-Dconf.path={props}",
+        f"-Ddap.feature.schema.file.path={RES}/campaign.json",
+        f"-Ddap.candidate.splits.path={tmp_path}/splits/part-r-00000",
+        str(data), str(tmp_path / "parts")])
+    assert rc == 0
+    seg_files = sorted((tmp_path / "parts").glob(
+        "split=*/segment=*/data/partition.txt"))
+    assert len(seg_files) >= 2
+    total = sum(len(f.read_text().splitlines()) for f in seg_files)
+    assert total == 2000  # every visit lands in exactly one segment
+
+
 def test_all_driver_scripts_exist_and_are_executable():
     for sh in ("markov.sh", "bandit.sh", "mutual_info.sh", "apriori.sh",
-               "carm.sh", "hica.sh", "ovsa.sh"):
+               "carm.sh", "hica.sh", "ovsa.sh",
+               "cluster.sh", "svm.sh", "retarget.sh"):
         p = os.path.join(RES, sh)
         assert os.path.exists(p) and os.access(p, os.X_OK)
