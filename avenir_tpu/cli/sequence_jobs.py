@@ -265,6 +265,71 @@ def sequence_positional_cluster(cfg: Config, in_path: str, out_path: str
     return counters
 
 
+@register("org.avenir.spark.markov.StateTransitionRate",
+          "stateTransitionRate")
+def state_transition_rate(cfg: Config, in_path: str, out_path: str
+                          ) -> Counters:
+    """Per-key CTMC generator (rate) matrices from timestamped state events
+    (spark/.../markov/StateTransitionRate.scala:47-168).  Keys:
+    key.field.ordinals, time.field.ordinal, state.field.ordinal,
+    state.values, rate.time.unit (hour|day|week), input.time.unit
+    (ms|sec|formatted + input.time.format), trans.rate.output.precision.
+    Output lines — key fields then the row-major rate matrix — feed
+    contTimeStateTransitionStats directly (its state.trans.file.path)."""
+    import datetime as _dt
+    from ..sequence.pst import ctmc_rate_matrices
+    from ..utils.timefmt import java_time_format
+    counters = Counters()
+    delim = cfg.get("field.delim.in", cfg.field_delim_regex)
+    split_line = _splitter(delim)
+    key_ords = [int(o) for o in cfg.must_get_list("key.field.ordinals")]
+    time_ord = cfg.must_get_int("time.field.ordinal")
+    state_ord = cfg.must_get_int("state.field.ordinal")
+    states = cfg.must_get_list("state.values")
+    state_code = {s: i for i, s in enumerate(states)}
+    rate_unit = cfg.get("rate.time.unit", "week")
+    in_unit = cfg.get("input.time.unit", "ms")
+    fmt = (java_time_format(cfg.must_get("input.time.format"))
+           if in_unit == "formatted" else None)
+
+    key_of: Dict[tuple, int] = {}
+    key_order: List[tuple] = []
+    kidx, times, sidx = [], [], []
+    for line in artifacts.read_text_input(in_path):
+        line = line.strip()
+        if not line:
+            continue
+        items = split_line(line)
+        key = tuple(items[o] for o in key_ords)
+        if key not in key_of:
+            key_of[key] = len(key_order)
+            key_order.append(key)
+        ts = items[time_ord]
+        if in_unit == "ms":
+            epoch_ms = float(ts)
+        elif in_unit == "sec":
+            epoch_ms = float(ts) * 1000.0
+        elif in_unit == "formatted":
+            epoch_ms = _dt.datetime.strptime(ts, fmt).timestamp() * 1000.0
+        else:
+            raise ValueError(f"invalid input time unit {in_unit!r}")
+        kidx.append(key_of[key])
+        times.append(epoch_ms)
+        sidx.append(state_code[items[state_ord]])
+    rates = ctmc_rate_matrices(np.asarray(kidx), np.asarray(times),
+                               np.asarray(sidx), len(key_order), len(states),
+                               rate_unit)
+    prec = cfg.get_int("trans.rate.output.precision", 6)
+    od = cfg.field_delim_out
+    out_lines = [od.join(list(key_order[i]) +
+                         [f"{v:.{prec}f}" for v in rates[i].ravel()])
+                 for i in range(len(key_order))]
+    artifacts.write_text_output(out_path, out_lines)
+    counters.set("TransitionRate", "keys", len(key_order))
+    counters.set("TransitionRate", "events", len(kidx))
+    return counters
+
+
 @register("org.avenir.spark.markov.ContTimeStateTransitionStats",
           "contTimeStateTransitionStats")
 def cont_time_state_transition_stats(cfg: Config, in_path: str,

@@ -62,9 +62,8 @@ class TaskScheduleDomain(MatrixCostDomain):
         employees = config["employees"]
         self.task_ids = [t["id"] for t in tasks]
         self.employee_ids = [e["id"] for e in employees]
-        date_fmt = config.get("dateFormat", "MM-dd-yyyy")
-        py_fmt = date_fmt.replace("MM", "%m").replace("dd", "%d") \
-                         .replace("yyyy", "%Y")
+        from ..utils.timefmt import java_time_format
+        py_fmt = java_time_format(config.get("dateFormat", "MM-dd-yyyy"))
         scale = float(config.get("costScale", 100))
         air_thr = float(config.get("airTravelDistThreshold", 100))
         per_mile = float(config.get("perMileDriveCost", 0.56))

@@ -199,6 +199,56 @@ def ctmc_state_dwell_time(rate_matrix: np.ndarray, time_horizon: float,
     return float(((time_horizon / (i + 1)) * inner * pois).sum())
 
 
+MS_PER_RATE_UNIT = {"hour": 3_600_000.0, "day": 86_400_000.0,
+                    "week": 604_800_000.0}
+
+
+def ctmc_rate_matrices(key_idx: np.ndarray, times_ms: np.ndarray,
+                       state_idx: np.ndarray, n_keys: int, n_states: int,
+                       rate_unit: str = "week") -> np.ndarray:
+    """Per-key CTMC generator matrices from timestamped state observations
+    (spark/.../markov/StateTransitionRate.scala:96-168 semantics): events
+    sorted by time within each key; every consecutive pair contributes one
+    cur->next transition and attributes the elapsed time to cur's dwell
+    duration; each visited row is scaled to transitions-per-rate-unit and
+    the diagonal set to -sum(off-diagonal), yielding proper generator
+    rows.  Self-transition counts are discarded by the diagonal overwrite,
+    as in the reference.
+
+    All-array formulation: one lexsort, one consecutive-pair mask, two
+    bincount scatter-adds over flattened (key, cur[, next]) indices —
+    no per-key Python loop.  Returns (n_keys, S, S) float64.
+    """
+    ms_per_unit = MS_PER_RATE_UNIT.get(rate_unit)
+    if ms_per_unit is None:
+        raise ValueError(f"invalid rate time unit {rate_unit!r}; known: "
+                         f"{sorted(MS_PER_RATE_UNIT)}")
+    order = np.lexsort((np.asarray(times_ms), np.asarray(key_idx)))
+    # int64 coercion matters when the inputs are empty Python lists:
+    # np.asarray([]) is float64, which bincount rejects
+    k = np.asarray(key_idx, dtype=np.int64)[order]
+    t = np.asarray(times_ms, dtype=np.float64)[order]
+    s = np.asarray(state_idx, dtype=np.int64)[order]
+    same = k[1:] == k[:-1]
+    kk, cur, nxt = k[:-1][same], s[:-1][same], s[1:][same]
+    dt = (t[1:] - t[:-1])[same] / ms_per_unit
+    counts = np.bincount((kk * n_states + cur) * n_states + nxt,
+                         minlength=n_keys * n_states * n_states
+                         ).reshape(n_keys, n_states, n_states).astype(float)
+    duration = np.bincount(kk * n_states + cur, weights=dt,
+                           minlength=n_keys * n_states
+                           ).reshape(n_keys, n_states)
+    visited = duration > 0
+    scale = np.where(visited, 1.0 / np.where(visited, duration, 1.0), 0.0)
+    rates = counts * scale[:, :, None]
+    # generator diagonal: -sum of off-diagonal rates (overwrites any
+    # scaled self-transition count, matching the reference's rowSum logic)
+    idx = np.arange(n_states)
+    rates[:, idx, idx] = 0.0
+    rates[:, idx, idx] = -rates.sum(axis=2)
+    return rates
+
+
 def ctmc_transition_count(rate_matrix: np.ndarray, time_horizon: float,
                           init_state: int, target_one: int, target_two: int,
                           end_state: Optional[int] = None,

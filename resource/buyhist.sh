@@ -1,0 +1,22 @@
+#!/bin/bash
+# Customer loyalty trajectory driver (train a supervised HMM on tagged
+# transaction sequences, then Viterbi-decode loyalty trajectories).
+#   ./buyhist.sh train  <tagged.csv> <model_dir>
+#   ./buyhist.sh decode <sequences.csv> <out_dir>    (MODEL=<model_dir>)
+set -e
+DIR=$(cd "$(dirname "$0")" && pwd)
+RUN="python -m avenir_tpu.cli.run"
+PROPS="$DIR/buyhist.properties"
+
+case "$1" in
+train)
+  $RUN org.avenir.markov.HiddenMarkovModelBuilder -Dconf.path=$PROPS \
+      "$2" "$3"
+  ;;
+decode)
+  $RUN org.avenir.markov.ViterbiStatePredictor -Dconf.path=$PROPS \
+      -Dvsp.hmm.model.path=${MODEL:-hmm_model}/part-r-00000 "$2" "$3"
+  ;;
+*)
+  echo "usage: $0 train|decode <in> <out>" >&2; exit 2 ;;
+esac

@@ -1,0 +1,60 @@
+#!/usr/bin/env python3
+"""Synthetic customer loyalty trajectories for the buyhist use case —
+the reference's xaction_state.rb role for buyhist.properties /
+customer_loyalty_trajectory_tutorial.txt.  Transactions are coded by two
+symbols: gap since last purchase (S short / L long) x amount vs last
+(H higher / M same / L lower), e.g. "SH".  The hidden loyalty state
+(loyal, drifting, lost) drives the observation mix; tagged mode emits
+obs,state pairs for HMM training, plain mode observation-only sequences
+for Viterbi decoding.
+Line (tagged): custId,obs,state,obs,state,...
+Line (plain):  custId,obs,obs,...
+Usage: loyalty_seq_gen.py <n_rows> [seed] [tagged|plain] > sequences.csv
+"""
+
+import sys
+
+import numpy as np
+
+STATES = ["loyal", "drifting", "lost"]
+OBS = ["SH", "SM", "SL", "LH", "LM", "LL"]
+
+# hidden loyalty dynamics
+TRANS = np.array([
+    [0.85, 0.13, 0.02],
+    [0.15, 0.65, 0.20],
+    [0.02, 0.08, 0.90],
+])
+INIT = np.array([0.6, 0.3, 0.1])
+# per-state observation mix over OBS
+EMIT = np.array([
+    [0.35, 0.30, 0.10, 0.10, 0.10, 0.05],   # loyal: short gaps, rising spend
+    [0.08, 0.15, 0.22, 0.10, 0.20, 0.25],   # drifting
+    [0.02, 0.03, 0.10, 0.05, 0.15, 0.65],   # lost: long gaps, falling spend
+])
+
+
+def generate(n: int, seed: int = 1, mode: str = "tagged",
+             min_len: int = 10, max_len: int = 25):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(n):
+        length = int(rng.integers(min_len, max_len + 1))
+        state = int(rng.choice(3, p=INIT))
+        parts = [f"U{i:05d}"]
+        for _ in range(length):
+            obs = OBS[rng.choice(len(OBS), p=EMIT[state])]
+            if mode == "tagged":
+                parts += [obs, STATES[state]]
+            else:
+                parts.append(obs)
+            state = int(rng.choice(3, p=TRANS[state]))
+        rows.append(",".join(parts))
+    return rows
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
+    seed = int(sys.argv[2]) if len(sys.argv) > 2 else 1
+    mode = sys.argv[3] if len(sys.argv) > 3 else "tagged"
+    print("\n".join(generate(n, seed, mode)))

@@ -1,0 +1,45 @@
+#!/usr/bin/env python3
+"""Synthetic per-product price-revenue feedback for the price_opt use case
+(reference price_opt.py role for price_optimize_tutorial.txt).  Each
+product has a hidden demand curve peaking at one of the candidate prices;
+observed revenue per sale event is price x noisy purchase indicator, so
+the bandit's revenue-maximizing arm is the demand-curve peak.
+Line: product,price,revenue
+Usage: price_revenue_gen.py <n_rows> [seed] [n_products] > revenue.csv
+"""
+
+import sys
+
+import numpy as np
+
+PRICES = ["price19", "price24", "price29", "price34"]
+PRICE_VALUE = {"price19": 19.0, "price24": 24.0, "price29": 29.0,
+               "price34": 34.0}
+
+
+def generate(n: int, seed: int = 1, n_products: int = 5, curve_seed: int = 0):
+    """seed varies the event noise per round; curve_seed fixes each
+    product's hidden optimal price so successive rounds agree."""
+    curve_rng = np.random.default_rng(curve_seed)
+    best = {f"prod{p}": int(curve_rng.integers(0, len(PRICES)))
+            for p in range(n_products)}
+    rng = np.random.default_rng(seed)
+    rows = []
+    for _ in range(n):
+        prod = f"prod{rng.integers(0, n_products)}"
+        a = int(rng.integers(0, len(PRICES)))
+        price = PRICES[a]
+        # buy probability falls off with distance from the sweet spot;
+        # scaled so revenue = p_buy * price peaks AT the sweet spot
+        p_buy = 0.9 / (1.0 + 1.5 * abs(a - best[prod])) \
+            * (PRICE_VALUE[PRICES[best[prod]]] / PRICE_VALUE[price])
+        revenue = PRICE_VALUE[price] if rng.random() < min(p_buy, 1.0) else 0.0
+        rows.append(f"{prod},{price},{revenue:.2f}")
+    return rows
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 2000
+    seed = int(sys.argv[2]) if len(sys.argv) > 2 else 1
+    np_ = int(sys.argv[3]) if len(sys.argv) > 3 else 5
+    print("\n".join(generate(n, seed, np_)))

@@ -1,0 +1,48 @@
+#!/usr/bin/env python3
+"""Synthetic timestamped supplier-fulfillment events for the sup use case
+(reference supplier.py role for sup.conf /
+supplier_fulfillment_forecast_tutorial.txt).  Each supplier is a latent
+CTMC over F (full), P (partial), L (late): reliable suppliers hold F for
+weeks and rarely visit L; shaky ones churn through P and linger in L —
+so the learned per-supplier rate matrices and dwell-time forecasts
+genuinely rank supplier risk.
+Line: supplierId,epochMs,state
+Usage: supplier_events_gen.py <n_suppliers> <events_per_supplier> [seed]
+"""
+
+import sys
+
+import numpy as np
+
+STATES = ["F", "P", "L"]
+MS_PER_WEEK = 604_800_000
+
+# (mean holding weeks per state, transition split per state) per profile
+PROFILES = {
+    "reliable": ([4.0, 1.0, 0.5],
+                 [[0.0, 0.9, 0.1], [0.8, 0.0, 0.2], [0.7, 0.3, 0.0]]),
+    "shaky": ([1.0, 1.5, 2.0],
+              [[0.0, 0.6, 0.4], [0.3, 0.0, 0.7], [0.4, 0.6, 0.0]]),
+}
+
+
+def generate(n_suppliers: int, n_events: int, seed: int = 1):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(n_suppliers):
+        profile = "reliable" if i % 2 == 0 else "shaky"
+        hold, branch = PROFILES[profile]
+        state = 0
+        t = float(rng.uniform(0, MS_PER_WEEK))
+        for _ in range(n_events):
+            rows.append(f"S{i:03d},{int(t)},{STATES[state]}")
+            t += rng.exponential(hold[state]) * MS_PER_WEEK
+            state = int(rng.choice(3, p=branch[state]))
+    return rows
+
+
+if __name__ == "__main__":
+    n_sup = int(sys.argv[1]) if len(sys.argv) > 1 else 10
+    n_ev = int(sys.argv[2]) if len(sys.argv) > 2 else 80
+    seed = int(sys.argv[3]) if len(sys.argv) > 3 else 1
+    print("\n".join(generate(n_sup, n_ev, seed)))

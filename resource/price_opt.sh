@@ -1,0 +1,20 @@
+#!/bin/bash
+# Price-optimization driver (reference price_optimize_tutorial.txt flow:
+# one bandit decisioning round per invocation over (product, price,
+# count, revenue) feedback; rotate state files between rounds).
+#   ./price_opt.sh round <revenue.csv> <out_dir>  (STATE_IN= STATE_OUT= ALGO=)
+set -e
+DIR=$(cd "$(dirname "$0")" && pwd)
+RUN="python -m avenir_tpu.cli.run"
+PROPS="$DIR/price_opt.properties"
+
+case "$1" in
+round)
+  $RUN org.avenir.spark.reinforce.MultiArmBandit -Dconf.path=$PROPS \
+      ${ALGO:+-Dmab.algorithm=$ALGO} \
+      ${STATE_IN:+-Dmab.model.state.file.in=$STATE_IN} \
+      ${STATE_OUT:+-Dmab.model.state.file.out=$STATE_OUT} "$2" "$3"
+  ;;
+*)
+  echo "usage: $0 round <revenue.csv> <out_dir>" >&2; exit 2 ;;
+esac

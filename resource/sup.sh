@@ -1,0 +1,24 @@
+#!/bin/bash
+# Supplier fulfillment forecasting driver (reference resource/sup.sh flow:
+# learn per-supplier CTMC rate matrices, then forecast expected weeks
+# spent in the late state over the horizon).
+#   ./sup.sh rates    <events.csv> <rates_dir>
+#   ./sup.sh forecast <initial_states.csv> <out_dir>   (RATES=<rates_dir>)
+set -e
+DIR=$(cd "$(dirname "$0")" && pwd)
+RUN="python -m avenir_tpu.cli.run"
+CONF="$DIR/sup.conf"
+
+case "$1" in
+rates)
+  $RUN org.avenir.spark.markov.StateTransitionRate -Dconf.path=$CONF \
+      "$2" "$3"
+  ;;
+forecast)
+  $RUN org.avenir.spark.markov.ContTimeStateTransitionStats \
+      -Dconf.path=$CONF \
+      -Dstate.trans.file.path=${RATES:-rates}/part-r-00000 "$2" "$3"
+  ;;
+*)
+  echo "usage: $0 rates|forecast <in> <out>" >&2; exit 2 ;;
+esac

@@ -469,9 +469,109 @@ def test_retarget_partition_flow(tmp_path):
     assert total == 2000  # every visit lands in exactly one segment
 
 
+def test_buyhist_loyalty_flow(tmp_path):
+    """buyhist.sh: supervised HMM from tagged sequences -> Viterbi decode
+    recovers hidden loyalty states (reference buyhist.properties +
+    customer_loyalty_trajectory_tutorial.txt)."""
+    import importlib
+    gen = importlib.import_module("gen.loyalty_seq_gen")
+    tagged = tmp_path / "tagged.csv"
+    tagged.write_text("\n".join(gen.generate(800, 1, "tagged")))
+    props = os.path.join(RES, "buyhist.properties")
+    model = tmp_path / "hmm_model"
+    rc = cli_run.main([
+        "org.avenir.markov.HiddenMarkovModelBuilder", f"-Dconf.path={props}",
+        str(tagged), str(model)])
+    assert rc == 0
+    # decode sequences whose true states we know (same generator, tagged)
+    test_rows = gen.generate(150, 2, "tagged")
+    plain = tmp_path / "plain.csv"
+    plain.write_text("\n".join(
+        ",".join([r.split(",")[0]] + r.split(",")[1::2]) for r in test_rows))
+    rc = cli_run.main([
+        "org.avenir.markov.ViterbiStatePredictor", f"-Dconf.path={props}",
+        f"-Dvsp.hmm.model.path={model}/part-r-00000",
+        str(plain), str(tmp_path / "decoded")])
+    assert rc == 0
+    out = list((tmp_path / "decoded").glob("part-*"))[0] \
+        .read_text().splitlines()
+    assert len(out) == 150
+    match = total = 0
+    truth = {r.split(",")[0]: r.split(",")[2::2] for r in test_rows}
+    for l in out:
+        parts = l.split(",")
+        states = parts[1:]
+        t = truth[parts[0]]
+        assert len(states) == len(t)
+        match += sum(a == b for a, b in zip(states, t))
+        total += len(t)
+    # Viterbi on a persistent 3-state chain beats the 1/3 base rate well
+    assert match / total > 0.6
+
+
+def test_sup_fulfillment_flow(tmp_path):
+    """sup.sh: per-supplier CTMC rate matrices -> expected late-state dwell
+    time; shaky suppliers forecast more late weeks than reliable ones
+    (reference sup.conf + supplier_fulfillment_forecast_tutorial.txt)."""
+    import importlib
+    gen = importlib.import_module("gen.supplier_events_gen")
+    events = tmp_path / "events.csv"
+    events.write_text("\n".join(gen.generate(6, 80, 1)))
+    conf = os.path.join(RES, "sup.conf")
+    rc = cli_run.main([
+        "org.avenir.spark.markov.StateTransitionRate",
+        f"-Dconf.path={conf}", str(events), str(tmp_path / "rates")])
+    assert rc == 0
+    init = tmp_path / "init.csv"
+    init.write_text("\n".join(f"S{i:03d},F" for i in range(6)))
+    rc = cli_run.main([
+        "org.avenir.spark.markov.ContTimeStateTransitionStats",
+        f"-Dconf.path={conf}",
+        f"-Dstate.trans.file.path={tmp_path}/rates/part-r-00000",
+        str(init), str(tmp_path / "fc")])
+    assert rc == 0
+    out = list((tmp_path / "fc").glob("part-*"))[0].read_text().splitlines()
+    assert len(out) == 6
+    dwell = {l.split(",")[0]: float(l.split(",")[1]) for l in out}
+    # generator profiles: even suppliers reliable, odd shaky
+    reliable = np.mean([dwell[f"S{i:03d}"] for i in (0, 2, 4)])
+    shaky = np.mean([dwell[f"S{i:03d}"] for i in (1, 3, 5)])
+    assert 0.0 <= reliable < shaky <= 4.0
+
+
+def test_price_opt_flow(tmp_path):
+    """price_opt.sh: UCB1 rounds over (product, price, revenue) feedback
+    converge each product to its demand-curve peak (reference
+    price_optimize_tutorial.txt)."""
+    import importlib
+    gen = importlib.import_module("gen.price_revenue_gen")
+    props = os.path.join(RES, "price_opt.properties")
+    state_in = "/nonexistent"
+    for rnd in range(1, 4):
+        rev = tmp_path / f"rev_r{rnd}.csv"
+        rev.write_text("\n".join(gen.generate(3000, rnd, 5)))
+        rc = cli_run.main([
+            "org.avenir.spark.reinforce.MultiArmBandit",
+            f"-Dconf.path={props}",
+            f"-Dmab.model.state.file.in={state_in}",
+            f"-Dmab.model.state.file.out={tmp_path}/state_r{rnd}/part",
+            str(rev), str(tmp_path / f"prices_r{rnd}")])
+        assert rc == 0
+        state_in = f"{tmp_path}/state_r{rnd}/part"
+    out = list((tmp_path / "prices_r3").glob("part-*"))[0] \
+        .read_text().splitlines()
+    assert len(out) == 5
+    curve_rng = np.random.default_rng(0)
+    best = {f"prod{p}": gen.PRICES[int(curve_rng.integers(0, 4))]
+            for p in range(5)}
+    hits = sum(1 for l in out if l.split(",")[1] == best[l.split(",")[0]])
+    assert hits >= 4  # UCB1 may still be exploring one product
+
+
 def test_all_driver_scripts_exist_and_are_executable():
     for sh in ("markov.sh", "bandit.sh", "mutual_info.sh", "apriori.sh",
                "carm.sh", "hica.sh", "ovsa.sh",
-               "cluster.sh", "svm.sh", "retarget.sh"):
+               "cluster.sh", "svm.sh", "retarget.sh",
+               "buyhist.sh", "sup.sh", "price_opt.sh"):
         p = os.path.join(RES, sh)
         assert os.path.exists(p) and os.access(p, os.X_OK)

@@ -65,6 +65,7 @@ EXPECTED = {
         "sequence_positional_cluster",
     "org.avenir.spark.markov.ContTimeStateTransitionStats":
         "cont_time_state_transition_stats",
+    "org.avenir.spark.markov.StateTransitionRate": "state_transition_rate",
     "org.avenir.spark.optimize.GeneticAlgorithm": "genetic_algorithm_job",
     "org.avenir.spark.sequence.EventTimeDistribution":
         "event_time_distribution",

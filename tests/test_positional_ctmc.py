@@ -173,3 +173,113 @@ def test_cli_positional_and_ctmc(tmp_path):
     assert len(lines) == 1 and lines[0].startswith("g1,")
     dwell = float(lines[0].split(",")[1])
     assert 0.0 < dwell < 5.0
+
+
+# ---------------------------------------------------------------------------
+# stateTransitionRate (spark/.../markov/StateTransitionRate.scala)
+# ---------------------------------------------------------------------------
+
+def test_rate_matrices_match_loop_oracle():
+    """ctmc_rate_matrices vs a direct per-key loop over the reference's
+    count/duration/scale/diagonal recipe, on shuffled multi-key events."""
+    from avenir_tpu.sequence.pst import ctmc_rate_matrices
+    rng = np.random.default_rng(4)
+    n_keys, n_states, n_ev = 5, 3, 400
+    kidx = rng.integers(n_keys, size=n_ev)
+    times = rng.uniform(0, 1e9, size=n_ev)
+    sidx = rng.integers(n_states, size=n_ev)
+    got = ctmc_rate_matrices(kidx, times, sidx, n_keys, n_states, "day")
+    ms_day = 86_400_000.0
+    for g in range(n_keys):
+        order = np.argsort(times[kidx == g], kind="stable")
+        s = sidx[kidx == g][order]
+        t = times[kidx == g][order]
+        counts = np.zeros((n_states, n_states))
+        dur = np.zeros(n_states)
+        for i in range(len(s) - 1):
+            counts[s[i], s[i + 1]] += 1
+            dur[s[i]] += (t[i + 1] - t[i]) / ms_day
+        exp = np.zeros((n_states, n_states))
+        for r in range(n_states):
+            if dur[r] > 0:
+                exp[r] = counts[r] / dur[r]
+        np.fill_diagonal(exp, 0.0)
+        exp[np.arange(n_states), np.arange(n_states)] = -exp.sum(axis=1)
+        np.testing.assert_allclose(got[g], exp, rtol=1e-9, atol=1e-12)
+        # generator property: every row sums to zero
+        np.testing.assert_allclose(got[g].sum(axis=1), 0.0, atol=1e-12)
+
+
+def test_rate_matrix_recovers_known_generator():
+    """Events simulated from a known 2-state CTMC recover its generator:
+    rate out of a state = 1/mean-holding-time, split by branch counts."""
+    from avenir_tpu.sequence.pst import ctmc_rate_matrices
+    rng = np.random.default_rng(9)
+    # true generator (per day): leaves 'up' at 0.5/day, 'down' at 2.0/day
+    lam = {0: 0.5, 1: 2.0}
+    t_ms, state, times, states = 0.0, 0, [], []
+    for _ in range(4000):
+        times.append(t_ms)
+        states.append(state)
+        t_ms += rng.exponential(1.0 / lam[state]) * 86_400_000.0
+        state = 1 - state
+    got = ctmc_rate_matrices(np.zeros(len(times), int), np.array(times),
+                             np.array(states), 1, 2, "day")[0]
+    assert got[0, 1] == pytest.approx(0.5, rel=0.1)
+    assert got[1, 0] == pytest.approx(2.0, rel=0.1)
+    np.testing.assert_allclose(got.sum(axis=1), 0.0, atol=1e-12)
+
+
+def test_state_transition_rate_feeds_ctmc_stats(tmp_path):
+    """The sup.conf pipeline: stateTransitionRate output is consumed
+    unchanged by contTimeStateTransitionStats (state.trans.file.path)."""
+    from avenir_tpu.cli import run as cli_run
+    from avenir_tpu.core import artifacts
+    rng = np.random.default_rng(11)
+    lines = []
+    for key in ("supA", "supB"):
+        t = 0
+        state = "F"
+        nxt = {"F": "P", "P": "L", "L": "F"}
+        for _ in range(60):
+            lines.append(f"{key},{t},{state}")
+            t += int(rng.exponential(3.0) * 604_800_000)  # ~3 weeks
+            state = nxt[state]
+    data = tmp_path / "events.csv"
+    data.write_text("\n".join(lines))
+    props = tmp_path / "rate.properties"
+    props.write_text(
+        "field.delim.in=,\nfield.delim.out=,\n"
+        "key.field.ordinals=0\ntime.field.ordinal=1\n"
+        "state.field.ordinal=2\nstate.values=F,P,L\n"
+        "rate.time.unit=week\ninput.time.unit=ms\n"
+        "trans.rate.output.precision=9\n")
+    out = tmp_path / "rates"
+    rc = cli_run.main(["org.avenir.spark.markov.StateTransitionRate",
+                       f"-Dconf.path={props}", str(data), str(out)])
+    assert rc == 0
+    rate_lines = artifacts.read_text_input(str(out))
+    assert len(rate_lines) == 2 and {l.split(",")[0] for l in rate_lines} \
+        == {"supA", "supB"}
+    # 9 matrix entries after the key, rows summing to ~0
+    for l in rate_lines:
+        vals = np.array([float(v) for v in l.split(",")[1:]])
+        assert vals.size == 9
+        np.testing.assert_allclose(vals.reshape(3, 3).sum(axis=1), 0.0,
+                                   atol=1e-6)
+    props2 = tmp_path / "stats.properties"
+    props2.write_text(
+        "field.delim.regex=,\nfield.delim.out=,\n"
+        "key.field.len=1\nstate.values=F,P,L\ntime.horizon=4\n"
+        f"state.trans.file.path={out}/part-r-00000\n"
+        "state.trans.stat=stateDwellTime\ntarget.states=L\n")
+    inp = tmp_path / "init.csv"
+    inp.write_text("supA,F\nsupB,P\n")
+    out2 = tmp_path / "dwell"
+    rc = cli_run.main(["contTimeStateTransitionStats",
+                       f"-Dconf.path={props2}", str(inp), str(out2)])
+    assert rc == 0
+    dwell = artifacts.read_text_input(str(out2))
+    assert len(dwell) == 2
+    for l in dwell:
+        assert 0.0 <= float(l.split(",")[1]) <= 4.0
