@@ -76,3 +76,44 @@ def rule_evaluator(cfg: Config, in_path: str, out_path: str) -> Counters:
          for name, conf, sup in results])
     counters.increment("Rules", "evaluated", len(results))
     return counters
+
+
+@register("org.chombo.mr.TemporalFilter", "temporalFilter")
+def temporal_filter(cfg: Config, in_path: str, out_path: str) -> Counters:
+    """Time-range record filter (the chombo TemporalFilter MR the
+    reference's fit flow runs before Apriori, resource/fit.sh:29-40,
+    fit.properties tef.* block).  Keys: tef.time.stamp.field.ordinal,
+    tef.time.range=<start>:<end> (epoch, inclusive),
+    tef.time.stamp.in.mili, tef.time.zone.shift.hours,
+    tef.seasonal.cycle.type (only anyTimeRange is supported — the other
+    chombo cycle types have no user in the reference's avenir flows)."""
+    counters = Counters()
+    cycle = cfg.get("tef.seasonal.cycle.type", "anyTimeRange")
+    if cycle != "anyTimeRange":
+        raise ValueError(f"unsupported seasonal cycle type {cycle!r}; "
+                         f"only anyTimeRange")
+    ts_ord = cfg.must_get_int("tef.time.stamp.field.ordinal",
+                              "missing timestamp field ordinal")
+    lo, _, hi = cfg.must_get("tef.time.range",
+                             "missing time range").partition(":")
+    lo, hi = float(lo), float(hi)
+    in_mili = cfg.get_boolean("tef.time.stamp.in.mili", False)
+    shift_s = cfg.get_int("tef.time.zone.shift.hours", 0) * 3600
+    split = _splitter(cfg.field_delim_regex)
+    kept = []
+    n_in = 0
+    for line in artifacts.read_text_input(in_path):
+        line = line.rstrip("\n")
+        if not line.strip():
+            continue
+        n_in += 1
+        ts = float(split(line)[ts_ord])
+        if in_mili:
+            ts /= 1000.0
+        ts += shift_s
+        if lo <= ts <= hi:
+            kept.append(line)
+    artifacts.write_text_output(out_path, kept, role="m")
+    counters.set("TemporalFilter", "inputRecords", n_in)
+    counters.set("TemporalFilter", "keptRecords", len(kept))
+    return counters

@@ -1,0 +1,22 @@
+#!/bin/bash
+# Conversion-prediction driver (train per-class engagement transition
+# matrices, then classify trajectories by log-odds).
+#   ./conv.sh train    <sequences.csv> <model_dir>
+#   ./conv.sh classify <sequences.csv> <pred_dir>   (MODEL=<model_dir>)
+set -e
+DIR=$(cd "$(dirname "$0")" && pwd)
+RUN="python -m avenir_tpu.cli.run"
+PROPS="$DIR/conv.properties"
+
+case "$1" in
+train)
+  $RUN org.avenir.markov.MarkovStateTransitionModel -Dconf.path=$PROPS \
+      "$2" "$3"
+  ;;
+classify)
+  $RUN org.avenir.markov.MarkovModelClassifier -Dconf.path=$PROPS \
+      -Dmmc.mm.model.path=${MODEL:-conv_model}/part-r-00000 "$2" "$3"
+  ;;
+*)
+  echo "usage: $0 train|classify <in> <out>" >&2; exit 2 ;;
+esac

@@ -1,0 +1,31 @@
+#!/bin/bash
+# Disease rule-mining driver (score candidate risk-factor splits against
+# the dataset info content, then evaluate hand-written risk rules).
+#   ./disease.sh rootInfo <patients.csv> <root_dir>
+#   ./disease.sh splits   <patients.csv> <splits_dir>  (PARENT_INFO=<v>)
+#   ./disease.sh rules    <patients.csv> <rules_dir>   (DATA_SIZE=<n>)
+set -e
+DIR=$(cd "$(dirname "$0")" && pwd)
+RUN="python -m avenir_tpu.cli.run"
+PROPS="$DIR/disease.properties"
+
+case "$1" in
+rootInfo)
+  $RUN org.avenir.explore.ClassPartitionGenerator -Dconf.path=$PROPS \
+      -Dcpg.feature.schema.file.path=$DIR/patient.json "$2" "$3"
+  ;;
+splits)
+  $RUN org.avenir.explore.ClassPartitionGenerator -Dconf.path=$PROPS \
+      -Dcpg.feature.schema.file.path=$DIR/patient.json \
+      -Dcpg.split.attributes=1,2,3,4,5 \
+      -Dcpg.parent.info=${PARENT_INFO:?set PARENT_INFO from rootInfo output} \
+      "$2" "$3"
+  ;;
+rules)
+  $RUN org.avenir.explore.RuleEvaluator -Dconf.path=$PROPS \
+      -Drue.data.size=${DATA_SIZE:?set DATA_SIZE to the record count} \
+      "$2" "$3"
+  ;;
+*)
+  echo "usage: $0 rootInfo|splits|rules <in> <out>" >&2; exit 2 ;;
+esac

@@ -1,0 +1,24 @@
+#!/bin/bash
+# Seasonal frequent-itemset driver (reference resource/fit.sh flow:
+# temporal filter to the season window, then level-wise Apriori).
+#   ./fit.sh filter <xactions.csv> <filtered_dir>
+#   ./fit.sh freq   <filtered_dir> <out_dir>  (LEN=1|2, COUNT=<n_filtered>,
+#                                              ITEMSETS=<level1_file>)
+set -e
+DIR=$(cd "$(dirname "$0")" && pwd)
+RUN="python -m avenir_tpu.cli.run"
+PROPS="$DIR/fit.properties"
+
+case "$1" in
+filter)
+  $RUN org.chombo.mr.TemporalFilter -Dconf.path=$PROPS "$2" "$3"
+  ;;
+freq)
+  $RUN org.avenir.association.FrequentItemsApriori -Dconf.path=$PROPS \
+      -Dfia.item.set.length=${LEN:-1} \
+      -Dfia.total.tans.count=${COUNT:?set COUNT to the filtered row count} \
+      ${ITEMSETS:+-Dfia.item.set.file.path=$ITEMSETS} "$2" "$3"
+  ;;
+*)
+  echo "usage: $0 filter|freq <in> <out>" >&2; exit 2 ;;
+esac

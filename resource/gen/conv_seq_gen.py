@@ -1,0 +1,61 @@
+#!/usr/bin/env python3
+"""Synthetic engagement-trajectory sequences for the conv (conversion
+prediction) use case — the reference's role for conv.properties /
+cust_conv_with_markov_chain_classification_tutorial.txt.  Each session
+state is a two-symbol code: recency tier (L/M/H) x intensity tier
+(L/M/H), e.g. "HM".  Converters (T) trend toward high-intensity states;
+non-converters (F) decay toward LL, giving the per-class transition
+matrices distinct log-odds structure.
+Line: custId,label,state,state,...
+Usage: conv_seq_gen.py <n_rows> [seed] > sequences.csv
+"""
+
+import sys
+
+import numpy as np
+
+STATES = ["LL", "LM", "LH", "ML", "MM", "MH", "HL", "HM", "HH"]
+
+
+def _drift_matrix(up_bias: float) -> np.ndarray:
+    """Random-walk transition matrix over the 3x3 state grid with a bias
+    toward higher (up_bias > 0.5) or lower tiers."""
+    n = len(STATES)
+    mat = np.zeros((n, n))
+    for i in range(n):
+        r, c = divmod(i, 3)
+        for dr in (-1, 0, 1):
+            for dc in (-1, 0, 1):
+                rr, cc = r + dr, c + dc
+                if 0 <= rr < 3 and 0 <= cc < 3:
+                    w = up_bias if (dr + dc) > 0 else \
+                        (1.0 - up_bias if (dr + dc) < 0 else 0.5)
+                    mat[i, rr * 3 + cc] = w
+        mat[i] /= mat[i].sum()
+    return mat
+
+
+CONVERTER = _drift_matrix(0.75)
+NON_CONVERTER = _drift_matrix(0.25)
+
+
+def generate(n: int, seed: int = 1, min_len: int = 8, max_len: int = 18):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(n):
+        conv = rng.random() < 0.4
+        mat = CONVERTER if conv else NON_CONVERTER
+        state = int(rng.integers(len(STATES)))
+        length = int(rng.integers(min_len, max_len + 1))
+        seq = []
+        for _ in range(length):
+            seq.append(STATES[state])
+            state = int(rng.choice(len(STATES), p=mat[state]))
+        rows.append(f"U{i:06d},{'T' if conv else 'F'}," + ",".join(seq))
+    return rows
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
+    seed = int(sys.argv[2]) if len(sys.argv) > 2 else 1
+    print("\n".join(generate(n, seed)))

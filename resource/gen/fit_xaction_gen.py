@@ -1,0 +1,45 @@
+#!/usr/bin/env python3
+"""Synthetic timestamped transactions for the fit (temporal-filter +
+Apriori) use case — the reference's freq_items.py role for
+fit.properties / resource/fit.sh.  A seasonal bundle (grill+charcoal)
+appears only inside the target time window [WINDOW_LO, WINDOW_HI); the
+year-round bundle (milk+bread) appears everywhere.  Filtering to the
+window before Apriori is what surfaces the seasonal association.
+Line: xactionId,epochSec,item1,item2,...
+Usage: fit_xaction_gen.py <n_rows> [seed] > xactions.csv
+"""
+
+import sys
+
+import numpy as np
+
+CATALOG = ["milk", "bread", "grill", "charcoal", "eggs", "soda", "candy",
+           "soap", "paper", "pasta"]
+# epoch seconds: a 10-day stream with a 3-day "season" in the middle
+STREAM_LO = 1_700_000_000
+WINDOW_LO = 1_700_300_000
+WINDOW_HI = 1_700_560_000
+STREAM_HI = 1_700_860_000
+
+
+def generate(n: int, seed: int = 1):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(n):
+        ts = int(rng.integers(STREAM_LO, STREAM_HI))
+        in_season = WINDOW_LO <= ts < WINDOW_HI
+        items = set()
+        if rng.random() < 0.35:
+            items.update(("milk", "bread"))
+        if in_season and rng.random() < 0.5:
+            items.update(("grill", "charcoal"))
+        n_extra = int(rng.integers(1, 4))
+        items.update(rng.choice(CATALOG, size=n_extra, replace=False))
+        rows.append(f"X{i:06d},{ts}," + ",".join(sorted(items)))
+    return rows
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
+    seed = int(sys.argv[2]) if len(sys.argv) > 2 else 1
+    print("\n".join(generate(n, seed)))

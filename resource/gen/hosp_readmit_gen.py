@@ -1,0 +1,39 @@
+#!/usr/bin/env python3
+"""Synthetic hospital-admission records for the hosp (readmission
+feature analysis) use case — the reference's hosp_readmit.rb role for
+hosp.properties / tutorial_hospital_readmit.txt.  Readmission risk is
+driven by prior admissions and diagnosis, mildly by age, and not at all
+by length of stay, so mutual-information ranking has a known answer.
+Line: admissionId,age,lengthOfStay,diagnosis,priorAdmissions,dischargedTo,readmitted
+Usage: hosp_readmit_gen.py <n_rows> [seed] > admissions.csv
+"""
+
+import sys
+
+import numpy as np
+
+DIAGNOSES = ["cardiac", "respiratory", "orthopedic", "metabolic", "other"]
+DIAG_RISK = {"cardiac": 0.30, "respiratory": 0.25, "orthopedic": 0.08,
+             "metabolic": 0.20, "other": 0.12}
+DISCHARGE = ["home", "homeCare", "skilledNursing"]
+
+
+def generate(n: int, seed: int = 1):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(n):
+        age = int(np.clip(rng.normal(62, 18), 18, 95))
+        los = int(np.clip(rng.gamma(2.0, 3.0), 1, 30))
+        diag = DIAGNOSES[rng.choice(5, p=[0.25, 0.2, 0.15, 0.15, 0.25])]
+        prior = int(np.clip(rng.poisson(1.0), 0, 9))
+        disch = DISCHARGE[rng.choice(3, p=[0.6, 0.25, 0.15])]
+        p = DIAG_RISK[diag] + 0.08 * prior + 0.002 * (age - 60)
+        readmit = "T" if rng.random() < np.clip(p, 0.02, 0.9) else "F"
+        rows.append(f"A{i:06d},{age},{los},{diag},{prior},{disch},{readmit}")
+    return rows
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
+    seed = int(sys.argv[2]) if len(sys.argv) > 2 else 1
+    print("\n".join(generate(n, seed)))

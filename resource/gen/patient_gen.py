@@ -1,0 +1,37 @@
+#!/usr/bin/env python3
+"""Synthetic patient records for the disease (rule mining) use case —
+the reference's disease.rb role for disease.properties /
+tutorial_diesase_rule_mining.txt.  Diabetes odds rise sharply with
+glucose and bmi and mildly with age, so candidate-split scoring and the
+hand-written risk rules both have real signal to confirm.
+Line: patientId,age,bmi,glucose,systolicBp,smoker,diabetic
+Usage: patient_gen.py <n_rows> [seed] > patients.csv
+"""
+
+import sys
+
+import numpy as np
+
+SMOKER = ["never", "former", "current"]
+
+
+def generate(n: int, seed: int = 1):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(n):
+        age = int(np.clip(rng.normal(52, 16), 18, 90))
+        bmi = int(np.clip(rng.normal(28, 6), 15, 50))
+        glucose = int(np.clip(rng.normal(105 + 0.8 * (bmi - 25), 30),
+                              60, 250))
+        bp = int(np.clip(rng.normal(125 + 0.4 * age - 15, 18), 90, 200))
+        smoker = SMOKER[rng.choice(3, p=[0.5, 0.3, 0.2])]
+        risk = -7.0 + 0.035 * glucose + 0.06 * bmi + 0.015 * age
+        diabetic = "T" if rng.random() < 1 / (1 + np.exp(-risk)) else "F"
+        rows.append(f"P{i:06d},{age},{bmi},{glucose},{bp},{smoker},{diabetic}")
+    return rows
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
+    seed = int(sys.argv[2]) if len(sys.argv) > 2 else 1
+    print("\n".join(generate(n, seed)))

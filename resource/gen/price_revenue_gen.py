@@ -17,12 +17,20 @@ PRICE_VALUE = {"price19": 19.0, "price24": 24.0, "price29": 29.0,
                "price34": 34.0}
 
 
+def best_prices(n_products: int = 5, curve_seed: int = 0):
+    """The hidden optimal price per product (the demand-curve peaks the
+    bandit should converge to) — exposed so tests assert against the
+    generator's own truth instead of replaying its RNG draws."""
+    curve_rng = np.random.default_rng(curve_seed)
+    return {f"prod{p}": PRICES[int(curve_rng.integers(0, len(PRICES)))]
+            for p in range(n_products)}
+
+
 def generate(n: int, seed: int = 1, n_products: int = 5, curve_seed: int = 0):
     """seed varies the event noise per round; curve_seed fixes each
     product's hidden optimal price so successive rounds agree."""
-    curve_rng = np.random.default_rng(curve_seed)
-    best = {f"prod{p}": int(curve_rng.integers(0, len(PRICES)))
-            for p in range(n_products)}
+    best = {k: PRICES.index(v)
+            for k, v in best_prices(n_products, curve_seed).items()}
     rng = np.random.default_rng(seed)
     rows = []
     for _ in range(n):

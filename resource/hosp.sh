@@ -1,0 +1,17 @@
+#!/bin/bash
+# Hospital readmission analysis driver (mutual-information ranking of
+# admission features against readmission).
+#   ./hosp.sh analyze <admissions.csv> <out_dir>
+set -e
+DIR=$(cd "$(dirname "$0")" && pwd)
+RUN="python -m avenir_tpu.cli.run"
+PROPS="$DIR/hosp.properties"
+
+case "$1" in
+analyze)
+  $RUN org.avenir.explore.MutualInformation -Dconf.path=$PROPS \
+      -Dmut.feature.schema.file.path=$DIR/hosp_readmit.json "$2" "$3"
+  ;;
+*)
+  echo "usage: $0 analyze <in> <out>" >&2; exit 2 ;;
+esac

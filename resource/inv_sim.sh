@@ -1,0 +1,13 @@
+#!/bin/bash
+# Inventory-forecasting driver (MCMC demand simulation; see inv_sim.py).
+#   ./inv_sim.sh forecast
+set -e
+DIR=$(cd "$(dirname "$0")" && pwd)
+
+case "$1" in
+forecast)
+  python "$DIR/inv_sim.py" "$DIR/inv_sim.properties"
+  ;;
+*)
+  echo "usage: $0 forecast" >&2; exit 2 ;;
+esac

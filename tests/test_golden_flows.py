@@ -561,17 +561,155 @@ def test_price_opt_flow(tmp_path):
     out = list((tmp_path / "prices_r3").glob("part-*"))[0] \
         .read_text().splitlines()
     assert len(out) == 5
-    curve_rng = np.random.default_rng(0)
-    best = {f"prod{p}": gen.PRICES[int(curve_rng.integers(0, 4))]
-            for p in range(5)}
+    best = gen.best_prices(5)
     hits = sum(1 for l in out if l.split(",")[1] == best[l.split(",")[0]])
     assert hits >= 4  # UCB1 may still be exploring one product
+
+
+def test_disease_rule_mining_flow(tmp_path):
+    """disease.sh: candidate risk-factor splits + hand-written risk rules
+    (reference disease.properties + tutorial_diesase_rule_mining.txt)."""
+    data = tmp_path / "patients.csv"
+    data.write_text("\n".join(_gen("patient_gen", 2500, 1)))
+    props = os.path.join(RES, "disease.properties")
+    rc = cli_run.main([
+        "org.avenir.explore.ClassPartitionGenerator", f"-Dconf.path={props}",
+        f"-Dcpg.feature.schema.file.path={RES}/patient.json",
+        str(data), str(tmp_path / "root")])
+    assert rc == 0
+    root_info = float(
+        list((tmp_path / "root").glob("part-*"))[0].read_text().strip())
+    rc = cli_run.main([
+        "org.avenir.explore.ClassPartitionGenerator", f"-Dconf.path={props}",
+        f"-Dcpg.feature.schema.file.path={RES}/patient.json",
+        "-Dcpg.split.attributes=1,2,3,4,5",
+        f"-Dcpg.parent.info={root_info}",
+        str(data), str(tmp_path / "splits")])
+    assert rc == 0
+    split_lines = list((tmp_path / "splits").glob("part-*"))[0] \
+        .read_text().splitlines()
+    # best gain-ratio split is on glucose (ordinal 3), the dominant factor
+    best = max(split_lines, key=lambda l: float(l.split(";")[2]))
+    assert best.split(";")[0] == "3"
+    rc = cli_run.main([
+        "org.avenir.explore.RuleEvaluator", f"-Dconf.path={props}",
+        "-Drue.data.size=2500",
+        str(data), str(tmp_path / "rules")])
+    assert rc == 0
+    rules = {l.split(",")[0]: (float(l.split(",")[1]), float(l.split(",")[2]))
+             for l in list((tmp_path / "rules").glob("part-*"))[0]
+             .read_text().splitlines()}
+    assert set(rules) == {"hyperglycemic", "obeseSenior", "leanYoung"}
+    # high glucose predicts diabetes far better than the ~30% base rate
+    assert rules["hyperglycemic"][0] > 0.6
+    assert rules["leanYoung"][0] > 0.7  # lean+young predicts non-diabetic
+
+
+def test_conv_markov_flow(tmp_path):
+    """conv.sh: per-class engagement transition matrices -> log-odds
+    conversion classification (reference conv.properties +
+    cust_conv_with_markov_chain_classification_tutorial.txt)."""
+    seqs = tmp_path / "sequences.csv"
+    seqs.write_text("\n".join(_gen("conv_seq_gen", 1200, 1)))
+    props = os.path.join(RES, "conv.properties")
+    model = tmp_path / "conv_model"
+    rc = cli_run.main([
+        "org.avenir.markov.MarkovStateTransitionModel",
+        f"-Dconf.path={props}", str(seqs), str(model)])
+    assert rc == 0
+    rc = cli_run.main([
+        "org.avenir.markov.MarkovModelClassifier", f"-Dconf.path={props}",
+        f"-Dmmc.mm.model.path={model}/part-r-00000",
+        str(seqs), str(tmp_path / "pred")])
+    assert rc == 0
+    out = list((tmp_path / "pred").glob("part-*"))[0].read_text().splitlines()
+    assert len(out) == 1200
+    acc = np.mean([l.split(",")[2] == l.split(",")[1] for l in out])
+    assert acc > 0.8
+
+
+def test_hosp_readmit_flow(tmp_path):
+    """hosp.sh: mutual-information ranking of readmission drivers
+    (reference hosp.properties + tutorial_hospital_readmit.txt)."""
+    data = tmp_path / "admissions.csv"
+    data.write_text("\n".join(_gen("hosp_readmit_gen", 4000, 1)))
+    props = os.path.join(RES, "hosp.properties")
+    rc = cli_run.main([
+        "org.avenir.explore.MutualInformation", f"-Dconf.path={props}",
+        f"-Dmut.feature.schema.file.path={RES}/hosp_readmit.json",
+        str(data), str(tmp_path / "mi")])
+    assert rc == 0
+    lines = list((tmp_path / "mi").glob("part-*"))[0].read_text().splitlines()
+    mi = {l.split(",")[1]: float(l.split(",")[2])
+          for l in lines if l.startswith("mutualInfo,")}
+    # diagnosis (3) and priorAdmissions (4) drive readmission;
+    # lengthOfStay (2) is noise
+    assert mi["3"] > mi["2"] and mi["4"] > mi["2"]
+
+
+def test_fit_seasonal_apriori_flow(tmp_path):
+    """fit.sh: temporal filter to the season window, then Apriori finds
+    the seasonal bundle the unfiltered stream would dilute below support
+    (reference fit.properties + resource/fit.sh)."""
+    import importlib
+    gen = importlib.import_module("gen.fit_xaction_gen")
+    data = tmp_path / "xactions.csv"
+    data.write_text("\n".join(gen.generate(2000, 1)))
+    props = os.path.join(RES, "fit.properties")
+    rc = cli_run.main([
+        "org.chombo.mr.TemporalFilter", f"-Dconf.path={props}",
+        str(data), str(tmp_path / "filtered")])
+    assert rc == 0
+    filtered = list((tmp_path / "filtered").glob("part-*"))[0] \
+        .read_text().splitlines()
+    assert 0 < len(filtered) < 2000
+    assert all(gen.WINDOW_LO <= int(l.split(",")[1]) < gen.WINDOW_HI
+               for l in filtered)
+    n_filt = len(filtered)
+    common = [f"-Dconf.path={props}", f"-Dfia.total.tans.count={n_filt}"]
+    rc = cli_run.main(["org.avenir.association.FrequentItemsApriori",
+                       *common, "-Dfia.item.set.length=1",
+                       "-Dfia.trans.id.output=true",
+                       str(tmp_path / "filtered"), str(tmp_path / "lvl1")])
+    assert rc == 0
+    rc = cli_run.main(["org.avenir.association.FrequentItemsApriori",
+                       *common, "-Dfia.item.set.length=2",
+                       f"-Dfia.item.set.file.path={tmp_path}/lvl1/part-r-00000",
+                       str(tmp_path / "filtered"), str(tmp_path / "lvl2")])
+    assert rc == 0
+    pairs = (tmp_path / "lvl2" / "part-r-00000").read_text()
+    assert "charcoal" in pairs and "grill" in pairs
+
+
+def test_inv_sim_forecast_flow(tmp_path):
+    """inv_sim.sh: MCMC demand simulation scores inventory levels and
+    picks an interior optimum (reference inv_sim.py +
+    inventory_forecasting_with_mcmc_tutorial.txt)."""
+    import subprocess
+    r = subprocess.run(
+        [sys.executable, os.path.join(RES, "inv_sim.py"),
+         os.path.join(RES, "inv_sim.properties")],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "PYTHONPATH":
+             os.pathsep.join([os.path.dirname(RES),
+                              os.environ.get("PYTHONPATH", "")])})
+    assert r.returncode == 0, r.stderr
+    out = r.stdout
+    assert out.count("average earning") == 5
+    best = [l for l in out.splitlines() if l.startswith("best inventory")]
+    assert len(best) == 1
+    # carrying cost vs shortage penalty makes the extremes suboptimal
+    assert int(best[0].split()[2]) in (60, 80, 100)
+    # geweke |z| sane at the configured burn-in
+    z = float(out.splitlines()[0].rsplit(" ", 1)[1])
+    assert abs(z) < 5.0
 
 
 def test_all_driver_scripts_exist_and_are_executable():
     for sh in ("markov.sh", "bandit.sh", "mutual_info.sh", "apriori.sh",
                "carm.sh", "hica.sh", "ovsa.sh",
                "cluster.sh", "svm.sh", "retarget.sh",
-               "buyhist.sh", "sup.sh", "price_opt.sh"):
+               "buyhist.sh", "sup.sh", "price_opt.sh",
+               "disease.sh", "conv.sh", "hosp.sh", "fit.sh", "inv_sim.sh"):
         p = os.path.join(RES, sh)
         assert os.path.exists(p) and os.access(p, os.X_OK)

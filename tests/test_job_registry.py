@@ -84,6 +84,7 @@ EXPECTED = {
     "org.avenir.tree.SplitGenerator": "class_partition_generator",
     "org.avenir.util.EntityDistanceMapFileAccessor": "entity_distance_store",
     "org.sifarish.feature.SameTypeSimilarity": "same_type_similarity",
+    "org.chombo.mr.TemporalFilter": "temporal_filter",
 }
 
 
