@@ -149,13 +149,15 @@ def run_all(base):
     return out
 
 
-def markov_flow(base):
-    d = os.path.join(base, "markov")
+def _markov_chain_flow(base, name, gen_mod, seed, props_name):
+    """Shared MarkovStateTransitionModel -> MarkovModelClassifier chain
+    (the markov and conv use cases differ only in domain/config)."""
+    d = os.path.join(base, name)
     os.makedirs(d, exist_ok=True)
     seqs = os.path.join(d, "sequences.csv")
     with open(seqs, "w") as fh:
-        fh.write("\n".join(_gen("event_seq_gen", 300, 21)))
-    props = os.path.join(RES, "markov.properties")
+        fh.write("\n".join(_gen(gen_mod, 300, seed)))
+    props = os.path.join(RES, props_name)
     assert cli_run.main([
         "org.avenir.markov.MarkovStateTransitionModel",
         f"-Dconf.path={props}", seqs, os.path.join(d, "model")]) == 0
@@ -163,8 +165,13 @@ def markov_flow(base):
         "org.avenir.markov.MarkovModelClassifier", f"-Dconf.path={props}",
         f"-Dmmc.mm.model.path={d}/model/part-r-00000",
         seqs, os.path.join(d, "pred")]) == 0
-    return {"markov/model.csv": _read(f"{d}/model/part-r-00000"),
-            "markov/pred.csv": _read(f"{d}/pred/part-m-00000")}
+    return {f"{name}/model.csv": _read(f"{d}/model/part-r-00000"),
+            f"{name}/pred.csv": _read(f"{d}/pred/part-m-00000")}
+
+
+def markov_flow(base):
+    return _markov_chain_flow(base, "markov", "event_seq_gen", 21,
+                              "markov.properties")
 
 
 def bandit_flow(base):
@@ -228,3 +235,120 @@ def apriori_flow(base):
 
 
 FLOWS = FLOWS + (markov_flow, bandit_flow, mi_flow, apriori_flow)
+
+
+def carm_flow(base):
+    d = os.path.join(base, "carm")
+    os.makedirs(d, exist_ok=True)
+    data = os.path.join(d, "calls.csv")
+    with open(data, "w") as fh:
+        fh.write("\n".join(_gen("cust_call_gen", 500, 31)))
+    props = os.path.join(RES, "carm.properties")
+    assert cli_run.main([
+        "org.avenir.explore.MutualInformation", f"-Dconf.path={props}",
+        f"-Dmut.feature.schema.file.path={RES}/cust_call.json",
+        data, os.path.join(d, "mi")]) == 0
+    assert cli_run.main([
+        "org.avenir.explore.CategoricalClassAffinity", f"-Dconf.path={props}",
+        f"-Dcca.feature.schema.file.path={RES}/cust_call.json",
+        data, os.path.join(d, "aff")]) == 0
+    return {"carm/mi.csv": _read(f"{d}/mi/part-r-00000"),
+            "carm/affinity.csv": _read(f"{d}/aff/part-r-00000")}
+
+
+def hica_flow(base):
+    d = os.path.join(base, "hica")
+    os.makedirs(d, exist_ok=True)
+    data = os.path.join(d, "deliveries.csv")
+    with open(data, "w") as fh:
+        fh.write("\n".join(_gen("delivery_gen", 800, 32)))
+    props = os.path.join(RES, "hica.properties")
+    out = {}
+    for mode, extra in (("enc", []),
+                        ("woe", ["-Dcoe.encoding.strategy=weightOfEvidence"])):
+        assert cli_run.main([
+            "org.avenir.explore.CategoricalContinuousEncoding",
+            f"-Dconf.path={props}",
+            f"-Dcoe.feature.schema.file.path={RES}/delivery.json",
+            *extra, data, os.path.join(d, mode)]) == 0
+        out[f"hica/{mode}.csv"] = _read(f"{d}/{mode}/part-r-00000")
+    return out
+
+
+def svm_flow(base):
+    d = os.path.join(base, "svm")
+    os.makedirs(d, exist_ok=True)
+    data = os.path.join(d, "churn.csv")
+    with open(data, "w") as fh:
+        fh.write("\n".join(_gen("churn_svm_gen", 300, 33)))
+    props = os.path.join(RES, "svm.properties")
+    assert cli_run.main([
+        "org.avenir.discriminant.SupportVectorMachine",
+        f"-Dconf.path={props}",
+        f"-Dsvm.feature.schema.file.path={RES}/churn_svm.json",
+        data, os.path.join(d, "model")]) == 0
+    assert cli_run.main([
+        "org.avenir.discriminant.SupportVectorPredictor",
+        f"-Dconf.path={props}",
+        f"-Dsvm.feature.schema.file.path={RES}/churn_svm.json",
+        f"-Dsvm.model.file.path={d}/model/part-r-00000",
+        data, os.path.join(d, "pred")]) == 0
+    return {"svm/model.csv": _read(f"{d}/model/part-r-00000"),
+            "svm/pred.csv": _read(f"{d}/pred/part-m-00000")}
+
+
+def conv_flow(base):
+    # same train->classify job chain as markov_flow, different domain
+    return _markov_chain_flow(base, "conv", "conv_seq_gen", 34,
+                              "conv.properties")
+
+
+def sup_flow(base):
+    d = os.path.join(base, "sup")
+    os.makedirs(d, exist_ok=True)
+    events = os.path.join(d, "events.csv")
+    with open(events, "w") as fh:
+        fh.write("\n".join(_gen("supplier_events_gen", 4, 50, 35)))
+    conf = os.path.join(RES, "sup.conf")
+    assert cli_run.main([
+        "org.avenir.spark.markov.StateTransitionRate",
+        f"-Dconf.path={conf}", events, os.path.join(d, "rates")]) == 0
+    init = os.path.join(d, "init.csv")
+    with open(init, "w") as fh:
+        fh.write("\n".join(f"S{i:03d},F" for i in range(4)))
+    assert cli_run.main([
+        "org.avenir.spark.markov.ContTimeStateTransitionStats",
+        f"-Dconf.path={conf}",
+        f"-Dstate.trans.file.path={d}/rates/part-r-00000",
+        init, os.path.join(d, "fc")]) == 0
+    return {"sup/rates.csv": _read(f"{d}/rates/part-r-00000"),
+            "sup/forecast.csv": _read(f"{d}/fc/part-r-00000")}
+
+
+def disease_flow(base):
+    d = os.path.join(base, "disease")
+    os.makedirs(d, exist_ok=True)
+    data = os.path.join(d, "patients.csv")
+    with open(data, "w") as fh:
+        fh.write("\n".join(_gen("patient_gen", 600, 36)))
+    props = os.path.join(RES, "disease.properties")
+    assert cli_run.main([
+        "org.avenir.explore.ClassPartitionGenerator", f"-Dconf.path={props}",
+        f"-Dcpg.feature.schema.file.path={RES}/patient.json",
+        data, os.path.join(d, "root")]) == 0
+    root_info = _read(f"{d}/root/part-r-00000").strip()
+    assert cli_run.main([
+        "org.avenir.explore.ClassPartitionGenerator", f"-Dconf.path={props}",
+        f"-Dcpg.feature.schema.file.path={RES}/patient.json",
+        "-Dcpg.split.attributes=1,2,3,4,5",
+        f"-Dcpg.parent.info={root_info}",
+        data, os.path.join(d, "splits")]) == 0
+    assert cli_run.main([
+        "org.avenir.explore.RuleEvaluator", f"-Dconf.path={props}",
+        "-Drue.data.size=600", data, os.path.join(d, "rules")]) == 0
+    return {"disease/splits.csv": _read(f"{d}/splits/part-r-00000"),
+            "disease/rules.csv": _read(f"{d}/rules/part-r-00000")}
+
+
+FLOWS = FLOWS + (carm_flow, hica_flow, svm_flow, conv_flow, sup_flow,
+                 disease_flow)
