@@ -257,16 +257,38 @@ def train(table: ColumnarTable, ctx: Optional[MeshContext] = None,
 # prediction
 # --------------------------------------------------------------------------
 
-@dataclass
 class PredictionResult:
-    pred_class: List[str]           # per record
-    pred_prob: np.ndarray           # (n,) int percent
-    class_probs: np.ndarray         # (n, C) int percent
-    class_prob_diff: Optional[np.ndarray] = None
-    # raw doubles for bap.output.feature.prob.only mode
-    # (BayesianPredictor.outputFeatureProb :276-286)
-    feature_prior_prob: Optional[np.ndarray] = None    # (n,)   P(x)
-    feature_post_prob: Optional[np.ndarray] = None     # (n, C) P(x|c)
+    """Per-record prediction outputs.  ``feature_prior_prob`` /
+    ``feature_post_prob`` (the raw doubles of
+    BayesianPredictor.outputFeatureProb :276-286, used only by the
+    bap.output.feature.prob.only mode) are read back from the device
+    lazily on first access — the standard predict path then ships ~60%
+    fewer bytes over the device->host link."""
+
+    def __init__(self, pred_class: List[str], pred_prob: np.ndarray,
+                 class_probs: np.ndarray,
+                 class_prob_diff: Optional[np.ndarray] = None,
+                 feature_prior_prob=None, feature_post_prob=None,
+                 n_rows: Optional[int] = None):
+        self.pred_class = pred_class            # per record
+        self.pred_prob = pred_prob              # (n,) int percent
+        self.class_probs = class_probs          # (n, C) int percent
+        self.class_prob_diff = class_prob_diff
+        self._px = feature_prior_prob           # (n,)   P(x), maybe device
+        self._pxc = feature_post_prob           # (n, C) P(x|c), maybe device
+        self._n = n_rows if n_rows is not None else len(pred_class)
+
+    @property
+    def feature_prior_prob(self) -> Optional[np.ndarray]:
+        if self._px is not None and not isinstance(self._px, np.ndarray):
+            self._px = np.asarray(self._px)[:self._n]
+        return self._px
+
+    @property
+    def feature_post_prob(self) -> Optional[np.ndarray]:
+        if self._pxc is not None and not isinstance(self._pxc, np.ndarray):
+            self._pxc = np.asarray(self._pxc)[:self._n]
+        return self._pxc
 
 
 def _log(x, eps=1e-30):
@@ -284,12 +306,22 @@ def _predict_kernel(bc, cv, nbins_arr, log_post, log_prior, log_class,
     predict to ~0.02M rows/sec."""
     bmax = log_post.shape[2]
     Fb = bc.shape[1]
-    safe = jnp.clip(bc, 0, bmax - 1)                      # (n, Fb)
-    # unknown categorical (-1) or out-of-alphabet bin: skip the feature
+    # codes arrive as uint8 when every bin id fits (255 = the unknown
+    # sentinel) — the ~16 MB/s host->device tunnel makes predict
+    # upload-bound, so the transfer ships the narrowest dtype and decodes
+    # here (TPU_NOTES.md section 5); int32 is the >=255-bin fallback
+    if bc.dtype == jnp.uint8:
+        bci = bc.astype(jnp.int32)
+        unknown = bci == 255
+    else:
+        bci = bc
+        unknown = bci < 0
+    safe = jnp.clip(bci, 0, bmax - 1)                     # (n, Fb)
+    # unknown categorical or out-of-alphabet bin: skip the feature
     # entirely (contribute to neither P(x|c) nor P(x)); the reference's
     # missing-BinCount lookup degenerates to 0/0, so skipping is the
     # well-defined superset behavior.
-    known = (bc >= 0) & (bc < nbins_arr[None, :Fb])
+    known = ~unknown & (bci < nbins_arr[None, :Fb])
     known_f = known.astype(jnp.float32)                   # (n, Fb)
     oh_b = jax.nn.one_hot(safe, bmax, dtype=jnp.float32)  # (n, Fb, B)
     hi_p = jax.lax.Precision.HIGHEST
@@ -311,6 +343,46 @@ def _predict_kernel(bc, cv, nbins_arr, log_post, log_prior, log_class,
     probs = jnp.exp(log_ratio)
     pct = jnp.floor(probs * 100.0).astype(jnp.int32)      # (n, C)
     return pct, jnp.exp(log_px), jnp.exp(log_px_c)
+
+
+def _device_model_tables(model: NaiveBayesModel, ctx: MeshContext):
+    """Model probability tables resident on device: all eight small arrays
+    packed into ONE f32 transfer (each separate upload costs a full
+    ~62 ms tunnel round trip — TPU_NOTES.md section 5), unpacked by
+    on-device slices, and cached on the model per context so chunked /
+    repeated predicts re-ship nothing."""
+    cached = getattr(model, "_dev_tables", None)
+    if cached is not None and cached[0] is ctx:
+        return cached[1]
+    post_p = model.post_counts / np.maximum(
+        model.class_counts[:, None, None], 1.0)
+    prior_p = model.prior_counts / max(model.total, 1.0)
+    class_p = model.class_counts / max(model.total, 1.0)
+    log_post = np.log(np.clip(post_p, 1e-30, None)).astype(np.float32)
+    log_prior = np.log(np.clip(prior_p, 1e-30, None)).astype(np.float32)
+    log_class = np.log(np.clip(class_p, 1e-30, None)).astype(np.float32)
+    cpm = np.asarray(model.cont_post_mean, dtype=np.float32)
+    cps = np.maximum(model.cont_post_std, 1e-6).astype(np.float32)
+    cqm = np.asarray(model.cont_prior_mean, dtype=np.float32)
+    cqs = np.maximum(model.cont_prior_std, 1e-6).astype(np.float32)
+    nbins = np.asarray(model.num_bins if model.num_bins else [1],
+                       dtype=np.float32)   # small ints, exact in f32
+    parts = [log_post.ravel(), log_prior.ravel(), log_class.ravel(),
+             cpm.ravel(), cps.ravel(), cqm.ravel(), cqs.ravel(), nbins]
+    packed_host = np.concatenate(parts)
+    packed = ctx.replicate(jnp.asarray(packed_host, dtype=jnp.float32))
+    shapes = [log_post.shape, log_prior.shape, log_class.shape,
+              cpm.shape, cps.shape, cqm.shape, cqs.shape, nbins.shape]
+    arrays = []
+    off = 0
+    for shp in shapes:
+        size = int(np.prod(shp)) if shp else 1
+        arrays.append(packed[off:off + size].reshape(shp))
+        off += size
+    arrays[-1] = jnp.round(arrays[-1]).astype(jnp.int32)   # nbins
+    tables = tuple(arrays)
+    model.__dict__["_dev_tables"] = (ctx, tables)
+    return tables
 
 
 def predict(model: NaiveBayesModel, table: ColumnarTable,
@@ -340,28 +412,26 @@ def predict(model: NaiveBayesModel, table: ColumnarTable,
     else:
         cont_vals = np.zeros((padded.n_rows, 0), dtype=np.float64)
 
-    # normalized log-prob tables (replicated small arrays)
-    post_p = model.post_counts / np.maximum(model.class_counts[:, None, None], 1.0)
-    prior_p = model.prior_counts / max(model.total, 1.0)
-    class_p = model.class_counts / max(model.total, 1.0)
+    (log_post, log_prior, log_class,
+     cpm, cps, cqm, cqs, nbins_arr) = _device_model_tables(model, ctx)
 
-    log_post = ctx.replicate(_log(jnp.asarray(post_p, dtype=jnp.float32)))
-    log_prior = ctx.replicate(_log(jnp.asarray(prior_p, dtype=jnp.float32)))
-    log_class = ctx.replicate(_log(jnp.asarray(class_p, dtype=jnp.float32)))
+    max_bins = max(model.num_bins) if model.num_bins else 0
+    if max_bins < 255:
+        # uint8 transfer, 255 = skip sentinel.  Unknown (-1) AND any
+        # out-of-alphabet code >= 255 map to it — an unclamped bucketed
+        # value (table.py bin codes have no upper clamp) would otherwise
+        # WRAP into a valid bin id under uint8 and poison the lookup
+        bin_codes = np.where((bin_codes < 0) | (bin_codes >= 255), 255,
+                             bin_codes).astype(np.uint8)
     bc = ctx.shard_rows(bin_codes)
     cv = ctx.shard_rows(cont_vals.astype(np.float32))
 
-    cpm = ctx.replicate(jnp.asarray(model.cont_post_mean, dtype=jnp.float32))
-    cps = ctx.replicate(jnp.asarray(np.maximum(model.cont_post_std, 1e-6), dtype=jnp.float32))
-    cqm = ctx.replicate(jnp.asarray(model.cont_prior_mean, dtype=jnp.float32))
-    cqs = ctx.replicate(jnp.asarray(np.maximum(model.cont_prior_std, 1e-6), dtype=jnp.float32))
-
-    nbins_arr = ctx.replicate(jnp.asarray(
-        model.num_bins if model.num_bins else [1], dtype=jnp.int32))
-
-    pct, px, pxc = (np.asarray(x)[:table.n_rows] for x in _predict_kernel(
+    pct_dev, px_dev, pxc_dev = _predict_kernel(
         bc, cv, nbins_arr, log_post, log_prior, log_class,
-        cpm, cps, cqm, cqs))
+        cpm, cps, cqm, cqs)
+    # only pct crosses the link eagerly; the raw feature probabilities
+    # stay device-side until feature-prob-only mode asks for them
+    pct = np.asarray(pct_dev)[:table.n_rows]
     best = np.argmax(pct, axis=1)
     pred_prob = pct[np.arange(len(best)), best]
     # difference with the next-highest class prob (defaultArbitrate :345-365)
@@ -373,7 +443,9 @@ def predict(model: NaiveBayesModel, table: ColumnarTable,
     pred_class = [model.class_values[i] for i in best]
     return PredictionResult(pred_class=pred_class, pred_prob=pred_prob,
                             class_probs=pct, class_prob_diff=diff,
-                            feature_prior_prob=px, feature_post_prob=pxc)
+                            feature_prior_prob=px_dev,
+                            feature_post_prob=pxc_dev,
+                            n_rows=table.n_rows)
 
 
 def evaluate(model: NaiveBayesModel, table: ColumnarTable,

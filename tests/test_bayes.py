@@ -142,3 +142,28 @@ def test_end_to_end_accuracy(data, mesh_ctx, tmp_path):
     cm = bayes.evaluate(m2, data, res, counters=counters)
     assert cm.accuracy() >= 85  # separable synthetic data
     assert counters.get("Validation", "TruePositive") == cm.true_pos
+
+
+def test_predict_far_out_of_range_value_skips_feature(data, mesh_ctx):
+    """A bucketed value >= 255 bins past the alphabet must be SKIPPED like
+    any out-of-alphabet bin, not wrapped into a valid bin id by the uint8
+    transfer (regression: uint8 wrap of unclamped codes >= 256)."""
+    m = bayes.train(data, mesh_ctx)
+    rows = make_rows(np.random.default_rng(3), 40)
+    far = [r.copy() for r in rows]
+    for r in far:
+        r[2] = "999999"       # usage bin code ~20000, >= 256
+    unk = [r.copy() for r in rows]
+    for r in unk:
+        r[2] = "250"          # bin 5 of 11 — stays in-alphabet
+    res_far = bayes.predict(m, encode_rows(far, SCHEMA))
+    # oracle for "skip the usage feature": out-of-alphabet but < 256, the
+    # int-path skip the kernel has always applied
+    mid = [r.copy() for r in rows]
+    for r in mid:
+        r[2] = "12000"        # bin 240: out-of-alphabet, fits in uint8
+    res_mid = bayes.predict(m, encode_rows(mid, SCHEMA))
+    np.testing.assert_array_equal(res_far.class_probs, res_mid.class_probs)
+    # sanity: an in-alphabet value actually changes the outputs
+    res_unk = bayes.predict(m, encode_rows(unk, SCHEMA))
+    assert not np.array_equal(res_far.class_probs, res_unk.class_probs)
