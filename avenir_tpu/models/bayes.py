@@ -354,24 +354,25 @@ def _device_model_tables(model: NaiveBayesModel, ctx: MeshContext):
     cached = getattr(model, "_dev_tables", None)
     if cached is not None and cached[0] is ctx:
         return cached[1]
-    post_p = model.post_counts / np.maximum(
-        model.class_counts[:, None, None], 1.0)
-    prior_p = model.prior_counts / max(model.total, 1.0)
-    class_p = model.class_counts / max(model.total, 1.0)
-    log_post = np.log(np.clip(post_p, 1e-30, None)).astype(np.float32)
-    log_prior = np.log(np.clip(prior_p, 1e-30, None)).astype(np.float32)
-    log_class = np.log(np.clip(class_p, 1e-30, None)).astype(np.float32)
+    # pack the PROBABILITY tables and take the log on device via _log —
+    # the same f32 values and XLA log op as the pre-packing path, so
+    # outputs stay bit-identical (a host np.log would differ in the last
+    # ulp from XLA's)
+    post_p = (model.post_counts / np.maximum(
+        model.class_counts[:, None, None], 1.0)).astype(np.float32)
+    prior_p = (model.prior_counts / max(model.total, 1.0)).astype(np.float32)
+    class_p = (model.class_counts / max(model.total, 1.0)).astype(np.float32)
     cpm = np.asarray(model.cont_post_mean, dtype=np.float32)
     cps = np.maximum(model.cont_post_std, 1e-6).astype(np.float32)
     cqm = np.asarray(model.cont_prior_mean, dtype=np.float32)
     cqs = np.maximum(model.cont_prior_std, 1e-6).astype(np.float32)
     nbins = np.asarray(model.num_bins if model.num_bins else [1],
                        dtype=np.float32)   # small ints, exact in f32
-    parts = [log_post.ravel(), log_prior.ravel(), log_class.ravel(),
+    parts = [post_p.ravel(), prior_p.ravel(), class_p.ravel(),
              cpm.ravel(), cps.ravel(), cqm.ravel(), cqs.ravel(), nbins]
     packed_host = np.concatenate(parts)
     packed = ctx.replicate(jnp.asarray(packed_host, dtype=jnp.float32))
-    shapes = [log_post.shape, log_prior.shape, log_class.shape,
+    shapes = [post_p.shape, prior_p.shape, class_p.shape,
               cpm.shape, cps.shape, cqm.shape, cqs.shape, nbins.shape]
     arrays = []
     off = 0
@@ -379,8 +380,9 @@ def _device_model_tables(model: NaiveBayesModel, ctx: MeshContext):
         size = int(np.prod(shp)) if shp else 1
         arrays.append(packed[off:off + size].reshape(shp))
         off += size
-    arrays[-1] = jnp.round(arrays[-1]).astype(jnp.int32)   # nbins
-    tables = tuple(arrays)
+    tables = (_log(arrays[0]), _log(arrays[1]), _log(arrays[2]),
+              arrays[3], arrays[4], arrays[5], arrays[6],
+              jnp.round(arrays[7]).astype(jnp.int32))
     model.__dict__["_dev_tables"] = (ctx, tables)
     return tables
 

@@ -211,6 +211,29 @@ def rf_predict_rate(n):
             "unit": "rows*trees/sec", "n": n, "trees": len(models)}
 
 
+def nb_predict_rate(n):
+    """NaiveBayes predict: full production path (uint8 code upload, packed
+    cached model tables, eager pct readback only) over n churn-style rows."""
+    from avenir_tpu.models import bayes
+    from avenir_tpu.core.schema import FeatureSchema
+    from avenir_tpu.core.table import encode_rows
+    res_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "resource")
+    sys.path.insert(0, res_dir)
+    from gen import telecom_churn_gen
+    schema = FeatureSchema.load(os.path.join(res_dir, "churn.json"))
+    rows = [r.split(",") for r in telecom_churn_gen.generate(n, 7)]
+    table = encode_rows(rows, schema)
+    model = bayes.train(table)
+    bayes.predict(model, table)  # compile + warm + device model cache
+    t0 = time.perf_counter()
+    res = bayes.predict(model, table)
+    dt = time.perf_counter() - t0
+    assert len(res.pred_class) == n
+    return {"metric": "nb_predict_rows_per_sec",
+            "value": round(n / dt, 1), "unit": "rows/sec", "n": n}
+
+
 def sa_rate(n_chains):
     """Simulated annealing: n_chains independent Metropolis chains over a
     matrix-cost assignment domain, 2000 iterations in one lax.scan — the
@@ -240,6 +263,7 @@ WORKLOADS = {
     "knn": (knn_rate, [8_000, 4_000]),
     "knn_big": (knn_big_rate, [20_000]),
     "rf_predict": (rf_predict_rate, [1_000_000, 200_000]),
+    "nb_predict": (nb_predict_rate, [500_000, 100_000]),
     "sa": (sa_rate, [4_096, 512]),
 }
 

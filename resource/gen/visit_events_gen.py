@@ -1,0 +1,51 @@
+#!/usr/bin/env python3
+"""Synthetic timestamped site-visit events for the visit (event-time
+distribution) use case — the reference's visit_history.py role feeding
+spark/.../sequence/EventTimeDistribution.scala.  Users split into
+daytime workers (visits peak 9-17h) and night owls (peak 20-02h), so
+the per-user hour-of-day histograms separate the two profiles.
+Line: userId,epochMs
+Usage: visit_events_gen.py <n_users> <events_per_user> [seed] > visits.csv
+"""
+
+import sys
+
+import numpy as np
+
+MS_HOUR = 3_600_000
+MS_DAY = 24 * MS_HOUR
+BASE = 19_676 * MS_DAY  # epoch ms at a UTC midnight, so hour offsets are exact
+
+
+def generate(n_users: int, n_events: int, seed: int = 1):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for u in range(n_users):
+        p = _night_p() if u % 2 == 1 else _day_p()
+        for _ in range(n_events):
+            day = int(rng.integers(0, 30))
+            hour = int(rng.choice(24, p=p))
+            minute_ms = int(rng.integers(0, MS_HOUR))
+            ts = BASE + day * MS_DAY + hour * MS_HOUR + minute_ms
+            rows.append(f"U{u:04d},{ts}")
+    return rows
+
+
+def _day_p():
+    p = np.ones(24) * 0.2
+    p[9:18] = 2.0
+    return p / p.sum()
+
+
+def _night_p():
+    p = np.ones(24) * 0.2
+    p[20:24] = 2.0
+    p[0:3] = 2.0
+    return p / p.sum()
+
+
+if __name__ == "__main__":
+    n_users = int(sys.argv[1]) if len(sys.argv) > 1 else 50
+    n_ev = int(sys.argv[2]) if len(sys.argv) > 2 else 100
+    seed = int(sys.argv[3]) if len(sys.argv) > 3 else 1
+    print("\n".join(generate(n_users, n_ev, seed)))

@@ -1,0 +1,16 @@
+#!/bin/bash
+# Visit-time distribution driver (per-user hour-of-day event histograms).
+#   ./visit.sh histogram <visits.csv> <out_dir>
+set -e
+DIR=$(cd "$(dirname "$0")" && pwd)
+RUN="python -m avenir_tpu.cli.run"
+PROPS="$DIR/visit.properties"
+
+case "$1" in
+histogram)
+  $RUN org.avenir.spark.sequence.EventTimeDistribution -Dconf.path=$PROPS \
+      "$2" "$3"
+  ;;
+*)
+  echo "usage: $0 histogram <in> <out>" >&2; exit 2 ;;
+esac

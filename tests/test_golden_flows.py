@@ -705,11 +705,41 @@ def test_inv_sim_forecast_flow(tmp_path):
     assert abs(z) < 5.0
 
 
+def test_visit_time_distribution_flow(tmp_path):
+    """visit.sh: per-user hour-of-day histograms separate daytime workers
+    from night owls (reference visit_history.py +
+    EventTimeDistribution.scala)."""
+    import importlib
+    gen = importlib.import_module("gen.visit_events_gen")
+    data = tmp_path / "visits.csv"
+    data.write_text("\n".join(gen.generate(20, 120, 1)))
+    props = os.path.join(RES, "visit.properties")
+    rc = cli_run.main([
+        "org.avenir.spark.sequence.EventTimeDistribution",
+        f"-Dconf.path={props}", str(data), str(tmp_path / "hist")])
+    assert rc == 0
+    out = list((tmp_path / "hist").glob("part-*"))[0].read_text().splitlines()
+    assert len(out) == 20
+    for l in out:
+        parts = l.split(",")
+        user = parts[0]
+        hist = {int(b.split(":")[0]): int(b.split(":")[1])
+                for b in parts[1:]}
+        assert sum(hist.values()) == 120
+        work = sum(hist.get(h, 0) for h in range(9, 18))
+        night = sum(hist.get(h, 0) for h in (20, 21, 22, 23, 0, 1, 2))
+        if int(user[1:]) % 2 == 0:
+            assert work > night      # daytime worker profile
+        else:
+            assert night > work      # night-owl profile
+
+
 def test_all_driver_scripts_exist_and_are_executable():
     for sh in ("markov.sh", "bandit.sh", "mutual_info.sh", "apriori.sh",
                "carm.sh", "hica.sh", "ovsa.sh",
                "cluster.sh", "svm.sh", "retarget.sh",
                "buyhist.sh", "sup.sh", "price_opt.sh",
-               "disease.sh", "conv.sh", "hosp.sh", "fit.sh", "inv_sim.sh"):
+               "disease.sh", "conv.sh", "hosp.sh", "fit.sh", "inv_sim.sh",
+               "visit.sh"):
         p = os.path.join(RES, sh)
         assert os.path.exists(p) and os.access(p, os.X_OK)
