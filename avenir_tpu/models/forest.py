@@ -50,6 +50,32 @@ class ForestParams:
     seed: int = 0
 
 
+def _pad_chunk(chunk, node_ids, branches, cls_codes, weights):
+    """Pad a tail slice up to the full chunk shape (node_id -1 = inactive,
+    weight 0) so the level kernels only ever compile ONE row shape per
+    level: un-padded tails used to trigger a fresh multi-second XLA
+    compile of the big count kernel for every (level, total-row-count)
+    pair, which dominated deep-scale builds.  The pad rows contribute
+    nothing (inactive AND zero weight), so counts are unchanged."""
+    short = chunk - node_ids.shape[0]
+    if short <= 0:
+        return node_ids, branches, cls_codes, weights
+    return (jnp.pad(node_ids, ((0, short), (0, 0)), constant_values=-1),
+            jnp.pad(branches, ((0, short), (0, 0))),
+            jnp.pad(cls_codes, ((0, short),)),
+            jnp.pad(weights, ((0, short), (0, 0))))
+
+
+@jax.jit
+def _unpack_weights4(packed):
+    """(n, ceil(T/2)) uint8 of 4-bit weight pairs -> (n, T_padded) uint8 on
+    device: the decode costs one elementwise launch; the wire cost is the
+    packed half."""
+    lo = packed & np.uint8(15)
+    hi = packed >> np.uint8(4)
+    return jnp.stack([lo, hi], axis=2).reshape(packed.shape[0], -1)
+
+
 @functools.lru_cache(maxsize=None)
 def _jitted_forest_count_kernel(S: int, B: int, C: int):
     def kernel(node_ids, branches, cls_codes, weights, n_nodes):
@@ -171,8 +197,10 @@ class ForestBuilder:
         acc = None
         for start in range(0, n, chunk):
             end = min(start + chunk, n)
-            c = kernel(node_ids[start:end], base.branches[start:end],
-                       base.cls_codes[start:end], weights[start:end], n_nodes)
+            nid, br, cc, ww = _pad_chunk(
+                chunk, node_ids[start:end], base.branches[start:end],
+                base.cls_codes[start:end], weights[start:end])
+            c = kernel(nid, br, cc, ww, n_nodes)
             ci = c.astype(jnp.int32)
             acc = ci if acc is None else acc + ci
         return np.asarray(acc, dtype=np.float64)
@@ -201,10 +229,11 @@ class ForestBuilder:
         ids_parts, acc = [], None
         for start in range(0, n, chunk):
             end = min(start + chunk, n)
-            ni, c = fused(node_ids[start:end], base.branches[start:end],
-                          base.cls_codes[start:end], weights[start:end],
-                          sel, ctab, n_new)
-            ids_parts.append(ni)
+            nid, br, cc, ww = _pad_chunk(
+                chunk, node_ids[start:end], base.branches[start:end],
+                base.cls_codes[start:end], weights[start:end])
+            ni, c = fused(nid, br, cc, ww, sel, ctab, n_new)
+            ids_parts.append(ni[:end - start])
             ci = c.astype(jnp.int32)
             acc = ci if acc is None else acc + ci
         return jnp.concatenate(ids_parts, axis=0), \
@@ -224,12 +253,21 @@ class ForestBuilder:
         # per-record weight cap feeds the exactness bound in level_chunk
         self._w_max = max((float(c.max()) for c in w_cols if c.size),
                           default=1.0)
-        # integral weights ship in the narrowest dtype that holds w_max
-        # (uint8 in practice: bootstrap counts are tiny) — the host->device
-        # link is the build's bottleneck; kernels cast to f32 on device
+        # integral weights ship in the narrowest form that holds w_max —
+        # the host->device link is the build's bottleneck; kernels cast to
+        # f32 on device.  Bootstrap counts are tiny, so the common case is
+        # 4-bit: two trees per byte, halving the (n, T) upload again
         wdtype = (np.uint8 if self._w_max < 256 else
                   np.uint16 if self._w_max < float(1 << 16) else np.float32)
-        weights = ctx.shard_rows(np.stack(w_cols, axis=1).astype(wdtype))
+        wst = np.stack(w_cols, axis=1).astype(wdtype)
+        if wdtype is np.uint8 and self._w_max < 16 and T > 1:
+            if T % 2:
+                wst = np.concatenate(
+                    [wst, np.zeros((n, 1), np.uint8)], axis=1)
+            packed = wst[:, 0::2] | (wst[:, 1::2] << 4)
+            weights = _unpack_weights4(ctx.shard_rows(packed))[:, :T]
+        else:
+            weights = ctx.shard_rows(wst)
         node_ids = ctx.zeros_rows((n, T), np.int32)
         S, B, C = base.split_set.n_splits, base.split_set.max_branches, base.C
         count_k = _jitted_forest_count_kernel(S, B, C)

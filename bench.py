@@ -188,6 +188,19 @@ def rf_big_rate(n):
     return dict(rf_rate(n), metric="random_forest_2m_rows_x_trees_per_sec")
 
 
+def rf_huge_rate(n):
+    """Deep-scale point toward the 100M-row north star (8M x 16 — repeated
+    20M-row sessions degraded and finally stalled the tunnel; the scale
+    story does not need to re-prove the link).  Warm at the SAME
+    size — every n-wide whole-array program (branch codes, weight unpack,
+    level tails) compiles per shape, and a smaller warm build leaves the
+    timed build paying multi-second XLA compiles.  The watchdog child's
+    persistent compilation cache carries those compiles across rounds, so
+    the warm build is only slow the first time this size is ever seen."""
+    return dict(rf_rate(n),
+                metric="random_forest_deep_scale_rows_x_trees_per_sec")
+
+
 def rf_predict_rate(n):
     """Flagship predict half: 9-tree ensemble vote over n rows, one fused
     device launch per chunk (models byte-identical to the host vote)."""
@@ -265,6 +278,9 @@ WORKLOADS = {
     "rf_predict": (rf_predict_rate, [1_000_000, 200_000]),
     "nb_predict": (nb_predict_rate, [500_000, 100_000]),
     "sa": (sa_rate, [4_096, 512]),
+    # device-only deep-scale point, run AFTER everything else in main():
+    # a timeout here must not down-mode the remaining workloads
+    "rf_huge": (rf_huge_rate, [8_000_000]),
 }
 
 
@@ -434,6 +450,8 @@ def main():
     device_ok = platform is not None and platform != "cpu"
     results, backends = {}, {}
     for name in WORKLOADS:  # dict order: nb first (the primary metric)
+        if name == "rf_huge":
+            continue  # deep-scale point: runs last, see below
         if name == "rf_big" and not device_ok:
             continue  # device-scale amortization point; meaningless on CPU
         if device_ok:
@@ -455,6 +473,15 @@ def main():
               for k in WORKLOADS if k != "nb" and k in results]
     extras.append(dict(pallas_probe(device_ok=device_ok),
                        backend="device" if device_ok else "cpu-fallback"))
+    if device_ok:
+        # deep-scale RF point last: a hang/timeout here can no longer
+        # down-mode anything, every other metric is already in hand.
+        # Generous budget — the full-size warm build pays every 20M-shape
+        # compile the first time (the persistent cache amortizes later
+        # rounds)
+        r, _ = measure("rf_huge", {}, max(DEVICE_TIMEOUT_S, 1500))
+        if r is not None:
+            extras.append(dict(r, backend="device"))
     print(json.dumps({
         "metric": nb["metric"],
         "value": nb["value"],

@@ -120,3 +120,61 @@ def test_cli_wordcount_and_rules(tmp_path):
     assert rc == 0
     lines = artifacts.read_text_input(str(rules_out))
     assert lines == ["highUse,0.667,0.600"]
+
+
+# ---------------------------------------------------------------------------
+# temporalFilter (chombo TemporalFilter, the fit flow's pre-Apriori pass)
+# ---------------------------------------------------------------------------
+
+def test_temporal_filter_range_and_units(tmp_path):
+    from avenir_tpu.cli import run as cli_run
+    from avenir_tpu.core import artifacts
+    data = tmp_path / "events.csv"
+    data.write_text("\n".join([
+        "a,999,x", "b,1000,x", "c,1500,x", "d,2000,x", "e,2001,x"]))
+    props = tmp_path / "f.properties"
+    props.write_text(
+        "tef.time.stamp.field.ordinal=1\n"
+        "tef.time.range=1000:2000\n")
+    out = tmp_path / "out"
+    rc = cli_run.main(["temporalFilter", f"-Dconf.path={props}",
+                       str(data), str(out)])
+    assert rc == 0
+    kept = artifacts.read_text_input(str(out))
+    # inclusive on both ends
+    assert [l.split(",")[0] for l in kept] == ["b", "c", "d"]
+
+    # milli timestamps: the same rows expressed in ms pass with in.mili
+    data2 = tmp_path / "events_ms.csv"
+    data2.write_text("\n".join([
+        "a,999000,x", "b,1000000,x", "d,2000000,x", "e,2000001,x"]))
+    out2 = tmp_path / "out2"
+    rc = cli_run.main(["temporalFilter", f"-Dconf.path={props}",
+                       "-Dtef.time.stamp.in.mili=true",
+                       str(data2), str(out2)])
+    assert rc == 0
+    assert [l.split(",")[0] for l in
+            artifacts.read_text_input(str(out2))] == ["b", "d"]
+
+    # timezone shift moves a boundary row out of range
+    out3 = tmp_path / "out3"
+    rc = cli_run.main(["temporalFilter", f"-Dconf.path={props}",
+                       "-Dtef.time.zone.shift.hours=1",
+                       str(data), str(out3)])
+    assert rc == 0
+    # +3600s pushes everything past 2000
+    assert artifacts.read_text_input(str(out3)) == []
+
+
+def test_temporal_filter_rejects_other_cycle_types(tmp_path):
+    from avenir_tpu.cli import run as cli_run
+    data = tmp_path / "e.csv"
+    data.write_text("a,5,x")
+    props = tmp_path / "f.properties"
+    props.write_text(
+        "tef.time.stamp.field.ordinal=1\n"
+        "tef.time.range=0:10\n"
+        "tef.seasonal.cycle.type=dayOfWeek\n")
+    with pytest.raises(ValueError, match="seasonal cycle"):
+        cli_run.main(["temporalFilter", f"-Dconf.path={props}",
+                      str(data), str(tmp_path / "out")])
