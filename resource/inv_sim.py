@@ -22,6 +22,10 @@ import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+from avenir_tpu.core.platform import force_platform    # noqa: E402
+force_platform()  # honor AVENIR_TPU_PLATFORM / JAX_PLATFORMS (wedged-tunnel
+                  # escape hatch; sitecustomize pre-imports jax pinned to axon)
+
 from avenir_tpu.core.config import load_config         # noqa: E402
 from avenir_tpu.stats.samplers import MetropolisSampler  # noqa: E402
 from avenir_tpu.stats.mcconverge import GewekeConvergence  # noqa: E402

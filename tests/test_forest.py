@@ -229,3 +229,21 @@ def test_feature_cache_rejects_cross_table_reuse(mesh_ctx):
     m.predict(t1, features=cache)
     with pytest.raises(ValueError, match="reused across tables"):
         m.predict(t2, features=cache)
+
+
+def test_chunked_padded_levels_identical_to_single_launch(mesh_ctx,
+                                                          monkeypatch):
+    """The deep-scale chunk loop (tail padded on device to the full chunk
+    shape — node_id -1, weight 0) must produce bit-identical models to the
+    single-launch path.  level_chunk returns millions of rows in practice,
+    so this forces a tiny chunk that exercises multiple launches AND a
+    ragged tail per level."""
+    from avenir_tpu.models import forest as F
+    table = make_table(1100)
+    params = ForestParams(num_trees=4, seed=9)
+    params.tree.max_depth = 3
+    whole = build_forest(table, params, mesh_ctx)
+    # 257 deliberately never divides the (padded) row count evenly
+    monkeypatch.setattr(F, "level_chunk", lambda *a, **k: 257)
+    chunked = F.build_forest(table, params, mesh_ctx)
+    assert [m.to_json() for m in chunked] == [m.to_json() for m in whole]

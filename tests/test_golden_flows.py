@@ -690,7 +690,12 @@ def test_inv_sim_forecast_flow(tmp_path):
         [sys.executable, os.path.join(RES, "inv_sim.py"),
          os.path.join(RES, "inv_sim.properties")],
         capture_output=True, text=True, timeout=600,
-        env={**os.environ, "PYTHONPATH":
+        env={**os.environ,
+             # fresh interpreter: force the CPU backend explicitly — the
+             # parent's in-process jax.config CPU pin does not inherit,
+             # and a wedged device tunnel would hang the child forever
+             "AVENIR_TPU_PLATFORM": "cpu",
+             "PYTHONPATH":
              os.pathsep.join([os.path.dirname(RES),
                               os.environ.get("PYTHONPATH", "")])})
     assert r.returncode == 0, r.stderr

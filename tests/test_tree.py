@@ -323,3 +323,32 @@ def test_pathmatrix_f64_boundary_values(mesh_ctx):
     pred_v, _ = model.predict(table)
     pred_l, _ = model._predict_loop(table)
     assert pred_v == pred_l == ["T", "F", "F"]
+
+
+def test_feature_matrix_wire_format(mesh_ctx):
+    """feature_matrix ships int16 only when lossless: integral columns in
+    int16 range -> int16; a fractional or out-of-range column anywhere ->
+    the f32 fallback.  Branch codes are identical either way."""
+    import jax.numpy as jnp
+    table = make_table(120)
+    splits = T.generate_candidate_splits(SCHEMA)
+    ss = T.SplitSet(splits, SCHEMA)
+    X = ss.feature_matrix(table)
+    assert X.dtype == np.int16  # codes + int holdTime: all narrow
+
+    # fractional values force the f32 path, same branch codes semantics
+    frac = make_table(120)
+    frac.columns[3] = frac.columns[3].astype(np.float64) + 0.5
+    Xf = ss.feature_matrix(frac)
+    assert Xf.dtype == np.float32
+    # out-of-int16-range integral values also fall back
+    big = make_table(120)
+    big.columns[3] = big.columns[3].astype(np.float64) + float(1 << 15)
+    assert ss.feature_matrix(big).dtype == np.float32
+
+    # parity: int16 wire and f32 wire produce identical branch codes for
+    # the same values
+    codes_narrow = np.asarray(ss.branch_codes(jnp.asarray(X)))
+    codes_f32 = np.asarray(ss.branch_codes(
+        jnp.asarray(X.astype(np.float32))))
+    np.testing.assert_array_equal(codes_narrow, codes_f32)
