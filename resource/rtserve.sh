@@ -1,0 +1,13 @@
+#!/bin/bash
+# Real-time serving demo driver (see rtserve.py).
+#   ./rtserve.sh serve
+set -e
+DIR=$(cd "$(dirname "$0")" && pwd)
+
+case "$1" in
+serve)
+  python "$DIR/rtserve.py" "$DIR/rtserve.properties"
+  ;;
+*)
+  echo "usage: $0 serve" >&2; exit 2 ;;
+esac

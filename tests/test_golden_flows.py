@@ -25,6 +25,17 @@ def _gen(mod_name, *args):
     return mod.generate(*args)
 
 
+def _driver_env():
+    """Env for subprocess-based driver tests: fresh interpreters must pin
+    the CPU backend explicitly (the parent's in-process jax.config pin
+    does not inherit, and a wedged device tunnel hangs the child
+    forever) and see the resource/ package on PYTHONPATH."""
+    return {**os.environ,
+            "AVENIR_TPU_PLATFORM": "cpu",
+            "PYTHONPATH": os.pathsep.join(
+                [os.path.dirname(RES), os.environ.get("PYTHONPATH", "")])}
+
+
 def test_naive_bayes_churn_flow(tmp_path):
     """churn.sh: BayesianDistribution train -> BayesianPredictor validate."""
     train = tmp_path / "train.csv"
@@ -689,15 +700,7 @@ def test_inv_sim_forecast_flow(tmp_path):
     r = subprocess.run(
         [sys.executable, os.path.join(RES, "inv_sim.py"),
          os.path.join(RES, "inv_sim.properties")],
-        capture_output=True, text=True, timeout=600,
-        env={**os.environ,
-             # fresh interpreter: force the CPU backend explicitly — the
-             # parent's in-process jax.config CPU pin does not inherit,
-             # and a wedged device tunnel would hang the child forever
-             "AVENIR_TPU_PLATFORM": "cpu",
-             "PYTHONPATH":
-             os.pathsep.join([os.path.dirname(RES),
-                              os.environ.get("PYTHONPATH", "")])})
+        capture_output=True, text=True, timeout=600, env=_driver_env())
     assert r.returncode == 0, r.stderr
     out = r.stdout
     assert out.count("average earning") == 5
@@ -739,12 +742,27 @@ def test_visit_time_distribution_flow(tmp_path):
             assert night > work      # night-owl profile
 
 
+def test_rtserve_flow(tmp_path):
+    """rtserve.sh: the Storm-topology serving loop converges onto the
+    hidden best channel while serving (reference
+    boost_lead_generation_tutorial.txt)."""
+    import subprocess
+    r = subprocess.run(
+        [sys.executable, os.path.join(RES, "rtserve.py"),
+         os.path.join(RES, "rtserve.properties")],
+        capture_output=True, text=True, timeout=600, env=_driver_env())
+    assert r.returncode == 0, r.stdout + r.stderr
+    last = r.stdout.strip().splitlines()[-1]
+    # exit 0 already means favourite == hidden best; sanity the summary
+    assert "learner favourite" in last
+
+
 def test_all_driver_scripts_exist_and_are_executable():
     for sh in ("markov.sh", "bandit.sh", "mutual_info.sh", "apriori.sh",
                "carm.sh", "hica.sh", "ovsa.sh",
                "cluster.sh", "svm.sh", "retarget.sh",
                "buyhist.sh", "sup.sh", "price_opt.sh",
                "disease.sh", "conv.sh", "hosp.sh", "fit.sh", "inv_sim.sh",
-               "visit.sh"):
+               "visit.sh", "rtserve.sh"):
         p = os.path.join(RES, sh)
         assert os.path.exists(p) and os.access(p, os.X_OK)
