@@ -28,7 +28,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .domain import SearchDomain, StepSize
+from .domain import SearchDomain, StepSize, cached_jit_run
 from ..parallel.mesh import MeshContext, runtime_context
 
 
@@ -140,13 +140,16 @@ def simulated_annealing(domain: SearchDomain, params: AnnealingParams,
             jnp.asarray(0, dtype=jnp.int32), jnp.asarray(0, dtype=jnp.int32),
             jnp.asarray(0.0, dtype=jnp.float32))
 
-    @jax.jit
-    def run(init):
-        carry, _ = jax.lax.scan(step, init,
-                                jnp.arange(params.max_num_iterations,
-                                           dtype=jnp.float32))
-        return carry
+    def build_run():
+        def run(init):
+            carry, _ = jax.lax.scan(step, init,
+                                    jnp.arange(params.max_num_iterations,
+                                               dtype=jnp.float32))
+            return carry
+        return run
 
+    from dataclasses import astuple
+    run = cached_jit_run(domain, "_sa_run", astuple(params), build_run)
     carry = run(init)
     (_, _, best, best_cost, _, _, key,
      n_better, n_best, n_worse, n_accept, cost_inc) = carry
@@ -182,11 +185,13 @@ def local_descent(domain: SearchDomain, solutions, costs,
         return (jnp.where(better[:, None], nxt, cur),
                 jnp.where(better, nxt_cost, cur_cost), key), None
 
-    @jax.jit
-    def run(solutions, costs, key):
-        carry, _ = jax.lax.scan(step, (solutions, costs, key), None,
-                                length=iterations)
-        return carry[0], carry[1]
+    def build_run():
+        def run(solutions, costs, key):
+            carry, _ = jax.lax.scan(step, (solutions, costs, key), None,
+                                    length=iterations)
+            return carry[0], carry[1]
+        return run
 
+    run = cached_jit_run(domain, "_descent_run", iterations, build_run)
     out, out_cost = run(solutions, costs, key)
     return out, out_cost

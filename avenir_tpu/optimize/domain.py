@@ -177,3 +177,18 @@ class MatrixCostDomain(SearchDomain):
             else:
                 total = total + self.conflict_penalty * pen
         return total
+
+
+def cached_jit_run(domain: SearchDomain, cache_attr: str, key, builder):
+    """Per-domain memo of a jitted optimizer program.  The SA/GA run
+    closures capture the domain's cost code plus Python-static knobs, so
+    a fresh ``@jax.jit`` inside each call has a new identity and
+    retraces/recompiles EVERY invocation (TPU_NOTES.md rule 3, the
+    per-call-closure disease).  The compiled program is cached on the
+    domain instance under ``cache_attr``, keyed by the static knobs;
+    shape changes re-trace inside the cached jit as usual."""
+    cached = getattr(domain, cache_attr, None)
+    if cached is None or cached[0] != key:
+        cached = (key, jax.jit(builder()))
+        setattr(domain, cache_attr, cached)
+    return cached[1]

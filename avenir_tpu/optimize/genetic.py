@@ -17,7 +17,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .domain import SearchDomain, set_components
+from .domain import SearchDomain, cached_jit_run, set_components
 from ..parallel.mesh import MeshContext, runtime_context
 
 
@@ -87,13 +87,16 @@ def genetic_algorithm(domain: SearchDomain, params: GeneticParams,
         new_pop = new_pop.at[:, 0, :].set(elite)
         return (new_pop, key), None
 
-    @jax.jit
-    def run(pop, key):
-        (pop, _), _ = jax.lax.scan(step, (pop, key), None,
-                                   length=params.num_generations)
-        costs = domain.cost_batch(pop.reshape(I * P, L)).reshape(I, P)
-        return pop, costs
+    def build_run():
+        def run(pop, key):
+            (pop, _), _ = jax.lax.scan(step, (pop, key), None,
+                                       length=params.num_generations)
+            costs = domain.cost_batch(pop.reshape(I * P, L)).reshape(I, P)
+            return pop, costs
+        return run
 
+    from dataclasses import astuple
+    run = cached_jit_run(domain, "_ga_run", astuple(params), build_run)
     pop, costs = run(pop, key)
     pop = np.asarray(pop)
     costs = np.asarray(costs)

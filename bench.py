@@ -269,6 +269,28 @@ def sa_rate(n_chains):
             "unit": "chain*steps/sec", "chains": n_chains, "iters": iters}
 
 
+def ga_rate(n_islands):
+    """Genetic algorithm: n_islands independent populations of 64, 500
+    generations in one jitted scan over a matrix-cost assignment domain —
+    the mapPartitions fan-out of the Spark job as an array axis."""
+    from avenir_tpu.optimize.genetic import GeneticParams, genetic_algorithm
+    from avenir_tpu.optimize.domain import MatrixCostDomain
+    rng = np.random.default_rng(5)
+    dom = MatrixCostDomain(cost_matrix=rng.random((24, 8)).astype(np.float32))
+    gens, pop = 500, 64
+    params = GeneticParams(num_generations=gens, population_size=pop,
+                           num_islands=n_islands, seed=5)
+    genetic_algorithm(dom, params)  # compile + warm
+    t0 = time.perf_counter()
+    res = genetic_algorithm(dom, params)
+    dt = time.perf_counter() - t0
+    assert res.island_best_costs.shape == (n_islands,)
+    return {"metric": "ga_individual_generations_per_sec",
+            "value": round(n_islands * pop * gens / dt, 1),
+            "unit": "individual*generations/sec",
+            "islands": n_islands, "population": pop, "generations": gens}
+
+
 WORKLOADS = {
     "nb": (nb_rate, [8_000_000, 1_000_000]),
     "rf": (rf_rate, [400_000, 50_000]),
@@ -278,6 +300,7 @@ WORKLOADS = {
     "rf_predict": (rf_predict_rate, [1_000_000, 200_000]),
     "nb_predict": (nb_predict_rate, [500_000, 100_000]),
     "sa": (sa_rate, [4_096, 512]),
+    "ga": (ga_rate, [256, 32]),
     # device-only deep-scale point, run AFTER everything else in main():
     # a timeout here must not down-mode the remaining workloads
     "rf_huge": (rf_huge_rate, [8_000_000]),
