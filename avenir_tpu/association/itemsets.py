@@ -36,6 +36,21 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def _support_kernel(M, C):
+    """Candidate support partial counts: (chunk, V) 0/1 matrix x
+    (n_cand, k) index sets -> (n_cand,) f32 counts.  Module-level jit so
+    each Apriori level (and each chunk) reuses ONE compiled program per
+    shape instead of recompiling per call."""
+    acc = jnp.ones((M.shape[0], C.shape[0]), dtype=jnp.float32)
+    for j in range(C.shape[1]):        # k is tiny and static
+        acc = acc * M[:, C[:, j]]
+    return acc.sum(axis=0)
+
 
 @dataclass
 class ItemSet:
@@ -148,23 +163,14 @@ class TransactionMatrix:
         of vocab indices: a jitted gather-product-reduce on device.
         Transactions are processed in chunks with float64 host accumulation
         so counts stay exact past float32's 2^24 integer ceiling."""
-        import jax
-        import jax.numpy as jnp
-
         if cand_idx.size == 0:
             return np.zeros((0,), dtype=np.int64)
-
-        @jax.jit
-        def kernel(M, C):
-            acc = jnp.ones((M.shape[0], C.shape[0]), dtype=jnp.float32)
-            for j in range(C.shape[1]):        # k is tiny and static
-                acc = acc * M[:, C[:, j]]
-            return acc.sum(axis=0)
 
         C = jnp.asarray(cand_idx)
         total = np.zeros((cand_idx.shape[0],), dtype=np.float64)
         for lo in range(0, self.matrix.shape[0], chunk):
-            part = kernel(jnp.asarray(self.matrix[lo:lo + chunk]), C)
+            part = _support_kernel(jnp.asarray(self.matrix[lo:lo + chunk]),
+                                   C)
             total += np.asarray(part, dtype=np.float64)
         return np.rint(total).astype(np.int64)
 

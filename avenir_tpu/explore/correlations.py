@@ -115,6 +115,18 @@ def heterogeneity_correlations(table: ColumnarTable, ordinals: Sequence[int],
     return out
 
 
+@jax.jit
+def _moment_kernel(X, m):
+    """(n, F) masked moment pass — module-level jit so repeat correlation
+    jobs share one compiled program per shape."""
+    Xm = X * m[:, None]
+    n = m.sum()
+    s1 = Xm.sum(axis=0)                      # Σx per attr
+    s2 = (Xm * X).sum(axis=0)                # Σx²
+    cross = jnp.einsum("ni,nj->ij", Xm, X)   # Σ x_i x_j
+    return n, s1, s2, cross
+
+
 def numerical_correlations(table: ColumnarTable, ordinals: Sequence[int],
                            ctx: Optional[MeshContext] = None
                            ) -> List[Tuple[int, int, float]]:
@@ -125,16 +137,7 @@ def numerical_correlations(table: ColumnarTable, ordinals: Sequence[int],
     X = np.stack([padded.columns[o] for o in ordinals], axis=1).astype(np.float64)
     mask = padded.valid_mask.astype(np.float64)
 
-    @jax.jit
-    def kernel(X, m):
-        Xm = X * m[:, None]
-        n = m.sum()
-        s1 = Xm.sum(axis=0)                      # Σx per attr
-        s2 = (Xm * X).sum(axis=0)                # Σx²
-        cross = jnp.einsum("ni,nj->ij", Xm, X)   # Σ x_i x_j
-        return n, s1, s2, cross
-
-    n, s1, s2, cross = (np.asarray(x) for x in kernel(
+    n, s1, s2, cross = (np.asarray(x) for x in _moment_kernel(
         ctx.shard_rows(X.astype(np.float32)), ctx.shard_rows(mask.astype(np.float32))))
     out = []
     for i in range(len(ordinals)):

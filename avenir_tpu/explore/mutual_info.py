@@ -20,6 +20,7 @@ class-augmented variant) — instead of the reference's per-pair shuffle keys.
 from __future__ import annotations
 
 from dataclasses import dataclass
+from functools import partial
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -82,6 +83,22 @@ class MutualInfoStats:
         return _entropy(self.pair_class_p[fi, fj])
 
 
+@partial(jax.jit, static_argnums=(3, 4))
+def _mi_kernel(bc, cc, m, B, C):
+    """All MI distributions for one row chunk — module-level jit keyed on
+    (shapes, B, C) so repeat compute_stats calls share one compiled
+    program instead of recompiling per call."""
+    mf = m.astype(jnp.float32)
+    oh = jax.nn.one_hot(bc, B, dtype=jnp.float32) * mf[:, None, None]  # (n,F,B)
+    ohc = jax.nn.one_hot(cc, C, dtype=jnp.float32) * mf[:, None]       # (n,C)
+    feat = oh.sum(axis=0)                                   # (F, B)
+    cls_counts = ohc.sum(axis=0)                            # (C,)
+    feat_cls = jnp.einsum("nfb,nc->fbc", oh, ohc)           # (F, B, C)
+    pair = jnp.einsum("nib,njd->ijbd", oh, oh)              # (F, F, B, B)
+    pair_cls = jnp.einsum("nib,njd,nc->ijbdc", oh, oh, ohc)
+    return feat, cls_counts, feat_cls, pair, pair_cls
+
+
 def compute_stats(table: ColumnarTable, ctx: Optional[MeshContext] = None,
                   chunk: int = 1 << 18) -> MutualInfoStats:
     """All distributions in one (chunked) jitted pass over row-sharded data."""
@@ -105,25 +122,13 @@ def compute_stats(table: ColumnarTable, ctx: Optional[MeshContext] = None,
     d_cls = ctx.shard_rows(cls)
     d_mask = ctx.shard_rows(mask)
 
-    @jax.jit
-    def kernel(bc, cc, m):
-        mf = m.astype(jnp.float32)
-        oh = jax.nn.one_hot(bc, B, dtype=jnp.float32) * mf[:, None, None]  # (n,F,B)
-        ohc = jax.nn.one_hot(cc, C, dtype=jnp.float32) * mf[:, None]       # (n,C)
-        feat = oh.sum(axis=0)                                   # (F, B)
-        cls_counts = ohc.sum(axis=0)                            # (C,)
-        feat_cls = jnp.einsum("nfb,nc->fbc", oh, ohc)           # (F, B, C)
-        pair = jnp.einsum("nib,njd->ijbd", oh, oh)              # (F, F, B, B)
-        pair_cls = jnp.einsum("nib,njd,nc->ijbdc", oh, oh, ohc)
-        return feat, cls_counts, feat_cls, pair, pair_cls
-
     n = padded.n_rows
     feat = np.zeros((F, B)); cls_counts = np.zeros((C,))
     feat_cls = np.zeros((F, B, C)); pair = np.zeros((F, F, B, B))
     pair_cls = np.zeros((F, F, B, B, C))
     for s in range(0, n, chunk):
         e = min(s + chunk, n)
-        out = kernel(d_bins[s:e], d_cls[s:e], d_mask[s:e])
+        out = _mi_kernel(d_bins[s:e], d_cls[s:e], d_mask[s:e], B, C)
         feat += np.asarray(out[0]); cls_counts += np.asarray(out[1])
         feat_cls += np.asarray(out[2]); pair += np.asarray(out[3])
         pair_cls += np.asarray(out[4])

@@ -277,6 +277,30 @@ def build_hmm(tagged: Sequence[Sequence[Tuple[str, str]]],
                              initial=norm(init), scale=scale)
 
 
+@jax.jit
+def _viterbi_kernel(obs, unknown, lens, log_tr, log_em, log_init):
+    """Batched Viterbi DP — module-level jit (model tables arrive as
+    arrays, so repeat decodes with any same-shape model share ONE
+    compiled program instead of recompiling per call)."""
+    def step(carry, xs):
+        score = carry                        # (n, S)
+        ob, unk, pos = xs                    # ob (n,)
+        em = jnp.where(unk[:, None], 0.0, log_em[:, ob].T)
+        cand = score[:, :, None] + log_tr[None]          # (n, S, S)
+        best_prev = jnp.argmax(cand, axis=1)             # (n, S)
+        best = jnp.max(cand, axis=1) + em                # (n, S)
+        active = (pos < lens)[:, None]
+        new_score = jnp.where(active, best, score)
+        return new_score, best_prev
+
+    first_em = jnp.where(unknown[:, 0][:, None], 0.0,
+                         log_em[:, obs[:, 0]].T)
+    first = log_init[None] + first_em                    # (n, S)
+    xs = (obs[:, 1:].T, unknown[:, 1:].T, jnp.arange(1, obs.shape[1]))
+    final, backptr = jax.lax.scan(step, first, xs)
+    return final, backptr
+
+
 def viterbi_decode(model: HiddenMarkovModel,
                    obs_sequences: Sequence[Sequence[str]]) -> List[List[str]]:
     """Batched Viterbi (markov/ViterbiDecoder.java:31): DP as lax.scan over
@@ -293,28 +317,9 @@ def viterbi_decode(model: HiddenMarkovModel,
     log_em = jnp.log(jnp.asarray(model.emission) + 1e-12)
     log_init = jnp.log(jnp.asarray(model.initial) + 1e-12)
 
-    @jax.jit
-    def kernel(obs, unknown, lens):
-        def step(carry, xs):
-            score = carry                        # (n, S)
-            ob, unk, pos = xs                    # ob (n,)
-            em = jnp.where(unk[:, None], 0.0, log_em[:, ob].T)
-            cand = score[:, :, None] + log_tr[None]          # (n, S, S)
-            best_prev = jnp.argmax(cand, axis=1)             # (n, S)
-            best = jnp.max(cand, axis=1) + em                # (n, S)
-            active = (pos < lens)[:, None]
-            new_score = jnp.where(active, best, score)
-            return new_score, best_prev
-
-        first_em = jnp.where(unknown[:, 0][:, None], 0.0,
-                             log_em[:, obs[:, 0]].T)
-        first = log_init[None] + first_em                    # (n, S)
-        xs = (obs[:, 1:].T, unknown[:, 1:].T, jnp.arange(1, obs.shape[1]))
-        final, backptr = jax.lax.scan(step, first, xs)
-        return final, backptr
-
-    final, backptr = (np.asarray(x) for x in kernel(
-        jnp.asarray(obs), jnp.asarray(unknown), jnp.asarray(lens)))
+    final, backptr = (np.asarray(x) for x in _viterbi_kernel(
+        jnp.asarray(obs), jnp.asarray(unknown), jnp.asarray(lens),
+        log_tr, log_em, log_init))
     out: List[List[str]] = []
     for i in range(n):
         T = int(lens[i])

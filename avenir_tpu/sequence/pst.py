@@ -120,15 +120,19 @@ def ctmc_transition_probabilities(rate_matrix: np.ndarray, t: float,
         np.array([math.lgamma(k + 1) for k in ks])
     w = jnp.asarray(np.exp(log_w), dtype=jnp.float32)
 
-    @jax.jit
-    def kernel(M, w):
-        def step(carry, wk):
-            Mk = carry
-            return Mk @ M, wk * Mk
-        _, terms = jax.lax.scan(step, jnp.eye(M.shape[0], dtype=M.dtype), w)
-        return terms.sum(axis=0)
+    return np.asarray(_uniformization_series_kernel(M, w),
+                      dtype=np.float64)
 
-    return np.asarray(kernel(M, w), dtype=np.float64)
+
+@jax.jit
+def _uniformization_series_kernel(M, w):
+    """sum_k w_k M^k as one scan — module-level jit so per-key CTMC jobs
+    compile once per (n_states, n_terms) shape, not once per rate matrix."""
+    def step(carry, wk):
+        Mk = carry
+        return Mk @ M, wk * Mk
+    _, terms = jax.lax.scan(step, jnp.eye(M.shape[0], dtype=M.dtype), w)
+    return terms.sum(axis=0)
 
 
 from functools import partial as _partial
