@@ -97,3 +97,43 @@ nn.checkpoint.interval=80
     with pytest.raises(ValueError, match="checkpoint"):
         cli_run.main(["neuralNetwork", f"-Dconf.path={props}",
                       str(train_csv), str(tmp_path / "out3")])
+
+
+def test_java_time_format_translation():
+    """utils/timefmt: the SimpleDateFormat subset reference configs use."""
+    from avenir_tpu.utils.timefmt import java_time_format
+    import datetime as dt
+    assert java_time_format("yyyy-MM-dd HH:mm:ss") == "%Y-%m-%d %H:%M:%S"
+    assert java_time_format("MM-dd-yyyy") == "%m-%d-%Y"
+    # round-trip: parse a formatted timestamp with the translated pattern
+    fmt = java_time_format("yyyy-MM-dd HH:mm:ss")
+    t = dt.datetime.strptime("2026-07-30 13:45:10", fmt)
+    assert (t.year, t.minute) == (2026, 45)
+
+
+def test_force_platform_no_request_is_noop(monkeypatch):
+    """core/platform: with nothing requested the escape hatch must not
+    touch jax config (the conftest already pinned cpu for this process)."""
+    from avenir_tpu.core.platform import force_platform
+    monkeypatch.delenv("AVENIR_TPU_PLATFORM", raising=False)
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    assert force_platform() is None
+
+
+def test_force_platform_applies_requested():
+    """The apply path must run in a FRESH interpreter (this process's
+    conftest already pinned cpu, which would make the in-process guard a
+    no-op and the assertion vacuous): sitecustomize pre-imports jax on
+    the default backend, then the escape hatch flips it to cpu."""
+    import subprocess
+    import sys
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "from avenir_tpu.core.platform import force_platform\n"
+         "import jax\n"
+         "applied = force_platform()\n"
+         "print(applied, jax.config.jax_platforms)"],
+        capture_output=True, text=True, timeout=300,
+        env={**__import__('os').environ, "AVENIR_TPU_PLATFORM": "cpu"})
+    assert r.returncode == 0, r.stderr
+    assert r.stdout.strip().split()[-2:] == ["cpu", "cpu"]
