@@ -174,20 +174,31 @@ def markov_flow(base):
                               "markov.properties")
 
 
-def bandit_flow(base):
-    d = os.path.join(base, "bandit")
+def _bandit_round_flow(base, name, gen_args, props_name,
+                       actions_key, state_key):
+    """Shared one-round MultiArmBandit invocation (cold-start state in,
+    rotated state out) — the bandit and price_opt use cases differ only
+    in domain/config."""
+    d = os.path.join(base, name)
     os.makedirs(d, exist_ok=True)
-    props = os.path.join(RES, "bandit.properties")
+    props = os.path.join(RES, props_name)
     rewards = os.path.join(d, "rewards.csv")
     with open(rewards, "w") as fh:
-        fh.write("\n".join(_gen("bandit_rewards_gen", 600, 22, 4)))
+        fh.write("\n".join(_gen(*gen_args)))
     assert cli_run.main([
         "org.avenir.spark.reinforce.MultiArmBandit", f"-Dconf.path={props}",
         "-Dmab.model.state.file.in=/nonexistent",
         f"-Dmab.model.state.file.out={d}/state/part",
         rewards, os.path.join(d, "actions")]) == 0
-    return {"bandit/actions.csv": _read(f"{d}/actions/part-r-00000"),
-            "bandit/state.csv": _read(f"{d}/state/part/part-r-00000")}
+    return {actions_key: _read(f"{d}/actions/part-r-00000"),
+            state_key: _read(f"{d}/state/part/part-r-00000")}
+
+
+def bandit_flow(base):
+    return _bandit_round_flow(base, "bandit",
+                              ("bandit_rewards_gen", 600, 22, 4),
+                              "bandit.properties",
+                              "bandit/actions.csv", "bandit/state.csv")
 
 
 def mi_flow(base):
@@ -352,3 +363,47 @@ def disease_flow(base):
 
 FLOWS = FLOWS + (carm_flow, hica_flow, svm_flow, conv_flow, sup_flow,
                  disease_flow)
+
+
+def buyhist_flow(base):
+    d = os.path.join(base, "buyhist")
+    os.makedirs(d, exist_ok=True)
+    tagged = os.path.join(d, "tagged.csv")
+    with open(tagged, "w") as fh:
+        fh.write("\n".join(_gen("loyalty_seq_gen", 200, 41, "tagged")))
+    props = os.path.join(RES, "buyhist.properties")
+    assert cli_run.main([
+        "org.avenir.markov.HiddenMarkovModelBuilder",
+        f"-Dconf.path={props}", tagged, os.path.join(d, "model")]) == 0
+    plain = os.path.join(d, "plain.csv")
+    with open(plain, "w") as fh:
+        fh.write("\n".join(_gen("loyalty_seq_gen", 40, 42, "plain")))
+    assert cli_run.main([
+        "org.avenir.markov.ViterbiStatePredictor", f"-Dconf.path={props}",
+        f"-Dvsp.hmm.model.path={d}/model/part-r-00000",
+        plain, os.path.join(d, "decoded")]) == 0
+    return {"buyhist/model.csv": _read(f"{d}/model/part-r-00000"),
+            "buyhist/decoded.csv": _read(f"{d}/decoded/part-m-00000")}
+
+
+def visit_flow(base):
+    d = os.path.join(base, "visit")
+    os.makedirs(d, exist_ok=True)
+    data = os.path.join(d, "visits.csv")
+    with open(data, "w") as fh:
+        fh.write("\n".join(_gen("visit_events_gen", 10, 60, 43)))
+    props = os.path.join(RES, "visit.properties")
+    assert cli_run.main([
+        "org.avenir.spark.sequence.EventTimeDistribution",
+        f"-Dconf.path={props}", data, os.path.join(d, "hist")]) == 0
+    return {"visit/hist.csv": _read(f"{d}/hist/part-r-00000")}
+
+
+def price_flow(base):
+    return _bandit_round_flow(base, "price",
+                              ("price_revenue_gen", 1000, 44, 5),
+                              "price_opt.properties",
+                              "price/prices.csv", "price/state.csv")
+
+
+FLOWS = FLOWS + (buyhist_flow, visit_flow, price_flow)
