@@ -258,37 +258,44 @@ def train(table: ColumnarTable, ctx: Optional[MeshContext] = None,
 # --------------------------------------------------------------------------
 
 class PredictionResult:
-    """Per-record prediction outputs.  ``feature_prior_prob`` /
-    ``feature_post_prob`` (the raw doubles of
-    BayesianPredictor.outputFeatureProb :276-286, used only by the
-    bap.output.feature.prob.only mode) are read back from the device
-    lazily on first access — the standard predict path then ships ~60%
-    fewer bytes over the device->host link."""
+    """Per-record prediction outputs.  ``class_probs`` (used by the
+    cost-arbitration branch and oracle tests), ``feature_prior_prob``,
+    and ``feature_post_prob`` (BayesianPredictor.outputFeatureProb
+    :276-286, feature-prob-only mode) are read back from the device
+    lazily on first access — the standard predict path then ships three
+    (n,) vectors instead of the full tables over the device->host link."""
 
     def __init__(self, pred_class: List[str], pred_prob: np.ndarray,
-                 class_probs: np.ndarray,
+                 class_probs=None,
                  class_prob_diff: Optional[np.ndarray] = None,
                  feature_prior_prob=None, feature_post_prob=None,
                  n_rows: Optional[int] = None):
         self.pred_class = pred_class            # per record
         self.pred_prob = pred_prob              # (n,) int percent
-        self.class_probs = class_probs          # (n, C) int percent
         self.class_prob_diff = class_prob_diff
+        self._pct = class_probs                 # (n, C) int percent, device?
         self._px = feature_prior_prob           # (n,)   P(x), maybe device
         self._pxc = feature_post_prob           # (n, C) P(x|c), maybe device
         self._n = n_rows if n_rows is not None else len(pred_class)
 
+    def _fetch(self, attr):
+        v = getattr(self, attr)
+        if v is not None and not isinstance(v, np.ndarray):
+            v = np.asarray(v)[:self._n]
+            setattr(self, attr, v)
+        return v
+
+    @property
+    def class_probs(self) -> Optional[np.ndarray]:
+        return self._fetch("_pct")
+
     @property
     def feature_prior_prob(self) -> Optional[np.ndarray]:
-        if self._px is not None and not isinstance(self._px, np.ndarray):
-            self._px = np.asarray(self._px)[:self._n]
-        return self._px
+        return self._fetch("_px")
 
     @property
     def feature_post_prob(self) -> Optional[np.ndarray]:
-        if self._pxc is not None and not isinstance(self._pxc, np.ndarray):
-            self._pxc = np.asarray(self._pxc)[:self._n]
-        return self._pxc
+        return self._fetch("_pxc")
 
 
 def _log(x, eps=1e-30):
@@ -304,6 +311,7 @@ def _predict_kernel(bc, cv, nbins_arr, log_post, log_prior, log_class,
     each output picks exactly ONE table value, bit-identical to the gather
     they replace — which lowered to a scalar loop on TPU and throttled
     predict to ~0.02M rows/sec."""
+    C = log_post.shape[0]
     bmax = log_post.shape[2]
     Fb = bc.shape[1]
     # codes arrive as uint8 when every bin id fits (255 = the unknown
@@ -342,7 +350,18 @@ def _predict_kernel(bc, cv, nbins_arr, log_post, log_prior, log_class,
     log_ratio = log_px_c + log_class[None] - log_px[:, None]
     probs = jnp.exp(log_ratio)
     pct = jnp.floor(probs * 100.0).astype(jnp.int32)      # (n, C)
-    return pct, jnp.exp(log_px), jnp.exp(log_px_c)
+    # argmax/prob/diff on device: the standard predict path then reads
+    # back three (n,) vectors instead of the full (n, C) table (which
+    # stays device-side for the arbitration/feature-prob modes)
+    best = jnp.argmax(pct, axis=1).astype(jnp.int32)      # first-max, like np
+    pred_prob = jnp.max(pct, axis=1)
+    if C > 1:
+        top2 = jax.lax.top_k(pct, 2)[0]
+        diff = top2[:, 0] - top2[:, 1]
+    else:
+        diff = jnp.full(pct.shape[:1], 100, dtype=jnp.int32)
+    return (pct, best, pred_prob, diff,
+            jnp.exp(log_px), jnp.exp(log_px_c))
 
 
 def _device_model_tables(model: NaiveBayesModel, ctx: MeshContext):
@@ -398,7 +417,6 @@ def predict(model: NaiveBayesModel, table: ColumnarTable,
     """
     ctx = ctx or runtime_context()
     schema = model.schema
-    C = len(model.class_values)
     binned_fields = [schema.find_field_by_ordinal(o) for o in model.binned_ordinals]
     cont_fields = [schema.find_field_by_ordinal(o) for o in model.cont_ordinals]
 
@@ -428,23 +446,21 @@ def predict(model: NaiveBayesModel, table: ColumnarTable,
     bc = ctx.shard_rows(bin_codes)
     cv = ctx.shard_rows(cont_vals.astype(np.float32))
 
-    pct_dev, px_dev, pxc_dev = _predict_kernel(
+    (pct_dev, best_dev, prob_dev, diff_dev,
+     px_dev, pxc_dev) = _predict_kernel(
         bc, cv, nbins_arr, log_post, log_prior, log_class,
         cpm, cps, cqm, cqs)
-    # only pct crosses the link eagerly; the raw feature probabilities
-    # stay device-side until feature-prob-only mode asks for them
-    pct = np.asarray(pct_dev)[:table.n_rows]
-    best = np.argmax(pct, axis=1)
-    pred_prob = pct[np.arange(len(best)), best]
-    # difference with the next-highest class prob (defaultArbitrate :345-365)
-    if C > 1:
-        sorted_pct = np.sort(pct, axis=1)
-        diff = sorted_pct[:, -1] - sorted_pct[:, -2]
-    else:
-        diff = np.full(len(best), 100)
+    # only the three (n,) vectors cross the link eagerly; the full (n, C)
+    # percent table and raw feature probabilities stay device-side until
+    # the arbitration / feature-prob-only modes ask for them.  The
+    # device argmax/max/top-2-diff match np.argmax (first max) and the
+    # np.sort-based diff (defaultArbitrate :345-365) exactly on ints
+    best = np.asarray(best_dev)[:table.n_rows]
+    pred_prob = np.asarray(prob_dev)[:table.n_rows]
+    diff = np.asarray(diff_dev)[:table.n_rows]
     pred_class = [model.class_values[i] for i in best]
     return PredictionResult(pred_class=pred_class, pred_prob=pred_prob,
-                            class_probs=pct, class_prob_diff=diff,
+                            class_probs=pct_dev, class_prob_diff=diff,
                             feature_prior_prob=px_dev,
                             feature_post_prob=pxc_dev,
                             n_rows=table.n_rows)
