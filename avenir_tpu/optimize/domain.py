@@ -186,7 +186,14 @@ def cached_jit_run(domain: SearchDomain, cache_attr: str, key, builder):
     retraces/recompiles EVERY invocation (TPU_NOTES.md rule 3, the
     per-call-closure disease).  The compiled program is cached on the
     domain instance under ``cache_attr``, keyed by the static knobs;
-    shape changes re-trace inside the cached jit as usual."""
+    shape changes re-trace inside the cached jit as usual.
+
+    Contract: a SearchDomain must be treated as IMMUTABLE after its first
+    optimizer run.  The cached program captures the domain's arrays (e.g.
+    MatrixCostDomain.cost_matrix) as compile-time closure constants, so
+    mutating them afterwards silently leaves the cached program computing
+    against the old values — build a fresh domain instead.  The cache also
+    pins those captured device buffers for the domain's lifetime."""
     cached = getattr(domain, cache_attr, None)
     if cached is None or cached[0] != key:
         cached = (key, jax.jit(builder()))

@@ -219,6 +219,12 @@ def ctmc_rate_matrices(key_idx: np.ndarray, times_ms: np.ndarray,
     rows.  Self-transition counts are discarded by the diagonal overwrite,
     as in the reference.
 
+    Intentional divergence on degenerate data: a state whose observed
+    transitions all carry zero elapsed time has duration 0, which the
+    reference still treats as visited and scales by 1/0 (emitting Inf
+    rates); here ``visited = duration > 0`` zeroes the row instead,
+    keeping every generator entry finite and well-defined.
+
     All-array formulation: one lexsort, one consecutive-pair mask, two
     bincount scatter-adds over flattened (key, cur[, next]) indices —
     no per-key Python loop.  Returns (n_keys, S, S) float64.
@@ -249,7 +255,9 @@ def ctmc_rate_matrices(key_idx: np.ndarray, times_ms: np.ndarray,
     # scaled self-transition count, matching the reference's rowSum logic)
     idx = np.arange(n_states)
     rates[:, idx, idx] = 0.0
-    rates[:, idx, idx] = -rates.sum(axis=2)
+    # + 0.0 canonicalizes the -0.0 a never-dwelt state's empty row sum
+    # produces, so serialization prints 0.000000 rather than -0.000000
+    rates[:, idx, idx] = -rates.sum(axis=2) + 0.0
     return rates
 
 
