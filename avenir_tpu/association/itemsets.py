@@ -135,22 +135,35 @@ def read_transactions(rows: Sequence[Sequence[str]], trans_id_ord: int = 0,
 
 class TransactionMatrix:
     """Boolean membership matrix over the item vocabulary — the device-side
-    representation of a transaction set."""
+    representation of a transaction set.
 
-    def __init__(self, transactions: Sequence[Tuple[str, List[str]]]):
+    ``items`` pins an explicit (e.g. globally merged) vocabulary; items in
+    the transactions but not in ``items`` are ignored, items in ``items``
+    but absent locally get an all-zero column.  Multi-process Apriori
+    builds every shard's matrix over the SAME merged vocabulary so
+    candidate index sets agree across processes."""
+
+    def __init__(self, transactions: Sequence[Tuple[str, List[str]]],
+                 items: Optional[Sequence[str]] = None):
         self.trans_ids = [t for t, _ in transactions]
         vocab: Dict[str, int] = {}
-        for _, items in transactions:
+        if items is not None:
             for it in items:
-                if it not in vocab:
-                    vocab[it] = len(vocab)
+                vocab.setdefault(it, len(vocab))
+        else:
+            for _, items_ in transactions:
+                for it in items_:
+                    if it not in vocab:
+                        vocab[it] = len(vocab)
         self.vocab = vocab
         self.items = list(vocab)
         n, m = len(transactions), max(len(vocab), 1)
         mat = np.zeros((n, m), dtype=np.float32)
-        for r, (_, items) in enumerate(transactions):
-            for it in items:
-                mat[r, vocab[it]] = 1.0
+        for r, (_, row_items) in enumerate(transactions):
+            for it in row_items:
+                col = vocab.get(it)
+                if col is not None:
+                    mat[r, col] = 1.0
         self.matrix = mat
 
     @property
@@ -209,12 +222,39 @@ def apriori_level(transactions: Sequence[Tuple[str, List[str]]],
                   itemset_length: int, total_trans_count: int,
                   support_threshold: float,
                   prior: Optional[Sequence[ItemSet]] = None,
-                  emit_trans_id: bool = True) -> List[ItemSet]:
+                  emit_trans_id: bool = True,
+                  collect_trans_ids: Optional[bool] = None) -> List[ItemSet]:
     """One reference MR pass: frequent itemsets of exactly
     ``itemset_length`` given the previous level's output (``prior``;
     required for length > 1).  Support must be strictly above the
-    threshold (reducer :331)."""
-    tm = TransactionMatrix(transactions)
+    threshold (reducer :331).
+
+    ``collect_trans_ids`` (default: ``emit_trans_id``) controls whether
+    supporting transaction ids are materialized on the result — the job
+    passes False when ``fia.trans.id.output=false`` drops them from the
+    output anyway, since under multi-process the per-itemset id lists are
+    the dominant allgather payload and would be spent producing nothing.
+
+    Multi-process (``jax.process_count() > 1``): ``transactions`` is this
+    process's shard and the result is the GLOBAL level — the reference's
+    shuffle global-ness (FrequentItemsApriori.java:89-306) rebuilt as three
+    collectives: the item vocabulary and the candidate sets are unioned
+    across shards (``allgather_object``), every shard counts the SAME
+    ordered candidate list on device, and the per-shard counts are
+    all-reduced.  Every process returns the identical level, so chained
+    levels and output files agree bit-for-bit across the pod."""
+    from ..parallel.distributed import is_multiprocess
+    dist = is_multiprocess()
+    if collect_trans_ids is None:
+        collect_trans_ids = emit_trans_id
+    if dist:
+        from ..parallel import distributed as _D
+        local_items = sorted({it for _, row in transactions for it in row})
+        global_items: List[str] = sorted(
+            set().union(*_D.allgather_object(local_items)))
+        tm = TransactionMatrix(transactions, items=global_items)
+    else:
+        tm = TransactionMatrix(transactions)
     if itemset_length == 1:
         cand_idx = _level1_candidates(tm)
         cand_items: List[Tuple[str, ...]] = [(it,) for it in tm.items]
@@ -223,21 +263,36 @@ def apriori_level(transactions: Sequence[Tuple[str, List[str]]],
             # convenience: chain the lower levels in-process (the reference
             # re-runs the job per level with the previous output file,
             # freq_items_apriori_tutorial.txt:33-41)
+            # prior levels feed only candidate extension (items, not ids)
             prior = apriori_level(transactions, itemset_length - 1,
                                   total_trans_count, support_threshold,
-                                  None, emit_trans_id)
+                                  None, emit_trans_id,
+                                  collect_trans_ids=False)
         cand_items = _extend_candidates(tm, prior)
+        if dist:
+            # a candidate exists if ANY shard has a supporting transaction
+            # with a co-occurring item: union of the per-shard extensions
+            cand_items = sorted(
+                set().union(*_D.allgather_object(cand_items)))
         cand_idx = np.array(
             [[tm.vocab[it] for it in items] for items in cand_items],
             dtype=np.int32).reshape(len(cand_items), itemset_length)
     counts = tm.support_counts(cand_idx)
-    out: List[ItemSet] = []
-    for items, cnt in zip(cand_items, counts):
-        support = float(cnt) / total_trans_count
-        if support > support_threshold:
-            trans = (tm.supporting_trans([tm.vocab[i] for i in items])
-                     if emit_trans_id else [])
-            out.append(ItemSet(items, trans, support, int(cnt)))
+    if dist:
+        counts = _D.all_reduce_host_array(counts)
+    keep = [(items, int(cnt)) for items, cnt in zip(cand_items, counts)
+            if float(cnt) / total_trans_count > support_threshold]
+    trans_lists: List[List[str]] = [[] for _ in keep]
+    if collect_trans_ids:
+        trans_lists = [tm.supporting_trans([tm.vocab[i] for i in items])
+                       for items, _ in keep]
+        if dist:
+            # per-shard supporting ids, concatenated in process order
+            per_proc = _D.allgather_object(trans_lists)
+            trans_lists = [[tid for shard in per_proc for tid in shard[i]]
+                           for i in range(len(keep))]
+    out = [ItemSet(items, trans, float(cnt) / total_trans_count, cnt)
+           for (items, cnt), trans in zip(keep, trans_lists)]
     out.sort(key=lambda s: s.items)
     return out
 
@@ -249,9 +304,16 @@ def frequent_itemsets(transactions: Sequence[Tuple[str, List[str]]],
                       ) -> Dict[int, List[ItemSet]]:
     """Full level-wise run 1..max_length — what ``fit.sh freqItems`` achieves
     by re-running the job with fia.item.set.length = 1,2,3,...
-    (freq_items_apriori_tutorial.txt:33-41)."""
-    total = (total_trans_count if total_trans_count is not None
-             else len(transactions))
+    (freq_items_apriori_tutorial.txt:33-41).  Multi-process: the default
+    total is the all-reduced global transaction count."""
+    if total_trans_count is not None:
+        total = total_trans_count
+    else:
+        total = len(transactions)
+        from ..parallel import distributed as _D
+        if _D.is_multiprocess():
+            total = int(_D.all_reduce_host_array(
+                np.array([total], dtype=np.int64))[0])
     levels: Dict[int, List[ItemSet]] = {}
     prior: Optional[List[ItemSet]] = None
     for k in range(1, max_length + 1):

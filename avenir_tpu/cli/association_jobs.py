@@ -21,7 +21,8 @@ def _read_rows(path: str, delim_regex: str):
 
 
 @register("org.avenir.association.FrequentItemsApriori",
-          "frequentItemsApriori")
+          "frequentItemsApriori",
+          dist="sharded")
 def frequent_items_apriori(cfg: Config, in_path: str, out_path: str
                            ) -> Counters:
     """One Apriori level (FrequentItemsApriori.java).  Keys:
@@ -55,18 +56,30 @@ def frequent_items_apriori(cfg: Config, in_path: str, out_path: str
             length - 1, emit_tid,
             cfg.get("fia.itemset.delim", cfg.field_delim_out))
     level = IT.apriori_level(transactions, length, total, threshold, prior,
-                             emit_tid)
+                             emit_tid,
+                             collect_trans_ids=emit_tid and tid_out)
     artifacts.write_text_output(
         out_path,
         IT.format_itemset_lines(level, emit_tid, tid_out,
                                 cfg.field_delim_out))
-    counters.increment("Apriori", "frequentItemSets", len(level))
+    # counter semantics under the multi-process all-reduce: increment what
+    # THIS process contributed — the level is global-identical on every
+    # process (count it on process 0 only; the others add 0 so the counter
+    # KEY still exists everywhere, which all_reduce_counters requires),
+    # the transactions are per-shard (the sum is the global count, like
+    # the reference's mapper counters)
+    import jax
+    from ..parallel import distributed as D
+    mine = (not D.is_multiprocess()) or jax.process_index() == 0
+    counters.increment("Apriori", "frequentItemSets",
+                       len(level) if mine else 0)
     counters.increment("Apriori", "transactions", len(transactions))
     return counters
 
 
 @register("org.avenir.association.InfrequentItemMarker",
-          "infrequentItemMarker")
+          "infrequentItemMarker",
+          dist="map")
 def infrequent_item_marker(cfg: Config, in_path: str, out_path: str
                            ) -> Counters:
     """Map-only infrequent-item masking (InfrequentItemMarker.java).  Keys:
@@ -91,14 +104,24 @@ def infrequent_item_marker(cfg: Config, in_path: str, out_path: str
                                        cfg.field_delim_regex))
     marked = IT.mark_infrequent(rows, freq, marker, skip)
     delim_out = cfg.get("iim.field.delim.out", cfg.field_delim_out)
+    # map-only job (reference emits from the mapper): per-process part-m
+    # files under multi-process, like the other per-record transforms
     artifacts.write_text_output(out_path,
-                                [delim_out.join(r) for r in marked])
-    counters.increment("Apriori", "frequentItems", len(freq))
+                                [delim_out.join(r) for r in marked],
+                                role="m")
+    # frequentItems is read from the replicated itemset model file, so it
+    # is global-identical on every process: count it once (others add 0 to
+    # keep the counter key set aligned for all_reduce_counters)
+    import jax
+    from ..parallel import distributed as D
+    mine = (not D.is_multiprocess()) or jax.process_index() == 0
+    counters.increment("Apriori", "frequentItems", len(freq) if mine else 0)
     return counters
 
 
 @register("org.avenir.association.AssociationRuleMiner",
-          "associationRuleMiner")
+          "associationRuleMiner",
+          dist="gather")
 def association_rule_miner(cfg: Config, in_path: str, out_path: str
                            ) -> Counters:
     """Rule mining from frequent itemsets (AssociationRuleMiner.java).

@@ -17,7 +17,8 @@ from ..core.table import load_csv
 from .jobs import register, _schema_path, _splitter
 
 
-@register("org.avenir.cluster.KmeansCluster", "kmeansCluster")
+@register("org.avenir.cluster.KmeansCluster", "kmeansCluster",
+          dist="gather")
 def kmeans_cluster(cfg: Config, in_path: str, out_path: str) -> Counters:
     """One Lloyd iteration over every active cluster group (one reference MR
     pass, cluster/KmeansCluster.java).  Keys: kmc.schema.file.path,
@@ -52,7 +53,8 @@ def kmeans_cluster(cfg: Config, in_path: str, out_path: str) -> Counters:
     return counters
 
 
-@register("org.avenir.cluster.AgglomerativeGraphical", "agglomerativeGraphical")
+@register("org.avenir.cluster.AgglomerativeGraphical", "agglomerativeGraphical",
+          dist="gather")
 def agglomerative_graphical(cfg: Config, in_path: str, out_path: str
                             ) -> Counters:
     """Greedy edge-weighted agglomerative pass
@@ -110,7 +112,8 @@ def agglomerative_graphical(cfg: Config, in_path: str, out_path: str
 
 
 @register("org.avenir.util.EntityDistanceMapFileAccessor",
-          "entityDistanceStore")
+          "entityDistanceStore",
+          dist="gather")
 def entity_distance_store(cfg: Config, in_path: str, out_path: str
                           ) -> Counters:
     """Build the persistent random-access distance store from entity-distance

@@ -34,7 +34,8 @@ def _svm_xy(cfg: Config, table, schema):
 
 
 @register("org.avenir.discriminant.SupportVectorMachine",
-          "supportVectorMachine")
+          "supportVectorMachine",
+          dist="gather")
 def support_vector_machine(cfg: Config, in_path: str, out_path: str
                            ) -> Counters:
     """SMO training; emits support-vector rows (features..., target, alpha)
@@ -67,7 +68,8 @@ def support_vector_machine(cfg: Config, in_path: str, out_path: str
 
 
 @register("org.avenir.discriminant.SupportVectorPredictor",
-          "supportVectorPredictor")
+          "supportVectorPredictor",
+          dist="map")
 def support_vector_predictor(cfg: Config, in_path: str, out_path: str
                              ) -> Counters:
     """Map-only linear-SVM prediction from the trained model's weights line;
@@ -110,7 +112,8 @@ def support_vector_predictor(cfg: Config, in_path: str, out_path: str
     return counters
 
 
-@register("org.avenir.discriminant.FisherDiscriminant", "fisherDiscriminant")
+@register("org.avenir.discriminant.FisherDiscriminant", "fisherDiscriminant",
+          dist="gather")
 def fisher_discriminant_job(cfg: Config, in_path: str, out_path: str
                             ) -> Counters:
     """Per-attribute two-class boundary lines

@@ -20,7 +20,8 @@ from ..parallel.mesh import runtime_context
 from .jobs import register, _schema_path, _splitter
 
 
-@register("org.avenir.explore.MutualInformation", "mutualInformation")
+@register("org.avenir.explore.MutualInformation", "mutualInformation",
+          dist="sharded")
 def mutual_information(cfg: Config, in_path: str, out_path: str) -> Counters:
     """MI distributions + selection scores (explore/MutualInformation.java).
     Keys: mut.feature.schema.file.path, mut.mutual.info.score.algorithms,
@@ -58,7 +59,8 @@ def mutual_information(cfg: Config, in_path: str, out_path: str) -> Counters:
     return counters
 
 
-@register("org.avenir.explore.CramerCorrelation", "cramerCorrelation")
+@register("org.avenir.explore.CramerCorrelation", "cramerCorrelation",
+          dist="gather")
 def cramer_correlation(cfg: Config, in_path: str, out_path: str) -> Counters:
     """Cramér index between source and dest categorical attrs
     (explore/CramerCorrelation.java; crc.* keys).  Output scaled ints."""
@@ -79,7 +81,8 @@ def cramer_correlation(cfg: Config, in_path: str, out_path: str) -> Counters:
     return counters
 
 
-@register("org.avenir.explore.NumericalCorrelation", "numericalCorrelation")
+@register("org.avenir.explore.NumericalCorrelation", "numericalCorrelation",
+          dist="sharded")
 def numerical_correlation(cfg: Config, in_path: str, out_path: str) -> Counters:
     """Pearson correlation for attr pairs (explore/NumericalCorrelation.java;
     nuc.attr.pairs = 'a:b,c:d' style pair list, or all feature pairs)."""
@@ -105,7 +108,8 @@ def numerical_correlation(cfg: Config, in_path: str, out_path: str) -> Counters:
 
 
 @register("org.avenir.explore.HeterogeneityReductionCorrelation",
-          "heterogeneityReductionCorrelation")
+          "heterogeneityReductionCorrelation",
+          dist="gather")
 def heterogeneity_correlation(cfg: Config, in_path: str, out_path: str) -> Counters:
     """Concentration/uncertainty coefficient per categorical pair
     (hrc.heterogeneity.algorithm = gini | entropy)."""
@@ -124,7 +128,8 @@ def heterogeneity_correlation(cfg: Config, in_path: str, out_path: str) -> Count
 
 
 @register("org.avenir.explore.CategoricalClassAffinity",
-          "categoricalClassAffinity")
+          "categoricalClassAffinity",
+          dist="gather")
 def categorical_class_affinity(cfg: Config, in_path: str, out_path: str) -> Counters:
     """value -> class affinity scores (explore/CategoricalClassAffinity.java)."""
     from ..explore.correlations import class_affinity
@@ -150,7 +155,8 @@ def categorical_class_affinity(cfg: Config, in_path: str, out_path: str) -> Coun
 
 
 @register("org.avenir.explore.CategoricalContinuousEncoding",
-          "categoricalContinuousEncoding")
+          "categoricalContinuousEncoding",
+          dist="gather")
 def categorical_continuous_encoding_job(cfg: Config, in_path: str,
                                         out_path: str) -> Counters:
     """Supervised encoding (coe.* keys; output 'ordinal,value,encoded')."""
@@ -172,7 +178,8 @@ def categorical_continuous_encoding_job(cfg: Config, in_path: str,
     return counters
 
 
-@register("org.avenir.explore.ClassBasedOverSampler", "classBasedOverSampler")
+@register("org.avenir.explore.ClassBasedOverSampler", "classBasedOverSampler",
+          dist="gather")
 def class_based_over_sampler(cfg: Config, in_path: str, out_path: str) -> Counters:
     """SMOTE oversampling of a minority class (cbos.* keys)."""
     from ..explore.samplers import smote_oversample
@@ -191,7 +198,8 @@ def class_based_over_sampler(cfg: Config, in_path: str, out_path: str) -> Counte
     return counters
 
 
-@register("org.avenir.explore.UnderSamplingBalancer", "underSamplingBalancer")
+@register("org.avenir.explore.UnderSamplingBalancer", "underSamplingBalancer",
+          dist="gather")
 def under_sampling_balancer(cfg: Config, in_path: str, out_path: str) -> Counters:
     """Majority-class undersampling (usb.* keys)."""
     from ..explore.samplers import under_sample
@@ -209,7 +217,8 @@ def under_sampling_balancer(cfg: Config, in_path: str, out_path: str) -> Counter
     return counters
 
 
-@register("org.avenir.explore.ReliefFeatureRelevance", "reliefFeatureRelevance")
+@register("org.avenir.explore.ReliefFeatureRelevance", "reliefFeatureRelevance",
+          dist="gather")
 def relief_feature_relevance(cfg: Config, in_path: str, out_path: str) -> Counters:
     """Relief relevance scores (ffr.* keys; output 'ordinal,score')."""
     from ..explore.samplers import relief_relevance
@@ -226,7 +235,8 @@ def relief_feature_relevance(cfg: Config, in_path: str, out_path: str) -> Counte
     return counters
 
 
-@register("org.avenir.explore.AdaBoostError", "adaBoostError")
+@register("org.avenir.explore.AdaBoostError", "adaBoostError",
+          dist="gather")
 def adaboost_error_job(cfg: Config, in_path: str, out_path: str) -> Counters:
     """Weighted boosting error (abe.* keys: actual/pred/boost ordinals)."""
     from ..explore.encoders import adaboost_error
@@ -249,7 +259,8 @@ def adaboost_error_job(cfg: Config, in_path: str, out_path: str) -> Counters:
     return counters
 
 
-@register("org.avenir.explore.AdaBoostUpdate", "adaBoostUpdate")
+@register("org.avenir.explore.AdaBoostUpdate", "adaBoostUpdate",
+          dist="gather")
 def adaboost_update_job(cfg: Config, in_path: str, out_path: str) -> Counters:
     """Boosting weight update pass (abu.* keys) emitting records with the
     boost column rewritten (AdaBoostUpdate.java:117-137)."""
@@ -276,7 +287,8 @@ def adaboost_update_job(cfg: Config, in_path: str, out_path: str) -> Counters:
     return counters
 
 
-@register("org.avenir.explore.BaggingSampler", "baggingSampler")
+@register("org.avenir.explore.BaggingSampler", "baggingSampler",
+          dist="gather")
 def bagging_sampler(cfg: Config, in_path: str, out_path: str) -> Counters:
     """Per-batch bagging (explore/BaggingSampler.java:90-124): stream rows in
     batches of bas.batch.size, emit batchSize uniform with-replacement draws
@@ -299,7 +311,8 @@ def bagging_sampler(cfg: Config, in_path: str, out_path: str) -> Counters:
     return counters
 
 
-@register("org.avenir.explore.TopMatchesByClass", "topMatchesByClass")
+@register("org.avenir.explore.TopMatchesByClass", "topMatchesByClass",
+          dist="gather")
 def top_matches_by_class(cfg: Config, in_path: str, out_path: str) -> Counters:
     """Per-record top-k nearest SAME-class neighbors, the SMOTE precursor
     (explore/TopMatchesByClass.java).  Input: pair-distance lines from the

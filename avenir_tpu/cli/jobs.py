@@ -23,13 +23,36 @@ from ..parallel.mesh import runtime_context
 
 JOBS: Dict[str, Callable] = {}
 
+# multi-process behavior class per job function (parallel/distributed.py
+# module docstring defines the contract cli.run enforces):
+#   sharded — consumes its local shard, internally global (device
+#             reductions / collectives)
+#   gather  — host-side global computation; cli.run allgathers the input
+#             lines so every process computes the full result
+#   map     — per-record transform; per-process part files are correct
+#   refuse  — known shard-local-wrong with no fix: rejected under
+#             jax.process_count() > 1
+JOB_DIST: Dict[Callable, str] = {}
+_DIST_MODES = ("sharded", "gather", "map", "refuse")
 
-def register(*names: str):
+
+def register(*names: str, dist: str):
+    if dist not in _DIST_MODES:
+        raise ValueError(f"register(dist={dist!r}): must be one of "
+                         f"{_DIST_MODES}")
+
     def deco(fn):
         for n in names:
             JOBS[n] = fn
+        JOB_DIST[fn] = dist
         return fn
     return deco
+
+
+def dist_mode(fn: Callable) -> str:
+    """The job's multi-process class; unregistered functions default to
+    'refuse' so nothing can silently emit shard-local results."""
+    return JOB_DIST.get(fn, "refuse")
 
 
 def resolve(name: str) -> Callable:
@@ -83,7 +106,8 @@ def _tree_params(cfg: Config):
     )
 
 
-@register("org.avenir.tree.DecisionTreeBuilder", "decisionTreeBuilder")
+@register("org.avenir.tree.DecisionTreeBuilder", "decisionTreeBuilder",
+          dist="sharded")
 def decision_tree_builder(cfg: Config, in_path: str, out_path: str) -> Counters:
     """One level of tree growth per invocation — the reference job contract
     (tree/DecisionTreeBuilder.java, driven by resource/detr.sh's rotation of
@@ -110,7 +134,8 @@ def decision_tree_builder(cfg: Config, in_path: str, out_path: str) -> Counters:
     return counters
 
 
-@register("org.avenir.tree.RandomForestBuilder", "randomForestBuilder")
+@register("org.avenir.tree.RandomForestBuilder", "randomForestBuilder",
+          dist="sharded")
 def random_forest_builder(cfg: Config, in_path: str, out_path: str) -> Counters:
     """Full in-process random forest: the rafo.sh per-tree rerun loop
     (resource/rafo.sh:34-43) collapsed into one job.  Writes one decision-path
@@ -131,7 +156,8 @@ def random_forest_builder(cfg: Config, in_path: str, out_path: str) -> Counters:
     return counters
 
 
-@register("org.avenir.model.ModelPredictor", "modelPredictor")
+@register("org.avenir.model.ModelPredictor", "modelPredictor",
+          dist="map")
 def model_predictor_job(cfg: Config, in_path: str, out_path: str) -> Counters:
     """Generic map-only predictor (model/ModelPredictor.java:46-82): loads N
     decision-path model files (mop.model.dir.path + mop.model.file.names) and
@@ -231,7 +257,8 @@ def _load_train_test(in_path: str, prefix: str, schema: FeatureSchema,
 
 
 @register("org.sifarish.feature.SameTypeSimilarity", "sameTypeSimilarity",
-          "recordSimilarity")
+          "recordSimilarity",
+          dist="gather")
 def same_type_similarity(cfg: Config, in_path: str, out_path: str) -> Counters:
     """All-pairs record distance (the external sifarish job of
     resource/knn.sh:47, and avenir-spark RecordSimilarity.scala:65-103).
@@ -282,7 +309,8 @@ def same_type_similarity(cfg: Config, in_path: str, out_path: str) -> Counters:
 
 
 @register("org.avenir.spark.similarity.GroupedRecordSimilarity",
-          "groupedRecordSimilarity")
+          "groupedRecordSimilarity",
+          dist="gather")
 def grouped_record_similarity(cfg: Config, in_path: str, out_path: str
                               ) -> Counters:
     """Per-group all-pairs record distance
@@ -334,7 +362,8 @@ def grouped_record_similarity(cfg: Config, in_path: str, out_path: str
     return counters
 
 
-@register("org.avenir.knn.KnnPipeline", "knnPipeline", "knnInProcess")
+@register("org.avenir.knn.KnnPipeline", "knnPipeline", "knnInProcess",
+          dist="gather")
 def knn_pipeline(cfg: Config, in_path: str, out_path: str) -> Counters:
     """The whole knn.sh pipeline fused in process: tiled device
     distance + running top-k (ops/distance.pairwise_topk) feeding the
@@ -440,11 +469,15 @@ def knn_pipeline(cfg: Config, in_path: str, out_path: str) -> Counters:
     if validation:
         cm.export(counters)
     counters.increment("Neighborhood", "Test records", test.n_rows)
-    artifacts.write_text_output(out_path, out_lines, local_shard=True)
+    # gather-mode job: every process holds the FULL prediction set, so the
+    # output is a global artifact (part 0 everywhere) — per-process parts
+    # would duplicate every record in a shared output dir
+    artifacts.write_text_output(out_path, out_lines)
     return counters
 
 
-@register("org.avenir.knn.FeatureCondProbJoiner", "featureCondProbJoiner")
+@register("org.avenir.knn.FeatureCondProbJoiner", "featureCondProbJoiner",
+          dist="gather")
 def feature_cond_prob_joiner(cfg: Config, in_path: str, out_path: str
                              ) -> Counters:
     """Join Bayesian feature posterior probabilities onto nearest-neighbor
@@ -526,7 +559,8 @@ def _knn_params(cfg: Config):
     return params
 
 
-@register("org.avenir.knn.NearestNeighbor", "nearestNeighbor", "knnClassifier")
+@register("org.avenir.knn.NearestNeighbor", "nearestNeighbor", "knnClassifier",
+          dist="gather")
 def nearest_neighbor(cfg: Config, in_path: str, out_path: str) -> Counters:
     """KNN classification/regression over precomputed neighbor lines
     (knn/NearestNeighbor.java; the knn.sh 'knnClassifier' step).
@@ -697,7 +731,8 @@ def _bayesian_predict_text(cfg: Config, in_path: str, out_path: str
     return counters
 
 
-@register("org.avenir.bayesian.BayesianDistribution", "bayesianDistribution")
+@register("org.avenir.bayesian.BayesianDistribution", "bayesianDistribution",
+          dist="sharded")
 def bayesian_distribution(cfg: Config, in_path: str, out_path: str) -> Counters:
     """Naive Bayes training job (bayesian/BayesianDistribution.java).
 
@@ -725,7 +760,8 @@ def bayesian_distribution(cfg: Config, in_path: str, out_path: str) -> Counters:
     return counters
 
 
-@register("org.avenir.bayesian.BayesianPredictor", "bayesianPredictor")
+@register("org.avenir.bayesian.BayesianPredictor", "bayesianPredictor",
+          dist="map")
 def bayesian_predictor(cfg: Config, in_path: str, out_path: str) -> Counters:
     """Naive Bayes prediction job (bayesian/BayesianPredictor.java).
 

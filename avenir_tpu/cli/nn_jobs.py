@@ -30,7 +30,8 @@ def _xy(table):
     return X[known], y[known]
 
 
-@register("org.avenir.supv.NeuralNetworkTrainer", "neuralNetwork")
+@register("org.avenir.supv.NeuralNetworkTrainer", "neuralNetwork",
+          dist="gather")
 def neural_network_trainer(cfg: Config, in_path: str, out_path: str) -> Counters:
     from ..nn import mlp
     counters = Counters()
@@ -133,7 +134,8 @@ def neural_network_trainer(cfg: Config, in_path: str, out_path: str) -> Counters
     return counters
 
 
-@register("org.avenir.supv.NeuralNetworkPredictor", "neuralNetworkPredictor")
+@register("org.avenir.supv.NeuralNetworkPredictor", "neuralNetworkPredictor",
+          dist="map")
 def neural_network_predictor(cfg: Config, in_path: str, out_path: str) -> Counters:
     from ..nn import mlp
     counters = Counters()

@@ -60,7 +60,8 @@ def _parse_start(domain, line: str, od: str) -> np.ndarray:
         return domain.from_string(head)
 
 
-@register("org.avenir.spark.optimize.SimulatedAnnealing", "simulatedAnnealing")
+@register("org.avenir.spark.optimize.SimulatedAnnealing", "simulatedAnnealing",
+          dist="gather")
 def simulated_annealing_job(cfg: Config, in_path: str, out_path: str) -> Counters:
     """SA over the configured domain (opt.conf keys; SURVEY.md §3.3).
     in_path may hold starting solutions (one per line, reference component
@@ -104,7 +105,8 @@ def simulated_annealing_job(cfg: Config, in_path: str, out_path: str) -> Counter
     return counters
 
 
-@register("org.avenir.spark.optimize.GeneticAlgorithm", "geneticAlgorithm")
+@register("org.avenir.spark.optimize.GeneticAlgorithm", "geneticAlgorithm",
+          dist="gather")
 def genetic_algorithm_job(cfg: Config, in_path: str, out_path: str) -> Counters:
     """GA over the configured domain (GeneticAlgorithm.scala:69-176)."""
     from ..optimize.genetic import GeneticParams, genetic_algorithm

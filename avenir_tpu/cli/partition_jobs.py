@@ -19,7 +19,8 @@ from .jobs import register, _schema_path
 
 @register("org.avenir.explore.ClassPartitionGenerator",
           "classPartitionGenerator",
-          "org.avenir.tree.SplitGenerator", "splitGenerator")
+          "org.avenir.tree.SplitGenerator", "splitGenerator",
+          dist="gather")
 def class_partition_generator(cfg: Config, in_path: str, out_path: str
                               ) -> Counters:
     """Scores every candidate split of the configured attributes (or emits
@@ -50,7 +51,8 @@ def class_partition_generator(cfg: Config, in_path: str, out_path: str
     return counters
 
 
-@register("org.avenir.tree.DataPartitioner", "dataPartitioner")
+@register("org.avenir.tree.DataPartitioner", "dataPartitioner",
+          dist="gather")
 def data_partitioner(cfg: Config, in_path: str, out_path: str) -> Counters:
     """Physically partitions data by the chosen candidate split into
     ``split=<i>/segment=<j>/data/partition.txt`` under out_path

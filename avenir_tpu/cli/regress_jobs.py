@@ -20,7 +20,8 @@ from ..core.table import load_csv
 from .jobs import register, _schema_path
 
 
-@register("org.avenir.regress.LogisticRegressionJob", "logisticRegression")
+@register("org.avenir.regress.LogisticRegressionJob", "logisticRegression",
+          dist="gather")
 def logistic_regression(cfg: Config, in_path: str, out_path: str) -> Counters:
     """Train to convergence (the reference main()'s do-while over MR runs,
     LogisticRegressionJob.java:203-211, collapsed into one in-process loop).
@@ -57,7 +58,8 @@ def logistic_regression(cfg: Config, in_path: str, out_path: str) -> Counters:
 
 
 @register("org.avenir.regress.LogisticRegressionPredictor",
-          "logisticRegressionPredictor")
+          "logisticRegressionPredictor",
+          dist="map")
 def logistic_regression_predictor(cfg: Config, in_path: str, out_path: str
                                   ) -> Counters:
     """Map-only prediction with the trained coefficient file (last history

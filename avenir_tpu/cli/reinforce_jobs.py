@@ -82,31 +82,36 @@ def _run_bandit(cfg: Config, in_path: str, out_path: str,
     return counters
 
 
-@register("org.avenir.spark.reinforce.MultiArmBandit", "multiArmBandit")
+@register("org.avenir.spark.reinforce.MultiArmBandit", "multiArmBandit",
+          dist="gather")
 def multi_arm_bandit(cfg: Config, in_path: str, out_path: str) -> Counters:
     return _run_bandit(cfg, in_path, out_path,
                        cfg.get("mab.algorithm", "randomGreedy"))
 
 
-@register("org.avenir.reinforce.GreedyRandomBandit", "greedyRandomBandit")
+@register("org.avenir.reinforce.GreedyRandomBandit", "greedyRandomBandit",
+          dist="gather")
 def greedy_random_bandit(cfg: Config, in_path: str, out_path: str) -> Counters:
     """epsilon-greedy batch job (reinforce/GreedyRandomBandit.java:150-205)."""
     return _run_bandit(cfg, in_path, out_path, "randomGreedy")
 
 
-@register("org.avenir.reinforce.SoftMaxBandit", "softMaxBandit")
+@register("org.avenir.reinforce.SoftMaxBandit", "softMaxBandit",
+          dist="gather")
 def soft_max_bandit(cfg: Config, in_path: str, out_path: str) -> Counters:
     return _run_bandit(cfg, in_path, out_path, "softMax")
 
 
-@register("org.avenir.reinforce.AuerDeterministic", "auerDeterministic")
+@register("org.avenir.reinforce.AuerDeterministic", "auerDeterministic",
+          dist="gather")
 def auer_deterministic(cfg: Config, in_path: str, out_path: str) -> Counters:
     """Auer's deterministic UCB1 variant."""
     return _run_bandit(cfg, in_path, out_path, "ucb1")
 
 
 @register("org.avenir.reinforce.RandomFirstGreedyBandit",
-          "randomFirstGreedyBandit")
+          "randomFirstGreedyBandit",
+          dist="gather")
 def random_first_greedy_bandit(cfg: Config, in_path: str,
                                out_path: str) -> Counters:
     """Random exploration first, then greedy: randomGreedy with linear

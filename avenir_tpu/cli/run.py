@@ -16,6 +16,7 @@ argument order used by resource/opt.sh.  Prints Hadoop-style counter dumps.
 
 from __future__ import annotations
 
+import os
 import sys
 from typing import List, Optional
 
@@ -78,9 +79,95 @@ def _enter_distributed_mode(mode: str) -> None:
     set_runtime_context(MeshContext(distributed.make_hybrid_mesh()))
 
 
-def main(argv: Optional[List[str]] = None) -> int:
-    import os
+def _apply_dist_mode(fn, job_name: str, in_path: Optional[str]):
+    """Enforce the job's multi-process class (parallel/distributed.py
+    docstring).  Single-process: identity.  Under ``process_count() > 1``:
+    'sharded' and 'map' jobs run on their local shard unchanged; 'gather'
+    jobs get their input allgathered into a process-local spool DIR so the
+    host-side computation sees the FULL input on every process (the
+    reference's shuffle global-ness); anything else is refused loudly —
+    silently emitting shard-local results is the worst failure mode.
 
+    Returns ``(effective_in_path, cleanup_dir_or_None)`` — the caller
+    removes the spool dir after the job so chained pipelines don't
+    accumulate full input copies in tmp.
+
+    The spool is a DIRECTORY that preserves each input file's basename
+    (suffixed ``.p<process>`` for cross-process uniqueness): several
+    gather jobs key behavior on basenames inside the input dir — the
+    train/test ``tr`` prefix of the similarity jobs, the ``condProb``
+    prefix of featureCondProbJoiner — and flattening to one spool file
+    would silently break them.  Every process joins the collectives even
+    with no local input (``in_path=None`` contributes zero files); if
+    processes disagree on WHETHER an input path was given at all, that is
+    an argv mismatch and raises on every process rather than deadlocking
+    half the pod inside a collective.
+
+    Shared-filesystem deployments (identical argv on every host — the
+    standard Hadoop-style launch) are detected FIRST via a digest
+    exchange: when every process holds the identical input files, the
+    original path is used as-is — no spool, no bulk gather, and no silent
+    P-fold double-count of the union semantics.  Only genuinely differing
+    shards pay the content gather, which ships whole shards through
+    ``allgather_object`` and therefore assumes host-side-job-sized inputs
+    (the per-process peak is ~process_count x the largest shard)."""
+    from ..parallel.distributed import is_multiprocess
+    if not is_multiprocess():
+        return in_path, None
+    mode = jobs.dist_mode(fn)
+    if mode in ("sharded", "map"):
+        return in_path, None
+    if mode == "gather":
+        import glob
+        import hashlib
+        import tempfile
+        import jax
+        from ..parallel.distributed import allgather_object
+        local = []
+        if in_path is not None:
+            paths = (sorted(p for p in glob.glob(os.path.join(in_path, "*"))
+                            if os.path.isfile(p))
+                     if os.path.isdir(in_path) else [in_path])
+            for p in paths:
+                with open(p, "r") as fh:
+                    local.append((os.path.basename(p), fh.read()))
+        digest = hashlib.sha256(
+            repr([(b, hashlib.sha256(t.encode()).hexdigest())
+                  for b, t in local]).encode()).hexdigest()
+        meta = allgather_object((in_path is not None, digest))
+        flags = [has for has, _ in meta]
+        if len(set(flags)) > 1:
+            raise RuntimeError(
+                f"job {job_name}: processes disagree on whether an input "
+                f"path was given ({flags}); fix the per-process argv")
+        if in_path is None:
+            return None, None
+        if len({d for _, d in meta}) == 1:
+            # identical files everywhere: shared-filesystem launch — the
+            # input already IS the global dataset on every process
+            if jax.process_index() == 0:
+                print(f"[dist] {job_name}: input identical on all "
+                      f"{len(meta)} processes; using it as-is (no gather)",
+                      file=sys.stderr)
+            return in_path, None
+        gathered = allgather_object(local)
+        spool = tempfile.mkdtemp(prefix="avenir_dist_gather_")
+        for proc, files in enumerate(gathered):
+            for base, text in files:
+                with open(os.path.join(spool, f"{base}.p{proc}"), "w") as fh:
+                    fh.write(text)
+        if jax.process_index() == 0:
+            print(f"[dist] {job_name}: gathered "
+                  f"{sum(len(f) for f in gathered)} input file(s) from "
+                  f"{len(gathered)} processes", file=sys.stderr)
+        return spool, spool
+    raise RuntimeError(
+        f"job {job_name} is not multi-process safe (dist mode {mode!r}): "
+        f"running it under jax.process_count() > 1 would emit shard-local "
+        f"results; run it single-process")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     job_name, conf_path, overrides, positional = parse_args(argv)
     if job_name is None:
@@ -105,7 +192,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         in_path, out_path = None, positional[0]
     else:
         in_path = out_path = None
+    spool_dir = None
     try:
+        # inside the try so a dist-mode refusal still runs the context
+        # cleanup below (no hybrid-mesh leak into later in-process runs)
+        in_path, spool_dir = _apply_dist_mode(fn, job_name, in_path)
         # job-level step accounting into the counters channel (the rebuild's
         # replacement for the Hadoop UI's job timing; SURVEY §5), plus an
         # optional XLA profiler capture dir
@@ -119,15 +210,24 @@ def main(argv: Optional[List[str]] = None) -> int:
             # Hadoop counters are cluster-global: under multi-host the per
             # -process host-side tallies are all-reduced, and only process 0
             # renders (matching the reference driver's single counter dump).
+            # gather-mode jobs are the exception: every process computed the
+            # identical full result, so their counters are ALREADY global —
+            # summing would inflate each one by the process count.
             # Profiling times are exported AFTER the reduce — per-process
             # wall clock must not be summed across the pod.
             from ..parallel.distributed import all_reduce_counters
             import jax
-            counters = all_reduce_counters(counters)
+            if jobs.dist_mode(fn) != "gather":
+                counters = all_reduce_counters(counters)
             timer.export(counters)
             if jax.process_index() == 0:
                 print(counters.render())
     finally:
+        if spool_dir is not None:
+            # gather spools hold a full copy of the global input; chained
+            # pipelines must not accumulate them in tmp
+            import shutil
+            shutil.rmtree(spool_dir, ignore_errors=True)
         if entered_distributed:
             # don't leak the hybrid context into later in-process runs
             from ..parallel.mesh import set_runtime_context

@@ -33,7 +33,8 @@ def _parse_sequences(lines, split_line, skip: int, class_ord: int = -1):
 
 
 @register("org.avenir.markov.MarkovStateTransitionModel",
-          "markovStateTransitionModel")
+          "markovStateTransitionModel",
+          dist="gather")
 def markov_state_transition_model(cfg: Config, in_path: str,
                                   out_path: str) -> Counters:
     """Markov transition-matrix trainer (mst.* keys: skip.field.count,
@@ -59,7 +60,8 @@ def markov_state_transition_model(cfg: Config, in_path: str,
     return counters
 
 
-@register("org.avenir.markov.MarkovModelClassifier", "markovModelClassifier")
+@register("org.avenir.markov.MarkovModelClassifier", "markovModelClassifier",
+          dist="map")
 def markov_model_classifier(cfg: Config, in_path: str, out_path: str) -> Counters:
     """Log-odds sequence classifier (mmc.* keys; output
     id[,actual],predClass,logOdds — MarkovModelClassifier.java:140-148)."""
@@ -101,7 +103,8 @@ def markov_model_classifier(cfg: Config, in_path: str, out_path: str) -> Counter
     return counters
 
 
-@register("org.avenir.markov.HiddenMarkovModelBuilder", "hiddenMarkovModelBuilder")
+@register("org.avenir.markov.HiddenMarkovModelBuilder", "hiddenMarkovModelBuilder",
+          dist="gather")
 def hidden_markov_model_builder(cfg: Config, in_path: str,
                                 out_path: str) -> Counters:
     """Supervised HMM builder (hmmb.* keys).  Input lines alternate
@@ -125,7 +128,8 @@ def hidden_markov_model_builder(cfg: Config, in_path: str,
     return counters
 
 
-@register("org.avenir.markov.ViterbiStatePredictor", "viterbiStatePredictor")
+@register("org.avenir.markov.ViterbiStatePredictor", "viterbiStatePredictor",
+          dist="map")
 def viterbi_state_predictor(cfg: Config, in_path: str, out_path: str) -> Counters:
     """Viterbi decode of observation sequences (vsp.* keys; output
     id,state,state,... — markov/ViterbiStatePredictor.java:77)."""
@@ -149,7 +153,8 @@ def viterbi_state_predictor(cfg: Config, in_path: str, out_path: str) -> Counter
 
 
 @register("org.avenir.markov.ProbabilisticSuffixTreeGenerator",
-          "probabilisticSuffixTreeGenerator")
+          "probabilisticSuffixTreeGenerator",
+          dist="gather")
 def probabilistic_suffix_tree_generator(cfg: Config, in_path: str,
                                         out_path: str) -> Counters:
     """PST counts up to pstg.max.depth (markov/ProbabilisticSuffixTree
@@ -168,7 +173,8 @@ def probabilistic_suffix_tree_generator(cfg: Config, in_path: str,
 
 
 @register("org.avenir.sequence.CandidateGenerationWithSelfJoin",
-          "candidateGenerationWithSelfJoin")
+          "candidateGenerationWithSelfJoin",
+          dist="gather")
 def candidate_generation_with_self_join(cfg: Config, in_path: str,
                                         out_path: str) -> Counters:
     """GSP candidate generation from (k-1)-frequent sequence lines
@@ -190,7 +196,8 @@ def candidate_generation_with_self_join(cfg: Config, in_path: str,
 
 
 @register("org.avenir.sequence.SequencePositionalCluster",
-          "sequencePositionalCluster")
+          "sequencePositionalCluster",
+          dist="gather")
 def sequence_positional_cluster(cfg: Config, in_path: str, out_path: str
                                 ) -> Counters:
     """Event-locality scoring in sliding time windows
@@ -266,7 +273,8 @@ def sequence_positional_cluster(cfg: Config, in_path: str, out_path: str
 
 
 @register("org.avenir.spark.markov.StateTransitionRate",
-          "stateTransitionRate")
+          "stateTransitionRate",
+          dist="gather")
 def state_transition_rate(cfg: Config, in_path: str, out_path: str
                           ) -> Counters:
     """Per-key CTMC generator (rate) matrices from timestamped state events
@@ -310,6 +318,10 @@ def state_transition_rate(cfg: Config, in_path: str, out_path: str
         elif in_unit == "sec":
             epoch_ms = float(ts) * 1000.0
         elif in_unit == "formatted":
+            # naive parse + .timestamp() uses the host's local timezone,
+            # mirroring Java SimpleDateFormat's default-TZ behavior in the
+            # reference; epoch values are therefore machine-dependent —
+            # keep formatted-mode flows out of byte-pinned fixtures
             epoch_ms = _dt.datetime.strptime(ts, fmt).timestamp() * 1000.0
         else:
             raise ValueError(f"invalid input time unit {in_unit!r}")
@@ -331,7 +343,8 @@ def state_transition_rate(cfg: Config, in_path: str, out_path: str
 
 
 @register("org.avenir.spark.markov.ContTimeStateTransitionStats",
-          "contTimeStateTransitionStats")
+          "contTimeStateTransitionStats",
+          dist="gather")
 def cont_time_state_transition_stats(cfg: Config, in_path: str,
                                      out_path: str) -> Counters:
     """CTMC uniformization statistics (spark/.../markov/ContTimeState
@@ -404,7 +417,8 @@ def cont_time_state_transition_stats(cfg: Config, in_path: str,
 
 
 @register("org.avenir.spark.sequence.EventTimeDistribution",
-          "eventTimeDistribution")
+          "eventTimeDistribution",
+          dist="gather")
 def event_time_distribution(cfg: Config, in_path: str, out_path: str
                             ) -> Counters:
     """Per-key event-time histogram
@@ -484,7 +498,8 @@ def event_time_distribution(cfg: Config, in_path: str, out_path: str
     return counters
 
 
-@register("org.avenir.spark.sequence.SequenceGenerator", "sequenceGenerator")
+@register("org.avenir.spark.sequence.SequenceGenerator", "sequenceGenerator",
+          dist="gather")
 def sequence_generator(cfg: Config, in_path: str, out_path: str) -> Counters:
     """Event-stream -> per-entity ordered sequences
     (spark/.../sequence/SequenceGenerator.scala:25-81): records grouped by

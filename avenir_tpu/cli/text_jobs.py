@@ -14,7 +14,8 @@ from ..core import artifacts
 from .jobs import register, _splitter
 
 
-@register("org.avenir.text.WordCounter", "wordCounter")
+@register("org.avenir.text.WordCounter", "wordCounter",
+          dist="gather")
 def word_counter(cfg: Config, in_path: str, out_path: str) -> Counters:
     """Word-count MR (text/WordCounter.java).  Keys: text.field.ordinal
     (whole line when not positive, mapper :102-106)."""
@@ -37,7 +38,8 @@ def word_counter(cfg: Config, in_path: str, out_path: str) -> Counters:
     return counters
 
 
-@register("org.avenir.explore.RuleEvaluator", "ruleEvaluator")
+@register("org.avenir.explore.RuleEvaluator", "ruleEvaluator",
+          dist="gather")
 def rule_evaluator(cfg: Config, in_path: str, out_path: str) -> Counters:
     """Rule confidence/support evaluation (explore/RuleEvaluator.java).
     Keys: rue.rule.names (list), rue.rule.<name> (each ``condition >
@@ -78,7 +80,8 @@ def rule_evaluator(cfg: Config, in_path: str, out_path: str) -> Counters:
     return counters
 
 
-@register("org.chombo.mr.TemporalFilter", "temporalFilter")
+@register("org.chombo.mr.TemporalFilter", "temporalFilter",
+          dist="map")
 def temporal_filter(cfg: Config, in_path: str, out_path: str) -> Counters:
     """Time-range record filter (the chombo TemporalFilter MR the
     reference's fit flow runs before Apriori, resource/fit.sh:29-40,

@@ -40,7 +40,8 @@ def write_text_output(dir_path: str, lines: Iterable[str],
         part = 0
         if local_shard:
             import jax
-            if getattr(jax, "process_count", lambda: 1)() > 1:
+            from ..parallel.distributed import is_multiprocess
+            if is_multiprocess():
                 part = jax.process_index()
     os.makedirs(dir_path, exist_ok=True)
     path = os.path.join(dir_path, f"part-{role}-{part:05d}")

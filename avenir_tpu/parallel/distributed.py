@@ -21,13 +21,31 @@ bit-identical across processes), and host-side tallies go through
 process 0 only).  Per-record outputs (prediction part files) are written
 per process as part-m-<process_index> — the Hadoop one-part-per-task
 layout (core/artifacts.write_text_output); training jobs whose artifact
-is the global model write identical bytes on every process.  KNOWN
-LIMITATION (round-4 work): jobs whose computation is host-side over the
-local lines (apriori, rule mining, the file-based KNN grouping) produce
-shard-local results under multi-process — they need either device
-formulations or an explicit gather step before they are pod-correct;
-the device-reduction jobs (NB, trees/forest, MI, correlations, KNN
-fused pipeline) are global-correct today.
+is the global model write identical bytes on every process.
+
+Every registered job carries an explicit multi-process mode
+(cli.jobs.register ``dist=``), enforced by cli.run under
+``jax.process_count() > 1``:
+
+  * ``sharded`` — the job consumes its local shard and produces global
+    results internally (device reductions over sharded global arrays, or
+    explicit collectives: NB, trees/forest, MI, numerical correlation,
+    Apriori support counting);
+  * ``gather`` — host-side global computation: cli.run allgathers the
+    per-process input FILES into a local spool dir first
+    (``allgather_object`` transport, basenames preserved), so every
+    process computes over the FULL input and writes the identical full
+    output — the reference's shuffle gave host-side reductions the same
+    global view.  Take process 0's output (its counters are already
+    global: cli.run skips the counter all-reduce for gather jobs).  The
+    dataset is the UNION of the per-process inputs: feed distinct shards
+    (or the whole file on one process and empty shards elsewhere);
+    replicating the same file to every process double-counts it;
+  * ``map`` — per-record transform over the local shard; per-process
+    part-m files are the correct Hadoop layout.
+
+A job with no mode (or an explicit ``refuse``) is rejected loudly under
+multi-process instead of silently emitting shard-local results.
 """
 
 from __future__ import annotations
@@ -39,6 +57,18 @@ import numpy as np
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# set once this process successfully joins a multi-process run
+_joined = False
+
+
+def process_count() -> int:
+    """jax.process_count with a fallback for jax builds lacking it."""
+    return getattr(jax, "process_count", lambda: 1)()
+
+
+def is_multiprocess() -> bool:
+    return process_count() > 1
 
 
 def initialize(coordinator_address: Optional[str] = None,
@@ -58,6 +88,14 @@ def initialize(coordinator_address: Optional[str] = None,
     A partially-specified explicit config raises instead of silently
     running single-process (each host computing 'global' results over only
     its own shard is the worst failure mode of this module)."""
+    # idempotent: chained CLI runs in one process (level-wise Apriori,
+    # pipeline scripts) re-enter distributed mode per job; the first join
+    # holds for the process lifetime.  NOTE: must not touch jax.process_count
+    # before the actual join — it would initialize the XLA backend and
+    # jax.distributed.initialize refuses to run after that
+    global _joined
+    if _joined:
+        return jax.process_count() > 1
     coordinator_address = coordinator_address or os.environ.get(
         "JAX_COORDINATOR_ADDRESS")
     if num_processes is None:
@@ -73,6 +111,7 @@ def initialize(coordinator_address: Optional[str] = None,
         jax.distributed.initialize(coordinator_address=coordinator_address,
                                    num_processes=num_processes,
                                    process_id=process_id)
+        _joined = True
         return True
     if coordinator_address and num_processes is None:
         raise ValueError("JAX_COORDINATOR_ADDRESS set without "
@@ -85,6 +124,7 @@ def initialize(coordinator_address: Optional[str] = None,
         auto = os.environ.get("AVENIR_TPU_DISTRIBUTED") == "1"
     if auto:
         jax.distributed.initialize()  # pod runtimes self-discover
+        _joined = True
         return jax.process_count() > 1
     return False
 
@@ -133,7 +173,7 @@ def from_process_local(local_rows: np.ndarray, mesh: Mesh):
     (verified on a 2-process CPU run).  The guard allgathers the row count
     (one tiny collective per ingest) and fails loudly instead."""
     sharding = row_sharding(mesh)
-    if getattr(jax, "process_count", lambda: 1)() <= 1:
+    if not is_multiprocess():
         return jax.device_put(local_rows, sharding)
     from jax.experimental import multihost_utils
     shapes = np.asarray(multihost_utils.process_allgather(
@@ -147,13 +187,59 @@ def from_process_local(local_rows: np.ndarray, mesh: Mesh):
     return jax.make_array_from_process_local_data(sharding, local_rows)
 
 
+def allgather_object(obj):
+    """All-gather an arbitrary picklable host object across processes,
+    returning the per-process list in process order (single-process:
+    ``[obj]``).  The transport is the device collective fabric
+    (``multihost_utils.process_allgather`` over a padded uint8 buffer) —
+    the same path the reference's shuffle rides, no side channel to
+    configure.  Intended for SMALL host-side state: vocabularies,
+    candidate sets, per-shard tallies — not bulk data."""
+    if not is_multiprocess():
+        return [obj]
+    import pickle
+    from jax.experimental import multihost_utils
+    data = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    # size exchange as (hi, lo) int31 halves: process_allgather
+    # canonicalizes int64 to int32 when jax_enable_x64 is off (this repo
+    # never enables it), and a single int32 would cap payloads at 2 GiB —
+    # cli.run's gather spool ships whole input shards through here
+    hi_lo = np.array([data.size >> 31, data.size & 0x7FFFFFFF],
+                     dtype=np.int32)
+    pairs = np.asarray(multihost_utils.process_allgather(hi_lo)
+                       ).reshape(-1, 2).astype(np.int64)
+    sizes = (pairs[:, 0] << 31) + pairs[:, 1]
+    buf = np.zeros((int(sizes.max()),), dtype=np.uint8)
+    buf[:data.size] = data
+    gathered = np.asarray(multihost_utils.process_allgather(buf))
+    return [pickle.loads(gathered[p, :sizes[p]].tobytes())
+            for p in range(len(sizes))]
+
+
+def all_reduce_host_array(x: np.ndarray) -> np.ndarray:
+    """Element-wise sum of a same-shaped host array across processes
+    (Apriori support counts, per-shard histograms), EXACT in the input
+    dtype: the transport is the pickled-object path, because
+    ``process_allgather`` canonicalizes int64/float64 down to 32 bits when
+    jax_enable_x64 is off and would silently wrap counts past 2^31.
+    Single-process: ``np.asarray(x)`` unchanged."""
+    x = np.asarray(x)
+    if not is_multiprocess():
+        return x
+    parts = allgather_object(x)
+    out = parts[0].copy()
+    for p in parts[1:]:
+        out += p
+    return out
+
+
 def all_reduce_counters(counters):
     """Sum a Counters object across all processes (Hadoop counters are
     global; host-side tallies — validation counts, emitted-line counts —
     are per-process under multi-host and must be reduced before rendering).
     Single-process: identity.  Keys must match across processes (they do:
     every process runs the same job)."""
-    if getattr(jax, "process_count", lambda: 1)() <= 1:
+    if not is_multiprocess():
         return counters
     from jax.experimental import multihost_utils
     items = sorted(counters._c.items())

@@ -1,11 +1,20 @@
-"""Worker for the true 2-process distributed test (spawned by
-tests/test_distributed.py): joins the coordinator, runs the NaiveBayes
-train job through the CLI distributed mode on THIS process's input shard,
-and prints the model file path + captured counter output for the parent to
-compare."""
+"""Worker for the true 2-process distributed tests (spawned by
+tests/test_distributed.py): joins the coordinator, then executes a JSON
+spec of one or more CLI runs on THIS process's input shard, printing the
+captured counter output between markers for the parent to compare.
+
+Spec file layout::
+
+    {"runs": [[argv...], [argv...], ...]}
+
+Placeholders are resolved by the parent before writing the spec.  Chained
+runs exercise the idempotent re-entry of distributed mode (level-wise
+Apriori, pipeline scripts).
+"""
 
 import contextlib
 import io
+import json
 import os
 import sys
 
@@ -13,9 +22,7 @@ import sys
 def main():
     pid = int(sys.argv[1])
     port = sys.argv[2]
-    shard = sys.argv[3]
-    out = sys.argv[4]
-    res = sys.argv[5]
+    spec_path = sys.argv[3]
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
     os.environ["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
@@ -24,14 +31,13 @@ def main():
     import jax
     jax.config.update("jax_platforms", "cpu")
     from avenir_tpu.cli import run as cli_run
+    with open(spec_path) as fh:
+        spec = json.load(fh)
     cap = io.StringIO()
-    with contextlib.redirect_stdout(cap):
-        rc = cli_run.main([
-            "org.avenir.bayesian.BayesianDistribution",
-            f"-Dconf.path={res}/churn.properties",
-            f"-Dbad.feature.schema.file.path={res}/churn.json",
-            "-Ddistributed.mode=1", shard, out])
-    assert rc == 0
+    for argv in spec["runs"]:
+        with contextlib.redirect_stdout(cap):
+            rc = cli_run.main(argv)
+        assert rc == 0, f"run failed rc={rc}: {argv}"
     sys.stdout.write(f"COUNTERS_BEGIN\n{cap.getvalue()}COUNTERS_END\n")
     print("WORKER_OK")
 

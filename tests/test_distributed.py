@@ -123,10 +123,12 @@ def test_all_reduce_counters_single_process_identity():
     assert out is c
 
 
-def _spawn_two_workers(tmp_path, res, shard_names):
-    """Spawn the 2-process worker pair on an ephemeral coordinator port,
-    returning [(returncode, stdout, stderr)] — workers are killed on
-    timeout so a hung coordinator can't leak into the rest of the run."""
+def _spawn_two_workers_spec(tmp_path, specs):
+    """Spawn the 2-process worker pair on an ephemeral coordinator port;
+    ``specs[i]`` is process i's {"runs": [[argv...], ...]} spec.  Returns
+    [(returncode, stdout, stderr)] — workers are killed on timeout so a
+    hung coordinator can't leak into the rest of the run."""
+    import json
     import os
     import socket
     import subprocess
@@ -142,21 +144,40 @@ def _spawn_two_workers(tmp_path, res, shard_names):
     env["PYTHONPATH"] = os.pathsep.join(
         [os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))]
         + [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p])
+    spec_paths = []
+    for i, spec in enumerate(specs):
+        p = tmp_path / f"spec{i}.json"
+        p.write_text(json.dumps(spec))
+        spec_paths.append(str(p))
     procs = [subprocess.Popen(
-        [sys.executable, worker, str(i), port,
-         str(tmp_path / shard_names[i]), str(tmp_path / f"out{i}"), res],
+        [sys.executable, worker, str(i), port, spec_paths[i]],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
         for i in range(2)]
     results = []
     try:
         for p in procs:
-            stdout, stderr = p.communicate(timeout=180)
+            stdout, stderr = p.communicate(timeout=300)
             results.append((p.returncode, stdout, stderr))
     except subprocess.TimeoutExpired:
         for p in procs:
             p.kill()
         raise
     return results
+
+
+def _nb_train_spec(res, shard, out):
+    return {"runs": [[
+        "org.avenir.bayesian.BayesianDistribution",
+        f"-Dconf.path={res}/churn.properties",
+        f"-Dbad.feature.schema.file.path={res}/churn.json",
+        "-Ddistributed.mode=1", shard, out]]}
+
+
+def _spawn_two_workers(tmp_path, res, shard_names):
+    return _spawn_two_workers_spec(tmp_path, [
+        _nb_train_spec(res, str(tmp_path / shard_names[i]),
+                       str(tmp_path / f"out{i}"))
+        for i in range(2)])
 
 
 def test_true_two_process_nb_train(tmp_path):
@@ -244,3 +265,206 @@ def test_write_text_output_per_process_parts(tmp_path, monkeypatch):
     p = artifacts.write_text_output(str(tmp_path / "x"), ["c"], role="r",
                                     local_shard=True)
     assert p.endswith("part-r-00001")
+
+
+# ---------------------------------------------------------------------------
+# round-4: multi-process correct-or-loud for host-side jobs
+# ---------------------------------------------------------------------------
+
+TRANS_LINES = [
+    "t01,milk,bread,butter", "t02,milk,bread", "t03,bread,butter",
+    "t04,milk,butter", "t05,milk,bread,butter,jam", "t06,bread,jam",
+    "t07,milk,bread", "t08,coffee,milk", "t09,milk,bread,butter",
+    "t10,bread,butter,jam", "t11,milk,jam", "t12,bread,milk,butter",
+]
+
+
+def _apriori_props(tmp_path, total):
+    props = tmp_path / "fit.properties"
+    props.write_text(
+        "field.delim.regex=,\nfield.delim.out=,\n"
+        "fia.item.set.length=1\nfia.tans.id.ord=0\n"
+        "fia.skip.field.count=1\nfia.support.threshold=0.25\n"
+        f"fia.total.tans.count={total}\n"
+        "fia.trans.id.output=false\n"
+        "arm.conf.threshold=0.5\narm.output.confidence=true\n")
+    return str(props)
+
+
+def _apriori_runs(props, shard, lvl1, lvl2, comb, rules):
+    """Level-1 -> level-2 -> rule mining, chained in one worker process
+    (re-enters distributed mode per run).  ``comb`` is the rule miner's
+    input dir — the parent pre-creates it with symlinks to both level
+    outputs (the reference feeds the miner every level's itemset file)."""
+    return [
+        ["org.avenir.association.FrequentItemsApriori",
+         f"-Dconf.path={props}", "-Ddistributed.mode=1", shard, lvl1],
+        ["org.avenir.association.FrequentItemsApriori",
+         f"-Dconf.path={props}", "-Dfia.item.set.length=2",
+         f"-Dfia.item.set.file.path={lvl1}",
+         "-Ddistributed.mode=1", shard, lvl2],
+        ["org.avenir.association.AssociationRuleMiner",
+         f"-Dconf.path={props}", "-Ddistributed.mode=1", comb, rules],
+    ]
+
+
+def _link_levels(comb, lvl_paths):
+    import os
+    os.makedirs(comb, exist_ok=True)
+    for j, lvl in enumerate(lvl_paths):
+        os.symlink(os.path.join(lvl, "part-r-00000"),
+                   os.path.join(comb, f"part-lvl{j}"))
+
+
+def test_true_two_process_apriori_and_rules(tmp_path):
+    """Sharded Apriori (vocab/candidate union + count all-reduce) and the
+    gather-mode rule miner must produce the IDENTICAL global output on both
+    processes as a single-process run over the full transaction file —
+    the reference got this from the shuffle (FrequentItemsApriori.java:
+    89-306); shard-local results are the silent failure this guards.
+
+    The rule-mining stage also pins the gather contract: the union of the
+    per-process inputs is the dataset, so a replicated upstream artifact
+    (every process holds the identical global itemset files) is fed on
+    process 0 only — process 1 reads an empty shard and still emits the
+    full global rule set."""
+    import os
+
+    from avenir_tpu.cli import run as cli_run
+
+    (tmp_path / "shard0.csv").write_text("\n".join(TRANS_LINES[:6]))
+    (tmp_path / "shard1.csv").write_text("\n".join(TRANS_LINES[6:]))
+    (tmp_path / "full.csv").write_text("\n".join(TRANS_LINES))
+    props = _apriori_props(tmp_path, len(TRANS_LINES))
+
+    # process 0's rule input: both level outputs; process 1: empty shard
+    _link_levels(str(tmp_path / "comb_0"),
+                 [str(tmp_path / "lvl1_0"), str(tmp_path / "lvl2_0")])
+    os.makedirs(tmp_path / "comb_1")
+    (tmp_path / "comb_1" / "part-empty").write_text("")
+
+    specs = []
+    for i in range(2):
+        specs.append({"runs": _apriori_runs(
+            props, str(tmp_path / f"shard{i}.csv"),
+            str(tmp_path / f"lvl1_{i}"), str(tmp_path / f"lvl2_{i}"),
+            str(tmp_path / f"comb_{i}"), str(tmp_path / f"rules_{i}"))})
+    outs = []
+    for rc, stdout, stderr in _spawn_two_workers_spec(tmp_path, specs):
+        assert rc == 0, f"worker failed:\n{stderr[-3000:]}"
+        assert "WORKER_OK" in stdout, stdout
+        outs.append(stdout)
+    # counter semantics: transactions are per-shard and all-reduced (6+6),
+    # the global-identical tallies are NOT inflated by the process count —
+    # frequentItemSets counted on process 0 only, and the gather-mode rule
+    # miner's counters skip the all-reduce entirely
+    c0 = outs[0].split("COUNTERS_BEGIN\n")[1].split("COUNTERS_END")[0]
+    assert "transactions=12" in c0, c0
+    assert "frequentItemSets=4" in c0, c0      # lvl1: bread,butter,jam,milk
+    assert "rules=6" in c0, c0
+
+    # single-process reference over the concatenated transactions
+    _link_levels(str(tmp_path / "comb_s"),
+                 [str(tmp_path / "lvl1_s"), str(tmp_path / "lvl2_s")])
+    for argv in _apriori_runs(props, str(tmp_path / "full.csv"),
+                              str(tmp_path / "lvl1_s"),
+                              str(tmp_path / "lvl2_s"),
+                              str(tmp_path / "comb_s"),
+                              str(tmp_path / "rules_s")):
+        assert cli_run.main([a for a in argv
+                             if a != "-Ddistributed.mode=1"]) == 0
+
+    for stage in ("lvl1", "lvl2", "rules"):
+        single = sorted((tmp_path / f"{stage}_s").glob("part-*"))[0].read_text()
+        assert single.strip(), f"single-process {stage} output empty"
+        for i in range(2):
+            got = sorted((tmp_path / f"{stage}_{i}").glob("part-*"))[0].read_text()
+            assert got == single, (
+                f"process {i} {stage} output != single-process global output")
+
+
+def test_every_job_has_dist_mode():
+    """The correct-or-loud contract: every registered job carries an
+    explicit multi-process class, so nothing can silently default."""
+    from avenir_tpu.cli import run as cli_run  # registers all packs # noqa
+    from avenir_tpu.cli.jobs import JOBS, JOB_DIST, _DIST_MODES
+    for name, fn in JOBS.items():
+        assert fn in JOB_DIST, f"{name} has no dist mode"
+        assert JOB_DIST[fn] in _DIST_MODES
+
+
+def test_dist_mode_guard_refuses_unclassified(monkeypatch, tmp_path):
+    """An unclassified (or refuse-marked) job must be rejected under
+    multi-process instead of emitting shard-local results."""
+    import pytest
+    from avenir_tpu.cli import run as cli_run
+
+    def fake_job(cfg, in_path, out_path):
+        return None
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    with pytest.raises(RuntimeError, match="not multi-process safe"):
+        cli_run._apply_dist_mode(fake_job, "FakeJob", str(tmp_path / "in"))
+
+
+def test_dist_mode_gather_spools_full_input(monkeypatch, tmp_path):
+    """gather-mode jobs see the allgathered input through a spool DIR that
+    preserves per-file basenames (prefix-keyed jobs depend on them), and
+    an input-presence mismatch across processes raises instead of
+    deadlocking half the pod in a collective."""
+    import os
+    import pytest
+    from avenir_tpu.cli import run as cli_run
+    from avenir_tpu.cli import jobs as J
+    from avenir_tpu.parallel import distributed as D
+
+    def fake_job(cfg, in_path, out_path):
+        return None
+
+    indir = tmp_path / "in"
+    indir.mkdir()
+    (indir / "tr-part").write_text("a\nb")
+    (indir / "part-r-00000").write_text("c")
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    monkeypatch.setitem(J.JOB_DIST, fake_job, "gather")
+
+    # simulate a peer process holding a DIFFERENT shard: digest meta phase
+    # (tuple arg) then the content phase (list arg)
+    def peer_differs(obj):
+        if isinstance(obj, tuple):
+            return [obj, (True, "peer-digest")]
+        return [obj, [("tr-part", "x\ny")]]
+
+    monkeypatch.setattr(D, "allgather_object", peer_differs)
+    spool, cleanup = cli_run._apply_dist_mode(fake_job, "FakeJob",
+                                              str(indir))
+    assert spool == cleanup and os.path.isdir(spool)
+    names = sorted(os.listdir(spool))
+    assert names == ["part-r-00000.p0", "tr-part.p0", "tr-part.p1"]
+    assert open(os.path.join(spool, "tr-part.p1")).read() == "x\ny"
+    # the train-prefix key survives spooling
+    assert sum(n.startswith("tr") for n in names) == 2
+
+    # shared-filesystem launch (identical digests everywhere): the input
+    # is used as-is — no spool, no P-fold double-count of the union
+    monkeypatch.setattr(D, "allgather_object", lambda obj: [obj, obj])
+    assert cli_run._apply_dist_mode(
+        fake_job, "FakeJob", str(indir)) == (str(indir), None)
+
+    # processes disagreeing on input presence must raise, not deadlock
+    monkeypatch.setattr(
+        D, "allgather_object", lambda obj: [obj, (False, "")])
+    with pytest.raises(RuntimeError, match="disagree"):
+        cli_run._apply_dist_mode(fake_job, "FakeJob", str(indir))
+
+    # sharded/map jobs pass through untouched
+    monkeypatch.setitem(J.JOB_DIST, fake_job, "sharded")
+    assert cli_run._apply_dist_mode(fake_job, "FakeJob", "x") == ("x", None)
+
+
+def test_allgather_helpers_single_process_identity():
+    from avenir_tpu.parallel import distributed as D
+    assert D.allgather_object({"k": [1, 2]}) == [{"k": [1, 2]}]
+    np.testing.assert_array_equal(
+        D.all_reduce_host_array(np.array([3, 4])), np.array([3, 4]))
