@@ -105,66 +105,114 @@ def _apply_dist_mode(fn, job_name: str, in_path: Optional[str]):
 
     Shared-filesystem deployments (identical argv on every host — the
     standard Hadoop-style launch) are detected FIRST via a digest
-    exchange: when every process holds the identical input files, the
-    original path is used as-is — no spool, no bulk gather, and no silent
-    P-fold double-count of the union semantics.  Only genuinely differing
-    shards pay the content gather, which ships whole shards through
-    ``allgather_object`` and therefore assumes host-side-job-sized inputs
-    (the per-process peak is ~process_count x the largest shard)."""
+    exchange, and the response depends on the mode:
+
+      * gather — the input already IS the global dataset on every
+        process: use it as-is (no spool, no bulk gather, no P-fold
+        double-count of the union semantics);
+      * sharded / map — every process would treat the FULL file as its
+        shard and the reductions/part files would silently P-fold-inflate
+        the results, so this RAISES with instructions to split the input
+        (AVENIR_TPU_ALLOW_IDENTICAL_SHARDS=1 overrides, for the corner
+        case of genuinely identical distinct shards).
+
+    Only genuinely differing gather shards pay the content gather, which
+    ships whole shards through ``allgather_object`` and therefore assumes
+    host-side-job-sized inputs (the per-process peak is ~process_count x
+    the largest shard); file contents are hashed streaming in the digest
+    phase and read again only when actually gathered."""
     from ..parallel.distributed import is_multiprocess
     if not is_multiprocess():
         return in_path, None
     mode = jobs.dist_mode(fn)
-    if mode in ("sharded", "map"):
-        return in_path, None
-    if mode == "gather":
-        import glob
-        import hashlib
-        import tempfile
-        import jax
-        from ..parallel.distributed import allgather_object
-        local = []
-        if in_path is not None:
-            paths = (sorted(p for p in glob.glob(os.path.join(in_path, "*"))
-                            if os.path.isfile(p))
-                     if os.path.isdir(in_path) else [in_path])
-            for p in paths:
-                with open(p, "r") as fh:
-                    local.append((os.path.basename(p), fh.read()))
-        digest = hashlib.sha256(
-            repr([(b, hashlib.sha256(t.encode()).hexdigest())
-                  for b, t in local]).encode()).hexdigest()
-        meta = allgather_object((in_path is not None, digest))
-        flags = [has for has, _ in meta]
-        if len(set(flags)) > 1:
-            raise RuntimeError(
-                f"job {job_name}: processes disagree on whether an input "
-                f"path was given ({flags}); fix the per-process argv")
+    if mode not in ("sharded", "gather", "map"):
+        raise RuntimeError(
+            f"job {job_name} is not multi-process safe (dist mode "
+            f"{mode!r}): running it under jax.process_count() > 1 would "
+            f"emit shard-local results; run it single-process")
+
+    import glob
+    import hashlib
+    import tempfile
+    import jax
+    from ..parallel.distributed import allgather_object
+
+    def input_paths():
         if in_path is None:
-            return None, None
-        if len({d for _, d in meta}) == 1:
-            # identical files everywhere: shared-filesystem launch — the
-            # input already IS the global dataset on every process
-            if jax.process_index() == 0:
-                print(f"[dist] {job_name}: input identical on all "
-                      f"{len(meta)} processes; using it as-is (no gather)",
-                      file=sys.stderr)
-            return in_path, None
-        gathered = allgather_object(local)
-        spool = tempfile.mkdtemp(prefix="avenir_dist_gather_")
-        for proc, files in enumerate(gathered):
-            for base, text in files:
-                with open(os.path.join(spool, f"{base}.p{proc}"), "w") as fh:
-                    fh.write(text)
+            return []
+        if os.path.isdir(in_path):
+            return sorted(p for p in glob.glob(os.path.join(in_path, "*"))
+                          if os.path.isfile(p))
+        return [in_path]
+
+    def file_sha(p, full):
+        """Streaming content sha; cheap head+tail+size form for the big
+        sharded/map inputs where a full read would double ingest cost."""
+        h = hashlib.sha256()
+        size = os.path.getsize(p)
+        with open(p, "rb") as fh:
+            if full:
+                for chunk in iter(lambda: fh.read(1 << 20), b""):
+                    h.update(chunk)
+            else:
+                h.update(f"{size}:".encode())
+                h.update(fh.read(1 << 16))
+                if size > (1 << 16):
+                    fh.seek(-(1 << 16), os.SEEK_END)
+                    h.update(fh.read(1 << 16))
+        return h.hexdigest()
+
+    paths = input_paths()
+    full = mode == "gather"
+    digest = hashlib.sha256(repr(
+        [(os.path.basename(p), file_sha(p, full)) for p in paths]
+    ).encode()).hexdigest()
+    meta = allgather_object((in_path is not None, digest))
+    flags = [has for has, _ in meta]
+    if len(set(flags)) > 1:
+        raise RuntimeError(
+            f"job {job_name}: processes disagree on whether an input "
+            f"path was given ({flags}); fix the per-process argv")
+    if in_path is None:
+        return None, None
+    identical = len({d for _, d in meta}) == 1
+
+    if mode in ("sharded", "map"):
+        if identical and not os.environ.get(
+                "AVENIR_TPU_ALLOW_IDENTICAL_SHARDS"):
+            raise RuntimeError(
+                f"job {job_name} (dist mode {mode!r}): all "
+                f"{len(meta)} processes were given IDENTICAL input — each "
+                f"would treat the full file as its shard and the results "
+                f"would be silently {len(meta)}x inflated.  Give each "
+                f"process its own input shard (or set "
+                f"AVENIR_TPU_ALLOW_IDENTICAL_SHARDS=1 if the shards are "
+                f"genuinely identical by coincidence)")
+        return in_path, None
+
+    # gather
+    if identical:
+        # shared-filesystem launch: the input already IS the global dataset
         if jax.process_index() == 0:
-            print(f"[dist] {job_name}: gathered "
-                  f"{sum(len(f) for f in gathered)} input file(s) from "
-                  f"{len(gathered)} processes", file=sys.stderr)
-        return spool, spool
-    raise RuntimeError(
-        f"job {job_name} is not multi-process safe (dist mode {mode!r}): "
-        f"running it under jax.process_count() > 1 would emit shard-local "
-        f"results; run it single-process")
+            print(f"[dist] {job_name}: input identical on all "
+                  f"{len(meta)} processes; using it as-is (no gather)",
+                  file=sys.stderr)
+        return in_path, None
+    local = []
+    for p in paths:
+        with open(p, "r") as fh:
+            local.append((os.path.basename(p), fh.read()))
+    gathered = allgather_object(local)
+    spool = tempfile.mkdtemp(prefix="avenir_dist_gather_")
+    for proc, files in enumerate(gathered):
+        for base, text in files:
+            with open(os.path.join(spool, f"{base}.p{proc}"), "w") as fh:
+                fh.write(text)
+    if jax.process_index() == 0:
+        print(f"[dist] {job_name}: gathered "
+              f"{sum(len(f) for f in gathered)} input file(s) from "
+              f"{len(gathered)} processes", file=sys.stderr)
+    return spool, spool
 
 
 def main(argv: Optional[List[str]] = None) -> int:

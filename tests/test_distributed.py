@@ -458,9 +458,21 @@ def test_dist_mode_gather_spools_full_input(monkeypatch, tmp_path):
     with pytest.raises(RuntimeError, match="disagree"):
         cli_run._apply_dist_mode(fake_job, "FakeJob", str(indir))
 
-    # sharded/map jobs pass through untouched
+    # sharded jobs with DISTINCT per-process shards pass through untouched
     monkeypatch.setitem(J.JOB_DIST, fake_job, "sharded")
-    assert cli_run._apply_dist_mode(fake_job, "FakeJob", "x") == ("x", None)
+    monkeypatch.setattr(D, "allgather_object",
+                        lambda obj: [obj, (True, "peer-digest")])
+    assert cli_run._apply_dist_mode(
+        fake_job, "FakeJob", str(indir)) == (str(indir), None)
+
+    # ...but an identical input everywhere (shared-fs same-argv launch)
+    # would silently P-fold inflate sharded/map results: refuse loudly
+    monkeypatch.setattr(D, "allgather_object", lambda obj: [obj, obj])
+    with pytest.raises(RuntimeError, match="IDENTICAL input"):
+        cli_run._apply_dist_mode(fake_job, "FakeJob", str(indir))
+    monkeypatch.setenv("AVENIR_TPU_ALLOW_IDENTICAL_SHARDS", "1")
+    assert cli_run._apply_dist_mode(
+        fake_job, "FakeJob", str(indir)) == (str(indir), None)
 
 
 def test_allgather_helpers_single_process_identity():
