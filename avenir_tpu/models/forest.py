@@ -247,9 +247,14 @@ class ForestBuilder:
         mask = base.mask_np
         w_cols = []
         for b in builders:
-            w = sampling_weights(n, b.params, b.rng)
-            w_cols.append((w if w is not None else
-                           np.ones((n,), np.float32)) * mask)
+            # drawn over the TRUE row count then zero-padded: model bytes
+            # must not depend on the mesh size via pad rows (see
+            # TreeBuilder's identical rule)
+            w = sampling_weights(base.n_rows, b.params, b.rng)
+            if w is None:
+                w = np.ones((base.n_rows,), np.float32)
+            w_cols.append(np.pad(w, (0, n - base.n_rows)
+                                 ).astype(np.float32) * mask)
         # per-record weight cap feeds the exactness bound in level_chunk
         self._w_max = max((float(c.max()) for c in w_cols if c.size),
                           default=1.0)

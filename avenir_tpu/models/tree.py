@@ -682,9 +682,15 @@ class TreeBuilder:
     # ---- the full build loop ----
     def build(self, max_levels: Optional[int] = None) -> DecisionPathList:
         p = self.params
-        weights_np = sampling_weights(self.n_padded, p, self.rng)
+        # draw over the TRUE row count, pad with zeros: the RNG stream (and
+        # therefore the model bytes) must depend on the data only, never on
+        # how many pad rows the mesh size added
+        weights_np = sampling_weights(self.n_rows, p, self.rng)
         if weights_np is None:
-            weights_np = np.ones((self.n_padded,), dtype=np.float32)
+            weights_np = np.ones((self.n_rows,), dtype=np.float32)
+        weights_np = np.pad(weights_np,
+                            (0, self.n_padded - self.n_rows)
+                            ).astype(np.float32)
         weights_np *= self.mask_np
         self._w_max = float(weights_np.max()) if weights_np.size else 1.0
         self._w_integral = True  # sampling_weights are counts/keeps/ones

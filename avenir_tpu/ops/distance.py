@@ -146,7 +146,15 @@ class DistanceComputer:
         through ``pairwise``; here it is ~170 MB per in-flight tile).
 
         Returns (distances (n_test, k) int32, train indices (n_test, k)
-        int32), rows sorted nearest-first, ties to the lowest train index."""
+        int32), rows sorted nearest-first, ties to the lowest train index.
+
+        Multi-device: the test axis is embarrassingly parallel (every kernel
+        is per-test-row), so when the runtime mesh has >1 device each test
+        chunk is row-sharded over it with the train tiles replicated — GSPMD
+        fans the distance + running-top-k work across the data axis with no
+        cross-device traffic until the final gather.  Chunks not divisible
+        by the device count fall back to single-device placement."""
+        from ..parallel.mesh import runtime_context
         tn, toh = self.encode(test)
         rn, roh = self.encode(train)
         n_test, n_train = tn.shape[0], rn.shape[0]
@@ -154,7 +162,13 @@ class DistanceComputer:
         merge = _topk_merge_kernel(k)
         # keep each (test_chunk, train_tile) tile around 2^27 f32 elements
         train_tile = max(1024, min(train_tile, (1 << 27) // max(test_chunk, 1)))
-        rn_d, roh_d = jnp.asarray(rn), jnp.asarray(roh)
+        ctx = runtime_context()
+        mesh_on = ctx.n_devices > 1
+        if mesh_on:
+            rn_d = jax.device_put(jnp.asarray(rn), ctx.replicated_sharding())
+            roh_d = jax.device_put(jnp.asarray(roh), ctx.replicated_sharding())
+        else:
+            rn_d, roh_d = jnp.asarray(rn), jnp.asarray(roh)
         if self.metric == "euclidean":
             dist_fn = self._euclid_jit
         elif self.metric == "manhattan":
@@ -165,9 +179,14 @@ class DistanceComputer:
         out_i: List[np.ndarray] = []
         for ts in range(0, n_test, test_chunk):
             te = min(ts + test_chunk, n_test)
-            tn_c, toh_c = jnp.asarray(tn[ts:te]), jnp.asarray(toh[ts:te])
-            best_d = jnp.full((te - ts, k), np.inf, dtype=jnp.float32)
-            best_i = jnp.full((te - ts, k), -1, dtype=jnp.int32)
+            if mesh_on and (te - ts) % ctx.n_devices == 0:
+                put = lambda a: jax.device_put(a, ctx.row_sharding())
+            else:
+                put = lambda a: a
+            tn_c = put(jnp.asarray(tn[ts:te]))
+            toh_c = put(jnp.asarray(toh[ts:te]))
+            best_d = put(jnp.full((te - ts, k), np.inf, dtype=jnp.float32))
+            best_i = put(jnp.full((te - ts, k), -1, dtype=jnp.int32))
             for s in range(0, n_train, train_tile):
                 e = min(s + train_tile, n_train)
                 if dist_fn is not None:
