@@ -1,0 +1,219 @@
+"""Minimal Redis-protocol (RESP2) queue transport for the serving loop.
+
+The reference's online RL rides Redis lists as queues: the Storm spout
+``rpop``s the event and reward queues and the action writer ``lpush``es
+``<eventID>,<action...>`` lines (storm/RedisSpout.java:30-95,
+RedisActionWriter.java:47-61).  This module provides both halves of that
+contract with no external dependency:
+
+  * :class:`RespServer` — a threaded TCP server speaking the RESP2 subset
+    the queue contract needs (LPUSH, RPOP, LLEN, DEL, PING), backed by
+    in-memory deques.  A real ``redis-cli``/client library can talk to it.
+  * :class:`RespClient` — a blocking client usable against this server OR
+    a real Redis instance (the wire format is the same), exposing exactly
+    the three verbs the reference uses.
+
+Security note: like stock Redis, there is no auth — bind to loopback
+(the default) or a trusted network only.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import threading
+from collections import deque
+from typing import Dict, List, Optional
+
+
+# ---------------------------------------------------------------------------
+# wire format
+# ---------------------------------------------------------------------------
+
+def _encode_command(args: List[str]) -> bytes:
+    """Client -> server: RESP array of bulk strings."""
+    out = [f"*{len(args)}\r\n".encode()]
+    for a in args:
+        b = a.encode()
+        out.append(b"$%d\r\n%s\r\n" % (len(b), b))
+    return b"".join(out)
+
+
+def _read_line(rf) -> bytes:
+    line = rf.readline()
+    if not line:
+        raise ConnectionError("peer closed")
+    return line.rstrip(b"\r\n")
+
+
+def _read_reply(rf):
+    """Parse one RESP reply: +simple, -error, :int, $bulk (None for -1),
+    *array."""
+    line = _read_line(rf)
+    kind, rest = line[:1], line[1:]
+    if kind == b"+":
+        return rest.decode()
+    if kind == b"-":
+        raise RuntimeError(f"server error: {rest.decode()}")
+    if kind == b":":
+        return int(rest)
+    if kind == b"$":
+        n = int(rest)
+        if n == -1:
+            return None
+        body = rf.read(n + 2)[:n]
+        return body.decode()
+    if kind == b"*":
+        n = int(rest)
+        if n == -1:
+            return None
+        return [_read_reply(rf) for _ in range(n)]
+    raise RuntimeError(f"unparseable reply {line!r}")
+
+
+def _read_command(rf) -> Optional[List[str]]:
+    """Server side: one client command (RESP array of bulk strings, plus
+    the inline fallback real Redis also accepts)."""
+    line = rf.readline()
+    if not line:
+        return None
+    line = line.rstrip(b"\r\n")
+    if not line:
+        return []
+    if line[:1] == b"*":
+        n = int(line[1:])
+        args = []
+        for _ in range(n):
+            hdr = _read_line(rf)
+            if hdr[:1] != b"$":
+                raise RuntimeError(f"expected bulk string, got {hdr!r}")
+            ln = int(hdr[1:])
+            args.append(rf.read(ln + 2)[:ln].decode())
+        return args
+    return line.decode().split()  # inline command
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        srv: "RespServer" = self.server.owner  # type: ignore[attr-defined]
+        while True:
+            try:
+                args = _read_command(self.rfile)
+            except (ConnectionError, ValueError, RuntimeError):
+                return
+            if args is None:
+                return
+            if not args:
+                continue
+            self.wfile.write(srv.dispatch(args))
+            self.wfile.flush()
+
+
+class _TCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class RespServer:
+    """In-memory Redis-list queue server.  ``start()`` binds and serves on
+    a daemon thread; ``port`` is resolved after start (pass 0 for an
+    ephemeral port)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host, self.port = host, port
+        self._queues: Dict[str, deque] = {}
+        self._lock = threading.Lock()
+        self._server: Optional[_TCPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- command dispatch (the RESP subset the queue contract uses) ----
+    def dispatch(self, args: List[str]) -> bytes:
+        cmd = args[0].upper()
+        try:
+            if cmd == "PING":
+                return b"+PONG\r\n"
+            if cmd == "LPUSH":
+                with self._lock:
+                    q = self._queues.setdefault(args[1], deque())
+                    for v in args[2:]:
+                        q.appendleft(v)
+                    return b":%d\r\n" % len(q)
+            if cmd == "RPOP":
+                with self._lock:
+                    q = self._queues.get(args[1])
+                    if not q:
+                        return b"$-1\r\n"
+                    v = q.pop().encode()
+                    if not q:
+                        del self._queues[args[1]]  # Redis drops empty lists
+                return b"$%d\r\n%s\r\n" % (len(v), v)
+            if cmd == "LLEN":
+                with self._lock:
+                    return b":%d\r\n" % len(self._queues.get(args[1], ()))
+            if cmd == "DEL":
+                with self._lock:
+                    n = sum(1 for k in args[1:] if self._queues.pop(k, None)
+                            is not None)
+                return b":%d\r\n" % n
+            return b"-ERR unknown command '%s'\r\n" % cmd.encode()
+        except IndexError:
+            return b"-ERR wrong number of arguments\r\n"
+
+    def start(self) -> "RespServer":
+        self._server = _TCPServer((self.host, self.port), _Handler)
+        self._server.owner = self  # type: ignore[attr-defined]
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+
+class RespClient:
+    """Blocking client for the three verbs the reference uses.  Works
+    against :class:`RespServer` or a real Redis."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 6379,
+                 timeout: float = 10.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._rf = self._sock.makefile("rb")
+
+    def _call(self, *args: str):
+        self._sock.sendall(_encode_command(list(args)))
+        return _read_reply(self._rf)
+
+    def ping(self) -> bool:
+        return self._call("PING") == "PONG"
+
+    def lpush(self, queue: str, value: str) -> int:
+        return int(self._call("LPUSH", queue, value))
+
+    def rpop(self, queue: str) -> Optional[str]:
+        return self._call("RPOP", queue)
+
+    def llen(self, queue: str) -> int:
+        return int(self._call("LLEN", queue))
+
+    def delete(self, *queues: str) -> int:
+        return int(self._call("DEL", *queues))
+
+    def close(self) -> None:
+        try:
+            self._rf.close()
+            self._sock.close()
+        except OSError:
+            pass

@@ -5,9 +5,17 @@ Parity target (SURVEY.md §2.6, §3.5): storm/ReinforcementLearnerTopology
 and reward messages from Redis queues into a bolt wrapping any factory
 learner, actions written back to an action queue.
 
-Here the queues are in-process (queue.Queue) with the same message
-semantics; swap them for any transport (the reference's Redis contract is
-just strings).  Message formats:
+Two transports share the same message semantics:
+  * in-process queue.Queue (ReinforcementLearnerService.start) — unit
+    tests and single-process demos;
+  * the wire (RedisServingLoop): Redis-list queues polled exactly like
+    the reference spout (``rpop`` event/reward queues, actions
+    ``lpush``ed — RedisSpout.java:83-95, RedisActionWriter.java:47-61),
+    against io/respq.RespServer or a real Redis, with the reference's
+    config keys (redis.server.host/port, redis.event.queue,
+    redis.reward.queue, redis.action.queue).
+
+Message formats:
   event:  'round,<roundNum>'  -> respond with next_actions on action queue
   reward: 'reward,<action>,<value>' -> learner.set_reward
 Processing is synchronous per message like the bolt's execute()."""
@@ -105,3 +113,69 @@ class VectorLearnerService:
             self.bandits.set_rewards(g, a, r)
             return None
         raise ValueError(f"unknown message type {parts[0]!r}")
+
+
+class RedisServingLoop:
+    """The Storm topology over the wire: poll the event and reward queues
+    (``rpop``, event queue first like RedisSpout.nextSpoutMessage), feed
+    each message through the wrapped service's bolt-execute, and ``lpush``
+    action responses — the reference's RedisSpout/RedisActionWriter
+    contract against io/respq.RespServer or a real Redis.
+
+    ``config`` uses the reference key names: redis.server.host,
+    redis.server.port, redis.event.queue, redis.reward.queue,
+    redis.action.queue.  A literal 'stop' message on the event queue ends
+    :meth:`run` (transport-level control, not part of the bolt contract).
+    """
+
+    def __init__(self, service, config: Optional[Dict] = None):
+        from ..io.respq import RespClient
+        cfg = dict(config or {})
+        self.service = service
+        self.client = RespClient(cfg.get("redis.server.host", "127.0.0.1"),
+                                 int(cfg.get("redis.server.port", 6379)))
+        self.event_q = cfg.get("redis.event.queue", "eventQueue")
+        self.reward_q = cfg.get("redis.reward.queue", "rewardQueue")
+        self.action_q = cfg.get("redis.action.queue", "actionQueue")
+        self.stopped = False
+
+    def poll_once(self) -> bool:
+        """One spout pass; returns True if a message was processed."""
+        msg = self.client.rpop(self.event_q)
+        if msg is not None:
+            if msg == "stop":
+                # drain queued rewards first: the client pushes its final
+                # rewards before 'stop', and dropping them would silently
+                # lose learner updates on every shutdown
+                while True:
+                    r = self.client.rpop(self.reward_q)
+                    if r is None:
+                        break
+                    self.service.process(r)
+                self.stopped = True
+                return True
+            out = self.service.process(msg)
+            if out is not None:
+                self.client.lpush(self.action_q, out)
+            return True
+        msg = self.client.rpop(self.reward_q)
+        if msg is not None:
+            self.service.process(msg)
+            return True
+        return False
+
+    def run(self, max_idle_s: float = 30.0, idle_sleep_s: float = 0.005
+            ) -> None:
+        """Poll until a 'stop' message or ``max_idle_s`` without traffic."""
+        import time
+        idle_since = time.monotonic()
+        while not self.stopped:
+            if self.poll_once():
+                idle_since = time.monotonic()
+            elif time.monotonic() - idle_since > max_idle_s:
+                break
+            else:
+                time.sleep(idle_sleep_s)
+
+    def close(self) -> None:
+        self.client.close()

@@ -2,19 +2,30 @@
 """Real-time lead-generation serving demo — the rebuilt counterpart of
 the reference's Storm topology walkthrough
 (boost_lead_generation_tutorial.txt: ReinforcementLearnerTopology fed by
-Redis event/reward queues).  The in-process queues carry the exact same
-message strings; swap them for any transport.
+Redis event/reward queues).
 
-A simulated session: each round an event message asks the service for
-the next sales channel to try on a lead; a hidden per-channel conversion
-rate pays rewards back through the reward queue.  The learner converges
-onto the best channel while serving.
+Modes:
+  serve    — single process, in-process queues (the original demo)
+  learner  — the serving side: embedded RESP queue server
+             (avenir_tpu/io/respq.py) + RedisServingLoop polling the
+             event/reward queues and pushing actions, exactly the
+             reference's RedisSpout/RedisActionWriter contract
+  client   — the environment side: pushes 'round,<n>' events, pops
+             actions, pays rewards from hidden conversion rates
+  wire     — spawns learner and client as two separate OS processes and
+             reports the learner's converged favourite (the two-process
+             proof of the transport)
 
-Usage: python rtserve.py [rtserve.properties]
+Usage: python rtserve.py [serve|learner|client|wire] [rtserve.properties]
+       python rtserve.py client <properties> <port>   # port printed by
+                                                      # the learner's
+                                                      # LEARNER_READY line
 """
 
 import os
+import subprocess
 import sys
+import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
@@ -24,25 +35,44 @@ force_platform()
 import numpy as np                                     # noqa: E402
 
 from avenir_tpu.core.config import load_config         # noqa: E402
-from avenir_tpu.reinforce.serving import ReinforcementLearnerService  # noqa: E402
+from avenir_tpu.reinforce.serving import (             # noqa: E402
+    RedisServingLoop, ReinforcementLearnerService)
 
 
-def main(conf_path: str) -> int:
+def _load(conf_path):
     cfg = load_config(conf_path)
     actions = cfg.must_get_list("rls.action.list")
-    algorithm = cfg.get("rls.algorithm", "sampsonSampler")
+    return cfg, actions
+
+
+def _service(cfg, actions):
+    return ReinforcementLearnerService(
+        cfg.get("rls.algorithm", "sampsonSampler"), actions,
+        config={"current.decision.round": 1, "batch.size": 1,
+                "random.seed": cfg.get_int("rls.random.seed", 1)})
+
+
+def _redis_cfg(cfg, port=None):
+    out = {
+        "redis.server.host": cfg.get("redis.server.host", "127.0.0.1"),
+        "redis.server.port": port if port is not None
+        else cfg.get_int("redis.server.port", 6379),
+        "redis.event.queue": cfg.get("redis.event.queue", "eventQueue"),
+        "redis.reward.queue": cfg.get("redis.reward.queue", "rewardQueue"),
+        "redis.action.queue": cfg.get("redis.action.queue", "actionQueue"),
+    }
+    return out
+
+
+def mode_serve(conf_path: str) -> int:
+    cfg, actions = _load(conf_path)
     n_rounds = cfg.get_int("rls.num.rounds", 2000)
     seed = cfg.get_int("rls.random.seed", 1)
     rng = np.random.default_rng(seed)
-    # hidden conversion rates: one strong channel, the rest weak
     best = int(rng.integers(len(actions)))
     rates = {a: (0.30 if i == best else 0.08)
              for i, a in enumerate(actions)}
-
-    svc = ReinforcementLearnerService(
-        algorithm, actions,
-        config={"current.decision.round": 1, "batch.size": 1,
-                "random.seed": seed})
+    svc = _service(cfg, actions)
     picks: dict = {}
     conversions = 0
     for rnd in range(1, n_rounds + 1):
@@ -61,7 +91,118 @@ def main(conf_path: str) -> int:
     return 0 if top == actions[best] else 1
 
 
+def mode_learner(conf_path: str) -> int:
+    """Serving process: embedded RESP queue server + the wire loop.
+    Prints 'LEARNER_READY <port>' once the server accepts connections."""
+    from avenir_tpu.io.respq import RespServer
+    cfg, actions = _load(conf_path)
+    embedded = cfg.get_boolean("redis.embedded", True)
+    port = cfg.get_int("redis.server.port", 0 if embedded else 6379)
+    server = None
+    if embedded:
+        server = RespServer(port=port).start()
+        port = server.port
+    loop = RedisServingLoop(_service(cfg, actions), _redis_cfg(cfg, port))
+    print(f"LEARNER_READY {port}", flush=True)
+    loop.run(max_idle_s=cfg.get_float("rls.max.idle.sec", 30.0))
+    loop.close()
+    if server is not None:
+        server.stop()
+    print("LEARNER_DONE", flush=True)
+    return 0
+
+
+def mode_client(conf_path: str, port=None) -> int:
+    """Environment process: drives rounds + rewards over the wire."""
+    from avenir_tpu.io.respq import RespClient
+    cfg, actions = _load(conf_path)
+    rc = _redis_cfg(cfg, port)
+    n_rounds = cfg.get_int("rls.num.rounds", 2000)
+    seed = cfg.get_int("rls.random.seed", 1)
+    rng = np.random.default_rng(seed)
+    best = int(rng.integers(len(actions)))
+    rates = {a: (0.30 if i == best else 0.08)
+             for i, a in enumerate(actions)}
+    cli = RespClient(rc["redis.server.host"], int(rc["redis.server.port"]))
+    picks: dict = {}
+    conversions = 0
+    for rnd in range(1, n_rounds + 1):
+        cli.lpush(rc["redis.event.queue"], f"round,{rnd}")
+        deadline = time.monotonic() + 10.0
+        out = None
+        while out is None and time.monotonic() < deadline:
+            out = cli.rpop(rc["redis.action.queue"])
+            if out is None:
+                time.sleep(0.0005)
+        assert out is not None, f"no action for round {rnd}"
+        action = out.split(",")[1]
+        picks[action] = picks.get(action, 0) + 1
+        reward = float(rng.random() < rates[action])
+        conversions += int(reward)
+        cli.lpush(rc["redis.reward.queue"], f"reward,{action},{reward}")
+    cli.lpush(rc["redis.event.queue"], "stop")
+    for a in actions:
+        print(f"channel {a} served {picks.get(a, 0)} "
+              f"({100.0 * picks.get(a, 0) / n_rounds:.0f}%)")
+    top = max(picks, key=picks.get)
+    print(f"best channel {actions[best]} learner favourite {top} "
+          f"conversions {conversions}/{n_rounds}")
+    cli.close()
+    return 0 if top == actions[best] else 1
+
+
+def mode_wire(conf_path: str) -> int:
+    """Two OS processes: learner (embedded queue server) + client."""
+    here = os.path.abspath(__file__)
+    learner = subprocess.Popen(
+        [sys.executable, here, "learner", conf_path],
+        stdout=subprocess.PIPE, text=True)
+    try:
+        line = learner.stdout.readline().strip()
+        assert line.startswith("LEARNER_READY"), line
+        port = int(line.split()[1])
+        rc = mode_client_with_port(conf_path, port)
+        learner.wait(timeout=30)
+        return rc
+    finally:
+        if learner.poll() is None:
+            learner.terminate()
+
+
+def mode_client_with_port(conf_path: str, port: int) -> int:
+    return mode_client(conf_path, port=port)
+
+
+def main(argv) -> int:
+    # original single-argument usage: rtserve.py [<properties>] == serve
+    if argv and argv[0].endswith(".properties"):
+        argv = ["serve"] + argv
+    mode = argv[0] if argv else "serve"
+    conf = (argv[1] if len(argv) > 1 else
+            os.path.join(os.path.dirname(__file__), "rtserve.properties"))
+    if mode == "serve":
+        return mode_serve(conf)
+    if mode == "learner":
+        return mode_learner(conf)
+    if mode == "client":
+        # standalone client needs the learner's actual port: the shipped
+        # properties use an ephemeral port (0), printed by the learner as
+        # 'LEARNER_READY <port>' — pass it as the third argument
+        port = int(argv[2]) if len(argv) > 2 else None
+        cfg = load_config(conf)
+        if port is None and cfg.get_int("redis.server.port", 0) == 0:
+            print("client mode needs the learner's port: "
+                  "rtserve.py client <properties> <port> (see the "
+                  "learner's LEARNER_READY line), or set a fixed "
+                  "redis.server.port", file=sys.stderr)
+            return 2
+        return mode_client(conf, port=port)
+    if mode == "wire":
+        return mode_wire(conf)
+    print(f"unknown mode {mode!r}; use serve|learner|client|wire",
+          file=sys.stderr)
+    return 2
+
+
 if __name__ == "__main__":
-    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else
-                  os.path.join(os.path.dirname(__file__),
-                               "rtserve.properties")))
+    sys.exit(main(sys.argv[1:]))

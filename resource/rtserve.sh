@@ -1,13 +1,20 @@
 #!/bin/bash
 # Real-time serving demo driver (see rtserve.py).
-#   ./rtserve.sh serve
+#   ./rtserve.sh serve      # single process, in-process queues
+#   ./rtserve.sh wire       # two processes over the RESP wire transport
+#   ./rtserve.sh learner    # serving side only (embedded queue server)
+#   ./rtserve.sh client     # environment side only (needs a learner)
 set -e
 DIR=$(cd "$(dirname "$0")" && pwd)
 
 case "$1" in
-serve)
-  python "$DIR/rtserve.py" "$DIR/rtserve.properties"
+client)
+  # $2 = the learner's port (printed as LEARNER_READY <port>)
+  python "$DIR/rtserve.py" client "$DIR/rtserve.properties" "${2:?pass the learner port}"
+  ;;
+serve|learner|wire)
+  python "$DIR/rtserve.py" "$1" "$DIR/rtserve.properties"
   ;;
 *)
-  echo "usage: $0 serve" >&2; exit 2 ;;
+  echo "usage: $0 serve|learner|wire|client <port>" >&2; exit 2 ;;
 esac

@@ -1,0 +1,98 @@
+"""RESP queue transport + wire serving loop (the reference's Redis
+contract: RedisSpout.java rpop polling, RedisActionWriter.java lpush)."""
+
+import os
+import subprocess
+import sys
+
+from avenir_tpu.io.respq import RespClient, RespServer
+from avenir_tpu.reinforce.serving import (RedisServingLoop,
+                                          ReinforcementLearnerService)
+
+RES = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "resource"))
+
+
+def test_resp_roundtrip():
+    server = RespServer().start()
+    try:
+        cli = RespClient(port=server.port)
+        assert cli.ping()
+        assert cli.rpop("q") is None                 # nil on empty
+        assert cli.lpush("q", "a") == 1
+        assert cli.lpush("q", "b") == 2
+        assert cli.llen("q") == 2
+        assert cli.rpop("q") == "a"                  # list as FIFO queue
+        assert cli.rpop("q") == "b"
+        assert cli.rpop("q") is None
+        cli.lpush("q", "x,y,z")                      # payload with commas
+        assert cli.rpop("q") == "x,y,z"
+        assert cli.delete("q") == 0                  # already empty=absent?
+        cli.lpush("q", "v")
+        assert cli.delete("q") == 1
+        cli.close()
+        # a second client sees the same queues (shared server state)
+        c2 = RespClient(port=server.port)
+        c2.lpush("shared", "1")
+        c3 = RespClient(port=server.port)
+        assert c3.rpop("shared") == "1"
+        c2.close()
+        c3.close()
+    finally:
+        server.stop()
+
+
+def test_wire_serving_loop_in_process():
+    """RedisServingLoop polls the queues with the reference's verbs and
+    the learner converges just like the in-process loop."""
+    server = RespServer().start()
+    try:
+        cfg = {"redis.server.port": server.port}
+        svc = ReinforcementLearnerService(
+            "randomGreedy", ["a", "b"],
+            config={"current.decision.round": 1, "batch.size": 1,
+                    "random.seed": 3})
+        loop = RedisServingLoop(svc, cfg)
+        env = RespClient(port=server.port)
+        for rnd in range(1, 60):
+            env.lpush("eventQueue", f"round,{rnd}")
+            assert loop.poll_once()                  # event -> action
+            out = env.rpop("actionQueue")
+            assert out is not None and out.split(",")[0] == str(rnd)
+            action = out.split(",")[1]
+            env.lpush("rewardQueue",
+                      f"reward,{action},{1.0 if action == 'b' else 0.0}")
+            assert loop.poll_once()                  # reward consumed
+        # final rewards queued BEFORE 'stop' must still reach the learner
+        # (the stop handler drains the reward queue first)
+        env.lpush("rewardQueue", "reward,b,1.0")
+        env.lpush("rewardQueue", "reward,a,0.0")
+        env.lpush("eventQueue", "stop")
+        loop.run(max_idle_s=1.0)
+        assert loop.stopped
+        assert env.llen("rewardQueue") == 0, "stop dropped queued rewards"
+        loop.close()
+        env.close()
+    finally:
+        server.stop()
+
+
+def test_two_process_wire_demo(tmp_path):
+    """The full two-OS-process demo: learner (embedded RESP server) and
+    client exchange the reference message formats over TCP and the
+    learner's favourite action wins."""
+    props = tmp_path / "rt.properties"
+    props.write_text(
+        "rls.algorithm=sampsonSampler\n"
+        "rls.action.list=coldCall,emailDrip,webinarInvite,demoOffer\n"
+        "rls.num.rounds=300\n"
+        "rls.random.seed=1\n"
+        "redis.embedded=true\n"
+        "redis.server.port=0\n")
+    env = dict(os.environ, AVENIR_TPU_PLATFORM="cpu", JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(RES, "rtserve.py"), "wire",
+         str(props)],
+        capture_output=True, text=True, timeout=180, env=env)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "learner favourite" in out.stdout
