@@ -173,7 +173,15 @@ class NaiveBayesModel:
 
 @functools.partial(jax.jit, static_argnums=(4, 5))
 def _train_kernel(cc, bc, cv, m, C, bmax):
-    """Module-level jit: the per-call closure recompiled on every train."""
+    """Module-level jit: the per-call closure recompiled on every train.
+
+    ``cc``/``bc`` may arrive uint8 (the narrow wire form — the host->device
+    link is the e2e bottleneck at scale); the upcast to int32 happens here
+    on device.  Sentinel 255 (unknown/out-of-range, see train()) stays out
+    of every one-hot range, contributing zero exactly like the wide form's
+    negative codes."""
+    cc = cc.astype(jnp.int32)
+    bc = bc.astype(jnp.int32)
     counts = class_bin_histogram(cc, bc, C, bmax, m)
     cls_counts = jax.nn.one_hot(cc, C, dtype=jnp.float32)
     cls_counts = (cls_counts * m.astype(jnp.float32)[:, None]).sum(axis=0)
@@ -182,14 +190,30 @@ def _train_kernel(cc, bc, cv, m, C, bmax):
 
 
 def train(table: ColumnarTable, ctx: Optional[MeshContext] = None,
-          counters: Optional[Counters] = None) -> NaiveBayesModel:
+          counters: Optional[Counters] = None,
+          chunk_rows: int = 1 << 23) -> NaiveBayesModel:
     """One-pass distribution computation (== BayesianDistribution MR job).
 
     Rows are padded to the mesh size and sharded over the data axis; the
     histogram/moment contractions reduce over rows, so GSPMD emits per-shard
     partials + all-reduce — the exact combiner+shuffle structure of the
-    reference job, in one XLA program.
-    """
+    reference job, in one XLA program per chunk.
+
+    Rows stream to the device in ``chunk_rows`` slices (tail padded to the
+    one compiled shape, masked out).  This keeps the 100M-row north star
+    inside two ceilings the single-launch form breaks: the (n, F, B)
+    one-hot intermediate would exceed HBM past ~50M rows, and f32 count
+    accumulation loses integer exactness past 2^24 per cell — per-chunk
+    counts stay below 2^24 and the cross-chunk accumulation is host
+    float64 (exact to 2^53).  Continuous-moment sums remain f32
+    tree-reductions within a chunk (the reference accumulates in long;
+    divergence is bounded by f32 rounding on ~8M-term sums and erased by
+    the floor-to-int model serialization in all tested configs).
+
+    Multi-process: the chunk schedule is agreed across the pod (max local
+    row count), so unequal per-process shards are handled CORRECTLY —
+    shorter shards pad masked-out rows instead of tripping
+    from_process_local's equal-shape guard."""
     ctx = ctx or runtime_context()
     schema = table.schema
     class_field = schema.class_attr_field
@@ -201,24 +225,72 @@ def train(table: ColumnarTable, ctx: Optional[MeshContext] = None,
     bmax = max(nbins) if nbins else 1
 
     padded = table.pad_to_multiple(ctx.n_devices)
-    mask = ctx.shard_rows(padded.valid_mask)
-    cls_codes = ctx.shard_rows(padded.columns[class_field.ordinal])
+    n = padded.n_rows
+
+    def narrow(codes, alphabet):
+        """uint8 wire form when the alphabet fits: 4x less host->device
+        upload (the tunnel link is the 100M-row e2e bottleneck).  Codes
+        outside [0, alphabet) — unknown (-1) or out-of-range — map to the
+        255 sentinel, which the kernel's one-hots drop exactly like the
+        wide form's out-of-range values."""
+        codes = np.asarray(codes)
+        if alphabet <= 255:
+            return np.where((codes >= 0) & (codes < alphabet),
+                            codes, 255).astype(np.uint8)
+        return codes.astype(np.int32)
+
+    cls_host = narrow(padded.columns[class_field.ordinal], C)
     if binned:
-        bin_codes = np.stack([padded.binned_codes(f.ordinal) for f in binned], axis=1)
+        bin_host = narrow(np.stack(
+            [padded.binned_codes(f.ordinal) for f in binned], axis=1), bmax)
     else:
-        bin_codes = np.zeros((padded.n_rows, 0), dtype=np.int32)
-    bin_codes = ctx.shard_rows(bin_codes)
+        bin_host = np.zeros((n, 0), dtype=np.int32)
     if cont:
         # reference parses continuous values as integers (long)
-        cont_vals = np.stack(
-            [np.trunc(padded.columns[f.ordinal]) for f in cont], axis=1)
+        cont_host = np.stack(
+            [np.trunc(padded.columns[f.ordinal]) for f in cont],
+            axis=1).astype(np.float32)
     else:
-        cont_vals = np.zeros((padded.n_rows, 0), dtype=np.float64)
-    cont_vals = ctx.shard_rows(cont_vals.astype(np.float32))
+        cont_host = np.zeros((n, 0), dtype=np.float32)
+    mask_host = padded.valid_mask
 
-    counts, cls_counts, moments = (
-        np.array(x) for x in _train_kernel(cls_codes, bin_codes, cont_vals,
-                                           mask, C, bmax))
+    # chunk-count agreement: every iteration is a collective, so all
+    # processes must run the SAME number of identically-shaped chunks even
+    # with unequal local shards — the schedule covers the pod-wide MAX
+    # local row count and shorter shards pad (mask False).  This also
+    # upgrades unequal per-process shards from an error to a correct
+    # masked computation.  Single-process: one launch for small inputs.
+    from ..parallel.distributed import allgather_object, is_multiprocess
+    n_goal = max(allgather_object(n)) if is_multiprocess() else n
+    align = ctx.n_devices
+    # max(..., align) keeps chunk > 0 for an empty table (zero iterations
+    # -> the zero-count model, matching the old single-launch behavior)
+    chunk = max(align,
+                min(max(chunk_rows - chunk_rows % align, align),
+                    n_goal + (-n_goal) % align))
+    Fb, Fc = bin_host.shape[1], cont_host.shape[1]
+    counts = np.zeros((C, Fb, bmax), dtype=np.float64)
+    cls_counts = np.zeros((C,), dtype=np.float64)
+    moments = np.zeros((C, Fc, 3), dtype=np.float64)
+    for s in range(0, n_goal, chunk):
+        e = min(s + chunk, n)
+        lo = min(s, n)
+        cc, bc = cls_host[lo:e], bin_host[lo:e]
+        cv, mm = cont_host[lo:e], mask_host[lo:e]
+        if e - lo < chunk:
+            # tail (or past-local-end) padded to the ONE compiled chunk
+            # shape, masked out
+            pad = chunk - (e - lo)
+            cc = np.pad(cc, (0, pad))
+            bc = np.pad(bc, ((0, pad), (0, 0)))
+            cv = np.pad(cv, ((0, pad), (0, 0)))
+            mm = np.pad(mm, (0, pad))
+        c_, cl_, mo_ = _train_kernel(
+            ctx.shard_rows(cc), ctx.shard_rows(bc), ctx.shard_rows(cv),
+            ctx.shard_rows(mm), C, bmax)
+        counts += np.asarray(c_, dtype=np.float64)
+        cls_counts += np.asarray(cl_, dtype=np.float64)
+        moments += np.asarray(mo_, dtype=np.float64)
 
     # zero out bins beyond each field's alphabet (padding of Bmax)
     for fi, nb in enumerate(nbins):

@@ -167,3 +167,18 @@ def test_predict_far_out_of_range_value_skips_feature(data, mesh_ctx):
     # sanity: an in-alphabet value actually changes the outputs
     res_unk = bayes.predict(m, encode_rows(unk, SCHEMA))
     assert not np.array_equal(res_far.class_probs, res_unk.class_probs)
+
+
+def test_train_chunked_equals_single_launch(mesh_ctx):
+    """Chunked streaming train (the 100M-row wire form: uint8 codes, tail
+    padded to one compiled shape, host f64 accumulation) must produce the
+    IDENTICAL model to a single-launch train."""
+    rng = np.random.default_rng(9)
+    table = encode_rows(make_rows(rng, 4321), SCHEMA)
+    full = bayes.train(table, mesh_ctx)
+    small = bayes.train(table, mesh_ctx, chunk_rows=512)
+    np.testing.assert_array_equal(full.post_counts, small.post_counts)
+    np.testing.assert_array_equal(full.class_counts, small.class_counts)
+    np.testing.assert_array_equal(full.cont_post_mean, small.cont_post_mean)
+    np.testing.assert_array_equal(full.cont_post_std, small.cont_post_std)
+    assert full.to_lines() == small.to_lines()
