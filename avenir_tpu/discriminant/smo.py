@@ -208,8 +208,41 @@ def predict(model: SVMModel, X: np.ndarray) -> np.ndarray:
     return np.where(decision_function(model, X) >= 0, 1.0, -1.0)
 
 
+def _train_one(params: SMOParams, X: np.ndarray, y: np.ndarray) -> SVMModel:
+    return SMOTrainer(params).train(X, y)
+
+
 def train_groups(groups: Dict[str, Tuple[np.ndarray, np.ndarray]],
-                 params: SMOParams) -> Dict[str, SVMModel]:
-    """Per-group SVMs (the reference's per-mapper partitions)."""
-    return {g: SMOTrainer(params).train(X, y)
-            for g, (X, y) in groups.items()}
+                 params: SMOParams,
+                 workers: int = 0) -> Dict[str, SVMModel]:
+    """Per-group SVMs — the reference's per-mapper partitions
+    (SupportVectorMachine.java:70-85), whose parallelism is PROCESS-level:
+    Platt's heuristics make each group's loop inherently sequential (the
+    second-choice pick and random fallbacks depend on the evolving error
+    cache), so the scaling axis is many groups at once, not a vectorized
+    step.
+
+    ``workers`` > 1 trains groups in a spawn-mode process pool (fork after
+    XLA backend init can deadlock); groups are independent and per-group
+    seeding is unchanged, so results are bit-identical to the serial loop
+    in any worker count.
+
+    Measured bound (CPU host, 100 groups x 200 rows x 6 features, C=1.0):
+    ~0.40 s/group serial (40 s total), and the 8-worker pool came out
+    0.5x — SLOWER — because this container's sitecustomize imports jax at
+    interpreter start (~2.3 s per spawned worker) and each worker re-pays
+    it.  Hence 0 = auto stays SERIAL; pass ``workers`` explicitly when
+    per-group work dwarfs worker spawn cost (thousands of rows per group,
+    or an environment with a light interpreter start)."""
+    items = list(groups.items())
+    if workers == 0:
+        workers = 1
+    if workers <= 1:
+        return {g: _train_one(params, X, y) for g, (X, y) in items}
+    import multiprocessing as mp
+    from concurrent.futures import ProcessPoolExecutor
+    with ProcessPoolExecutor(max_workers=workers,
+                             mp_context=mp.get_context("spawn")) as ex:
+        futs = {g: ex.submit(_train_one, params, X, y)
+                for g, (X, y) in items}
+        return {g: f.result() for g, f in futs.items()}

@@ -174,8 +174,9 @@ def mode_client_with_port(conf_path: str, port: int) -> int:
 
 
 def main(argv) -> int:
-    # original single-argument usage: rtserve.py [<properties>] == serve
-    if argv and argv[0].endswith(".properties"):
+    # original single-argument usage: rtserve.py [<config file>] == serve
+    if argv and argv[0] not in ("serve", "learner", "client", "wire") \
+            and os.path.isfile(argv[0]):
         argv = ["serve"] + argv
     mode = argv[0] if argv else "serve"
     conf = (argv[1] if len(argv) > 1 else

@@ -215,3 +215,23 @@ def test_fisher_large_mean_no_cancellation():
     _, pooled, dv = res.boundary(0)
     assert pooled == pytest.approx(1.0, rel=0.15)
     assert 10000.0 < dv < 10003.0
+
+
+def test_train_groups_pooled_identical():
+    """The spawn-pool path must be bit-identical to the serial loop
+    (groups are independent, per-group seeding unchanged)."""
+    import numpy as np
+    from avenir_tpu.discriminant import smo as S
+    rng = np.random.default_rng(4)
+    groups = {}
+    for g in range(3):
+        w = rng.normal(size=4)
+        X = rng.normal(size=(60, 4))
+        y = np.where(X @ w > 0, 1.0, -1.0)
+        groups[f"g{g}"] = (X, y)
+    p = S.SMOParams(penalty_factor=1.0, seed=3)
+    serial = S.train_groups(groups, p, workers=1)
+    pooled = S.train_groups(groups, p, workers=2)
+    for g in groups:
+        np.testing.assert_array_equal(serial[g].alphas, pooled[g].alphas)
+        assert serial[g].threshold == pooled[g].threshold
