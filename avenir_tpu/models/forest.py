@@ -270,9 +270,10 @@ class ForestBuilder:
                 wst = np.concatenate(
                     [wst, np.zeros((n, 1), np.uint8)], axis=1)
             packed = wst[:, 0::2] | (wst[:, 1::2] << 4)
-            weights = _unpack_weights4(ctx.shard_rows(packed))[:, :T]
+            weights = _unpack_weights4(
+                ctx.shard_rows_streamed(packed))[:, :T]
         else:
-            weights = ctx.shard_rows(wst)
+            weights = ctx.shard_rows_streamed(wst)
         node_ids = ctx.zeros_rows((n, T), np.int32)
         S, B, C = base.split_set.n_splits, base.split_set.max_branches, base.C
         count_k = _jitted_forest_count_kernel(S, B, C)

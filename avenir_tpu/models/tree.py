@@ -571,8 +571,12 @@ class TreeBuilder:
         self.n_rows = table.n_rows
         self.n_padded = padded.n_rows
         X = self.split_set.feature_matrix(padded)
-        self.X = self.ctx.shard_rows(X)
-        self.cls_codes = self.ctx.shard_rows(
+        # streamed uploads: the deep-scale bottleneck is the host->device
+        # link, and one opaque multi-hundred-MB device_put is exactly the
+        # transfer shape that stalled the tunnel at 20M rows (TPU_NOTES
+        # section 7) — chunked transfers keep progress observable
+        self.X = self.ctx.shard_rows_streamed(X)
+        self.cls_codes = self.ctx.shard_rows_streamed(
             padded.columns[self.class_field.ordinal].astype(np.int32))
         # host copy of the padding mask: weight builders multiply by it on
         # host, so the mask never needs a device copy or round-trip

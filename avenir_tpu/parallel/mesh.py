@@ -91,6 +91,48 @@ class MeshContext:
     def replicate(self, arr) -> jax.Array:
         return jax.device_put(arr, self.replicated_sharding())
 
+    def shard_rows_streamed(self, arr, chunk_bytes: int = 64 << 20
+                            ) -> jax.Array:
+        """``shard_rows`` with the host->device transfer cut into row
+        chunks, for deep-scale uploads over the tunneled link (TPU_NOTES
+        §5, §7): each chunk is its own transfer, so a mid-upload stall is
+        visible at chunk granularity (set AVENIR_TPU_UPLOAD_PROGRESS=1 for
+        a stderr heartbeat) instead of one opaque multi-minute device_put,
+        and the watchdog pattern around a failed run re-pays at most the
+        chunks already sent.  The chunks are reassembled ON DEVICE by one
+        jitted concatenate (transient 2x memory for the array).
+
+        Small arrays and multi-process runs take the plain shard_rows
+        path (multi-host ingest must build the global array in one
+        make_array call)."""
+        arr = np.asarray(arr)
+        if (jax.process_count() > 1 or arr.ndim == 0
+                or arr.nbytes <= chunk_bytes
+                or arr.shape[0] < 2 * self.n_devices
+                or arr.shape[0] % self.n_devices != 0):
+            # same contract as shard_rows: row count is pre-padded to the
+            # mesh (ColumnarTable.pad_to_multiple)
+            return self.shard_rows(arr)
+        row_bytes = max(arr.nbytes // arr.shape[0], 1)
+        rows = max((chunk_bytes // row_bytes) // self.n_devices,
+                   1) * self.n_devices
+        import os as _os
+        import sys as _sys
+        progress = _os.environ.get("AVENIR_TPU_UPLOAD_PROGRESS") == "1"
+        parts = []
+        n = arr.shape[0]
+        for s in range(0, n, rows):
+            e = min(s + rows, n)
+            # tail chunks may not divide the mesh; ship them replicated-
+            # free via plain device_put and let the concat reshard
+            parts.append(jax.device_put(arr[s:e], self.row_sharding())
+                         if (e - s) % self.n_devices == 0
+                         else jax.device_put(arr[s:e]))
+            if progress:
+                print(f"[upload] {e}/{n} rows "
+                      f"({100 * e // n}%)", file=_sys.stderr)
+        return _concat_jit(len(parts), self.row_sharding())(parts)
+
     def zeros_rows(self, shape, dtype=np.float32) -> jax.Array:
         """Row-sharded zeros materialized ON DEVICE — no host transfer (a
         (100M, T) node-id init would otherwise ship gigabytes through the
@@ -110,6 +152,12 @@ class MeshContext:
 @functools.lru_cache(maxsize=None)
 def _zeros_jit(shape, dtype, sharding):
     return jax.jit(lambda: jnp.zeros(shape, dtype), out_shardings=sharding)
+
+
+@functools.lru_cache(maxsize=None)
+def _concat_jit(n_parts, sharding):
+    return jax.jit(lambda parts: jnp.concatenate(parts, axis=0),
+                   out_shardings=sharding)
 
 
 # ---------------------------------------------------------------------------

@@ -711,12 +711,24 @@ def main():
     if platform is None:
         print("device probe failed; skipping device attempts", file=sys.stderr)
     device_ok = platform is not None and platform != "cpu"
+    # materialize the disk fixtures OUTSIDE the watchdog children so their
+    # one-time generation cost can't eat a timed workload's budget
+    for n_rows in sorted({n for w in ("ingest", "e2e")
+                          for n in WORKLOADS[w][1]}):
+        churn_csv(n_rows)
     results, backends = {}, {}
     for name in WORKLOADS:  # dict order: nb first (the primary metric)
         if name == "rf_huge":
             continue  # deep-scale point: runs last, see below
         if name == "rf_big" and not device_ok:
             continue  # device-scale amortization point; meaningless on CPU
+        if name == "ingest":
+            # pure host work: a slow-disk timeout here says NOTHING about
+            # the device and must not down-mode the remaining workloads
+            r, _ = measure(name, {}, DEVICE_TIMEOUT_S)
+            if r is not None:
+                results[name], backends[name] = r, "host"
+            continue
         if device_ok:
             r, wedged = measure(name, {}, DEVICE_TIMEOUT_S)
             if r is not None:

@@ -64,3 +64,43 @@ bap.bayesian.model.file.path={tmp_path}/model/part-r-00000
     assert len(out) == 10
     acc = np.mean([ln.split(",")[-1] == ln.split(",")[-2] for ln in out])
     assert acc == 1.0  # training-set classification of tiny separable corpus
+
+
+def test_tokenizer_lucene_parity():
+    """Pin tokenize() against Lucene 4.4 StandardAnalyzer output
+    (StandardTokenizer UAX#29 + LowerCaseFilter + English StopFilter),
+    hand-derived per the UAX#29 rules the reference's analyzer implements
+    (BayesianDistribution.java:124-130 builds
+    StandardAnalyzer(Version.LUCENE_44)).  Each case notes the rule."""
+    from avenir_tpu.text.wordcount import tokenize
+    cases = [
+        # plain words + stop removal
+        ("The quick brown fox jumps over the lazy dog",
+         ["quick", "brown", "fox", "jumps", "over", "lazy", "dog"]),
+        # MidLetter apostrophe joins letters (WB6/WB7)
+        ("Don't split O'Neill's contraction",
+         ["don't", "split", "o'neill's", "contraction"]),
+        # hyphens break (no MidLetter rule for '-')
+        ("state-of-the-art design", ["state", "art", "design"]),
+        # MidNumLet '.' joins digits (WB11/12); ',' deliberately diverges
+        # from Lucene (delimiter-safety — see tokenize docstring)
+        ("Version 3.14 costs 1,000 dollars",
+         ["version", "3.14", "costs", "1", "000", "dollars"]),
+        # '&' breaks; 'at'/'and' are stop words
+        ("AT&T and IBM", ["t", "ibm"]),
+        # unicode letters are kept whole
+        ("Café menu", ["café", "menu"]),
+        # ExtendNumLet '_' joins alphanumerics (WB13a/WB13b)
+        ("foo_bar baz_1", ["foo_bar", "baz_1"]),
+        # MidNumLet '.' joins letters too (WB6/7): domains stay whole
+        ("e-mail support@example.com",
+         ["e", "mail", "support", "example.com"]),
+        # symbols vanish; letter+digit runs stay whole
+        ("C++ and F81 runtimes", ["c", "f81", "runtimes"]),
+        # "it's" is NOT in the 33-word English stop set (but "it" is)
+        ("it it's", ["it's"]),
+        # leading/trailing apostrophes are not joiners
+        ("'quoted' words", ["quoted", "words"]),
+    ]
+    for text, expected in cases:
+        assert tokenize(text) == expected, (text, tokenize(text), expected)
