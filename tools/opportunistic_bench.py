@@ -1,0 +1,90 @@
+"""Opportunistic device-evidence capturer (VERDICT r4 weak #2).
+
+The axon tunnel wedges for hours at a time; betting the round's artifact
+of record on one capture-time bench attempt guaranteed that a wedge at
+round end erased the round (rounds 3 and 4 both lost their device story
+this way).  This loop probes the tunnel on an interval and, the first
+time it finds the device healthy, runs the full bench — bench.emit()
+persists the results to BENCH_DEVICE_EVIDENCE.json, which a later
+wedged-at-capture-time run replays as the artifact of record.
+
+Run it in the background for the whole round:
+
+    python tools/opportunistic_bench.py [--interval 600] [--deadline 39600]
+
+Exits 0 after one successful full-bench capture, 1 at deadline with no
+healthy window (the probe log is then the proof the tunnel never came up).
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PROBE_LOG = os.path.join(HERE, "PROBE_LOG.jsonl")
+
+
+def log(entry):
+    entry = dict(entry, t=time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
+    with open(PROBE_LOG, "a") as fh:
+        fh.write(json.dumps(entry) + "\n")
+    print(json.dumps(entry), flush=True)
+
+
+def probe(timeout_s=100):
+    """bench.probe_device in a subprocess (it already watchdogs the jax
+    call in a child; the outer timeout covers import-time hangs too)."""
+    code = ("import bench, json; "
+            "print(json.dumps({'platform': bench.probe_device()}))")
+    try:
+        out = subprocess.run([sys.executable, "-c", code], cwd=HERE,
+                             capture_output=True, text=True,
+                             timeout=timeout_s + 30)
+        return json.loads(out.stdout.strip().splitlines()[-1])["platform"]
+    except Exception:
+        return None
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=int, default=600)
+    ap.add_argument("--deadline", type=int, default=11 * 3600)
+    ap.add_argument("--bench-timeout", type=int, default=3600)
+    args = ap.parse_args()
+    t0 = time.time()
+    attempt = 0
+    while time.time() - t0 < args.deadline:
+        attempt += 1
+        platform = probe()
+        healthy = platform is not None and platform != "cpu"
+        log({"event": "probe", "attempt": attempt, "platform": platform,
+             "healthy": healthy})
+        if healthy:
+            log({"event": "bench_start", "attempt": attempt})
+            try:
+                out = subprocess.run(
+                    [sys.executable, "bench.py"], cwd=HERE,
+                    capture_output=True, text=True,
+                    timeout=args.bench_timeout,
+                    env=dict(os.environ, BENCH_PROBE_RETRIES="0"))
+                line = (out.stdout.strip().splitlines() or [""])[-1]
+                log({"event": "bench_done", "rc": out.returncode,
+                     "line": line[:500]})
+                # a REPLAYED line also says backend:device — that's stale
+                # prior evidence, not a fresh capture; keep probing
+                if (out.returncode == 0 and '"backend":"device"' in line
+                        and '"replayed"' not in line):
+                    log({"event": "captured"})
+                    return 0
+            except subprocess.TimeoutExpired:
+                log({"event": "bench_timeout"})
+        time.sleep(args.interval)
+    log({"event": "deadline", "attempts": attempt})
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
