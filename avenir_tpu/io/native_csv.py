@@ -6,6 +6,14 @@ exposes :func:`native_load_csv`, the drop-in fast path behind
 ``core.table.load_csv``.  Everything degrades gracefully: if the toolchain or
 the build is unavailable this module returns ``None`` and the caller uses the
 pure-python encoder (which is also the oracle in tests).
+
+The C side is a two-phase mmap + memchr parser (see csv_native.cpp): one
+``avt_open`` builds the line index, one ``avt_fill`` fills every requested
+column in a single fused pass, and string columns come back as a joined
+byte blob + int64 offsets wrapped in :class:`core.table.LazyStringColumn`
+(no per-row python string materialization at load time).
+``AVENIR_TPU_INGEST_THREADS`` caps the parse thread count (default: hardware
+concurrency; this container has one core, where the pool is bypassed).
 """
 
 from __future__ import annotations
@@ -14,7 +22,9 @@ import ctypes
 import os
 import subprocess
 import threading
-from typing import List, Optional
+import weakref
+from collections.abc import Sequence
+from typing import Optional
 
 import numpy as np
 
@@ -26,15 +36,26 @@ _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _lib_failed = False
 
+_KIND_NUMERIC = 1
+_KIND_CATEGORICAL = 2
+_KIND_STRING = 3
+_KIND_STRING_CHECK = 4
+
 
 def _build() -> bool:
     tmp = f"{_SO}.{os.getpid()}.tmp"  # unique per process: concurrent builds
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", tmp, _SRC]
+    # -march=native: the .so is built on and for this machine; retry
+    # without it for toolchains that reject the flag
+    base = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread"]
     try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=300)
-        os.replace(tmp, _SO)
-        return True
-    except Exception:
+        for flags in ([*base, "-march=native"], base):
+            try:
+                subprocess.run([*flags, "-o", tmp, _SRC], check=True,
+                               capture_output=True, timeout=300)
+                os.replace(tmp, _SO)
+                return True
+            except Exception:
+                continue
         return False
     finally:
         if os.path.exists(tmp):
@@ -62,30 +83,128 @@ def get_lib() -> Optional[ctypes.CDLL]:
         except Exception:
             _lib_failed = True
             return None
-        lib.avt_parse.restype = ctypes.c_void_p
-        lib.avt_parse.argtypes = [ctypes.c_char_p, ctypes.c_char]
+        lib.avt_open.restype = ctypes.c_void_p
+        lib.avt_open.argtypes = [ctypes.c_char_p, ctypes.c_char,
+                                 ctypes.c_int]
         lib.avt_n_rows.restype = ctypes.c_int64
         lib.avt_n_rows.argtypes = [ctypes.c_void_p]
-        lib.avt_max_fields.restype = ctypes.c_int
-        lib.avt_max_fields.argtypes = [ctypes.c_void_p]
-        lib.avt_fill_numeric.restype = ctypes.c_int64
-        lib.avt_fill_numeric.argtypes = [
+        lib.avt_fill.restype = ctypes.c_int64
+        lib.avt_fill.argtypes = [
             ctypes.c_void_p, ctypes.c_int,
-            ctypes.POINTER(ctypes.c_double)]
-        lib.avt_fill_categorical.restype = ctypes.c_int64
-        lib.avt_fill_categorical.argtypes = [
-            ctypes.c_void_p, ctypes.c_int,
-            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
-            ctypes.POINTER(ctypes.c_int32)]
+            ctypes.POINTER(ctypes.c_int32),            # ords
+            ctypes.POINTER(ctypes.c_int32),            # kinds
+            ctypes.POINTER(ctypes.c_void_p),           # outs
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_char_p)),  # vocabs
+            ctypes.POINTER(ctypes.c_int32),            # vocab_ns
+            ctypes.POINTER(ctypes.c_int64)]            # bad_out
+        lib.avt_string_blob.restype = ctypes.c_void_p
+        lib.avt_string_blob.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                        ctypes.POINTER(ctypes.c_int64)]
+        lib.avt_string_offsets.restype = ctypes.POINTER(ctypes.c_int64)
+        lib.avt_string_offsets.argtypes = [ctypes.c_void_p, ctypes.c_int]
         lib.avt_free.argtypes = [ctypes.c_void_p]
-        # returns a pointer sliced by *len_out (may contain no NUL terminator
-        # semantics we can rely on), so bind void_p rather than c_char_p:
-        lib.avt_string_col.restype = ctypes.c_void_p
-        lib.avt_string_col.argtypes = [
-            ctypes.c_void_p, ctypes.c_int,
-            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
         _lib = lib
         return _lib
+
+
+class _ParseHandle:
+    """Shared ownership of one avt_open handle (mmap + line index), freed
+    when the last referent drops.  Deferred string columns keep it alive
+    until they materialize.  ``path``/``delim`` enable the python-oracle
+    fallback when a late extraction fails."""
+
+    def __init__(self, lib, h, n, path, delim):
+        self.lib = lib
+        self.h = h
+        self.n = n
+        self.path = path
+        self.delim = delim
+        # extraction writes handle-owned blob/offset state: serialize it
+        self.lock = threading.Lock()
+        self._finalizer = weakref.finalize(
+            self, lib.avt_free, ctypes.c_void_p(h))
+
+    def extract_string(self, ordinal: int):
+        """One-column string extraction pass -> (blob bytes, offsets)."""
+        lib, h, n = self.lib, self.h, self.n
+        ords = (ctypes.c_int32 * 1)(ordinal)
+        kinds = (ctypes.c_int32 * 1)(_KIND_STRING)
+        outs = (ctypes.c_void_p * 1)()
+        vocabs = (ctypes.POINTER(ctypes.c_char_p) * 1)()
+        vns = (ctypes.c_int32 * 1)()
+        bads = (ctypes.c_int64 * 1)()
+        with self.lock:
+            if lib.avt_fill(h, 1, ords, kinds, outs, vocabs, vns,
+                            bads) != 0:
+                raise MemoryError("native string column extraction failed")
+            ln = ctypes.c_int64()
+            ptr = lib.avt_string_blob(h, 0, ctypes.byref(ln))
+            offs_ptr = lib.avt_string_offsets(h, 0)
+            if ((ptr is None and ln.value != 0) or ln.value < 0
+                    or not offs_ptr):
+                raise MemoryError("native string column extraction failed")
+            blob = ctypes.string_at(ptr, ln.value) if ln.value else b""
+            offsets = np.ctypeslib.as_array(offs_ptr, shape=(n + 1,)).copy()
+        return blob, offsets
+
+
+class DeferredStringColumn(Sequence):
+    """A string column that parses its bytes out of the (still-mapped) CSV
+    on FIRST access.  Load time pays only a presence check; tables whose id
+    columns are never read (NB/RF training) never pay the blob build at
+    all.  Same observable sequence semantics as the python oracle's list
+    (presence of every field was already validated at load)."""
+
+    __slots__ = ("_handle", "_ordinal", "_n", "_col")
+
+    def __init__(self, handle: _ParseHandle, ordinal: int):
+        self._handle = handle
+        self._ordinal = ordinal
+        self._n = handle.n
+        self._col = None
+
+    def _materialize(self):
+        if self._col is None:
+            from ..core.table import LazyStringColumn, _tokenize
+            handle = self._handle
+            try:
+                blob, offsets = handle.extract_string(self._ordinal)
+                self._col = LazyStringColumn(blob, offsets)
+            except (MemoryError, OSError):
+                # load_csv's python-oracle fallback already happened-or-not
+                # at LOAD time; a deferred extraction must not strand a
+                # long job mid-run, so re-read just this column the slow
+                # way (same 'behavior must not depend on whether the .so
+                # built' contract, paid only on failure)
+                with open(handle.path, "r") as fh:
+                    rows = _tokenize(fh.read(), handle.delim)
+                self._col = [r[self._ordinal] for r in rows]
+            self._handle = None  # release the mmap/index share
+        return self._col
+
+    def __len__(self):
+        return self._n
+
+    def __getitem__(self, i):
+        return self._materialize()[i]
+
+    def __iter__(self):
+        return iter(self._materialize())
+
+    def __eq__(self, other):
+        if isinstance(other, (list, tuple, Sequence)) \
+                and not isinstance(other, str):
+            mine = self._materialize()
+            return (len(mine) == len(other)
+                    and all(a == b for a, b in zip(mine, other)))
+        return NotImplemented
+
+    def __repr__(self):
+        state = "deferred" if self._col is None else "materialized"
+        return f"DeferredStringColumn(n={self._n}, {state})"
+
+    def tolist(self):
+        return list(self._materialize())
 
 
 def native_load_csv(path: str, schema, delim: str, keep_raw: bool = False):
@@ -95,7 +214,7 @@ def native_load_csv(path: str, schema, delim: str, keep_raw: bool = False):
     delimiter, or raw-row echo requested); raises ValueError on malformed
     numeric fields / short rows, matching the python encoder's behaviour.
     """
-    if keep_raw or len(delim) != 1:
+    if keep_raw or len(delim) != 1 or delim in "\r\n":
         return None
     lib = get_lib()
     if lib is None:
@@ -103,59 +222,55 @@ def native_load_csv(path: str, schema, delim: str, keep_raw: bool = False):
 
     from ..core.table import ColumnarTable  # local import: avoid cycle
 
-    h = lib.avt_parse(path.encode(), delim.encode())
+    n_threads = int(os.environ.get("AVENIR_TPU_INGEST_THREADS", "0"))
+    h = lib.avt_open(path.encode(), delim.encode(), n_threads)
     if not h:
         raise OSError(f"native csv parse failed to open {path!r}")
-    try:
-        n = int(lib.avt_n_rows(h))
-        columns = {}
-        str_columns = {}
-        for f in schema.fields:
-            o = f.ordinal
-            if f.is_categorical:
-                vocab = f.cardinality or []
-                enc = [v.encode() for v in vocab]
-                arr = (ctypes.c_char_p * len(enc))(*enc)
-                out = np.empty(n, dtype=np.int32)
-                bad = lib.avt_fill_categorical(
-                    h, o, arr, len(enc),
-                    out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
-                if bad < 0:
-                    raise MemoryError("native categorical fill failed")
-                if bad:
-                    raise ValueError(
-                        f"{bad} rows missing field {o} ({f.name!r}) in {path!r}")
-                columns[o] = out
-            elif f.is_numeric:
-                out = np.empty(n, dtype=np.float64)
-                bad = lib.avt_fill_numeric(
-                    h, o, out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
-                if bad:
-                    raise ValueError(
-                        f"{bad} rows with missing/non-numeric field {o} "
-                        f"({f.name!r}) in {path!r}")
-                columns[o] = out
-            else:
-                str_columns[o] = _string_col(lib, h, o, n, path, f.name)
-        return ColumnarTable(schema=schema, n_rows=n, columns=columns,
-                             str_columns=str_columns, raw_rows=None)
-    finally:
-        lib.avt_free(h)
-
-
-def _string_col(lib, h, ordinal: int, n: int, path: str, name: str) -> List[str]:
-    ln = ctypes.c_int64()
-    bad = ctypes.c_int64()
-    ptr = lib.avt_string_col(h, ordinal, ctypes.byref(ln), ctypes.byref(bad))
-    if ptr is None or ln.value < 0:
-        raise MemoryError("native string column extraction failed")
-    if bad.value:
-        raise ValueError(
-            f"{bad.value} rows missing field {ordinal} ({name!r}) in {path!r}")
-    if n == 0:
-        return []
-    blob = ctypes.string_at(ptr, ln.value).decode()
-    vals = blob.split("\n")
-    if len(vals) != n:
-        raise ValueError(f"string column {ordinal} of {path!r}: row mismatch")
-    return vals
+    handle = _ParseHandle(lib, h, int(lib.avt_n_rows(h)), path, delim)
+    n = handle.n
+    fields = list(schema.fields)
+    n_cols = len(fields)
+    ords = (ctypes.c_int32 * n_cols)()
+    kinds = (ctypes.c_int32 * n_cols)()
+    outs = (ctypes.c_void_p * n_cols)()
+    vocabs = (ctypes.POINTER(ctypes.c_char_p) * n_cols)()
+    vocab_ns = (ctypes.c_int32 * n_cols)()
+    bads = (ctypes.c_int64 * n_cols)()
+    columns = {}
+    str_ords = []
+    keep_alive = []  # encoded vocab arrays must outlive avt_fill
+    for i, f in enumerate(fields):
+        ords[i] = f.ordinal
+        if f.is_categorical:
+            kinds[i] = _KIND_CATEGORICAL
+            enc = [v.encode() for v in (f.cardinality or [])]
+            arr = (ctypes.c_char_p * len(enc))(*enc)
+            keep_alive.append((enc, arr))
+            vocabs[i] = arr
+            vocab_ns[i] = len(enc)
+            out = np.empty(n, dtype=np.int32)
+            columns[f.ordinal] = out
+            outs[i] = out.ctypes.data_as(ctypes.c_void_p)
+        elif f.is_numeric:
+            kinds[i] = _KIND_NUMERIC
+            out = np.empty(n, dtype=np.float64)
+            columns[f.ordinal] = out
+            outs[i] = out.ctypes.data_as(ctypes.c_void_p)
+        else:
+            # presence validated now (same load-time errors as the python
+            # oracle); bytes extracted on first access
+            kinds[i] = _KIND_STRING_CHECK
+            str_ords.append(f.ordinal)
+    rc = lib.avt_fill(h, n_cols, ords, kinds, outs, vocabs, vocab_ns, bads)
+    if rc != 0:
+        raise MemoryError("native csv fill failed")
+    for i, f in enumerate(fields):
+        if bads[i]:
+            what = ("missing/non-numeric" if kinds[i] == _KIND_NUMERIC
+                    else "missing")
+            raise ValueError(
+                f"{bads[i]} rows with {what} field {f.ordinal} "
+                f"({f.name!r}) in {path!r}")
+    str_columns = {o: DeferredStringColumn(handle, o) for o in str_ords}
+    return ColumnarTable(schema=schema, n_rows=n, columns=columns,
+                         str_columns=str_columns, raw_rows=None)

@@ -844,10 +844,11 @@ def emit(artifact):
         except Exception as exc:
             print(f"evidence merge failed (overwriting): {exc}",
                   file=sys.stderr)
-        if ev_art.get("carried_stale"):
-            # the merge displaced some of this run's own numbers (e.g. a
-            # workload that crashed to CPU this time): keep what this run
-            # ACTUALLY measured in the detail file regardless
+        if ev_art.get("carried_stale") or "primary_captured_at" in ev_art:
+            # the merge displaced some of this run's own numbers (a
+            # workload — or the primary itself — that fell back to CPU
+            # this time): keep what this run ACTUALLY measured in the
+            # detail file regardless
             local["fresh_run"] = artifact
         local["artifact"] = artifact = ev_art
         _atomic_write_json(EVIDENCE_PATH, {"captured_at": now,

@@ -100,3 +100,66 @@ def test_native_short_row_raises(tmp_path):
     p.write_text("a1,plus,30,1.5,active\na2,basic\n")
     with pytest.raises(ValueError):
         native_load_csv(str(p), SCHEMA, ",")
+
+
+def test_native_float_forms_match_python(tmp_path):
+    """Decimal/exponent/signed forms fall off the integer fast path and
+    must still match python float()."""
+    rows = ["a0,plus,30,1.5,active", "a1,basic,-7,2.5e3,churned",
+            "a2,plus,+4,-0.125,active", "a3,basic,0,1e-3,churned",
+            "a4,plus,999999999999999999999,inf,active"]
+    p = tmp_path / "floats.csv"
+    p.write_text("\n".join(rows) + "\n")
+    t = native_load_csv(str(p), SCHEMA, ",")
+    oracle = load_csv(str(p), SCHEMA, use_native=False)
+    np.testing.assert_array_equal(t.columns[2], oracle.columns[2])
+    np.testing.assert_array_equal(t.columns[3], oracle.columns[3])
+
+
+def test_native_threaded_matches_single(tmp_path, monkeypatch):
+    """Force the thread pool on a small file (explicit
+    AVENIR_TPU_INGEST_THREADS shards even under the tiny-file guard) and
+    pin byte-identical output incl. rows crossing shard boundaries."""
+    text = _make_csv(5_000, seed=11)
+    p = tmp_path / "sharded.csv"
+    p.write_text(text)
+    single = native_load_csv(str(p), SCHEMA, ",")
+    monkeypatch.setenv("AVENIR_TPU_INGEST_THREADS", "5")
+    sharded = native_load_csv(str(p), SCHEMA, ",")
+    assert sharded.n_rows == single.n_rows
+    for o in (1, 2, 3, 4):
+        np.testing.assert_array_equal(sharded.columns[o], single.columns[o])
+    assert list(sharded.str_columns[0]) == list(single.str_columns[0])
+
+
+def test_native_threaded_crlf(tmp_path, monkeypatch):
+    monkeypatch.setenv("AVENIR_TPU_INGEST_THREADS", "3")
+    lines = [f"b{i},plus,{i},{i}.5,active" for i in range(500)]
+    p = tmp_path / "crlf_sharded.csv"
+    p.write_bytes(("\r\n".join(lines) + "\r\n").encode())
+    t = native_load_csv(str(p), SCHEMA, ",")
+    oracle = load_csv(str(p), SCHEMA, use_native=False)
+    assert t.n_rows == oracle.n_rows == 500
+    np.testing.assert_array_equal(t.columns[2], oracle.columns[2])
+    assert t.str_columns[0] == oracle.str_columns[0]
+
+
+def test_deferred_string_column_semantics(tmp_path):
+    """String columns materialize on first access and behave like the
+    oracle's list: len, indexing (incl. negative + slices), iteration,
+    equality."""
+    p = tmp_path / "d.csv"
+    p.write_text(_make_csv(40))
+    t = native_load_csv(str(p), SCHEMA, ",")
+    col = t.str_columns[0]
+    assert repr(col).endswith("deferred)")
+    assert len(col) == 40          # no materialization needed for len
+    assert repr(col).endswith("deferred)")
+    oracle = load_csv(str(p), SCHEMA, use_native=False).str_columns[0]
+    assert col[0] == oracle[0] and col[-1] == oracle[-1]
+    assert col[3:6] == oracle[3:6]
+    assert list(col) == oracle
+    assert col == oracle
+    assert repr(col).endswith("materialized)")
+    with pytest.raises(IndexError):
+        col[40]
