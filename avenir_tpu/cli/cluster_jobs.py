@@ -18,13 +18,20 @@ from .jobs import register, _schema_path, _splitter
 
 
 @register("org.avenir.cluster.KmeansCluster", "kmeansCluster",
-          dist="gather")
+          dist="sharded")
 def kmeans_cluster(cfg: Config, in_path: str, out_path: str) -> Counters:
     """One Lloyd iteration over every active cluster group (one reference MR
     pass, cluster/KmeansCluster.java).  Keys: kmc.schema.file.path,
     kmc.attr.odinals, kmc.movement.threshold, kmc.cluster.file.path,
     kmc.num.iterations (extension: loop in-process instead of re-running the
-    job; default 1 = reference behavior), nads.output.precision."""
+    job; default 1 = reference behavior), nads.output.precision.
+
+    Multi-process (dist=sharded): each process loads its OWN data shard;
+    the engine's per-shard assignment sums are all-reduced before the
+    centroid update (kmeans.KMeansEngine.iterate), so every process
+    derives the identical global centroids from its local rows — the
+    reference reducer's shuffle as a collective.  The cluster (centroid)
+    file is replicated side input."""
     from ..cluster import kmeans as KM
     counters = Counters()
     schema = _schema_path(cfg, "kmc.schema.file.path")
@@ -45,11 +52,16 @@ def kmeans_cluster(cfg: Config, in_path: str, out_path: str) -> Counters:
                                     cfg.field_delim_out)
     groups, it = KM.run_kmeans(table, groups, engine,
                                max_iter=max(iters, 1), precision=precision)
-    counters.increment("Clustering", "iterations", it)
     out_lines = KM.format_cluster_lines(groups, cfg.field_delim_out, precision)
     artifacts.write_text_output(out_path, out_lines)
+    # iteration/active tallies describe the GLOBAL model every process
+    # derived identically; emit once so the sharded counter SUM is exact
+    import jax
+    p0 = jax.process_index() == 0
+    counters.increment("Clustering", "iterations", it if p0 else 0)
     for g in groups:
-        counters.increment("Clustering", "activeGroups", int(g.active))
+        counters.increment("Clustering", "activeGroups",
+                           int(g.active) if p0 else 0)
     return counters
 
 

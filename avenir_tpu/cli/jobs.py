@@ -25,15 +25,21 @@ JOBS: Dict[str, Callable] = {}
 
 # multi-process behavior class per job function (parallel/distributed.py
 # module docstring defines the contract cli.run enforces):
-#   sharded — consumes its local shard, internally global (device
-#             reductions / collectives)
-#   gather  — host-side global computation; cli.run allgathers the input
-#             lines so every process computes the full result
-#   map     — per-record transform; per-process part files are correct
-#   refuse  — known shard-local-wrong with no fix: rejected under
-#             jax.process_count() > 1
+#   sharded   — consumes its local shard, internally global (device
+#               reductions / collectives)
+#   gather    — host-side global computation; cli.run allgathers the input
+#               lines so every process computes the full result
+#   map       — per-record transform; per-process part files are correct
+#   partition — global input view (gather-style spool when shards differ)
+#               but the job SPLITS ITS WORK by process_index (chain/island
+#               slices, the test axis) — the reference's Spark
+#               mapPartitions executor semantics
+#               (spark SimulatedAnnealing.scala:109).  Counters are
+#               per-process partials (cli.run all-reduces them)
+#   refuse    — known shard-local-wrong with no fix: rejected under
+#               jax.process_count() > 1
 JOB_DIST: Dict[Callable, str] = {}
-_DIST_MODES = ("sharded", "gather", "map", "refuse")
+_DIST_MODES = ("sharded", "gather", "map", "partition", "refuse")
 
 
 def register(*names: str, dist: str):
@@ -363,7 +369,7 @@ def grouped_record_similarity(cfg: Config, in_path: str, out_path: str
 
 
 @register("org.avenir.knn.KnnPipeline", "knnPipeline", "knnInProcess",
-          dist="gather")
+          dist="partition")
 def knn_pipeline(cfg: Config, in_path: str, out_path: str) -> Counters:
     """The whole knn.sh pipeline fused in process: tiled device
     distance + running top-k (ops/distance.pairwise_topk) feeding the
@@ -406,12 +412,19 @@ def knn_pipeline(cfg: Config, in_path: str, out_path: str) -> Counters:
     output_class_distr = cfg.get_boolean("nen.output.class.distr", False)
 
     train, test, intra_set = _load_train_test(in_path, prefix, schema, delim)
+    # partition mode: this process classifies its work_slice of the test
+    # axis against the FULL train set; per-process part files union to the
+    # complete prediction set (single-process: slice = everything)
+    from ..parallel.distributed import work_slice
+    t_lo, t_hi = work_slice(test.n_rows)
+    test = test.take_rows(t_lo, t_hi)
     comp = DistanceComputer(schema, metric=metric, scale=scale)
     k = min(params.top_match_count, train.n_rows - (1 if intra_set else 0))
     # intra-set: fetch one extra neighbor, then drop each row's self-match
     nd, idx = comp.pairwise_topk(test, train, k + 1 if intra_set else k)
     if intra_set:
-        self_col = np.arange(test.n_rows)[:, None]
+        # self indices are TRAIN-relative: offset by the test slice start
+        self_col = (np.arange(test.n_rows) + t_lo)[:, None]
         keep_last = np.argsort(idx == self_col, axis=1, kind="stable")[:, :k]
         nd = np.take_along_axis(nd, keep_last, axis=1)
         idx = np.take_along_axis(idx, keep_last, axis=1)
@@ -438,7 +451,7 @@ def knn_pipeline(cfg: Config, in_path: str, out_path: str) -> Counters:
 
     id_ord = schema.id_fields[0].ordinal if schema.id_fields else 0
     test_ids = test.str_columns.get(
-        id_ord, [str(i) for i in range(test.n_rows)])
+        id_ord, [str(i) for i in range(t_lo, t_lo + test.n_rows)])
     actual = None
     if validation:
         actual = [cardinality[c] if c >= 0 else "?"
@@ -469,10 +482,10 @@ def knn_pipeline(cfg: Config, in_path: str, out_path: str) -> Counters:
     if validation:
         cm.export(counters)
     counters.increment("Neighborhood", "Test records", test.n_rows)
-    # gather-mode job: every process holds the FULL prediction set, so the
-    # output is a global artifact (part 0 everywhere) — per-process parts
-    # would duplicate every record in a shared output dir
-    artifacts.write_text_output(out_path, out_lines)
+    # partition-mode job: each process emits predictions for its test
+    # slice as its own part file (single-process: part-r-00000 as before);
+    # counters are per-slice partials that cli.run all-reduces
+    artifacts.write_text_output(out_path, out_lines, local_shard=True)
     return counters
 
 

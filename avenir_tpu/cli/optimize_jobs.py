@@ -61,12 +61,23 @@ def _parse_start(domain, line: str, od: str) -> np.ndarray:
 
 
 @register("org.avenir.spark.optimize.SimulatedAnnealing", "simulatedAnnealing",
-          dist="gather")
+          dist="partition")
 def simulated_annealing_job(cfg: Config, in_path: str, out_path: str) -> Counters:
     """SA over the configured domain (opt.conf keys; SURVEY.md §3.3).
     in_path may hold starting solutions (one per line, reference component
-    format); otherwise num.optimizers random starts are generated."""
-    from ..optimize.annealing import AnnealingParams, simulated_annealing
+    format); otherwise num.optimizers random starts are generated.
+
+    Multi-process: each process anneals its ``work_slice`` of the chains
+    with a process-folded seed (distinct streams — the reference's Spark
+    executors each draw their own rng,
+    spark SimulatedAnnealing.scala:96-255), then the per-chain bests are
+    allgathered so every process writes the identical merged output.
+    Single-process output is byte-identical to the pre-partition job (the
+    golden SA fixture): slice = all chains, seed fold = +0, allgather =
+    identity."""
+    from ..optimize.annealing import (COUNTER_KEYS, AnnealingParams,
+                                      simulated_annealing)
+    from ..parallel.distributed import allgather_object, work_slice
     counters = Counters()
     params = AnnealingParams(
         max_num_iterations=cfg.get_int("max.num.iterations", 300),
@@ -92,24 +103,54 @@ def simulated_annealing_job(cfg: Config, in_path: str, out_path: str) -> Counter
             od = cfg.field_delim_out
             starts = np.stack([_parse_start(domain, l, od) for l in lines])
             params.num_optimizers = len(lines)
-    res = simulated_annealing(domain, params, start_solutions=starts)
+    lo, hi = work_slice(params.num_optimizers)
+    owns_first = lo == 0 and hi > lo
+    params.num_optimizers = hi - lo
+    params.seed += lo  # fold by chain offset: distinct per-process streams
+    if starts is not None:
+        starts = starts[lo:hi]
     od = cfg.field_delim_out
-    order = np.argsort(res.best_costs)
-    out_lines = [f"{domain.to_string(res.best_solutions[i])}{od}"
-                 f"{res.best_costs[i]:.3f}" for i in order]
+    local = ([], 0.0, 0.0)
+    if hi > lo:
+        res = simulated_annealing(domain, params, start_solutions=starts)
+        local = ([(float(res.best_costs[i]),
+                   domain.to_string(res.best_solutions[i]))
+                  for i in range(hi - lo)],
+                 res.counters["costIncreaseAcum"],
+                 res.counters["worseSolnCount"])
+        for k, v in res.counters.items():
+            counters.set("Annealing", k, _safe_int(v))
+    else:  # more processes than chains: empty slice, counter keys must
+        for k in COUNTER_KEYS:
+            counters.set("Annealing", k, 0)  # still match for the reduce
+    gathered = allgather_object(local)
+    merged = [p for sols, _, _ in gathered for p in sols]
+    merged.sort(key=lambda cs: cs[0])
+    out_lines = [f"{sol}{od}{cost:.3f}" for cost, sol in merged]
     artifacts.write_text_output(out_path, out_lines)
-    for k, v in res.counters.items():
-        counters.set("Annealing", k, _safe_int(v))
+    # initial-temp diagnostic = total cost increase / total worse count,
+    # derived from the GLOBAL sums (a slice-local ratio would silently
+    # change meaning with pod size); emitted once for the counter reduce
+    total_inc = sum(ci for _, ci, _ in gathered)
+    total_worse = sum(nw for _, _, nw in gathered)
+    est = total_inc / total_worse if total_worse > 0 else 0.0
     counters.set("Annealing", "estimatedInitialTemp",
-                 _safe_int(res.estimated_initial_temp))
+                 _safe_int(est) if owns_first else 0)
     return counters
 
 
 @register("org.avenir.spark.optimize.GeneticAlgorithm", "geneticAlgorithm",
-          dist="gather")
+          dist="partition")
 def genetic_algorithm_job(cfg: Config, in_path: str, out_path: str) -> Counters:
-    """GA over the configured domain (GeneticAlgorithm.scala:69-176)."""
+    """GA over the configured domain (GeneticAlgorithm.scala:69-176).
+
+    Multi-process: each process evolves its ``work_slice`` of the islands
+    with an island-offset seed (the reference's num.partitions IS its
+    executor fan-out, GeneticAlgorithm.scala:69), then island bests are
+    allgathered so every process writes the identical merged output.
+    Single-process output is byte-identical to the pre-partition job."""
     from ..optimize.genetic import GeneticParams, genetic_algorithm
+    from ..parallel.distributed import allgather_object, work_slice
     counters = Counters()
     params = GeneticParams(
         num_generations=cfg.get_int("num.generations", 100),
@@ -121,11 +162,23 @@ def genetic_algorithm_job(cfg: Config, in_path: str, out_path: str) -> Counters:
     )
     domain = load_domain(cfg.must_get("domain.callback.class.name"),
                          cfg.must_get("domain.callback.config.file"))
-    res = genetic_algorithm(domain, params)
+    lo, hi = work_slice(params.num_islands)
+    owns_first = lo == 0 and hi > lo
+    params.num_islands = hi - lo
+    params.seed += lo  # fold by island offset: distinct per-process streams
     od = cfg.field_delim_out
-    out_lines = [f"{domain.to_string(res.island_best[i])}{od}"
-                 f"{res.island_best_costs[i]:.3f}"
-                 for i in np.argsort(res.island_best_costs)]
+    local = []
+    if hi > lo:
+        res = genetic_algorithm(domain, params)
+        local = [(float(res.island_best_costs[i]),
+                  domain.to_string(res.island_best[i]))
+                 for i in range(hi - lo)]
+    merged = [p for proc in allgather_object(local) for p in proc]
+    merged.sort(key=lambda cs: cs[0])
+    out_lines = [f"{sol}{od}{cost:.3f}" for cost, sol in merged]
     artifacts.write_text_output(out_path, out_lines)
-    counters.set("Genetic", "bestCost", _safe_int(res.best_cost))
+    # global best emitted exactly once (the cross-process counter reduce
+    # SUMS values; every process setting it would P-fold it)
+    counters.set("Genetic", "bestCost",
+                 _safe_int(merged[0][0]) if owns_first and merged else 0)
     return counters

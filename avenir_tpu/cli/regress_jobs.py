@@ -21,12 +21,17 @@ from .jobs import register, _schema_path
 
 
 @register("org.avenir.regress.LogisticRegressionJob", "logisticRegression",
-          dist="gather")
+          dist="sharded")
 def logistic_regression(cfg: Config, in_path: str, out_path: str) -> Counters:
     """Train to convergence (the reference main()'s do-while over MR runs,
     LogisticRegressionJob.java:203-211, collapsed into one in-process loop).
     The coefficient history file is read if present (resume) and rewritten
-    with one line per iteration."""
+    with one line per iteration.
+
+    Multi-process (dist=sharded): each process loads its OWN data shard;
+    per-iteration gradient sums are all-reduced inside
+    LogisticTrainer.step, so every process walks the identical coefficient
+    history — the reference reducer's aggregation as a collective."""
     from ..regress import logistic as LR
     counters = Counters()
     schema = _schema_path(cfg, "feature.schema.file.path")
@@ -52,8 +57,11 @@ def logistic_regression(cfg: Config, in_path: str, out_path: str) -> Counters:
     od = cfg.field_delim_out
     artifacts.write_text_output(out_path,
                                 [LR.format_coefficients(w, od)])
-    counters.set("Regression", "iterations", iters)
-    counters.set("Regression", "historyLength", len(history))
+    # global-identical values: emit once so the sharded counter SUM is exact
+    import jax
+    p0 = jax.process_index() == 0
+    counters.set("Regression", "iterations", iters if p0 else 0)
+    counters.set("Regression", "historyLength", len(history) if p0 else 0)
     return counters
 
 

@@ -151,7 +151,10 @@ class KMeansEngine:
              if f.max is not None and f.min is not None else 1.0
              for f in self.num_fields], dtype=np.float32)
         self.cards = [len(f.cardinality or []) for f in self.cat_fields]
-        self._iterate = jax.jit(self._iterate_impl)
+        self._partials = jax.jit(jax.vmap(self._partials_impl,
+                                          in_axes=(None, None, None,
+                                                   0, 0, 0)))
+        self._finalize = jax.jit(jax.vmap(self._finalize_impl))
 
     # ---- encoding -------------------------------------------------------
     def encode_table(self, table: ColumnarTable) -> Tuple[np.ndarray, np.ndarray]:
@@ -194,32 +197,52 @@ class KMeansEngine:
         d = jnp.sqrt(jnp.maximum(mean, 0.0))
         return jnp.where(valid[None, :], d, jnp.inf)
 
-    def _iterate_impl(self, num, cat, row_valid, cent_num, cent_cat, valid):
-        """One Lloyd update for one group; vmapped over the group axis by
-        iterate().  Returns new centroids + movement + per-cluster stats."""
+    def _partials_impl(self, num, cat, row_valid, cent_num, cent_cat, valid):
+        """Per-shard Lloyd sums for one group: assignment counts, numeric
+        sums, categorical histograms, squared-distance sums.  These are the
+        job's ONLY row-dependent terms, and they are plain sums — under
+        multi-host each process computes them over its local shard and an
+        all-reduce makes them global (the reference reducer's shuffle,
+        cluster/KmeansCluster.java:162)."""
         d = self._distances(num, cat, cent_num, cent_cat, valid)   # (n,K)
         assign = jnp.argmin(d, axis=1)
         K = cent_num.shape[0]
         onehot = jax.nn.one_hot(assign, K, dtype=jnp.float32)
         onehot = onehot * row_valid[:, None]
         counts = onehot.sum(0)                                     # (K,)
-        safe = jnp.maximum(counts, 1.0)
-        new_num = (onehot.T @ num) / safe[:, None]                 # (K,Fn)
-        # categorical mode per attribute: histogram via one-hot contraction
-        new_cat_cols = []
+        sum_num = onehot.T @ num                                   # (K,Fn)
+        # categorical histograms via one-hot contraction, padded to the
+        # max cardinality so the partial is ONE dense array
+        maxcard = max(self.cards, default=0)
+        hists = []
         for fi, card in enumerate(self.cards):
             codes_oh = jax.nn.one_hot(cat[:, fi], card, dtype=jnp.float32)
-            hist = onehot.T @ codes_oh                             # (K,card)
-            new_cat_cols.append(jnp.argmax(hist, axis=1).astype(jnp.int32))
+            h = onehot.T @ codes_oh                                # (K,card)
+            hists.append(jnp.pad(h, ((0, 0), (0, maxcard - card))))
+        cat_hist = (jnp.stack(hists, axis=1) if hists
+                    else jnp.zeros((K, 0, 0), jnp.float32))
+        dmin = jnp.min(jnp.where(valid[None, :], d, jnp.inf), axis=1)
+        sum_sq = onehot.T @ (dmin * dmin * row_valid)
+        return counts, sum_num, cat_hist, sum_sq
+
+    def _finalize_impl(self, counts, sum_num, cat_hist, sum_sq,
+                       cent_num, cent_cat):
+        """Global sums -> new centroids + movement + stats for one group.
+        Pure function of the (all-reduced) partials: every process derives
+        the identical model."""
+        K = cent_num.shape[0]
+        safe = jnp.maximum(counts, 1.0)
+        new_num = sum_num / safe[:, None]                          # (K,Fn)
+        new_cat_cols = []
+        for fi, card in enumerate(self.cards):
+            new_cat_cols.append(
+                jnp.argmax(cat_hist[:, fi, :card], axis=1).astype(jnp.int32))
         new_cat = (jnp.stack(new_cat_cols, axis=1) if new_cat_cols
                    else jnp.zeros_like(cent_cat))
         # empty clusters keep their old centroid
         empty = counts < 0.5
         new_num = jnp.where(empty[:, None], cent_num, new_num)
         new_cat = jnp.where(empty[:, None], cent_cat, new_cat)
-        # per-cluster mean squared distance (avError of the reference)
-        dmin = jnp.min(jnp.where(valid[None, :], d, jnp.inf), axis=1)
-        sum_sq = onehot.T @ (dmin * dmin * row_valid)
         av_error = sum_sq / safe
         # movement = distance(old centroid, new centroid), same semantics
         ranges = jnp.asarray(self.ranges)
@@ -228,17 +251,37 @@ class KMeansEngine:
         mv_cat = (cent_cat != new_cat).sum(-1).astype(jnp.float32)
         movement = jnp.sqrt((mv_sq + mv_cat) / max(self.n_attrs, 1))
         movement = jnp.where(empty, 0.0, movement)
-        return new_num, new_cat, movement, av_error, counts
+        return new_num, new_cat, movement, av_error
 
     def iterate(self, num: np.ndarray, cat: np.ndarray, row_valid: np.ndarray,
                 enc: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
-        """One Lloyd update for all groups (vmapped over G)."""
-        f = jax.vmap(self._iterate, in_axes=(None, None, None, 0, 0, 0))
-        new_num, new_cat, movement, av_error, counts = f(
+        """One Lloyd update for all groups (vmapped over G): local partial
+        sums -> cross-process all-reduce (identity single-process) ->
+        finalize.  Centroids are bit-identical across processes because
+        every process finalizes the same reduced sums."""
+        from ..parallel.distributed import (all_reduce_host_array,
+                                           is_multiprocess)
+        counts, sum_num, cat_hist, sum_sq = self._partials(
             jnp.asarray(num), jnp.asarray(cat),
             jnp.asarray(row_valid, dtype=jnp.float32),
             jnp.asarray(enc["cent_num"]), jnp.asarray(enc["cent_cat"]),
             jnp.asarray(enc["valid"]))
+        counts, sum_num, cat_hist, sum_sq = (
+            np.asarray(x) for x in (counts, sum_num, cat_hist, sum_sq))
+        if is_multiprocess():
+            # ONE packed collective per Lloyd iteration, not four: this is
+            # the training hot loop and each all-reduce is a full barrier
+            parts = [counts, sum_num, cat_hist, sum_sq]
+            flat = all_reduce_host_array(
+                np.concatenate([p.ravel() for p in parts]))
+            splits = np.cumsum([p.size for p in parts])[:-1]
+            counts, sum_num, cat_hist, sum_sq = (
+                seg.reshape(p.shape) for seg, p in
+                zip(np.split(flat, splits), parts))
+        new_num, new_cat, movement, av_error = self._finalize(
+            jnp.asarray(counts), jnp.asarray(sum_num),
+            jnp.asarray(cat_hist), jnp.asarray(sum_sq),
+            jnp.asarray(enc["cent_num"]), jnp.asarray(enc["cent_cat"]))
         return {"cent_num": np.asarray(new_num), "cent_cat": np.asarray(new_cat),
                 "movement": np.asarray(movement),
                 "av_error": np.asarray(av_error),

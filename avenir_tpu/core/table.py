@@ -131,6 +131,18 @@ class ColumnarTable:
             return np.zeros((self.n_rows, 0), dtype=np.int32)
         return np.stack([self.binned_codes(f.ordinal) for f in fields], axis=1)
 
+    def take_rows(self, lo: int, hi: int) -> "ColumnarTable":
+        """Contiguous row slice [lo, hi) as a new table — the work_slice
+        axis of partition-mode jobs (each process keeps its share of the
+        test rows).  Encoded columns are numpy views; string columns
+        materialize the slice (the consumers of a slice read the ids)."""
+        return ColumnarTable(
+            schema=self.schema, n_rows=hi - lo,
+            columns={k: v[lo:hi] for k, v in self.columns.items()},
+            str_columns={k: v[lo:hi] for k, v in self.str_columns.items()},
+            raw_rows=self.raw_rows[lo:hi] if self.raw_rows is not None
+            else None)
+
     def pad_to_multiple(self, multiple: int) -> "PaddedTable":
         """Pad all encoded columns with zeros to a row count divisible by
         ``multiple`` (the mesh data-axis size) and return the padded view with

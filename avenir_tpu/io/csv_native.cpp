@@ -102,6 +102,7 @@ inline bool is_space(char c) {
 // ever loading past `hard_end` (the mmap boundary).
 inline uint64_t load8_masked(const char* p, size_t len,
                              const char* hard_end) {
+    if (len == 0) return 0;  // a shift by 64 below would be UB
     uint64_t w = 0;
     if (p + 8 <= hard_end)
         std::memcpy(&w, p, 8);
@@ -316,14 +317,12 @@ void* avt_open(const char* path, char delim, int n_threads) try {
     h->fd = ::open(path, O_RDONLY);
     if (h->fd < 0) return nullptr;
     struct stat st;
-    if (::fstat(h->fd, &st) != 0 || !S_ISREG(st.st_mode)) {
-        ::close(h->fd);
-        return nullptr;  // pipe/special file: no fast path
-    }
+    if (::fstat(h->fd, &st) != 0 || !S_ISREG(st.st_mode))
+        return nullptr;  // pipe/special file: no fast path (~Handle closes)
     h->size = static_cast<size_t>(st.st_size);
     if (h->size > 0) {
         void* m = ::mmap(nullptr, h->size, PROT_READ, MAP_PRIVATE, h->fd, 0);
-        if (m == MAP_FAILED) { ::close(h->fd); return nullptr; }
+        if (m == MAP_FAILED) return nullptr;  // ~Handle closes the fd once
         ::madvise(m, h->size, MADV_SEQUENTIAL);
         h->data = static_cast<const char*>(m);
     }

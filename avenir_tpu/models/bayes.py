@@ -214,6 +214,15 @@ def train(table: ColumnarTable, ctx: Optional[MeshContext] = None,
     row count), so unequal per-process shards are handled CORRECTLY —
     shorter shards pad masked-out rows instead of tripping
     from_process_local's equal-shape guard."""
+    if chunk_rows > 1 << 23:
+        # the exactness arguments above are proved AT this bound: per-chunk
+        # counts < 2^24 (f32-exact) and moment-divergence bounded by ~8M-term
+        # f32 sums.  A caller-supplied larger chunk would silently weaken
+        # both invariants (round-4 advisor), so refuse it.
+        raise ValueError(
+            f"chunk_rows={chunk_rows} exceeds 1<<23: per-chunk f32 count "
+            f"exactness (2^24) and the documented moment-precision bound "
+            f"both assume chunks of at most 8M rows")
     ctx = ctx or runtime_context()
     schema = table.schema
     class_field = schema.class_attr_field

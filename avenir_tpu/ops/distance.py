@@ -163,7 +163,14 @@ class DistanceComputer:
         # keep each (test_chunk, train_tile) tile around 2^27 f32 elements
         train_tile = max(1024, min(train_tile, (1 << 27) // max(test_chunk, 1)))
         ctx = runtime_context()
-        mesh_on = ctx.n_devices > 1
+        # single-process only: device_put of a HOST-LOCAL array to a
+        # sharding spanning non-addressable devices bypasses the
+        # from_process_local ingest discipline and is version-sensitive
+        # (round-4 advisor).  Under multi-process the knnPipeline job
+        # already splits the test axis by process (dist=partition), so
+        # each process places plain local arrays here.
+        from ..parallel.distributed import is_multiprocess
+        mesh_on = ctx.n_devices > 1 and not is_multiprocess()
         if mesh_on:
             rn_d = jax.device_put(jnp.asarray(rn), ctx.replicated_sharding())
             roh_d = jax.device_put(jnp.asarray(roh), ctx.replicated_sharding())

@@ -32,6 +32,13 @@ from .domain import SearchDomain, StepSize, cached_jit_run
 from ..parallel.mesh import MeshContext, runtime_context
 
 
+# chain-summed run counters (the reference's Spark accumulators); the SA
+# job's empty-slice branch must emit the SAME key set for the cross-process
+# counter reduce, so the single source of truth lives here
+COUNTER_KEYS = ("betterSolnCount", "bestSolnCount", "worseSolnCount",
+                "worseSolnAcceptCount", "costIncreaseAcum")
+
+
 @dataclass
 class AnnealingParams:
     """The simulatedAnnealing block knobs (resource/opt.conf)."""
@@ -159,11 +166,9 @@ def simulated_annealing(domain: SearchDomain, params: AnnealingParams,
                                         params.max_num_local_iterations, key)
 
     n_worse_v = float(n_worse)
-    counters = {
-        "betterSolnCount": float(n_better), "bestSolnCount": float(n_best),
-        "worseSolnCount": n_worse_v, "worseSolnAcceptCount": float(n_accept),
-        "costIncreaseAcum": float(cost_inc),
-    }
+    counters = dict(zip(COUNTER_KEYS,
+                        (float(n_better), float(n_best), n_worse_v,
+                         float(n_accept), float(cost_inc))))
     est_temp = float(cost_inc) / n_worse_v if n_worse_v > 0 else 0.0
     return AnnealingResult(best_solutions=np.asarray(best),
                            best_costs=np.asarray(best_cost),

@@ -42,7 +42,15 @@ Every registered job carries an explicit multi-process mode
     (or the whole file on one process and empty shards elsewhere);
     replicating the same file to every process double-counts it;
   * ``map`` — per-record transform over the local shard; per-process
-    part-m files are the correct Hadoop layout.
+    part-m files are the correct Hadoop layout;
+  * ``partition`` — global input view (gather-style spool when shards
+    differ; an identical shared-fs input used as-is) but the job splits
+    its WORK by ``work_slice`` — SA chains / GA islands / the KNN test
+    axis — the reference's Spark mapPartitions executor semantics
+    (spark SimulatedAnnealing.scala:109, GeneticAlgorithm.scala:69).
+    Counters are per-process partials (cli.run all-reduces them);
+    'set'-style counters are emitted only by the slice owning item 0 so
+    the sum reproduces the value.
 
 A job with no mode (or an explicit ``refuse``) is rejected loudly under
 multi-process instead of silently emitting shard-local results.
@@ -185,6 +193,18 @@ def from_process_local(local_rows: np.ndarray, mesh: Mesh):
             f"drift) before ingest; mismatched blocks silently corrupt the "
             f"global array")
     return jax.make_array_from_process_local_data(sharding, local_rows)
+
+
+def work_slice(n: int):
+    """This process's contiguous [lo, hi) share of ``n`` independent work
+    items (annealing chains, GA islands, test rows) — the reference's Spark
+    mapPartitions executor split as an index range.  Single-process:
+    (0, n).  ``lo == 0 and hi > 0`` uniquely identifies the process owning
+    item 0 (use it to emit global 'set'-style counters exactly once, so the
+    cross-process counter SUM reproduces the value)."""
+    p, total = (jax.process_index(), process_count()) \
+        if is_multiprocess() else (0, 1)
+    return n * p // total, n * (p + 1) // total
 
 
 def allgather_object(obj):

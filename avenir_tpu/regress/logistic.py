@@ -99,7 +99,8 @@ class LogisticTrainer:
         self.schema = schema
         self.params = params
         self.ctx = ctx or runtime_context()
-        self._step = jax.jit(self._step_impl)
+        self._partials = jax.jit(self._partials_impl)
+        self._combine = jax.jit(self._combine_impl)
 
     def design_matrix(self, table: ColumnarTable
                       ) -> Tuple[np.ndarray, np.ndarray]:
@@ -114,19 +115,40 @@ class LogisticTrainer:
         y = (cls == pos_code).astype(np.float32)
         return X, y
 
-    def _step_impl(self, w, X, y):
+    def _partials_impl(self, w, X, y):
+        """Per-shard gradient-iteration sums: the reference mapper's
+        per-record x*(y-p) aggregation (LogisticRegressionJob.java:118-151).
+        Sums, not means — under multi-host each process computes them over
+        its local rows and an all-reduce plays the reducer (:157-188)."""
         p = jax.nn.sigmoid(X @ w)
-        grad = X.T @ (y - p) - self.params.l2 * w
-        n = X.shape[0]
-        w_new = w + self.params.learning_rate * grad / n
-        # training log-loss as the step metric
+        grad_data = X.T @ (y - p)
         eps = 1e-7
-        ll = -(y * jnp.log(p + eps) + (1 - y) * jnp.log(1 - p + eps)).mean()
-        return w_new, ll
+        ll_sum = -(y * jnp.log(p + eps)
+                   + (1 - y) * jnp.log(1 - p + eps)).sum()
+        return grad_data, ll_sum
+
+    def _combine_impl(self, w, grad_sum, n):
+        grad = grad_sum - self.params.l2 * w
+        w_new = w + self.params.learning_rate * grad / n
+        return w_new
 
     def step(self, w: np.ndarray, X, y) -> Tuple[np.ndarray, float]:
-        w_new, ll = self._step(jnp.asarray(w, jnp.float32), X, y)
-        return np.asarray(w_new, np.float64), float(ll)
+        """One gradient iteration.  Multi-process: X/y are this process's
+        LOCAL rows; the (grad, log-loss, row-count) sums are all-reduced so
+        every process applies the identical global update."""
+        from ..parallel.distributed import (all_reduce_host_array,
+                                           is_multiprocess)
+        w32 = jnp.asarray(w, jnp.float32)
+        grad_sum, ll_sum = self._partials(w32, X, y)
+        n = X.shape[0]
+        if is_multiprocess():
+            packed = np.concatenate([np.asarray(grad_sum, np.float32),
+                                     [np.float32(ll_sum), np.float32(n)]])
+            packed = all_reduce_host_array(packed)
+            grad_sum, ll_sum, n = packed[:-2], packed[-2], packed[-1]
+        w_new = self._combine(w32, jnp.asarray(grad_sum, jnp.float32),
+                              jnp.asarray(n, jnp.float32))
+        return np.asarray(w_new, np.float64), float(ll_sum) / float(n)
 
     def train(self, table: ColumnarTable,
               history: Optional[List[np.ndarray]] = None,
@@ -134,8 +156,12 @@ class LogisticTrainer:
               ) -> Tuple[np.ndarray, List[np.ndarray], int]:
         """Run gradient iterations until the convergence criteria fires
         (resuming from an existing history).  Returns (w, history, iters)."""
+        from ..parallel.distributed import is_multiprocess
         X, y = self.design_matrix(table)
-        if table.n_rows % self.ctx.n_devices == 0:
+        if is_multiprocess():
+            # local shard stays host-shaped; step() all-reduces the sums
+            X, y = jnp.asarray(X), jnp.asarray(y)
+        elif table.n_rows % self.ctx.n_devices == 0:
             X = self.ctx.shard_rows(X)
             y = self.ctx.shard_rows(y)
         else:

