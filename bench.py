@@ -210,6 +210,14 @@ def _native_available():
         return False
 
 
+def e2e_deep_rate(n):
+    """The 100M-row north star (BASELINE.json): disk CSV -> chunk-streamed
+    NB train -> model lines, at the full contract scale.  Separate
+    workload so it can run LAST (rf_huge-style) with its own budget; the
+    4.2 GB fixture is materialized once outside the watchdog child."""
+    return dict(e2e_rate(n), metric="e2e_100m_rows_per_sec")
+
+
 def e2e_rate(n):
     """End-to-end CSV-in -> NaiveBayes model: disk ingest + device train
     (upload/compute/readback) + model serialization, phases timed
@@ -586,9 +594,14 @@ WORKLOADS = {
     # the full disk-CSV -> model pipeline with per-phase timing
     "ingest": (ingest_rate, [10_000_000, 1_000_000]),
     "e2e": (e2e_rate, [10_000_000, 1_000_000]),
-    # device-only deep-scale point, run AFTER everything else in main():
-    # a timeout here must not down-mode the remaining workloads
+    # deep-scale points, run AFTER everything else in main(): a timeout
+    # here must not down-mode the remaining workloads
     "rf_huge": (rf_huge_rate, [8_000_000]),
+    # the 100M-row CSV-in north star; unlike rf_huge it also runs on the
+    # CPU fallback (ingest is host work either way and the chunked NB
+    # train fits host memory — a wedged tunnel must not erase the only
+    # ever full-scale end-to-end number)
+    "e2e_deep": (e2e_deep_rate, [100_000_000]),
 }
 
 
@@ -935,13 +948,14 @@ def main():
     device_ok = platform is not None and platform != "cpu"
     # materialize the disk fixtures OUTSIDE the watchdog children so their
     # one-time generation cost can't eat a timed workload's budget
-    for n_rows in sorted({n for w in ("ingest", "e2e") if w in selected
+    for n_rows in sorted({n for w in ("ingest", "e2e", "e2e_deep")
+                          if w in selected
                           for n in WORKLOADS[w][1]}):
         churn_csv(n_rows)
     results, backends = {}, {}
     for name in selected:  # dict order: nb first (the primary metric)
-        if name == "rf_huge":
-            continue  # deep-scale point: runs last, see below
+        if name in ("rf_huge", "e2e_deep"):
+            continue  # deep-scale points: run last, see below
         if name == "rf_big" and not device_ok:
             continue  # device-scale amortization point; meaningless on CPU
         if name == "ingest":
@@ -971,20 +985,41 @@ def main():
     if not only:
         extras.append(dict(pallas_probe(device_ok=device_ok),
                            backend="device" if device_ok else "cpu-fallback"))
+    def late_timeout(var, default):
+        # late-workload budgets: an explicit BENCH_TIMEOUT_S bound stays
+        # authoritative (these are the runs most likely to stall the
+        # tunnel, so an operator's quick-round cap must hold here too)
+        return int(os.environ.get(
+            var, DEVICE_TIMEOUT_S if "BENCH_TIMEOUT_S" in os.environ
+            else default))
+
     if device_ok and "rf_huge" in selected:
         # deep-scale RF point last: a hang/timeout here can no longer
         # down-mode anything, every other metric is already in hand.
         # Generous default budget — the full-size warm build pays every
         # deep-scale-shape compile the first time (the persistent cache
-        # amortizes later rounds).  An explicit BENCH_TIMEOUT_S bound
-        # stays authoritative: this is the workload most likely to stall
-        # the tunnel, so an operator's quick-round cap must hold here too
-        huge_timeout = int(os.environ.get(
-            "BENCH_HUGE_TIMEOUT_S",
-            DEVICE_TIMEOUT_S if "BENCH_TIMEOUT_S" in os.environ else 1500))
-        r, _ = measure("rf_huge", {}, huge_timeout)
+        # amortizes later rounds).
+        r, wedged = measure("rf_huge", {},
+                            late_timeout("BENCH_HUGE_TIMEOUT_S", 1500))
         if r is not None:
             extras.append(dict(r, backend="device"))
+        if wedged:
+            device_ok = False  # don't point e2e_deep at a dead tunnel
+    if "e2e_deep" in selected:
+        # the 100M north star runs even on the CPU fallback (see
+        # WORKLOADS), and a device failure retries on CPU — a wedge here
+        # must not erase the only full-scale end-to-end number
+        deep_timeout = late_timeout("BENCH_DEEP_TIMEOUT_S", 1800)
+        r = None
+        if device_ok:
+            r, _ = measure("e2e_deep", {}, deep_timeout)
+            if r is not None:
+                extras.append(dict(r, backend="device"))
+        if r is None:
+            r, _ = measure("e2e_deep", {"JAX_PLATFORMS": "cpu"},
+                           deep_timeout)
+            if r is not None:
+                extras.append(dict(r, backend="cpu-fallback"))
     emit({
         "metric": nb["metric"],
         "value": nb["value"],
