@@ -30,6 +30,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..parallel.mesh import runtime_context
+
 KERNEL_LINEAR = "linear"
 
 
@@ -389,9 +391,18 @@ def train_groups_batched(groups: Dict[str, Tuple[np.ndarray, np.ndarray]],
     run = _batched_smo_kernel(params.penalty_factor, params.tolerance,
                               params.eps,
                               max_iter=params.max_sweeps * n_max)
-    alpha, w, b, it = (np.asarray(v) for v in
-                       run(jnp.asarray(Xb), jnp.asarray(yb),
-                           jnp.asarray(valid)))
+    ctx = runtime_context()
+    if (jax.process_count() == 1 and ctx.n_devices > 1
+            and G % ctx.n_devices == 0):
+        # groups are embarrassingly parallel: shard the group axis over
+        # the mesh (every per-iteration op is per-group, so GSPMD's only
+        # collective is the all-groups-done reduction in the loop cond).
+        # Host numpy goes straight to the sharded placement — an
+        # asarray-then-reshard would upload everything to device 0 first
+        Xj, yj, vj = (ctx.shard_rows(a) for a in (Xb, yb, valid))
+    else:
+        Xj, yj, vj = jnp.asarray(Xb), jnp.asarray(yb), jnp.asarray(valid)
+    alpha, w, b, it = (np.asarray(v) for v in run(Xj, yj, vj))
     if stats is not None:
         # real lock-step iteration count (bench rooflines model work from
         # it rather than a hard-coded constant)
