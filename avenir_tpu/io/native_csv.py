@@ -40,6 +40,7 @@ _KIND_NUMERIC = 1
 _KIND_CATEGORICAL = 2
 _KIND_STRING = 3
 _KIND_STRING_CHECK = 4
+_KIND_NUMERIC_BINNED = 5
 
 
 def _build() -> bool:
@@ -96,7 +97,10 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_void_p),           # outs
             ctypes.POINTER(ctypes.POINTER(ctypes.c_char_p)),  # vocabs
             ctypes.POINTER(ctypes.c_int32),            # vocab_ns
-            ctypes.POINTER(ctypes.c_int64)]            # bad_out
+            ctypes.POINTER(ctypes.c_int64),            # bad_out
+            ctypes.POINTER(ctypes.c_void_p),           # bin_outs
+            ctypes.POINTER(ctypes.c_double),           # bin_widths
+            ctypes.POINTER(ctypes.c_int32)]            # bin_offsets
         lib.avt_string_blob.restype = ctypes.c_void_p
         lib.avt_string_blob.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                         ctypes.POINTER(ctypes.c_int64)]
@@ -133,9 +137,12 @@ class _ParseHandle:
         vocabs = (ctypes.POINTER(ctypes.c_char_p) * 1)()
         vns = (ctypes.c_int32 * 1)()
         bads = (ctypes.c_int64 * 1)()
+        bin_outs = (ctypes.c_void_p * 1)()
+        bin_ws = (ctypes.c_double * 1)()
+        bin_offs = (ctypes.c_int32 * 1)()
         with self.lock:
             if lib.avt_fill(h, 1, ords, kinds, outs, vocabs, vns,
-                            bads) != 0:
+                            bads, bin_outs, bin_ws, bin_offs) != 0:
                 raise MemoryError("native string column extraction failed")
             ln = ctypes.c_int64()
             ptr = lib.avt_string_blob(h, 0, ctypes.byref(ln))
@@ -236,7 +243,11 @@ def native_load_csv(path: str, schema, delim: str, keep_raw: bool = False):
     vocabs = (ctypes.POINTER(ctypes.c_char_p) * n_cols)()
     vocab_ns = (ctypes.c_int32 * n_cols)()
     bads = (ctypes.c_int64 * n_cols)()
+    bin_outs = (ctypes.c_void_p * n_cols)()
+    bin_ws = (ctypes.c_double * n_cols)()
+    bin_offs = (ctypes.c_int32 * n_cols)()
     columns = {}
+    binned_cache = {}
     str_ords = []
     keep_alive = []  # encoded vocab arrays must outlive avt_fill
     for i, f in enumerate(fields):
@@ -252,25 +263,43 @@ def native_load_csv(path: str, schema, delim: str, keep_raw: bool = False):
             columns[f.ordinal] = out
             outs[i] = out.ctypes.data_as(ctypes.c_void_p)
         elif f.is_numeric:
-            kinds[i] = _KIND_NUMERIC
             out = np.empty(n, dtype=np.float64)
             columns[f.ordinal] = out
             outs[i] = out.ctypes.data_as(ctypes.c_void_p)
+            if f.bucket_width is not None:
+                # bin codes emitted during the same parse pass (the host
+                # floor-divide re-walk is measurable NB-train prep cost)
+                kinds[i] = _KIND_NUMERIC_BINNED
+                bout = np.empty(n, dtype=np.int32)
+                binned_cache[f.ordinal] = bout
+                bin_outs[i] = bout.ctypes.data_as(ctypes.c_void_p)
+                bin_ws[i] = float(f.bucket_width)
+                bin_offs[i] = int(f.bin_offset)
+            else:
+                kinds[i] = _KIND_NUMERIC
         else:
             # presence validated now (same load-time errors as the python
             # oracle); bytes extracted on first access
             kinds[i] = _KIND_STRING_CHECK
             str_ords.append(f.ordinal)
-    rc = lib.avt_fill(h, n_cols, ords, kinds, outs, vocabs, vocab_ns, bads)
+    rc = lib.avt_fill(h, n_cols, ords, kinds, outs, vocabs, vocab_ns, bads,
+                      bin_outs, bin_ws, bin_offs)
     if rc != 0:
         raise MemoryError("native csv fill failed")
+    for arr in binned_cache.values():
+        # cached codes are returned BY REFERENCE from binned_codes (the
+        # oracle path returns fresh arrays): freeze them so a caller
+        # mutation fails loudly instead of silently corrupting the cache
+        arr.flags.writeable = False
     for i, f in enumerate(fields):
         if bads[i]:
-            what = ("missing/non-numeric" if kinds[i] == _KIND_NUMERIC
+            what = ("missing/non-numeric"
+                    if kinds[i] in (_KIND_NUMERIC, _KIND_NUMERIC_BINNED)
                     else "missing")
             raise ValueError(
                 f"{bads[i]} rows with {what} field {f.ordinal} "
                 f"({f.name!r}) in {path!r}")
     str_columns = {o: DeferredStringColumn(handle, o) for o in str_ords}
     return ColumnarTable(schema=schema, n_rows=n, columns=columns,
-                         str_columns=str_columns, raw_rows=None)
+                         str_columns=str_columns, raw_rows=None,
+                         binned_cache=binned_cache)

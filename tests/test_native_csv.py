@@ -102,6 +102,76 @@ def test_native_short_row_raises(tmp_path):
         native_load_csv(str(p), SCHEMA, ",")
 
 
+def test_native_bin_codes_match_oracle(tmp_path):
+    """Bin codes emitted during the native parse == the host floor-divide
+    the oracle path computes (incl. negatives and bucket boundaries), and
+    they survive pad_to_multiple / take_rows with cache parity."""
+    schema = FeatureSchema.from_dict({"fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "v", "ordinal": 1, "dataType": "double", "feature": True,
+         "min": -50, "max": 150, "bucketWidth": 25},
+        {"name": "w", "ordinal": 2, "dataType": "int", "feature": True,
+         "min": 0, "max": 1000, "bucketWidth": 100},
+    ]})
+    rng = np.random.default_rng(8)
+    lines = [f"r{i},{v:.4f},{int(w)}" for i, (v, w) in enumerate(
+        zip(rng.uniform(-50, 150, 300), rng.integers(0, 1000, 300)))]
+    lines += ["b0,-50,0", "b1,150,1000", "b2,-0.0001,100", "b3,24.9999,99"]
+    # non-integer width stressor in a second schema below exercises the
+    # fmod-corrected floor division (floor(a/b) != a//b cases)
+    p = tmp_path / "bins.csv"
+    p.write_text("\n".join(lines) + "\n")
+    t = native_load_csv(str(p), schema, ",")
+    oracle = load_csv(str(p), schema, use_native=False)
+    assert set(t.binned_cache) == {1, 2} and not oracle.binned_cache
+    for o in (1, 2):
+        np.testing.assert_array_equal(t.binned_codes(o),
+                                      oracle.binned_codes(o))
+    padded, opadded = t.pad_to_multiple(7), oracle.pad_to_multiple(7)
+    for o in (1, 2):
+        np.testing.assert_array_equal(padded.binned_codes(o),
+                                      opadded.binned_codes(o))
+    np.testing.assert_array_equal(t.take_rows(5, 105).binned_codes(1),
+                                  oracle.take_rows(5, 105).binned_codes(1))
+
+
+def test_native_bin_codes_fractional_width(tmp_path):
+    """Non-integer bucketWidth: numpy's // is fmod-corrected floor
+    division, NOT floor(a/b) — e.g. 511.8 // 0.1 == 5117 while
+    floor(511.8/0.1) == 5118.  The native emission must match numpy
+    bit for bit (this was a live divergence on 1112/2000 random rows)."""
+    schema = FeatureSchema.from_dict({"fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "v", "ordinal": 1, "dataType": "double", "feature": True,
+         "min": 0, "max": 1000, "bucketWidth": 0.1},
+    ]})
+    rng = np.random.default_rng(13)
+    vals = np.round(rng.uniform(0, 1000, 2000), 1)
+    p = tmp_path / "frac.csv"
+    p.write_text("\n".join(f"r{i},{v:.1f}" for i, v in enumerate(vals))
+                 + "\n511.8,511.8\n")
+    t = native_load_csv(str(p), schema, ",")
+    oracle = load_csv(str(p), schema, use_native=False)
+    np.testing.assert_array_equal(t.binned_codes(1), oracle.binned_codes(1))
+
+
+def test_native_bin_cache_is_frozen(tmp_path):
+    """Cached codes are returned by reference: mutation must fail loudly
+    (the oracle path hands out fresh arrays, so a silent cache mutation
+    would make results depend on whether the .so built)."""
+    schema = FeatureSchema.from_dict({"fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "v", "ordinal": 1, "dataType": "int", "feature": True,
+         "min": 0, "max": 100, "bucketWidth": 10},
+    ]})
+    p = tmp_path / "f.csv"
+    p.write_text("a,5\nb,15\n")
+    t = native_load_csv(str(p), schema, ",")
+    codes = t.binned_codes(1)
+    with pytest.raises(ValueError):
+        codes[0] = -1
+
+
 def test_native_empty_categorical_field(tmp_path):
     """Empty categorical cells (',,') must match the oracle — including a
     vocab that CONTAINS the empty string (len-0 masked-word compare)."""
