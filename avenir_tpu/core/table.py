@@ -159,6 +159,18 @@ class ColumnarTable:
         n = self.n_rows
         n_pad = (-n) % multiple
         total = n + n_pad
+        if n_pad == 0:
+            # already aligned: share the arrays — concatenating with an
+            # empty tail still deep-copies every column, which measured
+            # 21 s of the 86 s 100M-row NB train (single-device mesh
+            # always lands here)
+            mask = np.ones((n,), dtype=bool)
+            return PaddedTable(schema=self.schema, n_rows=n,
+                               columns=dict(self.columns),
+                               str_columns=self.str_columns,
+                               raw_rows=self.raw_rows,
+                               binned_cache=dict(self.binned_cache),
+                               valid_mask=mask, n_valid=n)
         cols = {}
         for k, v in self.columns.items():
             pad_val = 0
