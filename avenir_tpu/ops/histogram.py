@@ -15,21 +15,10 @@ one-hot materialization.
 
 from __future__ import annotations
 
-import os
-from functools import partial
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
-
-
-def _use_pallas() -> bool:
-    """Opt-in pallas fast path (see ops/pallas_kernels.py; not usable on the
-    tunneled axon dev platform, intended for real TPU deployments)."""
-    if os.environ.get("AVENIR_TPU_USE_PALLAS") != "1":
-        return False
-    from .pallas_kernels import HAVE_PALLAS
-    return HAVE_PALLAS
 
 
 def class_bin_histogram(class_codes: jnp.ndarray,    # (n,) int
@@ -45,10 +34,6 @@ def class_bin_histogram(class_codes: jnp.ndarray,    # (n,) int
     class histograms of the tree builder.  Out-of-range / negative bin codes
     (unknown categorical values) are dropped, as is anything with mask=False.
     """
-    if _use_pallas() and dtype == jnp.float32:
-        from .pallas_kernels import class_bin_histogram_pallas
-        return class_bin_histogram_pallas(class_codes, bin_codes,
-                                          num_classes, num_bins, mask)
     valid = (bin_codes >= 0) & (bin_codes < num_bins)
     if mask is not None:
         valid = valid & mask[:, None]

@@ -697,74 +697,6 @@ def measure(name, env_extra, timeout_s):
     return None, False
 
 
-def pallas_probe(timeout_s=None, device_ok=True):
-    """VERDICT r2 #5 'prove or prune': time the pallas coded_histogram
-    against the XLA one-hot formulation on the live backend, inside a
-    watchdog child — Mosaic HANGS at compile on the tunneled axon platform
-    (see ops/pallas_kernels.py), so the child's timeout converts that hang
-    into a recorded verdict instead of a wedged bench.  Returns an
-    extra_metrics entry either way: a measured ratio, or the documented
-    unsupported status."""
-    timeout_s = timeout_s or int(os.environ.get("BENCH_PALLAS_TIMEOUT_S",
-                                                "120"))
-    code = (
-        _CHILD_PRELUDE +
-        "import json, time\n"
-        "import numpy as np, jax.numpy as jnp\n"
-        "from avenir_tpu.ops.pallas_kernels import coded_histogram\n"
-        "n, F, K, reps = 4_000_000, 6, 24, 10\n"
-        "rng = np.random.default_rng(0)\n"
-        "codes = jnp.asarray(rng.integers(0, K, (n, F)).astype(np.int32))\n"
-        "# reps chained ON DEVICE (shifted codes defeat CSE) with one final\n"
-        "# readback: per-call readbacks would only measure the ~60ms tunnel\n"
-        "# round trip, not the kernels\n"
-        "def many(fn):\n"
-        "    def body(c):\n"
-        "        acc = None\n"
-        "        for i in range(reps):\n"
-        "            h = fn((c + i) % K)\n"
-        "            acc = h if acc is None else acc + h\n"
-        "        return acc\n"
-        "    return jax.jit(body)\n"
-        "xla_one = lambda c: jax.nn.one_hot(c, K, dtype=jnp.float32).sum(0)\n"
-        "def rate(fn):\n"
-        "    j = many(fn)\n"
-        "    np.asarray(j(codes))\n"
-        "    t0 = time.perf_counter()\n"
-        "    np.asarray(j(codes))\n"
-        "    return n * reps / (time.perf_counter() - t0)\n"
-        "p = rate(lambda c: coded_histogram(c, K, interpret=False))\n"
-        "x = rate(xla_one)\n"
-        "print(json.dumps({'pallas_rows_per_sec': round(p, 1),\n"
-        "                  'xla_rows_per_sec': round(x, 1),\n"
-        "                  'pallas_vs_xla': round(p / x, 3)}))\n")
-    if not device_ok:
-        # compiled pallas doesn't lower on the CPU backend (and interpret
-        # mode at this size would be glacial): record the skip instead of
-        # a crashed child
-        return {"metric": "pallas_coded_histogram", "value": 0,
-                "unit": "status",
-                "status": "skipped on cpu fallback (no Mosaic); XLA one-hot "
-                          "path is the production default"}
-    out = _run_child(code, {}, timeout_s)
-    if out is TIMEOUT:
-        return {"metric": "pallas_coded_histogram", "value": 0,
-                "unit": "status",
-                "status": "pallas child timed out (wedged device or Mosaic "
-                          "compile hang); XLA one-hot path is the "
-                          "production default (ops/pallas_kernels.py)"}
-    if out is None:
-        return {"metric": "pallas_coded_histogram", "value": 0,
-                "unit": "status", "status": "pallas child crashed; XLA "
-                "one-hot path is the production default"}
-    # same metric key as the status entries so the evidence merge replaces
-    # a stale timeout/skip with a later measured rate (and vice versa)
-    return {"metric": "pallas_coded_histogram",
-            "value": out["pallas_rows_per_sec"], "unit": "rows/sec",
-            "xla_rows_per_sec": out["xla_rows_per_sec"],
-            "pallas_vs_xla": out["pallas_vs_xla"]}
-
-
 # ---------------------------------------------------------------------------
 # artifact emission: compact line + full-detail file + device-evidence replay
 # ---------------------------------------------------------------------------
@@ -776,6 +708,11 @@ COMPACT_BUDGET = 1500  # driver tail-captures 2000 chars; stay well inside
 
 _BACKEND_CODE = {"device": "dev", "cpu-fallback": "cpu", "host": "host",
                  "python": "py"}
+
+# workloads deleted from the suite; stale evidence entries for them are
+# pruned at merge time instead of being carried forward forever
+REMOVED_METRICS = {"pallas_coded_histogram",
+                   "pallas_coded_histogram_rows_per_sec"}
 
 
 def compact_line(artifact):
@@ -857,8 +794,12 @@ def _merge_evidence(fresh, old):
         else:
             merged.append(o)
             carried += 1
-    merged.extend(old_by.values())
-    carried += len(old_by)
+    # metrics nothing can measure anymore (removed workloads — e.g. the
+    # r5-deleted pallas probe) must not be carried forward forever
+    leftovers = [o for o in old_by.values()
+                 if o["metric"] not in REMOVED_METRICS]
+    merged.extend(leftovers)
+    carried += len(leftovers)
     out = dict(fresh, extra_metrics=merged)
     if fresh.get("backend") != "device" and old.get("backend") == "device":
         out.update({k: old[k] for k in ("metric", "value", "unit",
@@ -982,9 +923,6 @@ def main():
         backends["nb"] = "python"
     extras = [dict(results[k], backend=backends[k])
               for k in selected if k != "nb" and k in results]
-    if not only:
-        extras.append(dict(pallas_probe(device_ok=device_ok),
-                           backend="device" if device_ok else "cpu-fallback"))
     def late_timeout(var, default):
         # late-workload budgets: an explicit BENCH_TIMEOUT_S bound stays
         # authoritative (these are the runs most likely to stall the

@@ -197,22 +197,39 @@ def test_merge_stamps_staleness_and_keeps_fresh_run(emit_paths, capsys,
 
 
 def test_status_entry_cannot_displace_measured_rate(emit_paths, capsys):
-    """A later pallas timeout (status entry, same metric key) must not
-    erase an earlier measured pallas rate — measurement beats status."""
+    """A later probe timeout (status entry, same metric key) must not
+    erase an earlier measured rate — measurement beats status."""
     _, evidence_path = emit_paths
     good = _artifact("device", n_extras=1)
     good["extra_metrics"].append(
-        {"metric": "pallas_coded_histogram", "value": 154.2e6,
+        {"metric": "probe_kernel", "value": 154.2e6,
          "unit": "rows/sec", "backend": "device"})
     bench.emit(good)
     bad = _artifact("device", n_extras=1, value=4.0)
     bad["extra_metrics"].append(
-        {"metric": "pallas_coded_histogram", "value": 0, "unit": "status",
-         "status": "pallas child timed out", "backend": "device"})
+        {"metric": "probe_kernel", "value": 0, "unit": "status",
+         "status": "probe child timed out", "backend": "device"})
     bench.emit(bad)
     capsys.readouterr()
     ev = json.load(open(evidence_path))["artifact"]
-    pallas = [e for e in ev["extra_metrics"]
-              if e["metric"] == "pallas_coded_histogram"]
-    assert len(pallas) == 1
-    assert pallas[0]["unit"] == "rows/sec" and pallas[0]["value"] == 154.2e6
+    probe = [e for e in ev["extra_metrics"]
+             if e["metric"] == "probe_kernel"]
+    assert len(probe) == 1
+    assert probe[0]["unit"] == "rows/sec" and probe[0]["value"] == 154.2e6
+
+
+def test_removed_metrics_pruned_from_evidence(emit_paths, capsys):
+    """Evidence entries for deleted workloads (the r5-removed pallas
+    probe) are pruned at merge time instead of being carried forever."""
+    _, evidence_path = emit_paths
+    old = _artifact("device", n_extras=2, value=2.0)
+    old["extra_metrics"].append(
+        {"metric": "pallas_coded_histogram", "value": 154.2e6,
+         "unit": "rows/sec", "backend": "device"})
+    bench.emit(old)
+    bench.emit(_artifact("device", n_extras=2, value=3.0))
+    capsys.readouterr()
+    ev = json.load(open(evidence_path))["artifact"]
+    metrics = {e["metric"] for e in ev["extra_metrics"]}
+    assert "pallas_coded_histogram" not in metrics
+    assert len(metrics) == 2
