@@ -35,7 +35,7 @@ from ..core.schema import FeatureSchema
 from ..core.table import ColumnarTable
 from ..core.metrics import Counters
 from ..parallel.mesh import MeshContext, runtime_context
-from .tree import (DecisionPath, DecisionPathList, DecisionTreeModel,
+from .tree import (acc_counts, DecisionPath, DecisionPathList, DecisionTreeModel,
                    Predicate, TreeBuilder, TreeParams, level_chunk,
                    sampling_weights)
 
@@ -201,8 +201,8 @@ class ForestBuilder:
                 chunk, node_ids[start:end], base.branches[start:end],
                 base.cls_codes[start:end], weights[start:end])
             c = kernel(nid, br, cc, ww, n_nodes)
-            ci = c.astype(jnp.int32)
-            acc = ci if acc is None else acc + ci
+            acc = c.astype(jnp.int32) if acc is None \
+                else acc_counts(acc, c)
         return np.asarray(acc, dtype=np.float64)
 
     def _level_fused(self, fused, node_ids, weights, sel_split: np.ndarray,
@@ -234,8 +234,8 @@ class ForestBuilder:
                 base.cls_codes[start:end], weights[start:end])
             ni, c = fused(nid, br, cc, ww, sel, ctab, n_new)
             ids_parts.append(ni[:end - start])
-            ci = c.astype(jnp.int32)
-            acc = ci if acc is None else acc + ci
+            acc = c.astype(jnp.int32) if acc is None \
+                else acc_counts(acc, c)
         return jnp.concatenate(ids_parts, axis=0), \
             np.asarray(acc, dtype=np.float64)
 

@@ -499,6 +499,14 @@ def sampling_weights(n: int, params: TreeParams,
     return None
 
 
+@jax.jit
+def acc_counts(acc, c):
+    """Fused chunk accumulate (astype + add in ONE dispatch): the eager
+    pair costs two dispatches per chunk in the deep-scale chunked regime.
+    Shared by the single-tree and forest builders."""
+    return acc + c.astype(jnp.int32)
+
+
 def level_chunk(n_nodes: int, n_trees: int, S: int, B: int, C: int,
                 w_max: float, mem_elems: int = 128 << 20) -> int:
     """Rows per level-kernel launch, bounded by (a) the f32 one-hot
@@ -650,8 +658,8 @@ class TreeBuilder:
                 c = self._count_kernel(
                     node_ids[start:end], self.branches[start:end],
                     self.cls_codes[start:end], weights[start:end], n_nodes)
-                ci = c.astype(jnp.int32)
-                acc = ci if acc is None else acc + ci
+                acc = c.astype(jnp.int32) if acc is None \
+                    else acc_counts(acc, c)
             return np.asarray(acc, dtype=np.float64)
         if n <= chunk:
             c = self._count_kernel(node_ids, self.branches, self.cls_codes,
