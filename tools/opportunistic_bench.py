@@ -78,6 +78,19 @@ def main():
                 if (out.returncode == 0 and '"backend":"device"' in line
                         and '"replayed"' not in line):
                     log({"event": "captured"})
+                    # same healthy window: run the roofline-vs-profiler
+                    # reconciliation (VERDICT r4 #8) while the tunnel is up
+                    try:
+                        prof = subprocess.run(
+                            [sys.executable,
+                             "tools/profile_nb_roofline.py"],
+                            cwd=HERE, capture_output=True, text=True,
+                            timeout=900)
+                        log({"event": "profile", "rc": prof.returncode,
+                             "line": (prof.stdout.strip().splitlines()
+                                      or [""])[-1][:400]})
+                    except subprocess.TimeoutExpired:
+                        log({"event": "profile_timeout"})
                     return 0
             except subprocess.TimeoutExpired:
                 log({"event": "bench_timeout"})
