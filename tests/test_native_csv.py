@@ -218,6 +218,11 @@ def test_native_threaded_matches_single(tmp_path, monkeypatch):
     for o in (1, 2, 3, 4):
         np.testing.assert_array_equal(sharded.columns[o], single.columns[o])
     assert list(sharded.str_columns[0]) == list(single.str_columns[0])
+    # parse-time bin codes shard with the rows: byte-identical too
+    assert set(sharded.binned_cache) == set(single.binned_cache) != set()
+    for o in sharded.binned_cache:
+        np.testing.assert_array_equal(sharded.binned_cache[o],
+                                      single.binned_cache[o])
 
 
 def test_native_threaded_crlf(tmp_path, monkeypatch):
