@@ -45,9 +45,13 @@ N_FEAT, N_BINS, N_CLASSES = 6, 12, 2
 PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "120"))
 DEVICE_TIMEOUT_S = int(os.environ.get("BENCH_TIMEOUT_S", "600"))
 # a wedge can clear between retries (observed across rounds): one failed
-# probe must not erase the round's device evidence
+# probe must not erase the round's device evidence.  Retry delay is short
+# since r5: the all-round opportunistic capturer + evidence replay carry
+# the device story now, so capture-time probing only needs to catch a
+# momentary blip — long sleeps here just push the run toward any outer
+# capture timeout
 PROBE_RETRIES = int(os.environ.get("BENCH_PROBE_RETRIES", "2"))
-PROBE_RETRY_DELAY_S = int(os.environ.get("BENCH_PROBE_RETRY_DELAY_S", "180"))
+PROBE_RETRY_DELAY_S = int(os.environ.get("BENCH_PROBE_RETRY_DELAY_S", "60"))
 
 BENCH_DATA_DIR = os.environ.get("AVENIR_TPU_BENCH_DATA",
                                 "/tmp/avenir_tpu_bench_data")
