@@ -214,6 +214,62 @@ def _native_available():
         return False
 
 
+def _rf_shape_terms(n, T, F, S, levels=4):
+    """Coarse RF-build shape model shared by the rf and e2e_rf workloads
+    (one source: the constants drifted when copy-pasted): per row/tree/
+    level a (S splits x 3 branches x 2 classes) one-hot contraction;
+    uploads = int16 feature matrix (F cols) + 4-bit packed bootstrap
+    weights; a few launches per level."""
+    flops = float(n) * T * levels * S * 3 * 2 * 2
+    up = float(n) * (F * 2) + float(n) * T / 2
+    return flops, flops / 6, up, levels * 3
+
+
+def e2e_rf_rate(n):
+    """End-to-end CSV-in -> 16-tree random forest (the OTHER flagship
+    family of the CSV-in contract): disk ingest + tree-batched build +
+    decision-path JSON serialization, phases timed separately — the
+    rafo.sh flow (resource/rafo.sh:34-43) as one pipeline."""
+    from avenir_tpu.core.table import load_csv
+    from avenir_tpu.models.forest import ForestParams, build_forest
+    from avenir_tpu.models.tree import generate_candidate_splits
+    from avenir_tpu.parallel.mesh import MeshContext
+    path = churn_csv(n)
+    schema = _churn_schema()
+    params = ForestParams(num_trees=16, seed=1)
+    params.tree.max_depth = 4
+    ctx = MeshContext()
+    # cold pass = the user's one-shot run (XLA compiles) + warmup
+    tc = time.perf_counter()
+    build_forest(load_csv(path, schema, ","), params, ctx)
+    cold_s = time.perf_counter() - tc
+    t0 = time.perf_counter()
+    table = load_csv(path, schema, ",")
+    t1 = time.perf_counter()
+    models = build_forest(table, params, ctx)
+    t2 = time.perf_counter()
+    blobs = [m.to_json() for m in models]
+    t3 = time.perf_counter()
+    assert len(blobs) == 16
+    dt = t3 - t0
+    T = 16
+    # shape terms from THIS schema, not _BENCH_SCHEMA's constants
+    S = len(generate_candidate_splits(schema))
+    F = len(schema.feature_fields)
+    flops, hbm, up, launches = _rf_shape_terms(n, T, F, S)
+    return {"metric": "e2e_csv_to_forest_rows_x_trees_per_sec",
+            "value": round(n * T / dt, 1), "unit": "rows*trees/sec",
+            "n": n, "trees": T, "candidate_splits": S,
+            "ingest_s": round(t1 - t0, 3),
+            "build_s": round(t2 - t1, 3),
+            "serialize_s": round(t3 - t2, 3),
+            "total_s": round(dt, 3),
+            "cold_total_s": round(cold_s, 3),
+            "roofline": roofline(t2 - t1, flops=flops, hbm_bytes=hbm,
+                                 up_bytes=up, launches=launches,
+                                 host_s=t1 - t0)}
+
+
 def e2e_deep_rate(n):
     """The 100M-row north star (BASELINE.json): disk CSV -> chunk-streamed
     NB train -> model lines, at the full contract scale.  Separate
@@ -362,17 +418,13 @@ def rf_rate(n):
     models = build_forest(table, params, ctx)
     dt = time.perf_counter() - t0
     T = len(models)
-    # coarse shape model: ~19 candidate splits x 3 branches x 2 classes
-    # one-hot per row/tree/level over 4 levels; uploads = int16 feature
-    # matrix (4 cols) + 4-bit packed bootstrap weights; a few launches
-    # per level (count + reassign + readback sync)
-    flops = float(n) * T * 4 * 19 * 3 * 2 * 2
-    up = float(n) * (4 * 2) + float(n) * T / 2
+    # _BENCH_SCHEMA shape: 19 candidate splits, 4 feature columns
+    flops, hbm, up, launches = _rf_shape_terms(n, T, F=4, S=19)
     return {"metric": "random_forest_rows_x_trees_per_sec",
             "value": round(n * T / dt, 1),
             "unit": "rows*trees/sec", "n": n, "trees": T,
-            "roofline": roofline(dt, flops=flops, hbm_bytes=flops / 6,
-                                 up_bytes=up, launches=4 * 3)}
+            "roofline": roofline(dt, flops=flops, hbm_bytes=hbm,
+                                 up_bytes=up, launches=launches)}
 
 
 def knn_rate(n):
@@ -598,6 +650,7 @@ WORKLOADS = {
     # the full disk-CSV -> model pipeline with per-phase timing
     "ingest": (ingest_rate, [10_000_000, 1_000_000]),
     "e2e": (e2e_rate, [10_000_000, 1_000_000]),
+    "e2e_rf": (e2e_rf_rate, [2_000_000, 400_000]),
     # deep-scale points, run AFTER everything else in main(): a timeout
     # here must not down-mode the remaining workloads
     "rf_huge": (rf_huge_rate, [8_000_000]),
@@ -893,7 +946,7 @@ def main():
     device_ok = platform is not None and platform != "cpu"
     # materialize the disk fixtures OUTSIDE the watchdog children so their
     # one-time generation cost can't eat a timed workload's budget
-    for n_rows in sorted({n for w in ("ingest", "e2e", "e2e_deep")
+    for n_rows in sorted({n for w in ("ingest", "e2e", "e2e_rf", "e2e_deep")
                           if w in selected
                           for n in WORKLOADS[w][1]}):
         churn_csv(n_rows)
