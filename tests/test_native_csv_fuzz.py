@@ -2,10 +2,10 @@
 
 The C++ fast path must be bit-identical to the pure-python oracle on ANY
 well-formed input: random schemas (categorical vocabs including the empty
-string and >8-entry hash-path vocabs, fractional/negative bucket widths,
-multiple string columns), random field text (whitespace padding, signs,
-decimals, exponents), blank/whitespace-only lines, and mixed LF/CRLF
-terminators.  Seeded, so a failure reproduces exactly.
+string and >8-entry hash-path vocabs, fractional bucket widths, multiple
+string columns), random field text (whitespace padding, signs, decimals,
+exponents), blank/whitespace-only lines, and LF or CRLF terminators
+(chosen per file).  Seeded, so a failure reproduces exactly.
 """
 
 import numpy as np
