@@ -182,3 +182,24 @@ def test_train_chunked_equals_single_launch(mesh_ctx):
     np.testing.assert_array_equal(full.cont_post_mean, small.cont_post_mean)
     np.testing.assert_array_equal(full.cont_post_std, small.cont_post_std)
     assert full.to_lines() == small.to_lines()
+
+
+def test_prefix_mask_kernel_matches_explicit_mask():
+    """The device-synthesized prefix mask (scalar k upload) must reproduce
+    the explicit byte-mask kernel exactly for every prefix length."""
+    import jax.numpy as jnp
+    import numpy as np
+    from avenir_tpu.models.bayes import _train_kernel, _train_kernel_prefix
+    rng = np.random.default_rng(4)
+    n, F, C, bmax = 512, 3, 2, 12
+    cc = rng.integers(0, C, n).astype(np.uint8)
+    bc = rng.integers(0, bmax, (n, F)).astype(np.uint8)
+    cv = rng.normal(0, 10, (n, 2)).astype(np.float32)
+    for k in (0, 1, 255, n):
+        m = np.arange(n) < k
+        a = _train_kernel(jnp.asarray(cc), jnp.asarray(bc),
+                          jnp.asarray(cv), jnp.asarray(m), C, bmax)
+        b = _train_kernel_prefix(jnp.asarray(cc), jnp.asarray(bc),
+                                 jnp.asarray(cv), jnp.int32(k), C, bmax)
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
