@@ -1,0 +1,129 @@
+"""Driver helper surface (reference python/lib/{support,util}.py)."""
+
+import numpy as np
+import pytest
+
+from avenir_tpu.utils import pyutil as pu
+
+
+def _ref_min_distances(x1, x2):
+    # reference support.py:32-39, verbatim semantics
+    out = np.zeros(len(x1))
+    for i, a in enumerate(x1):
+        out[i] = np.sqrt(np.sum((x2 - a) ** 2, axis=1)).min()
+    return out
+
+
+def _ref_min_between_rows(x):
+    # reference support.py:43-57, verbatim upper-diagonal semantics
+    n = x.shape[0] - 1
+    out = np.zeros(n)
+    for i, a in enumerate(x):
+        row = [np.sqrt(np.sum((a - b) ** 2)) for j, b in enumerate(x)
+               if j > i]
+        if i < n:
+            out[i] = min(row)
+    return out
+
+
+def test_find_min_distances_matches_reference_loop():
+    rng = np.random.default_rng(7)
+    x1 = rng.normal(size=(37, 5))
+    x2 = rng.normal(size=(23, 5))
+    np.testing.assert_allclose(pu.find_min_distances(x1, x2, chunk=8),
+                               _ref_min_distances(x1, x2))
+
+
+def test_find_min_distances_between_rows_matches_reference_loop():
+    rng = np.random.default_rng(8)
+    x = rng.normal(size=(12, 3))
+    got = pu.find_min_distances_between_rows(x)
+    assert got.shape == (11,)
+    np.testing.assert_allclose(got, _ref_min_between_rows(x))
+
+
+def test_split_data_random_is_contiguous_window():
+    x = np.arange(40).reshape(20, 2)
+    for seed in range(30):
+        win, rest = pu.split_data_random(
+            x, 6, rng=np.random.default_rng(seed))
+        assert win.shape == (6, 2) and rest.shape == (14, 2)
+        # the window is a contiguous run of the original rows
+        assert (np.diff(win[:, 0]) == 2).all()
+        # together they partition the input
+        both = np.concatenate([win, rest])
+        assert sorted(both[:, 0].tolist()) == x[:, 0].tolist()
+        # reference window range (support.py:65): last row never windowed
+        assert win[-1, 0] != x[-1, 0]
+    with pytest.raises(ValueError):
+        pu.split_data_random(x, 0)
+    # split_size == n is invalid in the reference too (randint(1, 0))
+    with pytest.raises(ValueError):
+        pu.split_data_random(x, len(x))
+
+
+def test_scale_min_max():
+    a = np.array([2.0, 4.0, 6.0])
+    np.testing.assert_allclose(pu.scale_min_max(a), [0.0, 0.5, 1.0])
+    np.testing.assert_allclose(pu.scale_min_max(np.full(3, 5.0)), 0.0)
+
+
+def test_gen_id_tokens_and_digit_weighting():
+    rng = np.random.default_rng(1)
+    ids = [pu.gen_id(16, rng=rng) for _ in range(200)]
+    assert all(len(i) == 16 and set(i) <= set(pu.ID_TOKENS) for i in ids)
+    # digits listed twice in the token table (util.py:9-10): expect
+    # roughly 10/23 digit mass, clearly above a uniform-36 3.6/13
+    digit_frac = sum(c.isdigit() for i in ids for c in i) / (200 * 16)
+    assert 0.35 < digit_frac < 0.52
+
+
+def test_select_random_sublist_distinct_and_errors():
+    rng = np.random.default_rng(2)
+    items = ["a", "b", "c", "d", "a"]  # dup collapses to 4 unique
+    got = pu.select_random_sublist_from_list(items, 4, rng=rng)
+    assert sorted(got) == ["a", "b", "c", "d"]
+    with pytest.raises(ValueError):
+        pu.select_random_sublist_from_list(items, 5)
+
+
+def test_select_random_sublist_duplicates_weight_the_draw():
+    # reference util.py:22-31 rejection-samples from the RAW list:
+    # ['a','a','b'] must pick 'a' first with probability ~2/3, not 1/2
+    rng = np.random.default_rng(5)
+    first = [pu.select_random_sublist_from_list(["a", "a", "b"], 2,
+                                                rng=rng)[0]
+             for _ in range(3000)]
+    frac_a = sum(f == "a" for f in first) / len(first)
+    assert 0.62 < frac_a < 0.71
+
+
+def test_gen_ip_address_valid_octets():
+    rng = np.random.default_rng(3)
+    for _ in range(50):
+        octets = [int(o) for o in pu.gen_ip_address(rng=rng).split(".")]
+        assert len(octets) == 4 and all(0 <= o <= 255 for o in octets)
+
+
+def test_sec_deg_poly_fit_recovers_quadratic():
+    a, b, c = 2.5, -1.0, 4.0
+    f = lambda x: a * x * x + b * x + c
+    got = pu.sec_deg_poly_fit(1.0, f(1.0), 3.0, f(3.0), -2.0, f(-2.0))
+    np.testing.assert_allclose(got, (a, b, c))
+
+
+def test_range_limit():
+    assert pu.range_limit(5, 0, 10) == 5
+    assert pu.range_limit(-1, 0, 10) == 0
+    assert pu.range_limit(11, 0, 10) == 10
+
+
+def test_get_configs_and_extract_table(tmp_path):
+    csv = tmp_path / "t.csv"
+    csv.write_text("1,2,3\n4,5,6\n")
+    props = tmp_path / "c.properties"
+    props.write_text(f"data.file={csv}\ncols=0,2\n")
+    cfg = pu.get_configs(str(props))
+    assert cfg["cols"] == "0,2"
+    tab = pu.extract_table_from_file(cfg, "data.file", "cols")
+    np.testing.assert_allclose(tab, [[1, 3], [4, 6]])
