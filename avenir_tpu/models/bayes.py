@@ -29,6 +29,7 @@ einsums plus a tiny (C,)-vector epilogue per record, all in one jitted pass.
 from __future__ import annotations
 
 import functools
+import os
 from dataclasses import dataclass
 from typing import List, Optional, Sequence, Tuple
 
@@ -194,6 +195,39 @@ def _train_kernel_prefix(cc, bc, cv, k, C, bmax):
     return _train_kernel_body(cc, bc, cv, m, C, bmax)
 
 
+def _unpack4(pk, F):
+    """Split the 4-bit packed wire matrix back into per-column codes on
+    device: byte j carries code 2j in its high nibble and code 2j+1 in
+    its low nibble; a trailing zero nibble (odd F) is sliced off.  Pure
+    elementwise shifts — XLA fuses this into the one-hot consumers, so
+    the unpack is free next to the halved link transfer."""
+    pk = pk.astype(jnp.int32)
+    both = jnp.stack([pk >> 4, pk & 15], axis=2)
+    return both.reshape(pk.shape[0], -1)[:, :F]
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4, 5))
+def _train_kernel_packed(pk, cv, m, C, bmax, F):
+    """_train_kernel over the 4-bit packed wire form (class code in
+    column 0, bin codes after): HALF the bytes of the uint8 form on the
+    host->device link, which bounds the 100M-row e2e train phase (600 MB
+    at the tunnel's ~16 MB/s — BASELINE.md round-5 device capture).
+    Usable whenever every alphabet fits in a nibble with 15 left as the
+    out-of-alphabet sentinel (nbins <= 15 and n_classes <= 15 — true of
+    the north-star churn schema and every resource/ use case)."""
+    codes = _unpack4(pk, F)
+    return _train_kernel_body(codes[:, 0], codes[:, 1:], cv, m, C, bmax)
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4, 5))
+def _train_kernel_prefix_packed(pk, cv, k, C, bmax, F):
+    """Packed wire form + device-synthesized prefix mask: the minimal
+    single-process transfer — ceil((1+Fb)/2) bytes/row, no mask byte."""
+    codes = _unpack4(pk, F)
+    m = jnp.arange(pk.shape[0], dtype=jnp.int32) < k
+    return _train_kernel_body(codes[:, 0], codes[:, 1:], cv, m, C, bmax)
+
+
 def _train_kernel_body(cc, bc, cv, m, C, bmax):
     cc = cc.astype(jnp.int32)
     bc = bc.astype(jnp.int32)
@@ -263,17 +297,57 @@ def train(table: ColumnarTable, ctx: Optional[MeshContext] = None,
                             codes, 255).astype(np.uint8)
         return codes.astype(np.int32)
 
-    cls_host = narrow(padded.columns[class_field.ordinal], C)
-    if binned:
-        # column-at-a-time into the preallocated wire matrix: a stacked
-        # (n, F) int32 intermediate plus a whole-matrix narrow() pass
-        # measured ~30 s of the 100M-row train prep
-        bin_host = np.empty((n, len(binned)),
-                            dtype=np.uint8 if bmax <= 255 else np.int32)
-        for j, f in enumerate(binned):
-            bin_host[:, j] = narrow(padded.binned_codes(f.ordinal), bmax)
+    # 4-bit packed wire form when every alphabet fits in a nibble with 15
+    # as the out-of-alphabet sentinel: HALF the uint8 form's bytes on the
+    # host->device link, which bounds the 100M-row train phase (600 MB at
+    # the tunnel's ~16 MB/s).  Column 0 is the class code, bin codes
+    # follow; codes 2j / 2j+1 share byte j (high/low nibble).
+    # Auto mode packs only on a REAL device: the nibble-OR host pass
+    # costs ~0.1 s/10M rows, which the CPU backend (no link to win back)
+    # measured as a pure 15-25% train-phase loss — see BASELINE.md.
+    # AVENIR_TPU_WIRE_PACK4=1/0 forces either path (tests, A/B runs).
+    env_pack4 = os.environ.get("AVENIR_TPU_WIRE_PACK4", "auto")
+    fits4 = C <= 15 and bmax <= 15
+    pack4 = (fits4 and env_pack4 != "0"
+             and (env_pack4 == "1" or ctx.device_platform != "cpu"))
+    if env_pack4 == "1" and not fits4:
+        # an A/B run that THINKS it measured the packed form must not
+        # silently record the uint8 path
+        import warnings
+        warnings.warn(
+            f"AVENIR_TPU_WIRE_PACK4=1 ignored: alphabets don't fit a "
+            f"nibble (C={C}, bmax={bmax}); using the uint8 wire form")
+    F_packed = 1 + len(binned)
+
+    def narrow4(codes, alphabet):
+        codes = np.asarray(codes)
+        return np.where((codes >= 0) & (codes < alphabet),
+                        codes, 15).astype(np.uint8)
+
+    if pack4:
+        # nibble-packed column-at-a-time into the preallocated matrix —
+        # same single-pass discipline as the uint8 fill below.  No
+        # separate cls_host/bin_host in this form: column 0 is the class,
+        # bins follow, and the kernels unpack everything from pk_host.
+        cols = [(padded.columns[class_field.ordinal], C)]
+        cols += [(padded.binned_codes(f.ordinal), bmax) for f in binned]
+        pk_host = np.zeros((n, (F_packed + 1) // 2), dtype=np.uint8)
+        for j, (codes, alphabet) in enumerate(cols):
+            col = narrow4(codes, alphabet)
+            pk_host[:, j // 2] |= (col << 4) if j % 2 == 0 else col
+        cls_host = bin_host = None
     else:
-        bin_host = np.zeros((n, 0), dtype=np.int32)
+        cls_host = narrow(padded.columns[class_field.ordinal], C)
+        if binned:
+            # column-at-a-time into the preallocated wire matrix: a
+            # stacked (n, F) int32 intermediate plus a whole-matrix
+            # narrow() pass measured ~30 s of the 100M-row train prep
+            bin_host = np.empty((n, len(binned)),
+                                dtype=np.uint8 if bmax <= 255 else np.int32)
+            for j, f in enumerate(binned):
+                bin_host[:, j] = narrow(padded.binned_codes(f.ordinal), bmax)
+        else:
+            bin_host = np.zeros((n, 0), dtype=np.int32)
     if cont:
         # reference parses continuous values as integers (long)
         cont_host = np.empty((n, len(cont)), dtype=np.float32)
@@ -297,7 +371,7 @@ def train(table: ColumnarTable, ctx: Optional[MeshContext] = None,
     chunk = max(align,
                 min(max(chunk_rows - chunk_rows % align, align),
                     n_goal + (-n_goal) % align))
-    Fb, Fc = bin_host.shape[1], cont_host.shape[1]
+    Fb, Fc = len(binned), cont_host.shape[1]
     counts = np.zeros((C, Fb, bmax), dtype=np.float64)
     cls_counts = np.zeros((C,), dtype=np.float64)
     moments = np.zeros((C, Fc, 3), dtype=np.float64)
@@ -311,23 +385,39 @@ def train(table: ColumnarTable, ctx: Optional[MeshContext] = None,
     for s in range(0, n_goal, chunk):  # PaddedTable constructor
         e = min(s + chunk, n)
         lo = min(s, n)
-        cc, bc = cls_host[lo:e], bin_host[lo:e]
         cv = cont_host[lo:e]
         mm = None if prefix_ok else mask_host[lo:e]
-        if e - lo < chunk:
-            # tail (or past-local-end) padded to the ONE compiled chunk
-            # shape, masked out
-            pad = chunk - (e - lo)
-            cc = np.pad(cc, (0, pad))
-            bc = np.pad(bc, ((0, pad), (0, 0)))
+        pad = chunk - (e - lo)
+        if pack4:
+            pk = pk_host[lo:e]
+            if pad:
+                # tail (or past-local-end) padded to the ONE compiled
+                # chunk shape, masked out.  Zero bytes unpack to code 0,
+                # which the mask drops — same as the uint8 path's zeros.
+                pk = np.pad(pk, ((0, pad), (0, 0)))
+        else:
+            cc, bc = cls_host[lo:e], bin_host[lo:e]
+            if pad:
+                cc = np.pad(cc, (0, pad))
+                bc = np.pad(bc, ((0, pad), (0, 0)))
+        if pad:
             cv = np.pad(cv, ((0, pad), (0, 0)))
             if mm is not None:
                 mm = np.pad(mm, (0, pad))
         if prefix_ok:
             k = int(np.clip(n_valid - lo, 0, chunk))
-            c_, cl_, mo_ = _train_kernel_prefix(
-                ctx.shard_rows(cc), ctx.shard_rows(bc),
-                ctx.shard_rows(cv), jnp.int32(k), C, bmax)
+            if pack4:
+                c_, cl_, mo_ = _train_kernel_prefix_packed(
+                    ctx.shard_rows(pk), ctx.shard_rows(cv),
+                    jnp.int32(k), C, bmax, F_packed)
+            else:
+                c_, cl_, mo_ = _train_kernel_prefix(
+                    ctx.shard_rows(cc), ctx.shard_rows(bc),
+                    ctx.shard_rows(cv), jnp.int32(k), C, bmax)
+        elif pack4:
+            c_, cl_, mo_ = _train_kernel_packed(
+                ctx.shard_rows(pk), ctx.shard_rows(cv),
+                ctx.shard_rows(mm), C, bmax, F_packed)
         else:
             c_, cl_, mo_ = _train_kernel(
                 ctx.shard_rows(cc), ctx.shard_rows(bc),

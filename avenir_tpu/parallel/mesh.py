@@ -72,6 +72,13 @@ class MeshContext:
     def n_devices(self) -> int:
         return self.mesh.devices.size
 
+    @property
+    def device_platform(self) -> str:
+        """Platform string of the mesh's devices ("cpu", "tpu", ...) —
+        lets wire-format choices trade host work against link bytes only
+        where a real (slow) host->device link exists."""
+        return self.mesh.devices.flat[0].platform
+
     def row_sharding(self) -> NamedSharding:
         return NamedSharding(self.mesh, P(self.axis))
 

@@ -203,3 +203,67 @@ def test_prefix_mask_kernel_matches_explicit_mask():
                                  jnp.asarray(cv), jnp.int32(k), C, bmax)
         for x, y in zip(a, b):
             np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_pack4_wire_form_equals_uint8_wire_form(mesh_ctx, monkeypatch):
+    """The 4-bit packed wire form (class + bin codes two-per-byte, half
+    the link bytes) must produce the IDENTICAL model to the uint8 form,
+    including with chunked streaming and unknown/out-of-range codes."""
+    rows = make_rows(np.random.default_rng(11), 700)
+    rows[3][1] = "enterprise"   # unknown categorical -> code -1 -> sentinel
+    rows[5][2] = "99999"        # out-of-range bin
+    table = encode_rows(rows, SCHEMA)
+    monkeypatch.setenv("AVENIR_TPU_WIRE_PACK4", "1")  # auto is off on cpu
+    packed = bayes.train(table, mesh_ctx)
+    packed_chunked = bayes.train(table, mesh_ctx, chunk_rows=256)
+    monkeypatch.setenv("AVENIR_TPU_WIRE_PACK4", "0")
+    wide = bayes.train(table, mesh_ctx)
+    assert packed.to_lines() == wide.to_lines()
+    assert packed_chunked.to_lines() == wide.to_lines()
+    np.testing.assert_array_equal(packed.post_counts, wide.post_counts)
+    np.testing.assert_array_equal(packed.class_counts, wide.class_counts)
+    np.testing.assert_array_equal(packed.cont_post_mean, wide.cont_post_mean)
+    np.testing.assert_array_equal(packed.cont_post_std, wide.cont_post_std)
+
+
+def test_pack4_kernels_match_unpacked_kernels():
+    """Nibble layout oracle: _unpack4(pack(codes)) == codes for odd and
+    even column counts, and the packed kernels reproduce the unpacked
+    kernels bit-for-bit (explicit mask AND prefix variants)."""
+    import jax.numpy as jnp
+    from avenir_tpu.models.bayes import (
+        _train_kernel, _train_kernel_packed, _train_kernel_prefix,
+        _train_kernel_prefix_packed, _unpack4)
+    rng = np.random.default_rng(6)
+    n, C, bmax = 256, 3, 13
+    for Fb in (2, 3):           # F_packed = 3 (odd) and 4 (even)
+        F = 1 + Fb
+        cc = rng.integers(0, C, n).astype(np.uint8)
+        bc = rng.integers(0, bmax, (n, Fb)).astype(np.uint8)
+        # sprinkle sentinels (15 = out-of-alphabet in the packed form,
+        # equivalent to 255 in the uint8 form)
+        cc[::17] = 15
+        bc[::13, 0] = 15
+        cv = rng.normal(0, 5, (n, 1)).astype(np.float32)
+        codes = np.concatenate([cc[:, None], bc], axis=1)
+        pk = np.zeros((n, (F + 1) // 2), dtype=np.uint8)
+        for j in range(F):
+            col = codes[:, j]
+            pk[:, j // 2] |= (col << 4) if j % 2 == 0 else col
+        np.testing.assert_array_equal(
+            np.asarray(_unpack4(jnp.asarray(pk), F)), codes)
+        wide_cc = np.where(cc == 15, 255, cc).astype(np.uint8)
+        wide_bc = np.where(bc == 15, 255, bc).astype(np.uint8)
+        m = np.arange(n) < 200
+        a = _train_kernel(jnp.asarray(wide_cc), jnp.asarray(wide_bc),
+                          jnp.asarray(cv), jnp.asarray(m), C, bmax)
+        b = _train_kernel_packed(jnp.asarray(pk), jnp.asarray(cv),
+                                 jnp.asarray(m), C, bmax, F)
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+        c = _train_kernel_prefix(jnp.asarray(wide_cc), jnp.asarray(wide_bc),
+                                 jnp.asarray(cv), jnp.int32(200), C, bmax)
+        d = _train_kernel_prefix_packed(jnp.asarray(pk), jnp.asarray(cv),
+                                        jnp.int32(200), C, bmax, F)
+        for x, y in zip(c, d):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
