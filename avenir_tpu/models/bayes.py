@@ -566,7 +566,11 @@ def _predict_kernel(bc, cv, nbins_arr, log_post, log_prior, log_class,
         diff = top2[:, 0] - top2[:, 1]
     else:
         diff = jnp.full(pct.shape[:1], 100, dtype=jnp.int32)
-    return (pct, best, pred_prob, diff,
+    # the three eager per-row outputs leave as ONE (3, n) array: each
+    # separate readback costs a full ~62 ms tunnel round trip
+    # (TPU_NOTES.md section 5), so fusing them cuts two round trips off
+    # every predict call.  Same int32 values, just stacked.
+    return (pct, jnp.stack([best, pred_prob, diff]),
             jnp.exp(log_px), jnp.exp(log_px_c))
 
 
@@ -655,18 +659,17 @@ def predict(model: NaiveBayesModel, table: ColumnarTable,
     bc = ctx.shard_rows(bin_codes)
     cv = ctx.shard_rows(cont_vals)
 
-    (pct_dev, best_dev, prob_dev, diff_dev,
-     px_dev, pxc_dev) = _predict_kernel(
+    pct_dev, eager_dev, px_dev, pxc_dev = _predict_kernel(
         bc, cv, nbins_arr, log_post, log_prior, log_class,
         cpm, cps, cqm, cqs)
-    # only the three (n,) vectors cross the link eagerly; the full (n, C)
-    # percent table and raw feature probabilities stay device-side until
-    # the arbitration / feature-prob-only modes ask for them.  The
-    # device argmax/max/top-2-diff match np.argmax (first max) and the
+    # only the fused (3, n) int32 block crosses the link eagerly (ONE
+    # round trip); the full (n, C) percent table and raw feature
+    # probabilities stay device-side until the arbitration /
+    # feature-prob-only modes ask for them.  The device
+    # argmax/max/top-2-diff match np.argmax (first max) and the
     # np.sort-based diff (defaultArbitrate :345-365) exactly on ints
-    best = np.asarray(best_dev)[:table.n_rows]
-    pred_prob = np.asarray(prob_dev)[:table.n_rows]
-    diff = np.asarray(diff_dev)[:table.n_rows]
+    eager = np.asarray(eager_dev)[:, :table.n_rows]
+    best, pred_prob, diff = eager[0], eager[1], eager[2]
     pred_class = [model.class_values[i] for i in best]
     return PredictionResult(pred_class=pred_class, pred_prob=pred_prob,
                             class_probs=pct_dev, class_prob_diff=diff,
