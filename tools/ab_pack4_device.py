@@ -1,0 +1,69 @@
+"""Device A/B for the 4-bit packed NB wire form (BASELINE.md round-5).
+
+Loads the cached 10M-row churn CSV, then times chunk-streamed NB train
+on the real device with AVENIR_TPU_WIRE_PACK4 forced 1 and 0
+(alternating reps, readback-based timing — ``block_until_ready`` lies on
+this platform, TPU_NOTES §6).  Writes PACK4_AB.json and prints one JSON
+line.  Run only inside a healthy tunnel window (the opportunistic
+capturer invokes it after a successful bench capture).
+"""
+
+import json
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+
+def main():
+    import jax
+    from avenir_tpu.core.schema import FeatureSchema
+    from avenir_tpu.core.table import load_csv
+    from avenir_tpu.models import bayes
+    from avenir_tpu.parallel.mesh import runtime_context
+
+    ctx = runtime_context()
+    platform = ctx.device_platform
+    path = os.path.join("/tmp/avenir_tpu_bench_data", "churn_10000000.csv")
+    if not os.path.exists(path):
+        import bench
+        path = bench.churn_csv(10_000_000)
+    schema = FeatureSchema.from_dict(
+        json.load(open(os.path.join(HERE, "resource", "churn.json"))))
+    table = load_csv(path, schema, ",")
+
+    def timed_train(mode):
+        os.environ["AVENIR_TPU_WIRE_PACK4"] = mode
+        t0 = time.time()
+        model = bayes.train(table, ctx)
+        # train() reads counts back to host f64 every chunk, so the wall
+        # time already includes full device sync
+        assert model.total > 0
+        return time.time() - t0
+
+    for mode in ("1", "0"):       # warm both compiled paths
+        timed_train(mode)
+    times = {"1": [], "0": []}
+    for _ in range(3):
+        for mode in ("1", "0"):
+            times[mode].append(round(timed_train(mode), 3))
+    out = {
+        "platform": platform,
+        "n_rows": table.n_rows,
+        "packed_s": times["1"],
+        "uint8_s": times["0"],
+        "packed_min_s": min(times["1"]),
+        "uint8_min_s": min(times["0"]),
+        "speedup_min": round(min(times["0"]) / min(times["1"]), 3),
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    with open(os.path.join(HERE, "PACK4_AB.json"), "w") as fh:
+        json.dump(out, fh, indent=1)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -91,6 +91,18 @@ def main():
                                       or [""])[-1][:400]})
                     except subprocess.TimeoutExpired:
                         log({"event": "profile_timeout"})
+                    # still in the window: device A/B for the 4-bit
+                    # packed NB wire form (BASELINE.md round-5)
+                    try:
+                        ab = subprocess.run(
+                            [sys.executable, "tools/ab_pack4_device.py"],
+                            cwd=HERE, capture_output=True, text=True,
+                            timeout=900)
+                        log({"event": "pack4_ab", "rc": ab.returncode,
+                             "line": (ab.stdout.strip().splitlines()
+                                      or [""])[-1][:400]})
+                    except subprocess.TimeoutExpired:
+                        log({"event": "pack4_ab_timeout"})
                     return 0
             except subprocess.TimeoutExpired:
                 log({"event": "bench_timeout"})
