@@ -517,9 +517,6 @@ def _predict_kernel(bc, cv, nbins_arr, log_post, log_prior, log_class,
     each output picks exactly ONE table value, bit-identical to the gather
     they replace — which lowered to a scalar loop on TPU and throttled
     predict to ~0.02M rows/sec."""
-    C = log_post.shape[0]
-    bmax = log_post.shape[2]
-    Fb = bc.shape[1]
     # codes arrive as uint8 when every bin id fits (255 = the unknown
     # sentinel) — the ~16 MB/s host->device tunnel makes predict
     # upload-bound, so the transfer ships the narrowest dtype and decodes
@@ -530,6 +527,30 @@ def _predict_kernel(bc, cv, nbins_arr, log_post, log_prior, log_class,
     else:
         bci = bc
         unknown = bci < 0
+    return _predict_body(bci, unknown, cv, nbins_arr, log_post, log_prior,
+                         log_class, cpm, cps, cqm, cqs)
+
+
+@functools.partial(jax.jit, static_argnums=(10,))
+def _predict_kernel_packed(pk, cv, nbins_arr, log_post, log_prior,
+                           log_class, cpm, cps, cqm, cqs, F):
+    """_predict_kernel over the 4-bit packed wire form (bin codes two per
+    byte, sentinel 15 = unknown/out-of-range): HALF the upload bytes on
+    the link-bound predict path.  Usable when every feature's alphabet
+    fits a nibble; a code in [nbins_f, 15) is dropped by the same
+    per-field ``nbins_arr`` check as the uint8 form, so outputs are
+    bit-identical."""
+    bci = _unpack4(pk, F)
+    unknown = bci == 15
+    return _predict_body(bci, unknown, cv, nbins_arr, log_post, log_prior,
+                         log_class, cpm, cps, cqm, cqs)
+
+
+def _predict_body(bci, unknown, cv, nbins_arr, log_post, log_prior,
+                  log_class, cpm, cps, cqm, cqs):
+    C = log_post.shape[0]
+    bmax = log_post.shape[2]
+    Fb = bci.shape[1]
     safe = jnp.clip(bci, 0, bmax - 1)                     # (n, Fb)
     # unknown categorical or out-of-alphabet bin: skip the feature
     # entirely (contribute to neither P(x|c) nor P(x)); the reference's
@@ -644,24 +665,45 @@ def predict(model: NaiveBayesModel, table: ColumnarTable,
     # into a valid bin id under uint8 and poison the lookup.
     max_bins = max(model.num_bins) if model.num_bins else 0
     u8 = max_bins < 255
-    bin_codes = np.empty((padded.n_rows, len(binned_fields)),
-                         dtype=np.uint8 if u8 else np.int32)
-    for j, f in enumerate(binned_fields):
-        codes = padded.binned_codes(f.ordinal)
-        if u8:
-            codes = np.where((codes < 0) | (codes >= 255), 255, codes)
-        bin_codes[:, j] = codes
+    # 4-bit packed upload when every alphabet fits a nibble (sentinel 15;
+    # same auto-gate + env override as train(): the nibble pass is host
+    # cost that only pays for itself across a real device link).  A code
+    # in [nbins_f, 15) survives the pack and is dropped by the kernel's
+    # per-field nbins check exactly like the uint8 form.
+    env_pack4 = os.environ.get("AVENIR_TPU_WIRE_PACK4", "auto")
+    pack4 = (max_bins <= 15 and env_pack4 != "0"
+             and (env_pack4 == "1" or ctx.device_platform != "cpu"))
+    Fb = len(binned_fields)
+    if pack4:
+        pk_host = np.zeros((padded.n_rows, (Fb + 1) // 2), dtype=np.uint8)
+        for j, f in enumerate(binned_fields):
+            codes = padded.binned_codes(f.ordinal)
+            col = np.where((codes < 0) | (codes >= 15), 15,
+                           codes).astype(np.uint8)
+            pk_host[:, j // 2] |= (col << 4) if j % 2 == 0 else col
+    else:
+        bin_codes = np.empty((padded.n_rows, Fb),
+                             dtype=np.uint8 if u8 else np.int32)
+        for j, f in enumerate(binned_fields):
+            codes = padded.binned_codes(f.ordinal)
+            if u8:
+                codes = np.where((codes < 0) | (codes >= 255), 255, codes)
+            bin_codes[:, j] = codes
     cont_vals = np.empty((padded.n_rows, len(cont_fields)),
                          dtype=np.float32)
     for j, f in enumerate(cont_fields):
         # reference parses continuous values as integers (long)
         cont_vals[:, j] = np.trunc(padded.columns[f.ordinal])
-    bc = ctx.shard_rows(bin_codes)
     cv = ctx.shard_rows(cont_vals)
 
-    pct_dev, eager_dev, px_dev, pxc_dev = _predict_kernel(
-        bc, cv, nbins_arr, log_post, log_prior, log_class,
-        cpm, cps, cqm, cqs)
+    if pack4:
+        pct_dev, eager_dev, px_dev, pxc_dev = _predict_kernel_packed(
+            ctx.shard_rows(pk_host), cv, nbins_arr, log_post, log_prior,
+            log_class, cpm, cps, cqm, cqs, Fb)
+    else:
+        pct_dev, eager_dev, px_dev, pxc_dev = _predict_kernel(
+            ctx.shard_rows(bin_codes), cv, nbins_arr, log_post, log_prior,
+            log_class, cpm, cps, cqm, cqs)
     # only the fused (3, n) int32 block crosses the link eagerly (ONE
     # round trip); the full (n, C) percent table and raw feature
     # probabilities stay device-side until the arbitration /

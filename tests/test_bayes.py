@@ -267,3 +267,24 @@ def test_pack4_kernels_match_unpacked_kernels():
                                         jnp.int32(200), C, bmax, F)
         for x, y in zip(c, d):
             np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_pack4_predict_equals_uint8_predict(data, mesh_ctx, monkeypatch):
+    """The 4-bit packed predict upload must reproduce the uint8 path's
+    outputs exactly, including unknown categoricals and out-of-range
+    bucketed values (both collapse to the skip sentinel)."""
+    m = bayes.train(data, mesh_ctx)
+    rows = make_rows(np.random.default_rng(13), 300)
+    rows[2][1] = "enterprise"   # unknown categorical
+    rows[4][2] = "12000"        # bin 240: out-of-alphabet, uint8-range
+    rows[6][2] = "999999"       # bin ~20000: out of uint8 range too
+    table = encode_rows(rows, SCHEMA)
+    monkeypatch.setenv("AVENIR_TPU_WIRE_PACK4", "1")  # auto is off on cpu
+    rp = bayes.predict(m, table)
+    monkeypatch.setenv("AVENIR_TPU_WIRE_PACK4", "0")
+    rw = bayes.predict(m, table)
+    assert rp.pred_class == rw.pred_class
+    np.testing.assert_array_equal(rp.pred_prob, rw.pred_prob)
+    np.testing.assert_array_equal(rp.class_prob_diff, rw.class_prob_diff)
+    np.testing.assert_array_equal(np.asarray(rp.class_probs),
+                                  np.asarray(rw.class_probs))

@@ -49,6 +49,25 @@ def main():
     for _ in range(3):
         for mode in ("1", "0"):
             times[mode].append(round(timed_train(mode), 3))
+
+    # predict upload A/B on the same table (packed vs uint8 bin codes)
+    os.environ["AVENIR_TPU_WIRE_PACK4"] = "0"
+    model = bayes.train(table, ctx)
+
+    def timed_predict(mode):
+        os.environ["AVENIR_TPU_WIRE_PACK4"] = mode
+        t0 = time.time()
+        res = bayes.predict(model, table)
+        assert len(res.pred_class) == table.n_rows  # forces the readback
+        return time.time() - t0
+
+    for mode in ("1", "0"):
+        timed_predict(mode)
+    ptimes = {"1": [], "0": []}
+    for _ in range(3):
+        for mode in ("1", "0"):
+            ptimes[mode].append(round(timed_predict(mode), 3))
+
     out = {
         "platform": platform,
         "n_rows": table.n_rows,
@@ -57,6 +76,10 @@ def main():
         "packed_min_s": min(times["1"]),
         "uint8_min_s": min(times["0"]),
         "speedup_min": round(min(times["0"]) / min(times["1"]), 3),
+        "predict_packed_s": ptimes["1"],
+        "predict_uint8_s": ptimes["0"],
+        "predict_speedup_min": round(
+            min(ptimes["0"]) / max(min(ptimes["1"]), 1e-9), 3),
         "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
     with open(os.path.join(HERE, "PACK4_AB.json"), "w") as fh:
