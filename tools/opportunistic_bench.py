@@ -80,17 +80,20 @@ def main():
                     log({"event": "captured"})
                     # same healthy window: run the roofline-vs-profiler
                     # reconciliation (VERDICT r4 #8) while the tunnel is up
-                    try:
-                        prof = subprocess.run(
-                            [sys.executable,
-                             "tools/profile_nb_roofline.py"],
-                            cwd=HERE, capture_output=True, text=True,
-                            timeout=900)
-                        log({"event": "profile", "rc": prof.returncode,
-                             "line": (prof.stdout.strip().splitlines()
-                                      or [""])[-1][:400]})
-                    except subprocess.TimeoutExpired:
-                        log({"event": "profile_timeout"})
+                    for wl in ("nb", "rf"):
+                        try:
+                            prof = subprocess.run(
+                                [sys.executable,
+                                 "tools/profile_nb_roofline.py",
+                                 "--workload", wl],
+                                cwd=HERE, capture_output=True, text=True,
+                                timeout=900)
+                            log({"event": f"profile_{wl}",
+                                 "rc": prof.returncode,
+                                 "line": (prof.stdout.strip().splitlines()
+                                          or [""])[-1][:400]})
+                        except subprocess.TimeoutExpired:
+                            log({"event": f"profile_{wl}_timeout"})
                     # still in the window: device A/B for the 4-bit
                     # packed NB wire form (BASELINE.md round-5)
                     try:

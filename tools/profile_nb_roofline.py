@@ -1,14 +1,21 @@
-"""Roofline-vs-profiler reconciliation for NB train (VERDICT r4 #8).
+"""Roofline-vs-profiler reconciliation for the flagship kernels
+(VERDICT r4 #8, extended to both flagship families in round 5).
 
-Captures a ``jax.profiler`` trace of the NB train kernel on the live
+Captures a ``jax.profiler`` trace of a bench workload on the live
 backend, extracts per-event device kernel times from the trace, and
 reconciles them with bench.py's MODELED flops/bytes and bound label.
-Writes a summary JSON (tools output dir) and prints the TPU_NOTES-ready
-verdict line: modeled vs measured within 2x, or which constant is off.
+Writes a summary JSON (``PROFILE_NB.json`` / ``PROFILE_RF.json``) and
+prints the TPU_NOTES-ready verdict line: modeled vs measured within 2x,
+or which constant is off.
 
 Run it inside a watchdog (the tunnel can wedge any jax call):
 
-    timeout 600 python tools/profile_nb_roofline.py [--n 8000000]
+    timeout 600 python tools/profile_nb_roofline.py [--workload nb|rf] [--n N]
+
+The NB workload is a single fused launch (kernel-vs-wall measures
+dispatch+link overhead); the RF workload is the real multi-launch
+level-synchronous forest build (kernel-vs-wall measures how much of the
+build loop is actually on-chip).
 
 The trace parse reads the ``*.trace.json.gz`` the profiler writes
 (plane: device kernels); if the runtime produces only the pb/xspace
@@ -28,56 +35,8 @@ HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, HERE)
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--n", type=int, default=8_000_000)
-    ap.add_argument("--out", default=os.path.join(HERE, "PROFILE_NB.json"))
-    args = ap.parse_args()
-
-    import jax
-    # sitecustomize freezes JAX_PLATFORMS=axon at interpreter start; honor
-    # an explicit env override (the bench children do the same)
-    want = os.environ.get("JAX_PLATFORMS")
-    if want and want != jax.config.jax_platforms:
-        jax.config.update("jax_platforms", want)
-    import numpy as np
-    import bench
-
-    platform = jax.devices()[0].platform
-    trace_dir = os.path.join("/tmp", f"avenir_nb_trace_{os.getpid()}")
-
-    # the bench workload body, traced on the second (warm) run
-    import jax.numpy as jnp
-    from avenir_tpu.ops.histogram import class_bin_histogram_chunked
-    n = args.n
-    cls, bins = bench.gen_data(n)
-    mask = np.ones((n,), dtype=bool)
-    d_cls, d_bins, d_mask = (jax.device_put(x) for x in (cls, bins, mask))
-    reps = 4
-    chunk = min(n, 1 << 21)
-    C, B, F = bench.N_CLASSES, bench.N_BINS, bench.N_FEAT
-
-    @jax.jit
-    def many(c, b, m):
-        acc = None
-        for i in range(reps):
-            h = class_bin_histogram_chunked((c + i) % C, (b + i) % B,
-                                            C, B, m, chunk=chunk)
-            acc = h if acc is None else acc + h
-        return acc
-
-    np.asarray(many(d_cls, d_bins, d_mask))  # compile + warm
-    with jax.profiler.trace(trace_dir):
-        t0 = time.perf_counter()
-        np.asarray(many(d_cls, d_bins, d_mask))
-        wall_s = time.perf_counter() - t0
-
-    # modeled terms (bench.nb_rate's accounting)
-    flops = float(n) * reps * F * C * B * 2
-    hbm = float(n) * reps * ((F + 1) * 4 + 1)
-    model = bench.roofline(wall_s, flops=flops, hbm_bytes=hbm, launches=1)
-
-    # pull device-kernel durations out of the trace
+def _device_kernel_time(trace_dir):
+    """Sum device-lane event durations from the chrome-trace dump."""
     kernel_us, events = 0.0, 0
     parse_note = "no trace files found"
     for tj in glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
@@ -100,16 +59,94 @@ def main():
                 events += 1
         parse_note = f"parsed {tj}"
         break
+    return kernel_us, events, parse_note
 
-    out = {
-        "platform": platform,
-        "n": n, "reps": reps,
-        "wall_s": round(wall_s, 4),
-        "modeled": model,
-        "device_kernel_s": round(kernel_us / 1e6, 4),
-        "device_events": events,
-        "trace_note": parse_note,
-    }
+
+def _run_nb(args, jax, np, bench, trace_dir):
+    """NB train counting kernel: reps chained in ONE fused launch."""
+    import jax.numpy as jnp  # noqa: F401  (kernel module import path)
+    from avenir_tpu.ops.histogram import class_bin_histogram_chunked
+    n = args.n or 8_000_000
+    cls, bins = bench.gen_data(n)
+    mask = np.ones((n,), dtype=bool)
+    d_cls, d_bins, d_mask = (jax.device_put(x) for x in (cls, bins, mask))
+    reps = 4
+    chunk = min(n, 1 << 21)
+    C, B, F = bench.N_CLASSES, bench.N_BINS, bench.N_FEAT
+
+    @jax.jit
+    def many(c, b, m):
+        acc = None
+        for i in range(reps):
+            h = class_bin_histogram_chunked((c + i) % C, (b + i) % B,
+                                            C, B, m, chunk=chunk)
+            acc = h if acc is None else acc + h
+        return acc
+
+    np.asarray(many(d_cls, d_bins, d_mask))  # compile + warm
+    with jax.profiler.trace(trace_dir):
+        t0 = time.perf_counter()
+        np.asarray(many(d_cls, d_bins, d_mask))
+        wall_s = time.perf_counter() - t0
+
+    flops = float(n) * reps * F * C * B * 2
+    hbm = float(n) * reps * ((F + 1) * 4 + 1)
+    model = bench.roofline(wall_s, flops=flops, hbm_bytes=hbm, launches=1)
+    return {"n": n, "reps": reps}, wall_s, flops, model
+
+
+def _run_rf(args, jax, np, bench, trace_dir):
+    """RF build: the REAL level-synchronous 16-tree build loop (multi
+    launch, host orchestration between levels), bench.rf_rate's shape."""
+    from avenir_tpu.models.forest import ForestParams, build_forest
+    from avenir_tpu.parallel.mesh import MeshContext
+    n = args.n or 400_000
+    table = bench._bench_table(n)
+    params = ForestParams(num_trees=16, seed=1)
+    params.tree.max_depth = 4
+    ctx = MeshContext()
+    build_forest(table, params, ctx)  # compile + warm
+    with jax.profiler.trace(trace_dir):
+        t0 = time.perf_counter()
+        bench_models = build_forest(table, params, ctx)
+        wall_s = time.perf_counter() - t0
+    T = len(bench_models)
+    flops, hbm, up, launches = bench._rf_shape_terms(n, T, F=4, S=19)
+    model = bench.roofline(wall_s, flops=flops, hbm_bytes=hbm,
+                           up_bytes=up, launches=launches)
+    return {"n": n, "trees": T}, wall_s, flops, model
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workload", choices=("nb", "rf"), default="nb")
+    ap.add_argument("--n", type=int, default=None)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+    out_path = args.out or os.path.join(
+        HERE, f"PROFILE_{args.workload.upper()}.json")
+
+    import jax
+    # sitecustomize freezes JAX_PLATFORMS=axon at interpreter start; honor
+    # an explicit env override (the bench children do the same)
+    want = os.environ.get("JAX_PLATFORMS")
+    if want and want != jax.config.jax_platforms:
+        jax.config.update("jax_platforms", want)
+    import numpy as np
+    import bench
+
+    platform = jax.devices()[0].platform
+    trace_dir = os.path.join(
+        "/tmp", f"avenir_{args.workload}_trace_{os.getpid()}")
+
+    runner = _run_nb if args.workload == "nb" else _run_rf
+    shape, wall_s, flops, model = runner(args, jax, np, bench, trace_dir)
+    kernel_us, events, parse_note = _device_kernel_time(trace_dir)
+
+    out = {"platform": platform, "workload": args.workload, **shape,
+           "wall_s": round(wall_s, 4), "modeled": model,
+           "device_kernel_s": round(kernel_us / 1e6, 4),
+           "device_events": events, "trace_note": parse_note}
     if events:
         k_s = kernel_us / 1e6
         measured_gflops = flops / k_s / 1e9 if k_s > 0 else 0.0
@@ -130,7 +167,7 @@ def main():
         out["verdict"] = ("trace produced no parseable device lanes on "
                           f"this runtime ({parse_note}); wall-clock "
                           "reconciliation only")
-    with open(args.out, "w") as fh:
+    with open(out_path, "w") as fh:
         json.dump(out, fh, indent=1)
     print(json.dumps(out))
 
