@@ -288,3 +288,28 @@ def test_pack4_predict_equals_uint8_predict(data, mesh_ctx, monkeypatch):
     np.testing.assert_array_equal(rp.class_prob_diff, rw.class_prob_diff)
     np.testing.assert_array_equal(np.asarray(rp.class_probs),
                                   np.asarray(rw.class_probs))
+
+
+def test_pack4_force_flag_warns_when_alphabet_too_big(mesh_ctx, monkeypatch):
+    """AVENIR_TPU_WIRE_PACK4=1 on a schema whose alphabets don't fit a
+    nibble must warn and fall back, not silently mislabel an A/B run."""
+    wide_schema = FeatureSchema.from_dict({
+        "fields": [
+            {"name": "v", "ordinal": 0, "dataType": "int", "feature": True,
+             "bucketWidth": 10, "min": 0, "max": 500},   # 51 bins > 15
+            {"name": "y", "ordinal": 1, "dataType": "categorical",
+             "cardinality": ["a", "b"]},
+        ]
+    })
+    rows = [[str(i % 500), "a" if i % 3 else "b"] for i in range(64)]
+    table = encode_rows(rows, wide_schema)
+    monkeypatch.setenv("AVENIR_TPU_WIRE_PACK4", "1")
+    with pytest.warns(UserWarning, match="don't fit a nibble"):
+        m = bayes.train(table, mesh_ctx)
+    # and the fallback still trains correctly
+    assert m.total == 64
+
+
+def test_mesh_context_device_platform(mesh_ctx):
+    """The wire-format auto-gate keys off this: the test mesh is CPU."""
+    assert mesh_ctx.device_platform == "cpu"
