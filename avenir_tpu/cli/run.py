@@ -39,6 +39,32 @@ from . import partition_jobs  # noqa: F401  (registers split/partition jobs)
 from . import nn_jobs  # noqa: F401  (registers neural-net jobs)
 
 
+def file_sha(path: str, full: bool) -> str:
+    """Streaming content sha; cheap head+tail+size form (``full=False``)
+    for the big sharded/map inputs where a full read would double ingest
+    cost.  The cheap form also hashes strided interior samples so
+    genuinely distinct shards that agree in head, tail, and size
+    (fixed-width records differing mid-file) are not refused as
+    IDENTICAL (round-4 advisor); still O(1) reads in file size."""
+    import hashlib
+    h = hashlib.sha256()
+    size = os.path.getsize(path)
+    with open(path, "rb") as fh:
+        if full:
+            for chunk in iter(lambda: fh.read(1 << 20), b""):
+                h.update(chunk)
+        else:
+            h.update(f"{size}:".encode())
+            h.update(fh.read(1 << 16))
+            if size > (1 << 16):
+                for frac in (0.25, 0.5, 0.75):
+                    fh.seek(int(size * frac))
+                    h.update(fh.read(4096))
+                fh.seek(-(1 << 16), os.SEEK_END)
+                h.update(fh.read(1 << 16))
+    return h.hexdigest()
+
+
 def parse_args(argv: List[str]):
     job_name: Optional[str] = None
     conf_path: Optional[str] = None
@@ -144,30 +170,6 @@ def _apply_dist_mode(fn, job_name: str, in_path: Optional[str]):
             return sorted(p for p in glob.glob(os.path.join(in_path, "*"))
                           if os.path.isfile(p))
         return [in_path]
-
-    def file_sha(p, full):
-        """Streaming content sha; cheap head+tail+size form for the big
-        sharded/map inputs where a full read would double ingest cost."""
-        h = hashlib.sha256()
-        size = os.path.getsize(p)
-        with open(p, "rb") as fh:
-            if full:
-                for chunk in iter(lambda: fh.read(1 << 20), b""):
-                    h.update(chunk)
-            else:
-                h.update(f"{size}:".encode())
-                h.update(fh.read(1 << 16))
-                if size > (1 << 16):
-                    # strided interior samples: genuinely distinct shards
-                    # that agree in head, tail, and size (fixed-width
-                    # records differing mid-file) must not be refused as
-                    # IDENTICAL (round-4 advisor); still O(1) in file size
-                    for frac in (0.25, 0.5, 0.75):
-                        fh.seek(int(size * frac))
-                        h.update(fh.read(4096))
-                    fh.seek(-(1 << 16), os.SEEK_END)
-                    h.update(fh.read(1 << 16))
-        return h.hexdigest()
 
     paths = input_paths()
     # partition jobs need the same GLOBAL input view as gather (they slice
