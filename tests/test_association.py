@@ -203,3 +203,26 @@ def test_cli_association_jobs(tmp_path):
     assert rc == 0
     rule_lines = artifacts.read_text_input(str(rules_out))
     assert any("->" in line for line in rule_lines)
+
+
+def test_support_kernel_mxu_equals_gather_form():
+    """The MXU matmul formulation (sum-of-memberships == k) must produce
+    the IDENTICAL counts as the gather-product form for every candidate
+    size — exact small-integer arithmetic in both."""
+    import jax.numpy as jnp
+    import numpy as np
+    from avenir_tpu.association.itemsets import (_support_kernel_gather,
+                                                 _support_kernel_mxu)
+    rng = np.random.default_rng(11)
+    M = (rng.random((500, 40)) < 0.25).astype(np.uint8)
+    for k in (1, 2, 3, 5):
+        C = np.stack([rng.permutation(40)[:k]
+                      for _ in range(64)]).astype(np.int32)
+        a = np.asarray(_support_kernel_gather(jnp.asarray(M),
+                                              jnp.asarray(C)))
+        b = np.asarray(_support_kernel_mxu(jnp.asarray(M), jnp.asarray(C)))
+        np.testing.assert_array_equal(a, b)
+        # and both match the numpy oracle
+        want = np.array([(M[:, c].all(axis=1)).sum() for c in C],
+                        dtype=np.float32)
+        np.testing.assert_array_equal(a, want)
