@@ -483,10 +483,13 @@ class EnsembleModel:
         K = len(self.classes)
         out = []
         for s in range(0, n, chunk):
-            idx = kernel(d_vals[s:s + chunk], d_codes[s:s + chunk],
-                         *consts, wvec, jnp.float32(self.min_odds_ratio))
-            out.append(np.asarray(idx))
-        idx = np.concatenate(out)
+            out.append(kernel(d_vals[s:s + chunk], d_codes[s:s + chunk],
+                              *consts, wvec,
+                              jnp.float32(self.min_odds_ratio)))
+        # chunk results stay device-side; ONE readback for the whole
+        # batch (each separate np.asarray costs a full ~62 ms tunnel
+        # round trip — TPU_NOTES section 5)
+        idx = np.asarray(out[0] if len(out) == 1 else jnp.concatenate(out))
         lut = np.concatenate([self._cls_arr.astype(object), [None]])
         return list(lut[idx])
 

@@ -201,10 +201,14 @@ class DistanceComputer:
                 else:
                     d = self._manh_jit(tn_c, toh_c, rn_d[s:e], roh_d[s:e])
                 best_d, best_i = merge(best_d, best_i, d, s)
-            out_d.append(np.asarray(best_d))
-            out_i.append(np.asarray(best_i))
-        return (np.concatenate(out_d).astype(np.int32),
-                np.concatenate(out_i))
+            # chunk results stay device-side; the whole test axis reads
+            # back in ONE transfer per output below (each separate
+            # np.asarray costs a full ~62 ms tunnel round trip)
+            out_d.append(best_d)
+            out_i.append(best_i)
+        d_all = out_d[0] if len(out_d) == 1 else jnp.concatenate(out_d)
+        i_all = out_i[0] if len(out_i) == 1 else jnp.concatenate(out_i)
+        return (np.asarray(d_all).astype(np.int32), np.asarray(i_all))
 
     def _manhattan_tiled(self, tn, toh, rn, roh, tile):
         out = np.zeros((tn.shape[0], rn.shape[0]), dtype=np.float32)
