@@ -313,3 +313,44 @@ def test_pack4_force_flag_warns_when_alphabet_too_big(mesh_ctx, monkeypatch):
 def test_mesh_context_device_platform(mesh_ctx):
     """The wire-format auto-gate keys off this: the test mesh is CPU."""
     assert mesh_ctx.device_platform == "cpu"
+
+
+def test_pack4_fuzz_random_schemas(mesh_ctx, monkeypatch):
+    """Randomized packed-vs-uint8 equivalence across schema shapes:
+    varying feature counts (odd/even packing), alphabet sizes at the
+    nibble boundary, classes, unknown rates, and chunk sizes."""
+    rng = np.random.default_rng(17)
+    for trial in range(6):
+        n_feat = int(rng.integers(1, 6))
+        n_bins = int(rng.integers(2, 16))      # <= 15: always packable
+        n_cls = int(rng.integers(2, 4))
+        n_rows = int(rng.integers(40, 400))
+        fields = [{"name": "id", "ordinal": 0, "id": True,
+                   "dataType": "string"}]
+        for f in range(n_feat):
+            fields.append({"name": f"f{f}", "ordinal": 1 + f,
+                           "dataType": "int", "feature": True,
+                           "bucketWidth": 10, "min": 0,
+                           "max": 10 * n_bins - 1})
+        fields.append({"name": "y", "ordinal": 1 + n_feat,
+                       "dataType": "categorical",
+                       "cardinality": [f"c{k}" for k in range(n_cls)]})
+        schema = FeatureSchema.from_dict({"fields": fields})
+        rows = []
+        for i in range(n_rows):
+            vals = [str(i)]
+            for f in range(n_feat):
+                if rng.random() < 0.05:
+                    vals.append(str(10 * n_bins * 50))   # out of range
+                else:
+                    vals.append(str(int(rng.integers(0, 10 * n_bins))))
+            vals.append(f"c{int(rng.integers(0, n_cls))}")
+            rows.append(vals)
+        table = encode_rows(rows, schema)
+        chunk = int(rng.choice([64, 128, 1 << 23]))
+        monkeypatch.setenv("AVENIR_TPU_WIRE_PACK4", "1")
+        packed = bayes.train(table, mesh_ctx, chunk_rows=chunk)
+        monkeypatch.setenv("AVENIR_TPU_WIRE_PACK4", "0")
+        wide = bayes.train(table, mesh_ctx, chunk_rows=chunk)
+        assert packed.to_lines() == wide.to_lines(), \
+            f"trial {trial}: F={n_feat} B={n_bins} C={n_cls} n={n_rows}"
