@@ -909,6 +909,12 @@ def _match_ok(vals, codes, lo, hi, num_restricted, cat_mask, cat_restricted,
     and throttled predict to ~0.6M rows/sec; exact because each (n, f) row
     of the one-hot selects a single 0/1 mask cell."""
     P, F = lo.shape
+    if xp is jnp:
+        # vals may arrive int16 (FeatureCache narrow wire); upcast on
+        # device — lossless, and keeps the comparisons in native f32.
+        # The numpy twin keeps the incoming dtype: int16 vs f64 bounds
+        # promotes exactly, and its f64 vals must stay f64.
+        vals = vals.astype(jnp.float32)
     interval = (vals[:, None, :] > lo[None]) & (vals[:, None, :] <= hi[None])
     num_ok = xp.where(num_restricted[None], interval, True)
     C = cat_mask.shape[2]
@@ -1003,8 +1009,9 @@ class FeatureCache:
 
     def device(self, vals: np.ndarray, codes: np.ndarray):
         if self._dev is None:
-            self._dev = (jnp.asarray(vals.astype(np.float32)),
-                         jnp.asarray(codes))
+            # ship the NARROW dtype (int16 when feature_arrays chose it —
+            # half the link bytes); kernels upcast on device in _match_ok
+            self._dev = (jnp.asarray(vals), jnp.asarray(codes))
         return self._dev
 
 
