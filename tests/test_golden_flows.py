@@ -770,3 +770,38 @@ def test_all_driver_scripts_exist_and_are_executable():
                "visit.sh", "rtserve.sh"):
         p = os.path.join(RES, sh)
         assert os.path.exists(p) and os.access(p, os.X_OK)
+
+
+def test_shell_driver_layer_runs_end_to_end(tmp_path):
+    """The .sh driver scripts themselves (arg parsing, MODEL= env
+    convention, properties wiring) — golden flows above call the CLI
+    in-process, so the shell layer needs its own smoke: churn.sh
+    train->predict and rafo.sh build->predict, end to end via bash."""
+    import subprocess
+
+    def sh(script, *args, env_extra=None):
+        env = _driver_env()
+        if env_extra:
+            env.update(env_extra)
+        r = subprocess.run(
+            ["bash", os.path.join(RES, script), *[str(a) for a in args]],
+            capture_output=True, text=True, timeout=600, env=env, cwd=RES)
+        assert r.returncode == 0, f"{script} {args}: {r.stderr[-1500:]}"
+        return r
+
+    churn = tmp_path / "churn.csv"
+    churn.write_text("\n".join(_gen("telecom_churn_gen", 1200, 1)))
+    sh("churn.sh", "train", churn, tmp_path / "cm")
+    sh("churn.sh", "predict", churn, tmp_path / "cp",
+       env_extra={"MODEL": str(tmp_path / "cm" / "part-r-00000")})
+    assert len((tmp_path / "cp" / "part-m-00000")
+               .read_text().splitlines()) == 1200
+
+    calls = tmp_path / "calls.csv"
+    calls.write_text("\n".join(_gen("call_hangup_gen", 1200, 2)))
+    sh("rafo.sh", "build", calls, tmp_path / "fm")
+    assert (tmp_path / "fm" / "tree_0.json").exists()
+    sh("rafo.sh", "predict", calls, tmp_path / "fp",
+       env_extra={"MODEL": str(tmp_path / "fm")})
+    assert len((tmp_path / "fp" / "part-m-00000")
+               .read_text().splitlines()) == 1200
