@@ -82,8 +82,14 @@ def _random_field_text(rng, f):
 
 
 @pytest.mark.parametrize("seed", range(12))
-def test_native_matches_oracle_on_random_input(tmp_path, seed):
+def test_native_matches_oracle_on_random_input(tmp_path, seed, monkeypatch):
     rng = np.random.default_rng(1000 + seed)
+    # randomly force the thread-pool path too (explicit env shards even
+    # under the tiny-file guard), so the fuzz covers chunk-boundary
+    # stitching, not just the single-thread parse
+    threads = int(rng.choice([0, 1, 3, 7]))
+    if threads:
+        monkeypatch.setenv("AVENIR_TPU_INGEST_THREADS", str(threads))
     schema = _random_schema(rng)
     n = int(rng.integers(1, 400))
     lines = []
