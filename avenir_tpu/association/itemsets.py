@@ -47,16 +47,21 @@ def _support_kernel_mxu(M, C):
 
     Because membership is 0/1 and candidates are SETS,
     ``prod_j M[t, c_j] == (sum_j M[t, c_j] == k)`` — so support counting
-    is ONE matmul against the one-hot candidate matrix followed by an
+    is ONE matmul against the multi-hot candidate matrix followed by an
     equality test, instead of k column-gathers (gathers lower to scalar
-    loops on TPU, the r2/r3 anti-pattern).  All intermediate values are
-    small integers (<= k <= vocab), exact in any matmul precision.  M
-    arrives uint8 (4x less host->device link than f32) and upcasts here.
-    Module-level jit so each Apriori level (and each chunk) reuses ONE
-    compiled program per shape instead of recompiling per call."""
+    loops on TPU, the r2/r3 anti-pattern).  The (n_cand, V) candidate
+    matrix is built by scatter-add directly — ``one_hot(C, V)`` would
+    materialize an (n_cand, k, V) f32 intermediate before the axis-1 sum,
+    a k-fold memory blowup for a matrix the scatter writes in one pass
+    (ADVICE r5).  All intermediate values are small integers (<= k <=
+    vocab), exact in any matmul precision.  M arrives uint8 (4x less
+    host->device link than f32) and upcasts here.  Module-level jit so
+    each Apriori level (and each chunk) reuses ONE compiled program per
+    shape instead of recompiling per call."""
     k = C.shape[1]
     V = M.shape[1]
-    K = jax.nn.one_hot(C, V, dtype=jnp.float32).sum(axis=1)   # (n_cand, V)
+    rows = jnp.arange(C.shape[0], dtype=C.dtype)[:, None]     # (n_cand, 1)
+    K = jnp.zeros((C.shape[0], V), jnp.float32).at[rows, C].add(1.0)
     hits = M.astype(jnp.float32) @ K.T                        # (chunk, n_cand)
     return (hits == float(k)).astype(jnp.float32).sum(axis=0)
 
@@ -74,10 +79,17 @@ def _support_kernel_gather(M, C):
     return acc.sum(axis=0)
 
 
-def _support_kernel(M, C):
-    """Platform dispatch (same auto-gate idea as the NB wire form): the
-    MXU matmul form on a real device, the gather form on cpu."""
-    if jax.devices()[0].platform == "cpu":
+def _support_kernel(M, C, platform: Optional[str] = None):
+    """Platform dispatch (same auto-gate as the NB wire form, which reads
+    ``MeshContext.device_platform``): the MXU matmul form on a real
+    device, the gather form on cpu.  ``platform`` is the RUNTIME MESH's
+    device platform — dispatching on ``jax.devices()[0]`` (the global
+    default backend) would pick the wrong form whenever the mesh context
+    runs on a different backend than the process default (ADVICE r5)."""
+    if platform is None:
+        from ..parallel.mesh import runtime_context
+        platform = runtime_context().device_platform
+    if platform == "cpu":
         return _support_kernel_gather(M, C)
     return _support_kernel_mxu(M, C)
 
@@ -211,11 +223,13 @@ class TransactionMatrix:
         if cand_idx.size == 0:
             return np.zeros((0,), dtype=np.int64)
 
+        from ..parallel.mesh import runtime_context
+        platform = runtime_context().device_platform
         C = jnp.asarray(cand_idx)
         total = np.zeros((cand_idx.shape[0],), dtype=np.float64)
         for lo in range(0, self.matrix.shape[0], chunk):
             part = _support_kernel(jnp.asarray(self.matrix[lo:lo + chunk]),
-                                   C)
+                                   C, platform)
             total += np.asarray(part, dtype=np.float64)
         return np.rint(total).astype(np.int64)
 

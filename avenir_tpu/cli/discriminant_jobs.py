@@ -41,11 +41,27 @@ def support_vector_machine(cfg: Config, in_path: str, out_path: str
     """SMO training; emits support-vector rows (features..., target, alpha)
     plus a 'weights' model line for the linear predictor.  Keys:
     svm.feature.schema.file.path, svm.pnalty.factor, svm.tolerance, svm.eps,
-    svm.kernel.type, svm.positive.class.value."""
+    svm.kernel.type, svm.positive.class.value.
+
+    ``svm.group.field.ordinals`` trains one SVM per distinct group key —
+    the reference's per-mapper partitions (SupportVectorMachine.java:70-85)
+    — with every output line prefixed by its group key.  ``svm.solver``
+    picks the trainer: ``serial`` (Platt, the default) or ``batched`` (the
+    lock-step maximal-violating-pair device SMO,
+    discriminant/smo.py:train_groups_batched — ALL groups advance in one
+    jitted while_loop; same optimum, so per-group weights/threshold/
+    predictions agree with serial to optimization tolerance, though the
+    support-vector line SETS may differ on degenerate margins)."""
     from ..discriminant import smo as S
     counters = Counters()
     schema = _schema_path(cfg, "svm.feature.schema.file.path")
-    table = load_csv(in_path, schema, cfg.field_delim_regex)
+    group_ords = [int(o) for o in
+                  cfg.get_list("svm.group.field.ordinals") or []]
+    solver = cfg.get("svm.solver", "serial")
+    if solver not in ("serial", "batched"):
+        raise ValueError(f"svm.solver must be serial|batched, got {solver!r}")
+    table = load_csv(in_path, schema, cfg.field_delim_regex,
+                     keep_raw=bool(group_ords))
     params = S.SMOParams(
         penalty_factor=cfg.get_float("svm.pnalty.factor",
                                      cfg.get_float("svm.penalty.factor", 0.05)),
@@ -55,14 +71,37 @@ def support_vector_machine(cfg: Config, in_path: str, out_path: str
         seed=cfg.get_int("svm.random.seed", 0),
     )
     X, y = _svm_xy(cfg, table, schema)
-    model = S.SMOTrainer(params).train(X, y)
     od = cfg.field_delim_out
-    lines: List[str] = model.support_vector_lines(od)
-    lines.append(od.join(["weights"] +
-                         [f"{w:.9g}" for w in model.weights] +
-                         [f"{model.threshold:.9g}"]))
+
+    def weights_line(model, prefix=()):
+        return od.join([*prefix, "weights"] +
+                       [f"{w:.9g}" for w in model.weights] +
+                       [f"{model.threshold:.9g}"])
+
+    lines: List[str] = []
+    if group_ords:
+        row_idx: dict = {}
+        for i, r in enumerate(table.raw_rows):
+            row_idx.setdefault(od.join(r[o] for o in group_ords),
+                               []).append(i)
+        gxy = {g: (X[idx], y[idx]) for g, idx in row_idx.items()}
+        models = S.train_groups(gxy, params, batched=(solver == "batched"))
+        n_sv = 0
+        for g in sorted(models):
+            m = models[g]
+            n_sv += len(m.sup_vec_idx)
+            lines.extend(od.join([g, sv])
+                         for sv in m.support_vector_lines(od))
+            lines.append(weights_line(m, prefix=(g,)))
+        counters.set("SVM", "groups", len(models))
+        counters.set("SVM", "supportVectors", n_sv)
+    else:
+        model = S.train_groups({"": (X, y)}, params,
+                               batched=(solver == "batched"))[""]
+        lines = model.support_vector_lines(od)
+        lines.append(weights_line(model))
+        counters.set("SVM", "supportVectors", len(model.sup_vec_idx))
     artifacts.write_text_output(out_path, lines)
-    counters.set("SVM", "supportVectors", len(model.sup_vec_idx))
     counters.set("SVM", "rows", table.n_rows)
     return counters
 

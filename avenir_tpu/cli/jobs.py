@@ -145,15 +145,30 @@ def decision_tree_builder(cfg: Config, in_path: str, out_path: str) -> Counters:
 def random_forest_builder(cfg: Config, in_path: str, out_path: str) -> Counters:
     """Full in-process random forest: the rafo.sh per-tree rerun loop
     (resource/rafo.sh:34-43) collapsed into one job.  Writes one decision-path
-    JSON per tree into the output dir (tree_<i>.json)."""
-    from ..models.forest import ForestParams, build_forest
+    JSON per tree into the output dir (tree_<i>.json).
+
+    ``dtb.streaming.ingest=true`` trains through the chunked CSV->device
+    pipeline (block size ``dtb.streaming.block.rows``): host memory holds
+    one parsed block instead of the whole encoded dataset — the knob that
+    makes the 100M-row flagship CSV feasible.  Models are bit-identical to
+    the monolithic path."""
+    from ..models.forest import (ForestParams, build_forest,
+                                 build_forest_from_stream)
     counters = Counters()
     schema = _schema_path(cfg, "dtb.feature.schema.file.path")
-    table = load_csv(in_path, schema, cfg.field_delim_regex)
     params = ForestParams(tree=_tree_params(cfg),
                           num_trees=cfg.get_int("dtb.num.trees", 5),
                           seed=cfg.get_int("dtb.random.seed", 0))
-    models = build_forest(table, params, runtime_context())
+    if cfg.get_boolean("dtb.streaming.ingest", False):
+        from ..core.table import iter_csv_chunks, prefetch_chunks
+        blocks = prefetch_chunks(iter_csv_chunks(
+            in_path, schema, cfg.field_delim_regex,
+            chunk_rows=cfg.get_int("dtb.streaming.block.rows", 1 << 22)))
+        models = build_forest_from_stream(blocks, schema, params,
+                                          runtime_context())
+    else:
+        table = load_csv(in_path, schema, cfg.field_delim_regex)
+        models = build_forest(table, params, runtime_context())
     os.makedirs(out_path, exist_ok=True)
     for i, dpl in enumerate(models):
         with open(os.path.join(out_path, f"tree_{i}.json"), "w") as fh:

@@ -152,6 +152,46 @@ class ColumnarTable:
             binned_cache={k: v[lo:hi]
                           for k, v in self.binned_cache.items()})
 
+    @classmethod
+    def from_chunks(cls, chunks: Sequence["ColumnarTable"]) -> "ColumnarTable":
+        """Assemble contiguous row blocks (same schema, in row order) into
+        one table — the inverse of chunked ingest.  Encoded columns and bin
+        caches concatenate; string columns concatenate as one joined
+        blob+offsets when every block carries the LazyStringColumn form
+        (the native chunk reader's output), else as plain lists.  The
+        result is byte-identical to loading the whole file at once
+        (tests/test_native_csv_fuzz.py proves it on fuzzed schemas)."""
+        chunks = list(chunks)
+        if not chunks:
+            raise ValueError("from_chunks needs at least one chunk")
+        schema = chunks[0].schema
+        n = sum(c.n_rows for c in chunks)
+        columns = {o: np.concatenate([c.columns[o] for c in chunks])
+                   for o in chunks[0].columns}
+        binned: Dict[int, np.ndarray] = {}
+        for o in chunks[0].binned_cache:
+            if all(o in c.binned_cache for c in chunks):
+                arr = np.concatenate([c.binned_cache[o] for c in chunks])
+                # keep the native path's freeze-by-reference contract
+                arr.flags.writeable = False
+                binned[o] = arr
+        str_columns: Dict[int, List[str]] = {}
+        for o in chunks[0].str_columns:
+            cols = [c.str_columns[o] for c in chunks]
+            if all(isinstance(c, LazyStringColumn) for c in cols):
+                str_columns[o] = _concat_lazy_strings(cols)
+            else:
+                merged: List[str] = []
+                for c in cols:
+                    merged.extend(c)
+                str_columns[o] = merged
+        raw = None
+        if all(c.raw_rows is not None for c in chunks):
+            raw = [r for c in chunks for r in c.raw_rows]
+        return cls(schema=schema, n_rows=n, columns=columns,
+                   str_columns=str_columns, raw_rows=raw,
+                   binned_cache=binned)
+
     def pad_to_multiple(self, multiple: int) -> "PaddedTable":
         """Pad all encoded columns with zeros to a row count divisible by
         ``multiple`` (the mesh data-axis size) and return the padded view with
@@ -194,6 +234,21 @@ class ColumnarTable:
 class PaddedTable(ColumnarTable):
     valid_mask: np.ndarray = None  # type: ignore[assignment]
     n_valid: int = 0
+
+
+def _concat_lazy_strings(cols: Sequence[LazyStringColumn]
+                         ) -> LazyStringColumn:
+    """Join per-chunk blob+offset string columns into one without decoding
+    a single row: blobs concatenate, each chunk's offsets shift by the
+    bytes before it."""
+    blobs = [c._blob for c in cols]
+    parts = [np.asarray(cols[0]._offsets, dtype=np.int64)]
+    base = len(blobs[0])
+    for c in cols[1:]:
+        offs = np.asarray(c._offsets, dtype=np.int64)
+        parts.append(offs[1:] + base)
+        base += len(c._blob)
+    return LazyStringColumn(b"".join(blobs), np.concatenate(parts))
 
 
 def _tokenize(text: str, delim_regex: str) -> List[List[str]]:
@@ -266,3 +321,151 @@ def load_csv(source: Union[str, io.TextIOBase], schema: FeatureSchema,
 def load_csv_text(text: str, schema: FeatureSchema, delim_regex: str = ",",
                   keep_raw: bool = False) -> ColumnarTable:
     return encode_rows(_tokenize(text, delim_regex), schema, keep_raw=keep_raw)
+
+
+# --------------------------------------------------------------------------
+# chunked / streaming ingest (the CSV->device pipeline's parse stage)
+# --------------------------------------------------------------------------
+
+def _iter_csv_chunks_python(path: str, schema: FeatureSchema,
+                            delim_regex: str, chunk_rows: int,
+                            skip_rows: int = 0):
+    """Oracle-equivalent streamed parse: read the file line by line (never
+    the whole text in memory), encode every ``chunk_rows`` non-blank rows.
+    ``skip_rows`` resumes after a partially-consumed native stream."""
+    plain = re.escape(delim_regex) == delim_regex
+    pat = None if plain else re.compile(delim_regex)
+    rows: List[List[str]] = []
+    skipped = 0
+    with open(path, "r") as fh:
+        for line in fh:
+            line = line.rstrip("\r\n")  # same record set as str.splitlines
+            if not line.strip():        # for \n / \r\n terminated CSVs
+                continue
+            if skipped < skip_rows:
+                skipped += 1
+                continue
+            rows.append(line.split(delim_regex) if plain
+                        else pat.split(line))
+            if len(rows) >= chunk_rows:
+                yield encode_rows(rows, schema)
+                rows = []
+    if rows:
+        yield encode_rows(rows, schema)
+
+
+def iter_csv_chunks(path: str, schema: FeatureSchema,
+                    delim_regex: str = ",", chunk_rows: int = 1 << 22,
+                    use_native: bool = True):
+    """Yield a CSV as ColumnarTable row blocks of up to ``chunk_rows`` rows
+    — the parse stage of the streaming CSV->device ingest pipeline.  Host
+    memory holds one encoded block at a time instead of the whole dataset
+    (what caps the monolithic path well short of the 100M-row north star).
+
+    Uses the native chunk reader (io.native_csv.NativeCsvReader) when
+    available; per the load_csv contract, behavior must not depend on
+    whether the .so built, so any native failure — including a mid-stream
+    ValueError from the C float grammar being stricter than python's —
+    resumes the stream from the python oracle at the exact row already
+    reached.  Blocks concatenate (ColumnarTable.from_chunks) to the same
+    table load_csv produces."""
+    if chunk_rows <= 0:
+        raise ValueError(f"chunk_rows must be positive, got {chunk_rows}")
+    done_rows = 0
+    if use_native and len(delim_regex) == 1:
+        reader = None
+        try:
+            from ..io.native_csv import native_open_csv
+            reader = native_open_csv(path, schema, delim_regex)
+        except Exception:
+            reader = None
+        if reader is not None:
+            try:
+                n = reader.n_rows
+                try:
+                    while done_rows < n:
+                        take = min(chunk_rows, n - done_rows)
+                        chunk = reader.parse_chunk(done_rows, take)
+                        yield chunk
+                        done_rows += take
+                    return
+                except (ValueError, MemoryError, OSError):
+                    pass  # python oracle resumes at done_rows below
+            finally:
+                reader.close()
+    yield from _iter_csv_chunks_python(path, schema, delim_regex,
+                                       chunk_rows, skip_rows=done_rows)
+
+
+def prefetch_chunks(chunks, depth: int = 1, stats: Optional[dict] = None):
+    """Run a chunk iterator in a background thread with a bounded queue:
+    the producer parses block i+1 while the consumer transfers/computes
+    block i — the double-buffering that overlaps the ingest pipeline's
+    stages.  ``depth`` bounds in-flight blocks (memory = depth + 1 blocks).
+    ``stats['parse_s']`` accumulates time spent inside the producer."""
+    import queue
+    import threading
+    import time as _time
+
+    if depth < 1:
+        raise ValueError(f"depth must be >= 1, got {depth}")
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    end = object()
+    failure: List[BaseException] = []
+    # set when the consumer abandons the generator mid-stream (an exception
+    # downstream, e.g. device OOM): a producer blocked on a full queue must
+    # not hang forever holding parsed blocks and the open mmap
+    stop = threading.Event()
+
+    def put_until_stopped(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def produce():
+        it = iter(chunks)
+        try:
+            while not stop.is_set():
+                t0 = _time.perf_counter()
+                try:
+                    item = next(it)
+                except StopIteration:
+                    break
+                finally:
+                    if stats is not None:
+                        stats["parse_s"] = (stats.get("parse_s", 0.0)
+                                            + _time.perf_counter() - t0)
+                if not put_until_stopped(item):
+                    break
+        except BaseException as exc:  # surfaced on the consumer side
+            failure.append(exc)
+        finally:
+            close = getattr(it, "close", None)
+            if close is not None:  # release the source NOW (native reader
+                try:               # mmap), not at some later GC pass
+                    close()
+                except Exception:
+                    pass
+            put_until_stopped(end)
+
+    threading.Thread(target=produce, daemon=True,
+                     name="avenir-ingest-prefetch").start()
+    try:
+        while True:
+            item = q.get()
+            if item is end:
+                if failure:
+                    raise failure[0]
+                return
+            yield item
+    finally:
+        stop.set()
+        try:  # unblock a producer mid-put; it exits via its stop check
+            while True:
+                q.get_nowait()
+        except queue.Empty:
+            pass

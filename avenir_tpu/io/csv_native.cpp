@@ -16,7 +16,9 @@
 //   phase B  fused fill: ONE walk over each row's fields dispatching every
 //            requested column directly into its output buffer (numeric ->
 //            from_chars float64, categorical -> small-vocab lookup int32,
-//            string -> per-thread blob + lengths, joined once).
+//            string -> per-thread blob + lengths, joined once).  avt_fill
+//            covers all rows; avt_fill_range fills one row block of the
+//            same index (the streaming ingest pipeline's parse stage).
 // Both phases shard by byte/row ranges across a thread pool; with one
 // hardware core (this container) T=1 and the pool is bypassed — the
 // single-core win comes from mmap (no copy), memchr, and index elimination.
@@ -34,9 +36,11 @@
 
 #include <algorithm>
 #include <atomic>
+#include <cerrno>
 #include <charconv>
 #include <cmath>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <functional>
 #include <memory>
@@ -54,6 +58,8 @@ struct Handle {
     int fd = -1;
     char delim = ',';
     int n_threads = 1;
+    bool explicit_threads = false;  // caller pinned the count (tests
+                                    // exercise the pool this way)
     std::vector<int64_t> starts;  // per non-blank line: byte offset
     std::vector<int32_t> lens;    // per non-blank line: byte length
     // per string column (fill-call order): joined bytes + n+1 offsets
@@ -150,6 +156,40 @@ inline bool parse_simple_number(std::string_view v, double* out) {
     }
     *out = neg ? -static_cast<double>(acc) : static_cast<double>(acc);
     return true;
+}
+
+// Full float parse for the non-simple shapes (decimals, exponents).
+// GCC >= 11 has floating-point from_chars; older libstdc++ (this build
+// container is GCC 10) only has the integer overloads, so fall back to
+// glibc strtod — also correctly rounded — with its extensions neutralized:
+// hex floats are rejected (python float() rejects them, and the native
+// path must never parse where the oracle raises) and the mmap slice is
+// copied to a NUL-terminated stack buffer (strtod needs termination).
+inline bool parse_general_number(std::string_view v, double* out) {
+#if defined(__cpp_lib_to_chars) && __cpp_lib_to_chars >= 201611L
+    auto res = std::from_chars(v.data(), v.data() + v.size(), *out);
+    return res.ec == std::errc() && res.ptr == v.data() + v.size();
+#else
+    if (v.empty() || v.size() > 64) return false;  // absurd width: oracle path
+    // match the from_chars grammar exactly: no leading '+' (the caller
+    // already stripped the single '+' python allows) and no leading
+    // whitespace — strtod accepts both, which would make this build parse
+    // fields (e.g. '++1', '+ 1') where the oracle raises
+    if (v[0] == '+' || is_space(v[0])) return false;
+    for (char c : v)
+        if (c == 'x' || c == 'X') return false;
+    char buf[65];
+    std::memcpy(buf, v.data(), v.size());
+    buf[v.size()] = '\0';
+    errno = 0;
+    char* end = nullptr;
+    double d = std::strtod(buf, &end);
+    // ERANGE == from_chars result_out_of_range: counted bad, same as the
+    // primary implementation
+    if (end != buf + v.size() || errno == ERANGE) return false;
+    *out = d;
+    return true;
+#endif
 }
 
 inline std::string_view trimmed(const char* p, int64_t len) {
@@ -331,6 +371,185 @@ struct Spec {
     int32_t bin_offset = 0;
 };
 
+// Fused fill of every requested column over rows [row_lo, row_hi) of the
+// line index, writing OUTPUT-RELATIVE indices (row r lands at r - row_lo).
+// The whole-file avt_fill is the (0, n) case; avt_fill_range exposes the
+// row-block form for the streaming ingest pipeline (a background thread
+// parses block i+1 while block i is in flight to the device).
+int64_t fill_range(Handle* h, int64_t row_lo, int64_t row_hi, int n_cols,
+                   const int32_t* ords, const int32_t* kinds, void** outs,
+                   const char*** vocabs, const int32_t* vocab_ns,
+                   int64_t* bad_out, void** bin_outs,
+                   const double* bin_widths,
+                   const int32_t* bin_offsets) try {
+    const int64_t span = row_hi - row_lo;
+    const char delim = h->delim;
+    const char* buf = h->data;
+    const char* hard_end = buf + h->size;
+
+    std::vector<Spec> specs(static_cast<size_t>(n_cols));
+    int n_str = 0;
+    for (int i = 0; i < n_cols; ++i) {
+        Spec& s = specs[static_cast<size_t>(i)];
+        s.ordinal = ords[i];
+        s.kind = kinds[i];
+        s.out = outs[i];
+        s.bad_idx = i;
+        s.str_idx = (s.kind == KIND_STRING) ? n_str++ : -1;
+        if (s.kind == KIND_CATEGORICAL)
+            s.vocab.build(vocabs[i], vocab_ns[i]);
+        if (s.kind == KIND_NUMERIC_BINNED) {
+            s.bin_out = static_cast<int32_t*>(bin_outs[i]);
+            s.bin_width = bin_widths[i];
+            s.bin_offset = bin_offsets[i];
+        }
+    }
+    std::sort(specs.begin(), specs.end(),
+              [](const Spec& a, const Spec& b) {
+                  return a.ordinal < b.ordinal;
+              });
+
+    // a small AUTO-threaded block does not amortize thread spawn: one
+    // shard under ~256k rows.  An EXPLICIT n_threads still shards even
+    // tiny spans — that is how tests exercise the multi-shard merge.
+    int T = h->n_threads;
+    if (!h->explicit_threads && span < (1 << 18)) T = 1;
+    // per-thread: bad counts, string bytes, per-row string lengths
+    std::vector<std::vector<int64_t>> t_bad(
+        static_cast<size_t>(T),
+        std::vector<int64_t>(static_cast<size_t>(n_cols), 0));
+    std::vector<std::vector<std::string>> t_blob(
+        static_cast<size_t>(T),
+        std::vector<std::string>(static_cast<size_t>(n_str)));
+    std::vector<std::vector<std::vector<int32_t>>> t_slen(
+        static_cast<size_t>(T),
+        std::vector<std::vector<int32_t>>(static_cast<size_t>(n_str)));
+
+    std::atomic<bool> fail{false};
+    run_sharded(T, [&](int t) {
+        try {
+            const int64_t r0 = row_lo + span * t / T;
+            const int64_t r1 = row_lo + span * (t + 1) / T;
+            auto& bad = t_bad[static_cast<size_t>(t)];
+            auto& blobs = t_blob[static_cast<size_t>(t)];
+            auto& slens = t_slen[static_cast<size_t>(t)];
+            for (auto& v : slens)
+                v.reserve(static_cast<size_t>(r1 - r0));
+            for (int64_t r = r0; r < r1; ++r) {
+                const int64_t o = r - row_lo;  // output-relative row index
+                const char* p = buf + h->starts[static_cast<size_t>(r)];
+                const char* line_end = p + h->lens[static_cast<size_t>(r)];
+                int32_t cur = 0;  // ordinal of the field starting at p
+                bool exhausted = false;
+                for (const Spec& s : specs) {
+                    // advance to the spec's ordinal
+                    while (!exhausted && cur < s.ordinal) {
+                        const char* q = find_byte(p, line_end, delim,
+                                                  hard_end);
+                        if (q == nullptr) { exhausted = true; break; }
+                        p = q + 1;
+                        ++cur;
+                    }
+                    if (exhausted) {  // short row: missing for this spec
+                        ++bad[static_cast<size_t>(s.bad_idx)];
+                        if (s.kind == KIND_NUMERIC
+                            || s.kind == KIND_NUMERIC_BINNED) {
+                            static_cast<double*>(s.out)[o] = 0.0;
+                            if (s.bin_out != nullptr)  // bin code of 0.0
+                                s.bin_out[o] = -s.bin_offset;
+                        } else if (s.kind == KIND_CATEGORICAL) {
+                            static_cast<int32_t*>(s.out)[o] = -1;
+                        } else if (s.kind == KIND_STRING) {
+                            slens[static_cast<size_t>(s.str_idx)]
+                                .push_back(0);
+                        }
+                        continue;
+                    }
+                    const char* q = find_byte(p, line_end, delim,
+                                              hard_end);
+                    const char* fe = q ? q : line_end;
+                    if (s.kind == KIND_NUMERIC
+                        || s.kind == KIND_NUMERIC_BINNED) {
+                        std::string_view v = trimmed(p, fe - p);
+                        bool plus = !v.empty() && v[0] == '+';
+                        if (plus)                       // python float()
+                            v.remove_prefix(1);         // accepts '+'
+                        // ...but never a second sign ('+-1' must stay
+                        // invalid: what remains after the strip would
+                        // parse as a plain signed number)
+                        bool double_sign = plus && !v.empty()
+                            && (v[0] == '+' || v[0] == '-');
+                        double d = 0.0;
+                        if (double_sign
+                            || (!parse_simple_number(v, &d)
+                                && !parse_general_number(v, &d))) {
+                            d = 0.0;
+                            ++bad[static_cast<size_t>(s.bad_idx)];
+                        }
+                        static_cast<double*>(s.out)[o] = d;
+                        if (s.bin_out != nullptr)
+                            // == numpy (col // bucketWidth) - bin_offset
+                            s.bin_out[o] = static_cast<int32_t>(
+                                np_floor_divide(d, s.bin_width))
+                                - s.bin_offset;
+                    } else if (s.kind == KIND_CATEGORICAL) {
+                        static_cast<int32_t*>(s.out)[o] =
+                            s.vocab.find(trimmed(p, fe - p), hard_end);
+                    } else if (s.kind == KIND_STRING) {
+                        blobs[static_cast<size_t>(s.str_idx)].append(
+                            p, static_cast<size_t>(fe - p));
+                        slens[static_cast<size_t>(s.str_idx)].push_back(
+                            static_cast<int32_t>(fe - p));
+                    }  // KIND_STRING_CHECK: presence already verified
+                    // leave p at the current field; the next spec advances
+                }
+            }
+        } catch (...) {
+            fail.store(true);
+        }
+    });
+    if (fail.load()) return -1;
+
+    for (int i = 0; i < n_cols; ++i) {
+        bad_out[i] = 0;
+        for (int t = 0; t < T; ++t)
+            bad_out[i] += t_bad[static_cast<size_t>(t)]
+                               [static_cast<size_t>(i)];
+    }
+
+    // join per-thread string pieces (threads cover disjoint ordered row
+    // ranges, so concatenation in thread order preserves row order)
+    h->str_blobs.assign(static_cast<size_t>(n_str), {});
+    h->str_offsets.assign(static_cast<size_t>(n_str), {});
+    for (int sidx = 0; sidx < n_str; ++sidx) {
+        size_t bytes = 0;
+        for (int t = 0; t < T; ++t)
+            bytes += t_blob[static_cast<size_t>(t)]
+                           [static_cast<size_t>(sidx)].size();
+        auto& blob = h->str_blobs[static_cast<size_t>(sidx)];
+        auto& offs = h->str_offsets[static_cast<size_t>(sidx)];
+        offs.reserve(static_cast<size_t>(span) + 1);
+        offs.push_back(0);
+        if (T == 1) {  // single shard: adopt the buffer, skip the copy
+            blob = std::move(t_blob[0][static_cast<size_t>(sidx)]);
+            for (int32_t L : t_slen[0][static_cast<size_t>(sidx)])
+                offs.push_back(offs.back() + L);
+        } else {
+            blob.reserve(bytes);
+            for (int t = 0; t < T; ++t) {
+                blob += t_blob[static_cast<size_t>(t)]
+                              [static_cast<size_t>(sidx)];
+                for (int32_t L : t_slen[static_cast<size_t>(t)]
+                                       [static_cast<size_t>(sidx)])
+                    offs.push_back(offs.back() + L);
+            }
+        }
+    }
+    return 0;
+} catch (...) {
+    return -1;
+}
+
 }  // namespace
 
 extern "C" {
@@ -354,6 +573,7 @@ void* avt_open(const char* path, char delim, int n_threads) try {
         h->data = static_cast<const char*>(m);
     }
     int hw = static_cast<int>(std::thread::hardware_concurrency());
+    h->explicit_threads = n_threads > 0;
     int T = n_threads > 0 ? n_threads : (hw > 0 ? hw : 1);
     if (T > 16) T = 16;
     // tiny files: thread spawn costs more than the scan (an EXPLICIT
@@ -425,165 +645,32 @@ int64_t avt_fill(void* hp, int n_cols, const int32_t* ords,
                  const char*** vocabs, const int32_t* vocab_ns,
                  int64_t* bad_out, void** bin_outs,
                  const double* bin_widths,
-                 const int32_t* bin_offsets) try {
+                 const int32_t* bin_offsets) {
     auto* h = static_cast<Handle*>(hp);
-    const int64_t n = avt_n_rows(hp);
-    const char delim = h->delim;
-    const char* buf = h->data;
-    const char* hard_end = buf + h->size;
+    return fill_range(h, 0, avt_n_rows(hp), n_cols, ords, kinds, outs,
+                      vocabs, vocab_ns, bad_out, bin_outs, bin_widths,
+                      bin_offsets);
+}
 
-    std::vector<Spec> specs(static_cast<size_t>(n_cols));
-    int n_str = 0;
-    for (int i = 0; i < n_cols; ++i) {
-        Spec& s = specs[static_cast<size_t>(i)];
-        s.ordinal = ords[i];
-        s.kind = kinds[i];
-        s.out = outs[i];
-        s.bad_idx = i;
-        s.str_idx = (s.kind == KIND_STRING) ? n_str++ : -1;
-        if (s.kind == KIND_CATEGORICAL)
-            s.vocab.build(vocabs[i], vocab_ns[i]);
-        if (s.kind == KIND_NUMERIC_BINNED) {
-            s.bin_out = static_cast<int32_t*>(bin_outs[i]);
-            s.bin_width = bin_widths[i];
-            s.bin_offset = bin_offsets[i];
-        }
-    }
-    std::sort(specs.begin(), specs.end(),
-              [](const Spec& a, const Spec& b) {
-                  return a.ordinal < b.ordinal;
-              });
-
-    const int T = h->n_threads;
-    // per-thread: bad counts, string bytes, per-row string lengths
-    std::vector<std::vector<int64_t>> t_bad(
-        static_cast<size_t>(T),
-        std::vector<int64_t>(static_cast<size_t>(n_cols), 0));
-    std::vector<std::vector<std::string>> t_blob(
-        static_cast<size_t>(T),
-        std::vector<std::string>(static_cast<size_t>(n_str)));
-    std::vector<std::vector<std::vector<int32_t>>> t_slen(
-        static_cast<size_t>(T),
-        std::vector<std::vector<int32_t>>(static_cast<size_t>(n_str)));
-
-    std::atomic<bool> fail{false};
-    run_sharded(T, [&](int t) {
-        try {
-            const int64_t r0 = n * t / T, r1 = n * (t + 1) / T;
-            auto& bad = t_bad[static_cast<size_t>(t)];
-            auto& blobs = t_blob[static_cast<size_t>(t)];
-            auto& slens = t_slen[static_cast<size_t>(t)];
-            for (auto& v : slens)
-                v.reserve(static_cast<size_t>(r1 - r0));
-            for (int64_t r = r0; r < r1; ++r) {
-                const char* p = buf + h->starts[static_cast<size_t>(r)];
-                const char* line_end = p + h->lens[static_cast<size_t>(r)];
-                int32_t cur = 0;  // ordinal of the field starting at p
-                bool exhausted = false;
-                for (const Spec& s : specs) {
-                    // advance to the spec's ordinal
-                    while (!exhausted && cur < s.ordinal) {
-                        const char* q = find_byte(p, line_end, delim,
-                                                  hard_end);
-                        if (q == nullptr) { exhausted = true; break; }
-                        p = q + 1;
-                        ++cur;
-                    }
-                    if (exhausted) {  // short row: missing for this spec
-                        ++bad[static_cast<size_t>(s.bad_idx)];
-                        if (s.kind == KIND_NUMERIC
-                            || s.kind == KIND_NUMERIC_BINNED) {
-                            static_cast<double*>(s.out)[r] = 0.0;
-                            if (s.bin_out != nullptr)  // bin code of 0.0
-                                s.bin_out[r] = -s.bin_offset;
-                        } else if (s.kind == KIND_CATEGORICAL) {
-                            static_cast<int32_t*>(s.out)[r] = -1;
-                        } else if (s.kind == KIND_STRING) {
-                            slens[static_cast<size_t>(s.str_idx)]
-                                .push_back(0);
-                        }
-                        continue;
-                    }
-                    const char* q = find_byte(p, line_end, delim,
-                                              hard_end);
-                    const char* fe = q ? q : line_end;
-                    if (s.kind == KIND_NUMERIC
-                        || s.kind == KIND_NUMERIC_BINNED) {
-                        std::string_view v = trimmed(p, fe - p);
-                        if (!v.empty() && v[0] == '+')  // python float()
-                            v.remove_prefix(1);         // accepts '+'
-                        double d = 0.0;
-                        if (!parse_simple_number(v, &d)) {
-                            auto res = std::from_chars(
-                                v.data(), v.data() + v.size(), d);
-                            if (res.ec != std::errc()
-                                || res.ptr != v.data() + v.size()) {
-                                d = 0.0;
-                                ++bad[static_cast<size_t>(s.bad_idx)];
-                            }
-                        }
-                        static_cast<double*>(s.out)[r] = d;
-                        if (s.bin_out != nullptr)
-                            // == numpy (col // bucketWidth) - bin_offset
-                            s.bin_out[r] = static_cast<int32_t>(
-                                np_floor_divide(d, s.bin_width))
-                                - s.bin_offset;
-                    } else if (s.kind == KIND_CATEGORICAL) {
-                        static_cast<int32_t*>(s.out)[r] =
-                            s.vocab.find(trimmed(p, fe - p), hard_end);
-                    } else if (s.kind == KIND_STRING) {
-                        blobs[static_cast<size_t>(s.str_idx)].append(
-                            p, static_cast<size_t>(fe - p));
-                        slens[static_cast<size_t>(s.str_idx)].push_back(
-                            static_cast<int32_t>(fe - p));
-                    }  // KIND_STRING_CHECK: presence already verified
-                    // leave p at the current field; the next spec advances
-                }
-            }
-        } catch (...) {
-            fail.store(true);
-        }
-    });
-    if (fail.load()) return -1;
-
-    for (int i = 0; i < n_cols; ++i) {
-        bad_out[i] = 0;
-        for (int t = 0; t < T; ++t)
-            bad_out[i] += t_bad[static_cast<size_t>(t)]
-                               [static_cast<size_t>(i)];
-    }
-
-    // join per-thread string pieces (threads cover disjoint ordered row
-    // ranges, so concatenation in thread order preserves row order)
-    h->str_blobs.assign(static_cast<size_t>(n_str), {});
-    h->str_offsets.assign(static_cast<size_t>(n_str), {});
-    for (int sidx = 0; sidx < n_str; ++sidx) {
-        size_t bytes = 0;
-        for (int t = 0; t < T; ++t)
-            bytes += t_blob[static_cast<size_t>(t)]
-                           [static_cast<size_t>(sidx)].size();
-        auto& blob = h->str_blobs[static_cast<size_t>(sidx)];
-        auto& offs = h->str_offsets[static_cast<size_t>(sidx)];
-        offs.reserve(static_cast<size_t>(n) + 1);
-        offs.push_back(0);
-        if (T == 1) {  // single shard: adopt the buffer, skip the copy
-            blob = std::move(t_blob[0][static_cast<size_t>(sidx)]);
-            for (int32_t L : t_slen[0][static_cast<size_t>(sidx)])
-                offs.push_back(offs.back() + L);
-        } else {
-            blob.reserve(bytes);
-            for (int t = 0; t < T; ++t) {
-                blob += t_blob[static_cast<size_t>(t)]
-                              [static_cast<size_t>(sidx)];
-                for (int32_t L : t_slen[static_cast<size_t>(t)]
-                                       [static_cast<size_t>(sidx)])
-                    offs.push_back(offs.back() + L);
-            }
-        }
-    }
-    return 0;
-} catch (...) {
-    return -1;
+// Row-block form of avt_fill: fill rows [row_lo, row_hi) of the line
+// index into output buffers of (row_hi - row_lo) rows (row r lands at
+// index r - row_lo).  String blobs/offsets (avt_string_blob /
+// avt_string_offsets) describe ONLY this block and are overwritten by the
+// next fill call on the handle.  Returns 0, -1 on allocation failure, -2
+// on an out-of-range row window.
+int64_t avt_fill_range(void* hp, int64_t row_lo, int64_t row_hi,
+                       int n_cols, const int32_t* ords,
+                       const int32_t* kinds, void** outs,
+                       const char*** vocabs, const int32_t* vocab_ns,
+                       int64_t* bad_out, void** bin_outs,
+                       const double* bin_widths,
+                       const int32_t* bin_offsets) {
+    auto* h = static_cast<Handle*>(hp);
+    if (row_lo < 0 || row_hi < row_lo || row_hi > avt_n_rows(hp))
+        return -2;
+    return fill_range(h, row_lo, row_hi, n_cols, ords, kinds, outs,
+                      vocabs, vocab_ns, bad_out, bin_outs, bin_widths,
+                      bin_offsets);
 }
 
 // String column `str_idx` (fill-call order among string columns): joined

@@ -12,6 +12,9 @@ The C side is a two-phase mmap + memchr parser (see csv_native.cpp): one
 column in a single fused pass, and string columns come back as a joined
 byte blob + int64 offsets wrapped in :class:`core.table.LazyStringColumn`
 (no per-row python string materialization at load time).
+:class:`NativeCsvReader` exposes the streaming form over the same handle:
+``parse_chunk(offset, n_rows)`` fills one row block via ``avt_fill_range``
+— the parse stage of the chunked CSV->device ingest pipeline.
 ``AVENIR_TPU_INGEST_THREADS`` caps the parse thread count (default: hardware
 concurrency; this container has one core, where the pool is bypassed).
 """
@@ -101,6 +104,10 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_void_p),           # bin_outs
             ctypes.POINTER(ctypes.c_double),           # bin_widths
             ctypes.POINTER(ctypes.c_int32)]            # bin_offsets
+        lib.avt_fill_range.restype = ctypes.c_int64
+        lib.avt_fill_range.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            *lib.avt_fill.argtypes[1:]]
         lib.avt_string_blob.restype = ctypes.c_void_p
         lib.avt_string_blob.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                         ctypes.POINTER(ctypes.c_int64)]
@@ -212,6 +219,172 @@ class DeferredStringColumn(Sequence):
 
     def tolist(self):
         return list(self._materialize())
+
+
+class NativeCsvReader:
+    """Streaming row-block access to one CSV.
+
+    ``avt_open`` runs once (mmap + line index); ``parse_chunk(offset,
+    n_rows)`` then fills ONLY that row block through ``avt_fill_range`` —
+    the parse half of the double-buffered CSV->device ingest pipeline,
+    where a background thread parses block i+1 while block i is in flight
+    to the device.  Peak host memory is one block, not the whole encoded
+    dataset.
+
+    Unlike :func:`native_load_csv`, string/id columns are extracted eagerly
+    per chunk (each chunk's blob is small and the handle's blob state is
+    overwritten by the next fill).  Chunks assembled with
+    ``ColumnarTable.from_chunks`` are byte-identical to a whole-file
+    ``native_load_csv`` (tests/test_native_csv_fuzz.py proves it on fuzzed
+    schemas)."""
+
+    def __init__(self, lib, path: str, schema, delim: str):
+        n_threads = int(os.environ.get("AVENIR_TPU_INGEST_THREADS", "0"))
+        h = lib.avt_open(path.encode(), delim.encode(), n_threads)
+        if not h:
+            raise OSError(f"native csv parse failed to open {path!r}")
+        self._handle = _ParseHandle(lib, h, int(lib.avt_n_rows(h)), path,
+                                    delim)
+        self.schema = schema
+        self.path = path
+        self.delim = delim
+        # per-chunk-invariant spec arrays built once; only the output
+        # buffers depend on the chunk length
+        fields = list(schema.fields)
+        self._fields = fields
+        n_cols = len(fields)
+        self._ords = (ctypes.c_int32 * n_cols)()
+        self._kinds = (ctypes.c_int32 * n_cols)()
+        self._vocabs = (ctypes.POINTER(ctypes.c_char_p) * n_cols)()
+        self._vocab_ns = (ctypes.c_int32 * n_cols)()
+        self._bin_ws = (ctypes.c_double * n_cols)()
+        self._bin_offs = (ctypes.c_int32 * n_cols)()
+        self._keep_alive = []  # encoded vocab arrays must outlive fills
+        self._str_ords = []
+        for i, f in enumerate(fields):
+            self._ords[i] = f.ordinal
+            if f.is_categorical:
+                self._kinds[i] = _KIND_CATEGORICAL
+                enc = [v.encode() for v in (f.cardinality or [])]
+                arr = (ctypes.c_char_p * len(enc))(*enc)
+                self._keep_alive.append((enc, arr))
+                self._vocabs[i] = arr
+                self._vocab_ns[i] = len(enc)
+            elif f.is_numeric:
+                if f.bucket_width is not None:
+                    self._kinds[i] = _KIND_NUMERIC_BINNED
+                    self._bin_ws[i] = float(f.bucket_width)
+                    self._bin_offs[i] = int(f.bin_offset)
+                else:
+                    self._kinds[i] = _KIND_NUMERIC
+            else:
+                self._kinds[i] = _KIND_STRING
+                self._str_ords.append(f.ordinal)
+
+    @property
+    def n_rows(self) -> int:
+        handle = self._handle
+        if handle is None:
+            raise ValueError("NativeCsvReader is closed")
+        return handle.n
+
+    def close(self) -> None:
+        handle, self._handle = self._handle, None
+        if handle is not None:
+            handle._finalizer()  # idempotent avt_free
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def parse_chunk(self, offset: int, n_rows: int):
+        """Rows [offset, offset + n_rows) as a ColumnarTable block, encoded
+        exactly like the whole-file path (same ValueError on malformed /
+        short rows, reported with the block's absolute row range)."""
+        from ..core.table import ColumnarTable, LazyStringColumn
+        handle = self._handle
+        if handle is None:
+            raise ValueError("NativeCsvReader is closed")
+        lo, hi = int(offset), int(offset) + int(n_rows)
+        if not 0 <= lo <= hi <= handle.n:
+            raise IndexError(f"rows [{lo}, {hi}) out of range "
+                             f"(file has {handle.n})")
+        m = hi - lo
+        fields = self._fields
+        n_cols = len(fields)
+        lib = handle.lib
+        outs = (ctypes.c_void_p * n_cols)()
+        bads = (ctypes.c_int64 * n_cols)()
+        bin_outs = (ctypes.c_void_p * n_cols)()
+        columns = {}
+        binned_cache = {}
+        for i, f in enumerate(fields):
+            kind = self._kinds[i]
+            if kind == _KIND_CATEGORICAL:
+                out = np.empty(m, dtype=np.int32)
+                columns[f.ordinal] = out
+                outs[i] = out.ctypes.data_as(ctypes.c_void_p)
+            elif kind in (_KIND_NUMERIC, _KIND_NUMERIC_BINNED):
+                out = np.empty(m, dtype=np.float64)
+                columns[f.ordinal] = out
+                outs[i] = out.ctypes.data_as(ctypes.c_void_p)
+                if kind == _KIND_NUMERIC_BINNED:
+                    bout = np.empty(m, dtype=np.int32)
+                    binned_cache[f.ordinal] = bout
+                    bin_outs[i] = bout.ctypes.data_as(ctypes.c_void_p)
+        str_columns = {}
+        with handle.lock:
+            rc = lib.avt_fill_range(handle.h, lo, hi, n_cols, self._ords,
+                                    self._kinds, outs, self._vocabs,
+                                    self._vocab_ns, bads, bin_outs,
+                                    self._bin_ws, self._bin_offs)
+            if rc != 0:
+                raise MemoryError(
+                    f"native csv chunk fill failed (rc={rc})")
+            # blob state is per-fill on the handle: copy out under the
+            # same lock, before any other fill can overwrite it
+            for sidx, o in enumerate(self._str_ords):
+                ln = ctypes.c_int64()
+                ptr = lib.avt_string_blob(handle.h, sidx, ctypes.byref(ln))
+                offs_ptr = lib.avt_string_offsets(handle.h, sidx)
+                if ((ptr is None and ln.value != 0) or ln.value < 0
+                        or not offs_ptr):
+                    raise MemoryError("native string chunk extraction "
+                                      "failed")
+                blob = ctypes.string_at(ptr, ln.value) if ln.value else b""
+                offsets = np.ctypeslib.as_array(
+                    offs_ptr, shape=(m + 1,)).copy()
+                str_columns[o] = LazyStringColumn(blob, offsets)
+        for arr in binned_cache.values():
+            # same freeze-by-reference contract as native_load_csv
+            arr.flags.writeable = False
+        for i, f in enumerate(fields):
+            if bads[i]:
+                what = ("missing/non-numeric"
+                        if self._kinds[i] in (_KIND_NUMERIC,
+                                              _KIND_NUMERIC_BINNED)
+                        else "missing")
+                raise ValueError(
+                    f"{bads[i]} rows with {what} field {f.ordinal} "
+                    f"({f.name!r}) in rows [{lo}, {hi}) of {self.path!r}")
+        return ColumnarTable(schema=self.schema, n_rows=m, columns=columns,
+                             str_columns=str_columns, raw_rows=None,
+                             binned_cache=binned_cache)
+
+
+def native_open_csv(path: str, schema, delim: str):
+    """A NativeCsvReader over ``path`` when the fast path applies, else
+    None (no library, multi-char delimiter) — the streaming twin of
+    :func:`native_load_csv`'s gate; raises OSError when the file cannot
+    be opened/mapped."""
+    if len(delim) != 1 or delim in "\r\n":
+        return None
+    lib = get_lib()
+    if lib is None:
+        return None
+    return NativeCsvReader(lib, path, schema, delim)
 
 
 def native_load_csv(path: str, schema, delim: str, keep_raw: bool = False):

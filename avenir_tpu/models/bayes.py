@@ -238,6 +238,18 @@ def _train_kernel_body(cc, bc, cv, m, C, bmax):
     return counts, cls_counts, moments
 
 
+def wire_pack4_fits(schema: FeatureSchema) -> bool:
+    """True when every alphabet fits a nibble with 15 left as the
+    out-of-alphabet sentinel — the pack4 wire-form eligibility gate.
+    ONE definition shared by train() and the A/B tool
+    (tools/ab_pack4_device.py): a hand-copied gate there could silently
+    diverge and mislabel which wire form an A/B actually measured."""
+    C = len(schema.class_attr_field.cardinality or [])
+    bmax = max((f.num_bins for f in schema.feature_fields if f.is_binned),
+               default=1)
+    return C <= 15 and bmax <= 15
+
+
 def train(table: ColumnarTable, ctx: Optional[MeshContext] = None,
           counters: Optional[Counters] = None,
           chunk_rows: int = 1 << 23) -> NaiveBayesModel:
@@ -307,7 +319,7 @@ def train(table: ColumnarTable, ctx: Optional[MeshContext] = None,
     # measured as a pure 15-25% train-phase loss — see BASELINE.md.
     # AVENIR_TPU_WIRE_PACK4=1/0 forces either path (tests, A/B runs).
     env_pack4 = os.environ.get("AVENIR_TPU_WIRE_PACK4", "auto")
-    fits4 = C <= 15 and bmax <= 15
+    fits4 = wire_pack4_fits(schema)
     pack4 = (fits4 and env_pack4 != "0"
              and (env_pack4 == "1" or ctx.device_platform != "cpu"))
     if env_pack4 == "1" and not fits4:

@@ -167,11 +167,17 @@ class ForestBuilder:
     counts (T, N, S, B, C) in one einsum) and records are re-tagged for all
     trees by the fused one-hot reassign inside the level kernel."""
 
-    def __init__(self, table: ColumnarTable, params: ForestParams,
-                 ctx: Optional[MeshContext] = None):
+    def __init__(self, table: Optional[ColumnarTable], params: ForestParams,
+                 ctx: Optional[MeshContext] = None,
+                 base: Optional[TreeBuilder] = None):
+        """``base`` injects a pre-built TreeBuilder (e.g. one assembled by
+        TreeBuilder.from_stream over CSV row blocks) — it must carry
+        ``replace(params.tree, seed=params.seed)``; otherwise the builder
+        is constructed from ``table``."""
         self.params = params
-        self.base = TreeBuilder(table, replace(params.tree, seed=params.seed),
-                                ctx or runtime_context())
+        self.base = base if base is not None else TreeBuilder(
+            table, replace(params.tree, seed=params.seed),
+            ctx or runtime_context())
         self.tree_builders = [
             self.base.with_params(
                 replace(params.tree, seed=params.seed + 1000 * (t + 1)))
@@ -244,17 +250,14 @@ class ForestBuilder:
         p = self.params.tree
         T, n = len(builders), base.n_padded
         ctx = base.ctx
-        mask = base.mask_np
         w_cols = []
         for b in builders:
-            # drawn over the TRUE row count then zero-padded: model bytes
-            # must not depend on the mesh size via pad rows (see
-            # TreeBuilder's identical rule)
-            w = sampling_weights(base.n_rows, b.params, b.rng)
-            if w is None:
-                w = np.ones((base.n_rows,), np.float32)
-            w_cols.append(np.pad(w, (0, n - base.n_rows)
-                                 ).astype(np.float32) * mask)
+            # drawn over the TRUE row count, placed at the valid device
+            # positions: model bytes must not depend on how many pad rows
+            # the mesh size (or per-block streamed padding) added — see
+            # TreeBuilder's identical rule
+            w_cols.append(base._expand_weights(
+                sampling_weights(base.n_rows, b.params, b.rng)))
         # per-record weight cap feeds the exactness bound in level_chunk
         self._w_max = max((float(c.max()) for c in w_cols if c.size),
                           default=1.0)
@@ -350,6 +353,39 @@ def build_forest(table: ColumnarTable, params: ForestParams,
     for t in range(params.num_trees):
         tree_params = replace(params.tree, seed=params.seed + 1000 * (t + 1))
         models.append(base_builder.with_params(tree_params).build())
+    return models
+
+
+def build_forest_from_stream(blocks, schema, params: ForestParams,
+                             ctx: Optional[MeshContext] = None,
+                             stats: Optional[dict] = None
+                             ) -> List[DecisionPathList]:
+    """Train the forest from an iterator of ColumnarTable row blocks — the
+    streaming CSV->device ingest pipeline's training entry.  Each block is
+    encoded to branch/class codes on device and released, so host memory
+    holds one in-flight block instead of the whole dataset; the resident
+    device arrays are uploaded ONCE and reused across all trees and
+    levels.  Wrap the source in ``core.table.prefetch_chunks`` so block
+    i+1 parses while block i transfers.
+
+    Models are bit-identical to ``build_forest(assembled_table, ...)``:
+    the bootstrap draws, RNG streams and level histograms see exactly the
+    same records (per-block pad rows carry zero weight).
+
+    ``stats`` (optional dict) collects phase timings: ``parse_s`` (from
+    prefetch_chunks), ``transfer_s``, ``ingest_wall_s``, ``build_s`` —
+    the bench derives the pipeline overlap fraction from them."""
+    import time as _time
+    ctx = ctx or runtime_context()
+    t0 = _time.perf_counter()
+    base = TreeBuilder.from_stream(blocks, schema,
+                                   replace(params.tree, seed=params.seed),
+                                   ctx, stats=stats)
+    t1 = _time.perf_counter()
+    models = ForestBuilder(None, params, ctx, base=base).build_all()
+    if stats is not None:
+        stats["ingest_wall_s"] = t1 - t0
+        stats["build_s"] = _time.perf_counter() - t1
     return models
 
 

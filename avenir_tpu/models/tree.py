@@ -603,6 +603,113 @@ class TreeBuilder:
         for i, s in enumerate(self.splits):
             self.splits_by_attr.setdefault(s.attr, []).append(i)
 
+    @classmethod
+    def from_stream(cls, blocks, schema: FeatureSchema, params: TreeParams,
+                    ctx: Optional[MeshContext] = None,
+                    splits: Optional[List[CandidateSplit]] = None,
+                    stats: Optional[dict] = None) -> "TreeBuilder":
+        """Build the device-resident state from an iterator of ColumnarTable
+        row blocks instead of one assembled table — the consume stage of
+        the streaming CSV->device ingest pipeline.
+
+        Per block: host feature matrix (narrow int16 wire when exact) ->
+        device upload -> branch codes ON DEVICE; only the (n, S) branch
+        codes and (n,) class codes stay resident, so peak host memory is
+        one block.  Uploads and branch-code launches are async dispatches,
+        so with a prefetching block source (core.table.prefetch_chunks)
+        the parse of block i+1 overlaps the transfer/compute of block i.
+
+        Each block pads independently to the mesh size, so valid rows are
+        NOT necessarily a prefix of the device arrays — per-record weights
+        are placed by mask position (``_expand_weights``); pad rows carry
+        zero weight and node id 0, contributing nothing to any level
+        histogram.  Models built from a streamed table are bit-identical
+        to ``TreeBuilder(assembled_table, ...)`` (tests/test_forest.py).
+
+        ``stats['transfer_s']`` accumulates consumer-side upload/dispatch
+        time plus the final device sync."""
+        import time as _time
+        self = cls.__new__(cls)
+        self.ctx = ctx or runtime_context()
+        self.params = params
+        self.schema = schema
+        self.class_field = schema.class_attr_field
+        self.class_values = list(self.class_field.cardinality or [])
+        self.C = len(self.class_values)
+        self.splits = splits if splits is not None else \
+            generate_candidate_splits(schema)
+        self.split_set = SplitSet(self.splits, schema)
+        self.rng = np.random.default_rng(params.seed)
+        self.pyrng = pyrandom.Random(params.seed)
+
+        align = self.ctx.n_devices
+        cls_ord = self.class_field.ordinal
+        br_parts, cls_parts, mask_parts = [], [], []
+        n_rows = 0
+        t_consume = 0.0
+        for block in blocks:
+            t0 = _time.perf_counter()
+            bn = block.n_rows
+            pad = (-bn) % align
+            X = self.split_set.feature_matrix(block)
+            cc = block.columns[cls_ord].astype(np.int32)
+            if pad:
+                X = np.pad(X, ((0, pad), (0, 0)))
+                cc = np.pad(cc, (0, pad))
+            mask = np.zeros((bn + pad,), dtype=np.float32)
+            mask[:bn] = 1.0
+            # async dispatches: the host is free to parse the next block
+            # while the upload + branch-code launch are in flight
+            Xd = self.ctx.shard_rows_streamed(X)
+            br_parts.append(self.split_set.branch_codes(Xd))
+            cls_parts.append(self.ctx.shard_rows_streamed(cc))
+            mask_parts.append(mask)
+            n_rows += bn
+            t_consume += _time.perf_counter() - t0
+        t0 = _time.perf_counter()
+        if not br_parts:
+            # the monolithic path cannot train on 0 rows either; fail with
+            # the cause instead of a downstream shape error
+            raise ValueError("from_stream got an empty block stream "
+                             "(no rows to train on)")
+        from ..parallel.mesh import _concat_jit
+        if len(br_parts) == 1:
+            self.branches, self.cls_codes = br_parts[0], cls_parts[0]
+        else:
+            sharding = self.ctx.row_sharding()
+            self.branches = _concat_jit(len(br_parts), sharding)(br_parts)
+            self.cls_codes = _concat_jit(len(cls_parts), sharding)(cls_parts)
+        self.mask_np = np.concatenate(mask_parts)
+        self.n_rows = n_rows
+        self.n_padded = int(self.mask_np.shape[0])
+        # the streamed state never keeps the feature matrix: branch codes
+        # are the only per-record view any level kernel reads
+        self.X = None
+        jax.block_until_ready((self.branches, self.cls_codes))
+        t_consume += _time.perf_counter() - t0
+        if stats is not None:
+            stats["transfer_s"] = stats.get("transfer_s", 0.0) + t_consume
+
+        S, B, C = self.split_set.n_splits, self.split_set.max_branches, self.C
+        self._count_kernel = _jitted_level_count_kernel(S, B, C)
+        self._reassign_kernel = _REASSIGN_JIT
+        self.splits_by_attr = {}
+        for i, s in enumerate(self.splits):
+            self.splits_by_attr.setdefault(s.attr, []).append(i)
+        return self
+
+    def _expand_weights(self, w: Optional[np.ndarray]) -> np.ndarray:
+        """Per-record weights drawn over the TRUE row count, placed at the
+        valid positions of the padded device layout (zero on pad rows).
+        The monolithic path's mask is a prefix, where this reduces to the
+        old pad-then-mask form byte for byte; streamed ingest pads per
+        block, so valid positions may interleave with padding."""
+        if w is None:
+            w = np.ones((self.n_rows,), dtype=np.float32)
+        full = np.zeros((self.n_padded,), dtype=np.float32)
+        full[self.mask_np > 0] = w.astype(np.float32)
+        return full
+
     def with_params(self, params: TreeParams) -> "TreeBuilder":
         """Shallow copy sharing the device-resident encoded data and compiled
         kernels, with fresh params/RNG — one bootstrap tree of a forest."""
@@ -697,13 +804,8 @@ class TreeBuilder:
         # draw over the TRUE row count, pad with zeros: the RNG stream (and
         # therefore the model bytes) must depend on the data only, never on
         # how many pad rows the mesh size added
-        weights_np = sampling_weights(self.n_rows, p, self.rng)
-        if weights_np is None:
-            weights_np = np.ones((self.n_rows,), dtype=np.float32)
-        weights_np = np.pad(weights_np,
-                            (0, self.n_padded - self.n_rows)
-                            ).astype(np.float32)
-        weights_np *= self.mask_np
+        weights_np = self._expand_weights(
+            sampling_weights(self.n_rows, p, self.rng))
         self._w_max = float(weights_np.max()) if weights_np.size else 1.0
         self._w_integral = True  # sampling_weights are counts/keeps/ones
         weights = self.ctx.shard_rows(weights_np.astype(np.float32))

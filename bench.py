@@ -225,13 +225,34 @@ def _rf_shape_terms(n, T, F, S, levels=4):
     return flops, flops / 6, up, levels * 3
 
 
+RF_STREAM_BLOCK_ROWS = int(os.environ.get("BENCH_RF_BLOCK_ROWS",
+                                          str(1 << 22)))
+
+
+def _overlap_fraction(parse_s, transfer_s, wall_s):
+    """Pipeline overlap achieved by the double-buffered ingest: time saved
+    vs running the stages serially, over the most that overlapping could
+    save (the shorter stage's duration).  1.0 = the shorter stage fully
+    hidden; 0.0 = serial."""
+    saved = parse_s + transfer_s - wall_s
+    shorter = min(parse_s, transfer_s)
+    if shorter <= 0:
+        return 0.0
+    return round(max(0.0, min(1.0, saved / shorter)), 3)
+
+
 def e2e_rf_rate(n):
     """End-to-end CSV-in -> 16-tree random forest (the OTHER flagship
-    family of the CSV-in contract): disk ingest + tree-batched build +
-    decision-path JSON serialization, phases timed separately — the
-    rafo.sh flow (resource/rafo.sh:34-43) as one pipeline."""
-    from avenir_tpu.core.table import load_csv
-    from avenir_tpu.models.forest import ForestParams, build_forest
+    family of the CSV-in contract), through the STREAMING ingest pipeline:
+    chunked CSV parse (background thread) overlapping chunked host->device
+    transfer + branch encoding, then the tree-batched build and
+    decision-path JSON serialization — the rafo.sh flow
+    (resource/rafo.sh:34-43) as one pipeline that never materializes the
+    whole encoded dataset on host.  Phases: parse (producer thread),
+    transfer (consumer upload/encode + final sync), compute (level
+    kernels), with the parse/transfer overlap fraction reported."""
+    from avenir_tpu.core.table import iter_csv_chunks, prefetch_chunks
+    from avenir_tpu.models.forest import ForestParams, build_forest_from_stream
     from avenir_tpu.models.tree import generate_candidate_splits
     from avenir_tpu.parallel.mesh import MeshContext
     path = churn_csv(n)
@@ -239,14 +260,22 @@ def e2e_rf_rate(n):
     params = ForestParams(num_trees=16, seed=1)
     params.tree.max_depth = 4
     ctx = MeshContext()
+
+    def run_once(stats):
+        blocks = prefetch_chunks(
+            iter_csv_chunks(path, schema, ",",
+                            chunk_rows=RF_STREAM_BLOCK_ROWS),
+            stats=stats)
+        return build_forest_from_stream(blocks, schema, params, ctx,
+                                        stats=stats)
+
     # cold pass = the user's one-shot run (XLA compiles) + warmup
     tc = time.perf_counter()
-    build_forest(load_csv(path, schema, ","), params, ctx)
+    run_once({})
     cold_s = time.perf_counter() - tc
+    stats = {}
     t0 = time.perf_counter()
-    table = load_csv(path, schema, ",")
-    t1 = time.perf_counter()
-    models = build_forest(table, params, ctx)
+    models = run_once(stats)
     t2 = time.perf_counter()
     blobs = [m.to_json() for m in models]
     t3 = time.perf_counter()
@@ -257,17 +286,38 @@ def e2e_rf_rate(n):
     S = len(generate_candidate_splits(schema))
     F = len(schema.feature_fields)
     flops, hbm, up, launches = _rf_shape_terms(n, T, F, S)
+    parse_s = stats.get("parse_s", 0.0)
+    transfer_s = stats.get("transfer_s", 0.0)
+    ingest_s = stats.get("ingest_wall_s", 0.0)
+    build_s = stats.get("build_s", t2 - t0 - ingest_s)
     return {"metric": "e2e_csv_to_forest_rows_x_trees_per_sec",
             "value": round(n * T / dt, 1), "unit": "rows*trees/sec",
             "n": n, "trees": T, "candidate_splits": S,
-            "ingest_s": round(t1 - t0, 3),
-            "build_s": round(t2 - t1, 3),
+            "streaming": True, "block_rows": RF_STREAM_BLOCK_ROWS,
+            "parse_s": round(parse_s, 3),
+            "transfer_s": round(transfer_s, 3),
+            "ingest_s": round(ingest_s, 3),
+            "overlap_fraction": _overlap_fraction(parse_s, transfer_s,
+                                                  ingest_s),
+            "compute_s": round(build_s, 3),
             "serialize_s": round(t3 - t2, 3),
             "total_s": round(dt, 3),
             "cold_total_s": round(cold_s, 3),
-            "roofline": roofline(t2 - t1, flops=flops, hbm_bytes=hbm,
+            "roofline": roofline(build_s, flops=flops, hbm_bytes=hbm,
                                  up_bytes=up, launches=launches,
-                                 host_s=t1 - t0)}
+                                 host_s=parse_s)}
+
+
+def e2e_rf_deep_rate(n):
+    """The RandomForest 100M-row north star (ROADMAP / BASELINE.json):
+    disk CSV -> streamed ingest -> 16-tree forest at full contract scale.
+    Runs LAST with its own budget (rf_huge-style); the CPU fallback runs
+    the >=20M point (see main()) — the streamed pipeline's memory story is
+    identical there, only the kernels are slower.  The metric name is
+    size-neutral on purpose: the recorded ``n`` (100M device / 20M CPU)
+    says which point was measured — a fixed '100m' label would let a 20M
+    fallback masquerade as the full-scale number."""
+    return dict(e2e_rf_rate(n), metric="e2e_rf_deep_rows_x_trees_per_sec")
 
 
 def e2e_deep_rate(n):
@@ -734,6 +784,10 @@ WORKLOADS = {
     # train fits host memory — a wedged tunnel must not erase the only
     # ever full-scale end-to-end number)
     "e2e_deep": (e2e_deep_rate, [100_000_000]),
+    # the RF 100M north star through the streamed ingest pipeline; the
+    # CPU fallback runs the 20M point only (main() trims the ladder: a
+    # 1.6B row*tree build is genuinely device-scale work)
+    "e2e_rf_deep": (e2e_rf_deep_rate, [100_000_000, 20_000_000]),
 }
 
 
@@ -811,12 +865,13 @@ def probe_device(timeout_s=PROBE_TIMEOUT_S):
     return None
 
 
-def measure(name, env_extra, timeout_s):
+def measure(name, env_extra, timeout_s, sizes=None):
     """Run one workload in a watchdog child, largest size first.
     Returns (result_dict_or_None, wedged: bool).  A hang aborts the size
     ladder (a wedge won't finish at any size); a crash tries the next
-    smaller size (OOM territory)."""
-    for i, n in enumerate(WORKLOADS[name][1]):
+    smaller size (OOM territory).  ``sizes`` overrides the workload's
+    default ladder (e.g. the CPU-fallback trim of a deep-scale point)."""
+    for i, n in enumerate(sizes if sizes is not None else WORKLOADS[name][1]):
         code = (_CHILD_PRELUDE +
                 f"import json, bench\n"
                 f"print(json.dumps(bench.run_workload({name!r}, {n})))\n")
@@ -1021,13 +1076,19 @@ def main():
     device_ok = platform is not None and platform != "cpu"
     # materialize the disk fixtures OUTSIDE the watchdog children so their
     # one-time generation cost can't eat a timed workload's budget
-    for n_rows in sorted({n for w in ("ingest", "e2e", "e2e_rf", "e2e_deep")
-                          if w in selected
-                          for n in WORKLOADS[w][1]}):
+    fixture_sizes = {n for w in ("ingest", "e2e", "e2e_rf", "e2e_deep")
+                     if w in selected for n in WORKLOADS[w][1]}
+    if "e2e_rf_deep" in selected:
+        # device-less hosts only ever run the 20M trim (see the deep
+        # section below): don't spend minutes + ~4 GB on a 100M fixture
+        # nothing will read
+        fixture_sizes |= set(WORKLOADS["e2e_rf_deep"][1]) if device_ok \
+            else {20_000_000}
+    for n_rows in sorted(fixture_sizes):
         churn_csv(n_rows)
     results, backends = {}, {}
     for name in selected:  # dict order: nb first (the primary metric)
-        if name in ("rf_huge", "e2e_deep"):
+        if name in ("rf_huge", "e2e_deep", "e2e_rf_deep"):
             continue  # deep-scale points: run last, see below
         if name == "rf_big" and not device_ok:
             continue  # device-scale amortization point; meaningless on CPU
@@ -1088,6 +1149,25 @@ def main():
         if r is None:
             r, _ = measure("e2e_deep", {"JAX_PLATFORMS": "cpu"},
                            deep_timeout)
+            if r is not None:
+                extras.append(dict(r, backend="cpu-fallback"))
+    if "e2e_rf_deep" in selected:
+        # the RF 100M north star via the streamed ingest pipeline, last of
+        # all: nothing left for a hang to down-mode.  CPU fallback runs
+        # the >=20M point only — the streamed-pipeline story (one in-flight
+        # block, phase timings, overlap) is identical there, and 100M x 16
+        # of level kernels is genuinely device-scale compute.
+        rfd_timeout = late_timeout("BENCH_DEEP_TIMEOUT_S", 1800)
+        r = None
+        if device_ok:
+            r, wedged = measure("e2e_rf_deep", {}, rfd_timeout)
+            if r is not None:
+                extras.append(dict(r, backend="device"))
+            if wedged:
+                device_ok = False
+        if r is None:
+            r, _ = measure("e2e_rf_deep", {"JAX_PLATFORMS": "cpu"},
+                           rfd_timeout, sizes=[20_000_000])
             if r is not None:
                 extras.append(dict(r, backend="cpu-fallback"))
     emit({

@@ -28,6 +28,33 @@ def _artifact(backend, n_extras=14, value=1.0):
             "backend": backend, "extra_metrics": extras}
 
 
+def test_overlap_fraction_bounds():
+    """The streamed-ingest overlap metric: 0 when serial, 1 when the
+    shorter stage is fully hidden, clipped into [0, 1], 0 on empty."""
+    assert bench._overlap_fraction(2.0, 3.0, 5.0) == 0.0     # serial
+    assert bench._overlap_fraction(2.0, 3.0, 3.0) == 1.0     # full hide
+    assert bench._overlap_fraction(2.0, 3.0, 4.0) == 0.5
+    assert bench._overlap_fraction(0.0, 3.0, 3.0) == 0.0     # no parse side
+    assert bench._overlap_fraction(2.0, 3.0, 1.0) == 1.0     # clock noise
+    assert bench._overlap_fraction(2.0, 3.0, 9.0) == 0.0
+
+
+@pytest.mark.slow
+def test_e2e_rf_workload_reports_streaming_phases(monkeypatch, tmp_path):
+    """The real bench e2e_rf workload (shrunk; the 100M/20M sizes are
+    bench-only, marked slow here so tier-1 stays fast) runs through the
+    streaming pipeline and reports all phase-timing fields."""
+    monkeypatch.setattr(bench, "BENCH_DATA_DIR", str(tmp_path))
+    monkeypatch.setattr(bench, "RF_STREAM_BLOCK_ROWS", 8_192)
+    r = bench.e2e_rf_rate(30_000)
+    assert r["streaming"] is True
+    for key in ("parse_s", "transfer_s", "ingest_s", "compute_s",
+                "serialize_s", "overlap_fraction"):
+        assert key in r, key
+    assert 0.0 <= r["overlap_fraction"] <= 1.0
+    assert r["value"] > 0
+
+
 def test_compact_line_under_budget_and_parseable():
     line = bench.compact_line(_artifact("device", value=710_534_221.7))
     assert len(line) < bench.COMPACT_BUDGET

@@ -164,6 +164,66 @@ def test_svm_cli_train_predict(tmp_path):
     assert correct / len(lines) > 0.95
 
 
+def test_svm_cli_grouped_batched_matches_serial(tmp_path):
+    """Job-level A/B of the svm.solver knob: supportVectorMachine with
+    svm.group.field.ordinals trains one SVM per group; the batched
+    lock-step solver (svm.solver=batched, smo.train_groups_batched) must
+    emit the SAME per-group models as the serial Platt path — same group
+    keys, weights/threshold agreeing to optimization tolerance, identical
+    train-set predictions per group."""
+    schema_path = tmp_path / "schema.json"
+    schema_path.write_text(json.dumps({
+        "fields": [
+            {"name": "region", "ordinal": 0, "id": True,
+             "dataType": "string"},
+            {"name": "x1", "ordinal": 1, "dataType": "double",
+             "feature": True},
+            {"name": "x2", "ordinal": 2, "dataType": "double",
+             "feature": True},
+            {"name": "label", "ordinal": 3, "dataType": "categorical",
+             "cardinality": ["no", "yes"]},
+        ]}))
+    rows, gxy = [], {}
+    for g in range(4):
+        X, y = sep_data(60 + 10 * g, seed=20 + g, margin=1.6)
+        gxy[f"reg{g}"] = (X, y)
+        rows.extend([f"reg{g}", f"{X[i, 0]:.4f}", f"{X[i, 1]:.4f}",
+                     "yes" if y[i] > 0 else "no"] for i in range(len(y)))
+    (tmp_path / "train.csv").write_text(
+        "\n".join(",".join(r) for r in rows) + "\n")
+
+    def run(solver):
+        props = tmp_path / f"svm_{solver}.properties"
+        props.write_text("\n".join([
+            f"svm.feature.schema.file.path={schema_path}",
+            "svm.pnalty.factor=1.0",
+            "svm.positive.class.value=yes",
+            "svm.group.field.ordinals=0",
+            f"svm.solver={solver}"]) + "\n")
+        out = tmp_path / f"model_{solver}"
+        assert cli_run.main(["supportVectorMachine",
+                             f"-Dconf.path={props}",
+                             str(tmp_path / "train.csv"), str(out)]) == 0
+        weights = {}
+        for line in (out / "part-r-00000").read_text().splitlines():
+            parts = line.split(",")
+            if len(parts) > 1 and parts[1] == "weights":
+                vals = [float(v) for v in parts[2:]]
+                weights[parts[0]] = (np.array(vals[:-1]), vals[-1])
+        return weights
+
+    serial, batched = run("serial"), run("batched")
+    assert set(serial) == set(batched) == set(gxy)
+    for g, (X, y) in gxy.items():
+        ws, bs = serial[g]
+        wb, bb = batched[g]
+        cos = ws @ wb / (np.linalg.norm(ws) * np.linalg.norm(wb) + 1e-12)
+        assert cos > 0.99, (g, cos)
+        ps = np.where(X @ ws - bs >= 0, 1.0, -1.0)
+        pb = np.where(X @ wb - bb >= 0, 1.0, -1.0)
+        np.testing.assert_array_equal(ps, pb, err_msg=g)
+
+
 def test_fisher_cli(tmp_path):
     schema_path = tmp_path / "schema.json"
     schema_path.write_text(json.dumps({

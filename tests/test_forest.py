@@ -158,6 +158,108 @@ def test_random_forest_builder_job(tmp_path, mesh_ctx):
     assert files == ["tree_0.json", "tree_1.json", "tree_2.json"]
 
 
+def _table_to_csv(table, path):
+    """Write a make_table()-shaped table back to CSV text."""
+    with open(path, "w") as fh:
+        for r in range(table.n_rows):
+            row = [table.str_columns[0][r],
+                   SCHEMA.find_field_by_ordinal(1).cardinality[table.columns[1][r]],
+                   SCHEMA.find_field_by_ordinal(2).cardinality[table.columns[2][r]],
+                   str(int(table.columns[3][r])),
+                   SCHEMA.find_field_by_ordinal(4).cardinality[table.columns[4][r]]]
+            fh.write(",".join(row) + "\n")
+
+
+def test_streamed_forest_bit_identical_to_monolithic(tmp_path, mesh_ctx):
+    """The streaming CSV->device ingest pipeline (chunked parse ->
+    per-block device upload/branch encode -> position-scattered bootstrap
+    weights) must produce byte-identical models to the monolithic path:
+    same level histograms, same split choices, same JSON.  Odd chunk size
+    on the 8-device mesh forces per-block padding to interleave pad rows
+    mid-array — the layout the positional weight expansion exists for."""
+    from avenir_tpu.core.table import (iter_csv_chunks, load_csv,
+                                       prefetch_chunks)
+    from avenir_tpu.models.forest import build_forest_from_stream
+    table = make_table(1100)
+    csv = tmp_path / "stream.csv"
+    _table_to_csv(table, csv)
+    params = ForestParams(num_trees=4, seed=11)
+    params.tree.max_depth = 3
+    mono = build_forest(load_csv(str(csv), SCHEMA), params, mesh_ctx)
+    for chunk_rows in (257, 1100, 4096):  # mid-block, exact, single-block
+        stats = {}
+        blocks = prefetch_chunks(
+            iter_csv_chunks(str(csv), SCHEMA, ",", chunk_rows=chunk_rows),
+            stats=stats)
+        streamed = build_forest_from_stream(blocks, SCHEMA, params,
+                                            mesh_ctx, stats=stats)
+        assert [m.to_json() for m in streamed] == \
+            [m.to_json() for m in mono], chunk_rows
+        assert stats["parse_s"] >= 0 and stats["transfer_s"] >= 0
+        assert stats["ingest_wall_s"] > 0 and stats["build_s"] > 0
+
+
+def test_streamed_level_histograms_bit_equal(mesh_ctx):
+    """Level-0 frontier histogram accumulated over streamed row blocks ==
+    the monolithic builder's, bit for bit (the per-block pad rows carry
+    zero weight and must vanish from the counts)."""
+    from avenir_tpu.models.tree import TreeBuilder, TreeParams
+    table = make_table(700)
+    params = TreeParams(seed=5)
+    mono = TreeBuilder(table, params, mesh_ctx)
+    blocks = [table.take_rows(lo, min(lo + 111, table.n_rows))
+              for lo in range(0, table.n_rows, 111)]
+    streamed = TreeBuilder.from_stream(iter(blocks), SCHEMA, params,
+                                       mesh_ctx)
+    assert streamed.n_rows == mono.n_rows
+    for b in (mono, streamed):
+        b._w_max, b._w_integral = 1.0, True
+    import numpy as _np
+    w_m = mono.ctx.shard_rows(mono._expand_weights(None))
+    w_s = streamed.ctx.shard_rows(streamed._expand_weights(None))
+    ids_m = mono.ctx.shard_rows(_np.zeros((mono.n_padded,), _np.int32))
+    ids_s = streamed.ctx.shard_rows(
+        _np.zeros((streamed.n_padded,), _np.int32))
+    np.testing.assert_array_equal(mono.level_counts(ids_m, w_m, 1),
+                                  streamed.level_counts(ids_s, w_s, 1))
+
+
+def test_streaming_rf_builder_job_knob(tmp_path, mesh_ctx):
+    """dtb.streaming.ingest=true routes the randomForestBuilder job through
+    the chunked pipeline; tree JSONs must match the monolithic job's."""
+    table = make_table(500)
+    csv = tmp_path / "in.csv"
+    _table_to_csv(table, csv)
+    schema_path = tmp_path / "s.json"
+    schema_path.write_text(json.dumps({"fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "custType", "ordinal": 1, "dataType": "categorical",
+         "feature": True, "maxSplit": 2, "cardinality": ["business", "residence"]},
+        {"name": "issue", "ordinal": 2, "dataType": "categorical", "feature": True,
+         "maxSplit": 2, "cardinality": ["internet", "cable", "billing", "other"]},
+        {"name": "holdTime", "ordinal": 3, "dataType": "int", "feature": True,
+         "min": 0, "max": 600, "splitScanInterval": 120},
+        {"name": "hungup", "ordinal": 4, "dataType": "categorical",
+         "cardinality": ["T", "F"]}]}))
+    base_props = ("field.delim.regex=,\n"
+                  f"dtb.feature.schema.file.path={schema_path}\n"
+                  "dtb.max.depth.limit=2\n"
+                  "dtb.num.trees=3\n")
+    outputs = {}
+    for mode, extra in [("mono", ""),
+                        ("stream", "dtb.streaming.ingest=true\n"
+                                   "dtb.streaming.block.rows=128\n")]:
+        props = tmp_path / f"rafo_{mode}.properties"
+        props.write_text(base_props + extra)
+        out = tmp_path / f"forest_{mode}"
+        rc = cli_run.main(["randomForestBuilder", f"-Dconf.path={props}",
+                           str(csv), str(out)])
+        assert rc == 0
+        outputs[mode] = {f: (out / f).read_text()
+                         for f in sorted(os.listdir(out))}
+    assert outputs["mono"] == outputs["stream"]
+
+
 def test_batched_forest_identical_to_sequential(mesh_ctx):
     """ForestBuilder (all trees one level per launch) must produce
     bit-identical models to the sequential per-tree loop: same bootstraps,

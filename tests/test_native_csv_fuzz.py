@@ -12,8 +12,9 @@ import numpy as np
 import pytest
 
 from avenir_tpu.core.schema import FeatureSchema
-from avenir_tpu.core.table import load_csv
-from avenir_tpu.io.native_csv import get_lib, native_load_csv
+from avenir_tpu.core.table import ColumnarTable, iter_csv_chunks, load_csv
+from avenir_tpu.io.native_csv import (get_lib, native_load_csv,
+                                      native_open_csv)
 
 pytestmark = pytest.mark.skipif(get_lib() is None,
                                 reason="native csv library unavailable")
@@ -128,3 +129,77 @@ def test_native_matches_oracle_on_random_input(tmp_path, seed, monkeypatch):
         else:
             assert list(native.str_columns[o]) \
                 == list(oracle.str_columns[o]), f"str field {o} seed {seed}"
+
+
+def _assert_tables_bit_equal(got, want, label):
+    """Every encoded column, bin-code cache and string column identical."""
+    assert got.n_rows == want.n_rows, label
+    for f in want.schema.fields:
+        o = f.ordinal
+        if f.is_categorical or f.is_numeric:
+            np.testing.assert_array_equal(got.columns[o], want.columns[o],
+                                          err_msg=f"col {o} {label}")
+            assert got.columns[o].dtype == want.columns[o].dtype
+            if f.is_numeric and f.bucket_width is not None:
+                np.testing.assert_array_equal(
+                    got.binned_codes(o), want.binned_codes(o),
+                    err_msg=f"bin codes {o} {label}")
+        else:
+            assert list(got.str_columns[o]) == list(want.str_columns[o]), \
+                f"str field {o} {label}"
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_chunked_parse_assembles_bit_identical(tmp_path, seed, monkeypatch):
+    """Streaming ingest oracle: NativeCsvReader.parse_chunk blocks (random
+    chunk size, so boundaries fall mid-file) assembled with
+    ColumnarTable.from_chunks must be byte-identical to the whole-file
+    native_load_csv AND the python oracle — same fuzzed schemas/field text
+    as the monolithic fuzz above."""
+    rng = np.random.default_rng(7000 + seed)
+    threads = int(rng.choice([0, 1, 3]))
+    if threads:
+        monkeypatch.setenv("AVENIR_TPU_INGEST_THREADS", str(threads))
+    schema = _random_schema(rng)
+    n = int(rng.integers(1, 500))
+    lines = []
+    for i in range(n):
+        row = [""] * schema.num_columns
+        row[0] = f"id{i:05d}"
+        for f in schema.fields:
+            if f.ordinal == 0:
+                continue
+            row[f.ordinal] = _random_field_text(rng, f)
+        lines.append(",".join(row))
+        if rng.random() < 0.05:
+            lines.append(" " * int(rng.integers(0, 4)))
+    term = "\r\n" if rng.random() < 0.3 else "\n"
+    p = tmp_path / "fuzz_chunked.csv"
+    p.write_bytes((term.join(lines) + term).encode())
+
+    whole = native_load_csv(str(p), schema, ",")
+    assert whole is not None
+    chunk_rows = int(rng.integers(1, whole.n_rows + 2))
+
+    # explicit reader API (parse_chunk over the shared mmap/line index)
+    reader = native_open_csv(str(p), schema, ",")
+    assert reader is not None
+    with reader:
+        assert reader.n_rows == whole.n_rows
+        chunks = [reader.parse_chunk(lo, min(chunk_rows,
+                                             reader.n_rows - lo))
+                  for lo in range(0, reader.n_rows, chunk_rows)]
+    if chunks:
+        assembled = ColumnarTable.from_chunks(chunks)
+        _assert_tables_bit_equal(assembled, whole,
+                                 f"seed {seed} chunk {chunk_rows}")
+
+    # the iterator facade (what streamed jobs consume), native and oracle
+    for use_native in (True, False):
+        blocks = list(iter_csv_chunks(str(p), schema, ",",
+                                      chunk_rows=chunk_rows,
+                                      use_native=use_native))
+        if blocks:
+            _assert_tables_bit_equal(
+                ColumnarTable.from_chunks(blocks), whole,
+                f"seed {seed} iter native={use_native}")
