@@ -10,13 +10,13 @@ scripts keep working by swapping the ``hadoop jar``/``spark-submit`` line for
 from __future__ import annotations
 
 import os
-from typing import Callable, Dict, List
+from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
 from ..core.config import Config
 from ..core.schema import FeatureSchema
-from ..core.table import load_csv
+from ..core.table import BadRecordPolicy, load_csv
 from ..core.metrics import Counters, CostBasedArbitrator
 from ..core import artifacts
 from ..parallel.mesh import runtime_context
@@ -75,6 +75,27 @@ def _schema_path(cfg: Config, key: str) -> FeatureSchema:
     return FeatureSchema.load(cfg.must_get(key))
 
 
+def _bad_records_policy(cfg: Config, counters: Counters,
+                        out_path: Optional[str] = None
+                        ) -> Optional[BadRecordPolicy]:
+    """The job-level ``badrecords.policy`` knob (fail|skip|quarantine):
+    Hadoop's skip-bad-records, rebuilt for the native ingest.  Quarantined
+    raw lines land in ``badrecords.quarantine.path`` (default
+    ``<out>/_quarantine``); skip/quarantine tallies surface through the
+    job's Hadoop-style counter dump (``BadRecords`` group)."""
+    pol = cfg.get("badrecords.policy", "fail")
+    if pol == "fail":
+        return None
+    qpath = cfg.get("badrecords.quarantine.path")
+    if pol == "quarantine" and not qpath:
+        if not out_path:
+            raise ValueError("badrecords.policy=quarantine needs "
+                             "badrecords.quarantine.path (no output dir "
+                             "to default under)")
+        qpath = os.path.join(out_path, "_quarantine")
+    return BadRecordPolicy(pol, qpath, counters)
+
+
 def _splitter(delim_regex: str):
     """Line splitter honoring field.delim.regex semantics: literal fast path,
     re.split otherwise (mirrors core.table._tokenize)."""
@@ -125,7 +146,8 @@ def decision_tree_builder(cfg: Config, in_path: str, out_path: str) -> Counters:
     from ..models import tree as T
     counters = Counters()
     schema = _schema_path(cfg, "dtb.feature.schema.file.path")
-    table = load_csv(in_path, schema, cfg.field_delim_regex, keep_raw=True)
+    table = load_csv(in_path, schema, cfg.field_delim_regex, keep_raw=True,
+                     bad_records=_bad_records_policy(cfg, counters, out_path))
     params = _tree_params(cfg)
     builder = T.TreeBuilder(table, params, runtime_context())
     dec_in = cfg.get("dtb.decision.file.path.in")
@@ -151,7 +173,14 @@ def random_forest_builder(cfg: Config, in_path: str, out_path: str) -> Counters:
     pipeline (block size ``dtb.streaming.block.rows``): host memory holds
     one parsed block instead of the whole encoded dataset — the knob that
     makes the 100M-row flagship CSV feasible.  Models are bit-identical to
-    the monolithic path."""
+    the monolithic path.
+
+    Fault tolerance (TPU_NOTES §15): ``badrecords.policy`` skips or
+    quarantines malformed records; ``dtb.streaming.checkpoint.dir`` (+
+    ``dtb.streaming.checkpoint.blocks``, default 16) persists ingest
+    progress so ``dtb.streaming.resume=true`` (CLI ``--resume``) restarts
+    from the last intact step and still produces the bit-identical model
+    of an uninterrupted run."""
     from ..models.forest import (ForestParams, build_forest,
                                  build_forest_from_stream)
     counters = Counters()
@@ -159,15 +188,61 @@ def random_forest_builder(cfg: Config, in_path: str, out_path: str) -> Counters:
     params = ForestParams(tree=_tree_params(cfg),
                           num_trees=cfg.get_int("dtb.num.trees", 5),
                           seed=cfg.get_int("dtb.random.seed", 0))
+    policy = _bad_records_policy(cfg, counters, out_path)
+    if cfg.get_boolean("dtb.streaming.resume", False) and \
+            not cfg.get_boolean("dtb.streaming.ingest", False):
+        # same refusal as the missing-checkpoint-dir case: a --resume that
+        # silently retrains from row 0 through the monolithic path is the
+        # failure mode the flag exists to prevent
+        raise ValueError("dtb.streaming.resume needs "
+                         "dtb.streaming.ingest=true (checkpoints only "
+                         "exist for the streaming build)")
     if cfg.get_boolean("dtb.streaming.ingest", False):
+        from ..core.checkpoint import CheckpointManager
         from ..core.table import iter_csv_chunks, prefetch_chunks
+        ckpt_dir = cfg.get("dtb.streaming.checkpoint.dir")
+        mgr = CheckpointManager(ckpt_dir) if ckpt_dir else None
+        every = cfg.get_int("dtb.streaming.checkpoint.blocks", 16) \
+            if mgr is not None else 0
+        resume_state = None
+        start_row = 0
+        if cfg.get_boolean("dtb.streaming.resume", False):
+            if mgr is None:
+                # a silently-ignored resume flag would re-ingest from row 0
+                # while the operator believes the job picked up where it
+                # left off — refuse instead
+                raise ValueError("dtb.streaming.resume needs "
+                                 "dtb.streaming.checkpoint.dir")
+            try:
+                step, arrays, meta = mgr.restore()
+            except FileNotFoundError:
+                if mgr.steps():
+                    # steps exist but NONE are intact — re-ingesting from
+                    # row 0 as if this were a cold start is the silent
+                    # failure the resume flag exists to prevent
+                    raise RuntimeError(
+                        f"dtb.streaming.resume: checkpoint dir "
+                        f"{ckpt_dir!r} holds {len(mgr.steps())} step(s) "
+                        f"but none restore intact; refusing to silently "
+                        f"restart from row 0 — clear the dir to force a "
+                        f"cold start")
+                pass  # genuinely nothing saved yet: cold start
+            else:
+                resume_state = (arrays, meta)
+                start_row = int(meta.get("source_rows_done") or 0)
+                counters.set("Checkpoint", "ResumedFromStep", step)
+                counters.set("Checkpoint", "ResumedSourceRows", start_row)
         blocks = prefetch_chunks(iter_csv_chunks(
             in_path, schema, cfg.field_delim_regex,
-            chunk_rows=cfg.get_int("dtb.streaming.block.rows", 1 << 22)))
-        models = build_forest_from_stream(blocks, schema, params,
-                                          runtime_context())
+            chunk_rows=cfg.get_int("dtb.streaming.block.rows", 1 << 22),
+            bad_records=policy, start_row=start_row))
+        models = build_forest_from_stream(
+            blocks, schema, params, runtime_context(),
+            checkpoint=mgr, checkpoint_every=every,
+            resume_state=resume_state)
     else:
-        table = load_csv(in_path, schema, cfg.field_delim_regex)
+        table = load_csv(in_path, schema, cfg.field_delim_regex,
+                         bad_records=policy)
         models = build_forest(table, params, runtime_context())
     os.makedirs(out_path, exist_ok=True)
     for i, dpl in enumerate(models):
@@ -781,7 +856,8 @@ def bayesian_distribution(cfg: Config, in_path: str, out_path: str) -> Counters:
         counters.set("Distribution Data", "Vocabulary", len(model_t.vocab))
         return counters
     schema = _schema_path(cfg, "bad.feature.schema.file.path")
-    table = load_csv(in_path, schema, cfg.field_delim_regex)
+    table = load_csv(in_path, schema, cfg.field_delim_regex,
+                     bad_records=_bad_records_policy(cfg, counters, out_path))
     ctx = runtime_context()
     model = bayes.train(table, ctx, counters)
     artifacts.write_text_output(out_path, model.to_lines(cfg.field_delim_out))

@@ -73,6 +73,10 @@ def parse_args(argv: List[str]):
     for a in argv:
         if a.startswith("-Dconf.path="):
             conf_path = a.split("=", 1)[1]
+        elif a == "--resume":
+            # restart a checkpointed streaming job from its last intact
+            # step (sugar for -Ddtb.streaming.resume=true)
+            overrides["dtb.streaming.resume"] = "true"
         elif a.startswith("-D"):
             k, _, v = a[2:].partition("=")
             overrides[k] = v

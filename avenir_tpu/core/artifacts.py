@@ -20,6 +20,8 @@ import json
 import os
 from typing import Any, Iterable, List, Optional
 
+from .faults import fault_point, with_retry
+
 
 def write_text_output(dir_path: str, lines: Iterable[str],
                       part: Optional[int] = None, role: str = "r",
@@ -45,10 +47,17 @@ def write_text_output(dir_path: str, lines: Iterable[str],
                 part = jax.process_index()
     os.makedirs(dir_path, exist_ok=True)
     path = os.path.join(dir_path, f"part-{role}-{part:05d}")
-    with open(path, "w") as fh:
-        for line in lines:
-            fh.write(line)
-            fh.write("\n")
+    # materialize once so a retried write re-emits identical content even
+    # when the caller passed a one-shot generator
+    lines = list(lines)
+
+    def write():
+        fault_point("artifact_write")
+        with open(path, "w") as fh:
+            for line in lines:
+                fh.write(line)
+                fh.write("\n")
+    with_retry(write, what=f"artifact write {path}")
     return path
 
 
@@ -76,8 +85,12 @@ def write_json(path: str, obj: Any, indent: int = 2) -> str:
     parent = os.path.dirname(path)
     if parent:
         os.makedirs(parent, exist_ok=True)
-    with open(path, "w") as fh:
-        json.dump(obj, fh, indent=indent)
+
+    def write():
+        fault_point("artifact_write")
+        with open(path, "w") as fh:
+            json.dump(obj, fh, indent=indent)
+    with_retry(write, what=f"artifact write {path}")
     return path
 
 

@@ -22,14 +22,92 @@ shared library is available; the numpy path is the fallback and the oracle.
 from __future__ import annotations
 
 import io
+import os
 import re
+import warnings
 from collections.abc import Sequence
 from dataclasses import dataclass, field as dc_field
 from typing import Dict, List, Optional, Union
 
 import numpy as np
 
+from .faults import fault_point, with_retry
+from .metrics import Counters
 from .schema import FeatureField, FeatureSchema
+
+
+# --------------------------------------------------------------------------
+# bad-record policy (Hadoop skip-bad-records, rebuilt natively)
+# --------------------------------------------------------------------------
+
+@dataclass
+class BadRecordPolicy:
+    """What to do with a malformed CSV record (short row, or a numeric
+    field that fails to parse — the native parser's ``bad`` contract;
+    unknown categorical values encode as -1 and are NOT malformed):
+
+      * ``fail``        — raise, killing the job (the historic behavior)
+      * ``skip``        — drop the record, count it
+      * ``quarantine``  — drop the record, count it, AND append its raw
+        line to ``<quarantine_path>/part-q-00000`` for offline triage
+        (the reference substrate's skipped-records output)
+
+    Counters land in the Hadoop-style ``BadRecords`` group: ``Malformed``
+    (total seen), ``Skipped``, ``Quarantined``.  Reporting is at-least-
+    once across crash+resume: records between the last checkpoint and a
+    crash are re-reported when the stream re-reads them.
+    """
+
+    policy: str = "fail"
+    quarantine_path: Optional[str] = None
+    counters: Optional[Counters] = None
+    n_bad: int = 0
+
+    POLICIES = ("fail", "skip", "quarantine")
+
+    def __post_init__(self):
+        if self.policy not in self.POLICIES:
+            raise ValueError(f"badrecords.policy must be one of "
+                             f"{self.POLICIES}, got {self.policy!r}")
+        if self.policy == "quarantine" and not self.quarantine_path:
+            raise ValueError("badrecords.policy=quarantine needs a "
+                             "quarantine path")
+
+    @property
+    def skips(self) -> bool:
+        return self.policy in ("skip", "quarantine")
+
+    def quarantine_file(self) -> str:
+        os.makedirs(self.quarantine_path, exist_ok=True)
+        return os.path.join(self.quarantine_path, "part-q-00000")
+
+    def record(self, lines: Sequence[str]) -> None:
+        """Report (and for quarantine, persist) a batch of malformed raw
+        lines.  Appends, so resumed runs accumulate into one part file.
+        The quarantine write happens FIRST (one buffered write call) and
+        counters bump only after it succeeds: a write that fails and gets
+        the whole chunk retried must not have already inflated the
+        tallies (the file itself stays at-least-once — a mid-append fault
+        can duplicate lines on retry, exactly like a re-run Hadoop
+        task)."""
+        n = len(lines)
+        if n == 0:
+            return
+        if self.policy == "quarantine":
+            path = self.quarantine_file()
+            payload = "".join(line + "\n" for line in lines)
+
+            def write():
+                fault_point("artifact_write")
+                with open(path, "a") as fh:
+                    fh.write(payload)
+            with_retry(write, what=f"quarantine append to {path}")
+            if self.counters is not None:
+                self.counters.increment("BadRecords", "Quarantined", n)
+        self.n_bad += n
+        if self.counters is not None:
+            self.counters.increment("BadRecords", "Malformed", n)
+            self.counters.increment("BadRecords", "Skipped", n)
 
 
 class LazyStringColumn(Sequence):
@@ -251,15 +329,66 @@ def _concat_lazy_strings(cols: Sequence[LazyStringColumn]
     return LazyStringColumn(b"".join(blobs), np.concatenate(parts))
 
 
+def _filter_lazy_strings(col, keep: np.ndarray):
+    """Drop the rows where ``keep`` is False from a blob+offsets string
+    column (the native chunk reader's form) without decoding kept rows;
+    plain lists filter by mask.  Bad rows are sparse, so the blob is
+    rebuilt from the contiguous runs BETWEEN dropped rows — O(n_bad)
+    slices, not one slice per kept row (a multi-million-row block with
+    one bad record must not pay millions of tiny allocations)."""
+    if not isinstance(col, LazyStringColumn):
+        return [v for v, k in zip(col, keep) if k]
+    offs = np.asarray(col._offsets, dtype=np.int64)
+    n = len(keep)
+    parts = []
+    lo = 0
+    for b in np.nonzero(~keep)[0]:
+        if b > lo:
+            parts.append(col._blob[offs[lo]:offs[b]])
+        lo = int(b) + 1
+    if lo < n:
+        parts.append(col._blob[offs[lo]:offs[n]])
+    idx = np.nonzero(keep)[0]
+    lens = offs[1:] - offs[:-1]
+    new_offs = np.zeros(len(idx) + 1, dtype=np.int64)
+    np.cumsum(lens[idx], out=new_offs[1:])
+    return LazyStringColumn(b"".join(parts), new_offs)
+
+
+def _bad_row_checker(schema: FeatureSchema):
+    """Per-row malformedness test matching the native parser's ``bad``
+    contract: short row (any schema field's ordinal missing) or a numeric
+    field that fails ``float()``.  (The python oracle's float grammar is
+    slightly laxer than the C one — '1_0', unicode digits — exactly as on
+    the fail path; genuinely corrupt fields fail both.)"""
+    need = max((f.ordinal for f in schema.fields), default=-1)
+    numeric_ords = [f.ordinal for f in schema.fields if f.is_numeric]
+
+    def bad(r: List[str]) -> bool:
+        if len(r) <= need:
+            return True
+        for o in numeric_ords:
+            try:
+                float(r[o])
+            except (TypeError, ValueError):
+                return True
+        return False
+    return bad
+
+
+def _make_splitter(delim_regex: str):
+    """ONE line-splitter for every parse path (tokenize, policy filter,
+    chunk iterators): literal fast path when the regex is a plain string,
+    compiled re.split otherwise."""
+    if re.escape(delim_regex) == delim_regex:
+        return lambda line: line.split(delim_regex)
+    return re.compile(delim_regex).split
+
+
 def _tokenize(text: str, delim_regex: str) -> List[List[str]]:
     """Split lines on the reference's field.delim.regex (usually a plain ',')."""
-    rows: List[List[str]] = []
-    plain = re.escape(delim_regex) == delim_regex  # fast path for literal delims
-    for line in text.splitlines():
-        if not line.strip():
-            continue
-        rows.append(line.split(delim_regex) if plain else re.split(delim_regex, line))
-    return rows
+    split = _make_splitter(delim_regex)
+    return [split(line) for line in text.splitlines() if line.strip()]
 
 
 def encode_rows(rows: List[List[str]], schema: FeatureSchema,
@@ -290,14 +419,22 @@ def encode_rows(rows: List[List[str]], schema: FeatureSchema,
 
 def load_csv(source: Union[str, io.TextIOBase], schema: FeatureSchema,
              delim_regex: str = ",", keep_raw: bool = False,
-             use_native: bool = True) -> ColumnarTable:
+             use_native: bool = True,
+             bad_records: Optional[BadRecordPolicy] = None) -> ColumnarTable:
     """Load a CSV file (path or file object) into a ColumnarTable.
 
     Uses the native C++ tokenizer/encoder when available and the delimiter is a
     literal single character; otherwise the pure-python path.
+
+    ``bad_records`` with a skipping policy (skip/quarantine) drops
+    malformed records instead of raising; the monolithic load runs the
+    python oracle path for it (per-record filtering needs the raw lines —
+    the streaming path, ``iter_csv_chunks``, keeps the native fast path
+    under the same policy).
     """
+    skipping = bad_records is not None and bad_records.skips
     if isinstance(source, str):
-        if use_native and len(delim_regex) == 1:
+        if use_native and len(delim_regex) == 1 and not skipping:
             try:
                 from ..io.native_csv import native_load_csv
                 t = native_load_csv(source, schema, delim_regex, keep_raw=keep_raw)
@@ -314,13 +451,32 @@ def load_csv(source: Union[str, io.TextIOBase], schema: FeatureSchema,
             text = fh.read()
     else:
         text = source.read()
-    rows = _tokenize(text, delim_regex)
-    return encode_rows(rows, schema, keep_raw=keep_raw)
+    return load_csv_text(text, schema, delim_regex, keep_raw=keep_raw,
+                         bad_records=bad_records)
 
 
 def load_csv_text(text: str, schema: FeatureSchema, delim_regex: str = ",",
-                  keep_raw: bool = False) -> ColumnarTable:
-    return encode_rows(_tokenize(text, delim_regex), schema, keep_raw=keep_raw)
+                  keep_raw: bool = False,
+                  bad_records: Optional[BadRecordPolicy] = None
+                  ) -> ColumnarTable:
+    if bad_records is None or not bad_records.skips:
+        return encode_rows(_tokenize(text, delim_regex), schema,
+                           keep_raw=keep_raw)
+    split = _make_splitter(delim_regex)
+    is_bad = _bad_row_checker(schema)
+    rows: List[List[str]] = []
+    bad_lines: List[str] = []
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        r = split(line)
+        if is_bad(r):
+            bad_lines.append(line)
+        else:
+            rows.append(r)
+    table = encode_rows(rows, schema, keep_raw=keep_raw)
+    bad_records.record(bad_lines)  # side effects after the fallible encode
+    return table
 
 
 # --------------------------------------------------------------------------
@@ -329,34 +485,58 @@ def load_csv_text(text: str, schema: FeatureSchema, delim_regex: str = ",",
 
 def _iter_csv_chunks_python(path: str, schema: FeatureSchema,
                             delim_regex: str, chunk_rows: int,
-                            skip_rows: int = 0):
+                            skip_rows: int = 0,
+                            bad_records: Optional[BadRecordPolicy] = None):
     """Oracle-equivalent streamed parse: read the file line by line (never
     the whole text in memory), encode every ``chunk_rows`` non-blank rows.
-    ``skip_rows`` resumes after a partially-consumed native stream."""
-    plain = re.escape(delim_regex) == delim_regex
-    pat = None if plain else re.compile(delim_regex)
+    ``skip_rows`` resumes after a partially-consumed native stream (or a
+    checkpoint): it counts SOURCE rows (non-blank lines), the same axis
+    every yielded chunk reports via ``source_row_end``."""
+    split = _make_splitter(delim_regex)
+    skipping = bad_records is not None and bad_records.skips
+    is_bad = _bad_row_checker(schema) if skipping else None
     rows: List[List[str]] = []
-    skipped = 0
+    bad_lines: List[str] = []
+    consumed = 0   # non-blank source lines consumed, absolute
+    block_idx = 0
     with open(path, "r") as fh:
         for line in fh:
             line = line.rstrip("\r\n")  # same record set as str.splitlines
             if not line.strip():        # for \n / \r\n terminated CSVs
                 continue
-            if skipped < skip_rows:
-                skipped += 1
+            consumed += 1
+            if consumed <= skip_rows:
                 continue
-            rows.append(line.split(delim_regex) if plain
-                        else pat.split(line))
+            r = split(line)
+            if skipping and is_bad(r):
+                bad_lines.append(line)
+                continue
+            rows.append(r)
             if len(rows) >= chunk_rows:
-                yield encode_rows(rows, schema)
+                fault_point("chunk_encode", block_idx)
+                chunk = encode_rows(rows, schema)
+                if bad_lines:
+                    bad_records.record(bad_lines)
+                    bad_lines = []
+                chunk.source_row_end = consumed
+                yield chunk
                 rows = []
-    if rows:
-        yield encode_rows(rows, schema)
+                block_idx += 1
+    if rows or bad_lines:
+        fault_point("chunk_encode", block_idx)
+        chunk = encode_rows(rows, schema) if rows else None
+        if bad_lines:
+            bad_records.record(bad_lines)
+        if chunk is not None:
+            chunk.source_row_end = consumed
+            yield chunk
 
 
 def iter_csv_chunks(path: str, schema: FeatureSchema,
                     delim_regex: str = ",", chunk_rows: int = 1 << 22,
-                    use_native: bool = True):
+                    use_native: bool = True,
+                    bad_records: Optional[BadRecordPolicy] = None,
+                    start_row: int = 0):
     """Yield a CSV as ColumnarTable row blocks of up to ``chunk_rows`` rows
     — the parse stage of the streaming CSV->device ingest pipeline.  Host
     memory holds one encoded block at a time instead of the whole dataset
@@ -367,11 +547,21 @@ def iter_csv_chunks(path: str, schema: FeatureSchema,
     whether the .so built, so any native failure — including a mid-stream
     ValueError from the C float grammar being stricter than python's —
     resumes the stream from the python oracle at the exact row already
-    reached.  Blocks concatenate (ColumnarTable.from_chunks) to the same
-    table load_csv produces."""
+    reached (with a degradation warning).  Blocks concatenate
+    (ColumnarTable.from_chunks) to the same table load_csv produces.
+
+    Fault tolerance: each native chunk read passes through
+    ``core.faults.with_retry`` (transient OSError/MemoryError retries
+    with backoff before the python fallback engages), ``bad_records``
+    applies the skip/quarantine policy per block, and ``start_row``
+    restarts the stream at a SOURCE row index (non-blank line count) —
+    the checkpoint/resume contract; every yielded chunk reports its own
+    ``source_row_end`` on that axis."""
     if chunk_rows <= 0:
         raise ValueError(f"chunk_rows must be positive, got {chunk_rows}")
-    done_rows = 0
+    if start_row < 0:
+        raise ValueError(f"start_row must be >= 0, got {start_row}")
+    done_rows = int(start_row)
     if use_native and len(delim_regex) == 1:
         reader = None
         try:
@@ -380,21 +570,40 @@ def iter_csv_chunks(path: str, schema: FeatureSchema,
         except Exception:
             reader = None
         if reader is not None:
-            try:
+            native_done = False
+            with reader:  # closed on EVERY exit path, incl. GeneratorExit
                 n = reader.n_rows
+                block_idx = 0
                 try:
                     while done_rows < n:
                         take = min(chunk_rows, n - done_rows)
-                        chunk = reader.parse_chunk(done_rows, take)
+
+                        def read_block(lo=done_rows, m=take, i=block_idx):
+                            fault_point("chunk_read", i)
+                            return reader.parse_chunk(
+                                lo, m, bad_records=bad_records)
+
+                        chunk = with_retry(
+                            read_block,
+                            what=f"chunk read [{done_rows}, "
+                                 f"{done_rows + take}) of {path!r}")
+                        chunk.source_row_end = done_rows + take
                         yield chunk
                         done_rows += take
-                    return
-                except (ValueError, MemoryError, OSError):
-                    pass  # python oracle resumes at done_rows below
-            finally:
-                reader.close()
+                        block_idx += 1
+                    native_done = True
+                except (ValueError, MemoryError, OSError) as exc:
+                    # python oracle resumes at done_rows below
+                    warnings.warn(
+                        f"native CSV reader failed mid-stream at row "
+                        f"{done_rows} of {path!r} ({type(exc).__name__}: "
+                        f"{exc}); degrading to the python parser",
+                        RuntimeWarning)
+            if native_done:
+                return
     yield from _iter_csv_chunks_python(path, schema, delim_regex,
-                                       chunk_rows, skip_rows=done_rows)
+                                       chunk_rows, skip_rows=done_rows,
+                                       bad_records=bad_records)
 
 
 def prefetch_chunks(chunks, depth: int = 1, stats: Optional[dict] = None):
@@ -427,8 +636,13 @@ def prefetch_chunks(chunks, depth: int = 1, stats: Optional[dict] = None):
         return False
 
     def produce():
-        it = iter(chunks)
+        it = None
         try:
+            # inside the try: a raising __iter__ must surface on the
+            # consumer side like any mid-stream failure, not kill the
+            # thread before `end` is queued (which would hang the consumer
+            # forever on q.get())
+            it = iter(chunks)
             while not stop.is_set():
                 t0 = _time.perf_counter()
                 try:

@@ -376,12 +376,18 @@ struct Spec {
 // The whole-file avt_fill is the (0, n) case; avt_fill_range exposes the
 // row-block form for the streaming ingest pipeline (a background thread
 // parses block i+1 while block i is in flight to the device).
+// row_bad (nullable): caller-zeroed span-length uint8 buffer; row o gets 1
+// when ANY requested field of that row was missing or failed numeric parse
+// — the per-row malformed-record report the skip/quarantine bad-record
+// policies filter on (threads write disjoint output rows, so the plain
+// stores race-free).
 int64_t fill_range(Handle* h, int64_t row_lo, int64_t row_hi, int n_cols,
                    const int32_t* ords, const int32_t* kinds, void** outs,
                    const char*** vocabs, const int32_t* vocab_ns,
                    int64_t* bad_out, void** bin_outs,
                    const double* bin_widths,
-                   const int32_t* bin_offsets) try {
+                   const int32_t* bin_offsets,
+                   uint8_t* row_bad) try {
     const int64_t span = row_hi - row_lo;
     const char delim = h->delim;
     const char* buf = h->data;
@@ -452,6 +458,8 @@ int64_t fill_range(Handle* h, int64_t row_lo, int64_t row_hi, int n_cols,
                     }
                     if (exhausted) {  // short row: missing for this spec
                         ++bad[static_cast<size_t>(s.bad_idx)];
+                        if (row_bad != nullptr)
+                            row_bad[o] = 1;
                         if (s.kind == KIND_NUMERIC
                             || s.kind == KIND_NUMERIC_BINNED) {
                             static_cast<double*>(s.out)[o] = 0.0;
@@ -485,6 +493,8 @@ int64_t fill_range(Handle* h, int64_t row_lo, int64_t row_hi, int n_cols,
                                 && !parse_general_number(v, &d))) {
                             d = 0.0;
                             ++bad[static_cast<size_t>(s.bad_idx)];
+                            if (row_bad != nullptr)
+                                row_bad[o] = 1;
                         }
                         static_cast<double*>(s.out)[o] = d;
                         if (s.bin_out != nullptr)
@@ -638,18 +648,20 @@ int64_t avt_n_rows(void* hp) {
 //   emission (KIND_NUMERIC_BINNED only, else null/ignored); all three
 //   may be null when no column requests binning.
 // bad_out[i] counts rows whose field was missing (all kinds) or failed
-// numeric parse; unknown categorical values are -1, NOT bad.  Returns 0,
-// or -1 on allocation failure (caller falls back to the python path).
+// numeric parse; unknown categorical values are -1, NOT bad.  row_bad
+// (nullable) is a caller-zeroed n-row uint8 buffer reporting WHICH rows
+// were malformed (the skip/quarantine policies' filter input).  Returns
+// 0, or -1 on allocation failure (caller falls back to the python path).
 int64_t avt_fill(void* hp, int n_cols, const int32_t* ords,
                  const int32_t* kinds, void** outs,
                  const char*** vocabs, const int32_t* vocab_ns,
                  int64_t* bad_out, void** bin_outs,
                  const double* bin_widths,
-                 const int32_t* bin_offsets) {
+                 const int32_t* bin_offsets, uint8_t* row_bad) {
     auto* h = static_cast<Handle*>(hp);
     return fill_range(h, 0, avt_n_rows(hp), n_cols, ords, kinds, outs,
                       vocabs, vocab_ns, bad_out, bin_outs, bin_widths,
-                      bin_offsets);
+                      bin_offsets, row_bad);
 }
 
 // Row-block form of avt_fill: fill rows [row_lo, row_hi) of the line
@@ -664,13 +676,27 @@ int64_t avt_fill_range(void* hp, int64_t row_lo, int64_t row_hi,
                        const char*** vocabs, const int32_t* vocab_ns,
                        int64_t* bad_out, void** bin_outs,
                        const double* bin_widths,
-                       const int32_t* bin_offsets) {
+                       const int32_t* bin_offsets, uint8_t* row_bad) {
     auto* h = static_cast<Handle*>(hp);
     if (row_lo < 0 || row_hi < row_lo || row_hi > avt_n_rows(hp))
         return -2;
     return fill_range(h, row_lo, row_hi, n_cols, ords, kinds, outs,
                       vocabs, vocab_ns, bad_out, bin_outs, bin_widths,
-                      bin_offsets);
+                      bin_offsets, row_bad);
+}
+
+// Raw bytes of non-blank line `row` of the index (for quarantining a
+// malformed record verbatim).  *len_out = line byte length; returns
+// nullptr (len -1) when row is out of range.  Valid while the handle
+// lives (points into the mmap).
+const char* avt_row_text(void* hp, int64_t row, int64_t* len_out) {
+    auto* h = static_cast<Handle*>(hp);
+    if (row < 0 || static_cast<size_t>(row) >= h->starts.size()) {
+        *len_out = -1;
+        return nullptr;
+    }
+    *len_out = h->lens[static_cast<size_t>(row)];
+    return h->data + h->starts[static_cast<size_t>(row)];
 }
 
 // String column `str_idx` (fill-call order among string columns): joined

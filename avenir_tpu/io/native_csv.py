@@ -103,11 +103,15 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_int64),            # bad_out
             ctypes.POINTER(ctypes.c_void_p),           # bin_outs
             ctypes.POINTER(ctypes.c_double),           # bin_widths
-            ctypes.POINTER(ctypes.c_int32)]            # bin_offsets
+            ctypes.POINTER(ctypes.c_int32),            # bin_offsets
+            ctypes.POINTER(ctypes.c_uint8)]            # row_bad (nullable)
         lib.avt_fill_range.restype = ctypes.c_int64
         lib.avt_fill_range.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
             *lib.avt_fill.argtypes[1:]]
+        lib.avt_row_text.restype = ctypes.c_void_p
+        lib.avt_row_text.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                     ctypes.POINTER(ctypes.c_int64)]
         lib.avt_string_blob.restype = ctypes.c_void_p
         lib.avt_string_blob.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                         ctypes.POINTER(ctypes.c_int64)]
@@ -149,7 +153,7 @@ class _ParseHandle:
         bin_offs = (ctypes.c_int32 * 1)()
         with self.lock:
             if lib.avt_fill(h, 1, ords, kinds, outs, vocabs, vns,
-                            bads, bin_outs, bin_ws, bin_offs) != 0:
+                            bads, bin_outs, bin_ws, bin_offs, None) != 0:
                 raise MemoryError("native string column extraction failed")
             ln = ctypes.c_int64()
             ptr = lib.avt_string_blob(h, 0, ctypes.byref(ln))
@@ -299,14 +303,37 @@ class NativeCsvReader:
     def __exit__(self, *exc):
         self.close()
 
-    def parse_chunk(self, offset: int, n_rows: int):
-        """Rows [offset, offset + n_rows) as a ColumnarTable block, encoded
-        exactly like the whole-file path (same ValueError on malformed /
-        short rows, reported with the block's absolute row range)."""
-        from ..core.table import ColumnarTable, LazyStringColumn
+    def row_text(self, row: int) -> str:
+        """Raw text of non-blank line ``row`` (absolute index into the
+        file's line index) — what the quarantine policy writes verbatim."""
         handle = self._handle
         if handle is None:
             raise ValueError("NativeCsvReader is closed")
+        ln = ctypes.c_int64()
+        ptr = handle.lib.avt_row_text(handle.h, int(row), ctypes.byref(ln))
+        if ptr is None or ln.value < 0:
+            raise IndexError(f"row {row} out of range")
+        return (ctypes.string_at(ptr, ln.value) if ln.value else b"") \
+            .decode(errors="replace")
+
+    def parse_chunk(self, offset: int, n_rows: int, bad_records=None):
+        """Rows [offset, offset + n_rows) as a ColumnarTable block, encoded
+        exactly like the whole-file path (same ValueError on malformed /
+        short rows, reported with the block's absolute row range).
+
+        ``bad_records`` (a ``core.table.BadRecordPolicy`` with a skipping
+        policy) switches malformed rows from ValueError to filter-and-
+        report: the C parser flags WHICH rows were bad (``row_bad``), the
+        block drops them, and the policy's counters/quarantine record the
+        raw lines.  Quarantine side effects happen LAST, after every
+        fallible native call, so a failed-then-retried chunk never
+        double-records."""
+        from ..core.table import (ColumnarTable, LazyStringColumn,
+                                  _filter_lazy_strings)
+        handle = self._handle
+        if handle is None:
+            raise ValueError("NativeCsvReader is closed")
+        skipping = bad_records is not None and bad_records.skips
         lo, hi = int(offset), int(offset) + int(n_rows)
         if not 0 <= lo <= hi <= handle.n:
             raise IndexError(f"rows [{lo}, {hi}) out of range "
@@ -335,11 +362,15 @@ class NativeCsvReader:
                     binned_cache[f.ordinal] = bout
                     bin_outs[i] = bout.ctypes.data_as(ctypes.c_void_p)
         str_columns = {}
+        row_bad = np.zeros(m, dtype=np.uint8) if skipping else None
         with handle.lock:
             rc = lib.avt_fill_range(handle.h, lo, hi, n_cols, self._ords,
                                     self._kinds, outs, self._vocabs,
                                     self._vocab_ns, bads, bin_outs,
-                                    self._bin_ws, self._bin_offs)
+                                    self._bin_ws, self._bin_offs,
+                                    None if row_bad is None else
+                                    row_bad.ctypes.data_as(
+                                        ctypes.POINTER(ctypes.c_uint8)))
             if rc != 0:
                 raise MemoryError(
                     f"native csv chunk fill failed (rc={rc})")
@@ -357,18 +388,34 @@ class NativeCsvReader:
                 offsets = np.ctypeslib.as_array(
                     offs_ptr, shape=(m + 1,)).copy()
                 str_columns[o] = LazyStringColumn(blob, offsets)
+        if skipping:
+            if row_bad.any():
+                keep = row_bad == 0
+                bad_idx = np.nonzero(row_bad)[0]
+                columns = {o: c[keep] for o, c in columns.items()}
+                binned_cache = {o: c[keep] for o, c in binned_cache.items()}
+                str_columns = {o: _filter_lazy_strings(c, keep)
+                               for o, c in str_columns.items()}
+                m = int(np.count_nonzero(keep))
+                # policy side effects LAST — everything fallible (native
+                # fill, string extraction) already succeeded, so a chunk
+                # that is retried after a failure never double-quarantines
+                bad_records.record(
+                    [self.row_text(lo + int(i)) for i in bad_idx])
+        else:
+            for i, f in enumerate(fields):
+                if bads[i]:
+                    what = ("missing/non-numeric"
+                            if self._kinds[i] in (_KIND_NUMERIC,
+                                                  _KIND_NUMERIC_BINNED)
+                            else "missing")
+                    raise ValueError(
+                        f"{bads[i]} rows with {what} field {f.ordinal} "
+                        f"({f.name!r}) in rows [{lo}, {hi}) of "
+                        f"{self.path!r}")
         for arr in binned_cache.values():
             # same freeze-by-reference contract as native_load_csv
             arr.flags.writeable = False
-        for i, f in enumerate(fields):
-            if bads[i]:
-                what = ("missing/non-numeric"
-                        if self._kinds[i] in (_KIND_NUMERIC,
-                                              _KIND_NUMERIC_BINNED)
-                        else "missing")
-                raise ValueError(
-                    f"{bads[i]} rows with {what} field {f.ordinal} "
-                    f"({f.name!r}) in rows [{lo}, {hi}) of {self.path!r}")
         return ColumnarTable(schema=self.schema, n_rows=m, columns=columns,
                              str_columns=str_columns, raw_rows=None,
                              binned_cache=binned_cache)
@@ -456,7 +503,7 @@ def native_load_csv(path: str, schema, delim: str, keep_raw: bool = False):
             kinds[i] = _KIND_STRING_CHECK
             str_ords.append(f.ordinal)
     rc = lib.avt_fill(h, n_cols, ords, kinds, outs, vocabs, vocab_ns, bads,
-                      bin_outs, bin_ws, bin_offs)
+                      bin_outs, bin_ws, bin_offs, None)
     if rc != 0:
         raise MemoryError("native csv fill failed")
     for arr in binned_cache.values():

@@ -358,8 +358,9 @@ def build_forest(table: ColumnarTable, params: ForestParams,
 
 def build_forest_from_stream(blocks, schema, params: ForestParams,
                              ctx: Optional[MeshContext] = None,
-                             stats: Optional[dict] = None
-                             ) -> List[DecisionPathList]:
+                             stats: Optional[dict] = None,
+                             checkpoint=None, checkpoint_every: int = 0,
+                             resume_state=None) -> List[DecisionPathList]:
     """Train the forest from an iterator of ColumnarTable row blocks — the
     streaming CSV->device ingest pipeline's training entry.  Each block is
     encoded to branch/class codes on device and released, so host memory
@@ -374,13 +375,21 @@ def build_forest_from_stream(blocks, schema, params: ForestParams,
 
     ``stats`` (optional dict) collects phase timings: ``parse_s`` (from
     prefetch_chunks), ``transfer_s``, ``ingest_wall_s``, ``build_s`` —
-    the bench derives the pipeline overlap fraction from them."""
+    the bench derives the pipeline overlap fraction from them.
+
+    ``checkpoint``/``checkpoint_every``/``resume_state`` thread straight
+    through to ``TreeBuilder.from_stream`` (see its docstring for the
+    resume contract): an interrupted-then-resumed streaming build trains
+    the bit-identical forest of an uninterrupted run."""
     import time as _time
     ctx = ctx or runtime_context()
     t0 = _time.perf_counter()
     base = TreeBuilder.from_stream(blocks, schema,
                                    replace(params.tree, seed=params.seed),
-                                   ctx, stats=stats)
+                                   ctx, stats=stats,
+                                   checkpoint=checkpoint,
+                                   checkpoint_every=checkpoint_every,
+                                   resume_state=resume_state)
     t1 = _time.perf_counter()
     models = ForestBuilder(None, params, ctx, base=base).build_all()
     if stats is not None:

@@ -551,6 +551,31 @@ def _jitted_level_count_kernel(S: int, B: int, C: int):
     return jax.jit(make_level_count_kernel(S, B, C), static_argnums=4)
 
 
+def _save_stream_checkpoint(mgr, blocks_done: int, br_parts, cls_parts,
+                            mask_parts, n_rows: int,
+                            source_rows_done: Optional[int],
+                            complete: bool) -> None:
+    """Persist the accumulated streamed-ingest state as one checkpoint
+    step.  Full-state snapshots (not increments): any single intact step
+    is sufficient to resume, which is what lets CheckpointManager retain
+    only the newest few and skip corrupt ones.  The host copies force a
+    device sync — size the ``checkpoint_every`` stride so this stays a
+    small fraction of ingest time."""
+    arrays = {
+        "branches": np.concatenate([np.asarray(p) for p in br_parts])
+        if br_parts else np.zeros((0, 0), np.int32),
+        "cls_codes": np.concatenate([np.asarray(p) for p in cls_parts])
+        if cls_parts else np.zeros((0,), np.int32),
+        "mask": np.concatenate(mask_parts)
+        if mask_parts else np.zeros((0,), np.float32),
+    }
+    meta = {"n_rows": int(n_rows), "blocks_done": int(blocks_done),
+            "source_rows_done": None if source_rows_done is None
+            else int(source_rows_done),
+            "ingest_complete": bool(complete)}
+    mgr.save(blocks_done, arrays, meta)
+
+
 class TreeBuilder:
     """Level-synchronous tree growth over a device mesh.
 
@@ -607,7 +632,9 @@ class TreeBuilder:
     def from_stream(cls, blocks, schema: FeatureSchema, params: TreeParams,
                     ctx: Optional[MeshContext] = None,
                     splits: Optional[List[CandidateSplit]] = None,
-                    stats: Optional[dict] = None) -> "TreeBuilder":
+                    stats: Optional[dict] = None,
+                    checkpoint=None, checkpoint_every: int = 0,
+                    resume_state=None) -> "TreeBuilder":
         """Build the device-resident state from an iterator of ColumnarTable
         row blocks instead of one assembled table — the consume stage of
         the streaming CSV->device ingest pipeline.
@@ -627,7 +654,22 @@ class TreeBuilder:
         to ``TreeBuilder(assembled_table, ...)`` (tests/test_forest.py).
 
         ``stats['transfer_s']`` accumulates consumer-side upload/dispatch
-        time plus the final device sync."""
+        time plus the final device sync.
+
+        Checkpoint/resume: with a ``checkpoint``
+        (core.checkpoint.CheckpointManager) and ``checkpoint_every`` > 0,
+        every Nth ingested block persists the accumulated device state
+        (branch codes, class codes, pad mask — int32/f32 host copies) plus
+        meta ``{n_rows, blocks_done, source_rows_done, ingest_complete}``;
+        a final step with ``ingest_complete=True`` lands after the last
+        block.  ``resume_state`` is a ``(arrays, meta)`` pair from
+        ``CheckpointManager.restore``: the restored state is re-uploaded
+        and ``blocks`` must be the REMAINING stream (construct it with
+        ``iter_csv_chunks(..., start_row=meta['source_rows_done'])``).
+        Because branch/class codes are exact integers and per-record
+        weights are placed by mask position over the TRUE row count, an
+        interrupted-then-resumed ingest trains the bit-identical model of
+        an uninterrupted run (pinned by tests/test_faults.py)."""
         import time as _time
         self = cls.__new__(cls)
         self.ctx = ctx or runtime_context()
@@ -646,7 +688,31 @@ class TreeBuilder:
         cls_ord = self.class_field.ordinal
         br_parts, cls_parts, mask_parts = [], [], []
         n_rows = 0
+        blocks_done = 0
+        source_rows_done: Optional[int] = None
         t_consume = 0.0
+        if resume_state is not None:
+            arrays, meta = resume_state
+            rb = np.asarray(arrays["branches"], dtype=np.int32)
+            if rb.shape[0]:
+                if rb.shape[1] != self.split_set.n_splits:
+                    raise ValueError(
+                        f"checkpoint branch width {rb.shape[1]} does not "
+                        f"match the schema's {self.split_set.n_splits} "
+                        f"candidate splits; the checkpoint belongs to a "
+                        f"different config")
+                if rb.shape[0] % align:
+                    raise ValueError(
+                        f"checkpoint rows {rb.shape[0]} not aligned to the "
+                        f"{align}-device mesh it must resume on")
+                br_parts.append(self.ctx.shard_rows_streamed(rb))
+                cls_parts.append(self.ctx.shard_rows_streamed(
+                    np.asarray(arrays["cls_codes"], dtype=np.int32)))
+                mask_parts.append(
+                    np.asarray(arrays["mask"], dtype=np.float32))
+            n_rows = int(meta["n_rows"])
+            blocks_done = int(meta.get("blocks_done", 0))
+            source_rows_done = meta.get("source_rows_done")
         for block in blocks:
             t0 = _time.perf_counter()
             bn = block.n_rows
@@ -665,7 +731,22 @@ class TreeBuilder:
             cls_parts.append(self.ctx.shard_rows_streamed(cc))
             mask_parts.append(mask)
             n_rows += bn
+            blocks_done += 1
+            src_end = getattr(block, "source_row_end", None)
+            if src_end is not None:
+                source_rows_done = int(src_end)
             t_consume += _time.perf_counter() - t0
+            if (checkpoint is not None and checkpoint_every > 0
+                    and blocks_done % checkpoint_every == 0):
+                _save_stream_checkpoint(
+                    checkpoint, blocks_done, br_parts, cls_parts,
+                    mask_parts, n_rows, source_rows_done, False)
+        if checkpoint is not None and checkpoint_every > 0:
+            # the ingest-complete step: a crash in the BUILD phase resumes
+            # straight to training, re-reading zero source rows
+            _save_stream_checkpoint(
+                checkpoint, blocks_done, br_parts, cls_parts, mask_parts,
+                n_rows, source_rows_done, True)
         t0 = _time.perf_counter()
         if not br_parts:
             # the monolithic path cannot train on 0 rows either; fail with

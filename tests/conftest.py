@@ -35,3 +35,21 @@ def mesh_ctx():
 @pytest.fixture()
 def rng():
     return np.random.default_rng(42)
+
+
+@pytest.fixture()
+def fault_injector():
+    """Factory installing a deterministic fault injector from a spec string
+    (see avenir_tpu.core.faults) — uninstalled at teardown, so no injected
+    fault leaks into a later test.  Fault-injection tests carry the
+    ``faultinject`` marker and run in the fast tier-1 lane (no ``slow``)."""
+    from avenir_tpu.core import faults
+
+    def make(spec: str, seed: int = 0):
+        inj = faults.FaultInjector.parse(spec, seed=seed)
+        faults.install(inj)
+        return inj
+
+    yield make
+    from avenir_tpu.core import faults as _f
+    _f.uninstall()

@@ -1,0 +1,612 @@
+"""Fault-tolerance suite: bad-record policies, retry/backoff, native-parser
+degradation, streaming checkpoint/resume, and the deterministic fault
+injector that drives them (ISSUE 2's end-to-end robustness contract).
+
+The flagship test runs the randomForestBuilder job over a CSV containing
+malformed rows with (a) an injected one-shot chunk-read fault (absorbed by
+retry), then (b) an injected crash + ``--resume``, and pins that the
+resumed run produces the bit-identical model bytes of a clean
+uninterrupted run, with skipped-record counters and quarantine output
+matching the injected corruption exactly.
+"""
+
+import json
+import os
+import threading
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core import faults
+from avenir_tpu.core.checkpoint import CheckpointManager
+from avenir_tpu.core.metrics import Counters
+from avenir_tpu.core.table import (BadRecordPolicy, ColumnarTable,
+                                   iter_csv_chunks, load_csv,
+                                   prefetch_chunks)
+from avenir_tpu.io.native_csv import get_lib, native_open_csv
+
+pytestmark = pytest.mark.faultinject
+
+HAS_NATIVE = get_lib() is not None
+needs_native = pytest.mark.skipif(not HAS_NATIVE,
+                                  reason="native CSV library unavailable")
+
+SCHEMA = {
+    "fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "f1", "ordinal": 1, "dataType": "int", "feature": True,
+         "min": 0, "max": 100, "splitScanInterval": 25, "maxSplit": 2},
+        {"name": "f2", "ordinal": 2, "dataType": "categorical",
+         "feature": True, "maxSplit": 2, "cardinality": ["x", "y", "z"]},
+        {"name": "cls", "ordinal": 3, "dataType": "categorical",
+         "cardinality": ["0", "1"]},
+    ]
+}
+
+
+def write_schema(tmp_path):
+    p = tmp_path / "schema.json"
+    p.write_text(json.dumps(SCHEMA))
+    from avenir_tpu.core.schema import FeatureSchema
+    return p, FeatureSchema.load(str(p))
+
+
+def gen_csv(path, n=240, seed=7):
+    rng = np.random.default_rng(seed)
+    lines = [f"r{i},{rng.integers(0, 100)},{'xyz'[rng.integers(0, 3)]},"
+             f"{int(rng.random() < 0.4)}" for i in range(n)]
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    return lines
+
+
+# --------------------------------------------------------------------------
+# injector + retry primitives
+# --------------------------------------------------------------------------
+
+def test_fault_spec_parse_and_fire():
+    inj = faults.FaultInjector.parse(
+        "chunk_read@2=raise:OSError, artifact_write@*=delay:0.001x2")
+    inj.fire("chunk_read", 0)
+    inj.fire("chunk_read", 1)
+    with pytest.raises(OSError):
+        inj.fire("chunk_read", 2)
+    inj.fire("chunk_read", 2)  # once only: healed
+    inj.fire("artifact_write")
+    inj.fire("artifact_write")
+    inj.fire("artifact_write")  # third call: spec exhausted after x2
+    assert [op for op, _, _ in inj.log] == \
+        ["chunk_read", "artifact_write", "artifact_write"]
+
+
+def test_fault_spec_rejects_garbage():
+    with pytest.raises(ValueError):
+        faults.FaultSpec.parse("nonsense")
+    with pytest.raises(ValueError):
+        faults.FaultSpec.parse("op@0=explode")
+
+
+def test_with_retry_absorbs_transient_and_propagates_hard():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        assert with_retry_fast(flaky) == "ok"
+    assert len(calls) == 3
+
+    def hard():
+        raise RuntimeError("not transient")
+    with pytest.raises(RuntimeError):
+        with_retry_fast(hard)
+
+    def always():
+        raise MemoryError("persistent")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with pytest.raises(MemoryError):
+            with_retry_fast(always)
+
+
+def with_retry_fast(fn):
+    return faults.with_retry(fn, attempts=3, base_delay=0.0)
+
+
+def test_fixture_installs_and_clears(fault_injector):
+    fault_injector("chunk_read@0=raise:OSError")
+    with pytest.raises(OSError):
+        faults.fault_point("chunk_read", 0)
+    # teardown (checked implicitly: later tests see no installed injector)
+
+
+# --------------------------------------------------------------------------
+# bad-record policy through the ingest stack
+# --------------------------------------------------------------------------
+
+def test_bad_record_policy_validation(tmp_path):
+    with pytest.raises(ValueError):
+        BadRecordPolicy("explode")
+    with pytest.raises(ValueError):
+        BadRecordPolicy("quarantine")  # no path
+    assert not BadRecordPolicy("fail").skips
+    assert BadRecordPolicy("skip").skips
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_skip_policy_chunked_matches_clean_subset(tmp_path, use_native):
+    if use_native and not HAS_NATIVE:
+        pytest.skip("native CSV library unavailable")
+    _, schema = write_schema(tmp_path)
+    csv = tmp_path / "d.csv"
+    lines = gen_csv(str(csv), n=60)
+    bad_rows = [5, 17, 44]
+    corrupted = faults.corrupt_csv_rows(str(csv), bad_rows, seed=1, field=1)
+    cnt = Counters()
+    pol = BadRecordPolicy("quarantine", str(tmp_path / "q"), cnt)
+    chunks = list(iter_csv_chunks(str(csv), schema, chunk_rows=16,
+                                  use_native=use_native, bad_records=pol))
+    table = ColumnarTable.from_chunks(chunks)
+    assert table.n_rows == 57
+    assert cnt.get("BadRecords", "Malformed") == 3
+    assert cnt.get("BadRecords", "Skipped") == 3
+    assert cnt.get("BadRecords", "Quarantined") == 3
+    with open(pol.quarantine_file()) as fh:
+        assert fh.read().splitlines() == corrupted
+    # the kept rows are exactly the clean rows, in order
+    keep = [l for i, l in enumerate(lines) if i not in bad_rows]
+    assert list(table.str_columns[0]) == [l.split(",")[0] for l in keep]
+    # source_row_end counts SOURCE rows, so the last chunk ends at n
+    assert chunks[-1].source_row_end == 60
+
+
+@needs_native
+def test_skip_policy_native_python_and_monolithic_agree(tmp_path):
+    _, schema = write_schema(tmp_path)
+    csv = tmp_path / "d.csv"
+    gen_csv(str(csv), n=50)
+    faults.corrupt_csv_rows(str(csv), [3, 20], seed=2, field=1)
+    faults.corrupt_csv_rows(str(csv), [31], seed=3, mode="truncate")
+    tabs = [
+        ColumnarTable.from_chunks(list(iter_csv_chunks(
+            str(csv), schema, chunk_rows=13, use_native=un,
+            bad_records=BadRecordPolicy("skip"))))
+        for un in (True, False)
+    ] + [load_csv(str(csv), schema, bad_records=BadRecordPolicy("skip"))]
+    for t in tabs[1:]:
+        assert t.n_rows == tabs[0].n_rows == 47
+        for o in tabs[0].columns:
+            np.testing.assert_array_equal(t.columns[o], tabs[0].columns[o])
+        assert list(t.str_columns[0]) == list(tabs[0].str_columns[0])
+
+
+def test_fail_policy_still_raises(tmp_path):
+    _, schema = write_schema(tmp_path)
+    csv = tmp_path / "d.csv"
+    gen_csv(str(csv), n=20)
+    faults.corrupt_csv_rows(str(csv), [4], seed=4, field=1)
+    with pytest.raises((ValueError, IndexError)):
+        load_csv(str(csv), schema)
+    with pytest.raises((ValueError, IndexError)):
+        list(iter_csv_chunks(str(csv), schema, chunk_rows=8))
+
+
+@needs_native
+def test_one_shot_chunk_fault_absorbed_by_retry(tmp_path, fault_injector,
+                                                monkeypatch):
+    monkeypatch.setattr(faults, "RETRY_BASE_S", 0.0)
+    _, schema = write_schema(tmp_path)
+    csv = tmp_path / "d.csv"
+    gen_csv(str(csv), n=40)
+    fault_injector("chunk_read@1=raise:OSError")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        table = ColumnarTable.from_chunks(list(iter_csv_chunks(
+            str(csv), schema, chunk_rows=10)))
+    assert table.n_rows == 40
+    assert any("retry" in str(x.message) for x in w)
+    assert not any("degrading" in str(x.message) for x in w)
+
+
+@needs_native
+def test_native_drop_degrades_to_python_with_warning(tmp_path,
+                                                     fault_injector,
+                                                     monkeypatch):
+    """The 'native .so dies mid-run' story: persistent chunk-read faults
+    exhaust the retry budget, the stream falls back to the python oracle
+    at the exact row reached, and a warning says so."""
+    monkeypatch.setattr(faults, "RETRY_BASE_S", 0.0)
+    _, schema = write_schema(tmp_path)
+    csv = tmp_path / "d.csv"
+    gen_csv(str(csv), n=40)
+    oracle = load_csv(str(csv), schema, use_native=False)
+    fault_injector("chunk_read@2=raise:OSErrorx99")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        table = ColumnarTable.from_chunks(list(iter_csv_chunks(
+            str(csv), schema, chunk_rows=10)))
+    assert any("degrading to the python parser" in str(x.message)
+               for x in w)
+    assert table.n_rows == oracle.n_rows
+    for o in oracle.columns:
+        np.testing.assert_array_equal(table.columns[o], oracle.columns[o])
+
+
+def test_injected_delay_fires(tmp_path, fault_injector):
+    _, schema = write_schema(tmp_path)
+    csv = tmp_path / "d.csv"
+    gen_csv(str(csv), n=12)
+    inj = fault_injector("chunk_encode@0=delay:0.05")
+    t0 = time.perf_counter()
+    list(iter_csv_chunks(str(csv), schema, chunk_rows=6, use_native=False))
+    assert time.perf_counter() - t0 >= 0.05
+    assert inj.log and inj.log[0][2] == "delay"
+
+
+# --------------------------------------------------------------------------
+# artifact write retry
+# --------------------------------------------------------------------------
+
+def test_artifact_write_retries_transient_fault(tmp_path, fault_injector,
+                                                monkeypatch):
+    monkeypatch.setattr(faults, "RETRY_BASE_S", 0.0)
+    from avenir_tpu.core import artifacts
+    fault_injector("artifact_write@0=raise:OSError")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        path = artifacts.write_text_output(
+            str(tmp_path / "out"), iter(["a", "b"]))
+    with open(path) as fh:
+        assert fh.read() == "a\nb\n"
+    fault_injector("artifact_write@*=raise:OSError")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        artifacts.write_json(str(tmp_path / "m.json"), {"k": 1})
+    assert json.load(open(tmp_path / "m.json")) == {"k": 1}
+
+
+# --------------------------------------------------------------------------
+# prefetch_chunks producer/consumer contract
+# --------------------------------------------------------------------------
+
+def _prefetch_threads():
+    return [t for t in threading.enumerate()
+            if t.name == "avenir-ingest-prefetch" and t.is_alive()]
+
+
+def _await_no_prefetch_threads(timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if not _prefetch_threads():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_prefetch_midstream_exception_propagates_exactly_once():
+    def source():
+        yield "a"
+        yield "b"
+        raise RuntimeError("boom")
+
+    it = prefetch_chunks(source(), depth=1)
+    assert next(it) == "a"
+    assert next(it) == "b"
+    with pytest.raises(RuntimeError, match="boom"):
+        next(it)
+    # exactly once: the generator is exhausted afterwards, not re-raising
+    with pytest.raises(StopIteration):
+        next(it)
+    assert _await_no_prefetch_threads(), "producer thread leaked"
+
+
+def test_prefetch_raising_iter_surfaces_instead_of_hanging():
+    class BadIterable:
+        def __iter__(self):
+            raise OSError("cannot open source")
+
+    it = prefetch_chunks(BadIterable(), depth=1)
+    with pytest.raises(OSError, match="cannot open source"):
+        next(it)
+    assert _await_no_prefetch_threads(), "producer thread leaked"
+
+
+def test_prefetch_consumer_abandon_shuts_down_full_queue_producer():
+    closed = []
+
+    def source():
+        try:
+            for i in range(10_000):
+                yield i
+        finally:
+            closed.append(True)
+
+    it = prefetch_chunks(source(), depth=1)
+    assert next(it) == 0
+    it.close()  # abandon mid-stream with the producer blocked on a full queue
+    assert _await_no_prefetch_threads(), \
+        "producer thread hung on the full queue"
+    assert closed == [True], "source iterator was not closed"
+
+
+def test_prefetch_clean_end_to_end():
+    it = prefetch_chunks(iter(range(5)), depth=2)
+    assert list(it) == [0, 1, 2, 3, 4]
+    assert _await_no_prefetch_threads()
+
+
+# --------------------------------------------------------------------------
+# NativeCsvReader lifecycle: no leaked handle on any exit path
+# --------------------------------------------------------------------------
+
+@needs_native
+def test_reader_closed_when_midstream_chunk_fails(tmp_path, monkeypatch):
+    monkeypatch.setattr(faults, "RETRY_BASE_S", 0.0)
+    import avenir_tpu.io.native_csv as nc
+    _, schema = write_schema(tmp_path)
+    csv = tmp_path / "d.csv"
+    gen_csv(str(csv), n=30)
+    # malformed row in the SECOND chunk so chunk one parses fine first
+    faults.corrupt_csv_rows(str(csv), [15], seed=5, field=1)
+    readers = []
+    orig = nc.native_open_csv
+
+    def spy(*a, **k):
+        r = orig(*a, **k)
+        if r is not None:
+            readers.append(r)
+        return r
+    monkeypatch.setattr(nc, "native_open_csv", spy)
+    with pytest.raises((ValueError, IndexError)):
+        list(iter_csv_chunks(str(csv), schema, chunk_rows=10))
+    assert len(readers) == 1
+    assert readers[0]._handle is None, "native handle leaked after failure"
+
+
+@needs_native
+def test_reader_closed_when_consumer_abandons_stream(tmp_path, monkeypatch):
+    import avenir_tpu.io.native_csv as nc
+    _, schema = write_schema(tmp_path)
+    csv = tmp_path / "d.csv"
+    gen_csv(str(csv), n=30)
+    readers = []
+    orig = nc.native_open_csv
+
+    def spy(*a, **k):
+        r = orig(*a, **k)
+        if r is not None:
+            readers.append(r)
+        return r
+    monkeypatch.setattr(nc, "native_open_csv", spy)
+    it = iter_csv_chunks(str(csv), schema, chunk_rows=10)
+    next(it)
+    it.close()  # consumer walks away mid-stream
+    assert len(readers) == 1
+    assert readers[0]._handle is None, "native handle leaked after abandon"
+
+
+@needs_native
+def test_reader_context_manager_and_closed_errors(tmp_path):
+    _, schema = write_schema(tmp_path)
+    csv = tmp_path / "d.csv"
+    gen_csv(str(csv), n=10)
+    with native_open_csv(str(csv), schema, ",") as r:
+        assert r.n_rows == 10
+        assert r.row_text(0).startswith("r0,")
+    assert r._handle is None
+    with pytest.raises(ValueError):
+        r.parse_chunk(0, 1)
+    with pytest.raises(ValueError):
+        r.row_text(0)
+
+
+# --------------------------------------------------------------------------
+# CheckpointManager corruption tolerance
+# --------------------------------------------------------------------------
+
+def test_latest_step_skips_corrupt_step(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep=0)
+    mgr.save(1, {"a": np.arange(4)}, {"step": 1})
+    mgr.save(2, {"a": np.arange(8)}, {"step": 2})
+    # torn write: truncate the newest step's state.npz
+    state = os.path.join(mgr._step_dir(2), "state.npz")
+    with open(state, "r+b") as fh:
+        fh.truncate(10)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert mgr.latest_step() == 1
+        step, arrays, meta = mgr.restore()
+    assert step == 1 and meta == {"step": 1}
+    np.testing.assert_array_equal(arrays["a"], np.arange(4))
+    assert any("torn write" in str(x.message) or "unreadable" in
+               str(x.message) for x in w)
+
+
+def test_latest_step_skips_missing_meta(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep=0)
+    mgr.save(3, {"a": np.arange(2)}, {"step": 3})
+    mgr.save(7, {"a": np.arange(3)}, {"step": 7})
+    os.remove(os.path.join(mgr._step_dir(7), "meta.json"))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        assert mgr.latest_step() == 3
+        assert mgr.restore()[0] == 3
+
+
+def test_all_steps_corrupt_raises_filenotfound(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep=0)
+    mgr.save(1, {"a": np.arange(2)}, {})
+    os.remove(os.path.join(mgr._step_dir(1), "state.npz"))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        assert mgr.latest_step() is None
+        with pytest.raises(FileNotFoundError):
+            mgr.restore()
+
+
+def test_empty_checkpoint_dir(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    assert mgr.latest_step() is None
+    with pytest.raises(FileNotFoundError):
+        mgr.restore()
+
+
+# --------------------------------------------------------------------------
+# flagship end-to-end: malformed rows + one-shot fault + crash + --resume
+# --------------------------------------------------------------------------
+
+def _rf_conf(tmp_path, schema_path, ckpt_dir, qdir):
+    props = tmp_path / "rafo.properties"
+    props.write_text(
+        "field.delim.regex=,\n"
+        "field.delim.out=,\n"
+        f"dtb.feature.schema.file.path={schema_path}\n"
+        "dtb.split.algorithm=giniIndex\n"
+        "dtb.path.stopping.strategy=maxDepth\n"
+        "dtb.max.depth.limit=2\n"
+        "dtb.num.trees=3\n"
+        "dtb.random.seed=11\n"
+        "dtb.streaming.ingest=true\n"
+        "dtb.streaming.block.rows=48\n"
+        f"dtb.streaming.checkpoint.dir={ckpt_dir}\n"
+        "dtb.streaming.checkpoint.blocks=1\n"
+        "badrecords.policy=quarantine\n"
+        f"badrecords.quarantine.path={qdir}\n")
+    return props
+
+
+def _read_trees(out_dir):
+    names = sorted(f for f in os.listdir(out_dir) if f.endswith(".json"))
+    return {n: open(os.path.join(out_dir, n)).read() for n in names}
+
+
+def test_streaming_forest_survives_faults_and_resumes_bit_identical(
+        tmp_path, fault_injector, monkeypatch):
+    """The ISSUE 2 acceptance scenario, driven through the CLI entry so the
+    job knobs and ``--resume`` are what is actually exercised."""
+    monkeypatch.setattr(faults, "RETRY_BASE_S", 0.0)
+    from avenir_tpu.cli import run as cli_run
+    schema_path, _ = write_schema(tmp_path)
+    csv = tmp_path / "train.csv"
+    gen_csv(str(csv), n=240, seed=13)
+    corrupted = faults.corrupt_csv_rows(str(csv), [30, 99, 201], seed=9,
+                                        field=1)
+
+    # ---- clean uninterrupted run (the oracle) ----
+    clean_out = tmp_path / "out_clean"
+    props = _rf_conf(tmp_path, schema_path, tmp_path / "ck_clean",
+                     tmp_path / "q_clean")
+    rc = cli_run.main(["randomForestBuilder", f"-Dconf.path={props}",
+                       str(csv), str(clean_out)])
+    assert rc == 0
+    clean_trees = _read_trees(clean_out)
+    assert len(clean_trees) == 3
+    with open(tmp_path / "q_clean" / "part-q-00000") as fh:
+        assert fh.read().splitlines() == corrupted
+
+    # ---- faulty run: retryable fault at chunk 1, crash at chunk 3 ----
+    props2 = _rf_conf(tmp_path, schema_path, tmp_path / "ck",
+                      tmp_path / "q")
+    fault_injector("chunk_read@1=raise:OSError,"
+                   "chunk_read@3=raise:RuntimeError")
+    out = tmp_path / "out"
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with pytest.raises(RuntimeError, match="injected fault"):
+            cli_run.main(["randomForestBuilder", f"-Dconf.path={props2}",
+                          str(csv), str(out)])
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    step = mgr.latest_step()
+    assert step is not None and step >= 1
+    assert not mgr.restore()[2]["ingest_complete"]
+
+    # ---- resumed run: picks up at the last intact step ----
+    faults.uninstall()
+    rc = cli_run.main(["randomForestBuilder", f"-Dconf.path={props2}",
+                       "--resume", str(csv), str(out)])
+    assert rc == 0
+    assert _read_trees(out) == clean_trees, \
+        "resumed model differs from the uninterrupted run"
+    # quarantine accumulated across crash + resume matches the injected
+    # corruption exactly (checkpoint stride 1 => no re-reported records)
+    with open(tmp_path / "q" / "part-q-00000") as fh:
+        assert fh.read().splitlines() == corrupted
+    # the resume landed an ingest-complete step
+    assert mgr.restore()[2]["ingest_complete"] is True
+
+
+def test_resume_with_all_steps_corrupt_refuses(tmp_path):
+    """--resume against a checkpoint dir whose every step is torn must NOT
+    silently re-ingest from row 0 as a cold start."""
+    from avenir_tpu.cli.jobs import random_forest_builder
+    from avenir_tpu.core.config import Config
+    schema_path, _ = write_schema(tmp_path)
+    csv = tmp_path / "d.csv"
+    gen_csv(str(csv), n=16)
+    ck = tmp_path / "ck"
+    mgr = CheckpointManager(str(ck))
+    mgr.save(1, {"a": np.arange(2)}, {})
+    os.remove(os.path.join(mgr._step_dir(1), "state.npz"))
+    cfg = Config({"dtb.feature.schema.file.path": str(schema_path),
+                  "dtb.streaming.ingest": "true",
+                  "dtb.streaming.resume": "true",
+                  "dtb.streaming.checkpoint.dir": str(ck),
+                  "dtb.num.trees": "1"})
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with pytest.raises(RuntimeError, match="none restore intact"):
+            random_forest_builder(cfg, str(csv), str(tmp_path / "out"))
+
+
+def test_resume_without_checkpoint_dir_refuses(tmp_path):
+    from avenir_tpu.cli.jobs import random_forest_builder
+    from avenir_tpu.core.config import Config
+    schema_path, _ = write_schema(tmp_path)
+    csv = tmp_path / "d.csv"
+    gen_csv(str(csv), n=16)
+    cfg = Config({"dtb.feature.schema.file.path": str(schema_path),
+                  "dtb.streaming.ingest": "true",
+                  "dtb.streaming.resume": "true",
+                  "dtb.num.trees": "1"})
+    with pytest.raises(ValueError, match="checkpoint.dir"):
+        random_forest_builder(cfg, str(csv), str(tmp_path / "out"))
+
+
+def test_resume_without_streaming_ingest_refuses(tmp_path):
+    """--resume against the monolithic path must refuse, not silently
+    retrain from row 0 (checkpoints only exist for the streaming build)."""
+    from avenir_tpu.cli.jobs import random_forest_builder
+    from avenir_tpu.core.config import Config
+    schema_path, _ = write_schema(tmp_path)
+    csv = tmp_path / "d.csv"
+    gen_csv(str(csv), n=16)
+    cfg = Config({"dtb.feature.schema.file.path": str(schema_path),
+                  "dtb.streaming.resume": "true",
+                  "dtb.num.trees": "1"})
+    with pytest.raises(ValueError, match="streaming.ingest"):
+        random_forest_builder(cfg, str(csv), str(tmp_path / "out"))
+
+
+def test_resume_after_ingest_complete_skips_reread(tmp_path):
+    """A crash in the BUILD phase (after ingest) resumes from the
+    ingest-complete step and re-reads zero source rows."""
+    from avenir_tpu.cli import run as cli_run
+    schema_path, _ = write_schema(tmp_path)
+    csv = tmp_path / "train.csv"
+    gen_csv(str(csv), n=96, seed=5)
+    props = _rf_conf(tmp_path, schema_path, tmp_path / "ck", tmp_path / "q")
+    out = tmp_path / "out"
+    rc = cli_run.main(["randomForestBuilder", f"-Dconf.path={props}",
+                       str(csv), str(out)])
+    assert rc == 0
+    first = _read_trees(out)
+    # resume against the completed checkpoint: same model, counters note it
+    rc = cli_run.main(["randomForestBuilder", f"-Dconf.path={props}",
+                       "--resume", str(csv), str(out)])
+    assert rc == 0
+    assert _read_trees(out) == first

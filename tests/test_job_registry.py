@@ -114,3 +114,25 @@ def test_aliases_agree_with_fqcn():
 
 def test_resolve_bare_class_name():
     assert resolve("BayesianDistribution").__name__ == "bayesian_distribution"
+
+
+def test_every_job_declares_explicit_dist_mode():
+    """Static multi-process-safety check: every registered job (jobs.py and
+    every cli/*_jobs.py pack) must carry an explicit ``dist=`` class in
+    JOB_DIST — the contract cli.run enforces under
+    ``jax.process_count() > 1``.  A job missing from JOB_DIST would fall
+    to dist_mode's 'refuse' default, i.e. silently lose multi-process
+    support; one with an unknown class would dodge the enforcement
+    entirely.  register() validates at import time; this pins it."""
+    from avenir_tpu.cli.jobs import JOB_DIST, _DIST_MODES, dist_mode
+    undeclared = sorted({fn.__name__ for fn in JOBS.values()
+                         if fn not in JOB_DIST})
+    assert undeclared == [], (
+        f"jobs registered without an explicit dist= mode: {undeclared}")
+    bad_modes = {fn.__name__: m for fn, m in JOB_DIST.items()
+                 if m not in _DIST_MODES}
+    assert bad_modes == {}
+    # and the resolver agrees: no registered job resolves to 'refuse' by
+    # silent default (only by explicit declaration)
+    for fn in set(JOBS.values()):
+        assert dist_mode(fn) == JOB_DIST[fn]
