@@ -97,13 +97,11 @@ def _bad_records_policy(cfg: Config, counters: Counters,
 
 
 def _splitter(delim_regex: str):
-    """Line splitter honoring field.delim.regex semantics: literal fast path,
-    re.split otherwise (mirrors core.table._tokenize)."""
-    import re as _re
-    if _re.escape(delim_regex) == delim_regex:
-        return lambda line: line.split(delim_regex)
-    pat = _re.compile(delim_regex)
-    return lambda line: pat.split(line)
+    """Line splitter honoring field.delim.regex semantics: literal fast
+    path, re.split otherwise — THE tokenizer, shared with core.table and
+    serving (one delimiter semantics everywhere)."""
+    from ..core.table import _make_splitter
+    return _make_splitter(delim_regex)
 
 
 # --------------------------------------------------------------------------
@@ -248,6 +246,19 @@ def random_forest_builder(cfg: Config, in_path: str, out_path: str) -> Counters:
     for i, dpl in enumerate(models):
         with open(os.path.join(out_path, f"tree_{i}.json"), "w") as fh:
             fh.write(dpl.to_json())
+    reg_dir = cfg.get("dtb.model.registry.dir")
+    if reg_dir:
+        # publish the trained forest into the serving registry (atomic
+        # versioned artifact; a live predictionService hot-swaps to it on
+        # its next refresh).  Every process trains the identical model
+        # (sharded job, device reductions), so under multi-process only
+        # process 0 publishes — the registry is single-writer per name
+        import jax
+        if jax.process_index() == 0:
+            from ..serving.registry import ModelRegistry
+            version = ModelRegistry(reg_dir).publish(
+                cfg.get("dtb.model.name", "forest"), models, schema=schema)
+            counters.set("Random forest", "RegistryVersion", version)
     counters.increment("Random forest", "Trees", len(models))
     return counters
 

@@ -37,6 +37,7 @@ from . import association_jobs  # noqa: F401  (registers association-pack jobs)
 from . import text_jobs  # noqa: F401  (registers text-pack + rule jobs)
 from . import partition_jobs  # noqa: F401  (registers split/partition jobs)
 from . import nn_jobs  # noqa: F401  (registers neural-net jobs)
+from . import serving_jobs  # noqa: F401  (registers online-serving jobs)
 
 
 def file_sha(path: str, full: bool) -> str:

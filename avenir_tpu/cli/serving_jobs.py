@@ -1,0 +1,123 @@
+"""Online-serving jobs (org.avenir.serving.*).
+
+``predictionService`` replays a file of request records through the
+micro-batched serving loop — the offline harness for the online subsystem
+(every layer the live loop uses: registry load, warm bucketed predictors,
+coalescing policy, optional RESP wire transport).  Config keys
+(reference-style, ``ps.`` namespace):
+
+  ps.model.registry.dir     registry base directory (required)
+  ps.model.name             model name in the registry (required)
+  ps.model.version          pin a version (default: newest intact)
+  ps.feature.schema.file.path  override the artifact's embedded schema
+  ps.batch.max.size         micro-batch close size (default 64)
+  ps.batch.max.wait.ms      micro-batch window (default 2.0)
+  ps.bucket.sizes           jit shape buckets (default 1,8,64,512)
+  ps.warm.start             pre-compile all buckets (default true)
+  ps.latency.window         latency sample window (default 8192)
+  ps.transport              inprocess | resp (default inprocess)
+  redis.request.queue / redis.prediction.queue   resp-queue names
+
+The input file holds one record per line (same layout the model's schema
+describes); the output is one ``<requestId><delim><predictedClass>`` line
+per request, requestId = 0-based input line number.  Latency percentiles
+and throughput land in the counter dump (Serving group).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..core.config import Config
+from ..core.metrics import Counters
+from ..core import artifacts
+from .jobs import register, _schema_path, _splitter
+
+
+@register("org.avenir.serving.PredictionService", "predictionService",
+          dist="refuse")
+def prediction_service(cfg: Config, in_path: str, out_path: str) -> Counters:
+    from ..serving.registry import ModelRegistry
+    from ..serving.predictor import DEFAULT_BUCKETS
+    from ..serving.service import (BatchPolicy, PredictionService,
+                                   RespPredictionLoop)
+    from ..utils.tracing import StepTimer
+    counters = Counters()
+    registry = ModelRegistry(cfg.must_get("ps.model.registry.dir"))
+    schema = _schema_path(cfg, "ps.feature.schema.file.path") \
+        if "ps.feature.schema.file.path" in cfg else None
+    policy = BatchPolicy(
+        max_batch=cfg.get_int("ps.batch.max.size", 64),
+        max_wait_ms=cfg.get_float("ps.batch.max.wait.ms", 2.0))
+    timer = StepTimer(keep_samples=cfg.get_int("ps.latency.window", 8192))
+    name = cfg.must_get("ps.model.name")
+    buckets = tuple(cfg.get_int_list("ps.bucket.sizes",
+                                     list(DEFAULT_BUCKETS)))
+    warm = cfg.get_boolean("ps.warm.start", True)
+    version = cfg.get_int("ps.model.version", 0)
+    common = dict(policy=policy, counters=counters, timer=timer,
+                  warm=warm, delim=cfg.field_delim_out)
+    if version:
+        # pinned serving: build the predictor for that exact version
+        # (hot-swap refresh is deliberately unavailable — a pin is a pin)
+        from ..serving.predictor import make_predictor
+        loaded = registry.load(name, version, schema=schema)
+        pred = make_predictor(loaded, schema=schema, buckets=buckets,
+                              delim=cfg.field_delim_out)
+        svc = PredictionService(pred, **common)
+        svc.version = version
+    else:
+        svc = PredictionService(registry=registry, model_name=name,
+                                schema=schema, buckets=buckets, **common)
+    counters.set("Serving", "ModelVersion", svc.version or 0)
+    # tokenize with the INPUT delimiter (field.delim.regex, like every
+    # other job); the service/wire delimiter is field.delim.out
+    split = _splitter(cfg.field_delim_regex)
+    rows = [split(line) for line in artifacts.read_text_input(in_path)]
+    od = cfg.field_delim_out
+    transport = cfg.get("ps.transport", "inprocess")
+    if transport == "resp":
+        from ..io.respq import RespClient, RespServer
+        server = RespServer().start()
+        try:
+            req_q = cfg.get("redis.request.queue", "requestQueue")
+            pred_q = cfg.get("redis.prediction.queue", "predictionQueue")
+            wire_cfg = {"redis.server.port": server.port,
+                        "redis.request.queue": req_q,
+                        "redis.prediction.queue": pred_q}
+            loop = RespPredictionLoop(svc, wire_cfg)
+            feeder = RespClient(port=server.port)
+            for i, row in enumerate(rows):
+                feeder.lpush(req_q, od.join(["predict", str(i)] + row))
+            feeder.lpush(req_q, "stop")
+            loop.run(max_idle_s=30.0)
+            out: List[str] = []
+            while True:
+                v = feeder.rpop(pred_q)
+                if v is None:
+                    break
+                out.append(v)
+            out.sort(key=lambda r: int(r.split(od, 1)[0]))
+            loop.close()
+            feeder.close()
+        finally:
+            server.stop()
+    elif transport == "inprocess":
+        svc.start()
+        futures = [svc.submit(row) for row in rows]
+        results = []
+        for f in futures:
+            try:
+                results.append(f.result(timeout=120))
+            except Exception:
+                # same contract as the wire transport: a malformed record
+                # costs ITS response line, not the whole replay
+                results.append(svc.error_label)
+        svc.stop()
+        out = [f"{i}{od}{r}" for i, r in enumerate(results)]
+    else:
+        raise ValueError(f"unknown ps.transport {transport!r} "
+                         "(inprocess | resp)")
+    artifacts.write_text_output(out_path, out, role="m")
+    timer.export(counters, group="Serving")
+    return counters

@@ -30,7 +30,8 @@ call, retries included).  ``times`` bounds how often the spec fires
 
 Instrumented ops: ``chunk_read`` (native chunk parse), ``chunk_encode``
 (python-oracle chunk parse), ``artifact_write`` (part-file/JSON writes),
-``checkpoint_save`` (CheckpointManager.save).
+``checkpoint_save`` (CheckpointManager.save), ``registry_publish``
+(serving ModelRegistry.publish array payload write).
 """
 
 from __future__ import annotations

@@ -160,6 +160,30 @@ class FeatureSchema:
         fields.sort(key=lambda f: f.ordinal)
         return cls(fields=fields)
 
+    def to_dict(self) -> Dict[str, Any]:
+        """Inverse of :meth:`from_dict` (reference JSON key names), so a
+        schema can travel inside a model artifact (serving registry) and
+        reconstruct identically: ``from_dict(s.to_dict()) == s``."""
+        out = []
+        for f in self.fields:
+            d: Dict[str, Any] = {"name": f.name, "ordinal": f.ordinal,
+                                 "dataType": f.data_type}
+            if f.feature:
+                d["feature"] = True
+            if f.id_field:
+                d["id"] = True
+            if f.class_field:
+                d["classAttr"] = True
+            for key, v in (("cardinality", f.cardinality), ("min", f.min),
+                           ("max", f.max), ("bucketWidth", f.bucket_width),
+                           ("maxSplit", f.max_split),
+                           ("splitScanInterval", f.split_scan_interval)):
+                if v is not None:
+                    d[key] = v
+            d.update(f.extras)
+            out.append(d)
+        return {"fields": out}
+
     @classmethod
     def from_json(cls, text: str) -> "FeatureSchema":
         return cls.from_dict(json.loads(text))

@@ -205,6 +205,34 @@ class RespClient:
     def rpop(self, queue: str) -> Optional[str]:
         return self._call("RPOP", queue)
 
+    def rpop_many(self, queue: str, n: int) -> List[str]:
+        """Drain up to ``n`` values with PIPELINED RPOPs: one socket write
+        carrying n commands, n replies read back — the wire half of
+        micro-batching (n round trips collapse to one).  Works against
+        this server or a real Redis (plain command pipelining).  Returns
+        the non-nil values in queue order; may be shorter than n."""
+        if n <= 0:
+            return []
+        self._sock.sendall(
+            b"".join(_encode_command(["RPOP", queue]) for _ in range(n)))
+        out: List[str] = []
+        first_err: Optional[RuntimeError] = None
+        for _ in range(n):
+            try:
+                v = _read_reply(self._rf)
+            except RuntimeError as exc:
+                # a -ERR reply is one consumed line; keep reading the
+                # remaining pipelined replies or the connection would
+                # desynchronize (the next command's _call would read a
+                # stale RPOP reply as its own answer)
+                first_err = first_err or exc
+                continue
+            if v is not None:
+                out.append(v)
+        if first_err is not None:
+            raise first_err
+        return out
+
     def llen(self, queue: str) -> int:
         return int(self._call("LLEN", queue))
 

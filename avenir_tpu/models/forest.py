@@ -398,36 +398,42 @@ def build_forest_from_stream(blocks, schema, params: ForestParams,
     return models
 
 
+def _ensemble_vote_body(vals, codes, lo, hi, num_r, cat_m, cat_r, cls_oh,
+                        wvec, min_odds):
+    """The fused ensemble vote: per-member first-match, weighted vote,
+    argmax + min-odds veto — all on device, one (n,) readback.  A trailing
+    always-match sentinel path per member carries its fallback class, so
+    first-match == the member's predict-with-fallback semantics.  Shared by
+    the batch predict kernel below and the serving layer's per-predictor
+    jit (serving/predictor.py hooks a trace counter around it)."""
+    from .tree import _match_ok
+    P = cls_oh.shape[1]
+    K = cls_oh.shape[2]
+    # the per-member matcher IS tree._match_ok, vmapped over the member
+    # axis — one predicate-semantics implementation for both paths
+    ok = jax.vmap(
+        lambda l, h, nr, cm, cr: _match_ok(vals, codes, l, h, nr, cm,
+                                           cr, jnp)
+    )(lo, hi, num_r, cat_m, cat_r)                    # (T, n, P)
+    ok = ok.transpose(1, 0, 2)                        # (n, T, P)
+    first = jnp.argmax(ok, axis=2)                    # (n, T)
+    foh = jax.nn.one_hot(first, P, dtype=jnp.float32)
+    votes = jnp.einsum("ntp,tpk,t->nk", foh, cls_oh, wvec,
+                       precision=jax.lax.Precision.HIGHEST)  # (n, K)
+    best = jnp.argmax(votes, axis=1)
+    top = votes.max(axis=1)
+    second = jnp.where(jax.nn.one_hot(best, K, dtype=bool), -jnp.inf,
+                       votes).max(axis=1)
+    veto = (min_odds > 1.0) & \
+        (top / jnp.maximum(second, 1e-12) <= min_odds)
+    return jnp.where(veto, K, best).astype(jnp.int32)
+
+
 @functools.lru_cache(maxsize=None)
 def _jitted_ensemble_vote_kernel(T: int, P: int, F: int, C: int, K: int):
     """One fused launch for the WHOLE ensemble: every member's path tensors
-    stacked on a leading member axis, per-member first-match, weighted vote,
-    argmax + min-odds veto — all on device, one (n,) readback.  A trailing
-    always-match sentinel path per member carries its fallback class, so
-    first-match == the member's predict-with-fallback semantics."""
-    from .tree import _match_ok
-
-    def kernel(vals, codes, lo, hi, num_r, cat_m, cat_r, cls_oh, wvec,
-               min_odds):
-        # the per-member matcher IS tree._match_ok, vmapped over the member
-        # axis — one predicate-semantics implementation for both paths
-        ok = jax.vmap(
-            lambda l, h, nr, cm, cr: _match_ok(vals, codes, l, h, nr, cm,
-                                               cr, jnp)
-        )(lo, hi, num_r, cat_m, cat_r)                    # (T, n, P)
-        ok = ok.transpose(1, 0, 2)                        # (n, T, P)
-        first = jnp.argmax(ok, axis=2)                    # (n, T)
-        foh = jax.nn.one_hot(first, P, dtype=jnp.float32)
-        votes = jnp.einsum("ntp,tpk,t->nk", foh, cls_oh, wvec,
-                           precision=jax.lax.Precision.HIGHEST)  # (n, K)
-        best = jnp.argmax(votes, axis=1)
-        top = votes.max(axis=1)
-        second = jnp.where(jax.nn.one_hot(best, K, dtype=bool), -jnp.inf,
-                           votes).max(axis=1)
-        veto = (min_odds > 1.0) & \
-            (top / jnp.maximum(second, 1e-12) <= min_odds)
-        return jnp.where(veto, K, best).astype(jnp.int32)
-    return jax.jit(kernel)
+    stacked on a leading member axis (see ``_ensemble_vote_body``)."""
+    return jax.jit(_ensemble_vote_body)
 
 
 class EnsembleModel:
@@ -456,6 +462,9 @@ class EnsembleModel:
         self.classes = sorted({c for m in models for c in m.matrix.classes}
                               | {""})
         self._cls_arr = np.array(self.classes)
+        # vote-index -> label decode (trailing None = min-odds veto): one
+        # table for the batch path and the serving layer
+        self._lut = np.concatenate([self._cls_arr.astype(object), [None]])
         self._stacked = self._stack_members()
 
     def _stack_members(self):
@@ -501,31 +510,42 @@ class EnsembleModel:
         return dev + (jnp.asarray(np.asarray(self.weights, np.float32)),
                       _jitted_ensemble_vote_kernel(T, P, F, cmax, K))
 
+    def device_inputs(self, table: ColumnarTable, cache=None):
+        """The single gate for the fused device vote: (d_vals, d_codes)
+        when this table can take it — members stacked, rows present, and
+        features f32-exact — else None (host path).  Shared by predict()
+        and the serving layer's per-predictor jit so the two paths can
+        never disagree on WHEN the device kernel applies."""
+        from .tree import FeatureCache
+        if self._stacked is None or table.n_rows == 0:
+            return None
+        cache = cache if cache is not None else FeatureCache()
+        m0 = self.models[0].matrix
+        vals, codes = cache.host(m0, table)
+        if not m0._f32_safe(vals):
+            return None
+        return cache.device(vals, codes)
+
     def predict(self, table: ColumnarTable) -> List[Optional[str]]:
         """Weighted vote; fused device path when available, else one
         (n, K) host reduction over per-member predictions (members still
         share one feature build/upload via FeatureCache)."""
         from .tree import FeatureCache
         cache = FeatureCache()
-        n = table.n_rows
-        if self._stacked is not None and n > 0:
-            m0 = self.models[0].matrix
-            vals, codes = cache.host(m0, table)
-            if m0._f32_safe(vals):
-                return self._predict_device(vals, codes, cache)
+        dev = self.device_inputs(table, cache)
+        if dev is not None:
+            return self._predict_device(*dev)
         return self._predict_host(table, cache)
 
-    def _predict_device(self, vals, codes, cache) -> List[Optional[str]]:
+    def _predict_device(self, d_vals, d_codes) -> List[Optional[str]]:
         *consts, wvec, kernel = self._stacked
         T, P, F = consts[0].shape
         C = consts[3].shape[3]
-        n = vals.shape[0]
-        d_vals, d_codes = cache.device(vals, codes)
+        n = d_vals.shape[0]
         # budget covers both the (n, T, P, F) match intermediate and the
         # (n, F, C) categorical one-hot (dominant for high cardinality)
         per_row = max(T * P * F, F * C, 1)
         chunk = max(1024, (1 << 26) // per_row)
-        K = len(self.classes)
         out = []
         for s in range(0, n, chunk):
             out.append(kernel(d_vals[s:s + chunk], d_codes[s:s + chunk],
@@ -535,8 +555,7 @@ class EnsembleModel:
         # batch (each separate np.asarray costs a full ~62 ms tunnel
         # round trip — TPU_NOTES section 5)
         idx = np.asarray(out[0] if len(out) == 1 else jnp.concatenate(out))
-        lut = np.concatenate([self._cls_arr.astype(object), [None]])
-        return list(lut[idx])
+        return list(self._lut[idx])
 
     def _predict_host(self, table: ColumnarTable, cache) -> List[Optional[str]]:
         n = table.n_rows

@@ -187,9 +187,24 @@ class LogisticTrainer:
                 threshold: float = 0.5) -> np.ndarray:
         """Returns class codes: pos_class code where p > threshold."""
         p = self.predict_proba(table, w)
-        pos_code = self.schema.class_attr_field.must_cat_code(
-            self.params.pos_class_value)
-        card = self.schema.class_attr_field.cardinality or []
-        neg_code = next((c for c in range(len(card)) if c != pos_code),
-                        1 - pos_code)
-        return np.where(p > threshold, pos_code, neg_code)
+        pos_code, neg_code = pos_neg_codes(self.schema.class_attr_field,
+                                           self.params.pos_class_value)
+        return threshold_codes(p, threshold, pos_code, neg_code)
+
+
+def pos_neg_codes(class_field, pos_class_value: str) -> Tuple[int, int]:
+    """(pos_code, neg_code) with the trainer's negative-class selection
+    rule (first cardinality code that is not the positive one).  Shared
+    with the serving LogisticPredictor so online decisions can never
+    diverge from this module's."""
+    pos_code = class_field.must_cat_code(pos_class_value)
+    card = class_field.cardinality or []
+    neg_code = next((c for c in range(len(card)) if c != pos_code),
+                    1 - pos_code)
+    return pos_code, neg_code
+
+
+def threshold_codes(p: np.ndarray, threshold: float, pos_code: int,
+                    neg_code: int) -> np.ndarray:
+    """The decision step itself: strictly-greater threshold compare."""
+    return np.where(p > threshold, pos_code, neg_code)

@@ -1,0 +1,33 @@
+"""Online prediction serving: model registry, warm bucketed predictors,
+micro-batched request loop.
+
+The training side of the framework runs one-shot batch jobs (cli/jobs.py);
+this package is the low-latency half of the TensorFlow lesson (PAPERS.md):
+the same model core must also serve online traffic.  Three layers:
+
+  * :mod:`.registry`  — versioned, atomically published model artifacts
+    (uniform JSON/NPZ format for forest / naive bayes / logistic / MLP)
+    with torn-version detection and hot-swap reload;
+  * :mod:`.predictor` — per-model ``Predictor`` wrappers holding
+    pre-warmed, shape-bucketed jitted predict functions (requests pad to
+    bucket sizes so XLA compiles once per bucket — the Execution
+    Templates insight: reuse pre-validated execution state);
+  * :mod:`.service`   — the in-process micro-batching request loop plus
+    the RESP wire transport (io/respq), same message conventions as the
+    bandit loop in reinforce/serving.py.
+"""
+
+from .registry import (FOREST, BAYES, LOGISTIC, MLP, LoadedModel,
+                       ModelRegistry, load_model, save_model)
+from .predictor import (DEFAULT_BUCKETS, BayesPredictor, ForestPredictor,
+                        LogisticPredictor, MLPPredictor, Predictor,
+                        make_predictor)
+from .service import BatchPolicy, PredictionService, RespPredictionLoop
+
+__all__ = [
+    "FOREST", "BAYES", "LOGISTIC", "MLP", "LoadedModel", "ModelRegistry",
+    "load_model", "save_model", "DEFAULT_BUCKETS", "BayesPredictor",
+    "ForestPredictor", "LogisticPredictor", "MLPPredictor", "Predictor",
+    "make_predictor", "BatchPolicy", "PredictionService",
+    "RespPredictionLoop",
+]

@@ -1,0 +1,308 @@
+"""Warm, shape-bucketed predictors: the per-request compile problem solved
+once for every model family.
+
+A naive serving loop hands XLA a fresh batch shape per request mix — and a
+fresh multi-second compile with it (the Execution Templates problem:
+repeated short tasks must reuse pre-validated execution state).  Every
+``Predictor`` here pads incoming micro-batches up to a fixed bucket size
+(mirroring PR 1's pad-to-one-shape forest level kernels), so each bucket
+compiles exactly once and every later request of any size <= that bucket
+reuses the warm executable.  ``warm()`` pre-compiles all buckets at model
+load, moving the cost off the request path entirely.
+
+Compile accounting: the per-instance jitted cores bump ``compile_count``
+from INSIDE the traced function — tracing runs once per compilation, so the
+counter is a true retrace/compile meter (the bucketed-jit tests pin it).
+
+Padding uses a copy of the batch's last row: per-row prediction is
+independent in every model family, so pad rows cannot perturb real rows;
+results are sliced back to the true request count.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.schema import FeatureSchema
+from ..core.table import ColumnarTable, _make_splitter, encode_rows
+from .registry import BAYES, FOREST, LOGISTIC, MLP, LoadedModel
+
+DEFAULT_BUCKETS = (1, 8, 64, 512)
+AMBIGUOUS = "ambiguous"   # the ensemble's min-odds veto, as a wire label
+
+
+class Predictor:
+    """Base: tokenized-row requests -> class-label strings, bucketed."""
+
+    kind = "?"
+
+    def __init__(self, schema: FeatureSchema,
+                 buckets: Sequence[int] = DEFAULT_BUCKETS,
+                 delim: str = ","):
+        self.schema = schema
+        self.buckets = tuple(sorted({int(b) for b in buckets}))
+        if not self.buckets or self.buckets[0] < 1:
+            raise ValueError(f"buckets must be positive ints, got {buckets}")
+        self.delim = delim
+        self._split = _make_splitter(delim)
+        self.compile_count = 0
+
+    # ---- bucketing ----
+    def bucket_size(self, n: int) -> int:
+        """Smallest bucket >= n; requests beyond the largest bucket are
+        chunked by the caller (predict_rows)."""
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    def dummy_row(self) -> List[str]:
+        """One schema-valid record (used to pre-compile buckets)."""
+        row = [""] * self.schema.num_columns
+        for f in self.schema.fields:
+            if f.is_categorical:
+                row[f.ordinal] = (f.cardinality or [""])[0]
+            elif f.is_numeric:
+                lo = f.min if f.min is not None else 0
+                row[f.ordinal] = str(int(lo)) if f.is_integer \
+                    else repr(float(lo))
+            else:
+                row[f.ordinal] = "x"
+        return row
+
+    def warm(self) -> "Predictor":
+        """Compile every bucket before traffic arrives: one dummy batch per
+        bucket size runs the full predict path, so the first real request
+        hits a warm executable."""
+        d = self.dummy_row()
+        for b in self.buckets:
+            self.predict_rows([list(d)] * b)
+        return self
+
+    # ---- request entries ----
+    def predict_line(self, line: str) -> Optional[str]:
+        return self.predict_rows([self._split(line)])[0]
+
+    def _bucketed_tables(self, rows: List[List[str]]):
+        """Yield (table, n_valid) per top-bucket chunk: rows are split at
+        the largest bucket, each chunk padded up to its bucket size with
+        copies of its last row — THE shape discipline every predict entry
+        shares, so a padding/bucketing fix lands everywhere at once."""
+        top = self.buckets[-1]
+        for s in range(0, len(rows), top):
+            chunk = rows[s:s + top]
+            n = len(chunk)
+            b = self.bucket_size(n)
+            yield encode_rows(chunk + [chunk[-1]] * (b - n),
+                              self.schema), n
+
+    def predict_rows(self, rows: List[List[str]]) -> List[Optional[str]]:
+        """Predict a micro-batch of tokenized records.  Batches larger than
+        the top bucket split into top-bucket chunks (each still one warm
+        executable)."""
+        if not rows:
+            return []
+        out: List[Optional[str]] = []
+        for table, n in self._bucketed_tables(rows):
+            out.extend(self._predict_table(table)[:n])
+        return out
+
+    # ---- subclass contract ----
+    def _predict_table(self, table: ColumnarTable) -> List[Optional[str]]:
+        raise NotImplementedError
+
+    def _note_trace(self) -> None:
+        """Called from inside traced cores: fires once per (re)trace."""
+        self.compile_count += 1
+
+
+class ForestPredictor(Predictor):
+    """Decision forest serving via the batch path's own vote kernel
+    (models/forest._ensemble_vote_body) wrapped in a per-instance jit, so
+    responses are exactly what the offline ModelPredictor job would emit
+    for the same records — the only difference is who owns the compile
+    cache.  ``None`` (min-odds veto) maps to ``ambiguous_label`` by the
+    service layer."""
+
+    kind = FOREST
+
+    def __init__(self, path_lists, schema: FeatureSchema,
+                 weights: Optional[Sequence[float]] = None,
+                 min_odds_ratio: float = 1.0, **kw):
+        super().__init__(schema, **kw)
+        from ..models.forest import EnsembleModel, _ensemble_vote_body
+        from ..models.tree import DecisionTreeModel
+        self.models = [DecisionTreeModel(pl, schema) for pl in path_lists]
+        self.single = len(self.models) == 1
+        if self.single:
+            self.ensemble = None
+            self._core = None
+            return
+        self.ensemble = EnsembleModel(self.models, weights=weights,
+                                      min_odds_ratio=min_odds_ratio,
+                                      require_odd=False)
+        if self.ensemble._stacked is not None:
+            *consts, wvec, _kernel = self.ensemble._stacked
+            min_odds = jnp.float32(min_odds_ratio)
+
+            def core(vals, codes):
+                self._note_trace()
+                return _ensemble_vote_body(vals, codes, *consts, wvec,
+                                           min_odds)
+            self._core = jax.jit(core)
+        else:
+            # degenerate member / non-f32-exact bounds: the host vote path
+            # is exact and compile-free, so bucketing is moot
+            self._core = None
+
+    def _predict_table(self, table: ColumnarTable) -> List[Optional[str]]:
+        if self.single:
+            preds, _ = self.models[0].predict(table)
+            return list(preds)
+        ens = self.ensemble
+        if self._core is not None:
+            # same device-path gate and label decode as the batch path —
+            # serving only substitutes the compile-counted jit.  The cache
+            # rides into the host fallback so a failed gate does not
+            # rebuild the feature arrays it already built.
+            from ..models.tree import FeatureCache
+            cache = FeatureCache()
+            dev = ens.device_inputs(table, cache)
+            if dev is not None:
+                return list(ens._lut[np.asarray(self._core(*dev))])
+            return ens._predict_host(table, cache)
+        return ens.predict(table)
+
+
+class BayesPredictor(Predictor):
+    """Naive bayes serving through models/bayes.predict itself (its kernels
+    are module-level jits keyed by batch shape, so the bucket padding here
+    is exactly what bounds their compile count)."""
+
+    kind = BAYES
+
+    def __init__(self, model, schema: Optional[FeatureSchema] = None,
+                 ctx=None, **kw):
+        super().__init__(schema or model.schema, **kw)
+        from ..parallel.mesh import runtime_context
+        self.model = model
+        self.ctx = ctx or runtime_context()
+
+    def _predict_table(self, table: ColumnarTable) -> List[Optional[str]]:
+        from ..models import bayes
+        return list(bayes.predict(self.model, table, self.ctx).pred_class)
+
+
+class LogisticPredictor(Predictor):
+    """Binary logistic serving: the trainer's exact predict math
+    (sigmoid of the f32 [1, x...] design row dotted with f32 weights,
+    regress/logistic.LogisticTrainer.predict) behind a per-instance jit."""
+
+    kind = LOGISTIC
+
+    def __init__(self, w, schema: FeatureSchema, pos_class_value: str,
+                 threshold: float = 0.5, **kw):
+        super().__init__(schema, **kw)
+        from ..regress.logistic import pos_neg_codes
+        self.w = np.asarray(w, np.float64)
+        self.threshold = float(threshold)
+        cf = schema.class_attr_field
+        self.card = list(cf.cardinality or [])
+        self.pos_code, self.neg_code = pos_neg_codes(cf, pos_class_value)
+
+        def core(X, w):
+            self._note_trace()
+            return jax.nn.sigmoid(X @ w)
+        self._core = jax.jit(core)
+
+    def _proba_table(self, table: ColumnarTable) -> np.ndarray:
+        """sigmoid([1, x...] @ w) for one bucket-padded table — the
+        trainer's exact design matrix and dtypes."""
+        feats = table.feature_matrix(dtype=np.float32)
+        X = np.concatenate(
+            [np.ones((table.n_rows, 1), np.float32), feats], axis=1)
+        return np.asarray(self._core(jnp.asarray(X),
+                                     jnp.asarray(self.w, jnp.float32)))
+
+    def _predict_table(self, table: ColumnarTable) -> List[Optional[str]]:
+        from ..regress.logistic import threshold_codes
+        codes = threshold_codes(self._proba_table(table), self.threshold,
+                                self.pos_code, self.neg_code)
+        if self.card:
+            return [self.card[int(c)] for c in codes]
+        return [str(int(c)) for c in codes]
+
+    def predict_proba_rows(self, rows: List[List[str]]) -> np.ndarray:
+        """Bucketed positive-class probabilities (same core, same
+        top-bucket chunking as predict_rows)."""
+        if not rows:
+            return np.zeros((0,), np.float32)
+        return np.concatenate([self._proba_table(t)[:n]
+                               for t, n in self._bucketed_tables(rows)])
+
+
+class MLPPredictor(Predictor):
+    """MLP serving: nn/mlp.forward_logits argmax (identical to mlp.predict)
+    behind a per-instance jit over bucket-padded batches."""
+
+    kind = MLP
+
+    def __init__(self, params: Dict[str, Any], schema: FeatureSchema,
+                 class_values: Optional[Sequence[str]] = None, **kw):
+        super().__init__(schema, **kw)
+        from ..nn import mlp as _mlp
+        self.params = {k: jnp.asarray(v) for k, v in params.items()}
+        cf = schema.class_attr_field
+        self.class_values = list(class_values or cf.cardinality or [])
+
+        def core(X, params):
+            self._note_trace()
+            return jnp.argmax(_mlp.forward_logits(params, X), axis=-1)
+        self._core = jax.jit(core)
+
+    def _predict_table(self, table: ColumnarTable) -> List[Optional[str]]:
+        X = jnp.asarray(table.feature_matrix(dtype=np.float32))
+        idx = np.asarray(self._core(X, self.params))
+        cv = self.class_values
+        return [cv[i] if i < len(cv) else str(int(i)) for i in idx]
+
+
+def make_predictor(loaded: LoadedModel,
+                   schema: Optional[FeatureSchema] = None,
+                   buckets: Sequence[int] = DEFAULT_BUCKETS,
+                   delim: str = ",", **kw) -> Predictor:
+    """Registry artifact -> the right Predictor (kind-dispatched), using
+    the artifact's embedded schema unless one is passed explicitly."""
+    schema = schema or loaded.schema
+    if schema is None:
+        raise ValueError(
+            f"model {loaded.name!r} v{loaded.version} has no embedded "
+            "schema; pass schema= to make_predictor")
+    common = dict(buckets=buckets, delim=delim)
+    if loaded.kind == FOREST:
+        p = loaded.params
+        return ForestPredictor(
+            loaded.model, schema,
+            weights=p.get("weights"),
+            min_odds_ratio=float(p.get("min_odds_ratio", 1.0)),
+            **common, **kw)
+    if loaded.kind == BAYES:
+        return BayesPredictor(loaded.model, schema, **common, **kw)
+    if loaded.kind == LOGISTIC:
+        p = loaded.params
+        if "pos_class_value" not in p:
+            raise ValueError("logistic artifact is missing the "
+                             "pos_class_value param (publish with "
+                             "params={'pos_class_value': ...})")
+        return LogisticPredictor(
+            loaded.model, schema, p["pos_class_value"],
+            threshold=float(p.get("threshold", 0.5)), **common, **kw)
+    if loaded.kind == MLP:
+        return MLPPredictor(loaded.model, schema,
+                            class_values=loaded.class_values or None,
+                            **common, **kw)
+    raise ValueError(f"unknown model kind {loaded.kind!r}")

@@ -1,0 +1,310 @@
+"""Model registry: versioned model artifacts with atomic hot-swap publish.
+
+Every servable model kind saves through ONE artifact format so the serving
+layer never special-cases training code:
+
+    <base_dir>/<name>/v_000001/meta.json     # kind, class labels, dtypes,
+                                             # params, schema, JSON payload
+    <base_dir>/<name>/v_000001/arrays.npz    # numeric payload (pinned dtypes)
+
+Publish is crash-safe the same way core/checkpoint.py steps are: the version
+directory is fully written as ``v_NNNNNN.tmp`` and renamed into place, so a
+reader either sees the previous latest or the complete new version — never a
+half-written one.  ``latest_version`` additionally probes intactness (a torn
+directory left by a crash mid-publish, or a copy-in from a dying node, is
+skipped with a warning instead of being served).
+
+The artifact JSON pins the contract the round-trip tests enforce:
+``class_values`` (label order — prediction indices are meaningless without
+it) and ``dtypes`` (per-array dtype strings — a silently float64->float32
+narrowed weight vector would shift decision boundaries).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import warnings
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.artifacts import ArtifactStore, write_json
+from ..core.faults import fault_point, with_retry
+from ..core.schema import FeatureSchema
+
+FOREST = "forest"
+BAYES = "bayes"
+LOGISTIC = "logistic"
+MLP = "mlp"
+KINDS = (FOREST, BAYES, LOGISTIC, MLP)
+
+META_FILE = "meta.json"
+ARRAYS_FILE = "arrays.npz"
+FORMAT_VERSION = 1
+
+_VERSION_RE = re.compile(r"^v_(\d{6})$")
+
+
+@dataclass
+class LoadedModel:
+    """What :meth:`ModelRegistry.load` returns: the reconstructed model
+    object plus everything needed to build a serving Predictor around it."""
+    name: str
+    version: int
+    kind: str
+    model: Any                       # kind-specific (see _decode)
+    meta: Dict[str, Any]
+    schema: Optional[FeatureSchema]  # from the artifact, when saved with one
+
+    @property
+    def params(self) -> Dict[str, Any]:
+        return self.meta.get("params", {})
+
+    @property
+    def class_values(self) -> List[str]:
+        return list(self.meta.get("class_values") or [])
+
+
+# --------------------------------------------------------------------------
+# kind-specific encode/decode
+# --------------------------------------------------------------------------
+
+def _detect_kind(model: Any) -> str:
+    from ..models.bayes import NaiveBayesModel
+    from ..models.tree import DecisionPathList
+    if isinstance(model, NaiveBayesModel):
+        return BAYES
+    if isinstance(model, DecisionPathList):
+        return FOREST
+    if isinstance(model, (list, tuple)) and model and \
+            all(isinstance(m, DecisionPathList) for m in model):
+        return FOREST
+    if isinstance(model, np.ndarray) and model.ndim == 1:
+        return LOGISTIC
+    if isinstance(model, dict) and {"W1", "b1", "W2", "b2"} <= set(model):
+        return MLP
+    raise TypeError(f"cannot infer model kind for {type(model).__name__}; "
+                    f"pass kind= explicitly (one of {KINDS})")
+
+
+def _encode(model: Any, kind: str, schema: Optional[FeatureSchema]
+            ) -> Tuple[Dict[str, np.ndarray], Optional[Any],
+                       Optional[List[str]]]:
+    """-> (arrays, model_json, class_values)."""
+    if kind == FOREST:
+        from ..models.tree import DecisionPathList
+        trees = [model] if isinstance(model, DecisionPathList) else list(model)
+        model_json = {"trees": [json.loads(t.to_json()) for t in trees]}
+        cls = list(schema.class_attr_field.cardinality or []) if schema \
+            else None
+        return {}, model_json, cls
+    if kind == BAYES:
+        arrays = {
+            "post_counts": np.asarray(model.post_counts),
+            "class_counts": np.asarray(model.class_counts),
+            "prior_counts": np.asarray(model.prior_counts),
+            "cont_post_mean": np.asarray(model.cont_post_mean),
+            "cont_post_std": np.asarray(model.cont_post_std),
+            "cont_prior_mean": np.asarray(model.cont_prior_mean),
+            "cont_prior_std": np.asarray(model.cont_prior_std),
+            "binned_ordinals": np.asarray(model.binned_ordinals, np.int64),
+            "cont_ordinals": np.asarray(model.cont_ordinals, np.int64),
+            "num_bins": np.asarray(model.num_bins, np.int64),
+        }
+        model_json = {"total": float(model.total)}
+        return arrays, model_json, list(model.class_values)
+    if kind == LOGISTIC:
+        w = np.asarray(model)
+        if w.ndim != 1:
+            raise ValueError(f"logistic weights must be 1-D, got {w.shape}")
+        cls = list(schema.class_attr_field.cardinality or []) if schema \
+            else None
+        return {"w": w}, None, cls
+    if kind == MLP:
+        arrays = {k: np.asarray(v) for k, v in model.items()}
+        cls = list(schema.class_attr_field.cardinality or []) if schema \
+            else None
+        return arrays, None, cls
+    raise ValueError(f"unknown model kind {kind!r}; known: {KINDS}")
+
+
+def _decode(kind: str, arrays: Dict[str, np.ndarray], meta: Dict[str, Any],
+            schema: Optional[FeatureSchema]) -> Any:
+    if kind == FOREST:
+        from ..models.tree import DecisionPathList
+        return [DecisionPathList.from_json(json.dumps(t))
+                for t in meta["model_json"]["trees"]]
+    if kind == BAYES:
+        from ..models.bayes import NaiveBayesModel
+        if schema is None:
+            raise ValueError("bayes artifact needs a schema (save one into "
+                             "the artifact or pass schema= to load)")
+        return NaiveBayesModel(
+            schema=schema,
+            class_values=list(meta.get("class_values") or []),
+            binned_ordinals=[int(o) for o in arrays["binned_ordinals"]],
+            cont_ordinals=[int(o) for o in arrays["cont_ordinals"]],
+            num_bins=[int(b) for b in arrays["num_bins"]],
+            post_counts=arrays["post_counts"],
+            class_counts=arrays["class_counts"],
+            prior_counts=arrays["prior_counts"],
+            total=float(meta["model_json"]["total"]),
+            cont_post_mean=arrays["cont_post_mean"],
+            cont_post_std=arrays["cont_post_std"],
+            cont_prior_mean=arrays["cont_prior_mean"],
+            cont_prior_std=arrays["cont_prior_std"])
+    if kind == LOGISTIC:
+        return arrays["w"]
+    if kind == MLP:
+        return {k: v for k, v in arrays.items()}
+    raise ValueError(f"unknown model kind {kind!r}; known: {KINDS}")
+
+
+# --------------------------------------------------------------------------
+# registry
+# --------------------------------------------------------------------------
+
+class ModelRegistry:
+    """Versioned model store over an ArtifactStore base directory."""
+
+    def __init__(self, base_dir: str):
+        self.store = ArtifactStore(base_dir)
+        self.base_dir = self.store.base_dir
+
+    # ---- layout ----
+    def version_dir(self, name: str, version: int) -> str:
+        return self.store.path(name, f"v_{version:06d}")
+
+    def versions(self, name: str) -> List[int]:
+        """All committed (renamed-into-place) version numbers, ascending.
+        ``.tmp`` publishes in flight (or abandoned) are not versions."""
+        d = self.store.path(name)
+        if not os.path.isdir(d):
+            return []
+        out = []
+        for entry in os.listdir(d):
+            m = _VERSION_RE.match(entry)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def is_intact(self, name: str, version: int) -> bool:
+        """True when the version's meta.json parses, declares a known kind,
+        and arrays.npz opens (zip directory intact — a torn copy fails
+        here, same probe as core/checkpoint.is_intact)."""
+        d = self.version_dir(name, version)
+        try:
+            with open(os.path.join(d, META_FILE)) as fh:
+                meta = json.load(fh)
+            if meta.get("kind") not in KINDS:
+                return False
+            with np.load(os.path.join(d, ARRAYS_FILE)) as z:
+                z.files
+            return True
+        except Exception:
+            return False
+
+    def latest_version(self, name: str) -> Optional[int]:
+        """Newest INTACT version — a torn newest directory is skipped with
+        a warning so hot-swap reload never serves a half-written model."""
+        for v in reversed(self.versions(name)):
+            if self.is_intact(name, v):
+                return v
+            warnings.warn(
+                f"model {name!r} version {v} in {self.base_dir!r} is torn "
+                f"or unreadable; skipping it for serving", RuntimeWarning)
+        return None
+
+    # ---- publish ----
+    def publish(self, name: str, model: Any, *,
+                schema: Optional[FeatureSchema] = None,
+                kind: Optional[str] = None,
+                params: Optional[Dict[str, Any]] = None) -> int:
+        """Write the model as the next version and atomically commit it.
+        Returns the new version number.  Readers polling
+        :meth:`latest_version` pick it up on their next refresh — the
+        hot-swap contract."""
+        kind = kind or _detect_kind(model)
+        arrays, model_json, class_values = _encode(model, kind, schema)
+        versions = self.versions(name)
+        version = (versions[-1] + 1) if versions else 1
+        final = self.version_dir(name, version)
+        # single publisher per model name is the contract (multi-process
+        # jobs publish from process 0 only); the pid suffix just keeps an
+        # abandoned .tmp from a dead publisher out of a later one's way
+        tmp = final + f".tmp.{os.getpid()}"
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        meta = {
+            "format_version": FORMAT_VERSION,
+            "name": name,
+            "version": version,
+            "kind": kind,
+            "class_values": class_values,
+            "dtypes": {k: str(v.dtype) for k, v in arrays.items()},
+            "params": dict(params or {}),
+            "model_json": model_json,
+            "schema": schema.to_dict() if schema is not None else None,
+        }
+
+        def write_arrays():
+            fault_point("registry_publish")
+            np.savez(os.path.join(tmp, ARRAYS_FILE), **arrays)
+        with_retry(write_arrays, what=f"registry publish {name} v{version}")
+        write_json(os.path.join(tmp, META_FILE), meta)
+        os.replace(tmp, final)
+        return version
+
+    # ---- load ----
+    def load(self, name: str, version: Optional[int] = None,
+             schema: Optional[FeatureSchema] = None) -> LoadedModel:
+        """Reconstruct a model (+ its schema when the artifact carries one).
+        Default version: the newest intact one.  Dtype pins from the
+        artifact JSON are enforced — a payload whose arrays do not match
+        the dtypes recorded at publish time fails loudly instead of
+        serving subtly different predictions."""
+        if version is None:
+            version = self.latest_version(name)
+            if version is None:
+                raise FileNotFoundError(
+                    f"no intact versions of model {name!r} in "
+                    f"{self.base_dir!r}")
+        d = self.version_dir(name, version)
+        with open(os.path.join(d, META_FILE)) as fh:
+            meta = json.load(fh)
+        with np.load(os.path.join(d, ARRAYS_FILE)) as z:
+            arrays = {k: z[k] for k in z.files}
+        declared = meta.get("dtypes", {})
+        actual = {k: str(v.dtype) for k, v in arrays.items()}
+        if declared != actual:
+            raise ValueError(
+                f"model {name!r} v{version}: array dtypes {actual} do not "
+                f"match the artifact's declared {declared}")
+        if schema is None and meta.get("schema") is not None:
+            schema = FeatureSchema.from_dict(meta["schema"])
+        kind = meta["kind"]
+        model = _decode(kind, arrays, meta, schema)
+        return LoadedModel(name=name, version=version, kind=kind,
+                           model=model, meta=meta, schema=schema)
+
+
+# --------------------------------------------------------------------------
+# module-level conveniences
+# --------------------------------------------------------------------------
+
+def save_model(base_dir: str, name: str, model: Any, *,
+               schema: Optional[FeatureSchema] = None,
+               kind: Optional[str] = None,
+               params: Optional[Dict[str, Any]] = None) -> int:
+    return ModelRegistry(base_dir).publish(name, model, schema=schema,
+                                           kind=kind, params=params)
+
+
+def load_model(base_dir: str, name: str, version: Optional[int] = None,
+               schema: Optional[FeatureSchema] = None) -> LoadedModel:
+    return ModelRegistry(base_dir).load(name, version, schema=schema)

@@ -1,0 +1,388 @@
+"""Micro-batched prediction serving: the request loop over warm predictors.
+
+Single-row requests are coalesced into device batches under a
+max-latency/max-batch policy: the first queued request opens a batch window
+of ``max_wait_ms``; the batch closes when ``max_batch`` requests are
+queued or the window expires, whichever is first.  One bucketed predict
+then answers the whole batch — the device does per-request work at batch
+throughput while the slowest request waits at most one window plus one
+predict.
+
+Transports (same split as reinforce/serving.py, the bandit loop):
+
+  * in-process — ``submit()`` returns a future; a daemon worker thread
+    runs the coalescing loop.  Unit tests and embedded serving.
+  * the wire (:class:`RespPredictionLoop`) — RESP-list queues polled like
+    the reference's Redis spout (requests ``rpop``ed from the request
+    queue, predictions ``lpush``ed to the prediction queue), against
+    io/respq.RespServer or a real Redis, with the same config key style
+    (redis.server.host/port, redis.request.queue, redis.prediction.queue).
+
+Message formats (delim-joined, like the bandit loop's ``round,<n>``):
+  request:    'predict,<requestId>,<field0>,<field1>,...'  (a full record)
+  response:   '<requestId>,<predictedClass>'
+  control:    'reload' -> hot-swap to the registry's newest intact model
+              'stop'   -> end the wire loop (transport-level, like the
+                          bandit loop's stop)
+
+Operational hooks: per-request and per-batch latency recorded through
+utils/tracing.StepTimer percentile samples, request/batch counters in the
+core/metrics.Counters channel, transient predict errors retried via
+core/faults.with_retry.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+from ..core.faults import with_retry
+from ..core.metrics import Counters
+from ..utils.tracing import StepTimer
+from .predictor import AMBIGUOUS, DEFAULT_BUCKETS, Predictor, make_predictor
+from .registry import ModelRegistry
+
+
+@dataclass
+class BatchPolicy:
+    """Coalescing knobs: a batch closes at ``max_batch`` requests or
+    ``max_wait_ms`` after its first request, whichever comes first."""
+    max_batch: int = 64
+    max_wait_ms: float = 2.0
+
+
+class _Request:
+    __slots__ = ("row", "t_submit", "future")
+
+    def __init__(self, row: List[str]):
+        self.row = row
+        self.t_submit = time.perf_counter()
+        self.future: "Future[Optional[str]]" = Future()
+
+
+class PredictionService:
+    """The serving bolt: coalesce, predict, respond.
+
+    Construct either around a ready ``predictor`` or around a
+    ``registry`` + ``model_name`` (which enables :meth:`refresh` hot-swap:
+    publish a new version, send 'reload' or call refresh(), and the next
+    batch runs on it — torn versions are skipped by the registry)."""
+
+    def __init__(self, predictor: Optional[Predictor] = None, *,
+                 registry: Optional[ModelRegistry] = None,
+                 model_name: Optional[str] = None,
+                 schema=None,
+                 buckets: Sequence[int] = DEFAULT_BUCKETS,
+                 policy: Optional[BatchPolicy] = None,
+                 counters: Optional[Counters] = None,
+                 timer: Optional[StepTimer] = None,
+                 warm: bool = True,
+                 delim: str = ",",
+                 ambiguous_label: str = AMBIGUOUS,
+                 error_label: str = "error"):
+        if predictor is None and (registry is None or model_name is None):
+            raise ValueError("need a predictor, or registry= + model_name=")
+        self.registry = registry
+        self.model_name = model_name
+        self._schema = schema
+        self._buckets = tuple(buckets)
+        self.policy = policy or BatchPolicy()
+        self.counters = counters if counters is not None else Counters()
+        self.timer = timer if timer is not None else \
+            StepTimer(keep_samples=8192)
+        self._warm = warm
+        self.delim = delim
+        self.ambiguous_label = ambiguous_label
+        self.error_label = error_label
+        self.version: Optional[int] = None
+        self._swap_lock = threading.Lock()
+        if predictor is None:
+            predictor = self._load(must=True)
+        elif warm:
+            predictor.warm()
+        self.predictor = predictor
+        self._queue: "queue.Queue[_Request]" = queue.Queue()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- model lifecycle ----
+    def _load(self, must: bool = False) -> Optional[Predictor]:
+        latest = self.registry.latest_version(self.model_name)
+        if latest is None:
+            if must:
+                raise FileNotFoundError(
+                    f"no intact versions of {self.model_name!r} in "
+                    f"{self.registry.base_dir!r}")
+            return None
+        loaded = self.registry.load(self.model_name, latest)
+        pred = make_predictor(loaded, schema=self._schema,
+                              buckets=self._buckets, delim=self.delim)
+        if self._warm:
+            pred.warm()
+        self.version = latest
+        return pred
+
+    def refresh(self) -> bool:
+        """Hot-swap reload: if the registry holds a newer INTACT version,
+        build + warm its predictor off the request path and swap it in
+        atomically (in-flight batches finish on the old one).  Returns
+        whether a swap happened.  A half-written newest version is skipped
+        by the registry with a warning — serving stays on the current
+        model."""
+        if self.registry is None:
+            return False
+        latest = self.registry.latest_version(self.model_name)
+        if latest is None or latest == self.version:
+            return False
+        loaded = self.registry.load(self.model_name, latest)
+        pred = make_predictor(loaded, schema=self._schema,
+                              buckets=self._buckets, delim=self.delim)
+        if self._warm:
+            pred.warm()
+        with self._swap_lock:
+            self.predictor = pred
+            self.version = latest
+        self.counters.increment("Serving", "HotSwaps")
+        return True
+
+    # ---- prediction ----
+    def _label(self, pred: Optional[str]) -> str:
+        return pred if pred is not None else self.ambiguous_label
+
+    def predict_rows(self, rows: List[List[str]]) -> List[str]:
+        """One coalesced device batch for ``rows``, with transient-error
+        retry (a recoverable allocator/IO hiccup re-runs the batch rather
+        than failing every request in it)."""
+        with self._swap_lock:
+            pred = self.predictor
+        t0 = time.perf_counter()
+        out = with_retry(lambda: pred.predict_rows(rows),
+                         what="serving predict batch")
+        self.timer.record("serve.batch", time.perf_counter() - t0)
+        self.counters.increment("Serving", "Requests", len(rows))
+        self.counters.increment("Serving", "Batches")
+        return [self._label(p) for p in out]
+
+    def _predict_isolating(self, rows: List[List[str]]):
+        """('ok', label) | ('err', exc) per row.  The whole batch runs as
+        one launch when it is clean; if anything in it fails (e.g. a short
+        record or a non-numeric token blowing up encode_rows), fall back
+        to per-row isolation so one malformed request cannot take down the
+        batchmates drained off the queue alongside it.  The fallback
+        accounts as ONE isolated batch — per-row launches must not flood
+        the Batches count or the serve.batch samples operators tune
+        BatchPolicy with."""
+        import warnings
+        try:
+            return [("ok", lab) for lab in self.predict_rows(rows)]
+        except Exception as exc:
+            warnings.warn(
+                f"serving: batch predict failed ({type(exc).__name__}: "
+                f"{exc}); isolating per row", RuntimeWarning)
+        with self._swap_lock:
+            pred = self.predictor
+        t0 = time.perf_counter()
+        out = []
+        for row in rows:
+            try:
+                lab = with_retry(lambda r=row: pred.predict_rows([r]),
+                                 what="serving predict row")[0]
+                out.append(("ok", self._label(lab)))
+            except Exception as exc:
+                self.counters.increment("Serving", "BadRequests")
+                out.append(("err", exc))
+        self.timer.record("serve.batch", time.perf_counter() - t0)
+        self.counters.increment("Serving", "Requests", len(rows))
+        self.counters.increment("Serving", "Batches")
+        self.counters.increment("Serving", "IsolatedBatches")
+        return out
+
+    # ---- message contract (shared by both transports) ----
+    def process(self, message: str) -> Optional[str]:
+        """Bolt-execute for ONE message (the bandit loop's synchronous
+        contract); micro-batching callers use process_batch."""
+        return (self.process_batch([message]) or [None])[0]
+
+    def process_batch(self, messages: List[str]) -> List[str]:
+        """Coalesce a drained message batch: all predict messages run as
+        one device batch, response lines returned in arrival order.  A
+        malformed or unknown message is counted + warned and skipped — it
+        must not take down the valid requests already drained off the
+        queue alongside it (they cannot be re-queued).  A 'reload' in the
+        drain applies AFTER the batch is answered: the swap (and its
+        multi-bucket warm-up compiles) must not stall requests already
+        accepted, so the new model takes effect from the next batch."""
+        import warnings
+        ids: List[str] = []
+        rows: List[List[str]] = []
+        reload_requested = False
+        for message in messages:
+            parts = message.split(self.delim)
+            if parts[0] == "predict" and len(parts) >= 3:
+                ids.append(parts[1])
+                rows.append(parts[2:])
+            elif parts[0] == "reload":
+                reload_requested = True
+            else:
+                self.counters.increment("Serving", "BadRequests")
+                warnings.warn(f"serving: dropping malformed message "
+                              f"{message!r}", RuntimeWarning)
+        if reload_requested and not rows:
+            self.refresh()
+            return []
+        if not rows:
+            return []
+        t0 = time.perf_counter()
+        results = self._predict_isolating(rows)
+        dt = time.perf_counter() - t0
+        out = []
+        for rid, (status, val) in zip(ids, results):
+            self.timer.record("serve.request", dt)
+            lab = val if status == "ok" else self.error_label
+            out.append(f"{rid}{self.delim}{lab}")
+        if reload_requested:
+            self.refresh()
+        return out
+
+    # ---- in-process micro-batch loop ----
+    def submit(self, row) -> "Future[str]":
+        """Queue one record (tokenized row or delim-joined line); the
+        worker thread answers the future with the class label."""
+        if isinstance(row, str):
+            row = row.split(self.delim)
+        req = _Request(list(row))
+        self._queue.put(req)
+        return req.future
+
+    def start(self) -> "PredictionService":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, drain_s: float = 5.0) -> None:
+        """Stop the worker; queued requests are still served (bounded by
+        ``drain_s``) so no accepted request is dropped on shutdown."""
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout=max(drain_s, 0.1) + 5.0)
+        self._thread = None
+        deadline = time.monotonic() + drain_s
+        batch = []
+        while time.monotonic() < deadline:
+            try:
+                batch.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        if batch:
+            self._serve(batch)
+
+    def _loop(self) -> None:
+        pol = self.policy
+        while not self._stop.is_set():
+            try:
+                first = self._queue.get(timeout=0.02)
+            except queue.Empty:
+                continue
+            batch = [first]
+            # free coalescing first: whatever queued while the previous
+            # batch was on device joins this one with zero added wait
+            while len(batch) < pol.max_batch:
+                try:
+                    batch.append(self._queue.get_nowait())
+                except queue.Empty:
+                    break
+            # then hold the window open for stragglers — bounded by the
+            # FIRST request's age, so the policy's latency promise holds
+            # even when the window was already spent in the backlog
+            deadline = first.t_submit + pol.max_wait_ms / 1000.0
+            while len(batch) < pol.max_batch:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                try:
+                    batch.append(self._queue.get(timeout=remaining))
+                except queue.Empty:
+                    break
+            self._serve(batch)
+
+    def _serve(self, batch: List[_Request]) -> None:
+        results = self._predict_isolating([r.row for r in batch])
+        now = time.perf_counter()
+        for r, (status, val) in zip(batch, results):
+            if r.future.set_running_or_notify_cancel():
+                if status == "ok":
+                    self.timer.record("serve.request", now - r.t_submit)
+                    r.future.set_result(val)
+                else:  # answer with the error, don't wedge the waiter
+                    r.future.set_exception(val)
+        self.counters.set("Serving", "MaxBatchObserved",
+                          max(len(batch),
+                              self.counters.get("Serving",
+                                                "MaxBatchObserved")))
+
+
+class RespPredictionLoop:
+    """The serving loop over the wire: drain up to ``policy.max_batch``
+    requests from the request queue per poll (pipelined RPOPs — the wire
+    half of micro-batching), answer them as one device batch, ``lpush``
+    each response to the prediction queue.  Config keys mirror
+    reinforce/serving.RedisServingLoop: redis.server.host,
+    redis.server.port, redis.request.queue, redis.prediction.queue.  A
+    literal 'stop' on the request queue ends :meth:`run` after the
+    requests drained alongside it are answered (no accepted request is
+    dropped, like the bandit loop's reward drain on stop)."""
+
+    def __init__(self, service: PredictionService,
+                 config: Optional[Dict] = None):
+        from ..io.respq import RespClient
+        cfg = dict(config or {})
+        self.service = service
+        self.client = RespClient(cfg.get("redis.server.host", "127.0.0.1"),
+                                 int(cfg.get("redis.server.port", 6379)))
+        self.request_q = cfg.get("redis.request.queue", "requestQueue")
+        self.prediction_q = cfg.get("redis.prediction.queue",
+                                    "predictionQueue")
+        self.stopped = False
+
+    def poll_once(self) -> int:
+        """One spout pass; returns how many messages were consumed."""
+        msgs = self.client.rpop_many(self.request_q,
+                                     self.service.policy.max_batch)
+        if not msgs:
+            return 0
+        batch: List[str] = []
+        for m in msgs:
+            if m == "stop":
+                # requests drained in the same pipelined pop as the stop
+                # are already off the queue — they are still answered
+                # below (the bandit loop's drain-before-stop rule)
+                self.stopped = True
+            else:
+                batch.append(m)
+        if batch:
+            for resp in self.service.process_batch(batch):
+                self.client.lpush(self.prediction_q, resp)
+        return len(msgs)
+
+    def run(self, max_idle_s: float = 30.0,
+            idle_sleep_s: float = 0.002) -> None:
+        """Poll until a 'stop' message or ``max_idle_s`` without traffic."""
+        idle_since = time.monotonic()
+        while not self.stopped:
+            if self.poll_once():
+                idle_since = time.monotonic()
+            elif time.monotonic() - idle_since > max_idle_s:
+                break
+            else:
+                time.sleep(idle_sleep_s)
+
+    def close(self) -> None:
+        self.client.close()

@@ -18,7 +18,7 @@ from __future__ import annotations
 import contextlib
 import logging
 import time
-from collections import defaultdict
+from collections import defaultdict, deque
 from typing import Dict, Iterator, Optional
 
 import numpy as np
@@ -46,12 +46,20 @@ class StepTimer:
     >>> with t.step("train"):
     ...     ...
     >>> t.export(counters)   # Profiling/train.timeMs, Profiling/train.calls
-    """
 
-    def __init__(self, sync: bool = False):
+    ``keep_samples > 0`` additionally records per-call durations (a bounded
+    window of the most recent ``keep_samples`` per step name) so tail
+    latency is observable: serving percentiles (p50/p95/p99) would be
+    averaged away by ``mean_ms``.  Percentiles export as integer
+    MICROseconds (``<name>.p99Us``) — request latencies are routinely
+    sub-millisecond, where integer ms would round every quantile to 0."""
+
+    def __init__(self, sync: bool = False, keep_samples: int = 0):
         self.totals: Dict[str, float] = defaultdict(float)
         self.calls: Dict[str, int] = defaultdict(int)
         self.sync = sync
+        self.keep_samples = keep_samples
+        self.samples: Dict[str, deque] = {}
 
     @contextlib.contextmanager
     def step(self, name: str, *sync_arrays) -> Iterator[None]:
@@ -61,17 +69,46 @@ class StepTimer:
         finally:
             if self.sync and sync_arrays:
                 device_sync(*sync_arrays)
-            self.totals[name] += time.perf_counter() - t0
-            self.calls[name] += 1
+            self.record(name, time.perf_counter() - t0)
+
+    def record(self, name: str, seconds: float) -> None:
+        """Account one completed step of ``seconds`` wall time — the
+        non-context-manager entry for durations measured elsewhere (e.g. a
+        serving request whose start and finish happen on different
+        threads)."""
+        self.totals[name] += seconds
+        self.calls[name] += 1
+        if self.keep_samples > 0:
+            q = self.samples.get(name)
+            if q is None:
+                q = self.samples[name] = deque(maxlen=self.keep_samples)
+            q.append(seconds)
 
     def mean_ms(self, name: str) -> float:
         c = self.calls.get(name, 0)
         return (self.totals[name] / c * 1000.0) if c else 0.0
 
+    def percentile_ms(self, name: str, q: float) -> float:
+        """q-th percentile (0-100, linear interpolation) of the recorded
+        sample window in milliseconds; 0.0 when nothing is recorded."""
+        s = self.samples.get(name)
+        if not s:
+            return 0.0
+        return float(np.percentile(np.asarray(s), q)) * 1000.0
+
+    def percentiles_ms(self, name: str,
+                       qs=(50.0, 95.0, 99.0)) -> Dict[float, float]:
+        return {q: self.percentile_ms(name, q) for q in qs}
+
     def export(self, counters, group: str = "Profiling") -> None:
         for name, total in sorted(self.totals.items()):
             counters.set(group, f"{name}.timeMs", int(round(total * 1000)))
             counters.set(group, f"{name}.calls", self.calls[name])
+            if self.samples.get(name):
+                for q in (50, 95, 99):
+                    counters.set(
+                        group, f"{name}.p{q}Us",
+                        int(round(self.percentile_ms(name, q) * 1000)))
 
     def summary(self) -> str:
         return "; ".join(

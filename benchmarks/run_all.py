@@ -168,12 +168,77 @@ def bench_logistic(scale):
             "value": round(n * iters / dt, 1), "n_rows": n, "iters": iters}
 
 
+def bench_serve_forest(scale):
+    """Online forest serving: micro-batched request loop throughput and
+    latency percentiles at several offered loads (plus a closed-loop pass
+    for the ceiling).  Requests are single records submitted one at a
+    time — the coalescing window and the warm shape-bucketed jits are
+    what is being measured, not batch predict."""
+    _force_platform()
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "resource"))
+    from gen.call_hangup_gen import generate
+    from avenir_tpu.core.schema import FeatureSchema
+    from avenir_tpu.core.table import load_csv_text
+    from avenir_tpu.models.forest import ForestParams, build_forest
+    from avenir_tpu.parallel.mesh import MeshContext
+    from avenir_tpu.serving.predictor import ForestPredictor
+    from avenir_tpu.serving.service import BatchPolicy, PredictionService
+    from avenir_tpu.utils.tracing import StepTimer
+    schema = FeatureSchema.load(os.path.join(
+        os.path.dirname(__file__), "..", "resource", "call_hangup.json"))
+    n_train = max(int(20_000 * scale), 500)
+    rows = [line.split(",") for line in generate(n_train + 4096, 1)]
+    table = load_csv_text(
+        "\n".join(",".join(r) for r in rows[:n_train]), schema)
+    params = ForestParams(num_trees=5, seed=1)
+    params.tree.max_depth = 4
+    models = build_forest(table, params, MeshContext())
+    predictor = ForestPredictor(models, schema).warm()
+    svc = PredictionService(predictor, warm=False,
+                            policy=BatchPolicy(max_batch=64,
+                                               max_wait_ms=2.0))
+    svc.start()
+    req_rows = rows[n_train:]
+    n_req = max(int(2_000 * scale), 200)
+
+    def one_load(offered):
+        """offered requests/sec (0 = closed loop: submit as fast as the
+        loop accepts)."""
+        svc.timer = StepTimer(keep_samples=1 << 16)
+        futures = []
+        t0 = time.perf_counter()
+        for i in range(n_req):
+            if offered:
+                target = t0 + i / offered
+                while True:
+                    now = time.perf_counter()
+                    if now >= target:
+                        break
+                    time.sleep(min(target - now, 0.001))
+            futures.append(svc.submit(req_rows[i % len(req_rows)]))
+        for f in futures:
+            f.result(timeout=120)
+        dt = time.perf_counter() - t0
+        return {"offered_req_per_sec": offered or "max",
+                "throughput_req_per_sec": round(n_req / dt, 1),
+                "p50_ms": round(svc.timer.percentile_ms("serve.request", 50), 3),
+                "p99_ms": round(svc.timer.percentile_ms("serve.request", 99), 3)}
+
+    one_load(0)  # warm the submit/coalesce path itself
+    loads = [one_load(off) for off in (0, 2000, 500)]
+    svc.stop()
+    return {"metric": "serve_forest_peak_req_per_sec",
+            "value": loads[0]["throughput_req_per_sec"],
+            "n_requests": n_req, "trees": len(models), "loads": loads}
+
+
 BENCHES = {
     "naive_bayes": bench_naive_bayes,
     "random_forest": bench_random_forest,
     "knn": bench_knn,
     "sa": bench_sa,
     "logistic": bench_logistic,
+    "serve_forest": bench_serve_forest,
 }
 
 

@@ -28,6 +28,59 @@ def test_step_timer_accumulates_and_exports():
     assert "work" in t.summary()
 
 
+def test_step_timer_percentiles_from_recorded_samples():
+    t = StepTimer(keep_samples=1000)
+    for ms in range(1, 101):               # 1..100 ms
+        t.record("req", ms / 1000.0)
+    assert t.calls["req"] == 100
+    # numpy linear-interpolation percentiles over the sample window
+    assert t.percentile_ms("req", 50) == pytest.approx(50.5)
+    assert t.percentile_ms("req", 95) == pytest.approx(95.05)
+    assert t.percentile_ms("req", 99) == pytest.approx(99.01)
+    assert t.percentiles_ms("req") == {
+        50.0: pytest.approx(50.5), 95.0: pytest.approx(95.05),
+        99.0: pytest.approx(99.01)}
+    c = Counters()
+    t.export(c)
+    # exported as integer MICROseconds so sub-ms tails survive
+    assert c.get("Profiling", "req.p50Us") == 50500
+    assert c.get("Profiling", "req.p95Us") == 95050
+    assert c.get("Profiling", "req.p99Us") == 99010
+    # p50 and the mean tell different stories under a skewed tail
+    t.record("req", 10.0)
+    assert t.mean_ms("req") > t.percentile_ms("req", 50)
+
+
+def test_step_timer_sample_window_is_bounded():
+    t = StepTimer(keep_samples=10)
+    for ms in range(1, 101):
+        t.record("req", ms / 1000.0)
+    # only the most recent 10 samples (91..100 ms) back the percentiles
+    assert len(t.samples["req"]) == 10
+    assert t.percentile_ms("req", 50) == pytest.approx(95.5)
+    # totals/calls still account every call
+    assert t.calls["req"] == 100
+
+
+def test_step_timer_step_context_records_samples():
+    t = StepTimer(keep_samples=16)
+    with t.step("work"):
+        time.sleep(0.005)
+    assert len(t.samples["work"]) == 1
+    assert t.percentile_ms("work", 50) >= 5.0
+
+
+def test_step_timer_without_samples_keeps_legacy_export():
+    t = StepTimer()                        # keep_samples=0: no window
+    with t.step("work"):
+        pass
+    assert t.percentile_ms("work", 99) == 0.0
+    c = Counters()
+    t.export(c)
+    assert "work.p99Us" not in c.as_dict().get("Profiling", {})
+    assert c.get("Profiling", "work.calls") == 1
+
+
 def test_trace_noop_without_dir():
     with trace(None) as active:
         assert active is False

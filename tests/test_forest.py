@@ -307,9 +307,9 @@ def test_ensemble_fused_device_vote_matches_host(mesh_ctx):
         ens = EnsembleModel(models, **kwargs)
         assert ens._stacked is not None
         from avenir_tpu.models.tree import FeatureCache
-        cache = FeatureCache()
-        vals, codes = cache.host(models[0].matrix, table)
-        dev = ens._predict_device(vals, codes, cache)
+        inputs = ens.device_inputs(table)
+        assert inputs is not None
+        dev = ens._predict_device(*inputs)
         host = ens._predict_host(table, FeatureCache())
         assert dev == host, f"mismatch for {kwargs}"
     # fractional weights must take the f64 host path (f32 vote sums could

@@ -59,6 +59,7 @@ EXPECTED = {
     "org.avenir.reinforce.GreedyRandomBandit": "greedy_random_bandit",
     "org.avenir.reinforce.RandomFirstGreedyBandit": "random_first_greedy_bandit",
     "org.avenir.reinforce.SoftMaxBandit": "soft_max_bandit",
+    "org.avenir.serving.PredictionService": "prediction_service",
     "org.avenir.sequence.CandidateGenerationWithSelfJoin":
         "candidate_generation_with_self_join",
     "org.avenir.sequence.SequencePositionalCluster":

@@ -4,6 +4,8 @@ contract: RedisSpout.java rpop polling, RedisActionWriter.java lpush)."""
 import os
 import subprocess
 import sys
+import threading
+import time
 
 from avenir_tpu.io.respq import RespClient, RespServer
 from avenir_tpu.reinforce.serving import (RedisServingLoop,
@@ -38,6 +40,85 @@ def test_resp_roundtrip():
         assert c3.rpop("shared") == "1"
         c2.close()
         c3.close()
+    finally:
+        server.stop()
+
+
+def test_multi_client_stress_no_loss_no_duplication():
+    """N producer threads lpush while N consumer threads rpop the same
+    queue concurrently: every message arrives exactly once.  The serving
+    loop leans on this server far harder than the bandit loop (pipelined
+    rpop_many under producer concurrency), so the queue's locking is
+    pinned here, not assumed."""
+    server = RespServer().start()
+    n_prod = n_cons = 6
+    per_prod = 250
+    expected = {f"p{p}-{i}" for p in range(n_prod) for i in range(per_prod)}
+    got = []
+    got_lock = threading.Lock()
+    stop = threading.Event()
+
+    def producer(p):
+        cli = RespClient(port=server.port)
+        for i in range(per_prod):
+            cli.lpush("q", f"p{p}-{i}")
+        cli.close()
+
+    def consumer(use_pipeline):
+        cli = RespClient(port=server.port)
+        while not stop.is_set():
+            # half the consumers drain with the serving loop's pipelined
+            # rpop_many, half with single rpop — both against the same list
+            vals = cli.rpop_many("q", 16) if use_pipeline else \
+                [v for v in [cli.rpop("q")] if v is not None]
+            if vals:
+                with got_lock:
+                    got.extend(vals)
+            else:
+                time.sleep(0.001)
+        cli.close()
+
+    producers = [threading.Thread(target=producer, args=(p,))
+                 for p in range(n_prod)]
+    consumers = [threading.Thread(target=consumer, args=(c % 2 == 0,))
+                 for c in range(n_cons)]
+    try:
+        for t in consumers + producers:
+            t.start()
+        for t in producers:
+            t.join(timeout=60)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            with got_lock:
+                if len(got) >= len(expected):
+                    break
+            time.sleep(0.005)
+        stop.set()
+        for t in consumers:
+            t.join(timeout=10)
+        # no loss, no duplication, nothing left behind
+        assert len(got) == len(expected), \
+            f"{len(got)} consumed vs {len(expected)} produced"
+        assert set(got) == expected
+        probe = RespClient(port=server.port)
+        assert probe.llen("q") == 0
+        probe.close()
+    finally:
+        stop.set()
+        server.stop()
+
+
+def test_rpop_many_pipelined_drain():
+    server = RespServer().start()
+    try:
+        cli = RespClient(port=server.port)
+        assert cli.rpop_many("q", 4) == []
+        for i in range(10):
+            cli.lpush("q", str(i))
+        assert cli.rpop_many("q", 4) == ["0", "1", "2", "3"]
+        assert cli.rpop_many("q", 64) == [str(i) for i in range(4, 10)]
+        assert cli.rpop_many("q", 0) == []
+        cli.close()
     finally:
         server.stop()
 

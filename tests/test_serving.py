@@ -1,0 +1,656 @@
+"""Online prediction serving: registry round-trips, bucketed-jit compile
+pinning, micro-batch loop, RESP wire transport, hot-swap reload.
+
+The contract under test (ISSUE 3): save→load→predict bit-identical to the
+in-memory model for all four families; one XLA compile per shape bucket;
+coalesced responses identical to the offline batch predict; torn registry
+versions never served."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core.config import Config
+from avenir_tpu.core.schema import FeatureSchema
+from avenir_tpu.core.table import encode_rows
+from avenir_tpu.serving.registry import ModelRegistry
+from avenir_tpu.serving.predictor import (BayesPredictor, ForestPredictor,
+                                          LogisticPredictor, MLPPredictor,
+                                          make_predictor)
+from avenir_tpu.serving.service import (BatchPolicy, PredictionService,
+                                        RespPredictionLoop)
+from tests.test_tree import SCHEMA, make_table
+
+pytestmark = pytest.mark.serving
+
+
+# --------------------------------------------------------------------------
+# helpers
+# --------------------------------------------------------------------------
+
+def raw_rows_of(table, n):
+    """First n records of a test_tree table re-rendered as token rows."""
+    f1 = SCHEMA.find_field_by_ordinal(1).cardinality
+    f2 = SCHEMA.find_field_by_ordinal(2).cardinality
+    f4 = SCHEMA.find_field_by_ordinal(4).cardinality
+    return [[table.str_columns[0][r], f1[table.columns[1][r]],
+             f2[table.columns[2][r]], str(int(table.columns[3][r])),
+             f4[table.columns[4][r]]] for r in range(n)]
+
+
+def small_forest(mesh_ctx, n=500, trees=5, seed=3, depth=3):
+    from avenir_tpu.models.forest import ForestParams, build_forest
+    table = make_table(n, seed=seed)
+    params = ForestParams(num_trees=trees, seed=seed)
+    params.tree.max_depth = depth
+    return table, build_forest(table, params, mesh_ctx)
+
+
+def forest_batch_predict(models, table):
+    from avenir_tpu.models.forest import EnsembleModel
+    from avenir_tpu.models.tree import DecisionTreeModel
+    ens = EnsembleModel([DecisionTreeModel(m, SCHEMA) for m in models])
+    return ens.predict(table)
+
+
+# --------------------------------------------------------------------------
+# registry round-trips (save -> load -> predict bit-identical)
+# --------------------------------------------------------------------------
+
+def test_registry_roundtrip_forest(tmp_path, mesh_ctx):
+    table, models = small_forest(mesh_ctx)
+    reg = ModelRegistry(str(tmp_path))
+    v = reg.publish("churn", models, schema=SCHEMA)
+    assert v == 1
+    loaded = reg.load("churn")
+    assert loaded.kind == "forest" and loaded.version == 1
+    # model bytes identical...
+    assert [m.to_json() for m in loaded.model] == \
+        [m.to_json() for m in models]
+    # ...and the loaded schema reconstructs the original exactly
+    assert loaded.schema == SCHEMA
+    # predictions through the serving predictor == offline ensemble
+    rows = raw_rows_of(table, 50)
+    pred = make_predictor(loaded, buckets=(8, 64))
+    expect = forest_batch_predict(models, encode_rows(rows, SCHEMA))
+    assert pred.predict_rows(rows) == expect
+
+
+def test_registry_roundtrip_bayes(tmp_path, mesh_ctx):
+    from avenir_tpu.models import bayes
+    from tests.test_bayes import SCHEMA as BSCHEMA, make_rows
+    rng = np.random.default_rng(7)
+    rows = make_rows(rng, 300)
+    table = encode_rows(rows, BSCHEMA)
+    model = bayes.train(table, mesh_ctx)
+    reg = ModelRegistry(str(tmp_path))
+    reg.publish("nb", model, schema=BSCHEMA)
+    loaded = reg.load("nb")
+    assert loaded.kind == "bayes"
+    m2 = loaded.model
+    for attr in ("post_counts", "class_counts", "prior_counts",
+                 "cont_post_mean", "cont_post_std", "cont_prior_mean",
+                 "cont_prior_std"):
+        a, b = getattr(model, attr), getattr(m2, attr)
+        assert a.dtype == b.dtype and np.array_equal(a, b), attr
+    assert m2.class_values == model.class_values
+    assert m2.total == model.total
+    r1 = bayes.predict(model, table, mesh_ctx)
+    r2 = bayes.predict(m2, table, mesh_ctx)
+    assert r1.pred_class == r2.pred_class
+    np.testing.assert_array_equal(r1.pred_prob, r2.pred_prob)
+    # and through the bucketed serving predictor
+    pred = BayesPredictor(m2, ctx=mesh_ctx, buckets=(8, 64))
+    assert pred.predict_rows(rows[:20]) == r1.pred_class[:20]
+
+
+LR_SCHEMA = FeatureSchema.from_dict({"fields": [
+    {"name": "x1", "ordinal": 0, "dataType": "double", "feature": True},
+    {"name": "x2", "ordinal": 1, "dataType": "double", "feature": True},
+    {"name": "y", "ordinal": 2, "dataType": "categorical",
+     "cardinality": ["n", "p"]}]})
+
+
+def _lr_data(n=400, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 2))
+    yb = (X.sum(axis=1) + rng.normal(0, 0.5, n)) > 0
+    rows = [[f"{a:.4f}", f"{b:.4f}", "p" if c else "n"]
+            for (a, b), c in zip(X, yb)]
+    return rows, encode_rows(rows, LR_SCHEMA)
+
+
+def test_registry_roundtrip_logistic(tmp_path):
+    from avenir_tpu.regress.logistic import LogisticParams, LogisticTrainer
+    rows, table = _lr_data()
+    params = LogisticParams(pos_class_value="p", iteration_limit=8)
+    trainer = LogisticTrainer(LR_SCHEMA, params)
+    w, _, _ = trainer.train(table, [])
+    reg = ModelRegistry(str(tmp_path))
+    reg.publish("lr", w, kind="logistic", schema=LR_SCHEMA,
+                params={"pos_class_value": "p"})
+    loaded = reg.load("lr")
+    assert loaded.kind == "logistic"
+    assert loaded.model.dtype == w.dtype
+    np.testing.assert_array_equal(loaded.model, w)
+    pred = make_predictor(loaded, buckets=(8, 64))
+    codes = trainer.predict(table, w)
+    card = LR_SCHEMA.class_attr_field.cardinality
+    expect = [card[int(c)] for c in codes]
+    assert pred.predict_rows(rows) == expect
+    # probabilities identical to the trainer's predict_proba
+    np.testing.assert_array_equal(
+        pred.predict_proba_rows(rows[:8]),
+        trainer.predict_proba(encode_rows(rows[:8], LR_SCHEMA), w))
+
+
+def test_registry_roundtrip_mlp(tmp_path):
+    from avenir_tpu.nn import mlp
+    rows, table = _lr_data(200, seed=1)
+    X = table.feature_matrix(dtype=np.float32)
+    y = np.asarray(table.class_codes()).astype(np.int32)
+    cfg = mlp.MLPConfig(hidden_dim=4, n_classes=2, iterations=60, seed=2)
+    params, _ = mlp.train(X, y, cfg)
+    reg = ModelRegistry(str(tmp_path))
+    reg.publish("net", {k: np.asarray(v) for k, v in params.items()},
+                schema=LR_SCHEMA)
+    loaded = reg.load("net")
+    assert loaded.kind == "mlp"
+    for k in ("W1", "b1", "W2", "b2"):
+        a = np.asarray(params[k])
+        assert loaded.model[k].dtype == a.dtype
+        np.testing.assert_array_equal(loaded.model[k], a)
+    pred = make_predictor(loaded, buckets=(8, 64))
+    idx = np.asarray(mlp.predict(params, X))
+    card = LR_SCHEMA.class_attr_field.cardinality
+    assert pred.predict_rows(rows) == [card[i] for i in idx]
+
+
+def test_registry_meta_pins_dtypes_and_class_order(tmp_path):
+    reg = ModelRegistry(str(tmp_path))
+    w = np.arange(3, dtype=np.float64)
+    reg.publish("lr", w, kind="logistic", schema=LR_SCHEMA,
+                params={"pos_class_value": "p"})
+    meta_path = os.path.join(reg.version_dir("lr", 1), "meta.json")
+    with open(meta_path) as fh:
+        meta = json.load(fh)
+    # the artifact JSON pins both contracts explicitly
+    assert meta["dtypes"] == {"w": "float64"}
+    assert meta["class_values"] == ["n", "p"]
+    # a dtype-mismatched payload is refused, not silently served
+    meta["dtypes"] = {"w": "float32"}
+    with open(meta_path, "w") as fh:
+        json.dump(meta, fh)
+    with pytest.raises(ValueError, match="dtypes"):
+        reg.load("lr", 1)
+
+
+def test_registry_versions_and_torn_skip(tmp_path, mesh_ctx):
+    _, models = small_forest(mesh_ctx, n=200, trees=3, depth=2)
+    reg = ModelRegistry(str(tmp_path))
+    assert reg.latest_version("churn") is None
+    assert reg.publish("churn", models, schema=SCHEMA) == 1
+    assert reg.publish("churn", models[:1], schema=SCHEMA) == 2
+    assert reg.versions("churn") == [1, 2]
+    assert reg.latest_version("churn") == 2
+    # a torn newest version (crash mid-publish copied in a half dir) is
+    # skipped with a warning; load() serves the newest INTACT one
+    torn = reg.version_dir("churn", 3)
+    os.makedirs(torn)
+    with open(os.path.join(torn, "meta.json"), "w") as fh:
+        fh.write('{"kind": "forest", "trunc')
+    with pytest.warns(RuntimeWarning, match="torn"):
+        assert reg.latest_version("churn") == 2
+    with pytest.warns(RuntimeWarning, match="torn"):
+        assert reg.load("churn").version == 2
+    # an in-flight .tmp publish is not a version at all
+    os.makedirs(reg.version_dir("churn", 4) + ".tmp")
+    assert reg.versions("churn") == [1, 2, 3]
+
+
+# --------------------------------------------------------------------------
+# bucketed jit: one compile per bucket
+# --------------------------------------------------------------------------
+
+def test_bucketed_jit_forest_single_compile(mesh_ctx):
+    table, models = small_forest(mesh_ctx, n=300, trees=3, depth=2)
+    pred = ForestPredictor(models, SCHEMA, buckets=(8, 64))
+    rows = raw_rows_of(table, 40)
+    assert pred.compile_count == 0
+    # two different request sizes inside ONE bucket -> exactly one compile
+    pred.predict_rows(rows[:3])
+    assert pred.compile_count == 1
+    pred.predict_rows(rows[:5])
+    assert pred.compile_count == 1
+    # crossing into the next bucket compiles once more
+    pred.predict_rows(rows[:20])
+    assert pred.compile_count == 2
+    # oversized batches chunk into top-bucket launches: no new shape
+    pred.predict_rows(rows + rows + rows)   # 120 rows > top bucket 64
+    assert pred.compile_count == 2
+
+
+def test_bucketed_jit_warm_precompiles(mesh_ctx):
+    table, models = small_forest(mesh_ctx, n=300, trees=3, depth=2)
+    pred = ForestPredictor(models, SCHEMA, buckets=(8, 64)).warm()
+    assert pred.compile_count == 2          # one per bucket, at load time
+    pred.predict_rows(raw_rows_of(table, 50))
+    assert pred.compile_count == 2          # traffic never compiles
+
+
+def test_bucketed_jit_logistic_single_compile():
+    rows, _ = _lr_data(100)
+    w = np.array([0.1, 1.0, -0.5])
+    pred = LogisticPredictor(w, LR_SCHEMA, "p", buckets=(8, 64))
+    pred.predict_rows(rows[:2])
+    pred.predict_rows(rows[:7])
+    assert pred.compile_count == 1
+    pred.predict_rows(rows[:30])
+    assert pred.compile_count == 2
+
+
+def test_forest_predictor_matches_batch_interleaved(mesh_ctx):
+    table, models = small_forest(mesh_ctx)
+    rows = raw_rows_of(table, 80)
+    expect = forest_batch_predict(models, encode_rows(rows, SCHEMA))
+    pred = ForestPredictor(models, SCHEMA, buckets=(1, 8, 64)).warm()
+    got = []
+    i = 0
+    for size in (1, 3, 1, 7, 20, 1, 47):   # interleaved request sizes
+        got.extend(pred.predict_rows(rows[i:i + size]))
+        i += size
+    assert got == expect[:i]
+
+
+def test_single_tree_predictor_matches_model(mesh_ctx):
+    table, models = small_forest(mesh_ctx, n=200, trees=1, depth=2)
+    from avenir_tpu.models.tree import DecisionTreeModel
+    rows = raw_rows_of(table, 30)
+    expect, _ = DecisionTreeModel(models[0], SCHEMA).predict(
+        encode_rows(rows, SCHEMA))
+    pred = ForestPredictor(models, SCHEMA, buckets=(8, 64))
+    assert pred.predict_rows(rows) == list(expect)
+
+
+# --------------------------------------------------------------------------
+# micro-batched service
+# --------------------------------------------------------------------------
+
+def test_service_coalesces_and_matches(mesh_ctx):
+    table, models = small_forest(mesh_ctx, n=400, trees=3, depth=2)
+    rows = raw_rows_of(table, 120)
+    expect = forest_batch_predict(models, encode_rows(rows, SCHEMA))
+    pred = ForestPredictor(models, SCHEMA, buckets=(8, 64)).warm()
+    svc = PredictionService(pred, warm=False,
+                            policy=BatchPolicy(max_batch=32,
+                                               max_wait_ms=5.0))
+    svc.start()
+    futures = [svc.submit(row) for row in rows]
+    got = [f.result(timeout=60) for f in futures]
+    svc.stop()
+    assert got == expect
+    c = svc.counters
+    assert c.get("Serving", "Requests") == 120
+    # the loop actually coalesced (fewer batches than requests)
+    assert 0 < c.get("Serving", "Batches") < 120
+    assert c.get("Serving", "MaxBatchObserved") > 1
+    # latency percentiles are recorded and exported, not averaged away
+    assert svc.timer.percentile_ms("serve.request", 99) >= \
+        svc.timer.percentile_ms("serve.request", 50) > 0.0
+    svc.timer.export(c, group="Serving")
+    assert c.get("Serving", "serve.request.p99Us") >= \
+        c.get("Serving", "serve.request.p50Us") > 0
+
+
+def test_service_threaded_submitters_interleaved(mesh_ctx):
+    table, models = small_forest(mesh_ctx, n=300, trees=3, depth=2)
+    rows = raw_rows_of(table, 60)
+    expect = forest_batch_predict(models, encode_rows(rows, SCHEMA))
+    pred = ForestPredictor(models, SCHEMA, buckets=(8, 64)).warm()
+    svc = PredictionService(pred, warm=False).start()
+    results = {}
+
+    def client(lo, hi):
+        futs = [(i, svc.submit(rows[i])) for i in range(lo, hi)]
+        for i, f in futs:
+            results[i] = f.result(timeout=60)
+
+    threads = [threading.Thread(target=client, args=(lo, lo + 20))
+               for lo in (0, 20, 40)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    svc.stop()
+    assert [results[i] for i in range(60)] == expect
+
+
+def test_service_hot_swap_reload(tmp_path, mesh_ctx):
+    table, m1 = small_forest(mesh_ctx, n=300, trees=3, seed=3, depth=2)
+    _, m2 = small_forest(mesh_ctx, n=300, trees=3, seed=11, depth=2)
+    rows = raw_rows_of(table, 30)
+    req_table = encode_rows(rows, SCHEMA)
+    reg = ModelRegistry(str(tmp_path))
+    reg.publish("churn", m1, schema=SCHEMA)
+    svc = PredictionService(registry=reg, model_name="churn",
+                            buckets=(8, 64))
+    def as_labels(preds):
+        return [p if p is not None else svc.ambiguous_label for p in preds]
+
+    assert svc.version == 1
+    assert svc.predict_rows(rows) == \
+        as_labels(forest_batch_predict(m1, req_table))
+    # no newer version -> no swap
+    assert svc.refresh() is False
+    # publish v2 and hot-swap to it
+    reg.publish("churn", m2, schema=SCHEMA)
+    assert svc.refresh() is True and svc.version == 2
+    assert svc.predict_rows(rows) == \
+        as_labels(forest_batch_predict(m2, req_table))
+    assert svc.counters.get("Serving", "HotSwaps") == 1
+    # a torn v3 is skipped: serving stays on v2 with a warning
+    torn = reg.version_dir("churn", 3)
+    os.makedirs(torn)
+    with open(os.path.join(torn, "meta.json"), "w") as fh:
+        fh.write("not json")
+    with pytest.warns(RuntimeWarning, match="torn"):
+        assert svc.refresh() is False
+    assert svc.version == 2
+    # the 'reload' control message drives the same path (v4 is intact and
+    # newest, so the torn v3 is never even probed)
+    reg.publish("churn", m1, schema=SCHEMA)   # v4 (intact)
+    assert svc.process("reload") is None
+    assert svc.version == 4
+
+
+# --------------------------------------------------------------------------
+# end to end: CLI-trained forest -> registry -> service (both transports)
+# --------------------------------------------------------------------------
+
+def _train_forest_via_cli(tmp_path, reg_dir):
+    """The existing randomForestBuilder CLI job, publishing to the
+    registry via dtb.model.registry.dir."""
+    from avenir_tpu.cli.jobs import random_forest_builder
+    table = make_table(400, seed=9)
+    csv = tmp_path / "train.csv"
+    with open(csv, "w") as fh:
+        for r in raw_rows_of(table, table.n_rows):
+            fh.write(",".join(r) + "\n")
+    schema_path = tmp_path / "schema.json"
+    schema_path.write_text(json.dumps(SCHEMA.to_dict()))
+    out_dir = tmp_path / "forest_out"
+    cfg = Config({
+        "field.delim.regex": ",", "field.delim.out": ",",
+        "dtb.feature.schema.file.path": str(schema_path),
+        "dtb.num.trees": "5", "dtb.random.seed": "7",
+        "dtb.max.depth.limit": "3",
+        "dtb.path.stopping.strategy": "maxDepth",
+        "dtb.model.registry.dir": str(reg_dir),
+        "dtb.model.name": "churn",
+    })
+    counters = random_forest_builder(cfg, str(csv), str(out_dir))
+    assert counters.get("Random forest", "Trees") == 5
+    assert counters.get("Random forest", "RegistryVersion") == 1
+    from avenir_tpu.models.tree import DecisionPathList
+    trees = []
+    for i in range(5):
+        with open(out_dir / f"tree_{i}.json") as fh:
+            trees.append(DecisionPathList.from_json(fh.read()))
+    return schema_path, trees
+
+
+def test_e2e_cli_train_registry_resp_serving(tmp_path, mesh_ctx):
+    """ISSUE 3 acceptance: train via the existing CLI job, save through
+    the registry, serve over BOTH transports, and pin that every response
+    matches the offline forest predict exactly."""
+    from avenir_tpu.io.respq import RespClient, RespServer
+    reg_dir = tmp_path / "registry"
+    _, trees = _train_forest_via_cli(tmp_path, reg_dir)
+    req_rows = raw_rows_of(make_table(64, seed=21), 64)
+    expect = forest_batch_predict(trees, encode_rows(req_rows, SCHEMA))
+    reg = ModelRegistry(str(reg_dir))
+    svc = PredictionService(registry=reg, model_name="churn",
+                            buckets=(8, 64),
+                            policy=BatchPolicy(max_batch=16,
+                                               max_wait_ms=2.0))
+    # -- in-process transport, interleaved single-row submits
+    svc.start()
+    futures = [svc.submit(row) for row in req_rows[:32]]
+    got = [f.result(timeout=60) for f in futures]
+    svc.stop()
+    assert got == expect[:32]
+    # -- RESP wire transport, same service, reference queue conventions
+    server = RespServer().start()
+    try:
+        loop = RespPredictionLoop(svc, {"redis.server.port": server.port})
+        cli = RespClient(port=server.port)
+        for i, row in enumerate(req_rows):
+            cli.lpush("requestQueue", ",".join(["predict", str(i)] + row))
+        cli.lpush("requestQueue", "stop")
+        loop.run(max_idle_s=5.0)
+        assert loop.stopped
+        by_id = {}
+        while True:
+            v = cli.rpop("predictionQueue")
+            if v is None:
+                break
+            rid, label = v.split(",", 1)
+            by_id[int(rid)] = label
+        loop.close()
+        cli.close()
+    finally:
+        server.stop()
+    assert [by_id[i] for i in range(64)] == expect
+
+
+def test_prediction_service_cli_job(tmp_path, mesh_ctx):
+    """The predictionService job end to end, both transports, via the
+    job registry (reference-style config keys)."""
+    from avenir_tpu.cli import serving_jobs  # noqa: F401  (registers the job)
+    from avenir_tpu.cli.jobs import resolve
+    reg_dir = tmp_path / "registry"
+    schema_path, trees = _train_forest_via_cli(tmp_path, reg_dir)
+    req_rows = raw_rows_of(make_table(40, seed=33), 40)
+    expect = forest_batch_predict(trees, encode_rows(req_rows, SCHEMA))
+    req_path = tmp_path / "requests.csv"
+    req_path.write_text("\n".join(",".join(r) for r in req_rows) + "\n")
+    job = resolve("predictionService")
+    for transport in ("inprocess", "resp"):
+        out_dir = tmp_path / f"out_{transport}"
+        cfg = Config({
+            "field.delim.regex": ",", "field.delim.out": ",",
+            "ps.model.registry.dir": str(reg_dir),
+            "ps.model.name": "churn",
+            "ps.feature.schema.file.path": str(schema_path),
+            "ps.batch.max.size": "16", "ps.batch.max.wait.ms": "2",
+            "ps.bucket.sizes": "8,64",
+            "ps.transport": transport,
+        })
+        counters = job(cfg, str(req_path), str(out_dir))
+        with open(out_dir / "part-m-00000") as fh:
+            lines = fh.read().splitlines()
+        assert [ln.split(",", 1)[1] for ln in lines] == expect
+        assert counters.get("Serving", "Requests") == 40
+        assert counters.get("Serving", "ModelVersion") == 1
+        assert counters.get("Serving", "serve.request.p99Us") > 0
+
+
+def test_malformed_message_does_not_drop_the_batch(mesh_ctx):
+    """A stray bad message drained alongside valid requests is counted
+    and skipped — the valid requests (already off the queue) still get
+    answers."""
+    table, models = small_forest(mesh_ctx, n=200, trees=3, depth=2)
+    rows = raw_rows_of(table, 4)
+    expect = forest_batch_predict(models, encode_rows(rows, SCHEMA))
+    pred = ForestPredictor(models, SCHEMA, buckets=(8,))
+    svc = PredictionService(pred, warm=False)
+    msgs = [",".join(["predict", "0"] + rows[0]),
+            "predit,typo,oops",
+            ",".join(["predict", "1"] + rows[1])]
+    with pytest.warns(RuntimeWarning, match="malformed"):
+        out = svc.process_batch(msgs)
+    assert out == [f"0,{expect[0]}", f"1,{expect[1]}"]
+    assert svc.counters.get("Serving", "BadRequests") == 1
+
+
+def test_malformed_record_isolated_not_fatal(mesh_ctx):
+    """A request that frames correctly but whose record blows up encoding
+    (short row) is answered with the error label; batchmates still get
+    real predictions and the in-process worker keeps serving."""
+    table, models = small_forest(mesh_ctx, n=200, trees=3, depth=2)
+    rows = raw_rows_of(table, 3)
+    expect = forest_batch_predict(models, encode_rows(rows, SCHEMA))
+    pred = ForestPredictor(models, SCHEMA, buckets=(8,))
+    svc = PredictionService(pred, warm=False)
+    msgs = [",".join(["predict", "0"] + rows[0]),
+            "predict,1,business",                 # short record
+            ",".join(["predict", "2"] + rows[1])]
+    with pytest.warns(RuntimeWarning, match="isolating"):
+        out = svc.process_batch(msgs)
+    assert out == [f"0,{expect[0]}", f"1,{svc.error_label}",
+                   f"2,{expect[1]}"]
+    assert svc.counters.get("Serving", "BadRequests") == 1
+    # the future path answers with the exception, not a hang
+    svc.start()
+    good = svc.submit(rows[2])
+    bad = svc.submit(["business"])
+    assert good.result(timeout=60) == expect[2]
+    with pytest.raises(Exception):
+        bad.result(timeout=60)
+    svc.stop()
+
+
+def test_cli_job_honors_input_delimiter(tmp_path, mesh_ctx):
+    """predictionService tokenizes requests with field.delim.regex (TSV
+    here), independent of the output/wire delimiter."""
+    from avenir_tpu.cli import serving_jobs  # noqa: F401
+    from avenir_tpu.cli.jobs import resolve
+    reg_dir = tmp_path / "registry"
+    schema_path, trees = _train_forest_via_cli(tmp_path, reg_dir)
+    req_rows = raw_rows_of(make_table(10, seed=4), 10)
+    expect = forest_batch_predict(trees, encode_rows(req_rows, SCHEMA))
+    req_path = tmp_path / "requests.tsv"
+    req_path.write_text("\n".join("\t".join(r) for r in req_rows) + "\n")
+    out_dir = tmp_path / "out_tsv"
+    cfg = Config({
+        "field.delim.regex": "\t", "field.delim.out": ",",
+        "ps.model.registry.dir": str(reg_dir),
+        "ps.model.name": "churn",
+        "ps.bucket.sizes": "8,64",
+    })
+    resolve("predictionService")(cfg, str(req_path), str(out_dir))
+    with open(out_dir / "part-m-00000") as fh:
+        lines = fh.read().splitlines()
+    assert [ln.split(",", 1)[1] for ln in lines] == expect
+
+
+def test_resp_stop_still_answers_same_drain(mesh_ctx):
+    """Requests popped in the same pipelined drain as 'stop' are answered
+    before the loop stops (nothing accepted is dropped)."""
+    from avenir_tpu.io.respq import RespClient, RespServer
+    table, models = small_forest(mesh_ctx, n=200, trees=3, depth=2)
+    rows = raw_rows_of(table, 3)
+    expect = forest_batch_predict(models, encode_rows(rows, SCHEMA))
+    pred = ForestPredictor(models, SCHEMA, buckets=(8,))
+    svc = PredictionService(pred, warm=False,
+                            policy=BatchPolicy(max_batch=16))
+    server = RespServer().start()
+    try:
+        cli = RespClient(port=server.port)
+        cli.lpush("requestQueue", ",".join(["predict", "0"] + rows[0]))
+        cli.lpush("requestQueue", "stop")
+        # pushed after 'stop' but drained in the same pipelined pop
+        cli.lpush("requestQueue", ",".join(["predict", "1"] + rows[1]))
+        loop = RespPredictionLoop(svc, {"redis.server.port": server.port})
+        loop.run(max_idle_s=2.0)
+        assert loop.stopped
+        got = {}
+        while True:
+            v = cli.rpop("predictionQueue")
+            if v is None:
+                break
+            rid, lab = v.split(",", 1)
+            got[int(rid)] = lab
+        assert got == {0: expect[0], 1: expect[1]}
+        loop.close()
+        cli.close()
+    finally:
+        server.stop()
+
+
+def test_logistic_proba_oversized_batch_chunks():
+    rows, table = _lr_data(100)
+    w = np.array([0.1, 1.0, -0.5])
+    pred = LogisticPredictor(w, LR_SCHEMA, "p", buckets=(8, 32))
+    p = pred.predict_proba_rows(rows)          # 100 rows > top bucket 32
+    assert p.shape == (100,)
+    # 3 full 32-chunks + the 4-row tail in the 8 bucket: two shapes total,
+    # never a raw-batch-size compile
+    assert pred.compile_count == 2
+    from avenir_tpu.regress.logistic import LogisticParams, LogisticTrainer
+    trainer = LogisticTrainer(LR_SCHEMA,
+                              LogisticParams(pos_class_value="p"))
+    np.testing.assert_array_equal(p, trainer.predict_proba(table, w))
+
+
+# --------------------------------------------------------------------------
+# publish-path fault tolerance
+# --------------------------------------------------------------------------
+
+@pytest.mark.faultinject
+def test_registry_publish_retries_transient_fault(tmp_path, fault_injector):
+    """A transient OSError on the array payload write is retried by
+    with_retry; the committed version is intact."""
+    inj = fault_injector("registry_publish@0=raise:OSError")
+    reg = ModelRegistry(str(tmp_path))
+    with pytest.warns(RuntimeWarning, match="retry"):
+        v = reg.publish("lr", np.arange(3, dtype=np.float64),
+                        kind="logistic", schema=LR_SCHEMA,
+                        params={"pos_class_value": "p"})
+    assert v == 1
+    assert ("registry_publish", 0, "raise") in inj.log
+    assert reg.is_intact("lr", 1)
+    np.testing.assert_array_equal(reg.load("lr", 1).model, np.arange(3.0))
+
+
+@pytest.mark.faultinject
+def test_registry_publish_crash_leaves_no_version(tmp_path, fault_injector):
+    """A non-transient crash mid-publish must not commit: the .tmp dir is
+    left behind but versions()/latest_version() never see it."""
+    fault_injector("registry_publish@*=raise:RuntimeErrorx9")
+    reg = ModelRegistry(str(tmp_path))
+    with pytest.raises(RuntimeError, match="injected"):
+        reg.publish("lr", np.arange(3, dtype=np.float64), kind="logistic",
+                    schema=LR_SCHEMA, params={"pos_class_value": "p"})
+    assert reg.versions("lr") == []
+    assert reg.latest_version("lr") is None
+
+
+# --------------------------------------------------------------------------
+# load soak (slow lane)
+# --------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_serving_soak_sustained_load(mesh_ctx):
+    """Sustained closed-loop load through the micro-batch loop: thousands
+    of requests, every answer correct, tail latency recorded."""
+    table, models = small_forest(mesh_ctx, n=500, trees=5, depth=3)
+    rows = raw_rows_of(table, 256)
+    expect = forest_batch_predict(models, encode_rows(rows, SCHEMA))
+    pred = ForestPredictor(models, SCHEMA).warm()
+    svc = PredictionService(pred, warm=False,
+                            policy=BatchPolicy(max_batch=64,
+                                               max_wait_ms=2.0))
+    svc.start()
+    n = 4000
+    futures = [(i % 256, svc.submit(rows[i % 256])) for i in range(n)]
+    for i, f in futures:
+        assert f.result(timeout=120) == expect[i]
+    svc.stop()
+    assert svc.counters.get("Serving", "Requests") == n
+    assert svc.counters.get("Serving", "Batches") < n
+    assert svc.timer.percentile_ms("serve.request", 99) > 0.0
