@@ -173,6 +173,14 @@ def random_forest_builder(cfg: Config, in_path: str, out_path: str) -> Counters:
     makes the 100M-row flagship CSV feasible.  Models are bit-identical to
     the monolithic path.
 
+    ``dtb.baseline.publish=true`` (with ``dtb.model.registry.dir``)
+    additionally profiles the training data device-side (feature
+    histograms + class distribution, ``dtb.baseline.bins`` numeric bins)
+    and publishes the profile as a baseline sidecar of the registry
+    version — the reference distribution the drift monitor
+    (``driftMonitor`` job, serving hook) scores live traffic against.
+    Streamed ingests tee the same single pass; no extra read.
+
     Fault tolerance (TPU_NOTES §15): ``badrecords.policy`` skips or
     quarantines malformed records; ``dtb.streaming.checkpoint.dir`` (+
     ``dtb.streaming.checkpoint.blocks``, default 16) persists ingest
@@ -187,6 +195,20 @@ def random_forest_builder(cfg: Config, in_path: str, out_path: str) -> Counters:
                           num_trees=cfg.get_int("dtb.num.trees", 5),
                           seed=cfg.get_int("dtb.random.seed", 0))
     policy = _bad_records_policy(cfg, counters, out_path)
+    reg_dir = cfg.get("dtb.model.registry.dir")
+    baseline_builder = None
+    if cfg.get_boolean("dtb.baseline.publish", False):
+        if not reg_dir:
+            # same refusal style as resume-without-streaming: a silently
+            # ignored publish flag surfaces only when driftMonitor later
+            # finds no sidecar — after the training pass the baseline
+            # was supposed to ride is gone
+            raise ValueError("dtb.baseline.publish needs "
+                             "dtb.model.registry.dir (baselines ride "
+                             "registry versions as sidecars)")
+        from ..monitor.baseline import BaselineBuilder
+        baseline_builder = BaselineBuilder(
+            schema, n_bins=cfg.get_int("dtb.baseline.bins", 32))
     if cfg.get_boolean("dtb.streaming.resume", False) and \
             not cfg.get_boolean("dtb.streaming.ingest", False):
         # same refusal as the missing-checkpoint-dir case: a --resume that
@@ -234,6 +256,12 @@ def random_forest_builder(cfg: Config, in_path: str, out_path: str) -> Counters:
             in_path, schema, cfg.field_delim_regex,
             chunk_rows=cfg.get_int("dtb.streaming.block.rows", 1 << 22),
             bad_records=policy, start_row=start_row))
+        if baseline_builder is not None:
+            # the baseline rides the SAME single ingest pass (a resumed
+            # run only re-profiles the re-read tail; the baseline is a
+            # distribution estimate, not a bit-pinned artifact)
+            from ..monitor.baseline import tee_blocks
+            blocks = tee_blocks(blocks, baseline_builder)
         models = build_forest_from_stream(
             blocks, schema, params, runtime_context(),
             checkpoint=mgr, checkpoint_every=every,
@@ -241,12 +269,13 @@ def random_forest_builder(cfg: Config, in_path: str, out_path: str) -> Counters:
     else:
         table = load_csv(in_path, schema, cfg.field_delim_regex,
                          bad_records=policy)
+        if baseline_builder is not None:
+            baseline_builder.update(table)
         models = build_forest(table, params, runtime_context())
     os.makedirs(out_path, exist_ok=True)
     for i, dpl in enumerate(models):
         with open(os.path.join(out_path, f"tree_{i}.json"), "w") as fh:
             fh.write(dpl.to_json())
-    reg_dir = cfg.get("dtb.model.registry.dir")
     if reg_dir:
         # publish the trained forest into the serving registry (atomic
         # versioned artifact; a live predictionService hot-swaps to it on
@@ -254,11 +283,23 @@ def random_forest_builder(cfg: Config, in_path: str, out_path: str) -> Counters:
         # (sharded job, device reductions), so under multi-process only
         # process 0 publishes — the registry is single-writer per name
         import jax
+        baseline = None
+        if baseline_builder is not None:
+            # partial shard counts all-reduce FIRST (collective: every
+            # process participates), then only process 0 writes
+            from ..monitor.baseline import allreduce_partials
+            baseline = allreduce_partials(baseline_builder).finalize()
         if jax.process_index() == 0:
             from ..serving.registry import ModelRegistry
-            version = ModelRegistry(reg_dir).publish(
-                cfg.get("dtb.model.name", "forest"), models, schema=schema)
+            registry = ModelRegistry(reg_dir)
+            model_name = cfg.get("dtb.model.name", "forest")
+            version = registry.publish(model_name, models, schema=schema)
             counters.set("Random forest", "RegistryVersion", version)
+            if baseline is not None:
+                from ..monitor.baseline import publish_baseline
+                publish_baseline(registry, model_name, version, baseline)
+                counters.set("Random forest", "BaselineRows",
+                             baseline.n_rows)
     counters.increment("Random forest", "Trees", len(models))
     return counters
 

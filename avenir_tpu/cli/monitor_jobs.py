@@ -1,0 +1,292 @@
+"""Drift-monitoring jobs (org.avenir.monitor.*).
+
+``driftMonitor`` replays a record stream — a CSV file/dir or a RESP queue
+— against a registry model's training baseline and emits one drift-score
+row per (window, monitored distribution), CSV out like every other job.
+Config keys (reference-style, ``dm.`` namespace):
+
+  dm.model.registry.dir      registry base directory (required)
+  dm.model.name              model name in the registry (required)
+  dm.model.version           pin a version (default: newest intact)
+  dm.feature.schema.file.path  override the artifact's embedded schema
+  dm.window.rows             tumbling window size (default 2048)
+  dm.longterm.decay          exponential long-window decay (default 0.9)
+  dm.consecutive.windows     debounce: windows at a level before an
+                             alert record emits (default 2)
+  dm.warn.<stat> / dm.alert.<stat>   threshold overrides per statistic
+                             (psi, kl, js, ks, chi2)
+  dm.score.predictions       also run the model per window: prediction-
+                             class distribution (prior drift) + delayed-
+                             label accuracy when the class column holds
+                             known labels (default false)
+  dm.accuracy.warn/.alert    integer accuracy percents (0 = disabled)
+  dm.accuracy.window         outcomes per quality window (default:
+                             dm.window.rows)
+  dm.source                  file | resp (default file)
+  redis.server.host/port, redis.request.queue, dm.resp.max.idle.s
+                             the RESP source (record lines rpop'ed in
+                             window-sized drains; a literal 'stop' ends
+                             the stream)
+
+Output: ``windowIndex,windowKind,scope,rowKind,nRows,psi,kl,js,ks,chi2,
+level`` rows (level = this window's immediate warn/alert standing;
+debounced alert records additionally land in ``<out>/alerts.jsonl`` and
+the counter dump, and the counters export as ``<out>/counters.json`` via
+``Counters.to_json``).  Report rows and alerts stream out per closed
+window; malformed records are skipped and tallied in the ``BadRecords``
+counter group rather than killing the replay.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.config import Config
+from ..core.metrics import Counters
+from .jobs import register, _splitter
+
+
+def _threshold_overrides(cfg: Config, prefix: str):
+    from ..monitor.drift import STATS
+    out = {}
+    for stat in STATS:
+        key = f"{prefix}.{stat}"
+        if key in cfg:
+            out[stat] = cfg.get_float(key)
+    return out
+
+
+def _iter_line_windows(in_path: str, split, window_rows: int):
+    """Token-row windows from a CSV file or a dir of part files, read
+    line by line (never the whole stream in memory)."""
+    if os.path.isdir(in_path):
+        paths = sorted(os.path.join(in_path, p)
+                       for p in os.listdir(in_path)
+                       if os.path.isfile(os.path.join(in_path, p))
+                       and not p.startswith(("_", ".")))
+    else:
+        paths = [in_path]
+    rows: List[List[str]] = []
+    for p in paths:
+        with open(p, "r") as fh:
+            for line in fh:
+                line = line.rstrip("\r\n")
+                if not line.strip():
+                    continue
+                rows.append(split(line))
+                if len(rows) >= window_rows:
+                    yield rows
+                    rows = []
+    if rows:
+        yield rows
+
+
+def _iter_resp_windows(cfg: Config, split, window_rows: int):
+    """Token-row windows drained from a RESP list queue (pipelined pops,
+    the serving loop's wire discipline); 'stop' or idle timeout ends."""
+    from ..io.respq import RespClient
+    client = RespClient(cfg.get("redis.server.host", "127.0.0.1"),
+                        int(cfg.get("redis.server.port", 6379)))
+    queue = cfg.get("redis.request.queue", "requestQueue")
+    max_idle_s = cfg.get_float("dm.resp.max.idle.s", 10.0)
+    idle_since = time.monotonic()
+    stopped = False
+    try:
+        rows: List[List[str]] = []
+        while not stopped:
+            msgs = client.rpop_many(queue, window_rows)
+            if not msgs:
+                if time.monotonic() - idle_since > max_idle_s:
+                    break
+                time.sleep(0.002)
+                continue
+            idle_since = time.monotonic()
+            for m in msgs:
+                if m == "stop":
+                    stopped = True
+                else:
+                    rows.append(split(m))
+            while len(rows) >= window_rows:
+                yield rows[:window_rows]
+                rows = rows[window_rows:]
+        if rows:
+            yield rows
+    finally:
+        client.close()
+
+
+@register("org.avenir.monitor.DriftMonitor", "driftMonitor", dist="refuse")
+def drift_monitor(cfg: Config, in_path: str, out_path: str) -> Counters:
+    from ..core.schema import FeatureSchema
+    from ..core.table import encode_rows
+    from ..monitor.accumulator import StreamDriftMonitor
+    from ..monitor.baseline import load_baseline
+    from ..monitor.drift import STATS
+    from ..monitor.policy import AccuracyTracker, DriftPolicy
+    from ..serving.registry import ModelRegistry
+
+    counters = Counters()
+    registry = ModelRegistry(cfg.must_get("dm.model.registry.dir"))
+    name = cfg.must_get("dm.model.name")
+    version: Optional[int] = cfg.get_int("dm.model.version", 0) or None
+    if version is None:
+        version = registry.latest_version(name)
+        if version is None:
+            raise FileNotFoundError(
+                f"no intact versions of model {name!r} in "
+                f"{registry.base_dir!r}")
+    baseline = load_baseline(registry, name, version)
+    counters.set("DriftMonitor", "ModelVersion", version)
+    score_predictions = cfg.get_boolean("dm.score.predictions", False)
+    # load the artifact at most once: the schema and (when enabled) the
+    # predictor come from the same LoadedModel
+    loaded = None
+    if "dm.feature.schema.file.path" in cfg:
+        schema = FeatureSchema.load(
+            cfg.must_get("dm.feature.schema.file.path"))
+    else:
+        loaded = registry.load(name, version)
+        schema = loaded.schema
+        if schema is None:
+            raise ValueError(
+                f"model {name!r} v{version} embeds no schema; set "
+                "dm.feature.schema.file.path")
+
+    window_rows = cfg.get_int("dm.window.rows", 2048)
+    policy = DriftPolicy(
+        warn=_threshold_overrides(cfg, "dm.warn"),
+        alert=_threshold_overrides(cfg, "dm.alert"),
+        consecutive=cfg.get_int("dm.consecutive.windows", 2),
+        counters=counters,
+        accuracy_warn=cfg.get_int("dm.accuracy.warn", 0),
+        accuracy_alert=cfg.get_int("dm.accuracy.alert", 0),
+        debug_on=cfg.debug_on)
+    monitor = StreamDriftMonitor(
+        baseline, policy=policy, window_rows=window_rows,
+        decay=cfg.get_float("dm.longterm.decay", 0.9),
+        counters=counters)
+
+    predictor = None
+    tracker = None
+    if score_predictions:
+        from ..serving.predictor import make_predictor
+        if loaded is None:
+            loaded = registry.load(name, version)
+        predictor = make_predictor(loaded, schema=schema).warm()
+        card = list(schema.class_attr_field.cardinality or [])
+        if len(card) >= 2 and (policy.accuracy_warn > 0
+                               or policy.accuracy_alert > 0):
+            # (neg, pos) = first two cardinality values, the reference's
+            # ConfusionMatrix convention
+            tracker = AccuracyTracker(
+                pos_class=card[1], neg_class=card[0], policy=policy,
+                window=cfg.get_int("dm.accuracy.window", window_rows))
+    cls_spec = baseline.specs[baseline.class_row]
+
+    split = _splitter(cfg.field_delim_regex)
+    source = cfg.get("dm.source", "file")
+    if source == "file":
+        windows = _iter_line_windows(in_path, split, window_rows)
+    elif source == "resp":
+        windows = _iter_resp_windows(cfg, split, window_rows)
+    else:
+        raise ValueError(f"unknown dm.source {source!r} (file | resp)")
+
+    # output streams PER CLOSED WINDOW (a long-lived RESP drain must not
+    # retain every report in memory, and a killed job must not lose the
+    # windows it already scored); alerts.jsonl is created lazily on the
+    # first alert so a quiet run leaves no empty file behind
+    od = cfg.field_delim_out
+    os.makedirs(out_path, exist_ok=True)
+    alerts_path = os.path.join(out_path, "alerts.jsonl")
+    if os.path.exists(alerts_path):
+        # append-mode writes must not leave a previous run's alerts
+        # looking like this run's (the file's existence IS the signal)
+        os.remove(alerts_path)
+
+    def level_of(row) -> str:
+        level = "ok"
+        for stat in STATS:
+            if not row.applicable(stat):
+                continue
+            if row.stats[stat] >= policy.alert[stat]:
+                return "alert"
+            if row.stats[stat] >= policy.warn[stat]:
+                level = "warn"
+        return level
+
+    def drain(part_fh) -> None:
+        for report in monitor.reports:
+            for row in report.rows:
+                part_fh.write(od.join(
+                    [str(report.index), report.kind, row.scope, row.kind,
+                     str(report.n_rows)]
+                    + [repr(round(row.stats[s], 6)) for s in STATS]
+                    + [level_of(row)]) + "\n")
+        monitor.reports.clear()
+        if policy.alerts:
+            with open(alerts_path, "a") as fh:
+                for rec in policy.alerts:
+                    fh.write(rec.to_json() + "\n")
+            policy.alerts.clear()
+        part_fh.flush()
+
+    # a monitoring replay must survive its stream: malformed records
+    # (short rows, unparseable numerics — the native parser's ``bad``
+    # contract) default to badrecords.policy=skip here — counted in the
+    # Hadoop-style BadRecords group through the SAME BadRecordPolicy as
+    # every other ingest path (quarantine works too; lines re-join with
+    # the output delimiter) instead of killing the job mid-drain, where
+    # one bad token would lose every record already rpop'ed off a RESP
+    # queue.  badrecords.policy=fail restores the historic crash.
+    from ..core.table import BadRecordPolicy, _bad_row_checker
+    pol = cfg.get("badrecords.policy", "skip")
+    qpath = cfg.get("badrecords.quarantine.path") or \
+        os.path.join(out_path, "_quarantine")
+    bad_records = None
+    if pol != "fail":
+        bad_records = BadRecordPolicy(
+            pol, qpath if pol == "quarantine" else None, counters)
+    is_bad = _bad_row_checker(schema)
+
+    with open(os.path.join(out_path, "part-r-00000"), "w") as part_fh:
+        for rows in windows:
+            if bad_records is not None:
+                good = [r for r in rows if not is_bad(r)]
+                if len(good) < len(rows):
+                    bad_records.record(
+                        [od.join(r) for r in rows if is_bad(r)])
+                rows = good
+            if not rows:
+                continue
+            table = encode_rows(rows, schema)
+            class_codes = None
+            if predictor is not None:
+                labels = predictor.predict_rows(rows)
+                # shared encoding with ServingMonitor: prediction-prior
+                # drift must score identically offline and live
+                class_codes = baseline.class_codes_for_labels(labels)
+                if tracker is not None:
+                    actual_codes = np.asarray(table.class_codes())
+                    card = cls_spec.labels or []
+                    known = actual_codes >= 0
+                    if known.any():
+                        tracker.record(
+                            [lab for lab, k in zip(labels, known) if k],
+                            [card[c] for c, k in zip(actual_codes, known)
+                             if k])
+            monitor.observe_table(table, class_codes=class_codes)
+            drain(part_fh)
+        monitor.close_window()       # score the partial tail window
+        if tracker is not None:
+            tracker.close()
+        drain(part_fh)
+    # machine-readable counters next to the report (Counters.to_json —
+    # the bench harness and operators consume this, not render() text)
+    with open(os.path.join(out_path, "counters.json"), "w") as fh:
+        fh.write(counters.to_json())
+    return counters

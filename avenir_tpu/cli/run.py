@@ -38,6 +38,7 @@ from . import text_jobs  # noqa: F401  (registers text-pack + rule jobs)
 from . import partition_jobs  # noqa: F401  (registers split/partition jobs)
 from . import nn_jobs  # noqa: F401  (registers neural-net jobs)
 from . import serving_jobs  # noqa: F401  (registers online-serving jobs)
+from . import monitor_jobs  # noqa: F401  (registers drift-monitoring jobs)
 
 
 def file_sha(path: str, full: bool) -> str:

@@ -14,8 +14,9 @@ validation counters match the reference run for run.
 
 from __future__ import annotations
 
+import json
 from collections import defaultdict
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 
@@ -49,6 +50,37 @@ class Counters:
             for n, v in names.items():
                 lines.append(f"\t{n}={v}")
         return "\n".join(lines)
+
+    # ---- machine-readable export (stable key order) ----
+    def to_json(self) -> str:
+        """One compact JSON object {group: {name: value}} with groups and
+        names sorted — jobs and the bench harness consume this instead of
+        parsing render() text, and identical counters always serialize to
+        identical bytes (diffable artifacts)."""
+        return json.dumps(self.as_dict(), sort_keys=True,
+                          separators=(",", ":"))
+
+    @classmethod
+    def from_json(cls, text: str) -> "Counters":
+        """Inverse of :meth:`to_json`: ``from_json(c.to_json())`` holds
+        every (group, name, value) of ``c``."""
+        out = cls()
+        for g, names in json.loads(text).items():
+            for n, v in names.items():
+                out.set(g, n, int(v))
+        return out
+
+    def append_jsonl(self, path: str,
+                     tag: Optional[str] = None) -> None:
+        """Append one ``{"tag":..., "counters": {...}}`` line to a JSONL
+        file (key order stable) — the per-window/per-run export stream."""
+        record: Dict = {}
+        if tag is not None:
+            record["tag"] = tag
+        record["counters"] = self.as_dict()
+        with open(path, "a") as fh:
+            fh.write(json.dumps(record, sort_keys=True,
+                                separators=(",", ":")) + "\n")
 
 
 class ConfusionMatrix:

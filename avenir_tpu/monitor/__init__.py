@@ -1,0 +1,49 @@
+"""Drift & model-quality monitoring: the observability half of serving.
+
+PR 1–3 built ingest, training, and the online `PredictionService`;
+nothing watched whether live traffic still looks like training data — a
+stale or drifting model serves silently until a human notices.  This
+package closes that loop:
+
+  * :mod:`.baseline`    — training-time feature/class profiles computed
+    device-side from ``ColumnarTable`` chunks, published as a
+    ``baseline.json`` + ``baseline.npz`` sidecar inside the model's
+    registry version (every served model carries its own reference
+    distribution);
+  * :mod:`.accumulator` — tumbling + exponential-decay window
+    accumulators (device scatter-adds, one host sync per window) and the
+    ``ServingMonitor`` PredictionService hook;
+  * :mod:`.drift`       — ONE jitted kernel scoring a finalized window
+    against the baseline across all features at once: PSI, KL,
+    Jensen–Shannon, binned KS (numerics), chi-square (categoricals), and
+    the same scores on the prediction-class distribution (prior drift);
+  * :mod:`.policy`      — warn/alert thresholds with consecutive-window
+    debounce, structured alert records through the Counters channel, and
+    the serving guardrails (registry re-probe / degrade flag), plus
+    delayed-label accuracy via ``ConfusionMatrix.report_batch``.
+
+CLI: the ``driftMonitor`` job (``dm.*`` keys) scores a CSV stream or a
+RESP queue against a registry baseline; ``randomForestBuilder`` publishes
+a baseline next to the model with ``dtb.baseline.publish=true``.
+"""
+
+from .baseline import (BASELINE_JSON, BASELINE_NPZ, Baseline,
+                       BaselineBuilder, PREDICTION_SCOPE, RowSpec,
+                       compute_baseline, load_baseline, monitor_specs,
+                       publish_baseline, tee_blocks)
+from .accumulator import (DriftAccumulator, ServingMonitor,
+                          StreamDriftMonitor)
+from .drift import STATS, DriftReport, DriftScorer, RowScore
+from .policy import (AccuracyTracker, AlertRecord, DriftPolicy,
+                     DEFAULT_ALERT, DEFAULT_WARN, degrade_action,
+                     refresh_action)
+
+__all__ = [
+    "BASELINE_JSON", "BASELINE_NPZ", "Baseline", "BaselineBuilder",
+    "PREDICTION_SCOPE", "RowSpec", "compute_baseline", "load_baseline",
+    "monitor_specs", "publish_baseline", "tee_blocks", "DriftAccumulator",
+    "ServingMonitor", "StreamDriftMonitor", "STATS", "DriftReport",
+    "DriftScorer", "RowScore", "AccuracyTracker", "AlertRecord",
+    "DriftPolicy", "DEFAULT_ALERT", "DEFAULT_WARN", "degrade_action",
+    "refresh_action",
+]

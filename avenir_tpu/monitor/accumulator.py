@@ -1,0 +1,338 @@
+"""Streaming window accumulators: absorb rows on device, sync once per
+window.
+
+Layout is the baseline's stacked (R, B_max) count matrix (TPU_NOTES §17):
+absorbing a row block is one ``feature_bin_counts`` one-hot contraction
+added into the pre-allocated device matrix — a scatter-add per block, not
+per row — and ``finalize()`` is the only host readback.  Incoming blocks
+pad up to power-of-two row buckets (mask-guarded, the serving layer's
+shape discipline) so the per-instance jit compiles O(log max-block)
+variants instead of one per batch size.
+
+Windows:
+
+  * tumbling — close after ``window_rows`` rows (and/or ``window_s``
+    seconds); each closed window scores independently against the
+    baseline.
+  * exponential-decay long window — after each tumbling close,
+    ``long = decay * long + window`` (host-side on the just-synced
+    snapshot: two small (R, B) arrays, no extra device traffic).  The
+    long window catches slow drifts whose per-window scores never clear
+    the warn bar.
+
+``ServingMonitor`` is the :class:`PredictionService` hook: per-micro-batch
+cost is two list extends (the <5% serve_forest overhead budget —
+benchmarked by the ``monitor_drift`` bench point); encoding and the
+device scatter-add amortize over ``flush_rows`` requests, scoring over
+``window_rows``.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+import warnings
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..core.metrics import Counters
+from ..core.table import ColumnarTable, encode_rows
+from .baseline import Baseline, encode_monitor_codes
+from .drift import DriftReport, DriftScorer
+
+DEFAULT_BLOCK_BUCKETS = (64, 256, 1024, 4096)
+
+
+@dataclass
+class WindowSnapshot:
+    """One finalized window: host counts + bookkeeping."""
+    index: int
+    counts: np.ndarray          # (R, B_max) float64
+    n_rows: int
+    t_start: float
+    t_end: float
+
+
+class DriftAccumulator:
+    """Pre-allocated device bin matrix + bucketed scatter-add absorb."""
+
+    def __init__(self, baseline: Baseline,
+                 buckets: Sequence[int] = DEFAULT_BLOCK_BUCKETS):
+        import jax
+        import jax.numpy as jnp
+        self.baseline = baseline
+        self.buckets = tuple(sorted({int(b) for b in buckets}))
+        if not self.buckets or self.buckets[0] < 1:
+            raise ValueError(f"buckets must be positive ints, got {buckets}")
+        r, b = baseline.counts.shape
+        self._shape = (r, b)
+        self._zero = jnp.zeros((r, b), dtype=jnp.float32)
+        self._counts = self._zero
+        self._n = 0
+
+        from ..ops.histogram import feature_bin_counts
+
+        def update(counts, codes, mask):
+            return counts + feature_bin_counts(codes, b, mask)
+        self._update = jax.jit(update)
+
+    @property
+    def n_rows(self) -> int:
+        return self._n
+
+    def _bucket(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    def absorb_codes(self, codes: np.ndarray) -> None:
+        """Add one (n, R) int32 code block.  Blocks beyond the top bucket
+        split; smaller blocks pad (mask-guarded) to the bucket size so
+        the jit never sees a fresh shape."""
+        import jax.numpy as jnp
+        n = codes.shape[0]
+        if n == 0:
+            return
+        top = self.buckets[-1]
+        for s in range(0, n, top):
+            chunk = codes[s:s + top]
+            m = chunk.shape[0]
+            b = self._bucket(m)
+            if m < b:
+                chunk = np.concatenate(
+                    [chunk, np.zeros((b - m, chunk.shape[1]), chunk.dtype)])
+            mask = np.zeros((b,), dtype=bool)
+            mask[:m] = True
+            self._counts = self._update(self._counts, jnp.asarray(chunk),
+                                        jnp.asarray(mask))
+        self._n += n
+
+    def absorb_table(self, table: ColumnarTable,
+                     class_codes: Optional[np.ndarray] = None) -> None:
+        self.absorb_codes(encode_monitor_codes(
+            table, self.baseline.specs, class_codes=class_codes))
+
+    def warm(self) -> "DriftAccumulator":
+        """Pre-compile the absorb jit for every bucket shape WITHOUT
+        touching the accumulated state (all-False mask; result
+        discarded) — a first live flush must not pay a compile on the
+        serving path."""
+        import jax.numpy as jnp
+        r = self._shape[0]
+        for b in self.buckets:
+            self._update(self._zero,
+                         jnp.zeros((b, r), dtype=jnp.int32),
+                         jnp.zeros((b,), dtype=bool))
+        return self
+
+    def finalize(self) -> "tuple[np.ndarray, int]":
+        """THE host sync: read the device matrix back, reset the
+        accumulator (tumbling semantics).  Returns (counts, n_rows)."""
+        counts = np.asarray(self._counts, dtype=np.float64)
+        n = self._n
+        self._counts = self._zero
+        self._n = 0
+        return counts, n
+
+
+class StreamDriftMonitor:
+    """Tumbling + exponential-decay windows over a code/table stream,
+    scored on close and fed to an optional policy.
+
+    ``observe_*`` absorbs rows, closing (and scoring) a window every
+    ``window_rows`` rows or ``window_s`` seconds; each close also decays
+    the long window and scores it as kind='longterm'.  Reports retain in
+    ``self.reports`` (bounded), alerts accumulate via the policy."""
+
+    def __init__(self, baseline: Baseline, scorer: Optional[DriftScorer]
+                 = None, policy=None, window_rows: int = 4096,
+                 window_s: Optional[float] = None, decay: float = 0.9,
+                 counters: Optional[Counters] = None,
+                 keep_reports: int = 256,
+                 buckets: Sequence[int] = DEFAULT_BLOCK_BUCKETS):
+        if not 0.0 <= decay < 1.0:
+            raise ValueError(f"decay must be in [0, 1), got {decay}")
+        if window_rows < 1:
+            # observe_codes fills windows by remaining room; a
+            # non-positive size would never make progress
+            raise ValueError(f"window_rows must be >= 1, got {window_rows}")
+        self.baseline = baseline
+        self.scorer = scorer or DriftScorer(baseline)
+        self.policy = policy
+        self.window_rows = int(window_rows)
+        self.window_s = window_s
+        self.decay = float(decay)
+        self.counters = counters if counters is not None else Counters()
+        self.keep_reports = keep_reports
+        self.acc = DriftAccumulator(baseline, buckets=buckets)
+        self._long_counts = np.zeros_like(baseline.counts)
+        self._long_n = 0.0
+        self._window_start = time.monotonic()
+        self._index = 0
+        self.reports: List[DriftReport] = []
+
+    def warm(self) -> "StreamDriftMonitor":
+        """Compile the absorb buckets and the scoring kernel off the
+        live path (scores a baseline-shaped dummy directly through the
+        scorer — no window, no policy, no report)."""
+        self.acc.warm()
+        self.scorer.score_counts(np.zeros_like(self.baseline.counts), 0)
+        return self
+
+    # ---- ingestion ----
+    def observe_codes(self, codes: np.ndarray) -> None:
+        n = codes.shape[0]
+        s = 0
+        while s < n:
+            room = self.window_rows - self.acc.n_rows
+            take = min(room, n - s)
+            self.acc.absorb_codes(codes[s:s + take])
+            s += take
+            if self.acc.n_rows >= self.window_rows:
+                self.close_window()
+        if self.window_s is not None and self.acc.n_rows > 0 and \
+                time.monotonic() - self._window_start >= self.window_s:
+            self.close_window()
+
+    def observe_table(self, table: ColumnarTable,
+                      class_codes: Optional[np.ndarray] = None) -> None:
+        self.observe_codes(encode_monitor_codes(
+            table, self.baseline.specs, class_codes=class_codes))
+
+    # ---- window close ----
+    def close_window(self, force: bool = False) -> Optional[DriftReport]:
+        """Finalize the current tumbling window (no-op when empty unless
+        ``force``), score it, decay-merge it into the long window and
+        score that too.  Returns the tumbling report."""
+        if self.acc.n_rows == 0 and not force:
+            return None
+        counts, n = self.acc.finalize()
+        now = time.monotonic()
+        report = self.scorer.score_counts(counts, n, index=self._index,
+                                          kind="window")
+        self._remember(report)
+        # exponential-decay long window rides the just-synced host copy
+        self._long_counts = self.decay * self._long_counts + counts
+        self._long_n = self.decay * self._long_n + n
+        long_report = self.scorer.score_counts(
+            self._long_counts, int(self._long_n), index=self._index,
+            kind="longterm")
+        self._remember(long_report)
+        self.counters.increment("DriftMonitor", "WindowsScored")
+        self.counters.increment("DriftMonitor", "RowsSeen", n)
+        if self.policy is not None:
+            self.policy.observe(report)
+            self.policy.observe(long_report)
+        self._index += 1
+        self._window_start = now
+        return report
+
+    def _remember(self, report: DriftReport) -> None:
+        self.reports.append(report)
+        if len(self.reports) > self.keep_reports:
+            del self.reports[:len(self.reports) - self.keep_reports]
+
+
+class ServingMonitor:
+    """The PredictionService hook: record served (row, predicted-label)
+    pairs, score them against the model's training baseline.
+
+    ``record_batch`` runs on the serving worker thread, so it only
+    buffers (two list extends — the <5% overhead budget); every
+    ``flush_rows`` requests the buffer hands off to a daemon monitor
+    thread that encodes once and scatter-adds once on device, so even
+    the amortized encode/score cost stays off the request path
+    (``async_flush=False`` keeps everything synchronous — deterministic
+    for tests and batch jobs).  Predicted labels map to class codes
+    through the baseline's class-row vocabulary (ambiguous/unknown
+    labels land in the trailing unknown bin).  Monitoring must never
+    take serving down: any failure inside a flush is caught, counted,
+    and warned."""
+
+    def __init__(self, baseline: Baseline, schema,
+                 policy=None, window_rows: int = 1024,
+                 flush_rows: int = 256, decay: float = 0.9,
+                 window_s: Optional[float] = None,
+                 counters: Optional[Counters] = None,
+                 async_flush: bool = True):
+        self.schema = schema
+        self.counters = counters if counters is not None else Counters()
+        self.stream = StreamDriftMonitor(
+            baseline, policy=policy, window_rows=window_rows,
+            window_s=window_s, decay=decay, counters=self.counters)
+        self.flush_rows = int(flush_rows)
+        self._rows: List[List[str]] = []
+        self._labels: List[str] = []
+        self.async_flush = async_flush
+        self._pending: "queue.Queue" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def reports(self) -> List[DriftReport]:
+        return self.stream.reports
+
+    def warm(self) -> "ServingMonitor":
+        """Pre-compile the absorb buckets and scoring kernel so the
+        first live flush never compiles on (or in competition with) the
+        serving path."""
+        self.stream.warm()
+        return self
+
+    def record_batch(self, rows: List[List[str]],
+                     labels: List[str]) -> None:
+        """Request-path entry: O(1) per row (buffer only)."""
+        self._rows.extend(rows)
+        self._labels.extend(labels)
+        if len(self._rows) >= self.flush_rows:
+            self.flush()
+
+    def flush(self) -> None:
+        """Hand the buffer to the monitor thread (or absorb inline when
+        ``async_flush=False``)."""
+        if not self._rows:
+            return
+        rows, labels = self._rows, self._labels
+        self._rows, self._labels = [], []
+        if self.async_flush:
+            self._pending.put((rows, labels))
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._drain, daemon=True,
+                    name="avenir-monitor-flush")
+                self._thread.start()
+        else:
+            self._absorb(rows, labels)
+
+    def _drain(self) -> None:
+        while True:
+            item = self._pending.get()
+            if item is None:
+                return
+            self._absorb(*item)
+
+    def _absorb(self, rows: List[List[str]], labels: List[str]) -> None:
+        try:
+            table = encode_rows(rows, self.schema)
+            codes = self.stream.baseline.class_codes_for_labels(labels)
+            self.stream.observe_table(table, class_codes=codes)
+        except Exception as exc:
+            self.counters.increment("DriftMonitor", "RecordErrors",
+                                    len(rows))
+            warnings.warn(
+                f"monitor: dropping {len(rows)} recorded rows "
+                f"({type(exc).__name__}: {exc}) — serving unaffected",
+                RuntimeWarning)
+
+    def close(self) -> Optional[DriftReport]:
+        """Flush the buffer, drain the monitor thread, and score
+        whatever partial window remains."""
+        self.flush()
+        if self._thread is not None:
+            self._pending.put(None)
+            self._thread.join(timeout=60)
+            self._thread = None
+        return self.stream.close_window()

@@ -1,0 +1,169 @@
+"""Drift scoring: one jitted kernel, every monitored distribution at once.
+
+A finalized window is a (R, B) count matrix in the same stacked layout as
+the baseline (monitor/baseline.py): numeric features, categorical
+features, and the prediction-class distribution as the last row.  Scoring
+is therefore ONE vectorized device pass over the whole matrix — per-row
+loops would launch R kernels per window and drown the actual math in
+dispatch overhead (TPU_NOTES §17).
+
+Statistics per row (all computed over the row's valid bins; the pad bins
+out to B_max carry identical clamped values on both sides and contribute
+exactly zero):
+
+  * ``psi``  — population stability index, Σ (q̃-p̃)·ln(q̃/p̃) with the
+    standard ε-floor (no renormalize): empty bins clamp to ``eps`` so
+    the log stays finite, the industry PSI convention.
+  * ``kl``   — KL(q̃ ‖ p̃), same ε-floored distributions.
+  * ``js``   — Jensen–Shannon divergence (nats, bounded by ln 2).
+  * ``ks``   — binned Kolmogorov–Smirnov statistic max|CDF_p - CDF_q|
+    over the UNclamped distributions (meaningful for ordered bins:
+    numeric rows only — the policy ignores it elsewhere).
+  * ``chi2`` — chi-square DISTANCE Σ (q-p)²/p over the bins the
+    baseline actually populated (the classic zero-expected-count
+    exclusion: dividing a stray window token by the ε floor would turn
+    ONE unknown value in a 2k-row window into an alert-level score;
+    genuinely new-category mass still registers through psi/kl/js,
+    which ε-floor instead of excluding).  This is the classic statistic
+    divided by the window count, so thresholds do not scale with window
+    size; the raw statistic is ``chi2 * n_window``.
+
+Every statistic is pinned against a pure-numpy oracle in
+tests/test_monitor.py, including empty-bin ε handling and
+all-mass-in-one-bin extremes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .baseline import Baseline, CLASS, NUMERIC, PREDICTION_SCOPE
+
+STATS = ("psi", "kl", "js", "ks", "chi2")
+DEFAULT_EPS = 1e-6
+
+# which statistics the policy treats as meaningful per row kind: KS needs
+# ordered bins; chi-square is the categorical/prior test of the reference
+# literature (psi/kl/js apply everywhere)
+STAT_KINDS = {
+    "psi": ("numeric", "categorical", "class"),
+    "kl": ("numeric", "categorical", "class"),
+    "js": ("numeric", "categorical", "class"),
+    "ks": ("numeric",),
+    "chi2": ("categorical", "class"),
+}
+
+
+@dataclass
+class RowScore:
+    """One monitored row's drift scores for one window."""
+    scope: str                  # feature name, or __prediction__
+    kind: str                   # numeric | categorical | class
+    stats: Dict[str, float]
+
+    def applicable(self, stat: str) -> bool:
+        return self.kind in STAT_KINDS[stat]
+
+
+@dataclass
+class DriftReport:
+    """All rows of one scored window."""
+    index: int
+    kind: str                   # window | longterm
+    n_rows: int
+    rows: List[RowScore] = dc_field(default_factory=list)
+
+    def row(self, scope: str) -> RowScore:
+        for r in self.rows:
+            if r.scope == scope:
+                return r
+        raise KeyError(f"no scored row {scope!r}")
+
+    def max_stat(self, stat: str) -> float:
+        vals = [r.stats[stat] for r in self.rows if r.applicable(stat)]
+        return max(vals) if vals else 0.0
+
+
+def _score_kernel(p, q_counts, valid, eps):
+    """The traced core: (R,B) baseline probs + window counts -> (R,5).
+    Also usable as the numpy oracle shape-for-shape (the tests run an
+    independently written oracle, not this function)."""
+    import jax.numpy as jnp
+    totals = q_counts.sum(axis=1, keepdims=True)
+    q = jnp.where(valid, q_counts / jnp.maximum(totals, 1.0), 0.0)
+    # ε-floored twins for the log statistics; invalid bins pin both sides
+    # to 1.0 so every term there is exactly (1-1)*log(1/1) = 0
+    pc = jnp.where(valid, jnp.maximum(p, eps), 1.0)
+    qc = jnp.where(valid, jnp.maximum(q, eps), 1.0)
+    log_ratio = jnp.log(qc) - jnp.log(pc)
+    psi = ((qc - pc) * log_ratio).sum(axis=1)
+    kl = (qc * log_ratio).sum(axis=1)
+    m = 0.5 * (pc + qc)
+    js = 0.5 * (pc * (jnp.log(pc) - jnp.log(m))).sum(axis=1) + \
+        0.5 * (qc * (jnp.log(qc) - jnp.log(m))).sum(axis=1)
+    ks = jnp.abs(jnp.cumsum(p - q, axis=1)).max(axis=1)
+    # zero-expected-count exclusion: only bins with baseline support
+    # contribute (see module docstring — ε denominators would make one
+    # stray unknown token an alert)
+    chi2 = (jnp.where(valid & (p > 0), (q - p) ** 2, 0.0) / pc
+            ).sum(axis=1)
+    return jnp.stack([psi, kl, js, ks, chi2], axis=1)
+
+
+class DriftScorer:
+    """Scores stacked window count matrices against one baseline.
+
+    The baseline's probability matrix, valid-bin mask, and the jitted
+    kernel are built once; every window then costs a single device
+    launch + one (R, 5) readback."""
+
+    def __init__(self, baseline: Baseline, eps: float = DEFAULT_EPS):
+        import jax
+        import jax.numpy as jnp
+        self.baseline = baseline
+        self.eps = float(eps)
+        r, b = baseline.counts.shape
+        valid = np.zeros((r, b), dtype=bool)
+        for i, s in enumerate(baseline.specs):
+            valid[i, :s.n_bins] = True
+        self._valid = jnp.asarray(valid)
+        self._p = jnp.asarray(baseline.probabilities().astype(np.float32))
+        eps_f = jnp.float32(self.eps)
+        self._kernel = jax.jit(
+            lambda q: _score_kernel(self._p, q, self._valid, eps_f))
+
+    def score_counts(self, window_counts: np.ndarray, n_rows: int,
+                     index: int = 0, kind: str = "window") -> DriftReport:
+        """Score one finalized (R, B) window count matrix."""
+        import jax.numpy as jnp
+        if window_counts.shape != self.baseline.counts.shape:
+            raise ValueError(
+                f"window shape {window_counts.shape} does not match "
+                f"baseline {self.baseline.counts.shape}")
+        mat = np.asarray(self._kernel(
+            jnp.asarray(window_counts, jnp.float32)))
+        report = DriftReport(index=index, kind=kind, n_rows=int(n_rows))
+        for i, s in enumerate(self.baseline.specs):
+            scope = PREDICTION_SCOPE if s.kind == CLASS else s.name
+            row_kind = NUMERIC if s.kind == NUMERIC else s.kind
+            report.rows.append(RowScore(
+                scope=scope, kind=row_kind,
+                stats={name: float(mat[i, j])
+                       for j, name in enumerate(STATS)}))
+        return report
+
+    def score_table(self, table, index: int = 0,
+                    class_codes: Optional[np.ndarray] = None) -> DriftReport:
+        """Convenience one-shot: encode + count + score a table as a
+        single window (jobs with in-memory windows)."""
+        import jax.numpy as jnp
+        from ..ops.histogram import feature_bin_counts
+        from .baseline import encode_monitor_codes
+        codes = encode_monitor_codes(table, self.baseline.specs,
+                                     class_codes=class_codes)
+        counts = np.asarray(feature_bin_counts(
+            jnp.asarray(codes), self.baseline.n_bins_max), dtype=np.float64)
+        return self.score_counts(counts, table.n_rows, index=index)

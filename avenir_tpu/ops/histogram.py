@@ -70,6 +70,22 @@ def class_bin_histogram_chunked(class_codes, bin_codes, num_classes, num_bins,
     return acc
 
 
+def feature_bin_counts(bin_codes: jnp.ndarray,   # (n, F) int
+                       num_bins: int,
+                       mask: Optional[jnp.ndarray] = None,
+                       dtype=jnp.float32) -> jnp.ndarray:
+    """counts[f, b] = #records with feature f in bin b — the classless
+    marginal of :func:`class_bin_histogram` (one dummy class).  The
+    counting primitive of the drift-monitoring subsystem: baseline
+    profiles and window accumulators are sums of these over row blocks
+    (monitor/baseline.py, monitor/accumulator.py).  Out-of-range codes
+    drop, masked rows contribute nothing."""
+    n = bin_codes.shape[0]
+    zeros = jnp.zeros((n,), dtype=jnp.int32)
+    return class_bin_histogram(zeros, bin_codes, 1, num_bins, mask,
+                               dtype)[0]
+
+
 def class_moments(class_codes: jnp.ndarray,   # (n,)
                   values: jnp.ndarray,        # (n, F) float
                   num_classes: int,

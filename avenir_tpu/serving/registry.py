@@ -194,16 +194,29 @@ class ModelRegistry:
 
     def is_intact(self, name: str, version: int) -> bool:
         """True when the version's meta.json parses, declares a known kind,
-        and arrays.npz opens (zip directory intact — a torn copy fails
-        here, same probe as core/checkpoint.is_intact)."""
+        and every file in its manifest probes intact (npz zip directory
+        opens, json parses, anything else exists non-empty — a torn copy
+        fails here, same probe as core/checkpoint.is_intact).  The
+        manifest (``meta["files"]``) covers optional sidecars generically
+        (e.g. the monitor baseline pair); artifacts published before the
+        manifest existed fall back to the arrays.npz probe."""
         d = self.version_dir(name, version)
         try:
             with open(os.path.join(d, META_FILE)) as fh:
                 meta = json.load(fh)
             if meta.get("kind") not in KINDS:
                 return False
-            with np.load(os.path.join(d, ARRAYS_FILE)) as z:
-                z.files
+            for fname in meta.get("files") or [ARRAYS_FILE]:
+                path = os.path.join(d, fname)
+                if fname.endswith(".npz"):
+                    with np.load(path) as z:
+                        z.files
+                elif fname.endswith(".json"):
+                    with open(path) as fh:
+                        json.load(fh)
+                elif not (os.path.isfile(path)
+                          and os.path.getsize(path) > 0):
+                    return False
             return True
         except Exception:
             return False
@@ -250,6 +263,9 @@ class ModelRegistry:
             "params": dict(params or {}),
             "model_json": model_json,
             "schema": schema.to_dict() if schema is not None else None,
+            # manifest of payload files the intactness probe must cover;
+            # add_sidecar extends it (meta.json itself is implied)
+            "files": [ARRAYS_FILE],
         }
 
         def write_arrays():
@@ -259,6 +275,56 @@ class ModelRegistry:
         write_json(os.path.join(tmp, META_FILE), meta)
         os.replace(tmp, final)
         return version
+
+    # ---- sidecars ----
+    def add_sidecar(self, name: str, version: int,
+                    files: Dict[str, bytes]) -> None:
+        """Attach extra payload files to a COMMITTED version and extend
+        its meta.json manifest, crash-safely: every sidecar file writes
+        ``<file>.tmp.<pid>`` and renames into place BEFORE the manifest
+        update (itself tmp-then-rename), so a crash at any point leaves
+        the version either intact-without-sidecar or intact-with — a
+        half-written sidecar is never listed, and a listed one that later
+        tears (dying-node copy-in) fails the is_intact probe."""
+        if not files:
+            return
+        d = self.version_dir(name, version)
+        meta_path = os.path.join(d, META_FILE)
+        with open(meta_path) as fh:
+            meta = json.load(fh)
+        reserved = {META_FILE, ARRAYS_FILE}
+        for fname, payload in files.items():
+            if os.path.basename(fname) != fname or fname in reserved:
+                raise ValueError(f"bad sidecar file name {fname!r}")
+            final = os.path.join(d, fname)
+            tmp = final + f".tmp.{os.getpid()}"
+
+            def write(tmp=tmp, final=final, payload=payload):
+                fault_point("registry_sidecar")
+                with open(tmp, "wb") as fh:
+                    fh.write(payload)
+                os.replace(tmp, final)
+            with_retry(write,
+                       what=f"sidecar write {name} v{version} {fname}")
+        manifest = list(meta.get("files") or [ARRAYS_FILE])
+        manifest.extend(f for f in files if f not in manifest)
+        meta["files"] = manifest
+        tmp_meta = meta_path + f".tmp.{os.getpid()}"
+        with open(tmp_meta, "w") as fh:
+            json.dump(meta, fh, indent=2)
+        os.replace(tmp_meta, meta_path)
+
+    def read_sidecar(self, name: str, version: int, fname: str) -> bytes:
+        """Read one sidecar payload; FileNotFoundError when the version
+        does not carry it (not listed in the manifest)."""
+        d = self.version_dir(name, version)
+        with open(os.path.join(d, META_FILE)) as fh:
+            meta = json.load(fh)
+        if fname not in (meta.get("files") or []):
+            raise FileNotFoundError(
+                f"model {name!r} v{version} has no sidecar {fname!r}")
+        with open(os.path.join(d, fname), "rb") as fh:
+            return fh.read()
 
     # ---- load ----
     def load(self, name: str, version: Optional[int] = None,

@@ -83,7 +83,8 @@ class PredictionService:
                  warm: bool = True,
                  delim: str = ",",
                  ambiguous_label: str = AMBIGUOUS,
-                 error_label: str = "error"):
+                 error_label: str = "error",
+                 monitor=None):
         if predictor is None and (registry is None or model_name is None):
             raise ValueError("need a predictor, or registry= + model_name=")
         self.registry = registry
@@ -99,11 +100,19 @@ class PredictionService:
         self.ambiguous_label = ambiguous_label
         self.error_label = error_label
         self.version: Optional[int] = None
+        # drift/quality hook (monitor.accumulator.ServingMonitor): every
+        # served micro-batch records through it; None = unmonitored
+        self.monitor = monitor
+        # set by mark_degraded (e.g. a drift policy's degrade_action):
+        # serving continues, operators see the reason + counter
+        self.degraded: Optional[str] = None
         self._swap_lock = threading.Lock()
         if predictor is None:
             predictor = self._load(must=True)
         elif warm:
             predictor.warm()
+        if monitor is not None and warm and hasattr(monitor, "warm"):
+            monitor.warm()   # monitor compiles must not race live traffic
         self.predictor = predictor
         self._queue: "queue.Queue[_Request]" = queue.Queue()
         self._stop = threading.Event()
@@ -146,8 +155,16 @@ class PredictionService:
         with self._swap_lock:
             self.predictor = pred
             self.version = latest
+        self.degraded = None   # a fresh model clears the degraded flag
         self.counters.increment("Serving", "HotSwaps")
         return True
+
+    def mark_degraded(self, reason: str) -> None:
+        """Flag the served model as degraded (drift policy guardrail).
+        Serving continues — the flag and counter are the operator
+        signal; a successful :meth:`refresh` hot-swap clears it."""
+        self.degraded = reason
+        self.counters.increment("Serving", "Degraded")
 
     # ---- prediction ----
     def _label(self, pred: Optional[str]) -> str:
@@ -178,7 +195,9 @@ class PredictionService:
         BatchPolicy with."""
         import warnings
         try:
-            return [("ok", lab) for lab in self.predict_rows(rows)]
+            results = [("ok", lab) for lab in self.predict_rows(rows)]
+            self._record_monitor(rows, results)
+            return results
         except Exception as exc:
             warnings.warn(
                 f"serving: batch predict failed ({type(exc).__name__}: "
@@ -199,7 +218,26 @@ class PredictionService:
         self.counters.increment("Serving", "Requests", len(rows))
         self.counters.increment("Serving", "Batches")
         self.counters.increment("Serving", "IsolatedBatches")
+        self._record_monitor(rows, out)
         return out
+
+    def _record_monitor(self, rows, results) -> None:
+        """Feed successfully answered (row, label) pairs to the drift
+        monitor hook.  Cheap on the request path (the hook only
+        buffers); monitoring failures are warned, never propagated —
+        observability must not take serving down."""
+        if self.monitor is None:
+            return
+        import warnings
+        try:
+            ok_rows = [r for r, (st, _) in zip(rows, results) if st == "ok"]
+            ok_labels = [v for st, v in results if st == "ok"]
+            if ok_rows:
+                self.monitor.record_batch(ok_rows, ok_labels)
+        except Exception as exc:
+            warnings.warn(f"serving: monitor hook failed "
+                          f"({type(exc).__name__}: {exc}); continuing "
+                          f"unmonitored for this batch", RuntimeWarning)
 
     # ---- message contract (shared by both transports) ----
     def process(self, message: str) -> Optional[str]:

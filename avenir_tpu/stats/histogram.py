@@ -56,15 +56,36 @@ class Histogram:
         return c / c[-1] if c[-1] > 0 else c
 
     def percentile(self, percent: float) -> float:
-        """Smallest bin upper edge whose cumulative share >= percent/100."""
+        """Smallest bin upper edge whose cumulative share >= percent/100.
+
+        Explicit edge behavior (pinned by tests/test_stats.py):
+        ``percent`` clamps into [0, 100]; an EMPTY histogram (no mass at
+        all) returns ``xmin`` — there is no distribution to locate a
+        quantile in, and raising would turn a quiet stream into a
+        crashed monitor.  The result is always a bin UPPER edge, so with
+        all mass in the last bin it is ``xmin + bin_width*len(bins)`` —
+        up to one bin width past ``xmax``, because ``xmax`` is the last
+        bin's LEFT edge (create_uninitialized's bins-cover-[min, max]
+        convention).  Callers whose bins tile the range exactly (e.g.
+        monitor baselines) get exact range-top quantiles; do NOT clamp
+        to xmax here — that would under-report every top-bin quantile
+        by a full bin width for them.  Works on unnormalized bins
+        (cum_distr normalizes internally)."""
         cum = self.cum_distr()
+        if cum[-1] <= 0.0:
+            return self.xmin
+        percent = min(max(percent, 0.0), 100.0)
         k = int(np.searchsorted(cum, percent / 100.0))
         k = min(k, len(self.bins) - 1)
         return self.xmin + self.bin_width * (k + 1)
 
     def value(self, x: float) -> float:
-        """Density/count of the bin containing x (0 outside range)."""
-        if x < self.xmin:  # int() truncates toward zero: guard explicitly
+        """Content of the bin containing x: the raw COUNT before
+        :meth:`normalize`, the probability share after (callers needing
+        density divide by bin_width).  Out-of-range x on either side
+        returns 0.0 — never a clamped edge bin (``int()`` truncates
+        toward zero, so the sub-xmin guard is explicit)."""
+        if x < self.xmin:
             return 0.0
         k = int((x - self.xmin) / self.bin_width)
         if k >= len(self.bins):
@@ -72,6 +93,11 @@ class Histogram:
         return float(self.bins[k])
 
     def cum_value(self, x: float) -> float:
+        """Cumulative share at x (always normalized, whether or not
+        :meth:`normalize` ran — cum_distr divides by the total).  Below
+        xmin: 0.0; at/above the top edge: the full share (1.0, or 0.0
+        for an empty histogram — an empty cumulative is 0 everywhere,
+        not NaN)."""
         if x < self.xmin:
             return 0.0
         k = min(int((x - self.xmin) / self.bin_width), len(self.bins) - 1)

@@ -232,6 +232,92 @@ def bench_serve_forest(scale):
             "n_requests": n_req, "trees": len(models), "loads": loads}
 
 
+def bench_monitor_drift(scale):
+    """Drift monitoring: (a) rows/s through the window accumulator +
+    vectorized scoring kernel, (b) the serving-overhead delta — closed-
+    loop serve_forest throughput with the ServingMonitor hook enabled vs
+    unmonitored (the <5% budget of ISSUE 4)."""
+    _force_platform()
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "resource"))
+    from gen.call_hangup_gen import generate
+    from avenir_tpu.core.schema import FeatureSchema
+    from avenir_tpu.core.table import load_csv_text
+    from avenir_tpu.models.forest import ForestParams, build_forest
+    from avenir_tpu.monitor import (DriftPolicy, ServingMonitor,
+                                    StreamDriftMonitor, compute_baseline)
+    from avenir_tpu.parallel.mesh import MeshContext
+    from avenir_tpu.serving.predictor import ForestPredictor
+    from avenir_tpu.serving.service import BatchPolicy, PredictionService
+    schema = FeatureSchema.load(os.path.join(
+        os.path.dirname(__file__), "..", "resource", "call_hangup.json"))
+    n_train = max(int(50_000 * scale), 2_000)
+    rows = [line.split(",") for line in generate(n_train + 4096, 1)]
+    table = load_csv_text(
+        "\n".join(",".join(r) for r in rows[:n_train]), schema)
+    baseline = compute_baseline(table)
+
+    # (a) scoring throughput: window-sized blocks through accumulate+score
+    n_score = max(int(500_000 * scale), 20_000)
+    window_rows = 4096
+    mon = StreamDriftMonitor(baseline, window_rows=window_rows)
+    block = table.take_rows(0, min(window_rows, table.n_rows))
+    mon.observe_table(block)  # warm the absorb/score compiles
+    mon.close_window()
+    t0 = time.perf_counter()
+    scored = 0
+    while scored < n_score:
+        mon.observe_table(block)
+        scored += block.n_rows
+    mon.close_window()
+    score_dt = time.perf_counter() - t0
+
+    # (b) serving overhead at the serve_forest closed-loop point
+    params = ForestParams(num_trees=5, seed=1)
+    params.tree.max_depth = 4
+    models = build_forest(table, params, MeshContext())
+    req_rows = rows[n_train:]
+    n_req = max(int(2_000 * scale), 500)
+
+    def closed_loop(monitor, reps: int = 3):
+        """Peak of ``reps`` measured passes on one warmed service —
+        coalescing dynamics make single closed-loop passes ±10% noisy,
+        and the overhead delta is the whole point of this measurement."""
+        predictor = ForestPredictor(models, schema).warm()
+        if monitor is not None:
+            monitor.warm()
+        svc = PredictionService(
+            predictor, warm=False, monitor=monitor,
+            policy=BatchPolicy(max_batch=64, max_wait_ms=2.0))
+        svc.start()
+        # warm the submit path (past a full monitor window)
+        for f in [svc.submit(req_rows[i % len(req_rows)])
+                  for i in range(1500)]:
+            f.result(timeout=120)
+        best = 0.0
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            futures = [svc.submit(req_rows[i % len(req_rows)])
+                       for i in range(n_req)]
+            for f in futures:
+                f.result(timeout=120)
+            best = max(best, n_req / (time.perf_counter() - t0))
+        svc.stop()
+        if monitor is not None:
+            monitor.close()
+        return best
+
+    plain = closed_loop(None)
+    monitored = closed_loop(ServingMonitor(
+        baseline, schema, policy=DriftPolicy(), window_rows=1024))
+    overhead = 1.0 - monitored / plain
+    return {"metric": "monitor_drift_rows_per_sec",
+            "value": round(scored / score_dt, 1), "n_rows_scored": scored,
+            "window_rows": window_rows,
+            "serve_plain_req_per_sec": round(plain, 1),
+            "serve_monitored_req_per_sec": round(monitored, 1),
+            "serving_overhead_fraction": round(overhead, 4)}
+
+
 BENCHES = {
     "naive_bayes": bench_naive_bayes,
     "random_forest": bench_random_forest,
@@ -239,6 +325,7 @@ BENCHES = {
     "sa": bench_sa,
     "logistic": bench_logistic,
     "serve_forest": bench_serve_forest,
+    "monitor_drift": bench_monitor_drift,
 }
 
 

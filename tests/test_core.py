@@ -179,6 +179,46 @@ def test_confusion_matrix_batch_matches_scalar():
            (cm2.true_pos, cm2.false_pos, cm2.true_neg, cm2.false_neg)
 
 
+def test_counters_json_roundtrip():
+    """to_json/from_json: stable byte-identical serialization for equal
+    counters, lossless round trip — jobs and the bench harness consume
+    this instead of parsing render() text."""
+    import json
+    c = Counters()
+    c.increment("Zeta", "b", 5)
+    c.increment("Alpha", "z", 1)
+    c.increment("Alpha", "a", 3)
+    c.set("Alpha", "a", 7)
+    text = c.to_json()
+    # stable key order: groups and names sorted, compact separators
+    assert text == '{"Alpha":{"a":7,"z":1},"Zeta":{"b":5}}'
+    back = Counters.from_json(text)
+    assert back.as_dict() == c.as_dict()
+    assert back.to_json() == text
+    # insertion order must not leak into the bytes
+    c2 = Counters()
+    c2.set("Zeta", "b", 5)
+    c2.set("Alpha", "a", 7)
+    c2.set("Alpha", "z", 1)
+    assert c2.to_json() == text
+    assert json.loads(Counters().to_json()) == {}
+
+
+def test_counters_jsonl_append(tmp_path):
+    import json
+    path = str(tmp_path / "counters.jsonl")
+    c = Counters()
+    c.increment("G", "n", 2)
+    c.append_jsonl(path, tag="window-0")
+    c.increment("G", "n", 1)
+    c.append_jsonl(path, tag="window-1")
+    with open(path) as fh:
+        lines = [json.loads(line) for line in fh]
+    assert [ln["tag"] for ln in lines] == ["window-0", "window-1"]
+    assert lines[0]["counters"] == {"G": {"n": 2}}
+    assert lines[1]["counters"] == {"G": {"n": 3}}
+
+
 def test_cost_arbitrator():
     arb = CostBasedArbitrator("F", "T", false_neg_cost=3, false_pos_cost=1)
     # threshold = 100*1//4 = 25

@@ -52,6 +52,7 @@ EXPECTED = {
         "probabilistic_suffix_tree_generator",
     "org.avenir.markov.ViterbiStatePredictor": "viterbi_state_predictor",
     "org.avenir.model.ModelPredictor": "model_predictor_job",
+    "org.avenir.monitor.DriftMonitor": "drift_monitor",
     "org.avenir.regress.LogisticRegressionJob": "logistic_regression",
     "org.avenir.regress.LogisticRegressionPredictor":
         "logistic_regression_predictor",

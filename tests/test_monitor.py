@@ -1,0 +1,514 @@
+"""Drift & model-quality monitoring: numpy-oracle parity for every
+statistic, baseline build/publish round-trips, window accumulators,
+threshold policy, and the registry sidecar manifest.
+
+The contract under test (ISSUE 4): one vectorized kernel scores a
+finalized window against the baseline across all features at once; a
+synthetically shifted stream (mean-shifted numeric + reweighted
+categorical) alerts after debounce while a same-distribution stream
+stays under thresholds; baselines ride registry versions as sidecars
+with the same torn-artifact discipline as the model payload."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core.schema import FeatureSchema
+from avenir_tpu.core.table import ColumnarTable, encode_rows
+from avenir_tpu.monitor.baseline import (BASELINE_NPZ, Baseline,
+                                         BaselineBuilder, RowSpec,
+                                         compute_baseline, load_baseline,
+                                         publish_baseline)
+from avenir_tpu.monitor.accumulator import (DriftAccumulator,
+                                            StreamDriftMonitor)
+from avenir_tpu.monitor.drift import STATS, DriftReport, DriftScorer, \
+    RowScore
+from avenir_tpu.monitor.policy import (AccuracyTracker, DriftPolicy,
+                                       degrade_action, refresh_action)
+from avenir_tpu.serving.registry import ModelRegistry
+
+pytestmark = pytest.mark.monitor
+
+
+SCHEMA = FeatureSchema.from_dict({"fields": [
+    {"name": "x1", "ordinal": 0, "dataType": "double", "feature": True,
+     "min": -6, "max": 6},
+    {"name": "hold", "ordinal": 1, "dataType": "int", "feature": True,
+     "bucketWidth": 60, "min": 0, "max": 600},
+    {"name": "cat", "ordinal": 2, "dataType": "categorical",
+     "feature": True, "cardinality": ["a", "b", "c"]},
+    {"name": "free", "ordinal": 3, "dataType": "double", "feature": True},
+    {"name": "y", "ordinal": 4, "dataType": "categorical",
+     "cardinality": ["n", "p"]}]})
+
+
+def make_rows(rng, n, mu=0.0, cat_w=(0.5, 0.3, 0.2), p_pos=0.4):
+    xs = rng.normal(mu, 1.0, n)
+    holds = rng.integers(0, 600, n)
+    cats = rng.choice(["a", "b", "c"], size=n, p=cat_w)
+    frees = rng.normal(10.0 + mu, 2.0, n)
+    ys = rng.choice(["n", "p"], size=n, p=(1 - p_pos, p_pos))
+    return [[f"{x:.4f}", str(h), c, f"{fr:.4f}", y]
+            for x, h, c, fr, y in zip(xs, holds, cats, frees, ys)]
+
+
+def base_table(n=16000, seed=0):
+    return encode_rows(make_rows(np.random.default_rng(seed), n), SCHEMA)
+
+
+# --------------------------------------------------------------------------
+# numpy oracles (written independently of the kernel)
+# --------------------------------------------------------------------------
+
+def oracle_stats(p_counts, q_counts, eps=1e-6):
+    """All five statistics over ONE row's valid bins, pure float64."""
+    p_counts = np.asarray(p_counts, np.float64)
+    q_counts = np.asarray(q_counts, np.float64)
+    p = p_counts / max(p_counts.sum(), 1.0)
+    q = q_counts / max(q_counts.sum(), 1.0)
+    pc, qc = np.maximum(p, eps), np.maximum(q, eps)
+    psi = float(np.sum((qc - pc) * np.log(qc / pc)))
+    kl = float(np.sum(qc * np.log(qc / pc)))
+    m = 0.5 * (pc + qc)
+    js = float(0.5 * np.sum(pc * np.log(pc / m))
+               + 0.5 * np.sum(qc * np.log(qc / m)))
+    ks = float(np.max(np.abs(np.cumsum(p - q))))
+    # chi2 excludes bins the baseline never populated (classic
+    # zero-expected-count rule; the kernel mirrors this)
+    support = p > 0
+    chi2 = float(np.sum(((q - p) ** 2 / pc)[support]))
+    return {"psi": psi, "kl": kl, "js": js, "ks": ks, "chi2": chi2}
+
+
+def fake_baseline(bin_sizes, counts_rows, n_rows):
+    """Hand-built Baseline over heterogeneous bin alphabets (exercises
+    the pad-to-B_max masking)."""
+    b_max = max(bin_sizes)
+    specs, counts = [], np.zeros((len(bin_sizes), b_max))
+    for i, nb in enumerate(bin_sizes):
+        kind = "class" if i == len(bin_sizes) - 1 else \
+            ("categorical" if i % 2 else "numeric")
+        specs.append(RowSpec(name=f"r{i}", kind=kind, ordinal=i, n_bins=nb,
+                             labels=None if kind == "numeric" else
+                             [f"v{j}" for j in range(nb)]))
+        counts[i, :nb] = counts_rows[i]
+    return Baseline(specs=specs, counts=counts, n_rows=n_rows)
+
+
+def test_scorer_matches_numpy_oracle_per_statistic():
+    rng = np.random.default_rng(3)
+    bin_sizes = [8, 4, 16, 3, 5]
+    p_rows = [rng.integers(1, 1000, nb) for nb in bin_sizes]
+    q_rows = [rng.integers(0, 500, nb) for nb in bin_sizes]
+    baseline = fake_baseline(bin_sizes, p_rows, sum(map(sum, p_rows)))
+    window = np.zeros_like(baseline.counts)
+    for i, nb in enumerate(bin_sizes):
+        window[i, :nb] = q_rows[i]
+    report = DriftScorer(baseline).score_counts(window, 100)
+    assert len(report.rows) == len(bin_sizes)
+    for i, row in enumerate(report.rows):
+        expect = oracle_stats(p_rows[i], q_rows[i])
+        for stat in STATS:
+            np.testing.assert_allclose(
+                row.stats[stat], expect[stat], rtol=2e-3, atol=1e-5,
+                err_msg=f"row {i} stat {stat}")
+
+
+def test_scorer_identical_distribution_scores_zero():
+    rng = np.random.default_rng(4)
+    bin_sizes = [8, 4, 3]
+    rows = [rng.integers(10, 1000, nb) for nb in bin_sizes]
+    baseline = fake_baseline(bin_sizes, rows, 1)
+    window = np.zeros_like(baseline.counts)
+    for i, nb in enumerate(bin_sizes):
+        # scaled counts: same distribution, different volume
+        window[i, :nb] = 3 * np.asarray(rows[i])
+    report = DriftScorer(baseline).score_counts(window, 1)
+    for row in report.rows:
+        for stat in STATS:
+            assert abs(row.stats[stat]) < 1e-5, (row.scope, stat)
+
+
+def test_scorer_empty_window_and_all_mass_extremes():
+    """ε handling: an all-empty window row and all-mass-in-one-bin on
+    both sides stay finite and match the oracle."""
+    bin_sizes = [6, 4]
+    p0 = np.zeros(6)
+    p0[1] = 500.0                      # baseline mass in ONE bin
+    p1 = np.array([5, 5, 5, 5.0])
+    baseline = fake_baseline(bin_sizes, [p0, p1], 520)
+    window = np.zeros_like(baseline.counts)
+    window[0, 4] = 333.0               # window mass in a DIFFERENT bin
+    # row 1 stays empty: q = 0 everywhere
+    report = DriftScorer(baseline).score_counts(window, 333)
+    for i, (p, q) in enumerate([(p0, window[0, :6]), (p1, window[1, :4])]):
+        expect = oracle_stats(p, q)
+        for stat in STATS:
+            v = report.rows[i].stats[stat]
+            assert np.isfinite(v)
+            np.testing.assert_allclose(v, expect[stat], rtol=2e-3,
+                                       atol=1e-5,
+                                       err_msg=f"row {i} stat {stat}")
+    # the disjoint-support extreme is a LARGE drift, not a NaN
+    assert report.rows[0].stats["psi"] > 5.0
+    assert report.rows[0].stats["ks"] > 0.99
+
+
+def test_one_stray_unknown_token_does_not_alert_chi2():
+    """A single unknown categorical value (or ambiguous prediction) in a
+    big window lands in a bin the baseline never populated; the ε
+    denominator must not turn it into an alert-level chi² — the
+    zero-expected-count exclusion keeps it ~0 (new-category MASS still
+    registers through psi/kl/js as it grows)."""
+    from avenir_tpu.monitor.policy import DEFAULT_WARN
+    baseline = compute_baseline(base_table(8000))
+    rng = np.random.default_rng(13)
+    rows = make_rows(rng, 2048)
+    rows[0][2] = "NEVER_SEEN"           # one unknown categorical token
+    report = DriftScorer(baseline).score_table(encode_rows(rows, SCHEMA))
+    cat = report.row("cat")
+    assert cat.stats["chi2"] < DEFAULT_WARN["chi2"] / 2
+    assert cat.stats["psi"] < DEFAULT_WARN["psi"]
+
+
+def test_stat_kind_applicability():
+    bin_sizes = [4, 4, 4]
+    baseline = fake_baseline(bin_sizes, [np.ones(4)] * 3, 4)
+    report = DriftScorer(baseline).score_counts(
+        np.zeros_like(baseline.counts), 0)
+    numeric, categorical, cls = report.rows
+    assert numeric.applicable("ks") and not categorical.applicable("ks")
+    assert categorical.applicable("chi2") and not numeric.applicable("chi2")
+    for r in report.rows:
+        assert r.applicable("psi") and r.applicable("js")
+    assert cls.applicable("chi2") and not cls.applicable("ks")
+
+
+# --------------------------------------------------------------------------
+# baseline building
+# --------------------------------------------------------------------------
+
+def test_baseline_chunked_equals_monolithic():
+    from avenir_tpu.monitor.baseline import resolve_spec_bounds
+    table = base_table(9000)
+    mono = compute_baseline(table)
+    b = BaselineBuilder(SCHEMA)
+    # the min/max-less 'free' field resolves its bins from the first
+    # chunk it sees; pin the full-table bounds so both paths bin alike
+    resolve_spec_bounds(b.specs, table)
+    for lo in range(0, 9000, 2000):            # uneven tail chunk
+        b.update(table.take_rows(lo, min(lo + 2000, 9000)))
+    chunked = b.finalize()
+    np.testing.assert_array_equal(mono.counts, chunked.counts)
+    assert mono.n_rows == chunked.n_rows == 9000
+    # every row's mass equals the row count (nothing dropped or doubled)
+    for i, s in enumerate(mono.specs):
+        assert mono.counts[i].sum() == 9000, s.name
+
+
+def test_baseline_quantiles_track_the_data():
+    table = base_table(20000)
+    baseline = compute_baseline(table)
+    i = baseline.row_index("x1")
+    qs = dict(zip(baseline.quantile_qs, baseline.quantiles[i]))
+    x = np.asarray(table.columns[0])
+    # bin-resolution agreement with the exact quantiles (bins are 12/32
+    # wide; upper-edge convention biases one bin high)
+    assert abs(qs[50.0] - np.quantile(x, 0.5)) < 0.8
+    assert abs(qs[95.0] - np.quantile(x, 0.95)) < 0.8
+    assert list(baseline.quantiles[i]) == sorted(baseline.quantiles[i])
+    # categorical/class rows carry no quantiles
+    assert np.isnan(baseline.quantiles[baseline.class_row]).all()
+    # top-bin quantiles report the bin's true UPPER edge (a clamp to the
+    # last bin's left edge would under-report by a full bin width)
+    top = encode_rows([["0.0", "599", "a", "1.0", "n"]] * 50, SCHEMA)
+    tb = compute_baseline(top)
+    hold = tb.row_index("hold")
+    assert (tb.quantiles[hold] == 600.0).all()
+
+
+def test_baseline_unbounded_numeric_resolves_from_first_chunk():
+    """The 'free' field has no schema min/max: bins resolve from the
+    first chunk, later out-of-range values clamp to edge bins (counted,
+    never dropped)."""
+    table = base_table(4000)
+    b = BaselineBuilder(SCHEMA)
+    b.update(table)
+    far = encode_rows([["0.0", "0", "a", "99999.0", "n"]], SCHEMA)
+    b.update(far)
+    baseline = b.finalize()
+    i = baseline.row_index("free")
+    spec = baseline.specs[i]
+    assert spec.n_bins > 0 and spec.width > 0
+    assert baseline.counts[i].sum() == 4001          # clamped, not lost
+    assert baseline.counts[i, spec.n_bins - 1] >= 1  # in the top edge bin
+
+
+def test_baseline_sidecar_roundtrip_bit_stable(tmp_path):
+    table = base_table(5000)
+    baseline = compute_baseline(table)
+    reg = ModelRegistry(str(tmp_path))
+    v = reg.publish("m", np.arange(3, dtype=np.float64), kind="logistic",
+                    schema=SCHEMA, params={"pos_class_value": "p"})
+    publish_baseline(reg, "m", v, baseline)
+    loaded = load_baseline(reg, "m", v)
+    # arrays byte-identical through the npz round trip
+    assert loaded.counts.dtype == baseline.counts.dtype
+    np.testing.assert_array_equal(loaded.counts, baseline.counts)
+    np.testing.assert_array_equal(loaded.quantiles, baseline.quantiles)
+    assert loaded.n_rows == baseline.n_rows
+    assert [s.to_dict() for s in loaded.specs] == \
+        [s.to_dict() for s in baseline.specs]
+    # ...and scoring through either object is bit-identical
+    window = base_table(2000, seed=9)
+    r1 = DriftScorer(baseline).score_table(window)
+    r2 = DriftScorer(loaded).score_table(window)
+    for a, b in zip(r1.rows, r2.rows):
+        assert a.stats == b.stats
+    # load_baseline with version=None resolves the newest intact version
+    assert load_baseline(reg, "m").n_rows == baseline.n_rows
+
+
+# --------------------------------------------------------------------------
+# registry sidecar manifest (satellite)
+# --------------------------------------------------------------------------
+
+def _publish_with_baseline(tmp_path, name="m"):
+    reg = ModelRegistry(str(tmp_path))
+    v = reg.publish(name, np.arange(3, dtype=np.float64), kind="logistic",
+                    schema=SCHEMA, params={"pos_class_value": "p"})
+    publish_baseline(reg, name, v, compute_baseline(base_table(2000)))
+    return reg, v
+
+
+def test_sidecar_manifest_extends_intactness_probe(tmp_path):
+    reg, v = _publish_with_baseline(tmp_path)
+    with open(os.path.join(reg.version_dir("m", v), "meta.json")) as fh:
+        meta = json.load(fh)
+    assert meta["files"] == ["arrays.npz", "baseline.json", "baseline.npz"]
+    assert reg.is_intact("m", v)
+
+
+def test_torn_sidecar_fails_probe_and_is_skipped(tmp_path):
+    """A listed sidecar that tears (dying-node copy-in) makes the whole
+    version non-intact; latest_version falls back to the previous intact
+    version with a warning — the model-payload discipline, generalized."""
+    reg, v1 = _publish_with_baseline(tmp_path)
+    reg2, v2 = _publish_with_baseline(tmp_path)   # same dir -> version 2
+    assert v2 == 2 and reg.latest_version("m") == 2
+    npz = os.path.join(reg.version_dir("m", 2), BASELINE_NPZ)
+    with open(npz, "wb") as fh:
+        fh.write(b"PK\x03\x04torn")               # truncated zip
+    assert not reg.is_intact("m", 2)
+    with pytest.warns(RuntimeWarning, match="torn"):
+        assert reg.latest_version("m") == 1
+    # a MISSING listed sidecar also fails the probe
+    os.remove(npz)
+    assert not reg.is_intact("m", 2)
+
+
+def test_premanifest_artifact_stays_intact(tmp_path):
+    """Artifacts published before the manifest existed (no "files" key)
+    keep the old arrays.npz-only probe."""
+    reg = ModelRegistry(str(tmp_path))
+    v = reg.publish("m", np.arange(3, dtype=np.float64), kind="logistic",
+                    schema=SCHEMA, params={"pos_class_value": "p"})
+    meta_path = os.path.join(reg.version_dir("m", v), "meta.json")
+    with open(meta_path) as fh:
+        meta = json.load(fh)
+    del meta["files"]
+    with open(meta_path, "w") as fh:
+        json.dump(meta, fh)
+    assert reg.is_intact("m", v)
+    assert reg.latest_version("m") == v
+
+
+def test_sidecar_rejects_reserved_and_pathy_names(tmp_path):
+    reg, v = _publish_with_baseline(tmp_path)
+    with pytest.raises(ValueError, match="sidecar"):
+        reg.add_sidecar("m", v, {"meta.json": b"x"})
+    with pytest.raises(ValueError, match="sidecar"):
+        reg.add_sidecar("m", v, {"../evil": b"x"})
+
+
+@pytest.mark.faultinject
+def test_sidecar_publish_retries_transient_fault(tmp_path, fault_injector):
+    reg = ModelRegistry(str(tmp_path))
+    v = reg.publish("m", np.arange(3, dtype=np.float64), kind="logistic",
+                    schema=SCHEMA, params={"pos_class_value": "p"})
+    baseline = compute_baseline(base_table(1000))
+    inj = fault_injector("registry_sidecar@0=raise:OSError")
+    with pytest.warns(RuntimeWarning, match="retry"):
+        publish_baseline(reg, "m", v, baseline)
+    assert ("registry_sidecar", 0, "raise") in inj.log
+    assert reg.is_intact("m", v)
+    np.testing.assert_array_equal(load_baseline(reg, "m", v).counts,
+                                  baseline.counts)
+
+
+@pytest.mark.faultinject
+def test_sidecar_publish_crash_leaves_version_intact(tmp_path,
+                                                     fault_injector):
+    """A non-transient crash mid-sidecar-write must leave the version
+    intact WITHOUT the sidecar (manifest never lists a half-written
+    file)."""
+    reg = ModelRegistry(str(tmp_path))
+    v = reg.publish("m", np.arange(3, dtype=np.float64), kind="logistic",
+                    schema=SCHEMA, params={"pos_class_value": "p"})
+    fault_injector("registry_sidecar@*=raise:RuntimeErrorx9")
+    with pytest.raises(RuntimeError, match="injected"):
+        publish_baseline(reg, "m", v, compute_baseline(base_table(1000)))
+    assert reg.is_intact("m", v)
+    assert reg.latest_version("m") == v
+    with pytest.raises(FileNotFoundError):
+        load_baseline(reg, "m", v)
+
+
+# --------------------------------------------------------------------------
+# accumulator + windows
+# --------------------------------------------------------------------------
+
+def test_accumulator_matches_baseline_counts():
+    table = base_table(5000)
+    baseline = compute_baseline(table)
+    acc = DriftAccumulator(baseline)
+    for lo in range(0, 5000, 700):             # odd chunk sizes
+        acc.absorb_table(table.take_rows(lo, min(lo + 700, 5000)))
+    counts, n = acc.finalize()
+    assert n == 5000
+    np.testing.assert_array_equal(counts, baseline.counts)
+    # finalize resets (tumbling semantics)
+    counts2, n2 = acc.finalize()
+    assert n2 == 0 and counts2.sum() == 0
+    # warm() must not perturb accumulated state
+    acc.warm()
+    acc.absorb_table(table.take_rows(0, 100))
+    counts3, n3 = acc.finalize()
+    assert n3 == 100 and counts3.sum() == 100 * len(baseline.specs)
+
+
+def test_stream_monitor_rejects_bad_knobs():
+    baseline = compute_baseline(base_table(200))
+    with pytest.raises(ValueError, match="window_rows"):
+        StreamDriftMonitor(baseline, window_rows=0)   # would spin forever
+    with pytest.raises(ValueError, match="decay"):
+        StreamDriftMonitor(baseline, decay=1.0)
+
+
+def test_class_codes_for_labels_shared_encoding():
+    baseline = compute_baseline(base_table(200))
+    codes = baseline.class_codes_for_labels(["n", "p", "ambiguous", None])
+    unknown = baseline.specs[baseline.class_row].n_bins - 1
+    np.testing.assert_array_equal(codes, [0, 1, unknown, unknown])
+
+
+def test_stream_monitor_windows_and_ewma():
+    rng = np.random.default_rng(11)
+    table = base_table(6000)
+    baseline = compute_baseline(table)
+    mon = StreamDriftMonitor(baseline, window_rows=2000, decay=0.5)
+    mon.observe_table(encode_rows(make_rows(rng, 5000), SCHEMA))
+    # 2 full windows closed; 1000 rows still pending
+    windows = [r for r in mon.reports if r.kind == "window"]
+    longs = [r for r in mon.reports if r.kind == "longterm"]
+    assert len(windows) == 2 and len(longs) == 2
+    assert all(w.n_rows == 2000 for w in windows)
+    assert mon.acc.n_rows == 1000
+    tail = mon.close_window()
+    assert tail.n_rows == 1000
+    # ewma arithmetic: long_n = ((2000*0.5)+2000)*0.5 + 1000
+    assert mon._long_n == pytest.approx(2500.0)
+    assert mon.counters.get("DriftMonitor", "WindowsScored") == 3
+    assert mon.counters.get("DriftMonitor", "RowsSeen") == 5000
+
+
+def test_shifted_stream_alerts_same_dist_stays_quiet():
+    """THE acceptance pin: mean-shifted numeric + reweighted categorical
+    fire after the debounce, a same-distribution stream never clears the
+    warn bar."""
+    rng = np.random.default_rng(21)
+    baseline = compute_baseline(base_table(20000))
+
+    def run_stream(**kw):
+        policy = DriftPolicy(consecutive=2)
+        mon = StreamDriftMonitor(baseline, policy=policy, window_rows=2000)
+        for _ in range(3):
+            mon.observe_table(
+                encode_rows(make_rows(rng, 2000, **kw), SCHEMA))
+        return policy
+
+    quiet = run_stream()
+    assert quiet.alerts == []
+    assert quiet.counters.get("DriftMonitor", "Alerts") == 0
+
+    drifted = run_stream(mu=1.5, cat_w=(0.1, 0.2, 0.7))
+    scopes = {a.scope for a in drifted.alerts if a.level == "alert"}
+    assert {"x1", "cat", "free"} <= scopes      # both shifted families
+    assert drifted.counters.get("DriftMonitor", "Alerts") > 0
+    # debounce: nothing fires on the FIRST drifted window
+    assert min(a.window_index for a in drifted.alerts) >= 1
+
+
+# --------------------------------------------------------------------------
+# policy mechanics
+# --------------------------------------------------------------------------
+
+def _report(index, value, kind="window", scope="f", row_kind="numeric"):
+    return DriftReport(index=index, kind=kind, n_rows=100, rows=[
+        RowScore(scope=scope, kind=row_kind,
+                 stats={"psi": value, "kl": 0.0, "js": 0.0, "ks": 0.0,
+                        "chi2": 0.0})])
+
+
+def test_policy_debounce_requires_consecutive_windows():
+    pol = DriftPolicy(consecutive=3)
+    assert pol.observe(_report(0, 9.0)) == []
+    assert pol.observe(_report(1, 9.0)) == []
+    fired = pol.observe(_report(2, 9.0))
+    assert len(fired) == 1 and fired[0].level == "alert" \
+        and fired[0].streak == 3
+    # a quiet window resets the streak
+    assert pol.observe(_report(3, 0.0)) == []
+    assert pol.observe(_report(4, 9.0)) == []
+    assert pol.observe(_report(5, 9.0)) == []
+    assert len(pol.observe(_report(6, 9.0))) == 1
+
+
+def test_policy_warn_band_and_kind_separation():
+    pol = DriftPolicy(consecutive=2, warn={"psi": 0.1}, alert={"psi": 1.0})
+    pol.observe(_report(0, 0.5))
+    fired = pol.observe(_report(1, 0.5))
+    assert [f.level for f in fired] == ["warn"]
+    assert pol.counters.get("DriftMonitor", "Warnings") == 1
+    # longterm windows debounce independently of tumbling windows
+    pol2 = DriftPolicy(consecutive=2)
+    pol2.observe(_report(0, 9.0, kind="window"))
+    assert pol2.observe(_report(1, 9.0, kind="longterm")) == []
+
+
+def test_policy_accuracy_inverted_thresholds():
+    pol = DriftPolicy(consecutive=2, accuracy_warn=80, accuracy_alert=60)
+    with pytest.raises(ValueError, match="window"):
+        AccuracyTracker("p", "n", pol, window=0)   # would spin forever
+    tracker = AccuracyTracker("p", "n", pol, window=10)
+    good = tracker.record(["p"] * 10, ["p"] * 10)
+    assert good == []
+    # two consecutive bad windows -> alert (accuracy 50 < 60)
+    tracker.record(["p", "n"] * 5, ["p"] * 10)
+    fired = tracker.record(["p", "n"] * 5, ["p"] * 10)
+    assert [f.level for f in fired] == ["alert"]
+    assert fired[0].stat == "accuracy" and fired[0].value == 50.0
+    assert pol.counters.get("DriftMonitor", "LabeledOutcomes") == 30
+    # partial-window close scores what remains
+    tracker.record(["p"] * 4, ["p"] * 4)
+    assert tracker.close() == []
+
+
+def test_alert_record_json_is_structured():
+    pol = DriftPolicy(consecutive=1)
+    rec = pol.observe(_report(0, 9.0))[0]
+    d = json.loads(rec.to_json())
+    assert d["scope"] == "f" and d["stat"] == "psi" \
+        and d["level"] == "alert" and d["window_kind"] == "window"

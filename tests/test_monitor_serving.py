@@ -1,0 +1,470 @@
+"""Monitoring wired into serving and the CLI: the PredictionService hook,
+the guardrail actions, the driftMonitor job (file + RESP sources), the
+randomForestBuilder baseline-publish knob, and the overhead budget.
+
+Acceptance pins (ISSUE 4): the hook records every successfully served
+request exactly once with a request-path cost far inside the 5% budget;
+a live alert can hot-swap (refresh) or degrade the service; the CLI job
+flags a synthetically shifted stream while a same-distribution replay
+stays quiet."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core.config import Config
+from avenir_tpu.core.schema import FeatureSchema
+from avenir_tpu.core.table import encode_rows
+from avenir_tpu.monitor import (DriftPolicy, ServingMonitor,
+                                compute_baseline, degrade_action,
+                                load_baseline, refresh_action)
+from avenir_tpu.serving.registry import ModelRegistry
+from avenir_tpu.serving.service import BatchPolicy, PredictionService
+from tests.test_monitor import make_rows
+
+pytestmark = pytest.mark.monitor
+
+# test_monitor.SCHEMA with every numeric feature bounded — the forest
+# builder's split scan grid needs min/max on numeric features (the
+# unbounded-field baseline path is covered in test_monitor.py)
+SCHEMA = FeatureSchema.from_dict({"fields": [
+    {"name": "x1", "ordinal": 0, "dataType": "double", "feature": True,
+     "min": -6, "max": 6, "splitScanInterval": 3},
+    {"name": "hold", "ordinal": 1, "dataType": "int", "feature": True,
+     "bucketWidth": 60, "min": 0, "max": 600, "splitScanInterval": 120},
+    {"name": "cat", "ordinal": 2, "dataType": "categorical",
+     "feature": True, "maxSplit": 2, "cardinality": ["a", "b", "c"]},
+    {"name": "free", "ordinal": 3, "dataType": "double", "feature": True,
+     "min": 0, "max": 30, "splitScanInterval": 10},
+    {"name": "y", "ordinal": 4, "dataType": "categorical",
+     "cardinality": ["n", "p"]}]})
+
+
+def base_table(n, seed=0):
+    return encode_rows(make_rows(np.random.default_rng(seed), n), SCHEMA)
+
+
+def _forest_service(mesh_ctx, monitor=None, n=2000, seed=5, **svc_kw):
+    from avenir_tpu.models.forest import ForestParams, build_forest
+    from avenir_tpu.serving.predictor import ForestPredictor
+    table = base_table(n, seed=seed)
+    params = ForestParams(num_trees=3, seed=seed)
+    params.tree.max_depth = 3
+    models = build_forest(table, params, mesh_ctx)
+    pred = ForestPredictor(models, SCHEMA, buckets=(8, 64)).warm()
+    svc = PredictionService(pred, warm=False, monitor=monitor, **svc_kw)
+    return svc
+
+
+# --------------------------------------------------------------------------
+# the PredictionService hook
+# --------------------------------------------------------------------------
+
+def test_hook_records_every_served_request(mesh_ctx):
+    rng = np.random.default_rng(2)
+    baseline = compute_baseline(base_table(8000))
+    monitor = ServingMonitor(baseline, SCHEMA, window_rows=64,
+                             flush_rows=32, async_flush=False).warm()
+    svc = _forest_service(mesh_ctx, monitor=monitor,
+                          policy=BatchPolicy(max_batch=16, max_wait_ms=2.0))
+    rows = make_rows(rng, 160)
+    svc.start()
+    futures = [svc.submit(row) for row in rows]
+    labels = [f.result(timeout=60) for f in futures]
+    svc.stop()
+    monitor.close()
+    assert monitor.counters.get("DriftMonitor", "RowsSeen") == 160
+    assert monitor.counters.get("DriftMonitor", "WindowsScored") >= 2
+    # the prediction-class row accumulated the PREDICTED labels (64-row
+    # windows are deliberately tiny here — small-sample PSI noise is why
+    # quietness-under-thresholds pins on 2000-row windows in
+    # test_monitor.py, not here)
+    windows = [r for r in monitor.reports if r.kind == "window"]
+    assert windows and all(
+        any(row.scope == "__prediction__" for row in w.rows)
+        for w in windows)
+    assert set(labels) <= {"n", "p", svc.ambiguous_label}
+
+
+def test_hook_failure_never_breaks_serving(mesh_ctx):
+    """A monitor whose flush blows up must cost a warning, not answers."""
+    rng = np.random.default_rng(3)
+    baseline = compute_baseline(base_table(2000))
+    monitor = ServingMonitor(baseline, SCHEMA, window_rows=8,
+                             flush_rows=4, async_flush=False)
+    monitor.stream.observe_table = None       # sabotage the flush path
+    svc = _forest_service(mesh_ctx, monitor=monitor)
+    rows = make_rows(rng, 8)
+    with pytest.warns(RuntimeWarning, match="monitor"):
+        out = svc.process_batch(
+            [",".join(["predict", str(i)] + r) for i, r in enumerate(rows)])
+    assert len(out) == 8 and all("," in o for o in out)
+    assert monitor.counters.get("DriftMonitor", "RecordErrors") == 8
+
+
+def test_hook_request_path_within_budget(mesh_ctx):
+    """The <5% budget, pinned deterministically: the request-path cost of
+    record_batch (pure buffering — encode/absorb/score ride the monitor
+    thread) must be under 5% of the batch predict cost for the same
+    rows.  The closed-loop delta itself is benchmarked (monitor_drift
+    bench point) and soak-tested in the slow lane."""
+    rng = np.random.default_rng(4)
+    baseline = compute_baseline(base_table(4000))
+    monitor = ServingMonitor(baseline, SCHEMA, window_rows=1 << 20,
+                             flush_rows=1 << 20).warm()
+    svc = _forest_service(mesh_ctx, monitor=None)
+    batches = [make_rows(rng, 64) for _ in range(40)]
+    labels = ["n"] * 64
+    svc.predict_rows(batches[0])              # warm the predict path
+    t0 = time.perf_counter()
+    for b in batches:
+        svc.predict_rows(b)
+    predict_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for b in batches:
+        monitor.record_batch(b, labels)
+    record_s = time.perf_counter() - t0
+    assert record_s < 0.05 * predict_s, \
+        f"record {record_s:.4f}s vs predict {predict_s:.4f}s"
+
+
+def test_alert_triggers_refresh_hot_swap(tmp_path, mesh_ctx):
+    """The retrain/rollback loop: drifted traffic alerts, the refresh
+    action probes the registry, and a newer published version hot-swaps
+    in (the drift monitor closing the loop the registry opened)."""
+    from avenir_tpu.models.forest import ForestParams, build_forest
+    rng = np.random.default_rng(6)
+    table = base_table(3000, seed=6)
+    params = ForestParams(num_trees=3, seed=6)
+    params.tree.max_depth = 3
+    m1 = build_forest(table, params, mesh_ctx)
+    reg = ModelRegistry(str(tmp_path))
+    reg.publish("churn", m1, schema=SCHEMA)
+    svc = PredictionService(registry=reg, model_name="churn",
+                            buckets=(8, 64))
+    policy = DriftPolicy(consecutive=2,
+                         on_alert=refresh_action(svc))
+    baseline = compute_baseline(table)
+    monitor = ServingMonitor(baseline, SCHEMA, policy=policy,
+                             window_rows=64, flush_rows=64,
+                             async_flush=False)
+    svc.monitor = monitor
+    assert svc.version == 1
+    # publish v2 (the "retrain" that already landed), then drift traffic
+    m2 = build_forest(base_table(3000, seed=7), params, mesh_ctx)
+    reg.publish("churn", m2, schema=SCHEMA)
+    drifted = make_rows(rng, 256, mu=2.5, cat_w=(0.05, 0.1, 0.85))
+    svc.predict_rows(drifted[:128])
+    svc.process_batch([",".join(["predict", str(i)] + r)
+                       for i, r in enumerate(drifted[128:])])
+    monitor.close()
+    assert policy.alerts, "drifted traffic must alert"
+    assert svc.version == 2                    # refresh picked up v2
+    assert svc.counters.get("Serving", "HotSwaps") == 1
+
+
+def test_alert_degrade_action_and_refresh_clears(tmp_path, mesh_ctx):
+    svc = _forest_service(mesh_ctx)
+    act = degrade_action(svc)
+    from avenir_tpu.monitor.policy import AlertRecord
+    rec = AlertRecord(window_index=1, window_kind="window", scope="x1",
+                      stat="psi", value=2.0, threshold=0.25,
+                      level="alert", streak=2, n_rows=100)
+    act(rec)
+    assert svc.degraded is not None and "psi" in svc.degraded
+    assert svc.counters.get("Serving", "Degraded") == 1
+    # a successful hot-swap clears the flag
+    from avenir_tpu.models.forest import ForestParams, build_forest
+    reg = ModelRegistry(str(tmp_path))
+    params = ForestParams(num_trees=2, seed=1)
+    params.tree.max_depth = 2
+    reg.publish("m", build_forest(base_table(500), params, mesh_ctx),
+                schema=SCHEMA)
+    svc.registry, svc.model_name, svc.version = reg, "m", None
+    assert svc.refresh() is True
+    assert svc.degraded is None
+
+
+# --------------------------------------------------------------------------
+# CLI: baseline publish knob + driftMonitor job
+# --------------------------------------------------------------------------
+
+def _train_with_baseline(tmp_path, reg_dir, streaming=False):
+    from avenir_tpu.cli.jobs import random_forest_builder
+    rng = np.random.default_rng(8)
+    csv = tmp_path / "train.csv"
+    with open(csv, "w") as fh:
+        for r in make_rows(rng, 4000):
+            fh.write(",".join(r) + "\n")
+    schema_path = tmp_path / "schema.json"
+    schema_path.write_text(json.dumps(SCHEMA.to_dict()))
+    cfg = Config({
+        "field.delim.regex": ",", "field.delim.out": ",",
+        "dtb.feature.schema.file.path": str(schema_path),
+        "dtb.num.trees": "3", "dtb.random.seed": "7",
+        "dtb.max.depth.limit": "3",
+        "dtb.path.stopping.strategy": "maxDepth",
+        "dtb.model.registry.dir": str(reg_dir),
+        "dtb.model.name": "churn",
+        "dtb.baseline.publish": "true",
+    })
+    if streaming:
+        cfg.set("dtb.streaming.ingest", "true")
+        cfg.set("dtb.streaming.block.rows", "1024")
+    counters = random_forest_builder(cfg, str(csv), str(tmp_path / "out"))
+    return schema_path, counters
+
+
+def test_rf_builder_baseline_without_registry_refuses(tmp_path):
+    """dtb.baseline.publish=true without a registry dir must refuse at
+    job start (the misconfig would otherwise surface only when
+    driftMonitor finds no sidecar — after the training pass)."""
+    from avenir_tpu.cli.jobs import random_forest_builder
+    schema_path = tmp_path / "schema.json"
+    schema_path.write_text(json.dumps(SCHEMA.to_dict()))
+    csv = tmp_path / "t.csv"
+    csv.write_text("\n".join(
+        ",".join(r) for r in make_rows(np.random.default_rng(0), 50)))
+    with pytest.raises(ValueError, match="dtb.model.registry.dir"):
+        random_forest_builder(Config({
+            "dtb.feature.schema.file.path": str(schema_path),
+            "dtb.baseline.publish": "true",
+        }), str(csv), str(tmp_path / "out"))
+
+
+def test_rf_builder_publishes_baseline_sidecar(tmp_path):
+    reg_dir = tmp_path / "registry"
+    _, counters = _train_with_baseline(tmp_path, reg_dir)
+    assert counters.get("Random forest", "BaselineRows") == 4000
+    reg = ModelRegistry(str(reg_dir))
+    assert reg.is_intact("churn", 1)
+    baseline = load_baseline(reg, "churn", 1)
+    assert baseline.n_rows == 4000
+    assert baseline.specs[-1].kind == "class"
+
+
+def test_rf_builder_streaming_tee_same_baseline(tmp_path):
+    """The streamed ingest tees blocks through the baseline builder:
+    bit-equal counts to the monolithic pass (every field carries schema
+    bounds, so block boundaries cannot move bin edges)."""
+    reg_a = tmp_path / "reg_a"
+    reg_b = tmp_path / "reg_b"
+    _train_with_baseline(tmp_path, reg_a)
+    _train_with_baseline(tmp_path, reg_b, streaming=True)
+    a = load_baseline(ModelRegistry(str(reg_a)), "churn", 1)
+    b = load_baseline(ModelRegistry(str(reg_b)), "churn", 1)
+    np.testing.assert_array_equal(a.counts, b.counts)
+    assert a.n_rows == b.n_rows == 4000
+
+
+def _write_stream_csv(tmp_path, name, rows):
+    p = tmp_path / name
+    with open(p, "w") as fh:
+        for r in rows:
+            fh.write(",".join(r) + "\n")
+    return p
+
+
+def test_drift_monitor_job_flags_shift_quiet_on_same(tmp_path):
+    from avenir_tpu.cli.jobs import resolve
+    from avenir_tpu.cli import monitor_jobs  # noqa: F401  (registers)
+    reg_dir = tmp_path / "registry"
+    schema_path, _ = _train_with_baseline(tmp_path, reg_dir)
+    rng = np.random.default_rng(9)
+    job = resolve("driftMonitor")
+    base_cfg = {
+        "field.delim.regex": ",", "field.delim.out": ",",
+        "dm.model.registry.dir": str(reg_dir),
+        "dm.model.name": "churn",
+        "dm.window.rows": "1000",
+        "dm.consecutive.windows": "2",
+    }
+
+    same = _write_stream_csv(tmp_path, "same.csv", make_rows(rng, 3000))
+    out_same = tmp_path / "out_same"
+    c_same = job(Config(dict(base_cfg)), str(same), str(out_same))
+    assert c_same.get("DriftMonitor", "Alerts") == 0
+    assert c_same.get("DriftMonitor", "WindowsScored") == 3
+    assert not os.path.exists(out_same / "alerts.jsonl")
+
+    shifted = _write_stream_csv(
+        tmp_path, "shifted.csv",
+        make_rows(rng, 3000, mu=1.5, cat_w=(0.1, 0.2, 0.7)))
+    out_shift = tmp_path / "out_shift"
+    c_shift = job(Config(dict(base_cfg)), str(shifted), str(out_shift))
+    assert c_shift.get("DriftMonitor", "Alerts") > 0
+    with open(out_shift / "alerts.jsonl") as fh:
+        alerts = [json.loads(line) for line in fh]
+    assert {"x1", "cat"} <= {a["scope"] for a in alerts}
+    # report rows: CSV out like every other job, stats + immediate level
+    with open(out_shift / "part-r-00000") as fh:
+        lines = [line.split(",") for line in fh.read().splitlines()]
+    assert all(len(ln) == 11 for ln in lines)
+    by_scope = {(ln[0], ln[2]): ln for ln in lines if ln[1] == "window"}
+    assert by_scope[("1", "x1")][-1] == "alert"
+    # machine-readable counters (Counters.to_json satellite) round-trip
+    from avenir_tpu.core.metrics import Counters
+    with open(out_shift / "counters.json") as fh:
+        loaded = Counters.from_json(fh.read())
+    assert loaded.get("DriftMonitor", "Alerts") == \
+        c_shift.get("DriftMonitor", "Alerts")
+    # rerunning a QUIET stream into the same out dir must not leave the
+    # previous run's alerts.jsonl behind (its existence IS the signal)
+    job(Config(dict(base_cfg)), str(same), str(out_shift))
+    assert not os.path.exists(out_shift / "alerts.jsonl")
+
+
+def test_drift_monitor_job_predictions_and_accuracy(tmp_path):
+    """dm.score.predictions: the model runs per window, prior drift is
+    scored on PREDICTED labels, and delayed-label accuracy feeds the
+    policy (labels deliberately shuffled to tank accuracy)."""
+    from avenir_tpu.cli.jobs import resolve
+    reg_dir = tmp_path / "registry"
+    schema_path, _ = _train_with_baseline(tmp_path, reg_dir)
+    rng = np.random.default_rng(10)
+    rows = make_rows(rng, 2000)
+    for r in rows:                     # shuffled labels: accuracy ~50%
+        r[4] = "p" if rng.random() < 0.5 else "n"
+    stream = _write_stream_csv(tmp_path, "labeled.csv", rows)
+    out = tmp_path / "out_pred"
+    counters = resolve("driftMonitor")(Config({
+        "field.delim.regex": ",", "field.delim.out": ",",
+        "dm.model.registry.dir": str(reg_dir),
+        "dm.model.name": "churn",
+        "dm.window.rows": "500",
+        "dm.consecutive.windows": "2",
+        "dm.score.predictions": "true",
+        "dm.accuracy.warn": "95", "dm.accuracy.alert": "90",
+        "dm.accuracy.window": "500",
+    }), str(stream), str(out))
+    assert counters.get("DriftMonitor", "LabeledOutcomes") == 2000
+    with open(out / "alerts.jsonl") as fh:
+        alerts = [json.loads(line) for line in fh]
+    acc = [a for a in alerts if a["stat"] == "accuracy"]
+    assert acc and all(a["window_kind"] == "quality" for a in acc)
+    assert acc[-1]["value"] < 90
+
+
+def test_drift_monitor_job_skips_malformed_records(tmp_path):
+    """One bad token in the stream must cost a BadRecords tally, not the
+    job (nor, on a RESP source, every drained record)."""
+    from avenir_tpu.cli.jobs import resolve
+    reg_dir = tmp_path / "registry"
+    _train_with_baseline(tmp_path, reg_dir)
+    rng = np.random.default_rng(14)
+    rows = make_rows(rng, 2000)
+    rows[5] = ["not_a_number", "0", "a", "1.0", "n"]   # bad numeric
+    rows[17] = ["0.1", "3"]                            # short row
+    stream = _write_stream_csv(tmp_path, "dirty.csv", rows)
+    counters = resolve("driftMonitor")(Config({
+        "field.delim.regex": ",", "field.delim.out": ",",
+        "dm.model.registry.dir": str(reg_dir),
+        "dm.model.name": "churn",
+        "dm.window.rows": "1000",
+    }), str(stream), str(tmp_path / "out_dirty"))
+    assert counters.get("BadRecords", "Malformed") == 2
+    assert counters.get("BadRecords", "Skipped") == 2
+    assert counters.get("DriftMonitor", "RowsSeen") == 1998
+
+
+def test_drift_monitor_job_resp_source(tmp_path):
+    from avenir_tpu.cli.jobs import resolve
+    from avenir_tpu.io.respq import RespClient, RespServer
+    reg_dir = tmp_path / "registry"
+    _train_with_baseline(tmp_path, reg_dir)
+    rng = np.random.default_rng(11)
+    server = RespServer().start()
+    try:
+        cli = RespClient(port=server.port)
+        for r in make_rows(rng, 1500, mu=2.0):
+            cli.lpush("driftQueue", ",".join(r))
+        cli.lpush("driftQueue", "stop")
+        out = tmp_path / "out_resp"
+        counters = resolve("driftMonitor")(Config({
+            "field.delim.regex": ",", "field.delim.out": ",",
+            "dm.model.registry.dir": str(reg_dir),
+            "dm.model.name": "churn",
+            "dm.window.rows": "500",
+            "dm.source": "resp",
+            "redis.server.port": str(server.port),
+            "redis.request.queue": "driftQueue",
+        }), None, str(out))
+        cli.close()
+    finally:
+        server.stop()
+    assert counters.get("DriftMonitor", "RowsSeen") == 1500
+    assert counters.get("DriftMonitor", "Alerts") > 0
+
+
+def test_drift_monitor_job_requires_baseline(tmp_path):
+    """A version published without a baseline refuses loudly."""
+    from avenir_tpu.cli.jobs import resolve
+    reg = ModelRegistry(str(tmp_path / "registry"))
+    reg.publish("m", np.arange(3, dtype=np.float64), kind="logistic",
+                schema=SCHEMA, params={"pos_class_value": "p"})
+    stream = _write_stream_csv(
+        tmp_path, "s.csv", make_rows(np.random.default_rng(0), 10))
+    with pytest.raises(FileNotFoundError, match="sidecar"):
+        resolve("driftMonitor")(Config({
+            "dm.model.registry.dir": str(tmp_path / "registry"),
+            "dm.model.name": "m",
+        }), str(stream), str(tmp_path / "out"))
+
+
+# --------------------------------------------------------------------------
+# closed-loop overhead soak (slow lane; the bench point measures the
+# same delta with the peak-of-3 protocol)
+# --------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_monitored_closed_loop_within_budget(mesh_ctx):
+    """serve_forest-style closed loop with and without the hook.  The
+    container's closed-loop throughput varies ±30%+ run to run (a single
+    pass can draw a 2x outlier), so this soak INTERLEAVES measured
+    passes of both variants (machine drift hits both sides), compares
+    medians, and floors at 0.6 — a gross-regression guard, e.g. a flush
+    gone synchronous-and-compiling.  The deterministic 5% request-path
+    pin is test_hook_request_path_within_budget; the bench point reports
+    the measured delta."""
+    import statistics
+    rng = np.random.default_rng(12)
+    baseline = compute_baseline(base_table(8000))
+    req = make_rows(rng, 4096)
+
+    def make_svc(monitor):
+        svc = _forest_service(
+            mesh_ctx, monitor=monitor, n=4000,
+            policy=BatchPolicy(max_batch=64, max_wait_ms=2.0))
+        if monitor is not None:
+            monitor.warm()
+        svc.start()
+        for f in [svc.submit(req[i % len(req)]) for i in range(1500)]:
+            f.result(timeout=120)
+        return svc
+
+    def one_pass(svc):
+        t0 = time.perf_counter()
+        futures = [svc.submit(req[i % len(req)]) for i in range(3000)]
+        for f in futures:
+            f.result(timeout=120)
+        return 3000 / (time.perf_counter() - t0)
+
+    monitor = ServingMonitor(baseline, SCHEMA, window_rows=4096,
+                             flush_rows=1024)
+    svc_plain = make_svc(None)
+    svc_mon = make_svc(monitor)
+    plain_rates, mon_rates = [], []
+    for _ in range(4):
+        plain_rates.append(one_pass(svc_plain))
+        mon_rates.append(one_pass(svc_mon))
+    svc_plain.stop()
+    svc_mon.stop()
+    monitor.close()
+    plain = statistics.median(plain_rates)
+    monitored = statistics.median(mon_rates)
+    assert monitored >= 0.6 * plain, (plain_rates, mon_rates)
+    # and the hook really recorded the traffic it rode along with
+    assert monitor.counters.get("DriftMonitor", "RowsSeen") > 10000

@@ -28,6 +28,37 @@ def test_histogram_roundtrip():
     assert h.cum_value(-0.5) == 0.0
 
 
+def test_histogram_edge_cases_explicit():
+    """The audited value()/cum_value()/percentile() contract: clamped,
+    documented, never out-of-range or NaN."""
+    h = Histogram.create_uninitialized(0.0, 10.0, 1.0)
+    # EMPTY histogram: no mass anywhere
+    assert h.percentile(50) == 0.0          # defined: xmin, not past-the-end
+    assert h.cum_value(5.0) == 0.0          # empty cumulative is 0, not NaN
+    assert h.value(5.0) == 0.0
+    # all mass in the LAST bin: the result is that bin's UPPER edge,
+    # one bin width past xmax (the last bin's LEFT edge) — callers
+    # whose bins tile the range exactly rely on exact top quantiles
+    h.add(10.0)
+    assert h.percentile(50) == h.xmax + h.bin_width == 11.0
+    assert h.percentile(100) == 11.0
+    # percent outside [0, 100] clamps instead of indexing off the ends
+    assert h.percentile(-5) == h.percentile(0)
+    assert h.percentile(250) == h.percentile(100)
+    # UNNORMALIZED bins: value() is the raw count, cum_value/percentile
+    # normalize internally
+    h2 = Histogram.create_uninitialized(0.0, 4.0, 1.0)
+    h2.add_many([0.5, 0.5, 2.5, 3.5])
+    assert h2.value(0.7) == 2.0
+    assert h2.cum_value(2.9) == 0.75
+    assert h2.percentile(50) == 1.0         # upper edge of the median bin
+    h2.normalize()
+    assert h2.value(0.7) == 0.5             # now a probability share
+    # out-of-range stays 0 on both sides after normalize too
+    assert h2.value(-0.2) == 0.0 and h2.value(99.0) == 0.0
+    assert h2.cum_value(-0.2) == 0.0 and h2.cum_value(99.0) == 1.0
+
+
 def test_gaussian_reject_sampler_moments():
     key = jax.random.PRNGKey(0)
     s = samplers.gaussian_reject_sample(key, mean=5.0, std=2.0, n=20_000)
