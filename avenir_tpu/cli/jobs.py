@@ -252,10 +252,13 @@ def random_forest_builder(cfg: Config, in_path: str, out_path: str) -> Counters:
                 start_row = int(meta.get("source_rows_done") or 0)
                 counters.set("Checkpoint", "ResumedFromStep", step)
                 counters.set("Checkpoint", "ResumedSourceRows", start_row)
+        # consumer_wait_key=None: this parse layer feeds from_stream's
+        # staging thread, whose own stats already time the wait on it
         blocks = prefetch_chunks(iter_csv_chunks(
             in_path, schema, cfg.field_delim_regex,
             chunk_rows=cfg.get_int("dtb.streaming.block.rows", 1 << 22),
-            bad_records=policy, start_row=start_row))
+            bad_records=policy, start_row=start_row),
+            consumer_wait_key=None)
         if baseline_builder is not None:
             # the baseline rides the SAME single ingest pass (a resumed
             # run only re-profiles the re-read tail; the baseline is a

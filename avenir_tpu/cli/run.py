@@ -279,14 +279,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         in_path, spool_dir = _apply_dist_mode(fn, job_name, in_path)
         # job-level step accounting into the counters channel (the rebuild's
         # replacement for the Hadoop UI's job timing; SURVEY §5), plus an
-        # optional XLA profiler capture dir
-        from ..utils.tracing import StepTimer, trace
+        # optional XLA profiler capture dir and the measured link-traffic
+        # ledger (H2D/D2H bytes + dispatches at the instrumented hot paths)
+        from ..utils.tracing import StepTimer, trace, transfer_ledger
         timer = StepTimer()
-        with trace(cfg.get("profile.trace.dir") or
-                   os.environ.get("AVENIR_TPU_TRACE_DIR")):
-            with timer.step("job"):
-                counters = fn(cfg, in_path, out_path)
+        with transfer_ledger() as ledger:
+            with trace(cfg.get("profile.trace.dir") or
+                       os.environ.get("AVENIR_TPU_TRACE_DIR")):
+                with timer.step("job"):
+                    counters = fn(cfg, in_path, out_path)
         if counters is not None:
+            # ledger export BEFORE the all-reduce: each process moved its
+            # own bytes, so the reduced dump shows true cluster totals
+            ledger.export(counters)
             # Hadoop counters are cluster-global: under multi-host the per
             # -process host-side tallies are all-reduced, and only process 0
             # renders (matching the reference driver's single counter dump).

@@ -436,7 +436,8 @@ def event_time_distribution(cfg: Config, in_path: str, out_path: str
 
     Output: keyFields..., bin:count pairs (bins ascending)."""
     import jax.numpy as jnp
-    from ..parallel.collectives import keyed_reduce
+    from ..parallel.collectives import keyed_reduce, sharded_jit_reduce
+    from ..parallel.mesh import runtime_context
     counters = Counters()
     delim = cfg.field_delim_regex
     od = cfg.field_delim_out
@@ -478,15 +479,52 @@ def event_time_distribution(cfg: Config, in_path: str, out_path: str
     # otherwise materialize ~GB of dense one-hot at once)
     key_arr = np.asarray(key_codes, dtype=np.int32)
     cyc_arr = np.asarray(cycles, dtype=np.int64)
-    hist = np.zeros((len(keys), n_bins), dtype=np.float64)
+    # ONE compiled shape (tail chunks zero-pad: a zero one-hot row sums
+    # into no key) row-sharded over the mesh, with a DEVICE-RESIDENT
+    # donated int32 accumulator carry: the running histogram updates IN
+    # PLACE (identical shape/dtype/sharding twin), so the old per-chunk
+    # defensive copy AND the per-chunk D2H readback both disappear — the
+    # production wiring of collectives.sharded_jit_reduce(donate=True).
+    # int32 cells are exact to 2^31 events per (key, bin), past the f64
+    # host accumulation it replaces.  Multi-process (dist=gather: every
+    # process holds the full input) keeps the eager host-local reduce —
+    # sharding host-local chunks over a hybrid mesh would bypass the
+    # from_process_local ingest discipline.
+    from ..parallel.distributed import is_multiprocess
+    ctx = runtime_context()
+    n_keys = len(keys)
+    sharded = not is_multiprocess()
     chunk = max((1 << 22) // max(n_bins, 1), 1024)
+    chunk += (-chunk) % ctx.n_devices          # mesh-divisible
+    if sharded:
+        reduce_chunk = sharded_jit_reduce(
+            lambda oh, kk, acc: acc + keyed_reduce(oh, kk, n_keys
+                                                   ).astype(jnp.int32),
+            ctx, n_batch_args=2, donate=True, carry_args=(2,))
+        acc = ctx.replicate(jnp.zeros((n_keys, n_bins), jnp.int32))
+    else:
+        hist = np.zeros((n_keys, n_bins), dtype=np.float64)
     for s in range(0, len(cyc_arr), chunk):
         e = min(s + chunk, len(cyc_arr))
-        onehot = np.zeros((e - s, n_bins), dtype=np.float32)
-        onehot[np.arange(e - s), cyc_arr[s:e]] = 1.0
-        hist += np.asarray(keyed_reduce(jnp.asarray(onehot),
-                                        jnp.asarray(key_arr[s:e]),
-                                        len(keys)))            # (K, n_bins)
+        if sharded:
+            onehot = np.zeros((chunk, n_bins), dtype=np.float32)
+            onehot[np.arange(e - s), cyc_arr[s:e]] = 1.0
+            kk = np.zeros((chunk,), dtype=np.int32)
+            kk[:e - s] = key_arr[s:e]
+            # batch args placed WITH the row sharding (no reshard copy
+            # inside the jit); the carry was ctx.replicate'd once and its
+            # layout matches, so its donation updates in place
+            acc = reduce_chunk(ctx.shard_rows(onehot),
+                               ctx.shard_rows(kk), acc)
+        else:
+            onehot = np.zeros((e - s, n_bins), dtype=np.float32)
+            onehot[np.arange(e - s), cyc_arr[s:e]] = 1.0
+            hist += np.asarray(keyed_reduce(jnp.asarray(onehot),
+                                            jnp.asarray(key_arr[s:e]),
+                                            n_keys))           # (K, n_bins)
+    if sharded:
+        from ..utils.tracing import fetch
+        hist = fetch(acc, dtype=np.float64)    # ONE readback for the job
     out_lines = []
     for ki, key in enumerate(keys):
         bins = [f"{b}:{int(hist[ki, b])}" for b in range(n_bins)

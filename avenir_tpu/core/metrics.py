@@ -36,6 +36,15 @@ class Counters:
     def get(self, group: str, name: str) -> int:
         return self._c.get((group, name), 0)
 
+    def update_group(self, group: str, values: Dict[str, int]) -> None:
+        """Set a whole group at once (e.g. a TransferLedger export)."""
+        for name, v in values.items():
+            self.set(group, name, v)
+
+    def group(self, group: str) -> Dict[str, int]:
+        """All (name, value) pairs of one group."""
+        return {n: v for (g, n), v in sorted(self._c.items()) if g == group}
+
     def as_dict(self) -> Dict[str, Dict[str, int]]:
         out: Dict[str, Dict[str, int]] = defaultdict(dict)
         for (g, n), v in sorted(self._c.items()):

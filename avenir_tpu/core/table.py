@@ -606,18 +606,48 @@ def iter_csv_chunks(path: str, schema: FeatureSchema,
                                        bad_records=bad_records)
 
 
-def prefetch_chunks(chunks, depth: int = 1, stats: Optional[dict] = None):
+def prefetch_chunks(chunks, depth: int = 1, stats: Optional[dict] = None,
+                    stage_fn=None, wait_key: str = "parse_s",
+                    stage_key: str = "transfer_s",
+                    consumer_wait_key: Optional[str] = "queue_wait_s",
+                    thread_name: str = "avenir-ingest-prefetch"):
     """Run a chunk iterator in a background thread with a bounded queue:
     the producer parses block i+1 while the consumer transfers/computes
     block i — the double-buffering that overlaps the ingest pipeline's
     stages.  ``depth`` bounds in-flight blocks (memory = depth + 1 blocks).
-    ``stats['parse_s']`` accumulates time spent inside the producer."""
+
+    ``stage_fn`` (optional) runs on every block IN THE PRODUCER THREAD
+    after it is pulled from the source — the device-staging hook: it
+    ``device_put``s block i+1 onto its own committed buffers while the
+    consumer computes on block i (see :func:`stage_chunks`).
+
+    Phase accounting (``stats``, all keys initialized to 0.0 so the
+    overlap decomposition downstream never KeyErrors):
+      * ``stats[wait_key]``   (default ``parse_s``)    — time pulling from
+        the source iterator (the parse, when the source is a raw reader;
+        upstream-queue wait when chained behind another prefetch layer);
+      * ``stats[stage_key]``  (default ``transfer_s``) — time inside
+        ``stage_fn`` (0.0 when no stage_fn);
+      * ``stats[consumer_wait_key]`` (default ``queue_wait_s``) —
+        CONSUMER-side blocking time on the queue: >0 means the consumer
+        outran the producer (the pipeline is parse/transfer-bound), ~0
+        means blocks were always ready (compute-bound).  Together with
+        the consumer's own compute timing this decomposes
+        ``overlap_fraction`` into parse vs transfer vs compute.  When
+        this layer feeds ANOTHER prefetch/stage layer (parse -> stage
+        chains), pass ``consumer_wait_key=None`` here: the downstream
+        layer's producer already times this layer's q.get as its own
+        upstream wait, and booking the same wall time twice would
+        misattribute parse starvation as final-consumer starvation."""
     import queue
     import threading
     import time as _time
 
     if depth < 1:
         raise ValueError(f"depth must be >= 1, got {depth}")
+    if stats is not None:
+        for key in (wait_key, stage_key, consumer_wait_key or "queue_wait_s"):
+            stats.setdefault(key, 0.0)
     q: "queue.Queue" = queue.Queue(maxsize=depth)
     end = object()
     failure: List[BaseException] = []
@@ -651,8 +681,16 @@ def prefetch_chunks(chunks, depth: int = 1, stats: Optional[dict] = None):
                     break
                 finally:
                     if stats is not None:
-                        stats["parse_s"] = (stats.get("parse_s", 0.0)
-                                            + _time.perf_counter() - t0)
+                        stats[wait_key] = (stats.get(wait_key, 0.0)
+                                           + _time.perf_counter() - t0)
+                if stage_fn is not None:
+                    t0 = _time.perf_counter()
+                    try:
+                        item = stage_fn(item)
+                    finally:
+                        if stats is not None:
+                            stats[stage_key] = (stats.get(stage_key, 0.0)
+                                                + _time.perf_counter() - t0)
                 if not put_until_stopped(item):
                     break
         except BaseException as exc:  # surfaced on the consumer side
@@ -667,10 +705,14 @@ def prefetch_chunks(chunks, depth: int = 1, stats: Optional[dict] = None):
             put_until_stopped(end)
 
     threading.Thread(target=produce, daemon=True,
-                     name="avenir-ingest-prefetch").start()
+                     name=thread_name).start()
     try:
         while True:
+            t0 = _time.perf_counter()
             item = q.get()
+            if stats is not None and consumer_wait_key is not None:
+                stats[consumer_wait_key] = (stats.get(consumer_wait_key, 0.0)
+                                            + _time.perf_counter() - t0)
             if item is end:
                 if failure:
                     raise failure[0]
@@ -683,3 +725,28 @@ def prefetch_chunks(chunks, depth: int = 1, stats: Optional[dict] = None):
                 q.get_nowait()
         except queue.Empty:
             pass
+
+
+def stage_chunks(blocks, stage_fn, depth: int = 2,
+                 stats: Optional[dict] = None):
+    """Two-deep device staging pipeline (TPU_NOTES §18): a staging thread
+    runs ``stage_fn(block)`` — host encode + ``device_put`` — for block
+    i+1 onto its own committed buffers while the consumer computes on
+    block i.  ``depth=2`` is classic double buffering (up to two staged
+    blocks queued plus one in flight inside stage_fn).
+
+    Chain behind :func:`prefetch_chunks` for the full three-stage
+    pipeline: parse (prefetch thread) || transfer (staging thread) ||
+    compute (consumer).  Stage time lands in ``stats['transfer_s']``,
+    upstream wait (which INCLUDES the parse layer's queue) in
+    ``stats['stage_wait_s']``, and final-consumer queue blocking in
+    ``stats['queue_wait_s']``.  Construct the upstream parse layer with
+    ``consumer_wait_key=None`` so the stage thread's wait on it is not
+    double-booked as consumer starvation.
+
+    Exactly-once failure propagation, thread shutdown on consumer
+    abandonment, and upstream ``close()`` follow prefetch_chunks."""
+    return prefetch_chunks(blocks, depth=depth, stats=stats,
+                           stage_fn=stage_fn, wait_key="stage_wait_s",
+                           stage_key="transfer_s",
+                           thread_name="avenir-ingest-stage")

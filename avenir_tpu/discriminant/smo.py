@@ -321,7 +321,14 @@ def _batched_smo_kernel(C: float, tol: float, eps: float, max_iter: int):
         done, it = state[3], state[5]
         return (~jnp.all(done)) & (it < max_iter)
 
-    @jax.jit
+    # the stacked label vector is DONATED: train_groups_batched builds a
+    # fresh y per call and never reuses it, and the (G, n) f32 alpha
+    # output is its exact shape/dtype twin, so XLA aliases the two
+    # buffers instead of holding a defensive copy across the while_loop.
+    # X and valid are deliberately NOT donated — no output matches their
+    # shape/dtype, so their donation would be a no-op that only emits the
+    # 'donated buffers were not usable' warning per compiled shape.
+    @functools.partial(jax.jit, donate_argnums=(1,))
     def run(X, y, valid):
         G, n, _ = X.shape
         alpha = jnp.zeros((G, n), jnp.float32)
@@ -401,8 +408,12 @@ def train_groups_batched(groups: Dict[str, Tuple[np.ndarray, np.ndarray]],
         # asarray-then-reshard would upload everything to device 0 first
         Xj, yj, vj = (ctx.shard_rows(a) for a in (Xb, yb, valid))
     else:
+        from ..utils.tracing import note_h2d
+        note_h2d(Xb.nbytes + yb.nbytes + valid.nbytes, transfers=3)
         Xj, yj, vj = jnp.asarray(Xb), jnp.asarray(yb), jnp.asarray(valid)
-    alpha, w, b, it = (np.asarray(v) for v in run(Xj, yj, vj))
+    from ..utils.tracing import fetch, note_dispatch
+    note_dispatch()
+    alpha, w, b, it = (fetch(v) for v in run(Xj, yj, vj))
     if stats is not None:
         # real lock-step iteration count (bench rooflines model work from
         # it rather than a hard-coded constant)

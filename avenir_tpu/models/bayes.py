@@ -434,9 +434,11 @@ def train(table: ColumnarTable, ctx: Optional[MeshContext] = None,
             c_, cl_, mo_ = _train_kernel(
                 ctx.shard_rows(cc), ctx.shard_rows(bc),
                 ctx.shard_rows(cv), ctx.shard_rows(mm), C, bmax)
-        counts += np.asarray(c_, dtype=np.float64)
-        cls_counts += np.asarray(cl_, dtype=np.float64)
-        moments += np.asarray(mo_, dtype=np.float64)
+        from ..utils.tracing import fetch, note_dispatch
+        note_dispatch()
+        counts += fetch(c_, dtype=np.float64)
+        cls_counts += fetch(cl_, dtype=np.float64)
+        moments += fetch(mo_, dtype=np.float64)
 
     # zero out bins beyond each field's alphabet (padding of Bmax)
     for fi, nb in enumerate(nbins):

@@ -35,6 +35,7 @@ from ..core.schema import FeatureSchema
 from ..core.table import ColumnarTable
 from ..core.metrics import Counters
 from ..parallel.mesh import MeshContext, runtime_context
+from ..utils.tracing import fetch, note_dispatch
 from .tree import (acc_counts, DecisionPath, DecisionPathList, DecisionTreeModel,
                    Predicate, TreeBuilder, TreeParams, level_chunk,
                    sampling_weights)
@@ -147,14 +148,18 @@ def _jitted_forest_level_kernel(S: int, B: int, C: int):
     """Fused per-level program: re-tag every record for every tree with the
     previous level's chosen splits, then histogram the new frontier — ONE
     launch and ONE host readback per level (the counts; new node ids stay
-    on device)."""
+    on device).  The (n, T) node-id state is DONATED: its output twin has
+    identical shape/dtype/sharding and every caller rebinds, so the level
+    loop's biggest carry updates in place instead of paying a defensive
+    HBM copy per level (the chunked path donates the per-chunk pad/slice
+    copies, which are equally dead after the call)."""
     def kernel(node_ids, branches, cls_codes, weights, sel_split,
                child_table, n_new):
         new_ids = _reassign_body(node_ids, branches, sel_split, child_table)
         counts = _count_body(new_ids, branches, cls_codes, weights,
                              n_new, B, C)
         return new_ids, counts
-    return jax.jit(kernel, static_argnums=6)
+    return jax.jit(kernel, static_argnums=6, donate_argnums=(0,))
 
 
 class ForestBuilder:
@@ -197,19 +202,21 @@ class ForestBuilder:
         chunk = level_chunk(n_nodes, T, S, B, C, self._w_max)
         n = base.n_padded
         if n <= chunk:
+            note_dispatch()
             c = kernel(node_ids, base.branches, base.cls_codes, weights,
                        n_nodes)
-            return np.asarray(c, dtype=np.float64)
+            return fetch(c, dtype=np.float64)
         acc = None
         for start in range(0, n, chunk):
             end = min(start + chunk, n)
             nid, br, cc, ww = _pad_chunk(
                 chunk, node_ids[start:end], base.branches[start:end],
                 base.cls_codes[start:end], weights[start:end])
+            note_dispatch(2)  # count kernel + device accumulate
             c = kernel(nid, br, cc, ww, n_nodes)
             acc = c.astype(jnp.int32) if acc is None \
                 else acc_counts(acc, c)
-        return np.asarray(acc, dtype=np.float64)
+        return fetch(acc, dtype=np.float64)
 
     def _level_fused(self, fused, node_ids, weights, sel_split: np.ndarray,
                      child_table: np.ndarray, n_new: int):
@@ -229,21 +236,25 @@ class ForestBuilder:
         chunk = level_chunk(n_new + n_prev + S + B, T, S, B, C, self._w_max)
         n = base.n_padded
         if n <= chunk:
+            note_dispatch()
             new_ids, c = fused(node_ids, base.branches, base.cls_codes,
                                weights, sel, ctab, n_new)
-            return new_ids, np.asarray(c, dtype=np.float64)
+            # ONE stacked (T, N, S, B, C) transfer per level for the whole
+            # forest — never per tree (pinned by tests/test_transfers.py)
+            return new_ids, fetch(c, dtype=np.float64)
         ids_parts, acc = [], None
         for start in range(0, n, chunk):
             end = min(start + chunk, n)
             nid, br, cc, ww = _pad_chunk(
                 chunk, node_ids[start:end], base.branches[start:end],
                 base.cls_codes[start:end], weights[start:end])
+            note_dispatch(2)  # fused level kernel + device accumulate
             ni, c = fused(nid, br, cc, ww, sel, ctab, n_new)
             ids_parts.append(ni[:end - start])
             acc = c.astype(jnp.int32) if acc is None \
                 else acc_counts(acc, c)
         return jnp.concatenate(ids_parts, axis=0), \
-            np.asarray(acc, dtype=np.float64)
+            fetch(acc, dtype=np.float64)
 
     def build_all(self) -> List[DecisionPathList]:
         base, builders = self.base, self.tree_builders
@@ -374,8 +385,10 @@ def build_forest_from_stream(blocks, schema, params: ForestParams,
     same records (per-block pad rows carry zero weight).
 
     ``stats`` (optional dict) collects phase timings: ``parse_s`` (from
-    prefetch_chunks), ``transfer_s``, ``ingest_wall_s``, ``build_s`` —
-    the bench derives the pipeline overlap fraction from them.
+    prefetch_chunks), ``transfer_s`` (staging thread),
+    ``ingest_compute_s`` (consumer branch-code dispatch + final sync),
+    ``queue_wait_s``, ``ingest_wall_s``, ``build_s`` — the bench derives
+    the parse/transfer/compute pipeline-overlap decomposition from them.
 
     ``checkpoint``/``checkpoint_every``/``resume_state`` thread straight
     through to ``TreeBuilder.from_stream`` (see its docstring for the
@@ -548,13 +561,18 @@ class EnsembleModel:
         chunk = max(1024, (1 << 26) // per_row)
         out = []
         for s in range(0, n, chunk):
+            note_dispatch()
             out.append(kernel(d_vals[s:s + chunk], d_codes[s:s + chunk],
                               *consts, wvec,
                               jnp.float32(self.min_odds_ratio)))
         # chunk results stay device-side; ONE readback for the whole
         # batch (each separate np.asarray costs a full ~62 ms tunnel
         # round trip — TPU_NOTES section 5)
-        idx = np.asarray(out[0] if len(out) == 1 else jnp.concatenate(out))
+        if len(out) == 1:
+            idx = fetch(out[0])
+        else:
+            note_dispatch()  # the concat is a real launch too
+            idx = fetch(jnp.concatenate(out))
         return list(self._lut[idx])
 
     def _predict_host(self, table: ColumnarTable, cache) -> List[Optional[str]]:

@@ -50,8 +50,9 @@ import jax
 import jax.numpy as jnp
 
 from ..core.schema import FeatureSchema, FeatureField
-from ..core.table import ColumnarTable
+from ..core.table import ColumnarTable, stage_chunks
 from ..parallel.mesh import MeshContext, runtime_context
+from ..utils.tracing import fetch, note_dispatch, note_h2d
 
 ROOT_PATH = "$root"
 SPLIT_DELIM = ":"          # splitId:predicate in shuffle keys (not in model)
@@ -499,11 +500,14 @@ def sampling_weights(n: int, params: TreeParams,
     return None
 
 
-@jax.jit
+@functools.partial(jax.jit, donate_argnums=(0,))
 def acc_counts(acc, c):
     """Fused chunk accumulate (astype + add in ONE dispatch): the eager
     pair costs two dispatches per chunk in the deep-scale chunked regime.
-    Shared by the single-tree and forest builders."""
+    Shared by the single-tree and forest builders.  The running
+    accumulator is DONATED — every caller rebinds ``acc = acc_counts(acc,
+    c)``, so XLA updates the (N, S, B, C) buffer in place instead of
+    copying it per chunk."""
     return acc + c.astype(jnp.int32)
 
 
@@ -642,9 +646,12 @@ class TreeBuilder:
         Per block: host feature matrix (narrow int16 wire when exact) ->
         device upload -> branch codes ON DEVICE; only the (n, S) branch
         codes and (n,) class codes stay resident, so peak host memory is
-        one block.  Uploads and branch-code launches are async dispatches,
-        so with a prefetching block source (core.table.prefetch_chunks)
-        the parse of block i+1 overlaps the transfer/compute of block i.
+        a couple of in-flight blocks.  The encode + upload runs on a
+        dedicated STAGING thread (core.table.stage_chunks, two committed
+        buffers deep): block i+1 device_puts while block i's branch-code
+        kernel computes, so with a prefetching block source
+        (core.table.prefetch_chunks) the pipeline is parse || transfer ||
+        compute — three overlapped stages, not two.
 
         Each block pads independently to the mesh size, so valid rows are
         NOT necessarily a prefix of the device arrays — per-record weights
@@ -653,8 +660,10 @@ class TreeBuilder:
         histogram.  Models built from a streamed table are bit-identical
         to ``TreeBuilder(assembled_table, ...)`` (tests/test_forest.py).
 
-        ``stats['transfer_s']`` accumulates consumer-side upload/dispatch
-        time plus the final device sync.
+        ``stats['transfer_s']`` accumulates staging-thread encode/upload
+        time; ``stats['ingest_compute_s']`` the consumer-side branch-code
+        dispatch time plus the final device sync (the sync point where
+        every outstanding upload AND kernel completes).
 
         Checkpoint/resume: with a ``checkpoint``
         (core.checkpoint.CheckpointManager) and ``checkpoint_every`` > 0,
@@ -690,7 +699,7 @@ class TreeBuilder:
         n_rows = 0
         blocks_done = 0
         source_rows_done: Optional[int] = None
-        t_consume = 0.0
+        t_compute = 0.0
         if resume_state is not None:
             arrays, meta = resume_state
             rb = np.asarray(arrays["branches"], dtype=np.int32)
@@ -713,8 +722,12 @@ class TreeBuilder:
             n_rows = int(meta["n_rows"])
             blocks_done = int(meta.get("blocks_done", 0))
             source_rows_done = meta.get("source_rows_done")
-        for block in blocks:
-            t0 = _time.perf_counter()
+        def _stage(block):
+            """Staging-thread half of the ingest: host encode + padded
+            device upload of ONE block (its time lands in
+            stats['transfer_s'] via stage_chunks).  Only numpy work and
+            async device_puts happen here; the branch-code kernel stays
+            on the consumer thread."""
             bn = block.n_rows
             pad = (-bn) % align
             X = self.split_set.feature_matrix(block)
@@ -724,18 +737,22 @@ class TreeBuilder:
                 cc = np.pad(cc, (0, pad))
             mask = np.zeros((bn + pad,), dtype=np.float32)
             mask[:bn] = 1.0
-            # async dispatches: the host is free to parse the next block
-            # while the upload + branch-code launch are in flight
             Xd = self.ctx.shard_rows_streamed(X)
+            ccd = self.ctx.shard_rows_streamed(cc)
+            return (Xd, ccd, mask, bn,
+                    getattr(block, "source_row_end", None))
+
+        for Xd, ccd, mask, bn, src_end in stage_chunks(
+                blocks, _stage, depth=2, stats=stats):
+            t0 = _time.perf_counter()
             br_parts.append(self.split_set.branch_codes(Xd))
-            cls_parts.append(self.ctx.shard_rows_streamed(cc))
+            cls_parts.append(ccd)
             mask_parts.append(mask)
             n_rows += bn
             blocks_done += 1
-            src_end = getattr(block, "source_row_end", None)
             if src_end is not None:
                 source_rows_done = int(src_end)
-            t_consume += _time.perf_counter() - t0
+            t_compute += _time.perf_counter() - t0
             if (checkpoint is not None and checkpoint_every > 0
                     and blocks_done % checkpoint_every == 0):
                 _save_stream_checkpoint(
@@ -767,9 +784,10 @@ class TreeBuilder:
         # are the only per-record view any level kernel reads
         self.X = None
         jax.block_until_ready((self.branches, self.cls_codes))
-        t_consume += _time.perf_counter() - t0
+        t_compute += _time.perf_counter() - t0
         if stats is not None:
-            stats["transfer_s"] = stats.get("transfer_s", 0.0) + t_consume
+            stats["ingest_compute_s"] = (stats.get("ingest_compute_s", 0.0)
+                                         + t_compute)
 
         S, B, C = self.split_set.n_splits, self.split_set.max_branches, self.C
         self._count_kernel = _jitted_level_count_kernel(S, B, C)
@@ -843,23 +861,26 @@ class TreeBuilder:
             acc = None
             for start in range(0, n, chunk):
                 end = min(start + chunk, n)
+                note_dispatch(2)  # count kernel + device accumulate
                 c = self._count_kernel(
                     node_ids[start:end], self.branches[start:end],
                     self.cls_codes[start:end], weights[start:end], n_nodes)
                 acc = c.astype(jnp.int32) if acc is None \
                     else acc_counts(acc, c)
-            return np.asarray(acc, dtype=np.float64)
+            return fetch(acc, dtype=np.float64)
         if n <= chunk:
+            note_dispatch()
             c = self._count_kernel(node_ids, self.branches, self.cls_codes,
                                    weights, n_nodes)
-            return np.asarray(c, dtype=np.float64)
+            return fetch(c, dtype=np.float64)
         total = np.zeros((n_nodes, S, B, C), dtype=np.float64)
         for start in range(0, n, chunk):
             end = min(start + chunk, n)
+            note_dispatch()
             c = self._count_kernel(node_ids[start:end], self.branches[start:end],
                                    self.cls_codes[start:end], weights[start:end],
                                    n_nodes)
-            total += np.asarray(c, dtype=np.float64)
+            total += fetch(c, dtype=np.float64)
         return total
 
     # ---- attribute selection (DecisionTreeBuilder.getSplitAttributes :365-381)
@@ -942,6 +963,7 @@ class TreeBuilder:
         counts = self.level_counts(node_ids, weights, len(active))
         new_leaves, stopped_paths, sel_split, child_table = \
             self._choose_splits(active, counts)
+        note_dispatch()
         node_ids = self._reassign_kernel(
             node_ids, self.branches,
             self.ctx.replicate(jnp.asarray(sel_split)),
@@ -1073,8 +1095,11 @@ class TreeBuilder:
 
 
 # process-wide jit of the (pure, static) reassignment kernel: every builder
-# shares one compiled version per shape signature
-_REASSIGN_JIT = jax.jit(TreeBuilder._reassign)
+# shares one compiled version per shape signature.  node_ids is DONATED —
+# the level loop always rebinds ``node_ids = reassign(node_ids, ...)`` and
+# the output has identical shape/dtype/sharding, so XLA re-tags records in
+# the same HBM buffer instead of the defensive copy it makes per dispatch
+_REASSIGN_JIT = jax.jit(TreeBuilder._reassign, donate_argnums=(0,))
 
 
 # --------------------------------------------------------------------------
@@ -1194,6 +1219,7 @@ class FeatureCache:
         if self._dev is None:
             # ship the NARROW dtype (int16 when feature_arrays chose it —
             # half the link bytes); kernels upcast on device in _match_ok
+            note_h2d(vals.nbytes + codes.nbytes, transfers=2)
             self._dev = (jnp.asarray(vals), jnp.asarray(codes))
         return self._dev
 

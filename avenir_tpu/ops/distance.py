@@ -18,12 +18,21 @@ distance is a matmul problem, not a join problem —
   * categorical mismatch count = F_cat - matches, matches = block-one-hot
     GEMM  A(n_test, sum_card) @ B(n_train, sum_card)^T;
   * manhattan falls back to a broadcast-tiled pass (bandwidth-bound).
+
+Link discipline (TPU_NOTES §18): the categorical one-hot ships int8 (4x
+fewer H2D bytes than f32; the device upcast is lossless), the train-side
+encode + upload is cached across calls/test chunks, the whole
+tile-loop of ``pairwise_topk`` is ONE ``lax.scan`` launch per test chunk
+(it used to be two dispatches per train tile), and the running best-k
+carries are donated.  Every transfer/dispatch records into the active
+``utils.tracing.TransferLedger``, and tests pin the exact counts.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import List, Tuple
+import weakref
+from typing import List, Optional, Tuple
 
 import numpy as np
 
@@ -32,10 +41,47 @@ import jax.numpy as jnp
 
 from ..core.schema import FeatureSchema
 from ..core.table import ColumnarTable
+from ..utils.tracing import fetch, note_dispatch, note_h2d
 
 
 @functools.lru_cache(maxsize=None)
-def _topk_merge_kernel(k: int):
+def _dist_kernels(n_cat: float, denom: float, fscale: float):
+    """The ONE implementation of both distance formulations, shared by the
+    eager per-computer jits and the fused top-k scan (a drifted copy would
+    silently break the scan-vs-full-matrix parity the tests pin).  The
+    one-hot operands may arrive int8 (the narrow wire form): the f32
+    upcast on device is lossless."""
+
+    def _euclid(tn, toh, rn, roh):
+        toh = toh.astype(jnp.float32)
+        roh = roh.astype(jnp.float32)
+        sq = (tn * tn).sum(1)[:, None] + (rn * rn).sum(1)[None, :] \
+            - 2.0 * tn @ rn.T                                  # (nt, nr)
+        cat_match = toh @ roh.T                                # matches
+        cat_mismatch = n_cat - cat_match
+        total = jnp.maximum(sq, 0.0) + cat_mismatch            # d in {0,1}: d^2=d
+        mean = total / denom
+        return jnp.floor(jnp.sqrt(jnp.maximum(mean, 0.0)) * fscale)
+
+    def _manh(tn_tile, toh_tile, rn, roh):
+        num = jnp.abs(tn_tile[:, None, :] - rn[None, :, :]).sum(2)
+        cat = n_cat - toh_tile.astype(jnp.float32) @ roh.astype(jnp.float32).T
+        return jnp.floor((num + cat) / denom * fscale)
+
+    return _euclid, _manh
+
+
+@functools.lru_cache(maxsize=None)
+def _euclid_jit(n_cat: float, denom: float, fscale: float):
+    return jax.jit(_dist_kernels(n_cat, denom, fscale)[0])
+
+
+@functools.lru_cache(maxsize=None)
+def _manh_jit(n_cat: float, denom: float, fscale: float):
+    return jax.jit(_dist_kernels(n_cat, denom, fscale)[1])
+
+
+def _merge_topk_body(best_d, best_i, d_tile, base, k: int):
     """Merge a fresh distance tile into the running best-k per test row:
     reduce the tile to its own best-k with ``lax.top_k`` (ties -> lowest
     position), then one stable 2k-wide multi-operand sort against the
@@ -44,21 +90,77 @@ def _topk_merge_kernel(k: int):
     not an option — gathers lower to scalar loops on this TPU.  Stability
     + tile order makes ties resolve to the lowest global train index,
     matching a stable argsort over the full matrix."""
+    kk = min(k, d_tile.shape[1])
+    neg_v, pos = jax.lax.top_k(-d_tile.astype(jnp.float32), kk)
+    tile_i = base + pos.astype(jnp.int32)
+    cand_d = jnp.concatenate([best_d, -neg_v], axis=1)
+    cand_i = jnp.concatenate([best_i, tile_i], axis=1)
+    d_sorted, i_sorted = jax.lax.sort((cand_d, cand_i), dimension=1,
+                                      num_keys=1)
+    return d_sorted[:, :k], i_sorted[:, :k]
+
+
+@functools.lru_cache(maxsize=None)
+def _topk_merge_kernel(k: int):
+    """Standalone jitted merge step (see ``_merge_topk_body``).  The fused
+    scan below subsumes it on the hot path; it remains the single-tile
+    building block for external callers.  The running best lists are
+    DONATED: the caller always rebinds ``best_d, best_i = merge(...)``, so
+    XLA may update the (n_test, k) carries in place instead of making the
+    defensive HBM copy every dispatch."""
     def merge(best_d, best_i, d_tile, base):
-        kk = min(k, d_tile.shape[1])
-        neg_v, pos = jax.lax.top_k(-d_tile.astype(jnp.float32), kk)
-        tile_i = base + pos.astype(jnp.int32)
-        cand_d = jnp.concatenate([best_d, -neg_v], axis=1)
-        cand_i = jnp.concatenate([best_i, tile_i], axis=1)
-        d_sorted, i_sorted = jax.lax.sort((cand_d, cand_i), dimension=1,
-                                          num_keys=1)
-        return d_sorted[:, :k], i_sorted[:, :k]
-    return jax.jit(merge)
+        return _merge_topk_body(best_d, best_i, d_tile, base, k)
+    return jax.jit(merge, donate_argnums=(0, 1))
+
+
+@functools.lru_cache(maxsize=None)
+def _topk_scan_kernel(k: int, metric: str, n_cat: float, denom: float,
+                      fscale: float):
+    """ONE launch per test chunk: ``lax.scan`` over the stacked uniform
+    train tiles, distance + running-best-k merge fused (the per-tile
+    Python loop used to cost 2 dispatches x T tiles per chunk — pure
+    dispatch latency on the tunneled link).  Tiles are padded to one
+    uniform width; pad columns get distance +inf, so with k <= n_train
+    they can never reach the final best list and results are bit-identical
+    to the per-tile merge (tests pin scan == full-matrix argsort)."""
+    eu, ma = _dist_kernels(n_cat, denom, fscale)
+    dist = eu if metric == "euclidean" else ma
+
+    def kernel(tn, toh, rn_t, roh_t, base, nvalid):
+        def body(carry, xs):
+            bd, bi = carry
+            rn, roh, b, nv = xs
+            d = dist(tn, toh, rn, roh)
+            col = jnp.arange(d.shape[1], dtype=jnp.int32)
+            d = jnp.where(col[None, :] < nv, d, jnp.inf)
+            return _merge_topk_body(bd, bi, d, b, k), None
+
+        nt = tn.shape[0]
+        bd0 = jnp.full((nt, k), jnp.inf, dtype=jnp.float32)
+        bi0 = jnp.full((nt, k), -1, dtype=jnp.int32)
+        (bd, bi), _ = jax.lax.scan(body, (bd0, bi0),
+                                   (rn_t, roh_t, base, nvalid))
+        return bd, bi
+
+    return jax.jit(kernel)
+
+
+@functools.lru_cache(maxsize=None)
+def _pair_concat_jit(n_parts: int):
+    """Concatenate the per-chunk (best_d, best_i) part lists in ONE
+    dispatch (two eager concatenates would be two)."""
+    return jax.jit(lambda ds, is_: (jnp.concatenate(ds),
+                                    jnp.concatenate(is_)))
 
 
 class DistanceComputer:
     """Precomputes per-attr normalization + categorical one-hot layout for a
-    schema, then computes all-pairs int distances on device."""
+    schema, then computes all-pairs int distances on device.
+
+    The train-side encode AND its device upload are cached across calls
+    (one slot, keyed by the train table): the KNN pipeline hits the same
+    train set with every test chunk, and re-encoding/re-uploading it per
+    call was half the H2D bytes of the whole pass."""
 
     def __init__(self, schema: FeatureSchema, metric: str = "euclidean",
                  scale: int = 1000):
@@ -73,31 +175,25 @@ class DistanceComputer:
              and f.min is not None else 1.0 for f in self.num_fields],
             dtype=np.float32)
         self.cards = [len(f.cardinality or []) for f in self.cat_fields]
-        # jit once per computer: a fresh closure per pairwise() call would
-        # retrace + recompile every invocation
-        n_cat = float(len(self.cat_fields))
-        denom = float(max(self.n_attrs, 1))
-        fscale = float(self.scale)
-
-        def _euclid(tn, toh, rn, roh):
-            sq = (tn * tn).sum(1)[:, None] + (rn * rn).sum(1)[None, :] \
-                - 2.0 * tn @ rn.T                                  # (nt, nr)
-            cat_match = toh @ roh.T                                # matches
-            cat_mismatch = n_cat - cat_match
-            total = jnp.maximum(sq, 0.0) + cat_mismatch            # d in {0,1}: d^2=d
-            mean = total / denom
-            return jnp.floor(jnp.sqrt(jnp.maximum(mean, 0.0)) * fscale)
-
-        def _manh(tn_tile, toh_tile, rn, roh):
-            num = jnp.abs(tn_tile[:, None, :] - rn[None, :, :]).sum(2)
-            cat = n_cat - toh_tile @ roh.T
-            return jnp.floor((num + cat) / denom * fscale)
-
-        self._euclid_jit = jax.jit(_euclid)
-        self._manh_jit = jax.jit(_manh)
+        # kernel constants double as the module-level jit cache keys, so
+        # every computer over the same shape shares ONE compiled program
+        self._n_cat = float(len(self.cat_fields))
+        self._denom = float(max(self.n_attrs, 1))
+        self._fscale = float(self.scale)
+        self._euclid_jit = _euclid_jit(self._n_cat, self._denom, self._fscale)
+        self._manh_jit = _manh_jit(self._n_cat, self._denom, self._fscale)
+        # one-slot train-side cache: weakref so a GC'd table can never
+        # false-hit via id() reuse
+        self._train_ref = lambda: None
+        self._train_host: Optional[Tuple[np.ndarray, np.ndarray]] = None
+        self._train_dev: dict = {}
 
     # ---- encode a table into (numeric matrix, categorical block one-hot) ----
     def encode(self, table: ColumnarTable) -> Tuple[np.ndarray, np.ndarray]:
+        """(numeric (n, Fn) float32, one-hot (n, sum_card) int8).  The
+        one-hot ships int8 — 4x less on the host->device link than the old
+        f32 form — and the kernels upcast on device (lossless: values are
+        0/1)."""
         n = table.n_rows
         if self.num_fields:
             num = np.stack([table.columns[f.ordinal] / r for f, r in
@@ -106,47 +202,75 @@ class DistanceComputer:
         else:
             num = np.zeros((n, 0), dtype=np.float32)
         total_card = sum(self.cards)
-        oh = np.zeros((n, total_card), dtype=np.float32)
+        oh = np.zeros((n, total_card), dtype=np.int8)
         off = 0
         for f, card in zip(self.cat_fields, self.cards):
             codes = table.columns[f.ordinal]
             valid = codes >= 0
-            oh[np.arange(n)[valid], off + codes[valid]] = 1.0
+            oh[np.arange(n)[valid], off + codes[valid]] = 1
             off += card
         return num, oh
+
+    def _encode_train(self, train: ColumnarTable
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+        """Cached train-side encode (host arrays); rebinding to a different
+        table drops the old entry and its device arrays."""
+        if self._train_ref() is not train or self._train_host is None:
+            self._train_host = self.encode(train)
+            self._train_dev = {}
+            self._train_ref = weakref.ref(train)
+        return self._train_host
+
+    def _train_device(self, key, build):
+        """Cached device placement of train-side arrays (``build`` uploads
+        on miss and its transfers hit the ledger exactly once per train
+        table, not once per call)."""
+        hit = self._train_dev.get(key)
+        if hit is None:
+            hit = self._train_dev[key] = build()
+        return hit
 
     def pairwise(self, test: ColumnarTable, train: ColumnarTable,
                  tile: int = 4096) -> np.ndarray:
         """(n_test, n_train) int32 scaled distances."""
         tn, toh = self.encode(test)
-        rn, roh = self.encode(train)
+        rn, roh = self._encode_train(train)
         if self.metric == "euclidean":
-            d = self._euclidean(jnp.asarray(tn), jnp.asarray(toh),
-                                jnp.asarray(rn), jnp.asarray(roh))
+            note_h2d(tn.nbytes + toh.nbytes, transfers=2)
+            rn_d, roh_d = self._train_device(
+                "flat", lambda: (note_h2d(rn.nbytes + roh.nbytes, 2),
+                                 (jnp.asarray(rn), jnp.asarray(roh)))[1])
+            note_dispatch()
+            d = fetch(self._euclid_jit(jnp.asarray(tn), jnp.asarray(toh),
+                                       rn_d, roh_d))
         elif self.metric == "manhattan":
             d = self._manhattan_tiled(tn, toh, rn, roh, tile)
         else:
             raise ValueError(f"unknown metric {self.metric!r}")
         return np.asarray(d).astype(np.int32)
 
-    def _euclidean(self, tn, toh, rn, roh):
-        return self._euclid_jit(tn, toh, rn, roh)
-
     def pairwise_topk(self, test: ColumnarTable, train: ColumnarTable,
                       k: int, train_tile: int = 1 << 14,
                       test_chunk: int = 1 << 13
                       ) -> Tuple[np.ndarray, np.ndarray]:
         """Fused all-pairs distance + nearest-k, tiled over the train axis:
-        the (n_test, n_train) matrix never exists — each train tile's
-        distances merge into a running (n_test, k) device-resident best list
-        (one stable sort per tile), and only ids + distances come back to
-        host.  Replaces the all-pairs-file -> secondary-sort-reducer pipeline
+        the (n_test, n_train) matrix never exists — the train set is
+        stacked into uniform tiles and ONE ``lax.scan`` launch per test
+        chunk folds every tile into the device-resident running best list;
+        only ids + distances come back to host (one transfer each).
+        Replaces the all-pairs-file -> secondary-sort-reducer pipeline
         of the reference (knn/NearestNeighbor.java:80-81, resource/knn.sh:47)
         and lifts the full-matrix memory ceiling (20k x 200k needed 16 GB
         through ``pairwise``; here it is ~170 MB per in-flight tile).
 
         Returns (distances (n_test, k) int32, train indices (n_test, k)
         int32), rows sorted nearest-first, ties to the lowest train index.
+
+        Dispatch/transfer shape (pinned by tests/test_transfers.py): with
+        a warm train cache, each test chunk costs 2 H2D transfers (its
+        numeric + one-hot arrays) and exactly 1 dispatch; the whole call
+        adds 1 concat dispatch (when >1 chunk) and 2 D2H transfers.  The
+        old per-tile loop was ~2T dispatches per chunk.
 
         Multi-device: the test axis is embarrassingly parallel (every kernel
         is per-test-row), so when the runtime mesh has >1 device each test
@@ -156,10 +280,14 @@ class DistanceComputer:
         by the device count fall back to single-device placement."""
         from ..parallel.mesh import runtime_context
         tn, toh = self.encode(test)
-        rn, roh = self.encode(train)
+        rn, roh = self._encode_train(train)
         n_test, n_train = tn.shape[0], rn.shape[0]
         k = min(k, n_train)
-        merge = _topk_merge_kernel(k)
+        if n_train == 0 or n_test == 0:
+            return (np.zeros((n_test, k), np.int32),
+                    np.zeros((n_test, k), np.int32))
+        if self.metric not in ("euclidean", "manhattan"):
+            raise ValueError(f"unknown metric {self.metric!r}")
         # keep each (test_chunk, train_tile) tile around 2^27 f32 elements
         train_tile = max(1024, min(train_tile, (1 << 27) // max(test_chunk, 1)))
         ctx = runtime_context()
@@ -171,50 +299,62 @@ class DistanceComputer:
         # each process places plain local arrays here.
         from ..parallel.distributed import is_multiprocess
         mesh_on = ctx.n_devices > 1 and not is_multiprocess()
-        if mesh_on:
-            rn_d = jax.device_put(jnp.asarray(rn), ctx.replicated_sharding())
-            roh_d = jax.device_put(jnp.asarray(roh), ctx.replicated_sharding())
-        else:
-            rn_d, roh_d = jnp.asarray(rn), jnp.asarray(roh)
-        if self.metric == "euclidean":
-            dist_fn = self._euclid_jit
-        elif self.metric == "manhattan":
-            dist_fn = None
-        else:
-            raise ValueError(f"unknown metric {self.metric!r}")
-        out_d: List[np.ndarray] = []
-        out_i: List[np.ndarray] = []
+
+        def build_tiles():
+            T = -(-n_train // train_tile)
+            pad = T * train_tile - n_train
+            rn_p = np.pad(rn, ((0, pad), (0, 0))) if pad else rn
+            roh_p = np.pad(roh, ((0, pad), (0, 0))) if pad else roh
+            rn_t = rn_p.reshape(T, train_tile, rn.shape[1])
+            roh_t = roh_p.reshape(T, train_tile, roh.shape[1])
+            base = (np.arange(T, dtype=np.int32) * train_tile)
+            nvalid = np.minimum(n_train - base, train_tile).astype(np.int32)
+            note_h2d(rn_t.nbytes + roh_t.nbytes + base.nbytes + nvalid.nbytes,
+                     transfers=4)
+            put = (lambda a: jax.device_put(jnp.asarray(a),
+                                            ctx.replicated_sharding())) \
+                if mesh_on else jnp.asarray
+            return tuple(put(a) for a in (rn_t, roh_t, base, nvalid))
+
+        rn_t, roh_t, base_d, nv_d = self._train_device(
+            ("tiled", train_tile, mesh_on), build_tiles)
+        kernel = _topk_scan_kernel(k, self.metric, self._n_cat, self._denom,
+                                   self._fscale)
+        out_d: List = []
+        out_i: List = []
         for ts in range(0, n_test, test_chunk):
             te = min(ts + test_chunk, n_test)
             if mesh_on and (te - ts) % ctx.n_devices == 0:
                 put = lambda a: jax.device_put(a, ctx.row_sharding())
             else:
                 put = lambda a: a
-            tn_c = put(jnp.asarray(tn[ts:te]))
-            toh_c = put(jnp.asarray(toh[ts:te]))
-            best_d = put(jnp.full((te - ts, k), np.inf, dtype=jnp.float32))
-            best_i = put(jnp.full((te - ts, k), -1, dtype=jnp.int32))
-            for s in range(0, n_train, train_tile):
-                e = min(s + train_tile, n_train)
-                if dist_fn is not None:
-                    d = dist_fn(tn_c, toh_c, rn_d[s:e], roh_d[s:e])
-                else:
-                    d = self._manh_jit(tn_c, toh_c, rn_d[s:e], roh_d[s:e])
-                best_d, best_i = merge(best_d, best_i, d, s)
+            tn_h, toh_h = tn[ts:te], toh[ts:te]
+            note_h2d(tn_h.nbytes + toh_h.nbytes, transfers=2)
+            tn_c = put(jnp.asarray(tn_h))
+            toh_c = put(jnp.asarray(toh_h))
+            note_dispatch()
+            best_d, best_i = kernel(tn_c, toh_c, rn_t, roh_t, base_d, nv_d)
             # chunk results stay device-side; the whole test axis reads
             # back in ONE transfer per output below (each separate
             # np.asarray costs a full ~62 ms tunnel round trip)
             out_d.append(best_d)
             out_i.append(best_i)
-        d_all = out_d[0] if len(out_d) == 1 else jnp.concatenate(out_d)
-        i_all = out_i[0] if len(out_i) == 1 else jnp.concatenate(out_i)
-        return (np.asarray(d_all).astype(np.int32), np.asarray(i_all))
+        if len(out_d) == 1:
+            d_all, i_all = out_d[0], out_i[0]
+        else:
+            note_dispatch()
+            d_all, i_all = _pair_concat_jit(len(out_d))(out_d, out_i)
+        return (fetch(d_all).astype(np.int32), fetch(i_all))
 
     def _manhattan_tiled(self, tn, toh, rn, roh, tile):
         out = np.zeros((tn.shape[0], rn.shape[0]), dtype=np.float32)
+        rn_d, roh_d = self._train_device(
+            "flat", lambda: (note_h2d(rn.nbytes + roh.nbytes, 2),
+                             (jnp.asarray(rn), jnp.asarray(roh)))[1])
         for s in range(0, tn.shape[0], tile):
             e = min(s + tile, tn.shape[0])
-            out[s:e] = np.asarray(self._manh_jit(
-                jnp.asarray(tn[s:e]), jnp.asarray(toh[s:e]),
-                jnp.asarray(rn), jnp.asarray(roh)))
+            note_h2d(tn[s:e].nbytes + toh[s:e].nbytes, transfers=2)
+            note_dispatch()
+            out[s:e] = fetch(self._manh_jit(
+                jnp.asarray(tn[s:e]), jnp.asarray(toh[s:e]), rn_d, roh_d))
         return out

@@ -62,12 +62,28 @@ from .mesh import MeshContext
 # --------------------------------------------------------------------------
 
 def sharded_jit_reduce(fn: Callable, ctx: MeshContext,
-                       n_batch_args: int = 1, donate: bool = False):
+                       n_batch_args: int = 1, donate: bool = False,
+                       carry_args: tuple = ()):
     """jit ``fn(batch_arg0, ..., *replicated_args)`` with the first
     ``n_batch_args`` arguments row-sharded over the data axis and everything
     else replicated; outputs replicated.  XLA turns any full reduction inside
     into per-shard partials + all-reduce (the combiner+shuffle of the
-    reference, e.g. MutualInformation.java:243's combiner, for free)."""
+    reference, e.g. MutualInformation.java:243's combiner, for free).
+
+    ``donate=True`` donates every index in ``carry_args`` — a replicated
+    running accumulator the caller rebinds each chunk, e.g.
+    ``acc = red(oh, keys, acc)`` in the eventTimeDistribution job.  The
+    carry's output twin has identical shape/dtype/sharding, so XLA
+    updates the accumulator IN PLACE instead of the defensive copy it
+    otherwise makes per dispatch.  The BATCH args are deliberately NOT
+    donated: a reduction's replicated output can never alias a
+    row-sharded batch input, so batch donation buys nothing on this jax
+    (unusable donations aren't even freed early) and would only emit a
+    'donated buffers were not usable' warning per compiled shape.
+    Contract: the caller must place the carry with the matching sharding
+    (``ctx.replicate``) and must NOT reuse it after the call — its
+    buffer is invalidated, which tests/test_transfers.py pins so a jax
+    upgrade cannot silently regress the API to copying again."""
     row = NamedSharding(ctx.mesh, P(ctx.axis))
     rep = NamedSharding(ctx.mesh, P())
     jitted_cache: Dict[int, Callable] = {}
@@ -77,8 +93,9 @@ def sharded_jit_reduce(fn: Callable, ctx: MeshContext,
         jitted = jitted_cache.get(len(args))
         if jitted is None:
             in_sh = tuple(row if i < n_batch_args else rep for i in range(len(args)))
-            jitted = jax.jit(fn, in_shardings=in_sh, out_shardings=rep,
-                             donate_argnums=tuple(range(n_batch_args)) if donate else ())
+            jitted = jax.jit(
+                fn, in_shardings=in_sh, out_shardings=rep,
+                donate_argnums=tuple(carry_args) if donate else ())
             jitted_cache[len(args)] = jitted
         return jitted(*args)
 

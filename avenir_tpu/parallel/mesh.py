@@ -89,13 +89,19 @@ class MeshContext:
         """Place an array row-sharded over the mesh.  Row count must be a
         multiple of the mesh size (use ColumnarTable.pad_to_multiple first).
         Multi-process: ``arr`` is this process's equalized local block and
-        the result is the global row-sharded array (multi-host ingest)."""
+        the result is the global row-sharded array (multi-host ingest).
+
+        All MeshContext placement helpers record their bytes into the
+        active :class:`utils.tracing.TransferLedger` (host arrays only —
+        re-placing an array already on device moves no link bytes)."""
+        _note_upload(arr)
         if jax.process_count() > 1:
             from .distributed import from_process_local
             return from_process_local(np.asarray(arr), self.mesh)
         return jax.device_put(arr, self.row_sharding())
 
     def replicate(self, arr) -> jax.Array:
+        _note_upload(arr)
         return jax.device_put(arr, self.replicated_sharding())
 
     def shard_rows_streamed(self, arr, chunk_bytes: int = 64 << 20
@@ -130,6 +136,7 @@ class MeshContext:
         n = arr.shape[0]
         for s in range(0, n, rows):
             e = min(s + rows, n)
+            _note_upload(arr[s:e])
             # tail chunks may not divide the mesh; ship them replicated-
             # free via plain device_put and let the concat reshard
             parts.append(jax.device_put(arr[s:e], self.row_sharding())
@@ -154,6 +161,19 @@ class MeshContext:
     def shard_table(self, padded, arrays: dict) -> dict:
         """Shard a dict of per-row arrays (all first-dim n_rows)."""
         return {k: self.shard_rows(v) for k, v in arrays.items()}
+
+
+def _note_upload(arr) -> None:
+    """Ledger hook for the placement helpers: a HOST array crossing to the
+    device records its bytes + one transfer; an array that is already a
+    jax.Array is a reshard, not a link transfer.  Bytes are the host
+    array's (replication fan-out to N devices is a runtime detail below
+    the accounting altitude)."""
+    if isinstance(arr, jax.Array):
+        return
+    from ..utils.tracing import note_h2d
+    a = np.asarray(arr)
+    note_h2d(a.nbytes)
 
 
 @functools.lru_cache(maxsize=None)

@@ -5,6 +5,14 @@ per-step timing metrics + optional XLA profiler capture).
 - :class:`StepTimer` — named wall-clock step accounting that exports into
   the job Counters channel (millisecond totals/counts, like Hadoop's
   job counters view).
+- :class:`TransferLedger` — measured host<->device traffic accounting:
+  H2D/D2H bytes, transfer counts and hot-path kernel dispatches, recorded
+  at the framework's instrumented upload/readback/launch sites (mesh
+  sharding helpers, the tree/forest level kernels, the fused KNN top-k,
+  the ensemble vote, SMO).  Replaces the hand-modeled
+  ``bytes_moved_link`` roofline terms with measured values and pins
+  dispatch-count regressions by test (trace-hook style, like
+  ``serving.predictor.compile_count``).
 - :func:`device_sync` — sync point that works on the tunneled axon platform
   where ``block_until_ready`` can return early: reads one leaf back.
 - :func:`trace` — context manager around ``jax.profiler.trace`` when the
@@ -17,9 +25,10 @@ from __future__ import annotations
 
 import contextlib
 import logging
+import threading
 import time
 from collections import defaultdict, deque
-from typing import Dict, Iterator, Optional
+from typing import Dict, Iterator, List, Optional
 
 import numpy as np
 
@@ -37,6 +46,124 @@ def device_sync(*arrays) -> None:
                 np.asarray(leaf[(0,) * leaf.ndim])
             else:
                 np.asarray(leaf)
+
+
+class TransferLedger:
+    """Measured link-traffic ledger: actual H2D/D2H bytes, transfer counts
+    and hot-path dispatches for the current scope.
+
+    The framework cannot see XLA's internal runtime counters portably, so
+    the ledger counts at the instrumented call sites instead — every
+    ``MeshContext`` upload helper, the readbacks/launches of the tree,
+    forest, KNN, ensemble-vote and SMO hot paths.  That is exactly the set
+    of transfers the rooflines used to MODEL, so the measured numbers are
+    directly comparable — and, unlike the model, they regress loudly when
+    a code change reintroduces a per-tile dispatch or a per-tree readback
+    (tests/test_transfers.py pins exact counts).
+
+    Scoping: ``transfer_ledger()`` pushes a ledger onto a process-global
+    stack; recording helpers write into EVERY active ledger, so a job-level
+    ledger (cli.run) and a test-local one can nest.  The stack is global
+    (not thread-local) on purpose: staging/prefetch threads must land their
+    uploads in the scope that spawned them.  Counts are lock-protected —
+    recording is a few adds per multi-MB transfer, so contention is noise.
+    """
+
+    __slots__ = ("h2d_bytes", "d2h_bytes", "h2d_transfers", "d2h_transfers",
+                 "dispatches", "_lock")
+
+    def __init__(self):
+        self.h2d_bytes = 0
+        self.d2h_bytes = 0
+        self.h2d_transfers = 0
+        self.d2h_transfers = 0
+        self.dispatches = 0
+        self._lock = threading.Lock()
+
+    def record_h2d(self, nbytes: int, transfers: int = 1) -> None:
+        with self._lock:
+            self.h2d_bytes += int(nbytes)
+            self.h2d_transfers += int(transfers)
+
+    def record_d2h(self, nbytes: int, transfers: int = 1) -> None:
+        with self._lock:
+            self.d2h_bytes += int(nbytes)
+            self.d2h_transfers += int(transfers)
+
+    def record_dispatch(self, n: int = 1) -> None:
+        with self._lock:
+            self.dispatches += int(n)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {"h2d_bytes": self.h2d_bytes,
+                    "d2h_bytes": self.d2h_bytes,
+                    "h2d_transfers": self.h2d_transfers,
+                    "d2h_transfers": self.d2h_transfers,
+                    "dispatches": self.dispatches}
+
+    def export(self, counters, group: str = "Transfers") -> None:
+        """Into the job Counters channel, Hadoop-dump style.  Byte tallies
+        are per-process host-side work, so exporting BEFORE a multi-process
+        all-reduce yields correct cluster totals (each process moves its
+        own bytes)."""
+        counters.update_group(group, {
+            "H2DBytes": self.h2d_bytes, "D2HBytes": self.d2h_bytes,
+            "H2DTransfers": self.h2d_transfers,
+            "D2HTransfers": self.d2h_transfers,
+            "Dispatches": self.dispatches})
+
+
+# global (NOT thread-local: staging threads record into their spawner's
+# scope) stack of active ledgers; the common no-ledger case is one truthiness
+# check per record site
+_ledgers: List[TransferLedger] = []
+_ledgers_lock = threading.Lock()
+
+
+@contextlib.contextmanager
+def transfer_ledger(ledger: Optional[TransferLedger] = None
+                    ) -> Iterator[TransferLedger]:
+    """Activate a TransferLedger for the dynamic scope (fresh one by
+    default); nests — inner scopes record into outer ledgers too."""
+    led = ledger if ledger is not None else TransferLedger()
+    with _ledgers_lock:
+        _ledgers.append(led)
+    try:
+        yield led
+    finally:
+        with _ledgers_lock:
+            _ledgers.remove(led)
+
+
+def note_h2d(nbytes: int, transfers: int = 1) -> None:
+    if _ledgers:
+        for led in list(_ledgers):
+            led.record_h2d(nbytes, transfers)
+
+
+def note_d2h(nbytes: int, transfers: int = 1) -> None:
+    if _ledgers:
+        for led in list(_ledgers):
+            led.record_d2h(nbytes, transfers)
+
+
+def note_dispatch(n: int = 1) -> None:
+    if _ledgers:
+        for led in list(_ledgers):
+            led.record_dispatch(n)
+
+
+def fetch(device_array, dtype=None) -> np.ndarray:
+    """``np.asarray`` with D2H accounting: the ONE way instrumented hot
+    paths read a device array back (each separate readback costs a full
+    ~62 ms tunnel round trip — TPU_NOTES §5 — so counting them is counting
+    the thing that hurts).  Bytes recorded are the DEVICE array's (the
+    wire form), not the optionally-widened host copy's."""
+    note_d2h(int(getattr(device_array, "nbytes", 0)))
+    if dtype is None:
+        return np.asarray(device_array)
+    return np.asarray(device_array, dtype=dtype)
 
 
 class StepTimer:

@@ -39,6 +39,37 @@ def test_overlap_fraction_bounds():
     assert bench._overlap_fraction(2.0, 3.0, 9.0) == 0.0
 
 
+def test_pipeline_overlap_decomposition():
+    """Three-stage (parse/transfer/compute) overlap: 1 when both shorter
+    stages hide behind the longest, 0 when serial, clipped, 0 on empty."""
+    d = bench._pipeline_overlap(2.0, 1.0, 3.0, 3.0)
+    assert d["overlap_fraction"] == 1.0            # fully hidden
+    assert d["parse_s"] == 2.0 and d["compute_s"] == 3.0
+    assert bench._pipeline_overlap(2.0, 1.0, 3.0, 6.0)["overlap_fraction"] \
+        == 0.0                                     # serial
+    assert bench._pipeline_overlap(2.0, 1.0, 3.0, 4.5)["overlap_fraction"] \
+        == 0.5
+    assert bench._pipeline_overlap(0.0, 0.0, 3.0, 3.0)["overlap_fraction"] \
+        == 0.0                                     # nothing to hide
+    assert bench._pipeline_overlap(2.0, 1.0, 3.0, 1.0)["overlap_fraction"] \
+        == 1.0                                     # clock noise clips
+
+
+def test_roofline_measured_link_fields():
+    """A ledger snapshot replaces the modeled link terms and marks the
+    block measured; the modeled form stays explicitly unmeasured."""
+    snap = {"h2d_bytes": 1000, "d2h_bytes": 500, "h2d_transfers": 3,
+            "d2h_transfers": 2, "dispatches": 7}
+    r = bench.roofline(1.0, flops=1e9, measured=snap)
+    assert r["measured"] is True
+    assert r["bytes_moved_link"] == 1500.0
+    assert r["link_h2d_bytes"] == 1000 and r["link_d2h_bytes"] == 500
+    assert r["link_transfers"] == 5 and r["dispatches"] == 7
+    r2 = bench.roofline(1.0, flops=1e9, up_bytes=10.0)
+    assert r2["measured"] is False
+    assert "link_h2d_bytes" not in r2
+
+
 @pytest.mark.slow
 def test_e2e_rf_workload_reports_streaming_phases(monkeypatch, tmp_path):
     """The real bench e2e_rf workload (shrunk; the 100M/20M sizes are
@@ -49,9 +80,14 @@ def test_e2e_rf_workload_reports_streaming_phases(monkeypatch, tmp_path):
     r = bench.e2e_rf_rate(30_000)
     assert r["streaming"] is True
     for key in ("parse_s", "transfer_s", "ingest_s", "compute_s",
-                "serialize_s", "overlap_fraction"):
+                "serialize_s", "overlap_fraction", "pipeline_overlap"):
         assert key in r, key
     assert 0.0 <= r["overlap_fraction"] <= 1.0
+    for key in ("parse_s", "transfer_s", "compute_s", "wall_s",
+                "overlap_fraction"):
+        assert key in r["pipeline_overlap"], key
+    assert r["roofline"]["measured"] is True
+    assert r["roofline"]["link_h2d_bytes"] > 0
     assert r["value"] > 0
 
 

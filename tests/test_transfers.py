@@ -1,0 +1,397 @@
+"""Link-bottleneck regression tests (ISSUE 5): the measured transfer
+ledger, buffer donation, the scan-fused KNN top-k's O(1) dispatch shape,
+the forest's one-stacked-readback-per-level rule, and the staged ingest
+pipeline's phase accounting.
+
+These pin the EXACT dispatch + transfer counts of the hot paths via the
+ledger (trace-hook style, like serving.predictor.compile_count): a code
+change that reintroduces a per-tile dispatch or a per-tree readback fails
+loudly here instead of silently re-throttling the tunnel."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from avenir_tpu.core.metrics import Counters
+from avenir_tpu.core.table import prefetch_chunks, stage_chunks
+from avenir_tpu.utils.tracing import (TransferLedger, fetch, note_dispatch,
+                                      note_h2d, transfer_ledger)
+
+
+# ---------------------------------------------------------------------------
+# TransferLedger mechanics
+# ---------------------------------------------------------------------------
+
+def test_ledger_records_and_exports():
+    led = TransferLedger()
+    led.record_h2d(100)
+    led.record_h2d(50, transfers=2)
+    led.record_d2h(30)
+    led.record_dispatch(3)
+    snap = led.snapshot()
+    assert snap == {"h2d_bytes": 150, "d2h_bytes": 30, "h2d_transfers": 3,
+                    "d2h_transfers": 1, "dispatches": 3}
+    c = Counters()
+    led.export(c)
+    assert c.get("Transfers", "H2DBytes") == 150
+    assert c.get("Transfers", "D2HBytes") == 30
+    assert c.get("Transfers", "Dispatches") == 3
+    assert c.group("Transfers")["H2DTransfers"] == 3
+
+
+def test_ledger_scopes_nest_and_thread_records_land():
+    with transfer_ledger() as outer:
+        note_h2d(10)
+        with transfer_ledger() as inner:
+            note_dispatch()
+            # a worker thread (the staging thread in production) records
+            # into the scope that spawned it
+            t = threading.Thread(target=lambda: note_h2d(5))
+            t.start()
+            t.join()
+        note_h2d(1)
+    assert inner.snapshot()["h2d_bytes"] == 5
+    assert inner.snapshot()["dispatches"] == 1
+    assert outer.snapshot() == {"h2d_bytes": 16, "d2h_bytes": 0,
+                                "h2d_transfers": 3, "d2h_transfers": 0,
+                                "dispatches": 1}
+    # no active scope: recording helpers are no-ops
+    note_h2d(1 << 30)
+    assert outer.snapshot()["h2d_bytes"] == 16
+
+
+def test_fetch_counts_device_wire_bytes():
+    dev = jnp.arange(8, dtype=jnp.int32)
+    with transfer_ledger() as led:
+        out = fetch(dev, dtype=np.float64)   # widened on host
+    assert out.dtype == np.float64 and out.shape == (8,)
+    assert led.snapshot()["d2h_bytes"] == 8 * 4   # device int32, not host f64
+    assert led.snapshot()["d2h_transfers"] == 1
+
+
+# ---------------------------------------------------------------------------
+# donation: the API must actually invalidate (no silent defensive copy)
+# ---------------------------------------------------------------------------
+
+def test_sharded_jit_reduce_donated_carry_is_invalidated(mesh_ctx):
+    """The eventTimeDistribution wiring: a streamed keyed reduce with a
+    donated replicated accumulator carry.  The carry's buffer must be
+    ACTUALLY invalidated (in-place aliasing happened) — if a jax upgrade
+    ever reverts this to a copy, the flag has silently stopped doing its
+    job and this pin fails."""
+    from avenir_tpu.parallel import collectives as C
+    n_keys = 4
+    fn = C.sharded_jit_reduce(
+        lambda v, kk, acc: acc + C.keyed_reduce(v, kk, n_keys
+                                                ).astype(jnp.int32),
+        mesh_ctx, n_batch_args=2, donate=True, carry_args=(2,))
+    # placed WITH the target shardings, as the production caller does: a
+    # mismatched layout would be resharded into a copy and the original
+    # would survive, making donation a silent no-op
+    acc = mesh_ctx.replicate(jnp.zeros((n_keys, 3), jnp.int32))
+    v = mesh_ctx.shard_rows(np.ones((16, 3), np.float32))
+    kk = mesh_ctx.shard_rows(np.tile(np.arange(4, dtype=np.int32), 4))
+    acc2 = fn(v, kk, acc)
+    assert acc.is_deleted()                   # updated in place, not copied
+    v2 = mesh_ctx.shard_rows(np.ones((16, 3), np.float32))
+    kk2 = mesh_ctx.shard_rows(np.tile(np.arange(4, dtype=np.int32), 4))
+    acc3 = fn(v2, kk2, acc2)
+    assert acc2.is_deleted()
+    out = np.asarray(acc3)
+    assert out.shape == (n_keys, 3) and out.sum() == 2 * 16 * 3
+    # non-donating form keeps its inputs usable
+    fn2 = C.sharded_jit_reduce(lambda v, kk: C.keyed_reduce(v, kk, n_keys),
+                               mesh_ctx, n_batch_args=2)
+    v3 = mesh_ctx.shard_rows(np.ones((16, 3), np.float32))
+    kk3 = mesh_ctx.shard_rows(np.tile(np.arange(4, dtype=np.int32), 4))
+    fn2(v3, kk3)
+    assert not v3.is_deleted()
+
+
+def test_topk_merge_kernel_donates_running_best():
+    from avenir_tpu.ops.distance import _topk_merge_kernel
+    merge = _topk_merge_kernel(3)
+    bd = jnp.full((4, 3), np.inf, dtype=jnp.float32)
+    bi = jnp.full((4, 3), -1, dtype=jnp.int32)
+    tile = jnp.asarray(np.arange(20, dtype=np.float32).reshape(4, 5))
+    nbd, nbi = merge(bd, bi, tile, jnp.int32(0))
+    assert bd.is_deleted() and bi.is_deleted()
+    assert np.asarray(nbi)[0].tolist() == [0, 1, 2]
+
+
+def test_tree_reassign_donates_node_ids():
+    from avenir_tpu.models.tree import _REASSIGN_JIT
+    node_ids = jnp.zeros((8,), jnp.int32)
+    branches = jnp.zeros((8, 2), jnp.int32)
+    sel = jnp.zeros((1,), jnp.int32)
+    ctab = jnp.zeros((1, 2), jnp.int32)
+    out = _REASSIGN_JIT(node_ids, branches, sel, ctab)
+    assert node_ids.is_deleted()
+    assert not branches.is_deleted()          # only the carry is donated
+    assert np.asarray(out).shape == (8,)
+
+
+def test_acc_counts_donates_accumulator():
+    from avenir_tpu.models.tree import acc_counts
+    acc = jnp.zeros((2, 3), jnp.int32)
+    c = jnp.ones((2, 3), jnp.float32)
+    out = acc_counts(acc, c)
+    assert acc.is_deleted()
+    assert np.asarray(out).sum() == 6
+
+
+# ---------------------------------------------------------------------------
+# KNN: int8 wire form, train-side cache, O(1) dispatches per test chunk
+# ---------------------------------------------------------------------------
+
+from avenir_tpu.core.schema import FeatureSchema
+from avenir_tpu.core.table import encode_rows
+from avenir_tpu.ops.distance import DistanceComputer
+
+KNN_SCHEMA = FeatureSchema.from_dict({
+    "fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "x", "ordinal": 1, "dataType": "double", "feature": True,
+         "min": 0, "max": 10},
+        {"name": "y", "ordinal": 2, "dataType": "double", "feature": True,
+         "min": 0, "max": 10},
+        {"name": "color", "ordinal": 3, "dataType": "categorical",
+         "feature": True, "cardinality": ["red", "green", "blue"]},
+        {"name": "label", "ordinal": 4, "dataType": "categorical",
+         "cardinality": ["A", "B"]},
+    ]
+})
+
+
+def knn_rows(n, seed=0):
+    rng = np.random.default_rng(seed)
+    cols = ["red", "green", "blue"]
+    return [[f"e{i}", f"{rng.uniform(0, 10):.3f}", f"{rng.uniform(0, 10):.3f}",
+             cols[rng.integers(0, 3)], "A"] for i in range(n)]
+
+
+def test_encode_one_hot_int8_parity():
+    """The int8 one-hot wire form computes bit-identical int distances to
+    explicitly-f32 one-hots through the same kernels (the device upcast is
+    lossless)."""
+    comp = DistanceComputer(KNN_SCHEMA, scale=1000)
+    train = encode_rows(knn_rows(40, 1), KNN_SCHEMA)
+    test = encode_rows(knn_rows(10, 2), KNN_SCHEMA)
+    tn, toh = comp.encode(test)
+    rn, roh = comp.encode(train)
+    assert toh.dtype == np.int8 and roh.dtype == np.int8
+    d_int8 = comp.pairwise(test, train)
+    d_f32 = np.asarray(comp._euclid_jit(
+        jnp.asarray(tn), jnp.asarray(toh.astype(np.float32)),
+        jnp.asarray(rn), jnp.asarray(roh.astype(np.float32)))
+    ).astype(np.int32)
+    assert (d_int8 == d_f32).all()
+
+
+def test_pairwise_topk_scan_multi_tile_parity():
+    """Scan-fused multi-tile top-k == full matrix + stable argsort with
+    REAL tile boundaries (>1024 train rows beats the tile-size floor)."""
+    train = encode_rows(knn_rows(2500, 3), KNN_SCHEMA)
+    test = encode_rows(knn_rows(64, 4), KNN_SCHEMA)
+    for metric in ("euclidean", "manhattan"):
+        comp = DistanceComputer(KNN_SCHEMA, metric=metric, scale=1000)
+        full = comp.pairwise(test, train)
+        k = 9
+        d, idx = comp.pairwise_topk(test, train, k, train_tile=1024,
+                                    test_chunk=32)
+        order = np.argsort(full, axis=1, kind="stable")[:, :k]
+        assert (d == np.take_along_axis(full, order, axis=1)).all()
+        assert (idx == order).all()
+
+
+def test_pairwise_topk_dispatch_and_transfer_counts():
+    """The pinned O(1)-dispatch shape: a 2-chunk run costs exactly 2 scan
+    launches + 1 concat and 2 D2H transfers; the warm train cache drops
+    the train-side H2D entirely on the second call."""
+    comp = DistanceComputer(KNN_SCHEMA, scale=1000)
+    train = encode_rows(knn_rows(2500, 5), KNN_SCHEMA)
+    test = encode_rows(knn_rows(64, 6), KNN_SCHEMA)
+    with transfer_ledger() as cold:
+        d1, i1 = comp.pairwise_topk(test, train, 7, train_tile=1024,
+                                    test_chunk=32)
+    s = cold.snapshot()
+    # 2 test chunks -> 2 fused scan dispatches + 1 concat; the old
+    # per-tile loop cost 2 dispatches x 3 tiles per chunk
+    assert s["dispatches"] == 3
+    assert s["d2h_transfers"] == 2            # distances + indices, once
+    # train tiles/base/nvalid (4) + 2 uploads per test chunk
+    assert s["h2d_transfers"] == 4 + 2 * 2
+    with transfer_ledger() as warm:
+        d2, i2 = comp.pairwise_topk(test, train, 7, train_tile=1024,
+                                    test_chunk=32)
+    w = warm.snapshot()
+    assert w["dispatches"] == 3 and w["d2h_transfers"] == 2
+    assert w["h2d_transfers"] == 2 * 2        # train side fully cached
+    assert w["h2d_bytes"] < s["h2d_bytes"]
+    assert (d1 == d2).all() and (i1 == i2).all()
+
+
+def test_pairwise_topk_single_chunk_no_concat():
+    comp = DistanceComputer(KNN_SCHEMA, scale=1000)
+    train = encode_rows(knn_rows(200, 7), KNN_SCHEMA)
+    test = encode_rows(knn_rows(16, 8), KNN_SCHEMA)
+    with transfer_ledger() as led:
+        comp.pairwise_topk(test, train, 5)
+    assert led.snapshot()["dispatches"] == 1  # one scan launch, no concat
+    assert led.snapshot()["d2h_transfers"] == 2
+
+
+# ---------------------------------------------------------------------------
+# RF: one dispatch + ONE stacked D2H per level for the whole forest
+# ---------------------------------------------------------------------------
+
+def test_forest_level_loop_dispatch_and_readback_counts(mesh_ctx):
+    """A max_depth=2 batched build is exactly: root count launch + one
+    fused level launch, each with ONE stacked (T,N,S,B,C) readback —
+    never a per-tree transfer."""
+    from avenir_tpu.models.forest import ForestBuilder, ForestParams
+    from tests.test_tree import make_table
+    table = make_table(600)
+    params = ForestParams(num_trees=4, seed=2)
+    params.tree.max_depth = 2
+    fb = ForestBuilder(table, params, mesh_ctx)
+    with transfer_ledger() as led:
+        models = fb.build_all()
+    s = led.snapshot()
+    assert len(models) == 4
+    assert s["dispatches"] == 2               # root count + fused level
+    assert s["d2h_transfers"] == 2            # one stacked transfer each
+    # the stacked counts came back as int32/f32 cells, not per-tree blocks
+    assert s["d2h_bytes"] > 0
+
+
+def test_tree_level_counts_single_readback(mesh_ctx):
+    from avenir_tpu.models.tree import TreeBuilder, TreeParams
+    from tests.test_tree import make_table
+    table = make_table(400)
+    b = TreeBuilder(table, TreeParams(max_depth=2, seed=1), mesh_ctx)
+    weights = mesh_ctx.shard_rows(
+        b._expand_weights(None).astype(np.float32))
+    node_ids = mesh_ctx.shard_rows(np.zeros((b.n_padded,), np.int32))
+    b._w_max, b._w_integral = 1.0, True
+    with transfer_ledger() as led:
+        counts = b.level_counts(node_ids, weights, 1)
+    assert counts.shape[0] == 1
+    assert led.snapshot()["dispatches"] == 1
+    assert led.snapshot()["d2h_transfers"] == 1
+
+
+# ---------------------------------------------------------------------------
+# staged ingest pipeline: phase accounting + threading contract
+# ---------------------------------------------------------------------------
+
+def test_prefetch_stats_decompose_with_slow_producer():
+    def slow_source():
+        for i in range(5):
+            time.sleep(0.02)
+            yield i
+
+    stats = {}
+    assert list(prefetch_chunks(slow_source(), stats=stats)) == list(range(5))
+    # all decomposition keys exist even when unused
+    for key in ("parse_s", "transfer_s", "queue_wait_s"):
+        assert key in stats
+    assert stats["parse_s"] >= 0.08           # 5 x 20ms of producer work
+    # consumer outran the slow producer: it visibly waited on the queue
+    assert stats["queue_wait_s"] > 0.0
+    assert stats["transfer_s"] == 0.0         # no staging hook installed
+
+
+def test_prefetch_stage_fn_runs_in_producer_and_is_timed():
+    main_thread = threading.get_ident()
+    seen_threads = []
+
+    def stage(item):
+        seen_threads.append(threading.get_ident())
+        time.sleep(0.01)
+        return item * 2
+
+    stats = {}
+    out = list(prefetch_chunks(iter(range(4)), stats=stats, stage_fn=stage))
+    assert out == [0, 2, 4, 6]
+    assert stats["transfer_s"] >= 0.03
+    assert all(t != main_thread for t in seen_threads)
+
+
+def test_stage_chunks_overlaps_staging_with_compute():
+    """Double-buffered staging: 4 x 30ms stage + 4 x 30ms consume must
+    take well under the 240ms serial sum."""
+    def stage(item):
+        time.sleep(0.03)
+        return item
+
+    stats = {}
+    t0 = time.perf_counter()
+    for _ in stage_chunks(iter(range(4)), stage, stats=stats):
+        time.sleep(0.03)                       # consumer compute
+    wall = time.perf_counter() - t0
+    assert stats["transfer_s"] >= 0.1
+    assert wall < 0.21, wall                   # >=25% hidden, robustly
+
+
+def test_stage_chunks_propagates_stage_failure_exactly_once():
+    def stage(item):
+        if item == 2:
+            raise RuntimeError("stage blew up")
+        return item
+
+    it = stage_chunks(iter(range(5)), stage, stats={})
+    got = []
+    with pytest.raises(RuntimeError, match="stage blew up"):
+        for x in it:
+            got.append(x)
+    assert got == [0, 1]
+
+
+def test_stage_chunks_thread_exits_when_consumer_abandons():
+    def stage(item):
+        return item
+
+    it = stage_chunks(iter(range(100)), stage)
+    next(it)
+    it.close()                                 # consumer walks away
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if not any(t.name == "avenir-ingest-stage"
+                   for t in threading.enumerate()):
+            break
+        time.sleep(0.01)
+    assert not any(t.name == "avenir-ingest-stage"
+                   for t in threading.enumerate())
+
+
+def test_from_stream_three_stage_stats_and_parity(mesh_ctx):
+    """The staged from_stream trains the bit-identical model of the
+    monolithic builder and reports the parse/transfer/compute phase
+    decomposition."""
+    from avenir_tpu.models.forest import (ForestParams, build_forest,
+                                          build_forest_from_stream)
+    from tests.test_tree import SCHEMA, make_table
+    table = make_table(900)
+    params = ForestParams(num_trees=3, seed=5)
+    params.tree.max_depth = 2
+    want = [m.to_json() for m in build_forest(table, params, mesh_ctx)]
+
+    def blocks():
+        for s in range(0, table.n_rows, 250):
+            yield table.take_rows(s, min(s + 250, table.n_rows))
+
+    stats = {}
+    got = build_forest_from_stream(
+        prefetch_chunks(blocks(), stats=stats, consumer_wait_key=None),
+        SCHEMA, params, mesh_ctx, stats=stats)
+    assert [m.to_json() for m in got] == want
+    for key in ("parse_s", "transfer_s", "ingest_compute_s",
+                "queue_wait_s", "ingest_wall_s", "build_s"):
+        assert key in stats, key
+    assert stats["transfer_s"] > 0.0
