@@ -96,6 +96,20 @@ def _bad_records_policy(cfg: Config, counters: Counters,
     return BadRecordPolicy(pol, qpath, counters)
 
 
+def _cache_policy(cfg: Config, counters: Counters,
+                  prefix: str = "dtb.streaming.cache"):
+    """The job-level columnar-cache knob (``<prefix>.policy`` =
+    off|use|build|require, ``<prefix>.dir`` overriding the default
+    ``<csv>.avtc`` sidecar location).  Tallies surface through the job's
+    counter dump as the ``ColumnarCache`` group, next to ``Transfers``."""
+    pol = cfg.get(f"{prefix}.policy", "off")
+    if pol == "off":
+        return None
+    from ..io.colcache import CachePolicy
+    return CachePolicy(policy=pol, cache_dir=cfg.get(f"{prefix}.dir"),
+                       counters=counters)
+
+
 def _splitter(delim_regex: str):
     """Line splitter honoring field.delim.regex semantics: literal fast
     path, re.split otherwise — THE tokenizer, shared with core.table and
@@ -186,7 +200,15 @@ def random_forest_builder(cfg: Config, in_path: str, out_path: str) -> Counters:
     ``dtb.streaming.checkpoint.blocks``, default 16) persists ingest
     progress so ``dtb.streaming.resume=true`` (CLI ``--resume``) restarts
     from the last intact step and still produces the bit-identical model
-    of an uninterrupted run."""
+    of an uninterrupted run.
+
+    ``dtb.streaming.cache.policy=use|build|require`` (+ optional
+    ``dtb.streaming.cache.dir``) slots the write-once binary columnar
+    sidecar (TPU_NOTES §19) under the ingest: ``build`` emits
+    ``<csv>.avtc/`` during the first full pass, later passes load the
+    encoded chunks at memcpy speed and skip CSV parse entirely; models,
+    resume, and quarantine behavior are bit-identical either way
+    (``ColumnarCache`` counter group reports hits/bytes)."""
     from ..models.forest import (ForestParams, build_forest,
                                  build_forest_from_stream)
     counters = Counters()
@@ -257,7 +279,8 @@ def random_forest_builder(cfg: Config, in_path: str, out_path: str) -> Counters:
         blocks = prefetch_chunks(iter_csv_chunks(
             in_path, schema, cfg.field_delim_regex,
             chunk_rows=cfg.get_int("dtb.streaming.block.rows", 1 << 22),
-            bad_records=policy, start_row=start_row),
+            bad_records=policy, start_row=start_row,
+            cache=_cache_policy(cfg, counters)),
             consumer_wait_key=None)
         if baseline_builder is not None:
             # the baseline rides the SAME single ingest pass (a resumed

@@ -31,7 +31,10 @@ call, retries included).  ``times`` bounds how often the spec fires
 Instrumented ops: ``chunk_read`` (native chunk parse), ``chunk_encode``
 (python-oracle chunk parse), ``artifact_write`` (part-file/JSON writes),
 ``checkpoint_save`` (CheckpointManager.save), ``registry_publish``
-(serving ModelRegistry.publish array payload write).
+(serving ModelRegistry.publish array payload write), ``cache_write``
+(columnar-cache chunk emit — a fault abandons the build with a warning,
+never the training pass), ``cache_read`` (columnar-cache chunk load — a
+fault degrades the stream to CSV parse with a warning).
 """
 
 from __future__ import annotations

@@ -62,6 +62,10 @@ class BadRecordPolicy:
     quarantine_path: Optional[str] = None
     counters: Optional[Counters] = None
     n_bad: int = 0
+    # quarantine dir existence is checked once, not per appended record
+    # (os.makedirs on every record() measured as pure syscall overhead on
+    # heavily-corrupt streams)
+    _qdir_ready: bool = dc_field(default=False, repr=False, compare=False)
 
     POLICIES = ("fail", "skip", "quarantine")
 
@@ -78,10 +82,13 @@ class BadRecordPolicy:
         return self.policy in ("skip", "quarantine")
 
     def quarantine_file(self) -> str:
-        os.makedirs(self.quarantine_path, exist_ok=True)
+        if not self._qdir_ready:
+            os.makedirs(self.quarantine_path, exist_ok=True)
+            self._qdir_ready = True
         return os.path.join(self.quarantine_path, "part-q-00000")
 
-    def record(self, lines: Sequence[str]) -> None:
+    def record(self, lines: Sequence[str],
+               src_rows: Optional[Sequence[int]] = None) -> None:
         """Report (and for quarantine, persist) a batch of malformed raw
         lines.  Appends, so resumed runs accumulate into one part file.
         The quarantine write happens FIRST (one buffered write call) and
@@ -89,7 +96,13 @@ class BadRecordPolicy:
         the whole chunk retried must not have already inflated the
         tallies (the file itself stays at-least-once — a mid-append fault
         can duplicate lines on retry, exactly like a re-run Hadoop
-        task)."""
+        task).
+
+        ``src_rows`` (parallel to ``lines``) carries each record's
+        absolute SOURCE row index (non-blank line count, the
+        checkpoint/resume axis).  This policy ignores it; the columnar
+        cache's recording wrapper (io.colcache) persists it so a cached
+        replay can honor a mid-cache ``start_row`` cut exactly."""
         n = len(lines)
         if n == 0:
             return
@@ -420,7 +433,8 @@ def encode_rows(rows: List[List[str]], schema: FeatureSchema,
 def load_csv(source: Union[str, io.TextIOBase], schema: FeatureSchema,
              delim_regex: str = ",", keep_raw: bool = False,
              use_native: bool = True,
-             bad_records: Optional[BadRecordPolicy] = None) -> ColumnarTable:
+             bad_records: Optional[BadRecordPolicy] = None,
+             cache=None) -> ColumnarTable:
     """Load a CSV file (path or file object) into a ColumnarTable.
 
     Uses the native C++ tokenizer/encoder when available and the delimiter is a
@@ -431,8 +445,28 @@ def load_csv(source: Union[str, io.TextIOBase], schema: FeatureSchema,
     python oracle path for it (per-record filtering needs the raw lines —
     the streaming path, ``iter_csv_chunks``, keeps the native fast path
     under the same policy).
+
+    ``cache`` (an ``io.colcache.CachePolicy``) routes the load through
+    the chunked stream so the binary columnar sidecar is used/built; the
+    assembled table is byte-identical to the direct load
+    (``ColumnarTable.from_chunks`` contract).  Only path sources without
+    ``keep_raw`` can be cached: ``require`` refuses anything else, the
+    softer policies fall through to the plain load.
     """
     skipping = bad_records is not None and bad_records.skips
+    if cache is not None and getattr(cache, "enabled", False):
+        cacheable = isinstance(source, str) and not keep_raw
+        if not cacheable and cache.policy == "require":
+            raise ValueError(
+                "cache.policy=require needs a path source without "
+                "keep_raw (raw-row echo and text streams are not cached)")
+        if cacheable:
+            chunks = list(iter_csv_chunks(
+                source, schema, delim_regex, use_native=use_native,
+                bad_records=bad_records, cache=cache))
+            if not chunks:
+                return encode_rows([], schema)
+            return ColumnarTable.from_chunks(chunks)
     if isinstance(source, str):
         if use_native and len(delim_regex) == 1 and not skipping:
             try:
@@ -497,6 +531,7 @@ def _iter_csv_chunks_python(path: str, schema: FeatureSchema,
     is_bad = _bad_row_checker(schema) if skipping else None
     rows: List[List[str]] = []
     bad_lines: List[str] = []
+    bad_srcs: List[int] = []   # absolute 0-based source row per bad line
     consumed = 0   # non-blank source lines consumed, absolute
     block_idx = 0
     with open(path, "r") as fh:
@@ -510,14 +545,15 @@ def _iter_csv_chunks_python(path: str, schema: FeatureSchema,
             r = split(line)
             if skipping and is_bad(r):
                 bad_lines.append(line)
+                bad_srcs.append(consumed - 1)
                 continue
             rows.append(r)
             if len(rows) >= chunk_rows:
                 fault_point("chunk_encode", block_idx)
                 chunk = encode_rows(rows, schema)
                 if bad_lines:
-                    bad_records.record(bad_lines)
-                    bad_lines = []
+                    bad_records.record(bad_lines, src_rows=bad_srcs)
+                    bad_lines, bad_srcs = [], []
                 chunk.source_row_end = consumed
                 yield chunk
                 rows = []
@@ -526,7 +562,7 @@ def _iter_csv_chunks_python(path: str, schema: FeatureSchema,
         fault_point("chunk_encode", block_idx)
         chunk = encode_rows(rows, schema) if rows else None
         if bad_lines:
-            bad_records.record(bad_lines)
+            bad_records.record(bad_lines, src_rows=bad_srcs)
         if chunk is not None:
             chunk.source_row_end = consumed
             yield chunk
@@ -536,7 +572,7 @@ def iter_csv_chunks(path: str, schema: FeatureSchema,
                     delim_regex: str = ",", chunk_rows: int = 1 << 22,
                     use_native: bool = True,
                     bad_records: Optional[BadRecordPolicy] = None,
-                    start_row: int = 0):
+                    start_row: int = 0, cache=None):
     """Yield a CSV as ColumnarTable row blocks of up to ``chunk_rows`` rows
     — the parse stage of the streaming CSV->device ingest pipeline.  Host
     memory holds one encoded block at a time instead of the whole dataset
@@ -556,11 +592,26 @@ def iter_csv_chunks(path: str, schema: FeatureSchema,
     applies the skip/quarantine policy per block, and ``start_row``
     restarts the stream at a SOURCE row index (non-blank line count) —
     the checkpoint/resume contract; every yielded chunk reports its own
-    ``source_row_end`` on that axis."""
+    ``source_row_end`` on that axis.
+
+    ``cache`` (an ``io.colcache.CachePolicy``) slots the write-once
+    binary columnar sidecar under this stream: ``use``/``build``/
+    ``require`` serve an intact fresh sidecar at memcpy speed (parse
+    skipped entirely), ``build`` additionally emits the sidecar during a
+    cold full pass; bad-record policy, quarantine bytes, counters, and
+    ``start_row`` resume behave bit-identically either way (the sidecar
+    persists the per-chunk bad-record manifest), and a torn sidecar
+    degrades to this CSV parse with a warning."""
     if chunk_rows <= 0:
         raise ValueError(f"chunk_rows must be positive, got {chunk_rows}")
     if start_row < 0:
         raise ValueError(f"start_row must be >= 0, got {start_row}")
+    if cache is not None and getattr(cache, "enabled", False):
+        from ..io.colcache import iter_csv_chunks_cached
+        yield from iter_csv_chunks_cached(
+            path, schema, delim_regex, chunk_rows, use_native,
+            bad_records, int(start_row), cache)
+        return
     done_rows = int(start_row)
     if use_native and len(delim_regex) == 1:
         reader = None

@@ -401,7 +401,8 @@ class NativeCsvReader:
                 # fill, string extraction) already succeeded, so a chunk
                 # that is retried after a failure never double-quarantines
                 bad_records.record(
-                    [self.row_text(lo + int(i)) for i in bad_idx])
+                    [self.row_text(lo + int(i)) for i in bad_idx],
+                    src_rows=[lo + int(i) for i in bad_idx])
         else:
             for i, f in enumerate(fields):
                 if bads[i]:

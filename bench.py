@@ -284,6 +284,81 @@ def _pipeline_overlap(parse_s, transfer_s, compute_s, wall_s,
             "overlap_fraction": frac}
 
 
+def _rf_cache_epoch(run_once, path, n, csv_blobs, csv_pass_s, csv_parse_s,
+                    csv_ingest_s):
+    """The repeated-epoch measurement the columnar sidecar exists for
+    (ISSUE 6): a cold pass that parses the CSV AND builds the
+    ``<csv>.avtc`` cache, then a warm pass that re-baselines the
+    identical forest from the cache with CSV parse removed entirely.
+    Reports ingest rows/s for both, the stage-level parse vs cache-read
+    rate (the host bound before/after), and the cache build overhead.
+    The sidecar is dropped afterwards — fixture disk is budgeted for the
+    CSVs, not a second copy."""
+    from avenir_tpu.io.colcache import (CachePolicy, SIDECAR_SUFFIX,
+                                        drop_cache)
+    cdir = path + SIDECAR_SUFFIX
+    drop_cache(cdir)
+    try:
+        build_stats = {}
+        bp = CachePolicy("build", stats=build_stats)
+        t0 = time.perf_counter()
+        run_once(build_stats, cache=bp)
+        build_pass_s = time.perf_counter() - t0
+        warm_stats = {}
+        wp = CachePolicy("require", stats=warm_stats)
+        t0 = time.perf_counter()
+        warm_models = run_once(warm_stats, cache=wp)
+        warm_pass_s = time.perf_counter() - t0
+        # the cached epoch must train the bit-identical forest; COMPUTED
+        # (not asserted) so python -O cannot silently hardcode a pass and
+        # a mismatch is a loudly-false field, not a lost bench point
+        bit_identical = [m.to_json() for m in warm_models] == csv_blobs
+        warm_ingest_s = warm_stats.get("ingest_wall_s", warm_pass_s)
+        cache_read_s = warm_stats.get("cache_read_s", 0.0)
+        warm_pipeline = _pipeline_overlap(
+            warm_stats.get("parse_s", 0.0),
+            warm_stats.get("transfer_s", 0.0),
+            warm_stats.get("ingest_compute_s", 0.0),
+            warm_ingest_s, warm_stats.get("queue_wait_s", 0.0))
+        warm_pipeline["cache_read_s"] = round(cache_read_s, 3)
+        return {
+            "build_pass_s": round(build_pass_s, 3),
+            # vs the plain CSV pass: what emitting the sidecar cost
+            "build_overhead_s": round(build_pass_s - csv_pass_s, 3),
+            "cache_write_s": round(build_stats.get("cache_write_s", 0.0),
+                                   3),
+            "bytes_written": bp.tallies.get("BytesWritten", 0),
+            "bytes_read": wp.tallies.get("BytesRead", 0),
+            "warm_pass_s": round(warm_pass_s, 3),
+            "warm_ingest_s": round(warm_ingest_s, 3),
+            "cache_read_s": round(cache_read_s, 3),
+            # stage rate: the host bound before (CSV parse) and after
+            # (memcpy-speed chunk loads) — the ISSUE 6 acceptance axis
+            "csv_parse_rows_per_s": round(n / csv_parse_s, 1)
+            if csv_parse_s > 0 else None,
+            "cache_read_rows_per_s": round(n / cache_read_s, 1)
+            if cache_read_s > 0 else None,
+            "parse_speedup": round(csv_parse_s / cache_read_s, 2)
+            if cache_read_s > 0 and csv_parse_s > 0 else None,
+            # wall-clock ingest rate (parse/transfer/compute overlapped)
+            "csv_ingest_rows_per_s": round(n / csv_ingest_s, 1)
+            if csv_ingest_s > 0 else None,
+            "warm_ingest_rows_per_s": round(n / warm_ingest_s, 1)
+            if warm_ingest_s > 0 else None,
+            "ingest_speedup": round(csv_ingest_s / warm_ingest_s, 2)
+            if warm_ingest_s > 0 and csv_ingest_s > 0 else None,
+            "models_bit_identical": bit_identical,
+            "pipeline_overlap": warm_pipeline,
+        }
+    except Exception as exc:
+        # an epoch-measurement failure (e.g. ENOSPC abandoning the build,
+        # making the require pass refuse) must not discard the primary
+        # e2e point that was already measured
+        return {"error": f"{type(exc).__name__}: {exc}"}
+    finally:
+        drop_cache(cdir)
+
+
 def e2e_rf_rate(n):
     """End-to-end CSV-in -> 16-tree random forest (the OTHER flagship
     family of the CSV-in contract), through the STREAMING ingest pipeline:
@@ -304,13 +379,13 @@ def e2e_rf_rate(n):
     params.tree.max_depth = 4
     ctx = MeshContext()
 
-    def run_once(stats):
+    def run_once(stats, cache=None):
         # consumer_wait_key=None: this parse layer feeds the staging
         # thread inside from_stream, whose stage_wait_s already times
         # the wait on this queue — queue_wait_s stays final-consumer-only
         blocks = prefetch_chunks(
             iter_csv_chunks(path, schema, ",",
-                            chunk_rows=RF_STREAM_BLOCK_ROWS),
+                            chunk_rows=RF_STREAM_BLOCK_ROWS, cache=cache),
             stats=stats, consumer_wait_key=None)
         return build_forest_from_stream(blocks, schema, params, ctx,
                                         stats=stats)
@@ -340,6 +415,9 @@ def e2e_rf_rate(n):
     build_s = stats.get("build_s", t2 - t0 - ingest_s)
     pipeline = _pipeline_overlap(parse_s, transfer_s, compute_s, ingest_s,
                                  stats.get("queue_wait_s", 0.0))
+    cache_epoch = _rf_cache_epoch(run_once, path, n, blobs,
+                                  csv_pass_s=t2 - t0, csv_parse_s=parse_s,
+                                  csv_ingest_s=ingest_s)
     return {"metric": "e2e_csv_to_forest_rows_x_trees_per_sec",
             "value": round(n * T / dt, 1), "unit": "rows*trees/sec",
             "n": n, "trees": T, "candidate_splits": S,
@@ -356,6 +434,9 @@ def e2e_rf_rate(n):
             "serialize_s": round(t3 - t2, 3),
             "total_s": round(dt, 3),
             "cold_total_s": round(cold_s, 3),
+            # the columnar-sidecar epoch story: cold pass builds the
+            # cache, warm pass re-baselines from it with parse removed
+            "cache_epoch": cache_epoch,
             "roofline": roofline(build_s, flops=flops, hbm_bytes=hbm,
                                  host_s=parse_s,
                                  measured=led.snapshot())}

@@ -1,0 +1,697 @@
+"""Columnar cache sidecar suite (io/colcache.py — ISSUE 6).
+
+Round-trip parity is pinned against the python-oracle CSV parse: chunks
+loaded from the binary sidecar must be bit-identical to parsing the text —
+same dtypes, values, string columns, bin codes, ``source_row_end`` — under
+all three bad-record policies, with unknown categoricals as -1, and with
+``start_row`` resume cuts landing mid-cache and mid-chunk.  The fault half
+proves a torn/truncated chunk or an interrupted build degrades to CSV
+parse with a warning, never wrong data, and the forest built through
+``cache.policy=use`` is byte-identical to the CSV-parsed build.
+"""
+
+import json
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core import faults
+from avenir_tpu.core.metrics import Counters
+from avenir_tpu.core.schema import FeatureSchema
+from avenir_tpu.core.table import (BadRecordPolicy, ColumnarTable,
+                                   iter_csv_chunks, load_csv,
+                                   prefetch_chunks)
+from avenir_tpu.io import colcache
+from avenir_tpu.io.colcache import (CachePolicy, CacheWriter, drop_cache,
+                                    probe, read_chunk_file, verify_cache)
+
+pytestmark = pytest.mark.colcache
+
+SCHEMA_D = {
+    "fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "f1", "ordinal": 1, "dataType": "int", "feature": True,
+         "min": 0, "max": 100, "bucketWidth": 25,
+         "splitScanInterval": 25, "maxSplit": 2},
+        {"name": "f2", "ordinal": 2, "dataType": "categorical",
+         "feature": True, "maxSplit": 2, "cardinality": ["x", "y", "z"]},
+        {"name": "f3", "ordinal": 3, "dataType": "double", "feature": True,
+         "min": 0, "max": 1},
+        {"name": "cls", "ordinal": 4, "dataType": "categorical",
+         "cardinality": ["0", "1"]},
+    ]
+}
+SCHEMA = FeatureSchema.from_dict(SCHEMA_D)
+CHUNK = 64
+
+
+def gen_csv(path, n=230, seed=7, unknown_cat=True):
+    rng = np.random.default_rng(seed)
+    toks = "xyzq" if unknown_cat else "xyz"   # 'q' -> unknown code -1
+    lines = [f"r{i},{rng.integers(0, 100)},"
+             f"{toks[rng.integers(0, len(toks))]},"
+             f"{rng.random():.6f},{int(rng.random() < 0.4)}"
+             for i in range(n)]
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    return lines
+
+
+def oracle_chunks(path, start_row=0, bad=None, chunk=CHUNK):
+    return list(iter_csv_chunks(str(path), SCHEMA, ",", chunk_rows=chunk,
+                                use_native=False, bad_records=bad,
+                                start_row=start_row))
+
+
+def cached_chunks(path, policy="use", start_row=0, bad=None, chunk=CHUNK,
+                  counters=None, stats=None):
+    cp = CachePolicy(policy, counters=counters, stats=stats)
+    return list(iter_csv_chunks(str(path), SCHEMA, ",", chunk_rows=chunk,
+                                bad_records=bad, start_row=start_row,
+                                cache=cp)), cp
+
+
+def build_cache(path, bad=None, chunk=CHUNK, use_native=True,
+                counters=None):
+    cp = CachePolicy("build", counters=counters)
+    chunks = list(iter_csv_chunks(str(path), SCHEMA, ",", chunk_rows=chunk,
+                                  use_native=use_native, bad_records=bad,
+                                  cache=cp))
+    return chunks, cp
+
+
+def assert_tables_equal(a_chunks, b_chunks):
+    """Assembled-table bit equality: dtypes, values, strings, bin codes.
+    (Chunk BOUNDARIES may differ between the native and python parsers
+    under skipping policies; ``from_chunks`` is the pinned axis, exactly
+    as the fuzz suite pins native-vs-oracle parity.)"""
+    A = ColumnarTable.from_chunks(list(a_chunks))
+    B = ColumnarTable.from_chunks(list(b_chunks))
+    assert A.n_rows == B.n_rows
+    assert set(A.columns) == set(B.columns)
+    for o in A.columns:
+        assert A.columns[o].dtype == B.columns[o].dtype, o
+        np.testing.assert_array_equal(A.columns[o], B.columns[o])
+    assert set(A.str_columns) == set(B.str_columns)
+    for o in A.str_columns:
+        assert list(A.str_columns[o]) == list(B.str_columns[o]), o
+    for f in A.schema.fields:
+        if f.is_binned and f.ordinal in A.columns:
+            np.testing.assert_array_equal(A.binned_codes(f.ordinal),
+                                          B.binned_codes(f.ordinal))
+    return A, B
+
+
+# --------------------------------------------------------------------------
+# round-trip parity
+# --------------------------------------------------------------------------
+
+def test_round_trip_bit_identical_to_oracle(tmp_path):
+    csv = tmp_path / "d.csv"
+    gen_csv(csv)
+    ctr = Counters()
+    built, cpb = build_cache(csv, counters=ctr)
+    assert cpb.tallies == {"Miss": 1,
+                           "BytesWritten": cpb.tallies["BytesWritten"],
+                           "Built": 1}
+    assert probe(str(csv), SCHEMA, ",")[0] == "hit"
+    assert verify_cache(str(csv) + ".avtc", schema=SCHEMA,
+                        csv_path=str(csv), delim=",") == []
+    stats = {}
+    cached, cpu = cached_chunks(csv, "require", counters=ctr, stats=stats)
+    assert cpu.tallies["Hit"] == 1 and cpu.tallies["BytesRead"] > 0
+    assert stats["cache_read_s"] >= 0
+    # the counters mirror carries the ColumnarCache group
+    g = ctr.group("ColumnarCache")
+    assert g["Hit"] == 1 and g["Built"] == 1 and g["Miss"] == 1
+    oracle = oracle_chunks(csv)
+    A, B = assert_tables_equal(oracle, cached)
+    # unknown categorical values survived as -1
+    assert (B.columns[2] == -1).any()
+    # per-chunk boundaries + source rows match on a clean CSV (no bad
+    # rows: native and python boundaries coincide)
+    assert [c.n_rows for c in cached] == [c.n_rows for c in oracle]
+    assert [c.source_row_end for c in cached] == \
+        [c.source_row_end for c in oracle]
+
+
+def test_cache_built_by_python_parser_matches(tmp_path):
+    """A cache emitted via the python-oracle parse path (no .so) serves
+    the identical bytes."""
+    csv = tmp_path / "d.csv"
+    gen_csv(csv, n=150)
+    build_cache(csv, use_native=False)
+    cached, _ = cached_chunks(csv, "require")
+    assert_tables_equal(oracle_chunks(csv), cached)
+
+
+def test_packed_dtypes_on_disk(tmp_path):
+    """Cardinality-3 categoricals pack to int8, schema-integer numerics
+    whose values fit pack to int32, doubles stay float64 — and loads
+    upcast to the canonical int32/float64."""
+    csv = tmp_path / "d.csv"
+    gen_csv(csv, n=80)
+    build_cache(csv)
+    manifest, _ = read_chunk_file(
+        CacheWriter.chunk_path(str(csv) + ".avtc", 0))
+    dt = {(c["ordinal"], c["kind"]): c["dtype"] for c in manifest["cols"]}
+    assert dt[(2, "cat")] == "|i1" and dt[(4, "cat")] == "|i1"
+    assert dt[(1, "num")] == "<i4"      # int field, values 0..99
+    assert dt[(3, "num")] == "<f8"      # fractional double: stays wide
+    if (1, "bin") in dt:                # native-built caches carry bins
+        assert dt[(1, "bin")] == "|i1"  # codes 0..4
+    cached, _ = cached_chunks(csv, "require")
+    assert cached[0].columns[2].dtype == np.int32
+    assert cached[0].columns[1].dtype == np.float64
+
+
+def test_wide_cardinality_packs_int16(tmp_path):
+    wide = FeatureSchema.from_dict({"fields": [
+        {"name": "c", "ordinal": 0, "dataType": "categorical",
+         "feature": True, "cardinality": [f"v{i}" for i in range(300)]},
+        {"name": "cls", "ordinal": 1, "dataType": "categorical",
+         "cardinality": ["0", "1"]}]})
+    csv = tmp_path / "w.csv"
+    with open(csv, "w") as fh:
+        fh.write("\n".join(f"v{i % 300},{i % 2}" for i in range(64)) + "\n")
+    cp = CachePolicy("build")
+    built = list(iter_csv_chunks(str(csv), wide, ",", chunk_rows=32,
+                                 cache=cp))
+    manifest, _ = read_chunk_file(
+        CacheWriter.chunk_path(str(csv) + ".avtc", 0))
+    dt = {(c["ordinal"], c["kind"]): c["dtype"] for c in manifest["cols"]}
+    assert dt[(0, "cat")] == "<i2"
+    cached = list(iter_csv_chunks(str(csv), wide, ",", chunk_rows=32,
+                                  cache=CachePolicy("require")))
+    np.testing.assert_array_equal(
+        np.concatenate([c.columns[0] for c in built]),
+        np.concatenate([c.columns[0] for c in cached]))
+
+
+def test_load_csv_through_cache(tmp_path):
+    csv = tmp_path / "d.csv"
+    gen_csv(csv, n=120)
+    plain = load_csv(str(csv), SCHEMA, ",")
+    built = load_csv(str(csv), SCHEMA, ",", cache=CachePolicy("build"))
+    warm = load_csv(str(csv), SCHEMA, ",", cache=CachePolicy("require"))
+    for t in (built, warm):
+        assert t.n_rows == plain.n_rows
+        for o in plain.columns:
+            np.testing.assert_array_equal(plain.columns[o], t.columns[o])
+        for o in plain.str_columns:
+            assert list(plain.str_columns[o]) == list(t.str_columns[o])
+    # require refuses the uncacheable raw-row form instead of silently
+    # re-parsing
+    with pytest.raises(ValueError, match="require"):
+        load_csv(str(csv), SCHEMA, ",", keep_raw=True,
+                 cache=CachePolicy("require"))
+
+
+def test_empty_csv_round_trip(tmp_path):
+    csv = tmp_path / "e.csv"
+    csv.write_text("")
+    _, cp = build_cache(csv)
+    assert cp.tallies.get("Built") == 1
+    assert probe(str(csv), SCHEMA, ",")[0] == "hit"
+    cached, _ = cached_chunks(csv, "require")
+    assert cached == []
+    assert load_csv(str(csv), SCHEMA, ",",
+                    cache=CachePolicy("require")).n_rows == 0
+
+
+# --------------------------------------------------------------------------
+# bad-record policy fidelity on cached replays
+# --------------------------------------------------------------------------
+
+def _corrupt(csv, rows=(3, 64, 65, 150, 228, 229)):
+    # includes two TRAILING bad rows: the python-built cache must carry
+    # them in the header's tail manifest (no chunk yields after them)
+    return faults.corrupt_csv_rows(str(csv), list(rows), seed=9, field=1)
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_quarantine_bytes_and_counters_identical(tmp_path, use_native):
+    csv = tmp_path / "d.csv"
+    gen_csv(csv, seed=3)
+    corrupted = _corrupt(csv)
+    c1, c2 = Counters(), Counters()
+    q1, q2 = tmp_path / "q1", tmp_path / "q2"
+    built, _ = build_cache(csv, bad=BadRecordPolicy("quarantine", str(q1),
+                                                    c1),
+                           use_native=use_native)
+    cached, _ = cached_chunks(csv, "use",
+                              bad=BadRecordPolicy("quarantine", str(q2),
+                                                  c2))
+    assert_tables_equal(built, cached)
+    b1 = (q1 / "part-q-00000").read_text()
+    assert b1 == (q2 / "part-q-00000").read_text()
+    assert b1.splitlines() == corrupted
+    assert c1.as_dict()["BadRecords"] == c2.as_dict()["BadRecords"]
+    assert c2.get("BadRecords", "Malformed") == len(corrupted)
+
+
+def test_skip_policy_counters_match_oracle(tmp_path):
+    csv = tmp_path / "d.csv"
+    gen_csv(csv, seed=5)
+    _corrupt(csv)
+    build_cache(csv, bad=BadRecordPolicy("skip"))
+    co, cc = Counters(), Counters()
+    oracle = oracle_chunks(csv, bad=BadRecordPolicy("skip", counters=co))
+    cached, _ = cached_chunks(csv, "use",
+                              bad=BadRecordPolicy("skip", counters=cc))
+    assert_tables_equal(oracle, cached)
+    assert co.as_dict() == cc.as_dict()
+
+
+def test_fail_policy_raises_on_cached_replay(tmp_path):
+    """A cache built under a skipping policy replayed under fail must
+    raise like the parse would — the manifest keeps the failure."""
+    csv = tmp_path / "d.csv"
+    gen_csv(csv, seed=6)
+    _corrupt(csv)
+    build_cache(csv, bad=BadRecordPolicy("skip"))
+    with pytest.raises(ValueError, match="malformed"):
+        cached_chunks(csv, "require", bad=None)
+    with pytest.raises(ValueError, match="malformed"):
+        cached_chunks(csv, "require", bad=BadRecordPolicy("fail"))
+
+
+def test_trailing_bad_rows_only_tail(tmp_path):
+    """Bad records AFTER the last good row must survive the round trip
+    (python-built cache: they ride the header tail manifest)."""
+    csv = tmp_path / "d.csv"
+    gen_csv(csv, n=70, seed=8)
+    corrupted = faults.corrupt_csv_rows(str(csv), [68, 69], field=1)
+    build_cache(csv, bad=BadRecordPolicy("skip"), use_native=False)
+    cc = Counters()
+    cached, _ = cached_chunks(csv, "require",
+                              bad=BadRecordPolicy("skip", counters=cc))
+    assert cc.get("BadRecords", "Malformed") == 2
+    assert sum(c.n_rows for c in cached) == 68
+    # resume past the tail: nothing re-reported
+    cc2 = Counters()
+    cached2, _ = cached_chunks(csv, "require", start_row=70,
+                               bad=BadRecordPolicy("skip", counters=cc2))
+    assert cc2.get("BadRecords", "Malformed") == 0
+
+
+# --------------------------------------------------------------------------
+# start_row resume lands mid-cache exactly where the parser would
+# --------------------------------------------------------------------------
+
+def test_start_row_resume_parity(tmp_path):
+    csv = tmp_path / "d.csv"
+    gen_csv(csv, seed=4)
+    _corrupt(csv)
+    build_cache(csv, bad=BadRecordPolicy("skip"))
+    for s in (0, 1, 3, 4, 64, 65, 70, 128, 200, 229, 230):
+        co, cc = Counters(), Counters()
+        oracle = oracle_chunks(csv, start_row=s,
+                               bad=BadRecordPolicy("skip", counters=co))
+        cached, cp = cached_chunks(csv, "use", start_row=s,
+                                   bad=BadRecordPolicy("skip",
+                                                       counters=cc))
+        assert cp.tallies.get("Hit") == 1, s
+        if oracle:
+            assert_tables_equal(oracle, cached)
+        else:
+            assert sum(c.n_rows for c in cached) == 0
+        assert co.as_dict() == cc.as_dict(), s
+
+
+def test_build_disabled_on_resumed_pass(tmp_path):
+    """A pass starting mid-stream must not masquerade as a full cache."""
+    csv = tmp_path / "d.csv"
+    gen_csv(csv, n=100)
+    chunks, cp = cached_chunks(csv, "build", start_row=10)
+    assert sum(c.n_rows for c in chunks) == 90
+    assert cp.tallies.get("Built") is None
+    assert probe(str(csv), SCHEMA, ",")[0] == "miss"
+
+
+# --------------------------------------------------------------------------
+# staleness / invalidation
+# --------------------------------------------------------------------------
+
+def test_source_change_goes_stale_then_rebuilds(tmp_path):
+    csv = tmp_path / "d.csv"
+    gen_csv(csv, n=100)
+    build_cache(csv)
+    st = os.stat(csv)
+    os.utime(csv, ns=(st.st_atime_ns, st.st_mtime_ns + 1))
+    assert probe(str(csv), SCHEMA, ",")[0] == "stale"
+    # use: parses (Miss), does not rebuild
+    chunks, cp = cached_chunks(csv, "use")
+    assert cp.tallies == {"Miss": 1, "Stale": 1}
+    assert probe(str(csv), SCHEMA, ",")[0] == "stale"
+    # require: refuses
+    with pytest.raises(FileNotFoundError, match="require"):
+        cached_chunks(csv, "require")
+    # build: rebuilds
+    chunks, cp = build_cache(csv)
+    assert cp.tallies.get("StaleRebuilt") == 1
+    assert probe(str(csv), SCHEMA, ",")[0] == "hit"
+    assert_tables_equal(oracle_chunks(csv), cached_chunks(csv)[0])
+
+
+def test_fingerprint_mismatch_is_stale(tmp_path):
+    csv = tmp_path / "d.csv"
+    gen_csv(csv, n=100)
+    build_cache(csv)
+    # the chunk budget is NOT identity: a replay with a different budget
+    # still hits and serves the cache's own boundaries, values identical
+    other_budget, cp = cached_chunks(csv, "require", chunk=CHUNK * 2)
+    assert cp.tallies.get("Hit") == 1
+    assert [c.n_rows for c in other_budget] == [64, 36]
+    assert_tables_equal(oracle_chunks(csv), other_budget)
+    # schema content IS identity — cardinality order changes the codes
+    other = FeatureSchema.from_dict(json.loads(json.dumps(SCHEMA_D)))
+    other.fields[2].cardinality = ["y", "x", "z"]   # vocab ORDER matters
+    assert probe(str(csv), other, ",")[0] == "stale"
+    assert probe(str(csv), SCHEMA, ";")[0] == "stale"
+
+
+def test_require_on_missing_cache_refuses(tmp_path):
+    csv = tmp_path / "d.csv"
+    gen_csv(csv, n=50)
+    with pytest.raises(FileNotFoundError, match="require"):
+        cached_chunks(csv, "require")
+
+
+def test_bad_policy_string_refused():
+    with pytest.raises(ValueError, match="cache.policy"):
+        CachePolicy("cache-me-if-you-can")
+
+
+# --------------------------------------------------------------------------
+# torn caches and interrupted builds (fault half)
+# --------------------------------------------------------------------------
+
+def _chunk_files(csv):
+    cdir = str(csv) + ".avtc"
+    return cdir, sorted(f for f in os.listdir(cdir)
+                        if f.startswith("chunk_"))
+
+
+@pytest.mark.parametrize("tear", ["truncate", "garble", "remove"])
+def test_torn_chunk_degrades_to_parse(tmp_path, tear):
+    csv = tmp_path / "d.csv"
+    gen_csv(csv, seed=11)
+    build_cache(csv)
+    cdir, files = _chunk_files(csv)
+    victim = os.path.join(cdir, files[1])
+    data = open(victim, "rb").read()
+    if tear == "truncate":
+        open(victim, "wb").write(data[:len(data) // 2])
+    elif tear == "garble":
+        open(victim, "wb").write(b"\x00" * len(data))
+    else:
+        os.remove(victim)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        cached, cp = cached_chunks(csv, "use")
+    assert any("degrading to CSV parse" in str(x.message) for x in w)
+    assert_tables_equal(oracle_chunks(csv), cached)
+    # verify reports the tear (structure or row totals, depending on mode)
+    assert verify_cache(cdir) != []
+
+
+def test_require_raises_on_torn_chunk(tmp_path):
+    """require's contract is serve-or-refuse: a torn chunk must raise,
+    never silently re-parse every epoch."""
+    csv = tmp_path / "d.csv"
+    gen_csv(csv, seed=14)
+    build_cache(csv)
+    cdir, files = _chunk_files(csv)
+    os.remove(os.path.join(cdir, files[1]))
+    with pytest.raises(colcache.CacheChunkError, match="require"):
+        cached_chunks(csv, "require")
+
+
+def test_no_build_dir_leftovers(tmp_path, fault_injector):
+    """Both a finished and an abandoned build must leave no private
+    .build-* directory behind; a dead builder's orphan is reaped by the
+    next build."""
+    csv = tmp_path / "d.csv"
+    gen_csv(csv, n=100)
+
+    def build_dirs():
+        return [f for f in os.listdir(tmp_path) if ".avtc.build-" in f]
+
+    build_cache(csv)
+    assert build_dirs() == []
+    # abandoned build (injected write fault) cleans up its dir too
+    st = os.stat(csv)
+    os.utime(csv, ns=(st.st_atime_ns, st.st_mtime_ns + 1))
+    fault_injector("cache_write@0=raise:OSError")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        build_cache(csv)
+    assert build_dirs() == []
+    faults.uninstall()
+    # a crashed builder's orphan (dead pid) is garbage-collected
+    orphan = str(csv) + ".avtc.build-999999999-deadbeef"
+    os.makedirs(orphan)
+    build_cache(csv)
+    assert build_dirs() == []
+    assert_tables_equal(oracle_chunks(csv), cached_chunks(csv)[0])
+
+
+def test_torn_header_is_a_miss(tmp_path):
+    csv = tmp_path / "d.csv"
+    gen_csv(csv, n=100)
+    build_cache(csv)
+    hdr = os.path.join(str(csv) + ".avtc", "header.json")
+    open(hdr, "w").write('{"format":')   # torn mid-write
+    assert probe(str(csv), SCHEMA, ",")[0] == "miss"
+    chunks, cp = cached_chunks(csv, "use")
+    assert cp.tallies == {"Miss": 1}     # torn header = no cache, not stale
+    assert_tables_equal(oracle_chunks(csv), chunks)
+
+
+@pytest.mark.faultinject
+def test_interrupted_build_leaves_no_cache_and_training_unaffected(
+        tmp_path, fault_injector):
+    """A cache_write fault mid-build abandons the build with a warning;
+    the parse stream the trainer consumes is untouched, and the next
+    build pass starts from a clean miss."""
+    csv = tmp_path / "d.csv"
+    gen_csv(csv, seed=12)
+    fault_injector("cache_write@2=raise:OSError")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        chunks, cp = build_cache(csv)
+    assert any("abandoning the build" in str(x.message) for x in w)
+    assert cp.tallies.get("Built") is None
+    assert_tables_equal(oracle_chunks(csv), chunks)
+    assert probe(str(csv), SCHEMA, ",")[0] == "miss"
+    faults.uninstall()
+    _, cp2 = build_cache(csv)
+    assert cp2.tallies.get("Built") == 1
+    assert_tables_equal(oracle_chunks(csv), cached_chunks(csv)[0])
+
+
+@pytest.mark.faultinject
+def test_cache_read_fault_degrades_to_parse(tmp_path, fault_injector):
+    csv = tmp_path / "d.csv"
+    gen_csv(csv, seed=13)
+    build_cache(csv)
+    fault_injector("cache_read@1=raise:OSError")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        cached, _ = cached_chunks(csv, "use")
+    assert any("degrading to CSV parse" in str(x.message) for x in w)
+    assert_tables_equal(oracle_chunks(csv), cached)
+
+
+def test_abandoned_consumer_never_finalizes(tmp_path):
+    """A downstream failure mid-build (consumer abandons the stream) must
+    not leave a header claiming a complete cache."""
+    csv = tmp_path / "d.csv"
+    gen_csv(csv)
+    cp = CachePolicy("build")
+    it = iter_csv_chunks(str(csv), SCHEMA, ",", chunk_rows=CHUNK, cache=cp)
+    next(it)
+    it.close()
+    assert probe(str(csv), SCHEMA, ",")[0] == "miss"
+    assert cp.tallies.get("Built") is None
+
+
+# --------------------------------------------------------------------------
+# streamed forest: bit-identical through the cache, prefetch-composed
+# --------------------------------------------------------------------------
+
+def _forest_csv(tmp_path, n=500):
+    csv = tmp_path / "train.csv"
+    gen_csv(csv, n=n, seed=21, unknown_cat=False)
+    return csv
+
+
+def test_streamed_forest_bit_identical_through_cache(tmp_path, mesh_ctx):
+    from avenir_tpu.models.forest import (ForestParams,
+                                          build_forest_from_stream)
+    csv = _forest_csv(tmp_path)
+    params = ForestParams(num_trees=3, seed=11)
+    params.tree.max_depth = 2
+
+    def run(cache=None, stats=None):
+        blocks = prefetch_chunks(
+            iter_csv_chunks(str(csv), SCHEMA, ",", chunk_rows=96,
+                            cache=cache),
+            stats=stats, consumer_wait_key=None)
+        return [m.to_json() for m in build_forest_from_stream(
+            blocks, SCHEMA, params, mesh_ctx, stats=stats)]
+
+    plain = run()
+    built = run(cache=CachePolicy("build"))
+    stats = {}
+    warm = run(cache=CachePolicy("require", stats=stats))
+    assert built == plain and warm == plain
+    assert stats["cache_read_s"] > 0
+
+
+def test_job_level_cache_knob_and_counters(tmp_path, mesh_ctx, capsys):
+    """dtb.streaming.cache.policy=build then =require through the CLI:
+    identical tree JSONs, ColumnarCache counter group in the dump."""
+    from avenir_tpu.cli import run as cli_run
+    csv = _forest_csv(tmp_path, n=300)
+    schema_path = tmp_path / "s.json"
+    schema_path.write_text(json.dumps(SCHEMA_D))
+    outputs = {}
+    for mode in ("build", "require"):
+        props = tmp_path / f"rafo_{mode}.properties"
+        props.write_text(
+            "field.delim.regex=,\n"
+            f"dtb.feature.schema.file.path={schema_path}\n"
+            "dtb.max.depth.limit=2\n"
+            "dtb.num.trees=3\n"
+            "dtb.streaming.ingest=true\n"
+            "dtb.streaming.block.rows=128\n"
+            f"dtb.streaming.cache.policy={mode}\n")
+        out = tmp_path / f"forest_{mode}"
+        rc = cli_run.main(["randomForestBuilder", f"-Dconf.path={props}",
+                           str(csv), str(out)])
+        assert rc == 0
+        outputs[mode] = {f: (out / f).read_text()
+                         for f in sorted(os.listdir(out))}
+        dump = capsys.readouterr().out
+        assert "ColumnarCache" in dump
+        assert ("Built=1" if mode == "build" else "Hit=1") in dump
+    assert outputs["build"] == outputs["require"]
+
+
+@pytest.mark.faultinject
+def test_resume_with_cache_bit_identical(tmp_path, fault_injector,
+                                         monkeypatch):
+    """The ISSUE 2 crash + --resume flow with cache.policy=use layered on
+    top: quarantine bytes and model bytes stay identical to the clean
+    CSV-parsed run (checkpoint/resume semantics unchanged under the
+    cache)."""
+    monkeypatch.setattr(faults, "RETRY_BASE_S", 0.0)
+    from avenir_tpu.cli import run as cli_run
+    csv = tmp_path / "train.csv"
+    gen_csv(csv, n=240, seed=13, unknown_cat=False)
+    corrupted = faults.corrupt_csv_rows(str(csv), [30, 99, 201], seed=9,
+                                        field=1)
+    schema_path = tmp_path / "s.json"
+    schema_path.write_text(json.dumps(SCHEMA_D))
+
+    def conf(tag, cache_mode):
+        props = tmp_path / f"rafo_{tag}.properties"
+        props.write_text(
+            "field.delim.regex=,\n"
+            f"dtb.feature.schema.file.path={schema_path}\n"
+            "dtb.max.depth.limit=2\n"
+            "dtb.num.trees=3\n"
+            "dtb.streaming.ingest=true\n"
+            "dtb.streaming.block.rows=48\n"
+            f"dtb.streaming.checkpoint.dir={tmp_path / ('ck_' + tag)}\n"
+            "dtb.streaming.checkpoint.blocks=1\n"
+            "badrecords.policy=quarantine\n"
+            f"badrecords.quarantine.path={tmp_path / ('q_' + tag)}\n"
+            + (f"dtb.streaming.cache.policy={cache_mode}\n"
+               if cache_mode else ""))
+        return props
+
+    def trees(out):
+        return {f: (out / f).read_text()
+                for f in sorted(os.listdir(out))}
+
+    # clean CSV-parsed oracle
+    clean_out = tmp_path / "out_clean"
+    rc = cli_run.main(["randomForestBuilder",
+                       f"-Dconf.path={conf('clean', None)}",
+                       str(csv), str(clean_out)])
+    assert rc == 0
+    # build the cache (also proves model parity of the build pass)
+    built_out = tmp_path / "out_build"
+    rc = cli_run.main(["randomForestBuilder",
+                       f"-Dconf.path={conf('build', 'build')}",
+                       str(csv), str(built_out)])
+    assert rc == 0
+    assert trees(built_out) == trees(clean_out)
+    # crash mid-ingest under cache.policy=use, then --resume
+    props = conf("use", "use")
+    fault_injector("cache_read@2=raise:RuntimeError")
+    out = tmp_path / "out_use"
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with pytest.raises(RuntimeError, match="injected fault"):
+            cli_run.main(["randomForestBuilder", f"-Dconf.path={props}",
+                          str(csv), str(out)])
+    faults.uninstall()
+    rc = cli_run.main(["randomForestBuilder", f"-Dconf.path={props}",
+                       "--resume", str(csv), str(out)])
+    assert rc == 0
+    assert trees(out) == trees(clean_out)
+    # quarantine accumulated across crash + resume matches exactly
+    # (checkpoint stride 1 => no re-reported records)
+    assert (tmp_path / "q_use" / "part-q-00000").read_text().splitlines() \
+        == corrupted
+
+
+# --------------------------------------------------------------------------
+# satellites: quarantine-dir caching, cachetool
+# --------------------------------------------------------------------------
+
+def test_quarantine_dir_created_once(tmp_path, monkeypatch):
+    import avenir_tpu.core.table as table_mod
+    calls = []
+    real = os.makedirs
+    monkeypatch.setattr(table_mod.os, "makedirs",
+                        lambda *a, **k: (calls.append(a), real(*a, **k)))
+    pol = BadRecordPolicy("quarantine", str(tmp_path / "q"))
+    for i in range(5):
+        pol.record([f"bad,{i}"])
+    assert len(calls) == 1
+    assert (tmp_path / "q" / "part-q-00000").read_text().splitlines() \
+        == [f"bad,{i}" for i in range(5)]
+
+
+def test_cachetool_inspect_verify_drop(tmp_path, capsys):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "cachetool", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "cachetool.py"))
+    cachetool = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cachetool)
+    csv = tmp_path / "d.csv"
+    gen_csv(csv, n=100)
+    _corrupt(csv, rows=(5,))
+    build_cache(csv, bad=BadRecordPolicy("skip"))
+    assert cachetool.main(["inspect", str(csv)]) == 0
+    out = capsys.readouterr().out
+    assert "build_id" in out and "chunk" in out
+    assert cachetool.main(["verify", str(csv)]) == 0
+    # corrupt one block payload byte -> crc mismatch -> rc 1
+    cdir, files = _chunk_files(csv)
+    victim = os.path.join(cdir, files[0])
+    data = bytearray(open(victim, "rb").read())
+    data[-1] ^= 0xFF
+    open(victim, "wb").write(bytes(data))
+    assert cachetool.main(["verify", str(csv)]) == 1
+    assert cachetool.main(["drop", str(csv)]) == 0
+    assert not os.path.isdir(cdir)
+    assert cachetool.main(["drop", str(csv)]) == 1
