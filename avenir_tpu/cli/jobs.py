@@ -61,6 +61,18 @@ def dist_mode(fn: Callable) -> str:
     return JOB_DIST.get(fn, "refuse")
 
 
+def shards_by_row_range(fn: Callable, cfg) -> bool:
+    """True when this job, under this config, splits ONE shared input by
+    row range itself (dtb.streaming.shard, TPU_NOTES §20) — the case
+    where every process legitimately receives the IDENTICAL input path
+    and cli.run's identical-shard refusal for sharded jobs must stand
+    down: the job's own split arithmetic guarantees each process consumes
+    a disjoint row range of it."""
+    return (fn is random_forest_builder
+            and cfg.get_boolean("dtb.streaming.ingest", False)
+            and cfg.get("dtb.streaming.shard", "auto") != "off")
+
+
 def resolve(name: str) -> Callable:
     if name in JOBS:
         return JOBS[name]
@@ -239,10 +251,55 @@ def random_forest_builder(cfg: Config, in_path: str, out_path: str) -> Counters:
         raise ValueError("dtb.streaming.resume needs "
                          "dtb.streaming.ingest=true (checkpoints only "
                          "exist for the streaming build)")
+    shard_knob = cfg.get("dtb.streaming.shard", "auto")
+    if shard_knob not in ("auto", "on", "off"):
+        raise ValueError(f"dtb.streaming.shard must be auto|on|off, "
+                         f"got {shard_knob!r}")
+    if shard_knob == "on" and \
+            not cfg.get_boolean("dtb.streaming.ingest", False):
+        # same refusal shape as resume-without-ingest: a shard=on run that
+        # silently trains monolithic single-host is the failure mode the
+        # 'on' value exists to refuse
+        raise ValueError("dtb.streaming.shard=on needs "
+                         "dtb.streaming.ingest=true (only the streaming "
+                         "build can row-range shard)")
+    stream_reducer = None
     if cfg.get_boolean("dtb.streaming.ingest", False):
         from ..core.checkpoint import CheckpointManager
         from ..core.table import iter_csv_chunks, prefetch_chunks
+        from ..parallel.distributed import shard_spec
+        # dtb.streaming.shard: row-range data parallelism for the
+        # streaming build (TPU_NOTES §20).  auto = shard whenever this is
+        # a multi-shard run (jax.distributed process, or the
+        # AVENIR_TPU_SHARD/ALLREDUCE_DIR smoke lane); on = require one;
+        # off = never (each process must then bring its own input file).
+        # Every process reads the SAME csv and parses only its row range;
+        # one all-reduce per tree level makes the model bit-identical to
+        # the single-host build on every process.
+        spec = shard_spec() if shard_knob != "off" else None
+        sharded = spec is not None and spec.active
+        if shard_knob == "on" and not sharded:
+            raise ValueError(
+                "dtb.streaming.shard=on needs a multi-shard run "
+                "(jax.distributed, or AVENIR_TPU_SHARD=i/P with "
+                "AVENIR_TPU_ALLREDUCE_DIR); refusing to silently train "
+                "single-host")
+        reducer = None
+        if sharded:
+            from ..parallel.collectives import AllReducer
+            reducer = stream_reducer = AllReducer(spec=spec,
+                                                  name="rf-stream")
+            # identity values, emitted by shard 0 only: the cross-process
+            # counter all-reduce SUMS, and a summed Shard/Count=2P would
+            # read as a different topology than the job actually ran
+            if spec.index == 0:
+                counters.set("Shard", "Count", spec.count)
         ckpt_dir = cfg.get("dtb.streaming.checkpoint.dir")
+        if ckpt_dir and sharded:
+            # per-shard step dirs: N processes checkpointing the same
+            # base dir would race the same step_<n> names
+            ckpt_dir = os.path.join(
+                ckpt_dir, f"shard-{spec.index}-of-{spec.count}")
         mgr = CheckpointManager(ckpt_dir) if ckpt_dir else None
         every = cfg.get_int("dtb.streaming.checkpoint.blocks", 16) \
             if mgr is not None else 0
@@ -280,7 +337,8 @@ def random_forest_builder(cfg: Config, in_path: str, out_path: str) -> Counters:
             in_path, schema, cfg.field_delim_regex,
             chunk_rows=cfg.get_int("dtb.streaming.block.rows", 1 << 22),
             bad_records=policy, start_row=start_row,
-            cache=_cache_policy(cfg, counters)),
+            cache=_cache_policy(cfg, counters),
+            shard=(spec.index, spec.count) if sharded else None),
             consumer_wait_key=None)
         if baseline_builder is not None:
             # the baseline rides the SAME single ingest pass (a resumed
@@ -289,9 +347,10 @@ def random_forest_builder(cfg: Config, in_path: str, out_path: str) -> Counters:
             from ..monitor.baseline import tee_blocks
             blocks = tee_blocks(blocks, baseline_builder)
         models = build_forest_from_stream(
-            blocks, schema, params, runtime_context(),
+            blocks, schema, params,
+            None if sharded else runtime_context(),
             checkpoint=mgr, checkpoint_every=every,
-            resume_state=resume_state)
+            resume_state=resume_state, reducer=reducer)
     else:
         table = load_csv(in_path, schema, cfg.field_delim_regex,
                          bad_records=policy)
@@ -314,8 +373,11 @@ def random_forest_builder(cfg: Config, in_path: str, out_path: str) -> Counters:
             # partial shard counts all-reduce FIRST (collective: every
             # process participates), then only process 0 writes
             from ..monitor.baseline import allreduce_partials
-            baseline = allreduce_partials(baseline_builder).finalize()
-        if jax.process_index() == 0:
+            baseline = allreduce_partials(baseline_builder,
+                                          reducer=stream_reducer).finalize()
+        publish_owner = jax.process_index() == 0 and (
+            stream_reducer is None or stream_reducer.spec.index == 0)
+        if publish_owner:
             from ..serving.registry import ModelRegistry
             registry = ModelRegistry(reg_dir)
             model_name = cfg.get("dtb.model.name", "forest")
@@ -580,16 +642,38 @@ def knn_pipeline(cfg: Config, in_path: str, out_path: str) -> Counters:
     output_class_distr = cfg.get_boolean("nen.output.class.distr", False)
 
     train, test, intra_set = _load_train_test(in_path, prefix, schema, delim)
-    # partition mode: this process classifies its work_slice of the test
-    # axis against the FULL train set; per-process part files union to the
-    # complete prediction set (single-process: slice = everything)
-    from ..parallel.distributed import work_slice
-    t_lo, t_hi = work_slice(test.n_rows)
-    test = test.take_rows(t_lo, t_hi)
     comp = DistanceComputer(schema, metric=metric, scale=scale)
     k = min(params.top_match_count, train.n_rows - (1 if intra_set else 0))
-    # intra-set: fetch one extra neighbor, then drop each row's self-match
-    nd, idx = comp.pairwise_topk(test, train, k + 1 if intra_set else k)
+    # nen.train.shard=true: multi-host data-parallel over the TRAIN axis
+    # (TPU_NOTES §20) — each shard scans the FULL test set against its
+    # row-range of the train set and the running best-k lists merge
+    # through ONE lock-step collective per test chunk, so every shard
+    # computes the identical (bit-identical to single-host) predictions.
+    # The default stays the partition-mode test-axis split below.
+    train_sharded = cfg.get_boolean("nen.train.shard", False)
+    knn_reducer = None
+    if train_sharded:
+        from ..parallel.collectives import AllReducer
+        from ..parallel.distributed import shard_spec
+        spec = shard_spec()
+        knn_reducer = AllReducer(spec=spec, name="knn-train")
+        tr_lo, tr_hi = spec.range_for(train.n_rows)
+        t_lo = 0
+        nd, idx = comp.pairwise_topk(
+            test, train.take_rows(tr_lo, tr_hi),
+            k + 1 if intra_set else k,
+            shard_reducer=knn_reducer, shard_base=tr_lo)
+    else:
+        # partition mode: this process classifies its work_slice of the
+        # test axis against the FULL train set; per-process part files
+        # union to the complete prediction set (single-process: slice =
+        # everything)
+        from ..parallel.distributed import work_slice
+        t_lo, t_hi = work_slice(test.n_rows)
+        test = test.take_rows(t_lo, t_hi)
+        # intra-set: fetch one extra neighbor, then drop the self-match
+        nd, idx = comp.pairwise_topk(test, train,
+                                     k + 1 if intra_set else k)
     if intra_set:
         # self indices are TRAIN-relative: offset by the test slice start
         self_col = (np.arange(test.n_rows) + t_lo)[:, None]
@@ -647,13 +731,20 @@ def knn_pipeline(cfg: Config, in_path: str, out_path: str) -> Counters:
             cm.report(res.pred_class[i], actual[i])
         parts.append(res.pred_class[i])
         out_lines.append(od.join(parts))
-    if validation:
-        cm.export(counters)
-    counters.increment("Neighborhood", "Test records", test.n_rows)
+    # train-sharded mode: every shard computed the IDENTICAL full
+    # prediction set, so the output is a global artifact (identical bytes
+    # from every process, like the sharded training jobs) and the
+    # already-global counters are emitted by shard 0 only — the
+    # cross-process counter sum must not multiply them by the shard count
+    if knn_reducer is None or knn_reducer.spec.index == 0:
+        if validation:
+            cm.export(counters)
+        counters.increment("Neighborhood", "Test records", test.n_rows)
     # partition-mode job: each process emits predictions for its test
     # slice as its own part file (single-process: part-r-00000 as before);
     # counters are per-slice partials that cli.run all-reduces
-    artifacts.write_text_output(out_path, out_lines, local_shard=True)
+    artifacts.write_text_output(out_path, out_lines,
+                                local_shard=knn_reducer is None)
     return counters
 
 

@@ -111,7 +111,7 @@ def _enter_distributed_mode(mode: str) -> None:
     set_runtime_context(MeshContext(distributed.make_hybrid_mesh()))
 
 
-def _apply_dist_mode(fn, job_name: str, in_path: Optional[str]):
+def _apply_dist_mode(fn, job_name: str, in_path: Optional[str], cfg=None):
     """Enforce the job's multi-process class (parallel/distributed.py
     docstring).  Single-process: identity.  Under ``process_count() > 1``:
     'sharded' and 'map' jobs run on their local shard unchanged; 'gather'
@@ -195,8 +195,25 @@ def _apply_dist_mode(fn, job_name: str, in_path: Optional[str]):
     identical = len({d for _, d in meta}) == 1
 
     if mode in ("sharded", "map"):
+        row_range = cfg is not None and jobs.shards_by_row_range(fn, cfg)
+        if not identical and row_range:
+            # the inverse of the refusal below: row-range sharding assumes
+            # ONE shared file, so under the per-process-shard-file layout
+            # each process would parse only rows [lo_i, hi_i) of its OWN
+            # file and (P-1)/P of every file's rows would silently never
+            # train
+            raise RuntimeError(
+                f"job {job_name}: dtb.streaming.shard is active but the "
+                f"{len(meta)} processes were given DISTINCT inputs — the "
+                f"row-range split assumes every process reads the SAME "
+                f"file and would silently drop rows from each per-process "
+                f"shard file.  Give every process the same input path, or "
+                f"set dtb.streaming.shard=off to train per-process shards")
         if identical and not os.environ.get(
-                "AVENIR_TPU_ALLOW_IDENTICAL_SHARDS"):
+                "AVENIR_TPU_ALLOW_IDENTICAL_SHARDS") and not row_range:
+            # row-range-sharded jobs (dtb.streaming.shard) are the
+            # sanctioned exception: one shared file, each process parses
+            # only its own source-row range (TPU_NOTES §20)
             raise RuntimeError(
                 f"job {job_name} (dist mode {mode!r}): all "
                 f"{len(meta)} processes were given IDENTICAL input — each "
@@ -276,7 +293,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     try:
         # inside the try so a dist-mode refusal still runs the context
         # cleanup below (no hybrid-mesh leak into later in-process runs)
-        in_path, spool_dir = _apply_dist_mode(fn, job_name, in_path)
+        in_path, spool_dir = _apply_dist_mode(fn, job_name, in_path, cfg)
         # job-level step accounting into the counters channel (the rebuild's
         # replacement for the Hadoop UI's job timing; SURVEY §5), plus an
         # optional XLA profiler capture dir and the measured link-traffic

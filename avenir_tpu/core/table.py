@@ -517,15 +517,31 @@ def load_csv_text(text: str, schema: FeatureSchema, delim_regex: str = ",",
 # chunked / streaming ingest (the CSV->device pipeline's parse stage)
 # --------------------------------------------------------------------------
 
+def count_source_rows(path: str) -> int:
+    """Total SOURCE rows (non-blank lines) of a CSV — the denominator of
+    the sharded-ingest split arithmetic when the native reader (which
+    indexes the file and knows its row count up front) is unavailable.
+    One streaming text pass, no tokenization."""
+    n = 0
+    with open(path, "r") as fh:
+        for line in fh:
+            if line.strip():
+                n += 1
+    return n
+
+
 def _iter_csv_chunks_python(path: str, schema: FeatureSchema,
                             delim_regex: str, chunk_rows: int,
                             skip_rows: int = 0,
-                            bad_records: Optional[BadRecordPolicy] = None):
+                            bad_records: Optional[BadRecordPolicy] = None,
+                            stop_row: Optional[int] = None):
     """Oracle-equivalent streamed parse: read the file line by line (never
     the whole text in memory), encode every ``chunk_rows`` non-blank rows.
     ``skip_rows`` resumes after a partially-consumed native stream (or a
     checkpoint): it counts SOURCE rows (non-blank lines), the same axis
-    every yielded chunk reports via ``source_row_end``."""
+    every yielded chunk reports via ``source_row_end``.  ``stop_row``
+    (exclusive, same axis) ends the stream early — the sharded-ingest
+    upper bound."""
     split = _make_splitter(delim_regex)
     skipping = bad_records is not None and bad_records.skips
     is_bad = _bad_row_checker(schema) if skipping else None
@@ -539,6 +555,8 @@ def _iter_csv_chunks_python(path: str, schema: FeatureSchema,
             line = line.rstrip("\r\n")  # same record set as str.splitlines
             if not line.strip():        # for \n / \r\n terminated CSVs
                 continue
+            if stop_row is not None and consumed >= stop_row:
+                break  # this line's 0-based source index == consumed
             consumed += 1
             if consumed <= skip_rows:
                 continue
@@ -572,7 +590,8 @@ def iter_csv_chunks(path: str, schema: FeatureSchema,
                     delim_regex: str = ",", chunk_rows: int = 1 << 22,
                     use_native: bool = True,
                     bad_records: Optional[BadRecordPolicy] = None,
-                    start_row: int = 0, cache=None):
+                    start_row: int = 0, cache=None,
+                    shard=None, stop_row: Optional[int] = None):
     """Yield a CSV as ColumnarTable row blocks of up to ``chunk_rows`` rows
     — the parse stage of the streaming CSV->device ingest pipeline.  Host
     memory holds one encoded block at a time instead of the whole dataset
@@ -601,60 +620,87 @@ def iter_csv_chunks(path: str, schema: FeatureSchema,
     cold full pass; bad-record policy, quarantine bytes, counters, and
     ``start_row`` resume behave bit-identically either way (the sidecar
     persists the per-chunk bad-record manifest), and a torn sidecar
-    degrades to this CSV parse with a warning."""
+    degrades to this CSV parse with a warning.
+
+    ``shard=(index, count)`` is the multi-host ingest mode: this stream
+    yields ONLY the row-range shard ``index`` of ``count`` — split points
+    from ``parallel.distributed.shard_rows`` over the total source-row
+    count (the native reader knows it up front; the python path pays one
+    cheap line-count pass), aligned to the ``chunk_rows`` grid so every
+    shard consumes whole ingest blocks and the per-shard streams union to
+    exactly the single-host stream (rows, ``source_row_end`` accounting,
+    and bad-record tallies all partition — pinned by
+    tests/test_sharded_stream.py).  Composes with ``start_row``: a
+    resumed shard restarts at max(its own range start, start_row).  A
+    cache hit shards too, by source-row arithmetic over the sidecar's own
+    chunk grid.  ``stop_row`` (exclusive source-row bound) is the
+    lower-level knob shard mode is built on; passing both is refused."""
     if chunk_rows <= 0:
         raise ValueError(f"chunk_rows must be positive, got {chunk_rows}")
     if start_row < 0:
         raise ValueError(f"start_row must be >= 0, got {start_row}")
+    if shard is not None and stop_row is not None:
+        raise ValueError("pass shard= or stop_row=, not both (shard "
+                         "computes its own bounds)")
     if cache is not None and getattr(cache, "enabled", False):
         from ..io.colcache import iter_csv_chunks_cached
         yield from iter_csv_chunks_cached(
             path, schema, delim_regex, chunk_rows, use_native,
-            bad_records, int(start_row), cache)
+            bad_records, int(start_row), cache, shard=shard)
         return
     done_rows = int(start_row)
+    stop: Optional[int] = int(stop_row) if stop_row is not None else None
+    reader = None
     if use_native and len(delim_regex) == 1:
-        reader = None
         try:
             from ..io.native_csv import native_open_csv
             reader = native_open_csv(path, schema, delim_regex)
         except Exception:
             reader = None
-        if reader is not None:
-            native_done = False
-            with reader:  # closed on EVERY exit path, incl. GeneratorExit
-                n = reader.n_rows
-                block_idx = 0
-                try:
-                    while done_rows < n:
-                        take = min(chunk_rows, n - done_rows)
+    if shard is not None:
+        from ..parallel.distributed import shard_rows as _split_rows
+        total = reader.n_rows if reader is not None \
+            else count_source_rows(path)
+        lo, hi = _split_rows(total, int(shard[0]), int(shard[1]),
+                             chunk_rows)
+        done_rows = max(done_rows, lo)
+        stop = hi
+    if reader is not None:
+        native_done = False
+        with reader:  # closed on EVERY exit path, incl. GeneratorExit
+            n = reader.n_rows if stop is None else min(reader.n_rows, stop)
+            block_idx = 0
+            try:
+                while done_rows < n:
+                    take = min(chunk_rows, n - done_rows)
 
-                        def read_block(lo=done_rows, m=take, i=block_idx):
-                            fault_point("chunk_read", i)
-                            return reader.parse_chunk(
-                                lo, m, bad_records=bad_records)
+                    def read_block(lo=done_rows, m=take, i=block_idx):
+                        fault_point("chunk_read", i)
+                        return reader.parse_chunk(
+                            lo, m, bad_records=bad_records)
 
-                        chunk = with_retry(
-                            read_block,
-                            what=f"chunk read [{done_rows}, "
-                                 f"{done_rows + take}) of {path!r}")
-                        chunk.source_row_end = done_rows + take
-                        yield chunk
-                        done_rows += take
-                        block_idx += 1
-                    native_done = True
-                except (ValueError, MemoryError, OSError) as exc:
-                    # python oracle resumes at done_rows below
-                    warnings.warn(
-                        f"native CSV reader failed mid-stream at row "
-                        f"{done_rows} of {path!r} ({type(exc).__name__}: "
-                        f"{exc}); degrading to the python parser",
-                        RuntimeWarning)
-            if native_done:
-                return
+                    chunk = with_retry(
+                        read_block,
+                        what=f"chunk read [{done_rows}, "
+                             f"{done_rows + take}) of {path!r}")
+                    chunk.source_row_end = done_rows + take
+                    yield chunk
+                    done_rows += take
+                    block_idx += 1
+                native_done = True
+            except (ValueError, MemoryError, OSError) as exc:
+                # python oracle resumes at done_rows below
+                warnings.warn(
+                    f"native CSV reader failed mid-stream at row "
+                    f"{done_rows} of {path!r} ({type(exc).__name__}: "
+                    f"{exc}); degrading to the python parser",
+                    RuntimeWarning)
+        if native_done:
+            return
     yield from _iter_csv_chunks_python(path, schema, delim_regex,
                                        chunk_rows, skip_rows=done_rows,
-                                       bad_records=bad_records)
+                                       bad_records=bad_records,
+                                       stop_row=stop)
 
 
 def prefetch_chunks(chunks, depth: int = 1, stats: Optional[dict] = None,

@@ -530,11 +530,13 @@ class CacheReader:
     def chunk_meta(self, idx: int) -> Dict[str, Any]:
         return self.header["chunks"][idx]
 
-    def load_chunk(self, idx: int, start_row: int = 0):
+    def load_chunk(self, idx: int, start_row: int = 0,
+                   stop_row: Optional[int] = None):
         """One cached block as ``(table, bad_src, bad_lines, nbytes)``,
-        sliced so only rows at source index >= ``start_row`` remain (the
-        checkpoint/resume axis).  Raises CacheChunkError when the file is
-        torn — never returns partial data."""
+        sliced so only rows at source index in ``[start_row, stop_row)``
+        remain — ``start_row`` is the checkpoint/resume axis, ``stop_row``
+        the sharded-ingest upper bound.  Raises CacheChunkError when the
+        file is torn — never returns partial data."""
         from ..core.table import ColumnarTable, LazyStringColumn
         fault_point("cache_read", idx)
         path = CacheWriter.chunk_path(self.dir, idx)
@@ -551,18 +553,28 @@ class CacheReader:
         base = manifest["_payload_base"]
         bad_src = np.asarray(manifest["bad"]["src"], dtype=np.int64)
         bad_lines = list(manifest["bad"]["lines"])
-        # source-row arithmetic for a mid-chunk resume cut: good rows
-        # appear in source order, so the number to drop is the number of
-        # source rows before the cut minus the bad ones among them
+        bad_sorted = np.sort(bad_src)
+        # source-row arithmetic for a mid-chunk cut: good rows appear in
+        # source order, so the number before a cut is the number of source
+        # rows before it minus the bad ones among them
         skip = 0
-        if start_row > src_start:
+        cut_head = start_row > src_start
+        if cut_head:
             cut = min(int(start_row), src_end)
-            skip = (cut - src_start) - int(np.searchsorted(
-                np.sort(bad_src), cut))
+            skip = (cut - src_start) - int(np.searchsorted(bad_sorted, cut))
             skip = max(0, min(skip, rows))
-            keep_bad = bad_src >= start_row
-            bad_lines = [ln for ln, k in zip(bad_lines, keep_bad) if k]
-            bad_src = bad_src[keep_bad]
+        end = rows
+        cut_tail = stop_row is not None and int(stop_row) < src_end
+        if cut_tail:
+            cut = max(int(stop_row), src_start)
+            end = (cut - src_start) - int(np.searchsorted(bad_sorted, cut))
+            end = max(skip, min(end, rows))
+        if cut_head or cut_tail:
+            window = bad_src >= start_row
+            if stop_row is not None:
+                window &= bad_src < int(stop_row)
+            bad_lines = [ln for ln, k in zip(bad_lines, window) if k]
+            bad_src = bad_src[window]
         columns: Dict[int, np.ndarray] = {}
         binned: Dict[int, np.ndarray] = {}
         str_columns: Dict[int, Any] = {}
@@ -574,15 +586,15 @@ class CacheReader:
                                      offset=base + c["offset"]).copy()
                 blob = buf[base + c["blob_offset"]:
                            base + c["blob_offset"] + c["blob_nbytes"]]
-                if skip:
-                    blob = blob[offs[skip]:]
-                    offs = offs[skip:] - offs[skip]
+                if skip or end < rows:
+                    blob = blob[offs[skip]:offs[end]]
+                    offs = offs[skip:end + 1] - offs[skip]
                 str_columns[o] = LazyStringColumn(blob, offs)
                 continue
             arr = np.frombuffer(buf, dtype=np.dtype(c["dtype"]),
                                 count=rows, offset=base + c["offset"])
-            if skip:
-                arr = arr[skip:]
+            if skip or end < rows:
+                arr = arr[skip:end]
             target = _KIND_TARGET[kind]
             if arr.dtype == target:
                 # already canonical: serve the read-only view over the
@@ -599,10 +611,14 @@ class CacheReader:
                 binned[o] = out
             else:
                 columns[o] = out
-        table = ColumnarTable(schema=self.schema, n_rows=rows - skip,
+        table = ColumnarTable(schema=self.schema, n_rows=end - skip,
                               columns=columns, str_columns=str_columns,
                               raw_rows=None, binned_cache=binned)
-        table.source_row_end = src_end
+        # a tail-cut chunk reports the cut as its end: the consumer's
+        # source-row accounting (checkpoints, shard resume) must never
+        # claim rows past its own shard bound
+        table.source_row_end = min(src_end, int(stop_row)) if cut_tail \
+            else src_end
         return table, bad_src, bad_lines, len(buf)
 
 
@@ -708,12 +724,30 @@ def _raise_cached_bad(n_bad: int, src: np.ndarray, csv_path: str) -> None:
         f"columnar cache at build time)")
 
 
+def _header_total_rows(header: Dict[str, Any]) -> int:
+    """Total SOURCE rows the sidecar covers: the last chunk's end, pushed
+    past any trailing bad-only records — the denominator of the sharded
+    serve's split arithmetic (must equal what the parse path would count,
+    so a cache hit and a parse miss of the same file agree on shard
+    bounds)."""
+    chunks = header.get("chunks") or []
+    n = int(chunks[-1]["source_row_end"]) if chunks else 0
+    tail = (header.get("tail_bad") or {}).get("src") or []
+    if tail:
+        n = max(n, max(int(s) for s in tail) + 1)
+    return n
+
+
 def _serve_cached(reader: CacheReader, csv_path: str, schema, delim: str,
                   chunk_rows: int, use_native: bool, bad_records,
-                  start_row: int, cache: CachePolicy):
-    """Yield the cached chunks, applying the bad-record policy per block
-    exactly where the parse path would; a torn chunk degrades the REST of
-    the stream to CSV parse from the last intact source row."""
+                  start_row: int, cache: CachePolicy,
+                  stop_row: Optional[int] = None):
+    """Yield the cached chunks whose source rows fall in ``[start_row,
+    stop_row)``, applying the bad-record policy per block exactly where
+    the parse path would; a torn chunk degrades the REST of the window to
+    CSV parse from the last intact source row (still bounded by
+    ``stop_row``, so a degraded shard can never eat its neighbor's
+    rows)."""
     from ..core import table as _table
     skipping = bad_records is not None and bad_records.skips
     done_rows = int(start_row)
@@ -723,10 +757,13 @@ def _serve_cached(reader: CacheReader, csv_path: str, schema, delim: str,
         if int(meta["source_row_end"]) <= start_row:
             done_rows = max(done_rows, int(meta["source_row_end"]))
             continue
+        if stop_row is not None and \
+                int(meta["source_row_start"]) >= stop_row:
+            break
         t0 = time.perf_counter()
         try:
             chunk, bad_src, bad_lines, nbytes = reader.load_chunk(
-                idx, start_row=start_row)
+                idx, start_row=start_row, stop_row=stop_row)
         except (CacheChunkError, OSError, ValueError, KeyError,
                 IndexError) as exc:
             if cache.policy == "require":
@@ -745,7 +782,7 @@ def _serve_cached(reader: CacheReader, csv_path: str, schema, delim: str,
             yield from _table.iter_csv_chunks(
                 csv_path, schema, delim, chunk_rows=chunk_rows,
                 use_native=use_native, bad_records=bad_records,
-                start_row=done_rows)
+                start_row=done_rows, stop_row=stop_row)
             return
         cache.add_time("cache_read_s", time.perf_counter() - t0)
         cache.bump("BytesRead", nbytes)
@@ -755,15 +792,16 @@ def _serve_cached(reader: CacheReader, csv_path: str, schema, delim: str,
             bad_records.record(bad_lines,
                                src_rows=[int(s) for s in bad_src])
         yield chunk
-        done_rows = int(meta["source_row_end"])
+        done_rows = int(getattr(chunk, "source_row_end",
+                                meta["source_row_end"]))
     tail = header.get("tail_bad") or {"src": [], "lines": []}
-    t_src = [s for s in tail["src"] if s >= start_row]
-    if t_src:
-        t_lines = [ln for s, ln in zip(tail["src"], tail["lines"])
-                   if s >= start_row]
+    keep = [(s, ln) for s, ln in zip(tail["src"], tail["lines"])
+            if s >= start_row and (stop_row is None or s < stop_row)]
+    if keep:
+        t_src = [s for s, _ in keep]
         if not skipping:
             _raise_cached_bad(len(t_src), np.asarray(t_src), csv_path)
-        bad_records.record(t_lines, src_rows=t_src)
+        bad_records.record([ln for _, ln in keep], src_rows=t_src)
 
 
 def _parse_and_build(csv_path: str, schema, delim: str, chunk_rows: int,
@@ -837,22 +875,53 @@ def _parse_and_build(csv_path: str, schema, delim: str, chunk_rows: int,
                 writer.abandon()
 
 
+def _build_owner(shard) -> bool:
+    """Whether THIS participant may emit the sidecar during its pass.
+    Two refusals (the multi-writer guard, TPU_NOTES §20):
+
+      * a row-range-sharded pass (``shard`` count > 1) never builds — a
+        shard is not the full file, and committing it as one would serve
+        wrong data to every later pass;
+      * under multi-process (real ``jax.distributed`` or the
+        AVENIR_TPU_SHARD lane) only process/shard 0 builds — N identical
+        builders racing the same commit point is wasted parse work and a
+        rename collision at finalize; the losers just parse this pass.
+    """
+    if shard is not None and int(shard[1]) > 1:
+        return False
+    from ..parallel.distributed import shard_spec
+    return shard_spec().index == 0
+
+
 def iter_csv_chunks_cached(csv_path: str, schema, delim: str,
                            chunk_rows: int, use_native: bool, bad_records,
-                           start_row: int, cache: CachePolicy):
+                           start_row: int, cache: CachePolicy, shard=None):
     """The cache-aware chunk stream behind
     ``core.table.iter_csv_chunks(..., cache=)``: serve from an intact
-    fresh sidecar, else parse (building one when the policy asks and the
-    pass starts at row 0 — a resumed tail must not masquerade as a full
-    cache)."""
+    fresh sidecar, else parse (building one when the policy asks, the
+    pass starts at row 0, and this participant owns the build — a
+    resumed tail or a row-range shard must not masquerade as a full
+    cache, and concurrent writers must not race one).
+
+    ``shard=(index, count)``: a cache HIT serves only the shard's
+    source-row window — the SAME ``shard_rows`` split over the same total
+    the parse path would use (``_header_total_rows``), so a warm shard
+    and a cold shard of the same run can never overlap; mid-window chunk
+    cuts ride ``CacheReader.load_chunk``'s source-row arithmetic."""
     cdir = cache.dir_for(csv_path)
     status, header = probe(csv_path, schema, delim, cache_dir=cdir)
     if status == "hit":
         cache.bump("Hit")
         reader = CacheReader(cdir, header, schema)
+        lo, hi = 0, None
+        if shard is not None and int(shard[1]) > 1:
+            from ..parallel.distributed import shard_rows as _split_rows
+            lo, hi = _split_rows(_header_total_rows(header),
+                                 int(shard[0]), int(shard[1]), chunk_rows)
         yield from _serve_cached(reader, csv_path, schema, delim,
                                  chunk_rows, use_native, bad_records,
-                                 start_row, cache)
+                                 max(int(start_row), lo), cache,
+                                 stop_row=hi)
         return
     if cache.policy == "require":
         raise FileNotFoundError(
@@ -867,7 +936,7 @@ def iter_csv_chunks_cached(csv_path: str, schema, delim: str,
         # the counter group can tell a touched source from a cold start
         cache.bump("Stale")
     from ..core import table as _table
-    if cache.builds and start_row == 0:
+    if cache.builds and start_row == 0 and _build_owner(shard):
         if status == "stale":
             # the old sidecar stays serveable-to-nobody (it probes stale)
             # until the private build dir swaps over it at finalize
@@ -875,7 +944,11 @@ def iter_csv_chunks_cached(csv_path: str, schema, delim: str,
         yield from _parse_and_build(csv_path, schema, delim, chunk_rows,
                                     use_native, bad_records, cache, cdir)
         return
+    if cache.builds:
+        # sharded pass / non-owner process: parse-only this time, counted
+        # so the skipped build is observable rather than a mystery miss
+        cache.bump("BuildSkipped")
     yield from _table.iter_csv_chunks(
         csv_path, schema, delim, chunk_rows=chunk_rows,
         use_native=use_native, bad_records=bad_records,
-        start_row=start_row)
+        start_row=start_row, shard=shard)

@@ -205,7 +205,7 @@ class ForestBuilder:
             note_dispatch()
             c = kernel(node_ids, base.branches, base.cls_codes, weights,
                        n_nodes)
-            return fetch(c, dtype=np.float64)
+            return base._reduce_counts(fetch(c, dtype=np.float64))
         acc = None
         for start in range(0, n, chunk):
             end = min(start + chunk, n)
@@ -216,7 +216,7 @@ class ForestBuilder:
             c = kernel(nid, br, cc, ww, n_nodes)
             acc = c.astype(jnp.int32) if acc is None \
                 else acc_counts(acc, c)
-        return fetch(acc, dtype=np.float64)
+        return base._reduce_counts(fetch(acc, dtype=np.float64))
 
     def _level_fused(self, fused, node_ids, weights, sel_split: np.ndarray,
                      child_table: np.ndarray, n_new: int):
@@ -241,7 +241,8 @@ class ForestBuilder:
                                weights, sel, ctab, n_new)
             # ONE stacked (T, N, S, B, C) transfer per level for the whole
             # forest — never per tree (pinned by tests/test_transfers.py)
-            return new_ids, fetch(c, dtype=np.float64)
+            # — and, sharded, ONE all-reduce of it per level
+            return new_ids, base._reduce_counts(fetch(c, dtype=np.float64))
         ids_parts, acc = [], None
         for start in range(0, n, chunk):
             end = min(start + chunk, n)
@@ -254,7 +255,7 @@ class ForestBuilder:
             acc = c.astype(jnp.int32) if acc is None \
                 else acc_counts(acc, c)
         return jnp.concatenate(ids_parts, axis=0), \
-            fetch(acc, dtype=np.float64)
+            base._reduce_counts(fetch(acc, dtype=np.float64))
 
     def build_all(self) -> List[DecisionPathList]:
         base, builders = self.base, self.tree_builders
@@ -279,7 +280,7 @@ class ForestBuilder:
         wdtype = (np.uint8 if self._w_max < 256 else
                   np.uint16 if self._w_max < float(1 << 16) else np.float32)
         wst = np.stack(w_cols, axis=1).astype(wdtype)
-        if wdtype is np.uint8 and self._w_max < 16 and T > 1:
+        if wdtype is np.uint8 and self._w_max < 16 and T > 1 and n > 0:
             if T % 2:
                 wst = np.concatenate(
                     [wst, np.zeros((n, 1), np.uint8)], axis=1)
@@ -371,7 +372,8 @@ def build_forest_from_stream(blocks, schema, params: ForestParams,
                              ctx: Optional[MeshContext] = None,
                              stats: Optional[dict] = None,
                              checkpoint=None, checkpoint_every: int = 0,
-                             resume_state=None) -> List[DecisionPathList]:
+                             resume_state=None,
+                             reducer=None) -> List[DecisionPathList]:
     """Train the forest from an iterator of ColumnarTable row blocks — the
     streaming CSV->device ingest pipeline's training entry.  Each block is
     encoded to branch/class codes on device and released, so host memory
@@ -393,16 +395,23 @@ def build_forest_from_stream(blocks, schema, params: ForestParams,
     ``checkpoint``/``checkpoint_every``/``resume_state`` thread straight
     through to ``TreeBuilder.from_stream`` (see its docstring for the
     resume contract): an interrupted-then-resumed streaming build trains
-    the bit-identical forest of an uninterrupted run."""
+    the bit-identical forest of an uninterrupted run.
+
+    ``reducer`` (a ``parallel.collectives.AllReducer``) turns the build
+    multi-host data-parallel: ``blocks`` must be this process's row-range
+    shard (``iter_csv_chunks(shard=reducer.spec)``); every tree level
+    pays exactly ONE all-reduce of the stacked (T, N, S, B, C) count
+    matrix, and every process returns the identical forest, bit-identical
+    to the single-host build (TPU_NOTES §20)."""
     import time as _time
-    ctx = ctx or runtime_context()
     t0 = _time.perf_counter()
     base = TreeBuilder.from_stream(blocks, schema,
                                    replace(params.tree, seed=params.seed),
                                    ctx, stats=stats,
                                    checkpoint=checkpoint,
                                    checkpoint_every=checkpoint_every,
-                                   resume_state=resume_state)
+                                   resume_state=resume_state,
+                                   reducer=reducer)
     t1 = _time.perf_counter()
     models = ForestBuilder(None, params, ctx, base=base).build_all()
     if stats is not None:

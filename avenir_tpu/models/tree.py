@@ -558,7 +558,7 @@ def _jitted_level_count_kernel(S: int, B: int, C: int):
 def _save_stream_checkpoint(mgr, blocks_done: int, br_parts, cls_parts,
                             mask_parts, n_rows: int,
                             source_rows_done: Optional[int],
-                            complete: bool) -> None:
+                            complete: bool, shard=None) -> None:
     """Persist the accumulated streamed-ingest state as one checkpoint
     step.  Full-state snapshots (not increments): any single intact step
     is sufficient to resume, which is what lets CheckpointManager retain
@@ -577,6 +577,12 @@ def _save_stream_checkpoint(mgr, blocks_done: int, br_parts, cls_parts,
             "source_rows_done": None if source_rows_done is None
             else int(source_rows_done),
             "ingest_complete": bool(complete)}
+    if shard is not None:
+        # the shard spec travels with the checkpoint: a sharded build's
+        # state is one shard's rows, and resuming it under a different
+        # process count would re-partition the file around it
+        meta["shard"] = {"index": int(shard.index),
+                         "count": int(shard.count)}
     mgr.save(blocks_done, arrays, meta)
 
 
@@ -626,6 +632,10 @@ class TreeBuilder:
         S, B, C = self.split_set.n_splits, self.split_set.max_branches, self.C
         self._count_kernel = _jitted_level_count_kernel(S, B, C)
         self._reassign_kernel = _REASSIGN_JIT
+        # single-host/monolithic: no cross-process reduce, weights map 1:1
+        self._reducer = None
+        self._local_rows = self.n_rows
+        self._row_offset = 0
 
         # splits grouped by attr for selection strategies
         self.splits_by_attr: Dict[int, List[int]] = {}
@@ -638,7 +648,7 @@ class TreeBuilder:
                     splits: Optional[List[CandidateSplit]] = None,
                     stats: Optional[dict] = None,
                     checkpoint=None, checkpoint_every: int = 0,
-                    resume_state=None) -> "TreeBuilder":
+                    resume_state=None, reducer=None) -> "TreeBuilder":
         """Build the device-resident state from an iterator of ColumnarTable
         row blocks instead of one assembled table — the consume stage of
         the streaming CSV->device ingest pipeline.
@@ -678,9 +688,34 @@ class TreeBuilder:
         Because branch/class codes are exact integers and per-record
         weights are placed by mask position over the TRUE row count, an
         interrupted-then-resumed ingest trains the bit-identical model of
-        an uninterrupted run (pinned by tests/test_faults.py)."""
+        an uninterrupted run (pinned by tests/test_faults.py).
+
+        Multi-host data-parallel mode (``reducer`` — a
+        ``parallel.collectives.AllReducer``): ``blocks`` is this
+        process's ROW-RANGE SHARD of the source
+        (``iter_csv_chunks(shard=(index, count))``), staged onto this
+        process's LOCAL devices only (no global array, no lock-step
+        block schedule — shards may have unequal block counts).  One
+        allgather after ingest exchanges per-shard row counts, giving
+        every process the global row total (the bootstrap RNG's
+        denominator) and its own global row offset (its slice of the
+        globally-drawn weight vectors).  Training then all-reduces ONE
+        stacked count matrix per level (``_reduce_counts``), so the host
+        epilogue — and therefore the model — is bit-identical on every
+        process to the single-host build (TPU_NOTES §20, pinned by
+        tests/test_sharded_stream.py).  A shard that owns no rows (more
+        processes than blocks) participates with empty arrays.
+        Checkpoints persist the shard spec; resume refuses a changed
+        process count (the file would be re-partitioned around the saved
+        state)."""
         import time as _time
         self = cls.__new__(cls)
+        if reducer is not None and ctx is None:
+            # shard-local arrays: never route through the multi-host
+            # global-array ingest — cross-process sync is the explicit
+            # per-level collective
+            from ..parallel.mesh import local_context
+            ctx = local_context()
         self.ctx = ctx or runtime_context()
         self.params = params
         self.schema = schema
@@ -695,6 +730,8 @@ class TreeBuilder:
 
         align = self.ctx.n_devices
         cls_ord = self.class_field.ordinal
+        spec = reducer.spec if reducer is not None else None
+        self._reducer = reducer
         br_parts, cls_parts, mask_parts = [], [], []
         n_rows = 0
         blocks_done = 0
@@ -702,6 +739,17 @@ class TreeBuilder:
         t_compute = 0.0
         if resume_state is not None:
             arrays, meta = resume_state
+            saved_shard = meta.get("shard")
+            want_shard = None if spec is None else \
+                {"index": spec.index, "count": spec.count}
+            if saved_shard != want_shard:
+                raise ValueError(
+                    f"checkpoint belongs to shard {saved_shard}, this "
+                    f"process is {want_shard}: a sharded build must "
+                    f"resume under the SAME process count and shard "
+                    f"assignment (the row-range split would move around "
+                    f"the saved state); clear the checkpoint dir to "
+                    f"restart cold")
             rb = np.asarray(arrays["branches"], dtype=np.int32)
             if rb.shape[0]:
                 if rb.shape[1] != self.split_set.n_splits:
@@ -757,27 +805,49 @@ class TreeBuilder:
                     and blocks_done % checkpoint_every == 0):
                 _save_stream_checkpoint(
                     checkpoint, blocks_done, br_parts, cls_parts,
-                    mask_parts, n_rows, source_rows_done, False)
+                    mask_parts, n_rows, source_rows_done, False,
+                    shard=spec)
         if checkpoint is not None and checkpoint_every > 0:
             # the ingest-complete step: a crash in the BUILD phase resumes
             # straight to training, re-reading zero source rows
             _save_stream_checkpoint(
                 checkpoint, blocks_done, br_parts, cls_parts, mask_parts,
-                n_rows, source_rows_done, True)
+                n_rows, source_rows_done, True, shard=spec)
         t0 = _time.perf_counter()
-        if not br_parts:
+        if not br_parts and spec is None:
             # the monolithic path cannot train on 0 rows either; fail with
             # the cause instead of a downstream shape error
             raise ValueError("from_stream got an empty block stream "
                              "(no rows to train on)")
         from ..parallel.mesh import _concat_jit
-        if len(br_parts) == 1:
+        if not br_parts:
+            # a sharded participant that owns no blocks (more processes
+            # than ingest blocks): it still joins every collective with
+            # all-zero partials
+            S = self.split_set.n_splits
+            self.branches = jnp.zeros((0, S), jnp.int32)
+            self.cls_codes = jnp.zeros((0,), jnp.int32)
+            mask_parts = [np.zeros((0,), np.float32)]
+        elif len(br_parts) == 1:
             self.branches, self.cls_codes = br_parts[0], cls_parts[0]
         else:
             sharding = self.ctx.row_sharding()
             self.branches = _concat_jit(len(br_parts), sharding)(br_parts)
             self.cls_codes = _concat_jit(len(cls_parts), sharding)(cls_parts)
         self.mask_np = np.concatenate(mask_parts)
+        self._local_rows = n_rows
+        self._row_offset = 0
+        if reducer is not None:
+            # ONE allgather: every process learns the global row total
+            # (the RNG denominator — the model bytes must not depend on
+            # the shard layout) and its own offset into the globally
+            # drawn per-record weight vectors
+            per_shard = reducer.allgather(int(n_rows))
+            self._row_offset = int(sum(per_shard[:spec.index]))
+            n_rows = int(sum(per_shard))
+            if n_rows == 0:
+                raise ValueError("sharded from_stream: no shard produced "
+                                 "any rows (empty source)")
         self.n_rows = n_rows
         self.n_padded = int(self.mask_np.shape[0])
         # the streamed state never keeps the feature matrix: branch codes
@@ -802,9 +872,17 @@ class TreeBuilder:
         valid positions of the padded device layout (zero on pad rows).
         The monolithic path's mask is a prefix, where this reduces to the
         old pad-then-mask form byte for byte; streamed ingest pads per
-        block, so valid positions may interleave with padding."""
+        block, so valid positions may interleave with padding.
+
+        Sharded streams draw ``w`` over the GLOBAL row count (every
+        process replays the identical RNG stream) and keep only this
+        shard's slice — global row i gets the same weight whichever host
+        holds it, which is half of what makes the sharded model
+        bit-identical (the other half is the per-level count reduce)."""
         if w is None:
             w = np.ones((self.n_rows,), dtype=np.float32)
+        if self._reducer is not None:
+            w = w[self._row_offset:self._row_offset + self._local_rows]
         full = np.zeros((self.n_padded,), dtype=np.float32)
         full[self.mask_np > 0] = w.astype(np.float32)
         return full
@@ -818,6 +896,36 @@ class TreeBuilder:
         b.rng = np.random.default_rng(params.seed)
         b.pyrng = pyrandom.Random(params.seed)
         return b
+
+    def _reduce_counts(self, counts: np.ndarray) -> np.ndarray:
+        """The ONE cross-process collective per tree level (TPU_NOTES
+        §20): sum this shard's stacked count matrix with every peer's —
+        after it, all processes hold the identical global histogram and
+        the host epilogue (split choice, stopping, RNG draws) replays
+        identically everywhere.  Exact: counts are integers, so the sum
+        is order-independent and the sharded model is bit-identical to
+        the single-host build.  No-op on monolithic builds (no reducer);
+        a sharded build still records the collective site into the
+        ledger's ``Collectives`` group even at shard count 1, which is
+        what lets a single-process test pin the
+        one-all-reduce-per-level discipline.
+
+        Wire dtype is chosen from a GLOBALLY AGREED bound, never from
+        this shard's values (every process must issue the identical
+        collective — see AllReducer._jax_sum): a count cell is at most
+        the global weight mass, which every process can derive from the
+        global row count and the sub-sampling rate alone.  Within int32
+        the payload rides the device psum path on a real pod; past it
+        (toward the 1B-row regime with heavy bootstrap rates) it ships
+        int64 over the exact host transport."""
+        if self._reducer is None:
+            return counts
+        p = self.params
+        rate = p.sub_sampling_rate / 100.0 \
+            if p.sub_sampling != "none" else 1.0
+        mass_bound = float(self.n_rows) * max(1.0, rate)
+        wire = np.int32 if mass_bound < float(2 ** 31 - 1) else np.int64
+        return self._reducer.sum(counts.astype(wire)).astype(np.float64)
 
     # ---- kernels ----
     def _make_count_kernel(self, S, B, C):
@@ -867,12 +975,12 @@ class TreeBuilder:
                     self.cls_codes[start:end], weights[start:end], n_nodes)
                 acc = c.astype(jnp.int32) if acc is None \
                     else acc_counts(acc, c)
-            return fetch(acc, dtype=np.float64)
+            return self._reduce_counts(fetch(acc, dtype=np.float64))
         if n <= chunk:
             note_dispatch()
             c = self._count_kernel(node_ids, self.branches, self.cls_codes,
                                    weights, n_nodes)
-            return fetch(c, dtype=np.float64)
+            return self._reduce_counts(fetch(c, dtype=np.float64))
         total = np.zeros((n_nodes, S, B, C), dtype=np.float64)
         for start in range(0, n, chunk):
             end = min(start + chunk, n)
@@ -881,7 +989,7 @@ class TreeBuilder:
                                    self.cls_codes[start:end], weights[start:end],
                                    n_nodes)
             total += fetch(c, dtype=np.float64)
-        return total
+        return self._reduce_counts(total)
 
     # ---- attribute selection (DecisionTreeBuilder.getSplitAttributes :365-381)
     def _allowed_attrs(self, leaf: _LeafState) -> List[int]:

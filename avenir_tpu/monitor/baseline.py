@@ -268,11 +268,12 @@ class BaselineBuilder:
         self._counts = None          # device (R, B_max) f32, lazy
         self._n = 0
         # fail at construction, not after the training pass: a
-        # multi-process baseline needs every numeric feature's bins
-        # pinned by the schema, or each shard resolves different edges
-        # and allreduce_partials sums apples with oranges
-        from ..parallel.distributed import is_multiprocess
-        if is_multiprocess():
+        # multi-process (or row-range-sharded) baseline needs every
+        # numeric feature's bins pinned by the schema, or each shard
+        # resolves different edges and allreduce_partials sums apples
+        # with oranges
+        from ..parallel.distributed import is_multiprocess, shard_spec
+        if is_multiprocess() or shard_spec().active:
             _require_bounded_numerics(schema)
 
     def _ensure_state(self):
@@ -325,7 +326,8 @@ def compute_baseline(table: ColumnarTable,
     return BaselineBuilder(table.schema, n_bins).update(table).finalize()
 
 
-def allreduce_partials(builder: BaselineBuilder) -> BaselineBuilder:
+def allreduce_partials(builder: BaselineBuilder,
+                       reducer=None) -> BaselineBuilder:
     """Under multi-process, sum the per-shard partial counts host-side so
     every process finalizes the identical GLOBAL baseline (the sharded
     training jobs' counter-reduction discipline; the matrices are small —
@@ -340,12 +342,19 @@ def allreduce_partials(builder: BaselineBuilder) -> BaselineBuilder:
     processes (BaselineBuilder resolves them from the first local
     chunk)."""
     from ..parallel.distributed import allgather_object, is_multiprocess
-    if not is_multiprocess():
+    if reducer is not None and reducer.spec.active:
+        # row-range-sharded streaming build: partials combine through the
+        # build's own collective transport (works on the
+        # jax.distributed-free lane too)
+        gather = reducer.allgather
+    elif is_multiprocess():
+        gather = allgather_object
+    else:
         return builder
     _require_bounded_numerics(builder.schema)
     import jax.numpy as jnp
     builder._ensure_state()
-    parts = allgather_object(
+    parts = gather(
         (np.asarray(builder._counts, np.float64), builder._n))
     builder._counts = jnp.asarray(
         np.sum([c for c, _ in parts], axis=0).astype(np.float32))

@@ -251,7 +251,8 @@ class DistanceComputer:
 
     def pairwise_topk(self, test: ColumnarTable, train: ColumnarTable,
                       k: int, train_tile: int = 1 << 14,
-                      test_chunk: int = 1 << 13
+                      test_chunk: int = 1 << 13,
+                      shard_reducer=None, shard_base: int = 0
                       ) -> Tuple[np.ndarray, np.ndarray]:
         """Fused all-pairs distance + nearest-k, tiled over the train axis:
         the (n_test, n_train) matrix never exists — the train set is
@@ -277,13 +278,39 @@ class DistanceComputer:
         chunk is row-sharded over it with the train tiles replicated — GSPMD
         fans the distance + running-top-k work across the data axis with no
         cross-device traffic until the final gather.  Chunks not divisible
-        by the device count fall back to single-device placement."""
+        by the device count fall back to single-device placement.
+
+        Multi-HOST (``shard_reducer``, a ``parallel.collectives.AllReducer``):
+        ``train`` is this process's row-range shard of the global train set
+        starting at global row ``shard_base``.  Each test chunk's local
+        best-k (indices lifted to global train rows) is merged with every
+        peer's through ONE lock-step collective per chunk
+        (``AllReducer.merge_topk``) — device-resident partials, one
+        collective per step, and the merged result is bit-identical to the
+        single-host full-train scan (ties to the lowest global train
+        index).  All processes must walk identical test chunks; the
+        returned lists are identical everywhere."""
         from ..parallel.mesh import runtime_context
         tn, toh = self.encode(test)
         rn, roh = self._encode_train(train)
         n_test, n_train = tn.shape[0], rn.shape[0]
-        k = min(k, n_train)
+        if shard_reducer is None:
+            k = min(k, n_train)
         if n_train == 0 or n_test == 0:
+            if shard_reducer is not None:
+                # an empty train shard still joins every per-chunk
+                # collective with zero-width partials (lock-step contract)
+                out_d, out_i = [], []
+                for ts in range(0, n_test, test_chunk):
+                    te = min(ts + test_chunk, n_test)
+                    d, i = shard_reducer.merge_topk(
+                        np.zeros((te - ts, 0), np.float32),
+                        np.zeros((te - ts, 0), np.int32), k)
+                    out_d.append(d)
+                    out_i.append(i)
+                if out_d:
+                    return (np.concatenate(out_d).astype(np.int32),
+                            np.concatenate(out_i))
             return (np.zeros((n_test, k), np.int32),
                     np.zeros((n_test, k), np.int32))
         if self.metric not in ("euclidean", "manhattan"):
@@ -318,8 +345,9 @@ class DistanceComputer:
 
         rn_t, roh_t, base_d, nv_d = self._train_device(
             ("tiled", train_tile, mesh_on), build_tiles)
-        kernel = _topk_scan_kernel(k, self.metric, self._n_cat, self._denom,
-                                   self._fscale)
+        k_loc = min(k, n_train)
+        kernel = _topk_scan_kernel(k_loc, self.metric, self._n_cat,
+                                   self._denom, self._fscale)
         out_d: List = []
         out_i: List = []
         for ts in range(0, n_test, test_chunk):
@@ -334,11 +362,25 @@ class DistanceComputer:
             toh_c = put(jnp.asarray(toh_h))
             note_dispatch()
             best_d, best_i = kernel(tn_c, toh_c, rn_t, roh_t, base_d, nv_d)
+            if shard_reducer is not None:
+                # lock-step merge: this chunk's local best-k (lifted to
+                # GLOBAL train rows) against every peer's — the ONE
+                # collective per test chunk (pinned by
+                # tests/test_sharded_stream.py)
+                d_h = fetch(best_d)
+                i_h = fetch(best_i) + np.int32(shard_base)
+                d_h, i_h = shard_reducer.merge_topk(d_h, i_h, k)
+                out_d.append(d_h)
+                out_i.append(i_h)
+                continue
             # chunk results stay device-side; the whole test axis reads
             # back in ONE transfer per output below (each separate
             # np.asarray costs a full ~62 ms tunnel round trip)
             out_d.append(best_d)
             out_i.append(best_i)
+        if shard_reducer is not None:
+            return (np.concatenate(out_d).astype(np.int32),
+                    np.concatenate(out_i))
         if len(out_d) == 1:
             d_all, i_all = out_d[0], out_i[0]
         else:

@@ -46,6 +46,8 @@ from __future__ import annotations
 import functools
 from typing import Any, Callable, Dict, Optional
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -182,3 +184,324 @@ def grouped_top_k(scores: jnp.ndarray, k: int, largest: bool = True):
     s = scores if largest else -scores
     vals, idx = jax.lax.top_k(s, k)
     return (vals if largest else -vals), idx
+
+
+# --------------------------------------------------------------------------
+# cross-process all-reduce: the ONE collective per step of the sharded
+# streaming pipelines (TF's parameter-aggregation design, PAPERS.md)
+# --------------------------------------------------------------------------
+
+class AllReducer:
+    """One-collective-per-step aggregation of same-shaped per-process
+    partials — the synchronization primitive of the sharded streaming
+    builds (each host trains on its row-range shard; the ONLY cross-host
+    traffic is one reduce of the stacked statistics per level/chunk/call).
+
+    Transports, chosen at construction:
+
+      * ``local``  — shard count 1: every op is the identity.  The call
+        SITE still records into the ledger's ``Collectives`` group, so the
+        one-all-reduce-per-level discipline is pinnable by a single-process
+        test (the count is the number of synchronization points the
+        algorithm pays, whatever the pod size).
+      * ``jax``    — a joined ``jax.distributed`` run: ``sum`` rides a
+        one-device-per-process mesh through ``sharded_jit_reduce`` (the
+        stacked (P, ...) partials array is process-sharded; one jitted
+        reduction with a DONATED device-resident accumulator carry — no
+        defensive copy, no host round trip), falling back to the exact
+        pickle-transport ``all_reduce_host_array`` off-mesh (uneven device
+        sets, dtypes x64 would canonicalize away).  ``allgather`` is
+        ``allgather_object``.
+      * ``file``   — the jax.distributed-free lane (AVENIR_TPU_ALLREDUCE_DIR,
+        or an explicit ``transport_dir``): plain processes/threads
+        rendezvous through a step-indexed file barrier.  Exists because
+        data-parallel CORRECTNESS (bit-identical models, split-point
+        arithmetic, resume) is a property of the algorithm, not of the
+        collective fabric — CI pins it without needing a coordinator.
+        The first exchange runs a run-identity handshake
+        (``_ensure_handshake``) so a transport dir reused across
+        sequential runs cannot serve one run's leftover partials to the
+        next; a dir shared by two CONCURRENT runs is still operator
+        error (the handshake turns it into a loud timeout, not silence).
+
+    Steps are strictly ordered per instance ``name``; every participant
+    must construct the same reducers in the same order and call the same
+    sequence of ops (lock-step is the contract, exactly as with a real
+    collective)."""
+
+    def __init__(self, spec=None, name: str = "reduce",
+                 transport_dir: Optional[str] = None,
+                 timeout_s: Optional[float] = None):
+        from .distributed import shard_spec
+        import os
+        self.spec = spec if spec is not None else shard_spec()
+        self.name = name
+        # AVENIR_TPU_ALLREDUCE_TIMEOUT_S: how long a live shard waits for
+        # a dead peer before failing its step (the file transport's
+        # liveness bound; a crashed shard must not hang the others past
+        # it — they fail loudly and the operator resumes the whole set)
+        self.timeout_s = float(
+            timeout_s if timeout_s is not None
+            else os.environ.get("AVENIR_TPU_ALLREDUCE_TIMEOUT_S", 300.0))
+        self.dir = transport_dir or os.environ.get(
+            "AVENIR_TPU_ALLREDUCE_DIR")
+        if self.spec.count == 1:
+            self.transport = "local"
+        elif self.dir:
+            self.transport = "file"
+            os.makedirs(self.dir, exist_ok=True)
+        else:
+            from .distributed import is_multiprocess
+            if not is_multiprocess():
+                raise ValueError(
+                    f"shard count {self.spec.count} > 1 but neither "
+                    f"jax.distributed is joined nor "
+                    f"AVENIR_TPU_ALLREDUCE_DIR is set — partials would "
+                    f"silently never combine")
+            self.transport = "jax"
+        self._step = 0
+        self._proc_ctx = None      # lazily-built one-device-per-process mesh
+        import uuid
+        self._nonce = uuid.uuid4().hex   # this run's identity on the wire
+        self._peers = None         # idx -> nonce, set by _ensure_handshake
+
+    # ---- public ops (each is ONE collective) ----
+    def sum(self, arr: np.ndarray) -> np.ndarray:
+        """Element-wise sum of a same-shaped per-process partial, exact in
+        the input dtype.  One collective."""
+        from ..utils.tracing import note_allreduce
+        arr = np.asarray(arr)
+        note_allreduce(arr.nbytes)
+        if self.transport == "local":
+            return arr
+        if self.transport == "file":
+            parts = self._file_exchange(arr)
+            out = parts[0].copy()
+            for p in parts[1:]:
+                out += p
+            return out
+        return self._jax_sum(arr)
+
+    def allgather(self, obj):
+        """Per-process list of ``obj`` in shard order.  One collective.
+        The payload is pickled exactly once — the ledger byte count and
+        the transport share the same buffer (KNN merges allgather
+        multi-MB top-k lists per test chunk; serializing twice would
+        double the host cost of the per-chunk collective)."""
+        from ..utils.tracing import note_allreduce
+        import pickle
+        if self.transport == "local":
+            note_allreduce(0)
+            return [obj]
+        buf = pickle.dumps(obj)
+        note_allreduce(len(buf))
+        if self.transport == "file":
+            return self._file_exchange(obj, pickled=buf)
+        from .distributed import allgather_object
+        return [pickle.loads(b) for b in allgather_object(buf)]
+
+    def merge_topk(self, nd: np.ndarray, ni: np.ndarray, k: int):
+        """Merge per-shard running nearest-k lists — the lock-step KNN
+        collective: each shard contributes its (n_test, k_local) best
+        (distance, GLOBAL train index) lists; every shard returns the
+        identical global best-k.  One collective per call.
+
+        Ties resolve to the lowest global train index: within a shard the
+        fused scan already orders ties that way, shards concatenate in
+        ascending index-range order, and the stable sort preserves it —
+        exactly the single-host full-matrix argsort semantics."""
+        parts = self.allgather((np.asarray(nd), np.asarray(ni)))
+        if len(parts) == 1:
+            return nd, ni
+        d_cat = np.concatenate([p[0] for p in parts], axis=1)
+        i_cat = np.concatenate([p[1] for p in parts], axis=1)
+        order = np.argsort(d_cat, axis=1, kind="stable")
+        kk = min(k, d_cat.shape[1])
+        take = order[:, :kk]
+        return (np.take_along_axis(d_cat, take, axis=1),
+                np.take_along_axis(i_cat, take, axis=1))
+
+    # ---- jax transport ----
+    def _jax_sum(self, arr: np.ndarray) -> np.ndarray:
+        """Transport choice must be PROCESS-INDEPENDENT: a collective is
+        a rendezvous, so every process must issue the same one in the
+        same order — deciding from local data (e.g. this shard's max)
+        would desync processes whose partials straddle the bound.  Hence:
+        dtype alone picks the path (int32/float32 ride the device psum;
+        int64 and anything x64-canonicalization would narrow take the
+        exact pickle transport), and callers who want the device path for
+        integer payloads narrow to int32 themselves from a globally
+        agreed bound (``TreeBuilder._reduce_counts`` derives one from the
+        global row count).  The device-path exception fallback is for
+        conditions identical on every process (device-set shape, backend
+        layout refusals) — the same try fails everywhere or nowhere."""
+        from .distributed import all_reduce_host_array
+        if arr.dtype not in (np.int32, np.float32):
+            return all_reduce_host_array(arr)
+        try:
+            return self._jax_sum_device(arr)
+        except Exception:
+            # off-mesh (uneven devices per process, backend refusing the
+            # layout): the exact host path is always available
+            return all_reduce_host_array(arr)
+
+    def _jax_sum_device(self, arr: np.ndarray) -> np.ndarray:
+        import jax as _jax
+        from jax.sharding import Mesh
+        import numpy as _np
+        if self._proc_ctx is None:
+            by_proc: dict = {}
+            for d in _jax.devices():
+                by_proc.setdefault(getattr(d, "process_index", 0),
+                                   []).append(d)
+            if len(by_proc) != self.spec.count:
+                raise RuntimeError("device set does not span every process")
+            devs = [by_proc[p][0] for p in sorted(by_proc)]
+            self._proc_ctx = MeshContext(Mesh(_np.array(devs), ("procs",)))
+        ctx = self._proc_ctx
+        red = _proc_sum_jit(ctx, arr.shape, arr.dtype.str)
+        sharding = NamedSharding(ctx.mesh, P("procs"))
+        parts = _jax.make_array_from_process_local_data(sharding, arr[None])
+        from ..parallel.mesh import _zeros_jit
+        acc = _zeros_jit(arr.shape, _np.dtype(arr.dtype),
+                         NamedSharding(ctx.mesh, P()))()
+        return np.asarray(red(parts, acc))
+
+    # ---- file transport ----
+    def _fpath(self, stem: str, idx: int) -> str:
+        import os
+        return os.path.join(self.dir, f"{self.name}-{stem}.{idx}.part")
+
+    def _fwrite(self, path: str, head, body: bytes = b""):
+        import os
+        import pickle
+        tmp = f"{path}.tmp-{os.getpid()}-{id(self)}"
+        with open(tmp, "wb") as fh:
+            fh.write(pickle.dumps(head))
+            fh.write(body)
+        os.replace(tmp, path)
+
+    def _fread_wait(self, path: str, deadline: float, what: str):
+        import pickle
+        import time
+        while True:
+            try:
+                with open(path, "rb") as fh:
+                    return pickle.load(fh)
+            except (OSError, EOFError, pickle.UnpicklingError):
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"AllReducer[{self.name}]: {what} never appeared "
+                        f"at {path!r} within {self.timeout_s}s")
+                time.sleep(0.005)
+
+    def _ensure_handshake(self):
+        """Run-identity handshake, lazily before the first exchange.
+
+        A reused transport dir can hold a previous run's leftovers (the
+        rolling reap keeps each shard's last two step files; a crash
+        keeps everything) — without identity on the wire, a later run
+        could read them as current-step payloads and silently sum a dead
+        run's partials.  Each participant sweeps ITS OWN leftovers first
+        (only it ever writes files carrying its index, so the sweep
+        cannot race a live peer), announces a fresh per-run nonce, and
+        blocks until every peer has echoed THAT nonce back — so nobody
+        enters the payload exchange while any peer's view of it predates
+        this run.  If our announce-read raced a peer's sweep we adopt
+        the fresh nonce from its echo file and republish ours, which is
+        what unblocks the peer in turn.  Payload files are tagged with
+        the writer's nonce, and a reader treats a stale tag exactly like
+        a missing file: leftovers can delay a step, never poison it."""
+        import glob
+        import os
+        import time
+        if self._peers is not None:
+            return
+        i = self.spec.index
+        for f in glob.glob(os.path.join(self.dir,
+                                        f"{self.name}-*.{i}.part")):
+            try:
+                os.remove(f)
+            except OSError:
+                pass
+        self._fwrite(self._fpath("hello-a", i), self._nonce)
+        deadline = time.monotonic() + self.timeout_s
+        self._peers = {
+            j: self._fread_wait(self._fpath("hello-a", j), deadline,
+                                f"shard {j}'s announce")
+            for j in range(self.spec.count)}
+        self._fwrite(self._fpath("hello-b", i),
+                     (self._nonce, dict(self._peers)))
+        for j in range(self.spec.count):
+            while True:
+                nonce_j, echo = self._fread_wait(
+                    self._fpath("hello-b", j), deadline,
+                    f"shard {j}'s acknowledgment")
+                if nonce_j != self._peers[j]:
+                    self._peers[j] = nonce_j
+                    self._fwrite(self._fpath("hello-b", i),
+                                 (self._nonce, dict(self._peers)))
+                if echo.get(self.spec.index) == self._nonce:
+                    break
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"AllReducer[{self.name}] handshake: shard {j} "
+                        f"never acknowledged this run within "
+                        f"{self.timeout_s}s (peer died, or {self.dir!r} "
+                        f"is shared with another live run)")
+                time.sleep(0.005)
+
+    def _file_exchange(self, obj, pickled: Optional[bytes] = None):
+        """Step-barrier exchange: write this shard's nonce-tagged pickled
+        payload (tmp-then-rename, so a visible file is always complete),
+        wait for every peer's file of the same step, read them in shard
+        order.  A participant entering step s has, by construction, read
+        every peer's step-(s-1) file — so each process reaps its OWN
+        step-(s-2) file, keeping the directory O(count) files."""
+        import os
+        import pickle
+        import time
+        self._ensure_handshake()
+        step = self._step
+        self._step += 1
+        stem = f"{step:06d}"
+        self._fwrite(self._fpath(stem, self.spec.index), self._nonce,
+                     pickled if pickled is not None else pickle.dumps(obj))
+        if step >= 2:
+            try:
+                os.remove(self._fpath(f"{step - 2:06d}", self.spec.index))
+            except OSError:
+                pass
+        parts = []
+        deadline = time.monotonic() + self.timeout_s
+        for idx in range(self.spec.count):
+            p = self._fpath(stem, idx)
+            while True:
+                try:
+                    with open(p, "rb") as fh:
+                        if pickle.load(fh) != self._peers[idx]:
+                            # a previous run's leftover in a reused dir:
+                            # stale == missing, keep waiting for this run's
+                            raise EOFError("stale payload")
+                        parts.append(pickle.load(fh))
+                    break
+                except (OSError, EOFError, pickle.UnpicklingError):
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(
+                            f"AllReducer[{self.name}] step {step}: shard "
+                            f"{idx} never produced {p!r} within "
+                            f"{self.timeout_s}s (peer died or fell out of "
+                            f"lock-step)")
+                    time.sleep(0.005)
+        return parts
+
+
+@functools.lru_cache(maxsize=None)
+def _proc_sum_jit(ctx: MeshContext, shape, dtype_str: str):
+    """The device collective of ``AllReducer._jax_sum_device``: sum the
+    process-sharded (P, ...) partials into a replicated result, with the
+    zero-initialized accumulator DONATED (its output twin has identical
+    shape/dtype/sharding, so XLA reduces into the buffer in place)."""
+    return sharded_jit_reduce(lambda parts, acc: acc + parts.sum(axis=0),
+                              ctx, n_batch_args=1, donate=True,
+                              carry_args=(1,))

@@ -59,7 +59,8 @@ multi-process instead of silently emitting shard-local results.
 from __future__ import annotations
 
 import os
-from typing import Optional, Sequence
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -193,6 +194,84 @@ def from_process_local(local_rows: np.ndarray, mesh: Mesh):
             f"drift) before ingest; mismatched blocks silently corrupt the "
             f"global array")
     return jax.make_array_from_process_local_data(sharding, local_rows)
+
+
+def shard_rows(n_rows: int, index: int, count: int,
+               chunk_rows: int = 1) -> Tuple[int, int]:
+    """Contiguous source-row range ``[lo, hi)`` owned by shard ``index`` of
+    ``count`` over an ``n_rows``-row source — THE split-point rule of the
+    sharded streaming ingest (every caller must use it so two processes can
+    never disagree about who owns a row).
+
+    Split points are aligned to the ``chunk_rows`` grid: the grid is exactly
+    the ``source_row_end`` accounting every streamed chunk reports (the PR 2
+    checkpoint/resume axis), so a shard always consumes WHOLE ingest blocks
+    — no mid-chunk truncation, and a bad record (counted on the source-row
+    axis like any other row) belongs to exactly one shard, which is what
+    makes per-shard quarantine tallies sum to the single-host totals.
+
+    Properties (pinned by tests/test_sharded_stream.py):
+      * ranges are disjoint and their union is ``[0, n_rows)``;
+      * more shards than blocks leaves the extras EMPTY (``lo == hi``) —
+        an empty shard is a valid degenerate participant, not an error;
+      * the last shard absorbs the tail remainder block.
+    """
+    if count < 1:
+        raise ValueError(f"shard count must be >= 1, got {count}")
+    if not 0 <= index < count:
+        raise ValueError(f"shard index {index} outside [0, {count})")
+    if chunk_rows < 1:
+        raise ValueError(f"chunk_rows must be >= 1, got {chunk_rows}")
+    if n_rows < 0:
+        raise ValueError(f"n_rows must be >= 0, got {n_rows}")
+    blocks = -(-n_rows // chunk_rows)      # ceil: tail remainder is a block
+    lo_b = blocks * index // count
+    hi_b = blocks * (index + 1) // count
+    return (min(lo_b * chunk_rows, n_rows),
+            min(hi_b * chunk_rows, n_rows))
+
+
+@dataclass(frozen=True)
+class ShardSpec:
+    """This process's identity in a row-range-sharded run: ``(index,
+    count)``.  ``count == 1`` is the single-host degenerate (every shard
+    helper becomes the identity)."""
+
+    index: int = 0
+    count: int = 1
+
+    def __post_init__(self):
+        if self.count < 1 or not 0 <= self.index < self.count:
+            raise ValueError(f"bad shard spec {self.index}/{self.count}")
+
+    @property
+    def active(self) -> bool:
+        return self.count > 1
+
+    def range_for(self, n_rows: int, chunk_rows: int = 1) -> Tuple[int, int]:
+        return shard_rows(n_rows, self.index, self.count, chunk_rows)
+
+
+def shard_spec() -> ShardSpec:
+    """The shard identity of THIS process: ``jax.process_index/count``
+    under a joined multi-process run; the ``AVENIR_TPU_SHARD=i/P`` override
+    for the jax.distributed-free smoke lane (two plain subprocesses
+    exchanging partials through ``parallel.collectives.AllReducer``'s file
+    transport); ``0/1`` otherwise.  The env override wins so the smoke
+    lane can never be silently demoted to single-shard by a container
+    where ``jax.distributed`` cannot rendezvous."""
+    env = os.environ.get("AVENIR_TPU_SHARD")
+    if env:
+        try:
+            i, _, p = env.partition("/")
+            return ShardSpec(int(i), int(p))
+        except ValueError as exc:
+            raise ValueError(
+                f"AVENIR_TPU_SHARD must look like 'index/count', got "
+                f"{env!r}") from exc
+    if is_multiprocess():
+        return ShardSpec(jax.process_index(), process_count())
+    return ShardSpec()
 
 
 def work_slice(n: int):

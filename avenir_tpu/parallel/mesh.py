@@ -48,6 +48,17 @@ def default_mesh() -> Mesh:
     return _default_mesh
 
 
+def local_context() -> "MeshContext":
+    """A MeshContext over THIS process's addressable devices only — the
+    execution substrate of the sharded streaming builds: each process keeps
+    its row-range shard device-resident locally and the only cross-process
+    traffic is the explicit one-collective-per-level reduce
+    (parallel.collectives.AllReducer).  Single-process this is just the
+    default 1-D mesh, so the same builder code serves both."""
+    return MeshContext(make_mesh(devices=jax.local_devices()),
+                       process_local=True)
+
+
 class MeshContext:
     """Convenience wrapper bundling a mesh with sharding helpers.
 
@@ -61,8 +72,17 @@ class MeshContext:
     between the two.
     """
 
-    def __init__(self, mesh: Optional[Mesh] = None):
+    def __init__(self, mesh: Optional[Mesh] = None,
+                 process_local: bool = False):
+        """``process_local=True`` marks a mesh built over THIS process's
+        addressable devices only (``local_context()``): the placement
+        helpers then never route through the multi-host global-array
+        ingest (``from_process_local``) even when ``jax.process_count() >
+        1`` — the sharded streaming builds keep their shard's arrays
+        process-local and synchronize through explicit per-level
+        collectives instead (parallel.collectives.AllReducer)."""
         self.mesh = mesh if mesh is not None else default_mesh()
+        self.process_local = process_local
         axes = tuple(self.mesh.axis_names)
         # single string for a 1-D mesh (back-compat), tuple for hybrid —
         # both forms are accepted by PartitionSpec and lax.psum
@@ -95,7 +115,7 @@ class MeshContext:
         active :class:`utils.tracing.TransferLedger` (host arrays only —
         re-placing an array already on device moves no link bytes)."""
         _note_upload(arr)
-        if jax.process_count() > 1:
+        if jax.process_count() > 1 and not self.process_local:
             from .distributed import from_process_local
             return from_process_local(np.asarray(arr), self.mesh)
         return jax.device_put(arr, self.row_sharding())
@@ -119,7 +139,8 @@ class MeshContext:
         path (multi-host ingest must build the global array in one
         make_array call)."""
         arr = np.asarray(arr)
-        if (jax.process_count() > 1 or arr.ndim == 0
+        if ((jax.process_count() > 1 and not self.process_local)
+                or arr.ndim == 0
                 or arr.nbytes <= chunk_bytes
                 or arr.shape[0] < 2 * self.n_devices
                 or arr.shape[0] % self.n_devices != 0):
@@ -154,7 +175,7 @@ class MeshContext:
         per-process local row count, so multi-process runs produce a global
         array of process_count * shape[0] rows (matching what shard_rows
         returns for same-shaped local blocks)."""
-        if jax.process_count() > 1:
+        if jax.process_count() > 1 and not self.process_local:
             shape = (shape[0] * jax.process_count(),) + tuple(shape[1:])
         return _zeros_jit(tuple(shape), np.dtype(dtype), self.row_sharding())()
 

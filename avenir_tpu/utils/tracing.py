@@ -70,7 +70,7 @@ class TransferLedger:
     """
 
     __slots__ = ("h2d_bytes", "d2h_bytes", "h2d_transfers", "d2h_transfers",
-                 "dispatches", "_lock")
+                 "dispatches", "allreduces", "allreduce_bytes", "_lock")
 
     def __init__(self):
         self.h2d_bytes = 0
@@ -78,6 +78,8 @@ class TransferLedger:
         self.h2d_transfers = 0
         self.d2h_transfers = 0
         self.dispatches = 0
+        self.allreduces = 0
+        self.allreduce_bytes = 0
         self._lock = threading.Lock()
 
     def record_h2d(self, nbytes: int, transfers: int = 1) -> None:
@@ -94,24 +96,42 @@ class TransferLedger:
         with self._lock:
             self.dispatches += int(n)
 
+    def record_allreduce(self, nbytes: int, n: int = 1) -> None:
+        """One cross-process collective of ``nbytes`` payload (this
+        process's contribution).  Recorded at every ``AllReducer`` call
+        site — including the single-process identity, so the
+        one-collective-per-level discipline is pinnable without spawning a
+        pod: the COUNT is the number of synchronization points the sharded
+        algorithm would pay, whatever the process count."""
+        with self._lock:
+            self.allreduces += int(n)
+            self.allreduce_bytes += int(nbytes)
+
     def snapshot(self) -> Dict[str, int]:
         with self._lock:
             return {"h2d_bytes": self.h2d_bytes,
                     "d2h_bytes": self.d2h_bytes,
                     "h2d_transfers": self.h2d_transfers,
                     "d2h_transfers": self.d2h_transfers,
-                    "dispatches": self.dispatches}
+                    "dispatches": self.dispatches,
+                    "allreduces": self.allreduces,
+                    "allreduce_bytes": self.allreduce_bytes}
 
     def export(self, counters, group: str = "Transfers") -> None:
         """Into the job Counters channel, Hadoop-dump style.  Byte tallies
         are per-process host-side work, so exporting BEFORE a multi-process
         all-reduce yields correct cluster totals (each process moves its
-        own bytes)."""
+        own bytes).  Collectives land in their OWN group (next to
+        Transfers) so the one-all-reduce-per-level claim is a counter an
+        operator (and a regression test) can read directly."""
         counters.update_group(group, {
             "H2DBytes": self.h2d_bytes, "D2HBytes": self.d2h_bytes,
             "H2DTransfers": self.h2d_transfers,
             "D2HTransfers": self.d2h_transfers,
             "Dispatches": self.dispatches})
+        counters.update_group("Collectives", {
+            "AllReduces": self.allreduces,
+            "AllReduceBytes": self.allreduce_bytes})
 
 
 # global (NOT thread-local: staging threads record into their spawner's
@@ -152,6 +172,12 @@ def note_dispatch(n: int = 1) -> None:
     if _ledgers:
         for led in list(_ledgers):
             led.record_dispatch(n)
+
+
+def note_allreduce(nbytes: int, n: int = 1) -> None:
+    if _ledgers:
+        for led in list(_ledgers):
+            led.record_allreduce(nbytes, n)
 
 
 def fetch(device_array, dtype=None) -> np.ndarray:

@@ -442,6 +442,137 @@ def e2e_rf_rate(n):
                                  measured=led.snapshot())}
 
 
+SCALE_TREES = 8
+SCALE_DEPTH = 3
+
+
+def _scale_child_code(csv, n, shard, rdir):
+    """Inline child for one shard of the sharded-RF scaling run: builds
+    the forest from its row-range shard with the file-transport
+    AllReducer, prints a JSON result line (wall, ingest, model hash,
+    per-process collective count/bytes from the TransferLedger)."""
+    return (_CHILD_PRELUDE + f"""
+import hashlib, json, time
+import bench
+from avenir_tpu.core.table import iter_csv_chunks, prefetch_chunks
+from avenir_tpu.models.forest import ForestParams, build_forest_from_stream
+from avenir_tpu.parallel.collectives import AllReducer
+from avenir_tpu.parallel.distributed import ShardSpec
+from avenir_tpu.utils.tracing import transfer_ledger
+
+schema = bench._churn_schema()
+params = ForestParams(num_trees={SCALE_TREES}, seed=1)
+params.tree.max_depth = {SCALE_DEPTH}
+idx, cnt = {shard!r}
+reducer = AllReducer(spec=ShardSpec(idx, cnt), name='rf-scale',
+                     transport_dir={rdir!r}) if cnt > 1 else None
+stats = {{}}
+with transfer_ledger() as led:
+    t0 = time.perf_counter()
+    blocks = prefetch_chunks(iter_csv_chunks(
+        {csv!r}, schema, ',', chunk_rows=bench.RF_STREAM_BLOCK_ROWS,
+        shard=(idx, cnt) if cnt > 1 else None), consumer_wait_key=None)
+    models = build_forest_from_stream(blocks, schema, params,
+                                      stats=stats, reducer=reducer)
+    wall = time.perf_counter() - t0
+snap = led.snapshot()
+h = hashlib.sha256(''.join(m.to_json() for m in models).encode())
+print(json.dumps({{
+    'wall_s': round(wall, 3), 'n': {n},
+    'ingest_s': round(stats.get('ingest_wall_s', 0.0), 3),
+    'build_s': round(stats.get('build_s', 0.0), 3),
+    'parse_s': round(stats.get('parse_s', 0.0), 3),
+    'model_sha': h.hexdigest(),
+    'allreduces': snap['allreduces'],
+    'allreduce_bytes': snap['allreduce_bytes']}}))
+""")
+
+
+def _scale_point(n, procs, timeout_s=900):
+    """One scaling measurement: ``procs`` concurrent shard processes over
+    one n-row CSV (procs=1: the plain single-host build).  Wall is the
+    slowest shard (the job is done when the last one is); collective
+    bytes are per process (each moved its own)."""
+    import tempfile
+    path = churn_csv(n)
+    rdir = tempfile.mkdtemp(prefix="avenir_scale_reduce_")
+    env = {"JAX_PLATFORMS": "cpu"}
+    children = []
+    for i in range(procs):
+        code = _scale_child_code(path, n, (i, procs), rdir)
+        children.append(subprocess.Popen(
+            [sys.executable, "-c", code], text=True,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            env=dict(os.environ, **env),
+            cwd=os.path.dirname(os.path.abspath(__file__))))
+    results = []
+    try:
+        for c in children:
+            so, se = c.communicate(timeout=timeout_s)
+            if c.returncode != 0:
+                raise RuntimeError(f"scale child failed:\n{se[-2000:]}")
+            results.append(json.loads(so.strip().splitlines()[-1]))
+    finally:
+        for c in children:
+            if c.poll() is None:
+                c.kill()
+        import shutil
+        shutil.rmtree(rdir, ignore_errors=True)
+    wall = max(r["wall_s"] for r in results)
+    shas = {r["model_sha"] for r in results}
+    return {"procs": procs, "n": n, "wall_s": wall,
+            "rows_per_sec": round(n / wall, 1),
+            "ingest_s": max(r["ingest_s"] for r in results),
+            "models_identical": len(shas) == 1,
+            "model_sha": sorted(shas)[0],
+            "allreduces_per_proc": results[0]["allreduces"],
+            "allreduce_bytes_per_proc":
+                max(r["allreduce_bytes"] for r in results)}
+
+
+def rf_scale_rate(n):
+    """Multi-host scaling-efficiency curve for the sharded streaming RF
+    build (ISSUE 7): the same n-row CSV built by 1 and 2 shard processes
+    (strong scaling — fixed total rows), plus a weak-scaling point (2
+    processes over 2n rows vs 1 over n).  Shards are real OS processes
+    exchanging one all-reduce per tree level over the file transport —
+    the jax.distributed-free twin of the pod deployment, so the curve
+    measures the algorithm's actual parallel fraction (parse + local level
+    kernels scale; the per-level collective and the host epilogue do
+    not).  Every shard's model hash must equal the single-host build's
+    (bit-identity is the correctness side of the scaling claim).
+    Collective count/bytes are reported per process straight from the
+    TransferLedger's Collectives group.  Forced to the CPU backend:
+    process-level scaling of host work is the quantity under test, and N
+    fake shards funneling into one tunneled chip would measure link
+    contention instead."""
+    churn_csv(2 * n)  # weak-scaling fixture, materialized before timing
+    s1 = _scale_point(n, 1)
+    s2 = _scale_point(n, 2)
+    weak = _scale_point(2 * n, 2)
+    strong_eff = round(s1["wall_s"] / (2 * s2["wall_s"]), 3) \
+        if s2["wall_s"] > 0 else None
+    weak_eff = round(s1["wall_s"] / weak["wall_s"], 3) \
+        if weak["wall_s"] > 0 else None
+    return {"metric": "rf_sharded_scaling_rows_per_sec_2proc",
+            "value": s2["rows_per_sec"], "unit": "rows/sec",
+            "n": n, "trees": SCALE_TREES,
+            "strong_scaling": [s1, s2],
+            "weak_scaling": weak,
+            # >1.0x means 2 shards beat 1 at fixed rows; 1.0 would be
+            # perfect linear (wall halves), 0.5 no speedup at all
+            "strong_efficiency": strong_eff,
+            "speedup_2proc": round(s1["wall_s"] / s2["wall_s"], 2)
+            if s2["wall_s"] > 0 else None,
+            "weak_efficiency": weak_eff,
+            "models_bit_identical": (s1["models_identical"]
+                                     and s2["models_identical"]
+                                     and weak["models_identical"]
+                                     and s1["model_sha"] == s2["model_sha"]),
+            "collectives_per_proc": s2["allreduces_per_proc"],
+            "collective_bytes_per_proc": s2["allreduce_bytes_per_proc"]}
+
+
 def e2e_rf_deep_rate(n):
     """The RandomForest 100M-row north star (ROADMAP / BASELINE.json):
     disk CSV -> streamed ingest -> 16-tree forest at full contract scale.
@@ -921,6 +1052,10 @@ WORKLOADS = {
     # CSV-in contract terms (VERDICT r3 #1): ingest-only throughput and
     # the full disk-CSV -> model pipeline with per-phase timing
     "ingest": (ingest_rate, [10_000_000, 1_000_000]),
+    # multi-host scaling-efficiency curve (ISSUE 7): sharded streaming RF
+    # at 1 vs 2 shard processes (strong + weak scaling, bit-identity,
+    # per-process collective bytes); host-process work by design
+    "rf_scale": (rf_scale_rate, [200_000, 50_000]),
     "e2e": (e2e_rate, [10_000_000, 1_000_000]),
     "e2e_rf": (e2e_rf_rate, [2_000_000, 400_000]),
     # deep-scale points, run AFTER everything else in main(): a timeout
@@ -1239,9 +1374,11 @@ def main():
             continue  # deep-scale points: run last, see below
         if name == "rf_big" and not device_ok:
             continue  # device-scale amortization point; meaningless on CPU
-        if name == "ingest":
-            # pure host work: a slow-disk timeout here says NOTHING about
-            # the device and must not down-mode the remaining workloads
+        if name in ("ingest", "rf_scale"):
+            # pure host(-process) work: a slow-disk timeout here says
+            # NOTHING about the device and must not down-mode the
+            # remaining workloads (rf_scale pins its children to the CPU
+            # backend by design — see its docstring)
             r, _ = measure(name, {}, DEVICE_TIMEOUT_S)
             if r is not None:
                 results[name], backends[name] = r, "host"

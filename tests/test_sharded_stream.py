@@ -1,0 +1,848 @@
+"""Multi-host data-parallel streaming training (ISSUE 7): row-range shard
+arithmetic, sharded ingest (parse + cache paths), the one-collective-per-
+level tree/forest build, lock-step KNN top-k merge, sharded SMO groups,
+kill/resume under sharding, the concurrent-cache-writer guard, and a true
+two-subprocess CLI smoke over the jax.distributed-free file transport.
+
+Thread-simulated shards pin a 1-device runtime mesh first: concurrent
+multi-device XLA programs from different threads interleave their
+per-device collective rendezvous and deadlock (production multi-host runs
+one thread per process, so the hazard is harness-only)."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core.metrics import Counters
+from avenir_tpu.core.schema import FeatureSchema
+from avenir_tpu.core.table import (BadRecordPolicy, ColumnarTable,
+                                   count_source_rows, iter_csv_chunks,
+                                   load_csv)
+from avenir_tpu.parallel.collectives import AllReducer
+from avenir_tpu.parallel.distributed import ShardSpec, shard_rows, shard_spec
+from avenir_tpu.parallel.mesh import MeshContext, make_mesh, \
+    set_runtime_context
+from avenir_tpu.utils.tracing import transfer_ledger
+
+pytestmark = pytest.mark.sharded
+
+SCHEMA = {"fields": [
+    {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+    {"name": "c1", "ordinal": 1, "dataType": "categorical", "feature": True,
+     "maxSplit": 2, "cardinality": ["a", "b", "c"]},
+    {"name": "n1", "ordinal": 2, "dataType": "int", "feature": True,
+     "min": 0, "max": 600, "splitScanInterval": 150},
+    {"name": "cls", "ordinal": 3, "dataType": "categorical",
+     "cardinality": ["T", "F"]},
+]}
+
+
+def _schema():
+    return FeatureSchema.from_dict(SCHEMA)
+
+
+def _write_csv(path, n=499, seed=3, bad_rows=()):
+    rng = np.random.default_rng(seed)
+    lines = []
+    for i in range(n):
+        if i in bad_rows:
+            lines.append(f"r{i},a,NOT_A_NUMBER,T")
+            continue
+        c = ["a", "b", "c"][rng.integers(0, 3)]
+        v = int(rng.integers(0, 600))
+        cls = "T" if (v > 300) ^ (c == "c") else "F"
+        lines.append(f"r{i},{c},{v},{cls}")
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    return str(path)
+
+
+@pytest.fixture()
+def one_device_ctx():
+    """Thread-simulated shards need single-device programs (see module
+    docstring); restores the default context afterwards."""
+    set_runtime_context(MeshContext(make_mesh(1)))
+    yield
+    set_runtime_context(None)
+
+
+# --------------------------------------------------------------------------
+# split-point arithmetic (parallel/distributed.shard_rows)
+# --------------------------------------------------------------------------
+
+def test_shard_rows_partition_properties():
+    for n, count, chunk in [(997, 2, 100), (997, 3, 100), (10, 5, 8),
+                            (0, 3, 4), (7, 7, 1), (100, 1, 32),
+                            (1000, 4, 1)]:
+        ranges = [shard_rows(n, i, count, chunk) for i in range(count)]
+        # disjoint, ordered, complete
+        assert ranges[0][0] == 0
+        assert ranges[-1][1] == n
+        for (lo_a, hi_a), (lo_b, hi_b) in zip(ranges, ranges[1:]):
+            assert hi_a == lo_b
+            assert lo_a <= hi_a and lo_b <= hi_b
+        # split points on the chunk grid (except the file end)
+        for lo, hi in ranges:
+            for p in (lo, hi):
+                assert p == n or p % chunk == 0
+
+
+def test_shard_rows_empty_shards_and_remainder():
+    # more shards than blocks: extras are empty, the last shard still owns
+    # the tail remainder block
+    parts = [shard_rows(10, i, 5, 8) for i in range(5)]
+    assert sum(h - l for l, h in parts) == 10
+    assert parts[-1][1] == 10 and parts[-1][0] == 8  # remainder block
+    assert any(l == h for l, h in parts)             # some shard is empty
+
+
+def test_shard_rows_validation():
+    with pytest.raises(ValueError):
+        shard_rows(10, 2, 2)
+    with pytest.raises(ValueError):
+        shard_rows(10, -1, 2)
+    with pytest.raises(ValueError):
+        shard_rows(10, 0, 0)
+    with pytest.raises(ValueError):
+        shard_rows(-1, 0, 1)
+    with pytest.raises(ValueError):
+        shard_rows(10, 0, 2, chunk_rows=0)
+
+
+def test_shard_spec_env_override(monkeypatch):
+    monkeypatch.setenv("AVENIR_TPU_SHARD", "1/3")
+    assert shard_spec() == ShardSpec(1, 3)
+    monkeypatch.setenv("AVENIR_TPU_SHARD", "junk")
+    with pytest.raises(ValueError):
+        shard_spec()
+    monkeypatch.delenv("AVENIR_TPU_SHARD")
+    assert shard_spec() == ShardSpec(0, 1)
+    assert not shard_spec().active
+
+
+# --------------------------------------------------------------------------
+# sharded ingest: parse paths
+# --------------------------------------------------------------------------
+
+def _union(csv, schema, count, chunk_rows, **kw):
+    chunks = []
+    for i in range(count):
+        chunks.extend(iter_csv_chunks(csv, schema, ",",
+                                      chunk_rows=chunk_rows,
+                                      shard=(i, count), **kw))
+    return ColumnarTable.from_chunks(chunks)
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_shard_union_equals_full_stream(tmp_path, use_native):
+    schema = _schema()
+    csv = _write_csv(tmp_path / "d.csv", n=499)
+    full = ColumnarTable.from_chunks(list(iter_csv_chunks(
+        csv, schema, ",", chunk_rows=64, use_native=use_native)))
+    for count in (2, 3, 7):
+        t = _union(csv, schema, count, 64, use_native=use_native)
+        assert t.n_rows == full.n_rows == 499
+        for o in full.columns:
+            np.testing.assert_array_equal(t.columns[o], full.columns[o])
+
+
+def test_shard_source_row_accounting(tmp_path):
+    """Every shard's chunks report absolute source_row_end on the shared
+    axis, and consecutive shards hand over exactly at the split point."""
+    schema = _schema()
+    csv = _write_csv(tmp_path / "d.csv", n=300)
+    ends = {}
+    for i in range(3):
+        ends[i] = [c.source_row_end
+                   for c in iter_csv_chunks(csv, schema, ",",
+                                            chunk_rows=64, shard=(i, 3))]
+    bounds = [shard_rows(300, i, 3, 64) for i in range(3)]
+    for i, (lo, hi) in enumerate(bounds):
+        if ends[i]:
+            assert ends[i][-1] == hi
+            assert all(lo < e <= hi for e in ends[i])
+
+
+def test_shard_bad_rows_on_boundary_counters_sum(tmp_path):
+    """Bad records landing exactly on (and around) shard split points are
+    reported by exactly one shard: per-shard quarantine tallies sum to the
+    single-host totals and the quarantined bytes union exactly."""
+    schema = _schema()
+    # chunk 64, 3 shards over 300 rows -> split points at 128, 192 (grid)
+    bad = {0, 63, 64, 127, 128, 191, 192, 299}
+    csv = _write_csv(tmp_path / "d.csv", n=300, bad_rows=bad)
+
+    def run(shard, tag):
+        counters = Counters()
+        pol = BadRecordPolicy("quarantine", str(tmp_path / f"q_{tag}"),
+                              counters)
+        rows = sum(c.n_rows for c in iter_csv_chunks(
+            csv, schema, ",", chunk_rows=64, bad_records=pol, shard=shard))
+        return rows, counters, tmp_path / f"q_{tag}" / "part-q-00000"
+
+    rows_full, c_full, q_full = run(None, "full")
+    assert c_full.get("BadRecords", "Malformed") == len(bad)
+    tot_rows, tot_bad, q_lines = 0, 0, []
+    for i in range(3):
+        r, c, q = run((i, 3), f"s{i}")
+        tot_rows += r
+        tot_bad += c.get("BadRecords", "Malformed")
+        if q.exists():
+            q_lines.extend(q.read_text().splitlines())
+    assert tot_rows == rows_full == 300 - len(bad)
+    assert tot_bad == len(bad)
+    assert sorted(q_lines) == sorted(q_full.read_text().splitlines())
+
+
+def test_shard_composes_with_start_row(tmp_path):
+    """Resume inside a shard: start_row cuts only within the shard's own
+    range (the satellite-2 shard-relative restart contract)."""
+    schema = _schema()
+    csv = _write_csv(tmp_path / "d.csv", n=300)
+    lo, hi = shard_rows(300, 1, 2, 64)
+    whole = ColumnarTable.from_chunks(list(iter_csv_chunks(
+        csv, schema, ",", chunk_rows=64, shard=(1, 2))))
+    cut = lo + 70  # mid-chunk, inside the shard
+    resumed = ColumnarTable.from_chunks(list(iter_csv_chunks(
+        csv, schema, ",", chunk_rows=64, shard=(1, 2), start_row=cut)))
+    assert resumed.n_rows == hi - cut
+    for o in whole.columns:
+        np.testing.assert_array_equal(resumed.columns[o],
+                                      whole.columns[o][cut - lo:])
+    # start_row past the shard's end: empty stream, not an error
+    assert list(iter_csv_chunks(csv, schema, ",", chunk_rows=64,
+                                shard=(1, 2), start_row=hi + 5)) == []
+
+
+def test_shard_and_stop_row_are_exclusive(tmp_path):
+    schema = _schema()
+    csv = _write_csv(tmp_path / "d.csv", n=50)
+    with pytest.raises(ValueError, match="not both"):
+        list(iter_csv_chunks(csv, schema, ",", shard=(0, 2), stop_row=10))
+
+
+def test_count_source_rows(tmp_path):
+    p = tmp_path / "x.csv"
+    p.write_text("a,b\n\n  \nc,d\ne,f")
+    assert count_source_rows(str(p)) == 3
+
+
+# --------------------------------------------------------------------------
+# sharded ingest: columnar-cache paths
+# --------------------------------------------------------------------------
+
+def _build_cache(csv, schema, chunk_rows=64, bad_records=None):
+    from avenir_tpu.io.colcache import CachePolicy
+    list(iter_csv_chunks(csv, schema, ",", chunk_rows=chunk_rows,
+                         bad_records=bad_records,
+                         cache=CachePolicy("build")))
+    assert os.path.isdir(csv + ".avtc")
+
+
+def test_shard_union_from_cache_hit(tmp_path):
+    """A warm (sidecar) sharded read unions to the same table and the same
+    bad-record tallies as the cold parse — even when the replay requests a
+    DIFFERENT chunk grid than the cache was built with (mid-chunk cuts by
+    source-row arithmetic)."""
+    from avenir_tpu.io.colcache import CachePolicy
+    schema = _schema()
+    bad = {10, 100, 250}
+    csv = _write_csv(tmp_path / "d.csv", n=300, bad_rows=bad)
+    pol0 = BadRecordPolicy("skip", None, Counters())
+    full = ColumnarTable.from_chunks(list(iter_csv_chunks(
+        csv, schema, ",", chunk_rows=64, bad_records=pol0)))
+    _build_cache(csv, schema, chunk_rows=64,
+                 bad_records=BadRecordPolicy("skip", None, Counters()))
+    for replay_chunk in (64, 50):   # same grid, and a mismatched one
+        chunks, tot_bad = [], 0
+        for i in range(3):
+            counters = Counters()
+            pol = BadRecordPolicy("skip", None, counters)
+            got = list(iter_csv_chunks(
+                csv, schema, ",", chunk_rows=replay_chunk,
+                bad_records=pol, shard=(i, 3),
+                cache=CachePolicy("require")))
+            chunks.extend(got)
+            tot_bad += counters.get("BadRecords", "Malformed")
+        t = ColumnarTable.from_chunks(chunks)
+        assert t.n_rows == full.n_rows
+        for o in full.columns:
+            np.testing.assert_array_equal(t.columns[o], full.columns[o])
+        assert tot_bad == len(bad)
+
+
+def test_sharded_pass_never_builds_cache(tmp_path):
+    """Satellite 1: a row-range shard must not commit itself as the full
+    sidecar; policy=build under sharding degrades to parse-only with a
+    visible BuildSkipped tally."""
+    from avenir_tpu.io.colcache import CachePolicy
+    schema = _schema()
+    csv = _write_csv(tmp_path / "d.csv", n=200)
+    pol = CachePolicy("build")
+    rows = sum(c.n_rows for c in iter_csv_chunks(
+        csv, schema, ",", chunk_rows=64, shard=(0, 2), cache=pol))
+    assert rows == shard_rows(200, 0, 2, 64)[1]
+    assert not os.path.isdir(csv + ".avtc")
+    assert pol.tallies.get("BuildSkipped") == 1
+    assert pol.tallies.get("Built") is None
+
+
+def test_nonowner_process_never_builds_cache(tmp_path, monkeypatch):
+    """Satellite 1, multi-process form: only process/shard 0 may build;
+    a non-owner with policy=build parses without racing the commit."""
+    from avenir_tpu.io.colcache import CachePolicy
+    schema = _schema()
+    csv = _write_csv(tmp_path / "d.csv", n=100)
+    monkeypatch.setenv("AVENIR_TPU_SHARD", "1/2")
+    pol = CachePolicy("build")
+    rows = sum(c.n_rows for c in iter_csv_chunks(
+        csv, schema, ",", chunk_rows=64, cache=pol))
+    assert rows == 100 and not os.path.isdir(csv + ".avtc")
+    assert pol.tallies.get("BuildSkipped") == 1
+    # ...and the owner does build
+    monkeypatch.setenv("AVENIR_TPU_SHARD", "0/2")
+    list(iter_csv_chunks(csv, schema, ",", chunk_rows=64,
+                         cache=CachePolicy("build")))
+    assert os.path.isdir(csv + ".avtc")
+
+
+def test_two_concurrent_cache_writers_last_commit_wins(tmp_path):
+    """Satellite 1 regression: two writers racing the same sidecar never
+    interleave chunks from two builds — each builds privately, the last
+    commit replaces the whole directory, and the survivor verifies
+    clean."""
+    from avenir_tpu.io.colcache import (CacheWriter, probe, verify_cache)
+    schema = _schema()
+    csv = _write_csv(tmp_path / "d.csv", n=120)
+    chunks = list(iter_csv_chunks(csv, schema, ",", chunk_rows=40))
+    cdir = csv + ".avtc"
+    w1 = CacheWriter(cdir, schema, csv, ",", 40)
+    w2 = CacheWriter(cdir, schema, csv, ",", 40)
+    # interleaved appends: private build dirs keep them apart
+    for c in chunks:
+        w1.append(c, [], [])
+        w2.append(c, [], [])
+    w1.finalize()
+    w2.finalize()
+    status, header = probe(csv, schema, ",")
+    assert status == "hit"
+    assert header["build_id"] == w2.build_id  # last commit, whole
+    assert verify_cache(cdir, schema, csv, ",") == []
+
+
+# --------------------------------------------------------------------------
+# sharded forest build: bit-identity + one collective per level
+# --------------------------------------------------------------------------
+
+def _forest_params(trees=3, depth=3, seed=7):
+    from avenir_tpu.models.forest import ForestParams
+    p = ForestParams(num_trees=trees, seed=seed)
+    p.tree.max_depth = depth
+    p.tree.stopping_strategy = "maxDepth"
+    return p
+
+
+def _reference_forest(csv, schema, params):
+    from avenir_tpu.models.forest import build_forest
+    return [m.to_json() for m in build_forest(load_csv(csv, schema, ","),
+                                              params)]
+
+
+def test_single_shard_build_bit_identical_one_collective_per_level(
+        tmp_path, one_device_ctx):
+    """The Collectives pin: a sharded build pays exactly ONE all-reduce
+    per tree level (root + each fused level) plus the single post-ingest
+    row-count allgather — and at shard count 1 it is still bit-identical
+    to the monolithic build."""
+    from avenir_tpu.models.forest import build_forest_from_stream
+    schema = _schema()
+    csv = _write_csv(tmp_path / "d.csv", n=400)
+    params = _forest_params(trees=3, depth=3)
+    ref = _reference_forest(csv, schema, params)
+    red = AllReducer(spec=ShardSpec(0, 1), name="rf")
+    with transfer_ledger() as led:
+        models = build_forest_from_stream(
+            iter_csv_chunks(csv, schema, ",", chunk_rows=128, shard=(0, 1)),
+            schema, params, ctx=MeshContext(make_mesh(1)), reducer=red)
+    assert [m.to_json() for m in models] == ref
+    snap = led.snapshot()
+    # depth-3 forest: root histogram + fused levels 1..2 = 3 per-level
+    # all-reduces, + 1 ingest row-count allgather.  Exact, so a change
+    # that sneaks in a second collective per level fails loudly.
+    assert snap["allreduces"] == 4, snap
+    assert snap["allreduce_bytes"] > 0
+
+
+def test_two_shard_threads_bit_identical(tmp_path, one_device_ctx):
+    from avenir_tpu.models.forest import build_forest_from_stream
+    schema = _schema()
+    csv = _write_csv(tmp_path / "d.csv", n=401)  # odd: remainder block
+    params = _forest_params(trees=3, depth=3)
+    ref = _reference_forest(csv, schema, params)
+    rdir = str(tmp_path / "reduce")
+    out = {}
+
+    def worker(i):
+        red = AllReducer(spec=ShardSpec(i, 2), name="rf2",
+                         transport_dir=rdir, timeout_s=120)
+        models = build_forest_from_stream(
+            iter_csv_chunks(csv, schema, ",", chunk_rows=64, shard=(i, 2)),
+            schema, params, ctx=MeshContext(make_mesh(1)), reducer=red)
+        out[i] = [m.to_json() for m in models]
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(2)]
+    [t.start() for t in ts]
+    [t.join(240) for t in ts]
+    assert out.get(0) == out.get(1) == ref, \
+        "sharded forest differs from the single-host build"
+
+
+def test_empty_shard_participates(tmp_path, one_device_ctx):
+    """More processes than ingest blocks: the row-less shard still joins
+    every collective and returns the identical model."""
+    from avenir_tpu.models.forest import build_forest_from_stream
+    schema = _schema()
+    csv = _write_csv(tmp_path / "d.csv", n=90)   # 2 blocks of 64
+    params = _forest_params(trees=2, depth=2)
+    ref = _reference_forest(csv, schema, params)
+    rdir = str(tmp_path / "reduce")
+    out = {}
+
+    def worker(i):
+        red = AllReducer(spec=ShardSpec(i, 3), name="rf3",
+                         transport_dir=rdir, timeout_s=120)
+        models = build_forest_from_stream(
+            iter_csv_chunks(csv, schema, ",", chunk_rows=64, shard=(i, 3)),
+            schema, params, ctx=MeshContext(make_mesh(1)), reducer=red)
+        out[i] = [m.to_json() for m in models]
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(3)]
+    [t.start() for t in ts]
+    [t.join(240) for t in ts]
+    # shard 0 owns block 0? (3 shards over 2 blocks: one shard is empty)
+    assert any(shard_rows(90, i, 3, 64)[0] == shard_rows(90, i, 3, 64)[1]
+               for i in range(3))
+    assert out.get(0) == out.get(1) == out.get(2) == ref
+
+
+def test_sharded_kill_resume_restarts_shard_relative(tmp_path,
+                                                     one_device_ctx):
+    """Satellite 2: kill one shard mid-ingest; resuming restarts each
+    process at its OWN shard-relative row and the finished model is
+    bit-identical; a resume under a different process count refuses."""
+    from avenir_tpu.core.checkpoint import CheckpointManager
+    from avenir_tpu.models.forest import build_forest_from_stream
+    schema = _schema()
+    csv = _write_csv(tmp_path / "d.csv", n=400)
+    params = _forest_params(trees=2, depth=2)
+    ref = _reference_forest(csv, schema, params)
+    mgrs = {i: CheckpointManager(str(tmp_path / f"ck{i}")) for i in range(2)}
+
+    class Boom(RuntimeError):
+        pass
+
+    def killed_blocks(i):
+        # shard 1 dies after its first block
+        for bi, c in enumerate(iter_csv_chunks(
+                csv, schema, ",", chunk_rows=64, shard=(i, 2))):
+            if i == 1 and bi == 1:
+                raise Boom("injected shard crash")
+            yield c
+
+    errs = {}
+
+    def crash_worker(i):
+        red = AllReducer(spec=ShardSpec(i, 2), name="rfc",
+                         transport_dir=str(tmp_path / "r1"), timeout_s=8)
+        try:
+            build_forest_from_stream(
+                killed_blocks(i), schema, params,
+                ctx=MeshContext(make_mesh(1)), reducer=red,
+                checkpoint=mgrs[i], checkpoint_every=1)
+        except Exception as exc:
+            errs[i] = exc
+
+    ts = [threading.Thread(target=crash_worker, args=(i,)) for i in range(2)]
+    [t.start() for t in ts]
+    [t.join(240) for t in ts]
+    # shard 1 crashed; shard 0 timed out waiting at the collective
+    assert isinstance(errs.get(1), Boom)
+    assert isinstance(errs.get(0), RuntimeError)
+    # both left intact checkpoints carrying their shard spec; the killed
+    # shard's ingest is incomplete (shard 0 finished its own blocks and
+    # died later, at the post-ingest collective)
+    for i in range(2):
+        _, _, meta = mgrs[i].restore()
+        assert meta["shard"] == {"index": i, "count": 2}
+    assert not mgrs[1].restore()[2]["ingest_complete"]
+
+    # refuse resume under a different process count
+    _, arrays, meta = (lambda t: t)(mgrs[0].restore())
+    with pytest.raises(ValueError, match="SAME process count"):
+        from avenir_tpu.models.tree import TreeBuilder, TreeParams
+        red = AllReducer(spec=ShardSpec(0, 3),
+                         transport_dir=str(tmp_path / "r_bad"))
+        TreeBuilder.from_stream(iter([]), schema, TreeParams(seed=7),
+                                ctx=MeshContext(make_mesh(1)),
+                                reducer=red, resume_state=(arrays, meta))
+
+    # resume both shards at their own source_rows_done
+    out = {}
+
+    def resume_worker(i):
+        step, arrays, meta = mgrs[i].restore()
+        start = int(meta.get("source_rows_done") or 0)
+        lo, hi = shard_rows(400, i, 2, 64)
+        assert lo <= start <= hi
+        red = AllReducer(spec=ShardSpec(i, 2), name="rfr",
+                         transport_dir=str(tmp_path / "r2"), timeout_s=120)
+        models = build_forest_from_stream(
+            iter_csv_chunks(csv, schema, ",", chunk_rows=64, shard=(i, 2),
+                            start_row=start),
+            schema, params, ctx=MeshContext(make_mesh(1)), reducer=red,
+            checkpoint=mgrs[i], checkpoint_every=1,
+            resume_state=(arrays, meta))
+        out[i] = [m.to_json() for m in models]
+
+    ts = [threading.Thread(target=resume_worker, args=(i,))
+          for i in range(2)]
+    [t.start() for t in ts]
+    [t.join(240) for t in ts]
+    assert out.get(0) == out.get(1) == ref, \
+        "resumed sharded forest differs from the single-host build"
+    for i in range(2):
+        assert mgrs[i].restore()[2]["ingest_complete"] is True
+
+
+# --------------------------------------------------------------------------
+# lock-step KNN top-k merge
+# --------------------------------------------------------------------------
+
+def _knn_tables():
+    schema = FeatureSchema.from_dict({"fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "x", "ordinal": 1, "dataType": "double", "feature": True,
+         "min": 0, "max": 10},
+        {"name": "c", "ordinal": 2, "dataType": "categorical",
+         "feature": True, "cardinality": ["p", "q"]},
+        {"name": "cls", "ordinal": 3, "dataType": "categorical",
+         "cardinality": ["A", "B"]}]})
+
+    def tbl(n, seed):
+        rng = np.random.default_rng(seed)
+        return ColumnarTable(schema=schema, n_rows=n, columns={
+            1: rng.integers(0, 10, n).astype(np.float64),  # many ties
+            2: rng.integers(0, 2, n).astype(np.int32),
+            3: rng.integers(0, 2, n).astype(np.int32)},
+            str_columns={0: [f"r{i}" for i in range(n)]})
+    return schema, tbl(173, 1), tbl(37, 2)
+
+
+def test_knn_sharded_topk_bit_identical(tmp_path, one_device_ctx):
+    from avenir_tpu.ops.distance import DistanceComputer
+    schema, train, test = _knn_tables()
+    k = 9
+    ref_d, ref_i = DistanceComputer(schema).pairwise_topk(
+        test, train, k, test_chunk=16)
+    out = {}
+
+    def worker(i, P):
+        red = AllReducer(spec=ShardSpec(i, P), name="knn",
+                         transport_dir=str(tmp_path / "knn"), timeout_s=120)
+        lo, hi = shard_rows(train.n_rows, i, P)
+        out[i] = DistanceComputer(schema).pairwise_topk(
+            test, train.take_rows(lo, hi), k, test_chunk=16,
+            shard_reducer=red, shard_base=lo)
+
+    P = 3
+    ts = [threading.Thread(target=worker, args=(i, P)) for i in range(P)]
+    [t.start() for t in ts]
+    [t.join(240) for t in ts]
+    for i in range(P):
+        d, idx = out[i]
+        np.testing.assert_array_equal(d, ref_d)
+        np.testing.assert_array_equal(idx, ref_i)
+
+
+def test_knn_single_shard_one_collective_per_chunk(one_device_ctx):
+    """The per-chunk collective pin: ceil(n_test / test_chunk) merges,
+    results identical to the unsharded scan."""
+    from avenir_tpu.ops.distance import DistanceComputer
+    schema, train, test = _knn_tables()
+    k = 9
+    ref_d, ref_i = DistanceComputer(schema).pairwise_topk(
+        test, train, k, test_chunk=16)
+    red = AllReducer(spec=ShardSpec(0, 1), name="knn1")
+    with transfer_ledger() as led:
+        d, idx = DistanceComputer(schema).pairwise_topk(
+            test, train, k, test_chunk=16, shard_reducer=red, shard_base=0)
+    np.testing.assert_array_equal(d, ref_d)
+    np.testing.assert_array_equal(idx, ref_i)
+    assert led.snapshot()["allreduces"] == 3   # ceil(37 / 16)
+
+
+# --------------------------------------------------------------------------
+# sharded SMO groups
+# --------------------------------------------------------------------------
+
+def test_smo_sharded_groups_identical_across_shards(tmp_path,
+                                                    one_device_ctx):
+    from avenir_tpu.discriminant import smo as S
+    rng = np.random.default_rng(3)
+    groups = {}
+    for g in range(5):
+        n = 24 + 4 * g
+        yv = np.where(np.arange(n) % 2 == 0, 1.0, -1.0)
+        groups[f"g{g}"] = (rng.normal(0, 0.7, (n, 3)) + 1.1 * yv[:, None],
+                          yv)
+    p = S.SMOParams(penalty_factor=1.0)
+    ref = S.train_groups_batched(groups, p)
+    out = {}
+
+    def worker(i):
+        red = AllReducer(spec=ShardSpec(i, 2), name="smo",
+                         transport_dir=str(tmp_path / "smo"), timeout_s=120)
+        out[i] = S.train_groups_sharded(groups, p, red)
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(2)]
+    [t.start() for t in ts]
+    [t.join(240) for t in ts]
+    assert set(out[0]) == set(out[1]) == set(ref)
+    X = np.vstack([groups[g][0] for g in groups])
+    for g in ref:
+        np.testing.assert_array_equal(out[0][g].weights, out[1][g].weights)
+        assert out[0][g].threshold == out[1][g].threshold
+        # same optimum as the unsharded batched trainer (the batch-width
+        # padding may retile f32 math, so optimization-tolerance close)
+        np.testing.assert_allclose(out[0][g].weights, ref[g].weights,
+                                   rtol=1e-4, atol=1e-5)
+        # and identical PREDICTIONS on the pooled data
+        np.testing.assert_array_equal(S.predict(out[0][g], X),
+                                      S.predict(ref[g], X))
+
+
+# --------------------------------------------------------------------------
+# CLI: the two-subprocess jax.distributed-free smoke lane
+# --------------------------------------------------------------------------
+
+def _cli_env(extra):
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("AVENIR_TPU_SHARD", "AVENIR_TPU_ALLREDUCE_DIR")}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))]
+        + [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p])
+    env.update(extra)
+    return env
+
+
+def test_cli_two_process_sharded_rf_smoke(tmp_path):
+    """The CI smoke lane (satellite 5): two plain subprocesses (no
+    jax.distributed coordinator) run the sharded streaming RF build on a
+    tiny CSV through the real CLI; both must write models bit-identical
+    to a single-host run, and process 0's counter dump must pin the
+    Collectives group."""
+    from avenir_tpu.cli import run as cli_run
+    schema_path = tmp_path / "s.json"
+    schema_path.write_text(json.dumps(SCHEMA))
+    csv = _write_csv(tmp_path / "d.csv", n=400)
+    props = tmp_path / "rf.properties"
+    props.write_text(
+        "field.delim.regex=,\nfield.delim.out=,\n"
+        f"dtb.feature.schema.file.path={schema_path}\n"
+        "dtb.num.trees=3\ndtb.random.seed=7\n"
+        "dtb.max.depth.limit=3\ndtb.path.stopping.strategy=maxDepth\n"
+        "dtb.streaming.ingest=true\ndtb.streaming.block.rows=100\n")
+
+    # single-host reference, in-process
+    assert cli_run.main(["randomForestBuilder", f"-Dconf.path={props}",
+                         str(csv), str(tmp_path / "out_single")]) == 0
+    ref = [(tmp_path / "out_single" / f"tree_{i}.json").read_text()
+           for i in range(3)]
+
+    rdir = str(tmp_path / "reduce")
+    procs = []
+    for i in range(2):
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "avenir_tpu.cli.run",
+             "randomForestBuilder", f"-Dconf.path={props}",
+             "-Ddtb.streaming.shard=on",
+             str(csv), str(tmp_path / f"out_shard{i}")],
+            env=_cli_env({"AVENIR_TPU_SHARD": f"{i}/2",
+                          "AVENIR_TPU_ALLREDUCE_DIR": rdir}),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    outs = []
+    try:
+        for p in procs:
+            so, se = p.communicate(timeout=280)
+            assert p.returncode == 0, se[-3000:]
+            outs.append(so)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        raise
+    for i in range(2):
+        got = [(tmp_path / f"out_shard{i}" / f"tree_{t}.json").read_text()
+               for t in range(3)]
+        assert got == ref, f"shard {i} models != single-host"
+    # Collectives pinned through the job counter dump: 3 per-level
+    # all-reduces (root + 2 fused levels) + 1 row-count allgather
+    for so in outs:
+        assert "AllReduces=4" in so, so
+    # shard identity is emitted by shard 0 only (the cross-process
+    # counter sum must not inflate it)
+    assert "Count=2" in outs[0]
+    assert "Count=2" not in outs[1]
+
+
+def test_cli_knn_train_shard_single_process(tmp_path, one_device_ctx):
+    """nen.train.shard=true through the knnPipeline job: at shard count 1
+    the lock-step merge is the identity, predictions and counters match
+    the default path byte for byte, and the output lands as a global
+    part-r file."""
+    from avenir_tpu.cli import run as cli_run
+    rng = np.random.default_rng(7)
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+
+    def rows(n, seed):
+        r = np.random.default_rng(seed)
+        out = []
+        for i in range(n):
+            a = r.random() < 0.5
+            out.append(f"s{seed}_{i:03d},{r.normal(2 if a else 8, 1.0):.3f},"
+                       f"{'A' if a else 'B'}")
+        return out
+
+    (data_dir / "tr_train.csv").write_text("\n".join(rows(60, 21)))
+    (data_dir / "test.csv").write_text("\n".join(rows(20, 22)))
+    schema_path = tmp_path / "ks.json"
+    schema_path.write_text(json.dumps({"fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "x", "ordinal": 1, "dataType": "double", "feature": True,
+         "min": 0, "max": 10},
+        {"name": "label", "ordinal": 2, "dataType": "categorical",
+         "cardinality": ["A", "B"]}]}))
+    props = tmp_path / "knn.properties"
+    props.write_text(
+        "field.delim.regex=,\nfield.delim.out=,\n"
+        f"sts.same.schema.file.path={schema_path}\n"
+        "sts.base.set.split.prefix=tr\nnen.top.match.count=5\n"
+        "nen.kernel.function=none\nnen.validation.mode=true\n")
+    assert cli_run.main(["knnPipeline", f"-Dconf.path={props}",
+                         str(data_dir), str(tmp_path / "out_plain")]) == 0
+    assert cli_run.main(["knnPipeline", f"-Dconf.path={props}",
+                         "-Dnen.train.shard=true",
+                         str(data_dir), str(tmp_path / "out_shard")]) == 0
+    assert (tmp_path / "out_shard" / "part-r-00000").read_text() == \
+        (tmp_path / "out_plain" / "part-r-00000").read_text()
+
+
+def test_cli_shard_on_requires_multi_shard(tmp_path):
+    """dtb.streaming.shard=on outside a multi-shard run refuses instead of
+    silently training single-host."""
+    from avenir_tpu.cli.jobs import random_forest_builder
+    from avenir_tpu.core.config import Config
+    schema_path = tmp_path / "s.json"
+    schema_path.write_text(json.dumps(SCHEMA))
+    csv = _write_csv(tmp_path / "d.csv", n=50)
+    cfg = Config({"dtb.feature.schema.file.path": str(schema_path),
+                  "dtb.streaming.ingest": "true",
+                  "dtb.streaming.shard": "on"})
+    with pytest.raises(ValueError, match="multi-shard"):
+        random_forest_builder(cfg, csv, str(tmp_path / "out"))
+
+
+def test_cli_shard_on_without_streaming_ingest_refuses(tmp_path):
+    """dtb.streaming.shard=on without dtb.streaming.ingest must refuse
+    (the monolithic load_csv build cannot row-range shard), and a junk
+    knob value is rejected whether or not streaming is on."""
+    from avenir_tpu.cli.jobs import random_forest_builder
+    from avenir_tpu.core.config import Config
+    schema_path = tmp_path / "s.json"
+    schema_path.write_text(json.dumps(SCHEMA))
+    csv = _write_csv(tmp_path / "d.csv", n=50)
+    base = {"dtb.feature.schema.file.path": str(schema_path),
+            "dtb.num.trees": "1"}
+    with pytest.raises(ValueError, match="streaming.ingest"):
+        random_forest_builder(
+            Config(dict(base, **{"dtb.streaming.shard": "on"})),
+            csv, str(tmp_path / "out"))
+    with pytest.raises(ValueError, match="auto|on|off"):
+        random_forest_builder(
+            Config(dict(base, **{"dtb.streaming.shard": "yes"})),
+            csv, str(tmp_path / "out"))
+
+
+def test_reused_transport_dir_ignores_stale_payloads(tmp_path):
+    """Regression: a transport dir reused across sequential runs must not
+    serve run 1's leftover step files as run 2's partials.  Run 1 is a
+    single-exchange pair (the rolling reap keeps its step-0 files); run 2
+    reuses the dir with one participant delayed past the point where an
+    unguarded reader would have accepted the stale payload."""
+    import time as _time
+    rdir = str(tmp_path / "reduce")
+
+    def run(tag, values, delay_shard1=0.0):
+        out, errs = {}, {}
+
+        def worker(i):
+            try:
+                if i == 1 and delay_shard1:
+                    _time.sleep(delay_shard1)
+                red = AllReducer(spec=ShardSpec(i, 2), name="reuse",
+                                 transport_dir=rdir, timeout_s=60)
+                out[i] = red.sum(np.array(values[i], dtype=np.int64))
+            except Exception as exc:  # surface thread failures in the test
+                errs[i] = exc
+        ts = [threading.Thread(target=worker, args=(i,)) for i in range(2)]
+        [t.start() for t in ts]
+        [t.join(120) for t in ts]
+        assert not errs, errs
+        return out
+
+    first = run("r1", {0: [1, 2], 1: [10, 20]})
+    np.testing.assert_array_equal(first[0], [11, 22])
+    # run 1's single step leaves its step-0 payloads behind
+    leftovers = os.listdir(rdir)
+    assert any("-000000.1." in f for f in leftovers), leftovers
+    second = run("r2", {0: [3, 4], 1: [30, 40]}, delay_shard1=1.5)
+    for i in range(2):
+        np.testing.assert_array_equal(second[i], [33, 44])
+
+
+def test_dist_mode_distinct_inputs_with_row_range_shard_refuse(
+        tmp_path, monkeypatch):
+    """Under jax.distributed, dtb.streaming.shard assumes ONE shared
+    input: distinct per-process shard files must refuse (each process
+    would row-range split its OWN file and silently drop rows), while
+    identical inputs stand the identical-shard refusal down."""
+    from avenir_tpu.cli import jobs, run as cli_run
+    from avenir_tpu.core.config import Config
+    import avenir_tpu.parallel.distributed as dist
+    csv = _write_csv(tmp_path / "d.csv", n=20)
+    cfg = Config({"dtb.streaming.ingest": "true"})
+    fn = jobs.resolve("randomForestBuilder")
+    monkeypatch.setattr(dist, "is_multiprocess", lambda: True)
+    monkeypatch.setattr(dist, "allgather_object",
+                        lambda obj: [obj, (obj[0], "other-digest")])
+    with pytest.raises(RuntimeError, match="DISTINCT"):
+        cli_run._apply_dist_mode(fn, "randomForestBuilder", csv, cfg)
+    # identical digests: the sanctioned shared-input layout passes through
+    monkeypatch.setattr(dist, "allgather_object", lambda obj: [obj, obj])
+    assert cli_run._apply_dist_mode(
+        fn, "randomForestBuilder", csv, cfg) == (csv, None)
+    # and with sharding off, distinct inputs are the per-process-shard
+    # layout and pass through unchanged
+    monkeypatch.setattr(dist, "allgather_object",
+                        lambda obj: [obj, (obj[0], "other-digest")])
+    cfg_off = Config({"dtb.streaming.ingest": "true",
+                      "dtb.streaming.shard": "off"})
+    assert cli_run._apply_dist_mode(
+        fn, "randomForestBuilder", csv, cfg_off) == (csv, None)

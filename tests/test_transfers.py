@@ -33,15 +33,20 @@ def test_ledger_records_and_exports():
     led.record_h2d(50, transfers=2)
     led.record_d2h(30)
     led.record_dispatch(3)
+    led.record_allreduce(64)
     snap = led.snapshot()
     assert snap == {"h2d_bytes": 150, "d2h_bytes": 30, "h2d_transfers": 3,
-                    "d2h_transfers": 1, "dispatches": 3}
+                    "d2h_transfers": 1, "dispatches": 3,
+                    "allreduces": 1, "allreduce_bytes": 64}
     c = Counters()
     led.export(c)
     assert c.get("Transfers", "H2DBytes") == 150
     assert c.get("Transfers", "D2HBytes") == 30
     assert c.get("Transfers", "Dispatches") == 3
     assert c.group("Transfers")["H2DTransfers"] == 3
+    # collectives land in their OWN group, next to Transfers
+    assert c.group("Collectives") == {"AllReduces": 1,
+                                      "AllReduceBytes": 64}
 
 
 def test_ledger_scopes_nest_and_thread_records_land():
@@ -59,7 +64,8 @@ def test_ledger_scopes_nest_and_thread_records_land():
     assert inner.snapshot()["dispatches"] == 1
     assert outer.snapshot() == {"h2d_bytes": 16, "d2h_bytes": 0,
                                 "h2d_transfers": 3, "d2h_transfers": 0,
-                                "dispatches": 1}
+                                "dispatches": 1, "allreduces": 0,
+                                "allreduce_bytes": 0}
     # no active scope: recording helpers are no-ops
     note_h2d(1 << 30)
     assert outer.snapshot()["h2d_bytes"] == 16
