@@ -31,10 +31,13 @@ Config keys (reference-style, ``dm.`` namespace):
 Output: ``windowIndex,windowKind,scope,rowKind,nRows,psi,kl,js,ks,chi2,
 level`` rows (level = this window's immediate warn/alert standing;
 debounced alert records additionally land in ``<out>/alerts.jsonl`` and
-the counter dump, and the counters export as ``<out>/counters.json`` via
-``Counters.to_json``).  Report rows and alerts stream out per closed
-window; malformed records are skipped and tallied in the ``BadRecords``
-counter group rather than killing the replay.
+the counter dump).  The machine-readable counters land in the universal
+``<out>.counters.json`` SIBLING that ``cli.run`` writes for every job
+(r13) — the job no longer writes its own ``<out>/counters.json``, which
+duplicated the shared writer with a pre-ledger-export snapshot.  Report
+rows and alerts stream out per closed window; malformed records are
+skipped and tallied in the ``BadRecords`` counter group rather than
+killing the replay.
 """
 
 from __future__ import annotations
@@ -285,8 +288,8 @@ def drift_monitor(cfg: Config, in_path: str, out_path: str) -> Counters:
         if tracker is not None:
             tracker.close()
         drain(part_fh)
-    # machine-readable counters next to the report (Counters.to_json —
-    # the bench harness and operators consume this, not render() text)
-    with open(os.path.join(out_path, "counters.json"), "w") as fh:
-        fh.write(counters.to_json())
+    # machine-readable counters: the universal <out>.counters.json
+    # sibling cli.run writes for EVERY job (after the ledger/timer
+    # export, so it is the complete final dump) replaced the job-local
+    # <out>/counters.json this job used to write
     return counters

@@ -67,6 +67,151 @@ def file_sha(path: str, full: bool) -> str:
     return h.hexdigest()
 
 
+def write_counters_json(counters, out_path: Optional[str]) -> Optional[str]:
+    """The machine-readable half of the job's counter dump:
+    ``<out>.counters.json`` (``Counters.to_json`` bytes, tmp-then-rename
+    so a crash never leaves a torn file) NEXT TO the job output — a
+    sibling, never inside it: output dirs are consumed as inputs by
+    chained jobs and byte-pinned by the golden flows, so a metadata file
+    inside one would leak into the next stage's record stream.  EVERY
+    job gets it (the render() print and this file come from the same
+    writer); returns the path written, or None when the job has no
+    output path or the write failed (counter persistence must never fail
+    a finished job)."""
+    if not out_path:
+        return None
+    dest = f"{out_path.rstrip('/' + os.sep)}.counters.json"
+    tmp = f"{dest}.tmp-{os.getpid()}"
+    try:
+        with open(tmp, "w") as fh:
+            fh.write(counters.to_json())
+        os.replace(tmp, dest)
+    except OSError as exc:
+        print(f"[counters] could not persist {dest!r}: {exc}",
+              file=sys.stderr)
+        try:
+            os.unlink(tmp)   # never litter a torn .tmp next to the output
+        except OSError:
+            pass
+        return None
+    return dest
+
+
+def emit_counters(counters, out_path: Optional[str],
+                  persist: bool = True) -> None:
+    """The ONE counter emitter: print the Hadoop-style dump AND persist
+    counters.json next to the job output (previously only driftMonitor
+    persisted its counters; now every job's dump is diffable with
+    ``tools/tracetool.py counter-diff``).  ``persist=False`` keeps the
+    print without the file — the non-owner shards of the file-transport
+    smoke lane, whose shard-local counters racing one counters.json
+    would make the persisted dump nondeterministic."""
+    print(counters.render())
+    if persist:
+        write_counters_json(counters, out_path)
+
+
+def _telemetry_setup(cfg, job_name: str, in_path: Optional[str]):
+    """Install run-scoped telemetry from the ``telemetry.*`` config keys
+    (TPU_NOTES §21); returns ``(tracer, metrics_server, registry)``,
+    all None when telemetry is off (the default — spans no-op).
+
+      telemetry.trace.dir      span tracing: per-process JSONL + Chrome
+                               trace export into this directory
+      telemetry.run.id         trace file run id (default under a
+                               sharded run: derived from job+INPUT —
+                               the one path every shard of a row-range
+                               run shares — so all shards agree; set
+                               explicitly to keep multiple runs of the
+                               same input apart in one dir)
+      telemetry.metrics.port   /metrics + /healthz endpoint port (0 =
+                               ephemeral, printed to stderr)
+      telemetry.metrics.host   endpoint bind address (default 127.0.0.1;
+                               set 0.0.0.0 so a load balancer / probe on
+                               another host can reach /healthz)
+      telemetry.metrics.snapshot.s   background snapshot cadence
+                               (JSONL flight recorder next to the
+                               output; 0 = off — works without a port:
+                               the registry runs endpoint-less)
+
+    Env twins AVENIR_TPU_TRACE_EVENTS_DIR / AVENIR_TPU_METRICS_PORT /
+    AVENIR_TPU_METRICS_HOST / AVENIR_TPU_RUN_ID serve launchers that
+    cannot edit the conf."""
+    # `or None` twice: an empty config value OR an empty env var both
+    # mean 'unset' (a launcher exporting AVENIR_TPU_METRICS_PORT="" must
+    # leave telemetry off, not abort the job on int(""))
+    trace_dir = cfg.get("telemetry.trace.dir") or \
+        os.environ.get("AVENIR_TPU_TRACE_EVENTS_DIR") or None
+    port = cfg.get("telemetry.metrics.port") or \
+        os.environ.get("AVENIR_TPU_METRICS_PORT") or None
+    snap_s = cfg.get_float("telemetry.metrics.snapshot.s", 0.0)
+    if not trace_dir and port is None and snap_s <= 0:
+        return None, None, None
+    from .. import telemetry
+    from ..parallel.distributed import shard_spec
+    spec = shard_spec()
+    tracer = server = registry = None
+    if trace_dir:
+        run_id = cfg.get("telemetry.run.id") or \
+            os.environ.get("AVENIR_TPU_RUN_ID")
+        if not run_id:
+            import hashlib
+            short = job_name.split(".")[-1]
+            if spec.active:
+                # every shard must derive the IDENTICAL id, or the
+                # merged timeline falls apart — hash the job + the
+                # shared INPUT path (out dirs are per-shard in the
+                # smoke lane; the input is the one thing a row-range
+                # sharded run shares by contract)
+                run_id = short + "-" + hashlib.sha256(
+                    f"{job_name}|{in_path}".encode()).hexdigest()[:8]
+            else:
+                import time as _time
+                import uuid as _uuid
+                # pid+second is NOT unique (two main() calls in one
+                # process within a second would truncate each other's
+                # trace file) — a short random tail keeps runs apart
+                run_id = f"{short}-{_time.strftime('%Y%m%d%H%M%S')}" \
+                         f"-{os.getpid()}-{_uuid.uuid4().hex[:6]}"
+        tracer = telemetry.install_tracer(telemetry.Tracer(
+            trace_dir, run_id=run_id, process_index=spec.index))
+    if port is not None or snap_s > 0:
+        # snapshot.s without a port still gets a registry: the JSONL
+        # flight recorder must not silently require the endpoint too
+        try:
+            registry = telemetry.MetricsRegistry()
+            telemetry.set_default_registry(registry)
+            if port is not None:
+                host = cfg.get("telemetry.metrics.host") or \
+                    os.environ.get("AVENIR_TPU_METRICS_HOST") or \
+                    "127.0.0.1"
+                bind_port = int(port)
+                if bind_port != 0 and spec.active:
+                    # per-shard offset: a fixed port under a single-host
+                    # multi-process run would EADDRINUSE every shard but
+                    # one, abort the losers, and leave the survivor
+                    # wedged at its first collective — the exact hang
+                    # the stall detector exists to prevent.  Shard i
+                    # scrapes at port+i, deterministically.
+                    bind_port += spec.index
+                server = telemetry.MetricsServer(
+                    registry, port=bind_port, host=host).start()
+        except Exception:
+            # a failed endpoint start (port in use, bad port string) must
+            # not leak the process-global tracer/registry installed above
+            # into later in-process runs
+            telemetry.set_default_registry(None)
+            if tracer is not None:
+                telemetry.uninstall_tracer()
+                tracer.close()
+            raise
+        if server is not None:
+            print(f"[telemetry] metrics endpoint "
+                  f"http://{server.host}:{server.port}/metrics "
+                  f"(+ /healthz)", file=sys.stderr)
+    return tracer, server, registry
+
+
 def parse_args(argv: List[str]):
     job_name: Optional[str] = None
     conf_path: Optional[str] = None
@@ -290,9 +435,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     else:
         in_path = out_path = None
     spool_dir = None
+    tracer = metrics_server = registry = None
     try:
         # inside the try so a dist-mode refusal still runs the context
         # cleanup below (no hybrid-mesh leak into later in-process runs)
+        orig_in_path = in_path   # pre-spool: the run-id anchor must be
         in_path, spool_dir = _apply_dist_mode(fn, job_name, in_path, cfg)
         # job-level step accounting into the counters channel (the rebuild's
         # replacement for the Hadoop UI's job timing; SURVEY §5), plus an
@@ -300,7 +447,33 @@ def main(argv: Optional[List[str]] = None) -> int:
         # ledger (H2D/D2H bytes + dispatches at the instrumented hot paths)
         from ..utils.tracing import StepTimer, trace, transfer_ledger
         timer = StepTimer()
+        # run-scoped telemetry (span tracer, /metrics + /healthz endpoint)
+        # from the telemetry.* keys — off by default, spans no-op
+        # the argv-level path, not a per-process gather spool dir
+        tracer, metrics_server, registry = _telemetry_setup(
+            cfg, job_name, orig_in_path)
         with transfer_ledger() as ledger:
+            if registry is not None:
+                # live sources: /metrics mid-job shows the ledger and the
+                # step timer moving, not an end-of-job summary
+                registry.attach_ledger(ledger)
+                registry.attach_timer(timer)
+                snap_s = cfg.get_float("telemetry.metrics.snapshot.s", 0.0)
+                if snap_s > 0:
+                    # sibling of the output, like counters.json: never
+                    # write metadata INSIDE a dir later jobs consume.
+                    # Owner-only under a shard spec, also like
+                    # counters.json: shards sharing one out path must
+                    # not truncate and interleave one flight recorder
+                    from ..parallel.distributed import shard_spec as _ss
+                    _spec = _ss()
+                    own = not _spec.active or _spec.index == 0
+                    registry.start_snapshots(
+                        snap_s,
+                        snapshot_path=(
+                            f"{out_path.rstrip('/' + os.sep)}"
+                            f".metrics.jsonl"
+                            if out_path and own else None))
             with trace(cfg.get("profile.trace.dir") or
                        os.environ.get("AVENIR_TPU_TRACE_DIR")):
                 with timer.step("job"):
@@ -317,14 +490,34 @@ def main(argv: Optional[List[str]] = None) -> int:
             # summing would inflate each one by the process count.
             # Profiling times are exported AFTER the reduce — per-process
             # wall clock must not be summed across the pod.
-            from ..parallel.distributed import all_reduce_counters
+            from ..parallel.distributed import all_reduce_counters, \
+                shard_spec
             import jax
             if jobs.dist_mode(fn) != "gather":
                 counters = all_reduce_counters(counters)
             timer.export(counters)
+            if registry is not None:
+                registry.attach_counters(counters)
+            spec = shard_spec()
             if jax.process_index() == 0:
-                print(counters.render())
+                emit_counters(counters, out_path,
+                              persist=not spec.active or spec.index == 0)
     finally:
+        if registry is not None:
+            registry.stop_snapshots()
+        if metrics_server is not None:
+            metrics_server.stop()
+        if registry is not None:
+            from ..telemetry import set_default_registry
+            set_default_registry(None)
+        if tracer is not None:
+            from ..telemetry import uninstall_tracer
+            uninstall_tracer()
+            try:  # flush + Chrome export; telemetry must never fail a job
+                tracer.close()
+            except Exception as exc:
+                print(f"[telemetry] trace close failed: {exc}",
+                      file=sys.stderr)
         if spool_dir is not None:
             # gather spools hold a full copy of the global input; chained
             # pipelines must not accumulate them in tmp

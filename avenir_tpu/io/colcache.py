@@ -81,6 +81,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..core.faults import fault_point
+from ..telemetry import span
 
 FORMAT_VERSION = 1
 MAGIC = b"AVTC\x01"
@@ -762,8 +763,9 @@ def _serve_cached(reader: CacheReader, csv_path: str, schema, delim: str,
             break
         t0 = time.perf_counter()
         try:
-            chunk, bad_src, bad_lines, nbytes = reader.load_chunk(
-                idx, start_row=start_row, stop_row=stop_row)
+            with span("cache.chunk", cat="parse", chunk=idx):
+                chunk, bad_src, bad_lines, nbytes = reader.load_chunk(
+                    idx, start_row=start_row, stop_row=stop_row)
         except (CacheChunkError, OSError, ValueError, KeyError,
                 IndexError) as exc:
             if cache.policy == "require":

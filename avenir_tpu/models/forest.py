@@ -35,6 +35,7 @@ from ..core.schema import FeatureSchema
 from ..core.table import ColumnarTable
 from ..core.metrics import Counters
 from ..parallel.mesh import MeshContext, runtime_context
+from ..telemetry import span
 from ..utils.tracing import fetch, note_dispatch
 from .tree import (acc_counts, DecisionPath, DecisionPathList, DecisionTreeModel,
                    Predicate, TreeBuilder, TreeParams, level_chunk,
@@ -308,23 +309,26 @@ class ForestBuilder:
             n_nodes = max((len(a) for a in active), default=0)
             if n_nodes == 0:
                 break
-            if _level > 0:
-                # one fused launch: re-tag with last level's winners + count
-                node_ids, counts = self._level_fused(
-                    fused_k, node_ids, weights, sel_split, child_table,
-                    n_nodes)
-            sel_split = np.full((T, n_nodes), -1, dtype=np.int32)
-            child_table = np.full((T, n_nodes, B), -1, dtype=np.int32)
-            for t, b in enumerate(builders):
-                if not active[t]:
-                    leaves[t] = []
-                    continue
-                new_l, stopped, sel, ctab = b._choose_splits(
-                    active[t], counts[t, :len(active[t])])
-                finals[t].extend(stopped)
-                leaves[t] = new_l
-                sel_split[t, :len(sel)] = sel
-                child_table[t, :ctab.shape[0]] = ctab
+            with span("forest.level", cat="compute", level=_level,
+                      nodes=n_nodes):
+                if _level > 0:
+                    # one fused launch: re-tag with last level's winners +
+                    # count
+                    node_ids, counts = self._level_fused(
+                        fused_k, node_ids, weights, sel_split, child_table,
+                        n_nodes)
+                sel_split = np.full((T, n_nodes), -1, dtype=np.int32)
+                child_table = np.full((T, n_nodes, B), -1, dtype=np.int32)
+                for t, b in enumerate(builders):
+                    if not active[t]:
+                        leaves[t] = []
+                        continue
+                    new_l, stopped, sel, ctab = b._choose_splits(
+                        active[t], counts[t, :len(active[t])])
+                    finals[t].extend(stopped)
+                    leaves[t] = new_l
+                    sel_split[t, :len(sel)] = sel
+                    child_table[t, :ctab.shape[0]] = ctab
             if not any(leaves):
                 break
 

@@ -52,6 +52,7 @@ import jax.numpy as jnp
 from ..core.schema import FeatureSchema, FeatureField
 from ..core.table import ColumnarTable, stage_chunks
 from ..parallel.mesh import MeshContext, runtime_context
+from ..telemetry import span
 from ..utils.tracing import fetch, note_dispatch, note_h2d
 
 ROOT_PATH = "$root"
@@ -565,6 +566,16 @@ def _save_stream_checkpoint(mgr, blocks_done: int, br_parts, cls_parts,
     only the newest few and skip corrupt ones.  The host copies force a
     device sync — size the ``checkpoint_every`` stride so this stays a
     small fraction of ingest time."""
+    with span("checkpoint.write", cat="checkpoint", blocks=blocks_done,
+              rows=int(n_rows), complete=bool(complete)):
+        _save_stream_checkpoint_body(mgr, blocks_done, br_parts, cls_parts,
+                                     mask_parts, n_rows, source_rows_done,
+                                     complete, shard)
+
+
+def _save_stream_checkpoint_body(mgr, blocks_done, br_parts, cls_parts,
+                                 mask_parts, n_rows, source_rows_done,
+                                 complete, shard):
     arrays = {
         "branches": np.concatenate([np.asarray(p) for p in br_parts])
         if br_parts else np.zeros((0, 0), np.int32),
@@ -793,7 +804,9 @@ class TreeBuilder:
         for Xd, ccd, mask, bn, src_end in stage_chunks(
                 blocks, _stage, depth=2, stats=stats):
             t0 = _time.perf_counter()
-            br_parts.append(self.split_set.branch_codes(Xd))
+            with span("device.compute", cat="compute", block=blocks_done,
+                      rows=bn):
+                br_parts.append(self.split_set.branch_codes(Xd))
             cls_parts.append(ccd)
             mask_parts.append(mask)
             n_rows += bn
@@ -853,7 +866,8 @@ class TreeBuilder:
         # the streamed state never keeps the feature matrix: branch codes
         # are the only per-record view any level kernel reads
         self.X = None
-        jax.block_until_ready((self.branches, self.cls_codes))
+        with span("device.compute", cat="compute", phase="final_sync"):
+            jax.block_until_ready((self.branches, self.cls_codes))
         t_compute += _time.perf_counter() - t0
         if stats is not None:
             stats["ingest_compute_s"] = (stats.get("ingest_compute_s", 0.0)

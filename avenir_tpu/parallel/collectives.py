@@ -44,6 +44,8 @@ Where each idiom runs in production:
 from __future__ import annotations
 
 import functools
+import threading
+import time
 from typing import Any, Callable, Dict, Optional
 
 import numpy as np
@@ -56,6 +58,7 @@ try:
 except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
 
+from ..telemetry import instant, span
 from .mesh import MeshContext
 
 
@@ -231,7 +234,8 @@ class AllReducer:
 
     def __init__(self, spec=None, name: str = "reduce",
                  transport_dir: Optional[str] = None,
-                 timeout_s: Optional[float] = None):
+                 timeout_s: Optional[float] = None,
+                 heartbeat_s: Optional[float] = None):
         from .distributed import shard_spec
         import os
         self.spec = spec if spec is not None else shard_spec()
@@ -243,6 +247,15 @@ class AllReducer:
         self.timeout_s = float(
             timeout_s if timeout_s is not None
             else os.environ.get("AVENIR_TPU_ALLREDUCE_TIMEOUT_S", 300.0))
+        # stall heartbeat (AVENIR_TPU_STALL_HEARTBEAT_S): well BEFORE the
+        # hard timeout, a wait that exceeds this emits a structured
+        # ``allreduce.stall`` telemetry event + warning NAMING the shards
+        # whose partials are missing — a stalled shard becomes a
+        # diagnosable event instead of a silent hang (<=0 disables)
+        self.heartbeat_s = float(
+            heartbeat_s if heartbeat_s is not None
+            else os.environ.get("AVENIR_TPU_STALL_HEARTBEAT_S",
+                                min(self.timeout_s / 4.0, 15.0)))
         self.dir = transport_dir or os.environ.get(
             "AVENIR_TPU_ALLREDUCE_DIR")
         if self.spec.count == 1:
@@ -265,6 +278,92 @@ class AllReducer:
         self._nonce = uuid.uuid4().hex   # this run's identity on the wire
         self._peers = None         # idx -> nonce, set by _ensure_handshake
 
+    # ---- stall detection (the heartbeat half of the observability
+    # contract: a dead peer is NAMED long before the hard timeout) ----
+    def _emit_stall(self, phase: str, step: int, missing,
+                    waited_s: float, on_thread=None) -> None:
+        """One structured stall record: an ``allreduce.stall`` telemetry
+        instant (when a tracer is installed) + a warning.  ``missing`` is
+        the shard indices whose partials have not appeared (None when the
+        transport cannot see per-peer progress, e.g. inside a device
+        collective).  ``on_thread`` pins the trace event to the BLOCKED
+        caller's lane when the emitter is a watchdog Timer thread."""
+        import warnings
+        missing_list = None if missing is None else sorted(missing)
+        instant("allreduce.stall", cat="collective", on_thread=on_thread,
+                reducer=self.name,
+                transport=self.transport, phase=phase, step=int(step),
+                shard=self.spec.index, count=self.spec.count,
+                missing_shards=missing_list,
+                waited_s=round(float(waited_s), 3),
+                timeout_s=self.timeout_s)
+        who = ("an unknown peer (transport cannot see per-shard progress)"
+               if missing_list is None else
+               f"shard(s) {missing_list}")
+        warnings.warn(
+            f"AllReducer[{self.name}] stall at {phase} step {step}: shard "
+            f"{self.spec.index}/{self.spec.count} has waited "
+            f"{waited_s:.1f}s for {who} (heartbeat {self.heartbeat_s}s, "
+            f"hard timeout {self.timeout_s}s)", RuntimeWarning)
+
+    def _watchdog(self, phase: str):
+        """Context manager arming a one-shot stall timer around a
+        transport call that blocks opaquely (the jax.distributed device
+        psum / pickle allgather): if the collective has not completed
+        within ``heartbeat_s`` a stall event fires — the transport cannot
+        name the missing shard, but the operator learns WHICH collective
+        wedged and when."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def arm():
+            if self.transport != "jax" or self.heartbeat_s <= 0:
+                yield
+                return
+            # the jax transport never goes through _file_exchange, so the
+            # op ordinal is counted here — a stall report must say WHICH
+            # collective of the run wedged, not "step 0" every time
+            step = self._step
+            self._step += 1
+            done = threading.Event()
+            t0 = time.monotonic()
+            # the Timer fires on its own ephemeral thread — the stall
+            # marker must land on the lane of the thread that is BLOCKED
+            # in the collective, not a one-event Thread-N lane per stall
+            caller = threading.current_thread()
+
+            def bark():
+                if not done.is_set():
+                    self._emit_stall(phase, step, None,
+                                     time.monotonic() - t0,
+                                     on_thread=caller)
+            timer = threading.Timer(self.heartbeat_s, bark)
+            timer.daemon = True
+            timer.start()
+            try:
+                yield
+            finally:
+                done.set()
+                timer.cancel()
+        return arm()
+
+    def _probe_missing(self, stem: str):
+        """Which peers have NOT yet produced a readable, current-run
+        payload file for ``stem`` — the stall event's missing-shard set."""
+        import pickle
+        missing = []
+        for j in range(self.spec.count):
+            if j == self.spec.index:
+                continue
+            try:
+                with open(self._fpath(stem, j), "rb") as fh:
+                    if self._peers is not None and \
+                            pickle.load(fh) != self._peers[j]:
+                        missing.append(j)
+            except (OSError, EOFError, pickle.UnpicklingError):
+                missing.append(j)
+        return missing
+
     # ---- public ops (each is ONE collective) ----
     def sum(self, arr: np.ndarray) -> np.ndarray:
         """Element-wise sum of a same-shaped per-process partial, exact in
@@ -272,15 +371,19 @@ class AllReducer:
         from ..utils.tracing import note_allreduce
         arr = np.asarray(arr)
         note_allreduce(arr.nbytes)
-        if self.transport == "local":
-            return arr
-        if self.transport == "file":
-            parts = self._file_exchange(arr)
-            out = parts[0].copy()
-            for p in parts[1:]:
-                out += p
-            return out
-        return self._jax_sum(arr)
+        with span("allreduce.sum", cat="collective", reducer=self.name,
+                  transport=self.transport, nbytes=int(arr.nbytes),
+                  shard=self.spec.index):
+            if self.transport == "local":
+                return arr
+            if self.transport == "file":
+                parts = self._file_exchange(arr)
+                out = parts[0].copy()
+                for p in parts[1:]:
+                    out += p
+                return out
+            with self._watchdog("sum"):
+                return self._jax_sum(arr)
 
     def allgather(self, obj):
         """Per-process list of ``obj`` in shard order.  One collective.
@@ -292,13 +395,20 @@ class AllReducer:
         import pickle
         if self.transport == "local":
             note_allreduce(0)
-            return [obj]
+            with span("allreduce.allgather", cat="collective",
+                      reducer=self.name, transport=self.transport,
+                      shard=self.spec.index):
+                return [obj]
         buf = pickle.dumps(obj)
         note_allreduce(len(buf))
-        if self.transport == "file":
-            return self._file_exchange(obj, pickled=buf)
-        from .distributed import allgather_object
-        return [pickle.loads(b) for b in allgather_object(buf)]
+        with span("allreduce.allgather", cat="collective",
+                  reducer=self.name, transport=self.transport,
+                  nbytes=len(buf), shard=self.spec.index):
+            if self.transport == "file":
+                return self._file_exchange(obj, pickled=buf)
+            from .distributed import allgather_object
+            with self._watchdog("allgather"):
+                return [pickle.loads(b) for b in allgather_object(buf)]
 
     def merge_topk(self, nd: np.ndarray, ni: np.ndarray, k: int):
         """Merge per-shard running nearest-k lists — the lock-step KNN
@@ -310,6 +420,11 @@ class AllReducer:
         fused scan already orders ties that way, shards concatenate in
         ascending index-range order, and the stable sort preserves it —
         exactly the single-host full-matrix argsort semantics."""
+        with span("allreduce.merge_topk", cat="collective",
+                  reducer=self.name, shard=self.spec.index, k=int(k)):
+            return self._merge_topk(nd, ni, k)
+
+    def _merge_topk(self, nd: np.ndarray, ni: np.ndarray, k: int):
         parts = self.allgather((np.asarray(nd), np.asarray(ni)))
         if len(parts) == 1:
             return nd, ni
@@ -381,15 +496,25 @@ class AllReducer:
             fh.write(body)
         os.replace(tmp, path)
 
-    def _fread_wait(self, path: str, deadline: float, what: str):
+    def _fread_wait(self, path: str, deadline: float, what: str,
+                    missing_shard: Optional[int] = None,
+                    phase: str = "handshake"):
         import pickle
-        import time
+        t_start = time.monotonic()
+        hb_next = t_start + self.heartbeat_s if self.heartbeat_s > 0 \
+            else None
         while True:
             try:
                 with open(path, "rb") as fh:
                     return pickle.load(fh)
             except (OSError, EOFError, pickle.UnpicklingError):
-                if time.monotonic() > deadline:
+                now = time.monotonic()
+                if hb_next is not None and now >= hb_next and \
+                        missing_shard is not None:
+                    self._emit_stall(phase, self._step, [missing_shard],
+                                     now - t_start)
+                    hb_next = now + self.heartbeat_s
+                if now > deadline:
                     raise RuntimeError(
                         f"AllReducer[{self.name}]: {what} never appeared "
                         f"at {path!r} within {self.timeout_s}s")
@@ -428,22 +553,34 @@ class AllReducer:
         deadline = time.monotonic() + self.timeout_s
         self._peers = {
             j: self._fread_wait(self._fpath("hello-a", j), deadline,
-                                f"shard {j}'s announce")
+                                f"shard {j}'s announce", missing_shard=j)
             for j in range(self.spec.count)}
         self._fwrite(self._fpath("hello-b", i),
                      (self._nonce, dict(self._peers)))
         for j in range(self.spec.count):
+            # own heartbeat for the ack spin: a READABLE hello-b echoing
+            # a stale nonce (peer crashed after echoing a prior run)
+            # returns from _fread_wait instantly, so ITS heartbeat never
+            # fires — without this the wait is silent to the hard timeout
+            t_ack = time.monotonic()
+            hb_next = t_ack + self.heartbeat_s if self.heartbeat_s > 0 \
+                else None
             while True:
                 nonce_j, echo = self._fread_wait(
                     self._fpath("hello-b", j), deadline,
-                    f"shard {j}'s acknowledgment")
+                    f"shard {j}'s acknowledgment", missing_shard=j)
                 if nonce_j != self._peers[j]:
                     self._peers[j] = nonce_j
                     self._fwrite(self._fpath("hello-b", i),
                                  (self._nonce, dict(self._peers)))
                 if echo.get(self.spec.index) == self._nonce:
                     break
-                if time.monotonic() > deadline:
+                now = time.monotonic()
+                if hb_next is not None and now >= hb_next:
+                    self._emit_stall("handshake", self._step, [j],
+                                     now - t_ack)
+                    hb_next = now + self.heartbeat_s
+                if now > deadline:
                     raise RuntimeError(
                         f"AllReducer[{self.name}] handshake: shard {j} "
                         f"never acknowledged this run within "
@@ -473,7 +610,10 @@ class AllReducer:
             except OSError:
                 pass
         parts = []
-        deadline = time.monotonic() + self.timeout_s
+        t_start = time.monotonic()
+        deadline = t_start + self.timeout_s
+        hb_next = t_start + self.heartbeat_s if self.heartbeat_s > 0 \
+            else None
         for idx in range(self.spec.count):
             p = self._fpath(stem, idx)
             while True:
@@ -486,7 +626,16 @@ class AllReducer:
                         parts.append(pickle.load(fh))
                     break
                 except (OSError, EOFError, pickle.UnpicklingError):
-                    if time.monotonic() > deadline:
+                    now = time.monotonic()
+                    if hb_next is not None and now >= hb_next:
+                        # name EVERY peer still missing at this instant,
+                        # not just the one this loop happens to be on —
+                        # the operator needs the full set of suspects
+                        self._emit_stall("exchange", step,
+                                         self._probe_missing(stem),
+                                         now - t_start)
+                        hb_next = now + self.heartbeat_s
+                    if now > deadline:
                         raise RuntimeError(
                             f"AllReducer[{self.name}] step {step}: shard "
                             f"{idx} never produced {p!r} within "

@@ -42,6 +42,7 @@ from typing import Dict, List, Optional, Sequence
 
 from ..core.faults import with_retry
 from ..core.metrics import Counters
+from ..telemetry import get_default_registry, span
 from ..utils.tracing import StepTimer
 from .predictor import AMBIGUOUS, DEFAULT_BUCKETS, Predictor, make_predictor
 from .registry import ModelRegistry
@@ -84,7 +85,8 @@ class PredictionService:
                  delim: str = ",",
                  ambiguous_label: str = AMBIGUOUS,
                  error_label: str = "error",
-                 monitor=None):
+                 monitor=None,
+                 metrics=None):
         if predictor is None and (registry is None or model_name is None):
             raise ValueError("need a predictor, or registry= + model_name=")
         self.registry = registry
@@ -117,6 +119,18 @@ class PredictionService:
         self._queue: "queue.Queue[_Request]" = queue.Queue()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # rows currently inside a device predict (for the in-flight gauge
+        # and stats(); the lock is a few adds per multi-row batch)
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        # metrics integration: bind queue-depth/in-flight gauges + the
+        # /healthz provider onto the given MetricsRegistry, defaulting to
+        # the process registry cli.run installs when the job opened a
+        # telemetry.metrics.port endpoint (None = unmetered)
+        self._metrics_binding = None
+        reg = metrics if metrics is not None else get_default_registry()
+        if reg is not None:
+            self.bind_metrics(reg)
 
     # ---- model lifecycle ----
     def _load(self, must: bool = False) -> Optional[Predictor]:
@@ -162,9 +176,117 @@ class PredictionService:
     def mark_degraded(self, reason: str) -> None:
         """Flag the served model as degraded (drift policy guardrail).
         Serving continues — the flag and counter are the operator
-        signal; a successful :meth:`refresh` hot-swap clears it."""
+        signal; a successful :meth:`refresh` hot-swap clears it.  The
+        flip is also an instant trace event and turns ``/healthz``
+        non-OK, so the load balancer sees it too."""
         self.degraded = reason
         self.counters.increment("Serving", "Degraded")
+        from ..telemetry import instant
+        instant("serving.degraded", cat="serving", reason=reason,
+                model_version=self.version)
+
+    # ---- observability snapshot (the /healthz + /metrics source) ----
+    def stats(self) -> Dict:
+        """One consistent-enough snapshot of the serving loop's state:
+        queue depth (requests accepted, not yet drained), in-flight rows
+        (inside a device predict right now), served/error/batch counts,
+        hot-swaps, the degraded reason (None = healthy) and the model
+        version.  Cheap — counter reads and a qsize — so probes and the
+        ``/healthz`` handler can call it on every scrape."""
+        with self._inflight_lock:
+            inflight = self._inflight
+        return {
+            "queue_depth": self._queue.qsize(),
+            "in_flight": inflight,
+            "served": self.counters.get("Serving", "Requests"),
+            "errors": self.counters.get("Serving", "BadRequests"),
+            "batches": self.counters.get("Serving", "Batches"),
+            "hot_swaps": self.counters.get("Serving", "HotSwaps"),
+            "degraded": self.degraded,
+            "model_version": self.version,
+        }
+
+    def health(self):
+        """Health-provider contract (``telemetry.MetricsRegistry
+        .add_health``): (ok, payload).  OK == not degraded; the payload
+        is :meth:`stats`, so the 503 body tells the operator WHY."""
+        st = self.stats()
+        st["degraded"] = st["degraded"] or ""
+        return self.degraded is None, st
+
+    def bind_metrics(self, registry) -> None:
+        """Register this service's gauges + health on a
+        ``telemetry.MetricsRegistry``: queue depth, in-flight rows,
+        served/error totals, degraded flag, model version, and latency
+        percentiles from the request timer — everything the acceptance
+        load balancer and autoscaler read."""
+        # every series carries the service identity (same key the health
+        # provider uses): two services bound to one registry — several
+        # models in one process — write DISJOINT labeled series instead
+        # of last-probe-wins clobbering each other's numbers
+        # one binding at a time: an explicit bind on a service that
+        # already auto-bound (constructed under cli.run's default
+        # registry) must release the old probe/health first, or stop()
+        # would only ever unbind the LAST one and the first probe would
+        # pin this service in the registry forever
+        self._unbind_metrics()
+        # two services must not share one identity on one registry (two
+        # UNNAMED ones would both be 'predictor'): add_health would
+        # silently overwrite one's health provider, their probes would
+        # clobber one label series, and either stop() would drop the
+        # survivor's gauges.  Uniquify against the registry's live
+        # health keys — own key was just unbound above, so rebinding
+        # the SAME service reclaims its label.
+        base = self.model_name or "predictor"
+        svc_label, n = base, 1
+        while registry.has_health(f"serving:{svc_label}"):
+            svc_label = f"{base}-{n}"
+            n += 1
+        g = registry.gauge("avenir_serving", "prediction service state",
+                           labels=("service", "key"))
+        gl = registry.gauge("avenir_serving_latency_ms",
+                            "serving latency percentiles",
+                            labels=("service", "step", "quantile"))
+
+        def probe():
+            st = self.stats()
+            g.set(st["queue_depth"], service=svc_label, key="queue_depth")
+            g.set(st["in_flight"], service=svc_label, key="in_flight")
+            g.set(st["served"], service=svc_label, key="served")
+            g.set(st["errors"], service=svc_label, key="errors")
+            g.set(st["batches"], service=svc_label, key="batches")
+            g.set(st["hot_swaps"], service=svc_label, key="hot_swaps")
+            g.set(0 if st["degraded"] is None else 1,
+                  service=svc_label, key="degraded")
+            g.set(st["model_version"] or 0,
+                  service=svc_label, key="model_version")
+            for step in ("serve.request", "serve.batch"):
+                if self.timer.samples.get(step):
+                    for q in (50, 95, 99):
+                        gl.set(self.timer.percentile_ms(step, q),
+                               service=svc_label, step=step,
+                               quantile=f"p{q}")
+        registry.register_probe(probe)
+        health_key = f"serving:{svc_label}"
+        registry.add_health(health_key, self.health)
+        # remembered so stop() can unbind: a retired service must not be
+        # probed (and thereby pinned in memory, predictor and all) by
+        # every scrape for the rest of the process
+        self._metrics_binding = (registry, probe, health_key,
+                                 (g, gl), svc_label)
+
+    def _unbind_metrics(self) -> None:
+        if self._metrics_binding is not None:
+            reg, probe, health_key, families, svc_label = \
+                self._metrics_binding
+            self._metrics_binding = None
+            reg.unregister_probe(probe)
+            reg.remove_health(health_key)
+            # drop the bound label series too: without this, the dead
+            # service's last-written gauges (degraded=1, queue_depth, …)
+            # keep rendering in every later scrape as if they were live
+            for fam in families:
+                fam.drop_series(service=svc_label)
 
     # ---- prediction ----
     def _label(self, pred: Optional[str]) -> str:
@@ -177,8 +299,9 @@ class PredictionService:
         with self._swap_lock:
             pred = self.predictor
         t0 = time.perf_counter()
-        out = with_retry(lambda: pred.predict_rows(rows),
-                         what="serving predict batch")
+        with span("serve.predict", cat="serving", rows=len(rows)):
+            out = with_retry(lambda: pred.predict_rows(rows),
+                             what="serving predict batch")
         self.timer.record("serve.batch", time.perf_counter() - t0)
         self.counters.increment("Serving", "Requests", len(rows))
         self.counters.increment("Serving", "Batches")
@@ -194,32 +317,38 @@ class PredictionService:
         the Batches count or the serve.batch samples operators tune
         BatchPolicy with."""
         import warnings
+        with self._inflight_lock:
+            self._inflight += len(rows)
         try:
-            results = [("ok", lab) for lab in self.predict_rows(rows)]
-            self._record_monitor(rows, results)
-            return results
-        except Exception as exc:
-            warnings.warn(
-                f"serving: batch predict failed ({type(exc).__name__}: "
-                f"{exc}); isolating per row", RuntimeWarning)
-        with self._swap_lock:
-            pred = self.predictor
-        t0 = time.perf_counter()
-        out = []
-        for row in rows:
             try:
-                lab = with_retry(lambda r=row: pred.predict_rows([r]),
-                                 what="serving predict row")[0]
-                out.append(("ok", self._label(lab)))
+                results = [("ok", lab) for lab in self.predict_rows(rows)]
+                self._record_monitor(rows, results)
+                return results
             except Exception as exc:
-                self.counters.increment("Serving", "BadRequests")
-                out.append(("err", exc))
-        self.timer.record("serve.batch", time.perf_counter() - t0)
-        self.counters.increment("Serving", "Requests", len(rows))
-        self.counters.increment("Serving", "Batches")
-        self.counters.increment("Serving", "IsolatedBatches")
-        self._record_monitor(rows, out)
-        return out
+                warnings.warn(
+                    f"serving: batch predict failed ({type(exc).__name__}: "
+                    f"{exc}); isolating per row", RuntimeWarning)
+            with self._swap_lock:
+                pred = self.predictor
+            t0 = time.perf_counter()
+            out = []
+            for row in rows:
+                try:
+                    lab = with_retry(lambda r=row: pred.predict_rows([r]),
+                                     what="serving predict row")[0]
+                    out.append(("ok", self._label(lab)))
+                except Exception as exc:
+                    self.counters.increment("Serving", "BadRequests")
+                    out.append(("err", exc))
+            self.timer.record("serve.batch", time.perf_counter() - t0)
+            self.counters.increment("Serving", "Requests", len(rows))
+            self.counters.increment("Serving", "Batches")
+            self.counters.increment("Serving", "IsolatedBatches")
+            self._record_monitor(rows, out)
+            return out
+        finally:
+            with self._inflight_lock:
+                self._inflight -= len(rows)
 
     def _record_monitor(self, rows, results) -> None:
         """Feed successfully answered (row, label) pairs to the drift
@@ -258,17 +387,18 @@ class PredictionService:
         ids: List[str] = []
         rows: List[List[str]] = []
         reload_requested = False
-        for message in messages:
-            parts = message.split(self.delim)
-            if parts[0] == "predict" and len(parts) >= 3:
-                ids.append(parts[1])
-                rows.append(parts[2:])
-            elif parts[0] == "reload":
-                reload_requested = True
-            else:
-                self.counters.increment("Serving", "BadRequests")
-                warnings.warn(f"serving: dropping malformed message "
-                              f"{message!r}", RuntimeWarning)
+        with span("serve.assemble", cat="serving", rows=len(messages)):
+            for message in messages:
+                parts = message.split(self.delim)
+                if parts[0] == "predict" and len(parts) >= 3:
+                    ids.append(parts[1])
+                    rows.append(parts[2:])
+                elif parts[0] == "reload":
+                    reload_requested = True
+                else:
+                    self.counters.increment("Serving", "BadRequests")
+                    warnings.warn(f"serving: dropping malformed message "
+                                  f"{message!r}", RuntimeWarning)
         if reload_requested and not rows:
             self.refresh()
             return []
@@ -277,11 +407,12 @@ class PredictionService:
         t0 = time.perf_counter()
         results = self._predict_isolating(rows)
         dt = time.perf_counter() - t0
-        out = []
-        for rid, (status, val) in zip(ids, results):
-            self.timer.record("serve.request", dt)
-            lab = val if status == "ok" else self.error_label
-            out.append(f"{rid}{self.delim}{lab}")
+        with span("serve.reply", cat="serving", rows=len(rows)):
+            out = []
+            for rid, (status, val) in zip(ids, results):
+                self.timer.record("serve.request", dt)
+                lab = val if status == "ok" else self.error_label
+                out.append(f"{rid}{self.delim}{lab}")
         if reload_requested:
             self.refresh()
         return out
@@ -307,6 +438,9 @@ class PredictionService:
     def stop(self, drain_s: float = 5.0) -> None:
         """Stop the worker; queued requests are still served (bounded by
         ``drain_s``) so no accepted request is dropped on shutdown."""
+        # unbind from the registry whether or not the worker ran: a
+        # stopped service must not be probed by every later scrape
+        self._unbind_metrics()
         if self._thread is None:
             return
         self._stop.set()
@@ -330,37 +464,41 @@ class PredictionService:
             except queue.Empty:
                 continue
             batch = [first]
-            # free coalescing first: whatever queued while the previous
-            # batch was on device joins this one with zero added wait
-            while len(batch) < pol.max_batch:
-                try:
-                    batch.append(self._queue.get_nowait())
-                except queue.Empty:
-                    break
-            # then hold the window open for stragglers — bounded by the
-            # FIRST request's age, so the policy's latency promise holds
-            # even when the window was already spent in the backlog
-            deadline = first.t_submit + pol.max_wait_ms / 1000.0
-            while len(batch) < pol.max_batch:
-                remaining = deadline - time.perf_counter()
-                if remaining <= 0:
-                    break
-                try:
-                    batch.append(self._queue.get(timeout=remaining))
-                except queue.Empty:
-                    break
+            with span("serve.assemble", cat="serving") as sp:
+                # free coalescing first: whatever queued while the previous
+                # batch was on device joins this one with zero added wait
+                while len(batch) < pol.max_batch:
+                    try:
+                        batch.append(self._queue.get_nowait())
+                    except queue.Empty:
+                        break
+                # then hold the window open for stragglers — bounded by
+                # the FIRST request's age, so the policy's latency promise
+                # holds even when the window was already spent in the
+                # backlog
+                deadline = first.t_submit + pol.max_wait_ms / 1000.0
+                while len(batch) < pol.max_batch:
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        break
+                    try:
+                        batch.append(self._queue.get(timeout=remaining))
+                    except queue.Empty:
+                        break
+                sp.add(rows=len(batch))
             self._serve(batch)
 
     def _serve(self, batch: List[_Request]) -> None:
         results = self._predict_isolating([r.row for r in batch])
         now = time.perf_counter()
-        for r, (status, val) in zip(batch, results):
-            if r.future.set_running_or_notify_cancel():
-                if status == "ok":
-                    self.timer.record("serve.request", now - r.t_submit)
-                    r.future.set_result(val)
-                else:  # answer with the error, don't wedge the waiter
-                    r.future.set_exception(val)
+        with span("serve.reply", cat="serving", rows=len(batch)):
+            for r, (status, val) in zip(batch, results):
+                if r.future.set_running_or_notify_cancel():
+                    if status == "ok":
+                        self.timer.record("serve.request", now - r.t_submit)
+                        r.future.set_result(val)
+                    else:  # answer with the error, don't wedge the waiter
+                        r.future.set_exception(val)
         self.counters.set("Serving", "MaxBatchObserved",
                           max(len(batch),
                               self.counters.get("Serving",

@@ -1,0 +1,61 @@
+"""Unified runtime telemetry (TPU_NOTES §21).
+
+Three layers, all off by default and cheap when off:
+
+* **span tracing** (:mod:`.trace`) — a per-run :class:`Tracer` buffering
+  lightweight ``span(stage, **attrs)`` events from every pipeline stage
+  (CSV/colcache parse, H2D staging, per-level device compute, AllReducer
+  waits, checkpoint writes, serving assemble/predict/reply) into a
+  per-process JSONL trace file whose lines ARE Chrome trace events —
+  one lane per thread, merged across shards by ``tools/tracetool.py``
+  into a catapult JSON timeline.  With no tracer installed, ``span()``
+  returns a shared null context manager: one global read per call site.
+
+* **metrics** (:mod:`.metrics`) — a :class:`MetricsRegistry` unifying
+  the Counters/TransferLedger/StepTimer exports behind one
+  counters/gauges/histograms API with probe-driven refresh, a background
+  snapshot thread, and Prometheus text exposition.
+
+* **serving endpoint** (:mod:`.server`) — :class:`MetricsServer`, a
+  stdlib ``http.server`` daemon thread exposing ``/metrics`` (Prometheus
+  text) and ``/healthz`` (aggregate of the registry's health providers,
+  503 when any is degraded) so a load balancer can see a degraded
+  worker.
+
+Collective stall detection lives with the transports
+(``parallel.collectives.AllReducer``): a heartbeat deadline emits a
+structured ``allreduce.stall`` instant event (through :func:`instant`)
+naming the missing shard(s) long before the hard timeout.
+"""
+
+from .trace import (NULL_SPAN, Tracer, current_tracer, install_tracer,
+                    instant, merge_trace_files, span, uninstall_tracer,
+                    validate_trace_events)
+
+# metrics/server are LAZY (PEP 562): every hot module (table, tree,
+# forest, colcache, collectives) imports span()/instant() from here for
+# the off-by-default no-op path, and must not drag http.server /
+# socketserver / the registry machinery into every process start
+_LAZY = {
+    "MetricsRegistry": ".metrics",
+    "get_default_registry": ".metrics",
+    "set_default_registry": ".metrics",
+    "MetricsServer": ".server",
+}
+
+
+def __getattr__(name: str):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    from importlib import import_module
+    return getattr(import_module(mod, __name__), name)
+
+
+__all__ = [
+    "Tracer", "span", "instant", "install_tracer", "uninstall_tracer",
+    "current_tracer", "NULL_SPAN", "validate_trace_events",
+    "merge_trace_files", "MetricsRegistry", "set_default_registry",
+    "get_default_registry", "MetricsServer",
+]

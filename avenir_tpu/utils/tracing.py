@@ -254,6 +254,16 @@ class StepTimer:
         return {q: self.percentile_ms(name, q) for q in qs}
 
     def export(self, counters, group: str = "Profiling") -> None:
+        """Export into the job Counters channel.  The key-set CONTRACT
+        (pinned by tests/test_telemetry.py): every recorded step name
+        exports exactly ``<name>.timeMs`` and ``<name>.calls``; steps
+        with a non-empty percentile sample window (``keep_samples > 0``
+        AND at least one ``record()``) additionally export
+        ``<name>.p50Us``, ``<name>.p95Us`` and ``<name>.p99Us`` — all
+        three or none.  With ``keep_samples=0`` the p* keys are ABSENT
+        (not zero): a dashboard must distinguish 'percentiles not
+        collected' from 'p99 == 0µs', so the timer never fabricates
+        zeros for quantiles it did not measure."""
         for name, total in sorted(self.totals.items()):
             counters.set(group, f"{name}.timeMs", int(round(total * 1000)))
             counters.set(group, f"{name}.calls", self.calls[name])
@@ -272,8 +282,11 @@ class StepTimer:
 @contextlib.contextmanager
 def trace(log_dir: Optional[str]) -> Iterator[bool]:
     """XLA profiler capture into ``log_dir`` (viewable with tensorboard /
-    xprof).  Yields whether capture is actually active; a None dir or an
-    unsupported backend degrades to a no-op."""
+    xprof).  Yields whether capture is actually active; a None dir is the
+    documented off switch (silent), but an unsupported backend or a
+    failing profiler start degrades to a no-op WITH a warning naming the
+    exception — an operator who asked for a capture and got nothing must
+    learn why from the log, not from an empty directory an hour later."""
     if not log_dir:
         yield False
         return
@@ -281,7 +294,12 @@ def trace(log_dir: Optional[str]) -> Iterator[bool]:
         import jax
         jax.profiler.start_trace(log_dir)
         active = True
-    except Exception:
+    except Exception as exc:
+        import warnings
+        warnings.warn(
+            f"profiler trace capture into {log_dir!r} unavailable "
+            f"({type(exc).__name__}: {exc}); continuing without capture",
+            RuntimeWarning)
         yield False
         return
     try:

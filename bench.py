@@ -418,6 +418,7 @@ def e2e_rf_rate(n):
     cache_epoch = _rf_cache_epoch(run_once, path, n, blobs,
                                   csv_pass_s=t2 - t0, csv_parse_s=parse_s,
                                   csv_ingest_s=ingest_s)
+    telemetry = _rf_telemetry_overhead(run_once, t2 - t0)
     return {"metric": "e2e_csv_to_forest_rows_x_trees_per_sec",
             "value": round(n * T / dt, 1), "unit": "rows*trees/sec",
             "n": n, "trees": T, "candidate_splits": S,
@@ -437,9 +438,52 @@ def e2e_rf_rate(n):
             # the columnar-sidecar epoch story: cold pass builds the
             # cache, warm pass re-baselines from it with parse removed
             "cache_epoch": cache_epoch,
+            # span tracing ON vs OFF for the identical build: the <2%
+            # overhead budget of ISSUE 8, plus the trace's own evidence
+            # (lane count == the parse||transfer||compute concurrency,
+            # schema-validated export)
+            "telemetry": telemetry,
             "roofline": roofline(build_s, flops=flops, hbm_bytes=hbm,
                                  host_s=parse_s,
                                  measured=led.snapshot())}
+
+
+def _rf_telemetry_overhead(run_once, untraced_s):
+    """One more identical streamed pass with the span tracer installed:
+    the measured telemetry overhead (budget <2%, ISSUE 8) and the
+    trace's own evidence — distinct span lanes (parse thread, staging
+    thread, consumer/compute) and a schema-validated Chrome export."""
+    import shutil
+    import tempfile
+    from avenir_tpu import telemetry as tele
+    from avenir_tpu.telemetry.trace import (read_trace_file,
+                                            validate_trace_events)
+    tdir = tempfile.mkdtemp(prefix="avenir_trace_bench_")
+    try:
+        tracer = tele.install_tracer(
+            tele.Tracer(tdir, run_id="e2e-rf", process_index=0))
+        try:
+            t0 = time.perf_counter()
+            run_once({})
+            traced_s = time.perf_counter() - t0
+        finally:
+            tele.uninstall_tracer()
+            tracer.close()
+        events = read_trace_file(tracer.path)
+        spans = [e for e in events if e.get("ph") == "X"]
+        return {
+            "traced_s": round(traced_s, 3),
+            "untraced_s": round(untraced_s, 3),
+            "overhead_fraction": round(traced_s / untraced_s - 1.0, 4)
+            if untraced_s > 0 else 0.0,
+            "trace_events": len(events),
+            "span_lanes": len({e.get("tid") for e in spans}),
+            "span_names": sorted({e.get("name") for e in spans}),
+            "schema_problems": len(validate_trace_events(events)),
+        }
+    finally:
+        # a failed traced pass must not leave trace dirs piling up
+        shutil.rmtree(tdir, ignore_errors=True)
 
 
 SCALE_TREES = 8

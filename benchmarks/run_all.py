@@ -224,12 +224,45 @@ def bench_serve_forest(scale):
                 "p50_ms": round(svc.timer.percentile_ms("serve.request", 50), 3),
                 "p99_ms": round(svc.timer.percentile_ms("serve.request", 99), 3)}
 
-    one_load(0)  # warm the submit/coalesce path itself
-    loads = [one_load(off) for off in (0, 2000, 500)]
-    svc.stop()
+    # the scrapeable observability surface (ISSUE 8): bind the service's
+    # gauges + health onto a registry, open /metrics + /healthz, and
+    # record a real scrape DURING the load passes — queue depth, p99
+    # latency, and the mark_degraded -> 503 flip a load balancer keys on
+    import urllib.error
+    import urllib.request
+    from avenir_tpu import telemetry as tele
+    reg = tele.MetricsRegistry()
+    svc.bind_metrics(reg)
+    msrv = tele.MetricsServer(reg, port=0).start()
+
+    try:
+        one_load(0)  # warm the submit/coalesce path itself
+        loads = [one_load(off) for off in (0, 2000, 500)]
+        scrape = urllib.request.urlopen(msrv.url + "/metrics",
+                                        timeout=10).read().decode()
+        healthz_ok = urllib.request.urlopen(
+            msrv.url + "/healthz", timeout=10).status == 200
+        svc.mark_degraded("bench probe")
+        try:
+            urllib.request.urlopen(msrv.url + "/healthz", timeout=10)
+            degraded_503 = False
+        except urllib.error.HTTPError as exc:
+            degraded_503 = exc.code == 503
+        svc.degraded = None
+    finally:
+        # a failed load pass or scrape must not leave the serving batch
+        # thread and the HTTP server running in the bench process
+        msrv.stop()
+        svc.stop()
     return {"metric": "serve_forest_peak_req_per_sec",
             "value": loads[0]["throughput_req_per_sec"],
-            "n_requests": n_req, "trees": len(models), "loads": loads}
+            "n_requests": n_req, "trees": len(models), "loads": loads,
+            "metrics_endpoint": {
+                "scrape_bytes": len(scrape),
+                "queue_depth_gauge": 'key="queue_depth"' in scrape,
+                "p99_gauge": 'quantile="p99"' in scrape,
+                "healthz_ok_then_degraded_503":
+                    healthz_ok and degraded_503}}
 
 
 def bench_monitor_drift(scale):

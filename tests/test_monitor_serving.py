@@ -305,9 +305,15 @@ def test_drift_monitor_job_flags_shift_quiet_on_same(tmp_path):
     assert all(len(ln) == 11 for ln in lines)
     by_scope = {(ln[0], ln[2]): ln for ln in lines if ln[1] == "window"}
     assert by_scope[("1", "x1")][-1] == "alert"
-    # machine-readable counters (Counters.to_json satellite) round-trip
+    # machine-readable counters round-trip through the UNIVERSAL
+    # <out>.counters.json sibling writer (cli.run, r13) — the job-local
+    # <out>/counters.json duplicate is gone
+    from avenir_tpu.cli.run import write_counters_json
     from avenir_tpu.core.metrics import Counters
-    with open(out_shift / "counters.json") as fh:
+    assert not os.path.exists(out_shift / "counters.json")
+    dest = write_counters_json(c_shift, str(out_shift))
+    assert dest == str(out_shift) + ".counters.json"
+    with open(dest) as fh:
         loaded = Counters.from_json(fh.read())
     assert loaded.get("DriftMonitor", "Alerts") == \
         c_shift.get("DriftMonitor", "Alerts")

@@ -1,0 +1,707 @@
+"""Unified runtime telemetry (ISSUE 8): span tracer + Chrome trace
+export/merge, MetricsRegistry + /metrics + /healthz endpoint, collective
+stall detection, StepTimer export contract, tracetool.
+
+Everything here runs in the fast tier-1 lane (``telemetry`` marker)."""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from avenir_tpu import telemetry as T
+from avenir_tpu.telemetry import trace as TT
+
+pytestmark = pytest.mark.telemetry
+
+
+def _load_tracetool():
+    """Load tools/tracetool.py by path (the cachetool idiom: tools/ is a
+    scripts dir, not a package, so imports must not depend on cwd)."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "tracetool", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "tracetool.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture()
+def tracer(tmp_path):
+    """Install a fresh Tracer for the test, uninstalled at teardown so no
+    spans leak into later tests."""
+    tr = T.install_tracer(T.Tracer(str(tmp_path / "traces"),
+                                   run_id="t", process_index=0))
+    yield tr
+    T.uninstall_tracer()
+
+
+# --------------------------------------------------------------------------
+# tracer core
+# --------------------------------------------------------------------------
+
+def test_span_is_noop_without_tracer():
+    assert T.current_tracer() is None
+    s = T.span("anything", cat="x", block=1)
+    assert s is T.NULL_SPAN
+    with s as sp:
+        sp.add(rows=3)  # must exist and do nothing
+    T.instant("nothing")  # no tracer: silently dropped
+
+
+def test_tracer_records_valid_chrome_events(tracer, tmp_path):
+    with T.span("parse.chunk", cat="parse", block=0, rows=10):
+        time.sleep(0.002)
+
+    def worker():
+        with T.span("h2d.stage", cat="transfer"):
+            time.sleep(0.001)
+    th = threading.Thread(target=worker, name="stage-thread")
+    th.start()
+    th.join()
+    T.instant("allreduce.stall", missing_shards=[1], shard=0)
+    tracer.close()
+    events = TT.read_trace_file(tracer.path)
+    assert TT.validate_trace_events(events) == []
+    spans = [e for e in events if e["ph"] == "X"]
+    assert {e["name"] for e in spans} == {"parse.chunk", "h2d.stage"}
+    # one lane per thread, named via thread_name metadata
+    assert len({e["tid"] for e in spans}) == 2
+    tn = [e for e in events if e["ph"] == "M"
+          and e["name"] == "thread_name"]
+    assert any(e["args"]["name"] == "stage-thread" for e in tn)
+    # span attrs ride through
+    parse = next(e for e in spans if e["name"] == "parse.chunk")
+    assert parse["args"] == {"block": 0, "rows": 10}
+    assert parse["dur"] >= 1000  # >= 1ms in microseconds
+    # chrome export: ts-sorted wrapper that json-loads
+    chrome_path = tracer.path[:-len(".jsonl")] + ".chrome.json"
+    with open(chrome_path) as fh:
+        chrome = json.load(fh)
+    tss = [e["ts"] for e in chrome["traceEvents"] if e["ph"] != "M"]
+    assert tss == sorted(tss), "chrome export must be ts-monotonic"
+
+
+def test_validator_catches_schema_problems():
+    good = [{"ph": "X", "name": "a", "ts": 1.0, "dur": 2.0,
+             "pid": 0, "tid": 1}]
+    assert TT.validate_trace_events(good) == []
+    assert TT.validate_trace_events(
+        [{"ph": "X", "name": "a", "ts": 1.0, "pid": 0, "tid": 1}])  # no dur
+    assert TT.validate_trace_events(
+        [{"ph": "X", "name": "a", "ts": -5, "dur": 1, "pid": 0,
+          "tid": 1}])  # negative ts
+    assert TT.validate_trace_events([{"ph": "Q", "name": "a"}])
+    # B/E pairing: a lone E and a lone B both flag
+    assert TT.validate_trace_events(
+        [{"ph": "E", "ts": 1.0, "pid": 0, "tid": 1}])
+    assert TT.validate_trace_events(
+        [{"ph": "B", "name": "a", "ts": 1.0, "pid": 0, "tid": 1}])
+    assert TT.validate_trace_events(
+        [{"ph": "B", "name": "a", "ts": 1.0, "pid": 0, "tid": 1},
+         {"ph": "E", "ts": 2.0, "pid": 0, "tid": 1}]) == []
+    # lane timeline: nested and disjoint spans are fine; a partial
+    # crossing (impossible from one thread's context-manager stack —
+    # the mixed-clock-anchor signature) flags
+    nested = [{"ph": "X", "name": "outer", "ts": 0.0, "dur": 100.0,
+               "pid": 0, "tid": 1},
+              {"ph": "X", "name": "inner", "ts": 10.0, "dur": 50.0,
+               "pid": 0, "tid": 1},
+              {"ph": "X", "name": "later", "ts": 200.0, "dur": 10.0,
+               "pid": 0, "tid": 1}]
+    assert TT.validate_trace_events(nested) == []
+    crossing = [{"ph": "X", "name": "a", "ts": 0.0, "dur": 100.0,
+                 "pid": 0, "tid": 1},
+                {"ph": "X", "name": "b", "ts": 50.0, "dur": 100.0,
+                 "pid": 0, "tid": 1}]
+    probs = TT.validate_trace_events(crossing)
+    assert probs and "crosses" in probs[0]
+    # same intervals on DIFFERENT lanes: fine (threads overlap freely)
+    crossing[1]["tid"] = 2
+    assert TT.validate_trace_events(crossing) == []
+
+
+def test_two_shard_merge(tmp_path):
+    """Two per-process traces of one run merge into one schema-valid
+    timeline with both pid lanes — the multi-shard acceptance shape."""
+    tdir = str(tmp_path / "traces")
+    paths = []
+    for idx in range(2):
+        tr = T.Tracer(tdir, run_id="job-abc", process_index=idx)
+        T.install_tracer(tr)
+        try:
+            with T.span("parse.chunk", cat="parse", block=idx):
+                time.sleep(0.001)
+            with T.span("allreduce.sum", cat="collective", shard=idx):
+                time.sleep(0.001)
+        finally:
+            T.uninstall_tracer()
+        tr.close()
+        paths.append(tr.path)
+    merged = TT.merge_trace_files(paths)
+    assert TT.validate_trace_events(merged) == []
+    spans = [e for e in merged if e["ph"] == "X"]
+    assert {e["pid"] for e in spans} == {0, 1}
+    tss = [e["ts"] for e in merged if e["ph"] != "M"]
+    assert tss == sorted(tss)
+    # tracetool merge writes a loadable catapult file
+    tracetool = _load_tracetool()
+    out = str(tmp_path / "merged.json")
+    assert tracetool.main(["merge", "-o", out] + paths) == 0
+    with open(out) as fh:
+        chrome = json.load(fh)
+    assert {e["pid"] for e in chrome["traceEvents"]
+            if e["ph"] == "X"} == {0, 1}
+
+
+def test_torn_tail_line_is_dropped(tmp_path):
+    tr = T.Tracer(str(tmp_path), run_id="k", process_index=0)
+    T.install_tracer(tr)
+    try:
+        with T.span("a"):
+            pass
+    finally:
+        T.uninstall_tracer()
+    tr.flush()
+    with open(tr.path, "a") as fh:
+        fh.write('{"ph": "X", "name": "torn')  # killed mid-append
+    events = TT.read_trace_file(tr.path)
+    assert TT.validate_trace_events(events) == []
+    assert [e["name"] for e in events if e["ph"] == "X"] == ["a"]
+
+
+# --------------------------------------------------------------------------
+# pipeline instrumentation: the streamed build's concurrent lanes
+# --------------------------------------------------------------------------
+
+SCHEMA = {"fields": [
+    {"name": "a", "ordinal": 0, "dataType": "categorical", "feature": True,
+     "cardinality": ["x", "y", "z"]},
+    {"name": "b", "ordinal": 1, "dataType": "categorical", "feature": True,
+     "cardinality": ["p", "q"]},
+    {"name": "cls", "ordinal": 2, "dataType": "categorical",
+     "cardinality": ["n", "y"]}]}
+
+
+def _write_csv(path, n=300, seed=5):
+    rng = np.random.default_rng(seed)
+    with open(path, "w") as fh:
+        for _ in range(n):
+            a = rng.choice(["x", "y", "z"])
+            b = rng.choice(["p", "q"])
+            c = "y" if (a == "x") ^ (b == "p") else "n"
+            fh.write(f"{a},{b},{c}\n")
+    return str(path)
+
+
+def test_streamed_build_traces_concurrent_lanes(tracer, tmp_path):
+    """A streamed RF build with the tracer installed produces parse /
+    H2D-staging / device-compute spans on >= 3 distinct thread lanes,
+    plus one allreduce.sum span per tree level and the row-count
+    allgather — the timeline the Chrome export shows."""
+    from avenir_tpu.core.schema import FeatureSchema
+    from avenir_tpu.core.table import iter_csv_chunks, prefetch_chunks
+    from avenir_tpu.models.forest import (ForestParams,
+                                          build_forest_from_stream)
+    from avenir_tpu.parallel.collectives import AllReducer
+    from avenir_tpu.parallel.distributed import ShardSpec
+    csv = _write_csv(tmp_path / "d.csv")
+    schema = FeatureSchema.from_dict(SCHEMA)
+    params = ForestParams(num_trees=3, seed=7)
+    params.tree.max_depth = 3
+    params.tree.stopping_strategy = "maxDepth"
+    reducer = AllReducer(spec=ShardSpec(0, 1), name="t-rf")
+    blocks = prefetch_chunks(
+        iter_csv_chunks(csv, schema, ",", chunk_rows=100),
+        consumer_wait_key=None)
+    models = build_forest_from_stream(blocks, schema, params,
+                                      reducer=reducer)
+    assert len(models) == 3
+    tracer.flush()
+    events = TT.read_trace_file(tracer.path)
+    assert TT.validate_trace_events(events) == []
+    spans = [e for e in events if e["ph"] == "X"]
+    names = {e["name"] for e in spans}
+    assert {"parse.chunk", "h2d.stage", "device.compute",
+            "forest.level", "allreduce.sum",
+            "allreduce.allgather"} <= names
+    # parse thread, staging thread, consumer thread: >= 3 lanes
+    lanes = {e["tid"] for e in spans}
+    assert len(lanes) >= 3
+    # parse and h2d.stage run on DIFFERENT lanes than device.compute
+    lane_of = {n: {e["tid"] for e in spans if e["name"] == n}
+               for n in ("parse.chunk", "h2d.stage", "device.compute")}
+    assert lane_of["parse.chunk"].isdisjoint(lane_of["device.compute"])
+    assert lane_of["h2d.stage"].isdisjoint(lane_of["device.compute"])
+    # ONE allreduce.sum per level (root + 2 fused levels at depth 3),
+    # mirroring the Collectives counter pin of the sharded suite
+    assert len([e for e in spans if e["name"] == "allreduce.sum"]) == 3
+    assert len([e for e in spans
+                if e["name"] == "allreduce.allgather"]) == 1
+
+
+def test_checkpoint_write_span(tracer, tmp_path):
+    from avenir_tpu.core.checkpoint import CheckpointManager
+    from avenir_tpu.core.schema import FeatureSchema
+    from avenir_tpu.core.table import iter_csv_chunks
+    from avenir_tpu.models.tree import TreeBuilder, TreeParams
+    csv = _write_csv(tmp_path / "d.csv", n=200)
+    schema = FeatureSchema.from_dict(SCHEMA)
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    TreeBuilder.from_stream(
+        iter_csv_chunks(csv, schema, ",", chunk_rows=50), schema,
+        TreeParams(max_depth=2, stopping_strategy="maxDepth", seed=1),
+        checkpoint=mgr, checkpoint_every=2)
+    tracer.flush()
+    events = TT.read_trace_file(tracer.path)
+    ck = [e for e in events if e.get("name") == "checkpoint.write"]
+    assert ck and all(e["ph"] == "X" for e in ck)
+    assert any(e["args"]["complete"] for e in ck)
+
+
+# --------------------------------------------------------------------------
+# collective stall detection
+# --------------------------------------------------------------------------
+
+def _stall_events(tr):
+    tr.flush()
+    return [e for e in TT.read_trace_file(tr.path)
+            if e.get("name") == "allreduce.stall"]
+
+
+def test_stall_event_names_dead_shard(tracer, tmp_path):
+    """The PR 7 kill scenario: the handshake completes with both shards
+    live, then shard 1 dies; shard 0's next collective emits a
+    structured stall event NAMING shard 1 well before the hard timeout,
+    then fails loudly at the timeout."""
+    from avenir_tpu.parallel.collectives import AllReducer
+    from avenir_tpu.parallel.distributed import ShardSpec
+    rdir = str(tmp_path / "reduce")
+    r0 = AllReducer(spec=ShardSpec(0, 2), name="kill", transport_dir=rdir,
+                    timeout_s=3.0, heartbeat_s=0.25)
+    r1 = AllReducer(spec=ShardSpec(1, 2), name="kill", transport_dir=rdir,
+                    timeout_s=3.0, heartbeat_s=0.25)
+    ones = np.ones((4,), np.int32)
+    out = {}
+    th = threading.Thread(target=lambda: out.setdefault(
+        "r1", r1.sum(ones)))
+    th.start()
+    assert np.array_equal(r0.sum(ones), 2 * ones)  # step 0: both live
+    th.join()
+    assert np.array_equal(out["r1"], 2 * ones)
+    # shard 1 is now dead; shard 0's next step stalls then times out
+    with pytest.warns(RuntimeWarning, match=r"stall.*shard\(s\) \[1\]"):
+        with pytest.raises(RuntimeError, match="never produced"):
+            r0.sum(ones)
+    stalls = _stall_events(tracer)
+    assert stalls, "stall must be a structured trace event"
+    args = stalls[0]["args"]
+    assert args["missing_shards"] == [1]
+    assert args["reducer"] == "kill" and args["phase"] == "exchange"
+    assert args["waited_s"] < 3.0  # emitted BEFORE the hard timeout
+
+
+def test_stall_event_during_handshake(tracer, tmp_path):
+    """A peer that never arrives is named already at the handshake."""
+    from avenir_tpu.parallel.collectives import AllReducer
+    from avenir_tpu.parallel.distributed import ShardSpec
+    r0 = AllReducer(spec=ShardSpec(0, 2), name="lone",
+                    transport_dir=str(tmp_path / "reduce"),
+                    timeout_s=1.0, heartbeat_s=0.2)
+    with pytest.warns(RuntimeWarning, match="stall"):
+        with pytest.raises(RuntimeError, match="never appeared"):
+            r0.sum(np.ones((2,), np.int32))
+    stalls = _stall_events(tracer)
+    assert stalls and stalls[0]["args"]["missing_shards"] == [1]
+    assert stalls[0]["args"]["phase"] == "handshake"
+
+
+# --------------------------------------------------------------------------
+# metrics registry + endpoint
+# --------------------------------------------------------------------------
+
+def _parse_prom(text):
+    """Parse Prometheus text into {name{labels}: float} + per-family
+    TYPE map — the 'parseable' acceptance check, done strictly."""
+    samples, types = {}, {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            types[name] = kind
+        elif line.startswith("#"):
+            continue
+        else:
+            key, _, val = line.rpartition(" ")
+            samples[key] = float(val)
+    return samples, types
+
+
+def test_metrics_registry_render():
+    reg = T.MetricsRegistry()
+    reg.counter("avenir_served_total", "served", labels=("model",)) \
+        .inc(5, model="forest")
+    reg.gauge("avenir_queue_depth", "depth").set(3)
+    h = reg.histogram("avenir_req_seconds", "latency",
+                      buckets=(0.01, 0.1))
+    h.observe(0.05)
+    h.observe(0.005)
+    samples, types = _parse_prom(reg.render())
+    assert types == {"avenir_served_total": "counter",
+                     "avenir_queue_depth": "gauge",
+                     "avenir_req_seconds": "histogram"}
+    assert samples['avenir_served_total{model="forest"}'] == 5
+    assert samples["avenir_queue_depth"] == 3
+    assert samples['avenir_req_seconds_bucket{le="0.01"}'] == 1
+    assert samples['avenir_req_seconds_bucket{le="0.1"}'] == 2
+    assert samples['avenir_req_seconds_bucket{le="+Inf"}'] == 2
+    assert samples["avenir_req_seconds_count"] == 2
+    # name/label sanitization + re-registration conflicts refuse
+    assert T.metrics.sanitize_name("serve.batch-p99") == "serve_batch_p99"
+    with pytest.raises(ValueError):
+        reg.counter("avenir_queue_depth", "now a counter")
+
+
+def test_metrics_attach_preexisting_channels():
+    """Counters / TransferLedger / StepTimer unify behind the registry:
+    one probe-driven gauge family each."""
+    from avenir_tpu.core.metrics import Counters
+    from avenir_tpu.utils.tracing import StepTimer, TransferLedger
+    reg = T.MetricsRegistry()
+    counters = Counters()
+    counters.increment("Serving", "Requests", 7)
+    ledger = TransferLedger()
+    ledger.record_h2d(1024)
+    timer = StepTimer(keep_samples=16)
+    timer.record("serve.batch", 0.002)
+    reg.attach_counters(counters)
+    reg.attach_ledger(ledger)
+    reg.attach_timer(timer)
+    samples, _ = _parse_prom(reg.render())
+    assert samples[
+        'avenir_job_counter{group="Serving",name="Requests"}'] == 7
+    assert samples['avenir_transfer{key="h2d_bytes"}'] == 1024
+    assert samples['avenir_step_calls_total{step="serve.batch"}'] == 1
+    assert samples[
+        'avenir_step_latency_ms{step="serve.batch",quantile="p99"}'] > 0
+    # live source: a later increment shows at the next render
+    counters.increment("Serving", "Requests", 3)
+    samples, _ = _parse_prom(reg.render())
+    assert samples[
+        'avenir_job_counter{group="Serving",name="Requests"}'] == 10
+
+
+def test_metrics_snapshot_thread(tmp_path):
+    reg = T.MetricsRegistry()
+    g = reg.gauge("avenir_x", "x")
+    ticks = []
+    reg.register_probe(lambda: (ticks.append(1), g.set(len(ticks)))[1])
+    snap = str(tmp_path / "metrics.jsonl")
+    reg.start_snapshots(0.05, snapshot_path=snap)
+    deadline = time.monotonic() + 5.0
+    while reg.snapshots_taken < 2 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    reg.stop_snapshots()
+    assert reg.snapshots_taken >= 2
+    with open(snap) as fh:
+        recs = [json.loads(line) for line in fh]
+    assert recs and all("ts" in r and "avenir_x" in r for r in recs)
+
+
+class _StubPredictor:
+    """predict_rows contract stub: class 'y' when field0 == 'x', raising
+    on the literal token 'boom' (the per-row isolation path)."""
+
+    def warm(self):
+        return self
+
+    def predict_rows(self, rows):
+        out = []
+        for r in rows:
+            if r[0] == "boom":
+                raise ValueError("boom row")
+            out.append("y" if r[0] == "x" else "n")
+        return out
+
+
+def _service(**kw):
+    from avenir_tpu.serving.service import BatchPolicy, PredictionService
+    return PredictionService(_StubPredictor(), warm=False,
+                             policy=BatchPolicy(max_batch=8,
+                                                max_wait_ms=1.0), **kw)
+
+
+@pytest.mark.serving
+def test_prediction_service_stats_snapshot():
+    svc = _service()
+    svc.version = 4
+    out = svc.process_batch(["predict,0,x,p", "predict,1,z,q",
+                             "predict,2,boom,q"])
+    assert out == ["0,y", "1,n", "2,error"]
+    st = svc.stats()
+    assert st == {"queue_depth": 0, "in_flight": 0, "served": 3,
+                  "errors": 1, "batches": 1, "hot_swaps": 0,
+                  "degraded": None, "model_version": 4}
+    ok, payload = svc.health()
+    assert ok and payload["served"] == 3
+    svc.mark_degraded("drift: psi over threshold")
+    ok, payload = svc.health()
+    assert not ok and payload["degraded"].startswith("drift")
+    assert svc.stats()["degraded"] == "drift: psi over threshold"
+
+
+@pytest.mark.serving
+def test_metrics_server_serves_service_gauges_and_healthz():
+    """The acceptance shape: /metrics exposes queue-depth and p99 gauges
+    for a live PredictionService; /healthz flips 200 -> 503 when
+    mark_degraded fires and back on refresh-like recovery."""
+    reg = T.MetricsRegistry()
+    svc = _service(metrics=reg)
+    svc.version = 2
+    svc.process_batch(["predict,0,x,p", "predict,1,z,q"])
+    srv = T.MetricsServer(reg, port=0).start()
+    try:
+        text = urllib.request.urlopen(
+            srv.url + "/metrics", timeout=10).read().decode()
+        samples, types = _parse_prom(text)
+        assert types["avenir_serving"] == "gauge"
+        p = 'avenir_serving{service="predictor",'
+        assert samples[p + 'key="queue_depth"}'] == 0
+        assert samples[p + 'key="served"}'] == 2
+        assert samples[p + 'key="model_version"}'] == 2
+        assert samples[p + 'key="degraded"}'] == 0
+        assert ('avenir_serving_latency_ms{service="predictor",'
+                'step="serve.batch",quantile="p99"}') in samples
+        hz = urllib.request.urlopen(srv.url + "/healthz", timeout=10)
+        assert hz.status == 200
+        assert json.loads(hz.read())["status"] == "ok"
+        svc.mark_degraded("drift alert")
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(srv.url + "/healthz", timeout=10)
+        assert exc_info.value.code == 503
+        body = json.loads(exc_info.value.read())
+        assert body["status"] == "degraded"
+        check = body["checks"]["serving:predictor"]
+        assert check["degraded"] == "drift alert"
+        samples, _ = _parse_prom(urllib.request.urlopen(
+            srv.url + "/metrics", timeout=10).read().decode())
+        assert samples['avenir_serving{service="predictor",'
+                       'key="degraded"}'] == 1
+        # unknown path: 404, server stays up
+        with pytest.raises(urllib.error.HTTPError) as e2:
+            urllib.request.urlopen(srv.url + "/nope", timeout=10)
+        assert e2.value.code == 404
+    finally:
+        srv.stop()
+
+
+def test_default_registry_binds_new_services():
+    """cli.run installs a process default registry; a PredictionService
+    constructed while it is live binds automatically (the serving job
+    path needs no explicit wiring)."""
+    reg = T.MetricsRegistry()
+    T.set_default_registry(reg)
+    try:
+        svc = _service()
+        svc.process_batch(["predict,0,x,p"])
+        samples, _ = _parse_prom(reg.render())
+        assert samples['avenir_serving{service="predictor",'
+                       'key="served"}'] == 1
+    finally:
+        T.set_default_registry(None)
+
+
+# --------------------------------------------------------------------------
+# satellites: StepTimer export contract, trace() degraded path
+# --------------------------------------------------------------------------
+
+def test_steptimer_export_key_contract():
+    """keep_samples=0 exports EXACTLY {timeMs, calls} per step; a step
+    with samples exports EXACTLY those plus p50/p95/p99 Us."""
+    from avenir_tpu.core.metrics import Counters
+    from avenir_tpu.utils.tracing import StepTimer
+    t0 = StepTimer(keep_samples=0)
+    t0.record("job", 0.5)
+    c0 = Counters()
+    t0.export(c0)
+    assert set(c0.group("Profiling")) == {"job.timeMs", "job.calls"}
+    t1 = StepTimer(keep_samples=8)
+    t1.record("serve", 0.001)
+    t1.record("other", 0.002)
+    # simulate a step recorded before sampling was enabled: no samples
+    t1.samples.pop("other")
+    c1 = Counters()
+    t1.export(c1)
+    assert set(c1.group("Profiling")) == {
+        "serve.timeMs", "serve.calls",
+        "serve.p50Us", "serve.p95Us", "serve.p99Us",
+        "other.timeMs", "other.calls"}
+    assert c1.get("Profiling", "serve.p50Us") == 1000
+
+
+def test_profiler_trace_degrades_with_warning(monkeypatch, tmp_path):
+    """Satellite: a failing jax.profiler.start_trace must WARN with the
+    exception, then degrade to a no-op (active=False) — never silently."""
+    import jax
+    from avenir_tpu.utils.tracing import trace
+
+    def boom(path):
+        raise RuntimeError("profiler unsupported on this backend")
+    monkeypatch.setattr(jax.profiler, "start_trace", boom)
+    with pytest.warns(RuntimeWarning,
+                      match="profiler trace capture.*unavailable.*"
+                            "RuntimeError: profiler unsupported"):
+        with trace(str(tmp_path / "prof")) as active:
+            assert active is False
+    # the None-dir off switch stays silent
+    import warnings as _w
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+        with trace(None) as active:
+            assert active is False
+
+
+# --------------------------------------------------------------------------
+# cli wiring: counters.json for every job + tracetool smoke
+# --------------------------------------------------------------------------
+
+def test_cli_writes_counters_json_for_every_job(tmp_path):
+    """Satellite: one shared writer prints render() AND persists
+    ``<out>.counters.json`` next to the job output (not just
+    driftMonitor) — a SIBLING of the output dir, never inside it (output
+    dirs chain into later jobs' inputs and are byte-pinned by the golden
+    flows)."""
+    from avenir_tpu.cli import run as cli_run
+    from avenir_tpu.core.metrics import Counters
+    schema_path = tmp_path / "s.json"
+    schema_path.write_text(json.dumps(SCHEMA))
+    csv = _write_csv(tmp_path / "d.csv", n=120)
+    props = tmp_path / "j.properties"
+    props.write_text(
+        "field.delim.regex=,\nfield.delim.out=,\n"
+        f"bad.feature.schema.file.path={schema_path}\n")
+    out_dir = tmp_path / "out"
+    rc = cli_run.main(["org.avenir.bayesian.BayesianDistribution",
+                       f"-Dconf.path={props}", csv, str(out_dir)])
+    assert rc == 0
+    with open(str(out_dir) + ".counters.json") as fh:
+        loaded = Counters.from_json(fh.read())
+    # the persisted dump is the FINAL one: profiling + transfers included
+    assert loaded.get("Profiling", "job.calls") == 1
+    assert "Transfers" in loaded.as_dict()
+    # the OUTPUT DIR stays exactly the job's part files
+    assert "counters.json" not in os.listdir(out_dir)
+
+
+@pytest.mark.sharded
+def test_cli_two_shard_build_produces_merged_chrome_trace(tmp_path):
+    """The acceptance scenario end-to-end: a streamed 2-shard RF build
+    (file-transport smoke lane) with ``telemetry.trace.dir`` set writes
+    one trace file per shard under the SAME derived run id; the merged
+    Chrome trace validates and shows parse / H2D staging / device
+    compute lanes on both shard pids plus per-level allreduce spans."""
+    import subprocess
+    schema_path = tmp_path / "s.json"
+    schema_path.write_text(json.dumps(SCHEMA))
+    csv = _write_csv(tmp_path / "d.csv", n=400)
+    props = tmp_path / "rf.properties"
+    tdir = tmp_path / "traces"
+    props.write_text(
+        "field.delim.regex=,\nfield.delim.out=,\n"
+        f"dtb.feature.schema.file.path={schema_path}\n"
+        "dtb.num.trees=3\ndtb.random.seed=7\n"
+        "dtb.max.depth.limit=3\ndtb.path.stopping.strategy=maxDepth\n"
+        "dtb.streaming.ingest=true\ndtb.streaming.block.rows=100\n"
+        f"telemetry.trace.dir={tdir}\n")
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    rdir = str(tmp_path / "reduce")
+    procs = []
+    for i in range(2):
+        env = {k: v for k, v in os.environ.items()
+               if k not in ("AVENIR_TPU_SHARD", "AVENIR_TPU_ALLREDUCE_DIR")}
+        env.update({"JAX_PLATFORMS": "cpu",
+                    "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+                    "PYTHONPATH": os.pathsep.join(
+                        [repo] + [p for p in
+                                  env.get("PYTHONPATH", "").split(os.pathsep)
+                                  if p]),
+                    "AVENIR_TPU_SHARD": f"{i}/2",
+                    "AVENIR_TPU_ALLREDUCE_DIR": rdir})
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "avenir_tpu.cli.run",
+             "randomForestBuilder", f"-Dconf.path={props}",
+             "-Ddtb.streaming.shard=on",
+             str(csv), str(tmp_path / f"out{i}")],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True))
+    try:
+        for p in procs:
+            _, se = p.communicate(timeout=280)
+            assert p.returncode == 0, se[-3000:]
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        raise
+    jsonls = sorted(str(tdir / f) for f in os.listdir(tdir)
+                    if f.endswith(".jsonl"))
+    assert len(jsonls) == 2, sorted(os.listdir(tdir))
+    # identical argv on both shards -> the SAME derived run id
+    stems = {os.path.basename(p).rsplit(".p", 1)[0] for p in jsonls}
+    assert len(stems) == 1, stems
+    merged = TT.merge_trace_files(jsonls)
+    assert TT.validate_trace_events(merged) == []
+    spans = [e for e in merged if e["ph"] == "X"]
+    assert {e["pid"] for e in spans} == {0, 1}
+    for pid in (0, 1):
+        names = {e["name"] for e in spans if e["pid"] == pid}
+        assert {"parse.chunk", "h2d.stage", "device.compute",
+                "allreduce.sum"} <= names, (pid, names)
+        # >= 3 concurrent lanes per shard: parse, staging, compute
+        assert len({e["tid"] for e in spans if e["pid"] == pid}) >= 3
+        # one allreduce.sum per tree level (root + 2 fused), both shards
+        assert len([e for e in spans if e["pid"] == pid
+                    and e["name"] == "allreduce.sum"]) == 3
+    # per-shard chrome exports landed too (cli.run closes the tracer)
+    assert all(os.path.exists(p[:-len(".jsonl")] + ".chrome.json")
+               for p in jsonls)
+
+
+def test_tracetool_summarize_and_counter_diff(tmp_path, capsys):
+    tracetool = _load_tracetool()
+    tr = T.Tracer(str(tmp_path), run_id="s", process_index=0)
+    T.install_tracer(tr)
+    try:
+        with T.span("parse.chunk", cat="parse"):
+            time.sleep(0.001)
+        T.instant("allreduce.stall", missing_shards=[1], shard=0,
+                  waited_s=1.5, reducer="rf", phase="exchange", step=3)
+    finally:
+        T.uninstall_tracer()
+    tr.close()
+    assert tracetool.main(["summarize", tr.path]) == 0
+    out = capsys.readouterr().out
+    assert "parse.chunk" in out and "STALL" in out
+    # chrome-export subcommand round-trips through the validator
+    exp = str(tmp_path / "exp.json")
+    assert tracetool.main(["chrome-export", tr.path, "-o", exp]) == 0
+    with open(exp) as fh:
+        assert TT.validate_trace_events(
+            json.load(fh)["traceEvents"]) == []
+    capsys.readouterr()
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(json.dumps({"Serving": {"Requests": 10, "Batches": 2}}))
+    b.write_text(json.dumps({"Serving": {"Requests": 14},
+                             "Drift": {"Alerts": 1}}))
+    assert tracetool.main(["counter-diff", str(a), str(b)]) == 0
+    out = capsys.readouterr().out
+    assert "Serving/Requests" in out and "4" in out
+    assert "Drift/Alerts" in out and "Serving/Batches" in out

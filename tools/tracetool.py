@@ -1,0 +1,207 @@
+#!/usr/bin/env python3
+"""Operator tooling over the telemetry artifacts (TPU_NOTES §21).
+
+    python tools/tracetool.py summarize   <trace.jsonl> [...]
+    python tools/tracetool.py merge       -o merged.json <trace.jsonl> [...]
+    python tools/tracetool.py chrome-export <trace.jsonl> [-o out.json]
+    python tools/tracetool.py counter-diff <a/counters.json> <b/counters.json>
+
+* **summarize** — per-stage span accounting (count, total/mean ms) plus
+  per-lane totals and the observed wall span, for one or many per-process
+  trace files (pass every shard's file to see the whole run).
+* **merge** — concatenate N per-process JSONL traces (the shards of one
+  run) into ONE ts-sorted Chrome trace JSON; epoch-anchored timestamps
+  make shard skew visible as lane offset.  Warns when the inputs carry
+  different run ids (sometimes intended: a resumed run's tail).
+* **chrome-export** — single-file variant of merge.
+* **counter-diff** — diff two jobs' ``counters.json`` dumps (the file
+  cli.run now writes next to every job output): every (group, name) with
+  its a/b values and delta — the regression-hunting view over reruns.
+
+Exit status: 0 on success, 1 on invalid input (schema problems are
+printed but do not fail merge/export — a torn shard file should not stop
+the operator from looking at the intact ones).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from collections import defaultdict
+from typing import Dict, List
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from avenir_tpu.telemetry.trace import (  # noqa: E402
+    merge_trace_files, read_trace_file, validate_trace_events,
+    write_chrome_trace)
+
+
+def _run_ids(paths: List[str]) -> Dict[str, str]:
+    ids = {}
+    for p in paths:
+        for ev in read_trace_file(p):
+            if ev.get("run_id"):
+                ids[p] = ev["run_id"]
+                break
+    return ids
+
+
+def cmd_summarize(args) -> int:
+    events = merge_trace_files(args.traces)
+    problems = validate_trace_events(events)
+    for pr in problems:
+        print(f"[schema] {pr}", file=sys.stderr)
+    # malformed X events (no numeric ts) are already reported as
+    # [schema] problems above — keep them out of the accounting so a
+    # torn line yields the documented exit 1, not a KeyError traceback
+    spans = [e for e in events if e.get("ph") == "X"
+             and isinstance(e.get("ts"), (int, float))
+             and isinstance(e.get("dur", 0.0), (int, float))]
+    if not spans:
+        print("no spans recorded")
+        return 0 if not problems else 1
+    by_name: Dict[str, List[float]] = defaultdict(list)
+    lane_spans: Dict[tuple, List[tuple]] = defaultdict(list)
+    for e in spans:
+        by_name[e.get("name", "?")].append(float(e.get("dur", 0.0)))
+        ts, dur = float(e["ts"]), float(e.get("dur", 0.0))
+        lane_spans[(e.get("pid"), e.get("tid"))].append((ts, ts + dur))
+    # busy time is the UNION of a lane's span intervals, not the sum of
+    # durations: nested spans (allreduce.merge_topk wrapping its own
+    # allgather) would otherwise double-count and report >100% of wall
+    by_lane: Dict[tuple, float] = {}
+    for lane, ivs in lane_spans.items():
+        ivs.sort()
+        busy, cur_lo, cur_hi = 0.0, None, None
+        for lo, hi in ivs:
+            if cur_hi is None or lo > cur_hi:
+                if cur_hi is not None:
+                    busy += cur_hi - cur_lo
+                cur_lo, cur_hi = lo, hi
+            else:
+                cur_hi = max(cur_hi, hi)
+        if cur_hi is not None:
+            busy += cur_hi - cur_lo
+        by_lane[lane] = busy
+    t_lo = min(float(e["ts"]) for e in spans)
+    t_hi = max(float(e["ts"]) + float(e.get("dur", 0.0)) for e in spans)
+    stalls = [e for e in events if e.get("ph") == "i"
+              and e.get("name") == "allreduce.stall"]
+    print(f"{len(spans)} spans over {len(by_lane)} lane(s), wall "
+          f"{(t_hi - t_lo) / 1e3:.1f} ms")
+    print(f"{'stage':<24}{'count':>8}{'total ms':>12}{'mean ms':>10}")
+    for name in sorted(by_name, key=lambda n: -sum(by_name[n])):
+        durs = by_name[name]
+        tot = sum(durs) / 1e3
+        print(f"{name:<24}{len(durs):>8}{tot:>12.1f}"
+              f"{tot / len(durs):>10.3f}")
+    print("\nper-lane busy time:")
+    for (pid, tid), busy in sorted(by_lane.items()):
+        print(f"  pid {pid} tid {tid}: {busy / 1e3:.1f} ms "
+              f"({100.0 * busy / max(t_hi - t_lo, 1e-9):.0f}% of wall)")
+    if stalls:
+        print(f"\n{len(stalls)} STALL event(s):")
+        for e in stalls:
+            a = e.get("args", {})
+            print(f"  shard {a.get('shard')} waited "
+                  f"{a.get('waited_s')}s for {a.get('missing_shards')} "
+                  f"({a.get('reducer')}/{a.get('phase')} step "
+                  f"{a.get('step')})")
+    # documented exit contract: summarize fails on invalid input so a CI
+    # lane can gate on it (merge/export only warn)
+    return 0 if not problems else 1
+
+
+def _merge_common(paths: List[str], out: str) -> int:
+    ids = _run_ids(paths)
+    if len(set(ids.values())) > 1:
+        print(f"[warn] merging traces from different runs: "
+              f"{sorted(set(ids.values()))}", file=sys.stderr)
+    events = merge_trace_files(paths)
+    problems = validate_trace_events(events)
+    for pr in problems:
+        print(f"[schema] {pr}", file=sys.stderr)
+    write_chrome_trace(out, events)
+    print(f"wrote {out} ({len(events)} events from {len(paths)} file(s)); "
+          f"load in chrome://tracing or https://ui.perfetto.dev")
+    return 0
+
+
+def cmd_merge(args) -> int:
+    return _merge_common(args.traces, args.output)
+
+
+def cmd_chrome_export(args) -> int:
+    out = args.output or (
+        args.trace[:-len(".jsonl")] if args.trace.endswith(".jsonl")
+        else args.trace) + ".chrome.json"
+    return _merge_common([args.trace], out)
+
+
+def cmd_counter_diff(args) -> int:
+    with open(args.a) as fh:
+        a = json.load(fh)
+    with open(args.b) as fh:
+        b = json.load(fh)
+    keys = sorted({(g, n) for g, names in a.items() for n in names} |
+                  {(g, n) for g, names in b.items() for n in names})
+    print(f"{'group/name':<44}{'a':>14}{'b':>14}{'delta':>14}")
+    changed = 0
+    for g, n in keys:
+        va = a.get(g, {}).get(n)
+        vb = b.get(g, {}).get(n)
+        if va == vb and not args.all:
+            continue
+        changed += 1
+        da = "-" if va is None else va
+        db = "-" if vb is None else vb
+        delta = (vb - va) if isinstance(va, (int, float)) \
+            and isinstance(vb, (int, float)) else ""
+        print(f"{g + '/' + n:<44}{da!s:>14}{db!s:>14}{delta!s:>14}")
+    if changed == 0:
+        print("(no differences)")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tracetool", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("summarize", help="per-stage span accounting")
+    p.add_argument("traces", nargs="+")
+    p.set_defaults(fn=cmd_summarize)
+
+    p = sub.add_parser("merge",
+                       help="merge N per-process traces into one Chrome "
+                            "trace JSON")
+    p.add_argument("traces", nargs="+")
+    p.add_argument("-o", "--output", required=True)
+    p.set_defaults(fn=cmd_merge)
+
+    p = sub.add_parser("chrome-export",
+                       help="export one trace file as Chrome trace JSON")
+    p.add_argument("trace")
+    p.add_argument("-o", "--output")
+    p.set_defaults(fn=cmd_chrome_export)
+
+    p = sub.add_parser("counter-diff",
+                       help="diff two runs' counters.json dumps")
+    p.add_argument("a")
+    p.add_argument("b")
+    p.add_argument("--all", action="store_true",
+                   help="print unchanged counters too")
+    p.set_defaults(fn=cmd_counter_diff)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
