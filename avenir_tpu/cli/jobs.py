@@ -220,7 +220,19 @@ def random_forest_builder(cfg: Config, in_path: str, out_path: str) -> Counters:
     ``<csv>.avtc/`` during the first full pass, later passes load the
     encoded chunks at memcpy speed and skip CSV parse entirely; models,
     resume, and quarantine behavior are bit-identical either way
-    (``ColumnarCache`` counter group reports hits/bytes)."""
+    (``ColumnarCache`` counter group reports hits/bytes).
+
+    ``dtb.pipeline.fuse=true`` (the default; TPU_NOTES §22) runs the
+    streaming per-chunk device work — branch-code encode plus, under
+    ``dtb.baseline.publish``, the baseline's bin-count absorb — as ONE
+    ProgramCache-compiled XLA launch per chunk with device-resident
+    intermediates and a donated count carry, instead of one launch per
+    stage plus a host-side ``tee_blocks`` second consumer.  Models and
+    baselines are bit-identical either way; the ``Dispatches`` counter
+    group shows the per-site launch delta and the ``ProgramCache`` group
+    reports this run's compile/hit tallies (a warm re-run of an
+    identical job shows Retraces=0).  ``false`` restores the eager
+    per-stage path."""
     from ..models.forest import (ForestParams, build_forest,
                                  build_forest_from_stream)
     counters = Counters()
@@ -340,17 +352,26 @@ def random_forest_builder(cfg: Config, in_path: str, out_path: str) -> Counters:
             cache=_cache_policy(cfg, counters),
             shard=(spec.index, spec.count) if sharded else None),
             consumer_wait_key=None)
-        if baseline_builder is not None:
-            # the baseline rides the SAME single ingest pass (a resumed
-            # run only re-profiles the re-read tail; the baseline is a
-            # distribution estimate, not a bit-pinned artifact)
-            from ..monitor.baseline import tee_blocks
-            blocks = tee_blocks(blocks, baseline_builder)
+        # the baseline rides the SAME single ingest pass either way (a
+        # resumed run only re-profiles the re-read tail; the baseline is
+        # a distribution estimate, not a bit-pinned artifact): fused, it
+        # is a stage of the per-chunk program; unfused, from_stream tees
+        # the block stream host-side
+        fuse = cfg.get_boolean("dtb.pipeline.fuse", True)
+        stream_stats: dict = {}
         models = build_forest_from_stream(
             blocks, schema, params,
             None if sharded else runtime_context(),
             checkpoint=mgr, checkpoint_every=every,
-            resume_state=resume_state, reducer=reducer)
+            resume_state=resume_state, reducer=reducer,
+            baseline=baseline_builder, fuse=fuse, stats=stream_stats)
+        pl = stream_stats.get("pipeline")
+        if pl:
+            # per-run program-cache tallies (TPU_NOTES §22): a warm
+            # re-run of an identical job reports Retraces=0 here
+            counters.update_group("ProgramCache", {
+                "Chunks": pl["chunks"], "Hits": pl["hits"],
+                "Misses": pl["misses"], "Retraces": pl["retraces"]})
     else:
         table = load_csv(in_path, schema, cfg.field_delim_regex,
                          bad_records=policy)

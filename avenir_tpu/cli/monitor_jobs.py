@@ -122,19 +122,15 @@ def _iter_resp_windows(cfg: Config, split, window_rows: int):
         client.close()
 
 
-@register("org.avenir.monitor.DriftMonitor", "driftMonitor", dist="refuse")
-def drift_monitor(cfg: Config, in_path: str, out_path: str) -> Counters:
-    from ..core.schema import FeatureSchema
-    from ..core.table import encode_rows
-    from ..monitor.accumulator import StreamDriftMonitor
-    from ..monitor.baseline import load_baseline
-    from ..monitor.drift import STATS
-    from ..monitor.policy import AccuracyTracker, DriftPolicy
-    from ..serving.registry import ModelRegistry
+# --------------------------------------------------------------------------
+# shared monitoring plumbing: ``driftMonitor`` and ``predictDriftScore``
+# are pinned byte-identical on the drift-report/alert artifacts, so the
+# model resolution, policy/monitor/tracker construction, window sources,
+# bad-record filtering, report formatting, and the per-window drain live
+# HERE exactly once — a fix in one job cannot silently miss the other
+# --------------------------------------------------------------------------
 
-    counters = Counters()
-    registry = ModelRegistry(cfg.must_get("dm.model.registry.dir"))
-    name = cfg.must_get("dm.model.name")
+def _resolve_model_version(cfg: Config, registry, name: str) -> int:
     version: Optional[int] = cfg.get_int("dm.model.version", 0) or None
     if version is None:
         version = registry.latest_version(name)
@@ -142,23 +138,34 @@ def drift_monitor(cfg: Config, in_path: str, out_path: str) -> Counters:
             raise FileNotFoundError(
                 f"no intact versions of model {name!r} in "
                 f"{registry.base_dir!r}")
-    baseline = load_baseline(registry, name, version)
-    counters.set("DriftMonitor", "ModelVersion", version)
-    score_predictions = cfg.get_boolean("dm.score.predictions", False)
-    # load the artifact at most once: the schema and (when enabled) the
-    # predictor come from the same LoadedModel
-    loaded = None
-    if "dm.feature.schema.file.path" in cfg:
-        schema = FeatureSchema.load(
-            cfg.must_get("dm.feature.schema.file.path"))
-    else:
-        loaded = registry.load(name, version)
-        schema = loaded.schema
-        if schema is None:
-            raise ValueError(
-                f"model {name!r} v{version} embeds no schema; set "
-                "dm.feature.schema.file.path")
+    return version
 
+
+def _monitor_schema(cfg: Config, registry, name: str, version: int,
+                    loaded):
+    """``dm.feature.schema.file.path`` override wins; otherwise the
+    artifact's embedded schema.  Returns (schema, loaded) — the artifact
+    is loaded at most once across callers (pass what you already
+    have; stays None under an override a caller never needs more)."""
+    from ..core.schema import FeatureSchema
+    if "dm.feature.schema.file.path" in cfg:
+        return FeatureSchema.load(
+            cfg.must_get("dm.feature.schema.file.path")), loaded
+    if loaded is None:
+        loaded = registry.load(name, version)
+    schema = loaded.schema
+    if schema is None:
+        raise ValueError(
+            f"model {name!r} v{version} embeds no schema; set "
+            "dm.feature.schema.file.path")
+    return schema, loaded
+
+
+def _make_policy_monitor(cfg: Config, baseline, counters):
+    """The dm.* policy/monitor pair; returns (policy, monitor,
+    window_rows)."""
+    from ..monitor.accumulator import StreamDriftMonitor
+    from ..monitor.policy import DriftPolicy
     window_rows = cfg.get_int("dm.window.rows", 2048)
     policy = DriftPolicy(
         warn=_threshold_overrides(cfg, "dm.warn"),
@@ -172,80 +179,56 @@ def drift_monitor(cfg: Config, in_path: str, out_path: str) -> Counters:
         baseline, policy=policy, window_rows=window_rows,
         decay=cfg.get_float("dm.longterm.decay", 0.9),
         counters=counters)
+    return policy, monitor, window_rows
 
-    predictor = None
-    tracker = None
-    if score_predictions:
-        from ..serving.predictor import make_predictor
-        if loaded is None:
-            loaded = registry.load(name, version)
-        predictor = make_predictor(loaded, schema=schema).warm()
-        card = list(schema.class_attr_field.cardinality or [])
-        if len(card) >= 2 and (policy.accuracy_warn > 0
-                               or policy.accuracy_alert > 0):
-            # (neg, pos) = first two cardinality values, the reference's
-            # ConfusionMatrix convention
-            tracker = AccuracyTracker(
-                pos_class=card[1], neg_class=card[0], policy=policy,
-                window=cfg.get_int("dm.accuracy.window", window_rows))
-    cls_spec = baseline.specs[baseline.class_row]
 
+def _make_accuracy_tracker(cfg: Config, schema, policy, window_rows: int):
+    """(neg, pos) = first two cardinality values, the reference's
+    ConfusionMatrix convention; None when thresholds are off or the
+    class attribute is not binarizable."""
+    from ..monitor.policy import AccuracyTracker
+    card = list(schema.class_attr_field.cardinality or [])
+    if len(card) >= 2 and (policy.accuracy_warn > 0
+                           or policy.accuracy_alert > 0):
+        return AccuracyTracker(
+            pos_class=card[1], neg_class=card[0], policy=policy,
+            window=cfg.get_int("dm.accuracy.window", window_rows))
+    return None
+
+
+def _record_accuracy(tracker, cls_spec, table, labels) -> None:
+    """Predicted-vs-actual outcomes for rows whose class column holds a
+    KNOWN label (delayed-label rows with an unknown class are skipped)."""
+    if tracker is None:
+        return
+    actual_codes = np.asarray(table.class_codes())
+    card = cls_spec.labels or []
+    known = actual_codes >= 0
+    if known.any():
+        tracker.record(
+            [lab for lab, k in zip(labels, known) if k],
+            [card[c] for c, k in zip(actual_codes, known) if k])
+
+
+def _window_source(cfg: Config, in_path: str, window_rows: int):
     split = _splitter(cfg.field_delim_regex)
     source = cfg.get("dm.source", "file")
     if source == "file":
-        windows = _iter_line_windows(in_path, split, window_rows)
-    elif source == "resp":
-        windows = _iter_resp_windows(cfg, split, window_rows)
-    else:
-        raise ValueError(f"unknown dm.source {source!r} (file | resp)")
+        return _iter_line_windows(in_path, split, window_rows)
+    if source == "resp":
+        return _iter_resp_windows(cfg, split, window_rows)
+    raise ValueError(f"unknown dm.source {source!r} (file | resp)")
 
-    # output streams PER CLOSED WINDOW (a long-lived RESP drain must not
-    # retain every report in memory, and a killed job must not lose the
-    # windows it already scored); alerts.jsonl is created lazily on the
-    # first alert so a quiet run leaves no empty file behind
-    od = cfg.field_delim_out
-    os.makedirs(out_path, exist_ok=True)
-    alerts_path = os.path.join(out_path, "alerts.jsonl")
-    if os.path.exists(alerts_path):
-        # append-mode writes must not leave a previous run's alerts
-        # looking like this run's (the file's existence IS the signal)
-        os.remove(alerts_path)
 
-    def level_of(row) -> str:
-        level = "ok"
-        for stat in STATS:
-            if not row.applicable(stat):
-                continue
-            if row.stats[stat] >= policy.alert[stat]:
-                return "alert"
-            if row.stats[stat] >= policy.warn[stat]:
-                level = "warn"
-        return level
-
-    def drain(part_fh) -> None:
-        for report in monitor.reports:
-            for row in report.rows:
-                part_fh.write(od.join(
-                    [str(report.index), report.kind, row.scope, row.kind,
-                     str(report.n_rows)]
-                    + [repr(round(row.stats[s], 6)) for s in STATS]
-                    + [level_of(row)]) + "\n")
-        monitor.reports.clear()
-        if policy.alerts:
-            with open(alerts_path, "a") as fh:
-                for rec in policy.alerts:
-                    fh.write(rec.to_json() + "\n")
-            policy.alerts.clear()
-        part_fh.flush()
-
-    # a monitoring replay must survive its stream: malformed records
-    # (short rows, unparseable numerics — the native parser's ``bad``
-    # contract) default to badrecords.policy=skip here — counted in the
-    # Hadoop-style BadRecords group through the SAME BadRecordPolicy as
-    # every other ingest path (quarantine works too; lines re-join with
-    # the output delimiter) instead of killing the job mid-drain, where
-    # one bad token would lose every record already rpop'ed off a RESP
-    # queue.  badrecords.policy=fail restores the historic crash.
+def _make_bad_filter(cfg: Config, schema, out_path: str, counters):
+    """A monitoring replay must survive its stream: malformed records
+    (short rows, unparseable numerics — the native parser's ``bad``
+    contract) default to badrecords.policy=skip — counted in the
+    Hadoop-style BadRecords group through the SAME BadRecordPolicy as
+    every other ingest path (quarantine works too; lines re-join with
+    the output delimiter) instead of killing the job mid-drain, where
+    one bad token would lose every record already rpop'ed off a RESP
+    queue.  badrecords.policy=fail restores the historic crash."""
     from ..core.table import BadRecordPolicy, _bad_row_checker
     pol = cfg.get("badrecords.policy", "skip")
     qpath = cfg.get("badrecords.quarantine.path") or \
@@ -254,16 +237,103 @@ def drift_monitor(cfg: Config, in_path: str, out_path: str) -> Counters:
     if pol != "fail":
         bad_records = BadRecordPolicy(
             pol, qpath if pol == "quarantine" else None, counters)
-    is_bad = _bad_row_checker(schema)
+    return bad_records, _bad_row_checker(schema)
+
+
+def _filter_bad(rows, bad_records, is_bad, od: str):
+    if bad_records is None:
+        return rows
+    good = [r for r in rows if not is_bad(r)]
+    if len(good) < len(rows):
+        bad_records.record([od.join(r) for r in rows if is_bad(r)])
+    return good
+
+
+def _level_of(row, policy) -> str:
+    """This window's immediate warn/alert standing for one report row
+    (the debounced alert stream is the policy's, not this label's)."""
+    from ..monitor.drift import STATS
+    level = "ok"
+    for stat in STATS:
+        if not row.applicable(stat):
+            continue
+        if row.stats[stat] >= policy.alert[stat]:
+            return "alert"
+        if row.stats[stat] >= policy.warn[stat]:
+            level = "warn"
+    return level
+
+
+def _drain(monitor, policy, part_fh, alerts_path: str, od: str) -> None:
+    """Flush closed-window report rows + debounced alert records NOW (a
+    long-lived RESP drain must not retain every report in memory, and a
+    killed job must not lose the windows it already scored);
+    alerts.jsonl appears lazily on the first alert so a quiet run
+    leaves no empty file behind."""
+    from ..monitor.drift import STATS
+    for report in monitor.reports:
+        for row in report.rows:
+            part_fh.write(od.join(
+                [str(report.index), report.kind, row.scope, row.kind,
+                 str(report.n_rows)]
+                + [repr(round(row.stats[s], 6)) for s in STATS]
+                + [_level_of(row, policy)]) + "\n")
+    monitor.reports.clear()
+    if policy.alerts:
+        with open(alerts_path, "a") as fh:
+            for rec in policy.alerts:
+                fh.write(rec.to_json() + "\n")
+        policy.alerts.clear()
+    part_fh.flush()
+
+
+def _fresh_alerts_path(out_path: str) -> str:
+    # append-mode writes must not leave a previous run's alerts looking
+    # like this run's (the file's existence IS the signal)
+    path = os.path.join(out_path, "alerts.jsonl")
+    if os.path.exists(path):
+        os.remove(path)
+    return path
+
+
+@register("org.avenir.monitor.DriftMonitor", "driftMonitor", dist="refuse")
+def drift_monitor(cfg: Config, in_path: str, out_path: str) -> Counters:
+    from ..core.table import encode_rows
+    from ..monitor.baseline import load_baseline
+    from ..serving.registry import ModelRegistry
+
+    counters = Counters()
+    registry = ModelRegistry(cfg.must_get("dm.model.registry.dir"))
+    name = cfg.must_get("dm.model.name")
+    version = _resolve_model_version(cfg, registry, name)
+    baseline = load_baseline(registry, name, version)
+    counters.set("DriftMonitor", "ModelVersion", version)
+    score_predictions = cfg.get_boolean("dm.score.predictions", False)
+    # load the artifact at most once: the schema and (when enabled) the
+    # predictor come from the same LoadedModel
+    schema, loaded = _monitor_schema(cfg, registry, name, version, None)
+    policy, monitor, window_rows = _make_policy_monitor(cfg, baseline,
+                                                        counters)
+
+    predictor = None
+    tracker = None
+    if score_predictions:
+        from ..serving.predictor import make_predictor
+        if loaded is None:
+            loaded = registry.load(name, version)
+        predictor = make_predictor(loaded, schema=schema).warm()
+        tracker = _make_accuracy_tracker(cfg, schema, policy, window_rows)
+    cls_spec = baseline.specs[baseline.class_row]
+
+    windows = _window_source(cfg, in_path, window_rows)
+    od = cfg.field_delim_out
+    os.makedirs(out_path, exist_ok=True)
+    alerts_path = _fresh_alerts_path(out_path)
+    bad_records, is_bad = _make_bad_filter(cfg, schema, out_path, counters)
 
     with open(os.path.join(out_path, "part-r-00000"), "w") as part_fh:
         for rows in windows:
-            if bad_records is not None:
-                good = [r for r in rows if not is_bad(r)]
-                if len(good) < len(rows):
-                    bad_records.record(
-                        [od.join(r) for r in rows if is_bad(r)])
-                rows = good
+            rows = _filter_bad(rows, bad_records, is_bad, od)
             if not rows:
                 continue
             table = encode_rows(rows, schema)
@@ -273,23 +343,163 @@ def drift_monitor(cfg: Config, in_path: str, out_path: str) -> Counters:
                 # shared encoding with ServingMonitor: prediction-prior
                 # drift must score identically offline and live
                 class_codes = baseline.class_codes_for_labels(labels)
-                if tracker is not None:
-                    actual_codes = np.asarray(table.class_codes())
-                    card = cls_spec.labels or []
-                    known = actual_codes >= 0
-                    if known.any():
-                        tracker.record(
-                            [lab for lab, k in zip(labels, known) if k],
-                            [card[c] for c, k in zip(actual_codes, known)
-                             if k])
+                _record_accuracy(tracker, cls_spec, table, labels)
             monitor.observe_table(table, class_codes=class_codes)
-            drain(part_fh)
+            _drain(monitor, policy, part_fh, alerts_path, od)
         monitor.close_window()       # score the partial tail window
         if tracker is not None:
             tracker.close()
-        drain(part_fh)
+        _drain(monitor, policy, part_fh, alerts_path, od)
     # machine-readable counters: the universal <out>.counters.json
     # sibling cli.run writes for EVERY job (after the ledger/timer
     # export, so it is the complete final dump) replaced the job-local
     # <out>/counters.json this job used to write
+    return counters
+
+
+@register("org.avenir.monitor.PredictDriftScore", "predictDriftScore",
+          dist="refuse")
+def predict_drift_score(cfg: Config, in_path: str, out_path: str
+                        ) -> Counters:
+    """Combined ``predict + driftScore`` in ONE pass (TPU_NOTES §22).
+
+    Before the pipeline compiler this was two jobs and two passes over
+    the records: ``modelPredictor`` (predictions part file) then
+    ``driftMonitor`` with ``dm.score.predictions=true`` (drift report +
+    alerts).  Here every window runs ONE fused XLA program — the whole
+    ensemble vote AND the drift-monitor bin counting, the predicted
+    classes flowing device-to-device into the monitor's class row — via
+    ``pipeline.flows.PredictDriftFlow``; the window scores through the
+    IDENTICAL ``StreamDriftMonitor`` path as ``driftMonitor``, so both
+    artifacts are bit-identical to the two-job flow (pinned by
+    tests/test_pipeline.py) at strictly fewer launches per window.
+
+    Config: the ``dm.*`` keys of ``driftMonitor`` apply unchanged
+    (windows, thresholds, decay, debounce, accuracy, source, bad
+    records).  ``dm.pipeline.fuse=false`` forces the unfused (but still
+    single-pass) path; non-forest model kinds, degenerate ensembles, and
+    windows whose values are not float32-exact fall back to it per
+    window automatically — results identical, only launch counts differ.
+
+    Contract boundary: drift report rows, alert record CONTENTS, and
+    predictions are byte-identical to the two-job flow always.  The
+    interleave ORDER of accuracy vs drift alerts inside alerts.jsonl is
+    additionally byte-pinned except in one corner: this job records
+    delayed-label outcomes per exact re-filtered window, while
+    ``driftMonitor`` records them per raw input batch — so when skipped
+    bad records shift batch boundaries off window boundaries AND
+    ``dm.accuracy.window`` is smaller than ``dm.window.rows``, an
+    accuracy window crossing a drift-window boundary can drain on the
+    other side of that drift window's alert than it does there.
+
+    Output: ``<out>/part-r-00000`` drift rows + ``<out>/alerts.jsonl``
+    exactly as ``driftMonitor``; predictions land in
+    ``<out>/predictions/part-m-00000`` (``withRecord`` lines: the
+    record, the output delimiter, the predicted class — ``ambiguous``
+    for a min-odds veto — byte-identical to ``modelPredictor``'s
+    default mode)."""
+    from ..core.table import encode_rows
+    from ..monitor.baseline import load_baseline
+    from ..serving.registry import FOREST, ModelRegistry
+
+    counters = Counters()
+    registry = ModelRegistry(cfg.must_get("dm.model.registry.dir"))
+    name = cfg.must_get("dm.model.name")
+    version = _resolve_model_version(cfg, registry, name)
+    baseline = load_baseline(registry, name, version)
+    counters.set("DriftMonitor", "ModelVersion", version)
+    loaded = registry.load(name, version)
+    schema, loaded = _monitor_schema(cfg, registry, name, version, loaded)
+    policy, monitor, window_rows = _make_policy_monitor(cfg, baseline,
+                                                        counters)
+    tracker = _make_accuracy_tracker(cfg, schema, policy, window_rows)
+    cls_spec = baseline.specs[baseline.class_row]
+
+    # the fused flow (forest ensembles); anything else predicts through
+    # the serving predictor per window — same results, more launches
+    flow = None
+    if loaded.kind == FOREST and len(loaded.model) > 1:
+        from ..models.forest import EnsembleModel
+        from ..models.tree import DecisionTreeModel
+        p = loaded.params
+        min_odds = float(p.get("min_odds_ratio", 1.0))
+        ensemble = EnsembleModel(
+            [DecisionTreeModel(pl, schema) for pl in loaded.model],
+            weights=p.get("weights"), min_odds_ratio=min_odds,
+            # modelPredictor's exact rule, applied whether or not the
+            # fused flow runs: an even unweighted forest must REFUSE
+            # here too, not silently tie-break predictions the
+            # byte-identity contract says cannot exist
+            require_odd=min_odds <= 1.0 and p.get("weights") is None)
+        if cfg.get_boolean("dm.pipeline.fuse", True):
+            from ..pipeline.flows import PredictDriftFlow
+            flow = PredictDriftFlow(ensemble, baseline, schema,
+                                    window_rows)
+    predictor = None
+
+    def fallback_labels(rows):
+        nonlocal predictor
+        if predictor is None:
+            from ..serving.predictor import make_predictor
+            predictor = make_predictor(loaded, schema=schema)
+        return predictor.predict_rows(rows)
+
+    batches = _window_source(cfg, in_path, window_rows)
+    bad_records, is_bad = _make_bad_filter(cfg, schema, out_path, counters)
+
+    od = cfg.field_delim_out
+    os.makedirs(out_path, exist_ok=True)
+    pred_dir = os.path.join(out_path, "predictions")
+    os.makedirs(pred_dir, exist_ok=True)
+    alerts_path = _fresh_alerts_path(out_path)
+
+    fused_windows = unfused_windows = 0
+
+    def process_window(rows, part_fh, pred_fh) -> None:
+        nonlocal fused_windows, unfused_windows
+        table = encode_rows(rows, schema)
+        res = flow.run_window(table) if flow is not None else None
+        labels = res[0] if res is not None else fallback_labels(rows)
+        # accuracy BEFORE the window closes: driftMonitor records a
+        # batch's outcomes ahead of observe_table, so a window where an
+        # accuracy alert and a drift alert both fire must drain them in
+        # that same order (alerts.jsonl is byte-pinned against the
+        # two-job flow)
+        _record_accuracy(tracker, cls_spec, table, labels)
+        if res is not None:
+            fused_windows += 1
+            monitor.close_counts(res[1], table.n_rows)
+        else:
+            unfused_windows += 1
+            monitor.observe_table(
+                table,
+                class_codes=baseline.class_codes_for_labels(labels))
+            monitor.close_window()  # no-op when the absorb auto-closed
+        for r, lab in zip(rows, labels):
+            pred_fh.write(od.join(r) + od
+                          + (lab if lab is not None else "ambiguous")
+                          + "\n")
+        _drain(monitor, policy, part_fh, alerts_path, od)
+        pred_fh.flush()
+
+    # re-window AFTER bad-record filtering so window boundaries (and
+    # therefore every report row) match driftMonitor's accumulate-
+    # across-batches semantics exactly
+    pending: List[List[str]] = []
+    with open(os.path.join(out_path, "part-r-00000"), "w") as part_fh, \
+            open(os.path.join(pred_dir, "part-m-00000"), "w") as pred_fh:
+        for rows in batches:
+            pending.extend(_filter_bad(rows, bad_records, is_bad, od))
+            while len(pending) >= window_rows:
+                process_window(pending[:window_rows], part_fh, pred_fh)
+                pending = pending[window_rows:]
+        if pending:
+            process_window(pending, part_fh, pred_fh)
+        if tracker is not None:
+            tracker.close()
+        _drain(monitor, policy, part_fh, alerts_path, od)
+    counters.set("PredictDrift", "FusedWindows", fused_windows)
+    counters.set("PredictDrift", "UnfusedWindows", unfused_windows)
+    if flow is not None:
+        flow.export(counters)
     return counters

@@ -745,7 +745,16 @@ def prefetch_chunks(chunks, depth: int = 1, stats: Optional[dict] = None,
         chains), pass ``consumer_wait_key=None`` here: the downstream
         layer's producer already times this layer's q.get as its own
         upstream wait, and booking the same wall time twice would
-        misattribute parse starvation as final-consumer starvation."""
+        misattribute parse starvation as final-consumer starvation.
+
+    Producer failures are re-raised on the consumer side in stream order
+    (exactly once), and the moment one happens the producer ALSO records
+    ``stats['producer_error']`` = ``"ExcType: message"`` and
+    ``stats['producer_error_thread']`` = the producer thread's name —
+    so anything watching the stats dict (an operator polling a stuck
+    job, the queue_wait decomposition) can tell a CRASHED producer from
+    a merely slow one without waiting for the consumer to drain the
+    queue and hit the raise."""
     import queue
     import threading
     import time as _time
@@ -802,6 +811,12 @@ def prefetch_chunks(chunks, depth: int = 1, stats: Optional[dict] = None,
                     break
         except BaseException as exc:  # surfaced on the consumer side
             failure.append(exc)
+            if stats is not None:
+                # visible IMMEDIATELY (not at join): a crashed producer
+                # and a slow one otherwise look identical from the
+                # consumer's queue_wait accounting until the raise lands
+                stats["producer_error"] = f"{type(exc).__name__}: {exc}"
+                stats["producer_error_thread"] = thread_name
         finally:
             close = getattr(it, "close", None)
             if close is not None:  # release the source NOW (native reader

@@ -203,7 +203,7 @@ class ForestBuilder:
         chunk = level_chunk(n_nodes, T, S, B, C, self._w_max)
         n = base.n_padded
         if n <= chunk:
-            note_dispatch()
+            note_dispatch(site="forest.level")
             c = kernel(node_ids, base.branches, base.cls_codes, weights,
                        n_nodes)
             return base._reduce_counts(fetch(c, dtype=np.float64))
@@ -213,7 +213,7 @@ class ForestBuilder:
             nid, br, cc, ww = _pad_chunk(
                 chunk, node_ids[start:end], base.branches[start:end],
                 base.cls_codes[start:end], weights[start:end])
-            note_dispatch(2)  # count kernel + device accumulate
+            note_dispatch(2, site="forest.level")  # count + accumulate
             c = kernel(nid, br, cc, ww, n_nodes)
             acc = c.astype(jnp.int32) if acc is None \
                 else acc_counts(acc, c)
@@ -237,7 +237,7 @@ class ForestBuilder:
         chunk = level_chunk(n_new + n_prev + S + B, T, S, B, C, self._w_max)
         n = base.n_padded
         if n <= chunk:
-            note_dispatch()
+            note_dispatch(site="forest.level")
             new_ids, c = fused(node_ids, base.branches, base.cls_codes,
                                weights, sel, ctab, n_new)
             # ONE stacked (T, N, S, B, C) transfer per level for the whole
@@ -250,7 +250,7 @@ class ForestBuilder:
             nid, br, cc, ww = _pad_chunk(
                 chunk, node_ids[start:end], base.branches[start:end],
                 base.cls_codes[start:end], weights[start:end])
-            note_dispatch(2)  # fused level kernel + device accumulate
+            note_dispatch(2, site="forest.level")  # fused level + accumulate
             ni, c = fused(nid, br, cc, ww, sel, ctab, n_new)
             ids_parts.append(ni[:end - start])
             acc = c.astype(jnp.int32) if acc is None \
@@ -377,7 +377,8 @@ def build_forest_from_stream(blocks, schema, params: ForestParams,
                              stats: Optional[dict] = None,
                              checkpoint=None, checkpoint_every: int = 0,
                              resume_state=None,
-                             reducer=None) -> List[DecisionPathList]:
+                             reducer=None, baseline=None,
+                             fuse: bool = True) -> List[DecisionPathList]:
     """Train the forest from an iterator of ColumnarTable row blocks — the
     streaming CSV->device ingest pipeline's training entry.  Each block is
     encoded to branch/class codes on device and released, so host memory
@@ -406,7 +407,15 @@ def build_forest_from_stream(blocks, schema, params: ForestParams,
     shard (``iter_csv_chunks(shard=reducer.spec)``); every tree level
     pays exactly ONE all-reduce of the stacked (T, N, S, B, C) count
     matrix, and every process returns the identical forest, bit-identical
-    to the single-host build (TPU_NOTES §20)."""
+    to the single-host build (TPU_NOTES §20).
+
+    ``baseline``/``fuse`` thread to ``TreeBuilder.from_stream``
+    (TPU_NOTES §22): with ``fuse=True`` (default) the per-chunk encode —
+    and, when a ``BaselineBuilder`` rides along, its bin-count absorb —
+    run as ONE ProgramCache-compiled XLA launch per chunk;
+    ``fuse=False`` keeps the eager per-stage path (``baseline`` then
+    tees the stream host-side).  Models and baseline are bit-identical
+    either way."""
     import time as _time
     t0 = _time.perf_counter()
     base = TreeBuilder.from_stream(blocks, schema,
@@ -415,7 +424,8 @@ def build_forest_from_stream(blocks, schema, params: ForestParams,
                                    checkpoint=checkpoint,
                                    checkpoint_every=checkpoint_every,
                                    resume_state=resume_state,
-                                   reducer=reducer)
+                                   reducer=reducer, baseline=baseline,
+                                   fuse=fuse)
     t1 = _time.perf_counter()
     models = ForestBuilder(None, params, ctx, base=base).build_all()
     if stats is not None:
@@ -574,7 +584,7 @@ class EnsembleModel:
         chunk = max(1024, (1 << 26) // per_row)
         out = []
         for s in range(0, n, chunk):
-            note_dispatch()
+            note_dispatch(site="ensemble.vote")
             out.append(kernel(d_vals[s:s + chunk], d_codes[s:s + chunk],
                               *consts, wvec,
                               jnp.float32(self.min_odds_ratio)))
@@ -584,7 +594,7 @@ class EnsembleModel:
         if len(out) == 1:
             idx = fetch(out[0])
         else:
-            note_dispatch()  # the concat is a real launch too
+            note_dispatch(site="ensemble.vote")  # the concat launches too
             idx = fetch(jnp.concatenate(out))
         return list(self._lut[idx])
 

@@ -340,19 +340,20 @@ class SplitSet:
         Delegates to the module-level jitted kernel so every SplitSet instance
         of the same shape shares ONE compiled program (a per-instance
         ``jax.jit`` used to recompile ~25 s per builder on the tunneled TPU)."""
+        note_dispatch(site="ingest.encode")
         return _branch_codes_kernel(X, jnp.asarray(self.attr_col),
                                     jnp.asarray(self.thresholds),
                                     jnp.asarray(self.cat_table),
                                     jnp.asarray(self.is_cat))
 
 
-@jax.jit
-def _branch_codes_kernel(X, attr_col, thresholds, cat_table, is_cat):
-    """Shared compiled branch evaluator (see SplitSet.branch_codes).  All
-    split-set constants arrive as arrays so the jit cache keys on shapes,
+def _branch_codes_body(X, attr_col, thresholds, cat_table, is_cat):
+    """The branch evaluator's pure body — shared VERBATIM by the eager
+    jit below and the fused ingest pipeline stage (one implementation,
+    so fused and unfused streams are bit-identical by construction).
+    All split-set constants arrive as arrays so callers key on shapes,
     and X may arrive int16 (feature_matrix's narrow wire format) — the
-    device upcast below is lossless,
-    not on Python object identity."""
+    device upcast below is lossless."""
     # upcast BEFORE the column gather: int16 is not a native TPU compute
     # type, and gathering it lowers far worse than gathering f32
     vals = X.astype(jnp.float32)[:, attr_col]                # (n, S)
@@ -363,6 +364,38 @@ def _branch_codes_kernel(X, attr_col, thresholds, cat_table, is_cat):
     cat_branch = cat_table[
         jnp.arange(thresholds.shape[0])[None, :], safe]      # (n, S)
     return jnp.where(is_cat[None, :], cat_branch, num_branch)
+
+
+# shared compiled form (see SplitSet.branch_codes): module-level jit so
+# every SplitSet instance of the same shape shares one compiled program
+_branch_codes_kernel = jax.jit(_branch_codes_body)
+
+
+def _encode_stage(split_set: SplitSet, cls_ordinal: int):
+    """The streaming build's encode stage for the pipeline compiler:
+    host half = feature matrix + class codes (runs on the staging
+    thread), device half = the exact ``_branch_codes_body``.  Split-set
+    tensors travel as stage CONSTANTS (runtime arguments of the fused
+    program), so two builders over the same schema/shapes share ONE
+    compiled executable — the Execution Templates split between staged
+    program and parameters (TPU_NOTES §22)."""
+    from ..pipeline.compiler import Stage
+    consts = {"attr_col": jnp.asarray(split_set.attr_col),
+              "thresholds": jnp.asarray(split_set.thresholds),
+              "cat_table": jnp.asarray(split_set.cat_table),
+              "is_cat": jnp.asarray(split_set.is_cat)}
+
+    def prepare(block):
+        return {"X": split_set.feature_matrix(block),
+                "cls": block.columns[cls_ordinal].astype(np.int32)}
+
+    def kernel(carry, consts, inputs, upstream):
+        return carry, {"branches": _branch_codes_body(
+            inputs["X"], consts["attr_col"], consts["thresholds"],
+            consts["cat_table"], consts["is_cat"])}
+
+    return Stage(name="encode", kernel=kernel, version="1",
+                 prepare=prepare, consts=consts, returns=("branches",))
 
 
 # --------------------------------------------------------------------------
@@ -659,7 +692,8 @@ class TreeBuilder:
                     splits: Optional[List[CandidateSplit]] = None,
                     stats: Optional[dict] = None,
                     checkpoint=None, checkpoint_every: int = 0,
-                    resume_state=None, reducer=None) -> "TreeBuilder":
+                    resume_state=None, reducer=None,
+                    baseline=None, fuse: bool = True) -> "TreeBuilder":
         """Build the device-resident state from an iterator of ColumnarTable
         row blocks instead of one assembled table — the consume stage of
         the streaming CSV->device ingest pipeline.
@@ -718,7 +752,22 @@ class TreeBuilder:
         processes than blocks) participates with empty arrays.
         Checkpoints persist the shard spec; resume refuses a changed
         process count (the file would be re-partitioned around the saved
-        state)."""
+        state).
+
+        Pipeline compiler (``fuse=True``, the default — TPU_NOTES §22):
+        the per-chunk device work runs as ONE fused XLA program through
+        ``avenir_tpu.pipeline.ChunkPipeline`` — the branch-code encode
+        plus (with ``baseline``, a ``monitor.baseline.BaselineBuilder``)
+        the baseline's bin-count absorb with a DONATED device-resident
+        count carry — compiled once per argument signature and cached in
+        the process-global ``ProgramCache`` (0 retraces on a warm
+        re-run; ``stats['pipeline']`` reports this run's
+        chunks/hits/misses/retraces).  ``fuse=False`` keeps the eager
+        per-stage path: ``baseline`` then tees the block stream exactly
+        like the historic ``tee_blocks`` consumer.  Branch codes, class
+        codes, the trained model, and the finalized baseline are
+        bit-identical either way (pinned by tests/test_pipeline.py);
+        only the launch count per chunk differs."""
         import time as _time
         self = cls.__new__(cls)
         if reducer is not None and ctx is None:
@@ -781,6 +830,27 @@ class TreeBuilder:
             n_rows = int(meta["n_rows"])
             blocks_done = int(meta.get("blocks_done", 0))
             source_rows_done = meta.get("source_rows_done")
+        pipeline = None
+        if fuse:
+            # the fused per-chunk program (TPU_NOTES §22): encode (+
+            # optional baseline absorb) as ONE cached XLA launch per
+            # chunk, intermediates device-resident
+            from ..pipeline import (ChunkPipeline, mesh_fingerprint,
+                                    schema_fingerprint)
+            pl_stages = [_encode_stage(self.split_set, cls_ord)]
+            if baseline is not None:
+                pl_stages.append(baseline.as_stage())
+            pipeline = ChunkPipeline(
+                pl_stages, ctx=self.ctx,
+                schema_fp=schema_fingerprint(schema),
+                mesh_fp=mesh_fingerprint(self.ctx, reducer),
+                name="rf-ingest")
+        elif baseline is not None:
+            # unfused: the historic host-side tee — the baseline rides
+            # the same single pass as a second consumer of each block
+            from ..monitor.baseline import tee_blocks
+            blocks = tee_blocks(blocks, baseline)
+
         def _stage(block):
             """Staging-thread half of the ingest: host encode + padded
             device upload of ONE block (its time lands in
@@ -798,16 +868,40 @@ class TreeBuilder:
             mask[:bn] = 1.0
             Xd = self.ctx.shard_rows_streamed(X)
             ccd = self.ctx.shard_rows_streamed(cc)
-            return (Xd, ccd, mask, bn,
+            return ((Xd, ccd), mask, bn,
                     getattr(block, "source_row_end", None))
 
-        for Xd, ccd, mask, bn, src_end in stage_chunks(
-                blocks, _stage, depth=2, stats=stats):
+        def _stage_fused(block):
+            """Pipeline twin of ``_stage``: every stage's host prepare
+            (feature matrix, class codes, monitor codes) runs here, all
+            arrays pad uniformly to the mesh alignment, and the merged
+            input dict uploads onto the staging thread's own buffers."""
+            bn = block.n_rows
+            pad = (-bn) % align
+            host = pipeline.prepare(block)
+            if pad:
+                host = {k: np.pad(v, ((0, pad),) + ((0, 0),) * (v.ndim - 1))
+                        for k, v in host.items()}
+            mask = np.zeros((bn + pad,), dtype=np.float32)
+            mask[:bn] = 1.0
+            host["mask"] = mask
+            return (pipeline.upload(host), mask, bn,
+                    getattr(block, "source_row_end", None))
+
+        for dev, mask, bn, src_end in stage_chunks(
+                blocks, _stage_fused if pipeline is not None else _stage,
+                depth=2, stats=stats):
             t0 = _time.perf_counter()
             with span("device.compute", cat="compute", block=blocks_done,
                       rows=bn):
-                br_parts.append(self.split_set.branch_codes(Xd))
-            cls_parts.append(ccd)
+                if pipeline is not None:
+                    outs = pipeline.run_chunk(dev)
+                    br_parts.append(outs["encode.branches"])
+                    cls_parts.append(dev["cls"])
+                else:
+                    Xd, ccd = dev
+                    br_parts.append(self.split_set.branch_codes(Xd))
+                    cls_parts.append(ccd)
             mask_parts.append(mask)
             n_rows += bn
             blocks_done += 1
@@ -868,10 +962,18 @@ class TreeBuilder:
         self.X = None
         with span("device.compute", cat="compute", phase="final_sync"):
             jax.block_until_ready((self.branches, self.cls_codes))
+        if pipeline is not None:
+            # hand each stage its final donated carry (the baseline's
+            # accumulated device counts install back into its builder)
+            pipeline.finalize()
         t_compute += _time.perf_counter() - t0
         if stats is not None:
             stats["ingest_compute_s"] = (stats.get("ingest_compute_s", 0.0)
                                          + t_compute)
+            if pipeline is not None:
+                # per-run program-cache tallies: the warm-re-run
+                # "0 retraces" acceptance counter reads these
+                stats["pipeline"] = pipeline.run_stats()
 
         S, B, C = self.split_set.n_splits, self.split_set.max_branches, self.C
         self._count_kernel = _jitted_level_count_kernel(S, B, C)
@@ -983,7 +1085,7 @@ class TreeBuilder:
             acc = None
             for start in range(0, n, chunk):
                 end = min(start + chunk, n)
-                note_dispatch(2)  # count kernel + device accumulate
+                note_dispatch(2, site="tree.level")  # count + accumulate
                 c = self._count_kernel(
                     node_ids[start:end], self.branches[start:end],
                     self.cls_codes[start:end], weights[start:end], n_nodes)
@@ -991,14 +1093,14 @@ class TreeBuilder:
                     else acc_counts(acc, c)
             return self._reduce_counts(fetch(acc, dtype=np.float64))
         if n <= chunk:
-            note_dispatch()
+            note_dispatch(site="tree.level")
             c = self._count_kernel(node_ids, self.branches, self.cls_codes,
                                    weights, n_nodes)
             return self._reduce_counts(fetch(c, dtype=np.float64))
         total = np.zeros((n_nodes, S, B, C), dtype=np.float64)
         for start in range(0, n, chunk):
             end = min(start + chunk, n)
-            note_dispatch()
+            note_dispatch(site="tree.level")
             c = self._count_kernel(node_ids[start:end], self.branches[start:end],
                                    self.cls_codes[start:end], weights[start:end],
                                    n_nodes)
@@ -1085,7 +1187,7 @@ class TreeBuilder:
         counts = self.level_counts(node_ids, weights, len(active))
         new_leaves, stopped_paths, sel_split, child_table = \
             self._choose_splits(active, counts)
-        note_dispatch()
+        note_dispatch(site="tree.reassign")
         node_ids = self._reassign_kernel(
             node_ids, self.branches,
             self.ctx.replicate(jnp.asarray(sel_split)),

@@ -97,6 +97,7 @@ class DriftAccumulator:
         n = codes.shape[0]
         if n == 0:
             return
+        from ..utils.tracing import note_dispatch
         top = self.buckets[-1]
         for s in range(0, n, top):
             chunk = codes[s:s + top]
@@ -107,6 +108,7 @@ class DriftAccumulator:
                     [chunk, np.zeros((b - m, chunk.shape[1]), chunk.dtype)])
             mask = np.zeros((b,), dtype=bool)
             mask[:m] = True
+            note_dispatch(site="monitor.absorb")
             self._counts = self._update(self._counts, jnp.asarray(chunk),
                                         jnp.asarray(mask))
         self._n += n
@@ -211,6 +213,27 @@ class StreamDriftMonitor:
         if self.acc.n_rows == 0 and not force:
             return None
         counts, n = self.acc.finalize()
+        return self._close(counts, n)
+
+    def close_counts(self, counts: np.ndarray, n: int
+                     ) -> Optional[DriftReport]:
+        """Close one EXTERNALLY-accumulated window — the fused pipeline's
+        per-chunk (R, B) count matrix (pipeline.flows.PredictDriftFlow)
+        enters here and then rides the IDENTICAL scoring / long-window
+        decay / policy path as :meth:`close_window`, which is what makes
+        the fused job's reports bit-identical to the unfused ones.
+        Refuses while the internal accumulator holds rows (interleaving
+        the two absorb paths would split a window's counts)."""
+        if self.acc.n_rows:
+            raise ValueError(
+                f"close_counts with {self.acc.n_rows} internally "
+                f"accumulated rows pending — one window must use one "
+                f"absorb path")
+        if n == 0:
+            return None
+        return self._close(np.asarray(counts, dtype=np.float64), int(n))
+
+    def _close(self, counts: np.ndarray, n: int) -> DriftReport:
         now = time.monotonic()
         report = self.scorer.score_counts(counts, n, index=self._index,
                                           kind="window")

@@ -287,14 +287,62 @@ class BaselineBuilder:
                mask: Optional[np.ndarray] = None) -> "BaselineBuilder":
         import jax.numpy as jnp
         from ..ops.histogram import feature_bin_counts
+        from ..utils.tracing import note_dispatch
         resolve_spec_bounds(self.specs, table, self.n_bins)
         self._ensure_state()
         codes = encode_monitor_codes(table, self.specs)
         m = jnp.asarray(mask) if mask is not None else None
+        note_dispatch(site="baseline.absorb")
         self._counts = self._counts + feature_bin_counts(
             jnp.asarray(codes), self._counts.shape[1], m)
         self._n += table.n_rows if mask is None else int(np.sum(mask))
         return self
+
+    def as_stage(self):
+        """This builder as a fused-pipeline stage (TPU_NOTES §22): the
+        monitor-code encode stays host-side on the staging thread (the
+        float64 clip/floor arithmetic is the bit-identity anchor shared
+        with :meth:`update`), the bin counting joins the chunk's ONE
+        fused launch, and the (R, B) count matrix lives as a DONATED
+        device carry updated in place per chunk.  ``finish`` installs
+        the final carry back here, so :meth:`finalize` (and
+        :func:`allreduce_partials`) work unchanged.  Counts are
+        integer-exact f32 sums, so fused and tee'd baselines finalize
+        byte-identically (tests/test_pipeline.py)."""
+        from ..pipeline.compiler import Stage
+        # unresolved numeric specs (schema without min/max) resolve to
+        # exactly ``self.n_bins`` bins on the first chunk, so the carry
+        # width is known BEFORE the stream starts — same b_max the tee
+        # path's lazy _ensure_state computes after resolution
+        b_max = max([s.n_bins for s in self.specs]
+                    + ([self.n_bins] if any(
+                        s.kind == NUMERIC and s.n_bins == 0
+                        for s in self.specs) else []))
+        builder = self
+
+        def prepare(table):
+            resolve_spec_bounds(builder.specs, table, builder.n_bins)
+            builder._n += table.n_rows
+            return {"mon_codes": encode_monitor_codes(table, builder.specs)}
+
+        def kernel(carry, consts, inputs, upstream):
+            from ..ops.histogram import feature_bin_counts
+            return carry + feature_bin_counts(
+                inputs["mon_codes"], b_max, inputs["mask"] > 0), {}
+
+        def carry_init():
+            import jax.numpy as jnp
+            return jnp.zeros((len(builder.specs), b_max), jnp.float32)
+
+        def finish(carry):
+            builder._counts = carry
+
+        # b_max is traced STATICALLY into the kernel, so it is part of
+        # the stage fingerprint (the ProgramCache must miss when a
+        # different bin budget produces the same array shapes elsewhere)
+        return Stage(name="monitor-absorb", kernel=kernel,
+                     version=f"1:b{b_max}", prepare=prepare,
+                     carry_init=carry_init, finish=finish)
 
     def finalize(self) -> Baseline:
         """Host sync: pull the device counts once, derive quantiles."""

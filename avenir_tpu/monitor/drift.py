@@ -143,6 +143,8 @@ class DriftScorer:
             raise ValueError(
                 f"window shape {window_counts.shape} does not match "
                 f"baseline {self.baseline.counts.shape}")
+        from ..utils.tracing import note_dispatch
+        note_dispatch(site="drift.score")
         mat = np.asarray(self._kernel(
             jnp.asarray(window_counts, jnp.float32)))
         report = DriftReport(index=index, kind=kind, n_rows=int(n_rows))

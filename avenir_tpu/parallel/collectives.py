@@ -278,6 +278,14 @@ class AllReducer:
         self._nonce = uuid.uuid4().hex   # this run's identity on the wire
         self._peers = None         # idx -> nonce, set by _ensure_handshake
 
+    def fingerprint(self) -> str:
+        """Topology identity for the pipeline ProgramCache key (TPU_NOTES
+        §22): shard count + transport, NOT the shard index — every shard
+        of one run compiles the identical per-chunk program, while a run
+        under a different process count must miss (its collective
+        schedule differs even though the local program body matches)."""
+        return f"shards{self.spec.count}:{self.transport}"
+
     # ---- stall detection (the heartbeat half of the observability
     # contract: a dead peer is NAMED long before the hard timeout) ----
     def _emit_stall(self, phase: str, step: int, missing,

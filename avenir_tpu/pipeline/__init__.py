@@ -1,0 +1,29 @@
+"""Pipeline compiler (TPU_NOTES §22): fuse multi-stage chunk jobs into
+ONE cached XLA program per chunk with device-resident intermediates.
+
+Three pieces:
+
+* :mod:`.compiler` — :class:`Stage` (one stage of a fused per-chunk
+  program: pure kernel + host ``prepare`` + donated carry + declared
+  returns) and :class:`ChunkPipeline` (composes a stage list into one
+  jitted/AOT-compiled per-chunk function, dispatched as ONE launch per
+  chunk, with per-run cache tallies for the job counters).
+* :mod:`.cache` — :class:`ProgramCache`, the Execution Templates
+  control plane: lowered/compiled executables keyed by (stage graph,
+  schema fingerprint, argument shapes/dtypes, mesh spec), process-global
+  so repeated jobs re-trace nothing, optionally persisted across
+  processes via ``jax.jit`` AOT serialization.
+* :mod:`.flows` — prebuilt fused flows: :class:`PredictDriftFlow`
+  (ensemble vote + drift-window absorb in one program — the combined
+  ``predictDriftScore`` CLI job's core).
+
+The streaming RF build's per-chunk encode(+baseline-absorb) path
+(``models/tree.TreeBuilder.from_stream``) is built on the same layer.
+"""
+
+from .cache import (ProgramCache, mesh_fingerprint, program_cache,
+                    schema_fingerprint)
+from .compiler import ChunkPipeline, Stage
+
+__all__ = ["Stage", "ChunkPipeline", "ProgramCache", "program_cache",
+           "schema_fingerprint", "mesh_fingerprint"]

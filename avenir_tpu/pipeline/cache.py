@@ -1,0 +1,246 @@
+"""ProgramCache: the Execution Templates control plane for fused chunk
+programs (PAPERS.md — cache the staged program + buffer plan so repeated
+jobs skip re-tracing/re-validation).
+
+A fused per-chunk program is keyed by everything that determines its
+lowered XLA form and NOTHING else:
+
+  * the **stage graph fingerprint** — ordered ``name:version`` chain of
+    the composed stages (the program's structure);
+  * the **schema fingerprint** — sha256 over the canonical schema dict
+    (stage constants like split thresholds or ensemble predicate
+    tensors are runtime *arguments*, so two jobs over the same schema
+    shape share one executable even when the learned values differ —
+    that is the whole point of the template split);
+  * the **argument signature** — flattened (shape, dtype) of every
+    carry, constant, and per-chunk input;
+  * the **mesh fingerprint** — device count, platform, axis names, and
+    (sharded runs) the shard spec transport identity.
+
+Changing any of the four MISSES (and compiles fresh); an identical
+re-run HITS with zero retraces — pinned by tests/test_pipeline.py via
+the cache's own counters.
+
+The cache is process-global (:func:`program_cache`) so repeated job
+invocations in one process skip re-tracing entirely, and — where the
+backend allows — entries persist ACROSS processes through ``jax.jit``
+AOT ``lower()/compile()`` + ``jax.experimental.serialize_executable``
+into ``AVENIR_TPU_PROGRAM_CACHE_DIR`` (off by default; a backend or
+pickle refusal degrades to in-memory with one warning, never an
+error).  Telemetry: a compile records a ``pipeline.compile`` span, a
+key served from cache records a ``pipeline.cache_hit`` instant.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import warnings
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from ..telemetry import instant, span
+
+DEFAULT_MAXSIZE = 64
+_PERSIST_ENV = "AVENIR_TPU_PROGRAM_CACHE_DIR"
+
+
+def schema_fingerprint(schema) -> str:
+    """sha256 over the canonical schema dict — the data-layout half of a
+    program key (same schema => same encode/monitor shapes)."""
+    payload = json.dumps(schema.to_dict(), sort_keys=True)
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+def mesh_fingerprint(ctx, reducer=None) -> str:
+    """The placement half of a program key: a compiled executable is
+    specialized to its device set, and a sharded run's program must not
+    be confused with a monolithic one (the shard count changes the
+    collective schedule even though the per-chunk program is local)."""
+    mesh = getattr(ctx, "mesh", None)
+    axes = tuple(getattr(mesh, "axis_names", ()) or ())
+    parts = [f"d{ctx.n_devices}", ctx.device_platform, "x".join(axes)]
+    if reducer is not None and getattr(reducer, "spec", None) is not None:
+        parts.append(reducer.fingerprint())
+    return ":".join(parts)
+
+
+def _arg_signature(tree) -> Tuple:
+    """Flattened (path-free) (shape, dtype) signature of a pytree of
+    arrays — the shape/dtype-set component of a program key."""
+    import jax
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    return (str(treedef),
+            tuple((tuple(getattr(l, "shape", ())),
+                   str(getattr(l, "dtype", type(l).__name__)))
+                  for l in leaves))
+
+
+class _Entry:
+    __slots__ = ("compiled", "from_disk")
+
+    def __init__(self, compiled, from_disk: bool = False):
+        self.compiled = compiled
+        self.from_disk = from_disk
+
+
+class ProgramCache:
+    """LRU cache of AOT-compiled fused chunk programs.
+
+    ``get_or_compile(key, build, args)`` returns a compiled executable:
+    a hit is a dict lookup; a miss calls ``build()`` for the jitted
+    function, then ``lower(*args).compile()`` under a
+    ``pipeline.compile`` span.  ``build`` must close over NO arrays —
+    every tensor reaches the program as an argument, so a cached
+    executable is valid for any caller whose key matches.
+
+    Thread-safe (thread-simulated shard tests share the process-global
+    instance); compiled executables themselves are safe to invoke
+    concurrently."""
+
+    def __init__(self, maxsize: int = DEFAULT_MAXSIZE,
+                 persist_dir: Optional[str] = None):
+        self.maxsize = int(maxsize)
+        self.persist_dir = persist_dir if persist_dir is not None \
+            else (os.environ.get(_PERSIST_ENV) or None)
+        self._entries: "OrderedDict[Tuple, _Entry]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.retraces = 0   # true compiles (disk hits are misses, not retraces)
+        self.disk_hits = 0
+        self.disk_stores = 0
+        self._disk_warned = False
+
+    # ---- stats ----
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses,
+                    "retraces": self.retraces, "disk_hits": self.disk_hits,
+                    "disk_stores": self.disk_stores,
+                    "entries": len(self._entries)}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+    def invalidate(self, key: Tuple) -> None:
+        with self._lock:
+            self._entries.pop(key, None)
+
+    # ---- the control plane ----
+    def get_or_compile(self, key: Tuple, build: Callable[[], Any],
+                       args: Tuple,
+                       on_outcome: Optional[Callable[[str], None]] = None
+                       ) -> Any:
+        """The one entry: ``key`` hashable, ``build()`` -> a ``jax.jit``
+        wrapper (donation flags and all), ``args`` the first chunk's
+        concrete argument tuple (shapes/dtypes define the lowering).
+
+        ``on_outcome`` (if given) is called once with how THIS call
+        resolved — ``"hit"`` | ``"disk"`` | ``"compile"`` — which is how
+        a per-run tally (ChunkPipeline's) stays correct when concurrent
+        pipelines share the process-global cache: diffing the shared
+        ``stats()`` around the call would absorb the other threads'
+        resolutions into this caller's numbers."""
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+        if ent is not None:
+            if on_outcome is not None:
+                on_outcome("hit")
+            instant("pipeline.cache_hit", cat="pipeline",
+                    key=_short_key(key))
+            return ent.compiled
+        # miss: compile OUTSIDE the lock (compiles are seconds; two
+        # threads racing the same key is one redundant compile, last
+        # writer wins — same answer either way)
+        compiled, from_disk = self._load_from_disk(key)
+        if compiled is None:
+            with span("pipeline.compile", cat="pipeline",
+                      key=_short_key(key)):
+                compiled = build().lower(*args).compile()
+            self._store_to_disk(key, compiled)
+        with self._lock:
+            self.misses += 1
+            if from_disk:
+                self.disk_hits += 1
+            else:
+                self.retraces += 1
+            self._entries[key] = _Entry(compiled, from_disk)
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.maxsize:
+                self._entries.popitem(last=False)
+        if on_outcome is not None:
+            on_outcome("disk" if from_disk else "compile")
+        return compiled
+
+    # ---- optional cross-process persistence ----
+    def _disk_path(self, key: Tuple) -> Optional[str]:
+        if not self.persist_dir:
+            return None
+        h = hashlib.sha256(repr(key).encode()).hexdigest()[:24]
+        return os.path.join(self.persist_dir, f"program-{h}.bin")
+
+    def _load_from_disk(self, key: Tuple):
+        path = self._disk_path(key)
+        if path is None or not os.path.exists(path):
+            return None, False
+        try:
+            import pickle
+            from jax.experimental import serialize_executable as _se
+            with open(path, "rb") as fh:
+                payload, in_tree, out_tree = pickle.load(fh)
+            compiled = _se.deserialize_and_load(payload, in_tree, out_tree)
+            return compiled, True
+        except Exception as exc:
+            self._warn_disk("load", exc)
+            return None, False
+
+    def _store_to_disk(self, key: Tuple, compiled) -> None:
+        path = self._disk_path(key)
+        if path is None:
+            return
+        try:
+            import pickle
+            from jax.experimental import serialize_executable as _se
+            payload, in_tree, out_tree = _se.serialize(compiled)
+            os.makedirs(self.persist_dir, exist_ok=True)
+            tmp = f"{path}.tmp-{os.getpid()}"
+            with open(tmp, "wb") as fh:
+                pickle.dump((payload, in_tree, out_tree), fh)
+            os.replace(tmp, path)
+            with self._lock:
+                self.disk_stores += 1
+        except Exception as exc:
+            self._warn_disk("store", exc)
+
+    def _warn_disk(self, what: str, exc: BaseException) -> None:
+        if not self._disk_warned:
+            self._disk_warned = True
+            warnings.warn(
+                f"program cache disk {what} under {self.persist_dir!r} "
+                f"unavailable ({type(exc).__name__}: {exc}); continuing "
+                f"in-memory only", RuntimeWarning)
+
+
+def _short_key(key: Tuple) -> str:
+    return hashlib.sha256(repr(key).encode()).hexdigest()[:10]
+
+
+_GLOBAL: Optional[ProgramCache] = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def program_cache() -> ProgramCache:
+    """The process-global cache: repeated jobs in one process re-trace
+    nothing (the warm-re-run contract)."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if _GLOBAL is None:
+            _GLOBAL = ProgramCache()
+        return _GLOBAL

@@ -1,0 +1,224 @@
+"""The pipeline compiler: compose per-chunk stages into ONE jitted XLA
+program with device-resident intermediates (Flare's whole-pipeline native
+compilation, PAPERS.md, applied to this framework's chunk streams).
+
+A job declares an ordered list of :class:`Stage`\\ s over a chunk stream
+(``encode -> transform -> model-update -> metrics -> monitor-absorb``);
+:class:`ChunkPipeline` composes their kernels into one traced function
+
+    fused(carries, consts, inputs) -> (new_carries, returns)
+
+jitted with the carry tuple DONATED (every iterative accumulator —
+baseline bin counts, window counts — updates its HBM buffer in place,
+PR 5's donation discipline), lowered/compiled once per argument
+signature through the process-global :class:`~.cache.ProgramCache`, and
+dispatched as ONE launch per chunk.  Stage outputs flow device-to-device
+inside the program (a later stage reads an earlier stage's outputs from
+the ``upstream`` dict without any host hop); only the keys a stage
+declares in ``returns`` leave the program, and they come back as device
+arrays — the caller decides what (if anything) to read back.
+
+Kernels must be PURE functions of their arguments: no captured arrays.
+Stage constants (split thresholds, ensemble predicate tensors, vote
+LUTs) are passed as runtime arguments every chunk — which is what lets
+two jobs with the same stage graph + schema + shapes share one compiled
+executable even when the learned values differ (the Execution Templates
+split between staged program and parameters).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..telemetry import span
+from ..utils.tracing import note_dispatch
+from .cache import ProgramCache, _arg_signature, program_cache
+
+PIPELINE_SITE = "pipeline.chunk"
+
+
+@dataclass
+class Stage:
+    """One stage of a fused per-chunk program.
+
+    ``kernel(carry, consts, inputs, upstream) -> (carry, outputs)``:
+      * ``carry``    — this stage's donated iterative state (pytree; ``()``
+        for stateless stages), threaded chunk to chunk on device;
+      * ``consts``   — this stage's device-resident constants (dict),
+        uploaded once and passed as arguments every chunk;
+      * ``inputs``   — the MERGED per-chunk input dict (all stages');
+      * ``upstream`` — earlier stages' outputs, keyed ``"<stage>.<out>"``
+        (device-to-device dataflow — no host hop between stages).
+
+    ``prepare(block) -> dict`` is the stage's host-side encode, run on
+    the staging thread; the driver pads/uploads what it returns.
+    ``returns`` names the outputs the fused program hands back per chunk
+    (still device arrays).  ``finish(final_carry)`` receives the carry
+    after the stream ends (e.g. to install accumulated baseline counts
+    back into their builder).  ``version`` bumps the stage's cache
+    fingerprint when its kernel logic changes."""
+
+    name: str
+    kernel: Callable
+    version: str = "1"
+    prepare: Optional[Callable] = None
+    carry_init: Optional[Callable[[], Any]] = None
+    consts: Dict[str, Any] = dc_field(default_factory=dict)
+    returns: Tuple[str, ...] = ()
+    finish: Optional[Callable[[Any], None]] = None
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.name}:{self.version}"
+
+
+class ChunkPipeline:
+    """Drive a stage list over a chunk stream as one cached XLA program
+    per chunk.
+
+    The driver half (host prepare, padding, upload threading) stays with
+    the caller — streaming trains already own a staging discipline
+    (``core.table.stage_chunks``); this class owns the fused program:
+    carry management, the ProgramCache key, the single dispatch, and the
+    per-run hit/miss tallies the acceptance counters read."""
+
+    def __init__(self, stages: List[Stage], ctx=None,
+                 schema_fp: str = "", mesh_fp: str = "",
+                 cache: Optional[ProgramCache] = None,
+                 name: str = "pipeline"):
+        if not stages:
+            raise ValueError("ChunkPipeline needs at least one stage")
+        names = [s.name for s in stages]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate stage names: {names}")
+        from ..parallel.mesh import runtime_context
+        from .cache import mesh_fingerprint
+        self.stages = list(stages)
+        self.ctx = ctx or runtime_context()
+        self.name = name
+        self.schema_fp = schema_fp
+        self.mesh_fp = mesh_fp or mesh_fingerprint(self.ctx)
+        self.cache = cache if cache is not None else program_cache()
+        self.graph_fp = "|".join(s.fingerprint for s in self.stages)
+        self._carries = tuple(
+            s.carry_init() if s.carry_init is not None else ()
+            for s in self.stages)
+        self._consts = {s.name: dict(s.consts or {}) for s in self.stages}
+        self._chunks = 0
+        # per-RUN tallies (the process-global cache accumulates forever;
+        # a warm re-run's "0 retraces" claim needs this run's view)
+        self.hits = 0
+        self.misses = 0
+        self.retraces = 0
+        self._finished = False
+
+    # ---- host side ----
+    def prepare(self, block) -> Dict[str, np.ndarray]:
+        """Merged host-encode of one block across all stages (staging
+        thread).  Colliding keys are refused — stages share inputs by
+        having ONE stage produce them."""
+        out: Dict[str, np.ndarray] = {}
+        for s in self.stages:
+            if s.prepare is None:
+                continue
+            d = s.prepare(block) or {}
+            dup = set(d) & set(out)
+            if dup:
+                raise ValueError(f"stage {s.name!r} re-produces input "
+                                 f"keys {sorted(dup)}")
+            out.update(d)
+        return out
+
+    def upload(self, host_inputs: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        """Row-sharded device_put of every prepared input (staging
+        thread; H2D bytes land in the ledger via the mesh helpers)."""
+        return {k: self.ctx.shard_rows_streamed(v)
+                for k, v in host_inputs.items()}
+
+    # ---- the fused program ----
+    def _build_fused(self):
+        stages = self.stages
+
+        def fused(carries, consts, inputs):
+            upstream: Dict[str, Any] = {}
+            new_carries = []
+            for st, c in zip(stages, carries):
+                nc, outs = st.kernel(c, consts.get(st.name, {}), inputs,
+                                     upstream)
+                new_carries.append(nc)
+                for k, v in (outs or {}).items():
+                    upstream[f"{st.name}.{k}"] = v
+            rets = {f"{st.name}.{r}": upstream[f"{st.name}.{r}"]
+                    for st in stages for r in st.returns}
+            return tuple(new_carries), rets
+
+        import jax
+        return jax.jit(fused, donate_argnums=(0,))
+
+    def _key(self, inputs) -> Tuple:
+        return ("chunk-pipeline", self.graph_fp, self.schema_fp,
+                self.mesh_fp,
+                _arg_signature(self._carries),
+                _arg_signature(self._consts),
+                _arg_signature(inputs))
+
+    def _tally(self, outcome: str) -> None:
+        """Per-RUN cache accounting, fed this call's own resolution by
+        the cache (never a delta of the shared process-global stats —
+        concurrent pipelines would absorb each other's compiles and a
+        warm shard could report Retraces>0)."""
+        if outcome == "hit":
+            self.hits += 1
+        else:
+            self.misses += 1
+            if outcome == "compile":
+                self.retraces += 1
+
+    def run_chunk(self, inputs: Dict[str, Any]) -> Dict[str, Any]:
+        """ONE dispatch: every stage advances on this chunk inside one
+        compiled program; returns the declared outputs as device arrays
+        (reading them back — if at all — is the caller's single stacked
+        readback)."""
+        if self._finished:
+            raise RuntimeError("pipeline already finalized")
+        key = self._key(inputs)
+        compiled = self.cache.get_or_compile(key, self._build_fused,
+                                             (self._carries, self._consts,
+                                              inputs),
+                                             on_outcome=self._tally)
+        note_dispatch(1, site=PIPELINE_SITE)
+        with span("pipeline.chunk", cat="pipeline", chunk=self._chunks,
+                  stages=len(self.stages)):
+            self._carries, rets = compiled(self._carries, self._consts,
+                                           inputs)
+        self._chunks += 1
+        return rets
+
+    def finalize(self) -> None:
+        """End of stream: hand each stage its final carry (idempotent)."""
+        if self._finished:
+            return
+        self._finished = True
+        for st, c in zip(self.stages, self._carries):
+            if st.finish is not None:
+                st.finish(c)
+
+    # ---- accounting ----
+    @property
+    def chunks(self) -> int:
+        return self._chunks
+
+    def run_stats(self) -> Dict[str, int]:
+        return {"chunks": self._chunks, "hits": self.hits,
+                "misses": self.misses, "retraces": self.retraces}
+
+    def export(self, counters, group: str = "ProgramCache") -> None:
+        """Per-run cache tallies into the job Counters channel: a warm
+        re-run of an identical job shows ``Retraces`` 0 / ``Hits`` ==
+        chunk-key resolutions — THE acceptance counter."""
+        counters.update_group(group, {
+            "Chunks": self._chunks, "Hits": self.hits,
+            "Misses": self.misses, "Retraces": self.retraces})

@@ -1,0 +1,165 @@
+"""Prebuilt fused flows on the pipeline compiler.
+
+:class:`PredictDriftFlow` is the combined ``predict + driftScore`` core:
+per window chunk, ONE compiled program runs the whole ensemble vote AND
+the drift-monitor bin counting, with the predicted classes flowing
+device-to-device into the monitor's class row (the unfused pair pays a
+predict launch, a host label decode/re-encode hop, and an absorb launch
+per window).  Outputs per window: the vote vector (decoded to labels on
+host for the prediction part file) and the (R, B) window count matrix
+(scored by the caller's :class:`~avenir_tpu.monitor.accumulator.
+StreamDriftMonitor` through ``close_counts`` — the identical
+scoring/decay/policy path as the unfused job, so reports are
+bit-identical).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.tracing import fetch
+from .cache import mesh_fingerprint, schema_fingerprint
+from .compiler import ChunkPipeline, Stage
+
+
+def _vote_kernel(carry, consts, inputs, upstream):
+    """The ensemble vote as a pipeline stage: models/forest's exact
+    fused vote body (one predicate-semantics implementation everywhere),
+    all predicate tensors arriving as runtime arguments."""
+    from ..models.forest import _ensemble_vote_body
+    votes = _ensemble_vote_body(
+        inputs["vals"], inputs["codes"], consts["lo"], consts["hi"],
+        consts["num_r"], consts["cat_m"], consts["cat_r"],
+        consts["cls_oh"], consts["wvec"], consts["min_odds"])
+    return carry, {"votes": votes}
+
+
+def _make_absorb_kernel(b_max: int):
+    """Monitor-absorb stage kernel: splice the UPSTREAM votes into the
+    class row of the host-encoded monitor codes (vote index -> class-row
+    bin through a LUT argument — the device twin of
+    ``Baseline.class_codes_for_labels``), then count every row's bins in
+    one contraction.  ``b_max`` is static (tagged into the stage
+    version so the program cache keys on it)."""
+    def kernel(carry, consts, inputs, upstream):
+        import jax.numpy as jnp
+        from ..ops.histogram import feature_bin_counts
+        votes = upstream["predict.votes"]                       # (n,)
+        cls_bin = jnp.take(consts["vote_lut"],
+                           jnp.clip(votes, 0,
+                                    consts["vote_lut"].shape[0] - 1))
+        codes = jnp.where(consts["class_col"][None, :],
+                          cls_bin[:, None], inputs["mon_codes"])
+        counts = feature_bin_counts(codes, b_max, inputs["mask"] > 0)
+        return carry, {"counts": counts}
+    return kernel
+
+
+class PredictDriftFlow:
+    """Fused (ensemble predict + drift-window absorb) over window-sized
+    chunks.
+
+    Eligibility mirrors the batch predict path's device gate exactly
+    (``EnsembleModel.device_inputs`` semantics): the ensemble must stack
+    (no degenerate member, f32-exact bounds, integer vote weights) and
+    each window's values must round-trip float32.  ``run_window``
+    returns None when a window fails the gate — the caller falls back to
+    the unfused path for that window; results are identical either way
+    (same vote kernel, same count arithmetic), only the launch count
+    differs.
+
+    Every window pads (mask-guarded, zero rows) to ``window_rows`` so
+    the WHOLE stream — tail window included — runs one compiled
+    program."""
+
+    def __init__(self, ensemble, baseline, schema, window_rows: int,
+                 ctx=None, cache=None):
+        import jax.numpy as jnp
+        from ..parallel.mesh import runtime_context
+        self.ens = ensemble
+        self.baseline = baseline
+        self.window_rows = int(window_rows)
+        self.ctx = ctx or runtime_context()
+        # one padded shape serves every window (tail included), rounded
+        # up to the mesh row alignment so the row sharding applies
+        align = max(self.ctx.n_devices, 1)
+        self._padded_rows = self.window_rows + (-self.window_rows) % align
+        self.eligible = ensemble._stacked is not None
+        self.pl: Optional[ChunkPipeline] = None
+        if not self.eligible:
+            return
+        *consts, wvec, _kernel = ensemble._stacked
+        lo, hi, num_r, cat_m, cat_r, cls_oh = consts
+        # vote index -> class-row bin through THE shared label encoding
+        # (serving hook, driftMonitor, and this flow must all bin a
+        # predicted label identically); the trailing entry is the
+        # min-odds veto — None on the wire — which the shared mapping
+        # sends to the unknown bin
+        lut = baseline.class_codes_for_labels(
+            list(ensemble.classes) + [None])
+        class_col = np.zeros((len(baseline.specs),), dtype=bool)
+        class_col[baseline.class_row] = True
+        b_max = int(baseline.n_bins_max)
+        predict = Stage(
+            name="predict", kernel=_vote_kernel, version="1",
+            consts={"lo": lo, "hi": hi, "num_r": num_r, "cat_m": cat_m,
+                    "cat_r": cat_r, "cls_oh": cls_oh, "wvec": wvec,
+                    "min_odds": jnp.float32(ensemble.min_odds_ratio)},
+            returns=("votes",))
+        absorb = Stage(
+            name="monitor", kernel=_make_absorb_kernel(b_max),
+            version=f"1:b{b_max}",
+            consts={"vote_lut": jnp.asarray(lut),
+                    "class_col": jnp.asarray(class_col)},
+            returns=("counts",))
+        self.pl = ChunkPipeline(
+            [predict, absorb], ctx=self.ctx,
+            schema_fp=schema_fingerprint(schema),
+            mesh_fp=mesh_fingerprint(self.ctx), cache=cache,
+            name="predict-drift")
+
+    def run_window(self, table
+                   ) -> Optional[Tuple[List[Optional[str]], np.ndarray]]:
+        """One fused window: (decoded labels, float64 (R, B) window
+        counts), or None when this window is not device-eligible.
+        Counts are integer-exact f32 sums — identical to the unfused
+        accumulator's bucketed absorb."""
+        if self.pl is None or table.n_rows == 0 \
+                or table.n_rows > self.window_rows:
+            return None
+        from ..monitor.baseline import encode_monitor_codes, \
+            resolve_spec_bounds
+        m0 = self.ens.models[0].matrix
+        vals, codes = m0.feature_arrays(table)
+        if not m0._f32_safe(vals):
+            return None
+        n = table.n_rows
+        pad = self._padded_rows - n
+        resolve_spec_bounds(self.baseline.specs, table)
+        mon = encode_monitor_codes(table, self.baseline.specs)
+        mask = np.zeros((self._padded_rows,), dtype=np.float32)
+        mask[:n] = 1.0
+        host = {"vals": _pad_rows(vals.astype(np.float32), pad),
+                "codes": _pad_rows(codes, pad),
+                "mon_codes": _pad_rows(mon, pad),
+                "mask": mask}
+        outs = self.pl.run_chunk(self.pl.upload(host))
+        votes = fetch(outs["predict.votes"])[:n]
+        counts = fetch(outs["monitor.counts"], dtype=np.float64)
+        return list(self.ens._lut[votes]), counts
+
+    def export(self, counters) -> None:
+        if self.pl is not None:
+            self.pl.export(counters)
+
+    def run_stats(self) -> Dict[str, int]:
+        return self.pl.run_stats() if self.pl is not None else {}
+
+
+def _pad_rows(a: np.ndarray, pad: int) -> np.ndarray:
+    """Zero-pad along axis 0 (mask-guarded downstream)."""
+    if pad <= 0:
+        return a
+    return np.pad(a, ((0, pad),) + ((0, 0),) * (a.ndim - 1))

@@ -170,9 +170,11 @@ class ForestPredictor(Predictor):
             # rides into the host fallback so a failed gate does not
             # rebuild the feature arrays it already built.
             from ..models.tree import FeatureCache
+            from ..utils.tracing import note_dispatch
             cache = FeatureCache()
             dev = ens.device_inputs(table, cache)
             if dev is not None:
+                note_dispatch(site="serve.predict")
                 return list(ens._lut[np.asarray(self._core(*dev))])
             return ens._predict_host(table, cache)
         return ens.predict(table)

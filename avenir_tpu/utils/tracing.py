@@ -70,7 +70,8 @@ class TransferLedger:
     """
 
     __slots__ = ("h2d_bytes", "d2h_bytes", "h2d_transfers", "d2h_transfers",
-                 "dispatches", "allreduces", "allreduce_bytes", "_lock")
+                 "dispatches", "dispatch_sites", "allreduces",
+                 "allreduce_bytes", "_lock")
 
     def __init__(self):
         self.h2d_bytes = 0
@@ -78,6 +79,10 @@ class TransferLedger:
         self.h2d_transfers = 0
         self.d2h_transfers = 0
         self.dispatches = 0
+        # per-site dispatch breakdown (site -> count): the fused-vs-unfused
+        # delta of a pipeline rewire is visible per call site in every
+        # job's counters.json, not only in a bench run
+        self.dispatch_sites: Dict[str, int] = defaultdict(int)
         self.allreduces = 0
         self.allreduce_bytes = 0
         self._lock = threading.Lock()
@@ -92,9 +97,11 @@ class TransferLedger:
             self.d2h_bytes += int(nbytes)
             self.d2h_transfers += int(transfers)
 
-    def record_dispatch(self, n: int = 1) -> None:
+    def record_dispatch(self, n: int = 1, site: Optional[str] = None) -> None:
         with self._lock:
             self.dispatches += int(n)
+            if site:
+                self.dispatch_sites[site] += int(n)
 
     def record_allreduce(self, nbytes: int, n: int = 1) -> None:
         """One cross-process collective of ``nbytes`` payload (this
@@ -108,6 +115,8 @@ class TransferLedger:
             self.allreduce_bytes += int(nbytes)
 
     def snapshot(self) -> Dict[str, int]:
+        """Scalar tallies only (stable key set — bench arithmetic diffs
+        these); the per-site dispatch breakdown has its own accessor."""
         with self._lock:
             return {"h2d_bytes": self.h2d_bytes,
                     "d2h_bytes": self.d2h_bytes,
@@ -117,13 +126,22 @@ class TransferLedger:
                     "allreduces": self.allreduces,
                     "allreduce_bytes": self.allreduce_bytes}
 
+    def site_snapshot(self) -> Dict[str, int]:
+        """Per-site dispatch counts (copy) for bench/tests: e.g.
+        ``{"pipeline.chunk": 12, "forest.level": 3}``."""
+        with self._lock:
+            return dict(self.dispatch_sites)
+
     def export(self, counters, group: str = "Transfers") -> None:
         """Into the job Counters channel, Hadoop-dump style.  Byte tallies
         are per-process host-side work, so exporting BEFORE a multi-process
         all-reduce yields correct cluster totals (each process moves its
         own bytes).  Collectives land in their OWN group (next to
         Transfers) so the one-all-reduce-per-level claim is a counter an
-        operator (and a regression test) can read directly."""
+        operator (and a regression test) can read directly; tagged
+        dispatch sites land in a ``Dispatches`` group so the fused-vs-
+        unfused launch count of a pipeline rewire is a per-site counter
+        in EVERY job's counters.json, no bench run needed."""
         counters.update_group(group, {
             "H2DBytes": self.h2d_bytes, "D2HBytes": self.d2h_bytes,
             "H2DTransfers": self.h2d_transfers,
@@ -132,6 +150,10 @@ class TransferLedger:
         counters.update_group("Collectives", {
             "AllReduces": self.allreduces,
             "AllReduceBytes": self.allreduce_bytes})
+        if self.dispatch_sites:
+            counters.update_group("Dispatches",
+                                  {k: v for k, v in
+                                   sorted(self.dispatch_sites.items())})
 
 
 # global (NOT thread-local: staging threads record into their spawner's
@@ -168,10 +190,10 @@ def note_d2h(nbytes: int, transfers: int = 1) -> None:
             led.record_d2h(nbytes, transfers)
 
 
-def note_dispatch(n: int = 1) -> None:
+def note_dispatch(n: int = 1, site: Optional[str] = None) -> None:
     if _ledgers:
         for led in list(_ledgers):
-            led.record_dispatch(n)
+            led.record_dispatch(n, site=site)
 
 
 def note_allreduce(nbytes: int, n: int = 1) -> None:

@@ -379,7 +379,7 @@ def e2e_rf_rate(n):
     params.tree.max_depth = 4
     ctx = MeshContext()
 
-    def run_once(stats, cache=None):
+    def run_once(stats, cache=None, fuse=True):
         # consumer_wait_key=None: this parse layer feeds the staging
         # thread inside from_stream, whose stage_wait_s already times
         # the wait on this queue — queue_wait_s stays final-consumer-only
@@ -388,7 +388,7 @@ def e2e_rf_rate(n):
                             chunk_rows=RF_STREAM_BLOCK_ROWS, cache=cache),
             stats=stats, consumer_wait_key=None)
         return build_forest_from_stream(blocks, schema, params, ctx,
-                                        stats=stats)
+                                        stats=stats, fuse=fuse)
 
     # cold pass = the user's one-shot run (XLA compiles) + warmup
     tc = time.perf_counter()
@@ -419,6 +419,8 @@ def e2e_rf_rate(n):
                                   csv_pass_s=t2 - t0, csv_parse_s=parse_s,
                                   csv_ingest_s=ingest_s)
     telemetry = _rf_telemetry_overhead(run_once, t2 - t0)
+    fused_pipeline = _rf_fused_pipeline(run_once, blobs, t2 - t0, stats,
+                                        led.site_snapshot())
     return {"metric": "e2e_csv_to_forest_rows_x_trees_per_sec",
             "value": round(n * T / dt, 1), "unit": "rows*trees/sec",
             "n": n, "trees": T, "candidate_splits": S,
@@ -443,9 +445,62 @@ def e2e_rf_rate(n):
             # (lane count == the parse||transfer||compute concurrency,
             # schema-validated export)
             "telemetry": telemetry,
+            # fused per-chunk pipeline vs the eager per-stage ingest:
+            # dispatches/chunk, warm-pass retrace count (ProgramCache),
+            # wall delta, models asserted bit-identical (ISSUE 9)
+            "fused_pipeline": fused_pipeline,
             "roofline": roofline(build_s, flops=flops, hbm_bytes=hbm,
                                  host_s=parse_s,
                                  measured=led.snapshot())}
+
+
+def _rf_fused_pipeline(run_once, fused_blobs, fused_wall_s, fused_stats,
+                       fused_sites):
+    """The pipeline-compiler measurement (ISSUE 9): the MEASURED e2e
+    pass already ran the fused per-chunk program (the default), so this
+    block adds ONE eager per-stage pass and reports the delta — fused vs
+    unfused ingest wall, launches per chunk from the ledger's per-site
+    dispatch breakdown (``pipeline.chunk`` vs ``ingest.encode``), and
+    the warm pass's ProgramCache retrace count (0: the cold pass
+    compiled, the measured pass reused).  Models computed (not
+    asserted) bit-identical so python -O cannot hide a divergence."""
+    try:
+        # warmup: the measured e2e passes both ran fused, so the eager
+        # encode kernel's one-time jit has never compiled — timing the
+        # first unfused pass would charge that compile against the
+        # unfused wall while fused_wall_s (a warm pass) never paid its
+        # own.  One throwaway pass makes both sides warm.
+        run_once({}, fuse=False)
+        stats_u = {}
+        with _ledger() as led_u:
+            t0 = time.perf_counter()
+            unfused_models = run_once(stats_u, fuse=False)
+            unfused_wall_s = time.perf_counter() - t0
+        sites_u = led_u.site_snapshot()
+        pl = fused_stats.get("pipeline", {})
+        chunks = max(pl.get("chunks", 0), 1)
+        fused_disp = fused_sites.get("pipeline.chunk", 0)
+        unfused_disp = sites_u.get("ingest.encode", 0) \
+            + sites_u.get("baseline.absorb", 0)
+        return {
+            "fused_wall_s": round(fused_wall_s, 3),
+            "unfused_wall_s": round(unfused_wall_s, 3),
+            "speedup": round(unfused_wall_s / fused_wall_s, 3)
+            if fused_wall_s > 0 else None,
+            "chunks": pl.get("chunks", 0),
+            "fused_dispatches_per_chunk": round(fused_disp / chunks, 3),
+            "unfused_dispatches_per_chunk": round(unfused_disp / chunks, 3),
+            # the measured (warm) fused pass: every chunk key served
+            # from the process-global ProgramCache, zero re-traces
+            "warm_retraces": pl.get("retraces"),
+            "warm_cache_hits": pl.get("hits"),
+            "models_bit_identical":
+                [m.to_json() for m in unfused_models] == fused_blobs,
+        }
+    except Exception as exc:
+        # a pipeline-measurement failure must not discard the primary
+        # e2e point that was already measured
+        return {"error": f"{type(exc).__name__}: {exc}"}
 
 
 def _rf_telemetry_overhead(run_once, untraced_s):

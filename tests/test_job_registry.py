@@ -53,6 +53,7 @@ EXPECTED = {
     "org.avenir.markov.ViterbiStatePredictor": "viterbi_state_predictor",
     "org.avenir.model.ModelPredictor": "model_predictor_job",
     "org.avenir.monitor.DriftMonitor": "drift_monitor",
+    "org.avenir.monitor.PredictDriftScore": "predict_drift_score",
     "org.avenir.regress.LogisticRegressionJob": "logistic_regression",
     "org.avenir.regress.LogisticRegressionPredictor":
         "logistic_regression_predictor",

@@ -1,0 +1,591 @@
+"""Pipeline-compiler tests (ISSUE 9): fused per-chunk XLA programs with
+device-resident intermediates, the ProgramCache control plane, and the
+three rewired flows.
+
+Pins, in the style of tests/test_transfers.py:
+
+  * bit-identity — fused pipeline output == unfused per-stage output for
+    the streamed RF build (+ monolithic oracle), the baseline publish
+    tee, and the combined predictDriftScore job vs the two-job flow,
+    including the 2-shard file-transport lane and checkpoint/resume
+    mid-stream;
+  * dispatch counts — the fused path launches STRICTLY fewer XLA
+    programs per chunk than the unfused path (ledger per-site
+    breakdown: ``pipeline.chunk`` vs ``ingest.encode`` +
+    ``baseline.absorb`` / ``monitor.absorb`` + ``serve.predict``);
+  * ProgramCache — schema-fingerprint, chunk-shape, and mesh-spec
+    changes each MISS; an identical re-run HITS with zero retraces
+    (compile counts via the cache's own tallies).
+"""
+
+import json
+import os
+import threading
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from avenir_tpu.core.metrics import Counters
+from avenir_tpu.core.schema import FeatureSchema
+from avenir_tpu.core.table import iter_csv_chunks, load_csv, prefetch_chunks
+from avenir_tpu.parallel.mesh import MeshContext, make_mesh, \
+    set_runtime_context
+from avenir_tpu.pipeline import (ChunkPipeline, ProgramCache, Stage,
+                                 program_cache, schema_fingerprint)
+from avenir_tpu.utils.tracing import TransferLedger, transfer_ledger
+
+pytestmark = pytest.mark.pipeline
+
+
+SCHEMA = {"fields": [
+    {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+    {"name": "c1", "ordinal": 1, "dataType": "categorical", "feature": True,
+     "maxSplit": 2, "cardinality": ["a", "b", "c"]},
+    {"name": "n1", "ordinal": 2, "dataType": "int", "feature": True,
+     "min": 0, "max": 600, "splitScanInterval": 150},
+    {"name": "cls", "ordinal": 3, "dataType": "categorical",
+     "cardinality": ["T", "F"]},
+]}
+
+
+def _schema():
+    return FeatureSchema.from_dict(SCHEMA)
+
+
+def _write_csv(path, n=400, seed=3, shift=0, noise=0.0):
+    rng = np.random.default_rng(seed)
+    lines = []
+    for i in range(n):
+        c = ["a", "b", "c"][rng.integers(0, 3)]
+        v = int(rng.integers(shift, 600))
+        cls = "T" if (v > 300) ^ (c == "c") else "F"
+        if noise and rng.random() < noise:
+            # flipped delayed labels: the model must mispredict some
+            # rows or the accuracy-alert path (inverted threshold) is
+            # unreachable — the split grid contains the true boundary
+            cls = "F" if cls == "T" else "T"
+        lines.append(f"r{i},{c},{v},{cls}")
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    return str(path)
+
+
+def _forest_params(trees=3, depth=3, seed=7):
+    from avenir_tpu.models.forest import ForestParams
+    p = ForestParams(num_trees=trees, seed=seed)
+    p.tree.max_depth = depth
+    p.tree.stopping_strategy = "maxDepth"
+    return p
+
+
+# --------------------------------------------------------------------------
+# ProgramCache mechanics
+# --------------------------------------------------------------------------
+
+def test_program_cache_hit_miss_and_eviction():
+    cache = ProgramCache(maxsize=2)
+
+    def build():
+        return jax.jit(lambda x: x + 1)
+
+    x = jnp.arange(4.0)
+    c1 = cache.get_or_compile(("k1",), build, (x,))
+    assert np.allclose(np.asarray(c1(x)), np.arange(4.0) + 1)
+    assert cache.stats()["retraces"] == 1
+    # identical key: hit, no recompile
+    cache.get_or_compile(("k1",), build, (x,))
+    s = cache.stats()
+    assert s["hits"] == 1 and s["retraces"] == 1
+    # two more keys overflow maxsize=2 -> k1 evicted (LRU)
+    cache.get_or_compile(("k2",), build, (x,))
+    cache.get_or_compile(("k3",), build, (x,))
+    assert cache.stats()["entries"] == 2
+    cache.get_or_compile(("k1",), build, (x,))
+    assert cache.stats()["retraces"] == 4  # k1 had to recompile
+
+
+def _toy_stage():
+    def kernel(carry, consts, inputs, upstream):
+        return carry, {"y": inputs["x"] * consts["scale"]}
+    return Stage(name="toy", kernel=kernel, version="1",
+                 consts={"scale": jnp.float32(2.0)}, returns=("y",))
+
+
+def _run_toy(cache, schema_fp="s", mesh_fp="m", n=8):
+    pl = ChunkPipeline([_toy_stage()], ctx=MeshContext(make_mesh(1)),
+                       schema_fp=schema_fp, mesh_fp=mesh_fp, cache=cache)
+    out = pl.run_chunk({"x": jnp.arange(float(n))})
+    assert np.allclose(np.asarray(out["toy.y"]), np.arange(n) * 2.0)
+    return pl
+
+
+def test_program_cache_key_invalidation_axes():
+    """Schema fingerprint, chunk shape, and mesh spec each MISS; an
+    identical re-run HITS with zero retraces."""
+    cache = ProgramCache()
+    _run_toy(cache, "s", "m", n=8)
+    assert cache.stats() == dict(hits=0, misses=1, retraces=1,
+                                 disk_hits=0, disk_stores=0, entries=1)
+    # identical re-run (fresh pipeline instance, same everything): HIT
+    pl = _run_toy(cache, "s", "m", n=8)
+    assert cache.stats()["retraces"] == 1
+    assert pl.run_stats() == {"chunks": 1, "hits": 1, "misses": 0,
+                              "retraces": 0}
+    # schema fingerprint change: MISS
+    _run_toy(cache, "s2", "m", n=8)
+    assert cache.stats()["retraces"] == 2
+    # chunk shape change: MISS
+    _run_toy(cache, "s", "m", n=16)
+    assert cache.stats()["retraces"] == 3
+    # mesh spec change: MISS
+    _run_toy(cache, "s", "m2", n=8)
+    assert cache.stats()["retraces"] == 4
+
+
+def test_pipeline_donated_carry_accumulates():
+    """A stage carry is device-resident and threads chunk to chunk."""
+    def kernel(carry, consts, inputs, upstream):
+        return carry + inputs["x"].sum(), {}
+    st = Stage(name="acc", kernel=kernel,
+               carry_init=lambda: jnp.float32(0.0))
+    pl = ChunkPipeline([st], ctx=MeshContext(make_mesh(1)),
+                       cache=ProgramCache())
+    total = 0.0
+    for k in range(3):
+        x = jnp.full((4,), float(k + 1))
+        pl.run_chunk({"x": x})
+        total += 4.0 * (k + 1)
+    got = {}
+    st.finish = lambda c: got.setdefault("v", float(np.asarray(c)))
+    pl.finalize()
+    assert got["v"] == total
+
+
+def test_pipeline_duplicate_stage_names_refused():
+    with pytest.raises(ValueError, match="duplicate"):
+        ChunkPipeline([_toy_stage(), _toy_stage()],
+                      ctx=MeshContext(make_mesh(1)), cache=ProgramCache())
+
+
+def test_pipeline_export_counters():
+    cache = ProgramCache()
+    pl = _run_toy(cache)
+    c = Counters()
+    pl.export(c)
+    assert c.group("ProgramCache") == {"Chunks": 1, "Hits": 0,
+                                       "Misses": 1, "Retraces": 1}
+
+
+# --------------------------------------------------------------------------
+# satellite: ledger per-site dispatch breakdown
+# --------------------------------------------------------------------------
+
+def test_ledger_site_breakdown_exports():
+    led = TransferLedger()
+    led.record_dispatch(2, site="pipeline.chunk")
+    led.record_dispatch(1, site="forest.level")
+    led.record_dispatch(1)             # untagged: total only
+    assert led.snapshot()["dispatches"] == 4
+    assert led.site_snapshot() == {"pipeline.chunk": 2, "forest.level": 1}
+    c = Counters()
+    led.export(c)
+    assert c.get("Transfers", "Dispatches") == 4
+    assert c.group("Dispatches") == {"pipeline.chunk": 2,
+                                     "forest.level": 1}
+
+
+def test_ledger_no_sites_no_dispatches_group():
+    led = TransferLedger()
+    led.record_dispatch(3)
+    c = Counters()
+    led.export(c)
+    assert c.group("Dispatches") == {}
+
+
+# --------------------------------------------------------------------------
+# satellite: producer exception type surfaces in the stats dict
+# --------------------------------------------------------------------------
+
+def test_prefetch_surfaces_producer_exception_in_stats():
+    def crashing():
+        yield 1
+        raise ValueError("bad parse at row 7")
+
+    stats = {}
+    it = prefetch_chunks(crashing(), stats=stats)
+    assert next(it) == 1
+    with pytest.raises(ValueError, match="bad parse"):
+        list(it)
+    # the crash is identifiable FROM THE STATS DICT, not only via the
+    # re-raise: a crashed producer no longer looks like a slow one
+    assert stats["producer_error"] == "ValueError: bad parse at row 7"
+    assert stats["producer_error_thread"] == "avenir-ingest-prefetch"
+
+
+def test_prefetch_no_error_leaves_stats_clean():
+    stats = {}
+    assert list(prefetch_chunks(iter([1, 2]), stats=stats)) == [1, 2]
+    assert "producer_error" not in stats
+
+
+# --------------------------------------------------------------------------
+# bit-identity: streamed RF build, fused vs unfused vs monolithic
+# --------------------------------------------------------------------------
+
+def _stream_forest(csv, schema, params, fuse, baseline=None,
+                   chunk_rows=128, **kw):
+    from avenir_tpu.models.forest import build_forest_from_stream
+    stats = {}
+    with transfer_ledger() as led:
+        models = build_forest_from_stream(
+            iter_csv_chunks(csv, schema, ",", chunk_rows=chunk_rows),
+            schema, params, stats=stats, fuse=fuse, baseline=baseline,
+            **kw)
+    return [m.to_json() for m in models], led, stats
+
+
+def test_rf_stream_fused_bit_identical_and_fewer_dispatches(tmp_path):
+    from avenir_tpu.models.forest import build_forest
+    schema = _schema()
+    csv = _write_csv(tmp_path / "d.csv", n=401)   # odd: remainder chunk
+    params = _forest_params()
+    ref = [m.to_json() for m in
+           build_forest(load_csv(csv, schema, ","), params)]
+    fused, led_f, stats_f = _stream_forest(csv, schema, params, fuse=True)
+    unfused, led_u, _ = _stream_forest(csv, schema, params, fuse=False)
+    assert fused == ref and unfused == ref
+    chunks = stats_f["pipeline"]["chunks"]
+    assert chunks == 4
+    # the acceptance pin: RF encode <= 1 dispatch per chunk fused
+    assert led_f.site_snapshot()["pipeline.chunk"] == chunks
+    assert led_u.site_snapshot()["ingest.encode"] == chunks
+
+
+def test_rf_stream_fused_baseline_strictly_fewer_dispatches(tmp_path):
+    """With the baseline riding along, fused = 1 launch/chunk vs the
+    unfused encode + tee'd absorb = 2 launches/chunk — and the finalized
+    baselines are byte-identical."""
+    from avenir_tpu.monitor.baseline import BaselineBuilder
+    schema = _schema()
+    csv = _write_csv(tmp_path / "d.csv", n=400)
+    params = _forest_params()
+    bf = BaselineBuilder(schema, n_bins=8)
+    bu = BaselineBuilder(schema, n_bins=8)
+    fused, led_f, stats_f = _stream_forest(csv, schema, params,
+                                           fuse=True, baseline=bf)
+    unfused, led_u, _ = _stream_forest(csv, schema, params,
+                                       fuse=False, baseline=bu)
+    assert fused == unfused
+    chunks = stats_f["pipeline"]["chunks"]
+    sf, su = led_f.site_snapshot(), led_u.site_snapshot()
+    fused_per_chunk = sf["pipeline.chunk"]
+    unfused_per_chunk = su["ingest.encode"] + su["baseline.absorb"]
+    assert fused_per_chunk == chunks
+    assert unfused_per_chunk == 2 * chunks
+    assert fused_per_chunk < unfused_per_chunk     # STRICTLY fewer
+    # baseline bit-identity: counts, row count, quantiles
+    fb, ub = bf.finalize(), bu.finalize()
+    assert np.array_equal(fb.counts, ub.counts)
+    assert fb.n_rows == ub.n_rows
+    assert np.array_equal(fb.quantiles, ub.quantiles, equal_nan=True)
+    assert fb.to_sidecar() == ub.to_sidecar()
+
+
+def test_rf_stream_warm_rerun_zero_retraces(tmp_path):
+    """Identical re-run: every chunk key HITS the process-global cache;
+    zero retraces (the Execution Templates acceptance)."""
+    schema = _schema()
+    csv = _write_csv(tmp_path / "d.csv", n=400)
+    params = _forest_params()
+    _, _, s1 = _stream_forest(csv, schema, params, fuse=True)
+    _, _, s2 = _stream_forest(csv, schema, params, fuse=True)
+    assert s2["pipeline"]["retraces"] == 0
+    assert s2["pipeline"]["misses"] == 0
+    assert s2["pipeline"]["hits"] == s2["pipeline"]["chunks"]
+
+
+def test_rf_stream_fused_checkpoint_resume_bit_identical(tmp_path):
+    """Crash mid-stream under the fused pipeline; resume finishes the
+    bit-identical model (checkpoint/resume composes with fusion)."""
+    from avenir_tpu.core.checkpoint import CheckpointManager
+    from avenir_tpu.models.forest import build_forest_from_stream
+    schema = _schema()
+    csv = _write_csv(tmp_path / "d.csv", n=400)
+    params = _forest_params()
+    ref, _, _ = _stream_forest(csv, schema, params, fuse=True,
+                               chunk_rows=64)
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+
+    def crash_after(blocks, k):
+        for i, b in enumerate(blocks):
+            if i == k:
+                raise RuntimeError("injected crash")
+            yield b
+
+    with pytest.raises(RuntimeError, match="injected crash"):
+        build_forest_from_stream(
+            crash_after(iter_csv_chunks(csv, schema, ",", chunk_rows=64),
+                        3),
+            schema, params, checkpoint=mgr, checkpoint_every=1, fuse=True)
+    step, arrays, meta = mgr.restore()
+    assert not meta["ingest_complete"] and meta["source_rows_done"] > 0
+    models = build_forest_from_stream(
+        iter_csv_chunks(csv, schema, ",", chunk_rows=64,
+                        start_row=meta["source_rows_done"]),
+        schema, params, checkpoint=mgr, checkpoint_every=1,
+        resume_state=(arrays, meta), fuse=True)
+    assert [m.to_json() for m in models] == ref
+
+
+def test_rf_stream_fused_two_shard_file_transport(tmp_path):
+    """The 2-shard file-transport lane: fused shards train the
+    bit-identical forest of the single-host build (thread-simulated
+    shards share the process-global ProgramCache — also a thread-safety
+    exercise)."""
+    from avenir_tpu.models.forest import build_forest, \
+        build_forest_from_stream
+    from avenir_tpu.parallel.collectives import AllReducer
+    from avenir_tpu.parallel.distributed import ShardSpec
+    set_runtime_context(MeshContext(make_mesh(1)))
+    try:
+        schema = _schema()
+        csv = _write_csv(tmp_path / "d.csv", n=401)
+        params = _forest_params()
+        ref = [m.to_json() for m in
+               build_forest(load_csv(csv, schema, ","), params,
+                            MeshContext(make_mesh(1)))]
+        rdir = str(tmp_path / "reduce")
+        out = {}
+
+        def worker(i):
+            red = AllReducer(spec=ShardSpec(i, 2), name="rf-pl",
+                             transport_dir=rdir, timeout_s=120)
+            models = build_forest_from_stream(
+                iter_csv_chunks(csv, schema, ",", chunk_rows=64,
+                                shard=(i, 2)),
+                schema, params, ctx=MeshContext(make_mesh(1)),
+                reducer=red, fuse=True)
+            out[i] = [m.to_json() for m in models]
+
+        ts = [threading.Thread(target=worker, args=(i,)) for i in range(2)]
+        [t.start() for t in ts]
+        [t.join(240) for t in ts]
+        assert out.get(0) == out.get(1) == ref, \
+            "fused sharded forest differs from the single-host build"
+    finally:
+        set_runtime_context(None)
+
+
+# --------------------------------------------------------------------------
+# the combined predictDriftScore flow vs the two-job baseline
+# --------------------------------------------------------------------------
+
+def _train_and_publish(tmp_path, schema_path):
+    """randomForestBuilder with streaming ingest + baseline publish."""
+    from avenir_tpu.cli import jobs
+    from avenir_tpu.core.config import Config
+    cfg = Config({"dtb.feature.schema.file.path": schema_path,
+                  "dtb.num.trees": "3", "dtb.random.seed": "7",
+                  "dtb.max.depth.limit": "3",
+                  "dtb.path.stopping.strategy": "maxDepth",
+                  "dtb.streaming.ingest": "true",
+                  "dtb.streaming.block.rows": "128",
+                  "dtb.baseline.publish": "true",
+                  "dtb.model.registry.dir": str(tmp_path / "reg"),
+                  "dtb.baseline.bins": "8"})
+    counters = jobs.random_forest_builder(
+        cfg, str(tmp_path / "train.csv"), str(tmp_path / "out_rf"))
+    return counters
+
+
+@pytest.fixture()
+def published(tmp_path):
+    schema_path = str(tmp_path / "schema.json")
+    with open(schema_path, "w") as fh:
+        json.dump(SCHEMA, fh)
+    _write_csv(tmp_path / "train.csv", n=500, seed=3)
+    # drifted scoring stream (value range shifted up, labels noisy so
+    # accuracy alerts fire ALONGSIDE drift alerts — the alerts.jsonl
+    # byte-diff therefore pins their relative order inside a window)
+    _write_csv(tmp_path / "score.csv", n=300, seed=11, shift=200,
+               noise=0.1)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        _train_and_publish(tmp_path, schema_path)
+    return schema_path
+
+
+def _dm_cfg(tmp_path, extra=None):
+    from avenir_tpu.core.config import Config
+    # accuracy thresholds ON: an accuracy alert and a drift alert firing
+    # in the SAME window pins the alert ordering inside alerts.jsonl,
+    # not just the set of alerts
+    keys = {"dm.model.registry.dir": str(tmp_path / "reg"),
+            "dm.model.name": "forest", "dm.window.rows": "100",
+            "dm.consecutive.windows": "1",
+            "dm.accuracy.warn": "100", "dm.accuracy.alert": "100",
+            "dm.score.predictions": "true"}
+    keys.update(extra or {})
+    return Config(keys)
+
+
+def test_predict_drift_score_bit_identical_to_two_jobs(tmp_path,
+                                                       published):
+    """The combined one-pass job's BOTH artifacts == the two-job flow's:
+    prediction lines byte-equal modelPredictor, drift rows + alerts
+    byte-equal driftMonitor(dm.score.predictions) — at strictly fewer
+    launches per window."""
+    from avenir_tpu.cli import jobs, monitor_jobs
+    from avenir_tpu.core.config import Config
+    score = str(tmp_path / "score.csv")
+    jobs.model_predictor_job(
+        Config({"mop.feature.schema.file.path": published,
+                "mop.model.dir.path": str(tmp_path / "out_rf")}),
+        score, str(tmp_path / "out_pred"))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with transfer_ledger() as led_dm:
+            monitor_jobs.drift_monitor(_dm_cfg(tmp_path), score,
+                                       str(tmp_path / "out_dm"))
+        with transfer_ledger() as led_pds:
+            c = monitor_jobs.predict_drift_score(
+                _dm_cfg(tmp_path), score, str(tmp_path / "out_pds"))
+
+    def rd(*p):
+        return open(os.path.join(str(tmp_path), *p)).read()
+
+    assert rd("out_pds", "predictions", "part-m-00000") \
+        == rd("out_pred", "part-m-00000")
+    assert rd("out_pds", "part-r-00000") == rd("out_dm", "part-r-00000")
+    assert rd("out_pds", "alerts.jsonl") == rd("out_dm", "alerts.jsonl")
+    # every window fused, ONE launch per window; the unfused pair pays
+    # predict + absorb launches per window
+    windows = 3
+    assert c.get("PredictDrift", "FusedWindows") == windows
+    assert c.get("PredictDrift", "UnfusedWindows") == 0
+    sf, su = led_pds.site_snapshot(), led_dm.site_snapshot()
+    assert sf["pipeline.chunk"] == windows
+    unfused = su["monitor.absorb"] + su.get("serve.predict", 0)
+    assert sf["pipeline.chunk"] < unfused
+    # drift scoring itself is shared (same launches either way)
+    assert sf["drift.score"] == su["drift.score"]
+
+
+def test_predict_drift_score_unfused_knob_identical(tmp_path, published):
+    """dm.pipeline.fuse=false: same single-pass job, eager per-stage
+    launches — artifacts identical to the fused run."""
+    from avenir_tpu.cli import monitor_jobs
+    score = str(tmp_path / "score.csv")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        cf = monitor_jobs.predict_drift_score(
+            _dm_cfg(tmp_path), score, str(tmp_path / "out_f"))
+        cu = monitor_jobs.predict_drift_score(
+            _dm_cfg(tmp_path, {"dm.pipeline.fuse": "false"}), score,
+            str(tmp_path / "out_u"))
+
+    def rd(*p):
+        return open(os.path.join(str(tmp_path), *p)).read()
+
+    assert rd("out_f", "predictions", "part-m-00000") \
+        == rd("out_u", "predictions", "part-m-00000")
+    assert rd("out_f", "part-r-00000") == rd("out_u", "part-r-00000")
+    assert cf.get("PredictDrift", "FusedWindows") > 0
+    assert cu.get("PredictDrift", "FusedWindows") == 0
+    assert cu.get("PredictDrift", "UnfusedWindows") > 0
+
+
+def test_rf_job_warm_rerun_reports_zero_retraces(tmp_path, published):
+    """The CLI-level warm-re-run acceptance: an identical second
+    randomForestBuilder run reports ProgramCache Retraces=0 (every
+    chunk program served from the process-global cache)."""
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        c2 = _train_and_publish(tmp_path, published)
+    assert c2.group("ProgramCache")["Retraces"] == 0
+    assert c2.group("ProgramCache")["Misses"] == 0
+    assert c2.group("ProgramCache")["Hits"] \
+        == c2.group("ProgramCache")["Chunks"]
+
+
+def test_predict_drift_score_refuses_even_unweighted_forest(tmp_path):
+    """modelPredictor refuses an even unweighted ensemble; the combined
+    job must too (both fused and unfused) — silently tie-broken
+    predictions would violate the byte-identity contract."""
+    from avenir_tpu.cli import jobs, monitor_jobs
+    from avenir_tpu.core.config import Config
+    schema_path = str(tmp_path / "schema.json")
+    with open(schema_path, "w") as fh:
+        json.dump(SCHEMA, fh)
+    _write_csv(tmp_path / "train.csv", n=400, seed=3)
+    _write_csv(tmp_path / "score.csv", n=120, seed=11)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        jobs.random_forest_builder(
+            Config({"dtb.feature.schema.file.path": schema_path,
+                    "dtb.num.trees": "4", "dtb.random.seed": "7",
+                    "dtb.max.depth.limit": "3",
+                    "dtb.path.stopping.strategy": "maxDepth",
+                    "dtb.baseline.publish": "true",
+                    "dtb.model.registry.dir": str(tmp_path / "reg"),
+                    "dtb.baseline.bins": "8"}),
+            str(tmp_path / "train.csv"), str(tmp_path / "out_rf"))
+    for extra in (None, {"dm.pipeline.fuse": "false"}):
+        with pytest.raises(ValueError, match="odd number"):
+            monitor_jobs.predict_drift_score(
+                _dm_cfg(tmp_path, extra), str(tmp_path / "score.csv"),
+                str(tmp_path / "out_even"))
+
+
+def test_stream_monitor_close_counts_matches_close_window():
+    """close_counts (the fused entry) and close_window (the internal
+    accumulator) score/decay/debounce identically for the same window
+    counts."""
+    from avenir_tpu.monitor.accumulator import StreamDriftMonitor
+    from avenir_tpu.monitor.baseline import compute_baseline, \
+        encode_monitor_codes
+    from avenir_tpu.core.table import encode_rows
+    schema = _schema()
+    rng = np.random.default_rng(5)
+    rows = [["r%d" % i, "abc"[rng.integers(3)],
+             str(int(rng.integers(0, 600))), "TF"[rng.integers(2)]]
+            for i in range(200)]
+    base_tbl = encode_rows(rows, schema)
+    baseline = compute_baseline(base_tbl, n_bins=8)
+    win = [["w%d" % i, "abc"[rng.integers(3)],
+            str(int(rng.integers(300, 600))), "T"] for i in range(64)]
+    tbl = encode_rows(win, schema)
+    m1 = StreamDriftMonitor(baseline, window_rows=64)
+    m1.observe_table(tbl)
+    r1 = m1.reports
+    # external counts: the same window counted in one contraction
+    import jax.numpy as jnp
+    from avenir_tpu.ops.histogram import feature_bin_counts
+    codes = encode_monitor_codes(tbl, baseline.specs)
+    counts = np.asarray(feature_bin_counts(
+        jnp.asarray(codes), baseline.n_bins_max), dtype=np.float64)
+    m2 = StreamDriftMonitor(baseline, window_rows=64)
+    m2.close_counts(counts, tbl.n_rows)
+    r2 = m2.reports
+    assert len(r1) == len(r2) == 2      # window + longterm
+    for a, b in zip(r1, r2):
+        assert a.kind == b.kind and a.n_rows == b.n_rows
+        for ra, rb in zip(a.rows, b.rows):
+            assert ra.stats == rb.stats
+
+    # interleaving guard: pending internal rows refuse the external path
+    m2.acc.absorb_codes(codes[:8])
+    with pytest.raises(ValueError, match="absorb path"):
+        m2.close_counts(counts, 64)
+
+
+def test_schema_fingerprint_stable_and_sensitive():
+    s1 = schema_fingerprint(_schema())
+    assert s1 == schema_fingerprint(_schema())
+    changed = {"fields": [dict(f) for f in SCHEMA["fields"]]}
+    changed["fields"][2]["max"] = 700
+    assert schema_fingerprint(FeatureSchema.from_dict(changed)) != s1
