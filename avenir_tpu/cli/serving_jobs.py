@@ -12,6 +12,14 @@ coalescing policy, optional RESP wire transport).  Config keys
   ps.feature.schema.file.path  override the artifact's embedded schema
   ps.batch.max.size         micro-batch close size (default 64)
   ps.batch.max.wait.ms      micro-batch window (default 2.0)
+  ps.batching               continuous | drain (default continuous)
+  ps.slo.p99.ms             p99 latency budget; >0 enables the adaptive
+                            coalescing window (default 0 = fixed)
+  ps.queue.max.depth        admission threshold; submits past it answer
+                            'busy' (default 0 = unbounded)
+  ps.workers                fleet size; >1 serves through a ServingFleet
+                            of workers draining one RESP queue (default 1;
+                            requires ps.transport=resp)
   ps.bucket.sizes           jit shape buckets (default 1,8,64,512)
   ps.warm.start             pre-compile all buckets (default true)
   ps.latency.window         latency sample window (default 8192)
@@ -48,34 +56,103 @@ def prediction_service(cfg: Config, in_path: str, out_path: str) -> Counters:
         if "ps.feature.schema.file.path" in cfg else None
     policy = BatchPolicy(
         max_batch=cfg.get_int("ps.batch.max.size", 64),
-        max_wait_ms=cfg.get_float("ps.batch.max.wait.ms", 2.0))
+        max_wait_ms=cfg.get_float("ps.batch.max.wait.ms", 2.0),
+        batching=cfg.get("ps.batching", "continuous"),
+        slo_p99_ms=cfg.get_float("ps.slo.p99.ms", 0.0),
+        max_queue_depth=cfg.get_int("ps.queue.max.depth", 0))
+    n_workers = cfg.get_int("ps.workers", 1)
     timer = StepTimer(keep_samples=cfg.get_int("ps.latency.window", 8192))
     name = cfg.must_get("ps.model.name")
     buckets = tuple(cfg.get_int_list("ps.bucket.sizes",
                                      list(DEFAULT_BUCKETS)))
     warm = cfg.get_boolean("ps.warm.start", True)
     version = cfg.get_int("ps.model.version", 0)
-    common = dict(policy=policy, counters=counters, timer=timer,
-                  warm=warm, delim=cfg.field_delim_out)
-    if version:
-        # pinned serving: build the predictor for that exact version
-        # (hot-swap refresh is deliberately unavailable — a pin is a pin)
-        from ..serving.predictor import make_predictor
-        loaded = registry.load(name, version, schema=schema)
-        pred = make_predictor(loaded, schema=schema, buckets=buckets,
-                              delim=cfg.field_delim_out)
-        svc = PredictionService(pred, **common)
-        svc.version = version
-    else:
-        svc = PredictionService(registry=registry, model_name=name,
-                                schema=schema, buckets=buckets, **common)
-    counters.set("Serving", "ModelVersion", svc.version or 0)
     # tokenize with the INPUT delimiter (field.delim.regex, like every
     # other job); the service/wire delimiter is field.delim.out
     split = _splitter(cfg.field_delim_regex)
     rows = [split(line) for line in artifacts.read_text_input(in_path)]
     od = cfg.field_delim_out
     transport = cfg.get("ps.transport", "inprocess")
+    if n_workers > 1 and transport != "resp":
+        raise ValueError("ps.workers > 1 requires ps.transport=resp "
+                         "(the fleet drains a RESP request queue)")
+
+    def pinned_factory():
+        # pinned serving: build the predictor for that exact version
+        # (hot-swap refresh is deliberately unavailable — a pin is a pin)
+        from ..serving.predictor import make_predictor
+        loaded = registry.load(name, version, schema=schema)
+        return make_predictor(loaded, schema=schema, buckets=buckets,
+                              delim=cfg.field_delim_out)
+
+    if n_workers > 1:
+        from ..io.respq import RespClient, RespServer
+        from ..serving.fleet import ServingFleet
+        server = RespServer().start()
+        fleet = feeder = None
+        try:
+            req_q = cfg.get("redis.request.queue", "requestQueue")
+            pred_q = cfg.get("redis.prediction.queue", "predictionQueue")
+            wire_cfg = {"redis.server.port": server.port,
+                        "redis.request.queue": req_q,
+                        "redis.prediction.queue": pred_q}
+            fleet = ServingFleet(
+                registry=None if version else registry,
+                model_name=None if version else name,
+                predictor_factory=pinned_factory if version else None,
+                schema=schema, buckets=buckets, policy=policy,
+                n_workers=n_workers, config=wire_cfg, warm=warm,
+                delim=od,
+                latency_window=cfg.get_int("ps.latency.window", 8192))
+            fleet.start()
+            feeder = RespClient(port=server.port)
+            feeder.lpush_many(
+                req_q, [od.join(["predict", str(i)] + row)
+                        for i, row in enumerate(rows)])
+            feeder.lpush(req_q, "stop")
+            if not fleet.wait(timeout_s=300.0):
+                # a wedged worker means an incomplete reply set: fail
+                # loudly rather than writing a silently truncated output
+                raise RuntimeError(
+                    "predictionService fleet: worker(s) still draining "
+                    "after 300s — replay aborted (partial output "
+                    "suppressed)")
+            out: List[str] = []
+            while True:
+                v = feeder.rpop(pred_q)
+                if v is None:
+                    break
+                out.append(v)
+            out.sort(key=lambda r: int(r.split(od, 1)[0]))
+            # fold the fleet's aggregate counters + latency percentiles
+            # into the job dump before teardown
+            for grp, names in fleet.merged_counters().as_dict().items():
+                counters.update_group(grp, names)
+            fleet.merged_timer().export(counters, group="Serving")
+            versions = [w.service.version or 0 for w in fleet.workers]
+            counters.set("Serving", "ModelVersion",
+                         version or min(versions, default=0))
+        finally:
+            # tear down on EVERY path: an aborted replay must not leave
+            # worker services running (and their gauges/health bound to
+            # the default registry) or the feeder socket open
+            if fleet is not None:
+                fleet.stop()
+            if feeder is not None:
+                feeder.close()
+            server.stop()
+        artifacts.write_text_output(out_path, out, role="m")
+        return counters
+
+    common = dict(policy=policy, counters=counters, timer=timer,
+                  warm=warm, delim=cfg.field_delim_out)
+    if version:
+        svc = PredictionService(pinned_factory(), **common)
+        svc.version = version
+    else:
+        svc = PredictionService(registry=registry, model_name=name,
+                                schema=schema, buckets=buckets, **common)
+    counters.set("Serving", "ModelVersion", svc.version or 0)
     if transport == "resp":
         from ..io.respq import RespClient, RespServer
         server = RespServer().start()

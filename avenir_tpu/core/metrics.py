@@ -15,6 +15,7 @@ validation counters match the reference run for run.
 from __future__ import annotations
 
 import json
+import threading
 from collections import defaultdict
 from typing import Dict, Optional, Tuple
 
@@ -22,16 +23,49 @@ import numpy as np
 
 
 class Counters:
-    """Hadoop-counter-style metrics: (group, name) -> int."""
+    """Hadoop-counter-style metrics: (group, name) -> int.
+
+    Updates are atomic under one internal lock: serving loops mutate
+    counters from several threads while the metrics snapshot thread reads
+    them mid-flight, so read-modify-write races (lost increments, a
+    high-water mark going DOWN) must be impossible by construction."""
 
     def __init__(self):
         self._c: Dict[Tuple[str, str], int] = defaultdict(int)
+        self._lock = threading.Lock()
+
+    def __getstate__(self):
+        # the lock is process-local; counters cross process boundaries
+        # (shard allgather, subprocess result plumbing) as plain data —
+        # snapshot UNDER the lock so pickling a live Counters cannot race
+        # a first-seen key insert mid-copy
+        with self._lock:
+            return dict(self._c)
+
+    def __setstate__(self, state):
+        self._c = defaultdict(int, state)
+        self._lock = threading.Lock()
 
     def increment(self, group: str, name: str, amount: int = 1) -> None:
-        self._c[(group, name)] += int(amount)
+        with self._lock:
+            self._c[(group, name)] += int(amount)
 
     def set(self, group: str, name: str, value: int) -> None:
-        self._c[(group, name)] = int(value)
+        with self._lock:
+            self._c[(group, name)] = int(value)
+
+    def max(self, group: str, name: str, value: int) -> int:
+        """Atomically raise the counter to ``value`` if it is larger;
+        returns the resulting value.  The high-water-mark update (e.g.
+        Serving/MaxBatchObserved) as ONE operation — a get-then-set from
+        two threads could publish the smaller of two observations."""
+        with self._lock:
+            key = (group, name)
+            cur = self._c.get(key, 0)
+            if int(value) > cur:
+                cur = int(value)
+                self._c[key] = cur
+            return cur
 
     def get(self, group: str, name: str) -> int:
         return self._c.get((group, name), 0)
@@ -43,11 +77,15 @@ class Counters:
 
     def group(self, group: str) -> Dict[str, int]:
         """All (name, value) pairs of one group."""
-        return {n: v for (g, n), v in sorted(self._c.items()) if g == group}
+        with self._lock:
+            items = sorted(self._c.items())
+        return {n: v for (g, n), v in items if g == group}
 
     def as_dict(self) -> Dict[str, Dict[str, int]]:
+        with self._lock:
+            items = sorted(self._c.items())
         out: Dict[str, Dict[str, int]] = defaultdict(dict)
-        for (g, n), v in sorted(self._c.items()):
+        for (g, n), v in items:
             out[g][n] = v
         return dict(out)
 

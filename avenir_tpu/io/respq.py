@@ -7,8 +7,9 @@ RedisActionWriter.java:47-61).  This module provides both halves of that
 contract with no external dependency:
 
   * :class:`RespServer` — a threaded TCP server speaking the RESP2 subset
-    the queue contract needs (LPUSH, RPOP, LLEN, DEL, PING), backed by
-    in-memory deques.  A real ``redis-cli``/client library can talk to it.
+    the queue contract needs (LPUSH, RPOP, BRPOP, LLEN, DEL, PING), backed
+    by in-memory deques.  A real ``redis-cli``/client library can talk to
+    it.
   * :class:`RespClient` — a blocking client usable against this server OR
     a real Redis instance (the wire format is the same), exposing exactly
     the three verbs the reference uses.
@@ -22,6 +23,7 @@ from __future__ import annotations
 import socket
 import socketserver
 import threading
+import time
 from collections import deque
 from typing import Dict, List, Optional
 
@@ -126,7 +128,10 @@ class RespServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self.host, self.port = host, port
         self._queues: Dict[str, deque] = {}
-        self._lock = threading.Lock()
+        # a Condition so BRPOP can park its handler thread until an LPUSH
+        # arrives (ThreadingTCPServer: blocking one handler blocks only
+        # that client's connection); its lock is the queues lock
+        self._lock = threading.Condition()
         self._server: Optional[_TCPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -141,8 +146,53 @@ class RespServer:
                     q = self._queues.setdefault(args[1], deque())
                     for v in args[2:]:
                         q.appendleft(v)
+                    self._lock.notify_all()   # wake parked BRPOP waiters
                     return b":%d\r\n" % len(q)
+            if cmd == "BRPOP":
+                # blocking pop: park THIS connection's handler thread
+                # until a value arrives or the timeout lapses (seconds,
+                # fractional ok; 0 = block indefinitely, as in Redis).
+                # Reply is [key, value] or nil — the real BRPOP wire form.
+                key = args[1]
+                timeout = float(args[2])
+                deadline = None if timeout <= 0 \
+                    else time.monotonic() + timeout
+                with self._lock:
+                    while True:
+                        q = self._queues.get(key)
+                        if q:
+                            v = q.pop().encode()
+                            if not q:
+                                del self._queues[key]
+                            k = key.encode()
+                            return (b"*2\r\n$%d\r\n%s\r\n$%d\r\n%s\r\n"
+                                    % (len(k), k, len(v), v))
+                        if deadline is None:
+                            self._lock.wait()
+                        else:
+                            remaining = deadline - time.monotonic()
+                            if remaining <= 0:
+                                return b"*-1\r\n"
+                            self._lock.wait(remaining)
             if cmd == "RPOP":
+                if len(args) > 2:
+                    # Redis >= 6.2 count form: ONE command drains up to
+                    # n values (array reply; nil when the list is gone) —
+                    # the server half of rpop_many's single round trip
+                    n = int(args[2])
+                    with self._lock:
+                        q = self._queues.get(args[1])
+                        if not q:
+                            return b"*-1\r\n"
+                        vals = []
+                        while q and len(vals) < n:
+                            vals.append(q.pop().encode())
+                        if not q:
+                            del self._queues[args[1]]
+                    return b"*%d\r\n%s" % (
+                        len(vals),
+                        b"".join(b"$%d\r\n%s\r\n" % (len(v), v)
+                                 for v in vals))
                 with self._lock:
                     q = self._queues.get(args[1])
                     if not q:
@@ -190,7 +240,11 @@ class RespClient:
     def __init__(self, host: str = "127.0.0.1", port: int = 6379,
                  timeout: float = 10.0):
         self._sock = socket.create_connection((host, port), timeout=timeout)
+        # request/reply round trips are small packets; Nagle would add
+        # 40ms stalls to every serving poll
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._rf = self._sock.makefile("rb")
+        self._rpop_count_ok = True
 
     def _call(self, *args: str):
         self._sock.sendall(_encode_command(list(args)))
@@ -202,17 +256,46 @@ class RespClient:
     def lpush(self, queue: str, value: str) -> int:
         return int(self._call("LPUSH", queue, value))
 
+    def lpush_many(self, queue: str, values: List[str]) -> int:
+        """Push ``values`` as ONE variadic LPUSH (n round trips collapse
+        to one — the producer half of the wire micro-batching).  Returns
+        the queue length after the push; no-op 0 on an empty list."""
+        if not values:
+            return 0
+        return int(self._call("LPUSH", queue, *values))
+
     def rpop(self, queue: str) -> Optional[str]:
         return self._call("RPOP", queue)
 
+    def brpop(self, queue: str, timeout_s: float = 0.05) -> Optional[str]:
+        """Blocking pop: park on the server until a value arrives or
+        ``timeout_s`` lapses (fractional seconds; None on timeout) — the
+        idle half of the fleet drain, so N parked workers cost the host
+        nothing instead of N spin-polling cores.  ``timeout_s`` must stay
+        comfortably under the client socket timeout."""
+        reply = self._call("BRPOP", queue, repr(float(timeout_s)))
+        if reply is None:
+            return None
+        return reply[1]   # [key, value]
+
     def rpop_many(self, queue: str, n: int) -> List[str]:
-        """Drain up to ``n`` values with PIPELINED RPOPs: one socket write
-        carrying n commands, n replies read back — the wire half of
-        micro-batching (n round trips collapse to one).  Works against
-        this server or a real Redis (plain command pipelining).  Returns
-        the non-nil values in queue order; may be shorter than n."""
+        """Drain up to ``n`` values in ONE round trip.  Prefers the
+        Redis >= 6.2 ``RPOP key count`` form (one command, one array
+        reply — the server parses n commands' worth of work once); falls
+        back permanently to PIPELINED single RPOPs (one socket write
+        carrying n commands) the first time the server rejects the count
+        argument (real pre-6.2 Redis).  Returns the non-nil values in
+        queue order; may be shorter than n."""
         if n <= 0:
             return []
+        if self._rpop_count_ok:
+            try:
+                reply = self._call("RPOP", queue, str(n))
+            except RuntimeError:
+                # old server: remember and fall back to pipelining
+                self._rpop_count_ok = False
+            else:
+                return [] if reply is None else list(reply)
         self._sock.sendall(
             b"".join(_encode_command(["RPOP", queue]) for _ in range(n)))
         out: List[str] = []

@@ -14,7 +14,13 @@ the same model core must also serve online traffic.  Three layers:
     Templates insight: reuse pre-validated execution state);
   * :mod:`.service`   — the in-process micro-batching request loop plus
     the RESP wire transport (io/respq), same message conventions as the
-    bandit loop in reinforce/serving.py.
+    bandit loop in reinforce/serving.py.  Continuous (double-buffered)
+    batching, an SLO-adaptive coalescing window, and bounded-queue
+    admission control live here (BatchPolicy knobs);
+  * :mod:`.fleet`     — :class:`ServingFleet`, the traffic-shaped tier:
+    N workers with per-worker warm bucket caches draining ONE RESP
+    request queue, coordinated hot-swap, degraded-worker parking, and
+    per-worker ``/healthz/<name>`` targets.
 """
 
 from .registry import (FOREST, BAYES, LOGISTIC, MLP, LoadedModel,
@@ -23,11 +29,12 @@ from .predictor import (DEFAULT_BUCKETS, BayesPredictor, ForestPredictor,
                         LogisticPredictor, MLPPredictor, Predictor,
                         make_predictor)
 from .service import BatchPolicy, PredictionService, RespPredictionLoop
+from .fleet import ServingFleet
 
 __all__ = [
     "FOREST", "BAYES", "LOGISTIC", "MLP", "LoadedModel", "ModelRegistry",
     "load_model", "save_model", "DEFAULT_BUCKETS", "BayesPredictor",
     "ForestPredictor", "LogisticPredictor", "MLPPredictor", "Predictor",
     "make_predictor", "BatchPolicy", "PredictionService",
-    "RespPredictionLoop",
+    "RespPredictionLoop", "ServingFleet",
 ]

@@ -1,0 +1,351 @@
+"""The traffic-shaped serving fleet: N workers draining ONE RESP queue.
+
+The tier above :class:`~avenir_tpu.serving.service.PredictionService`
+(the reference avenir's Storm topology role, shaped like TensorFlow's
+serving story — many stateless workers against shared published
+parameters).  Each worker owns:
+
+  * its OWN :class:`PredictionService` (continuous or drain batching per
+    the shared :class:`BatchPolicy`) with its OWN warm shape-bucket
+    predictor cache built against the SHARED model registry — the
+    Execution Templates discipline: staged bucket executables are reused
+    across requests, never re-traced on the serving path;
+  * its own :class:`~avenir_tpu.io.respq.RespClient` connection draining
+    the one request queue with pipelined ``rpop_many`` (the multi-client
+    stress test in tests/test_respq.py is the no-loss/no-dup proof this
+    leans on) and parking on ``brpop`` when idle instead of spin-polling;
+  * its own metrics identity (``<model>-w<i>``): per-worker labeled
+    gauges on the registry and a per-worker ``/healthz/<name>`` target.
+
+Fleet-level semantics:
+
+  * **coordinated hot-swap** — a ``reload`` message seen by ANY worker
+    bumps one shared generation counter; every worker notices at its
+    next poll and refreshes off the registry, so the whole fleet
+    converges to the newest intact version (in-flight batches finish on
+    the model they started on).
+  * **degraded parking** — a worker whose service was ``mark_degraded``
+    (drift guardrail) stops pulling: it flushes what it already
+    accepted, then parks until a hot-swap clears the flag.  Its
+    ``/healthz/<name>`` answers 503 while its peers keep serving.
+  * **admission control** — the bounded service queue is the admission
+    point for BOTH transports: a submit past ``policy.max_queue_depth``
+    resolves immediately as ``busy`` and the worker answers
+    ``<id>,busy`` on the wire.  Every popped request is answered with
+    SOMETHING (prediction, ``error``, or ``busy``) — no accepted
+    request is ever dropped, fleet-wide.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import warnings
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..core.metrics import Counters
+from ..utils.tracing import StepTimer
+from .predictor import DEFAULT_BUCKETS, Predictor
+from .service import BatchPolicy, PredictionService
+
+
+class _Worker:
+    """One fleet member: service + wire connection + drain thread."""
+
+    __slots__ = ("index", "name", "service", "client", "thread",
+                 "seen_gen", "pending")
+
+    def __init__(self, index: int, name: str, service: PredictionService):
+        self.index = index
+        self.name = name
+        self.service = service
+        self.client = None
+        self.thread: Optional[threading.Thread] = None
+        self.seen_gen = 0
+        # (request_id, future) in submit order; service batches complete
+        # in order, so FIFO head-flush is completion order
+        self.pending: "deque[tuple]" = deque()
+
+
+class ServingFleet:
+    """Run ``n_workers`` PredictionService workers against one RESP
+    request queue.  Construct around a shared ``registry`` +
+    ``model_name`` (hot-swap enabled) or a ``predictor_factory``
+    returning a fresh per-worker :class:`Predictor` (no registry, reload
+    is a no-op) — then :meth:`start`, feed the request queue, and
+    :meth:`stop` (or push a literal ``stop`` message, which stops every
+    worker after the requests already popped are answered)."""
+
+    def __init__(self, registry=None, model_name: Optional[str] = None, *,
+                 predictor_factory: Optional[Callable[[], Predictor]] = None,
+                 schema=None,
+                 buckets: Sequence[int] = DEFAULT_BUCKETS,
+                 policy: Optional[BatchPolicy] = None,
+                 n_workers: int = 2,
+                 config: Optional[Dict] = None,
+                 warm: bool = True,
+                 delim: str = ",",
+                 metrics=None,
+                 latency_window: int = 8192,
+                 idle_sleep_s: float = 0.002,
+                 max_idle_sleep_s: float = 0.05):
+        if predictor_factory is None and (registry is None
+                                          or model_name is None):
+            raise ValueError("need registry= + model_name=, or "
+                             "predictor_factory=")
+        if n_workers < 1:
+            raise ValueError(f"n_workers must be >= 1, got {n_workers}")
+        cfg = dict(config or {})
+        self.registry = registry
+        self.model_name = model_name
+        self.predictor_factory = predictor_factory
+        self._schema = schema
+        self._buckets = tuple(buckets)
+        self.policy = policy or BatchPolicy()
+        self.n_workers = int(n_workers)
+        self._warm = warm
+        self.delim = delim
+        self._metrics = metrics
+        self._latency_window = int(latency_window)
+        self.idle_sleep_s = float(idle_sleep_s)
+        self.max_idle_sleep_s = float(max_idle_sleep_s)
+        self.host = cfg.get("redis.server.host", "127.0.0.1")
+        self.port = int(cfg.get("redis.server.port", 6379))
+        self.request_q = cfg.get("redis.request.queue", "requestQueue")
+        self.prediction_q = cfg.get("redis.prediction.queue",
+                                    "predictionQueue")
+        self._reload_gen = 0
+        self._stop = threading.Event()
+        self.workers: List[_Worker] = []
+
+    # ---- lifecycle ----
+    def _make_service(self, wname: str) -> PredictionService:
+        common = dict(policy=self.policy, warm=self._warm,
+                      delim=self.delim, name=wname,
+                      counters=Counters(),
+                      timer=StepTimer(keep_samples=self._latency_window),
+                      metrics=self._metrics)
+        if self.predictor_factory is not None:
+            return PredictionService(self.predictor_factory(), **common)
+        return PredictionService(registry=self.registry,
+                                 model_name=self.model_name,
+                                 schema=self._schema,
+                                 buckets=self._buckets, **common)
+
+    def start(self) -> "ServingFleet":
+        if self.workers:
+            return self
+        from ..io.respq import RespClient
+        self._stop.clear()
+        base = self.model_name or "fleet"
+        for i in range(self.n_workers):
+            wname = f"{base}-w{i}"
+            w = _Worker(i, wname, self._make_service(wname))
+            w.service.start()
+            w.client = RespClient(self.host, self.port)
+            self.workers.append(w)
+        # connect everything before pulling: a worker that starts draining
+        # while a peer is still warming would skew the first measurements
+        for w in self.workers:
+            w.thread = threading.Thread(target=self._drain, args=(w,),
+                                        daemon=True,
+                                        name=f"avenir-fleet-{w.name}")
+            w.thread.start()
+        return self
+
+    def request_reload(self) -> None:
+        """Coordinated hot-swap: every worker refreshes from the shared
+        registry at its next poll (the caller may be any worker's drain
+        thread, or operator code)."""
+        self._reload_gen += 1
+
+    def wait(self, timeout_s: float = 60.0) -> bool:
+        """Block until every drain thread exited (a wire ``stop`` message
+        or :meth:`stop` ended the fleet); True when all did."""
+        deadline = time.monotonic() + timeout_s
+        ok = True
+        for w in self.workers:
+            if w.thread is not None:
+                w.thread.join(timeout=max(0.0, deadline - time.monotonic()))
+                ok = ok and not w.thread.is_alive()
+        return ok
+
+    def stop(self, drain_s: float = 5.0) -> None:
+        """Stop pulling, answer everything already accepted (pending wire
+        replies flushed, then each service's queued requests served in
+        ``max_batch`` chunks), tear down connections.  Workers stay
+        listed for post-run ``stats()``/``merged_counters()`` reads; a
+        stopped fleet is not restartable."""
+        self._stop.set()
+        self.wait(timeout_s=max(drain_s, 0.1) + 30.0)
+        for w in self.workers:
+            w.service.stop(drain_s=drain_s)
+            if w.client is not None:
+                try:
+                    w.client.close()
+                except OSError:
+                    pass
+
+    # ---- observability ----
+    def stats(self) -> Dict:
+        """Aggregate + per-worker snapshot: total served/rejected/errors,
+        per-worker model versions (converged after a coordinated
+        hot-swap), queue depths, degraded flags."""
+        per = {w.name: w.service.stats() for w in self.workers}
+        return {
+            "workers": len(self.workers),
+            "reload_generation": self._reload_gen,
+            "served": sum(s["served"] for s in per.values()),
+            "rejected": sum(s["rejected"] for s in per.values()),
+            "errors": sum(s["errors"] for s in per.values()),
+            "model_versions": {n: s["model_version"]
+                               for n, s in per.items()},
+            "per_worker": per,
+        }
+
+    def merged_counters(self) -> Counters:
+        """One Counters summing every worker's Serving group (the job
+        dump view; per-worker splits stay on the metrics registry)."""
+        out = Counters()
+        for w in self.workers:
+            for grp, names in w.service.counters.as_dict().items():
+                for n, v in names.items():
+                    if n.startswith("Max"):
+                        # high-water marks (MaxBatchObserved) merge by
+                        # max — summing two workers' 16s would report a
+                        # 32-row batch nothing ever served
+                        out.max(grp, n, v)
+                    else:
+                        out.increment(grp, n, v)
+        out.set("Serving", "Workers", len(self.workers)
+                or self.n_workers)
+        return out
+
+    def merged_timer(self) -> StepTimer:
+        """One StepTimer holding every worker's latency samples (fleet
+        percentiles; per-worker percentiles stay on each service)."""
+        merged = StepTimer(keep_samples=self._latency_window
+                           * max(1, self.n_workers))
+        for w in self.workers:
+            for name, dq in list(w.service.timer.samples.items()):
+                # the worker's predict thread appends concurrently; a
+                # live-stats caller must not crash on a mutating deque
+                for _ in range(3):
+                    try:
+                        samples = list(dq)
+                        break
+                    except RuntimeError:
+                        continue
+                else:
+                    samples = []
+                for s in samples:
+                    merged.record(name, s)
+        return merged
+
+    # ---- the drain loop (one thread per worker) ----
+    def _drain(self, w: _Worker) -> None:
+        svc = w.service
+        sleep_s = self.idle_sleep_s
+        try:
+            while not self._stop.is_set():
+                if w.seen_gen != self._reload_gen:
+                    w.seen_gen = self._reload_gen
+                    try:
+                        svc.refresh()
+                    except Exception as exc:
+                        warnings.warn(
+                            f"fleet {w.name}: hot-swap refresh failed "
+                            f"({type(exc).__name__}: {exc}); serving "
+                            f"stays on version {svc.version}",
+                            RuntimeWarning)
+                if svc.degraded is not None and \
+                        any(p.service.degraded is None
+                            for p in self.workers if p is not w):
+                    # a degraded worker stops pulling WHILE a healthy
+                    # peer keeps draining: answer what it already
+                    # accepted, then park (a hot-swap clears the flag
+                    # via refresh above).  When EVERY worker is degraded
+                    # the last one keeps serving (flagged, /healthz 503)
+                    # — otherwise nobody could ever pop the wire
+                    # 'reload' that is the documented recovery path, and
+                    # the whole queue would wedge unanswered.
+                    self._flush(w, wait=True)
+                    svc.counters.increment("Serving", "ParkedPolls")
+                    time.sleep(self.max_idle_sleep_s)
+                    continue
+                msgs = w.client.rpop_many(self.request_q,
+                                          svc.policy.max_batch)
+                svc.counters.increment("Serving", "Polls")
+                if msgs:
+                    sleep_s = self.idle_sleep_s
+                    self._ingest(w, msgs)
+                else:
+                    svc.counters.increment("Serving", "EmptyPolls")
+                    self._flush(w, wait=False)
+                    # park on the server instead of spin-polling; keep
+                    # the park short while replies are still pending so
+                    # a batch finishing mid-park is flushed promptly
+                    park = 0.001 if w.pending else sleep_s
+                    v = w.client.brpop(self.request_q, timeout_s=park)
+                    if v is not None:
+                        sleep_s = self.idle_sleep_s
+                        self._ingest(w, [v])
+                    elif not w.pending:
+                        sleep_s = min(sleep_s * 2.0, self.max_idle_sleep_s)
+                self._flush(w, wait=False)
+        finally:
+            # answer everything this worker accepted before it exits —
+            # the no-drop guarantee holds through 'stop' and crashes
+            try:
+                self._flush(w, wait=True)
+            except Exception as exc:
+                warnings.warn(f"fleet {w.name}: final flush failed "
+                              f"({type(exc).__name__}: {exc})",
+                              RuntimeWarning)
+
+    def _ingest(self, w: _Worker, msgs: List[str]) -> None:
+        svc = w.service
+        for m in msgs:
+            if m == "stop":
+                # fleet-wide: peers see the event at their next poll.
+                # Everything queued BEFORE the stop was already popped
+                # (FIFO) by someone and will be answered.
+                self._stop.set()
+                continue
+            parts = m.split(svc.delim)
+            if parts[0] == "reload":
+                self.request_reload()
+            elif parts[0] == "predict" and len(parts) >= 3:
+                # admission happens inside submit(): past the depth
+                # threshold the future comes back already resolved
+                # 'busy' and the flush answers <id>,busy
+                w.pending.append((parts[1], svc.submit(parts[2:])))
+            else:
+                svc.counters.increment("Serving", "BadRequests")
+                warnings.warn(f"fleet {w.name}: dropping malformed "
+                              f"message {m!r}", RuntimeWarning)
+
+    def _flush(self, w: _Worker, wait: bool,
+               timeout_s: float = 120.0) -> None:
+        """Answer completed futures onto the prediction queue, in FIFO
+        order, as ONE pipelined variadic LPUSH per flush (a whole served
+        batch costs one wire round trip, not one per reply).  ``wait=True``
+        blocks until every pending future resolved (shutdown / parking);
+        ``wait=False`` only flushes the done head."""
+        svc = w.service
+        replies: List[str] = []
+        while w.pending:
+            rid, fut = w.pending[0]
+            if not fut.done() and not wait:
+                break
+            try:
+                label = fut.result(timeout=timeout_s)
+            except Exception:
+                # per-request isolation already counted it; the waiter
+                # still gets a reply line
+                label = svc.error_label
+            replies.append(f"{rid}{svc.delim}{label}")
+            w.pending.popleft()
+        if replies:
+            w.client.lpush_many(self.prediction_q, replies)

@@ -100,16 +100,33 @@ class Predictor:
             yield encode_rows(chunk + [chunk[-1]] * (b - n),
                               self.schema), n
 
+    def prepare_rows(self, rows: List[List[str]]):
+        """The HOST half of predict_rows: tokenized records -> encoded,
+        bucket-padded tables.  Split out so the continuous serving loop
+        can run it on the assembler thread while the previous batch's
+        device predict is in flight (stage_chunks' parse ‖ compute split
+        applied to serving — encode is the dominant non-device cost of a
+        small-model predict).  The returned value is opaque; hand it to
+        :meth:`predict_prepared` on the SAME predictor instance (a
+        hot-swap between the two must finish the batch on the old
+        model)."""
+        return list(self._bucketed_tables(rows)) if rows else []
+
+    def predict_prepared(self, prepared) -> List[Optional[str]]:
+        """The DEVICE half: run warm bucket executables over tables from
+        :meth:`prepare_rows`."""
+        out: List[Optional[str]] = []
+        for table, n in prepared:
+            out.extend(self._predict_table(table)[:n])
+        return out
+
     def predict_rows(self, rows: List[List[str]]) -> List[Optional[str]]:
         """Predict a micro-batch of tokenized records.  Batches larger than
         the top bucket split into top-bucket chunks (each still one warm
         executable)."""
         if not rows:
             return []
-        out: List[Optional[str]] = []
-        for table, n in self._bucketed_tables(rows):
-            out.extend(self._predict_table(table)[:n])
-        return out
+        return self.predict_prepared(self.prepare_rows(rows))
 
     # ---- subclass contract ----
     def _predict_table(self, table: ColumnarTable) -> List[Optional[str]]:
@@ -159,25 +176,54 @@ class ForestPredictor(Predictor):
             # is exact and compile-free, so bucketing is moot
             self._core = None
 
+    def dispatch_prepared(self, prepared):
+        """The ASYNC half of predict_prepared: run the host prep and
+        LAUNCH the jitted vote per bucket chunk without forcing the
+        result — jax dispatch returns while XLA computes on its own
+        thread pool (the §18 async-dispatch discipline), so a continuous
+        serving loop can gather+encode the next batch during this one's
+        device time.  Chunks that fail the device gate (or the
+        single-tree/host-vote paths) compute synchronously here and ride
+        along pre-resolved."""
+        from ..models.tree import FeatureCache
+        from ..utils.tracing import note_dispatch
+        staged = []
+        for table, n in prepared:
+            if not self.single and self._core is not None:
+                # same device-path gate and label decode as the batch
+                # path — serving only substitutes the compile-counted
+                # jit.  The cache rides into the host fallback so a
+                # failed gate does not rebuild the feature arrays it
+                # already built.
+                cache = FeatureCache()
+                dev = self.ensemble.device_inputs(table, cache)
+                if dev is not None:
+                    note_dispatch(site="serve.predict")
+                    staged.append((True, self._core(*dev), n))
+                    continue
+                staged.append(
+                    (False, self.ensemble._predict_host(table, cache), n))
+            elif self.single:
+                staged.append(
+                    (False, list(self.models[0].predict(table)[0]), n))
+            else:
+                staged.append((False, self.ensemble.predict(table), n))
+        return staged
+
+    def readback_dispatched(self, staged) -> List[Optional[str]]:
+        """The BLOCKING half: force each staged device result and decode
+        labels (host-path chunks are already resolved)."""
+        out: List[Optional[str]] = []
+        for is_dev, v, n in staged:
+            if is_dev:
+                out.extend(list(self.ensemble._lut[np.asarray(v)])[:n])
+            else:
+                out.extend(list(v)[:n])
+        return out
+
     def _predict_table(self, table: ColumnarTable) -> List[Optional[str]]:
-        if self.single:
-            preds, _ = self.models[0].predict(table)
-            return list(preds)
-        ens = self.ensemble
-        if self._core is not None:
-            # same device-path gate and label decode as the batch path —
-            # serving only substitutes the compile-counted jit.  The cache
-            # rides into the host fallback so a failed gate does not
-            # rebuild the feature arrays it already built.
-            from ..models.tree import FeatureCache
-            from ..utils.tracing import note_dispatch
-            cache = FeatureCache()
-            dev = ens.device_inputs(table, cache)
-            if dev is not None:
-                note_dispatch(site="serve.predict")
-                return list(ens._lut[np.asarray(self._core(*dev))])
-            return ens._predict_host(table, cache)
-        return ens.predict(table)
+        return self.readback_dispatched(
+            self.dispatch_prepared([(table, table.n_rows)]))
 
 
 class BayesPredictor(Predictor):

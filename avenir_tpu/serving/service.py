@@ -8,6 +8,41 @@ then answers the whole batch — the device does per-request work at batch
 throughput while the slowest request waits at most one window plus one
 predict.
 
+Batching modes (``BatchPolicy.batching``):
+
+  * ``continuous`` (default) — double-buffered over ASYNC device
+    dispatch: the loop launches batch N without forcing its result (jax
+    computes on XLA's own pool), gathers + encodes + dispatches batch
+    N+1 while N is in flight, then reads N back — the serving twin of
+    ``stage_chunks``' parse ‖ transfer ‖ compute split, with no second
+    python thread contending for the GIL.  Device idle between batches
+    goes to ~0 under load; ``Serving/OverlappedBatches`` counts batches
+    whose assembly genuinely overlapped a predict in flight.  With a
+    batch in flight the coalescing window is skipped — the in-flight
+    predict IS the window (arrivals during it join the next greedy
+    drain).  Predictors without the dispatch/readback split degrade to
+    drain-first behavior.
+  * ``drain`` — the original drain-first loop: assemble, predict, repeat,
+    each batch forced before the next gather.  Kept for comparison (the
+    bench sweeps both).
+
+SLO-adaptive coalescing: with ``BatchPolicy.slo_p99_ms`` set, the
+effective window shrinks (×0.5, floored at ``min_wait_ms``) whenever the
+recent request-latency p99 climbs past ``_SLO_SHRINK_FRACTION`` (60%) of
+the budget AND the window's own measured hold is a real part of that
+latency, and grows back (×1.5, capped at ``max_wait_ms``) while p99 sits
+under ``_SLO_GROW_FRACTION`` (35%) of it — the window fills buckets when
+latency is cheap and gets out of the way when the budget is under
+pressure (see :meth:`PredictionService._effective_wait_ms` for the full
+rule).
+
+Admission control: with ``BatchPolicy.max_queue_depth`` set, a submit
+against a full queue is answered immediately with ``busy_label`` (wire
+reply ``<id>,busy``) instead of queueing unboundedly —
+``Serving/Rejected`` counts them and ``serve.admit``/``serve.reject``
+instants mark the decisions in the trace.  Nothing accepted is ever
+dropped.
+
 Transports (same split as reinforce/serving.py, the bandit loop):
 
   * in-process — ``submit()`` returns a future; a daemon worker thread
@@ -42,18 +77,42 @@ from typing import Dict, List, Optional, Sequence
 
 from ..core.faults import with_retry
 from ..core.metrics import Counters
-from ..telemetry import get_default_registry, span
+from ..telemetry import get_default_registry, instant, span
 from ..utils.tracing import StepTimer
 from .predictor import AMBIGUOUS, DEFAULT_BUCKETS, Predictor, make_predictor
 from .registry import ModelRegistry
+
+# adaptive-window hysteresis band: shrink above SHRINK*slo, grow back
+# below GROW*slo, hold in between (so the window does not oscillate on
+# every batch when p99 hovers near one edge).  SHRINK sits well under
+# 1.0 deliberately: the controller's equilibrium lands near SHRINK*slo,
+# and the gap up to the budget is the headroom that absorbs tail noise
+# the window cannot control (scheduler stalls, allocator hiccups)
+_SLO_SHRINK_FRACTION = 0.6
+_SLO_GROW_FRACTION = 0.35
 
 
 @dataclass
 class BatchPolicy:
     """Coalescing knobs: a batch closes at ``max_batch`` requests or
-    ``max_wait_ms`` after its first request, whichever comes first."""
+    ``max_wait_ms`` after its first request, whichever comes first.
+
+    ``batching`` selects the loop shape (``continuous`` double-buffered
+    assembly, or the original ``drain``-first).  ``slo_p99_ms > 0``
+    enables the adaptive window (``min_wait_ms`` is its floor; the
+    configured ``max_wait_ms`` its ceiling).  ``max_queue_depth > 0``
+    bounds the request queue: submits past it are answered ``busy``."""
     max_batch: int = 64
     max_wait_ms: float = 2.0
+    batching: str = "continuous"       # "continuous" | "drain"
+    slo_p99_ms: float = 0.0            # 0 = fixed window
+    min_wait_ms: float = 0.05          # adaptive-window floor
+    max_queue_depth: int = 0           # 0 = unbounded (no admission control)
+
+    def __post_init__(self):
+        if self.batching not in ("continuous", "drain"):
+            raise ValueError(f"BatchPolicy.batching must be 'continuous' "
+                             f"or 'drain', got {self.batching!r}")
 
 
 class _Request:
@@ -85,6 +144,8 @@ class PredictionService:
                  delim: str = ",",
                  ambiguous_label: str = AMBIGUOUS,
                  error_label: str = "error",
+                 busy_label: str = "busy",
+                 name: Optional[str] = None,
                  monitor=None,
                  metrics=None):
         if predictor is None and (registry is None or model_name is None):
@@ -101,6 +162,10 @@ class PredictionService:
         self.delim = delim
         self.ambiguous_label = ambiguous_label
         self.error_label = error_label
+        self.busy_label = busy_label
+        # identity for metrics/health series (fleet workers get w0/w1/...);
+        # defaults to the model name in bind_metrics
+        self.name = name
         self.version: Optional[int] = None
         # drift/quality hook (monitor.accumulator.ServingMonitor): every
         # served micro-batch records through it; None = unmonitored
@@ -119,6 +184,12 @@ class PredictionService:
         self._queue: "queue.Queue[_Request]" = queue.Queue()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # adaptive coalescing state (only moves when slo_p99_ms is set):
+        # the current window, plus an EMA of how long recent batches
+        # actually HELD the window open for stragglers — the window's own
+        # latency contribution, which decides shrink vs grow
+        self._adaptive_wait_ms = self.policy.max_wait_ms
+        self._hold_ema_ms = 0.0
         # rows currently inside a device predict (for the in-flight gauge
         # and stats(); the lock is a few adds per multi-row batch)
         self._inflight = 0
@@ -202,6 +273,8 @@ class PredictionService:
             "errors": self.counters.get("Serving", "BadRequests"),
             "batches": self.counters.get("Serving", "Batches"),
             "hot_swaps": self.counters.get("Serving", "HotSwaps"),
+            "rejected": self.counters.get("Serving", "Rejected"),
+            "window_ms": self._adaptive_wait_ms,
             "degraded": self.degraded,
             "model_version": self.version,
         }
@@ -237,7 +310,7 @@ class PredictionService:
         # survivor's gauges.  Uniquify against the registry's live
         # health keys — own key was just unbound above, so rebinding
         # the SAME service reclaims its label.
-        base = self.model_name or "predictor"
+        base = self.name or self.model_name or "predictor"
         svc_label, n = base, 1
         while registry.has_health(f"serving:{svc_label}"):
             svc_label = f"{base}-{n}"
@@ -256,6 +329,8 @@ class PredictionService:
             g.set(st["errors"], service=svc_label, key="errors")
             g.set(st["batches"], service=svc_label, key="batches")
             g.set(st["hot_swaps"], service=svc_label, key="hot_swaps")
+            g.set(st["rejected"], service=svc_label, key="rejected")
+            g.set(st["window_ms"], service=svc_label, key="window_ms")
             g.set(0 if st["degraded"] is None else 1,
                   service=svc_label, key="degraded")
             g.set(st["model_version"] or 0,
@@ -292,22 +367,32 @@ class PredictionService:
     def _label(self, pred: Optional[str]) -> str:
         return pred if pred is not None else self.ambiguous_label
 
-    def predict_rows(self, rows: List[List[str]]) -> List[str]:
+    def predict_rows(self, rows: List[List[str]], *,
+                     _pred=None, _prepared=None) -> List[str]:
         """One coalesced device batch for ``rows``, with transient-error
         retry (a recoverable allocator/IO hiccup re-runs the batch rather
-        than failing every request in it)."""
-        with self._swap_lock:
-            pred = self.predictor
+        than failing every request in it).  ``_pred``/``_prepared`` carry
+        a predictor snapshot + its pre-encoded tables from the continuous
+        assembler (the encode already overlapped the previous predict);
+        without them the whole predict runs here."""
+        if _pred is None:
+            with self._swap_lock:
+                _pred = self.predictor
         t0 = time.perf_counter()
         with span("serve.predict", cat="serving", rows=len(rows)):
-            out = with_retry(lambda: pred.predict_rows(rows),
-                             what="serving predict batch")
+            if _prepared is not None:
+                out = with_retry(lambda: _pred.predict_prepared(_prepared),
+                                 what="serving predict batch")
+            else:
+                out = with_retry(lambda: _pred.predict_rows(rows),
+                                 what="serving predict batch")
         self.timer.record("serve.batch", time.perf_counter() - t0)
         self.counters.increment("Serving", "Requests", len(rows))
         self.counters.increment("Serving", "Batches")
         return [self._label(p) for p in out]
 
-    def _predict_isolating(self, rows: List[List[str]]):
+    def _predict_isolating(self, rows: List[List[str]],
+                           pred=None, prepared=None):
         """('ok', label) | ('err', exc) per row.  The whole batch runs as
         one launch when it is clean; if anything in it fails (e.g. a short
         record or a non-numeric token blowing up encode_rows), fall back
@@ -321,34 +406,43 @@ class PredictionService:
             self._inflight += len(rows)
         try:
             try:
-                results = [("ok", lab) for lab in self.predict_rows(rows)]
+                results = [("ok", lab) for lab in
+                           self.predict_rows(rows, _pred=pred,
+                                             _prepared=prepared)]
                 self._record_monitor(rows, results)
                 return results
             except Exception as exc:
                 warnings.warn(
                     f"serving: batch predict failed ({type(exc).__name__}: "
                     f"{exc}); isolating per row", RuntimeWarning)
-            with self._swap_lock:
-                pred = self.predictor
-            t0 = time.perf_counter()
-            out = []
-            for row in rows:
-                try:
-                    lab = with_retry(lambda r=row: pred.predict_rows([r]),
-                                     what="serving predict row")[0]
-                    out.append(("ok", self._label(lab)))
-                except Exception as exc:
-                    self.counters.increment("Serving", "BadRequests")
-                    out.append(("err", exc))
-            self.timer.record("serve.batch", time.perf_counter() - t0)
-            self.counters.increment("Serving", "Requests", len(rows))
-            self.counters.increment("Serving", "Batches")
-            self.counters.increment("Serving", "IsolatedBatches")
-            self._record_monitor(rows, out)
-            return out
+            if pred is None:
+                with self._swap_lock:
+                    pred = self.predictor
+            return self._isolated_pass(pred, rows)
         finally:
             with self._inflight_lock:
                 self._inflight -= len(rows)
+
+    def _isolated_pass(self, pred, rows: List[List[str]]):
+        """Per-row isolation after a whole-batch failure: one launch per
+        row so one malformed request cannot take down its batchmates.
+        Accounts as ONE isolated batch (see _predict_isolating)."""
+        t0 = time.perf_counter()
+        out = []
+        for row in rows:
+            try:
+                lab = with_retry(lambda r=row: pred.predict_rows([r]),
+                                 what="serving predict row")[0]
+                out.append(("ok", self._label(lab)))
+            except Exception as exc:
+                self.counters.increment("Serving", "BadRequests")
+                out.append(("err", exc))
+        self.timer.record("serve.batch", time.perf_counter() - t0)
+        self.counters.increment("Serving", "Requests", len(rows))
+        self.counters.increment("Serving", "Batches")
+        self.counters.increment("Serving", "IsolatedBatches")
+        self._record_monitor(rows, out)
+        return out
 
     def _record_monitor(self, rows, results) -> None:
         """Feed successfully answered (row, label) pairs to the drift
@@ -420,10 +514,21 @@ class PredictionService:
     # ---- in-process micro-batch loop ----
     def submit(self, row) -> "Future[str]":
         """Queue one record (tokenized row or delim-joined line); the
-        worker thread answers the future with the class label."""
+        worker thread answers the future with the class label.  Past the
+        admission threshold (``policy.max_queue_depth``) the future is
+        answered immediately with ``busy_label`` — backpressure the
+        caller can see, never a silently dropped request."""
         if isinstance(row, str):
             row = row.split(self.delim)
         req = _Request(list(row))
+        dmax = self.policy.max_queue_depth
+        if dmax and self._queue.qsize() >= dmax:
+            self.counters.increment("Serving", "Rejected")
+            instant("serve.reject", cat="serving",
+                    queue_depth=self._queue.qsize())
+            req.future.set_result(self.busy_label)
+            return req.future
+        instant("serve.admit", cat="serving")
         self._queue.put(req)
         return req.future
 
@@ -431,52 +536,139 @@ class PredictionService:
         if self._thread is not None:
             return self
         self._stop.clear()
-        self._thread = threading.Thread(target=self._loop, daemon=True)
+        target = self._loop_continuous \
+            if self.policy.batching == "continuous" else self._loop
+        self._thread = threading.Thread(target=target, daemon=True,
+                                        name="avenir-serve-loop")
         self._thread.start()
         return self
 
     def stop(self, drain_s: float = 5.0) -> None:
         """Stop the worker; queued requests are still served (bounded by
-        ``drain_s``) so no accepted request is dropped on shutdown."""
+        ``drain_s``) so no accepted request is dropped on shutdown.  The
+        leftover drain is CHUNKED into ``policy.max_batch`` batches —
+        a deep backlog at shutdown must run through the same compiled
+        bucket sizes as live traffic, never one unbounded batch.  Also
+        runs when the worker never started: accepted futures are
+        answered regardless."""
         # unbind from the registry whether or not the worker ran: a
         # stopped service must not be probed by every later scrape
         self._unbind_metrics()
-        if self._thread is None:
-            return
         self._stop.set()
-        self._thread.join(timeout=max(drain_s, 0.1) + 5.0)
-        self._thread = None
+        join_s = max(drain_s, 0.1) + 5.0
+        if self._thread is not None:
+            self._thread.join(timeout=join_s)
+            self._thread = None
         deadline = time.monotonic() + drain_s
-        batch = []
+        max_b = max(1, self.policy.max_batch)
+        batch: List[_Request] = []
         while time.monotonic() < deadline:
             try:
                 batch.append(self._queue.get_nowait())
             except queue.Empty:
                 break
+            if len(batch) >= max_b:
+                self._serve(batch)
+                batch = []
         if batch:
             self._serve(batch)
 
-    def _loop(self) -> None:
-        pol = self.policy
-        while not self._stop.is_set():
+    # how many of the newest serve.request samples steer the adaptive
+    # window: small enough to react within ~a quarter second of traffic,
+    # large enough that one straggler is not "the p99"
+    _ADAPT_SAMPLES = 256
+
+    def _recent_p99_ms(self) -> float:
+        s = self.timer.samples.get("serve.request")
+        if not s:
+            return 0.0
+        import numpy as np
+        # the predict thread appends to this bounded deque concurrently
+        # (a full-deque append also pops): list() can raise "deque
+        # mutated during iteration".  Retry, and on persistent contention
+        # report "no pressure" (0.0) — one adaptive step on stale info is
+        # noise; an exception here would kill the assembler thread and
+        # silently stop the service
+        for _ in range(3):
             try:
-                first = self._queue.get(timeout=0.02)
-            except queue.Empty:
+                recent = list(s)[-self._ADAPT_SAMPLES:]
+                break
+            except RuntimeError:
                 continue
-            batch = [first]
-            with span("serve.assemble", cat="serving") as sp:
-                # free coalescing first: whatever queued while the previous
-                # batch was on device joins this one with zero added wait
-                while len(batch) < pol.max_batch:
-                    try:
-                        batch.append(self._queue.get_nowait())
-                    except queue.Empty:
-                        break
-                # then hold the window open for stragglers — bounded by
-                # the FIRST request's age, so the policy's latency promise
-                # holds even when the window was already spent in the
-                # backlog
-                deadline = first.t_submit + pol.max_wait_ms / 1000.0
+        else:
+            return 0.0
+        if not recent:
+            return 0.0
+        return float(np.percentile(np.asarray(recent), 99)) * 1000.0
+
+    def _effective_wait_ms(self) -> float:
+        """The coalescing window for the NEXT batch.  Fixed at
+        ``policy.max_wait_ms`` unless an SLO budget is set; under one:
+
+        * recent p99 past ``_SLO_SHRINK_FRACTION`` of the budget AND the
+          window's own measured latency contribution (the straggler-hold
+          EMA) above 10% of the budget -> SHRINK ×0.5 (floored at
+          ``min_wait_ms``): the window is demonstrably where the latency
+          comes from.
+        * recent p99 past the shrink fraction but the hold EMA is NOT
+          the cost -> GROW ×1.5: latency is coming from queueing/predict
+          pressure, and cutting the window further would only shrink
+          batch fill and collapse throughput (making p99 worse) — fill
+          the buckets instead.
+        * recent p99 under ``_SLO_GROW_FRACTION`` of the budget -> GROW
+          ×1.5 (capped at ``max_wait_ms``): latency is cheap, refill the
+          buckets.
+
+        Between the two fractions the window holds (hysteresis).
+        "Recent" is
+        the last ``_ADAPT_SAMPLES`` request samples — the full timer
+        window would remember a bad spell for thousands of requests and
+        keep the window pinned long after recovery."""
+        pol = self.policy
+        if not pol.slo_p99_ms:
+            return pol.max_wait_ms
+        w = self._adaptive_wait_ms
+        try:
+            p99 = self._recent_p99_ms()
+            if p99 >= _SLO_SHRINK_FRACTION * pol.slo_p99_ms:
+                if self._hold_ema_ms >= 0.1 * pol.slo_p99_ms:
+                    w = max(pol.min_wait_ms, w * 0.5)
+                else:
+                    w = min(pol.max_wait_ms, max(w * 1.5, pol.min_wait_ms))
+            elif p99 and p99 < _SLO_GROW_FRACTION * pol.slo_p99_ms:
+                w = min(pol.max_wait_ms, max(w * 1.5, pol.min_wait_ms))
+        except Exception:
+            # the adaptive controller is advisory: any failure keeps the
+            # current window rather than killing the assembler (whose
+            # death would wedge every future the loop still owes)
+            return w
+        self._adaptive_wait_ms = w
+        return w
+
+    def _gather(self, first: _Request,
+                skip_hold: bool = False) -> List[_Request]:
+        """Assemble one batch starting from ``first`` under the policy:
+        free coalescing of everything already queued, then hold the
+        window open for stragglers — bounded by the FIRST request's age,
+        so the latency promise holds even when the window was already
+        spent in the backlog.  ``skip_hold`` (continuous mode with a
+        batch already in flight) takes only the free coalescing: the
+        in-flight predict IS the window — everything arriving during it
+        joins the next greedy drain, and holding longer would only delay
+        the pending batch's readback."""
+        pol = self.policy
+        batch = [first]
+        with span("serve.assemble", cat="serving") as sp:
+            while len(batch) < pol.max_batch:
+                try:
+                    batch.append(self._queue.get_nowait())
+                except queue.Empty:
+                    break
+            hold_ms = 0.0
+            if not skip_hold:
+                deadline = first.t_submit + \
+                    self._effective_wait_ms() / 1000.0
+                t_hold = time.perf_counter()
                 while len(batch) < pol.max_batch:
                     remaining = deadline - time.perf_counter()
                     if remaining <= 0:
@@ -485,11 +677,126 @@ class PredictionService:
                         batch.append(self._queue.get(timeout=remaining))
                     except queue.Empty:
                         break
-                sp.add(rows=len(batch))
-            self._serve(batch)
+                hold_ms = (time.perf_counter() - t_hold) * 1000.0
+            # the window's own latency contribution, fed to the adaptive
+            # rule: how long THIS batch held open for stragglers
+            self._hold_ema_ms += 0.1 * (hold_ms - self._hold_ema_ms)
+            sp.add(rows=len(batch))
+        return batch
 
-    def _serve(self, batch: List[_Request]) -> None:
-        results = self._predict_isolating([r.row for r in batch])
+    def _loop(self) -> None:
+        """Drain-first: assemble, predict, repeat — one thread, device
+        idle while assembling, assembly idle while predicting."""
+        while not self._stop.is_set():
+            try:
+                first = self._queue.get(timeout=0.02)
+            except queue.Empty:
+                continue
+            self._serve(self._gather(first))
+
+    def _loop_continuous(self) -> None:
+        """Continuous batching, single-threaded over ASYNC device
+        dispatch (the §18 discipline): stage batch N (host encode +
+        launch, no forcing — XLA computes on its own pool, GIL free),
+        then gather+encode+dispatch batch N+1 while N is in flight, THEN
+        read N back.  Device idle between batches goes to ~0 under load
+        with no extra python thread contending for the GIL.  Predictors
+        without the dispatch/readback split stage pre-resolved and the
+        loop degrades to drain-first for them."""
+        staged = None
+        try:
+            while not self._stop.is_set():
+                try:
+                    # with a batch in flight, only peek for new work —
+                    # its readback must not wait an idle-poll period
+                    first = self._queue.get(
+                        timeout=0.0005 if staged is not None else 0.02)
+                except queue.Empty:
+                    if staged is not None:
+                        item, staged = staged, None
+                        self._complete(item)
+                    continue
+                batch = self._gather(first, skip_hold=staged is not None)
+                nxt = self._stage(batch)
+                if staged is not None:
+                    if staged[2] is not None:
+                        # this batch's assembly/encode/dispatch genuinely
+                        # overlapped the previous batch's DEVICE time —
+                        # a sync-staged predecessor (handle None) never
+                        # had anything in flight to overlap
+                        self.counters.increment("Serving",
+                                                "OverlappedBatches")
+                    self._complete(staged)
+                staged = nxt
+        finally:
+            if staged is not None:
+                self._complete(staged)
+
+    def _stage(self, batch: List[_Request]):
+        """The launch half of a continuous-mode batch: snapshot the
+        predictor (a hot-swap mid-flight must finish this batch on the
+        model that encoded it), encode, and dispatch asynchronously.
+        Returns ``(batch, pred, staged_handle)``; a predictor without
+        the async split — or a prepare/dispatch failure (malformed
+        row) — stages ``None`` and completes via the sync isolating
+        path."""
+        with self._swap_lock:
+            pred = self.predictor
+        dispatch = getattr(pred, "dispatch_prepared", None)
+        if dispatch is not None:
+            try:
+                with span("serve.dispatch", cat="serving",
+                          rows=len(batch)):
+                    handle = dispatch(
+                        pred.prepare_rows([r.row for r in batch]))
+            except Exception:
+                pass   # fall through to the sync isolating completion
+            else:
+                with self._inflight_lock:
+                    self._inflight += len(batch)
+                return (batch, pred, handle, time.perf_counter())
+        return (batch, pred, None, time.perf_counter())
+
+    def _complete(self, item) -> None:
+        """The readback half: force the staged device result, account,
+        reply.  A readback failure isolates per row (same contract as
+        the sync path); sync-staged batches run the full _serve."""
+        batch, pred, handle, t0 = item
+        if handle is None:
+            self._serve(batch, pred=pred)
+            return
+        rows = [r.row for r in batch]
+        try:
+            try:
+                with span("serve.predict", cat="serving", rows=len(rows)):
+                    out = pred.readback_dispatched(handle)
+                results = [("ok", self._label(p)) for p in out]
+                # serve.batch spans dispatch->readback: the batch's real
+                # device residency including the overlapped window
+                self.timer.record("serve.batch",
+                                  time.perf_counter() - t0)
+                self.counters.increment("Serving", "Requests", len(rows))
+                self.counters.increment("Serving", "Batches")
+                self._record_monitor(rows, results)
+            except Exception as exc:
+                import warnings
+                warnings.warn(
+                    f"serving: dispatched batch readback failed "
+                    f"({type(exc).__name__}: {exc}); isolating per row",
+                    RuntimeWarning)
+                results = self._isolated_pass(pred, rows)
+        finally:
+            with self._inflight_lock:
+                self._inflight -= len(batch)
+        self._reply(batch, results)
+
+    def _serve(self, batch: List[_Request], pred=None,
+               prepared=None) -> None:
+        self._reply(batch,
+                    self._predict_isolating([r.row for r in batch],
+                                            pred=pred, prepared=prepared))
+
+    def _reply(self, batch: List[_Request], results) -> None:
         now = time.perf_counter()
         with span("serve.reply", cat="serving", rows=len(batch)):
             for r, (status, val) in zip(batch, results):
@@ -499,10 +806,7 @@ class PredictionService:
                         r.future.set_result(val)
                     else:  # answer with the error, don't wedge the waiter
                         r.future.set_exception(val)
-        self.counters.set("Serving", "MaxBatchObserved",
-                          max(len(batch),
-                              self.counters.get("Serving",
-                                                "MaxBatchObserved")))
+        self.counters.max("Serving", "MaxBatchObserved", len(batch))
 
 
 class RespPredictionLoop:
@@ -549,16 +853,29 @@ class RespPredictionLoop:
         return len(msgs)
 
     def run(self, max_idle_s: float = 30.0,
-            idle_sleep_s: float = 0.002) -> None:
-        """Poll until a 'stop' message or ``max_idle_s`` without traffic."""
+            idle_sleep_s: float = 0.002,
+            max_idle_sleep_s: float = 0.05) -> None:
+        """Poll until a 'stop' message or ``max_idle_s`` without traffic.
+
+        While the queue stays empty the sleep backs off exponentially
+        (doubling from ``idle_sleep_s`` up to ``max_idle_sleep_s``) and
+        resets on the first drained message — an idle fleet of N workers
+        must not burn N cores spin-polling.  ``Serving/Polls`` and
+        ``Serving/EmptyPolls`` make the polling economy observable."""
+        counters = self.service.counters
         idle_since = time.monotonic()
+        sleep_s = idle_sleep_s
         while not self.stopped:
+            counters.increment("Serving", "Polls")
             if self.poll_once():
                 idle_since = time.monotonic()
+                sleep_s = idle_sleep_s
             elif time.monotonic() - idle_since > max_idle_s:
                 break
             else:
-                time.sleep(idle_sleep_s)
+                counters.increment("Serving", "EmptyPolls")
+                time.sleep(sleep_s)
+                sleep_s = min(sleep_s * 2.0, max_idle_sleep_s)
 
     def close(self) -> None:
         self.client.close()

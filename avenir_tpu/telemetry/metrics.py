@@ -353,6 +353,28 @@ class MetricsRegistry:
         with self._lock:
             self._health.pop(name, None)
 
+    def health_one(self, name: str) -> Optional[Tuple[bool, dict]]:
+        """Run ONE health provider — looked up by its exact key or by the
+        key minus a ``<kind>:`` prefix (so ``/healthz/churn-w0`` reaches
+        the provider registered as ``serving:churn-w0``).  None when no
+        provider matches: the per-worker probe a load balancer points at
+        one fleet member, where the aggregate :meth:`health` would flip
+        every worker's target on one degraded peer."""
+        with self._lock:
+            fn = self._health.get(name)
+            if fn is None:
+                for key, cand in self._health.items():
+                    if key.split(":", 1)[-1] == name:
+                        fn = cand
+                        break
+        if fn is None:
+            return None
+        try:
+            ok, payload = fn()
+        except Exception as exc:
+            ok, payload = False, {"error": f"{type(exc).__name__}: {exc}"}
+        return bool(ok), {"status": "ok" if ok else "degraded", **payload}
+
     def health(self) -> Tuple[bool, dict]:
         with self._lock:
             providers = dict(self._health)

@@ -68,6 +68,22 @@ class MetricsServer:
                             200 if ok else 503,
                             json.dumps(payload, sort_keys=True).encode(),
                             "application/json")
+                    elif path.startswith("/healthz/"):
+                        # per-provider probe: /healthz/<name> answers for
+                        # ONE health source (a fleet worker), so a load
+                        # balancer can pull one degraded worker while its
+                        # peers keep taking traffic
+                        res = registry.health_one(path[len("/healthz/"):])
+                        if res is None:
+                            self._answer(404, b"no such health check\n",
+                                         "text/plain")
+                        else:
+                            ok, payload = res
+                            self._answer(
+                                200 if ok else 503,
+                                json.dumps(payload,
+                                           sort_keys=True).encode(),
+                                "application/json")
                     else:
                         self._answer(404, b"not found\n", "text/plain")
                 except Exception as exc:  # scrape must not kill serving

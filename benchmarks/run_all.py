@@ -168,6 +168,215 @@ def bench_logistic(scale):
             "value": round(n * iters / dt, 1), "n_rows": n, "iters": iters}
 
 
+def _fleet_point(feeder, col, req_q, pred_q, req_rows, offered, n_req):
+    """Offer ``n_req`` requests at ``offered`` req/s (0 = burst the whole
+    load up front: the saturation probe) against a running fleet and
+    measure client-observed wire latency per request (send->reply) plus
+    achieved throughput.  Busy replies (admission control) are counted
+    separately and excluded from latency."""
+    import threading
+    t_send = {}
+    t_recv = {}
+    busy_ids = set()
+    give_up = threading.Event()
+
+    def collect():
+        while len(t_recv) + len(busy_ids) < n_req \
+                and not give_up.is_set():
+            vs = col.rpop_many(pred_q, 512)
+            if vs:
+                now = time.perf_counter()
+                for v in vs:
+                    rid, label = v.split(",", 1)
+                    if label == "busy":
+                        busy_ids.add(rid)
+                    else:
+                        t_recv[rid] = now
+            else:
+                time.sleep(0.0005)
+
+    ct = threading.Thread(target=collect, daemon=True)
+    ct.start()
+    msgs = [",".join(["predict", str(i)] + req_rows[i % len(req_rows)])
+            for i in range(n_req)]
+    t0 = time.perf_counter()
+    sent = 0
+    if offered == 0:
+        for i in range(0, n_req, 256):
+            now = time.perf_counter()
+            hi = min(i + 256, n_req)
+            for j in range(i, hi):
+                t_send[str(j)] = now
+            feeder.lpush_many(req_q, msgs[i:hi])
+        sent = n_req
+    else:
+        while sent < n_req:
+            now = time.perf_counter()
+            due = min(n_req, int(offered * (now - t0)) + 1)
+            if due > sent:
+                for j in range(sent, due):
+                    t_send[str(j)] = now
+                feeder.lpush_many(req_q, msgs[sent:due])
+                sent = due
+            time.sleep(0.001)
+    ct.join(timeout=120)
+    if ct.is_alive():
+        # the point timed out with replies missing: stop the collector
+        # before it can interleave reads on the SHARED client socket
+        # with the next point's collector (which would desync every
+        # later measurement), and fail the run loudly
+        give_up.set()
+        ct.join(timeout=15)
+        raise RuntimeError(
+            f"fleet bench point (offered={offered or 'max'}) incomplete: "
+            f"{len(t_recv) + len(busy_ids)}/{n_req} replies after 120s")
+    # busy replies are shed load: they count as answered but are kept
+    # out of BOTH the latency distribution and the served throughput
+    lat = np.array([t_recv[k] - t_send[k] for k in t_recv
+                    if k in t_send]) if t_recv else np.array([0.0])
+    tend = max(t_recv.values()) if t_recv else t0
+    return {"offered_req_per_sec": offered or "max",
+            "achieved_req_per_sec": round(len(t_recv) / max(tend - t0,
+                                                            1e-9), 1),
+            "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 2),
+            "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 2),
+            "answered": len(t_recv) + len(busy_ids),
+            "busy": len(busy_ids)}
+
+
+def _fleet_sweep(models, schema, req_rows, scale):
+    """ISSUE 10: the offered-load sweep over the ServingFleet — worker
+    count 1/2/4, continuous vs drain-first batching, and the SLO-adaptive
+    vs fixed coalescing window, all against ONE RESP request queue with
+    client-side (wire) latency measurement.  Saturation points take the
+    peak of their per-point rep count (3 for every compared config, 2
+    for the extra 2-worker continuous curve point) — the repo's
+    peak-of-N protocol for coalescing noise."""
+    import shutil
+    import tempfile
+    from avenir_tpu.io.respq import RespClient, RespServer
+    from avenir_tpu.serving import BatchPolicy, ModelRegistry, ServingFleet
+    reg_dir = tempfile.mkdtemp(prefix="avt_fleet_reg_")
+    server = RespServer().start()
+    n_sat = max(600, int(3000 * scale))
+    n_mid = max(500, int(2500 * scale))
+    mid_offered = 2000
+    curve = []
+
+    def run_cfg(tag, workers, batching, points, max_batch=64,
+                max_wait=5.0, slo=0.0, warm_n=300, warm_offered=0):
+        req_q, pred_q = f"rq-{tag}", f"pq-{tag}"
+        pol = BatchPolicy(max_batch=max_batch, max_wait_ms=max_wait,
+                          batching=batching, slo_p99_ms=slo)
+        fleet = ServingFleet(
+            reg, "bench", buckets=(8, 64), policy=pol, n_workers=workers,
+            config={"redis.server.port": server.port,
+                    "redis.request.queue": req_q,
+                    "redis.prediction.queue": pred_q})
+        fleet.start()
+        feeder = RespClient(port=server.port)
+        col = RespClient(port=server.port)
+        out = []
+        try:
+            _fleet_point(feeder, col, req_q, pred_q, req_rows,
+                         warm_offered, warm_n)   # warm the wire path
+            for offered, n_req, reps in points:
+                best = None
+                for _ in range(reps):
+                    r = _fleet_point(feeder, col, req_q, pred_q, req_rows,
+                                     offered, n_req)
+                    if best is None or r["achieved_req_per_sec"] > \
+                            best["achieved_req_per_sec"]:
+                        best = r
+                best.update(workers=workers, batching=batching,
+                            max_batch=max_batch, max_wait_ms=max_wait,
+                            slo_p99_ms=slo,
+                            window_ms=round(
+                                fleet.workers[0].service.stats()
+                                ["window_ms"], 2))
+                out.append(best)
+                curve.append(best)
+        finally:
+            fleet.stop()
+            feeder.close()
+            col.close()
+        return out
+
+    try:
+        reg = ModelRegistry(reg_dir)
+        reg.publish("bench", models, schema=schema)
+        sat_mid = [(0, n_sat, 3), (mid_offered, n_mid, 1)]
+        c1 = run_cfg("w1c", 1, "continuous", sat_mid)
+        d1 = run_cfg("w1d", 1, "drain", sat_mid)
+        # worker scaling is swept in DRAIN mode: each sync worker blocks
+        # through its device batch, so fleet width is what buys
+        # host/device overlap — the regime where worker count matters on
+        # a small host.  (A single continuous worker already overlaps
+        # via async dispatch and saturates this container's cores alone;
+        # its 2-worker point is recorded in the curve for comparison.)
+        d2 = run_cfg("w2d", 2, "drain", [(0, n_sat, 3)])
+        d4 = run_cfg("w4d", 4, "drain", [(0, n_sat, 3)])
+        c2 = run_cfg("w2c", 2, "continuous", [(0, n_sat, 2)])
+        # SLO block: a load where the big fixed window blows the p99
+        # budget (the window always binds: fill time > window) while the
+        # adaptive policy, steering on the same budget, stays within it
+        slo_ms, slo_offered = 300.0, 250
+        n_slo = max(400, int(1250 * scale))
+        fixed = run_cfg("slof", 1, "continuous",
+                        [(slo_offered, n_slo, 1)], max_batch=96,
+                        max_wait=slo_ms, warm_n=250,
+                        warm_offered=slo_offered)
+        adapt = run_cfg("sloa", 1, "continuous",
+                        [(slo_offered, n_slo, 1)], max_batch=96,
+                        max_wait=slo_ms, slo=slo_ms, warm_n=250,
+                        warm_offered=slo_offered)
+    finally:
+        server.stop()
+        shutil.rmtree(reg_dir, ignore_errors=True)
+    c1s, d1s, d2s, d4s, c2s = (c1[0], d1[0], d2[0], d4[0], c2[0])
+    return {
+        "trees": len(models),
+        "curve": curve,
+        "continuous_vs_drain": {
+            "workers": 1,
+            "continuous_sat_req_per_sec": c1s["achieved_req_per_sec"],
+            "drain_sat_req_per_sec": d1s["achieved_req_per_sec"],
+            "continuous_sat_p99_ms": c1s["p99_ms"],
+            "drain_sat_p99_ms": d1s["p99_ms"],
+            "continuous_beats_drain":
+                c1s["achieved_req_per_sec"] > d1s["achieved_req_per_sec"]
+                and c1s["p99_ms"] <= d1s["p99_ms"] * 1.1,
+        },
+        "workers_scaling": {
+            "batching": "drain",
+            "note": "sync workers block per device batch, so width buys "
+                    "host/device overlap; one async continuous worker "
+                    "already saturates this host's cores (see curve)",
+            "sat_req_per_sec": {"1": d1s["achieved_req_per_sec"],
+                                "2": d2s["achieved_req_per_sec"],
+                                "4": d4s["achieved_req_per_sec"]},
+            "sat_p99_ms": {"1": d1s["p99_ms"], "2": d2s["p99_ms"],
+                           "4": d4s["p99_ms"]},
+            "continuous_1w_vs_2w_req_per_sec":
+                {"1": c1s["achieved_req_per_sec"],
+                 "2": c2s["achieved_req_per_sec"]},
+            "two_workers_beat_one":
+                d2s["achieved_req_per_sec"] > d1s["achieved_req_per_sec"]
+                and d2s["p99_ms"] <= d1s["p99_ms"] * 1.1,
+        },
+        "slo_adaptive": {
+            "offered_req_per_sec": slo_offered,
+            "p99_budget_ms": slo_ms,
+            "fixed_window_ms": slo_ms,
+            "fixed_p99_ms": fixed[0]["p99_ms"],
+            "adaptive_p99_ms": adapt[0]["p99_ms"],
+            "adaptive_final_window_ms": adapt[0]["window_ms"],
+            "fixed_violates_budget": fixed[0]["p99_ms"] > slo_ms,
+            "adaptive_within_budget": adapt[0]["p99_ms"] <= slo_ms,
+        },
+    }
+
+
 def bench_serve_forest(scale):
     """Online forest serving: micro-batched request loop throughput and
     latency percentiles at several offered loads (plus a closed-loop pass
@@ -254,6 +463,15 @@ def bench_serve_forest(scale):
         # thread and the HTTP server running in the bench process
         msrv.stop()
         svc.stop()
+    # the fleet tier (ISSUE 10): a heavier forest so serving is
+    # device-compute-dominated (the regime worker parallelism serves;
+    # with a 5-tree toy model the wire/python path is the whole cost)
+    fleet_params = ForestParams(num_trees=48, seed=1)
+    fleet_params.tree.max_depth = 6
+    fleet_table = load_csv_text(
+        "\n".join(",".join(r) for r in rows[:min(n_train, 4000)]), schema)
+    fleet_models = build_forest(fleet_table, fleet_params, MeshContext())
+    fleet = _fleet_sweep(fleet_models, schema, req_rows, scale)
     return {"metric": "serve_forest_peak_req_per_sec",
             "value": loads[0]["throughput_req_per_sec"],
             "n_requests": n_req, "trees": len(models), "loads": loads,
@@ -262,7 +480,8 @@ def bench_serve_forest(scale):
                 "queue_depth_gauge": 'key="queue_depth"' in scrape,
                 "p99_gauge": 'quantile="p99"' in scrape,
                 "healthz_ok_then_degraded_503":
-                    healthz_ok and degraded_503}}
+                    healthz_ok and degraded_503},
+            "fleet_sweep": fleet}
 
 
 def bench_monitor_drift(scale):

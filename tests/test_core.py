@@ -179,6 +179,38 @@ def test_confusion_matrix_batch_matches_scalar():
            (cm2.true_pos, cm2.false_pos, cm2.true_neg, cm2.false_neg)
 
 
+def test_counters_max_atomic_high_water_mark():
+    """Counters.max is ONE atomic compare-and-raise: hammered from many
+    threads it can only end at the true maximum (the old get-then-set
+    read-modify-write could publish the smaller of two racing
+    observations), and a lower later value never wins."""
+    import threading
+    c = Counters()
+    assert c.max("Serving", "MaxBatchObserved", 5) == 5
+    assert c.max("Serving", "MaxBatchObserved", 3) == 5   # lower: no-op
+    assert c.get("Serving", "MaxBatchObserved") == 5
+    values = list(range(1, 401))
+
+    def hammer(vals):
+        for v in vals:
+            c.max("G", "M", v)
+            c.increment("G", "N")
+
+    threads = [threading.Thread(target=hammer, args=(values[i::4],))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.get("G", "M") == 400
+    # the lock also makes plain increments loss-free under contention
+    assert c.get("G", "N") == 400
+    # the lock is process-local state: counters still pickle as data
+    import pickle
+    back = pickle.loads(pickle.dumps(c))
+    assert back.get("G", "M") == 400
+
+
 def test_counters_json_roundtrip():
     """to_json/from_json: stable byte-identical serialization for equal
     counters, lossless round trip — jobs and the bench harness consume

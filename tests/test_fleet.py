@@ -1,0 +1,391 @@
+"""Traffic-shaped serving fleet (ISSUE 10): multi-worker RESP draining,
+coordinated hot-swap, admission-control backpressure, degraded-worker
+parking with per-worker /healthz.
+
+The contracts under test: every request popped off the one request queue
+is answered EXACTLY once (prediction, 'error', or 'busy' — never dropped,
+never duplicated) across N concurrent workers; a 'reload' seen by any
+worker converges every worker onto the newest intact registry version; a
+degraded worker stops pulling (503 on its own /healthz/<name>) while its
+peers keep serving."""
+
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from avenir_tpu.core.table import encode_rows
+from avenir_tpu.io.respq import RespClient, RespServer
+from avenir_tpu.serving import (BatchPolicy, ModelRegistry, ServingFleet)
+from avenir_tpu.serving.predictor import ForestPredictor
+from tests.test_serving import (forest_batch_predict, raw_rows_of,
+                                small_forest)
+from tests.test_tree import SCHEMA, make_table
+
+pytestmark = pytest.mark.fleet
+
+
+def drain_replies(cli, queue, expect_n, timeout_s=60.0):
+    """Pop replies until ``expect_n`` collected (or timeout); returns
+    {rid: [labels...]} so duplicates are visible, not masked."""
+    got = {}
+    deadline = time.monotonic() + timeout_s
+    n = 0
+    while n < expect_n and time.monotonic() < deadline:
+        vs = cli.rpop_many(queue, 256)
+        if not vs:
+            time.sleep(0.002)
+            continue
+        for v in vs:
+            rid, label = v.split(",", 1)
+            got.setdefault(rid, []).append(label)
+            n += 1
+    return got
+
+
+@pytest.fixture()
+def resp_server():
+    server = RespServer().start()
+    yield server
+    server.stop()
+
+
+def make_fleet_registry(tmp_path, mesh_ctx, trees=3, depth=2, seed=3):
+    table, models = small_forest(mesh_ctx, n=300, trees=trees, depth=depth,
+                                 seed=seed)
+    reg = ModelRegistry(str(tmp_path / "registry"))
+    reg.publish("churn", models, schema=SCHEMA)
+    return reg, table, models
+
+
+def test_fleet_serves_and_matches_offline(tmp_path, mesh_ctx, resp_server):
+    """2 workers draining one queue: every reply identical to the offline
+    batch predict, every id answered exactly once."""
+    reg, table, models = make_fleet_registry(tmp_path, mesh_ctx)
+    rows = raw_rows_of(table, 60)
+    expect = forest_batch_predict(models, encode_rows(rows, SCHEMA))
+    fleet = ServingFleet(reg, "churn", buckets=(8, 64),
+                         policy=BatchPolicy(max_batch=16, max_wait_ms=2.0),
+                         n_workers=2,
+                         config={"redis.server.port": resp_server.port})
+    fleet.start()
+    feeder = RespClient(port=resp_server.port)
+    try:
+        feeder.lpush_many("requestQueue",
+                          [",".join(["predict", str(i)] + rows[i % 60])
+                           for i in range(150)])
+        got = drain_replies(feeder, "predictionQueue", 150)
+        assert sorted(got, key=int) == [str(i) for i in range(150)]
+        assert all(len(v) == 1 for v in got.values()), "duplicated reply"
+        for i in range(150):
+            assert got[str(i)] == [expect[i % 60]]
+        st = fleet.stats()
+        assert st["served"] == 150 and st["errors"] == 0
+        # both workers actually pulled (the queue is shared, not sharded)
+        per = st["per_worker"]
+        assert len(per) == 2
+        assert all(s["model_version"] == 1 for s in per.values())
+        # a wire 'stop' ends every worker after pending replies flush
+        feeder.lpush("requestQueue", "stop")
+        assert fleet.wait(30.0)
+    finally:
+        fleet.stop()
+        feeder.close()
+
+
+def test_fleet_hot_swap_no_loss_no_dup(tmp_path, mesh_ctx, resp_server):
+    """The fleet-scope no-loss/no-dup guarantee under a concurrent
+    coordinated hot-swap: requests keep flowing while 'reload' lands,
+    every request is answered exactly once with a prediction from v1 OR
+    v2 (in-flight batches finish on the model they started on), and both
+    workers' model_version converges to the new version."""
+    reg, table, m1 = make_fleet_registry(tmp_path, mesh_ctx)
+    _, m2 = small_forest(mesh_ctx, n=300, trees=3, depth=2, seed=11)
+    rows = raw_rows_of(table, 60)
+    enc = encode_rows(rows, SCHEMA)
+    valid = {str(i): {forest_batch_predict(m1, enc)[i % 60],
+                      forest_batch_predict(m2, enc)[i % 60]}
+             for i in range(300)}
+    fleet = ServingFleet(reg, "churn", buckets=(8,),
+                         policy=BatchPolicy(max_batch=8, max_wait_ms=1.0),
+                         n_workers=2,
+                         config={"redis.server.port": resp_server.port})
+    fleet.start()
+    feeder = RespClient(port=resp_server.port)
+    try:
+        for i in range(300):
+            feeder.lpush("requestQueue",
+                         ",".join(["predict", str(i)] + rows[i % 60]))
+            if i == 120:
+                # publish v2 and drop the reload into the SAME queue the
+                # requests ride — whichever worker pops it triggers the
+                # fleet-wide swap
+                reg.publish("churn", m2, schema=SCHEMA)
+                feeder.lpush("requestQueue", "reload")
+            time.sleep(0.0005)
+        got = drain_replies(feeder, "predictionQueue", 300)
+        assert sorted(got, key=int) == [str(i) for i in range(300)]
+        assert all(len(v) == 1 for v in got.values()), "duplicated reply"
+        for rid, labels in got.items():
+            assert labels[0] in valid[rid], \
+                f"request {rid} answered {labels[0]!r}, not a v1/v2 label"
+        # every worker converged onto v2 (coordinated, not just the one
+        # that saw the message)
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            versions = set(fleet.stats()["model_versions"].values())
+            if versions == {2}:
+                break
+            time.sleep(0.05)
+        assert set(fleet.stats()["model_versions"].values()) == {2}
+        assert fleet.stats()["reload_generation"] >= 1
+    finally:
+        fleet.stop()
+        feeder.close()
+
+
+class _SlowPredictor:
+    """Forest predictor with a deliberate per-batch delay so the bounded
+    queue actually fills under a burst (backpressure test)."""
+
+    def __init__(self, inner, delay_s):
+        self.inner = inner
+        self.delay_s = delay_s
+
+    def warm(self):
+        self.inner.warm()
+        return self
+
+    def predict_rows(self, rows):
+        time.sleep(self.delay_s)
+        return self.inner.predict_rows(rows)
+
+
+def test_fleet_backpressure_busy_never_dropped(mesh_ctx, resp_server):
+    """Over-offered load against a bounded queue: the overflow is
+    answered '<id>,busy' (admission control), everything else gets a real
+    prediction, and EVERY request is answered exactly once — backpressure
+    sheds load, it never drops an accepted request."""
+    table, models = small_forest(mesh_ctx, n=200, trees=3, depth=2)
+    rows = raw_rows_of(table, 40)
+    expect = forest_batch_predict(models, encode_rows(rows, SCHEMA))
+    factory = lambda: _SlowPredictor(  # noqa: E731
+        ForestPredictor(models, SCHEMA, buckets=(8,)), 0.05)
+    fleet = ServingFleet(
+        predictor_factory=factory,
+        policy=BatchPolicy(max_batch=8, max_wait_ms=1.0,
+                           max_queue_depth=4),
+        n_workers=1,
+        config={"redis.server.port": resp_server.port})
+    fleet.start()
+    feeder = RespClient(port=resp_server.port)
+    try:
+        n = 120
+        feeder.lpush_many("requestQueue",
+                          [",".join(["predict", str(i)] + rows[i % 40])
+                           for i in range(n)])
+        got = drain_replies(feeder, "predictionQueue", n)
+        assert sorted(got, key=int) == [str(i) for i in range(n)]
+        assert all(len(v) == 1 for v in got.values()), "duplicated reply"
+        n_busy = sum(1 for v in got.values() if v == ["busy"])
+        assert n_busy > 0, "over-offered burst produced no busy replies"
+        assert n_busy < n, "nothing was actually served"
+        for rid, labels in got.items():
+            if labels != ["busy"]:
+                assert labels == [expect[int(rid) % 40]]
+        st = fleet.stats()
+        assert st["rejected"] == n_busy
+        assert st["served"] == n - n_busy
+    finally:
+        fleet.stop()
+        feeder.close()
+
+
+def test_fleet_degraded_worker_healthz_peers_serve(tmp_path, mesh_ctx,
+                                                   resp_server):
+    """mark_degraded on one worker: its own /healthz/<name> flips 503 and
+    it stops pulling (ParkedPolls), while its peer keeps answering; a
+    hot-swap to a fresh version clears the flag and it rejoins."""
+    from avenir_tpu.telemetry import MetricsRegistry, MetricsServer
+    reg, table, models = make_fleet_registry(tmp_path, mesh_ctx)
+    rows = raw_rows_of(table, 40)
+    expect = forest_batch_predict(models, encode_rows(rows, SCHEMA))
+    mreg = MetricsRegistry()
+    fleet = ServingFleet(reg, "churn", buckets=(8,),
+                         policy=BatchPolicy(max_batch=8, max_wait_ms=1.0),
+                         n_workers=2, metrics=mreg,
+                         config={"redis.server.port": resp_server.port})
+    fleet.start()
+    msrv = MetricsServer(mreg, port=0).start()
+    feeder = RespClient(port=resp_server.port)
+
+    def healthz(name):
+        try:
+            return urllib.request.urlopen(
+                f"{msrv.url}/healthz/{name}", timeout=10).status
+        except urllib.error.HTTPError as exc:
+            return exc.code
+
+    try:
+        assert healthz("churn-w0") == 200
+        assert healthz("churn-w1") == 200
+        assert healthz("no-such-worker") == 404
+        w0 = fleet.workers[0].service
+        w0.mark_degraded("drift: psi over threshold")
+        # the degraded worker's own endpoint flips; its peer's does not
+        assert healthz("churn-w0") == 503
+        assert healthz("churn-w1") == 200
+        # it parks (stops pulling) ...
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and \
+                w0.counters.get("Serving", "ParkedPolls") == 0:
+            time.sleep(0.01)
+        assert w0.counters.get("Serving", "ParkedPolls") > 0
+        polls_before = w0.counters.get("Serving", "Polls")
+        # ... while the peer keeps answering everything
+        feeder.lpush_many("requestQueue",
+                          [",".join(["predict", str(i)] + rows[i % 40])
+                           for i in range(60)])
+        got = drain_replies(feeder, "predictionQueue", 60)
+        assert sorted(got, key=int) == [str(i) for i in range(60)]
+        for i in range(60):
+            assert got[str(i)] == [expect[i % 40]]
+        assert w0.counters.get("Serving", "Polls") == polls_before, \
+            "a degraded worker kept pulling from the queue"
+        assert w0.counters.get("Serving", "Requests") == 0
+        # a fresh published version + coordinated reload clears the flag
+        # and the worker rejoins the fleet
+        reg.publish("churn", models, schema=SCHEMA)   # v2
+        fleet.request_reload()
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and w0.degraded is not None:
+            time.sleep(0.05)
+        assert w0.degraded is None
+        assert healthz("churn-w0") == 200
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and \
+                w0.counters.get("Serving", "Polls") == polls_before:
+            time.sleep(0.01)
+        assert w0.counters.get("Serving", "Polls") > polls_before
+    finally:
+        msrv.stop()
+        fleet.stop()
+        feeder.close()
+
+
+def test_fleet_all_degraded_last_worker_keeps_serving(tmp_path, mesh_ctx,
+                                                      resp_server):
+    """When EVERY worker is degraded (here: a fleet of one), the last
+    one keeps pulling — otherwise nobody could ever pop the wire
+    'reload' that is the documented recovery path, and the queue would
+    wedge unanswered forever."""
+    reg, table, models = make_fleet_registry(tmp_path, mesh_ctx)
+    rows = raw_rows_of(table, 8)
+    expect = forest_batch_predict(models, encode_rows(rows, SCHEMA))
+    fleet = ServingFleet(reg, "churn", buckets=(8,),
+                         policy=BatchPolicy(max_batch=8, max_wait_ms=1.0),
+                         n_workers=1,
+                         config={"redis.server.port": resp_server.port})
+    fleet.start()
+    feeder = RespClient(port=resp_server.port)
+    try:
+        w0 = fleet.workers[0].service
+        w0.mark_degraded("drift: psi over threshold")
+        feeder.lpush_many("requestQueue",
+                          [",".join(["predict", str(i)] + rows[i])
+                           for i in range(8)])
+        got = drain_replies(feeder, "predictionQueue", 8)
+        assert sorted(got, key=int) == [str(i) for i in range(8)]
+        for i in range(8):
+            assert got[str(i)] == [expect[i]]
+        # and the wire 'reload' recovery path actually recovers it
+        reg.publish("churn", models, schema=SCHEMA)   # v2
+        feeder.lpush("requestQueue", "reload")
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and w0.degraded is not None:
+            time.sleep(0.02)
+        assert w0.degraded is None and w0.version == 2
+    finally:
+        fleet.stop()
+        feeder.close()
+
+
+def test_fleet_cli_job_workers(tmp_path, mesh_ctx):
+    """predictionService with ps.workers=2: the replay answers every
+    request byte-identically to the single-worker job, and the counter
+    dump carries the fleet aggregate (Workers, Polls, per-worker-summed
+    Requests)."""
+    from avenir_tpu.core.config import Config
+    from avenir_tpu.cli import serving_jobs  # noqa: F401
+    from avenir_tpu.cli.jobs import resolve
+    from tests.test_serving import _train_forest_via_cli
+    reg_dir = tmp_path / "registry"
+    schema_path, trees = _train_forest_via_cli(tmp_path, reg_dir)
+    req_rows = raw_rows_of(make_table(40, seed=33), 40)
+    expect = forest_batch_predict(trees, encode_rows(req_rows, SCHEMA))
+    req_path = tmp_path / "requests.csv"
+    req_path.write_text("\n".join(",".join(r) for r in req_rows) + "\n")
+    job = resolve("predictionService")
+    out_dir = tmp_path / "out_fleet"
+    cfg = Config({
+        "field.delim.regex": ",", "field.delim.out": ",",
+        "ps.model.registry.dir": str(reg_dir),
+        "ps.model.name": "churn",
+        "ps.feature.schema.file.path": str(schema_path),
+        "ps.batch.max.size": "16", "ps.batch.max.wait.ms": "2",
+        "ps.bucket.sizes": "8,64",
+        "ps.transport": "resp",
+        "ps.workers": "2",
+    })
+    counters = job(cfg, str(req_path), str(out_dir))
+    with open(out_dir / "part-m-00000") as fh:
+        lines = fh.read().splitlines()
+    assert [ln.split(",", 1)[1] for ln in lines] == expect
+    assert counters.get("Serving", "Requests") == 40
+    assert counters.get("Serving", "Workers") == 2
+    assert counters.get("Serving", "Polls") > 0
+    assert counters.get("Serving", "ModelVersion") == 1
+    assert counters.get("Serving", "serve.request.p99Us") > 0
+    # fleet size needs the wire: inprocess transport refuses
+    bad = Config({
+        "field.delim.regex": ",", "field.delim.out": ",",
+        "ps.model.registry.dir": str(reg_dir),
+        "ps.model.name": "churn",
+        "ps.feature.schema.file.path": str(schema_path),
+        "ps.workers": "2",
+    })
+    with pytest.raises(ValueError, match="resp"):
+        job(bad, str(req_path), str(tmp_path / "out_bad"))
+
+
+@pytest.mark.slow
+def test_fleet_soak_sustained_multiworker(tmp_path, mesh_ctx, resp_server):
+    """Sustained load through 2 workers: thousands of requests, every
+    answer correct, exactly once."""
+    reg, table, models = make_fleet_registry(tmp_path, mesh_ctx, trees=5,
+                                             depth=3)
+    rows = raw_rows_of(table, 128)
+    expect = forest_batch_predict(models, encode_rows(rows, SCHEMA))
+    fleet = ServingFleet(reg, "churn", buckets=(8, 64),
+                         policy=BatchPolicy(max_batch=64, max_wait_ms=2.0),
+                         n_workers=2,
+                         config={"redis.server.port": resp_server.port})
+    fleet.start()
+    feeder = RespClient(port=resp_server.port)
+    try:
+        n = 4000
+        for i in range(0, n, 256):
+            feeder.lpush_many(
+                "requestQueue",
+                [",".join(["predict", str(j)] + rows[j % 128])
+                 for j in range(i, min(i + 256, n))])
+        got = drain_replies(feeder, "predictionQueue", n, timeout_s=120.0)
+        assert sorted(got, key=int) == [str(i) for i in range(n)]
+        assert all(len(v) == 1 for v in got.values())
+        for i in range(n):
+            assert got[str(i)] == [expect[i % 128]]
+    finally:
+        fleet.stop()
+        feeder.close()

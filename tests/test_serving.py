@@ -598,6 +598,190 @@ def test_logistic_proba_oversized_batch_chunks():
 
 
 # --------------------------------------------------------------------------
+# continuous batching, adaptive window, admission control, shutdown drain
+# (ISSUE 10)
+# --------------------------------------------------------------------------
+
+def test_stop_drain_chunks_into_max_batch(mesh_ctx):
+    """The shutdown drain must serve a deep leftover backlog in
+    ``max_batch`` chunks — 3x max_batch queued then stop() used to run as
+    ONE unbounded batch, blowing past every compiled bucket size."""
+    table, models = small_forest(mesh_ctx, n=200, trees=3, depth=2)
+    rows = raw_rows_of(table, 24)
+    expect = forest_batch_predict(models, encode_rows(rows, SCHEMA))
+    pred = ForestPredictor(models, SCHEMA, buckets=(8,))
+    svc = PredictionService(pred, warm=False,
+                            policy=BatchPolicy(max_batch=8))
+    # no start(): the worker never runs, so every request is still queued
+    # at stop() — the drain itself is what's under test (and accepted
+    # futures must be answered even when the loop never ran)
+    futures = [svc.submit(r) for r in rows]
+    svc.stop()
+    assert [f.result(timeout=0) for f in futures] == expect
+    assert svc.counters.get("Serving", "Batches") == 3
+    assert svc.counters.get("Serving", "MaxBatchObserved") == 8
+
+
+class _GatedPredictor:
+    """Async-split predictor whose READBACK blocks until released —
+    deterministic in-flight state for the continuous-batching overlap
+    pin (dispatch returns immediately, like real async jax dispatch)."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.gate = threading.Event()
+        self.dispatched = threading.Event()
+
+    def warm(self):
+        return self
+
+    def prepare_rows(self, rows):
+        return self.inner.prepare_rows(rows)
+
+    def dispatch_prepared(self, prepared):
+        self.dispatched.set()
+        return prepared
+
+    def readback_dispatched(self, prepared):
+        assert self.gate.wait(timeout=60)
+        return self.inner.predict_prepared(prepared)
+
+    def predict_rows(self, rows):
+        return self.inner.predict_rows(rows)
+
+
+def test_continuous_batching_assembles_during_flight(mesh_ctx):
+    """While a dispatched batch is in flight (readback pending), the
+    continuous loop keeps accepting: it assembles, encodes, and
+    dispatches the NEXT batch before forcing the previous one
+    (OverlappedBatches); answers are still exactly the offline
+    predictions."""
+    table, models = small_forest(mesh_ctx, n=200, trees=3, depth=2)
+    rows = raw_rows_of(table, 24)
+    expect = forest_batch_predict(models, encode_rows(rows, SCHEMA))
+    gated = _GatedPredictor(ForestPredictor(models, SCHEMA, buckets=(8,)))
+    svc = PredictionService(gated, warm=False,
+                            policy=BatchPolicy(max_batch=8,
+                                               max_wait_ms=1.0,
+                                               batching="continuous"))
+    # queue two batches' worth BEFORE the loop runs: batch 1 dispatches
+    # (gate pending), then batch 2 must be gathered + dispatched while
+    # batch 1 is still in flight — only then is batch 1 forced
+    futures = [svc.submit(r) for r in rows[:16]]
+    svc.start()
+    assert gated.dispatched.wait(timeout=30)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and \
+            svc.counters.get("Serving", "OverlappedBatches") == 0:
+        time.sleep(0.005)
+    assert svc.counters.get("Serving", "OverlappedBatches") >= 1
+    # batch 1's readback has NOT happened yet (the gate is closed), so
+    # nothing is answered — the overlap was assembly, not completion
+    assert not futures[0].done()
+    gated.gate.set()
+    got = [f.result(timeout=60) for f in futures]
+    svc.stop()
+    assert got == expect[:16]
+    assert svc.counters.get("Serving", "Batches") >= 2
+
+
+def test_submit_busy_past_queue_depth():
+    """Admission control in-process: past max_queue_depth the future is
+    answered 'busy' immediately (and counted) — never silently queued,
+    never dropped."""
+    pred = LogisticPredictor(np.array([0.1, 1.0, -0.5]), LR_SCHEMA, "p",
+                             buckets=(8,))
+    svc = PredictionService(pred, warm=False,
+                            policy=BatchPolicy(max_batch=8,
+                                               max_queue_depth=2))
+    # no worker: the queue fills deterministically
+    rows, _ = _lr_data(4)
+    f1, f2 = svc.submit(rows[0]), svc.submit(rows[1])
+    f3 = svc.submit(rows[2])
+    assert f3.done() and f3.result(timeout=0) == svc.busy_label
+    assert not f1.done() and not f2.done()
+    assert svc.counters.get("Serving", "Rejected") == 1
+    assert svc.stats()["rejected"] == 1
+    svc.stop()   # answers f1/f2 via the shutdown drain
+    assert f1.result(timeout=0) is not None
+
+
+def test_adaptive_window_rules():
+    """The SLO controller's three rules, unit-level: shrink only when the
+    window's own hold is the latency source, grow when latency is cheap
+    or when the pressure is NOT the window, hold in the hysteresis
+    band."""
+    pred = LogisticPredictor(np.array([0.1, 1.0, -0.5]), LR_SCHEMA, "p",
+                             buckets=(8,))
+    svc = PredictionService(pred, warm=False,
+                            policy=BatchPolicy(max_batch=8,
+                                               max_wait_ms=20.0,
+                                               slo_p99_ms=100.0,
+                                               min_wait_ms=0.1))
+    # no samples yet: the window stays at the ceiling
+    assert svc._effective_wait_ms() == 20.0
+
+    def feed(ms, n=64):
+        for _ in range(n):
+            svc.timer.record("serve.request", ms / 1000.0)
+
+    # p99 past 60% of budget with the hold EMA carrying the blame ->
+    # shrink x0.5
+    feed(80.0)
+    svc._hold_ema_ms = 15.0
+    assert svc._effective_wait_ms() == 10.0
+    assert svc._effective_wait_ms() == 5.0
+    # same pressure but the window is NOT the cost (hold ~0): grow —
+    # shrinking further would only cut batch fill and collapse throughput
+    svc._hold_ema_ms = 0.0
+    assert svc._effective_wait_ms() == 7.5
+    # cheap latency (under 35% of budget) -> grow toward the ceiling
+    feed(10.0, n=svc._ADAPT_SAMPLES)
+    assert svc._effective_wait_ms() == 11.25
+    # hysteresis: between the bands the window holds
+    feed(50.0, n=svc._ADAPT_SAMPLES)
+    assert svc._effective_wait_ms() == 11.25
+    # the floor holds
+    feed(95.0, n=svc._ADAPT_SAMPLES)
+    svc._hold_ema_ms = 50.0
+    for _ in range(12):
+        svc._effective_wait_ms()
+    assert svc._effective_wait_ms() == 0.1
+    # fixed-policy service never moves
+    svc2 = PredictionService(pred, warm=False,
+                             policy=BatchPolicy(max_wait_ms=3.0))
+    feed(80.0)
+    assert svc2._effective_wait_ms() == 3.0
+
+
+def test_resp_loop_idle_backoff_counters(mesh_ctx):
+    """RespPredictionLoop.run backs off exponentially while idle: far
+    fewer polls than a fixed-2ms spin would make, and the polling economy
+    lands in the Serving counter group."""
+    from avenir_tpu.io.respq import RespClient, RespServer
+    table, models = small_forest(mesh_ctx, n=200, trees=3, depth=2)
+    pred = ForestPredictor(models, SCHEMA, buckets=(8,))
+    svc = PredictionService(pred, warm=False)
+    server = RespServer().start()
+    try:
+        loop = RespPredictionLoop(svc, {"redis.server.port": server.port})
+        t0 = time.perf_counter()
+        loop.run(max_idle_s=0.5, idle_sleep_s=0.002, max_idle_sleep_s=0.05)
+        dt = time.perf_counter() - t0
+        polls = svc.counters.get("Serving", "Polls")
+        empty = svc.counters.get("Serving", "EmptyPolls")
+        # the final poll breaks on max_idle before counting its miss
+        assert polls >= empty > 0 and polls - empty <= 1
+        # a fixed 2ms sleep would poll ~250 times in 0.5s; the backoff
+        # (2->4->...->50ms cap) stays an order of magnitude below that
+        assert polls < 0.5 / 0.002 / 2, \
+            f"{polls} polls in {dt:.2f}s — idle backoff not applied"
+        loop.close()
+    finally:
+        server.stop()
+
+
+# --------------------------------------------------------------------------
 # publish-path fault tolerance
 # --------------------------------------------------------------------------
 

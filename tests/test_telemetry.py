@@ -449,6 +449,7 @@ def test_prediction_service_stats_snapshot():
     st = svc.stats()
     assert st == {"queue_depth": 0, "in_flight": 0, "served": 3,
                   "errors": 1, "batches": 1, "hot_swaps": 0,
+                  "rejected": 0, "window_ms": svc.policy.max_wait_ms,
                   "degraded": None, "model_version": 4}
     ok, payload = svc.health()
     assert ok and payload["served"] == 3

@@ -104,6 +104,29 @@ def cmd_summarize(args) -> int:
     for (pid, tid), busy in sorted(by_lane.items()):
         print(f"  pid {pid} tid {tid}: {busy / 1e3:.1f} ms "
               f"({100.0 * busy / max(t_hi - t_lo, 1e-9):.0f}% of wall)")
+    # serving lane breakdown: every lane that ran device predicts is one
+    # fleet worker's predict thread — batches, how full they ran, and
+    # what fraction of the lane's live window the device was busy
+    serve_lanes: Dict[tuple, List[dict]] = defaultdict(list)
+    for e in spans:
+        if e.get("name") == "serve.predict":
+            serve_lanes[(e.get("pid"), e.get("tid"))].append(e)
+    if serve_lanes:
+        print("\nserving lanes (serve.predict):")
+        print(f"  {'lane':<18}{'batches':>8}{'rows':>8}{'mean fill':>10}"
+              f"{'device-busy':>12}")
+        for lane in sorted(serve_lanes):
+            evs = serve_lanes[lane]
+            rows = [int(e.get("args", {}).get("rows", 0)) for e in evs]
+            busy_us = sum(float(e.get("dur", 0.0)) for e in evs)
+            lo = min(float(e["ts"]) for e in evs)
+            hi = max(float(e["ts"]) + float(e.get("dur", 0.0))
+                     for e in evs)
+            frac = busy_us / max(hi - lo, 1e-9)
+            pid, tid = lane
+            print(f"  pid {pid} tid {tid:<8}{len(evs):>8}{sum(rows):>8}"
+                  f"{(sum(rows) / max(len(evs), 1)):>10.1f}"
+                  f"{100.0 * frac:>11.0f}%")
     if stalls:
         print(f"\n{len(stalls)} STALL event(s):")
         for e in stalls:
