@@ -232,7 +232,18 @@ def random_forest_builder(cfg: Config, in_path: str, out_path: str) -> Counters:
     group shows the per-site launch delta and the ``ProgramCache`` group
     reports this run's compile/hit tallies (a warm re-run of an
     identical job shows Retraces=0).  ``false`` restores the eager
-    per-stage path."""
+    per-stage path.
+
+    ``dtb.model.quantize=true`` (with ``dtb.model.registry.dir``;
+    TPU_NOTES §24) additionally attaches the int8-quantized serving
+    sidecar to the published version, enforcing
+    ``dtb.model.quantize.budget`` (default 0.01 prediction-mismatch
+    fraction vs the float ensemble) on a training-data sample at publish
+    time — over-budget quantizations refuse to publish.  Streamed trains
+    re-read a ``dtb.model.quantize.sample.rows`` head sample (default
+    65536).  ``predictionService`` selects the sidecar with
+    ``ps.quantized``; ``kernel.backend=auto|xla|pallas`` (env twin
+    AVENIR_TPU_KERNEL_BACKEND) picks the hot-loop kernel form."""
     from ..models.forest import (ForestParams, build_forest,
                                  build_forest_from_stream)
     counters = Counters()
@@ -255,6 +266,12 @@ def random_forest_builder(cfg: Config, in_path: str, out_path: str) -> Counters:
         from ..monitor.baseline import BaselineBuilder
         baseline_builder = BaselineBuilder(
             schema, n_bins=cfg.get_int("dtb.baseline.bins", 32))
+    if cfg.get_boolean("dtb.model.quantize", False) and not reg_dir:
+        # same refusal shape as baseline.publish: the quantized sidecar
+        # rides a registry version — silently training without one only
+        # surfaces when ps.quantized later finds nothing to serve
+        raise ValueError("dtb.model.quantize needs dtb.model.registry.dir "
+                         "(the int8 sidecar rides the registry version)")
     if cfg.get_boolean("dtb.streaming.resume", False) and \
             not cfg.get_boolean("dtb.streaming.ingest", False):
         # same refusal as the missing-checkpoint-dir case: a --resume that
@@ -409,6 +426,42 @@ def random_forest_builder(cfg: Config, in_path: str, out_path: str) -> Counters:
                 publish_baseline(registry, model_name, version, baseline)
                 counters.set("Random forest", "BaselineRows",
                              baseline.n_rows)
+            if cfg.get_boolean("dtb.model.quantize", False):
+                # int8 serving sidecar (TPU_NOTES §24): quantize the
+                # published forest and enforce the pinned accuracy-delta
+                # budget on a training-data sample BEFORE attaching —
+                # an over-budget quantization refuses here, never at
+                # serve time.  Streamed trains re-read a bounded head
+                # sample (the encoded dataset is gone by publish time).
+                from ..serving.quantized import publish_quantized
+                if cfg.get_boolean("dtb.streaming.ingest", False):
+                    from ..core.table import iter_csv_chunks as _icc
+                    gen = _icc(
+                        in_path, schema, cfg.field_delim_regex,
+                        chunk_rows=cfg.get_int(
+                            "dtb.model.quantize.sample.rows", 65536),
+                        bad_records=BadRecordPolicy("skip"))
+                    try:
+                        sample = next(iter(gen))
+                    except StopIteration:
+                        raise ValueError(
+                            "dtb.model.quantize: the input yielded no "
+                            "sample rows to enforce the accuracy budget "
+                            "on (empty/fully-filtered file)") from None
+                    finally:
+                        gen.close()   # release the parser handle now
+                else:
+                    sample = table
+                info = publish_quantized(
+                    registry, model_name, version, models, schema,
+                    sample,
+                    budget=cfg.get_float("dtb.model.quantize.budget",
+                                         0.01))
+                counters.set("Random forest", "QuantizedSampleRows",
+                             int(info["n_sample"]))
+                counters.set("Random forest",
+                             "QuantizedMismatchPerMillion",
+                             int(round(info["mismatch"] * 1e6)))
     counters.increment("Random forest", "Trees", len(models))
     return counters
 

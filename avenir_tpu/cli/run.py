@@ -436,6 +436,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         in_path = out_path = None
     spool_dir = None
     tracer = metrics_server = registry = None
+    backend_knob = cfg.get("kernel.backend")
+    if backend_knob:
+        # kernel.backend=auto|xla|pallas (TPU_NOTES §24): process-level
+        # selection for the hot-loop pallas twins; the env twin
+        # AVENIR_TPU_KERNEL_BACKEND is read by the dispatch layer itself.
+        # Installed before the job, cleared in finally so one in-process
+        # run cannot leak its selection into the next.
+        from ..ops.pallas.dispatch import set_kernel_backend
+        set_kernel_backend(backend_knob)
     try:
         # inside the try so a dist-mode refusal still runs the context
         # cleanup below (no hybrid-mesh leak into later in-process runs)
@@ -503,6 +512,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                 emit_counters(counters, out_path,
                               persist=not spec.active or spec.index == 0)
     finally:
+        if backend_knob:
+            from ..ops.pallas.dispatch import set_kernel_backend
+            set_kernel_backend(None)
         if registry is not None:
             registry.stop_snapshots()
         if metrics_server is not None:

@@ -17,6 +17,10 @@ coalescing policy, optional RESP wire transport).  Config keys
                             coalescing window (default 0 = fixed)
   ps.queue.max.depth        admission threshold; submits past it answer
                             'busy' (default 0 = unbounded)
+  ps.quantized              serve the int8-quantized forest sidecar
+                            (budget-pinned at publish; a version without
+                            an intact sidecar warns and serves float —
+                            default false)
   ps.workers                fleet size; >1 serves through a ServingFleet
                             of workers draining one RESP queue (default 1;
                             requires ps.transport=resp)
@@ -67,6 +71,7 @@ def prediction_service(cfg: Config, in_path: str, out_path: str) -> Counters:
                                      list(DEFAULT_BUCKETS)))
     warm = cfg.get_boolean("ps.warm.start", True)
     version = cfg.get_int("ps.model.version", 0)
+    quantized = cfg.get_boolean("ps.quantized", False)
     # tokenize with the INPUT delimiter (field.delim.regex, like every
     # other job); the service/wire delimiter is field.delim.out
     split = _splitter(cfg.field_delim_regex)
@@ -83,7 +88,8 @@ def prediction_service(cfg: Config, in_path: str, out_path: str) -> Counters:
         from ..serving.predictor import make_predictor
         loaded = registry.load(name, version, schema=schema)
         return make_predictor(loaded, schema=schema, buckets=buckets,
-                              delim=cfg.field_delim_out)
+                              delim=cfg.field_delim_out,
+                              quantized=quantized)
 
     if n_workers > 1:
         from ..io.respq import RespClient, RespServer
@@ -102,7 +108,7 @@ def prediction_service(cfg: Config, in_path: str, out_path: str) -> Counters:
                 predictor_factory=pinned_factory if version else None,
                 schema=schema, buckets=buckets, policy=policy,
                 n_workers=n_workers, config=wire_cfg, warm=warm,
-                delim=od,
+                delim=od, quantized=quantized,
                 latency_window=cfg.get_int("ps.latency.window", 8192))
             fleet.start()
             feeder = RespClient(port=server.port)
@@ -151,7 +157,8 @@ def prediction_service(cfg: Config, in_path: str, out_path: str) -> Counters:
         svc.version = version
     else:
         svc = PredictionService(registry=registry, model_name=name,
-                                schema=schema, buckets=buckets, **common)
+                                schema=schema, buckets=buckets,
+                                quantized=quantized, **common)
     counters.set("Serving", "ModelVersion", svc.version or 0)
     if transport == "resp":
         from ..io.respq import RespClient, RespServer

@@ -79,8 +79,18 @@ def _unpack_weights4(packed):
 
 
 @functools.lru_cache(maxsize=None)
-def _jitted_forest_count_kernel(S: int, B: int, C: int):
+def _jitted_forest_count_kernel(S: int, B: int, C: int,
+                                backend: str = "xla",
+                                interpret: bool = False):
+    """``backend`` is part of the cache key ON PURPOSE (TPU_NOTES §24):
+    the dispatch decision happens at trace time, so a program traced
+    under one backend must never serve a call made under the other."""
     def kernel(node_ids, branches, cls_codes, weights, n_nodes):
+        if backend == "pallas":
+            from ..ops.pallas.histogram import forest_level_counts
+            return forest_level_counts(node_ids, branches, cls_codes,
+                                       weights, n_nodes, B, C,
+                                       interpret=interpret)
         return _count_body(node_ids, branches, cls_codes, weights,
                            n_nodes, B, C)
     return jax.jit(kernel, static_argnums=4)
@@ -145,7 +155,9 @@ def _reassign_body(node_ids, branches, sel_split, child_table):
 
 
 @functools.lru_cache(maxsize=None)
-def _jitted_forest_level_kernel(S: int, B: int, C: int):
+def _jitted_forest_level_kernel(S: int, B: int, C: int,
+                                backend: str = "xla",
+                                interpret: bool = False):
     """Fused per-level program: re-tag every record for every tree with the
     previous level's chosen splits, then histogram the new frontier — ONE
     launch and ONE host readback per level (the counts; new node ids stay
@@ -153,12 +165,25 @@ def _jitted_forest_level_kernel(S: int, B: int, C: int):
     identical shape/dtype/sharding and every caller rebinds, so the level
     loop's biggest carry updates in place instead of paying a defensive
     HBM copy per level (the chunked path donates the per-chunk pad/slice
-    copies, which are equally dead after the call)."""
+    copies, which are equally dead after the call).
+
+    ``backend="pallas"`` swaps the histogram half for the VMEM-resident
+    pallas kernel (ops/pallas/histogram.forest_level_counts — counts
+    bit-identical, interpret-mode parity pinned); the reassign stays the
+    XLA one-hot form either way (it is lookup-table matmuls, already the
+    right formulation).  The backend is part of the lru key — see
+    ``_jitted_forest_count_kernel``."""
     def kernel(node_ids, branches, cls_codes, weights, sel_split,
                child_table, n_new):
         new_ids = _reassign_body(node_ids, branches, sel_split, child_table)
-        counts = _count_body(new_ids, branches, cls_codes, weights,
-                             n_new, B, C)
+        if backend == "pallas":
+            from ..ops.pallas.histogram import forest_level_counts
+            counts = forest_level_counts(new_ids, branches, cls_codes,
+                                         weights, n_new, B, C,
+                                         interpret=interpret)
+        else:
+            counts = _count_body(new_ids, branches, cls_codes, weights,
+                                 n_new, B, C)
         return new_ids, counts
     return jax.jit(kernel, static_argnums=6, donate_argnums=(0,))
 
@@ -188,6 +213,9 @@ class ForestBuilder:
             self.base.with_params(
                 replace(params.tree, seed=params.seed + 1000 * (t + 1)))
             for t in range(params.num_trees)]
+        # resolved per build in build_all (trace-time decision); default
+        # for any direct _level_counts caller
+        self._kernel_backend = "xla"
 
     def _level_counts(self, kernel, node_ids, weights, n_nodes: int
                       ) -> np.ndarray:
@@ -202,8 +230,10 @@ class ForestBuilder:
         S, B, C = base.split_set.n_splits, base.split_set.max_branches, base.C
         chunk = level_chunk(n_nodes, T, S, B, C, self._w_max)
         n = base.n_padded
+        from ..ops.pallas.dispatch import note_backend
         if n <= chunk:
             note_dispatch(site="forest.level")
+            note_backend("forest.level", self._kernel_backend)
             c = kernel(node_ids, base.branches, base.cls_codes, weights,
                        n_nodes)
             return base._reduce_counts(fetch(c, dtype=np.float64))
@@ -214,6 +244,7 @@ class ForestBuilder:
                 chunk, node_ids[start:end], base.branches[start:end],
                 base.cls_codes[start:end], weights[start:end])
             note_dispatch(2, site="forest.level")  # count + accumulate
+            note_backend("forest.level", self._kernel_backend)
             c = kernel(nid, br, cc, ww, n_nodes)
             acc = c.astype(jnp.int32) if acc is None \
                 else acc_counts(acc, c)
@@ -236,8 +267,10 @@ class ForestBuilder:
         # ride the same budget via an inflated node-count term
         chunk = level_chunk(n_new + n_prev + S + B, T, S, B, C, self._w_max)
         n = base.n_padded
+        from ..ops.pallas.dispatch import note_backend
         if n <= chunk:
             note_dispatch(site="forest.level")
+            note_backend("forest.level", self._kernel_backend)
             new_ids, c = fused(node_ids, base.branches, base.cls_codes,
                                weights, sel, ctab, n_new)
             # ONE stacked (T, N, S, B, C) transfer per level for the whole
@@ -251,6 +284,7 @@ class ForestBuilder:
                 chunk, node_ids[start:end], base.branches[start:end],
                 base.cls_codes[start:end], weights[start:end])
             note_dispatch(2, site="forest.level")  # fused level + accumulate
+            note_backend("forest.level", self._kernel_backend)
             ni, c = fused(nid, br, cc, ww, sel, ctab, n_new)
             ids_parts.append(ni[:end - start])
             acc = c.astype(jnp.int32) if acc is None \
@@ -292,8 +326,17 @@ class ForestBuilder:
             weights = ctx.shard_rows_streamed(wst)
         node_ids = ctx.zeros_rows((n, T), np.int32)
         S, B, C = base.split_set.n_splits, base.split_set.max_branches, base.C
-        count_k = _jitted_forest_count_kernel(S, B, C)
-        fused_k = _jitted_forest_level_kernel(S, B, C)
+        # backend resolved ONCE per build (trace-time decision, so the
+        # jit caches key on it); which form actually ran lands in the
+        # ledger's KernelBackends group at every forest.level launch
+        from ..ops.pallas.dispatch import pallas_interpret, resolve_backend
+        self._kernel_backend = resolve_backend(ctx.device_platform,
+                                               ctx.n_devices)
+        interp = pallas_interpret(ctx.device_platform)
+        count_k = _jitted_forest_count_kernel(S, B, C,
+                                              self._kernel_backend, interp)
+        fused_k = _jitted_forest_level_kernel(S, B, C,
+                                              self._kernel_backend, interp)
 
         # the root histogram (every record at node 0) IS the level-0 frontier
         # histogram, so one launch serves both
@@ -466,9 +509,18 @@ def _ensemble_vote_body(vals, codes, lo, hi, num_r, cat_m, cat_r, cls_oh,
 
 
 @functools.lru_cache(maxsize=None)
-def _jitted_ensemble_vote_kernel(T: int, P: int, F: int, C: int, K: int):
+def _jitted_ensemble_vote_kernel(T: int, P: int, F: int, C: int, K: int,
+                                 backend: str = "xla",
+                                 interpret: bool = False):
     """One fused launch for the WHOLE ensemble: every member's path tensors
-    stacked on a leading member axis (see ``_ensemble_vote_body``)."""
+    stacked on a leading member axis (see ``_ensemble_vote_body``).
+    ``backend="pallas"`` runs the identical body tiled through the VMEM
+    kernel (ops/pallas/vote.ensemble_vote) — same votes, one launch; the
+    backend is part of the lru key (trace-time decision)."""
+    if backend == "pallas":
+        from ..ops.pallas.vote import ensemble_vote
+        return jax.jit(functools.partial(ensemble_vote,
+                                         interpret=interpret))
     return jax.jit(_ensemble_vote_body)
 
 
@@ -501,11 +553,15 @@ class EnsembleModel:
         # vote-index -> label decode (trailing None = min-odds veto): one
         # table for the batch path and the serving layer
         self._lut = np.concatenate([self._cls_arr.astype(object), [None]])
+        self._vote_backend = "xla"
         self._stacked = self._stack_members()
 
-    def _stack_members(self):
-        """(T, Pmax, ...) stacked predicate tensors, or None when any member
-        is degenerate (no paths/classes), bounds are not f32-exact, or the
+    def stacked_host(self):
+        """The HOST (numpy) form of the stacked member tensors
+        ``(lo, hi, num_r, cat_m, cat_r, cls_oh)`` — shared by the device
+        vote path and the int8 quantizer (serving/quantized.py), so both
+        see the identical pad/sentinel layout.  None when any member is
+        degenerate (no paths/classes), bounds are not f32-exact, or the
         vote weights are not small integers — fractional weights must
         accumulate in the host path's float64 (f32 vote sums could flip
         argmax/veto decisions near ties)."""
@@ -541,10 +597,27 @@ class EnsembleModel:
             hi[t, p] = np.inf
             num_r[t, p] = False
             cls_oh[t, p, cls_idx[m.classes[int(m.fallback_cls)]]] = 1.0
+        return lo, hi, num_r, cat_m, cat_r, cls_oh
+
+    def _stack_members(self):
+        """Device placement + jit of :meth:`stacked_host` (None passes
+        through: the host vote path serves those ensembles)."""
+        host = self.stacked_host()
+        if host is None:
+            return None
+        lo, hi, num_r, cat_m, cat_r, cls_oh = host
+        T, P, F = lo.shape
+        cmax, K = cat_m.shape[3], cls_oh.shape[2]
         dev = tuple(jnp.asarray(a) for a in
                     (lo, hi, num_r, cat_m, cat_r, cls_oh))
+        from ..ops.pallas.dispatch import pallas_interpret, resolve_backend
+        ctx = runtime_context()
+        platform = ctx.device_platform
+        self._vote_backend = resolve_backend(platform, ctx.n_devices)
         return dev + (jnp.asarray(np.asarray(self.weights, np.float32)),
-                      _jitted_ensemble_vote_kernel(T, P, F, cmax, K))
+                      _jitted_ensemble_vote_kernel(
+                          T, P, F, cmax, K, self._vote_backend,
+                          pallas_interpret(platform)))
 
     def device_inputs(self, table: ColumnarTable, cache=None):
         """The single gate for the fused device vote: (d_vals, d_codes)
@@ -582,9 +655,11 @@ class EnsembleModel:
         # (n, F, C) categorical one-hot (dominant for high cardinality)
         per_row = max(T * P * F, F * C, 1)
         chunk = max(1024, (1 << 26) // per_row)
+        from ..ops.pallas.dispatch import note_backend
         out = []
         for s in range(0, n, chunk):
             note_dispatch(site="ensemble.vote")
+            note_backend("ensemble.vote", self._vote_backend)
             out.append(kernel(d_vals[s:s + chunk], d_codes[s:s + chunk],
                               *consts, wvec,
                               jnp.float32(self.min_odds_ratio)))

@@ -287,14 +287,26 @@ class BaselineBuilder:
                mask: Optional[np.ndarray] = None) -> "BaselineBuilder":
         import jax.numpy as jnp
         from ..ops.histogram import feature_bin_counts
+        from ..ops.pallas.dispatch import (note_backend, pallas_interpret,
+                                           resolve_backend)
         from ..utils.tracing import note_dispatch
         resolve_spec_bounds(self.specs, table, self.n_bins)
         self._ensure_state()
         codes = encode_monitor_codes(table, self.specs)
         m = jnp.asarray(mask) if mask is not None else None
+        backend = resolve_backend()
         note_dispatch(site="baseline.absorb")
-        self._counts = self._counts + feature_bin_counts(
-            jnp.asarray(codes), self._counts.shape[1], m)
+        note_backend("baseline.absorb", backend)
+        if backend == "pallas":
+            # the VMEM-resident pallas twin (ops/pallas/histogram.
+            # bin_counts) — bit-identical 0/1 sums, one launch
+            from ..ops.pallas.histogram import bin_counts
+            self._counts = self._counts + bin_counts(
+                jnp.asarray(codes), self._counts.shape[1], m,
+                interpret=pallas_interpret())
+        else:
+            self._counts = self._counts + feature_bin_counts(
+                jnp.asarray(codes), self._counts.shape[1], m)
         self._n += table.n_rows if mask is None else int(np.sum(mask))
         return self
 
@@ -326,6 +338,16 @@ class BaselineBuilder:
             return {"mon_codes": encode_monitor_codes(table, builder.specs)}
 
         def kernel(carry, consts, inputs, upstream):
+            # trace-time backend branch: safe because ChunkPipeline's
+            # ProgramCache key carries a backend axis (TPU_NOTES §24) —
+            # a program traced under one backend never serves the other
+            from ..ops.pallas.dispatch import (pallas_interpret,
+                                               resolve_backend)
+            if resolve_backend() == "pallas":
+                from ..ops.pallas.histogram import bin_counts
+                return carry + bin_counts(
+                    inputs["mon_codes"], b_max, inputs["mask"] > 0,
+                    interpret=pallas_interpret()), {}
             from ..ops.histogram import feature_bin_counts
             return carry + feature_bin_counts(
                 inputs["mon_codes"], b_max, inputs["mask"] > 0), {}

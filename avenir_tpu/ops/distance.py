@@ -146,6 +146,25 @@ def _topk_scan_kernel(k: int, metric: str, n_cat: float, denom: float,
 
 
 @functools.lru_cache(maxsize=None)
+def _topk_pallas_jit(k: int, metric: str, n_cat: float, denom: float,
+                     fscale: float, interpret: bool):
+    """The pallas twin of ``_topk_scan_kernel`` (ops/pallas/topk): ONE
+    launch per test chunk over the FLAT train arrays — the kernel owns
+    its own tiling, the running best-k lives in VMEM scratch across the
+    train walk, and the distance body is the same ``_dist_kernels``
+    implementation, so results are bit-identical (interpret-mode parity
+    pinned by tests/test_pallas_kernels.py).  The backend is resolved
+    per call in ``pairwise_topk``; this cache keys on everything the
+    lowered kernel depends on."""
+    from .pallas.topk import topk_scan
+
+    def kernel(tn, toh, rn, roh):
+        return topk_scan(tn, toh, rn, roh, k, metric, n_cat, denom,
+                         fscale, interpret=interpret)
+    return jax.jit(kernel)
+
+
+@functools.lru_cache(maxsize=None)
 def _pair_concat_jit(n_parts: int):
     """Concatenate the per-chunk (best_d, best_i) part lists in ONE
     dispatch (two eager concatenates would be two)."""
@@ -343,16 +362,34 @@ class DistanceComputer:
                 if mesh_on else jnp.asarray
             return tuple(put(a) for a in (rn_t, roh_t, base, nvalid))
 
-        rn_t, roh_t, base_d, nv_d = self._train_device(
-            ("tiled", train_tile, mesh_on), build_tiles)
+        # backend dispatch (TPU_NOTES §24): the pallas kernel owns its own
+        # train tiling over the FLAT arrays and keeps the running best-k
+        # in VMEM scratch; the XLA form scans pre-stacked uniform tiles.
+        # Results are bit-identical; which form ran lands in the ledger's
+        # KernelBackends group under the knn.topk site.
+        from .pallas.dispatch import (note_backend, pallas_interpret,
+                                      resolve_backend)
+        backend = resolve_backend(ctx.device_platform, ctx.n_devices)
         k_loc = min(k, n_train)
-        kernel = _topk_scan_kernel(k_loc, self.metric, self._n_cat,
-                                   self._denom, self._fscale)
+        if backend == "pallas":
+            rn_d, roh_d = self._train_device(
+                "pallas-flat",
+                lambda: (note_h2d(rn.nbytes + roh.nbytes, 2),
+                         (jnp.asarray(rn), jnp.asarray(roh)))[1])
+            kernel = _topk_pallas_jit(k_loc, self.metric, self._n_cat,
+                                      self._denom, self._fscale,
+                                      pallas_interpret(ctx.device_platform))
+        else:
+            rn_t, roh_t, base_d, nv_d = self._train_device(
+                ("tiled", train_tile, mesh_on), build_tiles)
+            kernel = _topk_scan_kernel(k_loc, self.metric, self._n_cat,
+                                       self._denom, self._fscale)
         out_d: List = []
         out_i: List = []
         for ts in range(0, n_test, test_chunk):
             te = min(ts + test_chunk, n_test)
-            if mesh_on and (te - ts) % ctx.n_devices == 0:
+            if backend != "pallas" and mesh_on \
+                    and (te - ts) % ctx.n_devices == 0:
                 put = lambda a: jax.device_put(a, ctx.row_sharding())
             else:
                 put = lambda a: a
@@ -360,8 +397,13 @@ class DistanceComputer:
             note_h2d(tn_h.nbytes + toh_h.nbytes, transfers=2)
             tn_c = put(jnp.asarray(tn_h))
             toh_c = put(jnp.asarray(toh_h))
-            note_dispatch()
-            best_d, best_i = kernel(tn_c, toh_c, rn_t, roh_t, base_d, nv_d)
+            note_dispatch(site="knn.topk")
+            note_backend("knn.topk", backend)
+            if backend == "pallas":
+                best_d, best_i = kernel(tn_c, toh_c, rn_d, roh_d)
+            else:
+                best_d, best_i = kernel(tn_c, toh_c, rn_t, roh_t,
+                                        base_d, nv_d)
             if shard_reducer is not None:
                 # lock-step merge: this chunk's local best-k (lifted to
                 # GLOBAL train rows) against every peer's — the ONE

@@ -1,10 +1,19 @@
 """Counting kernels: the universal primitive of the rebuilt framework.
 
 Almost every reducer in the reference is 'sum 1s (or moments) per composite
-key' (SURVEY.md §7 guiding translation).  On TPU that is a dense one-hot
-contraction that XLA tiles onto the MXU; under GSPMD with row-sharded inputs
-the per-shard partial sums + all-reduce reproduce the combiner+shuffle
-exactly (map-side combine for free).
+key' (SURVEY.md §7 guiding translation).  The counting kernels build that
+sum by SCATTER-ADD over a flattened composite key (ISSUE 11) — the only
+intermediate is an (n, F) int32 key matrix, so large (F, B, C) shapes
+never materialize the (n, F, B) x (n, C) one-hot pair the old MXU
+contraction needed; under GSPMD with row-sharded inputs the per-shard
+partial sums + all-reduce reproduce the combiner+shuffle exactly
+(map-side combine for free).  ``class_moments`` keeps the one-hot
+contraction (its values are real moments, not 0/1 — the MXU form is the
+right one), and ``_class_bin_histogram_onehot`` preserves the original
+formulation as the scatter rewrite's parity oracle.  The forest/monitor
+hot paths additionally carry hand-written pallas twins under
+``ops/pallas/`` (TPU_NOTES §24), platform-selected via
+``ops.pallas.dispatch``.
 
 All kernels take a ``mask`` so padded rows (ColumnarTable.pad_to_multiple)
 contribute nothing.  Counts are accumulated in float32 by default — exact for
@@ -33,7 +42,34 @@ def class_bin_histogram(class_codes: jnp.ndarray,    # (n,) int
     (bayesian/BayesianDistribution.java:139-178, 263-327) and the per-node
     class histograms of the tree builder.  Out-of-range / negative bin codes
     (unknown categorical values) are dropped, as is anything with mask=False.
-    """
+
+    Built by SCATTER-ADD over the flattened (class, feature, bin) key
+    (the ``_support_kernel_mxu`` candidate-matrix trick, ISSUE 11): the
+    only intermediate is the (n, F) int32 key matrix, where the old
+    one-hot contraction materialized an (n, F, B) x (n, C) f32 pair —
+    a B-fold memory blowup at large (F, B, C) shapes regardless of
+    backend.  Counts are sums of 0/1 in ``dtype``, exact below 2^24 per
+    cell in f32 — bit-identical to the one-hot form (pinned against
+    ``_class_bin_histogram_onehot`` by tests/test_pallas_kernels.py)."""
+    n, F = bin_codes.shape
+    valid = (bin_codes >= 0) & (bin_codes < num_bins) \
+        & ((class_codes >= 0) & (class_codes < num_classes))[:, None]
+    if mask is not None:
+        valid = valid & mask[:, None]
+    c = jnp.clip(class_codes, 0, num_classes - 1).astype(jnp.int32)
+    b = jnp.clip(bin_codes, 0, num_bins - 1).astype(jnp.int32)
+    f = jnp.arange(F, dtype=jnp.int32)[None, :]
+    key = (c[:, None] * F + f) * num_bins + b                 # (n, F)
+    flat = jnp.zeros((num_classes * F * num_bins,), dtype
+                     ).at[key.ravel()].add(valid.ravel().astype(dtype))
+    return flat.reshape(num_classes, F, num_bins)
+
+
+def _class_bin_histogram_onehot(class_codes, bin_codes, num_classes,
+                                num_bins, mask=None, dtype=jnp.float32):
+    """The original one-hot contraction form, kept as the parity oracle
+    for the scatter rewrite (and the MXU formulation a dense-matmul
+    backend could still prefer).  Same drop semantics."""
     valid = (bin_codes >= 0) & (bin_codes < num_bins)
     if mask is not None:
         valid = valid & mask[:, None]
@@ -106,13 +142,18 @@ def joint_histogram(a_codes: jnp.ndarray, b_codes: jnp.ndarray,
                     mask: Optional[jnp.ndarray] = None,
                     dtype=jnp.float32) -> jnp.ndarray:
     """counts[a, b] joint histogram of two code columns (contingency matrix /
-    MutualInformation pair distributions, explore/MutualInformation.java)."""
-    valid = (a_codes >= 0) & (b_codes >= 0)
+    MutualInformation pair distributions, explore/MutualInformation.java).
+    Scatter-add over the flattened pair key — no (n, A) x (n, B) one-hot
+    pair; bit-identical to the one-hot form (0/1 sums)."""
+    valid = (a_codes >= 0) & (b_codes >= 0) \
+        & (a_codes < num_a) & (b_codes < num_b)
     if mask is not None:
         valid = valid & mask
-    oh_a = jax.nn.one_hot(a_codes, num_a, dtype=dtype) * valid.astype(dtype)[:, None]
-    oh_b = jax.nn.one_hot(b_codes, num_b, dtype=dtype)
-    return oh_a.T @ oh_b
+    a = jnp.clip(a_codes, 0, num_a - 1).astype(jnp.int32)
+    b = jnp.clip(b_codes, 0, num_b - 1).astype(jnp.int32)
+    flat = jnp.zeros((num_a * num_b,), dtype
+                     ).at[a * num_b + b].add(valid.astype(dtype))
+    return flat.reshape(num_a, num_b)
 
 
 def entropy(p: jnp.ndarray, axis=-1, eps: float = 1e-12) -> jnp.ndarray:

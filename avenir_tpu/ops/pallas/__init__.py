@@ -1,0 +1,29 @@
+"""Hand-written Pallas kernels for the ledger-measured hot loops
+(TPU_NOTES §24), platform-selected through :mod:`.dispatch`:
+
+* :mod:`.histogram` — fused encode -> scatter-add level/bin counting,
+  VMEM-resident accumulator (the forest per-level stacked (T,N,S,B,C)
+  histogram and the monitor's (R,B) bin counts);
+* :mod:`.topk`      — KNN tiled distance + running best-k in on-chip
+  scratch across the train-tile walk;
+* :mod:`.vote`      — the serving ensemble vote, float and int8
+  (quantized) forms.
+
+Training kernels are bit-identical to their XLA twins (interpret-mode
+parity pinned in the tier-1 lane under the ``kernels`` marker); the
+quantized serving path is accuracy-budget-pinned at publish time
+instead (serving/quantized.py).
+
+Heavy deps load lazily: importing the dispatch knob must not drag
+pallas into every process start.
+"""
+
+from .dispatch import (BACKENDS, BACKEND_ENV, BACKEND_KEY, force_backend,
+                       kernel_backend, note_backend, pallas_interpret,
+                       resolve_backend, set_kernel_backend, use_pallas)
+
+__all__ = [
+    "BACKENDS", "BACKEND_ENV", "BACKEND_KEY", "force_backend",
+    "kernel_backend", "note_backend", "pallas_interpret",
+    "resolve_backend", "set_kernel_backend", "use_pallas",
+]

@@ -1,0 +1,150 @@
+"""Kernel-backend dispatch: the ONE place that decides pallas vs XLA.
+
+Three hot loops carry hand-written Pallas twins (TPU_NOTES §24): the
+forest per-level stacked (T, N, S, B, C) histogram, the KNN tiled
+distance + top-k scan, and the serving ensemble vote.  Every call site
+resolves its backend HERE, so an operator (or a test) flips one knob and
+the whole framework follows:
+
+    kernel.backend = auto | xla | pallas      (CLI -D / conf key)
+    AVENIR_TPU_KERNEL_BACKEND                 (env twin)
+
+``auto`` (the default) selects pallas on a real TPU mesh and XLA
+everywhere else.  ``pallas`` forces the pallas kernels on any platform —
+off-TPU they run in *interpret mode* (:func:`pallas_interpret`), which
+is how the CPU tier-1 lane pins bit-identical parity against the XLA
+twins without a device.  ``xla`` pins the composed-op path everywhere
+(the escape hatch when a Mosaic compile regresses).
+
+Training kernels (histogram, top-k) are bit-identical across backends —
+pinned by the interpret-mode parity tests (tests/test_pallas_kernels.py,
+``kernels`` marker); the quantized serving vote is budget-pinned instead
+(serving/quantized.py).  Which backend actually ran at each hot site is
+recorded into the active TransferLedger (``KernelBackends`` counter
+group) via :func:`note_backend`, so a silent fallback can never flatter
+a pallas number (the bench roofline blocks assert on it).
+
+Jit-cache discipline: the backend is resolved at TRACE time, so every
+jit/lru cache wrapping a dispatched kernel must carry the resolved
+backend in its key (the forest level kernels and the vote kernel key on
+it; ``ChunkPipeline`` adds a backend axis to the ProgramCache key) — a
+program traced under one backend must never serve a call made under the
+other.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import Iterator, Optional
+
+BACKEND_AUTO = "auto"
+BACKEND_XLA = "xla"
+BACKEND_PALLAS = "pallas"
+BACKENDS = (BACKEND_AUTO, BACKEND_XLA, BACKEND_PALLAS)
+
+BACKEND_ENV = "AVENIR_TPU_KERNEL_BACKEND"
+BACKEND_KEY = "kernel.backend"
+
+# process-level override (cli.run installs the kernel.backend knob here);
+# a plain attribute read is the hot-path cost
+_process_backend: Optional[str] = None
+_lock = threading.Lock()
+
+
+def _check(name: str) -> str:
+    name = (name or "").strip().lower()
+    if name not in BACKENDS:
+        raise ValueError(
+            f"unknown kernel backend {name!r}; must be one of {BACKENDS} "
+            f"({BACKEND_KEY} config key / {BACKEND_ENV} env)")
+    return name
+
+
+def set_kernel_backend(name: Optional[str]) -> None:
+    """Install the process-level backend selection (``None`` clears it
+    back to env/auto resolution).  cli.run calls this from the
+    ``kernel.backend`` knob and clears it in its ``finally`` so one
+    in-process job cannot leak its selection into the next."""
+    global _process_backend
+    with _lock:
+        _process_backend = _check(name) if name is not None else None
+
+
+def kernel_backend() -> str:
+    """The requested backend: process override, else the env twin, else
+    ``auto``.  (Resolution to a concrete xla/pallas choice is
+    :func:`resolve_backend` — it needs the platform.)"""
+    b = _process_backend
+    if b is not None:
+        return b
+    env = os.environ.get(BACKEND_ENV)
+    if env:
+        return _check(env)
+    return BACKEND_AUTO
+
+
+def _runtime():
+    from ...parallel.mesh import runtime_context
+    return runtime_context()
+
+
+def resolve_backend(platform: Optional[str] = None,
+                    n_devices: Optional[int] = None) -> str:
+    """``"xla"`` or ``"pallas"`` for the current request + placement:
+    ``auto`` means pallas only on a SINGLE-chip TPU — everywhere else
+    the composed-op XLA path is the measured winner (off-TPU pallas
+    would run interpreted; on a multi-chip GSPMD mesh the kernels don't
+    speak shard_map yet, so XLA would gather the row axis around every
+    pallas call — TPU_NOTES §24).  An explicit ``xla``/``pallas``
+    selection is always honored.  Callers holding a MeshContext should
+    pass both ``platform`` and ``n_devices`` from it; either omitted
+    falls back to the runtime context."""
+    b = kernel_backend()
+    if b == BACKEND_AUTO:
+        if platform is None:
+            platform = _runtime().device_platform
+        if platform != "tpu":
+            return BACKEND_XLA
+        if n_devices is None:
+            n_devices = _runtime().n_devices
+        return BACKEND_PALLAS if n_devices == 1 else BACKEND_XLA
+    return b
+
+
+def use_pallas(platform: Optional[str] = None,
+               n_devices: Optional[int] = None) -> bool:
+    return resolve_backend(platform, n_devices) == BACKEND_PALLAS
+
+
+def pallas_interpret(platform: Optional[str] = None) -> bool:
+    """Interpret-mode flag for a pallas call: True off-TPU (the CPU
+    tier-1 parity lane), False on a real TPU (Mosaic compile)."""
+    p = platform if platform is not None else _runtime().device_platform
+    return p != "tpu"
+
+
+@contextlib.contextmanager
+def force_backend(name: str) -> Iterator[None]:
+    """Scoped backend override (tests, benches): restores the previous
+    process-level selection on exit."""
+    global _process_backend
+    with _lock:
+        prev = _process_backend
+        _process_backend = _check(name)
+    try:
+        yield
+    finally:
+        with _lock:
+            _process_backend = prev
+
+
+def note_backend(site: str, backend: str, n: int = 1) -> None:
+    """Record which kernel actually ran at a hot site into every active
+    TransferLedger (``KernelBackends`` counter group, key
+    ``<site>.<backend>``).  ``backend`` here is the EXECUTED form —
+    ``xla`` | ``pallas`` | ``quantized`` — not the requested knob, so a
+    fallback is visible as the wrong key."""
+    from ...utils.tracing import note_kernel_backend
+    note_kernel_backend(site, backend, n)

@@ -1,0 +1,134 @@
+"""Pallas histogram kernels: fused encode -> scatter-add, VMEM-resident.
+
+The XLA paths build counting as one-hot contractions; XLA materializes
+the (rows, ...) one-hot operands in HBM before the MXU pass.  These
+kernels walk the row axis as a sequential grid and keep everything —
+the per-tile one-hots AND the full count accumulator — in VMEM: one
+pallas launch replaces the launch-per-chunk + HBM round trip of the
+composed form.  Counts are exact integers in f32 (integral weights,
+chunk mass < 2^24 by the callers' ``level_chunk`` discipline), so any
+tile partitioning sums to the bit-identical result of the XLA twin —
+pinned in interpret mode by tests/test_pallas_kernels.py.
+
+Shared-body discipline (TPU_NOTES §24): the forest kernel's per-tile
+math IS ``models.forest._count_body`` — the pallas form changes WHERE
+the one-hots live, never WHAT is summed.  A drifted copy would silently
+break the parity the tier-1 lane pins.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+# f32 elements of per-tile intermediates we allow in flight (~8 MB) —
+# well under the ~16 MB/core VMEM budget with the accumulator resident
+_TILE_BUDGET_ELEMS = 2 << 20
+_MIN_ROWS = 8
+_MAX_ROWS = 1024
+
+
+def _rows_tile(per_row: int, n: int) -> int:
+    """Static rows-per-grid-step: bound the per-tile one-hot footprint,
+    8-row aligned (f32 sublane), never wider than needed."""
+    r = max(_TILE_BUDGET_ELEMS // max(per_row, 1), _MIN_ROWS)
+    r = min(r, _MAX_ROWS, max(n, _MIN_ROWS))
+    return max((r // 8) * 8, _MIN_ROWS)
+
+
+def forest_level_counts(node_ids, branches, cls_codes, weights,
+                        n_nodes: int, B: int, C: int,
+                        interpret: bool = True):
+    """Stacked (T, N, S, B, C) forest level histogram, ONE pallas launch.
+
+    Same contract as ``models.forest._count_body`` (whose body computes
+    each tile): node_ids/weights (n, T), branches (n, S), cls_codes
+    (n,); rows with node_id < 0 are inactive and weight-masked.  The
+    count accumulator lives in the output block — its index_map pins the
+    same (T, N, S, B, C) block every grid step, so it stays VMEM-resident
+    across the whole row walk — while the (rows, T, N) node one-hot and
+    (rows, C, S, B) class x branch one-hot exist only per tile.  Pad
+    rows (node_id -1, weight 0) contribute nothing, so the result is
+    bit-identical to the XLA einsum for any tiling."""
+    from ...models.forest import _count_body
+    n, T = node_ids.shape
+    S = branches.shape[1]
+    N = int(n_nodes)
+    if n == 0:
+        return jnp.zeros((T, N, S, B, C), jnp.float32)
+    rows = _rows_tile(T * N + C * S * B + T * S, n)
+    pad = (-n) % rows
+    if pad:
+        node_ids = jnp.pad(node_ids, ((0, pad), (0, 0)), constant_values=-1)
+        branches = jnp.pad(branches, ((0, pad), (0, 0)))
+        cls_codes = jnp.pad(cls_codes, ((0, pad),))
+        weights = jnp.pad(weights, ((0, pad), (0, 0)))
+    grid = (node_ids.shape[0] // rows,)
+
+    def kernel(nid_ref, br_ref, cls_ref, w_ref, out_ref):
+        @pl.when(pl.program_id(0) == 0)
+        def _init():
+            out_ref[...] = jnp.zeros_like(out_ref)
+        out_ref[...] += _count_body(nid_ref[...], br_ref[...],
+                                    cls_ref[...][:, 0], w_ref[...],
+                                    N, B, C)
+
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((rows, T), lambda i: (i, 0)),
+            pl.BlockSpec((rows, S), lambda i: (i, 0)),
+            pl.BlockSpec((rows, 1), lambda i: (i, 0)),
+            pl.BlockSpec((rows, T), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((T, N, S, B, C),
+                               lambda i: (0, 0, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((T, N, S, B, C), jnp.float32),
+        interpret=interpret,
+    )(node_ids, branches, cls_codes[:, None],
+      weights.astype(jnp.float32))
+
+
+def bin_counts(codes, num_bins: int, mask=None, interpret: bool = True):
+    """(R, B) monitored-row bin counts, the pallas twin of
+    ``ops.histogram.feature_bin_counts``: codes (n, R) int32, out-of-
+    range codes drop, masked rows contribute nothing.  The (rows, R, B)
+    one-hot exists only per VMEM tile; the (R, B) accumulator block is
+    revisited every grid step."""
+    n, R = codes.shape
+    B = int(num_bins)
+    if n == 0 or R == 0:
+        return jnp.zeros((R, B), jnp.float32)
+    m = mask if mask is not None else jnp.ones((n,), bool)
+    m = m.astype(jnp.float32)
+    rows = _rows_tile(R * B + R, n)
+    pad = (-n) % rows
+    if pad:
+        codes = jnp.pad(codes, ((0, pad), (0, 0)), constant_values=-1)
+        m = jnp.pad(m, ((0, pad),))
+    grid = (codes.shape[0] // rows,)
+
+    def kernel(c_ref, m_ref, out_ref):
+        @pl.when(pl.program_id(0) == 0)
+        def _init():
+            out_ref[...] = jnp.zeros_like(out_ref)
+        c = c_ref[...]
+        valid = (c >= 0) & (c < B)
+        w = valid.astype(jnp.float32) * m_ref[...][:, 0][:, None]  # (r, R)
+        oh = jax.nn.one_hot(jnp.clip(c, 0, B - 1), B,
+                            dtype=jnp.float32)                     # (r, R, B)
+        out_ref[...] += jnp.sum(oh * w[:, :, None], axis=0)
+
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((rows, R), lambda i: (i, 0)),
+            pl.BlockSpec((rows, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((R, B), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((R, B), jnp.float32),
+        interpret=interpret,
+    )(codes, m[:, None])

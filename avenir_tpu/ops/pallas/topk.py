@@ -1,0 +1,125 @@
+"""Pallas KNN kernel: tiled distance + running best-k in on-chip state.
+
+The XLA path (``ops.distance._topk_scan_kernel``) scans stacked train
+tiles with a ``lax.top_k`` + stable-sort merge; every tile's distance
+matrix and the running best lists round-trip HBM between scan steps.
+Here the whole scan is ONE pallas launch per test chunk: the grid walks
+(test tile, train tile), the running best-k lives in VMEM scratch that
+persists across the sequential train-tile steps ("in registers" at the
+kernel's altitude), and the distance tile never leaves VMEM.
+
+The distance body is ``ops.distance._dist_kernels`` — the ONE
+implementation shared with the eager and scan forms, so the pallas
+form cannot drift from the parity the tests pin.  The merge is a k-step
+lexicographic (distance, train-index) selection: ``lax.top_k`` + stable
+sort are unavailable inside Mosaic, but the XLA merge's result is
+exactly "the k smallest (d, i) pairs, ascending" (stability + tile
+order resolve ties to the lowest global train index), which the
+selection reproduces — bit-identical, pinned in interpret mode by
+tests/test_pallas_kernels.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# test rows / train rows per grid step: the in-flight distance tile is
+# (TM, TW) f32 (~512 KB) + the (TM, TW + k) merge candidates
+TEST_TILE = 256
+TRAIN_TILE = 512
+
+_INT_MAX = np.int32(np.iinfo(np.int32).max)
+
+
+def topk_scan(tn, toh, rn, roh, k: int, metric: str, n_cat: float,
+              denom: float, fscale: float, interpret: bool = True):
+    """(best_d (nt, k) f32, best_i (nt, k) i32), rows sorted
+    nearest-first, ties to the lowest train index — the exact contract
+    of the XLA scan kernel.  ``rn``/``roh`` are the FLAT train arrays
+    (this kernel owns its own tiling); ``toh``/``roh`` may arrive int8
+    (the narrow wire form) — the distance body upcasts on device."""
+    from ..distance import _dist_kernels
+    eu, ma = _dist_kernels(n_cat, denom, fscale)
+    dist = eu if metric == "euclidean" else ma
+    nt, n_train = tn.shape[0], rn.shape[0]
+    k = int(k)
+    # zero-width feature axes (all-categorical / all-numeric schemas)
+    # cannot block; one zero column contributes exactly +0.0 to every
+    # sum, so parity is preserved
+    if tn.shape[1] == 0:
+        tn = jnp.zeros((nt, 1), tn.dtype)
+        rn = jnp.zeros((n_train, 1), rn.dtype)
+    if toh.shape[1] == 0:
+        toh = jnp.zeros((nt, 1), toh.dtype)
+        roh = jnp.zeros((n_train, 1), roh.dtype)
+    tm, tw = TEST_TILE, TRAIN_TILE
+    pad_t = (-nt) % tm
+    pad_r = (-n_train) % tw
+    if pad_t:
+        tn = jnp.pad(tn, ((0, pad_t), (0, 0)))
+        toh = jnp.pad(toh, ((0, pad_t), (0, 0)))
+    if pad_r:
+        rn = jnp.pad(rn, ((0, pad_r), (0, 0)))
+        roh = jnp.pad(roh, ((0, pad_r), (0, 0)))
+    grid = (tn.shape[0] // tm, rn.shape[0] // tw)
+    Fn, Fc = tn.shape[1], toh.shape[1]
+
+    def kernel(tn_ref, toh_ref, rn_ref, roh_ref, od_ref, oi_ref, bd, bi):
+        j = pl.program_id(1)
+
+        @pl.when(j == 0)
+        def _init():
+            bd[...] = jnp.full_like(bd, jnp.inf)
+            bi[...] = jnp.full_like(bi, -1)
+
+        d = dist(tn_ref[...], toh_ref[...], rn_ref[...], roh_ref[...])
+        # pad train columns: +inf distance, so with k <= n_train they can
+        # never reach the final best list (same rule as the XLA scan)
+        col = j * tw + jax.lax.broadcasted_iota(jnp.int32, (1, tw), 1)
+        d = jnp.where(col < n_train, d, jnp.inf)
+        idx = jnp.broadcast_to(col, d.shape)
+        cand_d = jnp.concatenate([bd[...], d], axis=1)
+        cand_i = jnp.concatenate([bi[...], idx], axis=1)
+        # k-step (d, i)-lexicographic selection; (d, i) pairs are unique
+        # among finite candidates (each train row is visited once), so
+        # the remove-selected mask hits exactly one finite entry
+        nd, ni = [], []
+        for _ in range(k):
+            m = jnp.min(cand_d, axis=1)
+            sel = jnp.min(jnp.where(cand_d == m[:, None], cand_i,
+                                    _INT_MAX), axis=1)
+            nd.append(m)
+            ni.append(sel)
+            hit = (cand_d == m[:, None]) & (cand_i == sel[:, None])
+            cand_d = jnp.where(hit, jnp.inf, cand_d)
+        bd[...] = jnp.stack(nd, axis=1)
+        bi[...] = jnp.stack(ni, axis=1)
+
+        @pl.when(j == pl.num_programs(1) - 1)
+        def _emit():
+            od_ref[...] = bd[...]
+            oi_ref[...] = bi[...]
+
+    od, oi = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tm, Fn), lambda i, j: (i, 0)),
+            pl.BlockSpec((tm, Fc), lambda i, j: (i, 0)),
+            pl.BlockSpec((tw, Fn), lambda i, j: (j, 0)),
+            pl.BlockSpec((tw, Fc), lambda i, j: (j, 0)),
+        ],
+        out_specs=[pl.BlockSpec((tm, k), lambda i, j: (i, 0)),
+                   pl.BlockSpec((tm, k), lambda i, j: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((tn.shape[0], k), jnp.float32),
+                   jax.ShapeDtypeStruct((tn.shape[0], k), jnp.int32)],
+        scratch_shapes=[pltpu.VMEM((tm, k), jnp.float32),
+                        pltpu.VMEM((tm, k), jnp.int32)],
+        interpret=interpret,
+    )(tn, toh, rn, roh)
+    return od[:nt], oi[:nt]

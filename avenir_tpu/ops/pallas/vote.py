@@ -1,0 +1,90 @@
+"""Pallas ensemble-vote kernels: the serving hot loop, float and int8.
+
+One grid walk over row tiles; every member's predicate tensors sit in
+VMEM for the whole launch (they are KB-scale constants), each tile's
+(rows, T, P) match matrix and (rows, K) vote tally never leave VMEM.
+The float kernel's body IS ``models.forest._ensemble_vote_body`` —
+the pallas form relocates the intermediates, the vote math has exactly
+one implementation, so backend parity is structural (pinned by
+tests/test_pallas_kernels.py in interpret mode).
+
+The int8 kernel is the quantized serving twin (serving/quantized.py):
+identical vote structure over int8-binned values/thresholds — NOT
+bit-identical to the float path by design; its accuracy delta is
+budget-pinned at publish time instead.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+ROW_TILE = 256
+
+
+def _full_spec(shape):
+    n = len(shape)
+    return pl.BlockSpec(tuple(shape), lambda i, _n=n: (0,) * _n)
+
+
+def _tiled_vote(body, vals, codes, consts, min_odds, interpret: bool):
+    """Shared driver: pad rows to the tile, run ``body`` per tile with
+    the stacked member tensors resident, slice the pad back off.
+    ``min_odds`` rides as a (1, 1) input block (a pallas kernel cannot
+    close over traced values)."""
+    n = vals.shape[0]
+    if n == 0:
+        return jnp.zeros((0,), jnp.int32)
+    tm = min(ROW_TILE, max(8, ((n + 7) // 8) * 8))
+    pad = (-n) % tm
+    if pad:
+        # pad rows are a copy of the last row (any valid row works: per
+        # -row votes are independent and the pad slice is dropped)
+        vals = jnp.concatenate(
+            [vals, jnp.broadcast_to(vals[-1:], (pad,) + vals.shape[1:])])
+        codes = jnp.concatenate(
+            [codes, jnp.broadcast_to(codes[-1:], (pad,) + codes.shape[1:])])
+    grid = (vals.shape[0] // tm,)
+    mo = jnp.asarray(min_odds, jnp.float32).reshape(1, 1)
+
+    def kernel(v_ref, c_ref, *refs):
+        out_ref = refs[-1]
+        mo_ref = refs[-2]
+        cref = refs[:-2]
+        out_ref[...] = body(v_ref[...], c_ref[...],
+                            *[r[...] for r in cref],
+                            mo_ref[0, 0])[:, None]
+
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[pl.BlockSpec((tm, vals.shape[1]), lambda i: (i, 0)),
+                  pl.BlockSpec((tm, codes.shape[1]), lambda i: (i, 0))]
+        + [_full_spec(c.shape) for c in consts]
+        + [_full_spec((1, 1))],
+        out_specs=pl.BlockSpec((tm, 1), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((vals.shape[0], 1), jnp.int32),
+        interpret=interpret,
+    )(vals, codes, *consts, mo)
+    return out[:n, 0]
+
+
+def ensemble_vote(vals, codes, lo, hi, num_r, cat_m, cat_r, cls_oh, wvec,
+                  min_odds, interpret: bool = True):
+    """(n,) int32 vote indices — the pallas twin of
+    ``models.forest._ensemble_vote_body`` (same body, tiled)."""
+    from ...models.forest import _ensemble_vote_body
+    return _tiled_vote(_ensemble_vote_body, vals, codes,
+                       (lo, hi, num_r, cat_m, cat_r, cls_oh, wvec),
+                       min_odds, interpret)
+
+
+def quantized_vote(qvals, qcodes, q_lo, q_hi, num_r, cat_m, cat_r, cls_oh,
+                   wvec, min_odds, interpret: bool = True):
+    """(n,) int32 vote indices over int8-binned inputs — the pallas twin
+    of ``serving.quantized._quantized_vote_body`` (same body, tiled)."""
+    from ...serving.quantized import _quantized_vote_body
+    return _tiled_vote(_quantized_vote_body, qvals, qcodes,
+                       (q_lo, q_hi, num_r, cat_m, cat_r, cls_oh, wvec),
+                       min_odds, interpret)

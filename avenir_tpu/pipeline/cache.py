@@ -15,9 +15,12 @@ lowered XLA form and NOTHING else:
   * the **argument signature** — flattened (shape, dtype) of every
     carry, constant, and per-chunk input;
   * the **mesh fingerprint** — device count, platform, axis names, and
-    (sharded runs) the shard spec transport identity.
+    (sharded runs) the shard spec transport identity;
+  * the **kernel backend** — the resolved ``kernel.backend`` selection
+    (xla | pallas, TPU_NOTES §24): stage kernels may swap in pallas
+    twins at trace time, so a backend flip must miss.
 
-Changing any of the four MISSES (and compiles fresh); an identical
+Changing any of the five MISSES (and compiles fresh); an identical
 re-run HITS with zero retraces — pinned by tests/test_pipeline.py via
 the cache's own counters.
 

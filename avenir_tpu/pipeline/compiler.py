@@ -159,8 +159,16 @@ class ChunkPipeline:
         return jax.jit(fused, donate_argnums=(0,))
 
     def _key(self, inputs) -> Tuple:
+        # the backend axis (TPU_NOTES §24): stage kernels may branch on
+        # the resolved kernel backend at trace time (e.g. the baseline
+        # absorb's pallas twin), so the key must miss when the knob
+        # changes — resolved per call, not cached at construction, so a
+        # force_backend scope around a running pipeline is honored
+        from ..ops.pallas.dispatch import resolve_backend
         return ("chunk-pipeline", self.graph_fp, self.schema_fp,
                 self.mesh_fp,
+                resolve_backend(self.ctx.device_platform,
+                                self.ctx.n_devices),
                 _arg_signature(self._carries),
                 _arg_signature(self._consts),
                 _arg_signature(inputs))

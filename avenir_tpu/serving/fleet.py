@@ -89,7 +89,8 @@ class ServingFleet:
                  metrics=None,
                  latency_window: int = 8192,
                  idle_sleep_s: float = 0.002,
-                 max_idle_sleep_s: float = 0.05):
+                 max_idle_sleep_s: float = 0.05,
+                 quantized: bool = False):
         if predictor_factory is None and (registry is None
                                           or model_name is None):
             raise ValueError("need registry= + model_name=, or "
@@ -107,6 +108,7 @@ class ServingFleet:
         self._warm = warm
         self.delim = delim
         self._metrics = metrics
+        self._quantized = bool(quantized)
         self._latency_window = int(latency_window)
         self.idle_sleep_s = float(idle_sleep_s)
         self.max_idle_sleep_s = float(max_idle_sleep_s)
@@ -131,7 +133,8 @@ class ServingFleet:
         return PredictionService(registry=self.registry,
                                  model_name=self.model_name,
                                  schema=self._schema,
-                                 buckets=self._buckets, **common)
+                                 buckets=self._buckets,
+                                 quantized=self._quantized, **common)
 
     def start(self) -> "ServingFleet":
         if self.workers:

@@ -149,32 +149,70 @@ class ForestPredictor(Predictor):
 
     def __init__(self, path_lists, schema: FeatureSchema,
                  weights: Optional[Sequence[float]] = None,
-                 min_odds_ratio: float = 1.0, **kw):
+                 min_odds_ratio: float = 1.0, quantized=None, **kw):
         super().__init__(schema, **kw)
         from ..models.forest import EnsembleModel, _ensemble_vote_body
         from ..models.tree import DecisionTreeModel
+        from ..ops.pallas.dispatch import pallas_interpret, resolve_backend
         self.models = [DecisionTreeModel(pl, schema) for pl in path_lists]
         self.single = len(self.models) == 1
+        self.quantized = None
+        self._core_q = None
         if self.single:
+            if quantized is not None:
+                import warnings
+                warnings.warn(
+                    "ps.quantized: single-tree forests serve through the "
+                    "per-tree predict path; quantized sidecar ignored, "
+                    "serving the float model", RuntimeWarning)
             self.ensemble = None
             self._core = None
             return
         self.ensemble = EnsembleModel(self.models, weights=weights,
                                       min_odds_ratio=min_odds_ratio,
                                       require_odd=False)
+        self._vote_backend = resolve_backend()
         if self.ensemble._stacked is not None:
             *consts, wvec, _kernel = self.ensemble._stacked
             min_odds = jnp.float32(min_odds_ratio)
+            if self._vote_backend == "pallas":
+                import functools as _ft
+                from ..ops.pallas.vote import ensemble_vote
+                body = _ft.partial(ensemble_vote,
+                                   interpret=pallas_interpret())
+            else:
+                body = _ensemble_vote_body
 
             def core(vals, codes):
                 self._note_trace()
-                return _ensemble_vote_body(vals, codes, *consts, wvec,
-                                           min_odds)
+                return body(vals, codes, *consts, wvec, min_odds)
             self._core = jax.jit(core)
         else:
             # degenerate member / non-f32-exact bounds: the host vote path
             # is exact and compile-free, so bucketing is moot
             self._core = None
+        if quantized is not None:
+            # int8 serving (serving/quantized.py): valid only when it was
+            # quantized from THIS ensemble's stacked form and label order
+            if self._core is None:
+                import warnings
+                warnings.warn(
+                    "ps.quantized: ensemble has no stacked device form; "
+                    "serving the float host path", RuntimeWarning)
+            elif list(quantized.classes) != list(self.ensemble.classes):
+                import warnings
+                warnings.warn(
+                    "ps.quantized: sidecar class order does not match "
+                    "the loaded model; serving the float model",
+                    RuntimeWarning)
+            else:
+                self.quantized = quantized
+                vote = quantized.vote_fn()
+
+                def core_q(qv, qc):
+                    self._note_trace()
+                    return vote(qv, qc)
+                self._core_q = jax.jit(core_q)
 
     def dispatch_prepared(self, prepared):
         """The ASYNC half of predict_prepared: run the host prep and
@@ -186,9 +224,23 @@ class ForestPredictor(Predictor):
         single-tree/host-vote paths) compute synchronously here and ride
         along pre-resolved."""
         from ..models.tree import FeatureCache
-        from ..utils.tracing import note_dispatch
+        from ..utils.tracing import note_dispatch, note_h2d
+        from ..ops.pallas.dispatch import note_backend
         staged = []
         for table, n in prepared:
+            if self._core_q is not None:
+                # int8 quantized wire: ~4x fewer request bytes than the
+                # float path (f32/int16 vals + i32 codes); no f32-exact
+                # gate — binning subsumes it.  Budget enforced at publish.
+                cache = FeatureCache()
+                vals, codes = cache.host(self.models[0].matrix, table)
+                qv, qc = self.quantized.quantize_rows(vals, codes)
+                note_h2d(qv.nbytes + qc.nbytes, transfers=2)
+                note_dispatch(site="serve.predict")
+                note_backend("serve.predict", "quantized")
+                staged.append((True, self._core_q(jnp.asarray(qv),
+                                                  jnp.asarray(qc)), n))
+                continue
             if not self.single and self._core is not None:
                 # same device-path gate and label decode as the batch
                 # path — serving only substitutes the compile-counted
@@ -199,6 +251,7 @@ class ForestPredictor(Predictor):
                 dev = self.ensemble.device_inputs(table, cache)
                 if dev is not None:
                     note_dispatch(site="serve.predict")
+                    note_backend("serve.predict", self._vote_backend)
                     staged.append((True, self._core(*dev), n))
                     continue
                 staged.append(
@@ -322,21 +375,47 @@ class MLPPredictor(Predictor):
 def make_predictor(loaded: LoadedModel,
                    schema: Optional[FeatureSchema] = None,
                    buckets: Sequence[int] = DEFAULT_BUCKETS,
-                   delim: str = ",", **kw) -> Predictor:
+                   delim: str = ",", quantized: bool = False,
+                   **kw) -> Predictor:
     """Registry artifact -> the right Predictor (kind-dispatched), using
-    the artifact's embedded schema unless one is passed explicitly."""
+    the artifact's embedded schema unless one is passed explicitly.
+
+    ``quantized=True`` (forest only — the ``ps.quantized`` knob) loads
+    the version's int8 sidecar (serving/quantized.py) and serves the
+    budget-pinned quantized vote; a version without an intact sidecar
+    warns and serves the float model — never refuses traffic."""
     schema = schema or loaded.schema
     if schema is None:
         raise ValueError(
             f"model {loaded.name!r} v{loaded.version} has no embedded "
             "schema; pass schema= to make_predictor")
     common = dict(buckets=buckets, delim=delim)
+    if quantized and loaded.kind != FOREST:
+        import warnings
+        warnings.warn(
+            f"ps.quantized: only forest artifacts have a quantized "
+            f"serving path (got kind {loaded.kind!r}); serving the "
+            f"float model", RuntimeWarning)
     if loaded.kind == FOREST:
         p = loaded.params
+        qf = None
+        if quantized:
+            import warnings
+            if loaded.base_dir is None:
+                warnings.warn(
+                    "ps.quantized: model was not loaded from a registry "
+                    "(no sidecar source); serving the float model",
+                    RuntimeWarning)
+            else:
+                from .quantized import load_quantized
+                from .registry import ModelRegistry
+                qf = load_quantized(ModelRegistry(loaded.base_dir),
+                                    loaded.name, loaded.version)
         return ForestPredictor(
             loaded.model, schema,
             weights=p.get("weights"),
             min_odds_ratio=float(p.get("min_odds_ratio", 1.0)),
+            quantized=qf,
             **common, **kw)
     if loaded.kind == BAYES:
         return BayesPredictor(loaded.model, schema, **common, **kw)

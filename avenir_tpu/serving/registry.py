@@ -59,6 +59,8 @@ class LoadedModel:
     model: Any                       # kind-specific (see _decode)
     meta: Dict[str, Any]
     schema: Optional[FeatureSchema]  # from the artifact, when saved with one
+    base_dir: Optional[str] = None   # registry root this was loaded from
+    # (sidecar access for the serving layer, e.g. the quantized forest)
 
     @property
     def params(self) -> Dict[str, Any]:
@@ -356,7 +358,8 @@ class ModelRegistry:
         kind = meta["kind"]
         model = _decode(kind, arrays, meta, schema)
         return LoadedModel(name=name, version=version, kind=kind,
-                           model=model, meta=meta, schema=schema)
+                           model=model, meta=meta, schema=schema,
+                           base_dir=self.base_dir)
 
 
 # --------------------------------------------------------------------------

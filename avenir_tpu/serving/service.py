@@ -147,13 +147,18 @@ class PredictionService:
                  busy_label: str = "busy",
                  name: Optional[str] = None,
                  monitor=None,
-                 metrics=None):
+                 metrics=None,
+                 quantized: bool = False):
         if predictor is None and (registry is None or model_name is None):
             raise ValueError("need a predictor, or registry= + model_name=")
         self.registry = registry
         self.model_name = model_name
         self._schema = schema
         self._buckets = tuple(buckets)
+        # ps.quantized: registry loads (initial + hot-swap refresh) build
+        # the int8 predictor from the version's sidecar; a version
+        # without one warns and serves float (serving/quantized.py)
+        self._quantized = bool(quantized)
         self.policy = policy or BatchPolicy()
         self.counters = counters if counters is not None else Counters()
         self.timer = timer if timer is not None else \
@@ -214,7 +219,8 @@ class PredictionService:
             return None
         loaded = self.registry.load(self.model_name, latest)
         pred = make_predictor(loaded, schema=self._schema,
-                              buckets=self._buckets, delim=self.delim)
+                              buckets=self._buckets, delim=self.delim,
+                              quantized=self._quantized)
         if self._warm:
             pred.warm()
         self.version = latest
@@ -234,7 +240,8 @@ class PredictionService:
             return False
         loaded = self.registry.load(self.model_name, latest)
         pred = make_predictor(loaded, schema=self._schema,
-                              buckets=self._buckets, delim=self.delim)
+                              buckets=self._buckets, delim=self.delim,
+                              quantized=self._quantized)
         if self._warm:
             pred.warm()
         with self._swap_lock:

@@ -70,8 +70,8 @@ class TransferLedger:
     """
 
     __slots__ = ("h2d_bytes", "d2h_bytes", "h2d_transfers", "d2h_transfers",
-                 "dispatches", "dispatch_sites", "allreduces",
-                 "allreduce_bytes", "_lock")
+                 "dispatches", "dispatch_sites", "kernel_backends",
+                 "allreduces", "allreduce_bytes", "_lock")
 
     def __init__(self):
         self.h2d_bytes = 0
@@ -83,6 +83,12 @@ class TransferLedger:
         # delta of a pipeline rewire is visible per call site in every
         # job's counters.json, not only in a bench run
         self.dispatch_sites: Dict[str, int] = defaultdict(int)
+        # which kernel form actually RAN per hot site ("<site>.<backend>"
+        # -> launches, backend in {xla, pallas, quantized}): the pallas
+        # dispatch layer records the executed form, so a silent fallback
+        # shows up as the wrong key (benches assert on it, tracetool
+        # summarize prints it as the per-site backend column)
+        self.kernel_backends: Dict[str, int] = defaultdict(int)
         self.allreduces = 0
         self.allreduce_bytes = 0
         self._lock = threading.Lock()
@@ -102,6 +108,15 @@ class TransferLedger:
             self.dispatches += int(n)
             if site:
                 self.dispatch_sites[site] += int(n)
+
+    def record_kernel_backend(self, site: str, backend: str,
+                              n: int = 1) -> None:
+        """Tag ``n`` launches at ``site`` as executed by ``backend``
+        (xla | pallas | quantized).  Companion to :meth:`record_dispatch`
+        — it never moves the dispatch totals, only the per-site backend
+        breakdown, so dispatch-count pins stay backend-agnostic."""
+        with self._lock:
+            self.kernel_backends[f"{site}.{backend}"] += int(n)
 
     def record_allreduce(self, nbytes: int, n: int = 1) -> None:
         """One cross-process collective of ``nbytes`` payload (this
@@ -132,6 +147,13 @@ class TransferLedger:
         with self._lock:
             return dict(self.dispatch_sites)
 
+    def backend_snapshot(self) -> Dict[str, int]:
+        """Per-site executed-backend launch counts (copy), keys
+        ``<site>.<backend>`` — what the bench roofline blocks assert on
+        (no silent XLA fallback flattering a pallas number)."""
+        with self._lock:
+            return dict(self.kernel_backends)
+
     def export(self, counters, group: str = "Transfers") -> None:
         """Into the job Counters channel, Hadoop-dump style.  Byte tallies
         are per-process host-side work, so exporting BEFORE a multi-process
@@ -154,6 +176,10 @@ class TransferLedger:
             counters.update_group("Dispatches",
                                   {k: v for k, v in
                                    sorted(self.dispatch_sites.items())})
+        if self.kernel_backends:
+            counters.update_group("KernelBackends",
+                                  {k: v for k, v in
+                                   sorted(self.kernel_backends.items())})
 
 
 # global (NOT thread-local: staging threads record into their spawner's
@@ -194,6 +220,12 @@ def note_dispatch(n: int = 1, site: Optional[str] = None) -> None:
     if _ledgers:
         for led in list(_ledgers):
             led.record_dispatch(n, site=site)
+
+
+def note_kernel_backend(site: str, backend: str, n: int = 1) -> None:
+    if _ledgers:
+        for led in list(_ledgers):
+            led.record_kernel_backend(site, backend, n)
 
 
 def note_allreduce(nbytes: int, n: int = 1) -> None:

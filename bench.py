@@ -945,6 +945,129 @@ def rf_predict_rate(n):
                 measured=led.snapshot())}
 
 
+def _assert_backend(led, site, backend):
+    """ISSUE 11: the intended kernel backend must be the ONLY form the
+    ledger recorded at the hot site — a silent XLA fallback would
+    flatter a pallas number with an XLA measurement.  Returns the launch
+    count."""
+    snap = led.backend_snapshot()
+    ran = {k: v for k, v in snap.items() if k.startswith(site + ".")}
+    want = f"{site}.{backend}"
+    if want not in ran or any(k != want for k in ran):
+        raise AssertionError(
+            f"{site}: intended backend {backend!r} did not (exclusively) "
+            f"run — ledger saw {ran or snap}")
+    return ran[want]
+
+
+def pallas_kernels_rate(n):
+    """Per-kernel roofline blocks for the three pallas hot loops
+    (TPU_NOTES §24): forest level histogram, KNN distance+top-k, and the
+    ensemble vote, each measured under BOTH backends with the executed
+    form asserted from the ledger's KernelBackends breakdown and the
+    results asserted identical (models byte-equal, top-k/vote arrays
+    equal).  Off-TPU the pallas form runs in interpret mode — a parity
+    and plumbing proof, not a speed claim; the xla-vs-pallas wall times
+    and per-site launch counts are recorded either way."""
+    from avenir_tpu.models.forest import (EnsembleModel, ForestParams,
+                                          build_forest)
+    from avenir_tpu.models.tree import DecisionTreeModel
+    from avenir_tpu.ops.distance import DistanceComputer
+    from avenir_tpu.ops.pallas.dispatch import force_backend
+    from avenir_tpu.core.schema import FeatureSchema
+    from avenir_tpu.parallel.mesh import MeshContext
+    ctx = MeshContext()
+    table = _bench_table(n)
+    out = {"metric": "pallas_forest_level_rows_x_trees_per_sec",
+           "unit": "rows*trees/sec", "n": n}
+
+    # ---- (1) forest level histogram ----
+    params = ForestParams(num_trees=8, seed=1)
+    params.tree.max_depth = 4
+    fb = {}
+    jsons = {}
+    for backend in ("xla", "pallas"):
+        with force_backend(backend):
+            build_forest(table, params, ctx)  # compile + warm this form
+            with _ledger() as led:
+                t0 = time.perf_counter()
+                models = build_forest(table, params, ctx)
+                dt = time.perf_counter() - t0
+            launches = _assert_backend(led, "forest.level", backend)
+            T = len(models)
+            flops, hbm, _, _ = _rf_shape_terms(n, T, F=4, S=19)
+            fb[backend] = {
+                "rows_x_trees_per_sec": round(n * T / dt, 1),
+                "site_launches": launches,
+                "roofline": roofline(dt, flops=flops, hbm_bytes=hbm,
+                                     measured=led.snapshot())}
+            jsons[backend] = [m.to_json() for m in models]
+    assert jsons["xla"] == jsons["pallas"], \
+        "pallas forest level kernel diverged from the XLA twin"
+    out["value"] = fb["pallas"]["rows_x_trees_per_sec"]
+    out["forest_level"] = dict(fb, models_bit_identical=True)
+
+    # ---- (2) KNN distance + top-k ----
+    n_test = max(n // 25, 512)
+    train = _bench_table(10 * n_test, seed=3)
+    test = _bench_table(n_test, seed=4)
+    schema = FeatureSchema.from_dict(_BENCH_SCHEMA)
+    k = 10
+    kb = {}
+    res = {}
+    for backend in ("xla", "pallas"):
+        with force_backend(backend):
+            comp = DistanceComputer(schema, scale=1000)
+            comp.pairwise_topk(test, train, k)  # warm + train cache
+            with _ledger() as led:
+                t0 = time.perf_counter()
+                res[backend] = comp.pairwise_topk(test, train, k)
+                dt = time.perf_counter() - t0
+            launches = _assert_backend(led, "knn.topk", backend)
+            pairs = float(n_test) * 10 * n_test
+            kb[backend] = {
+                "test_rows_per_sec": round(n_test / dt, 1),
+                "site_launches": launches,
+                "roofline": roofline(dt, flops=pairs * (2 * 6.0 + 8),
+                                     hbm_bytes=3 * pairs * 4,
+                                     measured=led.snapshot())}
+    assert np.array_equal(res["xla"][0], res["pallas"][0]) and \
+        np.array_equal(res["xla"][1], res["pallas"][1]), \
+        "pallas KNN top-k diverged from the XLA scan"
+    out["knn_topk"] = dict(kb, topk_bit_identical=True, n_test=n_test,
+                           n_train=10 * n_test, k=k)
+
+    # ---- (3) ensemble vote ----
+    vote_n = min(n, 100_000)
+    vtable = _bench_table(vote_n, seed=5)
+    params9 = ForestParams(num_trees=9, seed=1)
+    params9.tree.max_depth = 4
+    base_models = [DecisionTreeModel(m, table.schema)
+                   for m in build_forest(table, params9, ctx)]
+    vb = {}
+    preds = {}
+    for backend in ("xla", "pallas"):
+        with force_backend(backend):
+            ens = EnsembleModel(base_models)
+            ens.predict(vtable)  # compile + warm
+            with _ledger() as led:
+                t0 = time.perf_counter()
+                preds[backend] = ens.predict(vtable)
+                dt = time.perf_counter() - t0
+            launches = _assert_backend(led, "ensemble.vote", backend)
+            T = len(base_models)
+            vb[backend] = {
+                "rows_x_trees_per_sec": round(vote_n * T / dt, 1),
+                "site_launches": launches,
+                "roofline": roofline(dt, flops=float(vote_n) * T * 16 * 4 * 2,
+                                     hbm_bytes=float(vote_n) * (4 * 4 + T),
+                                     measured=led.snapshot())}
+    assert preds["xla"] == preds["pallas"], \
+        "pallas ensemble vote diverged from the XLA kernel"
+    out["ensemble_vote"] = dict(vb, votes_identical=True, n=vote_n)
+    return out
+
+
 def nb_predict_rate(n):
     """NaiveBayes predict: full production path (uint8 code upload, packed
     cached model tables, eager pct readback only) over n churn-style rows."""
@@ -1142,6 +1265,10 @@ WORKLOADS = {
     "knn": (knn_rate, [8_000, 4_000]),
     "knn_big": (knn_big_rate, [20_000]),
     "rf_predict": (rf_predict_rate, [1_000_000, 200_000]),
+    # ISSUE 11: the three pallas hot-loop kernels, xla vs pallas forms,
+    # backend asserted from the ledger + bit-identity asserted (modest
+    # sizes: off-TPU the pallas form runs interpreted)
+    "pallas_kernels": (pallas_kernels_rate, [50_000, 10_000]),
     "nb_predict": (nb_predict_rate, [500_000, 100_000]),
     "sa": (sa_rate, [4_096, 512]),
     "ga": (ga_rate, [256, 32]),

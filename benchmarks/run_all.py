@@ -472,6 +472,59 @@ def bench_serve_forest(scale):
         "\n".join(",".join(r) for r in rows[:min(n_train, 4000)]), schema)
     fleet_models = build_forest(fleet_table, fleet_params, MeshContext())
     fleet = _fleet_sweep(fleet_models, schema, req_rows, scale)
+    # the int8 quantized serving path (ISSUE 11): publish the forest +
+    # budget-pinned quantized sidecar into a scratch registry, replay the
+    # same requests through the float and int8 predictors, and read the
+    # per-request H2D bytes off the measured TransferLedger — the ~4x
+    # wire-reduction acceptance number, with the executed backend
+    # ASSERTED from the KernelBackends breakdown (a silent float
+    # fallback must fail the block, not flatter it)
+    import shutil
+    import tempfile
+    from avenir_tpu.serving.predictor import make_predictor
+    from avenir_tpu.serving.quantized import publish_quantized
+    from avenir_tpu.serving.registry import ModelRegistry
+    from avenir_tpu.utils.tracing import transfer_ledger
+    qdir = tempfile.mkdtemp(prefix="avenir_bench_qreg_")
+    try:
+        reg = ModelRegistry(qdir)
+        v = reg.publish("bench-forest", models, schema=schema)
+        info = publish_quantized(reg, "bench-forest", v, models, schema,
+                                 table)
+        loaded = reg.load("bench-forest")
+        q_req = req_rows[:2048]
+        pf = make_predictor(loaded).warm()
+        pq = make_predictor(loaded, quantized=True).warm()
+        t0 = time.perf_counter()
+        with transfer_ledger() as led_f:
+            res_f = pf.predict_rows(q_req)
+        t_float = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        with transfer_ledger() as led_q:
+            res_q = pq.predict_rows(q_req)
+        t_quant = time.perf_counter() - t0
+        kb = led_q.backend_snapshot()
+        assert kb.get("serve.predict.quantized", 0) > 0 and not any(
+            k.startswith("serve.predict.") and k !=
+            "serve.predict.quantized" for k in kb), \
+            f"quantized serving fell back silently: {kb}"
+        f_b = led_f.snapshot()["h2d_bytes"]
+        q_b = led_q.snapshot()["h2d_bytes"]
+        quantized = {
+            "publish_mismatch": info["mismatch"],
+            "budget": info["budget"],
+            "serve_mismatch": round(
+                sum(a != b for a, b in zip(res_f, res_q)) / len(res_f), 5),
+            "n_requests": len(q_req),
+            "float_h2d_bytes": f_b,
+            "quantized_h2d_bytes": q_b,
+            "h2d_reduction_x": round(f_b / max(q_b, 1), 2),
+            "reduction_at_least_4x": f_b >= 4 * q_b,
+            "float_rows_per_sec": round(len(q_req) / t_float, 1),
+            "quantized_rows_per_sec": round(len(q_req) / t_quant, 1),
+        }
+    finally:
+        shutil.rmtree(qdir, ignore_errors=True)
     return {"metric": "serve_forest_peak_req_per_sec",
             "value": loads[0]["throughput_req_per_sec"],
             "n_requests": n_req, "trees": len(models), "loads": loads,
@@ -481,6 +534,7 @@ def bench_serve_forest(scale):
                 "p99_gauge": 'quantile="p99"' in scrape,
                 "healthz_ok_then_degraded_503":
                     healthz_ok and degraded_503},
+            "quantized": quantized,
             "fleet_sweep": fleet}
 
 

@@ -1,0 +1,567 @@
+"""Pallas kernel parity + int8 quantized serving (ISSUE 11, TPU_NOTES §24).
+
+The three hot-loop pallas kernels run here in INTERPRET mode on CPU and
+must be bit-identical to their XLA twins — remainder tiles, empty
+inputs, degenerate single-class/single-bin shapes, and the exact
+(T, N, S, B, C) level shapes a depth-1..3 forest build produces.  The
+scatter-add rewrite of the composed histogram kernels pins against the
+preserved one-hot oracle.  The quantized serving path pins its publish
+budget contract, torn-sidecar fallback, and the int8 wire reduction.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core.schema import FeatureSchema
+from avenir_tpu.core.table import ColumnarTable
+from avenir_tpu.ops.pallas.dispatch import (force_backend, kernel_backend,
+                                            resolve_backend,
+                                            set_kernel_backend)
+from avenir_tpu.utils.tracing import transfer_ledger
+
+pytestmark = pytest.mark.kernels
+
+_SCHEMA = {
+    "fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "c1", "ordinal": 1, "dataType": "categorical",
+         "feature": True, "maxSplit": 2, "cardinality": ["a", "b", "c"]},
+        {"name": "n1", "ordinal": 2, "dataType": "int", "feature": True,
+         "min": 0, "max": 600, "splitScanInterval": 120},
+        {"name": "n2", "ordinal": 3, "dataType": "int", "feature": True,
+         "min": 0, "max": 100, "splitScanInterval": 25},
+        {"name": "cls", "ordinal": 4, "dataType": "categorical",
+         "cardinality": ["T", "F"]},
+    ]
+}
+
+
+def _table(n, seed=1):
+    schema = FeatureSchema.from_dict(_SCHEMA)
+    rng = np.random.default_rng(seed)
+    n1 = rng.integers(0, 600, n)
+    c1 = rng.integers(0, 3, n)
+    label = ((n1 > 300) ^ (c1 == 2)) | (rng.random(n) < 0.05)
+    return ColumnarTable(schema=schema, n_rows=n, columns={
+        1: c1.astype(np.int32),
+        2: n1.astype(np.float64),
+        3: rng.integers(0, 100, n).astype(np.float64),
+        4: np.where(label, 0, 1).astype(np.int32),
+    })
+
+
+def _rows(table):
+    """Tokenized request rows matching ``_table``'s schema layout."""
+    c1_lut = np.asarray(["a", "b", "c"])
+    cls_lut = np.asarray(["T", "F"])
+    return [[str(i), c1_lut[table.columns[1][i]],
+             str(int(table.columns[2][i])), str(int(table.columns[3][i])),
+             cls_lut[table.columns[4][i]]]
+            for i in range(table.n_rows)]
+
+
+# --------------------------------------------------------------------------
+# dispatch knob
+# --------------------------------------------------------------------------
+
+def test_backend_knob_resolution(monkeypatch):
+    monkeypatch.delenv("AVENIR_TPU_KERNEL_BACKEND", raising=False)
+    assert kernel_backend() == "auto"
+    assert resolve_backend("cpu") == "xla"            # auto off-TPU -> xla
+    assert resolve_backend("tpu", 1) == "pallas"      # auto 1-chip TPU
+    # auto on a multi-chip mesh stays XLA: the kernels don't shard_map
+    # yet, GSPMD would gather the row axis around every pallas call
+    assert resolve_backend("tpu", 8) == "xla"
+    monkeypatch.setenv("AVENIR_TPU_KERNEL_BACKEND", "pallas")
+    assert kernel_backend() == "pallas"
+    assert resolve_backend("cpu") == "pallas"         # env twin forces
+    set_kernel_backend("xla")                         # process beats env
+    try:
+        assert resolve_backend("tpu", 1) == "xla"
+    finally:
+        set_kernel_backend(None)
+    with pytest.raises(ValueError, match="unknown kernel backend"):
+        set_kernel_backend("mosaic")
+    monkeypatch.setenv("AVENIR_TPU_KERNEL_BACKEND", "junk")
+    with pytest.raises(ValueError, match="unknown kernel backend"):
+        kernel_backend()
+
+
+def test_force_backend_scopes_nest():
+    assert resolve_backend("cpu") == "xla"
+    with force_backend("pallas"):
+        assert resolve_backend("cpu") == "pallas"
+        with force_backend("xla"):
+            assert resolve_backend("tpu") == "xla"
+        assert resolve_backend("cpu") == "pallas"
+    assert resolve_backend("cpu") == "xla"
+
+
+# --------------------------------------------------------------------------
+# scatter-add histogram rewrite vs the one-hot oracle
+# --------------------------------------------------------------------------
+
+def test_scatter_histograms_match_onehot_oracle(rng):
+    from avenir_tpu.ops.histogram import (_class_bin_histogram_onehot,
+                                          class_bin_histogram,
+                                          feature_bin_counts,
+                                          joint_histogram)
+    n, F, B, C = 4000, 5, 9, 3
+    cls = rng.integers(-1, C + 2, n).astype(np.int32)   # incl. oob codes
+    bins = rng.integers(-2, B + 2, (n, F)).astype(np.int32)
+    mask = rng.random(n) < 0.8
+    for m in (None, mask):
+        got = np.asarray(class_bin_histogram(cls, bins, C, B, m))
+        ref = np.asarray(_class_bin_histogram_onehot(cls, bins, C, B, m))
+        np.testing.assert_array_equal(got, ref)
+    # joint histogram vs its one-hot formulation
+    a = rng.integers(-1, 6, n).astype(np.int32)
+    b = rng.integers(-1, 8, n).astype(np.int32)
+    import jax
+    valid = ((a >= 0) & (b >= 0) & mask).astype(np.float32)
+    oh_a = np.asarray(jax.nn.one_hot(a, 5)) * valid[:, None]
+    oh_b = np.asarray(jax.nn.one_hot(b, 7))
+    np.testing.assert_array_equal(
+        np.asarray(joint_histogram(a, b, 5, 7, mask)), oh_a.T @ oh_b)
+    # degenerate shapes
+    assert np.asarray(class_bin_histogram(cls[:0], bins[:0], C, B)
+                      ).shape == (C, F, B)
+    assert np.asarray(feature_bin_counts(bins[:, :0], B)).shape == (0, B)
+
+
+# --------------------------------------------------------------------------
+# pallas forest level histogram
+# --------------------------------------------------------------------------
+
+def _level_args(rng, n, T, N, S, B, C, wmax=4):
+    nid = rng.integers(-1, N, (n, T)).astype(np.int32)
+    br = rng.integers(0, B, (n, S)).astype(np.int32)
+    cls = rng.integers(0, C, (n,)).astype(np.int32)
+    w = rng.integers(0, wmax, (n, T)).astype(np.float32)
+    return nid, br, cls, w
+
+
+@pytest.mark.parametrize("shape", [
+    (1000, 3, 4, 5, 3, 2),     # remainder tile (1000 % 8-aligned tiles)
+    (64, 1, 1, 1, 1, 1),       # fully degenerate: 1 tree/node/split/bin/class
+    (17, 2, 3, 19, 3, 2),      # tiny n below one tile
+    (3000, 16, 8, 19, 3, 2),   # the bench forest's level shape
+])
+def test_forest_level_counts_pallas_parity(rng, shape):
+    import jax
+    from avenir_tpu.models.forest import _count_body
+    from avenir_tpu.ops.pallas.histogram import forest_level_counts
+    n, T, N, S, B, C = shape
+    nid, br, cls, w = _level_args(rng, n, T, N, S, B, C)
+    ref = np.asarray(jax.jit(_count_body, static_argnums=(4, 5, 6))(
+        nid, br, cls, w, N, B, C))
+    got = np.asarray(forest_level_counts(nid, br, cls, w, N, B, C,
+                                         interpret=True))
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_forest_level_counts_empty():
+    from avenir_tpu.ops.pallas.histogram import forest_level_counts
+    out = np.asarray(forest_level_counts(
+        np.zeros((0, 2), np.int32), np.zeros((0, 3), np.int32),
+        np.zeros((0,), np.int32), np.zeros((0, 2), np.float32),
+        4, 3, 2, interpret=True))
+    assert out.shape == (2, 4, 3, 3, 2) and out.sum() == 0
+
+
+@pytest.mark.parametrize("depth", [1, 2, 3])
+def test_forest_build_bit_identical_across_backends(depth):
+    """Whole depth-1..3 builds under the forced pallas backend produce
+    byte-identical models — the exact (T, N, S, B, C) shapes the level
+    kernel sees at those depths, root histogram included — and the
+    ledger names the executed backend at every forest.level launch."""
+    from avenir_tpu.models.forest import ForestParams, build_forest
+    from avenir_tpu.parallel.mesh import MeshContext
+    table = _table(1500)
+    params = ForestParams(num_trees=5, seed=depth)
+    params.tree.max_depth = depth
+    ctx = MeshContext()
+    with transfer_ledger() as led_x:
+        ref = [m.to_json() for m in build_forest(table, params, ctx)]
+    assert set(led_x.backend_snapshot()) == {"forest.level.xla"}
+    with force_backend("pallas"):
+        with transfer_ledger() as led_p:
+            got = [m.to_json() for m in build_forest(table, params, ctx)]
+    assert got == ref
+    snap = led_p.backend_snapshot()
+    assert set(snap) == {"forest.level.pallas"}
+    # root histogram + one fused launch per deeper level
+    assert snap["forest.level.pallas"] == depth
+
+
+# --------------------------------------------------------------------------
+# pallas bin counts (baseline absorb)
+# --------------------------------------------------------------------------
+
+def test_bin_counts_pallas_parity(rng):
+    from avenir_tpu.ops.histogram import feature_bin_counts
+    from avenir_tpu.ops.pallas.histogram import bin_counts
+    n, R, B = 3000, 6, 33
+    codes = rng.integers(-2, B + 2, (n, R)).astype(np.int32)
+    mask = rng.random(n) < 0.7
+    for m in (None, mask):
+        ref = np.asarray(feature_bin_counts(codes, B, m))
+        got = np.asarray(bin_counts(codes, B, m, interpret=True))
+        np.testing.assert_array_equal(got, ref)
+    assert np.asarray(bin_counts(codes[:0], B, interpret=True)
+                      ).shape == (R, B)
+
+
+def test_baseline_absorb_backend_parity():
+    from avenir_tpu.monitor.baseline import compute_baseline
+    table = _table(2000)
+    ref = compute_baseline(table)
+    with force_backend("pallas"):
+        with transfer_ledger() as led:
+            got = compute_baseline(table)
+    np.testing.assert_array_equal(got.counts, ref.counts)
+    assert led.backend_snapshot() == {"baseline.absorb.pallas": 1}
+
+
+# --------------------------------------------------------------------------
+# pallas KNN distance + top-k
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n_test,n_train,k,chunk", [
+    (300, 700, 7, 128),      # remainder in both tile axes, multi-chunk
+    (17, 5, 9, 64),          # k > n_train (k_loc clamps), tiny train
+    (513, 2100, 10, 512),    # train tile remainder across scan steps
+])
+def test_pairwise_topk_pallas_parity(n_test, n_train, k, chunk):
+    from avenir_tpu.ops.distance import DistanceComputer
+    schema = FeatureSchema.from_dict(_SCHEMA)
+    # duplicated train rows: identical distances force the tie-break to
+    # the lowest global train index, the stable-sort contract
+    train = _table(n_train, seed=3)
+    for o in (1, 2, 3, 4):
+        col = np.asarray(train.columns[o]).copy()
+        col[n_train // 2:] = col[:n_train - n_train // 2]
+        train.columns[o] = col
+    test = _table(n_test, seed=4)
+    comp_x = DistanceComputer(schema, scale=1000)
+    d_ref, i_ref = comp_x.pairwise_topk(test, train, k, test_chunk=chunk)
+    comp_p = DistanceComputer(schema, scale=1000)
+    with force_backend("pallas"):
+        with transfer_ledger() as led:
+            d_got, i_got = comp_p.pairwise_topk(test, train, k,
+                                                test_chunk=chunk)
+    np.testing.assert_array_equal(d_got, d_ref)
+    np.testing.assert_array_equal(i_got, i_ref)
+    assert set(led.backend_snapshot()) == {"knn.topk.pallas"}
+
+
+def test_pairwise_topk_pallas_empty_test():
+    from avenir_tpu.ops.distance import DistanceComputer
+    schema = FeatureSchema.from_dict(_SCHEMA)
+    with force_backend("pallas"):
+        d, i = DistanceComputer(schema).pairwise_topk(
+            _table(0), _table(50, seed=3), 5)
+    assert d.shape == (0, 5) and i.shape == (0, 5)
+
+
+# --------------------------------------------------------------------------
+# pallas ensemble vote
+# --------------------------------------------------------------------------
+
+def _forest_models(table, trees=5, depth=3):
+    from avenir_tpu.models.forest import ForestParams, build_forest
+    from avenir_tpu.models.tree import DecisionTreeModel
+    from avenir_tpu.parallel.mesh import MeshContext
+    params = ForestParams(num_trees=trees, seed=1)
+    params.tree.max_depth = depth
+    return [DecisionTreeModel(m, table.schema)
+            for m in build_forest(table, params, MeshContext())]
+
+
+def test_ensemble_vote_pallas_parity():
+    from avenir_tpu.models.forest import EnsembleModel
+    table = _table(1000)
+    models = _forest_models(table)
+    req = _table(777, seed=9)            # remainder vs the 256-row tile
+    ens_x = EnsembleModel(models, min_odds_ratio=1.2)
+    ref = ens_x.predict(req)
+    with force_backend("pallas"):
+        ens_p = EnsembleModel(models, min_odds_ratio=1.2)
+        with transfer_ledger() as led:
+            got = ens_p.predict(req)
+    assert got == ref
+    assert ens_p._vote_backend == "pallas"
+    assert set(led.backend_snapshot()) == {"ensemble.vote.pallas"}
+
+
+def test_forest_predictor_pallas_parity():
+    from avenir_tpu.models.forest import ForestParams, build_forest
+    from avenir_tpu.parallel.mesh import MeshContext
+    from avenir_tpu.serving.predictor import ForestPredictor
+    table = _table(1000)
+    params = ForestParams(num_trees=5, seed=1)
+    params.tree.max_depth = 3
+    path_lists = build_forest(table, params, MeshContext())
+    req = _rows(_table(100, seed=9))
+    ref = ForestPredictor(path_lists, table.schema).warm().predict_rows(req)
+    with force_backend("pallas"):
+        p = ForestPredictor(path_lists, table.schema).warm()
+        with transfer_ledger() as led:
+            got = p.predict_rows(req)
+    assert got == ref
+    assert "serve.predict.pallas" in led.backend_snapshot()
+
+
+# --------------------------------------------------------------------------
+# ProgramCache backend axis
+# --------------------------------------------------------------------------
+
+def test_program_cache_key_grows_backend_axis():
+    from avenir_tpu.pipeline.compiler import ChunkPipeline, Stage
+
+    def kernel(carry, consts, inputs, upstream):
+        return carry, {}
+
+    pipe = ChunkPipeline([Stage(name="s", kernel=kernel)], schema_fp="x")
+    inputs = {"a": np.zeros((4, 2), np.float32)}
+    k_xla = pipe._key(inputs)
+    with force_backend("pallas"):
+        k_pal = pipe._key(inputs)
+    assert k_xla != k_pal
+    assert "xla" in k_xla and "pallas" in k_pal
+    with force_backend("xla"):
+        assert pipe._key(inputs) == k_xla
+
+
+# --------------------------------------------------------------------------
+# ledger export + tracetool backend column
+# --------------------------------------------------------------------------
+
+def test_kernel_backend_counters_and_tracetool(tmp_path):
+    from avenir_tpu.core.metrics import Counters
+    from avenir_tpu.utils.tracing import TransferLedger
+    led = TransferLedger()
+    led.record_dispatch(3, site="forest.level")
+    led.record_kernel_backend("forest.level", "pallas", 3)
+    led.record_kernel_backend("serve.predict", "quantized")
+    c = Counters()
+    led.export(c)
+    dump = c.as_dict()
+    assert dump["KernelBackends"] == {"forest.level.pallas": 3,
+                                      "serve.predict.quantized": 1}
+    cpath = tmp_path / "out.counters.json"
+    cpath.write_text(json.dumps(dump))
+    trace = tmp_path / "t.jsonl"
+    trace.write_text("")        # empty trace: table must still print
+    proc = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(__file__), "..",
+                                      "tools", "tracetool.py"),
+         "summarize", str(trace), "--counters", str(cpath)],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr
+    assert "forest.level" in proc.stdout
+    assert "pallas(3)" in proc.stdout
+    assert "quantized(1)" in proc.stdout
+
+
+# --------------------------------------------------------------------------
+# int8 quantized serving
+# --------------------------------------------------------------------------
+
+@pytest.fixture()
+def published(tmp_path):
+    from avenir_tpu.models.forest import ForestParams, build_forest
+    from avenir_tpu.parallel.mesh import MeshContext
+    from avenir_tpu.serving.registry import ModelRegistry
+    table = _table(3000)
+    params = ForestParams(num_trees=5, seed=1)
+    params.tree.max_depth = 3
+    models = build_forest(table, params, MeshContext())
+    reg = ModelRegistry(str(tmp_path))
+    v = reg.publish("f", models, schema=table.schema)
+    return reg, models, table, v
+
+
+@pytest.mark.serving
+def test_quantized_publish_roundtrip_and_budget(published):
+    from avenir_tpu.serving.quantized import (load_quantized,
+                                              publish_quantized)
+    reg, models, table, v = published
+    info = publish_quantized(reg, "f", v, models, table.schema, table,
+                             budget=0.02)
+    assert 0.0 <= info["mismatch"] <= 0.02
+    assert reg.is_intact("f", v)
+    qf = load_quantized(reg, "f", v)
+    assert qf is not None and qf.mismatch == info["mismatch"]
+    assert qf.q_lo.dtype == np.int8 and qf.q_hi.dtype == np.int8
+
+
+@pytest.mark.serving
+def test_quantized_publish_refuses_over_budget(published):
+    """The pinned accuracy contract: a budget below the measured
+    mismatch REFUSES to publish — the sidecar never reaches the
+    registry."""
+    from avenir_tpu.serving.quantized import (QUANTIZED_JSON,
+                                              publish_quantized)
+    reg, models, table, v = published
+    with pytest.raises(ValueError, match="exceeds the pinned"):
+        publish_quantized(reg, "f", v, models, table.schema, table,
+                          budget=-1.0)
+    with pytest.raises(FileNotFoundError):
+        reg.read_sidecar("f", v, QUANTIZED_JSON)
+    assert reg.is_intact("f", v)
+
+
+@pytest.mark.serving
+def test_quantized_serving_within_budget_and_4x_wire(published):
+    from avenir_tpu.serving.predictor import make_predictor
+    from avenir_tpu.serving.quantized import publish_quantized
+    reg, models, table, v = published
+    budget = 0.02
+    publish_quantized(reg, "f", v, models, table.schema, table,
+                      budget=budget)
+    loaded = reg.load("f")
+    req = _rows(_table(1024, seed=7))
+    pf = make_predictor(loaded).warm()
+    pq = make_predictor(loaded, quantized=True).warm()
+    assert pq.quantized is not None
+    with transfer_ledger() as led_f:
+        ref = pf.predict_rows(req)
+    with transfer_ledger() as led_q:
+        got = pq.predict_rows(req)
+    mismatch = sum(a != b for a, b in zip(ref, got)) / len(ref)
+    assert mismatch <= budget
+    # the wire acceptance: >= 4x fewer request H2D bytes, launches
+    # tagged quantized (never the float form)
+    f_b = led_f.snapshot()["h2d_bytes"]
+    q_b = led_q.snapshot()["h2d_bytes"]
+    assert f_b >= 4 * q_b, (f_b, q_b)
+    kb = led_q.backend_snapshot()
+    assert kb.get("serve.predict.quantized", 0) > 0
+    assert not any(k in ("serve.predict.xla", "serve.predict.pallas")
+                   for k in kb)
+
+
+@pytest.mark.serving
+def test_quantized_vote_backend_parity(published):
+    """The quantized vote itself is backend-dispatched: forced pallas
+    must answer exactly what the XLA int8 kernel answers."""
+    from avenir_tpu.serving.predictor import make_predictor
+    from avenir_tpu.serving.quantized import publish_quantized
+    reg, models, table, v = published
+    publish_quantized(reg, "f", v, models, table.schema, table)
+    loaded = reg.load("f")
+    req = _rows(_table(300, seed=11))
+    ref = make_predictor(loaded, quantized=True).warm().predict_rows(req)
+    with force_backend("pallas"):
+        got = make_predictor(loaded,
+                             quantized=True).warm().predict_rows(req)
+    assert got == ref
+
+
+@pytest.mark.serving
+def test_quantize_rows_nonfinite_value_semantics(published):
+    """+inf clips to the top cell (passes -inf/finite lower bounds like
+    the float compare); NaN and -inf take the -128 sentinel no
+    restricted interval admits."""
+    from avenir_tpu.serving.quantized import publish_quantized, load_quantized
+    reg, models, table, v = published
+    publish_quantized(reg, "f", v, models, table.schema, table)
+    qf = load_quantized(reg, "f", v)
+    F = qf.scale.shape[0]
+    vals = np.array([[np.inf] * F, [-np.inf] * F, [np.nan] * F, [0.0] * F])
+    qv, _ = qf.quantize_rows(vals, np.zeros((4, F), np.int32))
+    assert (qv[0] == 127).all()     # +inf: top cell, not the sentinel
+    assert (qv[1] == -128).all()    # -inf: never matches a strict > lo
+    assert (qv[2] == -128).all()    # NaN: never matches
+
+
+@pytest.mark.serving
+def test_quantized_single_tree_warns_and_serves_float(tmp_path):
+    from avenir_tpu.models.forest import ForestParams, build_forest
+    from avenir_tpu.parallel.mesh import MeshContext
+    from avenir_tpu.serving.predictor import make_predictor
+    from avenir_tpu.serving.quantized import publish_quantized
+    from avenir_tpu.serving.registry import ModelRegistry
+    table = _table(1500)
+    params = ForestParams(num_trees=1, seed=1)
+    params.tree.max_depth = 2
+    models = build_forest(table, params, MeshContext())
+    reg = ModelRegistry(str(tmp_path))
+    v = reg.publish("one", models, schema=table.schema)
+    publish_quantized(reg, "one", v, models, table.schema, table)
+    loaded = reg.load("one")
+    req = _rows(_table(32, seed=5))
+    ref = make_predictor(loaded).warm().predict_rows(req)
+    with pytest.warns(RuntimeWarning, match="single-tree"):
+        pq = make_predictor(loaded, quantized=True)
+    assert pq.quantized is None
+    assert pq.warm().predict_rows(req) == ref
+
+
+@pytest.mark.serving
+def test_quantized_missing_sidecar_serves_float(published):
+    from avenir_tpu.serving.predictor import make_predictor
+    reg, models, table, v = published
+    loaded = reg.load("f")
+    req = _rows(_table(64, seed=13))
+    ref = make_predictor(loaded).warm().predict_rows(req)
+    with pytest.warns(RuntimeWarning, match="no quantized sidecar"):
+        pq = make_predictor(loaded, quantized=True)
+    assert pq.quantized is None
+    assert pq.warm().predict_rows(req) == ref
+
+
+@pytest.mark.serving
+@pytest.mark.faultinject
+def test_quantized_publish_crash_falls_back_to_float(published,
+                                                     fault_injector):
+    """A crash mid-sidecar-write leaves the version intact WITHOUT the
+    quantized sidecar (tmp-then-rename before the manifest update);
+    ps.quantized then warns and serves the float model — never refuses
+    traffic."""
+    from avenir_tpu.serving.predictor import make_predictor
+    from avenir_tpu.serving.quantized import publish_quantized
+    reg, models, table, v = published
+    fault_injector("registry_sidecar@*=raise:RuntimeErrorx9")
+    with pytest.raises(RuntimeError, match="injected"):
+        publish_quantized(reg, "f", v, models, table.schema, table)
+    assert reg.is_intact("f", v)
+    assert reg.latest_version("f") == v
+    loaded = reg.load("f")
+    req = _rows(_table(64, seed=13))
+    ref = make_predictor(loaded).warm().predict_rows(req)
+    with pytest.warns(RuntimeWarning, match="quantized"):
+        pq = make_predictor(loaded, quantized=True)
+    assert pq.quantized is None
+    assert pq.warm().predict_rows(req) == ref
+
+
+@pytest.mark.serving
+def test_prediction_service_quantized_hot_swap(published, tmp_path):
+    """ps.quantized through the service layer: the initial load AND a
+    hot-swap refresh both serve the new version's int8 sidecar."""
+    from avenir_tpu.models.forest import ForestParams, build_forest
+    from avenir_tpu.parallel.mesh import MeshContext
+    from avenir_tpu.serving.quantized import publish_quantized
+    from avenir_tpu.serving.service import PredictionService
+    reg, models, table, v = published
+    publish_quantized(reg, "f", v, models, table.schema, table)
+    svc = PredictionService(registry=reg, model_name="f",
+                            quantized=True, warm=False)
+    assert svc.predictor.quantized is not None
+    assert svc.version == v
+    params = ForestParams(num_trees=5, seed=99)
+    params.tree.max_depth = 2
+    models2 = build_forest(table, params, MeshContext())
+    v2 = reg.publish("f", models2, schema=table.schema)
+    publish_quantized(reg, "f", v2, models2, table.schema, table)
+    assert svc.refresh()
+    assert svc.version == v2
+    assert svc.predictor.quantized is not None

@@ -8,7 +8,11 @@
 
 * **summarize** — per-stage span accounting (count, total/mean ms) plus
   per-lane totals and the observed wall span, for one or many per-process
-  trace files (pass every shard's file to see the whole run).
+  trace files (pass every shard's file to see the whole run).  With
+  ``--counters <out>.counters.json`` it additionally prints the per-site
+  kernel-backend table (xla vs pallas vs quantized, from the dispatch
+  ledger's ``KernelBackends`` group) so a trace shows WHICH kernel form
+  actually ran at each hot site (TPU_NOTES §24).
 * **merge** — concatenate N per-process JSONL traces (the shards of one
   run) into ONE ts-sorted Chrome trace JSON; epoch-anchored timestamps
   make shard skew visible as lane offset.  Warns when the inputs carry
@@ -51,6 +55,34 @@ def _run_ids(paths: List[str]) -> Dict[str, str]:
     return ids
 
 
+_BACKENDS = ("xla", "pallas", "quantized")
+
+
+def _print_backend_table(counters_path: str) -> None:
+    """The per-site backend column: join the ``Dispatches`` site counts
+    with the ``KernelBackends`` executed-form tallies from one job's
+    counters.json (tracing.TransferLedger.export)."""
+    with open(counters_path) as fh:
+        groups = json.load(fh)
+    sites = dict(groups.get("Dispatches") or {})
+    kb = groups.get("KernelBackends") or {}
+    by_site: Dict[str, List[str]] = defaultdict(list)
+    for key, n in sorted(kb.items()):
+        site, _, backend = key.rpartition(".")
+        if backend not in _BACKENDS:   # malformed key: show verbatim
+            site, backend = key, "?"
+        by_site[site].append(f"{backend}({n})")
+    if not by_site and not sites:
+        print(f"\n(no dispatch/backend counters in {counters_path})")
+        return
+    print(f"\nhot-site kernel backends ({counters_path}):")
+    print(f"  {'site':<24}{'dispatches':>12}  backend(launches)")
+    for site in sorted(set(by_site) | set(sites)):
+        disp = sites.get(site, "-")
+        forms = " ".join(by_site.get(site, [])) or "-"
+        print(f"  {site:<24}{disp!s:>12}  {forms}")
+
+
 def cmd_summarize(args) -> int:
     events = merge_trace_files(args.traces)
     problems = validate_trace_events(events)
@@ -64,6 +96,8 @@ def cmd_summarize(args) -> int:
              and isinstance(e.get("dur", 0.0), (int, float))]
     if not spans:
         print("no spans recorded")
+        for cpath in (args.counters or []):
+            _print_backend_table(cpath)
         return 0 if not problems else 1
     by_name: Dict[str, List[float]] = defaultdict(list)
     lane_spans: Dict[tuple, List[tuple]] = defaultdict(list)
@@ -135,6 +169,8 @@ def cmd_summarize(args) -> int:
                   f"{a.get('waited_s')}s for {a.get('missing_shards')} "
                   f"({a.get('reducer')}/{a.get('phase')} step "
                   f"{a.get('step')})")
+    for cpath in (args.counters or []):
+        _print_backend_table(cpath)
     # documented exit contract: summarize fails on invalid input so a CI
     # lane can gate on it (merge/export only warn)
     return 0 if not problems else 1
@@ -199,6 +235,9 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("summarize", help="per-stage span accounting")
     p.add_argument("traces", nargs="+")
+    p.add_argument("--counters", action="append",
+                   help="a job's <out>.counters.json: print the per-site "
+                        "kernel-backend table (repeatable)")
     p.set_defaults(fn=cmd_summarize)
 
     p = sub.add_parser("merge",
