@@ -24,6 +24,18 @@ coalescing policy, optional RESP wire transport).  Config keys
   ps.workers                fleet size; >1 serves through a ServingFleet
                             of workers draining one RESP queue (default 1;
                             requires ps.transport=resp)
+  ps.broker.shards          RESP broker shard count; >1 starts M embedded
+                            RespServers and every client rides the
+                            consistent-hash ShardedRespClient ring
+                            (default 1; requires ps.transport=resp)
+  ps.host.label             multi-host identity on metric series and
+                            stats (default: this hostname)
+  ps.autoscale              run the fleet under the SLO-driven
+                            FleetAutoscaler (default false; implies the
+                            fleet path, requires ps.transport=resp)
+  ps.autoscale.min.workers / ps.autoscale.max.workers
+                            active-worker bounds (default 1 / 4)
+  ps.autoscale.interval.ms  sensor tick period (default 250)
   ps.bucket.sizes           jit shape buckets (default 1,8,64,512)
   ps.warm.start             pre-compile all buckets (default true)
   ps.latency.window         latency sample window (default 8192)
@@ -38,7 +50,7 @@ and throughput land in the counter dump (Serving group).
 
 from __future__ import annotations
 
-from typing import List
+from typing import Dict, List
 
 from ..core.config import Config
 from ..core.metrics import Counters
@@ -78,9 +90,16 @@ def prediction_service(cfg: Config, in_path: str, out_path: str) -> Counters:
     rows = [split(line) for line in artifacts.read_text_input(in_path)]
     od = cfg.field_delim_out
     transport = cfg.get("ps.transport", "inprocess")
+    n_shards = cfg.get_int("ps.broker.shards", 1)
+    autoscale = cfg.get_boolean("ps.autoscale", False)
     if n_workers > 1 and transport != "resp":
         raise ValueError("ps.workers > 1 requires ps.transport=resp "
                          "(the fleet drains a RESP request queue)")
+    if (n_shards > 1 or autoscale) and transport != "resp":
+        raise ValueError("ps.broker.shards > 1 / ps.autoscale require "
+                         "ps.transport=resp (both live on the wire tier)")
+    if n_shards < 1:
+        raise ValueError(f"ps.broker.shards must be >= 1, got {n_shards}")
 
     def pinned_factory():
         # pinned serving: build the predictor for that exact version
@@ -91,27 +110,58 @@ def prediction_service(cfg: Config, in_path: str, out_path: str) -> Counters:
                               delim=cfg.field_delim_out,
                               quantized=quantized)
 
-    if n_workers > 1:
-        from ..io.respq import RespClient, RespServer
+    if n_workers > 1 or autoscale or n_shards > 1:
+        # the fleet path also carries a 1-worker fleet over a sharded
+        # ring (the RespPredictionLoop below is single-endpoint only)
+        from ..io.respq import RespServer, make_queue_client
+        from ..serving.autoscaler import AutoscalePolicy, FleetAutoscaler
         from ..serving.fleet import ServingFleet
-        server = RespServer().start()
-        fleet = feeder = None
+        # the broker tier: M shard servers (M=1 keeps the plain client
+        # underneath make_queue_client); started INSIDE the try so a
+        # bind failure on shard k doesn't leak the k-1 already running
+        servers: List[RespServer] = []
+        fleet = feeder = scaler = sensor = None
         try:
+            for _ in range(n_shards):
+                servers.append(RespServer().start())
             req_q = cfg.get("redis.request.queue", "requestQueue")
             pred_q = cfg.get("redis.prediction.queue", "predictionQueue")
-            wire_cfg = {"redis.server.port": server.port,
+            wire_cfg = {"redis.server.endpoints":
+                        [f"127.0.0.1:{s.port}" for s in servers],
                         "redis.request.queue": req_q,
                         "redis.prediction.queue": pred_q}
+            start_workers = n_workers
+            if autoscale:
+                # like fleet_host --autoscale MIN:MAX: the fleet starts
+                # at the configured floor (the tick-level floor would
+                # bring it up anyway, one worker per interval later)
+                start_workers = max(
+                    n_workers, cfg.get_int("ps.autoscale.min.workers", 1))
             fleet = ServingFleet(
                 registry=None if version else registry,
                 model_name=None if version else name,
                 predictor_factory=pinned_factory if version else None,
                 schema=schema, buckets=buckets, policy=policy,
-                n_workers=n_workers, config=wire_cfg, warm=warm,
+                n_workers=start_workers, config=wire_cfg, warm=warm,
                 delim=od, quantized=quantized,
+                host_label=cfg.get("ps.host.label"),
                 latency_window=cfg.get_int("ps.latency.window", 8192))
             fleet.start()
-            feeder = RespClient(port=server.port)
+            if autoscale:
+                # sensor connection is its own client (one per thread)
+                sensor = make_queue_client(wire_cfg, delim=od)
+                scaler = FleetAutoscaler(
+                    fleet, sensor, queue=req_q,
+                    policy=AutoscalePolicy(
+                        min_workers=cfg.get_int(
+                            "ps.autoscale.min.workers", 1),
+                        max_workers=cfg.get_int(
+                            "ps.autoscale.max.workers", 4),
+                        slo_p99_ms=policy.slo_p99_ms),
+                    interval_s=cfg.get_float(
+                        "ps.autoscale.interval.ms", 250.0) / 1000.0,
+                    counters=counters).start()
+            feeder = make_queue_client(wire_cfg, delim=od)
             feeder.lpush_many(
                 req_q, [od.join(["predict", str(i)] + row)
                         for i, row in enumerate(rows)])
@@ -123,18 +173,42 @@ def prediction_service(cfg: Config, in_path: str, out_path: str) -> Counters:
                     "predictionService fleet: worker(s) still draining "
                     "after 300s — replay aborted (partial output "
                     "suppressed)")
-            out: List[str] = []
+            if scaler is not None:
+                scaler.stop()
+                counters.set("Autoscaler", "FinalActiveWorkers",
+                             fleet.active_workers())
+            # first reply per id wins: the RespClient reconnect contract
+            # is at-least-once on writes, so a re-pushed request could
+            # answer twice — and a reply count that does not cover every
+            # request is a corrupted replay, never a part file
+            by_id: Dict[int, str] = {}
+            dups = 0
             while True:
                 v = feeder.rpop(pred_q)
                 if v is None:
                     break
-                out.append(v)
-            out.sort(key=lambda r: int(r.split(od, 1)[0]))
+                rid = int(v.split(od, 1)[0])
+                if rid in by_id:
+                    dups += 1
+                else:
+                    by_id[rid] = v
+            if dups:
+                import warnings
+                warnings.warn(f"predictionService fleet: {dups} "
+                              f"duplicate replies deduped (reconnect "
+                              f"re-push window)", RuntimeWarning)
+            if len(by_id) != len(rows):
+                raise RuntimeError(
+                    f"predictionService fleet: {len(by_id)} replies for "
+                    f"{len(rows)} requests — replay aborted (partial "
+                    f"output suppressed)")
+            out: List[str] = [by_id[rid] for rid in sorted(by_id)]
             # fold the fleet's aggregate counters + latency percentiles
             # into the job dump before teardown
             for grp, names in fleet.merged_counters().as_dict().items():
                 counters.update_group(grp, names)
             fleet.merged_timer().export(counters, group="Serving")
+            counters.set("Broker", "Shards", n_shards)
             versions = [w.service.version or 0 for w in fleet.workers]
             counters.set("Serving", "ModelVersion",
                          version or min(versions, default=0))
@@ -142,11 +216,15 @@ def prediction_service(cfg: Config, in_path: str, out_path: str) -> Counters:
             # tear down on EVERY path: an aborted replay must not leave
             # worker services running (and their gauges/health bound to
             # the default registry) or the feeder socket open
+            if scaler is not None:
+                scaler.stop()
             if fleet is not None:
                 fleet.stop()
-            if feeder is not None:
-                feeder.close()
-            server.stop()
+            for cli in (feeder, sensor):
+                if cli is not None:
+                    cli.close()
+            for s in servers:
+                s.stop()
         artifacts.write_text_output(out_path, out, role="m")
         return counters
 

@@ -7,12 +7,22 @@ RedisActionWriter.java:47-61).  This module provides both halves of that
 contract with no external dependency:
 
   * :class:`RespServer` — a threaded TCP server speaking the RESP2 subset
-    the queue contract needs (LPUSH, RPOP, BRPOP, LLEN, DEL, PING), backed
-    by in-memory deques.  A real ``redis-cli``/client library can talk to
-    it.
+    the queue contract needs (LPUSH, RPOP, BRPOP, LLEN, DEL, PING, INFO),
+    backed by in-memory deques.  A real ``redis-cli``/client library can
+    talk to it.
   * :class:`RespClient` — a blocking client usable against this server OR
     a real Redis instance (the wire format is the same), exposing exactly
-    the three verbs the reference uses.
+    the three verbs the reference uses.  A dropped TCP connection
+    mid-call reconnects once with backoff instead of poisoning the
+    client (see :meth:`RespClient._call`).
+  * :class:`ShardedRespClient` — the horizontal broker tier: one client
+    over M RESP endpoints, consistent-hashing request ids across the
+    ring (:class:`HashRing`) with per-shard pipelining on every fan-out
+    verb.  Requests and their replies share an id, so they land on the
+    SAME shard and reassembly is just collection.  A dead shard degrades
+    the client to the surviving ring (structured warning + a
+    ``Broker/BrokerShardDown`` counter) — values from a failed push are
+    re-routed, never dropped.
 
 Security note: like stock Redis, there is no auth — bind to loopback
 (the default) or a trusted network only.
@@ -20,12 +30,15 @@ Security note: like stock Redis, there is no auth — bind to loopback
 
 from __future__ import annotations
 
+import bisect
+import hashlib
 import socket
 import socketserver
 import threading
 import time
+import warnings
 from collections import deque
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 
 # ---------------------------------------------------------------------------
@@ -102,17 +115,24 @@ def _read_command(rf) -> Optional[List[str]]:
 class _Handler(socketserver.StreamRequestHandler):
     def handle(self):
         srv: "RespServer" = self.server.owner  # type: ignore[attr-defined]
-        while True:
-            try:
-                args = _read_command(self.rfile)
-            except (ConnectionError, ValueError, RuntimeError):
-                return
-            if args is None:
-                return
-            if not args:
-                continue
-            self.wfile.write(srv.dispatch(args))
-            self.wfile.flush()
+        srv._track(self.connection, add=True)
+        try:
+            while True:
+                try:
+                    args = _read_command(self.rfile)
+                except (ConnectionError, ValueError, RuntimeError, OSError):
+                    return
+                if args is None:
+                    return
+                if not args:
+                    continue
+                try:
+                    self.wfile.write(srv.dispatch(args))
+                    self.wfile.flush()
+                except OSError:
+                    return   # peer (or kill()) closed the socket mid-reply
+        finally:
+            srv._track(self.connection, add=False)
 
 
 class _TCPServer(socketserver.ThreadingTCPServer):
@@ -134,6 +154,22 @@ class RespServer:
         self._lock = threading.Condition()
         self._server: Optional[_TCPServer] = None
         self._thread: Optional[threading.Thread] = None
+        # live client sockets, so kill() can sever them the way a dead
+        # broker process would (stop() alone only closes the listener;
+        # established connections would keep serving from the ghost)
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
+        # flipped by kill(): parked BRPOP handlers re-check it on every
+        # wakeup, so severing the sockets can't leave a ghost waiter
+        # parked on the condition for the life of the process
+        self._killed = False
+
+    def _track(self, conn, add: bool) -> None:
+        with self._conns_lock:
+            if add:
+                self._conns.add(conn)
+            else:
+                self._conns.discard(conn)
 
     # ---- command dispatch (the RESP subset the queue contract uses) ----
     def dispatch(self, args: List[str]) -> bytes:
@@ -153,27 +189,35 @@ class RespServer:
                 # until a value arrives or the timeout lapses (seconds,
                 # fractional ok; 0 = block indefinitely, as in Redis).
                 # Reply is [key, value] or nil — the real BRPOP wire form.
+                # The condition is held ONLY across the queue check/pop;
+                # the reply is encoded after release so a slow handler
+                # never extends the critical section other waiters (and
+                # every LPUSH) contend on.
                 key = args[1]
                 timeout = float(args[2])
                 deadline = None if timeout <= 0 \
                     else time.monotonic() + timeout
+                popped: Optional[str] = None
                 with self._lock:
-                    while True:
+                    while not self._killed:
                         q = self._queues.get(key)
                         if q:
-                            v = q.pop().encode()
+                            popped = q.pop()
                             if not q:
                                 del self._queues[key]
-                            k = key.encode()
-                            return (b"*2\r\n$%d\r\n%s\r\n$%d\r\n%s\r\n"
-                                    % (len(k), k, len(v), v))
+                            break
                         if deadline is None:
                             self._lock.wait()
                         else:
                             remaining = deadline - time.monotonic()
                             if remaining <= 0:
-                                return b"*-1\r\n"
+                                break
                             self._lock.wait(remaining)
+                if popped is None:
+                    return b"*-1\r\n"
+                k, v = key.encode(), popped.encode()
+                return (b"*2\r\n$%d\r\n%s\r\n$%d\r\n%s\r\n"
+                        % (len(k), k, len(v), v))
             if cmd == "RPOP":
                 if len(args) > 2:
                     # Redis >= 6.2 count form: ONE command drains up to
@@ -202,8 +246,30 @@ class RespServer:
                         del self._queues[args[1]]  # Redis drops empty lists
                 return b"$%d\r\n%s\r\n" % (len(v), v)
             if cmd == "LLEN":
+                # snapshot under the BRPOP condition, format outside —
+                # depth probes (the autoscaler sensor polls this) must
+                # not stretch the critical section parked poppers and
+                # every LPUSH serialize on
                 with self._lock:
-                    return b":%d\r\n" % len(self._queues.get(args[1], ()))
+                    n = len(self._queues.get(args[1], ()))
+                return b":%d\r\n" % n
+            if cmd == "INFO":
+                # queue-depth observability WITHOUT popping: one bulk
+                # string of "queue_depth:<name>=<n>" lines (every queue,
+                # or just the named ones when keys are given).  The lock
+                # is held only long enough to copy the lengths.
+                with self._lock:
+                    if len(args) > 1:
+                        depths = {k: len(self._queues.get(k, ()))
+                                  for k in args[1:]}
+                    else:
+                        depths = {k: len(q)
+                                  for k, q in self._queues.items()}
+                body = "\n".join(
+                    ["# Queues", f"queues:{len(depths)}"] +
+                    [f"queue_depth:{k}={n}"
+                     for k, n in sorted(depths.items())]).encode()
+                return b"$%d\r\n%s\r\n" % (len(body), body)
             if cmd == "DEL":
                 with self._lock:
                     n = sum(1 for k in args[1:] if self._queues.pop(k, None)
@@ -228,6 +294,33 @@ class RespServer:
             self._server.server_close()
             self._server = None
 
+    def kill(self) -> None:
+        """Die like a crashed broker process: stop listening AND sever
+        every established client connection (their next call raises),
+        dropping the in-memory queues.  ``stop()`` is the graceful
+        teardown; this is what the killed-shard drills simulate."""
+        self.stop()
+        with self._conns_lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        # parked BRPOP handlers are waiting on the condition, not the
+        # socket: flip the killed flag and wake them — each wait loop
+        # exits, answers nil into the severed socket, and the handler
+        # thread ends (without the flag an indefinite waiter would
+        # re-check the empty queue and park forever)
+        with self._lock:
+            self._killed = True
+            self._queues.clear()
+            self._lock.notify_all()
+
 
 # ---------------------------------------------------------------------------
 # client
@@ -235,20 +328,88 @@ class RespServer:
 
 class RespClient:
     """Blocking client for the three verbs the reference uses.  Works
-    against :class:`RespServer` or a real Redis."""
+    against :class:`RespServer` or a real Redis.
+
+    A dropped TCP connection mid-call (server restart, transient network
+    fault) no longer poisons the client: ``_call`` reconnects ONCE with
+    short exponential backoff and re-issues the command before
+    surfacing the error (``reconnect=False`` restores the old
+    fail-fast).  Two caveats: (1) if the DROP happened after the server
+    executed the command but before the reply arrived, the re-issue can
+    apply a write twice — the same at-least-once window every
+    reconnecting Redis client has; exactly-once consumers dedupe by
+    request id.  (2) a reply TIMEOUT (server alive but stalled past the
+    socket timeout) reconnects so the next call starts on a clean
+    connection but does NOT re-issue — the command may have executed,
+    and re-issuing a destructive read (RPOP) would pop, and lose, a
+    second batch; the timeout surfaces to the caller instead."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 6379,
-                 timeout: float = 10.0):
-        self._sock = socket.create_connection((host, port), timeout=timeout)
+                 timeout: float = 10.0, reconnect: bool = True):
+        self.host, self.port = host, int(port)
+        self.timeout = float(timeout)
+        self._reconnect = bool(reconnect)
+        self._rpop_count_ok = True
+        self._sock = None
+        self._rf = None
+        self._connect()
+
+    def _connect(self) -> None:
+        self._sock = socket.create_connection((self.host, self.port),
+                                              timeout=self.timeout)
         # request/reply round trips are small packets; Nagle would add
         # 40ms stalls to every serving poll
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._rf = self._sock.makefile("rb")
-        self._rpop_count_ok = True
+
+    def _reconnect_once(self, why: BaseException) -> None:
+        """Drop the poisoned half-connection and re-establish — the
+        connect itself retried with ``core.faults.with_retry`` (base
+        0.05s, 2x backoff, 4 tries); raises the last connect failure
+        when the server stays unreachable."""
+        from ..core.faults import with_retry
+        try:
+            if self._rf is not None:
+                self._rf.close()
+            if self._sock is not None:
+                self._sock.close()
+        except OSError:
+            pass
+        with_retry(self._connect, attempts=4, base_delay=0.05,
+                   retry_on=(OSError,),
+                   what=f"respq reconnect to {self.host}:{self.port}")
+        warnings.warn(
+            f"respq: connection to {self.host}:{self.port} dropped "
+            f"({type(why).__name__}: {why}); reconnected",
+            RuntimeWarning)
+
+    def _recover(self, exc: BaseException) -> None:
+        """Shared reconnect policy for a failed command exchange:
+        re-establish the connection, then decide whether the caller may
+        re-issue.  A TIMEOUT means the server may be alive and may have
+        EXECUTED the command — re-issuing a destructive read would pop
+        (and lose) a second batch — so the fresh connection is kept for
+        the NEXT call and the timeout re-raises.  A hard drop
+        re-establishes and returns (the caller re-issues once)."""
+        if not self._reconnect:
+            raise exc
+        if isinstance(exc, socket.timeout):
+            try:
+                self._reconnect_once(exc)
+            except OSError:
+                pass   # surface the original timeout, not the connect
+            raise exc
+        self._reconnect_once(exc)
 
     def _call(self, *args: str):
-        self._sock.sendall(_encode_command(list(args)))
-        return _read_reply(self._rf)
+        payload = _encode_command(list(args))
+        try:
+            self._sock.sendall(payload)
+            return _read_reply(self._rf)
+        except (ConnectionError, OSError) as exc:
+            self._recover(exc)   # raises unless a re-issue is safe
+            self._sock.sendall(payload)
+            return _read_reply(self._rf)
 
     def ping(self) -> bool:
         return self._call("PING") == "PONG"
@@ -271,8 +432,17 @@ class RespClient:
         """Blocking pop: park on the server until a value arrives or
         ``timeout_s`` lapses (fractional seconds; None on timeout) — the
         idle half of the fleet drain, so N parked workers cost the host
-        nothing instead of N spin-polling cores.  ``timeout_s`` must stay
-        comfortably under the client socket timeout."""
+        nothing instead of N spin-polling cores.  ``timeout_s`` must be
+        positive and stay under the client socket timeout — ENFORCED,
+        not just documented: a park outliving the socket timeout would
+        hit the reconnect path mid-BRPOP, and the abandoned server-side
+        waiter could pop (and lose) the next pushed value.  Poll in a
+        loop for long parks."""
+        if not 0.0 < float(timeout_s) < self.timeout:
+            raise ValueError(
+                f"brpop timeout_s must be in (0, {self.timeout}) — the "
+                f"client socket timeout; got {timeout_s!r}.  Park in a "
+                f"loop for longer waits")
         reply = self._call("BRPOP", queue, repr(float(timeout_s)))
         if reply is None:
             return None
@@ -296,6 +466,16 @@ class RespClient:
                 self._rpop_count_ok = False
             else:
                 return [] if reply is None else list(reply)
+        try:
+            return self._pipelined_rpops(queue, n)
+        except (ConnectionError, OSError) as exc:
+            # same reconnect contract as _call (timeouts re-raise: the
+            # burst may have executed); on a hard drop the whole
+            # pipelined burst re-issues against the fresh connection
+            self._recover(exc)
+            return self._pipelined_rpops(queue, n)
+
+    def _pipelined_rpops(self, queue: str, n: int) -> List[str]:
         self._sock.sendall(
             b"".join(_encode_command(["RPOP", queue]) for _ in range(n)))
         out: List[str] = []
@@ -319,6 +499,24 @@ class RespClient:
     def llen(self, queue: str) -> int:
         return int(self._call("LLEN", queue))
 
+    def info(self, *queues: str) -> Dict[str, int]:
+        """Per-queue depths via the ``INFO`` command — observable WITHOUT
+        popping (the autoscaler's queue-depth sensor and operator depth
+        probes).  Returns ``{queue: depth}``; all queues by default, the
+        named ones when given.  Against a real Redis (whose INFO reports
+        server stats, not queue depths) the dict is empty — callers fall
+        back to :meth:`llen` per queue."""
+        reply = self._call("INFO", *queues)
+        out: Dict[str, int] = {}
+        for line in (reply or "").splitlines():
+            if line.startswith("queue_depth:"):
+                key, _, depth = line[len("queue_depth:"):].rpartition("=")
+                try:
+                    out[key] = int(depth)
+                except ValueError:
+                    continue
+        return out
+
     def delete(self, *queues: str) -> int:
         return int(self._call("DEL", *queues))
 
@@ -328,3 +526,329 @@ class RespClient:
             self._sock.close()
         except OSError:
             pass
+
+
+# ---------------------------------------------------------------------------
+# sharded broker client
+# ---------------------------------------------------------------------------
+
+def _hash64(key: str) -> int:
+    """Stable 64-bit ring hash (md5 head): identical placement in every
+    process and across runs — python's builtin hash() is seed-randomized
+    per process, which would put each fleet host on a DIFFERENT ring."""
+    return int.from_bytes(hashlib.md5(key.encode()).digest()[:8], "big")
+
+
+Endpoint = Union[str, Tuple[str, int]]
+
+
+def _norm_endpoint(ep: Endpoint) -> str:
+    if isinstance(ep, str):
+        return ep
+    host, port = ep
+    return f"{host}:{int(port)}"
+
+
+class HashRing:
+    """Consistent-hash ring over broker endpoints, ``replicas`` virtual
+    nodes each.  The property the shard tier leans on: removing (or
+    adding) one of M endpoints remaps only the ids that hashed TO it
+    (~1/M of the key space) — every surviving assignment stays put, so a
+    shard death never reshuffles the whole fleet's queues (pinned by
+    tests/test_broker.py)."""
+
+    __slots__ = ("endpoints", "replicas", "_hashes", "_owners")
+
+    def __init__(self, endpoints: Sequence[str], replicas: int = 64):
+        self.endpoints = [_norm_endpoint(e) for e in endpoints]
+        if len(set(self.endpoints)) != len(self.endpoints):
+            raise ValueError(f"duplicate broker endpoints: {self.endpoints}")
+        self.replicas = int(replicas)
+        points = sorted((_hash64(f"{ep}#{r}"), ep)
+                        for ep in self.endpoints
+                        for r in range(self.replicas))
+        self._hashes = [h for h, _ in points]
+        self._owners = [ep for _, ep in points]
+
+    def lookup(self, key: str) -> str:
+        """The endpoint owning ``key`` (first ring point clockwise)."""
+        if not self._owners:
+            raise RuntimeError("broker ring is empty (every shard down)")
+        i = bisect.bisect_right(self._hashes, _hash64(str(key)))
+        return self._owners[i % len(self._owners)]
+
+    def without(self, endpoint: str) -> "HashRing":
+        return HashRing([e for e in self.endpoints if e != endpoint],
+                        self.replicas)
+
+
+class ShardedRespClient:
+    """One client over M RESP broker shards: consistent-hash fan-out.
+
+    Request ids route by :class:`HashRing` lookup, so a request
+    (``predict,<id>,...``) and its reply (``<id>,<label>``) land on the
+    SAME shard and a collector simply fans ``rpop_many`` across the ring
+    and reassembles by id.  Per-shard pipelining everywhere: one
+    variadic LPUSH per shard per push batch, one RPOP-count (or
+    pipelined) drain per shard per poll.
+
+    Degraded-ring semantics: a shard whose connection fails (after the
+    underlying :class:`RespClient`'s own reconnect attempt) is marked
+    down with a structured warning and a ``Broker/BrokerShardDown``
+    counter, and the ring shrinks to the survivors — values from the
+    failed push are RE-ROUTED onto the surviving shards, never dropped.
+    Messages already queued inside the dead shard's memory are the
+    producer's re-offer window (unanswered ids get re-sent — the bench's
+    killed-shard protocol).  When the LAST shard dies the client raises:
+    there is nowhere left to degrade to.
+
+    Like :class:`RespClient`, not thread-safe — one instance per thread
+    (each fleet worker owns its own)."""
+
+    def __init__(self, endpoints: Sequence[Endpoint],
+                 timeout: float = 10.0, replicas: int = 64,
+                 delim: str = ",", counters=None):
+        eps = [_norm_endpoint(e) for e in endpoints]
+        if not eps:
+            raise ValueError("need at least one broker endpoint")
+        self._delim = delim
+        self.counters = counters
+        self._clients: Dict[str, RespClient] = {}
+        self._down: List[str] = []
+        live: List[str] = []
+        first_err: Optional[BaseException] = None
+        for ep in eps:
+            host, _, port = ep.rpartition(":")
+            try:
+                self._clients[ep] = RespClient(host or "127.0.0.1",
+                                               int(port), timeout=timeout)
+            except OSError as exc:
+                first_err = first_err or exc
+                self._note_down(ep, exc)
+            else:
+                live.append(ep)
+        if not live:
+            raise ConnectionError(
+                f"no broker shard reachable out of {eps}") from first_err
+        self._ring = HashRing(live, replicas=replicas)
+        self._rr = 0   # rotating start index: fair drain across shards
+
+    # ---- ring state ----
+    @property
+    def live_endpoints(self) -> List[str]:
+        return list(self._ring.endpoints)
+
+    @property
+    def down_endpoints(self) -> List[str]:
+        return list(self._down)
+
+    def shard_of(self, request_id: str) -> str:
+        """Which live shard owns ``request_id`` (tests + operators)."""
+        return self._ring.lookup(request_id)
+
+    def id_of(self, value: str) -> str:
+        """The routing id of a wire message: ``predict,<id>,...`` routes
+        by the id field, anything else (a reply ``<id>,<label>``, a
+        control word) by its first field."""
+        parts = value.split(self._delim, 2)
+        if parts[0] == "predict" and len(parts) > 1:
+            return parts[1]
+        return parts[0]
+
+    def _note_down(self, ep: str, exc: BaseException) -> None:
+        self._down.append(ep)
+        if self.counters is not None:
+            self.counters.increment("Broker", "BrokerShardDown")
+        survivors = sum(1 for e in self._clients if e != ep)
+        warnings.warn(
+            f"broker: shard {ep} down ({type(exc).__name__}: {exc}); "
+            f"degrading to the surviving ring ({survivors} shard(s) "
+            f"left)", RuntimeWarning)
+
+    def _mark_down(self, ep: str, exc: BaseException) -> None:
+        """Shrink the ring past a dead shard; raises when it was the
+        last one (nowhere to degrade to)."""
+        if ep not in self._clients:
+            return
+        self._note_down(ep, exc)
+        cli = self._clients.pop(ep)
+        try:
+            cli.close()
+        except OSError:
+            pass
+        self._ring = self._ring.without(ep)
+        if not self._ring.endpoints:
+            raise ConnectionError(
+                f"broker: last shard {ep} is down "
+                f"({type(exc).__name__}: {exc})") from exc
+
+    # ---- fan-out verbs ----
+    def ping(self) -> bool:
+        """True when every LIVE shard answers PONG.  Like every other
+        fan-out verb, a shard failing the probe degrades the ring
+        (warning + counter) instead of crashing the caller — a liveness
+        probe that raises on exactly the condition it probes for would
+        be useless; the last shard dying still raises."""
+        ok = True
+        for ep in self.live_endpoints:
+            if ep not in self._clients:
+                continue
+            try:
+                ok = self._clients[ep].ping() and ok
+            except (ConnectionError, OSError) as exc:
+                self._mark_down(ep, exc)
+                ok = False
+        return ok
+
+    def lpush(self, queue: str, value: str) -> int:
+        return self.lpush_many(queue, [value])
+
+    def lpush_many(self, queue: str, values: List[str]) -> int:
+        """Push a batch: group by owning shard, ONE variadic LPUSH per
+        shard.  A shard failing mid-push degrades the ring and its
+        group re-routes onto the survivors (accepted values are never
+        dropped by the client).  Returns the summed post-push depth of
+        the touched shards."""
+        total = 0
+        pending = list(values)
+        while pending:
+            groups: Dict[str, List[str]] = {}
+            for v in pending:
+                groups.setdefault(self._ring.lookup(self.id_of(v)),
+                                  []).append(v)
+            pending = []
+            for ep, vals in groups.items():
+                try:
+                    total += self._clients[ep].lpush_many(queue, vals)
+                except (ConnectionError, OSError) as exc:
+                    self._mark_down(ep, exc)   # raises when ring empties
+                    pending.extend(vals)       # re-route on the new ring
+        return total
+
+    def broadcast(self, queue: str, value: str) -> int:
+        """Push ``value`` onto EVERY live shard (control fan-out: a
+        'reload' must be seen whichever shard a fleet drains first).
+        Returns how many shards accepted it."""
+        n = 0
+        for ep in self.live_endpoints:
+            try:
+                self._clients[ep].lpush(queue, value)
+                n += 1
+            except (ConnectionError, OSError) as exc:
+                self._mark_down(ep, exc)
+        return n
+
+    def rpop(self, queue: str) -> Optional[str]:
+        vs = self.rpop_many(queue, 1)
+        return vs[0] if vs else None
+
+    def rpop_many(self, queue: str, n: int) -> List[str]:
+        """Drain up to ``n`` values across the ring: pipelined
+        ``rpop_many`` per shard, visiting shards from a rotating start
+        index so one busy shard cannot starve the others.  A failing
+        shard degrades the ring; the poll continues on the survivors."""
+        if n <= 0:
+            return []
+        out: List[str] = []
+        eps = self.live_endpoints
+        self._rr += 1
+        start = self._rr
+        for i in range(len(eps)):
+            ep = eps[(start + i) % len(eps)]
+            if ep not in self._clients:
+                continue
+            try:
+                out.extend(self._clients[ep].rpop_many(queue, n - len(out)))
+            except (ConnectionError, OSError) as exc:
+                self._mark_down(ep, exc)
+            if len(out) >= n:
+                break
+        return out
+
+    def brpop(self, queue: str, timeout_s: float = 0.05) -> Optional[str]:
+        """Park-when-idle over the ring: one non-blocking sweep first,
+        then a real BRPOP on ONE rotating shard for the timeout.  A
+        value landing on a different shard during the park is picked up
+        at the next poll — bounded by ``timeout_s``, which the fleet
+        keeps in the low milliseconds."""
+        vs = self.rpop_many(queue, 1)
+        if vs:
+            return vs[0]
+        eps = self.live_endpoints
+        if not eps:
+            raise RuntimeError("broker ring is empty (every shard down)")
+        self._rr += 1
+        ep = eps[self._rr % len(eps)]
+        try:
+            return self._clients[ep].brpop(queue, timeout_s)
+        except (ConnectionError, OSError) as exc:
+            self._mark_down(ep, exc)
+            return None
+
+    def llen(self, queue: str) -> int:
+        """Summed depth across the live ring (down shards excluded)."""
+        total = 0
+        for ep in self.live_endpoints:
+            if ep not in self._clients:
+                continue
+            try:
+                total += self._clients[ep].llen(queue)
+            except (ConnectionError, OSError) as exc:
+                self._mark_down(ep, exc)
+        return total
+
+    def depths(self, *queues: str) -> Dict[str, Dict[str, int]]:
+        """Per-shard per-queue depths via INFO (no popping):
+        ``{endpoint: {queue: depth}}`` — the observable the autoscaler
+        sensor and the killed-shard bench read."""
+        out: Dict[str, Dict[str, int]] = {}
+        for ep in self.live_endpoints:
+            if ep not in self._clients:
+                continue
+            try:
+                out[ep] = self._clients[ep].info(*queues)
+            except (ConnectionError, OSError) as exc:
+                self._mark_down(ep, exc)
+        return out
+
+    def delete(self, *queues: str) -> int:
+        n = 0
+        for ep in self.live_endpoints:
+            if ep not in self._clients:
+                continue
+            try:
+                n += self._clients[ep].delete(*queues)
+            except (ConnectionError, OSError) as exc:
+                self._mark_down(ep, exc)
+        return n
+
+    def close(self) -> None:
+        for cli in self._clients.values():
+            cli.close()
+        self._clients.clear()
+
+
+def make_queue_client(config: Optional[Dict] = None, delim: str = ",",
+                      counters=None
+                      ) -> Union[RespClient, ShardedRespClient]:
+    """Build the right client for a serving config: the plain
+    :class:`RespClient` for one ``redis.server.host``/``port``, the
+    :class:`ShardedRespClient` when ``redis.server.endpoints`` lists a
+    ring (list of ``host:port`` / ``(host, port)``, or one
+    comma-separated string).  The single-endpoint path stays the plain
+    client on purpose — no ring hashing on the hot path when there is
+    nothing to shard."""
+    cfg = dict(config or {})
+    endpoints = cfg.get("redis.server.endpoints")
+    if endpoints:
+        if isinstance(endpoints, str):
+            endpoints = [e.strip() for e in endpoints.split(",")
+                         if e.strip()]
+        endpoints = [_norm_endpoint(e) for e in endpoints]
+        if len(endpoints) > 1:
+            return ShardedRespClient(endpoints, delim=delim,
+                                     counters=counters)
+        host, _, port = endpoints[0].rpartition(":")
+        return RespClient(host or "127.0.0.1", int(port))
+    return RespClient(cfg.get("redis.server.host", "127.0.0.1"),
+                      int(cfg.get("redis.server.port", 6379)))

@@ -19,8 +19,13 @@ the same model core must also serve online traffic.  Three layers:
     admission control live here (BatchPolicy knobs);
   * :mod:`.fleet`     — :class:`ServingFleet`, the traffic-shaped tier:
     N workers with per-worker warm bucket caches draining ONE RESP
-    request queue, coordinated hot-swap, degraded-worker parking, and
-    per-worker ``/healthz/<name>`` targets.
+    request queue (or a ``ShardedRespClient`` ring of M broker shards),
+    coordinated hot-swap, degraded-worker parking, autoscaler parking
+    (``scale_to``), and per-worker ``/healthz/<name>`` targets;
+  * :mod:`.autoscaler` — :class:`FleetAutoscaler`, the SLO-driven
+    sensor→policy→actuator control loop over one fleet: queue-depth
+    derivative + recent-p99-vs-SLO sensing, hysteresis so it never
+    flaps, every decision traced and counted (``Autoscaler/*``).
 """
 
 from .registry import (FOREST, BAYES, LOGISTIC, MLP, LoadedModel,
@@ -30,11 +35,13 @@ from .predictor import (DEFAULT_BUCKETS, BayesPredictor, ForestPredictor,
                         make_predictor)
 from .service import BatchPolicy, PredictionService, RespPredictionLoop
 from .fleet import ServingFleet
+from .autoscaler import AutoscalePolicy, FleetAutoscaler
 
 __all__ = [
     "FOREST", "BAYES", "LOGISTIC", "MLP", "LoadedModel", "ModelRegistry",
     "load_model", "save_model", "DEFAULT_BUCKETS", "BayesPredictor",
     "ForestPredictor", "LogisticPredictor", "MLPPredictor", "Predictor",
     "make_predictor", "BatchPolicy", "PredictionService",
-    "RespPredictionLoop", "ServingFleet",
+    "RespPredictionLoop", "ServingFleet", "AutoscalePolicy",
+    "FleetAutoscaler",
 ]

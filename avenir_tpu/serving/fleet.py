@@ -34,6 +34,17 @@ Fleet-level semantics:
     ``<id>,busy`` on the wire.  Every popped request is answered with
     SOMETHING (prediction, ``error``, or ``busy``) — no accepted
     request is ever dropped, fleet-wide.
+  * **horizontal tier** (ISSUE 13) — ``redis.server.endpoints`` listing
+    M broker shards makes every worker drain a
+    :class:`~avenir_tpu.io.respq.ShardedRespClient` ring (a dead shard
+    degrades that worker's ring with a ``Broker/BrokerShardDown``
+    counter in the merged dump); ``host_label`` stamps every metric
+    series and ``stats()`` so N fleets on N hosts scraped into one
+    registry stay disjoint; ``scale_to``/``add_worker`` are the
+    autoscaler's actuator — autoscale-parked workers keep their warm
+    compiled services resident (unpark is repointing traffic, not a
+    cold start), and the last worker can never be parked.  Run one
+    fleet per host with ``python -m avenir_tpu.serving.fleet_host``.
 """
 
 from __future__ import annotations
@@ -54,7 +65,7 @@ class _Worker:
     """One fleet member: service + wire connection + drain thread."""
 
     __slots__ = ("index", "name", "service", "client", "thread",
-                 "seen_gen", "pending")
+                 "seen_gen", "pending", "parked")
 
     def __init__(self, index: int, name: str, service: PredictionService):
         self.index = index
@@ -66,6 +77,10 @@ class _Worker:
         # (request_id, future) in submit order; service batches complete
         # in order, so FIFO head-flush is completion order
         self.pending: "deque[tuple]" = deque()
+        # autoscaler parking: a parked worker stops PULLING but keeps
+        # its warm service (compiled buckets resident) so unparking is
+        # instant — distinct from degraded parking (health stays OK)
+        self.parked = threading.Event()
 
 
 class ServingFleet:
@@ -90,7 +105,8 @@ class ServingFleet:
                  latency_window: int = 8192,
                  idle_sleep_s: float = 0.002,
                  max_idle_sleep_s: float = 0.05,
-                 quantized: bool = False):
+                 quantized: bool = False,
+                 host_label: Optional[str] = None):
         if predictor_factory is None and (registry is None
                                           or model_name is None):
             raise ValueError("need registry= + model_name=, or "
@@ -114,17 +130,32 @@ class ServingFleet:
         self.max_idle_sleep_s = float(max_idle_sleep_s)
         self.host = cfg.get("redis.server.host", "127.0.0.1")
         self.port = int(cfg.get("redis.server.port", 6379))
+        # the broker ring: with redis.server.endpoints listing M shards
+        # every worker drains through a ShardedRespClient (consistent-
+        # hash fan-out); single host/port keeps the plain client
+        self._wire_cfg = cfg
         self.request_q = cfg.get("redis.request.queue", "requestQueue")
         self.prediction_q = cfg.get("redis.prediction.queue",
                                     "predictionQueue")
+        # multi-host identity: labels every worker's metric series and
+        # rides stats() so N fleets scraped into one registry stay
+        # disjoint (None = single-host, this process's hostname)
+        import socket as _socket
+        self.host_label = host_label or _socket.gethostname()
         self._reload_gen = 0
         self._stop = threading.Event()
+        # set alongside _stop ONLY by a wire 'stop': gates the
+        # drain-then-stop ring sweep.  A programmatic stop() means
+        # "stop pulling" — it must not start draining the whole broker.
+        self._wire_stop = False
+        self._scale_lock = threading.Lock()
         self.workers: List[_Worker] = []
 
     # ---- lifecycle ----
     def _make_service(self, wname: str) -> PredictionService:
         common = dict(policy=self.policy, warm=self._warm,
                       delim=self.delim, name=wname,
+                      host_label=self.host_label,
                       counters=Counters(),
                       timer=StepTimer(keep_samples=self._latency_window),
                       metrics=self._metrics)
@@ -136,17 +167,26 @@ class ServingFleet:
                                  buckets=self._buckets,
                                  quantized=self._quantized, **common)
 
+    def _make_client(self, counters=None):
+        from ..io.respq import make_queue_client
+        cfg = dict(self._wire_cfg)
+        cfg.setdefault("redis.server.host", self.host)
+        cfg.setdefault("redis.server.port", self.port)
+        # the worker's counters ride into the sharded client so a dead
+        # broker shard lands as Broker/BrokerShardDown in the fleet's
+        # merged dump
+        return make_queue_client(cfg, delim=self.delim, counters=counters)
+
     def start(self) -> "ServingFleet":
         if self.workers:
             return self
-        from ..io.respq import RespClient
         self._stop.clear()
         base = self.model_name or "fleet"
         for i in range(self.n_workers):
             wname = f"{base}-w{i}"
             w = _Worker(i, wname, self._make_service(wname))
             w.service.start()
-            w.client = RespClient(self.host, self.port)
+            w.client = self._make_client(w.service.counters)
             self.workers.append(w)
         # connect everything before pulling: a worker that starts draining
         # while a peer is still warming would skew the first measurements
@@ -156,6 +196,51 @@ class ServingFleet:
                                         name=f"avenir-fleet-{w.name}")
             w.thread.start()
         return self
+
+    # ---- the autoscaler's actuator surface ----
+    def _add_worker_locked(self) -> "_Worker":
+        i = len(self.workers)
+        wname = f"{self.model_name or 'fleet'}-w{i}"
+        w = _Worker(i, wname, self._make_service(wname))
+        w.service.start()
+        w.client = self._make_client(w.service.counters)
+        self.workers.append(w)
+        w.thread = threading.Thread(target=self._drain, args=(w,),
+                                    daemon=True,
+                                    name=f"avenir-fleet-{w.name}")
+        w.thread.start()
+        return w
+
+    def add_worker(self) -> "_Worker":
+        """Grow the fleet by one live worker mid-run (warm-started: the
+        service compiles its buckets before the drain thread pulls)."""
+        with self._scale_lock:
+            return self._add_worker_locked()
+
+    def active_workers(self) -> int:
+        return sum(1 for w in self.workers if not w.parked.is_set())
+
+    def scale_to(self, n: int) -> int:
+        """Set the ACTIVE (pulling) worker count — the autoscaler's
+        actuator.  Scale-up unparks before it adds: a parked worker
+        keeps its warm per-worker predictor cache (service thread +
+        compiled bucket executables stay resident), so re-admitting it
+        is repointing traffic, not a cold start — the Execution
+        Templates control-plane/data-plane split applied to serving.
+        Scale-down parks the tail workers (they flush everything
+        already accepted first — parking never drops a request).  Never
+        parks the last worker.  Returns the new active count."""
+        n = max(1, int(n))
+        with self._scale_lock:
+            if self.workers:
+                while len(self.workers) < n:
+                    self._add_worker_locked()
+            for i, w in enumerate(self.workers):
+                if i < n:
+                    w.parked.clear()
+                else:
+                    w.parked.set()
+            return self.active_workers()
 
     def request_reload(self) -> None:
         """Coordinated hot-swap: every worker refreshes from the shared
@@ -197,11 +282,15 @@ class ServingFleet:
         hot-swap), queue depths, degraded flags."""
         per = {w.name: w.service.stats() for w in self.workers}
         return {
+            "host": self.host_label,
             "workers": len(self.workers),
+            "active_workers": self.active_workers(),
+            "parked": {w.name: w.parked.is_set() for w in self.workers},
             "reload_generation": self._reload_gen,
             "served": sum(s["served"] for s in per.values()),
             "rejected": sum(s["rejected"] for s in per.values()),
             "errors": sum(s["errors"] for s in per.values()),
+            "queue_depth": sum(s["queue_depth"] for s in per.values()),
             "model_versions": {n: s["model_version"]
                                for n, s in per.items()},
             "per_worker": per,
@@ -227,9 +316,12 @@ class ServingFleet:
 
     def merged_timer(self) -> StepTimer:
         """One StepTimer holding every worker's latency samples (fleet
-        percentiles; per-worker percentiles stay on each service)."""
+        percentiles; per-worker percentiles stay on each service).
+        Sized by the LIVE worker count, not the constructed one — an
+        autoscaled fleet that grew past n_workers must not evict the
+        early workers' samples from the merged window."""
         merged = StepTimer(keep_samples=self._latency_window
-                           * max(1, self.n_workers))
+                           * max(1, len(self.workers) or self.n_workers))
         for w in self.workers:
             for name, dq in list(w.service.timer.samples.items()):
                 # the worker's predict thread appends concurrently; a
@@ -262,23 +354,51 @@ class ServingFleet:
                             f"({type(exc).__name__}: {exc}); serving "
                             f"stays on version {svc.version}",
                             RuntimeWarning)
-                if svc.degraded is not None and \
-                        any(p.service.degraded is None
-                            for p in self.workers if p is not w):
-                    # a degraded worker stops pulling WHILE a healthy
-                    # peer keeps draining: answer what it already
-                    # accepted, then park (a hot-swap clears the flag
-                    # via refresh above).  When EVERY worker is degraded
-                    # the last one keeps serving (flagged, /healthz 503)
-                    # — otherwise nobody could ever pop the wire
-                    # 'reload' that is the documented recovery path, and
-                    # the whole queue would wedge unanswered.
+                if w.parked.is_set() and \
+                        any(not p.parked.is_set() for p in self.workers
+                            if p is not w):
+                    # autoscaler parking: stop pulling, answer what was
+                    # already accepted, keep the warm service resident
+                    # for the unpark.  Like degraded parking, never the
+                    # last worker (scale_to can't park it, but guard
+                    # against racing list mutation anyway).
                     self._flush(w, wait=True)
                     svc.counters.increment("Serving", "ParkedPolls")
                     time.sleep(self.max_idle_sleep_s)
                     continue
-                msgs = w.client.rpop_many(self.request_q,
-                                          svc.policy.max_batch)
+                if svc.degraded is not None and \
+                        any(p.service.degraded is None
+                            and not p.parked.is_set()
+                            for p in self.workers if p is not w):
+                    # a degraded worker stops pulling WHILE a healthy
+                    # UNPARKED peer keeps draining: answer what it
+                    # already accepted, then park (a hot-swap clears the
+                    # flag via refresh above).  When every other worker
+                    # is degraded OR autoscale-parked the last active
+                    # one keeps serving (flagged, /healthz 503) —
+                    # otherwise a scaled-down fleet whose sole active
+                    # worker degrades would have NOBODY pulling (parked
+                    # peers wait for an active one, the degraded one
+                    # waits for a healthy peer) and the queue would
+                    # wedge unanswered, unreachable even by the wire
+                    # 'reload' recovery path.
+                    self._flush(w, wait=True)
+                    svc.counters.increment("Serving", "ParkedPolls")
+                    time.sleep(self.max_idle_sleep_s)
+                    continue
+                try:
+                    msgs = w.client.rpop_many(self.request_q,
+                                              svc.policy.max_batch)
+                except (ConnectionError, OSError, RuntimeError) as exc:
+                    # a sharded client degrades around ONE dead shard on
+                    # its own; reaching here means the whole broker tier
+                    # is unreachable — answer what was accepted and exit
+                    # this worker with a structured warning
+                    warnings.warn(
+                        f"fleet {w.name}: broker unreachable "
+                        f"({type(exc).__name__}: {exc}); worker exiting",
+                        RuntimeWarning)
+                    break
                 svc.counters.increment("Serving", "Polls")
                 if msgs:
                     sleep_s = self.idle_sleep_s
@@ -290,13 +410,47 @@ class ServingFleet:
                     # the park short while replies are still pending so
                     # a batch finishing mid-park is flushed promptly
                     park = 0.001 if w.pending else sleep_s
-                    v = w.client.brpop(self.request_q, timeout_s=park)
+                    try:
+                        v = w.client.brpop(self.request_q, timeout_s=park)
+                    except (ConnectionError, OSError,
+                            RuntimeError) as exc:
+                        warnings.warn(
+                            f"fleet {w.name}: broker unreachable "
+                            f"({type(exc).__name__}: {exc}); worker "
+                            f"exiting", RuntimeWarning)
+                        break
                     if v is not None:
                         sleep_s = self.idle_sleep_s
                         self._ingest(w, [v])
                     elif not w.pending:
                         sleep_s = min(sleep_s * 2.0, self.max_idle_sleep_s)
                 self._flush(w, wait=False)
+            # drain-then-stop: the single-queue FIFO invariant
+            # ("everything queued before the stop was already popped")
+            # does NOT hold across a shard ring — the stop lands on ONE
+            # shard while tail requests sit on others.  Sweep the ring
+            # empty before exiting so a WIRE stop never strands
+            # accepted traffic (a surplus stop swept up here is
+            # re-pushed for its own fleet by _ingest; a programmatic
+            # stop() does not sweep — it means "stop pulling").
+            if self._wire_stop:
+                try:
+                    while True:
+                        msgs = w.client.rpop_many(self.request_q,
+                                                  svc.policy.max_batch)
+                        if not msgs:
+                            break
+                        # requests get answered; surplus stops are
+                        # re-pushed for their own fleets by _ingest
+                        self._ingest(w, msgs)
+                        self._flush(w, wait=False)
+                        if all(m == "stop" for m in msgs):
+                            break   # only (re-pushed) stops remain —
+                            # don't ping-pong with our own re-push
+                except (ConnectionError, OSError, RuntimeError) as exc:
+                    warnings.warn(
+                        f"fleet {w.name}: stop-drain sweep cut short "
+                        f"({type(exc).__name__}: {exc})", RuntimeWarning)
         finally:
             # answer everything this worker accepted before it exits —
             # the no-drop guarantee holds through 'stop' and crashes
@@ -314,11 +468,34 @@ class ServingFleet:
                 # fleet-wide: peers see the event at their next poll.
                 # Everything queued BEFORE the stop was already popped
                 # (FIFO) by someone and will be answered.
-                self._stop.set()
+                if self._stop.is_set():
+                    # a SECOND stop drained by this fleet was aimed at
+                    # another fleet process (multi-host topologies push
+                    # one per host): put it back instead of eating it
+                    try:
+                        w.client.lpush(self.request_q, "stop")
+                    except Exception:
+                        pass
+                else:
+                    self._wire_stop = True
+                    self._stop.set()
                 continue
             parts = m.split(svc.delim)
             if parts[0] == "reload":
-                self.request_reload()
+                # 'reload' (unaddressed) swaps THIS fleet;
+                # 'reload,<host_label>' is multi-host convergence: one
+                # addressed copy per host (ShardedRespClient.broadcast
+                # alone cannot converge N hosts — one host's workers,
+                # parked across every shard, can pop all the copies).
+                # A copy addressed to a peer host is re-pushed for it.
+                if len(parts) > 1 and parts[1] \
+                        and parts[1] != self.host_label:
+                    try:
+                        w.client.lpush(self.request_q, m)
+                    except Exception:
+                        pass
+                else:
+                    self.request_reload()
             elif parts[0] == "predict" and len(parts) >= 3:
                 # admission happens inside submit(): past the depth
                 # threshold the future comes back already resolved

@@ -1,0 +1,167 @@
+"""One fleet HOST as a runnable OS process: the multi-host serve-out unit.
+
+    python -m avenir_tpu.serving.fleet_host \
+        --registry <dir> --model <name> \
+        --endpoints host:port[,host:port...] \
+        [--workers N] [--host-label h] [--batching continuous|drain] \
+        [--max-batch 64] [--max-wait-ms 2.0] [--slo-p99-ms 0] \
+        [--max-queue-depth 0] [--buckets 8,64] \
+        [--autoscale MIN:MAX] [--autoscale-interval-s 0.25] \
+        [--request-queue rq] [--prediction-queue pq] \
+        [--max-idle-s 30] [--metrics-port -1] [--stats-out file.json]
+
+Starts a :class:`~avenir_tpu.serving.fleet.ServingFleet` (optionally
+under a :class:`~avenir_tpu.serving.autoscaler.FleetAutoscaler`)
+draining the given broker ring against the SHARED registry directory,
+and exits on a wire ``stop`` message or after ``--max-idle-s`` without
+traffic — whichever first.  On exit it prints ONE JSON line of fleet
+stats + merged counters to stdout (and to ``--stats-out`` when given),
+so a parent process — the multi-process saturation bench, the
+two-process test lane — can collect per-host served/rejected tallies.
+
+This is the data-plane process of the horizontal tier: N of these on N
+hosts, all pointed at the same broker endpoints and the same published
+registry (a shared filesystem, like the training shards' inputs).  The
+PR 10 generation-counter hot-swap converges per host: push one
+ADDRESSED ``reload,<host_label>`` per host (a fleet that pops a copy
+addressed to a peer re-pushes it) — a bare broadcast 'reload' cannot
+converge N hosts, because one host's workers, parked across every
+shard, can pop all the copies.
+
+``--metrics-port``: -1 = no endpoint, 0 = ephemeral (printed on
+stderr), >0 = fixed — the off-host ``/metrics`` + ``/healthz`` bind
+from PR 8 (set ``--metrics-host 0.0.0.0`` to expose beyond loopback).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _parse_args(argv):
+    ap = argparse.ArgumentParser(prog="fleet_host", description=__doc__)
+    ap.add_argument("--registry", required=True)
+    ap.add_argument("--model", required=True)
+    ap.add_argument("--endpoints", required=True,
+                    help="comma-separated broker shard host:port list")
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--host-label", default=None)
+    ap.add_argument("--batching", default="continuous",
+                    choices=("continuous", "drain"))
+    ap.add_argument("--max-batch", type=int, default=64)
+    ap.add_argument("--max-wait-ms", type=float, default=2.0)
+    ap.add_argument("--slo-p99-ms", type=float, default=0.0)
+    ap.add_argument("--max-queue-depth", type=int, default=0)
+    ap.add_argument("--buckets", default="8,64")
+    ap.add_argument("--autoscale", default=None, metavar="MIN:MAX",
+                    help="enable the autoscaler between MIN and MAX "
+                         "active workers (workers start at MIN)")
+    ap.add_argument("--autoscale-interval-s", type=float, default=0.25)
+    ap.add_argument("--request-queue", default="requestQueue")
+    ap.add_argument("--prediction-queue", default="predictionQueue")
+    ap.add_argument("--max-idle-s", type=float, default=30.0)
+    ap.add_argument("--metrics-port", type=int, default=-1)
+    ap.add_argument("--metrics-host", default="127.0.0.1")
+    ap.add_argument("--stats-out", default=None)
+    ap.add_argument("--ready-file", default=None,
+                    help="touched once the fleet is draining — a parent "
+                         "orchestrating several hosts waits on these "
+                         "before offering load, so a slow-starting host "
+                         "isn't measured as absent")
+    return ap.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = _parse_args(argv)
+    from ..core.platform import force_platform
+    force_platform()
+    from . import (AutoscalePolicy, BatchPolicy, FleetAutoscaler,
+                   ModelRegistry, ServingFleet)
+    from ..io.respq import make_queue_client
+
+    wire_cfg = {"redis.server.endpoints": args.endpoints,
+                "redis.request.queue": args.request_queue,
+                "redis.prediction.queue": args.prediction_queue}
+    scale = None
+    n_workers = args.workers
+    if args.autoscale:
+        lo, _, hi = args.autoscale.partition(":")
+        scale = (int(lo), int(hi or lo))
+        n_workers = scale[0]
+    policy = BatchPolicy(max_batch=args.max_batch,
+                         max_wait_ms=args.max_wait_ms,
+                         batching=args.batching,
+                         slo_p99_ms=args.slo_p99_ms,
+                         max_queue_depth=args.max_queue_depth)
+    registry = ModelRegistry(args.registry)
+    metrics = msrv = None
+    if args.metrics_port >= 0:
+        from ..telemetry import MetricsRegistry, MetricsServer
+        metrics = MetricsRegistry()
+        msrv = MetricsServer(metrics, port=args.metrics_port,
+                             host=args.metrics_host).start()
+        print(f"fleet_host: /metrics on {msrv.url}", file=sys.stderr)
+    fleet = ServingFleet(
+        registry, args.model,
+        buckets=tuple(int(b) for b in args.buckets.split(",")),
+        policy=policy, n_workers=n_workers, config=wire_cfg,
+        host_label=args.host_label, metrics=metrics)
+    fleet.start()
+    scaler = sensor = None
+    if scale is not None:
+        # the sensor needs its OWN broker connection (clients are
+        # one-per-thread); autoscale SLO defaults to the batch policy's
+        sensor = make_queue_client(wire_cfg, delim=fleet.delim)
+        scaler = FleetAutoscaler(
+            fleet, sensor, queue=args.request_queue,
+            policy=AutoscalePolicy(min_workers=scale[0],
+                                   max_workers=scale[1],
+                                   slo_p99_ms=args.slo_p99_ms),
+            interval_s=args.autoscale_interval_s,
+            counters=fleet.workers[0].service.counters).start()
+    rc = 0
+    try:
+        if args.ready_file:
+            with open(args.ready_file, "w") as fh:
+                fh.write("ready\n")
+        # wait for a wire stop (fleet.wait returns once every drain
+        # thread exited) or the idle timeout
+        idle_since = time.monotonic()
+        last_served = -1
+        while not fleet.wait(timeout_s=0.5):
+            served = fleet.stats()["served"]
+            if served != last_served:
+                last_served = served
+                idle_since = time.monotonic()
+            elif time.monotonic() - idle_since > args.max_idle_s:
+                print(f"fleet_host: idle {args.max_idle_s}s, exiting",
+                      file=sys.stderr)
+                break
+    finally:
+        if scaler is not None:
+            scaler.stop()
+        fleet.stop()
+        stats = fleet.stats()
+        stats["counters"] = fleet.merged_counters().as_dict()
+        if scaler is not None:
+            stats["autoscaler"] = {
+                "decisions": len(scaler.decisions),
+                "final_active": fleet.active_workers(),
+            }
+        line = json.dumps(stats, sort_keys=True)
+        print(line)
+        if args.stats_out:
+            with open(args.stats_out, "w") as fh:
+                fh.write(line + "\n")
+        if sensor is not None:
+            sensor.close()
+        if msrv is not None:
+            msrv.stop()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

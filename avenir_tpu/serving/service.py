@@ -146,6 +146,7 @@ class PredictionService:
                  error_label: str = "error",
                  busy_label: str = "busy",
                  name: Optional[str] = None,
+                 host_label: Optional[str] = None,
                  monitor=None,
                  metrics=None,
                  quantized: bool = False):
@@ -171,6 +172,12 @@ class PredictionService:
         # identity for metrics/health series (fleet workers get w0/w1/...);
         # defaults to the model name in bind_metrics
         self.name = name
+        # multi-host identity: every bound gauge series carries a `host`
+        # label (empty when unset) so N fleets on N hosts scraped into
+        # one Prometheus land as DISJOINT series — the same fix shape as
+        # the PR 8 `service` label, one level up.  ServingFleet threads
+        # its host_label through here.
+        self.host_label = host_label
         self.version: Optional[int] = None
         # drift/quality hook (monitor.accumulator.ServingMonitor): every
         # served micro-batch records through it; None = unmonitored
@@ -284,6 +291,7 @@ class PredictionService:
             "window_ms": self._adaptive_wait_ms,
             "degraded": self.degraded,
             "model_version": self.version,
+            "host": self.host_label or "",
         }
 
     def health(self):
@@ -318,57 +326,78 @@ class PredictionService:
         # health keys — own key was just unbound above, so rebinding
         # the SAME service reclaims its label.
         base = self.name or self.model_name or "predictor"
+        # the host label makes multi-HOST series disjoint; unset renders
+        # as host="" (single-process serving, the pre-fleet shape).  A
+        # host-labeled service's health key is host-qualified too, so
+        # two fleets with identical worker names on one registry (two
+        # hosts scraped centrally) keep both providers — /healthz/<name>
+        # still reaches them by bare worker name or by <host>:<name>
+        # (telemetry.MetricsRegistry.health_one's suffix match).
+        host = self.host_label or ""
+
+        def _health_key(label: str) -> str:
+            return f"serving:{host}:{label}" if host \
+                else f"serving:{label}"
         svc_label, n = base, 1
-        while registry.has_health(f"serving:{svc_label}"):
+        while registry.has_health(_health_key(svc_label)):
             svc_label = f"{base}-{n}"
             n += 1
         g = registry.gauge("avenir_serving", "prediction service state",
-                           labels=("service", "key"))
+                           labels=("host", "service", "key"))
         gl = registry.gauge("avenir_serving_latency_ms",
                             "serving latency percentiles",
-                            labels=("service", "step", "quantile"))
+                            labels=("host", "service", "step", "quantile"))
 
         def probe():
             st = self.stats()
-            g.set(st["queue_depth"], service=svc_label, key="queue_depth")
-            g.set(st["in_flight"], service=svc_label, key="in_flight")
-            g.set(st["served"], service=svc_label, key="served")
-            g.set(st["errors"], service=svc_label, key="errors")
-            g.set(st["batches"], service=svc_label, key="batches")
-            g.set(st["hot_swaps"], service=svc_label, key="hot_swaps")
-            g.set(st["rejected"], service=svc_label, key="rejected")
-            g.set(st["window_ms"], service=svc_label, key="window_ms")
+            g.set(st["queue_depth"], host=host, service=svc_label,
+                  key="queue_depth")
+            g.set(st["in_flight"], host=host, service=svc_label,
+                  key="in_flight")
+            g.set(st["served"], host=host, service=svc_label, key="served")
+            g.set(st["errors"], host=host, service=svc_label, key="errors")
+            g.set(st["batches"], host=host, service=svc_label,
+                  key="batches")
+            g.set(st["hot_swaps"], host=host, service=svc_label,
+                  key="hot_swaps")
+            g.set(st["rejected"], host=host, service=svc_label,
+                  key="rejected")
+            g.set(st["window_ms"], host=host, service=svc_label,
+                  key="window_ms")
             g.set(0 if st["degraded"] is None else 1,
-                  service=svc_label, key="degraded")
+                  host=host, service=svc_label, key="degraded")
             g.set(st["model_version"] or 0,
-                  service=svc_label, key="model_version")
+                  host=host, service=svc_label, key="model_version")
             for step in ("serve.request", "serve.batch"):
                 if self.timer.samples.get(step):
                     for q in (50, 95, 99):
                         gl.set(self.timer.percentile_ms(step, q),
-                               service=svc_label, step=step,
+                               host=host, service=svc_label, step=step,
                                quantile=f"p{q}")
         registry.register_probe(probe)
-        health_key = f"serving:{svc_label}"
+        health_key = _health_key(svc_label)
         registry.add_health(health_key, self.health)
         # remembered so stop() can unbind: a retired service must not be
         # probed (and thereby pinned in memory, predictor and all) by
         # every scrape for the rest of the process
         self._metrics_binding = (registry, probe, health_key,
-                                 (g, gl), svc_label)
+                                 (g, gl), {"host": host,
+                                           "service": svc_label})
 
     def _unbind_metrics(self) -> None:
         if self._metrics_binding is not None:
-            reg, probe, health_key, families, svc_label = \
+            reg, probe, health_key, families, ident = \
                 self._metrics_binding
             self._metrics_binding = None
             reg.unregister_probe(probe)
             reg.remove_health(health_key)
             # drop the bound label series too: without this, the dead
             # service's last-written gauges (degraded=1, queue_depth, …)
-            # keep rendering in every later scrape as if they were live
+            # keep rendering in every later scrape as if they were live.
+            # Matched on (host, service): another host's same-named
+            # worker on a shared registry must keep its series.
             for fam in families:
-                fam.drop_series(service=svc_label)
+                fam.drop_series(**ident)
 
     # ---- prediction ----
     def _label(self, pred: Optional[str]) -> str:

@@ -354,9 +354,13 @@ class MetricsRegistry:
             self._health.pop(name, None)
 
     def health_one(self, name: str) -> Optional[Tuple[bool, dict]]:
-        """Run ONE health provider — looked up by its exact key or by the
+        """Run ONE health provider — looked up by its exact key, by the
         key minus a ``<kind>:`` prefix (so ``/healthz/churn-w0`` reaches
-        the provider registered as ``serving:churn-w0``).  None when no
+        the provider registered as ``serving:churn-w0``), or by the
+        LAST ``:`` segment (so the same probe reaches a host-qualified
+        ``serving:<host>:churn-w0``; with several hosts sharing one
+        registry, disambiguate with ``/healthz/<host>:churn-w0`` — the
+        prefix-stripped match).  First match wins.  None when no
         provider matches: the per-worker probe a load balancer points at
         one fleet member, where the aggregate :meth:`health` would flip
         every worker's target on one degraded peer."""
@@ -364,7 +368,8 @@ class MetricsRegistry:
             fn = self._health.get(name)
             if fn is None:
                 for key, cand in self._health.items():
-                    if key.split(":", 1)[-1] == name:
+                    if key.split(":", 1)[-1] == name \
+                            or key.rsplit(":", 1)[-1] == name:
                         fn = cand
                         break
         if fn is None:

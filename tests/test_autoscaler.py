@@ -1,0 +1,420 @@
+"""SLO-driven fleet autoscaler (ISSUE 13): sensor→policy→actuator.
+
+The policy is pure and unit-tested with synthetic sensors (hysteresis
+bands, consecutive-tick debounce, cooldown — the never-flaps contract);
+the actuator is pinned against a REAL fleet (scale_to parks/unparks
+warm workers, never below one, parked workers answer what they already
+accepted); the acceptance shape — a 10x offered-load spike whose p99
+returns within the SLO budget with no human action — is pinned twice:
+deterministically against a synthetic capacity model here, and at wall
+clock in the serve_forest bench."""
+
+import time
+
+import pytest
+
+from avenir_tpu.core.metrics import Counters
+from avenir_tpu.core.table import encode_rows
+from avenir_tpu.io.respq import RespServer, ShardedRespClient
+from avenir_tpu.serving import (AutoscalePolicy, BatchPolicy,
+                                FleetAutoscaler, ServingFleet)
+from avenir_tpu.serving.predictor import ForestPredictor
+from tests.test_fleet import drain_replies, make_fleet_registry
+from tests.test_serving import (forest_batch_predict, raw_rows_of,
+                                small_forest)
+from tests.test_tree import SCHEMA
+
+pytestmark = [pytest.mark.broker, pytest.mark.fleet]
+
+
+class FakeFleet:
+    """Actuator stub for policy unit tests: records every scale call."""
+
+    def __init__(self, active=1):
+        self.active = active
+        self.workers = []
+        self.request_q = "rq"
+        self.calls = []
+
+    def active_workers(self):
+        return self.active
+
+    def scale_to(self, n):
+        self.calls.append(n)
+        self.active = max(1, n)
+        return self.active
+
+
+def make_scaler(fleet, counters=None, **pol):
+    sensors = {"depth": 0, "p99": 0.0}
+    defaults = dict(min_workers=1, max_workers=4, slo_p99_ms=300.0,
+                    depth_high=32, depth_low=4, derivative_high=50.0,
+                    up_consecutive=2, down_consecutive=3,
+                    cooldown_ticks=2)
+    defaults.update(pol)
+    scaler = FleetAutoscaler(
+        fleet, policy=AutoscalePolicy(**defaults), counters=counters,
+        depth_fn=lambda: sensors["depth"],
+        p99_fn=lambda: sensors["p99"])
+    return scaler, sensors
+
+
+# --------------------------------------------------------------------------
+# policy: hysteresis
+# --------------------------------------------------------------------------
+
+def test_policy_never_flaps_inside_the_band():
+    """Readings oscillating BETWEEN the calm and pressure bands (the
+    ambiguous middle) produce zero actions over a long run — the
+    hysteresis hold, plus the between-band decay that stops ambiguous
+    spells banking ticks toward either action."""
+    fleet = FakeFleet(active=2)
+    scaler, sensors = make_scaler(fleet)
+    for i in range(200):
+        # bounce between the bands: above depth_low, below depth_high,
+        # p99 between 50% and 80% of budget
+        sensors["depth"] = 10 if i % 2 else 20
+        sensors["p99"] = 160.0 if i % 2 else 220.0
+        rec = scaler.tick()
+        assert rec["action"] == "hold"
+    assert fleet.calls == []
+    assert fleet.active == 2
+
+
+def test_policy_debounce_one_noisy_tick_never_scales():
+    """One pressure tick between calm ones never reaches
+    up_consecutive: a single noisy scrape cannot add a worker."""
+    fleet = FakeFleet(active=1)
+    scaler, sensors = make_scaler(fleet, up_consecutive=2)
+    for i in range(60):
+        sensors["depth"] = 500 if i % 3 == 0 else 0
+        sensors["p99"] = 0.0
+        scaler.tick()
+    assert fleet.calls == []
+
+
+def test_policy_spike_scales_to_max_and_calm_returns_to_min():
+    fleet = FakeFleet(active=1)
+    cnt = Counters()
+    scaler, sensors = make_scaler(fleet, counters=cnt)
+    sensors["depth"], sensors["p99"] = 500, 400.0
+    for _ in range(14):
+        scaler.tick()
+    assert fleet.active == 4                      # pinned at max_workers
+    sensors["depth"], sensors["p99"] = 0, 40.0
+    for _ in range(30):
+        scaler.tick()
+    assert fleet.active == 1                      # back to min_workers
+    d = cnt.as_dict()["Autoscaler"]
+    assert d["ScaleUps"] == 3 and d["ScaleDowns"] == 3
+    assert d["Ticks"] == 44 and d["ActiveWorkers"] == 1
+    # scale-down is deliberately slower than scale-up (late up costs
+    # SLO, late down costs only footprint)
+    assert scaler.policy.down_consecutive > scaler.policy.up_consecutive \
+        or scaler.policy.down_consecutive >= 3
+
+
+def test_policy_10x_spike_p99_returns_within_budget():
+    """The acceptance shape, deterministic: a synthetic capacity model
+    where p99 falls as workers are added (p99 = 10x-load pressure /
+    active).  The spike drives p99 to 4x budget; the scaler must bring
+    it back UNDER budget and then hold (no further actions) with no
+    external intervention."""
+    fleet = FakeFleet(active=1)
+    scaler, sensors = make_scaler(fleet, max_workers=6, slo_p99_ms=200.0)
+    spike_pressure = 800.0   # p99 ms at 1 worker under the 10x spike
+
+    def model_tick():
+        sensors["depth"] = int(400 / fleet.active)
+        sensors["p99"] = spike_pressure / fleet.active
+        return scaler.tick()
+
+    recs = [model_tick() for _ in range(40)]
+    # converged: p99 under budget, and the tail of the run is all holds
+    assert sensors["p99"] <= 200.0, \
+        f"p99 never recovered: {sensors['p99']}ms at {fleet.active}w"
+    tail = [r["action"] for r in recs[-8:]]
+    assert set(tail) == {"hold"}, f"still flapping at the end: {tail}"
+    # and the recovery was autonomous: scale-ups happened, no downs yet
+    assert fleet.active >= 5
+    assert all(c > 1 for c in fleet.calls)
+
+
+def test_floor_below_min_workers_scales_up_under_calm():
+    """A fleet started (or externally scaled) below min_workers is
+    brought up to the floor even under perfect calm — decide() only
+    scales up on pressure, so the floor is the tick's job."""
+    fleet = FakeFleet(active=1)
+    scaler, sensors = make_scaler(fleet, min_workers=3, max_workers=5)
+    rec = scaler.tick()          # depth 0, p99 0 — calm
+    assert rec["action"] == "up" and fleet.active == 3
+    for _ in range(10):
+        assert scaler.tick()["action"] == "hold"
+    assert fleet.active == 3
+
+
+def test_degraded_sole_active_worker_keeps_serving_when_peers_parked(
+        tmp_path, mesh_ctx, resp_server):
+    """The degraded/parked wedge: a fleet scaled down to one active
+    worker whose service then degrades must KEEP serving (flagged) —
+    parked peers wait for an active one and the degraded one must not
+    wait on peers that are parked, or nobody pulls and the queue wedges
+    unanswered forever."""
+    reg, table, models = make_fleet_registry(tmp_path, mesh_ctx)
+    rows = raw_rows_of(table, 8)
+    expect = forest_batch_predict(models, encode_rows(rows, SCHEMA))
+    fleet = ServingFleet(reg, "churn", buckets=(8,),
+                         policy=BatchPolicy(max_batch=8, max_wait_ms=1.0),
+                         n_workers=3,
+                         config={"redis.server.port": resp_server.port})
+    fleet.start()
+    from avenir_tpu.io.respq import RespClient
+    feeder = RespClient(port=resp_server.port)
+    try:
+        assert fleet.scale_to(1) == 1          # workers 1,2 parked
+        fleet.workers[0].service.mark_degraded("drift")
+        feeder.lpush_many("requestQueue",
+                          [",".join(["predict", str(i)] + rows[i])
+                           for i in range(8)])
+        got = drain_replies(feeder, "predictionQueue", 8, timeout_s=30.0)
+        assert sorted(got, key=int) == [str(i) for i in range(8)], \
+            "degraded sole-active worker stopped pulling (wedge)"
+        for i in range(8):
+            assert got[str(i)] == [expect[i]]
+        # parked peers stayed parked (they did not serve this)
+        assert fleet.stats()["active_workers"] == 1
+    finally:
+        fleet.stop()
+        feeder.close()
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError, match="min_workers"):
+        AutoscalePolicy(min_workers=0)
+    with pytest.raises(ValueError, match="max_workers"):
+        AutoscalePolicy(min_workers=3, max_workers=2)
+    with pytest.raises(ValueError, match="band inverted"):
+        AutoscalePolicy(depth_low=64, depth_high=64)
+    with pytest.raises(ValueError, match="fractions"):
+        AutoscalePolicy(slo_p99_ms=100.0, p99_low_fraction=0.9,
+                        p99_high_fraction=0.8)
+
+
+def test_decisions_are_traced_instants(tmp_path):
+    """Every tick — holds included — lands as an autoscaler.decision
+    instant with the sensed values, so tracetool can replay WHY the
+    fleet scaled."""
+    from avenir_tpu import telemetry as T
+    from avenir_tpu.telemetry.trace import read_trace_file
+    fleet = FakeFleet(active=1)
+    scaler, sensors = make_scaler(fleet)
+    tr = T.install_tracer(T.Tracer(str(tmp_path / "traces"),
+                                   run_id="as", process_index=0))
+    try:
+        sensors["depth"] = 500
+        for _ in range(5):
+            scaler.tick()
+    finally:
+        tr.close()
+        T.uninstall_tracer()
+    evs = [e for e in read_trace_file(tr.path)
+           if e.get("ph") == "i" and e.get("name") ==
+           "autoscaler.decision"]
+    assert len(evs) == 5
+    acts = [e["args"]["action"] for e in evs]
+    assert "up" in acts and "hold" in acts
+    for e in evs:
+        assert {"depth", "derivative_per_s", "p99_ms", "active",
+                "new_active"} <= set(e["args"])
+    # and tracetool summarize replays the decision log from that trace
+    import os
+    import subprocess
+    import sys
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.dirname(
+             os.path.abspath(__file__))), "tools", "tracetool.py"),
+         "summarize", tr.path],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "autoscaler decisions (5 ticks" in out.stdout
+    assert "up    active 1->2" in out.stdout
+    assert "hold tick(s)" in out.stdout
+
+
+# --------------------------------------------------------------------------
+# actuator: the real fleet
+# --------------------------------------------------------------------------
+
+@pytest.fixture()
+def resp_server():
+    server = RespServer().start()
+    yield server
+    server.stop()
+
+
+def test_fleet_scale_to_parks_and_unparks(tmp_path, mesh_ctx,
+                                          resp_server):
+    """scale_to is the warm actuator: parking stops a worker pulling
+    (ParkedPolls) while its peer answers everything; unparking rejoins
+    it with its warm service; growing past the built count adds live
+    workers; the last worker can never be parked."""
+    reg, table, models = make_fleet_registry(tmp_path, mesh_ctx)
+    rows = raw_rows_of(table, 20)
+    expect = forest_batch_predict(models, encode_rows(rows, SCHEMA))
+    fleet = ServingFleet(reg, "churn", buckets=(8,),
+                         policy=BatchPolicy(max_batch=8, max_wait_ms=1.0),
+                         n_workers=2,
+                         config={"redis.server.port": resp_server.port})
+    fleet.start()
+    from avenir_tpu.io.respq import RespClient
+    feeder = RespClient(port=resp_server.port)
+    try:
+        assert fleet.active_workers() == 2
+        assert fleet.scale_to(1) == 1
+        w1 = fleet.workers[1]
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and \
+                w1.service.counters.get("Serving", "ParkedPolls") == 0:
+            time.sleep(0.01)
+        assert w1.service.counters.get("Serving", "ParkedPolls") > 0
+        polls_before = w1.service.counters.get("Serving", "Polls")
+        feeder.lpush_many("requestQueue",
+                          [",".join(["predict", str(i)] + rows[i % 20])
+                           for i in range(40)])
+        got = drain_replies(feeder, "predictionQueue", 40)
+        assert sorted(got, key=int) == [str(i) for i in range(40)]
+        for i in range(40):
+            assert got[str(i)] == [expect[i % 20]]
+        assert w1.service.counters.get("Serving", "Polls") == \
+            polls_before, "a parked worker kept pulling"
+        # unpark + grow: three active, the new worker drains too
+        assert fleet.scale_to(3) == 3
+        assert len(fleet.workers) == 3
+        feeder.lpush_many("requestQueue",
+                          [",".join(["predict", str(i)] + rows[i % 20])
+                           for i in range(40, 80)])
+        got = drain_replies(feeder, "predictionQueue", 40)
+        assert sorted(got, key=int) == [str(i) for i in range(40, 80)]
+        # floor: scale_to(0) clamps to one active worker
+        assert fleet.scale_to(0) == 1
+        assert fleet.stats()["active_workers"] == 1
+        assert fleet.stats()["parked"]["churn-w1"] is True
+    finally:
+        fleet.stop()
+        feeder.close()
+
+
+def _slow_forest_factory(models, delay_s):
+    class _Slow:
+        def __init__(self):
+            self.inner = ForestPredictor(models, SCHEMA, buckets=(8,))
+
+        def warm(self):
+            self.inner.warm()
+            return self
+
+        def predict_rows(self, rows):
+            time.sleep(delay_s)
+            return self.inner.predict_rows(rows)
+    return _Slow
+
+
+def test_autoscaler_scales_real_fleet_under_burst(mesh_ctx, resp_server):
+    """End to end on a live fleet: a slow predictor + a burst builds
+    real broker depth, the autoscaler (fast ticks) adds workers, the
+    burst drains with every request answered exactly once, and the
+    fleet parks back down to one worker afterwards."""
+    table, models = small_forest(mesh_ctx, n=200, trees=3, depth=2)
+    rows = raw_rows_of(table, 20)
+    expect = forest_batch_predict(models, encode_rows(rows, SCHEMA))
+    fleet = ServingFleet(
+        predictor_factory=_slow_forest_factory(models, 0.03),
+        policy=BatchPolicy(max_batch=8, max_wait_ms=1.0),
+        n_workers=1,
+        config={"redis.server.port": resp_server.port})
+    fleet.start()
+    cnt = Counters()
+    from avenir_tpu.io.respq import RespClient
+    sensor = RespClient(port=resp_server.port)
+    feeder = RespClient(port=resp_server.port)
+    scaler = FleetAutoscaler(
+        fleet, sensor, queue="requestQueue",
+        policy=AutoscalePolicy(min_workers=1, max_workers=3,
+                               depth_high=20, depth_low=2,
+                               up_consecutive=2, down_consecutive=4,
+                               cooldown_ticks=1),
+        interval_s=0.05, counters=cnt).start()
+    try:
+        n = 240
+        feeder.lpush_many("requestQueue",
+                          [",".join(["predict", str(i)] + rows[i % 20])
+                           for i in range(n)])
+        got = drain_replies(feeder, "predictionQueue", n, timeout_s=120.0)
+        assert sorted(got, key=int) == [str(i) for i in range(n)]
+        assert all(len(v) == 1 for v in got.values()), "duplicated reply"
+        for i in range(n):
+            assert got[str(i)] == [expect[i % 20]]
+        assert cnt.get("Autoscaler", "ScaleUps") >= 1, \
+            "the burst never scaled the fleet up"
+        peak = len(fleet.workers)
+        assert peak >= 2
+        # drained: the calm path parks back down to min_workers
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and fleet.active_workers() > 1:
+            time.sleep(0.05)
+        assert fleet.active_workers() == 1, \
+            "autoscaler never scaled back down after the drain"
+        assert cnt.get("Autoscaler", "ScaleDowns") >= 1
+    finally:
+        scaler.stop()
+        fleet.stop()
+        sensor.close()
+        feeder.close()
+
+
+def test_cli_job_autoscale(tmp_path, mesh_ctx):
+    """predictionService with ps.autoscale: replay is still exact, the
+    Autoscaler counter group lands in the dump, and the final active
+    count respects the bounds."""
+    from avenir_tpu.core.config import Config
+    from avenir_tpu.cli import serving_jobs  # noqa: F401
+    from avenir_tpu.cli.jobs import resolve
+    from tests.test_serving import _train_forest_via_cli
+    from tests.test_tree import make_table
+    reg_dir = tmp_path / "registry"
+    schema_path, trees = _train_forest_via_cli(tmp_path, reg_dir)
+    req_rows = raw_rows_of(make_table(40, seed=33), 40)
+    expect = forest_batch_predict(trees, encode_rows(req_rows, SCHEMA))
+    req_path = tmp_path / "requests.csv"
+    req_path.write_text("\n".join(",".join(r) for r in req_rows) + "\n")
+    job = resolve("predictionService")
+    out_dir = tmp_path / "out_autoscale"
+    cfg = Config({
+        "field.delim.regex": ",", "field.delim.out": ",",
+        "ps.model.registry.dir": str(reg_dir),
+        "ps.model.name": "churn",
+        "ps.feature.schema.file.path": str(schema_path),
+        "ps.batch.max.size": "16", "ps.bucket.sizes": "8,64",
+        "ps.transport": "resp", "ps.workers": "1",
+        "ps.autoscale": "true",
+        "ps.autoscale.min.workers": "1",
+        "ps.autoscale.max.workers": "2",
+        "ps.autoscale.interval.ms": "20",
+    })
+    counters = job(cfg, str(req_path), str(out_dir))
+    with open(out_dir / "part-m-00000") as fh:
+        lines = fh.read().splitlines()
+    assert [ln.split(",", 1)[1] for ln in lines] == expect
+    d = counters.as_dict()["Autoscaler"]
+    assert 1 <= d["FinalActiveWorkers"] <= 2
+    # autoscale without the wire refuses
+    bad = Config({
+        "field.delim.regex": ",", "field.delim.out": ",",
+        "ps.model.registry.dir": str(reg_dir),
+        "ps.model.name": "churn",
+        "ps.feature.schema.file.path": str(schema_path),
+        "ps.autoscale": "true",
+    })
+    with pytest.raises(ValueError, match="resp"):
+        job(bad, str(req_path), str(tmp_path / "out_bad"))

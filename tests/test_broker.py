@@ -1,0 +1,492 @@
+"""Sharded RESP broker tier (ISSUE 13): the consistent-hash ring, the
+fan-out client, and the horizontal fleet on top of it.
+
+Contracts under test:
+
+  * ring stability — adding/removing one of M shards remaps only ~1/M of
+    the id space, and every surviving assignment stays put (the property
+    that makes a shard death a local event, not a fleet-wide reshuffle);
+  * reply reassembly — the same requests through a 2-shard ring and
+    through one broker produce byte-identical (id, label) sets;
+  * degraded-ring semantics — a killed shard degrades the client to the
+    survivors with a warning + ``Broker/BrokerShardDown`` counter;
+    values from a failed push re-route, and the unanswered-id re-offer
+    closes the loop: NO accepted request ends the run unanswered (busy
+    replies allowed, drops are not);
+  * the multi-process lane — two ``fleet_host`` OS processes over two
+    broker shards answer a shared load exactly once, each under its own
+    host label.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import warnings
+
+import pytest
+
+from avenir_tpu.core.metrics import Counters
+from avenir_tpu.core.table import encode_rows
+from avenir_tpu.io.respq import (HashRing, RespClient, RespServer,
+                                 ShardedRespClient, make_queue_client)
+from avenir_tpu.serving import BatchPolicy, ModelRegistry, ServingFleet
+from tests.test_fleet import drain_replies, make_fleet_registry
+from tests.test_serving import forest_batch_predict, raw_rows_of
+from tests.test_tree import SCHEMA
+
+pytestmark = pytest.mark.broker
+
+
+# --------------------------------------------------------------------------
+# the ring
+# --------------------------------------------------------------------------
+
+def test_hash_ring_remap_bound_on_remove_and_add():
+    """Consistent hashing's defining property, pinned: dropping one of
+    M=4 shards moves EXACTLY the dead shard's keys (~1/M, bounded at
+    1.6/M for vnode imbalance) and no surviving key moves; adding a 5th
+    moves at most ~1.6/5."""
+    ids = [str(i) for i in range(20_000)]
+    eps4 = [f"shard{i}:1" for i in range(4)]
+    r4 = HashRing(eps4)
+    r3 = r4.without("shard2:1")
+    before = {i: r4.lookup(i) for i in ids}
+    after3 = {i: r3.lookup(i) for i in ids}
+    moved = [i for i in ids if before[i] != after3[i]]
+    # everything that moved WAS on the removed shard; nothing else moved
+    assert all(before[i] == "shard2:1" for i in moved)
+    assert len(moved) == sum(1 for i in ids if before[i] == "shard2:1")
+    assert len(moved) / len(ids) <= 1.6 / 4, \
+        f"remove remapped {len(moved) / len(ids):.3f} of the id space"
+    r5 = HashRing(eps4 + ["shard4:1"])
+    after5 = {i: r5.lookup(i) for i in ids}
+    moved5 = [i for i in ids if before[i] != after5[i]]
+    # adding only STEALS keys for the new shard — no lateral moves
+    assert all(after5[i] == "shard4:1" for i in moved5)
+    assert len(moved5) / len(ids) <= 1.6 / 5, \
+        f"add remapped {len(moved5) / len(ids):.3f} of the id space"
+
+
+def test_hash_ring_stable_across_constructions():
+    """Placement is md5-derived, not builtin hash(): two independently
+    built rings (what two fleet hosts do) agree on every id."""
+    eps = ["h1:1", "h2:1", "h3:1"]
+    a, b = HashRing(eps), HashRing(list(eps))
+    assert all(a.lookup(str(i)) == b.lookup(str(i)) for i in range(2000))
+    with pytest.raises(ValueError, match="duplicate"):
+        HashRing(["h1:1", "h1:1"])
+
+
+def test_sharded_client_routes_request_and_reply_together():
+    """predict,<id>,... and its reply <id>,<label> hash to the same
+    shard, and the distribution across M=3 is roughly balanced."""
+    servers = [RespServer().start() for _ in range(3)]
+    try:
+        eps = [f"127.0.0.1:{s.port}" for s in servers]
+        sc = ShardedRespClient(eps)
+        counts = {e: 0 for e in eps}
+        for i in range(3000):
+            ep = sc.shard_of(sc.id_of(f"predict,{i},a,b"))
+            assert ep == sc.shard_of(sc.id_of(f"{i},label"))
+            counts[ep] += 1
+        for ep, n in counts.items():
+            assert 0.15 <= n / 3000 <= 0.55, f"{ep} got {n}/3000"
+        sc.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+# --------------------------------------------------------------------------
+# reassembly parity vs a single broker
+# --------------------------------------------------------------------------
+
+def _collect(cli, queue, expect_n, timeout_s=60.0, stall_s=None):
+    """Pop first-reply-per-id until ``expect_n`` collected, the timeout
+    lapses, or (``stall_s``) no NEW reply arrived for that long — the
+    killed-shard drill's 'the rest died with the shard' detector."""
+    got = {}
+    deadline = time.monotonic() + timeout_s
+    last_progress = time.monotonic()
+    while len(got) < expect_n and time.monotonic() < deadline:
+        vs = cli.rpop_many(queue, 256)
+        if not vs:
+            if stall_s is not None \
+                    and time.monotonic() - last_progress > stall_s:
+                break
+            time.sleep(0.002)
+            continue
+        last_progress = time.monotonic()
+        for v in vs:
+            rid, label = v.split(",", 1)
+            got.setdefault(rid, label)
+    return got
+
+
+def test_sharded_replies_match_single_broker_oracle(tmp_path, mesh_ctx):
+    """The SAME 120 requests through a 2-shard ring (2-worker fleet) and
+    through one broker (the oracle) reassemble to byte-identical
+    ``<id>,<label>`` lines."""
+    reg, table, models = make_fleet_registry(tmp_path, mesh_ctx)
+    rows = raw_rows_of(table, 40)
+    msgs = [",".join(["predict", str(i)] + rows[i % 40])
+            for i in range(120)]
+
+    def run(endpoints):
+        fleet = ServingFleet(
+            reg, "churn", buckets=(8, 64),
+            policy=BatchPolicy(max_batch=16, max_wait_ms=2.0),
+            n_workers=2,
+            config={"redis.server.endpoints": endpoints})
+        fleet.start()
+        feeder = make_queue_client({"redis.server.endpoints": endpoints})
+        try:
+            feeder.lpush_many("requestQueue", msgs)
+            got = _collect(feeder, "predictionQueue", len(msgs))
+            feeder.lpush("requestQueue", "stop")
+            assert fleet.wait(30.0)
+        finally:
+            fleet.stop()
+            feeder.close()
+        return ["%s,%s" % (rid, got[rid]) for rid in
+                sorted(got, key=int)]
+
+    servers = [RespServer().start() for _ in range(3)]
+    try:
+        sharded = run([f"127.0.0.1:{servers[0].port}",
+                       f"127.0.0.1:{servers[1].port}"])
+        single = run([f"127.0.0.1:{servers[2].port}"])
+    finally:
+        for s in servers:
+            s.stop()
+    assert len(sharded) == 120
+    assert "\n".join(sharded).encode() == "\n".join(single).encode(), \
+        "sharded reassembly diverged from the single-broker oracle"
+
+
+# --------------------------------------------------------------------------
+# degraded ring: killed shard, nothing accepted is lost
+# --------------------------------------------------------------------------
+
+def test_dead_shard_degrades_client_with_counter():
+    servers = [RespServer().start() for _ in range(2)]
+    eps = [f"127.0.0.1:{s.port}" for s in servers]
+    cnt = Counters()
+    sc = ShardedRespClient(eps, counters=cnt)
+    try:
+        msgs = [f"predict,{i},x" for i in range(50)]
+        sc.lpush_many("q", msgs)
+        servers[1].kill()
+        with pytest.warns(RuntimeWarning, match="degrading to the "
+                                               "surviving ring"):
+            sc.lpush_many("q", msgs)          # re-routes the dead group
+        assert cnt.get("Broker", "BrokerShardDown") == 1
+        assert sc.down_endpoints == [eps[1]]
+        assert sc.live_endpoints == [eps[0]]
+        # the re-routed batch is fully poppable from the survivor
+        got = sc.rpop_many("q", 500)
+        assert len(got) >= len(msgs)
+        # depth observability over the degraded ring keeps working
+        assert eps[0] in sc.depths("q")
+        # killing the LAST shard raises — nowhere to degrade to
+        servers[0].kill()
+        with pytest.raises((ConnectionError, OSError)):
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                sc.lpush_many("q", msgs)
+        sc.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_killed_shard_mid_run_no_accepted_request_lost(tmp_path,
+                                                       mesh_ctx):
+    """The acceptance drill: 2-shard ring, 2-worker fleet, one shard
+    KILLED mid-load.  The producer re-offers ids still unanswered after
+    the kill (the documented client-side recovery for messages that
+    died inside the shard's memory), and the run ends with EVERY id
+    answered a real prediction — busy replies would be allowed, drops
+    are not.  The fleet's merged counters carry the BrokerShardDown
+    evidence."""
+    reg, table, models = make_fleet_registry(tmp_path, mesh_ctx)
+    rows = raw_rows_of(table, 40)
+    expect = forest_batch_predict(models, encode_rows(rows, SCHEMA))
+    servers = [RespServer().start() for _ in range(2)]
+    eps = [f"127.0.0.1:{s.port}" for s in servers]
+    fleet = ServingFleet(
+        reg, "churn", buckets=(8, 64),
+        policy=BatchPolicy(max_batch=16, max_wait_ms=1.0),
+        n_workers=2, config={"redis.server.endpoints": eps})
+    n = 240
+    ids = [str(i) for i in range(n)]
+    msgs = {i: ",".join(["predict", i] + rows[int(i) % 40]) for i in ids}
+    got = {}
+    feeder = None
+    try:
+        with warnings.catch_warnings():
+            # shard-down warnings from worker threads and the feeder are
+            # the EXPECTED evidence here; pytest.warns can't see the
+            # worker threads' anyway
+            warnings.simplefilter("ignore", RuntimeWarning)
+            fleet.start()
+            feeder = ShardedRespClient(eps)
+            feeder.lpush_many("requestQueue",
+                              [msgs[i] for i in ids[:n // 2]])
+            # let the fleet get properly into the first half…
+            deadline = time.monotonic() + 30
+            while len(got) < n // 4 and time.monotonic() < deadline:
+                got.update(_collect(feeder, "predictionQueue", n // 4,
+                                    timeout_s=0.2))
+            # …kill shard B mid-run, keep offering the second half: the
+            # feeder re-routes onto the survivor
+            servers[1].kill()
+            feeder.lpush_many("requestQueue",
+                              [msgs[i] for i in ids[n // 2:]])
+            got.update(_collect(feeder, "predictionQueue", n,
+                                timeout_s=30.0, stall_s=3.0))
+            # requests that died inside the killed shard's memory are
+            # the producer's re-offer window: send the unanswered ids
+            # again through the surviving ring
+            missing = [i for i in ids if i not in got]
+            resent = len(missing)
+            if missing:
+                feeder.lpush_many("requestQueue",
+                                  [msgs[i] for i in missing])
+                got.update(_collect(feeder, "predictionQueue", n,
+                                    timeout_s=30.0))
+        assert sorted(got, key=int) == ids, \
+            f"{n - len(got)} accepted requests lost after shard kill " \
+            f"({resent} re-offered)"
+        for i in ids:
+            assert got[i] == expect[int(i) % 40]
+        merged = fleet.merged_counters()
+        assert merged.get("Broker", "BrokerShardDown") >= 1 \
+            or feeder.down_endpoints, \
+            "nothing recorded the dead shard"
+    finally:
+        fleet.stop()
+        if feeder is not None:
+            feeder.close()
+        for s in servers:
+            s.stop()
+
+
+def test_addressed_reload_reaches_its_host_only(tmp_path, mesh_ctx):
+    """Multi-host hot-swap convergence: 'reload,<host_label>' applies
+    only on the addressed fleet; a copy popped by the WRONG host is
+    re-pushed until the addressee drains it (a bare broadcast cannot
+    converge N hosts — one host's workers can pop every copy).  The
+    unaddressed 'reload' single-fleet path stays pinned by
+    test_fleet_hot_swap_no_loss_no_dup."""
+    import warnings as _w
+    reg, table, models = make_fleet_registry(tmp_path, mesh_ctx)
+    server = RespServer().start()
+
+    def make(host):
+        return ServingFleet(
+            reg, "churn", buckets=(8,),
+            policy=BatchPolicy(max_batch=8, max_wait_ms=1.0),
+            n_workers=1, host_label=host,
+            config={"redis.server.port": server.port})
+
+    fa, fb = make("hA").start(), make("hB").start()
+    feeder = RespClient(port=server.port)
+    try:
+        reg.publish("churn", models, schema=SCHEMA)   # v2
+        # addressed to hB: hA workers must re-push, hB must converge
+        feeder.lpush("requestQueue", "reload,hB")
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and \
+                set(fb.stats()["model_versions"].values()) != {2}:
+            time.sleep(0.05)
+        assert set(fb.stats()["model_versions"].values()) == {2}
+        assert set(fa.stats()["model_versions"].values()) == {1}, \
+            "a reload addressed to hB swapped hA"
+    finally:
+        with _w.catch_warnings():
+            _w.simplefilter("ignore")
+            fa.stop()
+            fb.stop()
+        feeder.close()
+        server.stop()
+
+
+def test_stop_on_one_shard_never_strands_requests_on_another(tmp_path,
+                                                             mesh_ctx):
+    """The drain-then-stop invariant, made deterministic: the wire
+    'stop' and a batch of requests are pushed to DIFFERENT shards
+    BEFORE the fleet starts, so a worker can meet the stop first.  The
+    single-queue FIFO argument ('everything before the stop was already
+    popped') does not hold across a ring — the post-stop sweep must
+    still answer every request."""
+    reg, table, models = make_fleet_registry(tmp_path, mesh_ctx)
+    rows = raw_rows_of(table, 20)
+    expect = forest_batch_predict(models, encode_rows(rows, SCHEMA))
+    servers = [RespServer().start() for _ in range(2)]
+    eps = [f"127.0.0.1:{s.port}" for s in servers]
+    feeder = ShardedRespClient(eps)
+    stop_shard = feeder.shard_of(feeder.id_of("stop"))
+    # ids routed to the shard the stop does NOT land on
+    ids = [str(i) for i in range(400)
+           if feeder.shard_of(str(i)) != stop_shard][:60]
+    assert len(ids) == 60
+    fleet = ServingFleet(
+        reg, "churn", buckets=(8, 64),
+        policy=BatchPolicy(max_batch=16, max_wait_ms=1.0),
+        n_workers=2, config={"redis.server.endpoints": eps})
+    try:
+        # everything queued BEFORE the fleet exists: the stop sits
+        # alone on its shard, the requests on the other
+        feeder.lpush_many("requestQueue",
+                          [",".join(["predict", i] + rows[int(i) % 20])
+                           for i in ids])
+        feeder.lpush("requestQueue", "stop")
+        fleet.start()
+        assert fleet.wait(60.0), "fleet never stopped"
+        got = _collect(feeder, "predictionQueue", len(ids),
+                       timeout_s=30.0, stall_s=3.0)
+        missing = sorted(set(ids) - set(got), key=int)
+        assert not missing, \
+            f"stop stranded {len(missing)} accepted requests on the " \
+            f"other shard: {missing[:5]}..."
+        for i in ids:
+            assert got[i] == expect[int(i) % 20]
+    finally:
+        fleet.stop()
+        feeder.close()
+        for s in servers:
+            s.stop()
+
+
+# --------------------------------------------------------------------------
+# multi-process: two fleet_host processes, two broker shards
+# --------------------------------------------------------------------------
+
+def test_two_fleet_hosts_two_shards_exactly_once(tmp_path, mesh_ctx):
+    """The horizontal topology as OS processes: 2 broker shards in this
+    process, 2 ``fleet_host`` children draining them against the shared
+    registry.  Every request answered exactly once, BOTH hosts served a
+    share, and each child reports its own host label."""
+    reg, table, models = make_fleet_registry(tmp_path, mesh_ctx)
+    rows = raw_rows_of(table, 40)
+    expect = forest_batch_predict(models, encode_rows(rows, SCHEMA))
+    servers = [RespServer().start() for _ in range(2)]
+    eps = ",".join(f"127.0.0.1:{s.port}" for s in servers)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               AVENIR_TPU_PLATFORM="cpu")
+    children = [
+        subprocess.Popen(
+            [sys.executable, "-m", "avenir_tpu.serving.fleet_host",
+             "--registry", str(tmp_path / "registry"),
+             "--model", "churn", "--endpoints", eps,
+             "--workers", "2", "--host-label", label,
+             "--buckets", "8,64", "--max-batch", "16",
+             "--max-idle-s", "45",
+             "--ready-file", str(tmp_path / f"ready-{label}")],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env)
+        for label in ("hostA", "hostB")]
+    feeder = ShardedRespClient(eps.split(","))
+    n = 200
+    try:
+        # wait for BOTH hosts to be draining before offering load: a
+        # slow-starting child (jax import) must not be measured absent
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and not all(
+                (tmp_path / f"ready-{lab}").exists()
+                for lab in ("hostA", "hostB")):
+            assert all(c.poll() is None for c in children), \
+                "a fleet_host child died during startup"
+            time.sleep(0.05)
+        # paced bursts (not one burst) so both hosts demonstrably pull
+        for i in range(0, n, 20):
+            feeder.lpush_many(
+                "requestQueue",
+                [",".join(["predict", str(j)] + rows[j % 40])
+                 for j in range(i, min(i + 20, n))])
+            time.sleep(0.02)
+        got = drain_replies(feeder, "predictionQueue", n, timeout_s=120.0)
+        assert sorted(got, key=int) == [str(i) for i in range(n)]
+        assert all(len(v) == 1 for v in got.values()), "duplicated reply"
+        for i in range(n):
+            assert got[str(i)] == [expect[i % 40]]
+        # one stop per child process, SERIALIZED (push, wait for a child
+        # to exit, push the next) so one fast host cannot eat both
+        stats = []
+        remaining = list(children)
+        while remaining:
+            feeder.lpush("requestQueue", "stop")
+            deadline = time.monotonic() + 90
+            exited = None
+            while exited is None and time.monotonic() < deadline:
+                exited = next((c for c in remaining
+                               if c.poll() is not None), None)
+                time.sleep(0.05)
+            assert exited is not None, "no fleet_host exited on stop"
+            remaining.remove(exited)
+            out, err = exited.communicate(timeout=30)
+            assert exited.returncode == 0, err
+            stats.append(json.loads(out.strip().splitlines()[-1]))
+        assert {s["host"] for s in stats} == {"hostA", "hostB"}
+        assert sum(s["served"] for s in stats) == n
+        assert all(s["served"] > 0 for s in stats), \
+            f"one host served nothing: {[s['served'] for s in stats]}"
+    finally:
+        for c in children:
+            if c.poll() is None:
+                c.kill()
+        feeder.close()
+        for s in servers:
+            s.stop()
+
+
+# --------------------------------------------------------------------------
+# CLI: ps.broker.shards
+# --------------------------------------------------------------------------
+
+def test_cli_job_broker_shards(tmp_path, mesh_ctx):
+    """predictionService with ps.workers=2 ps.broker.shards=2 answers
+    byte-identically to the single-broker replay and stamps the shard
+    count into the dump."""
+    from avenir_tpu.core.config import Config
+    from avenir_tpu.cli import serving_jobs  # noqa: F401
+    from avenir_tpu.cli.jobs import resolve
+    from tests.test_serving import _train_forest_via_cli
+    from tests.test_tree import make_table
+    reg_dir = tmp_path / "registry"
+    schema_path, trees = _train_forest_via_cli(tmp_path, reg_dir)
+    req_rows = raw_rows_of(make_table(40, seed=33), 40)
+    expect = forest_batch_predict(trees, encode_rows(req_rows, SCHEMA))
+    req_path = tmp_path / "requests.csv"
+    req_path.write_text("\n".join(",".join(r) for r in req_rows) + "\n")
+    job = resolve("predictionService")
+    out_dir = tmp_path / "out_sharded"
+    cfg = Config({
+        "field.delim.regex": ",", "field.delim.out": ",",
+        "ps.model.registry.dir": str(reg_dir),
+        "ps.model.name": "churn",
+        "ps.feature.schema.file.path": str(schema_path),
+        "ps.batch.max.size": "16", "ps.bucket.sizes": "8,64",
+        "ps.transport": "resp", "ps.workers": "2",
+        "ps.broker.shards": "2",
+    })
+    counters = job(cfg, str(req_path), str(out_dir))
+    with open(out_dir / "part-m-00000") as fh:
+        lines = fh.read().splitlines()
+    assert [ln.split(",", 1)[1] for ln in lines] == expect
+    assert counters.get("Broker", "Shards") == 2
+    assert counters.get("Serving", "Requests") == 40
+    # shards without the wire refuse
+    from avenir_tpu.core.config import Config as C2
+    bad = C2({
+        "field.delim.regex": ",", "field.delim.out": ",",
+        "ps.model.registry.dir": str(reg_dir),
+        "ps.model.name": "churn",
+        "ps.feature.schema.file.path": str(schema_path),
+        "ps.broker.shards": "2",
+    })
+    with pytest.raises(ValueError, match="resp"):
+        job(bad, str(req_path), str(tmp_path / "out_bad"))

@@ -360,6 +360,62 @@ def test_fleet_cli_job_workers(tmp_path, mesh_ctx):
         job(bad, str(req_path), str(tmp_path / "out_bad"))
 
 
+def test_two_fleets_one_registry_host_label_disjoint(tmp_path, mesh_ctx,
+                                                     resp_server):
+    """The multi-host scrape shape: two fleets (two 'hosts') serving the
+    SAME model name bound to ONE MetricsRegistry write DISJOINT
+    host-labeled series — same fix shape as the PR 8 service label, one
+    level up.  Worker names collide across hosts on purpose; the host
+    label (and host-qualified health keys) keep them apart, and
+    stopping one fleet drops only ITS series."""
+    from avenir_tpu.telemetry import MetricsRegistry
+    reg, table, models = make_fleet_registry(tmp_path, mesh_ctx)
+    mreg = MetricsRegistry()
+
+    def make(host):
+        return ServingFleet(
+            reg, "churn", buckets=(8,),
+            policy=BatchPolicy(max_batch=8, max_wait_ms=1.0),
+            n_workers=1, metrics=mreg, host_label=host,
+            config={"redis.server.port": resp_server.port,
+                    "redis.request.queue": f"rq-{host}",
+                    "redis.prediction.queue": f"pq-{host}"})
+
+    fa, fb = make("hostA").start(), make("hostB").start()
+    try:
+        assert fa.stats()["host"] == "hostA"
+        assert fb.stats()["host"] == "hostB"
+        text = mreg.render()
+        a = 'avenir_serving{host="hostA",service="churn-w0",'
+        b = 'avenir_serving{host="hostB",service="churn-w0",'
+        assert a + 'key="queue_depth"}' in text
+        assert b + 'key="queue_depth"}' in text
+        # NO rename happened: both kept the bare worker identity, the
+        # host label is what separates the series
+        assert "churn-w0-1" not in text
+        # health providers are host-qualified and both reachable
+        ok_a = mreg.health_one("hostA:churn-w0")
+        ok_b = mreg.health_one("hostB:churn-w0")
+        assert ok_a is not None and ok_b is not None
+        # the bare-name probe still resolves (first match — the single-
+        # host shape load balancers use)
+        assert mreg.health_one("churn-w0") is not None
+        # one fleet degrading flips ONLY its own provider
+        fb.workers[0].service.mark_degraded("drift")
+        assert mreg.health_one("hostA:churn-w0")[0] is True
+        assert mreg.health_one("hostB:churn-w0")[0] is False
+        # stopping hostB drops ITS series and provider; hostA's survive
+        fb.stop()
+        text = mreg.render()
+        assert a + 'key="queue_depth"}' in text
+        assert b + 'key="queue_depth"}' not in text
+        assert mreg.health_one("hostB:churn-w0") is None
+        assert mreg.health_one("hostA:churn-w0") is not None
+    finally:
+        fa.stop()
+        fb.stop()
+
+
 @pytest.mark.slow
 def test_fleet_soak_sustained_multiworker(tmp_path, mesh_ctx, resp_server):
     """Sustained load through 2 workers: thousands of requests, every

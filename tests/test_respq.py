@@ -7,6 +7,8 @@ import sys
 import threading
 import time
 
+import pytest
+
 from avenir_tpu.io.respq import RespClient, RespServer
 from avenir_tpu.reinforce.serving import (RedisServingLoop,
                                           ReinforcementLearnerService)
@@ -103,6 +105,168 @@ def test_multi_client_stress_no_loss_no_duplication():
         probe = RespClient(port=server.port)
         assert probe.llen("q") == 0
         probe.close()
+    finally:
+        stop.set()
+        server.stop()
+
+
+def test_info_reports_depths_without_popping():
+    """INFO answers per-queue depths as a parseable bulk string and
+    consumes nothing; LLEN and INFO snapshot under the BRPOP condition
+    only long enough to copy the lengths."""
+    server = RespServer().start()
+    try:
+        cli = RespClient(port=server.port)
+        assert cli.info() == {}
+        cli.lpush_many("a", ["1", "2", "3"])
+        cli.lpush("b", "x")
+        assert cli.info() == {"a": 3, "b": 1}
+        # named form: only the asked-for queues (absent ones report 0)
+        assert cli.info("a", "nope") == {"a": 3, "nope": 0}
+        # nothing was popped by any of that
+        assert cli.llen("a") == 3 and cli.llen("b") == 1
+        assert cli.rpop("a") == "1"
+        assert cli.info()["a"] == 2
+        cli.close()
+    finally:
+        server.stop()
+
+
+def test_client_reconnects_after_server_restart():
+    """A dropped TCP connection mid-call must not poison the client: the
+    server dies (established connections severed), a replacement binds
+    the same port, and the SAME client object keeps working after one
+    warned reconnect.  reconnect=False keeps the old fail-fast."""
+    server = RespServer().start()
+    port = server.port
+    cli = RespClient(port=port)
+    hard = RespClient(port=port, reconnect=False)
+    assert cli.ping() and hard.ping()
+    server.kill()
+    server2 = RespServer(port=port).start()
+    try:
+        with pytest.warns(RuntimeWarning, match="reconnected"):
+            assert cli.lpush("q", "v") == 1
+        assert cli.rpop("q") == "v"          # connection healthy again
+        with pytest.raises((ConnectionError, OSError)):
+            hard.ping()
+        cli.close()
+        hard.close()
+    finally:
+        server2.stop()
+
+
+def test_client_reconnect_exhausted_surfaces_error():
+    """With the server gone for good the reconnect backoff runs out and
+    the ORIGINAL failure class surfaces — no infinite retry loop."""
+    server = RespServer().start()
+    cli = RespClient(port=server.port)
+    assert cli.ping()
+    server.kill()
+    with pytest.raises((ConnectionError, OSError)):
+        cli.ping()
+    cli.close()
+
+
+def test_brpop_timeout_bounds_enforced():
+    """A park outliving the client socket timeout would hit the
+    reconnect path mid-BRPOP and the abandoned server-side waiter could
+    pop (and lose) the next value — so the bound is enforced, not just
+    documented."""
+    server = RespServer().start()
+    try:
+        cli = RespClient(port=server.port, timeout=2.0)
+        with pytest.raises(ValueError, match="brpop timeout_s"):
+            cli.brpop("q", timeout_s=0)       # "block forever" never
+        with pytest.raises(ValueError, match="brpop timeout_s"):
+            cli.brpop("q", timeout_s=2.0)     # >= socket timeout
+        cli.lpush("q", "v")
+        assert cli.brpop("q", timeout_s=0.5) == "v"
+        cli.close()
+    finally:
+        server.stop()
+
+
+def test_kill_unparks_brpop_waiters_promptly():
+    """kill() must wake parked BRPOP handlers (killed flag + notify):
+    a waiter mid-park errors out within moments of the kill instead of
+    sitting on the condition until its deadline (or forever)."""
+    server = RespServer().start()
+    cli = RespClient(port=server.port, timeout=10.0)
+    t0 = time.monotonic()
+    result = {}
+
+    def parked():
+        try:
+            result["v"] = cli.brpop("q", timeout_s=8.0)
+        except Exception as exc:
+            result["exc"] = exc
+        result["dt"] = time.monotonic() - t0
+
+    t = threading.Thread(target=parked)
+    t.start()
+    time.sleep(0.3)            # let it park server-side
+    server.kill()
+    t.join(timeout=6.0)
+    assert not t.is_alive(), "brpop still parked after kill()"
+    # woken by the kill, not by the 8s deadline
+    assert result["dt"] < 5.0, f"waiter sat {result['dt']:.1f}s"
+    assert result.get("v") is None   # nil or a connection error — never
+    cli.close()                      # a value
+
+
+def test_brpop_multi_client_wakeup_ordering_stress():
+    """N consumers parked in BRPOP while a producer pushes in bursts:
+    every message is popped EXACTLY once (no lost wakeups — a notify
+    that races a timeout must still leave the value poppable; no
+    duplicate pops — the check/pop is atomic under the condition), and
+    nothing is left behind.  The multi-client lpush/rpop stress test
+    covers the non-blocking path; this one pins the parking path the
+    fleet idles on."""
+    server = RespServer().start()
+    n_cons, n_msgs = 6, 400
+    got = []
+    got_lock = threading.Lock()
+    stop = threading.Event()
+
+    def consumer():
+        cli = RespClient(port=server.port)
+        while not stop.is_set():
+            v = cli.brpop("q", timeout_s=0.2)
+            if v is not None:
+                with got_lock:
+                    got.append(v)
+        cli.close()
+
+    threads = [threading.Thread(target=consumer) for _ in range(n_cons)]
+    try:
+        for t in threads:
+            t.start()
+        prod = RespClient(port=server.port)
+        rng_sizes = [1, 7, 3, 1, 12, 40, 2, 5]   # bursts + singletons
+        sent = 0
+        i = 0
+        while sent < n_msgs:
+            k = min(rng_sizes[i % len(rng_sizes)], n_msgs - sent)
+            i += 1
+            prod.lpush_many("q", [f"m{j}" for j in range(sent, sent + k)])
+            sent += k
+            # let consumers park again between bursts so wakeups (not
+            # polling) deliver most of the traffic
+            time.sleep(0.002)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            with got_lock:
+                if len(got) >= n_msgs:
+                    break
+            time.sleep(0.005)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert len(got) == n_msgs, f"{len(got)} popped of {n_msgs}"
+        assert set(got) == {f"m{j}" for j in range(n_msgs)}
+        assert prod.llen("q") == 0
+        prod.close()
     finally:
         stop.set()
         server.stop()

@@ -12,7 +12,11 @@
   ``--counters <out>.counters.json`` it additionally prints the per-site
   kernel-backend table (xla vs pallas vs quantized, from the dispatch
   ledger's ``KernelBackends`` group) so a trace shows WHICH kernel form
-  actually ran at each hot site (TPU_NOTES §24).
+  actually ran at each hot site (TPU_NOTES §24).  Traces carrying
+  ``autoscaler.decision`` instants get the decision log printed next to
+  the serving-lane breakdown — scale actions with their sensed inputs
+  (queue depth, depth derivative, recent p99), hold runs compressed —
+  so an operator can replay WHY the fleet scaled (TPU_NOTES §25).
 * **merge** — concatenate N per-process JSONL traces (the shards of one
   run) into ONE ts-sorted Chrome trace JSON; epoch-anchored timestamps
   make shard skew visible as lane offset.  Warns when the inputs carry
@@ -83,6 +87,44 @@ def _print_backend_table(counters_path: str) -> None:
         print(f"  {site:<24}{disp!s:>12}  {forms}")
 
 
+def _print_autoscaler_log(events) -> None:
+    """The sensor→policy→actuator replay: every ``autoscaler.decision``
+    instant, scale actions printed verbatim with their sensed inputs,
+    runs of holds compressed to one line — WHY the fleet scaled, next to
+    the serving-lane view of WHAT it was serving."""
+    decisions = sorted(
+        (e for e in events if e.get("ph") == "i"
+         and e.get("name") == "autoscaler.decision"
+         and isinstance(e.get("ts"), (int, float))),
+        key=lambda e: float(e["ts"]))
+    if not decisions:
+        return
+    t0 = float(decisions[0]["ts"])
+    actions = [e for e in decisions
+               if e.get("args", {}).get("action") in ("up", "down")]
+    print(f"\nautoscaler decisions ({len(decisions)} ticks, "
+          f"{len(actions)} scale actions):")
+    held = 0
+    for e in decisions:
+        a = e.get("args", {})
+        if a.get("action") not in ("up", "down"):
+            held += 1
+            continue
+        if held:
+            print(f"  ... {held} hold tick(s) ...")
+            held = 0
+        print(f"  +{(float(e['ts']) - t0) / 1e6:8.2f}s "
+              f"{a.get('action', '?'):<5} "
+              f"active {a.get('active')}->{a.get('new_active')}  "
+              f"depth {a.get('depth')}  "
+              f"d(depth)/dt {a.get('derivative_per_s')}/s  "
+              f"p99 {a.get('p99_ms')}ms"
+              + (f" (slo {a.get('slo_p99_ms')}ms)"
+                 if a.get("slo_p99_ms") else ""))
+    if held:
+        print(f"  ... {held} hold tick(s) ...")
+
+
 def cmd_summarize(args) -> int:
     events = merge_trace_files(args.traces)
     problems = validate_trace_events(events)
@@ -96,6 +138,7 @@ def cmd_summarize(args) -> int:
              and isinstance(e.get("dur", 0.0), (int, float))]
     if not spans:
         print("no spans recorded")
+        _print_autoscaler_log(events)
         for cpath in (args.counters or []):
             _print_backend_table(cpath)
         return 0 if not problems else 1
@@ -161,6 +204,7 @@ def cmd_summarize(args) -> int:
             print(f"  pid {pid} tid {tid:<8}{len(evs):>8}{sum(rows):>8}"
                   f"{(sum(rows) / max(len(evs), 1)):>10.1f}"
                   f"{100.0 * frac:>11.0f}%")
+    _print_autoscaler_log(events)
     if stalls:
         print(f"\n{len(stalls)} STALL event(s):")
         for e in stalls:
