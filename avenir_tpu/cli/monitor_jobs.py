@@ -133,7 +133,11 @@ def _iter_resp_windows(cfg: Config, split, window_rows: int):
 def _resolve_model_version(cfg: Config, registry, name: str) -> int:
     version: Optional[int] = cfg.get_int("dm.model.version", 0) or None
     if version is None:
-        version = registry.latest_version(name)
+        # serving_version, not latest_version: after a controller
+        # rollback pin the monitor must score the model the fleet is
+        # actually serving, not the refused/rolled-back newest one
+        # (identical to latest when no pin exists)
+        version = registry.serving_version(name)
         if version is None:
             raise FileNotFoundError(
                 f"no intact versions of model {name!r} in "

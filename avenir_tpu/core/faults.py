@@ -35,11 +35,21 @@ Instrumented ops: ``chunk_read`` (native chunk parse), ``chunk_encode``
 (columnar-cache chunk emit — a fault abandons the build with a warning,
 never the training pass), ``cache_read`` (columnar-cache chunk load — a
 fault degrades the stream to CSV parse with a warning).
+
+The retrain controller (control/controller.py, TPU_NOTES §26) names its
+five stages as fault points for the chaos-drill lane: ``retrain_build``
+(stage entry + once per training block), ``candidate_validate``,
+``registry_publish`` (stage entry, the registry's own payload-write
+point, and post-publish/pre-journal — the double-publish window),
+``fleet_swap``, ``rollback``.  A ``raise:RuntimeError`` at any of them is
+the "controller crashed here" drill; the journal contract says a new
+controller resumes the cycle without double-publishing or half-swapping.
 """
 
 from __future__ import annotations
 
 import os
+import random
 import threading
 import time
 import warnings
@@ -173,18 +183,48 @@ RETRY_BASE_S = float(os.environ.get("AVENIR_TPU_RETRY_BASE_S", "0.05"))
 # spike should be re-attempted before the job gives up on the fast path
 TRANSIENT = (OSError, MemoryError)
 
+# full-jitter backoff RNG, one stream per process: seeded from the pid so
+# P sharded processes whose chunk reads fail together (one NFS hiccup, one
+# broker stall) do NOT retry in lockstep and re-hammer the same file or
+# broker at the exact same instants.  AVENIR_TPU_RETRY_SEED pins the
+# stream for deterministic tests; with_retry(jitter_seed=) pins one call.
+_JITTER_RNG = random.Random(
+    int(os.environ["AVENIR_TPU_RETRY_SEED"])
+    if os.environ.get("AVENIR_TPU_RETRY_SEED") else os.getpid())
+_JITTER_LOCK = threading.Lock()
+
+
+def _jitter_delay(base_cap: float, rng: Optional[random.Random]) -> float:
+    """Full-jitter draw: uniform over (0, cap] where cap is this
+    attempt's exponential ceiling (AWS's 'full jitter' rule — the whole
+    interval is randomized, not just a fringe, so colliding retriers
+    spread across the entire window).  The draw is floored at cap/100 so
+    a pathological 0 draw still yields a real backoff."""
+    r = rng if rng is not None else _JITTER_RNG
+    if rng is None:
+        with _JITTER_LOCK:
+            u = r.random()
+    else:
+        u = r.random()
+    return base_cap * max(u, 0.01)
+
 
 def with_retry(fn: Callable, *, attempts: Optional[int] = None,
                base_delay: Optional[float] = None,
                retry_on: Tuple[type, ...] = TRANSIENT,
-               what: str = "operation"):
+               what: str = "operation",
+               jitter_seed: Optional[int] = None):
     """Call ``fn()``; on a ``retry_on`` exception retry up to ``attempts``
-    total tries with exponential backoff (base, 2*base, 4*base, ...).
+    total tries with full-jitter exponential backoff: attempt i sleeps a
+    uniform draw from (0, base * 2**i] (deterministic under a fixed
+    ``jitter_seed`` or AVENIR_TPU_RETRY_SEED; per-process pid-seeded
+    otherwise, so sharded processes never back off in lockstep).
     Anything else — including the classes an injected "crash" uses —
     propagates immediately.  The final failure re-raises the last
     exception unchanged so callers' except clauses keep working."""
     attempts = RETRY_ATTEMPTS if attempts is None else attempts
     base_delay = RETRY_BASE_S if base_delay is None else base_delay
+    rng = random.Random(jitter_seed) if jitter_seed is not None else None
     last: Optional[BaseException] = None
     for i in range(max(1, attempts)):
         try:
@@ -193,12 +233,13 @@ def with_retry(fn: Callable, *, attempts: Optional[int] = None,
             last = exc
             if i + 1 >= max(1, attempts):
                 break
+            delay = _jitter_delay(base_delay * (1 << i), rng)
             warnings.warn(
                 f"{what} failed ({type(exc).__name__}: {exc}); "
                 f"retry {i + 1}/{attempts - 1} after "
-                f"{base_delay * (1 << i):.3g}s", RuntimeWarning,
+                f"{delay:.3g}s", RuntimeWarning,
                 stacklevel=2)
-            time.sleep(base_delay * (1 << i))
+            time.sleep(delay)
     raise last
 
 

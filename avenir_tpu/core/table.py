@@ -654,7 +654,8 @@ def iter_csv_chunks(path: str, schema: FeatureSchema,
         from ..io.colcache import iter_csv_chunks_cached
         yield from iter_csv_chunks_cached(
             path, schema, delim_regex, chunk_rows, use_native,
-            bad_records, int(start_row), cache, shard=shard)
+            bad_records, int(start_row), cache, shard=shard,
+            stop_row=stop_row)
         return
     done_rows = int(start_row)
     stop: Optional[int] = int(stop_row) if stop_row is not None else None

@@ -897,7 +897,8 @@ def _build_owner(shard) -> bool:
 
 def iter_csv_chunks_cached(csv_path: str, schema, delim: str,
                            chunk_rows: int, use_native: bool, bad_records,
-                           start_row: int, cache: CachePolicy, shard=None):
+                           start_row: int, cache: CachePolicy, shard=None,
+                           stop_row=None):
     """The cache-aware chunk stream behind
     ``core.table.iter_csv_chunks(..., cache=)``: serve from an intact
     fresh sidecar, else parse (building one when the policy asks, the
@@ -915,7 +916,7 @@ def iter_csv_chunks_cached(csv_path: str, schema, delim: str,
     if status == "hit":
         cache.bump("Hit")
         reader = CacheReader(cdir, header, schema)
-        lo, hi = 0, None
+        lo, hi = 0, stop_row
         if shard is not None and int(shard[1]) > 1:
             from ..parallel.distributed import shard_rows as _split_rows
             lo, hi = _split_rows(_header_total_rows(header),
@@ -938,7 +939,10 @@ def iter_csv_chunks_cached(csv_path: str, schema, delim: str,
         # the counter group can tell a touched source from a cold start
         cache.bump("Stale")
     from ..core import table as _table
-    if cache.builds and start_row == 0 and _build_owner(shard):
+    if cache.builds and start_row == 0 and stop_row is None \
+            and _build_owner(shard):
+        # a stop_row-bounded read is a HEAD, and a head must never
+        # masquerade as a full cache (the same rule start_row>0 follows)
         if status == "stale":
             # the old sidecar stays serveable-to-nobody (it probes stale)
             # until the private build dir swaps over it at finalize
@@ -953,4 +957,4 @@ def iter_csv_chunks_cached(csv_path: str, schema, delim: str,
     yield from _table.iter_csv_chunks(
         csv_path, schema, delim, chunk_rows=chunk_rows,
         use_native=use_native, bad_records=bad_records,
-        start_row=start_row, shard=shard)
+        start_row=start_row, shard=shard, stop_row=stop_row)

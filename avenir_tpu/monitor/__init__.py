@@ -19,8 +19,9 @@ package closes that loop:
     the same scores on the prediction-class distribution (prior drift);
   * :mod:`.policy`      — warn/alert thresholds with consecutive-window
     debounce, structured alert records through the Counters channel, and
-    the serving guardrails (registry re-probe / degrade flag), plus
-    delayed-label accuracy via ``ConfusionMatrix.report_batch``.
+    the serving guardrails (registry re-probe / degrade flag / retrain
+    controller handoff), plus delayed-label accuracy via
+    ``ConfusionMatrix.report_batch``.
 
 CLI: the ``driftMonitor`` job (``dm.*`` keys) scores a CSV stream or a
 RESP queue against a registry baseline; ``randomForestBuilder`` publishes
@@ -36,7 +37,7 @@ from .accumulator import (DriftAccumulator, ServingMonitor,
 from .drift import STATS, DriftReport, DriftScorer, RowScore
 from .policy import (AccuracyTracker, AlertRecord, DriftPolicy,
                      DEFAULT_ALERT, DEFAULT_WARN, degrade_action,
-                     refresh_action)
+                     refresh_action, retrain_action)
 
 __all__ = [
     "BASELINE_JSON", "BASELINE_NPZ", "Baseline", "BaselineBuilder",
@@ -45,5 +46,5 @@ __all__ = [
     "ServingMonitor", "StreamDriftMonitor", "STATS", "DriftReport",
     "DriftScorer", "RowScore", "AccuracyTracker", "AlertRecord",
     "DriftPolicy", "DEFAULT_ALERT", "DEFAULT_WARN", "degrade_action",
-    "refresh_action",
+    "refresh_action", "retrain_action",
 ]

@@ -354,6 +354,14 @@ class BaselineBuilder:
 
         def carry_init():
             import jax.numpy as jnp
+            if builder._counts is not None:
+                # a pre-seeded builder (the retrain controller's resumed
+                # build re-profiles the already-consumed head via
+                # update() before fusing the tail) carries its counts
+                # INTO the stage — finish() would otherwise DISCARD the
+                # head with the final carry.  Copy: the carry is donated
+                # and must not alias a buffer the builder still holds.
+                return jnp.array(builder._counts, jnp.float32, copy=True)
             return jnp.zeros((len(builder.specs), b_max), jnp.float32)
 
         def finish(carry):

@@ -16,6 +16,9 @@ to an action callback — the serving guardrails:
   * :func:`degrade_action` — ``PredictionService.mark_degraded``: keep
     answering but flag the model so operators (and the counter dump)
     see it.
+  * :func:`retrain_action` — hand the alert to a
+    ``control.RetrainController`` (queue append, never an inline
+    retrain): the confirmed-drift -> retrain -> validate -> swap loop.
 
 Delayed-label model quality rides the same policy:
 :class:`AccuracyTracker` folds (predicted, actual) label pairs through
@@ -185,12 +188,30 @@ def refresh_action(service, counters: Optional[Counters] = None
 def degrade_action(service, counters: Optional[Counters] = None
                    ) -> Callable[[AlertRecord], None]:
     """On alert, mark the serving model degraded (it keeps answering;
-    operators and the counter dump see the flag)."""
+    operators and the counter dump see the flag).  ``service`` may be a
+    single ``PredictionService`` or a ``ServingFleet`` (fleet-scope
+    ``mark_degraded`` flags every worker; the PR 12 parking rules keep
+    the last active worker serving)."""
     def act(rec: AlertRecord) -> None:
         service.mark_degraded(f"{rec.scope} {rec.stat}={rec.value:.4g} "
                               f">= {rec.threshold:.4g}")
         if counters is not None:
             counters.increment("DriftMonitor", "Degradations")
+    return act
+
+
+def retrain_action(controller, counters: Optional[Counters] = None
+                   ) -> Callable[[AlertRecord], None]:
+    """On alert, hand the record to the retrain controller's
+    control-plane intake (``RetrainController.submit_alert``) — the
+    policy -> controller wiring that closes the loop.  The handoff is a
+    queue append: a retrain NEVER runs inline on the serving/monitor
+    thread (the controller must stay off the data path; its own loop —
+    ``run_pending``/``start()`` — picks the alert up)."""
+    def act(rec: AlertRecord) -> None:
+        controller.submit_alert(rec)
+        if counters is not None:
+            counters.increment("DriftMonitor", "RetrainRequests")
     return act
 
 

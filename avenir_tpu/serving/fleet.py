@@ -248,6 +248,46 @@ class ServingFleet:
         thread, or operator code)."""
         self._reload_gen += 1
 
+    # ---- guardrail-action + controller surface ----
+    # The monitor's refresh_action/degrade_action (and the retrain
+    # controller's fleet link) duck-type against a PredictionService;
+    # these three methods give the fleet the same verbs so a policy wired
+    # at fleet scope converges ALL workers instead of touching one.
+    def refresh(self) -> bool:
+        """Fleet-addressed refresh: bump the generation counter so every
+        worker (parked ones included — the generation check precedes the
+        park check in the drain loop) re-resolves the registry's serving
+        version at its next poll.  Returns whether a swap is actually
+        due (some worker is off the registry's serving version) — the
+        same will-it-swap meaning `PredictionService.refresh` returns,
+        so a counter like `DriftMonitor/RefreshSwaps` is not inflated by
+        alerts that had nothing to swap to.  The swap itself is
+        asynchronous per worker; :meth:`converged_version` is the ack."""
+        self.request_reload()
+        if self.registry is None or self.model_name is None:
+            return False
+        target = self.registry.serving_version(self.model_name)
+        return target is not None and \
+            any(w.service.version != target for w in self.workers)
+
+    def mark_degraded(self, reason: str) -> None:
+        """Flag EVERY worker's service degraded (drift-policy guardrail at
+        fleet scope).  The PR 12 parking rules then apply per worker: a
+        degraded worker parks only while a healthy unparked peer keeps
+        pulling, and the last active worker keeps serving flagged — a
+        fleet-wide degrade never stops the fleet answering."""
+        for w in self.workers:
+            w.service.mark_degraded(reason)
+
+    def converged_version(self) -> Optional[int]:
+        """The single model version every worker is serving, or None
+        while workers disagree (mid-swap) — the controller's swap-ack:
+        poll until this equals the version it published/pinned."""
+        versions = {w.service.version for w in self.workers}
+        if len(versions) == 1:
+            return versions.pop()
+        return None
+
     def wait(self, timeout_s: float = 60.0) -> bool:
         """Block until every drain thread exited (a wire ``stop`` message
         or :meth:`stop` ended the fleet); True when all did."""

@@ -26,6 +26,7 @@ import json
 import os
 import re
 import shutil
+import time
 import warnings
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
@@ -44,9 +45,20 @@ KINDS = (FOREST, BAYES, LOGISTIC, MLP)
 
 META_FILE = "meta.json"
 ARRAYS_FILE = "arrays.npz"
+# serving pin: <base>/<name>/serving.json selects the version the serving
+# tier resolves (rollback surface); absent = newest intact, the historic
+# behavior.  Written tmp-then-rename like every other registry artifact.
+PIN_FILE = "serving.json"
 FORMAT_VERSION = 1
 
 _VERSION_RE = re.compile(r"^v_(\d{6})$")
+# abandoned publish/pin tmps a dead process left behind (the trailing
+# group is the pid retire()'s sweep liveness-checks); younger tmps are
+# never swept — a remote host's live publisher looks pid-dead locally
+_TMP_RE = re.compile(r"^(?:v_\d{6}|" + re.escape(PIN_FILE)
+                     + r")\.tmp\.(\d+)$")
+_TMP_GRACE_S = float(os.environ.get("AVENIR_TPU_REGISTRY_TMP_GRACE_S",
+                                    "3600"))
 
 
 @dataclass
@@ -233,6 +245,148 @@ class ModelRegistry:
                 f"model {name!r} version {v} in {self.base_dir!r} is torn "
                 f"or unreadable; skipping it for serving", RuntimeWarning)
         return None
+
+    # ---- serving pin (the rollback surface) ----
+    def _pin_path(self, name: str) -> str:
+        return self.store.path(name, PIN_FILE)
+
+    def pin_version(self, name: str, version: int) -> None:
+        """Pin the version the serving tier resolves (tmp-then-rename, so
+        readers see the old pin or the new one, never a torn file).  The
+        retrain controller uses this for BOTH directions: forward swap
+        (clears any stale rollback pin that would mask the new candidate)
+        and rollback (repoint the fleet at the prior version).  Refuses a
+        version that is not committed+intact — pinning a torn version
+        would wedge every later hot-swap refresh."""
+        if not self.is_intact(name, version):
+            raise ValueError(
+                f"refusing to pin model {name!r} version {version}: not a "
+                f"committed intact version in {self.base_dir!r}")
+        final = self._pin_path(name)
+        tmp = final + f".tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump({"version": int(version),
+                       "pinned_unix": time.time()}, fh)
+        os.replace(tmp, final)
+
+    def clear_pin(self, name: str) -> None:
+        """Back to newest-intact resolution (idempotent)."""
+        try:
+            os.remove(self._pin_path(name))
+        except FileNotFoundError:
+            pass
+
+    def pinned_version(self, name: str) -> Optional[int]:
+        """The pinned version number, or None (no pin / unreadable pin —
+        an unreadable pin file warns and reads as absent: serving must
+        never wedge on a torn control-plane artifact)."""
+        try:
+            with open(self._pin_path(name)) as fh:
+                return int(json.load(fh)["version"])
+        except FileNotFoundError:
+            return None
+        except Exception as exc:
+            warnings.warn(
+                f"model {name!r} serving pin in {self.base_dir!r} is "
+                f"unreadable ({type(exc).__name__}: {exc}); falling back "
+                f"to newest intact version", RuntimeWarning)
+            return None
+
+    def serving_version(self, name: str) -> Optional[int]:
+        """THE version the serving tier should run: the pinned version
+        when a pin exists and its target is intact (rollback contract),
+        otherwise the newest intact version (the historic hot-swap
+        resolution).  A pin whose target tore (dying-node copy-in)
+        degrades to newest-intact with a warning instead of refusing
+        traffic."""
+        pin = self.pinned_version(name)
+        if pin is not None:
+            if self.is_intact(name, pin):
+                return pin
+            warnings.warn(
+                f"model {name!r} pinned version {pin} in "
+                f"{self.base_dir!r} is torn or missing; serving falls "
+                f"back to the newest intact version", RuntimeWarning)
+        return self.latest_version(name)
+
+    # ---- retention ----
+    @staticmethod
+    def _pid_alive(pid: int) -> bool:
+        try:
+            os.kill(pid, 0)
+            return True
+        except ProcessLookupError:
+            return False
+        except PermissionError:
+            return True        # exists, just not ours
+        except OSError:
+            return True        # unknown: err on the safe side
+
+    def retire(self, name: str, keep_last: int = 3,
+               dry_run: bool = False) -> List[int]:
+        """GC old versions so a controller's publish cadence cannot grow
+        the registry unboundedly: keep the newest ``keep_last`` committed
+        versions, plus — always — the pinned version and the resolved
+        serving version (retiring the version a rollback points at, or
+        the one the fleet is converging onto, would turn the next refresh
+        into a FileNotFoundError).  Abandoned ``.tmp`` publishes are
+        swept too — but ONLY when the pid in their suffix is dead: a
+        cadenced GC racing a live publisher's in-flight tmp must not
+        yank the directory out from under its payload write.  Returns
+        the retired version numbers; ``dry_run`` computes the same list
+        (the single source of the keep rule) without deleting anything."""
+        if keep_last < 1:
+            raise ValueError(f"keep_last must be >= 1, got {keep_last}")
+        versions = self.versions(name)
+        keep = set(versions[-keep_last:])
+        for protected in (self.pinned_version(name),
+                          self.serving_version(name)):
+            if protected is not None:
+                keep.add(protected)
+        retired = [v for v in versions if v not in keep]
+        if dry_run:
+            return retired
+        for v in retired:
+            shutil.rmtree(self.version_dir(name, v), ignore_errors=True)
+        d = self.store.path(name)
+        if os.path.isdir(d):
+            now = time.time()
+            for entry in os.listdir(d):
+                m = _TMP_RE.match(entry)
+                if not m or self._pid_alive(int(m.group(1))):
+                    continue
+                path = os.path.join(d, entry)
+                try:
+                    age = now - os.path.getmtime(path)
+                except OSError:
+                    continue
+                if age < _TMP_GRACE_S:
+                    # the pid probe only sees THIS host; on a shared
+                    # (NFS) registry a remote publisher's in-flight tmp
+                    # looks pid-dead here — the age grace is what keeps
+                    # a cadenced GC from yanking it mid-write.  A real
+                    # orphan is still swept one grace period later.
+                    continue
+                if os.path.isdir(path):
+                    shutil.rmtree(path, ignore_errors=True)
+                else:
+                    try:
+                        os.remove(path)   # an orphaned pin tmp file
+                    except OSError:
+                        pass
+        return retired
+
+    def names(self) -> List[str]:
+        """All model names with at least one committed version (the
+        registrytool listing surface)."""
+        if not os.path.isdir(self.base_dir):
+            return []
+        out = []
+        for entry in sorted(os.listdir(self.base_dir)):
+            if os.path.isdir(os.path.join(self.base_dir, entry)) \
+                    and self.versions(entry):
+                out.append(entry)
+        return out
 
     # ---- publish ----
     def publish(self, name: str, model: Any, *,

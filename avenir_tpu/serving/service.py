@@ -217,7 +217,9 @@ class PredictionService:
 
     # ---- model lifecycle ----
     def _load(self, must: bool = False) -> Optional[Predictor]:
-        latest = self.registry.latest_version(self.model_name)
+        # serving_version, not latest_version: a controller rollback pin
+        # (registry.pin_version) must repoint a cold-started worker too
+        latest = self.registry.serving_version(self.model_name)
         if latest is None:
             if must:
                 raise FileNotFoundError(
@@ -234,15 +236,17 @@ class PredictionService:
         return pred
 
     def refresh(self) -> bool:
-        """Hot-swap reload: if the registry holds a newer INTACT version,
-        build + warm its predictor off the request path and swap it in
-        atomically (in-flight batches finish on the old one).  Returns
-        whether a swap happened.  A half-written newest version is skipped
-        by the registry with a warning — serving stays on the current
-        model."""
+        """Hot-swap reload: converge onto the registry's SERVING version —
+        the newest intact one, or the pinned one when a controller
+        pin/rollback is in force (so a refresh can swap DOWN to the prior
+        version, the rollback contract).  The replacement predictor is
+        built + warmed off the request path and swapped in atomically
+        (in-flight batches finish on the old one).  Returns whether a
+        swap happened.  A half-written target is skipped by the registry
+        with a warning — serving stays on the current model."""
         if self.registry is None:
             return False
-        latest = self.registry.latest_version(self.model_name)
+        latest = self.registry.serving_version(self.model_name)
         if latest is None or latest == self.version:
             return False
         loaded = self.registry.load(self.model_name, latest)

@@ -996,6 +996,99 @@ def bench_monitor_drift(scale):
             "serving_overhead_fraction": round(overhead, 4)}
 
 
+def bench_retrain_loop(scale):
+    """The closed loop (ISSUE 14): wall time from a drift alert to a
+    retrained, validated, published, hot-swapped candidate (one
+    controller cycle over an n-row fresh window, a live
+    PredictionService as the swap link/ack), plus the auto-rollback wall
+    (probation failure -> serving back on the prior version).  The
+    controller is control-plane only, so the serving link answers with a
+    valid model at every instant of both measurements."""
+    _force_platform()
+    import shutil
+    import tempfile
+    import warnings as _warnings
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "resource"))
+    from gen.call_hangup_gen import generate
+    from avenir_tpu.control import RetrainController, RetrainPolicy
+    from avenir_tpu.core.schema import FeatureSchema
+    from avenir_tpu.core.table import load_csv
+    from avenir_tpu.models.forest import ForestParams, build_forest
+    from avenir_tpu.monitor import compute_baseline, publish_baseline
+    from avenir_tpu.monitor.policy import AlertRecord
+    from avenir_tpu.parallel.mesh import MeshContext
+    from avenir_tpu.serving import ModelRegistry, PredictionService
+    schema = FeatureSchema.load(os.path.join(
+        os.path.dirname(__file__), "..", "resource", "call_hangup.json"))
+    n = max(int(100_000 * scale), 5_000)
+    base = tempfile.mkdtemp(prefix="avenir-retrain-bench-")
+    try:
+        train_csv = os.path.join(base, "train.csv")
+        fresh_csv = os.path.join(base, "fresh.csv")
+        with open(train_csv, "w") as fh:
+            fh.write("\n".join(generate(n, 1)) + "\n")
+        with open(fresh_csv, "w") as fh:
+            fh.write("\n".join(generate(n, 2)) + "\n")
+        table = load_csv(train_csv, schema)
+        params = ForestParams(num_trees=5, seed=1)
+        params.tree.max_depth = 4
+        models = build_forest(table, params, MeshContext())
+        reg = ModelRegistry(os.path.join(base, "registry"))
+        v = reg.publish("forest", models, schema=schema)
+        publish_baseline(reg, "forest", v, compute_baseline(table))
+        svc = PredictionService(registry=reg, model_name="forest")
+
+        def alert():
+            return AlertRecord(window_index=1, window_kind="window",
+                               scope="callDuration", stat="psi",
+                               value=0.6, threshold=0.25, level="alert",
+                               streak=2, n_rows=n)
+
+        # (a) alert -> published+swapped cycle wall
+        ctl = RetrainController(
+            reg, "forest", schema, state_dir=os.path.join(base, "s1"),
+            train_source=fresh_csv, forest_params=params, fleet=svc,
+            policy=RetrainPolicy(chunk_rows=1 << 18))
+        ctl.submit_alert(alert())
+        t0 = time.perf_counter()
+        summary = ctl.run_pending()
+        cycle_s = time.perf_counter() - t0
+        assert summary["outcome"] == "published", summary
+        assert svc.version == summary["candidate_version"]
+
+        # (b) probation failure -> rollback wall (serving back on (a)'s
+        # candidate, which is this cycle's champion)
+        outcomes = 256
+        ctl2 = RetrainController(
+            reg, "forest", schema, state_dir=os.path.join(base, "s2"),
+            train_source=fresh_csv, forest_params=params, fleet=svc,
+            policy=RetrainPolicy(chunk_rows=1 << 18,
+                                 probation_outcomes=outcomes))
+        ctl2.submit_alert(alert())
+        waiting = ctl2.run_pending()
+        assert waiting["stage"] == "probation", waiting
+        card = list(schema.class_attr_field.cardinality)
+        t0 = time.perf_counter()
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("ignore")
+            verdict = None
+            for _ in range(outcomes):
+                verdict = ctl2.record_outcome(card[0], card[1])
+                if verdict is not None:
+                    break
+        rollback_s = time.perf_counter() - t0
+        assert verdict and verdict["outcome"] == "rolled_back", verdict
+        assert svc.version == summary["candidate_version"]
+        return {"metric": "retrain_cycle_s", "value": round(cycle_s, 3),
+                "n_rows": n,
+                "retrain_rows_per_sec": round(n / cycle_s, 1),
+                "rollback_s": round(rollback_s, 3),
+                "serving_version_final": svc.version}
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+
 BENCHES = {
     "naive_bayes": bench_naive_bayes,
     "random_forest": bench_random_forest,
@@ -1004,6 +1097,7 @@ BENCHES = {
     "logistic": bench_logistic,
     "serve_forest": bench_serve_forest,
     "monitor_drift": bench_monitor_drift,
+    "retrain_loop": bench_retrain_loop,
 }
 
 

@@ -1,0 +1,971 @@
+"""Closed-loop retrain controller (ISSUE 14): drift alert -> retrain ->
+validate -> publish -> swap -> probation, with automatic rollback and a
+crash journal.
+
+The acceptance contracts under test:
+
+  * chaos drills — the controller killed (injected RuntimeError) at each
+    of its five named fault points (``retrain_build``,
+    ``candidate_validate``, ``registry_publish``, ``fleet_swap``,
+    ``rollback``) while a LIVE 2-worker ServingFleet drains traffic: the
+    fleet keeps answering through the crash, never sees a torn or
+    duplicated version, and a NEW controller resumed on the same state
+    dir converges the fleet onto exactly one model version — with
+    exactly one new registry version (no double-publish, pinned by sha
+    dedup);
+  * a worse candidate is REFUSED at validation (champion untouched);
+  * a candidate that underperforms live probation AUTO-ROLLS-BACK to the
+    prior registry version;
+  * the controller never sits on the data path: its only side effects
+    are registry writes and a reload nudge.
+"""
+
+import json
+import os
+import shutil
+import time
+
+import numpy as np
+import pytest
+
+from avenir_tpu.control import (CycleJournal, PROBATION, PUBLISHED,
+                                REFUSED, RETRAIN_BUILD, ROLLED_BACK,
+                                RetrainController, RetrainPolicy,
+                                alerts_from_jsonl)
+from avenir_tpu.core import faults
+from avenir_tpu.core.table import load_csv
+from avenir_tpu.models.forest import ForestParams, build_forest
+from avenir_tpu.monitor.baseline import compute_baseline, publish_baseline
+from avenir_tpu.monitor.policy import (AlertRecord, DriftPolicy,
+                                       retrain_action)
+from avenir_tpu.serving import BatchPolicy, ModelRegistry, ServingFleet
+from tests.test_tree import SCHEMA
+
+pytestmark = pytest.mark.controller
+
+MODEL = "churn"
+
+
+# --------------------------------------------------------------------------
+# data: a clean regime the champion learns, and a drifted regime (shifted
+# feature distributions AND a different label rule) the candidate learns
+# --------------------------------------------------------------------------
+
+def gen_rows(n, seed, drifted=False, shuffle_labels=False):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(n):
+        ct = rng.choice(["business", "residence"])
+        if drifted:
+            issue = rng.choice(["internet", "cable", "billing", "other"],
+                               p=[0.05, 0.05, 0.55, 0.35])
+            ht = int(rng.integers(0, 240))
+            hung = issue in ("billing", "other")
+        else:
+            issue = rng.choice(["internet", "cable", "billing", "other"])
+            ht = int(rng.integers(0, 600))
+            hung = (issue in ("internet", "cable") and ht > 240) or \
+                   (ct == "business" and ht > 480)
+        if rng.random() < 0.03:
+            hung = not hung
+        rows.append([f"r{i}", ct, issue, str(ht), "T" if hung else "F"])
+    if shuffle_labels:
+        labs = [r[4] for r in rows]
+        rng.shuffle(labs)
+        for r, lab in zip(rows, labs):
+            r[4] = lab
+    return rows
+
+
+def write_csv(path, rows):
+    with open(path, "w") as fh:
+        for r in rows:
+            fh.write(",".join(r) + "\n")
+
+
+def forest_params(trees=3, depth=2, seed=3):
+    p = ForestParams(num_trees=trees, seed=seed)
+    p.tree.max_depth = depth
+    return p
+
+
+def build_champion(tmp_path, mesh_ctx, params=None):
+    """Registry holding v1 (clean-regime forest + baseline sidecar) plus
+    the clean/fresh CSV pair on disk."""
+    params = params or forest_params()
+    clean = str(tmp_path / "clean.csv")
+    fresh = str(tmp_path / "fresh.csv")
+    write_csv(clean, gen_rows(600, seed=1))
+    write_csv(fresh, gen_rows(600, seed=2, drifted=True))
+    table = load_csv(clean, SCHEMA, ",")
+    models = build_forest(table, params, mesh_ctx)
+    reg = ModelRegistry(str(tmp_path / "registry"))
+    v = reg.publish(MODEL, models, schema=SCHEMA)
+    publish_baseline(reg, MODEL, v, compute_baseline(table))
+    return reg, params, clean, fresh
+
+
+def make_controller(reg, params, tmp_path, train_source, fleet=None,
+                    **policy_kw):
+    kw = dict(chunk_rows=128, checkpoint_blocks=1, swap_ack_timeout_s=20.0)
+    kw.update(policy_kw)
+    return RetrainController(
+        reg, MODEL, SCHEMA, state_dir=str(tmp_path / "state"),
+        train_source=train_source, forest_params=params, fleet=fleet,
+        policy=RetrainPolicy(**kw))
+
+
+def drift_alert(n_rows=600):
+    return AlertRecord(window_index=3, window_kind="window",
+                       scope="holdTime", stat="psi", value=0.7,
+                       threshold=0.25, level="alert", streak=2,
+                       n_rows=n_rows)
+
+
+# --------------------------------------------------------------------------
+# journal
+# --------------------------------------------------------------------------
+
+def test_journal_atomic_roundtrip_and_torn_tolerance(tmp_path):
+    state = str(tmp_path / "state")
+    jr = CycleJournal(state)
+    assert jr.stage == "idle" and not jr.pending
+    jr.open_cycle({"scope": "x"}, "incremental", champion_version=1)
+    jr.advance("candidate_validate", candidate_sha="abc")
+    # a fresh instance reads the exact persisted state
+    jr2 = CycleJournal(state)
+    assert jr2.stage == "candidate_validate" and jr2.pending
+    assert jr2["candidate_sha"] == "abc" and jr2.cycle == 1
+    # an abandoned pre-rename tmp never shadows the real file
+    with open(jr2.path + ".tmp.999", "w") as fh:
+        fh.write("{ torn")
+    assert CycleJournal(state).stage == "candidate_validate"
+    # a damaged final journal degrades to idle with a warning, it does
+    # not wedge the controller forever
+    with open(jr2.path, "w") as fh:
+        fh.write("{ not json")
+    with pytest.warns(RuntimeWarning, match="unreadable"):
+        jr3 = CycleJournal(state)
+    assert jr3.stage == "idle"
+
+
+def test_journal_refuses_overlapping_cycles(tmp_path):
+    jr = CycleJournal(str(tmp_path / "state"))
+    jr.open_cycle(None, "incremental", 1)
+    with pytest.raises(RuntimeError, match="still at stage"):
+        jr.open_cycle(None, "incremental", 1)
+    jr.close_cycle(PUBLISHED)
+    assert jr.open_cycle(None, "full", 2) == 2
+    assert [h["cycle"] for h in jr.history] == [1]
+
+
+# --------------------------------------------------------------------------
+# the happy cycle
+# --------------------------------------------------------------------------
+
+def test_cycle_retrains_validates_publishes_swaps(tmp_path, mesh_ctx):
+    """Alert -> incremental retrain on the fresh window -> candidate beats
+    the champion on the drifted holdout -> published -> pinned -> a
+    linked PredictionService hot-swaps to it.  The published candidate is
+    bit-identical to a direct build over the same window (streaming
+    determinism carries through the controller)."""
+    from avenir_tpu.serving import PredictionService
+    reg, params, clean, fresh = build_champion(tmp_path, mesh_ctx)
+    svc = PredictionService(registry=reg, model_name=MODEL, warm=False)
+    ctl = make_controller(reg, params, tmp_path, fresh, fleet=svc)
+    assert ctl.submit_alert(drift_alert())
+    summary = ctl.run_pending()
+    assert summary["outcome"] == PUBLISHED
+    assert summary["candidate_version"] == 2
+    # candidate really is better on the drifted holdout
+    assert summary["candidate_accuracy"] > summary["champion_accuracy"]
+    # registry: exactly one new version, pinned, sha-stamped, baseline on
+    assert reg.versions(MODEL) == [1, 2]
+    assert reg.pinned_version(MODEL) == 2
+    assert reg.serving_version(MODEL) == 2
+    loaded = reg.load(MODEL, 2)
+    assert loaded.params["candidate_sha"]
+    assert loaded.params["retrain_mode"] == "incremental"
+    from avenir_tpu.monitor.baseline import load_baseline
+    assert load_baseline(reg, MODEL, 2).n_rows == 600
+    # the linked service swapped (and the ack saw it)
+    assert svc.version == 2
+    # bit-identity vs a direct monolithic build over the same window
+    ref = build_forest(load_csv(fresh, SCHEMA, ","), params, mesh_ctx)
+    assert [m.to_json() for m in loaded.model] == \
+        [m.to_json() for m in ref]
+    c = ctl.counters.as_dict()["Controller"]
+    assert c["Cycles"] == 1 and c["Published"] == 1 and c["Swaps"] == 1
+    assert ctl.journal.stage == "complete" and not ctl.journal.pending
+    # the cycle working set was swept; the journal survives
+    assert os.listdir(ctl.journal.state_dir) == ["controller.json"]
+
+
+def test_worse_candidate_refused_champion_untouched(tmp_path, mesh_ctx):
+    """A candidate trained on label noise scores below the champion on
+    the holdout: REFUSED — nothing published, nothing pinned, serving
+    still the champion."""
+    reg, params, clean, fresh = build_champion(tmp_path, mesh_ctx)
+    garbage = str(tmp_path / "garbage.csv")
+    write_csv(garbage, gen_rows(600, seed=9, shuffle_labels=True))
+    ctl = RetrainController(
+        reg, MODEL, SCHEMA, state_dir=str(tmp_path / "state"),
+        train_source=garbage, holdout_source=clean,
+        forest_params=params,
+        policy=RetrainPolicy(chunk_rows=128))
+    ctl.submit_alert(drift_alert())
+    with pytest.warns(RuntimeWarning, match="candidate refused"):
+        summary = ctl.run_pending()
+    assert summary["outcome"] == REFUSED
+    assert summary["candidate_accuracy"] < summary["champion_accuracy"]
+    assert reg.versions(MODEL) == [1]
+    assert reg.pinned_version(MODEL) is None
+    assert reg.serving_version(MODEL) == 1
+    assert ctl.counters.get("Controller", "Refused") == 1
+
+
+def test_scheduled_full_rebuild_mode(tmp_path, mesh_ctx):
+    """full_rebuild_every=1 makes every cycle a FULL rebuild over the
+    full_source instead of the fresh window."""
+    reg, params, clean, fresh = build_champion(tmp_path, mesh_ctx)
+    ctl = RetrainController(
+        reg, MODEL, SCHEMA, state_dir=str(tmp_path / "state"),
+        train_source=fresh, full_source=clean, holdout_source=clean,
+        forest_params=params,
+        policy=RetrainPolicy(chunk_rows=128, full_rebuild_every=1))
+    ctl.submit_alert(drift_alert())
+    summary = ctl.run_pending()
+    assert summary["outcome"] == PUBLISHED
+    loaded = reg.load(MODEL, 2)
+    assert loaded.params["retrain_mode"] == "full"
+    # trained on the FULL (clean) source: identical to the champion build
+    ref = build_forest(load_csv(clean, SCHEMA, ","), params, mesh_ctx)
+    assert [m.to_json() for m in loaded.model] == \
+        [m.to_json() for m in ref]
+
+
+def test_alert_intake_coalesce_and_warn_ignored(tmp_path, mesh_ctx):
+    reg, params, clean, fresh = build_champion(tmp_path, mesh_ctx)
+    ctl = make_controller(reg, params, tmp_path, fresh)
+    warn = drift_alert()
+    warn.level = "warn"
+    assert not ctl.submit_alert(warn)
+    assert ctl.counters.get("Controller", "AlertsIgnored") == 1
+    assert ctl.run_pending() is None       # nothing pending
+    assert ctl.submit_alert(drift_alert())
+    assert not ctl.submit_alert(drift_alert())   # coalesced
+    assert ctl.counters.get("Controller", "AlertsCoalesced") == 1
+
+
+def test_policy_retrain_action_wires_alerts_to_controller(tmp_path,
+                                                          mesh_ctx):
+    """The live wiring: a DriftPolicy scoring drifted windows against the
+    champion baseline fires through retrain_action into the controller's
+    intake — and the handoff is a queue append (no retrain ran inline)."""
+    from avenir_tpu.core.metrics import Counters
+    from avenir_tpu.monitor.accumulator import StreamDriftMonitor
+    from avenir_tpu.monitor.baseline import load_baseline
+    reg, params, clean, fresh = build_champion(tmp_path, mesh_ctx)
+    ctl = make_controller(reg, params, tmp_path, fresh)
+    counters = Counters()
+    policy = DriftPolicy(consecutive=1, counters=counters,
+                         on_alert=retrain_action(ctl, counters))
+    monitor = StreamDriftMonitor(load_baseline(reg, MODEL, 1),
+                                 policy=policy, window_rows=300)
+    monitor.observe_table(load_csv(fresh, SCHEMA, ","))
+    monitor.close_window()
+    assert counters.get("DriftMonitor", "RetrainRequests") >= 1
+    assert ctl.counters.get("Controller", "Alerts") == 1
+    assert ctl.journal.stage == "idle"      # nothing ran inline
+    summary = ctl.run_pending()
+    assert summary["outcome"] == PUBLISHED
+    assert reg.serving_version(MODEL) == 2
+    # the triggering alert is journaled as the cycle's trigger
+    assert ctl.journal["trigger"]["level"] == "alert"
+
+
+def test_alerts_jsonl_stream_intake(tmp_path, mesh_ctx):
+    """The batch intake: a driftMonitor-style alerts.jsonl (including a
+    malformed line, which is skipped with a warning) triggers a cycle."""
+    reg, params, clean, fresh = build_champion(tmp_path, mesh_ctx)
+    apath = str(tmp_path / "alerts.jsonl")
+    with open(apath, "w") as fh:
+        warn = drift_alert()
+        warn.level = "warn"
+        fh.write(warn.to_json() + "\n")
+        fh.write("NOT JSON\n")
+        fh.write(drift_alert().to_json() + "\n")
+    with pytest.warns(RuntimeWarning, match="unparseable"):
+        recs = alerts_from_jsonl(apath)
+    assert len(recs) == 2
+    ctl = make_controller(reg, params, tmp_path, fresh)
+    assert ctl.consume(recs) == 1          # warn ignored, alert queued
+    assert ctl.run_pending()["outcome"] == PUBLISHED
+    # missing file: empty, no crash
+    assert alerts_from_jsonl(str(tmp_path / "nope.jsonl")) == []
+
+
+# --------------------------------------------------------------------------
+# probation: live underperformance auto-rolls-back
+# --------------------------------------------------------------------------
+
+def test_probation_rollback_on_live_underperformance(tmp_path, mesh_ctx):
+    reg, params, clean, fresh = build_champion(tmp_path, mesh_ctx)
+    ctl = make_controller(reg, params, tmp_path, fresh,
+                          probation_outcomes=40, probation_margin=5)
+    ctl.submit_alert(drift_alert())
+    waiting = ctl.run_pending()
+    assert waiting["stage"] == PROBATION
+    assert reg.serving_version(MODEL) == 2       # candidate live
+    floor = ctl.journal["probation"]["floor"]
+    # every live outcome wrong -> window accuracy 0 < floor -> rollback
+    verdict = None
+    with pytest.warns(RuntimeWarning, match="rolled back"):
+        for _ in range(40):
+            verdict = ctl.record_outcome("T", "F")
+            if verdict is not None:
+                break
+    assert verdict["outcome"] == ROLLED_BACK
+    assert reg.pinned_version(MODEL) == 1
+    assert reg.serving_version(MODEL) == 1       # champion restored
+    assert reg.versions(MODEL) == [1, 2]         # candidate retained
+    assert ctl.counters.get("Controller", "Rollbacks") == 1
+    assert ctl.journal["probation"]["last_accuracy"] < floor
+    # a later refresh-driven service loads the CHAMPION despite v2 newer
+    from avenir_tpu.serving import PredictionService
+    svc = PredictionService(registry=reg, model_name=MODEL, warm=False)
+    assert svc.version == 1
+
+
+def test_probation_survival_completes_published(tmp_path, mesh_ctx):
+    reg, params, clean, fresh = build_champion(tmp_path, mesh_ctx)
+    ctl = make_controller(reg, params, tmp_path, fresh,
+                          probation_outcomes=20, probation_windows=2)
+    ctl.submit_alert(drift_alert())
+    assert ctl.run_pending()["stage"] == PROBATION
+    verdict = None
+    for _ in range(40):                      # 2 windows of 20, all right
+        verdict = ctl.record_outcome("T", "T")
+        if verdict is not None:
+            break
+    assert verdict["outcome"] == PUBLISHED
+    assert reg.serving_version(MODEL) == 2
+    assert ctl.counters.get("Controller", "ProbationWindows") == 2
+    # outside probation the feed is a no-op
+    assert ctl.record_outcome("T", "F") is None
+
+
+# --------------------------------------------------------------------------
+# chaos drills: kill the controller at every fault point under live load
+# --------------------------------------------------------------------------
+
+@pytest.fixture()
+def resp_server():
+    from avenir_tpu.io.respq import RespServer
+    server = RespServer().start()
+    yield server
+    server.stop()
+
+
+def start_fleet(reg, port, n_workers=2):
+    fleet = ServingFleet(reg, MODEL, buckets=(8, 64),
+                         policy=BatchPolicy(max_batch=16, max_wait_ms=1.0),
+                         n_workers=n_workers,
+                         config={"redis.server.port": port})
+    return fleet.start()
+
+
+def serve_round(client, rows, base_id, n=20, timeout_s=30.0):
+    """Push n requests, pop n replies; returns {rid: label} — the 'fleet
+    is still answering' probe used before/during/after each drill."""
+    client.lpush_many("requestQueue",
+                      [",".join(["predict", f"{base_id}-{i}"]
+                                + rows[i % len(rows)])
+                       for i in range(n)])
+    got = {}
+    deadline = time.monotonic() + timeout_s
+    while len(got) < n and time.monotonic() < deadline:
+        for v in client.rpop_many("predictionQueue", 64):
+            rid, label = v.split(",", 1)
+            assert rid not in got, f"duplicate reply for {rid}"
+            got[rid] = label
+        time.sleep(0.002)
+    assert len(got) == n, f"fleet stopped answering ({len(got)}/{n})"
+    return got
+
+
+DRILLS = [
+    # (spec, what the kill interrupts)
+    ("retrain_build@3=raise:RuntimeError", "mid-build, checkpoint saved"),
+    ("candidate_validate@0=raise:RuntimeError", "validation entry"),
+    ("registry_publish@1=raise:RuntimeError", "mid payload write"),
+    ("registry_publish@2=raise:RuntimeError",
+     "post-commit pre-journal (the double-publish window)"),
+    ("fleet_swap@0=raise:RuntimeError", "before pin+reload"),
+]
+
+
+@pytest.mark.faultinject
+@pytest.mark.parametrize("spec,_what", DRILLS,
+                         ids=[s.split("=")[0] for s, _ in DRILLS])
+def test_chaos_drill_controller_killed_fleet_survives(
+        spec, _what, tmp_path, mesh_ctx, resp_server, fault_injector):
+    """Kill the controller at each named fault point while a live
+    2-worker fleet drains traffic: the fleet answers through the crash
+    on exactly one model version, and a NEW controller resumed on the
+    same state dir finishes the cycle with exactly ONE new registry
+    version (no double-publish) and converges the fleet onto it."""
+    from avenir_tpu.io.respq import RespClient
+    reg, params, clean, fresh = build_champion(tmp_path, mesh_ctx)
+    rows = gen_rows(30, seed=77, drifted=True)
+    fleet = start_fleet(reg, resp_server.port)
+    feeder = RespClient(port=resp_server.port)
+    try:
+        serve_round(feeder, rows, "pre", 20)
+        assert fleet.converged_version() == 1
+        ctl = make_controller(reg, params, tmp_path, fresh, fleet=fleet)
+        ctl.submit_alert(drift_alert())
+        fault_injector(spec)
+        with pytest.raises(RuntimeError, match="injected fault"):
+            ctl.run_pending()
+        # the crash journaled a mid-flight stage; serving never noticed:
+        # the fleet still answers, on exactly one (un-torn) version
+        assert ctl.journal.pending
+        serve_round(feeder, rows, "mid", 20)
+        assert fleet.converged_version() == 1
+        faults.uninstall()
+        # a NEW controller (no shared memory with the dead one) resumes
+        ctl2 = make_controller(reg, params, tmp_path, fresh, fleet=fleet)
+        summary = ctl2.run_pending()
+        assert summary["outcome"] == PUBLISHED
+        assert ctl2.counters.get("Controller", "Resumes") == 1
+        # exactly one new version: the sha dedup closed the
+        # double-publish window
+        assert reg.versions(MODEL) == [1, 2]
+        assert reg.serving_version(MODEL) == 2
+        # the fleet converged onto exactly the published version and
+        # still answers
+        deadline = time.monotonic() + 20.0
+        while fleet.converged_version() != 2 \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert fleet.converged_version() == 2
+        serve_round(feeder, rows, "post", 20)
+        st = fleet.stats()
+        assert st["errors"] == 0
+        assert set(st["model_versions"].values()) == {2}
+    finally:
+        fleet.stop()
+        feeder.close()
+
+
+@pytest.mark.faultinject
+def test_chaos_drill_killed_mid_rollback_resumes_rollback(
+        tmp_path, mesh_ctx, resp_server, fault_injector):
+    """The fifth fault point: probation fails, the controller dies INSIDE
+    rollback (after journaling the rollback intent, before the pin) —
+    the fleet keeps serving the candidate meanwhile, and the resumed
+    controller finishes the rollback: pin back to the champion, fleet
+    converges back onto v1."""
+    from avenir_tpu.io.respq import RespClient
+    reg, params, clean, fresh = build_champion(tmp_path, mesh_ctx)
+    rows = gen_rows(30, seed=78, drifted=True)
+    fleet = start_fleet(reg, resp_server.port)
+    feeder = RespClient(port=resp_server.port)
+    try:
+        ctl = make_controller(reg, params, tmp_path, fresh, fleet=fleet,
+                              probation_outcomes=10)
+        ctl.submit_alert(drift_alert())
+        assert ctl.run_pending()["stage"] == PROBATION
+        deadline = time.monotonic() + 20.0
+        while fleet.converged_version() != 2 \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert fleet.converged_version() == 2
+        fault_injector("rollback@0=raise:RuntimeError")
+        with pytest.raises(RuntimeError, match="injected fault"):
+            for _ in range(10):
+                ctl.record_outcome("T", "F")
+        faults.uninstall()
+        # mid-rollback crash: candidate still pinned+serving, fleet fine
+        assert ctl.journal.stage == "rollback"
+        assert reg.serving_version(MODEL) == 2
+        serve_round(feeder, rows, "mid", 20)
+        ctl2 = make_controller(reg, params, tmp_path, fresh, fleet=fleet)
+        with pytest.warns(RuntimeWarning, match="rolled back"):
+            summary = ctl2.run_pending()
+        assert summary["outcome"] == ROLLED_BACK
+        assert reg.serving_version(MODEL) == 1
+        deadline = time.monotonic() + 20.0
+        while fleet.converged_version() != 1 \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert fleet.converged_version() == 1
+        serve_round(feeder, rows, "post", 20)
+        assert fleet.stats()["errors"] == 0
+    finally:
+        fleet.stop()
+        feeder.close()
+
+
+@pytest.mark.faultinject
+def test_resumed_build_is_bit_identical(tmp_path, mesh_ctx,
+                                        fault_injector):
+    """A build killed between checkpoints resumes from the checkpoint and
+    publishes the bit-identical model of an uninterrupted run (the PR 2/7
+    resume contract carried through the controller)."""
+    reg, params, clean, fresh = build_champion(tmp_path, mesh_ctx)
+    ctl = make_controller(reg, params, tmp_path, fresh)
+    ctl.submit_alert(drift_alert())
+    fault_injector("retrain_build@3=raise:RuntimeError")
+    with pytest.raises(RuntimeError):
+        ctl.run_pending()
+    faults.uninstall()
+    ctl2 = make_controller(reg, params, tmp_path, fresh)
+    assert ctl2.run_pending()["outcome"] == PUBLISHED
+    # the resume really started from the checkpoint, not row 0
+    assert ctl2.counters.get("Controller", "BuildResumes") == 1
+    ref = build_forest(load_csv(fresh, SCHEMA, ","), params, mesh_ctx)
+    assert [m.to_json() for m in reg.load(MODEL, 2).model] == \
+        [m.to_json() for m in ref]
+    # the published baseline covers the WHOLE window, not just the
+    # post-crash tail: the resumed build re-profiles the head the
+    # checkpoint already consumed (and the fused absorb stage carries
+    # those pre-seeded counts through instead of discarding them)
+    from avenir_tpu.monitor.baseline import load_baseline
+    bl = load_baseline(reg, MODEL, 2)
+    assert bl.n_rows == 600
+    ref_bl = compute_baseline(load_csv(fresh, SCHEMA, ","))
+    assert np.array_equal(bl.counts, ref_bl.counts)
+
+
+def test_resume_without_candidate_abandons_safely(tmp_path, mesh_ctx):
+    """A journal stuck at candidate_validate whose candidate payload is
+    gone (or torn) cannot finish the cycle — resume abandons it with the
+    champion untouched instead of wedging or publishing garbage."""
+    reg, params, clean, fresh = build_champion(tmp_path, mesh_ctx)
+    state = str(tmp_path / "state")
+    jr = CycleJournal(state)
+    jr.open_cycle(None, "incremental", champion_version=1)
+    jr.advance("candidate_validate", candidate_sha="deadbeef")
+    ctl = RetrainController(reg, MODEL, SCHEMA, state_dir=state,
+                            train_source=fresh, forest_params=params)
+    with pytest.warns(RuntimeWarning, match="abandoned"):
+        summary = ctl.run_pending()
+    assert summary["outcome"] == "abandoned"
+    assert reg.versions(MODEL) == [1]
+    assert reg.serving_version(MODEL) == 1
+    assert ctl.counters.get("Controller", "Abandoned") == 1
+
+
+# --------------------------------------------------------------------------
+# registry pin / retire / tool
+# --------------------------------------------------------------------------
+
+def small_registry(tmp_path, mesh_ctx, versions=4):
+    params = forest_params()
+    table = load_csv_rows(tmp_path)
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    models = build_forest(table, params, mesh_ctx)
+    for _ in range(versions):
+        reg.publish(MODEL, models, schema=SCHEMA)
+    return reg
+
+
+def load_csv_rows(tmp_path):
+    p = str(tmp_path / "rows.csv")
+    write_csv(p, gen_rows(200, seed=4))
+    return load_csv(p, SCHEMA, ",")
+
+
+def test_registry_pin_and_serving_resolution(tmp_path, mesh_ctx):
+    reg = small_registry(tmp_path, mesh_ctx, versions=3)
+    assert reg.serving_version(MODEL) == 3
+    reg.pin_version(MODEL, 2)
+    assert reg.pinned_version(MODEL) == 2
+    assert reg.serving_version(MODEL) == 2
+    assert reg.latest_version(MODEL) == 3    # pin does not lie to latest
+    # pinning a non-version refuses
+    with pytest.raises(ValueError, match="refusing to pin"):
+        reg.pin_version(MODEL, 99)
+    # a pin whose target tears falls back to newest intact with a warning
+    shutil.rmtree(reg.version_dir(MODEL, 2))
+    with pytest.warns(RuntimeWarning, match="pinned version 2"):
+        assert reg.serving_version(MODEL) == 3
+    reg.clear_pin(MODEL)
+    reg.clear_pin(MODEL)                     # idempotent
+    assert reg.serving_version(MODEL) == 3
+
+
+def test_registry_retire_keeps_pin_and_newest(tmp_path, mesh_ctx):
+    reg = small_registry(tmp_path, mesh_ctx, versions=4)
+    reg.pin_version(MODEL, 2)
+    # an abandoned tmp publish from a DEAD process is swept; a LIVE
+    # publisher's in-flight tmp (this process's pid) must survive a
+    # cadenced GC racing it
+    dead = 999999
+    while os.path.exists(f"/proc/{dead}"):
+        dead -= 1
+    old_dir = os.path.join(reg.store.path(MODEL),
+                           f"v_000099.tmp.{dead}")
+    os.makedirs(old_dir)
+    # a crashed pin_version leaves a tmp FILE — swept by the same rule
+    old_pin = os.path.join(reg.store.path(MODEL),
+                           f"serving.json.tmp.{dead}")
+    with open(old_pin, "w") as fh:
+        fh.write("{}")
+    # backdate both past the NFS grace window (a YOUNG dead-pid tmp may
+    # be a remote host's live publisher and must survive the sweep)
+    stale = time.time() - 7200
+    os.utime(old_dir, (stale, stale))
+    os.utime(old_pin, (stale, stale))
+    fresh_dead = os.path.join(reg.store.path(MODEL),
+                              f"v_000097.tmp.{dead}")
+    os.makedirs(fresh_dead)
+    live = os.path.join(reg.store.path(MODEL),
+                        f"v_000098.tmp.{os.getpid()}")
+    os.makedirs(live)
+    # dry_run reports the same keep rule without touching anything
+    assert reg.retire(MODEL, keep_last=1, dry_run=True) == [1, 3]
+    assert reg.versions(MODEL) == [1, 2, 3, 4]
+    retired = reg.retire(MODEL, keep_last=1)
+    assert retired == [1, 3]
+    assert reg.versions(MODEL) == [2, 4]     # pinned + newest survive
+    assert reg.serving_version(MODEL) == 2
+    assert not os.path.exists(old_dir)       # stale dead-pid dir swept
+    assert not os.path.exists(old_pin)       # stale pin tmp swept
+    assert os.path.isdir(fresh_dead)         # young: maybe remote-live
+    assert os.path.isdir(live)               # live publisher untouched
+    reg.clear_pin(MODEL)
+    assert reg.retire(MODEL, keep_last=1) == [2]
+    assert reg.versions(MODEL) == [4]
+    with pytest.raises(ValueError):
+        reg.retire(MODEL, keep_last=0)
+
+
+def test_registrytool_list_verify_gc(tmp_path, mesh_ctx, capsys):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "registrytool", os.path.join(os.path.dirname(__file__), "..",
+                                     "tools", "registrytool.py"))
+    tool = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tool)
+    # a missing/empty registry (typo'd path) must not read as healthy
+    assert tool.main(["verify", str(tmp_path / "nowhere")]) == 1
+    capsys.readouterr()
+    reg = small_registry(tmp_path, mesh_ctx, versions=3)
+    reg.pin_version(MODEL, 2)
+    base = reg.base_dir
+    assert tool.main(["list", base]) == 0
+    out = capsys.readouterr().out
+    assert "pinned=2 serving=2" in out and " 3 " in out
+    assert tool.main(["verify", base]) == 0
+    assert "verified" in capsys.readouterr().out
+    # dry-run GC changes nothing
+    assert tool.main(["gc", base, "--name", MODEL, "--keep", "1",
+                      "--dry-run"]) == 0
+    assert reg.versions(MODEL) == [1, 2, 3]
+    assert tool.main(["gc", base, "--name", MODEL, "--keep", "1"]) == 0
+    assert reg.versions(MODEL) == [2, 3]
+    # tear a version -> verify exits 1 and names it
+    meta = os.path.join(reg.version_dir(MODEL, 3), "meta.json")
+    with open(meta, "w") as fh:
+        fh.write("{ torn")
+    assert tool.main(["verify", base]) == 1
+    assert "TORN" in capsys.readouterr().out
+
+
+def test_controller_retires_old_versions_in_loop(tmp_path, mesh_ctx):
+    """retire_keep_last in the controller policy GCs after each cycle so
+    the publish cadence cannot grow the registry unboundedly."""
+    reg, params, clean, fresh = build_champion(tmp_path, mesh_ctx)
+    ctl = make_controller(reg, params, tmp_path, fresh,
+                          retire_keep_last=2)
+    for i in range(2):
+        ctl.submit_alert(drift_alert())
+        assert ctl.run_pending()["outcome"] == PUBLISHED
+    # three versions existed (1,2,3); GC kept the newest two (3 pinned)
+    assert reg.versions(MODEL) == [2, 3]
+    assert reg.serving_version(MODEL) == 3
+    assert ctl.counters.get("Controller", "VersionsRetired") >= 1
+
+
+# --------------------------------------------------------------------------
+# CLI job
+# --------------------------------------------------------------------------
+
+def test_retrain_controller_cli_job(tmp_path, mesh_ctx):
+    """End-to-end through the CLI: alerts.jsonl trigger, incremental
+    retrain, publish+pin, decisions artifact; then a second run whose
+    probation replay (against labels the candidate gets WRONG) rolls the
+    fleet back — all through config keys only."""
+    from avenir_tpu.cli import run as cli_run
+    reg, params, clean, fresh = build_champion(
+        tmp_path, mesh_ctx, params=forest_params(seed=3))
+    schema_path = str(tmp_path / "schema.json")
+    import json as _json
+    with open(schema_path, "w") as fh:
+        _json.dump(SCHEMA.to_dict(), fh)
+    apath = str(tmp_path / "alerts.jsonl")
+    with open(apath, "w") as fh:
+        fh.write(drift_alert().to_json() + "\n")
+    props = str(tmp_path / "retrain.properties")
+    with open(props, "w") as fh:
+        fh.write("\n".join([
+            f"dtb.model.registry.dir={reg.base_dir}",
+            f"dtb.model.name={MODEL}",
+            f"dtb.feature.schema.file.path={schema_path}",
+            f"dtb.retrain.state.dir={tmp_path / 'cli_state'}",
+            f"dtb.retrain.alerts.path={apath}",
+            "dtb.retrain.block.rows=128",
+            "dtb.num.trees=3",
+            "dtb.max.depth.limit=2",
+            "dtb.random.seed=3",
+        ]) + "\n")
+    out = str(tmp_path / "out")
+    rc = cli_run.main(["retrainController", f"-Dconf.path={props}",
+                       fresh, out])
+    assert rc == 0
+    assert reg.versions(MODEL) == [1, 2]
+    assert reg.serving_version(MODEL) == 2
+    lines = open(os.path.join(out, "decisions.jsonl")).read().splitlines()
+    recs = [json.loads(ln) for ln in lines]
+    assert recs[0]["outcome"] == PUBLISHED and recs[0].get("this_run")
+    part = open(os.path.join(out, "part-r-00000")).read().split(",")
+    assert part[2].strip() == PUBLISHED
+    # counters sibling written by cli.run
+    ctrs = json.loads(open(out + ".counters.json").read())
+    assert ctrs["Controller"]["Published"] == 1
+
+
+def test_retrain_controller_cli_probation_rollback(tmp_path, mesh_ctx):
+    """CLI probation replay: the swapped candidate scores the probation
+    CSV; labels engineered so it underperforms the floor -> the job
+    auto-rolls-back before exiting."""
+    from avenir_tpu.cli import run as cli_run
+    reg, params, clean, fresh = build_champion(tmp_path, mesh_ctx)
+    schema_path = str(tmp_path / "schema.json")
+    import json as _json
+    with open(schema_path, "w") as fh:
+        _json.dump(SCHEMA.to_dict(), fh)
+    # probation stream: drifted features with INVERTED labels — the
+    # candidate (trained on the drifted rule) gets nearly all wrong
+    prob = str(tmp_path / "probation.csv")
+    rows = gen_rows(200, seed=11, drifted=True)
+    for r in rows:
+        r[4] = "F" if r[4] == "T" else "T"
+    write_csv(prob, rows)
+    props = str(tmp_path / "retrain.properties")
+    with open(props, "w") as fh:
+        fh.write("\n".join([
+            f"dtb.model.registry.dir={reg.base_dir}",
+            f"dtb.model.name={MODEL}",
+            f"dtb.feature.schema.file.path={schema_path}",
+            f"dtb.retrain.state.dir={tmp_path / 'cli_state'}",
+            "dtb.retrain.trigger=force",
+            "dtb.retrain.probation.outcomes=50",
+            f"dtb.retrain.probation.input={prob}",
+            "dtb.retrain.block.rows=128",
+            "dtb.num.trees=3",
+            "dtb.max.depth.limit=2",
+            "dtb.random.seed=3",
+        ]) + "\n")
+    out = str(tmp_path / "out")
+    rc = cli_run.main(["retrainController", f"-Dconf.path={props}",
+                       fresh, out])
+    assert rc == 0
+    assert reg.versions(MODEL) == [1, 2]
+    assert reg.serving_version(MODEL) == 1       # rolled back
+    recs = [json.loads(ln) for ln in
+            open(os.path.join(out, "decisions.jsonl"))]
+    assert any(r.get("outcome") == ROLLED_BACK for r in recs)
+
+
+# --------------------------------------------------------------------------
+# the closed-loop soak: monitor -> policy -> controller thread -> fleet
+# --------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_closed_loop_soak_drift_to_swap(tmp_path, mesh_ctx, resp_server):
+    """The whole loop live: a fleet serves drifted traffic, the stream
+    monitor fires a debounced alert through retrain_action, the
+    controller's background thread retrains/validates/publishes/swaps,
+    and the fleet converges onto the candidate — no operator in the
+    loop."""
+    from avenir_tpu.core.metrics import Counters
+    from avenir_tpu.io.respq import RespClient
+    from avenir_tpu.monitor.accumulator import StreamDriftMonitor
+    from avenir_tpu.monitor.baseline import load_baseline
+    reg, params, clean, fresh = build_champion(tmp_path, mesh_ctx)
+    fleet = start_fleet(reg, resp_server.port)
+    feeder = RespClient(port=resp_server.port)
+    ctl = make_controller(reg, params, tmp_path, fresh, fleet=fleet)
+    counters = Counters()
+    policy = DriftPolicy(consecutive=2, counters=counters,
+                         on_alert=retrain_action(ctl, counters))
+    monitor = StreamDriftMonitor(load_baseline(reg, MODEL, 1),
+                                 policy=policy, window_rows=200)
+    ctl.start(poll_s=0.05)
+    try:
+        drift_rows = gen_rows(500, seed=21, drifted=True)
+        # live traffic + the monitor scoring the same stream
+        serve_round(feeder, drift_rows, "soak", 40)
+        monitor.observe_table(load_csv(fresh, SCHEMA, ","))
+        monitor.close_window()
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            if ctl.journal.stage == "complete" \
+                    and ctl.journal["outcome"] == PUBLISHED:
+                break
+            time.sleep(0.05)
+        assert ctl.journal["outcome"] == PUBLISHED
+        deadline = time.monotonic() + 20.0
+        while fleet.converged_version() != 2 \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert fleet.converged_version() == 2
+        serve_round(feeder, drift_rows, "post", 40)
+        assert fleet.stats()["errors"] == 0
+    finally:
+        ctl.stop()
+        fleet.stop()
+        feeder.close()
+
+
+def test_rollback_target_retired_abandons_not_wedges(tmp_path, mesh_ctx):
+    """An external GC that retired the journaled champion mid-probation
+    must not wedge the rollback stage forever: the cycle abandons with a
+    loud warning, serving stays on the newest intact version."""
+    reg, params, clean, fresh = build_champion(tmp_path, mesh_ctx)
+    ctl = make_controller(reg, params, tmp_path, fresh,
+                          probation_outcomes=10)
+    ctl.submit_alert(drift_alert())
+    assert ctl.run_pending()["stage"] == PROBATION
+    shutil.rmtree(reg.version_dir(MODEL, 1))     # the GC-killed champion
+    with pytest.warns(RuntimeWarning, match="rollback target"):
+        verdict = None
+        for _ in range(10):
+            verdict = ctl.record_outcome("T", "F")
+            if verdict is not None:
+                break
+    assert verdict["outcome"] == "abandoned"
+    assert ctl.counters.get("Controller", "RollbackTargetMissing") == 1
+    assert reg.pinned_version(MODEL) is None     # un-pinned, not wedged
+    assert reg.serving_version(MODEL) == 2
+    # the controller is usable again: a new cycle opens cleanly (and
+    # enters probation per this controller's policy)
+    ctl.submit_alert(drift_alert())
+    assert ctl.run_pending()["stage"] == PROBATION
+    assert ctl.resolve_probation(keep=True)["outcome"] == PUBLISHED
+
+
+def test_cached_head_read_honors_stop_row(tmp_path, mesh_ctx):
+    """The bounded head read the resumed build uses is served from a
+    warm .avtc sidecar: the cached iterator honors stop_row, and a
+    bounded read never BUILDS a cache (a head must not masquerade as a
+    full sidecar)."""
+    from avenir_tpu.core.table import iter_csv_chunks
+    from avenir_tpu.io.colcache import CachePolicy
+    fresh = str(tmp_path / "rows.csv")
+    write_csv(fresh, gen_rows(600, seed=5))
+
+    def head_rows(cache):
+        out = 0
+        for c in iter_csv_chunks(fresh, SCHEMA, ",", chunk_rows=128,
+                                 cache=cache, stop_row=256):
+            out += c.n_rows
+        return out
+
+    # bounded read under policy=build: parses, does NOT build
+    assert head_rows(CachePolicy(policy="build")) == 256
+    assert not os.path.exists(fresh + ".avtc")
+    # build the sidecar with a full pass, then a bounded cached read
+    for _ in iter_csv_chunks(fresh, SCHEMA, ",", chunk_rows=128,
+                             cache=CachePolicy(policy="build")):
+        pass
+    assert os.path.exists(fresh + ".avtc")
+    pol = CachePolicy(policy="require")
+    assert head_rows(pol) == 256                 # served FROM the cache
+
+
+def test_probation_timeout_and_operator_resolve(tmp_path, mesh_ctx):
+    """A probation whose outcome stream never materializes must not
+    wedge the controller: past probation_timeout_s the next tick keeps
+    the candidate with a warning; resolve_probation(keep=False) is the
+    operator's immediate rollback."""
+    reg, params, clean, fresh = build_champion(tmp_path, mesh_ctx)
+    ctl = make_controller(reg, params, tmp_path, fresh,
+                          probation_outcomes=10,
+                          probation_timeout_s=0.05)
+    ctl.submit_alert(drift_alert())
+    assert ctl.run_pending()["stage"] == PROBATION
+    assert ctl.run_pending() is None         # within the timeout: wait
+    time.sleep(0.1)
+    with pytest.warns(RuntimeWarning, match="no verdict"):
+        summary = ctl.run_pending()
+    assert summary["outcome"] == PUBLISHED
+    assert ctl.counters.get("Controller", "ProbationTimeouts") == 1
+    assert reg.serving_version(MODEL) == 2
+    # operator rollback on a second cycle stuck in probation
+    ctl2 = make_controller(reg, params, tmp_path / "s2", fresh,
+                           probation_outcomes=10)
+    ctl2.submit_alert(drift_alert())
+    assert ctl2.run_pending()["stage"] == PROBATION
+    assert ctl2.force_cycle() is None        # force must NOT reset it
+    with pytest.warns(RuntimeWarning, match="rolled back"):
+        verdict = ctl2.resolve_probation(keep=False)
+    assert verdict["outcome"] == ROLLED_BACK
+    assert reg.serving_version(MODEL) == 2   # back on cycle-2's champion
+    assert ctl2.resolve_probation() is None  # no-op outside probation
+
+
+def test_submit_alert_never_blocks_on_a_running_cycle(tmp_path):
+    """The monitor/serving thread's handoff contract: submit_alert takes
+    only the alert-slot lock, so an alert arriving while run_pending
+    holds the cycle lock for a whole retrain returns immediately."""
+    import threading
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    ctl = RetrainController(reg, MODEL, SCHEMA,
+                            state_dir=str(tmp_path / "state"),
+                            train_source=str(tmp_path / "x.csv"))
+    with ctl._lock:                  # a cycle is mid-flight
+        done = threading.Event()
+        threading.Thread(
+            target=lambda: (ctl.submit_alert(drift_alert()), done.set()),
+            daemon=True).start()
+        assert done.wait(2.0), "submit_alert blocked behind the cycle lock"
+    assert ctl.counters.get("Controller", "Alerts") == 1
+
+
+def test_alerts_from_resp_repushes_stop_keeps_batch(resp_server):
+    """The RESP tap: a drained 'stop' sentinel goes BACK on the queue
+    (it was aimed at the queue's consumer, not this reader) and alerts
+    popped in the same batch are still returned, never dropped."""
+    from avenir_tpu.control import alerts_from_resp
+    from avenir_tpu.io.respq import RespClient
+    cli = RespClient(port=resp_server.port)
+    try:
+        cli.lpush_many("alertQueue", [drift_alert().to_json(), "stop",
+                                      drift_alert(n_rows=7).to_json()])
+        recs = alerts_from_resp(cli, "alertQueue")
+        assert [r.n_rows for r in recs] == [600, 7]
+        assert cli.rpop_many("alertQueue", 10) == ["stop"]
+    finally:
+        cli.close()
+
+
+def test_wire_fleet_link_pushes_addressed_reloads(resp_server):
+    """The out-of-process swap link speaks the PR 12 multi-host
+    convergence protocol: one addressed reload per named host (bare
+    'reload' when unnamed) onto the request queue."""
+    from avenir_tpu.control import WireFleetLink
+    from avenir_tpu.io.respq import RespClient
+    cli = RespClient(port=resp_server.port)
+    try:
+        assert WireFleetLink(cli, hosts=["hostA", "hostB"]).refresh()
+        assert set(cli.rpop_many("requestQueue", 10)) == \
+            {"reload,hostA", "reload,hostB"}
+        assert WireFleetLink(cli).refresh()
+        assert cli.rpop_many("requestQueue", 10) == ["reload"]
+    finally:
+        cli.close()

@@ -118,6 +118,57 @@ def with_retry_fast(fn):
     return faults.with_retry(fn, attempts=3, base_delay=0.0)
 
 
+def _retry_sleeps(monkeypatch, *, attempts=4, base=0.1, seed=None):
+    """Run an always-failing with_retry recording the backoff sleeps."""
+    slept = []
+    monkeypatch.setattr(faults.time, "sleep", slept.append)
+
+    def always():
+        raise OSError("transient")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with pytest.raises(OSError):
+            faults.with_retry(always, attempts=attempts, base_delay=base,
+                              jitter_seed=seed)
+    return slept
+
+
+def test_with_retry_backoff_has_full_jitter(monkeypatch):
+    """The backoff is FULL jitter: each attempt's sleep is a draw from
+    (0, base * 2**i], never the bare exponential ladder — P sharded
+    processes whose reads fail together must not retry in lockstep and
+    re-hammer the same file/broker at the same instants."""
+    slept = _retry_sleeps(monkeypatch, attempts=4, base=0.1, seed=1234)
+    assert len(slept) == 3
+    for i, s in enumerate(slept):
+        cap = 0.1 * (1 << i)
+        assert 0.0 < s <= cap, f"attempt {i}: {s} outside (0, {cap}]"
+    # astronomically unlikely that a jittered ladder equals the exact
+    # deterministic one — if it does, the jitter is not being applied
+    assert slept != [0.1, 0.2, 0.4]
+
+
+def test_with_retry_jitter_deterministic_under_fixed_seed(monkeypatch):
+    """Same jitter_seed -> identical sleep sequence (reproducible fault
+    tests); different seeds -> decorrelated sequences (the lockstep
+    breaker)."""
+    a = _retry_sleeps(monkeypatch, seed=42)
+    b = _retry_sleeps(monkeypatch, seed=42)
+    c = _retry_sleeps(monkeypatch, seed=43)
+    assert a == b
+    assert a != c
+
+
+def test_with_retry_default_jitter_stream_advances(monkeypatch):
+    """Without an explicit seed the module's per-process RNG advances
+    between calls: two consecutive failing retries in ONE process do not
+    repeat the same delays either (the stream is shared, not re-seeded
+    per call)."""
+    a = _retry_sleeps(monkeypatch)
+    b = _retry_sleeps(monkeypatch)
+    assert a != b
+
+
 def test_fixture_installs_and_clears(fault_injector):
     fault_injector("chunk_read@0=raise:OSError")
     with pytest.raises(OSError):

@@ -445,3 +445,117 @@ def test_fleet_soak_sustained_multiworker(tmp_path, mesh_ctx, resp_server):
     finally:
         fleet.stop()
         feeder.close()
+
+
+# --------------------------------------------------------------------------
+# drift-policy guardrail actions at FLEET scope (ISSUE 14 satellite):
+# refresh_action/degrade_action were written against a single
+# PredictionService — pin that wired to a ServingFleet the refresh
+# converges ALL workers and a degrade parks only per the PR 12 rules
+# --------------------------------------------------------------------------
+
+def _fake_alert(value=0.7):
+    from avenir_tpu.monitor.policy import AlertRecord
+    return AlertRecord(window_index=1, window_kind="window",
+                       scope="holdTime", stat="psi", value=value,
+                       threshold=0.25, level="alert", streak=2,
+                       n_rows=256)
+
+
+def test_refresh_action_converges_whole_fleet(tmp_path, mesh_ctx,
+                                              resp_server):
+    """A fleet-addressed refresh_action (the 'a retrain already landed'
+    guardrail) converges every worker onto the newly published version —
+    not just one service."""
+    from avenir_tpu.core.metrics import Counters
+    from avenir_tpu.monitor.policy import refresh_action
+    reg, table, m1 = make_fleet_registry(tmp_path, mesh_ctx)
+    fleet = ServingFleet(reg, "churn", buckets=(8,),
+                         policy=BatchPolicy(max_batch=8, max_wait_ms=1.0),
+                         n_workers=2,
+                         config={"redis.server.port": resp_server.port})
+    fleet.start()
+    feeder = RespClient(port=resp_server.port)
+    counters = Counters()
+    act = refresh_action(fleet, counters)
+    try:
+        assert fleet.converged_version() == 1
+        # no newer version yet: the probe counts, but NOT a swap —
+        # fleet.refresh() reports will-it-swap like a service's does
+        act(_fake_alert())
+        assert counters.get("DriftMonitor", "RefreshSwaps") == 0
+        _, m2 = small_forest(mesh_ctx, n=300, trees=3, depth=2, seed=11)
+        reg.publish("churn", m2, schema=SCHEMA)
+        act(_fake_alert())
+        assert counters.get("DriftMonitor", "RefreshSwaps") == 1
+        deadline = time.monotonic() + 20.0
+        while fleet.converged_version() != 2 and \
+                time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert fleet.converged_version() == 2
+        st = fleet.stats()
+        assert set(st["model_versions"].values()) == {2}
+        assert counters.get("DriftMonitor", "RefreshProbes") == 2
+        # the fleet still answers after the converged swap
+        rows = raw_rows_of(table, 16)
+        feeder.lpush_many("requestQueue",
+                          [",".join(["predict", str(i)] + rows[i])
+                           for i in range(16)])
+        got = drain_replies(feeder, "predictionQueue", 16)
+        assert len(got) == 16
+    finally:
+        fleet.stop()
+        feeder.close()
+
+
+def test_degrade_action_fleet_parks_per_pr12_rules(tmp_path, mesh_ctx,
+                                                   resp_server):
+    """degrade_action at fleet scope flags EVERY worker; the PR 12
+    parking rules then hold: the fleet keeps answering (the last active
+    worker serves flagged rather than parking — nobody-pulling is the
+    wedge the rules exist to prevent), and a hot-swap to a fresh version
+    clears the flags and un-parks everyone."""
+    from avenir_tpu.core.metrics import Counters
+    from avenir_tpu.monitor.policy import degrade_action
+    reg, table, m1 = make_fleet_registry(tmp_path, mesh_ctx)
+    fleet = ServingFleet(reg, "churn", buckets=(8,),
+                         policy=BatchPolicy(max_batch=8, max_wait_ms=1.0),
+                         n_workers=2,
+                         config={"redis.server.port": resp_server.port})
+    fleet.start()
+    feeder = RespClient(port=resp_server.port)
+    counters = Counters()
+    try:
+        degrade_action(fleet, counters)(_fake_alert())
+        assert counters.get("DriftMonitor", "Degradations") == 1
+        st = fleet.stats()
+        assert all(s["degraded"] for s in st["per_worker"].values())
+        # an all-degraded fleet still answers (last-active-keeps-serving)
+        rows = raw_rows_of(table, 16)
+        feeder.lpush_many("requestQueue",
+                          [",".join(["predict", str(i)] + rows[i])
+                           for i in range(16)])
+        got = drain_replies(feeder, "predictionQueue", 16)
+        assert len(got) == 16 and all(len(v) == 1 for v in got.values())
+        # publish a fix + fleet refresh: flags clear, both workers serve
+        _, m2 = small_forest(mesh_ctx, n=300, trees=3, depth=2, seed=11)
+        reg.publish("churn", m2, schema=SCHEMA)
+        fleet.refresh()
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            st = fleet.stats()
+            if set(st["model_versions"].values()) == {2} and \
+                    not any(s["degraded"]
+                            for s in st["per_worker"].values()):
+                break
+            time.sleep(0.01)
+        st = fleet.stats()
+        assert set(st["model_versions"].values()) == {2}
+        assert not any(s["degraded"] for s in st["per_worker"].values())
+        feeder.lpush_many("requestQueue",
+                          [",".join(["predict", f"b{i}"] + rows[i])
+                           for i in range(16)])
+        assert len(drain_replies(feeder, "predictionQueue", 16)) == 16
+    finally:
+        fleet.stop()
+        feeder.close()

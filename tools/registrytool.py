@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""Inspect / verify / GC a serving model registry (serving/registry.py).
+
+    python tools/registrytool.py list   <registry-dir> [--name <model>]
+    python tools/registrytool.py verify <registry-dir> [--name <model>]
+    python tools/registrytool.py gc     <registry-dir> --name <model>
+                                        [--keep 3] [--dry-run]
+
+``list`` prints, per model name, every committed version with its kind,
+intactness, payload files, on-disk bytes, and the pin/serving resolution
+— the operator's view of what a hot-swap refresh would actually load.
+
+``verify`` probes every version with the registry's own ``is_intact``
+(meta.json parses, every manifest file opens) plus a pin-target check.
+Exit code 0 = all intact, 1 = problems found, 2 = usage error.
+
+``gc`` retires old versions through ``ModelRegistry.retire`` (keeps the
+newest ``--keep``, never the pinned or serving version, sweeps abandoned
+``.tmp`` publishes).  ``--dry-run`` prints what WOULD go.  This is the
+retention story behind the retrain controller's publish cadence
+(``dtb.retrain.retire.keep.last`` runs the same call in-loop).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))  # repo root, for avenir_tpu
+
+from avenir_tpu.serving.registry import META_FILE, ModelRegistry  # noqa: E402
+
+
+def _dir_bytes(d: str) -> int:
+    total = 0
+    for root, _, files in os.walk(d):
+        for f in files:
+            try:
+                total += os.path.getsize(os.path.join(root, f))
+            except OSError:
+                pass
+    return total
+
+
+def _names(reg: ModelRegistry, only: str | None):
+    if only:
+        return [only]
+    return reg.names()
+
+
+def cmd_list(args) -> int:
+    reg = ModelRegistry(args.registry)
+    names = _names(reg, args.name)
+    if not names:
+        print(f"no models in {reg.base_dir!r}", file=sys.stderr)
+        return 1
+    for name in names:
+        pin = reg.pinned_version(name)
+        serving = reg.serving_version(name)
+        print(f"{name}: pinned={pin if pin is not None else '-'} "
+              f"serving={serving if serving is not None else '-'}")
+        print(f"  {'ver':>6} {'intact':>6} {'kind':>8} {'bytes':>10}  "
+              f"files")
+        for v in reg.versions(name):
+            d = reg.version_dir(name, v)
+            kind, files = "?", []
+            try:
+                with open(os.path.join(d, META_FILE)) as fh:
+                    meta = json.load(fh)
+                kind = meta.get("kind", "?")
+                files = meta.get("files") or []
+            except Exception:
+                pass
+            mark = "*" if v == serving else " "
+            print(f"  {v:>5}{mark} {str(reg.is_intact(name, v)):>6} "
+                  f"{kind:>8} {_dir_bytes(d):>10}  {' '.join(files)}")
+    return 0
+
+
+def cmd_verify(args) -> int:
+    reg = ModelRegistry(args.registry)
+    names = _names(reg, args.name)
+    if not names:
+        # same contract as cmd_list: a missing/empty registry (typo'd
+        # path) must NOT read as 'verified' to a gating script
+        print(f"no models in {reg.base_dir!r}", file=sys.stderr)
+        return 1
+    problems = 0
+    for name in names:
+        versions = reg.versions(name)
+        if not versions:
+            print(f"{name}: NO committed versions")
+            problems += 1
+            continue
+        for v in versions:
+            if reg.is_intact(name, v):
+                print(f"{name} v{v}: ok")
+            else:
+                print(f"{name} v{v}: TORN or unreadable")
+                problems += 1
+        pin = reg.pinned_version(name)
+        if pin is not None and not reg.is_intact(name, pin):
+            print(f"{name}: pin -> v{pin} whose target is NOT intact "
+                  f"(serving falls back to newest intact)")
+            problems += 1
+    print(f"{'PROBLEMS: %d' % problems if problems else 'verified'}")
+    return 1 if problems else 0
+
+
+def cmd_gc(args) -> int:
+    reg = ModelRegistry(args.registry)
+    versions = reg.versions(args.name)
+    if not versions:
+        print(f"no committed versions of {args.name!r} in "
+              f"{reg.base_dir!r}", file=sys.stderr)
+        return 1
+    if args.dry_run:
+        # retire(dry_run=True) computes the keep rule — ONE source of
+        # truth, never a re-implementation that can drift from it
+        would = reg.retire(args.name, keep_last=args.keep, dry_run=True)
+        print(f"would retire: {would or 'nothing'} "
+              f"(keep {[v for v in versions if v not in would]}; "
+              f"dead .tmp publishes would be swept)")
+        return 0
+    retired = reg.retire(args.name, keep_last=args.keep)
+    print(f"retired: {retired or 'nothing'} "
+          f"(kept {reg.versions(args.name)})")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="registrytool", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p = sub.add_parser("list", help="per-model version table")
+    p.add_argument("registry")
+    p.add_argument("--name")
+    p.set_defaults(fn=cmd_list)
+    p = sub.add_parser("verify", help="probe every version intact")
+    p.add_argument("registry")
+    p.add_argument("--name")
+    p.set_defaults(fn=cmd_verify)
+    p = sub.add_parser("gc", help="retire old versions")
+    p.add_argument("registry")
+    p.add_argument("--name", required=True)
+    p.add_argument("--keep", type=int, default=3)
+    p.add_argument("--dry-run", action="store_true")
+    p.set_defaults(fn=cmd_gc)
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as exc:
+        return 2 if exc.code not in (0, None) else 0
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
