@@ -40,6 +40,14 @@ coalescing policy, optional RESP wire transport).  Config keys
   ps.warm.start             pre-compile all buckets (default true)
   ps.latency.window         latency sample window (default 8192)
   ps.transport              inprocess | resp (default inprocess)
+  ps.trace.sample           request-trace head sampling: trace every
+                            Nth request end to end (flow events +
+                            component histograms with exemplars, ISSUE
+                            15; env twin AVENIR_TPU_TRACE_SAMPLE;
+                            default 0 = off — zero cost beyond one
+                            global read).  Sets the PROCESS sampling
+                            rate for the job's lifetime, like the env
+                            twin.
   redis.request.queue / redis.prediction.queue   resp-queue names
 
 The input file holds one record per line (same layout the model's schema
@@ -67,6 +75,12 @@ def prediction_service(cfg: Config, in_path: str, out_path: str) -> Counters:
                                    RespPredictionLoop)
     from ..utils.tracing import StepTimer
     counters = Counters()
+    # an EXPLICIT ps.trace.sample always wins — including 0, which must
+    # be able to switch sampling off over an exported
+    # AVENIR_TPU_TRACE_SAMPLE env twin (the untraced-baseline replay)
+    if "ps.trace.sample" in cfg:
+        from ..telemetry import reqtrace
+        reqtrace.set_sample_rate(cfg.get_int("ps.trace.sample", 0))
     registry = ModelRegistry(cfg.must_get("ps.model.registry.dir"))
     schema = _schema_path(cfg, "ps.feature.schema.file.path") \
         if "ps.feature.schema.file.path" in cfg else None
@@ -248,7 +262,8 @@ def prediction_service(cfg: Config, in_path: str, out_path: str) -> Counters:
                         "redis.request.queue": req_q,
                         "redis.prediction.queue": pred_q}
             loop = RespPredictionLoop(svc, wire_cfg)
-            feeder = RespClient(port=server.port)
+            feeder = RespClient(port=server.port, delim=od,
+                                counters=counters)
             for i, row in enumerate(rows):
                 feeder.lpush(req_q, od.join(["predict", str(i)] + row))
             feeder.lpush(req_q, "stop")

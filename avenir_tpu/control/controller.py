@@ -62,7 +62,7 @@ from ..core.faults import fault_point
 from ..core.metrics import Counters
 from ..monitor.policy import (ALERT, DEFAULT_ALERT, AccuracyTracker,
                               AlertRecord, DriftPolicy)
-from ..telemetry import instant
+from ..telemetry import instant, span
 from .journal import (ABANDONED, CANDIDATE_VALIDATE, COMPLETE, FLEET_SWAP,
                       PROBATION, PUBLISHED, REFUSED, REGISTRY_PUBLISH,
                       RETRAIN_BUILD, ROLLBACK, ROLLED_BACK, CycleJournal)
@@ -411,9 +411,16 @@ class RetrainController:
         """Run the state machine from ``stage`` to a terminal state (or
         to probation-wait).  Candidate payloads travel in-memory along
         the happy path and reload from the cycle directory on resume."""
+        # every stage executes under ONE taxonomy span
+        # (``controller.stage``, args naming the stage + cycle): the
+        # control plane's decisions become correlatable with the
+        # data-plane latencies they cause in the same merged timeline —
+        # the stages already journal, so tracing is just this wrapper
         models = baseline = None
         if stage == RETRAIN_BUILD:
-            models, baseline = self._stage_build(resuming)
+            with span("controller.stage", cat="controller",
+                      stage=RETRAIN_BUILD, cycle=self.journal.cycle):
+                models, baseline = self._stage_build(resuming)
             stage = CANDIDATE_VALIDATE
         if stage in (CANDIDATE_VALIDATE, REGISTRY_PUBLISH) \
                 and models is None:
@@ -433,15 +440,21 @@ class RetrainController:
             else:
                 models, baseline = cand
         if stage == CANDIDATE_VALIDATE:
-            verdict = self._stage_validate(models, baseline)
+            with span("controller.stage", cat="controller",
+                      stage=CANDIDATE_VALIDATE, cycle=self.journal.cycle):
+                verdict = self._stage_validate(models, baseline)
             if verdict is not None:
                 return verdict           # refused
             stage = REGISTRY_PUBLISH
         if stage == REGISTRY_PUBLISH:
-            self._stage_publish(models, baseline)
+            with span("controller.stage", cat="controller",
+                      stage=REGISTRY_PUBLISH, cycle=self.journal.cycle):
+                self._stage_publish(models, baseline)
             stage = FLEET_SWAP
         if stage == FLEET_SWAP:
-            waiting = self._stage_swap()
+            with span("controller.stage", cat="controller",
+                      stage=FLEET_SWAP, cycle=self.journal.cycle):
+                waiting = self._stage_swap()
             if waiting:
                 return {"cycle": self.journal.cycle, "stage": PROBATION,
                         "candidate_version":
@@ -827,6 +840,14 @@ class RetrainController:
 
     # ---- stage: rollback ----
     def _stage_rollback(self) -> Dict[str, Any]:
+        # spanned HERE, not in _advance: probation outcomes trigger
+        # rollback from record_outcome/check_probation_timeout too, and
+        # every entry path must land on the timeline
+        with span("controller.stage", cat="controller", stage=ROLLBACK,
+                  cycle=self.journal.cycle):
+            return self._rollback_locked()
+
+    def _rollback_locked(self) -> Dict[str, Any]:
         jr = self.journal
         fault_point("rollback")
         champion = jr["champion_version"]

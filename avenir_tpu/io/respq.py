@@ -40,6 +40,9 @@ import warnings
 from collections import deque
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
+from ..telemetry import instant
+from ..telemetry import reqtrace
+
 
 # ---------------------------------------------------------------------------
 # wire format
@@ -345,11 +348,24 @@ class RespClient:
     second batch; the timeout surfaces to the caller instead."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 6379,
-                 timeout: float = 10.0, reconnect: bool = True):
+                 timeout: float = 10.0, reconnect: bool = True,
+                 delim: str = ",", counters=None, stamp: bool = True):
         self.host, self.port = host, int(port)
         self.timeout = float(timeout)
         self._reconnect = bool(reconnect)
         self._rpop_count_ok = True
+        # request-trace stamping (ISSUE 15): with ps.trace.sample set,
+        # every Nth predict push gets the wire trace field at THIS
+        # client.  ``stamp=False`` is for inner clients whose owner
+        # already stamped (the shard ring, which knows the owning
+        # shard); ``delim`` is the wire field separator.
+        self._delim = delim
+        self._stamp = bool(stamp)
+        # reconnect observability: tally + trace instant per reconnect,
+        # so a silent reconnect storm shows up in scrapes and timelines
+        # instead of only as stderr warnings
+        self.counters = counters
+        self.reconnects = 0
         self._sock = None
         self._rf = None
         self._connect()
@@ -378,6 +394,13 @@ class RespClient:
         with_retry(self._connect, attempts=4, base_delay=0.05,
                    retry_on=(OSError,),
                    what=f"respq reconnect to {self.host}:{self.port}")
+        self.reconnects += 1
+        if self.counters is not None:
+            self.counters.increment("Broker", "Reconnects")
+        instant("broker.reconnect", cat="broker",
+                endpoint=f"{self.host}:{self.port}",
+                attempt=self.reconnects,
+                cause=f"{type(why).__name__}: {why}")
         warnings.warn(
             f"respq: connection to {self.host}:{self.port} dropped "
             f"({type(why).__name__}: {why}); reconnected",
@@ -415,14 +438,27 @@ class RespClient:
         return self._call("PING") == "PONG"
 
     def lpush(self, queue: str, value: str) -> int:
+        # enabled() gate first: sampling off must stay allocation-free
+        # on the per-request push path (no temp list, no call into
+        # stamp_values)
+        if self._stamp and reqtrace.enabled():
+            value = reqtrace.stamp_values(
+                [value], delim=self._delim,
+                broker=f"{self.host}:{self.port}")[0]
         return int(self._call("LPUSH", queue, value))
 
     def lpush_many(self, queue: str, values: List[str]) -> int:
         """Push ``values`` as ONE variadic LPUSH (n round trips collapse
         to one — the producer half of the wire micro-batching).  Returns
-        the queue length after the push; no-op 0 on an empty list."""
+        the queue length after the push; no-op 0 on an empty list.
+        Predict messages pass the head-sampling stamp (one global read
+        when ``ps.trace.sample`` is off)."""
         if not values:
             return 0
+        if self._stamp:
+            values = reqtrace.stamp_values(
+                values, delim=self._delim,
+                broker=f"{self.host}:{self.port}")
         return int(self._call("LPUSH", queue, *values))
 
     def rpop(self, queue: str) -> Optional[str]:
@@ -620,8 +656,13 @@ class ShardedRespClient:
         for ep in eps:
             host, _, port = ep.rpartition(":")
             try:
+                # inner clients do NOT stamp: the ring stamps per push
+                # group below, where the owning shard is known
                 self._clients[ep] = RespClient(host or "127.0.0.1",
-                                               int(port), timeout=timeout)
+                                               int(port), timeout=timeout,
+                                               delim=delim,
+                                               counters=counters,
+                                               stamp=False)
             except OSError as exc:
                 first_err = first_err or exc
                 self._note_down(ep, exc)
@@ -660,6 +701,9 @@ class ShardedRespClient:
         if self.counters is not None:
             self.counters.increment("Broker", "BrokerShardDown")
         survivors = sum(1 for e in self._clients if e != ep)
+        instant("broker.shard_down", cat="broker", endpoint=ep,
+                cause=f"{type(exc).__name__}: {exc}",
+                survivors=survivors)
         warnings.warn(
             f"broker: shard {ep} down ({type(exc).__name__}: {exc}); "
             f"degrading to the surviving ring ({survivors} shard(s) "
@@ -718,6 +762,12 @@ class ShardedRespClient:
                                   []).append(v)
             pending = []
             for ep, vals in groups.items():
+                # head-sampling stamp AFTER routing, so the flow start
+                # names the owning shard; a re-route keeps the original
+                # stamp (the field-present check makes re-stamping a
+                # no-op) — the enqueue time is the FIRST offer
+                vals = reqtrace.stamp_values(vals, delim=self._delim,
+                                             broker=ep)
                 try:
                     total += self._clients[ep].lpush_many(queue, vals)
                 except (ConnectionError, OSError) as exc:
@@ -849,6 +899,8 @@ def make_queue_client(config: Optional[Dict] = None, delim: str = ",",
             return ShardedRespClient(endpoints, delim=delim,
                                      counters=counters)
         host, _, port = endpoints[0].rpartition(":")
-        return RespClient(host or "127.0.0.1", int(port))
+        return RespClient(host or "127.0.0.1", int(port), delim=delim,
+                          counters=counters)
     return RespClient(cfg.get("redis.server.host", "127.0.0.1"),
-                      int(cfg.get("redis.server.port", 6379)))
+                      int(cfg.get("redis.server.port", 6379)),
+                      delim=delim, counters=counters)

@@ -287,7 +287,10 @@ class FleetAutoscaler:
                "pressure_ticks": self._pressure_ticks,
                "calm_ticks": self._calm_ticks,
                "cooldown": self._cooldown}
-        instant("autoscaler.decision", cat="serving", **rec)
+        # the host label rides the instant (not the decision record) so
+        # a multi-host incident report can attribute scale actions
+        instant("autoscaler.decision", cat="serving",
+                host=getattr(self.fleet, "host_label", None), **rec)
         self.decisions.append(rec)
         if len(self.decisions) > 4096:
             del self.decisions[:2048]

@@ -56,6 +56,7 @@ from collections import deque
 from typing import Callable, Dict, List, Optional, Sequence
 
 from ..core.metrics import Counters
+from ..telemetry import reqtrace
 from ..utils.tracing import StepTimer
 from .predictor import DEFAULT_BUCKETS, Predictor
 from .service import BatchPolicy, PredictionService
@@ -74,8 +75,9 @@ class _Worker:
         self.client = None
         self.thread: Optional[threading.Thread] = None
         self.seen_gen = 0
-        # (request_id, future) in submit order; service batches complete
-        # in order, so FIFO head-flush is completion order
+        # (request_id, future, trace_ctx_or_None) in submit order;
+        # service batches complete in order, so FIFO head-flush is
+        # completion order
         self.pending: "deque[tuple]" = deque()
         # autoscaler parking: a parked worker stops PULLING but keeps
         # its warm service (compiled buckets resident) so unparking is
@@ -539,8 +541,20 @@ class ServingFleet:
             elif parts[0] == "predict" and len(parts) >= 3:
                 # admission happens inside submit(): past the depth
                 # threshold the future comes back already resolved
-                # 'busy' and the flush answers <id>,busy
-                w.pending.append((parts[1], svc.submit(parts[2:])))
+                # 'busy' and the flush answers <id>,busy.  A sampled
+                # request (optional wire trace field, ISSUE 15) gets its
+                # worker-pop flow step here and rides its context into
+                # the service batch.
+                rid, row, ctx = reqtrace.split_predict(parts)
+                if ctx is not None:
+                    ctx.t_pop_us = reqtrace.now_us()
+                    reqtrace.emit_flow("t", rid, "pop",
+                                       ts_us=ctx.t_pop_us,
+                                       worker=w.name,
+                                       host=self.host_label)
+                w.pending.append(
+                    (rid, svc.submit(row, trace=ctx, sample_local=False),
+                     ctx))
             else:
                 svc.counters.increment("Serving", "BadRequests")
                 warnings.warn(f"fleet {w.name}: dropping malformed "
@@ -555,8 +569,9 @@ class ServingFleet:
         ``wait=False`` only flushes the done head."""
         svc = w.service
         replies: List[str] = []
+        traced = None
         while w.pending:
-            rid, fut = w.pending[0]
+            rid, fut, ctx = w.pending[0]
             if not fut.done() and not wait:
                 break
             try:
@@ -566,6 +581,18 @@ class ServingFleet:
                 # still gets a reply line
                 label = svc.error_label
             replies.append(f"{rid}{svc.delim}{label}")
+            if ctx is not None:
+                if traced is None:
+                    traced = []
+                traced.append(ctx)
             w.pending.popleft()
         if replies:
             w.client.lpush_many(self.prediction_q, replies)
+            if traced:
+                # the replies are actually on the wire now: stamp the
+                # reply-push time and close each sampled request's flow
+                # (+ component histograms/exemplars) at its service
+                t = reqtrace.now_us()
+                for ctx in traced:
+                    ctx.t_reply_us = t
+                    svc.record_request_trace(ctx)

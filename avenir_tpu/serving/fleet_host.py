@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -65,6 +66,21 @@ def _parse_args(argv):
     ap.add_argument("--max-idle-s", type=float, default=30.0)
     ap.add_argument("--metrics-port", type=int, default=-1)
     ap.add_argument("--metrics-host", default="127.0.0.1")
+    ap.add_argument("--trace-dir", default=None,
+                    help="span/flow tracing: write this host's "
+                         "trace-<run-id>.p<trace-index>.jsonl here "
+                         "(default: AVENIR_TPU_TRACE_EVENTS_DIR, else "
+                         "off); sampled wire requests' flow events land "
+                         "in it for the tracetool merged timeline")
+    ap.add_argument("--run-id", default="serve",
+                    help="trace run id — every process of one serving "
+                         "run (clients included) must share it")
+    ap.add_argument("--trace-index", type=int, default=None,
+                    help="this process's trace lane index (unique per "
+                         "process of the run; the client convention is "
+                         "index 0).  Default: derived from the pid, so "
+                         "two hosts launched without it never "
+                         "interleave one trace file")
     ap.add_argument("--stats-out", default=None)
     ap.add_argument("--ready-file", default=None,
                     help="touched once the fleet is draining — a parent "
@@ -97,6 +113,25 @@ def main(argv=None) -> int:
                          slo_p99_ms=args.slo_p99_ms,
                          max_queue_depth=args.max_queue_depth)
     registry = ModelRegistry(args.registry)
+    tracer = None
+    trace_dir = args.trace_dir or \
+        os.environ.get("AVENIR_TPU_TRACE_EVENTS_DIR") or None
+    if trace_dir:
+        from ..telemetry import Tracer, install_tracer
+        # unset index derives from hostname+pid: two fleet_hosts
+        # launched without --trace-index — even on DIFFERENT machines
+        # sharing an NFS trace dir, where bare pids can collide — must
+        # never append into ONE lane file (interleaved lanes read as
+        # false span-crossing problems and scramble the flow arrows)
+        idx = args.trace_index
+        if idx is None:
+            import socket
+            import zlib
+            idx = (zlib.crc32(socket.gethostname().encode()) % 9000
+                   + 1000) * 100000 + os.getpid() % 100000
+        tracer = install_tracer(Tracer(trace_dir, run_id=args.run_id,
+                                       process_index=idx))
+        print(f"fleet_host: tracing to {tracer.path}", file=sys.stderr)
     metrics = msrv = None
     if args.metrics_port >= 0:
         from ..telemetry import MetricsRegistry, MetricsServer
@@ -160,6 +195,10 @@ def main(argv=None) -> int:
             sensor.close()
         if msrv is not None:
             msrv.stop()
+        if tracer is not None:
+            from ..telemetry import uninstall_tracer
+            uninstall_tracer()
+            tracer.close()
     return rc
 
 

@@ -36,6 +36,7 @@ import numpy as np
 from ..core.artifacts import ArtifactStore, write_json
 from ..core.faults import fault_point, with_retry
 from ..core.schema import FeatureSchema
+from ..telemetry import instant
 
 FOREST = "forest"
 BAYES = "bayes"
@@ -268,13 +269,18 @@ class ModelRegistry:
             json.dump({"version": int(version),
                        "pinned_unix": time.time()}, fh)
         os.replace(tmp, final)
+        # pin flips are control-plane decisions serving latencies hang
+        # off: mark them on the run's timeline (ISSUE 15)
+        instant("registry.pin", cat="registry", model=name,
+                version=int(version))
 
     def clear_pin(self, name: str) -> None:
         """Back to newest-intact resolution (idempotent)."""
         try:
             os.remove(self._pin_path(name))
         except FileNotFoundError:
-            pass
+            return
+        instant("registry.unpin", cat="registry", model=name)
 
     def pinned_version(self, name: str) -> Optional[int]:
         """The pinned version number, or None (no pin / unreadable pin —
@@ -430,6 +436,8 @@ class ModelRegistry:
         with_retry(write_arrays, what=f"registry publish {name} v{version}")
         write_json(os.path.join(tmp, META_FILE), meta)
         os.replace(tmp, final)
+        instant("registry.publish", cat="registry", model=name,
+                version=version, kind=kind)
         return version
 
     # ---- sidecars ----

@@ -55,6 +55,10 @@ Transports (same split as reinforce/serving.py, the bandit loop):
 
 Message formats (delim-joined, like the bandit loop's ``round,<n>``):
   request:    'predict,<requestId>,<field0>,<field1>,...'  (a full record)
+              — optionally carrying the request-trace field as the third
+              token: 'predict,<id>,t=<enqueue_us>:<sampled>,<fields...>'
+              (head-sampled at the pushing client, ``ps.trace.sample``;
+              absent = old behavior — see telemetry/reqtrace.py)
   response:   '<requestId>,<predictedClass>'
   control:    'reload' -> hot-swap to the registry's newest intact model
               'stop'   -> end the wire loop (transport-level, like the
@@ -78,6 +82,7 @@ from typing import Dict, List, Optional, Sequence
 from ..core.faults import with_retry
 from ..core.metrics import Counters
 from ..telemetry import get_default_registry, instant, span
+from ..telemetry import reqtrace
 from ..utils.tracing import StepTimer
 from .predictor import AMBIGUOUS, DEFAULT_BUCKETS, Predictor, make_predictor
 from .registry import ModelRegistry
@@ -115,13 +120,61 @@ class BatchPolicy:
                              f"or 'drain', got {self.batching!r}")
 
 
-class _Request:
-    __slots__ = ("row", "t_submit", "future")
+def _stamp_dispatch(ctxs, rows: int) -> None:
+    """Stamp dispatch time + emit the flow ``t`` step for every sampled
+    context entering a device batch (shared by the submit path and
+    ``process_batch``).  Lazy timestamp: an untraced batch costs one
+    None-check per member, no clock, no allocation."""
+    t = None
+    for tr in ctxs:
+        if tr is not None and tr.t_dispatch_us is None:
+            if t is None:
+                t = reqtrace.now_us()
+            tr.t_dispatch_us = t
+            reqtrace.emit_flow("t", tr.rid, "dispatch", ts_us=t,
+                               rows=rows)
 
-    def __init__(self, row: List[str]):
+
+def _stamp_done(ctxs) -> None:
+    """Stamp readback-complete time for every sampled context in a
+    finished batch (same lazy-clock discipline)."""
+    t = None
+    for tr in ctxs:
+        if tr is not None:
+            if t is None:
+                t = reqtrace.now_us()
+            tr.t_done_us = t
+
+
+def _mark_dispatch(batch, rows: int) -> None:
+    _stamp_dispatch((r.trace for r in batch), rows)
+
+
+def _mark_done(batch) -> None:
+    _stamp_done(r.trace for r in batch)
+
+
+def _mark_popped(req) -> None:
+    """Stamp queue-pop time for a sampled request the batch loop just
+    dequeued.  Wire contexts already carry their worker-pop stamp (the
+    fleet sets it at RESP drain) — without this, an in-process request's
+    queue backlog would masquerade as coalesce time in the
+    decomposition."""
+    tr = req.trace
+    if tr is not None and tr.t_pop_us is None:
+        tr.t_pop_us = reqtrace.now_us()
+        reqtrace.emit_flow("t", tr.rid, "pop", ts_us=tr.t_pop_us)
+
+
+class _Request:
+    __slots__ = ("row", "t_submit", "future", "trace")
+
+    def __init__(self, row: List[str], trace=None):
         self.row = row
         self.t_submit = time.perf_counter()
         self.future: "Future[Optional[str]]" = Future()
+        # reqtrace.RequestTrace for a head-sampled request, else None
+        self.trace = trace
 
 
 class PredictionService:
@@ -211,6 +264,15 @@ class PredictionService:
         # the process registry cli.run installs when the job opened a
         # telemetry.metrics.port endpoint (None = unmetered)
         self._metrics_binding = None
+        # request-component histogram binding (ISSUE 15): ONE attribute
+        # holding (family, ident), read/cleared under _comp_lock so a
+        # sampled request closing concurrently with stop() can neither
+        # see a half-applied unbind nor observe into a series
+        # drop_series already swept (which would resurrect the retired
+        # service's series in every later scrape).  None = sampled
+        # requests still trace, just no histogram/exemplar landing spot
+        self._comp_binding = None
+        self._comp_lock = threading.Lock()
         reg = metrics if metrics is not None else get_default_registry()
         if reg is not None:
             self.bind_metrics(reg)
@@ -381,18 +443,33 @@ class PredictionService:
         registry.register_probe(probe)
         health_key = _health_key(svc_label)
         registry.add_health(health_key, self.health)
+        # per-sampled-request latency decomposition with request-id
+        # exemplars (ISSUE 15): observed only for traced requests, so
+        # the family costs nothing with sampling off
+        ch = registry.histogram(
+            "avenir_request_component_seconds",
+            "sampled-request latency decomposition (queue_wait/"
+            "coalesce/device/reply/total), exemplar = request id",
+            labels=("host", "service", "component"))
+        self._comp_binding = (ch, {"host": host, "service": svc_label})
         # remembered so stop() can unbind: a retired service must not be
         # probed (and thereby pinned in memory, predictor and all) by
         # every scrape for the rest of the process
         self._metrics_binding = (registry, probe, health_key,
-                                 (g, gl), {"host": host,
-                                           "service": svc_label})
+                                 (g, gl, ch), {"host": host,
+                                               "service": svc_label})
 
     def _unbind_metrics(self) -> None:
         if self._metrics_binding is not None:
             reg, probe, health_key, families, ident = \
                 self._metrics_binding
             self._metrics_binding = None
+            # clear under the observe lock BEFORE sweeping the series:
+            # an in-flight record_request_trace either finished its
+            # observe (drop_series below sweeps it) or will re-read
+            # None and skip — never observe-after-drop
+            with self._comp_lock:
+                self._comp_binding = None
             reg.unregister_probe(probe)
             reg.remove_health(health_key)
             # drop the bound label series too: without this, the dead
@@ -402,6 +479,35 @@ class PredictionService:
             # worker on a shared registry must keep its series.
             for fam in families:
                 fam.drop_series(**ident)
+
+    # ---- per-request trace closure ----
+    def record_request_trace(self, ctx) -> None:
+        """Close one sampled request's trace: stamp the reply time if
+        the transport has not, emit the flow ``f`` finish carrying the
+        component decomposition, observe the component histograms with
+        the request id as exemplar.  Called by :meth:`_reply` for
+        in-process requests and by the wire transports (fleet flush /
+        ``process_batch``) AFTER the reply actually pushed."""
+        if ctx.t_reply_us is None:
+            ctx.t_reply_us = reqtrace.now_us()
+        comps = ctx.components_ms()
+        self.counters.increment("Serving", "TracedRequests")
+        # observe under _comp_lock: once _unbind_metrics cleared the
+        # binding (same lock) and swept the series, no straggler may
+        # observe the dead series back into existence
+        with self._comp_lock:
+            binding = self._comp_binding
+            if binding is not None:
+                hist, ident = binding
+                for comp, ms in comps.items():
+                    # clamp at 0: queue_wait bridges the client->worker
+                    # clock boundary, and a skewed-negative value would
+                    # land in EVERY bucket and walk _sum backwards
+                    hist.observe(max(ms, 0.0) / 1e3, exemplar=ctx.rid,
+                                 component=comp, **ident)
+        reqtrace.emit_flow("f", ctx.rid, "reply", ts_us=ctx.t_reply_us,
+                           **{f"{k}_ms": round(v, 3)
+                              for k, v in comps.items()})
 
     # ---- prediction ----
     def _label(self, pred: Optional[str]) -> str:
@@ -520,13 +626,25 @@ class PredictionService:
         import warnings
         ids: List[str] = []
         rows: List[List[str]] = []
+        traced = None
         reload_requested = False
         with span("serve.assemble", cat="serving", rows=len(messages)):
             for message in messages:
                 parts = message.split(self.delim)
                 if parts[0] == "predict" and len(parts) >= 3:
-                    ids.append(parts[1])
-                    rows.append(parts[2:])
+                    # the optional wire trace field (ISSUE 15) is
+                    # stripped whether sampled or not; absent = the old
+                    # message layout, byte for byte
+                    rid, row, ctx = reqtrace.split_predict(parts)
+                    ids.append(rid)
+                    rows.append(row)
+                    if ctx is not None:
+                        ctx.t_pop_us = reqtrace.now_us()
+                        reqtrace.emit_flow("t", rid, "pop",
+                                           ts_us=ctx.t_pop_us)
+                        if traced is None:
+                            traced = []
+                        traced.append(ctx)
                 elif parts[0] == "reload":
                     reload_requested = True
                 else:
@@ -538,37 +656,66 @@ class PredictionService:
             return []
         if not rows:
             return []
+        if traced:
+            _stamp_dispatch(traced, len(rows))
         t0 = time.perf_counter()
         results = self._predict_isolating(rows)
         dt = time.perf_counter() - t0
+        if traced:
+            _stamp_done(traced)
         with span("serve.reply", cat="serving", rows=len(rows)):
             out = []
             for rid, (status, val) in zip(ids, results):
                 self.timer.record("serve.request", dt)
                 lab = val if status == "ok" else self.error_label
                 out.append(f"{rid}{self.delim}{lab}")
+        if traced:
+            # the reply lines are about to push (RespPredictionLoop
+            # lpushes right after this returns): close the flows here,
+            # where the service identity (histograms, exemplars) lives
+            for ctx in traced:
+                self.record_request_trace(ctx)
         if reload_requested:
             self.refresh()
         return out
 
     # ---- in-process micro-batch loop ----
-    def submit(self, row) -> "Future[str]":
+    def submit(self, row, trace=None,
+               sample_local: bool = True) -> "Future[str]":
         """Queue one record (tokenized row or delim-joined line); the
         worker thread answers the future with the class label.  Past the
         admission threshold (``policy.max_queue_depth``) the future is
         answered immediately with ``busy_label`` — backpressure the
-        caller can see, never a silently dropped request."""
+        caller can see, never a silently dropped request.  ``trace``
+        carries a wire request's :class:`~avenir_tpu.telemetry.reqtrace
+        .RequestTrace`; without one, in-process head sampling applies
+        (one global read when ``ps.trace.sample`` is off).  Wire
+        transports pass ``sample_local=False``: sampling is a HEAD
+        decision — a request the pushing client left unstamped must not
+        be re-sampled mid-path (its queue-wait leg is already lost)."""
         if isinstance(row, str):
             row = row.split(self.delim)
-        req = _Request(list(row))
+        if trace is None and sample_local:
+            trace = reqtrace.maybe_sample_local()
+        req = _Request(list(row), trace=trace)
         dmax = self.policy.max_queue_depth
         if dmax and self._queue.qsize() >= dmax:
             self.counters.increment("Serving", "Rejected")
             instant("serve.reject", cat="serving",
                     queue_depth=self._queue.qsize())
             req.future.set_result(self.busy_label)
+            # a rejected sampled request still closes its flow (busy IS
+            # the reply) — for wire contexts the transport closes it
+            # when the busy reply pushes
+            if trace is not None and not trace.wire:
+                self.record_request_trace(trace)
             return req.future
-        instant("serve.admit", cat="serving")
+        # admit instants only for SAMPLED requests: an every-submit
+        # instant runs >1k/s at saturation — past the §21 granularity
+        # rule — and measurably taxes the traced closed loop; rejects
+        # stay always-on (rare, and exactly the event operators hunt)
+        if trace is not None:
+            instant("serve.admit", cat="serving", rid=trace.rid)
         self._queue.put(req)
         return req.future
 
@@ -604,9 +751,11 @@ class PredictionService:
         batch: List[_Request] = []
         while time.monotonic() < deadline:
             try:
-                batch.append(self._queue.get_nowait())
+                leftover = self._queue.get_nowait()
             except queue.Empty:
                 break
+            _mark_popped(leftover)
+            batch.append(leftover)
             if len(batch) >= max_b:
                 self._serve(batch)
                 batch = []
@@ -704,6 +853,11 @@ class PredictionService:
                     batch.append(self._queue.get_nowait())
                 except queue.Empty:
                     break
+            # pop stamps BEFORE the straggler hold: in-process sampled
+            # requests' queue backlog must read as queue_wait, and the
+            # hold as coalesce — not all lumped into one component
+            for r in batch:
+                _mark_popped(r)
             hold_ms = 0.0
             if not skip_hold:
                 deadline = first.t_submit + \
@@ -714,9 +868,11 @@ class PredictionService:
                     if remaining <= 0:
                         break
                     try:
-                        batch.append(self._queue.get(timeout=remaining))
+                        straggler = self._queue.get(timeout=remaining)
                     except queue.Empty:
                         break
+                    _mark_popped(straggler)
+                    batch.append(straggler)
                 hold_ms = (time.perf_counter() - t_hold) * 1000.0
             # the window's own latency contribution, fed to the adaptive
             # rule: how long THIS batch held open for stragglers
@@ -792,6 +948,7 @@ class PredictionService:
             except Exception:
                 pass   # fall through to the sync isolating completion
             else:
+                _mark_dispatch(batch, len(batch))
                 with self._inflight_lock:
                     self._inflight += len(batch)
                 return (batch, pred, handle, time.perf_counter())
@@ -828,13 +985,17 @@ class PredictionService:
         finally:
             with self._inflight_lock:
                 self._inflight -= len(batch)
+        _mark_done(batch)
         self._reply(batch, results)
 
     def _serve(self, batch: List[_Request], pred=None,
                prepared=None) -> None:
-        self._reply(batch,
-                    self._predict_isolating([r.row for r in batch],
-                                            pred=pred, prepared=prepared))
+        # sync path: the whole predict runs here, so dispatch == entry
+        _mark_dispatch(batch, len(batch))
+        results = self._predict_isolating([r.row for r in batch],
+                                          pred=pred, prepared=prepared)
+        _mark_done(batch)
+        self._reply(batch, results)
 
     def _reply(self, batch: List[_Request], results) -> None:
         now = time.perf_counter()
@@ -846,6 +1007,12 @@ class PredictionService:
                         r.future.set_result(val)
                     else:  # answer with the error, don't wedge the waiter
                         r.future.set_exception(val)
+                # in-process sampled requests close here (the future IS
+                # the reply); wire contexts close at the transport's
+                # reply push, which owns the t_reply stamp
+                tr = r.trace
+                if tr is not None and not tr.wire:
+                    self.record_request_trace(tr)
         self.counters.max("Serving", "MaxBatchObserved", len(batch))
 
 
@@ -865,8 +1032,12 @@ class RespPredictionLoop:
         from ..io.respq import RespClient
         cfg = dict(config or {})
         self.service = service
+        # the service's counters ride in so this client's reconnects
+        # land as Broker/Reconnects in the job dump, same as the fleet's
         self.client = RespClient(cfg.get("redis.server.host", "127.0.0.1"),
-                                 int(cfg.get("redis.server.port", 6379)))
+                                 int(cfg.get("redis.server.port", 6379)),
+                                 delim=service.delim,
+                                 counters=service.counters)
         self.request_q = cfg.get("redis.request.queue", "requestQueue")
         self.prediction_q = cfg.get("redis.prediction.queue",
                                     "predictionQueue")

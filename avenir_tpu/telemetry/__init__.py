@@ -22,15 +22,21 @@ Three layers, all off by default and cheap when off:
   503 when any is degraded) so a load balancer can see a degraded
   worker.
 
+Per-REQUEST distributed tracing (:mod:`.reqtrace`, TPU_NOTES §27) rides
+the span layer: head-sampled requests carry a wire trace field end to
+end, leave Chrome flow events (``flow()``) across process lanes, and
+land component-timing histograms with request-id exemplars in the
+metrics registry — off by default, one global read when off.
+
 Collective stall detection lives with the transports
 (``parallel.collectives.AllReducer``): a heartbeat deadline emits a
 structured ``allreduce.stall`` instant event (through :func:`instant`)
 naming the missing shard(s) long before the hard timeout.
 """
 
-from .trace import (NULL_SPAN, Tracer, current_tracer, install_tracer,
-                    instant, merge_trace_files, span, uninstall_tracer,
-                    validate_trace_events)
+from .trace import (NULL_SPAN, Tracer, current_tracer, flow,
+                    install_tracer, instant, merge_trace_files, span,
+                    uninstall_tracer, validate_trace_events)
 
 # metrics/server are LAZY (PEP 562): every hot module (table, tree,
 # forest, colcache, collectives) imports span()/instant() from here for
@@ -54,8 +60,8 @@ def __getattr__(name: str):
 
 
 __all__ = [
-    "Tracer", "span", "instant", "install_tracer", "uninstall_tracer",
-    "current_tracer", "NULL_SPAN", "validate_trace_events",
-    "merge_trace_files", "MetricsRegistry", "set_default_registry",
-    "get_default_registry", "MetricsServer",
+    "Tracer", "span", "instant", "flow", "install_tracer",
+    "uninstall_tracer", "current_tracer", "NULL_SPAN",
+    "validate_trace_events", "merge_trace_files", "MetricsRegistry",
+    "set_default_registry", "get_default_registry", "MetricsServer",
 ]

@@ -60,15 +60,29 @@ def _fmt_value(v: float) -> str:
     return repr(f)
 
 
+def _escape_label_value(v) -> str:
+    """Label-value escaping per the Prometheus text-format spec:
+    backslash first (or the other escapes would double up), then
+    double-quote and newline.  A host label or service name carrying any
+    of the three otherwise emits an unparseable scrape — pinned by
+    tests/test_reqtrace.py."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def _escape_help(text: str) -> str:
+    """HELP-text escaping per the spec (backslash and newline only —
+    quotes are legal in HELP)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _fmt_labels(names: Sequence[str], values: Sequence[str],
                 extra: Sequence[Tuple[str, str]] = ()) -> str:
     pairs = [(n, v) for n, v in zip(names, values)] + list(extra)
     if not pairs:
         return ""
-    body = ",".join(
-        f'{sanitize_label(n)}="' +
-        str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
-        + '"' for n, v in pairs)
+    body = ",".join(f'{sanitize_label(n)}="{_escape_label_value(v)}"'
+                    for n, v in pairs)
     return "{" + body + "}"
 
 
@@ -78,7 +92,7 @@ class _Metric:
     registry (metric updates are a few ops per multi-ms unit of work)."""
 
     __slots__ = ("name", "kind", "help", "label_names", "values",
-                 "buckets", "_lock")
+                 "buckets", "exemplars", "_lock")
 
     def __init__(self, name: str, kind: str, help_text: str,
                  label_names: Sequence[str], lock: threading.Lock,
@@ -92,6 +106,11 @@ class _Metric:
         # histogram: labels -> [bucket_counts..., sum, count]
         self.values: Dict[tuple, object] = {}
         self.buckets = tuple(sorted(buckets)) if kind == "histogram" else ()
+        # histogram exemplars (ISSUE 15): labels -> {native bucket index
+        # -> (trace_id, value, unix_ts)} — each bucket remembers the
+        # LAST sampled observation that landed in it, so a p99 spike
+        # resolves to a concrete request id in one step
+        self.exemplars: Dict[tuple, Dict[int, tuple]] = {}
 
     def _key(self, labels: Dict[str, str]) -> tuple:
         if set(labels) != set(self.label_names):
@@ -135,10 +154,15 @@ class _Metric:
                       if all(k[i] == w for i, w in zip(idx, want))]
             for k in doomed:
                 del self.values[k]
+                self.exemplars.pop(k, None)
         return len(doomed)
 
     # histogram
-    def observe(self, value: float, **labels) -> None:
+    def observe(self, value: float, exemplar=None, **labels) -> None:
+        """One observation; ``exemplar`` (a sampled request's trace id)
+        is remembered by the NATIVE bucket — the smallest bucket the
+        value fits, last write wins — and rendered OpenMetrics-style on
+        that ``_bucket`` line."""
         if self.kind != "histogram":
             raise TypeError(f"{self.name} is a {self.kind}; use inc()/set()")
         key = self._key(labels)
@@ -146,38 +170,69 @@ class _Metric:
             st = self.values.get(key)
             if st is None:
                 st = self.values[key] = [0] * len(self.buckets) + [0.0, 0]
+            native = len(self.buckets)
             for i, edge in enumerate(self.buckets):
                 if value <= edge:
                     st[i] += 1
+                    if i < native:
+                        native = i
             st[-2] += float(value)
             st[-1] += 1
+            if exemplar is not None:
+                self.exemplars.setdefault(key, {})[native] = (
+                    str(exemplar), float(value), time.time())
+
+    def _exemplar_suffix(self, ex: Optional[Dict[int, tuple]],
+                         idx: int) -> str:
+        """The OpenMetrics exemplar tail for one ``_bucket`` line:
+        `` # {trace_id="<id>"} <value> <unix_ts>`` — metric spike to
+        concrete request id in one scrape."""
+        if not ex or idx not in ex:
+            return ""
+        rid, val, ts = ex[idx]
+        return (f' # {{trace_id="{_escape_label_value(rid)}"}} '
+                f"{_fmt_value(val)} {ts:.3f}")
 
     # exposition
-    def render(self) -> List[str]:
-        lines = [f"# HELP {self.name} {self.help}",
+    def render(self, openmetrics: bool = False) -> List[str]:
+        """Text-format lines.  ``openmetrics=True`` renders the
+        OpenMetrics dialect: exemplar tails on ``_bucket`` lines and the
+        mandatory ``_total`` suffix on counter samples — both ILLEGAL /
+        absent in the classic 0.0.4 exposition (whose parser rejects
+        tokens after the value), so the default render stays classic."""
+        lines = [f"# HELP {self.name} {_escape_help(self.help)}",
                  f"# TYPE {self.name} {self.kind}"]
+        sample_name = self.name
+        if openmetrics and self.kind == "counter":
+            # OpenMetrics REQUIRES counter samples named <family>_total;
+            # a bare-name counter fails the whole scrape at the parser
+            sample_name = f"{self.name}_total"
         with self._lock:
             items = sorted(self.values.items())
+            ex_copy = {k: dict(v) for k, v in self.exemplars.items()} \
+                if openmetrics else {}
         for key, v in items:
             if self.kind == "histogram":
+                ex = ex_copy.get(key)
                 cum = 0
                 for i, edge in enumerate(self.buckets):
                     cum = v[i]
                     lines.append(
                         f"{self.name}_bucket"
                         f"{_fmt_labels(self.label_names, key, [('le', _fmt_value(edge))])}"
-                        f" {cum}")
+                        f" {cum}{self._exemplar_suffix(ex, i)}")
                 lines.append(
                     f"{self.name}_bucket"
                     f"{_fmt_labels(self.label_names, key, [('le', '+Inf')])}"
-                    f" {v[-1]}")
+                    f" {v[-1]}"
+                    f"{self._exemplar_suffix(ex, len(self.buckets))}")
                 lines.append(f"{self.name}_sum"
                              f"{_fmt_labels(self.label_names, key)}"
                              f" {_fmt_value(v[-2])}")
                 lines.append(f"{self.name}_count"
                              f"{_fmt_labels(self.label_names, key)} {v[-1]}")
             else:
-                lines.append(f"{self.name}"
+                lines.append(f"{sample_name}"
                              f"{_fmt_labels(self.label_names, key)}"
                              f" {_fmt_value(v)}")
         return lines
@@ -399,13 +454,30 @@ class MetricsRegistry:
     # ---- exposition ----
     def render(self) -> str:
         """Prometheus text format 0.0.4 of every family, probes run
-        first so attached sources are fresh at scrape time."""
+        first so attached sources are fresh at scrape time.  NO
+        exemplars — the classic parser rejects them; scrapers that want
+        them negotiate :meth:`render_openmetrics` via Accept."""
         self.run_probes()
         with self._lock:
             metrics = [self._metrics[k] for k in sorted(self._metrics)]
         lines: List[str] = []
         for m in metrics:
             lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+    def render_openmetrics(self) -> str:
+        """The OpenMetrics exposition: same families, ``_bucket`` lines
+        carrying their exemplar tails, counters suffixed ``_total``,
+        ``# EOF`` terminated — what a scraper sending ``Accept:
+        application/openmetrics-text`` gets, and the ONLY text form
+        exemplars legally ride."""
+        self.run_probes()
+        with self._lock:
+            metrics = [self._metrics[k] for k in sorted(self._metrics)]
+        lines: List[str] = []
+        for m in metrics:
+            lines.extend(m.render(openmetrics=True))
+        lines.append("# EOF")
         return "\n".join(lines) + "\n"
 
     def sample(self) -> Dict[str, object]:
@@ -425,6 +497,32 @@ class MetricsRegistry:
                     out[f"{m.name}{label}.sum"] = v[-2]
                 else:
                     out[f"{m.name}{label}"] = v
+        return out
+
+    def exemplars_json(self) -> Dict[str, List[dict]]:
+        """The ``/metrics``-adjacent JSON view of every histogram
+        exemplar: ``{metric: [{labels, le, trace_id, value, unix_ts}]}``
+        — what ``tracetool`` and dashboards resolve a p99 bucket's
+        request id from without parsing the text exposition."""
+        out: Dict[str, List[dict]] = {}
+        with self._lock:
+            metrics = [self._metrics[k] for k in sorted(self._metrics)]
+        for m in metrics:
+            if m.kind != "histogram":
+                continue
+            with m._lock:
+                ex = {k: dict(v) for k, v in m.exemplars.items()}
+            rows: List[dict] = []
+            for key, by_bucket in sorted(ex.items()):
+                labels = dict(zip(m.label_names, key))
+                for i, (rid, val, ts) in sorted(by_bucket.items()):
+                    le = "+Inf" if i >= len(m.buckets) \
+                        else _fmt_value(m.buckets[i])
+                    rows.append({"labels": labels, "le": le,
+                                 "trace_id": rid, "value": val,
+                                 "unix_ts": ts})
+            if rows:
+                out[m.name] = rows
         return out
 
     # ---- background snapshot thread ----

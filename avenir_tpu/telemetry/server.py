@@ -8,7 +8,9 @@ Prometheus text; ``/healthz`` aggregates the registry's health providers
 — 200 with ``{"status": "ok"}`` when every provider reports healthy,
 503 with the failing checks when any is degraded, which is exactly the
 contract a load balancer's health probe consumes (a degraded serving
-worker stops pulling traffic).  Anything else is 404.
+worker stops pulling traffic).  ``/exemplars`` is the JSON twin of the
+histogram exemplars (bucket -> last sampled request id).  Anything else
+is 404.
 
 The server must never take the job down: handler errors answer 500,
 logging is suppressed (stdlib BaseHTTPRequestHandler logs every request
@@ -25,6 +27,8 @@ from typing import Optional
 from .metrics import MetricsRegistry
 
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+OPENMETRICS_CONTENT_TYPE = \
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
 
 
 class MetricsServer:
@@ -59,9 +63,32 @@ class MetricsServer:
                 try:
                     path = self.path.split("?", 1)[0]
                     if path == "/metrics":
-                        self._answer(200,
-                                     registry.render().encode("utf-8"),
-                                     PROM_CONTENT_TYPE)
+                        # content negotiation, the real Prometheus
+                        # protocol: exemplars are only legal in the
+                        # OpenMetrics exposition, so the classic 0.0.4
+                        # body stays exemplar-free and a scraper asking
+                        # for openmetrics (what Prometheus sends when
+                        # exemplar scraping is on) gets them
+                        accept = self.headers.get("Accept", "") or ""
+                        if "application/openmetrics-text" in accept:
+                            self._answer(
+                                200,
+                                registry.render_openmetrics()
+                                .encode("utf-8"),
+                                OPENMETRICS_CONTENT_TYPE)
+                        else:
+                            self._answer(
+                                200, registry.render().encode("utf-8"),
+                                PROM_CONTENT_TYPE)
+                    elif path == "/exemplars":
+                        # the /metrics-adjacent JSON: histogram bucket
+                        # -> last sampled request id, for tooling that
+                        # should not have to parse the text exposition
+                        self._answer(
+                            200,
+                            json.dumps(registry.exemplars_json(),
+                                       sort_keys=True).encode(),
+                            "application/json")
                     elif path == "/healthz":
                         ok, payload = registry.health()
                         self._answer(

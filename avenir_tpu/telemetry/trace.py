@@ -43,14 +43,21 @@ TRACE_SUFFIX = ".jsonl"
 CHROME_SUFFIX = ".chrome.json"
 
 # Chrome trace-event schema subset this module emits (and the validator
-# checks): complete spans, instants, and metadata.
+# checks): complete spans, instants, metadata, and — since ISSUE 15 —
+# legacy flow events (s/t/f) carrying one sampled request's id across
+# process lanes (client enqueue -> worker pop -> dispatch -> reply).
 _REQUIRED_KEYS = {
     "X": ("name", "ph", "ts", "dur", "pid", "tid"),
     "i": ("name", "ph", "ts", "pid", "tid"),
     "B": ("name", "ph", "ts", "pid", "tid"),
     "E": ("ph", "ts", "pid", "tid"),
     "M": ("name", "ph", "pid"),
+    "s": ("name", "ph", "ts", "pid", "tid", "id"),
+    "t": ("name", "ph", "ts", "pid", "tid", "id"),
+    "f": ("name", "ph", "ts", "pid", "tid", "id"),
 }
+
+FLOW_PHASES = ("s", "t", "f")
 
 
 class Tracer:
@@ -193,6 +200,30 @@ class Tracer:
             ev["args"] = args
         self._append(ev)
 
+    def flow(self, name: str, phase: str, flow_id,
+             cat: Optional[str] = None, ts_us: Optional[float] = None,
+             args: Optional[dict] = None) -> None:
+        """One leg of a Chrome legacy flow (``s`` start / ``t`` step /
+        ``f`` finish): the arrow connecting one sampled request's hops
+        across process lanes.  All legs of one flow must share cat, name
+        AND id (catapult binds on the triplet), so callers keep the name
+        constant and put the hop label in ``args``.  ``ts_us`` pins the
+        event to a timestamp the caller already took (a stamped wire
+        enqueue time) instead of now."""
+        if phase not in FLOW_PHASES:
+            raise ValueError(f"flow phase must be one of {FLOW_PHASES}, "
+                             f"got {phase!r}")
+        ev = {"ph": phase, "name": name, "id": str(flow_id),
+              "ts": round(self.now_us() if ts_us is None else ts_us, 1),
+              "pid": self.process_index, "tid": self._tid()}
+        if phase == "f":
+            ev["bp"] = "e"   # bind to the enclosing slice, chrome-style
+        if cat:
+            ev["cat"] = cat
+        if args:
+            ev["args"] = args
+        self._append(ev)
+
     # ---- persistence ----
     def flush(self) -> None:
         """Append the buffered events to the JSONL file.  IO runs outside
@@ -319,6 +350,16 @@ def instant(name: str, cat: Optional[str] = None,
         tr.instant(name, cat=cat, on_thread=on_thread, **args)
 
 
+def flow(name: str, phase: str, flow_id, cat: Optional[str] = None,
+         ts_us: Optional[float] = None, **args) -> None:
+    """Record one flow leg on the installed tracer (no-op when off) —
+    see :meth:`Tracer.flow`."""
+    tr = _active
+    if tr is not None:
+        tr.flow(name, phase, flow_id, cat=cat, ts_us=ts_us,
+                args=args or None)
+
+
 # --------------------------------------------------------------------------
 # trace-file reading / validation / merge (shared with tools/tracetool.py)
 # --------------------------------------------------------------------------
@@ -350,10 +391,14 @@ def validate_trace_events(events: List[dict]) -> List[str]:
     crossing (spans on one lane come from a LIFO stack of context
     managers on one thread, so a crossing means the clock ran backwards,
     e.g. events with mixed epoch anchors merged into one lane); any
-    legacy B/E duration events pair up per lane."""
+    legacy B/E duration events pair up per lane; per flow id, at most
+    one ``s`` start and one ``f`` finish (a dangling ``t``/``f`` with
+    no ``s`` is NOT flagged — one process's file is a legitimate
+    partial view of a multi-process flow)."""
     problems: List[str] = []
     open_stacks: Dict[tuple, List[str]] = {}
     lane_spans: Dict[tuple, List[tuple]] = {}
+    flow_counts: Dict[str, List[int]] = {}
     for i, ev in enumerate(events):
         ph = ev.get("ph")
         if ph not in _REQUIRED_KEYS:
@@ -368,6 +413,9 @@ def validate_trace_events(events: List[dict]) -> List[str]:
                 problems.append(
                     f"event {i} (ph={ph}): {key} must be a non-negative "
                     f"number, got {ev[key]!r}")
+        if ph in ("s", "f") and "id" in ev:
+            cnt = flow_counts.setdefault(str(ev["id"]), [0, 0])
+            cnt[0 if ph == "s" else 1] += 1
         if ph == "X" and isinstance(ev.get("ts"), (int, float)) \
                 and isinstance(ev.get("dur"), (int, float)):
             lane_spans.setdefault(
@@ -388,6 +436,13 @@ def validate_trace_events(events: List[dict]) -> List[str]:
         for name in stack:
             problems.append(f"unmatched 'B' event {name!r} on lane "
                             f"(pid={pid}, tid={tid})")
+    for fid, (n_s, n_f) in sorted(flow_counts.items()):
+        if n_s > 1:
+            problems.append(f"flow {fid!r}: {n_s} 's' start events "
+                            f"(must be at most one)")
+        if n_f > 1:
+            problems.append(f"flow {fid!r}: {n_f} 'f' finish events "
+                            f"(must be at most one)")
     # lane timeline check: 1µs slack absorbs the 0.1µs ts/dur rounding
     eps = 1.0
     for (pid, tid), spans in lane_spans.items():

@@ -814,6 +814,56 @@ def bench_serve_forest(scale):
         except urllib.error.HTTPError as exc:
             degraded_503 = exc.code == 503
         svc.degraded = None
+        # request-level tracing (ISSUE 15): re-run the closed loop with
+        # head sampling ON (every 16th request traced end to end) vs a
+        # fresh untraced baseline — the <2% throughput budget — then
+        # pull an exemplar request id off a scraped p99-region histogram
+        # bucket and prove it resolves to a valid `tracetool request`
+        # timeline
+        import re as _re
+        import subprocess as _sp
+        import tempfile as _tf
+        from avenir_tpu.telemetry import reqtrace as _rt
+        rt_base = one_load(0)
+        rt_dir = _tf.mkdtemp(prefix="avt_reqtrace_")
+        tracer = tele.install_tracer(tele.Tracer(rt_dir,
+                                                 run_id="bench-rt"))
+        _rt.set_sample_rate(16)
+        try:
+            rt_traced = one_load(0)
+        finally:
+            _rt.set_sample_rate(0)
+            tele.uninstall_tracer()
+            tracer.close()
+        # exemplars ride the OpenMetrics exposition only (the classic
+        # 0.0.4 parser rejects them): scrape the way Prometheus does
+        # with exemplar scraping on
+        rt_scrape = urllib.request.urlopen(urllib.request.Request(
+            msrv.url + "/metrics",
+            headers={"Accept": "application/openmetrics-text"}),
+            timeout=10).read().decode()
+        m = _re.search(r'# \{trace_id="([^"]+)"\}', rt_scrape)
+        exemplar_id = m.group(1) if m else None
+        exemplar_resolves = False
+        if exemplar_id:
+            p = _sp.run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(__file__), "..", "tools",
+                              "tracetool.py"),
+                 "request", exemplar_id, tracer.path],
+                capture_output=True, text=True)
+            exemplar_resolves = p.returncode == 0
+        rt_delta = 1.0 - rt_traced["throughput_req_per_sec"] \
+            / max(rt_base["throughput_req_per_sec"], 1e-9)
+        request_tracing = {
+            "sample_rate": 16,
+            "untraced_req_per_sec": rt_base["throughput_req_per_sec"],
+            "traced_req_per_sec": rt_traced["throughput_req_per_sec"],
+            "throughput_delta_fraction": round(rt_delta, 4),
+            "within_2pct_budget": rt_delta < 0.02,
+            "exemplar_trace_id": exemplar_id,
+            "exemplar_resolves_to_timeline": exemplar_resolves,
+        }
     finally:
         # a failed load pass or scrape must not leave the serving batch
         # thread and the HTTP server running in the bench process
@@ -905,6 +955,7 @@ def bench_serve_forest(scale):
                 "p99_gauge": 'quantile="p99"' in scrape,
                 "healthz_ok_then_degraded_503":
                     healthz_ok and degraded_503},
+            "request_tracing": request_tracing,
             "quantized": quantized,
             "fleet_sweep": fleet,
             "horizontal": horizontal}

@@ -1,0 +1,165 @@
+"""The ISSUE 15 end-to-end pin: under a 2-shard broker ring with two
+``fleet_host`` OS processes, a head-sampled request's trace reconstructs
+client-enqueue -> shard -> worker pop -> batch dispatch -> reply from
+the MERGED per-process trace files — every sampled flow exactly one
+``s`` and one ``f``, components summing (±ε) to the client-observed wire
+latency, and ``tracetool request <id>`` rendering the timeline.
+
+Runs in the tier-1 lane (``obs`` marker, same weight class as the
+existing two-fleet-host broker test)."""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from avenir_tpu import telemetry as T
+from avenir_tpu.telemetry import reqtrace as RT
+from avenir_tpu.core.table import encode_rows
+from avenir_tpu.io.respq import RespServer, ShardedRespClient
+from tests.test_fleet import drain_replies, make_fleet_registry
+from tests.test_serving import forest_batch_predict, raw_rows_of
+from tests.test_tree import SCHEMA
+
+pytestmark = pytest.mark.obs
+
+_TRACETOOL = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools", "tracetool.py")
+
+
+def test_two_fleet_hosts_two_shards_merged_request_flows(tmp_path,
+                                                         mesh_ctx):
+    reg, table, models = make_fleet_registry(tmp_path, mesh_ctx)
+    rows = raw_rows_of(table, 40)
+    expect = forest_batch_predict(models, encode_rows(rows, SCHEMA))
+    trace_dir = str(tmp_path / "traces")
+    servers = [RespServer().start() for _ in range(2)]
+    eps = ",".join(f"127.0.0.1:{s.port}" for s in servers)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               AVENIR_TPU_PLATFORM="cpu")
+    env.pop(RT.SAMPLE_ENV, None)   # consumers never re-sample anyway
+    children = [
+        subprocess.Popen(
+            [sys.executable, "-m", "avenir_tpu.serving.fleet_host",
+             "--registry", str(tmp_path / "registry"),
+             "--model", "churn", "--endpoints", eps,
+             "--workers", "2", "--host-label", label,
+             "--buckets", "8,64", "--max-batch", "16",
+             "--max-idle-s", "60",
+             "--trace-dir", trace_dir, "--run-id", "obs",
+             "--trace-index", str(idx),
+             "--ready-file", str(tmp_path / f"ready-{label}")],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env)
+        for idx, label in ((1, "hostA"), (2, "hostB"))]
+    # the CLIENT process traces too: its lane carries the flow starts
+    tracer = T.install_tracer(T.Tracer(trace_dir, run_id="obs",
+                                       process_index=0))
+    feeder = ShardedRespClient(eps.split(","))
+    n = 60
+    sampled_ids = None
+    try:
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and not all(
+                (tmp_path / f"ready-{lab}").exists()
+                for lab in ("hostA", "hostB")):
+            assert all(c.poll() is None for c in children), \
+                "a fleet_host child died during startup"
+            time.sleep(0.05)
+        RT.set_sample_rate(3)   # every 3rd request traced end to end
+        try:
+            for i in range(0, n, 20):
+                feeder.lpush_many(
+                    "requestQueue",
+                    [",".join(["predict", str(j)] + rows[j % 40])
+                     for j in range(i, min(i + 20, n))])
+                time.sleep(0.02)
+        finally:
+            RT.set_sample_rate(0)
+        got = drain_replies(feeder, "predictionQueue", n,
+                            timeout_s=120.0)
+        # the trace field never changes the answers
+        assert sorted(got, key=int) == [str(i) for i in range(n)]
+        assert all(len(v) == 1 for v in got.values())
+        for i in range(n):
+            assert got[str(i)] == [expect[i % 40]]
+        # stop both children (serialized, the broker-test protocol)
+        remaining = list(children)
+        while remaining:
+            feeder.lpush("requestQueue", "stop")
+            deadline = time.monotonic() + 90
+            exited = None
+            while exited is None and time.monotonic() < deadline:
+                exited = next((c for c in remaining
+                               if c.poll() is not None), None)
+                time.sleep(0.05)
+            assert exited is not None, "no fleet_host exited on stop"
+            remaining.remove(exited)
+            out, err = exited.communicate(timeout=30)
+            assert exited.returncode == 0, err
+    finally:
+        for c in children:
+            if c.poll() is None:
+                c.kill()
+        feeder.close()
+        for s in servers:
+            s.stop()
+        T.uninstall_tracer()
+        tracer.close()
+    # ---- the merged-flow pin ----
+    paths = sorted(glob.glob(os.path.join(trace_dir,
+                                          "trace-obs.p*.jsonl")))
+    assert len(paths) == 3, paths   # client + 2 fleet hosts
+    events = T.merge_trace_files(paths)
+    assert T.validate_trace_events(events) == []
+    starts = {e["id"]: e for e in events if e.get("ph") == "s"}
+    finishes = {e["id"]: e for e in events if e.get("ph") == "f"}
+    sampled_ids = set(starts)
+    assert sampled_ids, "no request was sampled"
+    assert sampled_ids == set(finishes), \
+        "every sampled flow needs exactly one s and one f"
+    assert len(sampled_ids) == n // 3
+    # flows CROSS process lanes: s on the client lane (pid 0), f on a
+    # fleet_host lane (pid 1 or 2)
+    assert {starts[i]["pid"] for i in sampled_ids} == {0}
+    assert {finishes[i]["pid"] for i in sampled_ids} <= {1, 2}
+    # every sampled request passed a worker pop and a batch dispatch
+    steps_by_id = {}
+    for e in events:
+        if e.get("ph") == "t":
+            steps_by_id.setdefault(e["id"], set()).add(
+                e.get("args", {}).get("step"))
+    for rid in sampled_ids:
+        assert {"pop", "dispatch"} <= steps_by_id.get(rid, set()), rid
+    # the s leg names a live broker shard from the ring
+    shard_eps = set(eps.split(","))
+    for rid in sampled_ids:
+        assert starts[rid]["args"]["broker"] in shard_eps
+    # components sum (±ε) to the client-observed wire latency
+    for rid in sampled_ids:
+        a = finishes[rid]["args"]
+        comp_sum = sum(a[k] for k in ("queue_wait_ms", "coalesce_ms",
+                                      "device_ms", "reply_ms"))
+        wire_ms = (finishes[rid]["ts"] - starts[rid]["ts"]) / 1e3
+        assert abs(comp_sum - a["total_ms"]) < 0.05, (rid, a)
+        assert abs(a["total_ms"] - wire_ms) < 1.0, (rid, a, wire_ms)
+    # ---- tracetool request renders the merged timeline ----
+    rid = sorted(sampled_ids)[0]
+    p = subprocess.run([sys.executable, _TRACETOOL, "request", rid]
+                       + paths, capture_output=True, text=True)
+    assert p.returncode == 0, p.stderr
+    assert f"request {rid}:" in p.stdout and "wire" in p.stdout
+    assert "enqueue" in p.stdout and "pop" in p.stdout \
+        and "reply" in p.stdout
+    # ---- and the incident report covers the window ----
+    t_lo = min(e["ts"] for e in events if isinstance(
+        e.get("ts"), (int, float)) and e["ts"] > 0)
+    p = subprocess.run([sys.executable, _TRACETOOL, "incident",
+                        str(t_lo / 1e6 - 1), str(t_lo / 1e6 + 600)]
+                       + paths, capture_output=True, text=True)
+    assert p.returncode == 0, p.stderr
+    assert "sampled requests" in p.stdout

@@ -1,0 +1,599 @@
+"""Request-level distributed tracing (ISSUE 15): the wire trace field +
+head sampling, flow events across client/worker/device lanes, component
+decomposition summing to the wire latency, histogram exemplars and the
+hardened Prometheus exposition, broker reconnect observability, and the
+``tracetool request``/``incident`` exit contracts.
+
+Everything here runs in the fast tier-1 lane (``obs`` marker)."""
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from avenir_tpu import telemetry as T
+from avenir_tpu.telemetry import reqtrace as RT
+from avenir_tpu.telemetry.metrics import MetricsRegistry
+from avenir_tpu.io.respq import RespClient, RespServer, ShardedRespClient
+from avenir_tpu.serving.service import (BatchPolicy, PredictionService,
+                                        RespPredictionLoop)
+
+pytestmark = pytest.mark.obs
+
+
+class FakePredictor:
+    """Minimal sync predictor: label = first field upper-cased."""
+
+    def warm(self):
+        return self
+
+    def predict_rows(self, rows):
+        return [r[0].upper() for r in rows]
+
+
+@pytest.fixture()
+def tracer(tmp_path):
+    tr = T.install_tracer(T.Tracer(str(tmp_path / "traces"),
+                                   run_id="rt", process_index=0))
+    yield tr
+    T.uninstall_tracer()
+
+
+@pytest.fixture(autouse=True)
+def _sampling_off_after():
+    """Sampling is a module global: never leak a test's rate into the
+    rest of the suite."""
+    yield
+    RT.set_sample_rate(0)
+
+
+def _flows(path, phase=None):
+    evs = T.merge_trace_files([path])
+    out = [e for e in evs if e.get("ph") in ("s", "t", "f")]
+    if phase is not None:
+        out = [e for e in out if e["ph"] == phase]
+    return out
+
+
+# --------------------------------------------------------------------------
+# the wire field
+# --------------------------------------------------------------------------
+
+def test_trace_field_round_trip_and_rejection():
+    tok = RT.encode_field(1234567.9, sampled=1)
+    assert tok == "t=1234567:1"
+    enq, sampled = RT.parse_field(tok)
+    assert enq == 1234567.0 and sampled
+    enq, sampled = RT.parse_field("t=99:0")
+    assert enq == 99.0 and not sampled
+    # not trace fields: ordinary features stay features — the grammar
+    # is EXACTLY t=<int>:<0|1> (a bare "t=2024" is a real feature a
+    # pre-§27 client may legitimately push; eating it would corrupt
+    # the row and fabricate a sampled context with tracing off)
+    for bad in ("x=1:1", "t=abc:1", "temperature", "t=", "t=2024",
+                "t=1.5:1", "t=1000:2", "t=1000:", "t=-3:1"):
+        assert RT.parse_field(bad) is None
+
+
+def test_split_predict_strips_field_and_keeps_old_layout():
+    # old layout: untouched
+    rid, row, ctx = RT.split_predict(["predict", "7", "a", "b"])
+    assert (rid, row, ctx) == ("7", ["a", "b"], None)
+    # sampled field: stripped, context carries the enqueue stamp
+    rid, row, ctx = RT.split_predict(
+        ["predict", "7", "t=1000:1", "a", "b"])
+    assert rid == "7" and row == ["a", "b"]
+    assert ctx is not None and ctx.enqueue_us == 1000.0 and ctx.wire
+    # present-but-unsampled: stripped, no context
+    rid, row, ctx = RT.split_predict(["predict", "7", "t=1000:0", "a"])
+    assert row == ["a"] and ctx is None
+    # a first feature that merely LOOKS like the prefix stays a feature
+    for feature in ("t=oops", "t=2024", "t=1.5:1"):
+        rid, row, ctx = RT.split_predict(["predict", "7", feature, "a"])
+        assert row == [feature, "a"] and ctx is None
+
+
+def test_stamping_off_is_identity_same_object():
+    assert RT.sample_rate() == 0
+    vals = ["predict,1,a,b", "reload"]
+    assert RT.stamp_values(vals) is vals
+
+
+def test_stamping_samples_every_nth_and_never_restamps(tracer):
+    RT.set_sample_rate(2)
+    vals = [f"predict,{i},a,b" for i in range(8)] + ["reload", "stop"]
+    out = RT.stamp_values(vals, broker="b0")
+
+    def n_stamped(vs):
+        return sum(1 for v in vs if v.startswith("predict,")
+                   and v.split(",")[2].startswith("t="))
+    stamped = [v for v in out if v.startswith("predict,")
+               and v.split(",")[2].startswith("t=")]
+    assert len(stamped) == 4
+    assert out[-2:] == ["reload", "stop"]   # non-predict untouched
+    # a second pass (the inner shard client) must not re-stamp or
+    # re-count the already-stamped ones
+    again = RT.stamp_values(list(out), broker="b1")
+    assert n_stamped(again) >= len(stamped)
+    for v in stamped:
+        assert again[out.index(v)] == v
+    tracer.flush()
+    starts = _flows(tracer.path, "s")
+    # one flow start per newly stamped value, broker recorded
+    assert sum(1 for e in starts if e["args"]["broker"] == "b0") == 4
+
+
+def test_sharded_client_stamps_with_owning_shard(tracer):
+    servers = [RespServer().start() for _ in range(2)]
+    try:
+        eps = [f"127.0.0.1:{s.port}" for s in servers]
+        sc = ShardedRespClient(eps)
+        RT.set_sample_rate(1)
+        sc.lpush_many("rq", [f"predict,{i},a" for i in range(6)])
+        RT.set_sample_rate(0)
+        tracer.flush()
+        starts = _flows(tracer.path, "s")
+        assert len(starts) == 6
+        # flow ids are namespaced <run_id>:<rid> against cross-run
+        # collisions in a shared trace dir; the flow start names the
+        # shard the ring actually routed the bare rid to
+        for e in starts:
+            run_id, _, rid = e["id"].partition(":")
+            assert run_id == "rt"
+            assert e["args"]["broker"] == sc.shard_of(rid)
+        # request and its stamped form route identically (field is not
+        # part of the routing id)
+        for i in range(6):
+            assert sc.shard_of(sc.id_of(f"predict,{i},t=1:1,a")) \
+                == sc.shard_of(sc.id_of(f"predict,{i},a"))
+        sc.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+# --------------------------------------------------------------------------
+# flow-event schema
+# --------------------------------------------------------------------------
+
+def test_validate_flow_events_keys_and_duplicates():
+    base = {"ts": 1.0, "pid": 0, "tid": 1, "cat": "request"}
+    ok = [{"ph": "s", "name": "request", "id": "7", **base},
+          {"ph": "t", "name": "request", "id": "7", **base},
+          {"ph": "f", "name": "request", "id": "7", **base}]
+    assert T.validate_trace_events(ok) == []
+    # a dangling t/f (partial single-process view) is fine
+    assert T.validate_trace_events(ok[1:]) == []
+    dup = ok + [{"ph": "s", "name": "request", "id": "7", **base}]
+    assert any("2 's'" in p for p in T.validate_trace_events(dup))
+    missing = [{"ph": "s", "name": "request", **base}]
+    assert any("missing 'id'" in p
+               for p in T.validate_trace_events(missing))
+
+
+def test_tracer_flow_rejects_unknown_phase(tracer):
+    with pytest.raises(ValueError, match="flow phase"):
+        tracer.flow("request", "x", "1")
+
+
+# --------------------------------------------------------------------------
+# Prometheus exposition hardening + exemplars
+# --------------------------------------------------------------------------
+
+def test_label_values_escaped_per_text_format_spec():
+    reg = MetricsRegistry()
+    g = reg.gauge("avt_esc", 'help with "quotes"', labels=("host",))
+    hostile = 'a"b\\c\nd'
+    g.set(1, host=hostile)
+    text = reg.render()
+    line = next(l for l in text.splitlines() if l.startswith("avt_esc{"))
+    assert line == 'avt_esc{host="a\\"b\\\\c\\nd"} 1'
+    assert "\n" not in line   # the raw newline never reaches the wire
+
+
+def test_help_text_escaped():
+    reg = MetricsRegistry()
+    reg.gauge("avt_help", "line1\nline2 \\ tail").set(0)
+    text = reg.render()
+    help_line = next(l for l in text.splitlines()
+                     if l.startswith("# HELP avt_help"))
+    assert help_line == "# HELP avt_help line1\\nline2 \\\\ tail"
+
+
+def test_histogram_exemplars_native_bucket_last_wins():
+    reg = MetricsRegistry()
+    h = reg.histogram("avt_lat", "latency", labels=("svc",),
+                      buckets=(0.001, 0.01, 0.1))
+    h.observe(0.005, exemplar="r1", svc="a")
+    h.observe(0.007, exemplar="r2", svc="a")   # same bucket: last wins
+    h.observe(0.05, exemplar="r3", svc="a")
+    h.observe(5.0, exemplar="rInf", svc="a")   # lands in +Inf only
+    h.observe(0.0005, svc="a")                 # no exemplar: no suffix
+    # the CLASSIC 0.0.4 exposition must stay exemplar-free (the classic
+    # parser rejects tokens after the value); exemplars ride the
+    # OpenMetrics render only
+    assert "# {" not in reg.render()
+    text = reg.render_openmetrics()
+    assert text.rstrip().endswith("# EOF")
+    lines = [l for l in text.splitlines() if "avt_lat_bucket" in l]
+    by_le = {l.split('le="')[1].split('"')[0]: l for l in lines}
+    assert '# {trace_id="r2"} 0.007' in by_le["0.01"]
+    assert '# {trace_id="r3"} 0.05' in by_le["0.1"]
+    assert '# {trace_id="rInf"} 5' in by_le["+Inf"]
+    assert "# {" not in by_le["0.001"]
+    ex = reg.exemplars_json()["avt_lat"]
+    assert {e["trace_id"] for e in ex} == {"r2", "r3", "rInf"}
+    assert all(e["labels"] == {"svc": "a"} for e in ex)
+    # drop_series clears the exemplars with the values
+    h.drop_series(svc="a")
+    assert reg.exemplars_json() == {}
+
+
+def test_openmetrics_counter_total_suffix():
+    """OpenMetrics REQUIRES counter samples named <family>_total; the
+    classic exposition keeps the bare name (renaming it would break
+    existing dashboards)."""
+    reg = MetricsRegistry()
+    c = reg.counter("avt_hits", "hits", labels=())
+    c.inc(3)
+    classic = reg.render()
+    assert "\navt_hits 3" in "\n" + classic
+    assert "avt_hits_total" not in classic
+    om = reg.render_openmetrics()
+    assert "\navt_hits_total 3" in "\n" + om
+    assert "\navt_hits 3" not in "\n" + om
+
+
+def test_metrics_server_exemplars_endpoint_and_negotiation():
+    reg = MetricsRegistry()
+    h = reg.histogram("avt_e2e", "x", labels=())
+    h.observe(0.002, exemplar="req-9")
+    srv = T.MetricsServer(reg, port=0).start()
+    try:
+        body = urllib.request.urlopen(srv.url + "/exemplars",
+                                      timeout=10).read().decode()
+        payload = json.loads(body)
+        assert payload["avt_e2e"][0]["trace_id"] == "req-9"
+        # default scrape: classic 0.0.4, no exemplar tokens
+        resp = urllib.request.urlopen(srv.url + "/metrics", timeout=10)
+        assert "version=0.0.4" in resp.headers["Content-Type"]
+        assert "# {" not in resp.read().decode()
+        # Accept: openmetrics -> exemplars + # EOF
+        resp = urllib.request.urlopen(urllib.request.Request(
+            srv.url + "/metrics",
+            headers={"Accept": "application/openmetrics-text"}),
+            timeout=10)
+        assert "openmetrics-text" in resp.headers["Content-Type"]
+        body = resp.read().decode()
+        assert '# {trace_id="req-9"}' in body
+        assert body.rstrip().endswith("# EOF")
+    finally:
+        srv.stop()
+
+
+# --------------------------------------------------------------------------
+# in-process service: components + exemplars + counters
+# --------------------------------------------------------------------------
+
+def test_inprocess_sampling_components_sum_to_wire(tracer):
+    reg = MetricsRegistry()
+    svc = PredictionService(FakePredictor(), warm=False,
+                            policy=BatchPolicy(max_batch=8,
+                                               max_wait_ms=1.0),
+                            metrics=reg)
+    RT.set_sample_rate(1)
+    svc.start()
+    futs = [svc.submit(["x", "y"]) for _ in range(6)]
+    assert [f.result(timeout=30) for f in futs] == ["X"] * 6
+    RT.set_sample_rate(0)
+    # scrape BEFORE stop: a stopped service drops its series
+    text = reg.render_openmetrics()
+    assert svc.counters.get("Serving", "TracedRequests") == 6
+    assert "avenir_request_component_seconds_bucket" in text
+    assert '# {trace_id="inproc-' in text
+    svc.stop()
+    T.uninstall_tracer()
+    tracer.close()
+    evs = T.merge_trace_files([tracer.path])
+    assert T.validate_trace_events(evs) == []
+    fins = [e for e in evs if e["ph"] == "f"]
+    starts = {e["id"]: e for e in evs if e["ph"] == "s"}
+    assert len(fins) == 6 and set(starts) == {e["id"] for e in fins}
+    for f in fins:
+        a = f["args"]
+        comp_sum = sum(a[k] for k in ("queue_wait_ms", "coalesce_ms",
+                                      "device_ms", "reply_ms"))
+        wire_ms = (f["ts"] - starts[f["id"]]["ts"]) / 1e3
+        assert abs(comp_sum - a["total_ms"]) < 0.02
+        assert abs(a["total_ms"] - wire_ms) < 1.0
+
+
+def test_rejected_request_still_closes_its_flow(tracer):
+    svc = PredictionService(FakePredictor(), warm=False,
+                            policy=BatchPolicy(max_queue_depth=1))
+    # NOT started: the queue never drains, so the second submit rejects
+    RT.set_sample_rate(1)
+    f1 = svc.submit(["a"])
+    f2 = svc.submit(["b"])
+    RT.set_sample_rate(0)
+    assert not f1.done() and f2.result(timeout=1) == svc.busy_label
+    tracer.flush()
+    evs = T.merge_trace_files([tracer.path])
+    # the rejected request has BOTH legs; the queued one only its start
+    assert len(_flows(tracer.path, "s")) == 2
+    fins = [e for e in evs if e["ph"] == "f"]
+    assert len(fins) == 1 and fins[0]["args"]["device_ms"] == 0.0
+    svc.stop()
+
+
+# --------------------------------------------------------------------------
+# the wire loop: stamped and unstamped messages answer identically
+# --------------------------------------------------------------------------
+
+def test_resp_loop_parses_trace_field_backward_compatibly(tracer):
+    server = RespServer().start()
+    try:
+        svc = PredictionService(FakePredictor(), warm=False,
+                                policy=BatchPolicy(max_batch=16))
+        loop = RespPredictionLoop(svc, {"redis.server.port": server.port})
+        feeder = RespClient(port=server.port, stamp=False)
+        # half stamped by hand, half old-layout: same answers
+        for i in range(4):
+            feeder.lpush("requestQueue", f"predict,s{i},t=1000:1,a,b")
+            feeder.lpush("requestQueue", f"predict,u{i},a,b")
+        feeder.lpush("requestQueue", "stop")
+        loop.run(max_idle_s=10.0)
+        got = {}
+        while True:
+            v = feeder.rpop("predictionQueue")
+            if v is None:
+                break
+            rid, _, lab = v.partition(",")
+            got[rid] = lab
+        assert got == {f"{p}{i}": "A" for p in "su" for i in range(4)}
+        tracer.flush()
+        fins = _flows(tracer.path, "f")
+        assert {e["id"].split(":", 1)[-1] for e in fins} \
+            == {f"s{i}" for i in range(4)}
+        loop.close()
+        feeder.close()
+    finally:
+        server.stop()
+
+
+# --------------------------------------------------------------------------
+# broker reconnect observability (satellite)
+# --------------------------------------------------------------------------
+
+def test_reconnect_counter_and_instant(tracer):
+    from avenir_tpu.core.metrics import Counters
+    counters = Counters()
+    server = RespServer().start()
+    port = server.port
+    cli = RespClient(port=port, counters=counters)
+    assert cli.ping()
+    server.kill()
+    server2 = RespServer(port=port).start()
+    try:
+        with pytest.warns(RuntimeWarning, match="reconnected"):
+            cli.lpush("q", "v")
+        assert counters.get("Broker", "Reconnects") == 1
+        assert cli.reconnects == 1
+        tracer.flush()
+        evs = T.merge_trace_files([tracer.path])
+        recs = [e for e in evs if e.get("name") == "broker.reconnect"]
+        assert len(recs) == 1
+        a = recs[0]["args"]
+        assert a["endpoint"] == f"127.0.0.1:{port}" and a["attempt"] == 1
+        assert a["cause"]
+        cli.close()
+    finally:
+        server2.stop()
+
+
+def test_shard_down_emits_instant(tracer):
+    servers = [RespServer().start() for _ in range(2)]
+    eps = [f"127.0.0.1:{s.port}" for s in servers]
+    sc = ShardedRespClient(eps, timeout=2.0)
+    try:
+        servers[0].kill()
+        with pytest.warns(RuntimeWarning, match="degrading"):
+            sc.llen("q")
+        tracer.flush()
+        evs = T.merge_trace_files([tracer.path])
+        downs = [e for e in evs if e.get("name") == "broker.shard_down"]
+        assert len(downs) == 1 and downs[0]["args"]["endpoint"] == eps[0]
+        sc.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+# --------------------------------------------------------------------------
+# tracetool request / incident
+# --------------------------------------------------------------------------
+
+def _load_tracetool():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "tracetool", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "tracetool.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write_trace(path, events):
+    with open(path, "w") as fh:
+        for e in events:
+            fh.write(json.dumps(e) + "\n")
+
+
+def test_tracetool_request_renders_and_unknown_exits_1(tmp_path, capsys):
+    tt = _load_tracetool()
+    t0 = 1_700_000_000_000_000.0
+    base = {"pid": 0, "tid": 1, "cat": "request", "name": "request"}
+    _write_trace(tmp_path / "t.jsonl", [
+        {"ph": "s", "id": "42", "ts": t0,
+         "args": {"step": "enqueue", "broker": "b0"}, **base},
+        {"ph": "t", "id": "42", "ts": t0 + 3000,
+         "args": {"step": "pop", "worker": "w0"}, **base},
+        {"ph": "f", "id": "42", "ts": t0 + 5000,
+         "args": {"step": "reply", "queue_wait_ms": 3.0,
+                  "coalesce_ms": 1.0, "device_ms": 0.8,
+                  "reply_ms": 0.2, "total_ms": 5.0}, **base},
+    ])
+    rc = tt.main(["request", "42", str(tmp_path / "t.jsonl")])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "request 42: 3 flow leg(s), wire 5.000 ms" in out
+    assert "enqueue" in out and "pop" in out and "reply" in out
+    assert "queue_wait" in out and "5.000 ms" in out
+    rc = tt.main(["request", "nope", str(tmp_path / "t.jsonl")])
+    err = capsys.readouterr().err
+    assert rc == 1 and "unknown or unsampled request id" in err
+    # namespaced ids: the bare rid resolves when unique, errors named
+    # when two runs in one dir sampled the same rid
+    _write_trace(tmp_path / "two.jsonl", [
+        {"ph": "s", "id": "runA:7", "ts": t0, "args": {}, **base},
+        {"ph": "f", "id": "runA:7", "ts": t0 + 100, "args": {}, **base},
+        {"ph": "s", "id": "runB:7", "ts": t0 + 50, "args": {}, **base},
+    ])
+    rc = tt.main(["request", "7", str(tmp_path / "two.jsonl")])
+    err = capsys.readouterr().err
+    assert rc == 1 and "ambiguous" in err and "runA:7" in err
+    rc = tt.main(["request", "runA:7", str(tmp_path / "two.jsonl")])
+    out = capsys.readouterr().out
+    assert rc == 0 and "request runA:7" in out
+
+
+def test_tracetool_incident_report_and_empty_window(tmp_path, capsys):
+    tt = _load_tracetool()
+    t0 = 1_700_000_000_000_000.0   # epoch us
+    ibase = {"ph": "i", "pid": 0, "tid": 1, "s": "p"}
+    fbase = {"pid": 0, "tid": 1, "cat": "request", "name": "request"}
+    _write_trace(tmp_path / "t.jsonl", [
+        {"name": "autoscaler.decision", "ts": t0 + 1e6,
+         "args": {"action": "up", "active": 1, "new_active": 2,
+                  "depth": 99, "derivative_per_s": 10.0,
+                  "p99_ms": 5.0}, **ibase},
+        {"name": "broker.shard_down", "ts": t0 + 2e6,
+         "args": {"endpoint": "127.0.0.1:9", "cause": "gone"}, **ibase},
+        {"name": "registry.publish", "ts": t0 + 3e6,
+         "args": {"model": "m", "version": 4}, **ibase},
+        {"ph": "X", "name": "controller.stage", "ts": t0 + 2.5e6,
+         "dur": 5e5, "pid": 0, "tid": 1,
+         "args": {"stage": "fleet_swap", "cycle": 1}},
+        {"ph": "s", "id": "a", "ts": t0 + 0.5e6,
+         "args": {"step": "enqueue"}, **fbase},
+        {"ph": "f", "id": "a", "ts": t0 + 0.6e6,
+         "args": {"step": "reply"}, **fbase},
+        {"ph": "s", "id": "b", "ts": t0 + 3.5e6,
+         "args": {"step": "enqueue"}, **fbase},
+        {"ph": "f", "id": "b", "ts": t0 + 3.9e6,
+         "args": {"step": "reply"}, **fbase},
+    ])
+    rc = tt.main(["incident", str(t0 / 1e6), str(t0 / 1e6 + 4),
+                  str(tmp_path / "t.jsonl")])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "broker events" in out and "broker.shard_down" in out
+    assert "registry events" in out and "version=4" in out
+    assert "controller stages" in out and "fleet_swap" in out
+    assert "autoscaler decisions" in out
+    assert "before" in out and "after" in out   # p99 exemplar split
+    assert "b (" in out    # the slow after-window request id surfaces
+    rc = tt.main(["incident", "1000", "1001",
+                  str(tmp_path / "t.jsonl")])
+    err = capsys.readouterr().err
+    assert rc == 1 and "empty window" in err
+
+
+# --------------------------------------------------------------------------
+# the ps.trace.sample config key through the predictionService job
+# --------------------------------------------------------------------------
+
+def test_prediction_service_job_ps_trace_sample(tmp_path, mesh_ctx,
+                                                tracer):
+    """``ps.trace.sample=2`` on the sharded fleet replay: answers stay
+    byte-identical to the untraced oracle, half the requests trace end
+    to end (counter + flows), and the trace field never leaks into the
+    output lines."""
+    from avenir_tpu.core.config import Config
+    from avenir_tpu.core.table import encode_rows
+    from avenir_tpu.cli import serving_jobs  # noqa: F401 (registers)
+    from avenir_tpu.cli.jobs import resolve
+    from tests.test_serving import (_train_forest_via_cli,
+                                    forest_batch_predict, raw_rows_of)
+    from tests.test_tree import SCHEMA, make_table
+    reg_dir = tmp_path / "registry"
+    schema_path, trees = _train_forest_via_cli(tmp_path, reg_dir)
+    req_rows = raw_rows_of(make_table(40, seed=33), 40)
+    expect = forest_batch_predict(trees, encode_rows(req_rows, SCHEMA))
+    req_path = tmp_path / "requests.csv"
+    req_path.write_text("\n".join(",".join(r) for r in req_rows) + "\n")
+    job = resolve("predictionService")
+    cfg = Config({
+        "field.delim.regex": ",", "field.delim.out": ",",
+        "ps.model.registry.dir": str(reg_dir),
+        "ps.model.name": "churn",
+        "ps.feature.schema.file.path": str(schema_path),
+        "ps.batch.max.size": "16", "ps.bucket.sizes": "8,64",
+        "ps.transport": "resp", "ps.workers": "2",
+        "ps.broker.shards": "2", "ps.trace.sample": "2",
+    })
+    out_dir = tmp_path / "out_traced"
+    counters = job(cfg, str(req_path), str(out_dir))
+    with open(out_dir / "part-m-00000") as fh:
+        lines = fh.read().splitlines()
+    assert [ln.split(",", 1)[1] for ln in lines] == expect
+    assert counters.get("Serving", "TracedRequests") == 20
+    tracer.flush()
+    evs = T.merge_trace_files([tracer.path])
+    assert T.validate_trace_events(evs) == []
+    assert len([e for e in evs if e.get("ph") == "s"]) == 20
+    assert len([e for e in evs if e.get("ph") == "f"]) == 20
+
+
+def test_job_explicit_zero_overrides_env_twin(tmp_path, mesh_ctx,
+                                              monkeypatch):
+    """An explicit ``ps.trace.sample=0`` must win over an exported
+    AVENIR_TPU_TRACE_SAMPLE — the untraced-baseline replay the docs
+    promise."""
+    from avenir_tpu.core.config import Config
+    from avenir_tpu.cli import serving_jobs  # noqa: F401
+    from avenir_tpu.cli.jobs import resolve
+    from tests.test_serving import _train_forest_via_cli, raw_rows_of
+    from tests.test_tree import make_table
+    reg_dir = tmp_path / "registry"
+    schema_path, _ = _train_forest_via_cli(tmp_path, reg_dir)
+    req_rows = raw_rows_of(make_table(12, seed=33), 12)
+    req_path = tmp_path / "requests.csv"
+    req_path.write_text("\n".join(",".join(r) for r in req_rows) + "\n")
+    RT.set_sample_rate(16)   # stands in for the env twin's import-time set
+    job = resolve("predictionService")
+    counters = job(Config({
+        "field.delim.regex": ",", "field.delim.out": ",",
+        "ps.model.registry.dir": str(reg_dir),
+        "ps.model.name": "churn",
+        "ps.feature.schema.file.path": str(schema_path),
+        "ps.bucket.sizes": "8,64", "ps.transport": "resp",
+        "ps.trace.sample": "0",
+    }), str(req_path), str(tmp_path / "out_off"))
+    assert RT.sample_rate() == 0
+    assert counters.get("Serving", "TracedRequests") == 0
+
+
+# --------------------------------------------------------------------------
+# env twin
+# --------------------------------------------------------------------------
+
+def test_sample_rate_env_twin(monkeypatch):
+    monkeypatch.setenv(RT.SAMPLE_ENV, "8")
+    assert RT.configure_from_env() == 8
+    monkeypatch.setenv(RT.SAMPLE_ENV, "junk")
+    assert RT.configure_from_env() == 8   # unparseable: keep current
+    RT.set_sample_rate(0)

@@ -1,0 +1,85 @@
+"""Span-taxonomy drift guard (ISSUE 15 satellite): every ``span(``/
+``instant(``/``flow(`` name literal in the source tree must appear in
+the TPU_NOTES §27 taxonomy table, and every table row must still exist
+in code — docs and instrumentation can no longer diverge silently.
+
+Runs in the fast tier-1 lane (``obs`` marker)."""
+
+import os
+import re
+
+import pytest
+
+pytestmark = pytest.mark.obs
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_NOTES = os.path.join(_REPO, "docs", "TPU_NOTES.md")
+_SCAN_DIRS = ("avenir_tpu", "tools")
+
+# first string-literal argument of a span()/instant()/flow() call — the
+# taxonomy is literal names by design (a computed name would be
+# un-greppable for operators too)
+_CALL_RE = re.compile(
+    r"\b(?:span|instant|flow)\(\s*[\"']([a-z0-9_.]+)[\"']")
+_TABLE_ROW_RE = re.compile(r"^\s*\|\s*`([a-z0-9_.]+)`\s*\|")
+
+# call sites whose first string argument is deliberately NOT a taxonomy
+# name (empty: every literal is governed)
+_IGNORED = set()
+
+
+def _source_names():
+    names = {}
+    for d in _SCAN_DIRS:
+        for root, _, files in os.walk(os.path.join(_REPO, d)):
+            for fn in files:
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(root, fn)
+                with open(path) as fh:
+                    text = fh.read()
+                for m in _CALL_RE.finditer(text):
+                    names.setdefault(m.group(1), []).append(
+                        os.path.relpath(path, _REPO))
+    # reqtrace emits its flow legs through the FLOW_NAME constant; pick
+    # it up so the flow family is governed by the same table
+    from avenir_tpu.telemetry import reqtrace
+    names.setdefault(reqtrace.FLOW_NAME, []).append(
+        "avenir_tpu/telemetry/reqtrace.py")
+    return names
+
+
+def _taxonomy_names():
+    with open(_NOTES) as fh:
+        text = fh.read()
+    m = re.search(r"<!-- span-taxonomy:begin -->(.*?)"
+                  r"<!-- span-taxonomy:end -->", text, re.DOTALL)
+    assert m, "TPU_NOTES.md lost its span-taxonomy table markers"
+    names = set()
+    for line in m.group(1).splitlines():
+        row = _TABLE_ROW_RE.match(line)
+        if row and row.group(1) != "name":
+            names.add(row.group(1))
+    assert names, "span-taxonomy table parsed empty"
+    return names
+
+
+def test_every_source_literal_is_in_the_taxonomy_table():
+    src = _source_names()
+    table = _taxonomy_names()
+    missing = {n: files for n, files in src.items()
+               if n not in table and n not in _IGNORED}
+    assert not missing, (
+        f"span/instant/flow names used in code but absent from the "
+        f"TPU_NOTES §27 taxonomy table: {missing} — add them to the "
+        f"table (between the span-taxonomy markers)")
+
+
+def test_every_taxonomy_row_still_exists_in_source():
+    src = _source_names()
+    table = _taxonomy_names()
+    stale = sorted(table - set(src))
+    assert not stale, (
+        f"taxonomy table rows with no remaining span/instant/flow call "
+        f"site: {stale} — remove the rows or restore the "
+        f"instrumentation")

@@ -5,6 +5,8 @@
     python tools/tracetool.py merge       -o merged.json <trace.jsonl> [...]
     python tools/tracetool.py chrome-export <trace.jsonl> [-o out.json]
     python tools/tracetool.py counter-diff <a/counters.json> <b/counters.json>
+    python tools/tracetool.py request  <request_id> <trace.jsonl> [...]
+    python tools/tracetool.py incident <t0> <t1> <trace.jsonl> [...]
 
 * **summarize** — per-stage span accounting (count, total/mean ms) plus
   per-lane totals and the observed wall span, for one or many per-process
@@ -25,10 +27,23 @@
 * **counter-diff** — diff two jobs' ``counters.json`` dumps (the file
   cli.run now writes next to every job output): every (group, name) with
   its a/b values and delta — the regression-hunting view over reruns.
+* **request** — reconstruct ONE sampled request's timeline from its flow
+  events (client enqueue -> broker shard -> worker pop -> batch dispatch
+  -> reply push) across however many per-process files hold its legs,
+  plus the component decomposition carried on the flow finish — the
+  "where did request X spend its 400 ms" answer (TPU_NOTES §27).
+* **incident** — a time-window report over the merged traces: autoscaler
+  decisions, broker reconnects/shard deaths, controller stage spans and
+  decisions, registry publish/pin flips, degradation instants, and the
+  sampled-request latency picture (p99 + slowest request ids) before vs
+  after the window midpoint.  ``t0``/``t1`` are epoch seconds (values
+  above 1e12 are taken as epoch microseconds, the trace's native unit).
 
 Exit status: 0 on success, 1 on invalid input (schema problems are
 printed but do not fail merge/export — a torn shard file should not stop
-the operator from looking at the intact ones).
+the operator from looking at the intact ones).  ``request`` with an
+unknown id and ``incident`` with an empty window exit 1 with a named
+message on stderr, same contract as ``summarize``.
 """
 
 from __future__ import annotations
@@ -246,6 +261,181 @@ def cmd_chrome_export(args) -> int:
     return _merge_common([args.trace], out)
 
 
+_FLOW_STEPS = {"s": "enqueue", "t": "step", "f": "reply"}
+
+
+def _flow_events_of(events, rid: str) -> List[dict]:
+    return sorted(
+        (e for e in events
+         if e.get("ph") in ("s", "t", "f") and str(e.get("id")) == rid
+         and isinstance(e.get("ts"), (int, float))),
+        key=lambda e: float(e["ts"]))
+
+
+def _resolve_flow_id(events, rid: str):
+    """Flow ids are namespaced ``<run_id>:<request_id>``; accept either
+    the full form or the bare request id.  Returns ``(flow_id, None)``
+    on a unique match, ``(None, candidates)`` when the bare id matches
+    several runs' flows, ``(None, [])`` when nothing matches."""
+    ids = {str(e.get("id")) for e in events
+           if e.get("ph") in ("s", "t", "f")}
+    if rid in ids:
+        return rid, None
+    cands = sorted(i for i in ids if i.split(":", 1)[-1] == rid)
+    if len(cands) == 1:
+        return cands[0], None
+    return None, cands
+
+
+def _print_request_timeline(events, rid: str) -> None:
+    legs = _flow_events_of(events, rid)
+    t0 = float(legs[0]["ts"])
+    start = next((e for e in legs if e.get("ph") == "s"), None)
+    finish = next((e for e in legs if e.get("ph") == "f"), None)
+    wire_ms = (float(finish["ts"]) - float(start["ts"])) / 1e3 \
+        if start is not None and finish is not None else None
+    head = f"request {rid}: {len(legs)} flow leg(s)"
+    if wire_ms is not None:
+        head += f", wire {wire_ms:.3f} ms (enqueue -> reply push)"
+    print(head)
+    for e in legs:
+        a = e.get("args", {}) or {}
+        step = a.get("step") or _FLOW_STEPS.get(e["ph"], "?")
+        where = " ".join(f"{k}={a[k]}" for k in ("broker", "worker",
+                                                 "host", "rows")
+                         if a.get(k) is not None)
+        print(f"  +{(float(e['ts']) - t0) / 1e3:9.3f} ms  "
+              f"{e['ph']} {step:<10} lane pid {e.get('pid')} "
+              f"tid {e.get('tid')}" + (f"  [{where}]" if where else ""))
+    if finish is not None:
+        a = finish.get("args", {}) or {}
+        comps = [(k[:-3], a[k]) for k in
+                 ("queue_wait_ms", "coalesce_ms", "device_ms",
+                  "reply_ms", "total_ms") if k in a]
+        if comps:
+            print("  components:")
+            for name, ms in comps:
+                print(f"    {name:<12}{float(ms):10.3f} ms")
+            if wire_ms is not None and "total_ms" in a:
+                print(f"    components sum to {float(a['total_ms']):.3f}"
+                      f" ms vs wire {wire_ms:.3f} ms")
+
+
+def cmd_request(args) -> int:
+    events = merge_trace_files(args.traces)
+    rid, cands = _resolve_flow_id(events, str(args.request_id))
+    if rid is None:
+        if cands:
+            print(f"request {args.request_id!r}: ambiguous across "
+                  f"{len(cands)} runs in these traces — pass the full "
+                  f"flow id: {', '.join(cands)}", file=sys.stderr)
+        else:
+            print(f"request {args.request_id!r}: no flow events in "
+                  f"{len(args.traces)} trace file(s) — unknown or "
+                  f"unsampled request id", file=sys.stderr)
+        return 1
+    _print_request_timeline(events, rid)
+    return 0
+
+
+def _parse_epoch_us(raw: str) -> float:
+    t = float(raw)
+    return t if t > 1e12 else t * 1e6
+
+
+def cmd_incident(args) -> int:
+    t0_us, t1_us = _parse_epoch_us(args.t0), _parse_epoch_us(args.t1)
+    if t1_us <= t0_us:
+        t0_us, t1_us = t1_us, t0_us
+    events = merge_trace_files(args.traces)
+
+    def in_window(e) -> bool:
+        ts = e.get("ts")
+        if not isinstance(ts, (int, float)):
+            return False
+        end = float(ts) + float(e.get("dur", 0.0) or 0.0)
+        return end >= t0_us and float(ts) <= t1_us
+
+    window = [e for e in events if in_window(e)]
+    if not window:
+        print(f"incident window [{t0_us / 1e6:.3f}, {t1_us / 1e6:.3f}] "
+              f"(epoch s): no events in {len(args.traces)} trace "
+              f"file(s) — empty window", file=sys.stderr)
+        return 1
+    print(f"incident report: {len(window)} event(s) over "
+          f"{(t1_us - t0_us) / 1e6:.2f}s window")
+
+    def offs(e) -> str:
+        return f"+{(float(e['ts']) - t0_us) / 1e6:8.2f}s"
+
+    # control plane: broker health, controller stages, registry flips,
+    # degradations — the WHY lanes of the incident
+    sections = (
+        ("broker events", ("broker.reconnect", "broker.shard_down")),
+        ("controller decisions", ("controller.decision",)),
+        ("registry events", ("registry.publish", "registry.pin",
+                             "registry.unpin")),
+        ("degradations", ("serving.degraded",)),
+        ("collective stalls", ("allreduce.stall",)),
+    )
+    for title, names in sections:
+        evs = [e for e in window if e.get("ph") == "i"
+               and e.get("name") in names]
+        if not evs:
+            continue
+        print(f"\n{title} ({len(evs)}):")
+        for e in evs:
+            a = e.get("args", {}) or {}
+            detail = " ".join(f"{k}={v}" for k, v in sorted(a.items())
+                              if v is not None)
+            print(f"  {offs(e)} {e['name']}  {detail}")
+    stages = [e for e in window if e.get("ph") == "X"
+              and e.get("name") == "controller.stage"]
+    if stages:
+        print(f"\ncontroller stages ({len(stages)}):")
+        for e in stages:
+            a = e.get("args", {}) or {}
+            print(f"  {offs(e)} {a.get('stage', '?')} "
+                  f"(cycle {a.get('cycle', '?')}) "
+                  f"{float(e.get('dur', 0.0)) / 1e3:.1f} ms")
+    _print_autoscaler_log(window)
+    # the sampled-request latency picture: completed flows (s + f both
+    # inside the merged traces) whose finish lands in the window, split
+    # at the window midpoint — p99 + slowest exemplar ids before/after,
+    # so "did the swap/scale action help" reads off one report
+    starts: Dict[str, float] = {}
+    for e in events:
+        if e.get("ph") == "s" and isinstance(e.get("ts"), (int, float)):
+            starts.setdefault(str(e.get("id")), float(e["ts"]))
+    flows = []
+    for e in window:
+        if e.get("ph") != "f":
+            continue
+        rid = str(e.get("id"))
+        if rid in starts:
+            flows.append((float(e["ts"]),
+                          (float(e["ts"]) - starts[rid]) / 1e3, rid))
+    if flows:
+        mid = (t0_us + t1_us) / 2.0
+
+        def describe(label, part):
+            if not part:
+                print(f"  {label}: no sampled requests")
+                return
+            lats = sorted(ms for _, ms, _ in part)
+            p99 = lats[min(len(lats) - 1, int(0.99 * len(lats)))]
+            worst = sorted(part, key=lambda f: -f[1])[:3]
+            ids = ", ".join(f"{rid} ({ms:.2f} ms)"
+                            for _, ms, rid in worst)
+            print(f"  {label}: {len(part)} request(s), p99 "
+                  f"{p99:.2f} ms; slowest: {ids}")
+        print(f"\nsampled requests ({len(flows)} completed in window, "
+              f"split at window midpoint):")
+        describe("before", [f for f in flows if f[0] < mid])
+        describe("after ", [f for f in flows if f[0] >= mid])
+    return 0
+
+
 def cmd_counter_diff(args) -> int:
     with open(args.a) as fh:
         a = json.load(fh)
@@ -296,6 +486,23 @@ def main(argv=None) -> int:
     p.add_argument("trace")
     p.add_argument("-o", "--output")
     p.set_defaults(fn=cmd_chrome_export)
+
+    p = sub.add_parser("request",
+                       help="one sampled request's cross-process "
+                            "timeline + component decomposition")
+    p.add_argument("request_id")
+    p.add_argument("traces", nargs="+")
+    p.set_defaults(fn=cmd_request)
+
+    p = sub.add_parser("incident",
+                       help="time-window report: autoscaler/broker/"
+                            "controller/registry events + sampled-"
+                            "request p99 exemplars before/after")
+    p.add_argument("t0", help="window start, epoch seconds (or epoch "
+                              "microseconds when > 1e12)")
+    p.add_argument("t1", help="window end, same unit")
+    p.add_argument("traces", nargs="+")
+    p.set_defaults(fn=cmd_incident)
 
     p = sub.add_parser("counter-diff",
                        help="diff two runs' counters.json dumps")
