@@ -48,6 +48,15 @@ coalescing policy, optional RESP wire transport).  Config keys
                             global read).  Sets the PROCESS sampling
                             rate for the job's lifetime, like the env
                             twin.
+  ps.wire.native            auto | on | off (default auto): the native
+                            serving data plane — one C pass per drained
+                            batch for message parse/feature assembly and
+                            reply RESP encode.  ``auto`` uses it when
+                            the toolchain can build the codec and falls
+                            back to pure python otherwise; ``off`` pins
+                            the pure-python path (the differential
+                            baseline).  Env twin AVENIR_TPU_NO_NATIVE=1
+                            disables the build outright.
   redis.request.queue / redis.prediction.queue   resp-queue names
 
 The input file holds one record per line (same layout the model's schema
@@ -81,6 +90,12 @@ def prediction_service(cfg: Config, in_path: str, out_path: str) -> Counters:
     if "ps.trace.sample" in cfg:
         from ..telemetry import reqtrace
         reqtrace.set_sample_rate(cfg.get_int("ps.trace.sample", 0))
+    wire_native = cfg.get("ps.wire.native", "auto")
+    if "ps.wire.native" in cfg:
+        # explicit knob also sets the PROCESS default, so helper
+        # clients built outside the service (the feeder below) follow
+        from ..io import native_wire
+        native_wire.set_mode(wire_native)
     registry = ModelRegistry(cfg.must_get("ps.model.registry.dir"))
     schema = _schema_path(cfg, "ps.feature.schema.file.path") \
         if "ps.feature.schema.file.path" in cfg else None
@@ -159,7 +174,8 @@ def prediction_service(cfg: Config, in_path: str, out_path: str) -> Counters:
                 n_workers=start_workers, config=wire_cfg, warm=warm,
                 delim=od, quantized=quantized,
                 host_label=cfg.get("ps.host.label"),
-                latency_window=cfg.get_int("ps.latency.window", 8192))
+                latency_window=cfg.get_int("ps.latency.window", 8192),
+                wire_native=wire_native)
             fleet.start()
             if autoscale:
                 # sensor connection is its own client (one per thread)
@@ -243,7 +259,8 @@ def prediction_service(cfg: Config, in_path: str, out_path: str) -> Counters:
         return counters
 
     common = dict(policy=policy, counters=counters, timer=timer,
-                  warm=warm, delim=cfg.field_delim_out)
+                  warm=warm, delim=cfg.field_delim_out,
+                  wire_native=wire_native)
     if version:
         svc = PredictionService(pinned_factory(), **common)
         svc.version = version

@@ -405,9 +405,25 @@ def _tokenize(text: str, delim_regex: str) -> List[List[str]]:
     return [split(line) for line in text.splitlines() if line.strip()]
 
 
+# Contract: categorical values are trimmed of exactly these six ASCII
+# whitespace bytes (not unicode whitespace) before vocab lookup, so the
+# native C++ encoders — the CSV ingest (io/csv_native.cpp) and the
+# serving wire assembler (io/serve_native.cpp), both alternate producers
+# of ColumnarTable columns — are bit-identical to this python oracle.
+CATEGORICAL_TRIM = " \t\r\n\v\f"
+
+
 def encode_rows(rows: List[List[str]], schema: FeatureSchema,
                 keep_raw: bool = False) -> ColumnarTable:
-    """Encode tokenized rows into a ColumnarTable per the schema."""
+    """Encode tokenized rows into a ColumnarTable per the schema.
+
+    This is the encode CONTRACT every producer matches: categorical ->
+    ``vocab.get(value.strip(CATEGORICAL_TRIM), -1)`` int32, numeric ->
+    ``float(value)`` float64, everything else a host string column; a
+    short row (any schema ordinal missing) raises.  The native serving
+    wire codec (io/native_wire.WireCodec) assembles the same columns
+    straight from socket bytes and FALLS BACK here whenever it is not
+    bit-certain (tests/test_native_wire_fuzz.py holds the two equal)."""
     n = len(rows)
     columns: Dict[int, np.ndarray] = {}
     str_columns: Dict[int, List[str]] = {}
@@ -415,10 +431,8 @@ def encode_rows(rows: List[List[str]], schema: FeatureSchema,
         o = f.ordinal
         if f.is_categorical:
             vocab = {v: i for i, v in enumerate(f.cardinality or [])}
-            # Contract: categorical values are trimmed of ASCII whitespace
-            # only (not unicode), so the native C++ path is bit-identical.
             col = np.fromiter(
-                (vocab.get(r[o].strip(" \t\r\n\v\f"), -1) for r in rows),
+                (vocab.get(r[o].strip(CATEGORICAL_TRIM), -1) for r in rows),
                 dtype=np.int32, count=n)
             columns[o] = col
         elif f.is_numeric:

@@ -1,0 +1,426 @@
+"""ctypes binding for the native serving wire codec (serve_native.cpp).
+
+The serving data plane's inner loop — RESP message tokenize, per-field
+``float()``, categorical vocab lookup, reply RESP encode — is GIL-bound
+python and was the measured saturation wall (ROADMAP: "kill the Python
+host path per request").  This module compiles ``serve_native.cpp`` on
+first use exactly like :mod:`native_csv` (g++ -O3, cached ``.so`` next
+to the source, rebuilt when the source is newer) and exposes:
+
+* :class:`WireCodec` — one native pass over a drained batch of raw
+  message strings: request ids, trace-field offsets (PR 15 grammar),
+  float-form feature columns written straight into reusable host
+  buffers (bucket-padded tables, no ``encode_rows``), and the int8
+  pre-binned ``predictq`` form decoded row-major so a quantized request
+  is memcpy -> device.
+* :func:`encode_lpush` — the whole variadic ``LPUSH q v1 .. vn`` reply
+  command as ONE RESP buffer for a single ``sendall`` (byte-identical
+  to ``respq._encode_command``).
+
+Everything degrades gracefully.  No compiler / failed build /
+``AVENIR_TPU_NO_NATIVE=1`` -> :func:`get_lib` returns None and callers
+run the retained pure-python path (which is also the differential-fuzz
+oracle, ``tests/test_native_wire_fuzz.py``).  The C side additionally
+returns a FALLBACK verdict on ANY input it is not bit-certain about
+(lexotic numerics python would accept, short rows, malformed trace or
+predictq payloads, embedded separator bytes) and the caller re-runs the
+whole batch through python — so replies and BadRequests counts cannot
+diverge by construction.
+
+The serving-wide switch is :func:`set_mode` (``auto``/``on``/``off``,
+wired to the ``ps.wire.native`` job knob): ``off`` disables the codec
+even when the library built; ``on`` insists (still python-fallback when
+the toolchain is absent, with a one-time warning); ``auto`` uses it
+when available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+import warnings
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.table import ColumnarTable
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "serve_native.cpp")
+_SO = os.path.join(_DIR, "_serve_native.so")
+
+_ABI_VERSION = 2
+NO_NATIVE_ENV = "AVENIR_TPU_NO_NATIVE"
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_failed = False
+
+# message classification (mirrors serve_native.cpp)
+MSG_PREDICT = 0
+MSG_PREDICTQ = 1
+MSG_RELOAD = 2
+MSG_BAD = 3
+
+_KIND_NUMERIC = 1
+_KIND_CATEGORICAL = 2
+
+MODES = ("auto", "on", "off")
+_mode = "auto"
+_warned_fallback = False
+
+
+def set_mode(mode: str) -> None:
+    """Process-wide codec mode (the ``ps.wire.native`` knob)."""
+    global _mode
+    if mode not in MODES:
+        raise ValueError(f"wire codec mode must be one of {MODES}, "
+                         f"got {mode!r}")
+    _mode = mode
+
+
+def get_mode() -> str:
+    return _mode
+
+
+def native_enabled() -> bool:
+    """True when the serving path should use the native codec."""
+    return _mode != "off" and get_lib() is not None
+
+
+def warn_fallback_once(reason: str) -> None:
+    """One warning per process when native serving was wanted but is
+    unavailable — the serving loop must not spam a warning per batch."""
+    global _warned_fallback
+    if _warned_fallback or _mode == "off":
+        return
+    _warned_fallback = True
+    warnings.warn(f"serving: native wire codec unavailable ({reason}); "
+                  "using the pure-python data plane", RuntimeWarning,
+                  stacklevel=2)
+
+
+def _build() -> bool:
+    tmp = f"{_SO}.{os.getpid()}.tmp"  # unique per process: concurrent builds
+    # -march=native: the .so is built on and for this machine; retry
+    # without it for toolchains that reject the flag
+    base = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread"]
+    try:
+        for flags in ([*base, "-march=native"], base):
+            try:
+                subprocess.run([*flags, "-o", tmp, _SRC], check=True,
+                               capture_output=True, timeout=300)
+                os.replace(tmp, _SO)
+                return True
+            except Exception:
+                continue
+        return False
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    lib.awp_abi_version.restype = ctypes.c_int32
+    lib.awp_abi_version.argtypes = []
+    lib.awp_parse.restype = ctypes.c_int32
+    lib.awp_parse.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,   # buf, len, n_msgs
+        ctypes.c_char, ctypes.c_char,                      # sep, delim
+        ctypes.c_int32,                                    # n_cols
+        ctypes.POINTER(ctypes.c_int32),                    # ords
+        ctypes.POINTER(ctypes.c_int32),                    # kinds
+        ctypes.POINTER(ctypes.c_void_p),                   # outs
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_char_p)),   # vocabs
+        ctypes.POINTER(ctypes.c_int32),                    # vocab_ns
+        ctypes.c_int32,                                    # min_fields
+        ctypes.c_int32,                                    # q_width
+        ctypes.POINTER(ctypes.c_int8),                     # qv_out
+        ctypes.POINTER(ctypes.c_int8),                     # qc_out
+        ctypes.POINTER(ctypes.c_uint8),                    # kind_out
+        ctypes.POINTER(ctypes.c_int64),                    # id_start
+        ctypes.POINTER(ctypes.c_int32),                    # id_len
+        ctypes.POINTER(ctypes.c_int64),                    # trace_us
+        ctypes.POINTER(ctypes.c_uint8),                    # trace_sampled
+        ctypes.POINTER(ctypes.c_int64),                    # slot_out
+        ctypes.POINTER(ctypes.c_int64),                    # counts
+        ctypes.POINTER(ctypes.c_uint8),                    # rid_out
+        ctypes.POINTER(ctypes.c_int64),                    # rid_out_len
+    ]
+    # void_p (not char_p): the auto-bytes conversion would orphan the
+    # malloc'd buffer before awp_free_buf could run
+    lib.awp_encode_lpush.restype = ctypes.c_void_p
+    lib.awp_encode_lpush.argtypes = [
+        ctypes.c_char_p, ctypes.c_int32,
+        ctypes.c_char_p, ctypes.c_int64,
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_int64)]
+    lib.awp_free_buf.restype = None
+    lib.awp_free_buf.argtypes = [ctypes.c_void_p]
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The loaded shared library, building it if needed; None if
+    unavailable or disabled via ``AVENIR_TPU_NO_NATIVE``."""
+    global _lib, _lib_failed
+    if os.environ.get(NO_NATIVE_ENV):
+        return None
+    if _lib is not None or _lib_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        try:
+            if (not os.path.exists(_SO)
+                    or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+                if not _build():
+                    _lib_failed = True
+                    return None
+            lib = ctypes.CDLL(_SO)
+            _declare(lib)
+            if lib.awp_abi_version() != _ABI_VERSION:
+                # stale .so from an older binding: rebuild once
+                if not _build():
+                    _lib_failed = True
+                    return None
+                lib = ctypes.CDLL(_SO)
+                _declare(lib)
+                if lib.awp_abi_version() != _ABI_VERSION:
+                    _lib_failed = True
+                    return None
+        except Exception:
+            _lib_failed = True
+            return None
+        _lib = lib
+        return _lib
+
+
+# --------------------------------------------------------------------------
+# reply-side: one RESP buffer per batch
+# --------------------------------------------------------------------------
+
+def encode_lpush(queue: str, values: Sequence[str]) -> Optional[bytes]:
+    """``_encode_command(["LPUSH", queue, *values])`` built natively as one
+    buffer; None when unavailable or when any value embeds the join byte
+    (the caller then uses the python encoder — a mis-split can never
+    reach the wire)."""
+    if _mode == "off" or not values:
+        return None
+    lib = get_lib()
+    if lib is None:
+        return None
+    try:
+        blob = "\n".join(values).encode()
+        q = queue.encode()
+    except UnicodeEncodeError:
+        return None
+    out_len = ctypes.c_int64()
+    ptr = lib.awp_encode_lpush(q, len(q), blob, len(blob), len(values),
+                               ctypes.byref(out_len))
+    if not ptr:
+        return None
+    try:
+        return ctypes.string_at(ptr, out_len.value)
+    finally:
+        lib.awp_free_buf(ptr)
+
+
+# --------------------------------------------------------------------------
+# request-side: batch assembler
+# --------------------------------------------------------------------------
+
+class ParsedBatch:
+    """One native pass over a drained batch.  Per-message arrays are VIEWS
+    of the codec's reusable buffers — valid until the codec's next
+    ``parse`` (process_batch is synchronous through readback, so one
+    codec per service is safe).  ``prepared`` is the float-form
+    bucket-padded table list (same shape discipline as
+    ``Predictor._bucketed_tables``); ``qv``/``qc`` are the int8
+    pre-binned rows in slot order."""
+
+    __slots__ = ("n_msgs", "kind", "slot", "rids", "trace_us",
+                 "trace_sampled", "n_float", "n_q", "n_reload",
+                 "prepared", "qv", "qc")
+
+    def __init__(self, n_msgs, kind, slot, rids, trace_us, trace_sampled,
+                 n_float, n_q, n_reload, prepared, qv, qc):
+        self.n_msgs = n_msgs
+        self.kind = kind
+        self.slot = slot
+        self.rids = rids
+        self.trace_us = trace_us
+        self.trace_sampled = trace_sampled
+        self.n_float = n_float
+        self.n_q = n_q
+        self.n_reload = n_reload
+        self.prepared = prepared
+        self.qv = qv
+        self.qc = qc
+
+
+class WireCodec:
+    """Reusable native batch assembler bound to one (schema, delim,
+    buckets, q_width).  ``parse(messages)`` returns a :class:`ParsedBatch`
+    or None — None means "run this batch through the python path" (codec
+    unavailable, unsupported delimiter, or the C side returned its
+    fallback verdict); it is never an error."""
+
+    def __init__(self, schema, *, delim: str = ",",
+                 buckets: Sequence[int] = (1, 8, 64, 512),
+                 q_width: int = 0):
+        self.schema = schema
+        self.delim = delim
+        self.buckets = tuple(buckets)
+        self.q_width = int(q_width)
+        # native needs a literal single-byte delimiter that cannot collide
+        # with the message join byte
+        self.usable = (len(delim) == 1 and delim != "\n"
+                       and len(delim.encode()) == 1)
+        self._delim_b = delim.encode() if self.usable else b","
+
+        # ---- per-schema spec arrays (built once) ----
+        fields = [f for f in schema.fields
+                  if f.is_categorical or f.is_numeric]
+        self._n_cols = len(fields)
+        self._ords = (ctypes.c_int32 * self._n_cols)(
+            *[f.ordinal for f in fields])
+        self._kinds = (ctypes.c_int32 * self._n_cols)()
+        self._vocabs = (ctypes.POINTER(ctypes.c_char_p) * self._n_cols)()
+        self._vocab_ns = (ctypes.c_int32 * self._n_cols)()
+        self._keep_alive = []  # encoded vocab arrays must outlive parses
+        self._field_kinds = []
+        for i, f in enumerate(fields):
+            if f.is_categorical:
+                self._kinds[i] = _KIND_CATEGORICAL
+                enc = [v.encode() for v in (f.cardinality or [])]
+                arr = (ctypes.c_char_p * len(enc))(*enc)
+                self._keep_alive.append((enc, arr))
+                self._vocabs[i] = arr
+                self._vocab_ns[i] = len(enc)
+                self._field_kinds.append("cat")
+            else:
+                self._kinds[i] = _KIND_NUMERIC
+                self._field_kinds.append("num")
+        # python encode_rows indexes r[o] for EVERY schema field (strings
+        # included) and raises on a short row — the native path must
+        # fall back on exactly the same rows
+        self._min_fields = (max(f.ordinal for f in schema.fields) + 1
+                            if schema.fields else 0)
+        self._field_ordinals = [f.ordinal for f in fields]
+
+        # ---- reusable output buffers (grown on demand) ----
+        self._cap = 0
+        self._cols: List[np.ndarray] = []
+        self._outs = (ctypes.c_void_p * max(self._n_cols, 1))()
+        self._qv = self._qc = None
+        self._kind = self._id_start = self._id_len = None
+        self._trace_us = self._trace_sampled = self._slot = None
+        self._counts = (ctypes.c_int64 * 3)()
+
+    def _ensure_capacity(self, n: int) -> None:
+        if n <= self._cap:
+            return
+        cap = max(n, 2 * self._cap, 64)
+        self._cols = [
+            np.empty(cap, dtype=np.int32 if k == "cat" else np.float64)
+            for k in self._field_kinds]
+        for i, col in enumerate(self._cols):
+            self._outs[i] = col.ctypes.data
+        if self.q_width > 0:
+            self._qv = np.empty((cap, self.q_width), dtype=np.int8)
+            self._qc = np.empty((cap, self.q_width), dtype=np.int8)
+        self._kind = np.empty(cap, dtype=np.uint8)
+        self._id_start = np.empty(cap, dtype=np.int64)
+        self._id_len = np.empty(cap, dtype=np.int32)
+        self._trace_us = np.empty(cap, dtype=np.int64)
+        self._trace_sampled = np.empty(cap, dtype=np.uint8)
+        self._slot = np.empty(cap, dtype=np.int64)
+        self._cap = cap
+
+    def _bucket_size(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    def _float_prepared(self, n_float: int):
+        """Slice the filled columns into bucket-padded (table, n) chunks —
+        the exact ``_bucketed_tables`` shape discipline.  Full chunks are
+        zero-copy views frozen by reference (``writeable=False``): the
+        backing buffers are the codec's and are overwritten by the next
+        parse, so nothing downstream may retain OR mutate them."""
+        prepared = []
+        top = self.buckets[-1]
+        for s in range(0, n_float, top):
+            n = min(top, n_float - s)
+            b = self._bucket_size(n)
+            columns: Dict[int, np.ndarray] = {}
+            for o, col in zip(self._field_ordinals, self._cols):
+                if b == n:
+                    v = col[s:s + n]
+                else:  # tail chunk: pad with copies of its last row
+                    v = np.empty(b, dtype=col.dtype)
+                    v[:n] = col[s:s + n]
+                    v[n:] = col[s + n - 1]
+                v.flags.writeable = False
+                columns[o] = v
+            prepared.append((ColumnarTable(schema=self.schema, n_rows=b,
+                                           columns=columns,
+                                           str_columns={}), n))
+        return prepared
+
+    def parse(self, messages: Sequence[str]) -> Optional[ParsedBatch]:
+        if not self.usable or _mode == "off" or not messages:
+            return None
+        lib = get_lib()
+        if lib is None:
+            return None
+        try:
+            blob = "\n".join(messages).encode()
+        except UnicodeEncodeError:
+            return None
+        n = len(messages)
+        self._ensure_capacity(n)
+        as_ptr = lambda a, t: a.ctypes.data_as(ctypes.POINTER(t))
+        qw = self.q_width
+        # rids come back packed '\n'-terminated (one entry per message, ""
+        # for reload/bad) so one decode+split replaces n slice decodes;
+        # no rid can contain '\n' — the sep count validation forbids it
+        rid_buf = np.empty(len(blob) + n + 1, dtype=np.uint8)
+        rid_len = ctypes.c_int64(0)
+        rc = lib.awp_parse(
+            blob, len(blob), n, b"\n", self._delim_b,
+            self._n_cols, self._ords, self._kinds, self._outs,
+            self._vocabs, self._vocab_ns, self._min_fields,
+            qw,
+            as_ptr(self._qv, ctypes.c_int8) if qw > 0 else None,
+            as_ptr(self._qc, ctypes.c_int8) if qw > 0 else None,
+            as_ptr(self._kind, ctypes.c_uint8),
+            as_ptr(self._id_start, ctypes.c_int64),
+            as_ptr(self._id_len, ctypes.c_int32),
+            as_ptr(self._trace_us, ctypes.c_int64),
+            as_ptr(self._trace_sampled, ctypes.c_uint8),
+            as_ptr(self._slot, ctypes.c_int64),
+            self._counts,
+            as_ptr(rid_buf, ctypes.c_uint8), ctypes.byref(rid_len))
+        if rc != 0:  # FALLBACK or internal error: python path, whole batch
+            return None
+        n_float, n_q, n_reload = (int(self._counts[0]),
+                                  int(self._counts[1]),
+                                  int(self._counts[2]))
+        kind = self._kind[:n]
+        rids = rid_buf[:rid_len.value].tobytes().decode()[:-1].split("\n")
+        prepared = self._float_prepared(n_float) if n_float else []
+        qv = qc = None
+        if n_q and qw > 0:
+            qv = self._qv[:n_q]
+            qc = self._qc[:n_q]
+            qv.flags.writeable = False
+            qc.flags.writeable = False
+        return ParsedBatch(n, kind, self._slot[:n], rids,
+                           self._trace_us[:n], self._trace_sampled[:n],
+                           n_float, n_q, n_reload, prepared, qv, qc)

@@ -42,6 +42,7 @@ from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 from ..telemetry import instant
 from ..telemetry import reqtrace
+from . import native_wire
 
 
 # ---------------------------------------------------------------------------
@@ -425,7 +426,13 @@ class RespClient:
         self._reconnect_once(exc)
 
     def _call(self, *args: str):
-        payload = _encode_command(list(args))
+        return self._call_raw(_encode_command(list(args)))
+
+    def _call_raw(self, payload: bytes):
+        """One command exchange from an already-encoded RESP buffer —
+        the native reply encoder (io/native_wire.encode_lpush) lands
+        here so a whole batch of replies is ONE sendall; same
+        reconnect/re-issue policy as :meth:`_call`."""
         try:
             self._sock.sendall(payload)
             return _read_reply(self._rf)
@@ -452,13 +459,23 @@ class RespClient:
         to one — the producer half of the wire micro-batching).  Returns
         the queue length after the push; no-op 0 on an empty list.
         Predict messages pass the head-sampling stamp (one global read
-        when ``ps.trace.sample`` is off)."""
+        when ``ps.trace.sample`` is off).
+
+        The command buffer is built by the native codec when available
+        (one C pass over the batch instead of a python loop of
+        per-value bulk-string encodes) — byte-identical to
+        ``_encode_command`` by the golden/fuzz contract, and None from
+        the encoder (no toolchain, embedded join byte) falls back to
+        the python encode of the SAME values."""
         if not values:
             return 0
         if self._stamp:
             values = reqtrace.stamp_values(
                 values, delim=self._delim,
                 broker=f"{self.host}:{self.port}")
+        payload = native_wire.encode_lpush(queue, values)
+        if payload is not None:
+            return int(self._call_raw(payload))
         return int(self._call("LPUSH", queue, *values))
 
     def rpop(self, queue: str) -> Optional[str]:

@@ -108,7 +108,8 @@ class ServingFleet:
                  idle_sleep_s: float = 0.002,
                  max_idle_sleep_s: float = 0.05,
                  quantized: bool = False,
-                 host_label: Optional[str] = None):
+                 host_label: Optional[str] = None,
+                 wire_native: str = "auto"):
         if predictor_factory is None and (registry is None
                                           or model_name is None):
             raise ValueError("need registry= + model_name=, or "
@@ -127,6 +128,11 @@ class ServingFleet:
         self.delim = delim
         self._metrics = metrics
         self._quantized = bool(quantized)
+        # ps.wire.native: every worker service shares one mode (the
+        # native batch assembler is per-service state; the mode is
+        # config) — fleet _ingest keeps its python parse, the codec
+        # rides inside each worker's process_batch
+        self._wire_native = wire_native
         self._latency_window = int(latency_window)
         self.idle_sleep_s = float(idle_sleep_s)
         self.max_idle_sleep_s = float(max_idle_sleep_s)
@@ -160,7 +166,8 @@ class ServingFleet:
                       host_label=self.host_label,
                       counters=Counters(),
                       timer=StepTimer(keep_samples=self._latency_window),
-                      metrics=self._metrics)
+                      metrics=self._metrics,
+                      wire_native=self._wire_native)
         if self.predictor_factory is not None:
             return PredictionService(self.predictor_factory(), **common)
         return PredictionService(registry=self.registry,

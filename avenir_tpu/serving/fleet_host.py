@@ -81,6 +81,13 @@ def _parse_args(argv):
                          "index 0).  Default: derived from the pid, so "
                          "two hosts launched without it never "
                          "interleave one trace file")
+    ap.add_argument("--wire-native", default="auto",
+                    choices=("auto", "on", "off"),
+                    help="native serving data plane (the ps.wire.native "
+                         "knob): one C pass per drained batch for "
+                         "message parse/assembly and reply RESP encode; "
+                         "'auto' uses it when the toolchain can build "
+                         "it, 'off' pins the pure-python path")
     ap.add_argument("--stats-out", default=None)
     ap.add_argument("--ready-file", default=None,
                     help="touched once the fleet is draining — a parent "
@@ -139,11 +146,14 @@ def main(argv=None) -> int:
         msrv = MetricsServer(metrics, port=args.metrics_port,
                              host=args.metrics_host).start()
         print(f"fleet_host: /metrics on {msrv.url}", file=sys.stderr)
+    from ..io import native_wire
+    native_wire.set_mode(args.wire_native)
     fleet = ServingFleet(
         registry, args.model,
         buckets=tuple(int(b) for b in args.buckets.split(",")),
         policy=policy, n_workers=n_workers, config=wire_cfg,
-        host_label=args.host_label, metrics=metrics)
+        host_label=args.host_label, metrics=metrics,
+        wire_native=args.wire_native)
     fleet.start()
     scaler = sensor = None
     if scale is not None:

@@ -109,7 +109,10 @@ class Predictor:
         small-model predict).  The returned value is opaque; hand it to
         :meth:`predict_prepared` on the SAME predictor instance (a
         hot-swap between the two must finish the batch on the old
-        model)."""
+        model).  The native wire codec (io/native_wire.WireCodec) is the
+        alternate producer of the same (table, n_valid) chunk list —
+        assembled straight from socket bytes, bit-identical to this
+        path by the differential fuzz contract."""
         return list(self._bucketed_tables(rows)) if rows else []
 
     def predict_prepared(self, prepared) -> List[Optional[str]]:
@@ -127,6 +130,22 @@ class Predictor:
         if not rows:
             return []
         return self.predict_prepared(self.prepare_rows(rows))
+
+    # ---- pre-binned int8 wire form (predictq) ----
+    @property
+    def supports_prebinned(self) -> bool:
+        """True when :meth:`predict_prebinned` can serve the int8
+        ``predictq`` wire form (quantized forests only)."""
+        return False
+
+    @property
+    def prebinned_width(self) -> int:
+        """F of the (n, F) int8 pre-binned row — 0 when unsupported."""
+        return 0
+
+    def predict_prebinned(self, qv, qc) -> List[Optional[str]]:
+        raise NotImplementedError(
+            f"{type(self).__name__} has no pre-binned serving path")
 
     # ---- subclass contract ----
     def _predict_table(self, table: ColumnarTable) -> List[Optional[str]]:
@@ -277,6 +296,52 @@ class ForestPredictor(Predictor):
     def _predict_table(self, table: ColumnarTable) -> List[Optional[str]]:
         return self.readback_dispatched(
             self.dispatch_prepared([(table, table.n_rows)]))
+
+    # ---- pre-binned int8 wire form (predictq) ----
+    @property
+    def supports_prebinned(self) -> bool:
+        return self._core_q is not None
+
+    @property
+    def prebinned_width(self) -> int:
+        if self._core_q is None:
+            return 0
+        return len(self.models[0].matrix.feat_ordinals)
+
+    def predict_prebinned(self, qv, qc) -> List[Optional[str]]:
+        """Serve client-pre-binned int8 rows (the ``predictq`` wire
+        form): the entire host encode — tokenize, ``float()``,
+        ``quantize_rows`` — is already done on the client, so a request
+        is memcpy -> device.  Same bucket/pad shape discipline as
+        ``_bucketed_tables`` (the warm() pass over the quantized core
+        pre-compiled these shapes)."""
+        if self._core_q is None:
+            raise NotImplementedError(
+                "predict_prebinned needs a quantized sidecar "
+                "(ps.quantized)")
+        from ..utils.tracing import note_dispatch, note_h2d
+        from ..ops.pallas.dispatch import note_backend
+        qv = np.asarray(qv, np.int8)
+        qc = np.asarray(qc, np.int8)
+        n_all = qv.shape[0]
+        staged = []
+        top = self.buckets[-1]
+        for s in range(0, n_all, top):
+            n = min(top, n_all - s)
+            b = self.bucket_size(n)
+            cv, cc = qv[s:s + n], qc[s:s + n]
+            if b != n:  # pad with copies of the chunk's last row
+                cv = np.concatenate([cv, np.repeat(cv[-1:], b - n, 0)])
+                cc = np.concatenate([cc, np.repeat(cc[-1:], b - n, 0)])
+            note_h2d(cv.nbytes + cc.nbytes, transfers=2)
+            note_dispatch(site="serve.predict")
+            note_backend("serve.predict", "quantized")
+            staged.append((self._core_q(jnp.asarray(cv),
+                                        jnp.asarray(cc)), n))
+        out: List[Optional[str]] = []
+        for v, n in staged:
+            out.extend(list(self.ensemble._lut[np.asarray(v)])[:n])
+        return out
 
 
 class BayesPredictor(Predictor):

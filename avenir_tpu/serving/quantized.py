@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import io as _io
 import json
+import re
 import warnings
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -294,6 +295,68 @@ def publish_quantized(registry, name: str, version: int, models,
     registry.add_sidecar(name, version, qf.to_sidecar())
     return {"mismatch": float(mismatch), "budget": float(budget),
             "n_sample": float(n)}
+
+
+# --------------------------------------------------------------------------
+# the int8 wire form: client-side pre-binning (PR 16)
+# --------------------------------------------------------------------------
+#
+# A client that holds the published grid (sidecar ``scale``/``fmin``) can
+# quantize request rows ITSELF and ship the int8 form:
+#
+#   predictq,<rid>[,t=<us>:<0|1>],<F>,<qv_0..qv_{F-1}>,<qc_0..qc_{F-1}>
+#
+# where F = len(feat_ordinals) of the serving forest and every qv/qc
+# token is a CANONICAL signed decimal int8: ``0`` or ``-?[1-9][0-9]{0,2}``
+# in [-128, 127] — no '+', no '-0', no leading zeros, so one byte pattern
+# per value and the native parser (io/serve_native.cpp) and this python
+# codec can never disagree on a valid payload.  The width echo <F> lets
+# the server reject a grid-shape mismatch before touching the payload.
+# The layout is pinned by tests/test_golden_bytes.py (wire flow).
+
+QUANTIZED_VERB = "predictq"
+
+_Q_INT_RE = re.compile(r"^(?:0|-?[1-9][0-9]{0,2})$")
+_WIDTH_RE = re.compile(r"^(?:0|[1-9][0-9]*)$")
+
+
+def wire_encode_rows(rids: Sequence[str], qv: np.ndarray, qc: np.ndarray,
+                     *, delim: str = ",") -> List[str]:
+    """Encode pre-binned rows (``quantize_rows`` output) as predictq wire
+    messages, one per request id — the canonical on-wire layout."""
+    qv = np.asarray(qv, np.int8)
+    qc = np.asarray(qc, np.int8)
+    width = qv.shape[1]
+    out = []
+    for rid, vrow, crow in zip(rids, qv, qc):
+        parts = [QUANTIZED_VERB, str(rid), str(width)]
+        parts.extend(str(int(x)) for x in vrow)
+        parts.extend(str(int(x)) for x in crow)
+        out.append(delim.join(parts))
+    return out
+
+
+def wire_decode_tokens(tokens: Sequence[str], width: int
+                       ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Strict decode of a predictq payload (the row fields after
+    rid/trace): ``(qv, qc)`` int8 arrays, or None when the payload is
+    malformed — wrong arity, width-echo mismatch, or any non-canonical
+    token.  This python decoder is the semantics oracle the native
+    parser defers to (it FALLS BACK rather than guess)."""
+    if len(tokens) != 1 + 2 * width:
+        return None
+    if _WIDTH_RE.match(tokens[0]) is None or int(tokens[0]) != width:
+        return None
+    vals = []
+    for tok in tokens[1:]:
+        if _Q_INT_RE.match(tok) is None:
+            return None
+        v = int(tok)
+        if not -128 <= v <= 127:
+            return None
+        vals.append(v)
+    return (np.asarray(vals[:width], np.int8),
+            np.asarray(vals[width:], np.int8))
 
 
 def load_quantized(registry, name: str,

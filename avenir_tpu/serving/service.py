@@ -75,16 +75,21 @@ from __future__ import annotations
 import queue
 import threading
 import time
+import weakref
 from concurrent.futures import Future
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence
 
+import numpy as np
+
 from ..core.faults import with_retry
 from ..core.metrics import Counters
+from ..io import native_wire
 from ..telemetry import get_default_registry, instant, span
 from ..telemetry import reqtrace
 from ..utils.tracing import StepTimer
 from .predictor import AMBIGUOUS, DEFAULT_BUCKETS, Predictor, make_predictor
+from .quantized import QUANTIZED_VERB, wire_decode_tokens
 from .registry import ModelRegistry
 
 # adaptive-window hysteresis band: shrink above SHRINK*slo, grow back
@@ -95,6 +100,12 @@ from .registry import ModelRegistry
 # the window cannot control (scheduler stalls, allocator hiccups)
 _SLO_SHRINK_FRACTION = 0.6
 _SLO_GROW_FRACTION = 0.35
+
+# one warning per affected batch, identical text on both data planes —
+# the differential fuzz compares recorded warnings too
+_NO_PREBINNED_WARNING = (
+    "serving: predictq message(s) but the served model has no quantized "
+    "sidecar (ps.quantized); replying error")
 
 
 @dataclass
@@ -202,9 +213,14 @@ class PredictionService:
                  host_label: Optional[str] = None,
                  monitor=None,
                  metrics=None,
-                 quantized: bool = False):
+                 quantized: bool = False,
+                 wire_native: str = "auto"):
         if predictor is None and (registry is None or model_name is None):
             raise ValueError("need a predictor, or registry= + model_name=")
+        if wire_native not in native_wire.MODES:
+            raise ValueError(
+                f"wire_native must be one of {native_wire.MODES}, "
+                f"got {wire_native!r}")
         self.registry = registry
         self.model_name = model_name
         self._schema = schema
@@ -273,6 +289,13 @@ class PredictionService:
         # requests still trace, just no histogram/exemplar landing spot
         self._comp_binding = None
         self._comp_lock = threading.Lock()
+        # ps.wire.native: the native data-plane switch for THIS service
+        # ("auto" defers to the process-wide native_wire.set_mode knob).
+        # The codec is built lazily per predictor (schema/buckets/
+        # pre-binned width are the predictor's) and rebuilt on hot-swap.
+        self.wire_native = wire_native
+        self._wire_codec = None
+        self._wire_codec_pred = None   # weakref to the codec's predictor
         reg = metrics if metrics is not None else get_default_registry()
         if reg is not None:
             self.bind_metrics(reg)
@@ -490,8 +513,15 @@ class PredictionService:
         ``process_batch``) AFTER the reply actually pushed."""
         if ctx.t_reply_us is None:
             ctx.t_reply_us = reqtrace.now_us()
-        comps = ctx.components_ms()
         self.counters.increment("Serving", "TracedRequests")
+        # unlocked peek: with no metrics binding and no tracer installed
+        # the decomposition has no consumer — skip building it.  A stale
+        # non-None read just falls through to the locked re-check below;
+        # a None read after unbind is exactly the skip the unbind wants.
+        if self._comp_binding is None \
+                and reqtrace.current_tracer() is None:
+            return
+        comps = ctx.components_ms()
         # observe under _comp_lock: once _unbind_metrics cleared the
         # binding (same lock) and swept the series, no straggler may
         # observe the dead series back into existence
@@ -622,22 +652,59 @@ class PredictionService:
         queue alongside it (they cannot be re-queued).  A 'reload' in the
         drain applies AFTER the batch is answered: the swap (and its
         multi-bucket warm-up compiles) must not stall requests already
-        accepted, so the new model takes effect from the next batch."""
+        accepted, so the new model takes effect from the next batch.
+
+        Two message forms are served: the float ``predict`` form and the
+        int8 pre-binned ``predictq`` form (serving/quantized.py wire
+        codec), the latter only when the served model carries a
+        quantized sidecar — without one the request is answered
+        ``error`` and counted (the grid lives with the model; there is
+        nothing to decode against).
+
+        The batch runs through the native wire codec
+        (io/native_wire.WireCodec) when available and enabled
+        (``ps.wire.native``): one C pass classifies + assembles the
+        whole drain straight into reusable host buffers, no per-request
+        python tokenize/float().  Any input the native pass is not
+        bit-certain about re-runs the WHOLE batch through the python
+        path below, so replies and BadRequests counts are identical by
+        construction (tests/test_native_wire_fuzz.py)."""
+        if not messages:
+            return []
+        with self._swap_lock:
+            pred = self.predictor
+        codec = self._wire_codec_for(pred)
+        if codec is not None:
+            out = self._process_batch_native(pred, codec, messages)
+            if out is not None:
+                return out
+        return self._process_batch_python(pred, messages)
+
+    def _process_batch_python(self, pred, messages: List[str]) -> List[str]:
+        """The retained pure-python data plane — the semantics oracle
+        the native codec defers to, and the serving path when the
+        toolchain is unavailable or a drift monitor needs token rows."""
         import warnings
-        ids: List[str] = []
+        # (form, rid, slot): "f" float row, "q" decoded pre-binned row,
+        # "e" error reply (unservable/malformed predictq) — arrival order
+        entries: List[tuple] = []
         rows: List[List[str]] = []
+        q_rows: List[tuple] = []
         traced = None
         reload_requested = False
+        q_width = pred.prebinned_width \
+            if getattr(pred, "supports_prebinned", False) else 0
+        warned_no_prebinned = False
         with span("serve.assemble", cat="serving", rows=len(messages)):
             for message in messages:
                 parts = message.split(self.delim)
-                if parts[0] == "predict" and len(parts) >= 3:
+                is_predict = parts[0] == "predict"
+                if (is_predict or parts[0] == QUANTIZED_VERB) \
+                        and len(parts) >= 3:
                     # the optional wire trace field (ISSUE 15) is
                     # stripped whether sampled or not; absent = the old
                     # message layout, byte for byte
                     rid, row, ctx = reqtrace.split_predict(parts)
-                    ids.append(rid)
-                    rows.append(row)
                     if ctx is not None:
                         ctx.t_pop_us = reqtrace.now_us()
                         reqtrace.emit_flow("t", rid, "pop",
@@ -645,28 +712,63 @@ class PredictionService:
                         if traced is None:
                             traced = []
                         traced.append(ctx)
+                    if is_predict:
+                        entries.append(("f", rid, len(rows)))
+                        rows.append(row)
+                    elif q_width <= 0:
+                        self.counters.increment("Serving", "BadRequests")
+                        if not warned_no_prebinned:
+                            warned_no_prebinned = True
+                            warnings.warn(_NO_PREBINNED_WARNING,
+                                          RuntimeWarning)
+                        entries.append(("e", rid, -1))
+                    else:
+                        decoded = wire_decode_tokens(row, q_width)
+                        if decoded is None:
+                            self.counters.increment("Serving",
+                                                    "BadRequests")
+                            warnings.warn(
+                                f"serving: malformed predictq payload "
+                                f"{message!r}", RuntimeWarning)
+                            entries.append(("e", rid, -1))
+                        else:
+                            entries.append(("q", rid, len(q_rows)))
+                            q_rows.append(decoded)
                 elif parts[0] == "reload":
                     reload_requested = True
                 else:
                     self.counters.increment("Serving", "BadRequests")
                     warnings.warn(f"serving: dropping malformed message "
                                   f"{message!r}", RuntimeWarning)
-        if reload_requested and not rows:
+        if reload_requested and not entries:
             self.refresh()
             return []
-        if not rows:
+        if not entries:
             return []
         if traced:
-            _stamp_dispatch(traced, len(rows))
+            _stamp_dispatch(traced, len(rows) + len(q_rows))
         t0 = time.perf_counter()
-        results = self._predict_isolating(rows)
+        results_f = self._predict_isolating(rows, pred=pred) if rows \
+            else []
+        if q_rows:
+            results_q = self._serve_prebinned(
+                pred, np.stack([v for v, _ in q_rows]),
+                np.stack([c for _, c in q_rows]))
+        else:
+            results_q = []
         dt = time.perf_counter() - t0
         if traced:
             _stamp_done(traced)
-        with span("serve.reply", cat="serving", rows=len(rows)):
+        with span("serve.reply", cat="serving", rows=len(entries)):
+            self._record_request_times(traced, dt)
             out = []
-            for rid, (status, val) in zip(ids, results):
-                self.timer.record("serve.request", dt)
+            for form, rid, slot in entries:
+                if form == "f":
+                    status, val = results_f[slot]
+                elif form == "q":
+                    status, val = results_q[slot]
+                else:
+                    status, val = "err", None
                 lab = val if status == "ok" else self.error_label
                 out.append(f"{rid}{self.delim}{lab}")
         if traced:
@@ -678,6 +780,226 @@ class PredictionService:
         if reload_requested:
             self.refresh()
         return out
+
+    def _process_batch_native(self, pred, codec,
+                              messages: List[str]) -> Optional[List[str]]:
+        """The native data plane: the batch was already classified and
+        assembled by ONE C pass (``codec.parse``) — what remains in
+        python is per-message bookkeeping (counters, trace contexts) and
+        the reply join.  Returns None when the codec declined the batch
+        (its fallback verdict): the caller re-runs the python path on
+        the SAME messages, which is where all only-python-can-judge
+        inputs (lexotic numerics, malformed payloads) are decided."""
+        import warnings
+        pb = codec.parse(messages)
+        if pb is None:
+            return None
+        traced = None
+        n_replies = pb.n_float + pb.n_q
+        with span("serve.assemble", cat="serving", rows=len(messages),
+                  native=1):
+            # per-message python work only where the batch actually has
+            # exceptions: the all-clean saturation case (every message a
+            # decoded predict/predictq, nothing traced) skips the scans
+            # the C pass already did
+            if n_replies + pb.n_reload != pb.n_msgs:
+                for i in np.nonzero(pb.kind == native_wire.MSG_BAD)[0]:
+                    self.counters.increment("Serving", "BadRequests")
+                    warnings.warn(f"serving: dropping malformed message "
+                                  f"{messages[i]!r}", RuntimeWarning)
+                unsup = np.nonzero((pb.kind == native_wire.MSG_PREDICTQ)
+                                   & (pb.slot < 0))[0]
+                if len(unsup):
+                    # no quantized sidecar on the served model: answered
+                    # error, never decoded — same as the python path
+                    n_replies += len(unsup)
+                    self.counters.increment("Serving", "BadRequests",
+                                            len(unsup))
+                    warnings.warn(_NO_PREBINNED_WARNING, RuntimeWarning)
+            if pb.trace_sampled.any():
+                traced = []
+                for i in np.nonzero(pb.trace_sampled)[0]:
+                    ctx = reqtrace.RequestTrace(pb.rids[i],
+                                                float(pb.trace_us[i]),
+                                                wire=True)
+                    ctx.t_pop_us = reqtrace.now_us()
+                    reqtrace.emit_flow("t", ctx.rid, "pop",
+                                       ts_us=ctx.t_pop_us)
+                    traced.append(ctx)
+        if pb.n_reload and n_replies == 0:
+            self.refresh()
+            return []
+        if n_replies == 0:
+            return []
+        if traced:
+            _stamp_dispatch(traced, pb.n_float + pb.n_q)
+        t0 = time.perf_counter()
+        results_f = self._serve_prepared_native(
+            pred, pb.prepared, pb.n_float,
+            lambda: self._retokenize_float_rows(messages, pb)) \
+            if pb.n_float else []
+        results_q = self._serve_prebinned(pred, pb.qv, pb.qc) \
+            if pb.n_q else []
+        dt = time.perf_counter() - t0
+        if traced:
+            _stamp_done(traced)
+        with span("serve.reply", cat="serving", rows=n_replies, native=1):
+            self._record_request_times(traced, dt)
+            delim = self.delim
+            err = self.error_label
+            labs_f = [v if s == "ok" else err for s, v in results_f]
+            if pb.n_float == pb.n_msgs:
+                # saturation fast path: all-float batch, slots ARE the
+                # arrival order — one join, no per-message dispatch
+                out = [f"{r}{delim}{lab}"
+                       for r, lab in zip(pb.rids, labs_f)]
+            else:
+                labs_q = [v if s == "ok" else err for s, v in results_q]
+                out = []
+                for i in range(pb.n_msgs):
+                    k = pb.kind[i]
+                    if k == native_wire.MSG_PREDICT:
+                        lab = labs_f[pb.slot[i]]
+                    elif k == native_wire.MSG_PREDICTQ:
+                        s = pb.slot[i]
+                        lab = labs_q[s] if s >= 0 else err
+                    else:
+                        continue
+                    out.append(f"{pb.rids[i]}{delim}{lab}")
+        if traced:
+            for ctx in traced:
+                self.record_request_trace(ctx)
+        if pb.n_reload:
+            self.refresh()
+        return out
+
+    def _retokenize_float_rows(self, messages: List[str], pb):
+        """Token rows (slot order) for the native path's per-row
+        isolation — built ONLY when a whole-batch predict failed, so
+        the common path never pays a python tokenize."""
+        rows = []
+        for i in range(pb.n_msgs):
+            if pb.kind[i] == native_wire.MSG_PREDICT:
+                _, row, _ = reqtrace.split_predict(
+                    messages[i].split(self.delim))
+                rows.append(row)
+        return rows
+
+    def _serve_prepared_native(self, pred, prepared, n_rows: int,
+                               row_thunk):
+        """``_predict_isolating`` for natively-assembled float batches:
+        same counters/timer/span accounting, but the tokenized rows are
+        materialized (``row_thunk``) only if the whole-batch predict
+        fails and per-row isolation must run — parse validity was
+        already proven by the codec, so a failure here is device-side."""
+        import warnings
+        with self._inflight_lock:
+            self._inflight += n_rows
+        try:
+            t0 = time.perf_counter()
+            try:
+                with span("serve.predict", cat="serving", rows=n_rows):
+                    out = with_retry(
+                        lambda: pred.predict_prepared(prepared),
+                        what="serving predict batch")
+                self.timer.record("serve.batch", time.perf_counter() - t0)
+                self.counters.increment("Serving", "Requests", n_rows)
+                self.counters.increment("Serving", "Batches")
+                amb = self.ambiguous_label
+                return [("ok", p if p is not None else amb) for p in out]
+            except Exception as exc:
+                warnings.warn(
+                    f"serving: batch predict failed "
+                    f"({type(exc).__name__}: {exc}); isolating per row",
+                    RuntimeWarning)
+                return self._isolated_pass(pred, row_thunk())
+        finally:
+            with self._inflight_lock:
+                self._inflight -= n_rows
+
+    def _serve_prebinned(self, pred, qv, qc):
+        """('ok', label) | ('err', exc) per pre-binned int8 row — BOTH
+        data planes land predictq rows here, so their replies and
+        counters cannot diverge.  No per-row isolation: a decoded int8
+        row has no per-row failure mode (arity and range were validated
+        at decode), so a predict failure is device-side and fails the
+        whole q-batch."""
+        import warnings
+        n = len(qv)
+        with self._inflight_lock:
+            self._inflight += n
+        try:
+            t0 = time.perf_counter()
+            try:
+                with span("serve.predict", cat="serving", rows=n):
+                    out = with_retry(
+                        lambda: pred.predict_prebinned(qv, qc),
+                        what="serving predictq batch")
+                self.timer.record("serve.batch", time.perf_counter() - t0)
+                self.counters.increment("Serving", "Requests", n)
+                self.counters.increment("Serving", "Batches")
+                return [("ok", self._label(p)) for p in out]
+            except Exception as exc:
+                warnings.warn(
+                    f"serving: pre-binned batch predict failed "
+                    f"({type(exc).__name__}: {exc}); failing the q-batch",
+                    RuntimeWarning)
+                self.counters.increment("Serving", "BadRequests", n)
+                return [("err", exc)] * n
+        finally:
+            with self._inflight_lock:
+                self._inflight -= n
+
+    def _record_request_times(self, traced, dt: float) -> None:
+        """``serve.request`` histogram feed: traced requests record
+        their true wire-derived latency (reply time minus the client
+        enqueue stamp), one sample each; an untraced batch records ONE
+        ``dt`` sample.  The old loop recorded the same batch ``dt``
+        once PER request, over-weighting large batches in the very
+        histogram BatchPolicy is tuned against."""
+        if traced:
+            t_now = reqtrace.now_us()
+            for ctx in traced:
+                self.timer.record(
+                    "serve.request",
+                    max(t_now - ctx.enqueue_us, 0.0) / 1e6)
+        else:
+            self.timer.record("serve.request", dt)
+
+    def _wire_codec_for(self, pred):
+        """The native batch assembler bound to the CURRENT predictor
+        (schema/buckets/pre-binned width are its), rebuilt on hot-swap.
+        None = python path: mode off, toolchain unavailable (one
+        process-wide warning), a drift monitor attached (it needs the
+        token rows the native path never materializes), or no usable
+        schema/delimiter."""
+        mode = self.wire_native if self.wire_native != "auto" \
+            else native_wire.get_mode()
+        if mode == "off":
+            return None
+        if self.monitor is not None:
+            return None
+        schema = getattr(pred, "schema", None)
+        if schema is None or not getattr(schema, "fields", None):
+            return None
+        if native_wire.get_lib() is None:
+            native_wire.warn_fallback_once(
+                "no toolchain or AVENIR_TPU_NO_NATIVE set")
+            return None
+        if self._wire_codec is not None \
+                and self._wire_codec_pred is not None \
+                and self._wire_codec_pred() is pred:
+            return self._wire_codec
+        q_width = pred.prebinned_width \
+            if getattr(pred, "supports_prebinned", False) else 0
+        codec = native_wire.WireCodec(schema, delim=self.delim,
+                                      buckets=tuple(pred.buckets),
+                                      q_width=q_width)
+        if not codec.usable:
+            return None
+        self._wire_codec = codec
+        self._wire_codec_pred = weakref.ref(pred)
+        return codec
 
     # ---- in-process micro-batch loop ----
     def submit(self, row, trace=None,
@@ -1059,8 +1381,12 @@ class RespPredictionLoop:
             else:
                 batch.append(m)
         if batch:
-            for resp in self.service.process_batch(batch):
-                self.client.lpush(self.prediction_q, resp)
+            out = self.service.process_batch(batch)
+            if out:
+                # ONE variadic LPUSH for the whole batch of replies —
+                # with the native codec the buffer is built by one C
+                # pass and hits the socket as a single sendall
+                self.client.lpush_many(self.prediction_q, out)
         return len(msgs)
 
     def run(self, max_idle_s: float = 30.0,

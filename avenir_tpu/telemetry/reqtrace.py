@@ -120,7 +120,11 @@ def flow_id_of(rid: str) -> str:
 def emit_flow(phase: str, rid: str, step: str,
               ts_us: Optional[float] = None, **args) -> None:
     """THE flow-emission funnel: every request-flow leg goes through
-    here so the name/cat/id-namespacing contract lives in one place."""
+    here so the name/cat/id-namespacing contract lives in one place.
+    Returns before any id/args formatting when no tracer is installed —
+    this runs per sampled request on the serving hot path."""
+    if current_tracer() is None:
+        return
     flow(FLOW_NAME, phase, flow_id_of(rid), cat=FLOW_CAT, ts_us=ts_us,
          step=step, **args)
 

@@ -407,3 +407,56 @@ def price_flow(base):
 
 
 FLOWS = FLOWS + (buyhist_flow, visit_flow, price_flow)
+
+
+def wire_flow(base):
+    """The int8 ``predictq`` wire form + the batched RESP reply buffer
+    (PR 16 native data plane): byte layouts OTHER processes parse, so
+    they are format contracts.  The fixture is produced by the PYTHON
+    encoders (always available); when the native codec built, the flow
+    additionally asserts the native bytes are identical before
+    returning — so a regen on a toolchain host can never freeze bytes
+    the fallback path would not produce."""
+    import numpy as np
+    from avenir_tpu.io import native_wire
+    from avenir_tpu.io.respq import _encode_command
+    from avenir_tpu.serving.quantized import QuantizedForest, \
+        wire_encode_rows
+
+    qf = QuantizedForest(
+        q_lo=np.zeros((1, 1, 4), np.int8),
+        q_hi=np.zeros((1, 1, 4), np.int8),
+        num_r=np.zeros((1, 1, 4), bool),
+        cat_m=np.zeros((1, 1, 4, 1), bool),
+        cat_r=np.zeros((1, 1, 4), bool),
+        cls_oh=np.zeros((1, 1, 2), np.uint8),
+        wvec=np.ones((1,), np.float32),
+        scale=np.array([0.5, 2.0, 10.0, 0.25]),
+        fmin=np.array([-10.0, 0.0, -100.0, 1.0]),
+        classes=["T", "F"])
+    vals = np.array([
+        [-10.0, 0.0, -100.0, 1.0],          # grid origin -> cell 0
+        [-9.75, 1.0, -95.0, 1.125],         # just inside the first cells
+        [117.0, 508.0, 2440.0, 64.5],       # top finite cells
+        [1e9, -1e9, 0.0, -1e9],             # clip both ends
+        [np.inf, -np.inf, np.nan, 2.0],     # non-finite sentinels
+    ])
+    codes = np.array([[0, 1, 2, 3],
+                      [-1, -5, 0, 1],
+                      [127, 200, 7, 0],
+                      [3, 1, 4, 1],
+                      [0, 0, 0, 0]], np.int32)
+    qv, qc = qf.quantize_rows(vals, codes)
+    lines = wire_encode_rows([0, 1, 2, 3, 4], qv, qc)
+
+    replies = [f"{i},{lab}" for i, lab in
+               enumerate(["T", "F", "T", "error", "__AMBIG__"])]
+    resp = _encode_command(["LPUSH", "predictionQueue"] + replies)
+    if native_wire.get_lib() is not None:
+        native = native_wire.encode_lpush("predictionQueue", replies)
+        assert native == resp, "native RESP encode diverged from python"
+    return {"wire/predictq.csv": "\n".join(lines) + "\n",
+            "wire/resp_lpush.txt": repr(resp) + "\n"}
+
+
+FLOWS = FLOWS + (wire_flow,)

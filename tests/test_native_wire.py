@@ -1,0 +1,382 @@
+"""Native serving data plane (io/serve_native.cpp + io/native_wire.py):
+reply RESP-encode byte parity, the ps.wire.native mode knob, the
+no-toolchain / AVENIR_TPU_NO_NATIVE fallback contract (pure-python path,
+ONE warning, tier-1 still green), the predictq int8 wire grammar, and a
+real quantized-forest end-to-end through the native assembler.
+
+The differential batch-level fuzz (random schemas/delimiters/trace
+fields/malformed payloads vs the retained python plane) lives in
+tests/test_native_wire_fuzz.py.
+"""
+
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core.schema import FeatureSchema
+from avenir_tpu.io import native_wire
+from avenir_tpu.io.respq import _encode_command
+from avenir_tpu.serving.quantized import (QUANTIZED_VERB, wire_decode_tokens,
+                                          wire_encode_rows)
+from avenir_tpu.serving.service import PredictionService
+
+pytestmark = pytest.mark.serving
+
+
+SCHEMA = FeatureSchema.from_dict({"fields": [
+    {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+    {"name": "plan", "ordinal": 1, "dataType": "categorical",
+     "feature": True, "cardinality": ["basic", "plus", "premium"]},
+    {"name": "usage", "ordinal": 2, "dataType": "double", "feature": True},
+    {"name": "age", "ordinal": 3, "dataType": "int", "feature": True},
+    {"name": "churn", "ordinal": 4, "dataType": "categorical",
+     "cardinality": ["T", "F"]}]})
+
+
+from avenir_tpu.serving.predictor import Predictor  # noqa: E402
+
+
+class _Digest(Predictor):
+    """Deterministic pure-host predictor: the label is a digest of the
+    ENCODED feature columns, so any float/vocab divergence between the
+    native assembler and python encode_rows changes the reply."""
+
+    kind = "digest"
+
+    def __init__(self, schema, buckets=(1, 8, 64), delim=",", q_width=0):
+        super().__init__(schema, buckets=buckets, delim=delim)
+        self._q_width = int(q_width)
+
+    def _predict_table(self, table):
+        acc = np.zeros(table.n_rows, dtype=np.float64)
+        for f in self.schema.fields:
+            if not f.feature:
+                continue
+            if f.is_categorical:
+                acc = acc * 31.0 + table.columns[f.ordinal]
+            elif f.is_numeric:
+                v = np.nan_to_num(table.columns[f.ordinal], nan=-7.0,
+                                  posinf=9e6, neginf=-9e6)
+                acc = acc * 31.0 + np.floor(v * 8.0)
+        return [f"L{int(x) % 9973}" for x in acc]
+
+    @property
+    def supports_prebinned(self):
+        return self._q_width > 0
+
+    @property
+    def prebinned_width(self):
+        return self._q_width
+
+    def predict_prebinned(self, qv, qc):
+        qv = np.asarray(qv, dtype=np.int64)
+        qc = np.asarray(qc, dtype=np.int64)
+        acc = (qv * 31 + qc + 128).sum(axis=1)
+        return [f"Q{int(x) % 9973}" for x in acc]
+
+
+def _rows(n, seed=0):
+    rng = np.random.default_rng(seed)
+    plans = ["basic", "plus", "premium", "UNKNOWN"]
+    return [[f"id{i}", str(rng.choice(plans)),
+             f"{rng.uniform(-50, 50):.3f}", str(int(rng.integers(18, 90))),
+             "T"] for i in range(n)]
+
+
+def _msgs(rows, delim=",", start=0):
+    return [delim.join(["predict", str(start + i)] + r)
+            for i, r in enumerate(rows)]
+
+
+@pytest.fixture(autouse=True)
+def _reset_mode():
+    native_wire.set_mode("auto")
+    yield
+    native_wire.set_mode("auto")
+
+
+# --------------------------------------------------------------------------
+# reply-side: one RESP buffer, byte parity
+# --------------------------------------------------------------------------
+
+@pytest.mark.skipif(native_wire.get_lib() is None,
+                    reason="native wire library unavailable")
+def test_encode_lpush_byte_parity():
+    cases = [
+        ["0,T"],
+        [f"{i},label{i}" for i in range(257)],
+        ["", "x", "sp ace", "Ünïcode,véry", "y" * 4096],
+        ["tab\tand\rcr"],
+    ]
+    for values in cases:
+        got = native_wire.encode_lpush("predictionQueue", values)
+        want = _encode_command(["LPUSH", "predictionQueue"] + values)
+        assert got == want, values[:2]
+
+
+@pytest.mark.skipif(native_wire.get_lib() is None,
+                    reason="native wire library unavailable")
+def test_encode_lpush_embedded_join_byte_returns_none():
+    """A value embedding the join byte would mis-split inside C — the
+    encoder must refuse (count mismatch) and hand back to python."""
+    assert native_wire.encode_lpush("q", ["ok", "bad\nsplit"]) is None
+    # empty batch is a python no-op, never a native call
+    assert native_wire.encode_lpush("q", []) is None
+
+
+def test_lpush_many_wire_bytes_identical_either_plane(monkeypatch):
+    """RespClient.lpush_many must put the SAME bytes on the socket with
+    the codec on or off (captured at the sendall boundary)."""
+    from avenir_tpu.io import respq
+
+    sent = []
+
+    class _Sock:
+        def sendall(self, b):
+            sent.append(bytes(b))
+
+    monkeypatch.setattr(respq, "_read_reply", lambda rf: 1)
+    cli = respq.RespClient.__new__(respq.RespClient)
+    cli._sock = _Sock()
+    cli._rf = None
+    cli._stamp = False
+    cli._delim = ","
+    values = [f"{i},L{i}" for i in range(40)] + ["", "ü,x"]
+
+    native_wire.set_mode("off")
+    cli.lpush_many("pq", list(values))
+    native_wire.set_mode("auto")
+    cli.lpush_many("pq", list(values))
+    assert len(sent) == 2 and sent[0] == sent[1]
+
+
+# --------------------------------------------------------------------------
+# the mode knob + fallback contract
+# --------------------------------------------------------------------------
+
+def test_set_mode_validates():
+    with pytest.raises(ValueError, match="wire codec mode"):
+        native_wire.set_mode("bogus")
+    with pytest.raises(ValueError, match="wire_native"):
+        PredictionService(_Digest(SCHEMA), warm=False, wire_native="bogus")
+
+
+def test_mode_off_pins_the_python_plane():
+    native_wire.set_mode("off")
+    assert not native_wire.native_enabled()
+    assert native_wire.encode_lpush("q", ["a"]) is None
+    codec = native_wire.WireCodec(SCHEMA)
+    assert codec.parse(_msgs(_rows(3))) is None
+    svc = PredictionService(_Digest(SCHEMA), warm=False)
+    assert svc._wire_codec_for(svc.predictor) is None
+
+
+def test_env_twin_disables_even_when_built(monkeypatch):
+    monkeypatch.setenv(native_wire.NO_NATIVE_ENV, "1")
+    assert native_wire.get_lib() is None
+    assert native_wire.encode_lpush("q", ["a"]) is None
+    assert native_wire.WireCodec(SCHEMA).parse(_msgs(_rows(2))) is None
+
+
+def _force_no_toolchain(monkeypatch, tmp_path):
+    """Simulate a container without g++: unbuilt .so, empty PATH, fresh
+    module latch."""
+    monkeypatch.setattr(native_wire, "_lib", None)
+    monkeypatch.setattr(native_wire, "_lib_failed", False)
+    monkeypatch.setattr(native_wire, "_SO", str(tmp_path / "absent.so"))
+    monkeypatch.setenv("PATH", str(tmp_path))
+
+
+def test_no_toolchain_serves_pure_python_and_warns_once(
+        monkeypatch, tmp_path):
+    _force_no_toolchain(monkeypatch, tmp_path)
+    monkeypatch.setattr(native_wire, "_warned_fallback", False)
+    assert native_wire.get_lib() is None
+
+    rows = _rows(6)
+    svc = PredictionService(_Digest(SCHEMA), warm=False, wire_native="on")
+    with pytest.warns(RuntimeWarning, match="native wire codec unavailable"):
+        out1 = svc.process_batch(_msgs(rows))
+    # ...exactly once: the second batch must stay silent
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        out2 = svc.process_batch(_msgs(rows))
+    assert not [x for x in w if "native wire codec" in str(x.message)]
+    assert out1 == out2
+    assert out1 == [f"{i},{lab}" for i, lab in
+                    enumerate(_Digest(SCHEMA).predict_rows(rows))]
+
+
+def test_no_toolchain_mode_off_never_warns(monkeypatch, tmp_path):
+    _force_no_toolchain(monkeypatch, tmp_path)
+    monkeypatch.setattr(native_wire, "_warned_fallback", False)
+    svc = PredictionService(_Digest(SCHEMA), warm=False, wire_native="off")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        svc.process_batch(_msgs(_rows(3)))
+    assert not [x for x in w if "native wire codec" in str(x.message)]
+
+
+# --------------------------------------------------------------------------
+# predictq int8 wire grammar (oracle level)
+# --------------------------------------------------------------------------
+
+def test_wire_encode_decode_roundtrip():
+    rng = np.random.default_rng(5)
+    qv = rng.integers(-128, 128, size=(7, 4)).astype(np.int8)
+    qc = rng.integers(-1, 128, size=(7, 4)).astype(np.int8)
+    lines = wire_encode_rows(list(range(7)), qv, qc)
+    assert all(l.startswith(QUANTIZED_VERB + ",") for l in lines)
+    for i, line in enumerate(lines):
+        parts = line.split(",")
+        assert parts[1] == str(i)
+        dec = wire_decode_tokens(parts[2:], 4)
+        assert dec is not None
+        np.testing.assert_array_equal(dec[0], qv[i])
+        np.testing.assert_array_equal(dec[1], qc[i])
+
+
+@pytest.mark.parametrize("toks", [
+    ["2", "1"],                       # arity: missing qc half
+    ["3", "1", "2", "3", "4"],        # width echo mismatches token count
+    ["2", "01", "2", "3", "4"],       # leading zero is not canonical
+    ["2", "+1", "2", "3", "4"],       # explicit plus is not canonical
+    ["2", "1.5", "2", "3", "4"],      # not an int
+    ["2", "128", "2", "3", "4"],      # > int8 max
+    ["2", "-129", "2", "3", "4"],     # < int8 min
+    ["2", "", "2", "3", "4"],         # empty token
+    ["x", "1", "2", "3", "4"],        # width echo not an int
+    ["-2", "1", "2", "3", "4"],       # negative width echo
+])
+def test_wire_decode_rejects_noncanonical(toks):
+    assert wire_decode_tokens(toks, 2) is None
+
+
+def test_wire_decode_accepts_bounds():
+    dec = wire_decode_tokens(["2", "-128", "127", "0", "-1"], 2)
+    assert dec is not None
+    np.testing.assert_array_equal(dec[0], np.array([-128, 127], np.int8))
+    np.testing.assert_array_equal(dec[1], np.array([0, -1], np.int8))
+
+
+# --------------------------------------------------------------------------
+# service-level predictq + native assembler
+# --------------------------------------------------------------------------
+
+@pytest.mark.skipif(native_wire.get_lib() is None,
+                    reason="native wire library unavailable")
+def test_predictq_service_parity_and_unsupported():
+    rng = np.random.default_rng(9)
+    qv = rng.integers(-128, 128, size=(5, 3)).astype(np.int8)
+    qc = rng.integers(-1, 3, size=(5, 3)).astype(np.int8)
+    msgs = wire_encode_rows(list(range(5)), qv, qc) \
+        + _msgs(_rows(4), start=5) \
+        + [f"predictq,9,t=777:1,3,1,2,3,0,0,0"]
+
+    def run(mode, q_width):
+        svc = PredictionService(_Digest(SCHEMA, q_width=q_width),
+                                warm=False, wire_native=mode)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            out = svc.process_batch(list(msgs))
+        return out, svc.counters.get("Serving", "BadRequests"), \
+            sorted(str(x.message) for x in w), svc
+
+    out_n, bad_n, warn_n, svc_n = run("on", 3)
+    assert svc_n._wire_codec is not None   # the native plane really ran
+    out_p, bad_p, warn_p, _ = run("off", 3)
+    assert out_n == out_p and bad_n == bad_p == 0
+    expect_q = _Digest(SCHEMA, q_width=3).predict_prebinned(qv, qc)
+    assert out_n[:5] == [f"{i},{lab}" for i, lab in enumerate(expect_q)]
+
+    # no pre-binned path on the served model: error reply + BadRequests,
+    # SAME on both planes, with the one-per-batch sidecar warning
+    out_n, bad_n, warn_n, _ = run("on", 0)
+    out_p, bad_p, warn_p, _ = run("off", 0)
+    assert out_n == out_p and bad_n == bad_p == 6
+    assert sum("no quantized sidecar" in m for m in warn_n) == 1
+    assert sum("no quantized sidecar" in m for m in warn_p) == 1
+
+
+@pytest.mark.skipif(native_wire.get_lib() is None,
+                    reason="native wire library unavailable")
+def test_quantized_forest_predictq_end_to_end(tmp_path, mesh_ctx):
+    """The real thing: publish a forest + int8 sidecar, serve predictq
+    through the native assembler, replies == the float path's labels
+    within the pinned mismatch budget (here: exact, same rows the
+    sidecar was calibrated on)."""
+    from avenir_tpu.models.forest import ForestParams, build_forest
+    from avenir_tpu.serving.predictor import make_predictor
+    from avenir_tpu.serving.quantized import load_quantized, \
+        publish_quantized
+    from avenir_tpu.serving.registry import ModelRegistry
+    from tests.test_tree import make_table
+
+    table = make_table(400, seed=3)
+    params = ForestParams(num_trees=3, seed=3)
+    params.tree.max_depth = 2
+    models = build_forest(table, params, mesh_ctx)
+    reg = ModelRegistry(str(tmp_path))
+    v = reg.publish("f", models, schema=table.schema)
+    publish_quantized(reg, "f", v, models, table.schema, table)
+
+    pred = make_predictor(reg.load("f"), quantized=True, buckets=(8,))
+    qf = load_quantized(reg, "f", v)
+    F = qf.scale.shape[0]
+    assert pred.prebinned_width == F
+    rng = np.random.default_rng(17)
+    vals = rng.normal(0, 50, size=(12, F))
+    vals[3, 0] = np.nan
+    vals[4, 0] = np.inf
+    codes = rng.integers(-1, 4, size=(12, F)).astype(np.int32)
+    qv, qc = qf.quantize_rows(vals, codes)
+    assert qv.shape == (12, F) and qv.dtype == np.int8
+
+    msgs = wire_encode_rows(list(range(12)), qv, qc)
+    svc_n = PredictionService(pred, warm=False, wire_native="on")
+    out_n = svc_n.process_batch(list(msgs))
+    assert svc_n._wire_codec is not None
+    svc_p = PredictionService(pred, warm=False, wire_native="off")
+    out_p = svc_p.process_batch(list(msgs))
+    assert out_n == out_p
+    direct = pred.predict_prebinned(qv, qc)
+    assert out_n == [f"{i},{svc_n._label(p)}" for i, p in enumerate(direct)]
+
+
+# --------------------------------------------------------------------------
+# codec lifecycle inside the service
+# --------------------------------------------------------------------------
+
+@pytest.mark.skipif(native_wire.get_lib() is None,
+                    reason="native wire library unavailable")
+def test_codec_rebuilt_on_hot_swap_and_skipped_with_monitor():
+    svc = PredictionService(_Digest(SCHEMA), warm=False, wire_native="on")
+    svc.process_batch(_msgs(_rows(2)))
+    first = svc._wire_codec
+    assert first is not None
+    # same predictor -> cached codec object
+    svc.process_batch(_msgs(_rows(2)))
+    assert svc._wire_codec is first
+    # a swapped-in predictor gets a FRESH codec (weakref key)
+    svc.predictor = _Digest(SCHEMA)
+    svc.process_batch(_msgs(_rows(2)))
+    assert svc._wire_codec is not None and svc._wire_codec is not first
+
+    # drift monitor needs the token rows: the codec must stand down
+    svc2 = PredictionService(_Digest(SCHEMA), warm=False, wire_native="on",
+                             monitor=object())
+    assert svc2._wire_codec_for(svc2.predictor) is None
+
+
+@pytest.mark.skipif(native_wire.get_lib() is None,
+                    reason="native wire library unavailable")
+def test_multibyte_delimiter_stays_python():
+    svc = PredictionService(_Digest(SCHEMA, delim="::"), warm=False,
+                            delim="::", wire_native="on")
+    rows = _rows(3)
+    out = svc.process_batch(_msgs(rows, delim="::"))
+    assert svc._wire_codec is None or not svc._wire_codec.usable
+    assert out == [f"{i}::{lab}" for i, lab in
+                   enumerate(_Digest(SCHEMA).predict_rows(rows))]

@@ -1,0 +1,264 @@
+"""Differential fuzz: the native serving data plane vs the retained
+pure-python path (tests/test_native_csv_fuzz.py's oracle style lifted to
+the SERVICE level).
+
+One randomized batch — random schemas, random single-byte delimiters,
+embedded trace fields (valid and near-miss), malformed/truncated
+messages, NaN/inf/empty numeric fields, unknown vocab words, reloads,
+valid and malformed ``predictq`` payloads, even embedded join bytes —
+goes through the same service twice: ``wire_native="on"`` and
+``wire_native="off"``.  Replies must be byte-identical IN ORDER, the
+BadRequests delta identical, and the warning multiset identical.  The
+native plane is allowed to decline a batch (its fallback verdict re-runs
+python, so parity is then trivial); what it may never do is answer
+differently.  Seeded, so a failure reproduces exactly.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core.schema import FeatureSchema
+from avenir_tpu.io import native_wire
+from avenir_tpu.serving.predictor import Predictor
+from avenir_tpu.serving.service import PredictionService
+
+pytestmark = [
+    pytest.mark.serving,
+    pytest.mark.skipif(native_wire.get_lib() is None,
+                       reason="native wire library unavailable"),
+]
+
+WORDS = ["", "a", "bb", "basic", "plus", "premium", "goldmember",
+         "x" * 12, "Ü", "sp ace"]
+DELIMS = [",", ";", "|", "\t", ":"]
+
+
+class DigestPredictor(Predictor):
+    """Pure-host deterministic predictor: the label digests the ENCODED
+    feature columns, so any assembler divergence (float parse, vocab
+    lookup, row/slot order, padding) changes a reply."""
+
+    kind = "digest"
+
+    def __init__(self, schema, buckets=(1, 8, 64), delim=",", q_width=0):
+        super().__init__(schema, buckets=buckets, delim=delim)
+        self._q_width = int(q_width)
+
+    def _predict_table(self, table):
+        acc = np.zeros(table.n_rows, dtype=np.float64)
+        for f in self.schema.fields:
+            if not f.feature:
+                continue
+            if f.is_categorical:
+                acc = acc * 31.0 + table.columns[f.ordinal]
+            elif f.is_numeric:
+                v = np.nan_to_num(table.columns[f.ordinal], nan=-7.0,
+                                  posinf=9e6, neginf=-9e6)
+                acc = acc * 31.0 + np.floor(v * 8.0)
+        return [f"L{int(x) % 99991}" for x in acc]
+
+    @property
+    def supports_prebinned(self):
+        return self._q_width > 0
+
+    @property
+    def prebinned_width(self):
+        return self._q_width
+
+    def predict_prebinned(self, qv, qc):
+        qv = np.asarray(qv, dtype=np.int64)
+        qc = np.asarray(qc, dtype=np.int64)
+        acc = (qv * 31 + qc + 128).sum(axis=1)
+        return [f"Q{int(x) % 99991}" for x in acc]
+
+
+def _random_schema(rng):
+    fields = [{"name": "id", "ordinal": 0, "id": True,
+               "dataType": "string"}]
+    n_fields = int(rng.integers(2, 6))
+    for o in range(1, n_fields + 1):
+        kind = rng.choice(["cat", "catbig", "num", "str"])
+        if kind == "cat":
+            vocab = list(rng.choice(WORDS, size=int(rng.integers(1, 6)),
+                                    replace=False))
+            fields.append({"name": f"c{o}", "ordinal": o,
+                           "dataType": "categorical", "feature": True,
+                           "cardinality": vocab})
+        elif kind == "catbig":
+            fields.append({"name": f"cb{o}", "ordinal": o,
+                           "dataType": "categorical", "feature": True,
+                           "cardinality": [f"v{i}" for i in range(12)]})
+        elif kind == "num":
+            fields.append({"name": f"n{o}", "ordinal": o,
+                           "dataType": "double", "feature": True})
+        else:
+            fields.append({"name": f"s{o}", "ordinal": o,
+                           "dataType": "string"})
+    return FeatureSchema.from_dict({"fields": fields})
+
+
+def _numeric_text(rng):
+    style = rng.random()
+    if style < 0.30:
+        return str(int(rng.integers(-10000, 10000)))
+    if style < 0.55:
+        return f"{rng.uniform(-100, 100):.4f}"
+    if style < 0.70:
+        return f"{rng.uniform(-1, 1):.3e}"
+    if style < 0.78:
+        return "+" + str(int(rng.integers(0, 999)))
+    if style < 0.86:
+        return str(rng.choice(["nan", "NaN", "inf", "-inf", "Infinity"]))
+    if style < 0.93:
+        return ""          # empty numeric field: python float('') raises
+    return str(rng.choice(["1_000", "0x1p3", "  12  ", "--3", "1e", "."]))
+
+
+def _field_text(rng, f, delim):
+    if f.is_categorical:
+        if rng.random() < 0.75 and f.cardinality:
+            v = str(rng.choice(f.cardinality))
+        else:
+            v = "UNKNOWNVAL"
+        if any(ch in v for ch in (" ", "\t", delim)):
+            return v
+        pad = " " * int(rng.integers(0, 3))
+        return pad + v + pad
+    if f.is_numeric:
+        return _numeric_text(rng)
+    return "t" + str(int(rng.integers(0, 10 ** 6)))
+
+
+def _trace_token(rng):
+    r = rng.random()
+    if r < 0.4:
+        return f"t={int(rng.integers(0, 10**9))}:1"
+    if r < 0.7:
+        return f"t={int(rng.integers(0, 10**9))}:0"
+    # near-miss spellings: ordinary data by the grammar, both planes
+    return str(rng.choice(["t=12", "t=1:2", "t=x:1", "t=:1", "t=1:01",
+                           "t= 5:1"]))
+
+
+def _predict_msg(rng, schema, delim, rid):
+    row = [""] * schema.num_columns
+    row[0] = f"id{rid}"
+    for f in schema.fields:
+        if f.ordinal:
+            row[f.ordinal] = _field_text(rng, f, delim)
+    body = ["predict", str(rid)]
+    if rng.random() < 0.35:
+        body.append(_trace_token(rng))
+    msg = delim.join(body + row)
+    if rng.random() < 0.06:      # truncated mid-row
+        msg = msg[:int(rng.integers(8, max(9, len(msg))))]
+    return msg
+
+
+def _predictq_msg(rng, delim, rid, q_width):
+    if rng.random() < 0.75 and q_width > 0:
+        qv = rng.integers(-128, 128, size=q_width)
+        qc = rng.integers(-1, 5, size=q_width)
+        toks = [str(q_width)] + [str(int(x)) for x in qv] \
+            + [str(int(x)) for x in qc]
+    else:  # malformed: bad width echo / arity / range / spelling
+        w = max(q_width, 1)
+        toks = [str(w)] + [str(int(x)) for x in
+                           rng.integers(-200, 200,
+                                        size=int(rng.integers(0, 2 * w + 2)))]
+        if rng.random() < 0.3:
+            toks[0] = str(rng.choice(["01", "-1", "x", ""]))
+    body = ["predictq", str(rid)]
+    if rng.random() < 0.3:
+        body.append(_trace_token(rng))
+    return delim.join(body + toks)
+
+
+def _make_batch(rng, schema, delim, q_width):
+    msgs, rid = [], 0
+    for _ in range(int(rng.integers(1, 120))):
+        r = rng.random()
+        if r < 0.62:
+            msgs.append(_predict_msg(rng, schema, delim, rid))
+        elif r < 0.80:
+            msgs.append(_predictq_msg(rng, delim, rid, q_width))
+        elif r < 0.86:
+            msgs.append(str(rng.choice([
+                "predit" + delim + "typo", "garbage", "", " ",
+                "predict", "predict" + delim, "stopx",
+                "PREDICT" + delim + "0" + delim + "x"])))
+        elif r < 0.90:
+            # embedded join byte: the codec must decline, never mis-split
+            msgs.append("predict" + delim + str(rid) + delim + "a\nb")
+        else:
+            msgs.append("reload")
+        rid += 1
+    return msgs
+
+
+def _run(svc, msgs):
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        out = svc.process_batch(list(msgs))
+    return (out, svc.counters.get("Serving", "BadRequests"),
+            svc.counters.get("Serving", "Requests"),
+            sorted(str(x.message) for x in w))
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_native_plane_matches_python_plane(seed):
+    rng = np.random.default_rng(2000 + seed)
+    schema = _random_schema(rng)
+    delim = str(rng.choice(DELIMS))
+    q_width = int(rng.choice([0, 2, 5]))
+    msgs = _make_batch(rng, schema, delim, q_width)
+
+    def service(mode):
+        return PredictionService(
+            DigestPredictor(schema, delim=delim, q_width=q_width),
+            warm=False, delim=delim, wire_native=mode)
+
+    out_n, bad_n, req_n, warn_n = _run(service("on"), msgs)
+    out_p, bad_p, req_p, warn_p = _run(service("off"), msgs)
+    label = f"seed {seed} delim {delim!r} q_width {q_width}"
+    assert out_n == out_p, label
+    assert bad_n == bad_p, label
+    assert req_n == req_p, label
+    assert warn_n == warn_p, label
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_clean_batches_really_take_the_native_plane(seed):
+    """Guard against silently falling back on every batch (which would
+    make the parity fuzz vacuous): a clean all-valid batch must PARSE
+    natively — codec attached and the parse not declined."""
+    rng = np.random.default_rng(6000 + seed)
+    schema = _random_schema(rng)
+    q_width = int(rng.choice([0, 3]))
+    rows = []
+    for i in range(int(rng.integers(1, 40))):
+        row = [""] * schema.num_columns
+        row[0] = f"id{i}"
+        for f in schema.fields:
+            if not f.ordinal:
+                continue
+            if f.is_categorical:
+                row[f.ordinal] = str(rng.choice(f.cardinality))
+            elif f.is_numeric:
+                row[f.ordinal] = f"{rng.uniform(-50, 50):.3f}"
+            else:
+                row[f.ordinal] = "s"
+        rows.append(row)
+    msgs = [",".join(["predict", str(i)] + r) for i, r in enumerate(rows)]
+    svc = PredictionService(DigestPredictor(schema, q_width=q_width),
+                            warm=False, wire_native="on")
+    codec = svc._wire_codec_for(svc.predictor)
+    assert codec is not None and codec.usable
+    pb = codec.parse(msgs)
+    assert pb is not None and pb.n_float == len(msgs)
+    out = svc.process_batch(msgs)
+    svc_p = PredictionService(DigestPredictor(schema, q_width=q_width),
+                              warm=False, wire_native="off")
+    assert out == svc_p.process_batch(msgs)
