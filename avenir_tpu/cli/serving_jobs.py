@@ -28,6 +28,24 @@ coalescing policy, optional RESP wire transport).  Config keys
                             RespServers and every client rides the
                             consistent-hash ShardedRespClient ring
                             (default 1; requires ps.transport=resp)
+  ps.broker.durable         broker queue durability: off | commit |
+                            fsync (env twin AVENIR_TPU_BROKER_DURABLE;
+                            default off = today's in-memory bytes).
+                            commit/fsync give every embedded shard a
+                            write-ahead journal (under a job temp dir)
+                            replayed on restart; fsync also forces the
+                            OS flush per batch
+  ps.broker.lease.timeout.s worker pops become visibility-timeout
+                            leases with this expiry, acked by the
+                            batched reply push; an expired lease
+                            re-enqueues (at-least-once + broker reply
+                            dedup = exactly-once effect).  Default 30
+                            when ps.broker.durable != off, else 0 =
+                            classic destructive pops
+  ps.request.ttl.ms         stamp every request with an absolute
+                            deadline this far in the future;
+                            past-deadline requests answer '<id>,late'
+                            before device dispatch (default 0 = none)
   ps.host.label             multi-host identity on metric series and
                             stats (default: this hostname)
   ps.autoscale              run the fleet under the SLO-driven
@@ -67,7 +85,7 @@ and throughput land in the counter dump (Serving group).
 
 from __future__ import annotations
 
-from typing import Dict, List
+from typing import List
 
 from ..core.config import Config
 from ..core.metrics import Counters
@@ -129,6 +147,16 @@ def prediction_service(cfg: Config, in_path: str, out_path: str) -> Counters:
                          "ps.transport=resp (both live on the wire tier)")
     if n_shards < 1:
         raise ValueError(f"ps.broker.shards must be >= 1, got {n_shards}")
+    from ..io.respq import resolve_durable
+    durable = resolve_durable(cfg.get("ps.broker.durable"))
+    lease_s = cfg.get_float("ps.broker.lease.timeout.s",
+                            30.0 if durable != "off" else 0.0)
+    ttl_ms = cfg.get_float("ps.request.ttl.ms", 0.0)
+    if (durable != "off" or lease_s > 0 or ttl_ms > 0) \
+            and transport != "resp":
+        raise ValueError("ps.broker.durable / ps.broker.lease.timeout.s"
+                         " / ps.request.ttl.ms require ps.transport=resp"
+                         " (all three live on the wire tier)")
 
     def pinned_factory():
         # pinned serving: build the predictor for that exact version
@@ -142,23 +170,34 @@ def prediction_service(cfg: Config, in_path: str, out_path: str) -> Counters:
     if n_workers > 1 or autoscale or n_shards > 1:
         # the fleet path also carries a 1-worker fleet over a sharded
         # ring (the RespPredictionLoop below is single-endpoint only)
-        from ..io.respq import RespServer, make_queue_client
+        import os
+        import shutil
+        import tempfile
+        from ..io.respq import RespServer, dedup_replies, make_queue_client
         from ..serving.autoscaler import AutoscalePolicy, FleetAutoscaler
         from ..serving.fleet import ServingFleet
         # the broker tier: M shard servers (M=1 keeps the plain client
         # underneath make_queue_client); started INSIDE the try so a
         # bind failure on shard k doesn't leak the k-1 already running
         servers: List[RespServer] = []
-        fleet = feeder = scaler = sensor = None
+        fleet = feeder = scaler = sensor = journal_root = None
         try:
-            for _ in range(n_shards):
-                servers.append(RespServer().start())
+            if durable != "off":
+                journal_root = tempfile.mkdtemp(
+                    prefix="avenir-broker-journal-")
+            for k in range(n_shards):
+                jdir = os.path.join(journal_root, f"shard{k}") \
+                    if journal_root else None
+                servers.append(RespServer(durable=durable,
+                                          journal_dir=jdir,
+                                          counters=counters).start())
             req_q = cfg.get("redis.request.queue", "requestQueue")
             pred_q = cfg.get("redis.prediction.queue", "predictionQueue")
             wire_cfg = {"redis.server.endpoints":
                         [f"127.0.0.1:{s.port}" for s in servers],
                         "redis.request.queue": req_q,
-                        "redis.prediction.queue": pred_q}
+                        "redis.prediction.queue": pred_q,
+                        "redis.lease.timeout.s": lease_s}
             start_workers = n_workers
             if autoscale:
                 # like fleet_host --autoscale MIN:MAX: the fleet starts
@@ -192,9 +231,12 @@ def prediction_service(cfg: Config, in_path: str, out_path: str) -> Counters:
                         "ps.autoscale.interval.ms", 250.0) / 1000.0,
                     counters=counters).start()
             feeder = make_queue_client(wire_cfg, delim=od)
-            feeder.lpush_many(
-                req_q, [od.join(["predict", str(i)] + row)
-                        for i, row in enumerate(rows)])
+            msgs = [od.join(["predict", str(i)] + row)
+                    for i, row in enumerate(rows)]
+            if ttl_ms > 0:
+                from ..telemetry import reqtrace
+                msgs = reqtrace.stamp_deadline(msgs, ttl_ms, delim=od)
+            feeder.lpush_many(req_q, msgs)
             feeder.lpush(req_q, "stop")
             if not fleet.wait(timeout_s=300.0):
                 # a wedged worker means an incomplete reply set: fail
@@ -207,21 +249,19 @@ def prediction_service(cfg: Config, in_path: str, out_path: str) -> Counters:
                 scaler.stop()
                 counters.set("Autoscaler", "FinalActiveWorkers",
                              fleet.active_workers())
-            # first reply per id wins: the RespClient reconnect contract
-            # is at-least-once on writes, so a re-pushed request could
-            # answer twice — and a reply count that does not cover every
-            # request is a corrupted replay, never a part file
-            by_id: Dict[int, str] = {}
-            dups = 0
+            # first reply per id wins (the shared dedup_replies helper —
+            # same consumer-side exactly-once contract the replay CLI
+            # uses): the RespClient reconnect contract is at-least-once
+            # on writes, so a re-pushed request could answer twice — and
+            # a reply count that does not cover every request is a
+            # corrupted replay, never a part file
+            replies: List[str] = []
             while True:
                 v = feeder.rpop(pred_q)
                 if v is None:
                     break
-                rid = int(v.split(od, 1)[0])
-                if rid in by_id:
-                    dups += 1
-                else:
-                    by_id[rid] = v
+                replies.append(v)
+            by_id, dups = dedup_replies(replies, delim=od)
             if dups:
                 import warnings
                 warnings.warn(f"predictionService fleet: {dups} "
@@ -232,7 +272,8 @@ def prediction_service(cfg: Config, in_path: str, out_path: str) -> Counters:
                     f"predictionService fleet: {len(by_id)} replies for "
                     f"{len(rows)} requests — replay aborted (partial "
                     f"output suppressed)")
-            out: List[str] = [by_id[rid] for rid in sorted(by_id)]
+            out: List[str] = [f"{rid}{od}{by_id[rid]}"
+                              for rid in sorted(by_id, key=int)]
             # fold the fleet's aggregate counters + latency percentiles
             # into the job dump before teardown
             for grp, names in fleet.merged_counters().as_dict().items():
@@ -255,6 +296,8 @@ def prediction_service(cfg: Config, in_path: str, out_path: str) -> Counters:
                     cli.close()
             for s in servers:
                 s.stop()
+            if journal_root is not None:
+                shutil.rmtree(journal_root, ignore_errors=True)
         artifacts.write_text_output(out_path, out, role="m")
         return counters
 
@@ -270,19 +313,31 @@ def prediction_service(cfg: Config, in_path: str, out_path: str) -> Counters:
                                 quantized=quantized, **common)
     counters.set("Serving", "ModelVersion", svc.version or 0)
     if transport == "resp":
+        import shutil
+        import tempfile
         from ..io.respq import RespClient, RespServer
-        server = RespServer().start()
+        journal_root = tempfile.mkdtemp(prefix="avenir-broker-journal-") \
+            if durable != "off" else None
+        server = RespServer(durable=durable,
+                            journal_dir=journal_root,
+                            counters=counters).start()
         try:
             req_q = cfg.get("redis.request.queue", "requestQueue")
             pred_q = cfg.get("redis.prediction.queue", "predictionQueue")
             wire_cfg = {"redis.server.port": server.port,
                         "redis.request.queue": req_q,
-                        "redis.prediction.queue": pred_q}
+                        "redis.prediction.queue": pred_q,
+                        "redis.lease.timeout.s": lease_s}
             loop = RespPredictionLoop(svc, wire_cfg)
             feeder = RespClient(port=server.port, delim=od,
                                 counters=counters)
-            for i, row in enumerate(rows):
-                feeder.lpush(req_q, od.join(["predict", str(i)] + row))
+            msgs = [od.join(["predict", str(i)] + row)
+                    for i, row in enumerate(rows)]
+            if ttl_ms > 0:
+                from ..telemetry import reqtrace
+                msgs = reqtrace.stamp_deadline(msgs, ttl_ms, delim=od)
+            for m in msgs:
+                feeder.lpush(req_q, m)
             feeder.lpush(req_q, "stop")
             loop.run(max_idle_s=30.0)
             out: List[str] = []
@@ -296,6 +351,8 @@ def prediction_service(cfg: Config, in_path: str, out_path: str) -> Counters:
             feeder.close()
         finally:
             server.stop()
+            if journal_root is not None:
+                shutil.rmtree(journal_root, ignore_errors=True)
     elif transport == "inprocess":
         svc.start()
         futures = [svc.submit(row) for row in rows]

@@ -34,7 +34,13 @@ Instrumented ops: ``chunk_read`` (native chunk parse), ``chunk_encode``
 (serving ModelRegistry.publish array payload write), ``cache_write``
 (columnar-cache chunk emit — a fault abandons the build with a warning,
 never the training pass), ``cache_read`` (columnar-cache chunk load — a
-fault degrades the stream to CSV parse with a warning).
+fault degrades the stream to CSV parse with a warning), and the broker
+write-ahead journal trio (io/qjournal, TPU_NOTES §29): ``journal_write``
+(segment append + checkpoint write — a fault degrades the shard to
+in-memory with a warning, availability over durability),
+``journal_fsync`` (the fsync-mode flush), ``journal_replay`` (restart
+recovery entry — a fault/torn tail recovers the intact prefix with a
+warning, never a corrupt record).
 
 The retrain controller (control/controller.py, TPU_NOTES §26) names its
 five stages as fault points for the chaos-drill lane: ``retrain_build``

@@ -24,6 +24,16 @@ contract with no external dependency:
     ``Broker/BrokerShardDown`` counter) — values from a failed push are
     re-routed, never dropped.
 
+Durability + delivery guarantees (ISSUE 17): the server optionally
+journals every accepted mutation (``durable=commit|fsync``,
+``io/qjournal.py``) and replays it on restart, and the ``LEASE`` /
+``ACKPUSH`` verbs replace destructive pops with visibility-timeout
+leases whose ack piggybacks on the batched reply push — at-least-once
+delivery, upgraded to exactly-once EFFECT by request-id reply dedup
+(server-side answered set + the shared consumer-side
+:func:`dedup_replies`).  ``durable=off`` + the classic verbs remain
+byte-identical to the pre-durability wire (pinned by golden tests).
+
 Security note: like stock Redis, there is no auth — bind to loopback
 (the default) or a trusted network only.
 """
@@ -32,17 +42,67 @@ from __future__ import annotations
 
 import bisect
 import hashlib
+import os
 import socket
 import socketserver
 import threading
 import time
 import warnings
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
+from ..core.metrics import Counters
 from ..telemetry import instant
 from ..telemetry import reqtrace
 from . import native_wire
+from . import qjournal
+
+DURABLE_ENV = "AVENIR_TPU_BROKER_DURABLE"
+DURABLE_MODES = ("off", "commit", "fsync")
+
+
+def resolve_durable(value: Optional[str] = None) -> str:
+    """The ``ps.broker.durable`` knob / ``AVENIR_TPU_BROKER_DURABLE``
+    env twin: ``off`` (today's bytes and behavior, the default),
+    ``commit`` (journal write+flush per accepted batch — survives
+    process kill), ``fsync`` (plus fsync — survives power loss)."""
+    mode = (value if value is not None
+            else os.environ.get(DURABLE_ENV) or "off").strip().lower()
+    if mode not in DURABLE_MODES:
+        raise ValueError(
+            f"broker durable mode must be one of {DURABLE_MODES}, "
+            f"got {value!r}")
+    return mode
+
+
+def _lease_rid(value: str, delim: str) -> Optional[str]:
+    """The lease identity of a queued value: request messages
+    (``predict``/``predictq``) lease by their id field; anything else
+    (control words like ``stop``/``reload``, malformed lines) has no
+    identity and is delivered destructively, exactly as before."""
+    parts = value.split(delim, 2)
+    if parts[0] in ("predict", "predictq") and len(parts) > 1 and parts[1]:
+        return parts[1]
+    return None
+
+
+def dedup_replies(values: Sequence[str], delim: str = ","
+                  ) -> Tuple[Dict[str, str], int]:
+    """First-wins reply dedup by request id — the consumer half of the
+    exactly-once contract (at-least-once delivery + idempotent effect).
+    Returns ``({rid: reply_tail}, duplicates_dropped)`` where the tail
+    is the reply with its id stripped (the label for ``<id>,<label>``).
+    Shared by the CLI reply collector, the drills, and any client
+    reassembling replies from the ring."""
+    by_id: Dict[str, str] = {}
+    dups = 0
+    for v in values:
+        rid, _, rest = v.partition(delim)
+        if rid in by_id:
+            dups += 1
+            continue
+        by_id[rid] = rest
+    return by_id, dups
 
 
 # ---------------------------------------------------------------------------
@@ -147,11 +207,58 @@ class _TCPServer(socketserver.ThreadingTCPServer):
 class RespServer:
     """In-memory Redis-list queue server.  ``start()`` binds and serves on
     a daemon thread; ``port`` is resolved after start (pass 0 for an
-    ephemeral port)."""
+    ephemeral port).
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    Durability (ISSUE 17): with ``durable`` in ``commit``/``fsync`` every
+    queue mutation is journaled (``io/qjournal.py``) under ``journal_dir``
+    BEFORE the in-memory deque mutates, and ``start()`` replays the
+    journal — a killed-and-restarted shard (same dir) comes back with
+    exactly the accepted-but-unanswered set.  ``off`` (default) is
+    byte-for-byte today's broker.
+
+    Leases: the ``LEASE`` verb delivers request messages under a
+    visibility-timeout lease instead of a destructive pop (Redis
+    ``RPOPLPUSH``-style reliable delivery).  ``ACKPUSH`` pushes a batch
+    of replies AND acks the leases their request ids held — the ack
+    piggybacks on the reply trip, so the worker's crash window closes
+    without extra round trips.  An expired lease re-enqueues at the POP
+    end (redelivered before fresh traffic — age order), and replies for
+    already-acked ids are dropped server-side (first wins).  Leases work
+    with or without the journal; together they give exactly-once
+    EFFECT without the pushing client re-offering."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 durable: Optional[str] = None,
+                 journal_dir: Optional[str] = None,
+                 counters: Optional[Counters] = None,
+                 acked_cap: int = 65536,
+                 journal_segment_bytes: int = 4 << 20):
         self.host, self.port = host, port
+        self.durable = resolve_durable(durable)
+        if self.durable != "off" and not journal_dir:
+            raise ValueError(
+                f"durable={self.durable!r} needs a journal_dir")
+        self.journal_dir = journal_dir
+        self.counters = counters if counters is not None else Counters()
+        # queues hold (seq, value): seq is the journal identity of one
+        # accepted value — assigned even with the journal off, so leases
+        # and durability compose without a format switch
         self._queues: Dict[str, deque] = {}
+        self._next_seq = 1
+        # queue -> rid -> (seq, value, expiry_monotonic): outstanding
+        # leases; queue -> OrderedDict(rid -> True): answered ids (the
+        # server half of reply dedup), bounded at acked_cap first-in
+        # first-evicted — an id evicted here can in principle dup past
+        # the broker, which is why consumers ALSO dedup (dedup_replies)
+        self._leases: Dict[str, Dict[str, Tuple[int, str, float]]] = {}
+        self._acked: Dict[str, "OrderedDict[str, bool]"] = {}
+        self._acked_cap = int(acked_cap)
+        self._journal: Optional[qjournal.QueueJournal] = None
+        self._journal_segment_bytes = int(journal_segment_bytes)
+        self._journal_errors = 0
+        self.redelivered = 0
+        self.journal_replayed = 0
+        self.dup_replies_dropped = 0
         # a Condition so BRPOP can park its handler thread until an LPUSH
         # arrives (ThreadingTCPServer: blocking one handler blocks only
         # that client's connection); its lock is the queues lock
@@ -175,6 +282,80 @@ class RespServer:
             else:
                 self._conns.discard(conn)
 
+    # ---- durability plumbing ----
+    def _journal_batch(self, payloads: List[bytes]) -> None:
+        """Append encoded records; a journal that cannot write degrades
+        the shard to in-memory with a warning instead of refusing
+        traffic (availability-first — the drills pin replay, not
+        refusal)."""
+        if self._journal is None or not payloads:
+            return
+        try:
+            self._journal.append(payloads)
+        except (OSError, MemoryError) as exc:
+            self._journal_errors += 1
+            self.counters.increment("Broker", "JournalWriteErrors")
+            if self._journal_errors == 1:
+                warnings.warn(
+                    f"respq: journal write failed "
+                    f"({type(exc).__name__}: {exc}); shard continues "
+                    "IN-MEMORY (durability degraded)", RuntimeWarning)
+
+    def _journal_snapshot(self) -> Tuple[dict, dict, int]:
+        """Rotation checkpoint source: every outstanding value — queued
+        OR under lease (leased-not-acked is still unanswered work) —
+        plus the acked-id sets, oldest-first by seq."""
+        with self._lock:
+            queues: Dict[str, List[Tuple[int, str]]] = {
+                k: sorted(q, key=lambda it: it[0])
+                for k, q in self._queues.items()}
+            for k, tab in self._leases.items():
+                if not tab:
+                    continue
+                items = queues.setdefault(k, [])
+                items.extend((seq, v) for seq, v, _exp in tab.values())
+                items.sort(key=lambda it: it[0])
+            acked = {k: list(od) for k, od in self._acked.items() if od}
+            return queues, acked, self._next_seq
+
+    def _trim_acked(self, od: "OrderedDict[str, bool]") -> None:
+        while len(od) > self._acked_cap:
+            od.popitem(last=False)
+
+    # ---- leases ----
+    def _expire_locked(self, key: str) -> List[Tuple[str, str]]:
+        """Re-enqueue expired leases of ``key`` at the POP end (served
+        before fresh traffic — redelivery honors request age).  Returns
+        ``(queue, rid)`` pairs for instant emission OUTSIDE the lock."""
+        tab = self._leases.get(key)
+        if not tab:
+            return []
+        now = time.monotonic()
+        expired = [rid for rid, ent in tab.items() if ent[2] <= now]
+        if not expired:
+            return []
+        q = self._queues.setdefault(key, deque())
+        out = []
+        for rid in expired:
+            seq, v, _exp = tab.pop(rid)
+            q.append((seq, v))
+            out.append((key, rid))
+        self.redelivered += len(out)
+        self.counters.increment("Broker", "Redelivered", len(out))
+        self._lock.notify_all()
+        return out
+
+    def _next_expiry_locked(self, key: str) -> Optional[float]:
+        tab = self._leases.get(key)
+        if not tab:
+            return None
+        return min(ent[2] for ent in tab.values())
+
+    @staticmethod
+    def _note_redelivered(red: List[Tuple[str, str]]) -> None:
+        for key, rid in red:
+            instant("broker.redeliver", cat="broker", queue=key, rid=rid)
+
     # ---- command dispatch (the RESP subset the queue contract uses) ----
     def dispatch(self, args: List[str]) -> bytes:
         cmd = args[0].upper()
@@ -184,8 +365,16 @@ class RespServer:
             if cmd == "LPUSH":
                 with self._lock:
                     q = self._queues.setdefault(args[1], deque())
+                    items = []
                     for v in args[2:]:
-                        q.appendleft(v)
+                        items.append((self._next_seq, v))
+                        self._next_seq += 1
+                    if self._journal is not None:
+                        self._journal_batch([
+                            qjournal.encode_push(seq, args[1], v)
+                            for seq, v in items])
+                    for it in items:
+                        q.appendleft(it)
                     self._lock.notify_all()   # wake parked BRPOP waiters
                     return b":%d\r\n" % len(q)
             if cmd == "BRPOP":
@@ -202,21 +391,36 @@ class RespServer:
                 deadline = None if timeout <= 0 \
                     else time.monotonic() + timeout
                 popped: Optional[str] = None
+                red: List[Tuple[str, str]] = []
                 with self._lock:
                     while not self._killed:
+                        red.extend(self._expire_locked(key))
                         q = self._queues.get(key)
                         if q:
-                            popped = q.pop()
+                            seq, popped = q.pop()
+                            if self._journal is not None:
+                                self._journal_batch(
+                                    [qjournal.encode_ack(seq, key, "")])
                             if not q:
                                 del self._queues[key]
                             break
+                        nxt = self._next_expiry_locked(key)
                         if deadline is None:
-                            self._lock.wait()
+                            if nxt is None:
+                                self._lock.wait()
+                            else:
+                                self._lock.wait(
+                                    max(nxt - time.monotonic(), 0.001))
                         else:
                             remaining = deadline - time.monotonic()
                             if remaining <= 0:
                                 break
+                            if nxt is not None:
+                                remaining = max(
+                                    min(remaining, nxt - time.monotonic()),
+                                    0.001)
                             self._lock.wait(remaining)
+                self._note_redelivered(red)
                 if popped is None:
                     return b"*-1\r\n"
                 k, v = key.encode(), popped.encode()
@@ -228,27 +432,61 @@ class RespServer:
                     # n values (array reply; nil when the list is gone) —
                     # the server half of rpop_many's single round trip
                     n = int(args[2])
+                    red = []
                     with self._lock:
+                        red.extend(self._expire_locked(args[1]))
                         q = self._queues.get(args[1])
                         if not q:
+                            self._note_redelivered(red)
                             return b"*-1\r\n"
                         vals = []
+                        acks = []
                         while q and len(vals) < n:
-                            vals.append(q.pop().encode())
+                            seq, v = q.pop()
+                            if self._journal is not None:
+                                acks.append(qjournal.encode_ack(
+                                    seq, args[1], ""))
+                            vals.append(v.encode())
+                        self._journal_batch(acks)
                         if not q:
                             del self._queues[args[1]]
+                    self._note_redelivered(red)
                     return b"*%d\r\n%s" % (
                         len(vals),
                         b"".join(b"$%d\r\n%s\r\n" % (len(v), v)
                                  for v in vals))
+                red = []
                 with self._lock:
+                    red.extend(self._expire_locked(args[1]))
                     q = self._queues.get(args[1])
                     if not q:
+                        self._note_redelivered(red)
                         return b"$-1\r\n"
-                    v = q.pop().encode()
+                    seq, popped = q.pop()
+                    if self._journal is not None:
+                        self._journal_batch(
+                            [qjournal.encode_ack(seq, args[1], "")])
+                    v = popped.encode()
                     if not q:
                         del self._queues[args[1]]  # Redis drops empty lists
+                self._note_redelivered(red)
                 return b"$%d\r\n%s\r\n" % (len(v), v)
+            if cmd == "LEASE":
+                # LEASE <key> <n> <lease_s> <block_s> [<delim>] — deliver
+                # up to n values under a visibility-timeout lease instead
+                # of a destructive pop (the RPOPLPUSH-equivalent).  A
+                # leased value stays journal-outstanding until ACKPUSH
+                # acks its id; expiry re-enqueues it.  Values without a
+                # lease identity (control words) deliver destructively.
+                # block_s > 0 parks like BRPOP, waking early for lease
+                # expiries so a redelivery never waits out a full park.
+                return self._lease(args)
+            if cmd == "ACKPUSH":
+                # ACKPUSH <pushq> <ackq> <delim> <v...> — push replies
+                # AND ack the leases their request ids hold on <ackq>;
+                # replies whose id was already answered are dropped
+                # (first wins).  ONE trip closes the worker crash window.
+                return self._ackpush(args)
             if cmd == "LLEN":
                 # snapshot under the BRPOP condition, format outside —
                 # depth probes (the autoscaler sensor polls this) must
@@ -261,49 +499,225 @@ class RespServer:
                 # queue-depth observability WITHOUT popping: one bulk
                 # string of "queue_depth:<name>=<n>" lines (every queue,
                 # or just the named ones when keys are given).  The lock
-                # is held only long enough to copy the lengths.
+                # is held only long enough to copy the lengths.  Lease /
+                # journal lines appear ONLY when present, so the default
+                # broker's INFO stays byte-identical.
                 with self._lock:
                     if len(args) > 1:
                         depths = {k: len(self._queues.get(k, ()))
                                   for k in args[1:]}
+                        leased = {k: len(self._leases.get(k, ()))
+                                  for k in args[1:]}
                     else:
                         depths = {k: len(q)
                                   for k, q in self._queues.items()}
-                body = "\n".join(
-                    ["# Queues", f"queues:{len(depths)}"] +
-                    [f"queue_depth:{k}={n}"
-                     for k, n in sorted(depths.items())]).encode()
+                        leased = {k: len(t)
+                                  for k, t in self._leases.items()}
+                lines = (["# Queues", f"queues:{len(depths)}"] +
+                         [f"queue_depth:{k}={n}"
+                          for k, n in sorted(depths.items())])
+                lines += [f"queue_leased:{k}={n}"
+                          for k, n in sorted(leased.items()) if n]
+                if self.durable != "off":
+                    lines.append(f"durable:{self.durable}")
+                    if self._journal is not None:
+                        st = self._journal.stats()
+                        lines += [
+                            f"journal_segments:{st['segments']}",
+                            f"journal_bytes:{st['bytes']}",
+                            f"journal_records:{st['records']}"]
+                body = "\n".join(lines).encode()
                 return b"$%d\r\n%s\r\n" % (len(body), body)
             if cmd == "DEL":
                 with self._lock:
-                    n = sum(1 for k in args[1:] if self._queues.pop(k, None)
-                            is not None)
+                    n = 0
+                    dels = []
+                    for k in args[1:]:
+                        had = self._queues.pop(k, None) is not None
+                        held = self._leases.pop(k, None)
+                        answered = self._acked.pop(k, None)
+                        if had:
+                            n += 1
+                        if (had or held or answered) \
+                                and self._journal is not None:
+                            dels.append(qjournal.encode_del(k))
+                    self._journal_batch(dels)
                 return b":%d\r\n" % n
             return b"-ERR unknown command '%s'\r\n" % cmd.encode()
         except IndexError:
             return b"-ERR wrong number of arguments\r\n"
 
+    def _lease(self, args: List[str]) -> bytes:
+        key = args[1]
+        n = int(args[2])
+        lease_s = float(args[3])
+        block_s = float(args[4])
+        delim = args[5] if len(args) > 5 else ","
+        deadline = None if block_s <= 0 else time.monotonic() + block_s
+        out: List[bytes] = []
+        red: List[Tuple[str, str]] = []
+        with self._lock:
+            while not self._killed:
+                red.extend(self._expire_locked(key))
+                q = self._queues.get(key)
+                if q:
+                    tab = self._leases.setdefault(key, {})
+                    answered = self._acked.get(key)
+                    jr = self._journal is not None
+                    recs: List[bytes] = []
+                    while q and len(out) < n:
+                        seq, v = q.pop()
+                        rid = _lease_rid(v, delim)
+                        if rid is not None and answered \
+                                and rid in answered:
+                            # a redelivered copy raced its own ack:
+                            # retire it instead of double-serving
+                            if jr:
+                                recs.append(
+                                    qjournal.encode_ack(seq, key, ""))
+                            continue
+                        if rid is not None and lease_s > 0:
+                            tab[rid] = (seq, v,
+                                        time.monotonic() + lease_s)
+                        elif jr:
+                            recs.append(qjournal.encode_ack(seq, key, ""))
+                        out.append(v.encode())
+                    if not q:
+                        del self._queues[key]
+                    self._journal_batch(recs)
+                    if out:
+                        break
+                if deadline is None:
+                    break   # non-blocking
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                nxt = self._next_expiry_locked(key)
+                if nxt is not None:
+                    remaining = max(min(remaining,
+                                        nxt - time.monotonic()), 0.001)
+                self._lock.wait(remaining)
+        self._note_redelivered(red)
+        if not out:
+            return b"*-1\r\n"
+        return b"*%d\r\n%s" % (
+            len(out),
+            b"".join(b"$%d\r\n%s\r\n" % (len(v), v) for v in out))
+
+    def _ackpush(self, args: List[str]) -> bytes:
+        pushq, ackq, delim = args[1], args[2], args[3]
+        values = args[4:]
+        dups = 0
+        with self._lock:
+            tab = self._leases.get(ackq)
+            answered = self._acked.setdefault(ackq, OrderedDict())
+            jr = self._journal is not None
+            recs: List[bytes] = []
+            accepted: List[str] = []
+            for v in values:
+                rid = v.split(delim, 1)[0]
+                if rid in answered:
+                    dups += 1   # first reply won; drop the duplicate
+                    continue
+                ent = tab.pop(rid, None) if tab else None
+                # journal the ack even with no lease held HERE (a
+                # destructively-popped or cross-shard request): the
+                # answered-set must survive restart for dedup to hold
+                if jr:
+                    recs.append(qjournal.encode_ack(
+                        ent[0] if ent is not None else 0, ackq, rid))
+                answered[rid] = True
+                accepted.append(v)
+            self._trim_acked(answered)
+            q = self._queues.setdefault(pushq, deque())
+            items = []
+            for v in accepted:
+                items.append((self._next_seq, v))
+                self._next_seq += 1
+                if jr:
+                    recs.append(
+                        qjournal.encode_push(items[-1][0], pushq, v))
+            self._journal_batch(recs)
+            for it in items:
+                q.appendleft(it)
+            if not q:
+                self._queues.pop(pushq, None)
+            self._lock.notify_all()
+            depth = len(q)
+        if dups:
+            self.dup_replies_dropped += dups
+            self.counters.increment("Broker", "DupRepliesDropped", dups)
+        return b":%d\r\n" % depth
+
     def start(self) -> "RespServer":
+        replayed = None
+        if self.durable != "off" and self._journal is None:
+            self._journal = qjournal.QueueJournal(
+                self.journal_dir, mode=self.durable,
+                segment_bytes=self._journal_segment_bytes)
+            replayed = self._journal.replay()
+            with self._lock:
+                for k, items in replayed.queues.items():
+                    # items are oldest-first; the deque pops from the
+                    # RIGHT, so newest go leftmost
+                    self._queues[k] = deque(reversed(items))
+                for k, ids in replayed.acked.items():
+                    od = self._acked.setdefault(k, OrderedDict())
+                    for rid in ids:
+                        od[rid] = True
+                    self._trim_acked(od)
+                self._next_seq = max(self._next_seq, replayed.next_seq)
+            self._journal.snapshot_provider = self._journal_snapshot
+            self._journal.open_for_append()
+            self.journal_replayed += replayed.restored
+            self.counters.increment("Broker", "JournalReplayed",
+                                    replayed.restored)
         self._server = _TCPServer((self.host, self.port), _Handler)
         self._server.owner = self  # type: ignore[attr-defined]
         self.port = self._server.server_address[1]
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True)
         self._thread.start()
+        if replayed is not None and (replayed.records or replayed.restored
+                                     or replayed.torn):
+            instant("broker.journal_replay", cat="broker",
+                    endpoint=f"{self.host}:{self.port}",
+                    records=replayed.records, restored=replayed.restored,
+                    torn=int(replayed.torn))
         return self
 
-    def stop(self) -> None:
+    def _stop_listener(self) -> None:
         if self._server is not None:
             self._server.shutdown()
             self._server.server_close()
             self._server = None
 
+    def stop(self) -> None:
+        """Graceful teardown: close the listener, then compact + sync +
+        close the journal so the NEXT start replays from the checkpoint
+        alone (cheap restart)."""
+        self._stop_listener()
+        if self._journal is not None:
+            with self._lock:
+                try:
+                    self._journal.checkpoint()
+                    self._journal.sync()
+                except Exception as exc:  # noqa: BLE001 - teardown
+                    warnings.warn(
+                        f"respq: journal shutdown checkpoint failed "
+                        f"({type(exc).__name__}: {exc}); next start "
+                        "replays the segments instead", RuntimeWarning)
+                self._journal.close()
+
     def kill(self) -> None:
         """Die like a crashed broker process: stop listening AND sever
         every established client connection (their next call raises),
         dropping the in-memory queues.  ``stop()`` is the graceful
-        teardown; this is what the killed-shard drills simulate."""
-        self.stop()
+        teardown; this is what the killed-shard drills simulate.  The
+        journal is ABANDONED exactly where the crash left it (no
+        checkpoint, no sync — a possibly-torn tail): a new server on the
+        same ``journal_dir`` replays it."""
+        self._stop_listener()
         with self._conns_lock:
             conns = list(self._conns)
         for conn in conns:
@@ -323,7 +737,47 @@ class RespServer:
         with self._lock:
             self._killed = True
             self._queues.clear()
+            self._leases.clear()
+            self._acked.clear()
             self._lock.notify_all()
+        if self._journal is not None:
+            self._journal.close()   # file handle only; no checkpoint
+
+    # ---- observability ----
+    def journal_stats(self) -> dict:
+        return {} if self._journal is None else self._journal.stats()
+
+    def bind_metrics(self, registry, endpoint: Optional[str] = None):
+        """Export broker durability state on a ``MetricsRegistry``:
+        queue/lease depths, redeliveries, and journal bytes/segments/
+        fsync latency as a labeled gauge family, plus the Broker/*
+        counters via ``attach_counters``.  Returns the probe (for
+        ``unregister_probe`` at teardown)."""
+        ep = endpoint or f"{self.host}:{self.port}"
+        g = registry.gauge(
+            "avenir_broker_durable",
+            "durable broker state (io/respq.py RespServer)",
+            labels=("endpoint", "key"))
+
+        def probe():
+            with self._lock:
+                depth = sum(len(q) for q in self._queues.values())
+                leased = sum(len(t) for t in self._leases.values())
+            g.set(depth, endpoint=ep, key="queue_depth")
+            g.set(leased, endpoint=ep, key="leased")
+            g.set(self.redelivered, endpoint=ep, key="redelivered")
+            g.set(self.journal_replayed, endpoint=ep,
+                  key="journal_replayed")
+            if self._journal is not None:
+                st = self._journal.stats()
+                g.set(st["bytes"], endpoint=ep, key="journal_bytes")
+                g.set(st["segments"], endpoint=ep,
+                      key="journal_segments")
+                g.set(st["fsync_ms_ema"], endpoint=ep,
+                      key="journal_fsync_ms")
+        registry.register_probe(probe)
+        registry.attach_counters(self.counters)
+        return probe
 
 
 # ---------------------------------------------------------------------------
@@ -355,6 +809,12 @@ class RespClient:
         self.timeout = float(timeout)
         self._reconnect = bool(reconnect)
         self._rpop_count_ok = True
+        # LEASE/ACKPUSH are this broker's verbs; against a real Redis
+        # (or a pre-lease server) the first -ERR permanently falls back
+        # to the destructive rpop/lpush path — same pattern as
+        # _rpop_count_ok
+        self._lease_ok = True
+        self._ackpush_ok = True
         # request-trace stamping (ISSUE 15): with ps.trace.sample set,
         # every Nth predict push gets the wire trace field at THIS
         # client.  ``stamp=False`` is for inner clients whose owner
@@ -549,6 +1009,60 @@ class RespClient:
             raise first_err
         return out
 
+    def lease_many(self, queue: str, n: int, lease_s: float,
+                   block_s: float = 0.0) -> List[str]:
+        """Acquire up to ``n`` values under a visibility-timeout lease
+        (``LEASE``) — the at-least-once replacement for
+        :meth:`rpop_many`: a worker that dies before acking gets its
+        values redelivered after ``lease_s``.  ``block_s > 0`` parks on
+        the server like BRPOP (must stay under the socket timeout).
+
+        Unlike a destructive read, a LEASE is SAFE to re-issue after a
+        connection drop: values the lost exchange leased simply expire
+        and redeliver.  Against a server without the verb (real Redis)
+        this falls back permanently to ``rpop_many`` (+ ``brpop`` for
+        the park) — delivery is then destructive, as before."""
+        if n <= 0:
+            return []
+        if block_s > 0 and not block_s < self.timeout:
+            raise ValueError(
+                f"lease_many block_s must stay under the client socket "
+                f"timeout ({self.timeout}); got {block_s!r}")
+        if self._lease_ok:
+            try:
+                reply = self._call("LEASE", queue, str(int(n)),
+                                   repr(float(lease_s)),
+                                   repr(float(block_s)), self._delim)
+            except RuntimeError:
+                self._lease_ok = False
+            else:
+                return [] if reply is None else list(reply)
+        vals = self.rpop_many(queue, n)
+        if vals or block_s <= 0:
+            return vals
+        v = self.brpop(queue, block_s)
+        return [] if v is None else [v]
+
+    def ackpush(self, push_queue: str, ack_queue: str,
+                values: List[str]) -> int:
+        """Push a reply batch AND ack the leases its request ids hold on
+        ``ack_queue`` — ONE round trip (``ACKPUSH``), so the ack
+        piggybacks on the reply push the worker already makes.  Replies
+        for already-answered ids are dropped server-side (first wins).
+        Safe to re-issue after a drop: a double-delivered ack batch
+        dedups on the answered set.  Falls back permanently to plain
+        :meth:`lpush_many` (no ack, no dedup) against a server without
+        the verb."""
+        if not values:
+            return 0
+        if self._ackpush_ok:
+            try:
+                return int(self._call("ACKPUSH", push_queue, ack_queue,
+                                      self._delim, *values))
+            except RuntimeError:
+                self._ackpush_ok = False
+        return self.lpush_many(push_queue, values)
+
     def llen(self, queue: str) -> int:
         return int(self._call("LLEN", queue))
 
@@ -665,9 +1179,22 @@ class ShardedRespClient:
         if not eps:
             raise ValueError("need at least one broker endpoint")
         self._delim = delim
+        self._timeout = float(timeout)
         self.counters = counters
         self._clients: Dict[str, RespClient] = {}
         self._down: List[str] = []
+        # rid -> endpoint it was LEASED from, so the piggybacked ack
+        # reaches the shard actually holding the lease even after ring
+        # membership changed in between; bounded first-in first-evicted
+        # (an evicted entry just means the ack routes by ring lookup
+        # and the lease expires into a redelivery — dedup absorbs it)
+        self._lease_src: "OrderedDict[str, str]" = OrderedDict()
+        self._lease_src_cap = 65536
+        # a down shard is probed for REJOIN at most once per interval:
+        # the kill-and-restart drill needs the restarted shard (journal
+        # replayed) to re-enter the ring without rebuilding every client
+        self.rejoin_interval_s = 1.0
+        self._last_rejoin = 0.0
         live: List[str] = []
         first_err: Optional[BaseException] = None
         for ep in eps:
@@ -743,6 +1270,53 @@ class ShardedRespClient:
                 f"broker: last shard {ep} is down "
                 f"({type(exc).__name__}: {exc})") from exc
 
+    def _maybe_rejoin(self) -> None:
+        """Probe down shards (rate-limited) and fold a revived one back
+        into the ring — the client half of the killed-and-restarted
+        shard drill: a shard that came back with its journal replayed
+        re-owns its id range (consistent hashing: only ids that hashed
+        to it move back; every surviving assignment stays put)."""
+        if not self._down:
+            return
+        now = time.monotonic()
+        # rate-limited while the ring still has survivors; when EVERY
+        # shard is down there is nothing left to throttle for — probe
+        # on every verb so a restarted shard is folded back the moment
+        # it binds (the fleet's broker-outage grace retry depends on
+        # this to recover from a total ring loss)
+        if self._ring.endpoints and \
+                now - self._last_rejoin < self.rejoin_interval_s:
+            return
+        self._last_rejoin = now
+        for ep in list(self._down):
+            host, _, port = ep.rpartition(":")
+            try:
+                cli = RespClient(host or "127.0.0.1", int(port),
+                                 timeout=self._timeout, delim=self._delim,
+                                 counters=self.counters, stamp=False)
+            except OSError:
+                continue
+            self._down.remove(ep)
+            self._clients[ep] = cli
+            self._ring = HashRing(self._ring.endpoints + [ep],
+                                  replicas=self._ring.replicas)
+            if self.counters is not None:
+                self.counters.increment("Broker", "BrokerShardUp")
+            instant("broker.shard_up", cat="broker", endpoint=ep,
+                    survivors=len(self._ring.endpoints))
+            warnings.warn(
+                f"broker: shard {ep} is back; rejoined the ring "
+                f"({len(self._ring.endpoints)} shard(s) live)",
+                RuntimeWarning)
+
+    def _note_leased(self, values: List[str], ep: str) -> None:
+        for v in values:
+            rid = _lease_rid(v, self._delim)
+            if rid is not None:
+                self._lease_src[rid] = ep
+        while len(self._lease_src) > self._lease_src_cap:
+            self._lease_src.popitem(last=False)
+
     # ---- fan-out verbs ----
     def ping(self) -> bool:
         """True when every LIVE shard answers PONG.  Like every other
@@ -750,6 +1324,7 @@ class ShardedRespClient:
         (warning + counter) instead of crashing the caller — a liveness
         probe that raises on exactly the condition it probes for would
         be useless; the last shard dying still raises."""
+        self._maybe_rejoin()
         ok = True
         for ep in self.live_endpoints:
             if ep not in self._clients:
@@ -770,6 +1345,7 @@ class ShardedRespClient:
         group re-routes onto the survivors (accepted values are never
         dropped by the client).  Returns the summed post-push depth of
         the touched shards."""
+        self._maybe_rejoin()
         total = 0
         pending = list(values)
         while pending:
@@ -816,6 +1392,7 @@ class ShardedRespClient:
         shard degrades the ring; the poll continues on the survivors."""
         if n <= 0:
             return []
+        self._maybe_rejoin()
         out: List[str] = []
         eps = self.live_endpoints
         self._rr += 1
@@ -832,12 +1409,94 @@ class ShardedRespClient:
                 break
         return out
 
+    def lease_many(self, queue: str, n: int, lease_s: float,
+                   block_s: float = 0.0) -> List[str]:
+        """Lease up to ``n`` values across the ring: one non-blocking
+        LEASE sweep from a rotating start, then (idle + ``block_s``) a
+        blocking LEASE on ONE rotating shard — the at-least-once drain.
+        Records which shard leased each id so the piggybacked ack
+        (:meth:`ackpush`) routes back to the lease holder."""
+        if n <= 0:
+            return []
+        self._maybe_rejoin()
+        out: List[str] = []
+        eps = self.live_endpoints
+        self._rr += 1
+        start = self._rr
+        for i in range(len(eps)):
+            ep = eps[(start + i) % len(eps)]
+            cli = self._clients.get(ep)
+            if cli is None:
+                continue
+            try:
+                got = cli.lease_many(queue, n - len(out), lease_s)
+            except (ConnectionError, OSError) as exc:
+                self._mark_down(ep, exc)
+                continue
+            self._note_leased(got, ep)
+            out.extend(got)
+            if len(out) >= n:
+                break
+        if out or block_s <= 0:
+            return out
+        eps = self.live_endpoints
+        if not eps:
+            raise RuntimeError("broker ring is empty (every shard down)")
+        self._rr += 1
+        ep = eps[self._rr % len(eps)]
+        cli = self._clients.get(ep)
+        if cli is None:
+            return []
+        try:
+            got = cli.lease_many(queue, n, lease_s, block_s)
+        except (ConnectionError, OSError) as exc:
+            self._mark_down(ep, exc)
+            return []
+        self._note_leased(got, ep)
+        return got
+
+    def ackpush(self, push_queue: str, ack_queue: str,
+                values: List[str]) -> int:
+        """Reply push + lease ack, grouped by the shard each id was
+        LEASED from (falling back to ring lookup when unknown).  A
+        shard failing mid-ack degrades the ring and its replies
+        re-route to the survivors — the reply is never dropped; the
+        orphaned lease expires into a redelivery that the answered-set
+        (or the consumer-side :func:`dedup_replies`) absorbs."""
+        if not values:
+            return 0
+        self._maybe_rejoin()
+        total = 0
+        pending = list(values)
+        while pending:
+            groups: Dict[str, List[str]] = {}
+            for v in pending:
+                rid = v.split(self._delim, 1)[0]
+                ep = self._lease_src.get(rid)
+                if ep is None or ep not in self._clients:
+                    ep = self._ring.lookup(self.id_of(v))
+                groups.setdefault(ep, []).append(v)
+            pending = []
+            for ep, vals in groups.items():
+                try:
+                    total += self._clients[ep].ackpush(
+                        push_queue, ack_queue, vals)
+                except (ConnectionError, OSError) as exc:
+                    self._mark_down(ep, exc)   # raises when ring empties
+                    pending.extend(vals)
+                else:
+                    for v in vals:
+                        self._lease_src.pop(
+                            v.split(self._delim, 1)[0], None)
+        return total
+
     def brpop(self, queue: str, timeout_s: float = 0.05) -> Optional[str]:
         """Park-when-idle over the ring: one non-blocking sweep first,
         then a real BRPOP on ONE rotating shard for the timeout.  A
         value landing on a different shard during the park is picked up
         at the next poll — bounded by ``timeout_s``, which the fleet
         keeps in the low milliseconds."""
+        self._maybe_rejoin()
         vs = self.rpop_many(queue, 1)
         if vs:
             return vs[0]
@@ -868,6 +1527,7 @@ class ShardedRespClient:
         """Per-shard per-queue depths via INFO (no popping):
         ``{endpoint: {queue: depth}}`` — the observable the autoscaler
         sensor and the killed-shard bench read."""
+        self._maybe_rejoin()
         out: Dict[str, Dict[str, int]] = {}
         for ep in self.live_endpoints:
             if ep not in self._clients:

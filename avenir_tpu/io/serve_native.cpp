@@ -203,6 +203,21 @@ inline int parse_trace_field(const char* p, const char* e,
     return 1;
 }
 
+// telemetry/reqtrace._DEADLINE_RE, compiled to C: ^d=(\d+)$
+// Returns 1 matched — a WELL-FORMED deadline field (ISSUE 17): the
+// whole batch punts to python, which owns deadline shedding (the late
+// reply, the Broker/LateShed counter).  0 = not a deadline field (an
+// ordinary feature value — same backward-compat rule as the trace
+// field).  Width does not matter here: any all-digit tail is
+// well-formed to python's arbitrary-width \d+, and the action for
+// every match is the same fallback.
+inline int parse_deadline_field(const char* p, const char* e) {
+    if (e - p < 3 || p[0] != 'd' || p[1] != '=') return 0;
+    for (const char* d = p + 2; d < e; ++d)
+        if (*d < '0' || *d > '9') return 0;
+    return 1;
+}
+
 // serving/quantized.py wire-int grammar: canonical signed decimal int8 —
 // "0" or -?[1-9][0-9]{0,2}, value in [-128, 127].  No "-0", no leading
 // zeros, no '+', no whitespace: the golden-bytes pin freezes this form.
@@ -373,6 +388,14 @@ int32_t awp_parse(const char* buf, int64_t buf_len, int64_t n_msgs,
                 if (tr < 0) return AWP_FALLBACK;
                 if (tr == 1) body = 3;
             }
+            // optional deadline field next (reqtrace.
+            // split_predict_deadline's len(parts) >= i+2 rule): a
+            // well-formed one punts the batch to python, which owns
+            // deadline shedding; a near-miss is an ordinary feature
+            if (n_tok >= body + 2
+                && parse_deadline_field(fields[body].first,
+                                        fields[body].second))
+                return AWP_FALLBACK;
             const size_t n_fields = n_tok - body;
             if (!quant) {
                 if (static_cast<int32_t>(n_fields) < min_fields)

@@ -53,6 +53,7 @@ import threading
 import time
 import warnings
 from collections import deque
+from concurrent.futures import Future
 from typing import Callable, Dict, List, Optional, Sequence
 
 from ..core.metrics import Counters
@@ -66,7 +67,7 @@ class _Worker:
     """One fleet member: service + wire connection + drain thread."""
 
     __slots__ = ("index", "name", "service", "client", "thread",
-                 "seen_gen", "pending", "parked")
+                 "seen_gen", "pending", "parked", "down_since", "unsent")
 
     def __init__(self, index: int, name: str, service: PredictionService):
         self.index = index
@@ -79,6 +80,12 @@ class _Worker:
         # service batches complete in order, so FIFO head-flush is
         # completion order
         self.pending: "deque[tuple]" = deque()
+        # broker-outage grace: when the WHOLE ring is unreachable the
+        # drain parks and retries (down_since starts the grace clock);
+        # replies whose push failed mid-outage wait in unsent rather
+        # than being dropped
+        self.down_since: Optional[float] = None
+        self.unsent: List[str] = []
         # autoscaler parking: a parked worker stops PULLING but keeps
         # its warm service (compiled buckets resident) so unparking is
         # instant — distinct from degraded parking (health stays OK)
@@ -107,6 +114,7 @@ class ServingFleet:
                  latency_window: int = 8192,
                  idle_sleep_s: float = 0.002,
                  max_idle_sleep_s: float = 0.05,
+                 broker_grace_s: float = 10.0,
                  quantized: bool = False,
                  host_label: Optional[str] = None,
                  wire_native: str = "auto"):
@@ -136,6 +144,13 @@ class ServingFleet:
         self._latency_window = int(latency_window)
         self.idle_sleep_s = float(idle_sleep_s)
         self.max_idle_sleep_s = float(max_idle_sleep_s)
+        # total-ring-loss grace: a kill-and-restart drill routinely
+        # leaves EVERY shard unreachable for a beat (the replacement is
+        # still binding / replaying its journal), and the sharded
+        # client recovers on its own once one comes back — so a drain
+        # thread parks and retries for this long before treating the
+        # outage as permanent and exiting
+        self.broker_grace_s = float(broker_grace_s)
         self.host = cfg.get("redis.server.host", "127.0.0.1")
         self.port = int(cfg.get("redis.server.port", 6379))
         # the broker ring: with redis.server.endpoints listing M shards
@@ -145,6 +160,14 @@ class ServingFleet:
         self.request_q = cfg.get("redis.request.queue", "requestQueue")
         self.prediction_q = cfg.get("redis.prediction.queue",
                                     "predictionQueue")
+        # ps.broker.lease.timeout.s (ISSUE 17): > 0 switches the drain
+        # to leased at-least-once delivery — requests are acquired
+        # under a visibility-timeout LEASE and acked by the reply
+        # ACKPUSH, so a worker killed mid-batch redelivers instead of
+        # stranding its popped requests.  0 (default) keeps the classic
+        # destructive rpop/brpop/lpush path, byte for byte.
+        self.lease_timeout_s = float(
+            cfg.get("redis.lease.timeout.s", 0.0) or 0.0)
         # multi-host identity: labels every worker's metric series and
         # rides stats() so N fleets scraped into one registry stay
         # disjoint (None = single-host, this process's hostname)
@@ -436,44 +459,65 @@ class ServingFleet:
                     time.sleep(self.max_idle_sleep_s)
                     continue
                 try:
-                    msgs = w.client.rpop_many(self.request_q,
-                                              svc.policy.max_batch)
+                    if self.lease_timeout_s > 0:
+                        msgs = w.client.lease_many(self.request_q,
+                                                   svc.policy.max_batch,
+                                                   self.lease_timeout_s)
+                    else:
+                        msgs = w.client.rpop_many(self.request_q,
+                                                  svc.policy.max_batch)
                 except (ConnectionError, OSError, RuntimeError) as exc:
                     # a sharded client degrades around ONE dead shard on
                     # its own; reaching here means the whole broker tier
-                    # is unreachable — answer what was accepted and exit
-                    # this worker with a structured warning
-                    warnings.warn(
-                        f"fleet {w.name}: broker unreachable "
-                        f"({type(exc).__name__}: {exc}); worker exiting",
-                        RuntimeWarning)
-                    break
+                    # is unreachable RIGHT NOW — park and retry within
+                    # the grace window (a restarting shard rejoins the
+                    # ring on a later verb), exit only when it stays gone
+                    if self._broker_gone(w, exc):
+                        break
+                    continue
+                w.down_since = None
                 svc.counters.increment("Serving", "Polls")
                 if msgs:
                     sleep_s = self.idle_sleep_s
                     self._ingest(w, msgs)
                 else:
                     svc.counters.increment("Serving", "EmptyPolls")
-                    self._flush(w, wait=False)
+                    try:
+                        self._flush(w, wait=False)
+                    except (ConnectionError, OSError,
+                            RuntimeError) as exc:
+                        if self._broker_gone(w, exc):
+                            break
+                        continue
                     # park on the server instead of spin-polling; keep
                     # the park short while replies are still pending so
                     # a batch finishing mid-park is flushed promptly
                     park = 0.001 if w.pending else sleep_s
                     try:
-                        v = w.client.brpop(self.request_q, timeout_s=park)
+                        if self.lease_timeout_s > 0:
+                            got = w.client.lease_many(
+                                self.request_q, 1, self.lease_timeout_s,
+                                block_s=park)
+                            v = got[0] if got else None
+                        else:
+                            v = w.client.brpop(self.request_q,
+                                               timeout_s=park)
                     except (ConnectionError, OSError,
                             RuntimeError) as exc:
-                        warnings.warn(
-                            f"fleet {w.name}: broker unreachable "
-                            f"({type(exc).__name__}: {exc}); worker "
-                            f"exiting", RuntimeWarning)
-                        break
+                        if self._broker_gone(w, exc):
+                            break
+                        continue
+                    w.down_since = None
                     if v is not None:
                         sleep_s = self.idle_sleep_s
                         self._ingest(w, [v])
                     elif not w.pending:
                         sleep_s = min(sleep_s * 2.0, self.max_idle_sleep_s)
-                self._flush(w, wait=False)
+                try:
+                    self._flush(w, wait=False)
+                except (ConnectionError, OSError, RuntimeError) as exc:
+                    if self._broker_gone(w, exc):
+                        break
             # drain-then-stop: the single-queue FIFO invariant
             # ("everything queued before the stop was already popped")
             # does NOT hold across a shard ring — the stop lands on ONE
@@ -509,6 +553,35 @@ class ServingFleet:
                 warnings.warn(f"fleet {w.name}: final flush failed "
                               f"({type(exc).__name__}: {exc})",
                               RuntimeWarning)
+
+    def _broker_gone(self, w: _Worker, exc: BaseException) -> bool:
+        """Total-ring-loss triage for a drain thread: every broker shard
+        is unreachable at this instant.  A kill-and-restart drill passes
+        through this state routinely (the replacement shard needs a beat
+        to bind and replay its journal) and the sharded client CAN
+        recover — its rejoin probe folds a revived shard back into the
+        ring on a later verb — so park briefly and retry; only a ring
+        that stays empty past ``broker_grace_s`` is a real outage, and
+        then the worker exits (answering what it already accepted).
+        Returns True when the worker should exit."""
+        now = time.monotonic()
+        if w.down_since is None:
+            w.down_since = now
+            warnings.warn(
+                f"fleet {w.name}: broker tier unreachable "
+                f"({type(exc).__name__}: {exc}); parking to retry for "
+                f"up to {self.broker_grace_s:.0f}s", RuntimeWarning)
+        w.service.counters.increment("Serving", "BrokerRetries")
+        if now - w.down_since >= self.broker_grace_s:
+            warnings.warn(
+                f"fleet {w.name}: broker unreachable for "
+                f"{now - w.down_since:.1f}s ({type(exc).__name__}: "
+                f"{exc}); worker exiting", RuntimeWarning)
+            return True
+        if self._stop.is_set():
+            return True   # stopping anyway — don't sit out the grace
+        time.sleep(0.05)
+        return False
 
     def _ingest(self, w: _Worker, msgs: List[str]) -> None:
         svc = w.service
@@ -552,13 +625,25 @@ class ServingFleet:
                 # request (optional wire trace field, ISSUE 15) gets its
                 # worker-pop flow step here and rides its context into
                 # the service batch.
-                rid, row, ctx = reqtrace.split_predict(parts)
+                rid, row, ctx, deadline_us = \
+                    reqtrace.split_predict_deadline(parts)
                 if ctx is not None:
                     ctx.t_pop_us = reqtrace.now_us()
                     reqtrace.emit_flow("t", rid, "pop",
                                        ts_us=ctx.t_pop_us,
                                        worker=w.name,
                                        host=self.host_label)
+                if deadline_us is not None \
+                        and reqtrace.now_us() > deadline_us:
+                    # deadline-aware admission (ISSUE 17): past-deadline
+                    # requests — fresh, replayed, or redelivered —
+                    # answer late BEFORE a device dispatch, so a
+                    # replayed backlog can't brown out fresh traffic
+                    svc.counters.increment("Broker", "LateShed")
+                    fut: "Future[str]" = Future()
+                    fut.set_result(svc.late_label)
+                    w.pending.append((rid, fut, ctx))
+                    continue
                 w.pending.append(
                     (rid, svc.submit(row, trace=ctx, sample_local=False),
                      ctx))
@@ -575,7 +660,11 @@ class ServingFleet:
         blocks until every pending future resolved (shutdown / parking);
         ``wait=False`` only flushes the done head."""
         svc = w.service
-        replies: List[str] = []
+        # replies whose push failed during a broker outage were parked
+        # in w.unsent — re-offer them ahead of the newly completed head
+        # (they are older, so FIFO order is preserved)
+        replies: List[str] = w.unsent
+        w.unsent = []
         traced = None
         while w.pending:
             rid, fut, ctx = w.pending[0]
@@ -594,7 +683,23 @@ class ServingFleet:
                 traced.append(ctx)
             w.pending.popleft()
         if replies:
-            w.client.lpush_many(self.prediction_q, replies)
+            try:
+                if self.lease_timeout_s > 0:
+                    # the ack piggybacks on the reply push (ONE trip):
+                    # every answered request's lease is released, and a
+                    # duplicate answer (redelivery race) is dropped
+                    # broker-side
+                    w.client.ackpush(self.prediction_q, self.request_q,
+                                     replies)
+                else:
+                    w.client.lpush_many(self.prediction_q, replies)
+            except (ConnectionError, OSError, RuntimeError):
+                # broker tier momentarily gone: an ANSWERED request is
+                # never dropped — buffer the replies on the worker and
+                # let the drain loop's grace retry re-offer them once a
+                # shard rejoins the ring
+                w.unsent = replies
+                raise
             if traced:
                 # the replies are actually on the wire now: stamp the
                 # reply-push time and close each sampled request's flow

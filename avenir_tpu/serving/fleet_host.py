@@ -38,6 +38,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import signal
 import sys
 import time
 
@@ -63,6 +64,11 @@ def _parse_args(argv):
     ap.add_argument("--autoscale-interval-s", type=float, default=0.25)
     ap.add_argument("--request-queue", default="requestQueue")
     ap.add_argument("--prediction-queue", default="predictionQueue")
+    ap.add_argument("--lease-timeout-s", type=float, default=0.0,
+                    help="drain under visibility-timeout leases with "
+                         "this expiry (at-least-once + broker-side "
+                         "reply dedup = exactly-once effect); 0 keeps "
+                         "the classic destructive-pop wire path")
     ap.add_argument("--max-idle-s", type=float, default=30.0)
     ap.add_argument("--metrics-port", type=int, default=-1)
     ap.add_argument("--metrics-host", default="127.0.0.1")
@@ -107,7 +113,8 @@ def main(argv=None) -> int:
 
     wire_cfg = {"redis.server.endpoints": args.endpoints,
                 "redis.request.queue": args.request_queue,
-                "redis.prediction.queue": args.prediction_queue}
+                "redis.prediction.queue": args.prediction_queue,
+                "redis.lease.timeout.s": args.lease_timeout_s}
     scale = None
     n_workers = args.workers
     if args.autoscale:
@@ -168,15 +175,34 @@ def main(argv=None) -> int:
             interval_s=args.autoscale_interval_s,
             counters=fleet.workers[0].service.counters).start()
     rc = 0
+    # graceful SIGTERM (ISSUE 17): break the wait loop instead of dying
+    # mid-batch, so the finally path below runs fleet.stop() — pending
+    # replies flushed (acking their leases in lease mode), accepted
+    # requests answered, connections torn down — before the process
+    # exits.  SIGKILL remains the chaos-drill crash; its leases expire
+    # and redeliver broker-side.
+    sigterm = {"hit": False}
+
+    def _on_sigterm(signum, frame):  # noqa: ARG001 - signal signature
+        sigterm["hit"] = True
+
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except (ValueError, OSError):
+        pass  # not the main thread / platform without SIGTERM
     try:
         if args.ready_file:
             with open(args.ready_file, "w") as fh:
                 fh.write("ready\n")
         # wait for a wire stop (fleet.wait returns once every drain
-        # thread exited) or the idle timeout
+        # thread exited), SIGTERM, or the idle timeout
         idle_since = time.monotonic()
         last_served = -1
         while not fleet.wait(timeout_s=0.5):
+            if sigterm["hit"]:
+                print("fleet_host: SIGTERM, draining and exiting",
+                      file=sys.stderr)
+                break
             served = fleet.stats()["served"]
             if served != last_served:
                 last_served = served

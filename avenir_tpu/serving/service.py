@@ -209,6 +209,7 @@ class PredictionService:
                  ambiguous_label: str = AMBIGUOUS,
                  error_label: str = "error",
                  busy_label: str = "busy",
+                 late_label: str = "late",
                  name: Optional[str] = None,
                  host_label: Optional[str] = None,
                  monitor=None,
@@ -238,6 +239,11 @@ class PredictionService:
         self.ambiguous_label = ambiguous_label
         self.error_label = error_label
         self.busy_label = busy_label
+        # deadline-aware admission (ISSUE 17): a request whose wire
+        # deadline field has passed answers this label BEFORE any
+        # device dispatch — a replayed/redelivered backlog sheds its
+        # stale tail cheaply instead of browning out fresh traffic
+        self.late_label = late_label
         # identity for metrics/health series (fleet workers get w0/w1/...);
         # defaults to the model name in bind_metrics
         self.name = name
@@ -701,10 +707,11 @@ class PredictionService:
                 is_predict = parts[0] == "predict"
                 if (is_predict or parts[0] == QUANTIZED_VERB) \
                         and len(parts) >= 3:
-                    # the optional wire trace field (ISSUE 15) is
-                    # stripped whether sampled or not; absent = the old
-                    # message layout, byte for byte
-                    rid, row, ctx = reqtrace.split_predict(parts)
+                    # the optional wire trace + deadline fields (ISSUE
+                    # 15/17) are stripped whether acted on or not;
+                    # absent = the old message layout, byte for byte
+                    rid, row, ctx, deadline_us = \
+                        reqtrace.split_predict_deadline(parts)
                     if ctx is not None:
                         ctx.t_pop_us = reqtrace.now_us()
                         reqtrace.emit_flow("t", rid, "pop",
@@ -712,6 +719,12 @@ class PredictionService:
                         if traced is None:
                             traced = []
                         traced.append(ctx)
+                    if deadline_us is not None \
+                            and reqtrace.now_us() > deadline_us:
+                        # past deadline: answer late, never dispatch
+                        self.counters.increment("Broker", "LateShed")
+                        entries.append(("l", rid, -1))
+                        continue
                     if is_predict:
                         entries.append(("f", rid, len(rows)))
                         rows.append(row)
@@ -767,6 +780,9 @@ class PredictionService:
                     status, val = results_f[slot]
                 elif form == "q":
                     status, val = results_q[slot]
+                elif form == "l":
+                    out.append(f"{rid}{self.delim}{self.late_label}")
+                    continue
                 else:
                     status, val = "err", None
                 lab = val if status == "ok" else self.error_label
@@ -1363,12 +1379,23 @@ class RespPredictionLoop:
         self.request_q = cfg.get("redis.request.queue", "requestQueue")
         self.prediction_q = cfg.get("redis.prediction.queue",
                                     "predictionQueue")
+        # ps.broker.lease.timeout.s (ISSUE 17): > 0 drains under
+        # visibility-timeout leases and acks via the reply push — a
+        # loop killed mid-batch gets its requests redelivered.  0
+        # (default) keeps the classic destructive path byte for byte.
+        self.lease_timeout_s = float(
+            cfg.get("redis.lease.timeout.s", 0.0) or 0.0)
         self.stopped = False
 
     def poll_once(self) -> int:
         """One spout pass; returns how many messages were consumed."""
-        msgs = self.client.rpop_many(self.request_q,
-                                     self.service.policy.max_batch)
+        if self.lease_timeout_s > 0:
+            msgs = self.client.lease_many(self.request_q,
+                                          self.service.policy.max_batch,
+                                          self.lease_timeout_s)
+        else:
+            msgs = self.client.rpop_many(self.request_q,
+                                         self.service.policy.max_batch)
         if not msgs:
             return 0
         batch: List[str] = []
@@ -1385,8 +1412,14 @@ class RespPredictionLoop:
             if out:
                 # ONE variadic LPUSH for the whole batch of replies —
                 # with the native codec the buffer is built by one C
-                # pass and hits the socket as a single sendall
-                self.client.lpush_many(self.prediction_q, out)
+                # pass and hits the socket as a single sendall.  In
+                # lease mode the push doubles as the lease ack
+                # (ACKPUSH), closing the crash window in the same trip.
+                if self.lease_timeout_s > 0:
+                    self.client.ackpush(self.prediction_q,
+                                        self.request_q, out)
+                else:
+                    self.client.lpush_many(self.prediction_q, out)
         return len(msgs)
 
     def run(self, max_idle_s: float = 30.0,

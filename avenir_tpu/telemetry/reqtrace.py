@@ -192,20 +192,65 @@ def parse_field(tok: str) -> Optional[Tuple[float, bool]]:
     return float(m.group(1)), m.group(2) == "1"
 
 
+DEADLINE_FIELD_PREFIX = "d="
+
+# same backward-compat rule as the trace field (TPU_NOTES §27/§29):
+# only `d=<int>` is a deadline; anything laxer would eat a legitimate
+# feature value that merely starts with "d=".
+_DEADLINE_RE = re.compile(r"^d=(\d+)$")
+
+
+def encode_deadline(deadline_us: float) -> str:
+    return f"{DEADLINE_FIELD_PREFIX}{int(deadline_us)}"
+
+
+def parse_deadline(tok: str) -> Optional[float]:
+    """Absolute epoch-microsecond deadline for a deadline-field token,
+    None when the token is not one (ordinary feature value)."""
+    m = _DEADLINE_RE.match(tok)
+    if m is None:
+        return None
+    return float(m.group(1))
+
+
+def split_predict_deadline(parts: Sequence[str]):
+    """Consumer-side parse of an already-split predict message:
+    ``(request_id, row_fields, ctx_or_None, deadline_us_or_None)``.
+
+    The optional fields ride in order after the id — ``t=...`` then
+    ``d=...``, each independently absent — and each is recognized only
+    when at least one token follows it (a row must remain).  The
+    deadline (ISSUE 17) is absolute epoch microseconds on the
+    :func:`now_us` clock: consumers answer ``<id>,late`` without a
+    device dispatch once it has passed."""
+    rid = parts[1]
+    i = 2
+    ctx = None
+    deadline = None
+    if len(parts) >= i + 2 and parts[i].startswith(TRACE_FIELD_PREFIX):
+        parsed = parse_field(parts[i])
+        if parsed is not None:
+            enqueue_us, sampled = parsed
+            if sampled:
+                ctx = RequestTrace(rid, enqueue_us, wire=True)
+            i += 1
+    if len(parts) >= i + 2 and parts[i].startswith(DEADLINE_FIELD_PREFIX):
+        d = parse_deadline(parts[i])
+        if d is not None:
+            deadline = d
+            i += 1
+    return rid, list(parts[i:]), ctx, deadline
+
+
 def split_predict(parts: Sequence[str]):
     """Consumer-side parse of an already-split predict message:
     ``(request_id, row_fields, ctx_or_None)``.  The trace field — when
     present and parseable — is stripped from the row whether or not it
-    is sampled; unsampled or absent yields ctx None."""
-    rid = parts[1]
-    if len(parts) >= 4 and parts[2].startswith(TRACE_FIELD_PREFIX):
-        parsed = parse_field(parts[2])
-        if parsed is not None:
-            enqueue_us, sampled = parsed
-            ctx = RequestTrace(rid, enqueue_us, wire=True) if sampled \
-                else None
-            return rid, list(parts[3:]), ctx
-    return rid, list(parts[2:]), None
+    is sampled; unsampled or absent yields ctx None.  A deadline field
+    is stripped too (callers that enforce deadlines use
+    :func:`split_predict_deadline`)."""
+    rid, row, ctx, _ = split_predict_deadline(parts)
+    return rid, row, ctx
 
 
 # --------------------------------------------------------------------------
@@ -239,6 +284,33 @@ def stamp_values(values: List[str], delim: str = ",",
             out = list(values)
         out[i] = delim.join((parts[0], rid, encode_field(t), parts[2]))
         emit_flow("s", rid, "enqueue", ts_us=t, broker=broker)
+    return out if out is not None else values
+
+
+def stamp_deadline(values: List[str], ttl_ms: float,
+                   delim: str = ",") -> List[str]:
+    """Stamp every un-stamped request message in a push batch with an
+    absolute deadline ``ttl_ms`` from now (the ``ps.request.ttl.ms``
+    producer knob).  Rides AFTER a trace field when one is present;
+    already-stamped messages keep their original deadline (a re-offer
+    or re-route must not extend the budget).  ``ttl_ms <= 0`` returns
+    the input unchanged (same object)."""
+    if not ttl_ms or ttl_ms <= 0:
+        return values
+    field = encode_deadline(now_us() + float(ttl_ms) * 1e3)
+    out: Optional[List[str]] = None
+    for i, v in enumerate(values):
+        parts = v.split(delim)
+        if parts[0] not in ("predict", "predictq") or len(parts) < 3:
+            continue
+        j = 2
+        if len(parts) > j + 1 and parse_field(parts[j]) is not None:
+            j += 1
+        if len(parts) > j + 1 and parse_deadline(parts[j]) is not None:
+            continue
+        if out is None:
+            out = list(values)
+        out[i] = delim.join(parts[:j] + [field] + parts[j:])
     return out if out is not None else values
 
 

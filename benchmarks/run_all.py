@@ -733,6 +733,82 @@ def _horizontal_serveout(reg_dir, model_name, models, schema, req_rows,
             "killed_broker_shard": killed}
 
 
+def _durable_bench(scale):
+    """The durable-broker numbers (ISSUE 17): push/pop saturation
+    throughput + per-batch p50/p99 for durable=off vs commit (and a
+    shorter fsync pass), the commit overhead fraction, and cold-restart
+    journal replay time at several backlog depths."""
+    import shutil
+    import tempfile
+    from avenir_tpu.io.respq import RespClient, RespServer
+
+    def cycle_stats(server, n_batches, batch):
+        cli = RespClient(port=server.port)
+        vals = [f"predict,{i},x{i % 97}" for i in range(batch)]
+        lat = []
+        t0 = time.perf_counter()
+        for _ in range(n_batches):
+            s = time.perf_counter()
+            cli.lpush_many("rq", vals)
+            got = cli.rpop_many("rq", batch)
+            lat.append(time.perf_counter() - s)
+            assert len(got) == batch
+        dt = time.perf_counter() - t0
+        cli.close()
+        lat.sort()
+        return {"req_per_sec": round(n_batches * batch / dt, 1),
+                "p50_ms": round(lat[len(lat) // 2] * 1e3, 3),
+                "p99_ms": round(
+                    lat[min(len(lat) - 1, int(len(lat) * 0.99))] * 1e3, 3)}
+
+    n_batches = max(int(150 * scale), 30)
+    batch = 64
+    jroot = tempfile.mkdtemp(prefix="avt_durable_")
+    try:
+        srv = RespServer().start()
+        off = cycle_stats(srv, n_batches, batch)
+        srv.stop()
+        srv = RespServer(durable="commit",
+                         journal_dir=os.path.join(jroot, "commit")).start()
+        commit = cycle_stats(srv, n_batches, batch)
+        srv.stop()
+        # fsync pays a real disk flush per dispatch: a shorter pass is
+        # plenty to place it
+        srv = RespServer(durable="fsync",
+                         journal_dir=os.path.join(jroot, "fsync")).start()
+        fsync = cycle_stats(srv, max(n_batches // 10, 10), batch)
+        srv.stop()
+        overhead = 1.0 - commit["req_per_sec"] / max(off["req_per_sec"],
+                                                     1e-9)
+        replay = []
+        for depth in (1_000, 5_000, 20_000):
+            d = max(int(depth * scale), 200)
+            jd = os.path.join(jroot, f"replay{d}")
+            srv = RespServer(durable="commit", journal_dir=jd).start()
+            cli = RespClient(port=srv.port)
+            vals = [f"predict,{i},x" for i in range(d)]
+            for i in range(0, d, 1024):
+                cli.lpush_many("rq", vals[i:i + 1024])
+            cli.close()
+            srv.kill()   # crash: no checkpoint — the restart replays
+            t0 = time.perf_counter()
+            srv = RespServer(durable="commit", journal_dir=jd).start()
+            replay_s = time.perf_counter() - t0
+            assert srv.journal_replayed == d, \
+                f"replay restored {srv.journal_replayed}, pushed {d}"
+            srv.stop()
+            replay.append({
+                "backlog_depth": d,
+                "replay_s": round(replay_s, 4),
+                "replayed_per_sec": round(d / max(replay_s, 1e-9), 1)})
+        return {"batch": batch, "n_batches": n_batches,
+                "in_memory": off, "commit": commit, "fsync": fsync,
+                "commit_overhead_fraction": round(overhead, 4),
+                "journal_replay": replay}
+    finally:
+        shutil.rmtree(jroot, ignore_errors=True)
+
+
 def bench_serve_forest(scale):
     """Online forest serving: micro-batched request loop throughput and
     latency percentiles at several offered loads (plus a closed-loop pass
@@ -893,6 +969,12 @@ def bench_serve_forest(scale):
                                           req_rows, scale)
     finally:
         _shutil.rmtree(hreg_dir, ignore_errors=True)
+    # the durable tier (ISSUE 17): what the write-ahead journal costs on
+    # the broker data plane — journaled commit (and fsync) vs in-memory
+    # push/pop throughput and p99 at saturation with the overhead
+    # fraction, plus how long a killed shard's restart replay takes as
+    # the journaled backlog deepens
+    durable = _durable_bench(scale)
     # the int8 quantized serving path (ISSUE 11): publish the forest +
     # budget-pinned quantized sidecar into a scratch registry, replay the
     # same requests through the float and int8 predictors, and read the
@@ -958,7 +1040,8 @@ def bench_serve_forest(scale):
             "request_tracing": request_tracing,
             "quantized": quantized,
             "fleet_sweep": fleet,
-            "horizontal": horizontal}
+            "horizontal": horizontal,
+            "durable": durable}
 
 
 def bench_wire_codec(scale):

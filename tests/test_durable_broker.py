@@ -1,0 +1,796 @@
+"""Durable serving broker (ISSUE 17): write-ahead journaled shard
+queues, visibility-timeout leases whose ack rides the batched reply push
+(+ first-wins reply dedup = the exactly-once EFFECT), deadline-aware
+shedding — chaos-drilled end to end.
+
+The drills' discipline: the pushing client offers every request ONCE and
+never re-offers.  A kill -9'd worker mid-batch and a killed-and-restarted
+broker shard must both end with every accepted request answered exactly
+once (dedup-verified: zero lost, zero duplicate effect)."""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from avenir_tpu.core import faults
+from avenir_tpu.core.table import encode_rows
+from avenir_tpu.io import qjournal
+from avenir_tpu.io.respq import (RespClient, RespServer, ShardedRespClient,
+                                 dedup_replies, resolve_durable)
+from avenir_tpu.serving import BatchPolicy, ServingFleet
+from avenir_tpu.telemetry import reqtrace
+from tests.test_fleet import make_fleet_registry
+from tests.test_serving import forest_batch_predict, raw_rows_of
+from tests.test_tree import SCHEMA
+
+pytestmark = pytest.mark.broker
+
+
+# --------------------------------------------------------------------------
+# journal unit: roundtrip, rotation/compaction, damage recovery
+# --------------------------------------------------------------------------
+
+def test_journal_push_ack_roundtrip(tmp_path):
+    j = qjournal.QueueJournal(str(tmp_path / "j"))
+    j.open_for_append()
+    j.append([qjournal.encode_push(1, "rq", "predict,0,a"),
+              qjournal.encode_push(2, "rq", "predict,1,b"),
+              qjournal.encode_push(3, "pq", "0,label")])
+    j.append([qjournal.encode_ack(1, "rq", "0")])
+    j.close()
+    st = qjournal.QueueJournal(str(tmp_path / "j")).replay()
+    assert st.torn is False
+    assert st.queues["rq"] == [(2, "predict,1,b")]
+    assert st.queues["pq"] == [(3, "0,label")]
+    assert st.acked["rq"] == ["0"]
+    assert st.next_seq == 4
+    assert st.records == 4 and st.restored == 2
+
+
+def test_journal_del_drops_queue(tmp_path):
+    j = qjournal.QueueJournal(str(tmp_path / "j"))
+    j.open_for_append()
+    j.append([qjournal.encode_push(1, "rq", "v1"),
+              qjournal.encode_push(2, "keep", "v2"),
+              qjournal.encode_del("rq")])
+    j.close()
+    st = qjournal.QueueJournal(str(tmp_path / "j")).replay()
+    assert "rq" not in st.queues
+    assert st.queues["keep"] == [(2, "v2")]
+
+
+def test_journal_rotation_compacts_segments(tmp_path):
+    """Tiny segment budget: every append rotates.  Old segments are
+    deleted, the checkpoint carries the live state, and replay from
+    checkpoint + tail equals the full history's state."""
+    live = {"queues": {}, "acked": {}, "next_seq": [1]}
+
+    def provider():
+        return (dict(live["queues"]), dict(live["acked"]),
+                live["next_seq"][0])
+
+    j = qjournal.QueueJournal(str(tmp_path / "j"), segment_bytes=64)
+    j.snapshot_provider = provider
+    j.open_for_append()
+    for i in range(1, 21):
+        j.append([qjournal.encode_push(i, "rq", f"predict,{i},row{i}")])
+        live["queues"].setdefault("rq", []).append((i, f"predict,{i},row{i}"))
+        live["next_seq"][0] = i + 1
+    assert j.rotations > 0
+    # compaction held: far fewer segments on disk than appends
+    assert len(j._segments()) <= 2
+    j.close()
+    st = qjournal.QueueJournal(str(tmp_path / "j")).replay()
+    assert [s for s, _ in st.queues["rq"]] == list(range(1, 21))
+    assert st.next_seq == 21
+
+
+def _fresh_journal_records(tmp_path, n=4):
+    j = qjournal.QueueJournal(str(tmp_path / "j"))
+    j.open_for_append()
+    for i in range(1, n + 1):
+        j.append([qjournal.encode_push(i, "rq", f"predict,{i},v{i}")])
+    j.close()
+    segs = qjournal.QueueJournal(str(tmp_path / "j"))._segments()
+    assert len(segs) == 1
+    return segs[0][1]
+
+
+def test_journal_torn_final_record_recovers_prefix(tmp_path):
+    """A torn tail (partial final record — the kill -9 mid-write shape)
+    recovers exactly the intact prefix with a warning."""
+    seg = _fresh_journal_records(tmp_path, n=4)
+    data = open(seg, "rb").read()
+    # append half of a bogus record header: torn mid-frame
+    with open(seg, "ab") as fh:
+        fh.write(b"\x40\x00\x00\x00\x12")
+    with pytest.warns(RuntimeWarning, match="torn|damaged"):
+        st = qjournal.QueueJournal(str(tmp_path / "j")).replay()
+    assert st.torn is True
+    assert [v for _, v in st.queues["rq"]] == [f"predict,{i},v{i}"
+                                              for i in range(1, 5)]
+    assert len(data) > 0  # the original records were really on disk
+
+
+def test_journal_truncated_segment_recovers_prefix(tmp_path):
+    """A segment truncated mid-record (lost tail) degrades to the
+    records before the cut — never a corrupt or partial value."""
+    seg = _fresh_journal_records(tmp_path, n=4)
+    size = os.path.getsize(seg)
+    with open(seg, "r+b") as fh:
+        fh.truncate(size - 7)   # cut into the final record's payload
+    with pytest.warns(RuntimeWarning, match="torn|damaged"):
+        st = qjournal.QueueJournal(str(tmp_path / "j")).replay()
+    assert st.torn is True
+    assert [v for _, v in st.queues["rq"]] == [f"predict,{i},v{i}"
+                                              for i in range(1, 4)]
+
+
+def test_journal_bad_crc_stops_at_intact_prefix(tmp_path):
+    """A bit-flip inside a record body fails its crc32: replay stops
+    BEFORE the damaged record — a corrupt value is never served."""
+    seg = _fresh_journal_records(tmp_path, n=4)
+    data = bytearray(open(seg, "rb").read())
+    data[-3] ^= 0xFF            # flip a byte in the last record's payload
+    open(seg, "wb").write(bytes(data))
+    with pytest.warns(RuntimeWarning, match="torn|damaged"):
+        st = qjournal.QueueJournal(str(tmp_path / "j")).replay()
+    assert st.torn is True
+    served = [v for _, v in st.queues["rq"]]
+    assert served == [f"predict,{i},v{i}" for i in range(1, 4)]
+    assert all("v4" not in v for v in served)
+
+
+def test_journal_crash_between_rotate_and_checkpoint(tmp_path):
+    """Fault-injected crash inside rotate(): the new segment is open but
+    the checkpoint write dies.  The ordering contract (open next ->
+    checkpoint -> delete) must leave a replayable pair on disk."""
+    live_q = {}
+
+    def provider():
+        return dict(live_q), {}, 3
+
+    j = qjournal.QueueJournal(str(tmp_path / "j"))
+    j.snapshot_provider = provider
+    j.open_for_append()
+    j.append([qjournal.encode_push(1, "rq", "predict,1,a")])
+    j.append([qjournal.encode_push(2, "rq", "predict,2,b")])
+    live_q["rq"] = [(1, "predict,1,a"), (2, "predict,2,b")]
+    # the injector counts from install: the FIRST journal_write it sees
+    # is rotate's checkpoint write — the injected crash point
+    faults.install(faults.FaultInjector.parse("journal_write@0=raise:OSError"))
+    try:
+        with pytest.raises(OSError):
+            j.rotate()
+    finally:
+        faults.uninstall()
+    j.close()
+    # no checkpoint landed, both segments remain: replay sees everything
+    assert not os.path.exists(str(tmp_path / "j" / qjournal.CHECKPOINT))
+    assert len(qjournal.QueueJournal(str(tmp_path / "j"))._segments()) == 2
+    st = qjournal.QueueJournal(str(tmp_path / "j")).replay()
+    assert [v for _, v in st.queues["rq"]] == ["predict,1,a", "predict,2,b"]
+
+
+def test_journal_replay_fault_point_fires(tmp_path):
+    _fresh_journal_records(tmp_path, n=1)
+    inj = faults.FaultInjector.parse("journal_replay@0=delay:0.001")
+    faults.install(inj)
+    try:
+        qjournal.QueueJournal(str(tmp_path / "j")).replay()
+    finally:
+        faults.uninstall()
+    assert ("journal_replay", 0, "delay") in inj.log
+
+
+@pytest.mark.faultinject
+def test_fsync_fault_degrades_to_memory_not_an_outage(tmp_path):
+    """Availability-first failure policy: a dying fsync costs the
+    durability of that batch (counted + warned), never the request."""
+    s = RespServer(durable="fsync", journal_dir=str(tmp_path / "j")).start()
+    cli = RespClient(port=s.port)
+    try:
+        faults.install(faults.FaultInjector.parse(
+            "journal_fsync@*=raise:OSErrorx100"))
+        try:
+            assert cli.lpush_many("rq", ["predict,0,a", "predict,1,b"]) == 2
+        finally:
+            faults.uninstall()
+        assert s.counters.get("Broker", "JournalWriteErrors") > 0
+        # the shard kept serving in-memory
+        assert cli.rpop("rq") == "predict,0,a"
+    finally:
+        cli.close()
+        s.stop()
+
+
+# --------------------------------------------------------------------------
+# knob plumbing + shared dedup helper
+# --------------------------------------------------------------------------
+
+def test_resolve_durable_and_env_twin(monkeypatch):
+    assert resolve_durable(None) == "off"
+    assert resolve_durable("fsync") == "fsync"
+    assert resolve_durable(" Commit ") == "commit"
+    monkeypatch.setenv("AVENIR_TPU_BROKER_DURABLE", "commit")
+    assert resolve_durable(None) == "commit"
+    with pytest.raises(ValueError):
+        resolve_durable("paranoid")
+    with pytest.raises(ValueError):
+        RespServer(durable="commit")   # durable requires a journal dir
+
+
+def test_dedup_replies_first_wins():
+    by_id, dups = dedup_replies(["1,a", "2,b", "1,c", "2,b", "3,d"])
+    assert by_id == {"1": "a", "2": "b", "3": "d"}
+    assert dups == 2
+    assert dedup_replies([]) == ({}, 0)
+
+
+# --------------------------------------------------------------------------
+# leases: redelivery, ack piggyback, server-side reply dedup
+# --------------------------------------------------------------------------
+
+def test_lease_expiry_redelivers_ack_retires(tmp_path):
+    s = RespServer(durable="commit", journal_dir=str(tmp_path / "j")).start()
+    cli = RespClient(port=s.port)
+    try:
+        cli.lpush_many("rq", ["predict,0,a", "predict,1,b"])
+        got = cli.lease_many("rq", 2, lease_s=0.25)
+        assert sorted(got) == ["predict,0,a", "predict,1,b"]
+        # leased values are invisible while the lease holds
+        assert cli.lease_many("rq", 2, lease_s=0.25) == []
+        time.sleep(0.3)
+        again = cli.lease_many("rq", 4, lease_s=0.25)
+        assert sorted(again) == ["predict,0,a", "predict,1,b"]
+        assert s.redelivered == 2
+        # ack rides the reply push; acked requests never redeliver
+        assert cli.ackpush("pq", "rq", ["0,l0", "1,l1"]) == 2
+        time.sleep(0.3)
+        assert cli.lease_many("rq", 4, lease_s=0.25) == []
+        assert sorted(cli.rpop_many("pq", 4)) == ["0,l0", "1,l1"]
+        # a duplicate reply for an answered id is dropped server-side
+        assert cli.ackpush("pq", "rq", ["1,dup"]) == 0
+        assert s.dup_replies_dropped == 1
+        assert cli.rpop_many("pq", 4) == []
+    finally:
+        cli.close()
+        s.stop()
+
+
+def test_lease_control_words_stay_destructive():
+    s = RespServer().start()
+    cli = RespClient(port=s.port)
+    try:
+        cli.lpush_many("rq", ["predict,7,x", "stop"])
+        got = cli.lease_many("rq", 4, lease_s=30.0)
+        assert sorted(got) == ["predict,7,x", "stop"]
+        # 'stop' had no lease identity: it is gone for good; the predict
+        # is leased and comes back on expiry only
+        assert cli.lease_many("rq", 4, lease_s=1.0) == []
+        assert cli.llen("rq") == 0
+    finally:
+        cli.close()
+        s.stop()
+
+
+def test_blocking_lease_wakes_on_peer_expiry():
+    """A blocked LEASE must wake when a peer's lease expires, not sit
+    out its full block window."""
+    s = RespServer().start()
+    a, b = RespClient(port=s.port), RespClient(port=s.port, timeout=10.0)
+    try:
+        a.lpush("rq", "predict,0,x")
+        assert a.lease_many("rq", 1, lease_s=0.4) == ["predict,0,x"]
+        t0 = time.monotonic()
+        got = b.lease_many("rq", 1, lease_s=5.0, block_s=5.0)
+        waited = time.monotonic() - t0
+        assert got == ["predict,0,x"]
+        assert waited < 3.0, f"blocked past the peer's expiry ({waited}s)"
+        assert s.redelivered == 1
+    finally:
+        a.close()
+        b.close()
+        s.stop()
+
+
+# --------------------------------------------------------------------------
+# restart replay at the server level
+# --------------------------------------------------------------------------
+
+def test_server_kill_restart_replays_outstanding_only(tmp_path):
+    """kill() (the crash sim: no checkpoint, torn tail abandoned) then a
+    fresh server on the same journal: answered requests stay answered,
+    outstanding ones (queued OR leased-unacked) come back."""
+    jd = str(tmp_path / "j")
+    s = RespServer(durable="commit", journal_dir=jd).start()
+    port = s.port
+    cli = RespClient(port=port)
+    cli.lpush_many("rq", [f"predict,{i},v{i}" for i in range(5)])
+    leased = cli.lease_many("rq", 3, lease_s=60.0)
+    assert len(leased) == 3
+    cli.ackpush("pq", "rq", ["0,l0"])      # one answered pre-crash
+    cli.close()
+    s.kill()
+    s2 = RespServer(port=port, durable="commit", journal_dir=jd).start()
+    cli = RespClient(port=port)
+    try:
+        assert s2.journal_replayed > 0
+        # outstanding = 2 leased-unacked + 2 never-leased; id 0 retired
+        back = cli.lease_many("rq", 8, lease_s=60.0)
+        assert sorted(back) == [f"predict,{i},v{i}" for i in (1, 2, 3, 4)]
+        # the reply pushed pre-crash survived too
+        assert cli.rpop_many("pq", 4) == ["0,l0"]
+        # and the answered set survived: a late duplicate is dropped
+        assert cli.ackpush("pq", "rq", ["0,dup"]) == 0
+        assert s2.dup_replies_dropped == 1
+    finally:
+        cli.close()
+        s2.stop()
+
+
+def test_server_graceful_stop_checkpoints(tmp_path):
+    """stop() compacts: the next start replays from the checkpoint alone
+    (fresh segment tail), with identical state."""
+    jd = str(tmp_path / "j")
+    s = RespServer(durable="commit", journal_dir=jd).start()
+    port = s.port
+    cli = RespClient(port=port)
+    cli.lpush_many("rq", ["predict,0,a", "predict,1,b"])
+    cli.rpop("rq")             # destructive pop is journaled as an ack
+    cli.close()
+    s.stop()
+    s2 = RespServer(port=port, durable="commit", journal_dir=jd).start()
+    cli = RespClient(port=port)
+    try:
+        assert cli.rpop_many("rq", 4) == ["predict,1,b"]
+    finally:
+        cli.close()
+        s2.stop()
+
+
+# --------------------------------------------------------------------------
+# golden bytes: durable=off is byte-identical on the wire
+# --------------------------------------------------------------------------
+
+def test_durable_off_wire_bytes_golden():
+    """Pin the EXACT bytes of a scripted conversation against a default
+    (durable=off) server — the PR 16 wire surface.  Any durable-mode
+    leakage into the default path (INFO lines, reply framing) fails
+    here byte-for-byte."""
+    script = [
+        (("PING",), b"+PONG\r\n"),
+        (("LPUSH", "rq", "predict,0,a,b"), b":1\r\n"),
+        (("LPUSH", "rq", "predict,1,c,d", "predict,2,e,f"), b":3\r\n"),
+        (("LLEN", "rq"), b":3\r\n"),
+        (("RPOP", "rq"), b"$13\r\npredict,0,a,b\r\n"),
+        (("RPOP", "rq", "2"),
+         b"*2\r\n$13\r\npredict,1,c,d\r\n$13\r\npredict,2,e,f\r\n"),
+        (("BRPOP", "rq", "0.01"), b"*-1\r\n"),
+        (("INFO",), b"$17\r\n# Queues\nqueues:0\r\n"),
+        (("DEL", "rq"), b":0\r\n"),
+        (("RPOP", "rq"), b"$-1\r\n"),
+    ]
+    s = RespServer().start()
+    try:
+        sk = socket.create_connection(("127.0.0.1", s.port), timeout=10)
+        rf = sk.makefile("rb")
+        for args, expect in script:
+            payload = b"*%d\r\n" % len(args)
+            for a in args:
+                ab = a.encode()
+                payload += b"$%d\r\n%s\r\n" % (len(ab), ab)
+            sk.sendall(payload)
+            got = rf.read(len(expect))
+            assert got == expect, f"{args}: {got!r} != {expect!r}"
+        rf.close()
+        sk.close()
+    finally:
+        s.stop()
+
+
+# --------------------------------------------------------------------------
+# deadline field: parse, stamp, shed
+# --------------------------------------------------------------------------
+
+def test_deadline_parse_and_stamp():
+    now = int(reqtrace.now_us())
+    parts = ["predict", "7", f"d={now}", "f1", "f2"]
+    rid, row, ctx, dl = reqtrace.split_predict_deadline(parts)
+    assert (rid, row, ctx, dl) == ("7", ["f1", "f2"], None, now)
+    # deadline after a trace field
+    parts = ["predict", "7", "t=5:0", "d=9", "f1"]
+    rid, row, ctx, dl = reqtrace.split_predict_deadline(parts)
+    assert rid == "7" and row == ["f1"] and dl == 9
+    # near-miss spellings are ordinary features, exactly as before
+    for bad in ("d=", "d=1x", "d=-3", "d= 5", "D=5"):
+        rid, row, _, dl = reqtrace.split_predict_deadline(
+            ["predict", "1", bad, "f1"])
+        assert dl is None and row == [bad, "f1"]
+    # a d= token with NOTHING after it is data (the >= i+2 rule)
+    rid, row, _, dl = reqtrace.split_predict_deadline(["predict", "1", "d=5"])
+    assert dl is None and row == ["d=5"]
+    # stamping: every un-stamped predict gains a deadline; an existing
+    # stamp is preserved (a re-offer must not extend its budget)
+    msgs = ["predict,0,a", "predict,1,d=123,b", "stop"]
+    out = reqtrace.stamp_deadline(msgs, ttl_ms=1000.0)
+    assert out[0].split(",")[2].startswith("d=")
+    assert int(out[0].split(",")[2][2:]) > now
+    assert out[1] == "predict,1,d=123,b"
+    assert out[2] == "stop"
+    assert reqtrace.stamp_deadline(msgs, ttl_ms=0) is msgs
+
+
+def test_service_sheds_past_deadline(mesh_ctx, tmp_path):
+    """A request whose wire deadline already passed answers
+    ``<id>,late`` BEFORE device dispatch; fresh ones serve normally."""
+    from avenir_tpu.serving.predictor import ForestPredictor
+    from avenir_tpu.serving.service import PredictionService
+    from tests.test_serving import small_forest
+    table, models = small_forest(mesh_ctx, n=200, trees=1, depth=2)
+    rows = raw_rows_of(table, 4)
+    expect = forest_batch_predict(models, encode_rows(rows, SCHEMA))
+    pred = ForestPredictor(models, SCHEMA, buckets=(8,))
+    svc = PredictionService(pred, warm=False)
+    future = int(reqtrace.now_us()) + 60_000_000
+    out = svc.process_batch([
+        ",".join(["predict", "0", "d=1"] + rows[0]),          # long past
+        ",".join(["predict", "1", f"d={future}"] + rows[1]),  # fresh
+        ",".join(["predict", "2"] + rows[2]),                 # no deadline
+    ])
+    assert sorted(out) == sorted(["0,late", f"1,{expect[1]}",
+                                  f"2,{expect[2]}"])
+    assert svc.counters.get("Broker", "LateShed") == 1
+
+
+# --------------------------------------------------------------------------
+# chaos drills (exactly-once, client never re-offers)
+# --------------------------------------------------------------------------
+
+def _collect_exactly_once(cli, queue, n, timeout_s=120.0):
+    """Drain first-reply-per-id until all n ids answered; returns
+    ({rid: label}, transport_duplicates)."""
+    got, dups = {}, 0
+    deadline = time.monotonic() + timeout_s
+    while len(got) < n and time.monotonic() < deadline:
+        vs = cli.rpop_many(queue, 256)
+        if not vs:
+            time.sleep(0.005)
+            continue
+        for v in vs:
+            rid, _, label = v.partition(",")
+            if rid in got:
+                dups += 1
+            else:
+                got[rid] = label
+    return got, dups
+
+
+@pytest.mark.chaos
+def test_chaos_kill_restart_shard_exactly_once(tmp_path, mesh_ctx):
+    """Drill (a): kill() one durable broker shard mid-traffic, restart
+    it on the same port from its journal.  The fleet rejoins the revived
+    shard; every accepted request ends answered exactly once WITHOUT the
+    pushing client re-offering anything."""
+    reg, table, models = make_fleet_registry(tmp_path, mesh_ctx)
+    rows = raw_rows_of(table, 40)
+    expect = forest_batch_predict(models, encode_rows(rows, SCHEMA))
+    jroots = [str(tmp_path / "j0"), str(tmp_path / "j1")]
+    servers = [RespServer(durable="commit", journal_dir=jroots[i]).start()
+               for i in range(2)]
+    eps = [f"127.0.0.1:{s.port}" for s in servers]
+    fleet = ServingFleet(reg, "churn", buckets=(8, 64),
+                         policy=BatchPolicy(max_batch=8, max_wait_ms=2.0),
+                         n_workers=2,
+                         config={"redis.server.endpoints": eps,
+                                 "redis.lease.timeout.s": 1.0})
+    fleet.start()
+    feeder = ShardedRespClient(eps)
+    n = 150
+    try:
+        # the ONE offer — never repeated below
+        feeder.lpush_many("requestQueue",
+                          [",".join(["predict", str(i)] + rows[i % 40])
+                           for i in range(n)])
+        # wait until the fleet is demonstrably mid-flight, then crash
+        # shard 0 and restart it from its journal on the same port
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            with servers[0]._lock:
+                depth = sum(len(q) for q in servers[0]._queues.values())
+                leased = sum(len(t) for t in servers[0]._leases.values())
+            if leased or depth == 0:
+                break
+            time.sleep(0.001)
+        port0 = servers[0].port
+        servers[0].kill()
+        replacement = RespServer(port=port0, durable="commit",
+                                 journal_dir=jroots[0]).start()
+        old_stats = servers[0]
+        servers[0] = replacement
+        assert replacement.journal_replayed >= 0  # replay ran (may be 0 rows)
+        got, dups = _collect_exactly_once(feeder, "predictionQueue", n)
+        assert sorted(got, key=int) == [str(i) for i in range(n)], \
+            f"lost {n - len(got)} requests across the shard restart"
+        for i in range(n):
+            assert got[str(i)] == expect[i % 40]
+        del old_stats
+    finally:
+        fleet.stop()
+        feeder.close()
+        for s in servers:
+            s.stop()
+
+
+@pytest.mark.chaos
+def test_chaos_kill9_worker_mid_batch_exactly_once(tmp_path, mesh_ctx):
+    """Drill (b): a fleet_host OS process is SIGKILLed while it holds
+    leased work mid-batch.  Its leases expire and redeliver; a rescue
+    fleet answers them.  Exactly-once, no client re-offer."""
+    reg, table, models = make_fleet_registry(tmp_path, mesh_ctx)
+    rows = raw_rows_of(table, 40)
+    expect = forest_batch_predict(models, encode_rows(rows, SCHEMA))
+    server = RespServer(durable="commit",
+                        journal_dir=str(tmp_path / "j")).start()
+    ep = f"127.0.0.1:{server.port}"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", AVENIR_TPU_PLATFORM="cpu")
+    child = subprocess.Popen(
+        [sys.executable, "-m", "avenir_tpu.serving.fleet_host",
+         "--registry", str(tmp_path / "registry"), "--model", "churn",
+         "--endpoints", ep, "--workers", "2", "--buckets", "8,64",
+         "--max-batch", "8", "--max-wait-ms", "20",
+         "--lease-timeout-s", "1.0", "--max-idle-s", "120",
+         "--ready-file", str(tmp_path / "ready")],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env)
+    feeder = RespClient(port=server.port)
+    rescue = None
+    n = 80
+    try:
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline \
+                and not (tmp_path / "ready").exists():
+            assert child.poll() is None, "fleet_host died during startup"
+            time.sleep(0.05)
+        # the ONE offer
+        feeder.lpush_many("requestQueue",
+                          [",".join(["predict", str(i)] + rows[i % 40])
+                           for i in range(n)])
+        # SIGKILL the host the moment it holds leases (mid-batch: leased
+        # but unacked — predict hasn't finished)
+        deadline = time.monotonic() + 60
+        killed = False
+        while time.monotonic() < deadline:
+            with server._lock:
+                leased = sum(len(t) for t in server._leases.values())
+            if leased:
+                child.kill()
+                killed = True
+                break
+            time.sleep(0.001)
+        assert killed, "fleet_host never leased work"
+        child.wait(timeout=30)
+        # rescue fleet drains the redelivered + remaining backlog
+        rescue = ServingFleet(
+            reg, "churn", buckets=(8, 64),
+            policy=BatchPolicy(max_batch=8, max_wait_ms=2.0), n_workers=2,
+            config={"redis.server.endpoints": [ep],
+                    "redis.lease.timeout.s": 1.0})
+        rescue.start()
+        got, dups = _collect_exactly_once(feeder, "predictionQueue", n)
+        assert sorted(got, key=int) == [str(i) for i in range(n)], \
+            f"lost {n - len(got)} requests across the worker kill"
+        for i in range(n):
+            assert got[str(i)] == expect[i % 40]
+        # the killed host's in-flight leases really did redeliver
+        assert server.redelivered > 0
+    finally:
+        if child.poll() is None:
+            child.kill()
+        if rescue is not None:
+            rescue.stop()
+        feeder.close()
+        server.stop()
+
+
+@pytest.mark.chaos
+def test_chaos_fleet_host_sigterm_drains_gracefully(tmp_path, mesh_ctx):
+    """SIGTERM (not KILL) is the graceful path: the host flushes what it
+    accepted (acking those leases) and exits 0 with its stats line.
+    Answered + still-queued must partition the offer — nothing lost,
+    nothing answered twice, nothing both answered and re-queued."""
+    reg, table, models = make_fleet_registry(tmp_path, mesh_ctx)
+    rows = raw_rows_of(table, 40)
+    server = RespServer(durable="commit",
+                        journal_dir=str(tmp_path / "j")).start()
+    ep = f"127.0.0.1:{server.port}"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", AVENIR_TPU_PLATFORM="cpu")
+    child = subprocess.Popen(
+        [sys.executable, "-m", "avenir_tpu.serving.fleet_host",
+         "--registry", str(tmp_path / "registry"), "--model", "churn",
+         "--endpoints", ep, "--workers", "2", "--buckets", "8,64",
+         "--max-batch", "8", "--lease-timeout-s", "30.0",
+         "--max-idle-s", "120",
+         "--ready-file", str(tmp_path / "ready")],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=env)
+    feeder = RespClient(port=server.port)
+    n = 60
+    try:
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline \
+                and not (tmp_path / "ready").exists():
+            assert child.poll() is None, "fleet_host died during startup"
+            time.sleep(0.05)
+        feeder.lpush_many("requestQueue",
+                          [",".join(["predict", str(i)] + rows[i % 40])
+                           for i in range(n)])
+        # let it get into flight, then SIGTERM mid-drain
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if feeder.llen("predictionQueue") > 0:
+                break
+            time.sleep(0.002)
+        child.send_signal(signal.SIGTERM)
+        out, _ = child.communicate(timeout=60)
+        assert child.returncode == 0, "SIGTERM exit was not graceful"
+        import json as _json
+        stats = _json.loads(out.strip().splitlines()[-1])
+        assert stats["served"] > 0
+        # drain both sides; 30s leases mean an ANSWERED-BUT-UNACKED
+        # request cannot exist (the flush acks), and unleased ones wait
+        answered, dups = {}, 0
+        vs = []
+        while True:
+            batch = feeder.rpop_many("predictionQueue", 256)
+            if not batch:
+                break
+            vs.extend(batch)
+        answered, dups = dedup_replies(vs)
+        assert dups == 0
+        left = feeder.rpop_many("requestQueue", 256)
+        left_ids = {v.split(",")[1] for v in left}
+        assert not (set(answered) & left_ids), \
+            "a request is both answered and still queued"
+        assert set(answered) | left_ids == {str(i) for i in range(n)}, \
+            "requests lost across the SIGTERM drain"
+        assert len(answered) == stats["served"]
+    finally:
+        if child.poll() is None:
+            child.kill()
+        feeder.close()
+        server.stop()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_soak_repeated_shard_crashes(tmp_path, mesh_ctx):
+    """Multi-minute soak: continuous offered load while a shard is
+    crash/restarted repeatedly; every request of every wave answered
+    exactly once."""
+    reg, table, models = make_fleet_registry(tmp_path, mesh_ctx)
+    rows = raw_rows_of(table, 40)
+    jroots = [str(tmp_path / "j0"), str(tmp_path / "j1")]
+    servers = [RespServer(durable="commit", journal_dir=jroots[i]).start()
+               for i in range(2)]
+    eps = [f"127.0.0.1:{s.port}" for s in servers]
+    fleet = ServingFleet(reg, "churn", buckets=(8, 64),
+                         policy=BatchPolicy(max_batch=8, max_wait_ms=2.0),
+                         n_workers=2,
+                         config={"redis.server.endpoints": eps,
+                                 "redis.lease.timeout.s": 1.0})
+    fleet.start()
+    feeder = ShardedRespClient(eps)
+    try:
+        base = 0
+        for wave in range(4):
+            msgs = [",".join(["predict", str(base + i)] + rows[i % 40])
+                    for i in range(200)]
+            feeder.lpush_many("requestQueue", msgs)
+            time.sleep(0.2)
+            victim = wave % 2
+            port = servers[victim].port
+            servers[victim].kill()
+            time.sleep(0.5)
+            servers[victim] = RespServer(
+                port=port, durable="commit",
+                journal_dir=jroots[victim]).start()
+            got, _ = _collect_exactly_once(
+                feeder, "predictionQueue", 200, timeout_s=180.0)
+            assert sorted(got, key=int) == \
+                [str(base + i) for i in range(200)], \
+                f"wave {wave}: lost {200 - len(got)}"
+            base += 200
+    finally:
+        fleet.stop()
+        feeder.close()
+        for s in servers:
+            s.stop()
+
+
+# --------------------------------------------------------------------------
+# observability
+# --------------------------------------------------------------------------
+
+def test_bind_metrics_exposes_durable_gauges(tmp_path):
+    from avenir_tpu.telemetry.metrics import MetricsRegistry
+    registry = MetricsRegistry()
+    s = RespServer(durable="commit",
+                   journal_dir=str(tmp_path / "j")).start()
+    cli = RespClient(port=s.port)
+    try:
+        s.bind_metrics(registry, endpoint=f"127.0.0.1:{s.port}")
+        cli.lpush_many("rq", ["predict,0,a", "predict,1,b"])
+        cli.lease_many("rq", 1, lease_s=30.0)
+        text = registry.render()
+        assert "avenir_broker_durable" in text
+        for key in ("queue_depth", "leased", "journal_bytes",
+                    "journal_segments", "redelivered", "journal_replayed"):
+            assert f'key="{key}"' in text, f"missing durable gauge {key}"
+        assert 'key="queue_depth"' in text
+    finally:
+        cli.close()
+        s.stop()
+
+
+def test_info_reports_durable_and_leases(tmp_path):
+    s = RespServer(durable="commit",
+                   journal_dir=str(tmp_path / "j")).start()
+    cli = RespClient(port=s.port)
+    try:
+        cli.lpush_many("rq", ["predict,0,a", "predict,1,b"])
+        cli.lease_many("rq", 1, lease_s=30.0)
+        raw = cli._call("INFO")
+        assert "durable:commit" in raw
+        assert "queue_leased:rq=1" in raw
+        assert "journal_segments:" in raw
+        # the depth parse still works with the extra lines present
+        assert cli.info()["rq"] == 1
+    finally:
+        cli.close()
+        s.stop()
+
+
+def test_tracetool_incident_surfaces_redelivery_and_replay(tmp_path,
+                                                           capsys):
+    """The incident report's broker-events lane must carry the durable
+    story: a lease redelivery and a restarted shard's journal replay
+    both show up in one `tracetool incident` window."""
+    import importlib.util
+    from avenir_tpu import telemetry as T
+    spec = importlib.util.spec_from_file_location(
+        "tracetool", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "tracetool.py"))
+    tt = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tt)
+    t0 = time.time() - 1.0
+    tr = T.install_tracer(T.Tracer(str(tmp_path / "traces"),
+                                   run_id="dur", process_index=0))
+    try:
+        s = RespServer(durable="commit",
+                       journal_dir=str(tmp_path / "j")).start()
+        cli = RespClient(port=s.port)
+        cli.lpush_many("rq", ["predict,0,a"])
+        assert cli.lease_many("rq", 1, lease_s=0.05)
+        time.sleep(0.1)
+        assert cli.lease_many("rq", 1, lease_s=30.0)   # the redelivery
+        cli.close()
+        s.kill()   # crash: no checkpoint — the restart must replay
+        s2 = RespServer(port=s.port, durable="commit",
+                        journal_dir=str(tmp_path / "j")).start()
+        assert s2.journal_replayed == 1
+        s2.stop()
+        tr.flush()
+    finally:
+        T.uninstall_tracer()
+    t1 = time.time() + 1.0
+    rc = tt.main(["incident", str(t0), str(t1), tr.path])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "broker events" in out
+    assert "broker.redeliver" in out and "rid=0" in out
+    assert "broker.journal_replay" in out and "restored=1" in out

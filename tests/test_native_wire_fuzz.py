@@ -142,6 +142,23 @@ def _trace_token(rng):
                            "t= 5:1"]))
 
 
+def _deadline_token(rng):
+    """Wire deadline field (ISSUE 17).  Valid spellings are pinned to
+    deterministic outcomes — far past (always sheds 'late') or far
+    future (never sheds) — so the native-vs-python differential cannot
+    flake on a deadline racing now_us() between the two runs.  Near-miss
+    spellings are ordinary data by the grammar, both planes."""
+    r = rng.random()
+    if r < 0.25:
+        return "d=" + str(10 ** 17)       # far future: never late
+    if r < 0.40:
+        return "d=1"                      # long past: always late
+    if r < 0.50:
+        return "d=" + "9" * 19            # valid but 19-digit
+    return str(rng.choice(["d=12x3", "d=", "d=1:2", "d= 5", "d=-1",
+                           "d=+5", "d=1.5", "D=12", "d=0x1f"]))
+
+
 def _predict_msg(rng, schema, delim, rid):
     row = [""] * schema.num_columns
     row[0] = f"id{rid}"
@@ -151,6 +168,8 @@ def _predict_msg(rng, schema, delim, rid):
     body = ["predict", str(rid)]
     if rng.random() < 0.35:
         body.append(_trace_token(rng))
+    if rng.random() < 0.25:
+        body.append(_deadline_token(rng))
     msg = delim.join(body + row)
     if rng.random() < 0.06:      # truncated mid-row
         msg = msg[:int(rng.integers(8, max(9, len(msg))))]

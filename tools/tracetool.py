@@ -371,7 +371,9 @@ def cmd_incident(args) -> int:
     # control plane: broker health, controller stages, registry flips,
     # degradations — the WHY lanes of the incident
     sections = (
-        ("broker events", ("broker.reconnect", "broker.shard_down")),
+        ("broker events", ("broker.reconnect", "broker.shard_down",
+                           "broker.shard_up", "broker.redeliver",
+                           "broker.journal_replay")),
         ("controller decisions", ("controller.decision",)),
         ("registry events", ("registry.publish", "registry.pin",
                              "registry.unpin")),
