@@ -17,6 +17,32 @@ coalescing policy, optional RESP wire transport).  Config keys
                             coalescing window (default 0 = fixed)
   ps.queue.max.depth        admission threshold; submits past it answer
                             'busy' (default 0 = unbounded)
+  ps.models                 comma list of resident models for the
+                            multi-model router (ISSUE 18), each
+                            ``name`` (follow the registry's serving
+                            version) or ``name:version`` (pinned).
+                            Every fleet worker then runs a ModelRouter
+                            over the whole set; requests route by the
+                            optional wire field ``m=<name[:version]>``
+                            and requests without one serve the default
+                            model (ps.model.name, else the first spec)
+                            byte for byte.  Requires ps.transport=resp.
+  ps.model.<name>.queue.max.depth
+                            per-model admission depth for resident
+                            <name> (tenant isolation: a noisy model is
+                            answered 'busy' at ITS depth while quiet
+                            residents keep their own budget; default =
+                            ps.queue.max.depth)
+  ps.canary.<name>.version  canary this version of resident <name>: a
+                            deterministic per-request-id split routes
+                            ps.canary.<name>.percent % (default 10) of
+                            the model's traffic to it
+  ps.shadow.<name>.version  shadow this version behind resident <name>:
+                            it scores every request, replies come only
+                            from the champion, divergence is counted
+  ps.client.model           stamp every replayed request with this
+                            ``m=<name[:version]>`` routing field (the
+                            producer-side knob; default: no field)
   ps.quantized              serve the int8-quantized forest sidecar
                             (budget-pinned at publish; a version without
                             an intact sidecar warns and serves float —
@@ -125,7 +151,21 @@ def prediction_service(cfg: Config, in_path: str, out_path: str) -> Counters:
         max_queue_depth=cfg.get_int("ps.queue.max.depth", 0))
     n_workers = cfg.get_int("ps.workers", 1)
     timer = StepTimer(keep_samples=cfg.get_int("ps.latency.window", 8192))
-    name = cfg.must_get("ps.model.name")
+    # multi-model residency: ps.models lists name[:version] specs
+    models_spec = [s.strip() for s in
+                   (cfg.get("ps.models") or "").split(",") if s.strip()]
+    if models_spec:
+        from ..serving.router import parse_model_spec
+        model_names = [parse_model_spec(s)[0] for s in models_spec]
+        name = cfg.get("ps.model.name") or model_names[0]
+        model_depths = {
+            m: cfg.get_int(f"ps.model.{m}.queue.max.depth",
+                           policy.max_queue_depth)
+            for m in model_names
+            if f"ps.model.{m}.queue.max.depth" in cfg}
+    else:
+        model_names, model_depths = [], {}
+        name = cfg.must_get("ps.model.name")
     buckets = tuple(cfg.get_int_list("ps.bucket.sizes",
                                      list(DEFAULT_BUCKETS)))
     warm = cfg.get_boolean("ps.warm.start", True)
@@ -142,6 +182,12 @@ def prediction_service(cfg: Config, in_path: str, out_path: str) -> Counters:
     if n_workers > 1 and transport != "resp":
         raise ValueError("ps.workers > 1 requires ps.transport=resp "
                          "(the fleet drains a RESP request queue)")
+    if models_spec and transport != "resp":
+        raise ValueError("ps.models requires ps.transport=resp (the "
+                         "model router serves through the fleet)")
+    if models_spec and version:
+        raise ValueError("ps.models and ps.model.version are exclusive "
+                         "— pin per model with name:version specs")
     if (n_shards > 1 or autoscale) and transport != "resp":
         raise ValueError("ps.broker.shards > 1 / ps.autoscale require "
                          "ps.transport=resp (both live on the wire tier)")
@@ -167,7 +213,7 @@ def prediction_service(cfg: Config, in_path: str, out_path: str) -> Counters:
                               delim=cfg.field_delim_out,
                               quantized=quantized)
 
-    if n_workers > 1 or autoscale or n_shards > 1:
+    if n_workers > 1 or autoscale or n_shards > 1 or models_spec:
         # the fleet path also carries a 1-worker fleet over a sharded
         # ring (the RespPredictionLoop below is single-endpoint only)
         import os
@@ -206,16 +252,33 @@ def prediction_service(cfg: Config, in_path: str, out_path: str) -> Counters:
                 start_workers = max(
                     n_workers, cfg.get_int("ps.autoscale.min.workers", 1))
             fleet = ServingFleet(
-                registry=None if version else registry,
-                model_name=None if version else name,
-                predictor_factory=pinned_factory if version else None,
+                registry=registry if models_spec
+                else (None if version else registry),
+                model_name=name if models_spec
+                else (None if version else name),
+                predictor_factory=(pinned_factory
+                                   if version and not models_spec
+                                   else None),
                 schema=schema, buckets=buckets, policy=policy,
                 n_workers=start_workers, config=wire_cfg, warm=warm,
                 delim=od, quantized=quantized,
                 host_label=cfg.get("ps.host.label"),
                 latency_window=cfg.get_int("ps.latency.window", 8192),
-                wire_native=wire_native)
+                wire_native=wire_native,
+                models=models_spec or None,
+                model_depths=model_depths or None)
             fleet.start()
+            # deployment policies as config (multi-model fleets only)
+            for mname in model_names:
+                cv = cfg.get_int(f"ps.canary.{mname}.version", 0)
+                if cv:
+                    fleet.install_canary(
+                        mname, version=cv,
+                        percent=cfg.get_int(
+                            f"ps.canary.{mname}.percent", 10))
+                sv = cfg.get_int(f"ps.shadow.{mname}.version", 0)
+                if sv:
+                    fleet.install_shadow(mname, version=sv)
             if autoscale:
                 # sensor connection is its own client (one per thread)
                 sensor = make_queue_client(wire_cfg, delim=od)
@@ -236,6 +299,10 @@ def prediction_service(cfg: Config, in_path: str, out_path: str) -> Counters:
             if ttl_ms > 0:
                 from ..telemetry import reqtrace
                 msgs = reqtrace.stamp_deadline(msgs, ttl_ms, delim=od)
+            client_model = cfg.get("ps.client.model")
+            if client_model:
+                from ..telemetry import reqtrace
+                msgs = reqtrace.stamp_model(msgs, client_model, delim=od)
             feeder.lpush_many(req_q, msgs)
             feeder.lpush(req_q, "stop")
             if not fleet.wait(timeout_s=300.0):

@@ -63,9 +63,10 @@ from ..core.metrics import Counters
 from ..monitor.policy import (ALERT, DEFAULT_ALERT, AccuracyTracker,
                               AlertRecord, DriftPolicy)
 from ..telemetry import instant, span
-from .journal import (ABANDONED, CANDIDATE_VALIDATE, COMPLETE, FLEET_SWAP,
-                      PROBATION, PUBLISHED, REFUSED, REGISTRY_PUBLISH,
-                      RETRAIN_BUILD, ROLLBACK, ROLLED_BACK, CycleJournal)
+from .journal import (ABANDONED, CANARY_VALIDATE, CANDIDATE_VALIDATE,
+                      COMPLETE, FLEET_SWAP, PROBATION, PUBLISHED, REFUSED,
+                      REGISTRY_PUBLISH, RETRAIN_BUILD, ROLLBACK,
+                      ROLLED_BACK, CycleJournal)
 
 CANDIDATE_DIR = "candidate"
 CANDIDATE_META = "meta.json"
@@ -99,6 +100,16 @@ class RetrainPolicy:
     # the candidate ever arrived).  0 = wait indefinitely;
     # resolve_probation() is the operator escape either way.
     probation_timeout_s: float = 24 * 3600.0
+    # canary validation (ISSUE 18): with canary_outcomes > 0 and a
+    # models= fleet attached, a validated candidate serves a
+    # deterministic canary_percent% live split (pre-publish, from the
+    # in-memory payload) and must score within accuracy_margin of the
+    # journaled champion accuracy over canary_outcomes candidate-arm
+    # outcomes before the cycle publishes.  0 = journaled skip (the
+    # canary_validate stage records why and passes straight through).
+    canary_outcomes: int = 0
+    canary_percent: int = 10
+    canary_timeout_s: float = 3600.0
     swap_ack_timeout_s: float = 30.0
     cooldown_s: float = 0.0          # min seconds between cycle starts
     chunk_rows: int = 1 << 16        # streaming build block size
@@ -111,6 +122,10 @@ class RetrainPolicy:
         if self.probation_outcomes < 0 or self.probation_windows < 1:
             raise ValueError("probation_outcomes must be >= 0 and "
                              "probation_windows >= 1")
+        if self.canary_outcomes < 0 \
+                or not 0 <= self.canary_percent <= 100:
+            raise ValueError("canary_outcomes must be >= 0 and "
+                             "canary_percent 0..100")
         if self.checkpoint_blocks < 1 or self.chunk_rows < 1:
             raise ValueError("chunk_rows and checkpoint_blocks must be "
                              ">= 1")
@@ -248,6 +263,13 @@ class RetrainController:
         # probation outcome buffers (live delayed labels)
         self._prob_pred: List[str] = []
         self._prob_actual: List[str] = []
+        # canary_validate live state: True only while THIS process has
+        # the canary installed on the fleet (deliberately not journaled
+        # — a restarted controller re-installs on resume; buffered
+        # outcomes restart with it)
+        self._canary_live = False
+        self._can_pred: Dict[str, List[str]] = {}
+        self._can_actual: Dict[str, List[str]] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -283,6 +305,20 @@ class RetrainController:
         do."""
         with self._lock:
             if self.journal.pending:
+                if self.journal.stage == CANARY_VALIDATE \
+                        and self._canary_live:
+                    # WAITING on live canary outcomes, not crashed:
+                    # record_canary_outcome drives it.  Past the timeout
+                    # the candidate proceeds to publish — no evidence
+                    # against it ever arrived (the probation-timeout
+                    # rationale, one stage earlier).
+                    can = self.journal["canary"] or {}
+                    opened = float(can.get("opened_unix") or 0)
+                    if self.policy.canary_timeout_s > 0 and opened \
+                            and time.time() - opened \
+                            > self.policy.canary_timeout_s:
+                        return self._resolve_canary_locked(timed_out=True)
+                    return None
                 if self.journal.stage == PROBATION:
                     # not a crash to resume: the cycle is WAITING on live
                     # delayed labels (record_outcome drives it); alerts
@@ -319,7 +355,9 @@ class RetrainController:
         candidate a fresh one."""
         with self._lock:
             if self.journal.pending:
-                if self.journal.stage == PROBATION:
+                if self.journal.stage == PROBATION or \
+                        (self.journal.stage == CANARY_VALIDATE
+                         and self._canary_live):
                     return None
                 return self._resume_locked()
             return self._run_cycle_locked(None, mode=mode)
@@ -422,8 +460,8 @@ class RetrainController:
                       stage=RETRAIN_BUILD, cycle=self.journal.cycle):
                 models, baseline = self._stage_build(resuming)
             stage = CANDIDATE_VALIDATE
-        if stage in (CANDIDATE_VALIDATE, REGISTRY_PUBLISH) \
-                and models is None:
+        if stage in (CANDIDATE_VALIDATE, CANARY_VALIDATE,
+                     REGISTRY_PUBLISH) and models is None:
             cand = self._load_candidate()
             if cand is None:
                 # resume found no usable candidate payload: published
@@ -445,6 +483,17 @@ class RetrainController:
                 verdict = self._stage_validate(models, baseline)
             if verdict is not None:
                 return verdict           # refused
+            stage = CANARY_VALIDATE
+        if stage == CANARY_VALIDATE:
+            with span("controller.stage", cat="controller",
+                      stage=CANARY_VALIDATE, cycle=self.journal.cycle):
+                waiting = self._stage_canary(models)
+            if waiting:
+                # the cycle now WAITS on live canary outcomes —
+                # record_canary_outcome (or the timeout) decides it
+                return {"cycle": self.journal.cycle,
+                        "stage": CANARY_VALIDATE,
+                        "canary": self.journal["canary"]}
             stage = REGISTRY_PUBLISH
         if stage == REGISTRY_PUBLISH:
             with span("controller.stage", cat="controller",
@@ -631,7 +680,7 @@ class RetrainController:
                 f"{champ_norm if champ_norm is not None else 'n/a'}); "
                 f"champion stays", RuntimeWarning)
             return self._complete(REFUSED)
-        jr.advance(REGISTRY_PUBLISH)
+        jr.advance(CANARY_VALIDATE)
         return None
 
     def _accuracy_table(self, models, table) -> int:
@@ -641,6 +690,153 @@ class RetrainController:
         card = list(self.schema.class_attr_field.cardinality or [])
         return accuracy_pct(labels, actual,
                             neg_class=card[0], pos_class=card[1])
+
+    # ---- stage: canary_validate (live outcomes drive it) ----
+    def _canary_fleet(self):
+        """The fleet link, iff it speaks the multi-model canary verbs."""
+        f = self.fleet
+        if f is not None and hasattr(f, "install_canary") \
+                and hasattr(f, "record_canary_outcome"):
+            return f
+        return None
+
+    def _stage_canary(self, models) -> bool:
+        """Install the candidate as a live canary (pre-publish, from the
+        in-memory payload) and wait for outcomes.  Returns True when the
+        cycle now waits; False when the stage was a journaled skip
+        (policy disabled, or no canary-capable fleet attached) and the
+        cycle proceeds straight to publish."""
+        jr = self.journal
+        fault_point("canary_validate")
+        fleet = self._canary_fleet()
+        if self.policy.canary_outcomes <= 0 or fleet is None:
+            reason = ("disabled" if self.policy.canary_outcomes <= 0
+                      else "no canary-capable fleet")
+            # journaled skip: the durable record says the stage ran and
+            # WHY it passed through, so a resumed cycle replays the
+            # same decision instead of inventing a canary it never had
+            jr.advance(REGISTRY_PUBLISH, canary={"skipped": True,
+                                                 "reason": reason})
+            self.counters.increment("Controller", "CanarySkipped")
+            instant("controller.decision", cat="controller",
+                    action="canary_skip", cycle=jr.cycle, reason=reason)
+            return False
+        from ..serving.predictor import ForestPredictor
+        card = list(self.schema.class_attr_field.cardinality or [])
+        pred = ForestPredictor(models, self.schema)
+        fleet.install_canary(self.model_name, predictor=pred,
+                             percent=self.policy.canary_percent,
+                             pos_class=card[1], neg_class=card[0],
+                             window=max(1, self.policy.canary_outcomes))
+        self._can_pred = {"champion": [], "candidate": []}
+        self._can_actual = {"champion": [], "candidate": []}
+        self._canary_live = True
+        jr.advance(CANARY_VALIDATE, canary={
+            "needed": self.policy.canary_outcomes,
+            "percent": self.policy.canary_percent,
+            "opened_unix": time.time()})
+        self.counters.increment("Controller", "CanaryInstalled")
+        instant("controller.decision", cat="controller",
+                action="canary_start", cycle=jr.cycle,
+                percent=self.policy.canary_percent,
+                needed=self.policy.canary_outcomes)
+        return True
+
+    def record_canary_outcome(self, rid, predicted: str, actual: str
+                              ) -> Optional[Dict[str, Any]]:
+        """Feed one live delayed-label outcome for a canaried request.
+        The arm is re-derived from the request id by the SAME
+        deterministic split that routed it (no routing journal needed).
+        Collecting ``canary_outcomes`` candidate-arm outcomes decides
+        the stage: candidate accuracy within ``accuracy_margin`` of the
+        journaled champion accuracy proceeds to publish (synchronously,
+        on this thread — the control-plane lane, like probation's
+        deciding outcome); below it the cycle completes REFUSED and the
+        champion keeps 100%.  No-op (None) outside canary-wait."""
+        with self._lock:
+            if self.journal.stage != CANARY_VALIDATE \
+                    or not self._canary_live:
+                return None
+            fleet = self._canary_fleet()
+            arm = None
+            if fleet is not None:
+                arm = fleet.record_canary_outcome(
+                    self.model_name, rid, predicted, actual)
+            if arm is None:
+                from ..serving.router import canary_split
+                arm = "candidate" if canary_split(
+                    rid, self.policy.canary_percent) else "champion"
+            self._can_pred[arm].append(predicted)
+            self._can_actual[arm].append(actual)
+            if len(self._can_pred["candidate"]) \
+                    < self.policy.canary_outcomes:
+                return None
+            return self._resolve_canary_locked(timed_out=False)
+
+    def _teardown_canary(self) -> None:
+        fleet = self._canary_fleet()
+        if fleet is not None and self._canary_live:
+            try:
+                fleet.clear_canary(self.model_name)
+            except Exception as exc:
+                warnings.warn(
+                    f"retrain cycle {self.journal.cycle}: canary "
+                    f"teardown failed ({type(exc).__name__}: {exc})",
+                    RuntimeWarning)
+        self._canary_live = False
+
+    def _resolve_canary_locked(self, timed_out: bool
+                               ) -> Optional[Dict[str, Any]]:
+        jr = self.journal
+        card = list(self.schema.class_attr_field.cardinality or [])
+        cand_n = len(self._can_pred["candidate"])
+        cand_acc = accuracy_pct(self._can_pred["candidate"],
+                                self._can_actual["candidate"],
+                                neg_class=card[0], pos_class=card[1]) \
+            if cand_n else None
+        champ_n = len(self._can_pred["champion"])
+        champ_acc = accuracy_pct(self._can_pred["champion"],
+                                 self._can_actual["champion"],
+                                 neg_class=card[0], pos_class=card[1]) \
+            if champ_n else None
+        floor = max(0, (jr["champion_accuracy"] or 0)
+                    - self.policy.accuracy_margin)
+        refused = not timed_out and cand_acc is not None \
+            and cand_acc < floor
+        can = dict(jr["canary"] or {})
+        can.update(candidate_accuracy=cand_acc,
+                   candidate_outcomes=cand_n,
+                   champion_accuracy=champ_acc,
+                   champion_outcomes=champ_n,
+                   floor=floor, timed_out=timed_out)
+        jr.update(canary=can)
+        self._teardown_canary()
+        self.counters.increment(
+            "Controller",
+            "CanaryTimeouts" if timed_out else "CanaryWindows")
+        instant("controller.decision", cat="controller",
+                action="canary_verdict", cycle=jr.cycle,
+                candidate_accuracy=cand_acc, floor=floor,
+                candidate_outcomes=cand_n, champion_outcomes=champ_n,
+                refused=refused, timed_out=timed_out)
+        if timed_out:
+            warnings.warn(
+                f"retrain cycle {jr.cycle}: canary received only "
+                f"{cand_n}/{self.policy.canary_outcomes} candidate "
+                f"outcomes within {self.policy.canary_timeout_s}s; "
+                f"proceeding to publish (no evidence against the "
+                f"candidate — wire the delayed-label lane)",
+                RuntimeWarning)
+        if refused:
+            self.counters.increment("Controller", "Refused")
+            warnings.warn(
+                f"retrain cycle {jr.cycle}: candidate refused at canary "
+                f"(live accuracy {cand_acc} under floor {floor} over "
+                f"{cand_n} outcomes); champion keeps 100%",
+                RuntimeWarning)
+            return self._complete(REFUSED)
+        jr.advance(REGISTRY_PUBLISH)
+        return self._advance(REGISTRY_PUBLISH, resuming=False)
 
     # ---- stage: registry_publish ----
     def _find_published(self, sha: Optional[str]) -> Optional[int]:
@@ -893,6 +1089,7 @@ class RetrainController:
 
     def _complete(self, outcome: str) -> Dict[str, Any]:
         jr = self.journal
+        self._teardown_canary()   # no-op unless a canary is still live
         cycle_dir = jr.cycle_dir()
         jr.close_cycle(outcome)
         self._last_cycle_end = time.monotonic()

@@ -19,9 +19,15 @@ anything a restarted controller could not safely act on.
 Stage order (the five chaos-drill fault points map 1:1 onto the five
 active stages)::
 
-    idle -> retrain_build -> candidate_validate -> registry_publish
-         -> fleet_swap -> probation -> complete
-                                    \\-> rollback -> complete
+    idle -> retrain_build -> candidate_validate -> canary_validate
+         -> registry_publish -> fleet_swap -> probation -> complete
+                                           \\-> rollback -> complete
+
+(``canary_validate`` — a policy-gated live-traffic gate (ISSUE 18):
+the candidate serves a deterministic x% canary split on a models=
+fleet and must match the champion's accuracy on its own outcome
+series before publish; ``RetrainPolicy.canary_outcomes == 0`` records
+a journaled skip and the stage is a pass-through.)
 
 Terminal outcomes recorded at ``complete``: ``published`` (candidate
 survived probation or probation disabled), ``refused`` (validation said
@@ -41,17 +47,18 @@ from typing import Any, Dict, List, Optional
 IDLE = "idle"
 RETRAIN_BUILD = "retrain_build"
 CANDIDATE_VALIDATE = "candidate_validate"
+CANARY_VALIDATE = "canary_validate"
 REGISTRY_PUBLISH = "registry_publish"
 FLEET_SWAP = "fleet_swap"
 PROBATION = "probation"
 ROLLBACK = "rollback"
 COMPLETE = "complete"
 
-STAGES = (IDLE, RETRAIN_BUILD, CANDIDATE_VALIDATE, REGISTRY_PUBLISH,
-          FLEET_SWAP, PROBATION, ROLLBACK, COMPLETE)
+STAGES = (IDLE, RETRAIN_BUILD, CANDIDATE_VALIDATE, CANARY_VALIDATE,
+          REGISTRY_PUBLISH, FLEET_SWAP, PROBATION, ROLLBACK, COMPLETE)
 # the resumable (mid-cycle) stages, in order
-ACTIVE_STAGES = (RETRAIN_BUILD, CANDIDATE_VALIDATE, REGISTRY_PUBLISH,
-                 FLEET_SWAP, PROBATION, ROLLBACK)
+ACTIVE_STAGES = (RETRAIN_BUILD, CANDIDATE_VALIDATE, CANARY_VALIDATE,
+                 REGISTRY_PUBLISH, FLEET_SWAP, PROBATION, ROLLBACK)
 
 # outcomes
 PUBLISHED = "published"
@@ -90,6 +97,7 @@ class CycleJournal:
             "candidate_sha": None,     # model fingerprint, set BEFORE publish
             "candidate_version": None,  # set AFTER publish commits
             "probation": None,         # {floor, needed, seen, windows}
+            "canary": None,            # {needed, percent, opened_unix, ...}
             "history": [],             # bounded completed-cycle summaries
         }
 
@@ -164,7 +172,8 @@ class CycleJournal:
             trigger=trigger, mode=mode,
             champion_version=champion_version,
             champion_accuracy=None, candidate_accuracy=None,
-            candidate_sha=None, candidate_version=None, probation=None)
+            candidate_sha=None, candidate_version=None, probation=None,
+            canary=None)
         self.write()
         return self.cycle
 

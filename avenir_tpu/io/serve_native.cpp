@@ -218,6 +218,36 @@ inline int parse_deadline_field(const char* p, const char* e) {
     return 1;
 }
 
+// telemetry/reqtrace._MODEL_RE, compiled to C:
+// ^m=([A-Za-z0-9_.\-]+)(?::(\d+))?$
+// Returns 1 matched — a WELL-FORMED model-routing field (ISSUE 18): the
+// whole batch punts to python, which owns model routing (the router
+// dispatch, the Serving/UnknownModel counter, per-model admission).
+// 0 = not a model field (ordinary feature value — same backward-compat
+// rule as the trace and deadline fields).
+inline int parse_model_field(const char* p, const char* e) {
+    if (e - p < 3 || p[0] != 'm' || p[1] != '=') return 0;
+    const char* d = p + 2;
+    int name_len = 0;
+    while (d < e) {
+        const char c = *d;
+        const bool name_ch = (c >= 'A' && c <= 'Z')
+            || (c >= 'a' && c <= 'z') || (c >= '0' && c <= '9')
+            || c == '_' || c == '.' || c == '-';
+        if (!name_ch) break;
+        ++d;
+        ++name_len;
+    }
+    if (name_len == 0) return 0;
+    if (d == e) return 1;              // m=<name>
+    if (*d != ':') return 0;
+    ++d;
+    if (d == e) return 0;              // m=<name>: — version missing
+    for (; d < e; ++d)
+        if (*d < '0' || *d > '9') return 0;
+    return 1;                          // m=<name>:<digits>
+}
+
 // serving/quantized.py wire-int grammar: canonical signed decimal int8 —
 // "0" or -?[1-9][0-9]{0,2}, value in [-128, 127].  No "-0", no leading
 // zeros, no '+', no whitespace: the golden-bytes pin freezes this form.
@@ -267,7 +297,7 @@ extern "C" {
 
 // ABI marker: native_wire.py refuses a stale .so whose ABI predates the
 // binding (belt over the mtime-based rebuild).
-int32_t awp_abi_version() { return 2; }
+int32_t awp_abi_version() { return 3; }
 
 // Parse one drained batch.  `buf` holds all messages joined by `sep`
 // (a byte no wire message may contain — validated here by separator
@@ -395,6 +425,13 @@ int32_t awp_parse(const char* buf, int64_t buf_len, int64_t n_msgs,
             if (n_tok >= body + 2
                 && parse_deadline_field(fields[body].first,
                                         fields[body].second))
+                return AWP_FALLBACK;
+            // optional model-routing field next (same rule): a
+            // well-formed one punts the batch to python, which owns
+            // model routing; a near-miss is an ordinary feature
+            if (n_tok >= body + 2
+                && parse_model_field(fields[body].first,
+                                     fields[body].second))
                 return AWP_FALLBACK;
             const size_t n_fields = n_tok - body;
             if (!quant) {

@@ -25,7 +25,12 @@ the same model core must also serve online traffic.  Three layers:
   * :mod:`.autoscaler` — :class:`FleetAutoscaler`, the SLO-driven
     sensor→policy→actuator control loop over one fleet: queue-depth
     derivative + recent-p99-vs-SLO sensing, hysteresis so it never
-    flaps, every decision traced and counted (``Autoscaler/*``).
+    flaps, every decision traced and counted (``Autoscaler/*``);
+  * :mod:`.router`    — :class:`ModelRouter`, the multi-model tier
+    (ISSUE 18): N resident models behind one service-shaped surface,
+    per-request routing by the wire ``m=<model[:version]>`` field,
+    cross-model executable sharing, per-model admission depths
+    (tenant isolation), canary/shadow deployment policies.
 """
 
 from .registry import (FOREST, BAYES, LOGISTIC, MLP, LoadedModel,
@@ -34,6 +39,7 @@ from .predictor import (DEFAULT_BUCKETS, BayesPredictor, ForestPredictor,
                         LogisticPredictor, MLPPredictor, Predictor,
                         make_predictor)
 from .service import BatchPolicy, PredictionService, RespPredictionLoop
+from .router import ModelRouter, canary_split, parse_model_spec
 from .fleet import ServingFleet
 from .autoscaler import AutoscalePolicy, FleetAutoscaler
 
@@ -42,6 +48,7 @@ __all__ = [
     "load_model", "save_model", "DEFAULT_BUCKETS", "BayesPredictor",
     "ForestPredictor", "LogisticPredictor", "MLPPredictor", "Predictor",
     "make_predictor", "BatchPolicy", "PredictionService",
-    "RespPredictionLoop", "ServingFleet", "AutoscalePolicy",
+    "RespPredictionLoop", "ModelRouter", "canary_split",
+    "parse_model_spec", "ServingFleet", "AutoscalePolicy",
     "FleetAutoscaler",
 ]

@@ -198,6 +198,21 @@ class FleetAutoscaler:
             return 0.0
         return float(np.percentile(np.asarray(recent), 99)) * 1000.0
 
+    def _sense_model_depths(self) -> Dict[str, int]:
+        # per-tenant pressure (ISSUE 18): a models= fleet exposes
+        # model_queue_depths() — each resident model's own queued
+        # backlog, summed across workers.  The aggregate policy still
+        # decides up/down; the per-model split rides every decision
+        # record and instant so an operator (and the noisy-tenant
+        # bench) can see WHICH tenant's backlog drove the action.
+        probe = getattr(self.fleet, "model_queue_depths", None)
+        if probe is None:
+            return {}
+        try:
+            return dict(probe())
+        except Exception:
+            return {}
+
     def _sense(self) -> Dict:
         now = time.monotonic()
         depth = self._sense_depth()
@@ -207,8 +222,12 @@ class FleetAutoscaler:
         else:
             deriv = (depth - self._last_depth) / (now - self._last_t)
         self._last_depth, self._last_t = depth, now
-        return {"depth": depth, "derivative_per_s": round(deriv, 2),
-                "p99_ms": round(self._sense_p99_ms(), 3)}
+        sensed = {"depth": depth, "derivative_per_s": round(deriv, 2),
+                  "p99_ms": round(self._sense_p99_ms(), 3)}
+        by_model = self._sense_model_depths()
+        if by_model:
+            sensed["depth_by_model"] = by_model
+        return sensed
 
     # ---- policy (pure: no clocks, no actuation) ----
     def decide(self, depth: int, deriv: float, p99_ms: float,

@@ -60,6 +60,7 @@ from ..core.metrics import Counters
 from ..telemetry import reqtrace
 from ..utils.tracing import StepTimer
 from .predictor import DEFAULT_BUCKETS, Predictor
+from .router import ModelRouter, parse_model_spec
 from .service import BatchPolicy, PredictionService
 
 
@@ -117,9 +118,27 @@ class ServingFleet:
                  broker_grace_s: float = 10.0,
                  quantized: bool = False,
                  host_label: Optional[str] = None,
-                 wire_native: str = "auto"):
-        if predictor_factory is None and (registry is None
-                                          or model_name is None):
+                 wire_native: str = "auto",
+                 models: Optional[Sequence] = None,
+                 model_depths: Optional[Dict[str, int]] = None,
+                 shared_cores: bool = True):
+        # multi-model residency (ISSUE 18): models= lists the resident
+        # set ("name" or "name:version" specs); every worker then runs a
+        # ModelRouter over N co-resident services instead of one
+        # PredictionService, and predict messages carrying the optional
+        # wire field m=<name[:version]> route per request.  model_name
+        # (or the first spec) is the default model — requests without an
+        # m= field serve it byte for byte as a single-model fleet would.
+        self.models_spec = list(models) if models else None
+        self._model_depths = dict(model_depths or {})
+        self._shared_cores = bool(shared_cores)
+        if self.models_spec:
+            if registry is None:
+                raise ValueError("models= needs registry=")
+            if model_name is None:
+                model_name = parse_model_spec(self.models_spec[0])[0]
+        elif predictor_factory is None and (registry is None
+                                            or model_name is None):
             raise ValueError("need registry= + model_name=, or "
                              "predictor_factory=")
         if n_workers < 1:
@@ -183,10 +202,29 @@ class ServingFleet:
         self.workers: List[_Worker] = []
 
     # ---- lifecycle ----
-    def _make_service(self, wname: str) -> PredictionService:
+    def _make_service(self, wname: str):
+        if self.models_spec:
+            # one router per worker: N resident models, each with its
+            # own warm predictor cache, sharing compiled executables
+            # where the ProgramCache axes agree (shared_cores)
+            return ModelRouter(self.registry, self.models_spec,
+                               default_model=self.model_name,
+                               policy=self.policy,
+                               model_depths=self._model_depths,
+                               buckets=self._buckets,
+                               counters=Counters(),
+                               warm=self._warm, delim=self.delim,
+                               name=wname,
+                               host_label=self.host_label,
+                               metrics=self._metrics,
+                               latency_window=self._latency_window,
+                               quantized=self._quantized,
+                               wire_native=self._wire_native,
+                               shared_cores=self._shared_cores)
         common = dict(policy=self.policy, warm=self._warm,
                       delim=self.delim, name=wname,
                       host_label=self.host_label,
+                      model_label=self.model_name,
                       counters=Counters(),
                       timer=StepTimer(keep_samples=self._latency_window),
                       metrics=self._metrics,
@@ -320,6 +358,71 @@ class ServingFleet:
             return versions.pop()
         return None
 
+    # ---- multi-model deployment surface (ISSUE 18) ----
+    # Present only on a models= fleet (workers are ModelRouters); the
+    # retrain controller's canary_validate stage and operator tooling
+    # address deployment policies at fleet scope so every worker's
+    # router applies the same split.
+    def _routers(self) -> List[ModelRouter]:
+        return [w.service for w in self.workers
+                if isinstance(w.service, ModelRouter)]
+
+    def install_canary(self, mname: str, version: Optional[int] = None,
+                       percent: int = 10, **kw) -> None:
+        """Canary ``mname`` on EVERY worker: the split is deterministic
+        on the request id, so N workers each applying it locally is one
+        fleet-wide x% split — no coordination traffic."""
+        routers = self._routers()
+        if not routers:
+            raise ValueError("install_canary needs a models= fleet")
+        for r in routers:
+            r.install_canary(mname, version=version, percent=percent,
+                             **kw)
+
+    def clear_canary(self, mname: str):
+        out = None
+        for r in self._routers():
+            got = r.clear_canary(mname)
+            out = out or got
+        return out
+
+    def install_shadow(self, mname: str, version: Optional[int] = None,
+                       **kw) -> None:
+        routers = self._routers()
+        if not routers:
+            raise ValueError("install_shadow needs a models= fleet")
+        for r in routers:
+            r.install_shadow(mname, version=version, **kw)
+
+    def clear_shadow(self, mname: str) -> None:
+        for r in self._routers():
+            r.clear_shadow(mname)
+
+    def record_canary_outcome(self, mname: str, rid, predicted: str,
+                              actual: str):
+        """Outcome labels land on ONE router's trackers (the first
+        worker's) — the arm attribution is re-derived from the id, so
+        any router gives the same answer; one series, not N copies."""
+        routers = self._routers()
+        if not routers:
+            return None
+        return routers[0].record_canary_outcome(mname, rid, predicted,
+                                                actual)
+
+    def canary_state(self, mname: str):
+        routers = self._routers()
+        return routers[0].canary_state(mname) if routers else None
+
+    def model_queue_depths(self) -> Dict[str, int]:
+        """model name -> queued depth summed across workers — the
+        autoscaler's per-tenant pressure sensor (empty for a
+        single-model fleet)."""
+        out: Dict[str, int] = {}
+        for r in self._routers():
+            for mname, d in r.model_queue_depths().items():
+                out[mname] = out.get(mname, 0) + d
+        return out
+
     def wait(self, timeout_s: float = 60.0) -> bool:
         """Block until every drain thread exited (a wire ``stop`` message
         or :meth:`stop` ended the fleet); True when all did."""
@@ -353,8 +456,21 @@ class ServingFleet:
         per-worker model versions (converged after a coordinated
         hot-swap), queue depths, degraded flags."""
         per = {w.name: w.service.stats() for w in self.workers}
+        per_model: Dict[str, Dict] = {}
+        for s in per.values():
+            # multi-model workers (ModelRouter) expose a per_model
+            # breakdown; fold the per-tenant numbers across workers
+            for mname, ms in (s.get("per_model") or {}).items():
+                agg = per_model.setdefault(
+                    mname, {"queue_depth": 0, "requests": 0,
+                            "rejected": 0, "model_version": None})
+                agg["queue_depth"] += ms["queue_depth"]
+                agg["requests"] += ms["requests"]
+                agg["rejected"] += ms["rejected"]
+                agg["model_version"] = ms["model_version"]
         return {
             "host": self.host_label,
+            "per_model": per_model,
             "workers": len(self.workers),
             "active_workers": self.active_workers(),
             "parked": {w.name: w.parked.is_set() for w in self.workers},
@@ -624,15 +740,23 @@ class ServingFleet:
                 # 'busy' and the flush answers <id>,busy.  A sampled
                 # request (optional wire trace field, ISSUE 15) gets its
                 # worker-pop flow step here and rides its context into
-                # the service batch.
-                rid, row, ctx, deadline_us = \
-                    reqtrace.split_predict_deadline(parts)
+                # the service batch.  The optional m=<model[:version]>
+                # field (ISSUE 18) routes a multi-model worker; a
+                # single-model service serves its one model for any tag.
+                rid, row, ctx, deadline_us, model_tag = \
+                    reqtrace.split_predict_route(parts)
                 if ctx is not None:
                     ctx.t_pop_us = reqtrace.now_us()
+                    mspec = ""
+                    if model_tag:
+                        mspec = model_tag[0] + (
+                            f":{model_tag[1]}"
+                            if model_tag[1] is not None else "")
                     reqtrace.emit_flow("t", rid, "pop",
                                        ts_us=ctx.t_pop_us,
                                        worker=w.name,
-                                       host=self.host_label)
+                                       host=self.host_label,
+                                       model=mspec)
                 if deadline_us is not None \
                         and reqtrace.now_us() > deadline_us:
                     # deadline-aware admission (ISSUE 17): past-deadline
@@ -644,9 +768,14 @@ class ServingFleet:
                     fut.set_result(svc.late_label)
                     w.pending.append((rid, fut, ctx))
                     continue
-                w.pending.append(
-                    (rid, svc.submit(row, trace=ctx, sample_local=False),
-                     ctx))
+                if hasattr(svc, "submit_routed"):
+                    fut = svc.submit_routed(row, rid=rid,
+                                            model_tag=model_tag,
+                                            trace=ctx,
+                                            sample_local=False)
+                else:
+                    fut = svc.submit(row, trace=ctx, sample_local=False)
+                w.pending.append((rid, fut, ctx))
             else:
                 svc.counters.increment("Serving", "BadRequests")
                 warnings.warn(f"fleet {w.name}: dropping malformed "

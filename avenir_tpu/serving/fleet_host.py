@@ -2,6 +2,7 @@
 
     python -m avenir_tpu.serving.fleet_host \
         --registry <dir> --model <name> \
+        [--models name[:ver],name2...] [--model-depth name=N] \
         --endpoints host:port[,host:port...] \
         [--workers N] [--host-label h] [--batching continuous|drain] \
         [--max-batch 64] [--max-wait-ms 2.0] [--slo-p99-ms 0] \
@@ -46,7 +47,21 @@ import time
 def _parse_args(argv):
     ap = argparse.ArgumentParser(prog="fleet_host", description=__doc__)
     ap.add_argument("--registry", required=True)
-    ap.add_argument("--model", required=True)
+    ap.add_argument("--model", default=None,
+                    help="single resident model (classic form); "
+                         "required unless --models is given")
+    ap.add_argument("--models", default=None,
+                    help="comma-separated resident model specs "
+                         "(name or name:version): every worker runs a "
+                         "ModelRouter over the set and requests route "
+                         "by the wire m=<name[:version]> field; "
+                         "--model (or the first spec) is the default "
+                         "model for untagged requests")
+    ap.add_argument("--model-depth", action="append", default=[],
+                    metavar="NAME=DEPTH",
+                    help="per-model admission queue depth (tenant "
+                         "isolation; repeatable; default "
+                         "--max-queue-depth)")
     ap.add_argument("--endpoints", required=True,
                     help="comma-separated broker shard host:port list")
     ap.add_argument("--workers", type=int, default=2)
@@ -155,12 +170,23 @@ def main(argv=None) -> int:
         print(f"fleet_host: /metrics on {msrv.url}", file=sys.stderr)
     from ..io import native_wire
     native_wire.set_mode(args.wire_native)
+    if not args.model and not args.models:
+        print("fleet_host: --model or --models is required",
+              file=sys.stderr)
+        return 2
+    models = [s.strip() for s in (args.models or "").split(",")
+              if s.strip()] or None
+    depths = {}
+    for spec in args.model_depth:
+        mname, _, d = spec.partition("=")
+        depths[mname.strip()] = int(d)
     fleet = ServingFleet(
         registry, args.model,
         buckets=tuple(int(b) for b in args.buckets.split(",")),
         policy=policy, n_workers=n_workers, config=wire_cfg,
         host_label=args.host_label, metrics=metrics,
-        wire_native=args.wire_native)
+        wire_native=args.wire_native,
+        models=models, model_depths=depths or None)
     fleet.start()
     scaler = sensor = None
     if scale is not None:

@@ -34,6 +34,48 @@ from .registry import BAYES, FOREST, LOGISTIC, MLP, LoadedModel
 DEFAULT_BUCKETS = (1, 8, 64, 512)
 AMBIGUOUS = "ambiguous"   # the ensemble's min-odds veto, as a wire label
 
+# --------------------------------------------------------------------------
+# cross-model executable sharing (ISSUE 18)
+# --------------------------------------------------------------------------
+# Co-resident models whose compiled programs are structurally identical —
+# same family variant, same schema fingerprint, same bucket ladder, same
+# mesh, same parameter shapes/dtypes — share ONE jitted core: the weights
+# travel as call arguments, not as closed-over constants, so a second
+# model with matching axes reuses the first model's warm executables
+# instead of recompiling them (Execution Templates' install-once/
+# instantiate-cheap argument applied across the model zoo).  The key is
+# derived from the same axes ProgramCache uses (stage variant, schema fp,
+# shapes/dtypes, mesh fp) — NEVER model identity.  Opt-in per predictor
+# (``shared_cores=True``, the router default): the per-instance closure
+# path stays byte-for-byte what it was.  Trace attribution: the shared
+# core bumps the BUILDING predictor's ``compile_count`` (tracing happens
+# once, under the builder), so a sharing model's own count stays 0 — the
+# pinned instrument for the sharing tests.
+_SHARED_CORES: Dict[tuple, Any] = {}
+
+
+def _shared_core_key(variant, schema: FeatureSchema,
+                     buckets: Sequence[int], arg_fp) -> tuple:
+    from ..parallel.mesh import runtime_context
+    from ..pipeline.cache import mesh_fingerprint, schema_fingerprint
+    return (variant, schema_fingerprint(schema), tuple(buckets),
+            mesh_fingerprint(runtime_context()), arg_fp)
+
+
+def _shared_core(key: tuple, build):
+    fn = _SHARED_CORES.get(key)
+    if fn is None:
+        fn = build()
+        _SHARED_CORES[key] = fn
+    return fn
+
+
+def _array_fp(arrays) -> tuple:
+    """Shape/dtype fingerprint of a flat array sequence (the
+    shapes/dtypes cache axis)."""
+    return tuple((tuple(np.shape(a)), str(np.result_type(a)))
+                 for a in arrays)
+
 
 class Predictor:
     """Base: tokenized-row requests -> class-label strings, bucketed."""
@@ -42,7 +84,7 @@ class Predictor:
 
     def __init__(self, schema: FeatureSchema,
                  buckets: Sequence[int] = DEFAULT_BUCKETS,
-                 delim: str = ","):
+                 delim: str = ",", shared_cores: bool = False):
         self.schema = schema
         self.buckets = tuple(sorted({int(b) for b in buckets}))
         if not self.buckets or self.buckets[0] < 1:
@@ -50,6 +92,7 @@ class Predictor:
         self.delim = delim
         self._split = _make_splitter(delim)
         self.compile_count = 0
+        self.shared_cores = bool(shared_cores)
 
     # ---- bucketing ----
     def bucket_size(self, n: int) -> int:
@@ -202,10 +245,28 @@ class ForestPredictor(Predictor):
             else:
                 body = _ensemble_vote_body
 
-            def core(vals, codes):
-                self._note_trace()
-                return body(vals, codes, *consts, wvec, min_odds)
-            self._core = jax.jit(core)
+            if self.shared_cores:
+                # weights as call args, keyed on the ProgramCache axes:
+                # a co-resident model with the same variant/schema/
+                # buckets/mesh/shape structure reuses this executable
+                extra = (*consts, wvec, min_odds)
+                key = _shared_core_key(
+                    ("forest", self._vote_backend), self.schema,
+                    self.buckets, _array_fp(extra))
+
+                def build():
+                    def core(vals, codes, *cs):
+                        self._note_trace()
+                        return body(vals, codes, *cs)
+                    return jax.jit(core)
+                jitted = _shared_core(key, build)
+                self._core = lambda vals, codes: \
+                    jitted(vals, codes, *extra)
+            else:
+                def core(vals, codes):
+                    self._note_trace()
+                    return body(vals, codes, *consts, wvec, min_odds)
+                self._core = jax.jit(core)
         else:
             # degenerate member / non-f32-exact bounds: the host vote path
             # is exact and compile-free, so bucketing is moot
@@ -347,7 +408,9 @@ class ForestPredictor(Predictor):
 class BayesPredictor(Predictor):
     """Naive bayes serving through models/bayes.predict itself (its kernels
     are module-level jits keyed by batch shape, so the bucket padding here
-    is exactly what bounds their compile count)."""
+    is exactly what bounds their compile count — and co-resident bayes
+    models already share executables by construction; ``shared_cores``
+    is a no-op here)."""
 
     kind = BAYES
 
@@ -383,7 +446,13 @@ class LogisticPredictor(Predictor):
         def core(X, w):
             self._note_trace()
             return jax.nn.sigmoid(X @ w)
-        self._core = jax.jit(core)
+        if self.shared_cores:
+            self._core = _shared_core(
+                _shared_core_key(LOGISTIC, self.schema, self.buckets,
+                                 _array_fp((self.w,))),
+                lambda: jax.jit(core))
+        else:
+            self._core = jax.jit(core)
 
     def _proba_table(self, table: ColumnarTable) -> np.ndarray:
         """sigmoid([1, x...] @ w) for one bucket-padded table — the
@@ -428,7 +497,15 @@ class MLPPredictor(Predictor):
         def core(X, params):
             self._note_trace()
             return jnp.argmax(_mlp.forward_logits(params, X), axis=-1)
-        self._core = jax.jit(core)
+        if self.shared_cores:
+            arg_fp = tuple(sorted(
+                (k, tuple(v.shape), str(v.dtype))
+                for k, v in self.params.items()))
+            self._core = _shared_core(
+                _shared_core_key(MLP, self.schema, self.buckets, arg_fp),
+                lambda: jax.jit(core))
+        else:
+            self._core = jax.jit(core)
 
     def _predict_table(self, table: ColumnarTable) -> List[Optional[str]]:
         X = jnp.asarray(table.feature_matrix(dtype=np.float32))

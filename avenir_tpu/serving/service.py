@@ -212,10 +212,12 @@ class PredictionService:
                  late_label: str = "late",
                  name: Optional[str] = None,
                  host_label: Optional[str] = None,
+                 model_label: Optional[str] = None,
                  monitor=None,
                  metrics=None,
                  quantized: bool = False,
-                 wire_native: str = "auto"):
+                 wire_native: str = "auto",
+                 shared_cores: bool = False):
         if predictor is None and (registry is None or model_name is None):
             raise ValueError("need a predictor, or registry= + model_name=")
         if wire_native not in native_wire.MODES:
@@ -230,6 +232,12 @@ class PredictionService:
         # the int8 predictor from the version's sidecar; a version
         # without one warns and serves float (serving/quantized.py)
         self._quantized = bool(quantized)
+        # cross-model executable sharing (ISSUE 18): registry loads build
+        # predictors whose jitted cores are memoized on the ProgramCache
+        # axes (variant, schema fp, buckets, mesh fp, arg shapes) instead
+        # of model identity — N residents with structurally identical
+        # programs compile once (serving/predictor.py _SHARED_CORES)
+        self._shared_cores = bool(shared_cores)
         self.policy = policy or BatchPolicy()
         self.counters = counters if counters is not None else Counters()
         self.timer = timer if timer is not None else \
@@ -253,6 +261,12 @@ class PredictionService:
         # the PR 8 `service` label, one level up.  ServingFleet threads
         # its host_label through here.
         self.host_label = host_label
+        # multi-model identity (ISSUE 18): every bound series also
+        # carries a `model` label (empty when unset — the single-model
+        # shape) so N resident models behind one ModelRouter land as
+        # disjoint per-tenant series in one scrape.  Same fix shape as
+        # host, one level down.
+        self.model_label = model_label
         self.version: Optional[int] = None
         # drift/quality hook (monitor.accumulator.ServingMonitor): every
         # served micro-batch records through it; None = unmonitored
@@ -320,7 +334,8 @@ class PredictionService:
         loaded = self.registry.load(self.model_name, latest)
         pred = make_predictor(loaded, schema=self._schema,
                               buckets=self._buckets, delim=self.delim,
-                              quantized=self._quantized)
+                              quantized=self._quantized,
+                              shared_cores=self._shared_cores)
         if self._warm:
             pred.warm()
         self.version = latest
@@ -343,7 +358,8 @@ class PredictionService:
         loaded = self.registry.load(self.model_name, latest)
         pred = make_predictor(loaded, schema=self._schema,
                               buckets=self._buckets, delim=self.delim,
-                              quantized=self._quantized)
+                              quantized=self._quantized,
+                              shared_cores=self._shared_cores)
         if self._warm:
             pred.warm()
         with self._swap_lock:
@@ -387,6 +403,7 @@ class PredictionService:
             "degraded": self.degraded,
             "model_version": self.version,
             "host": self.host_label or "",
+            "model": self.model_label or "",
         }
 
     def health(self):
@@ -437,37 +454,46 @@ class PredictionService:
         while registry.has_health(_health_key(svc_label)):
             svc_label = f"{base}-{n}"
             n += 1
+        # the model label makes multi-MODEL series disjoint (ISSUE 18);
+        # unset renders as model="" — the single-model serving shape
+        mlabel = self.model_label or ""
         g = registry.gauge("avenir_serving", "prediction service state",
-                           labels=("host", "service", "key"))
+                           labels=("host", "service", "model", "key"))
         gl = registry.gauge("avenir_serving_latency_ms",
                             "serving latency percentiles",
-                            labels=("host", "service", "step", "quantile"))
+                            labels=("host", "service", "model", "step",
+                                    "quantile"))
 
         def probe():
             st = self.stats()
             g.set(st["queue_depth"], host=host, service=svc_label,
-                  key="queue_depth")
+                  model=mlabel, key="queue_depth")
             g.set(st["in_flight"], host=host, service=svc_label,
-                  key="in_flight")
-            g.set(st["served"], host=host, service=svc_label, key="served")
-            g.set(st["errors"], host=host, service=svc_label, key="errors")
+                  model=mlabel, key="in_flight")
+            g.set(st["served"], host=host, service=svc_label,
+                  model=mlabel, key="served")
+            g.set(st["errors"], host=host, service=svc_label,
+                  model=mlabel, key="errors")
             g.set(st["batches"], host=host, service=svc_label,
-                  key="batches")
+                  model=mlabel, key="batches")
             g.set(st["hot_swaps"], host=host, service=svc_label,
-                  key="hot_swaps")
+                  model=mlabel, key="hot_swaps")
             g.set(st["rejected"], host=host, service=svc_label,
-                  key="rejected")
+                  model=mlabel, key="rejected")
             g.set(st["window_ms"], host=host, service=svc_label,
-                  key="window_ms")
+                  model=mlabel, key="window_ms")
             g.set(0 if st["degraded"] is None else 1,
-                  host=host, service=svc_label, key="degraded")
+                  host=host, service=svc_label, model=mlabel,
+                  key="degraded")
             g.set(st["model_version"] or 0,
-                  host=host, service=svc_label, key="model_version")
+                  host=host, service=svc_label, model=mlabel,
+                  key="model_version")
             for step in ("serve.request", "serve.batch"):
                 if self.timer.samples.get(step):
                     for q in (50, 95, 99):
                         gl.set(self.timer.percentile_ms(step, q),
-                               host=host, service=svc_label, step=step,
+                               host=host, service=svc_label,
+                               model=mlabel, step=step,
                                quantile=f"p{q}")
         registry.register_probe(probe)
         health_key = _health_key(svc_label)
@@ -479,14 +505,16 @@ class PredictionService:
             "avenir_request_component_seconds",
             "sampled-request latency decomposition (queue_wait/"
             "coalesce/device/reply/total), exemplar = request id",
-            labels=("host", "service", "component"))
-        self._comp_binding = (ch, {"host": host, "service": svc_label})
+            labels=("host", "service", "model", "component"))
+        self._comp_binding = (ch, {"host": host, "service": svc_label,
+                                   "model": mlabel})
         # remembered so stop() can unbind: a retired service must not be
         # probed (and thereby pinned in memory, predictor and all) by
         # every scrape for the rest of the process
         self._metrics_binding = (registry, probe, health_key,
                                  (g, gl, ch), {"host": host,
-                                               "service": svc_label})
+                                               "service": svc_label,
+                                               "model": mlabel})
 
     def _unbind_metrics(self) -> None:
         if self._metrics_binding is not None:
@@ -561,7 +589,8 @@ class PredictionService:
             with self._swap_lock:
                 _pred = self.predictor
         t0 = time.perf_counter()
-        with span("serve.predict", cat="serving", rows=len(rows)):
+        with span("serve.predict", cat="serving", rows=len(rows),
+                  model=self.model_label or ""):
             if _prepared is not None:
                 out = with_retry(lambda: _pred.predict_prepared(_prepared),
                                  what="serving predict batch")
@@ -914,7 +943,8 @@ class PredictionService:
         try:
             t0 = time.perf_counter()
             try:
-                with span("serve.predict", cat="serving", rows=n_rows):
+                with span("serve.predict", cat="serving", rows=n_rows,
+                          model=self.model_label or ""):
                     out = with_retry(
                         lambda: pred.predict_prepared(prepared),
                         what="serving predict batch")
@@ -947,7 +977,8 @@ class PredictionService:
         try:
             t0 = time.perf_counter()
             try:
-                with span("serve.predict", cat="serving", rows=n):
+                with span("serve.predict", cat="serving", rows=n,
+                          model=self.model_label or ""):
                     out = with_retry(
                         lambda: pred.predict_prebinned(qv, qc),
                         what="serving predictq batch")
@@ -1303,7 +1334,8 @@ class PredictionService:
         rows = [r.row for r in batch]
         try:
             try:
-                with span("serve.predict", cat="serving", rows=len(rows)):
+                with span("serve.predict", cat="serving", rows=len(rows),
+                          model=self.model_label or ""):
                     out = pred.readback_dispatched(handle)
                 results = [("ok", self._label(p)) for p in out]
                 # serve.batch spans dispatch->readback: the batch's real

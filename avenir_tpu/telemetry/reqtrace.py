@@ -213,20 +213,51 @@ def parse_deadline(tok: str) -> Optional[float]:
     return float(m.group(1))
 
 
-def split_predict_deadline(parts: Sequence[str]):
+MODEL_FIELD_PREFIX = "m="
+
+# same backward-compat rule again (TPU_NOTES §27/§30): only
+# `m=<name>` or `m=<name>:<version>` routes, where <name> is
+# [A-Za-z0-9_.-]+ (registry model names) and <version> is digits.
+# Anything laxer would eat a legitimate feature value starting "m=".
+_MODEL_RE = re.compile(r"^m=([A-Za-z0-9_.\-]+)(?::(\d+))?$")
+
+
+def encode_model(name: str, version: Optional[int] = None) -> str:
+    if version is None:
+        return f"{MODEL_FIELD_PREFIX}{name}"
+    return f"{MODEL_FIELD_PREFIX}{name}:{int(version)}"
+
+
+def parse_model(tok: str) -> Optional[Tuple[str, Optional[int]]]:
+    """``(model_name, version_or_None)`` for a model-routing token, None
+    when the token is not one (ordinary feature value — only
+    ``m=<name>[:<version>]`` routes)."""
+    m = _MODEL_RE.match(tok)
+    if m is None:
+        return None
+    v = m.group(2)
+    return m.group(1), (int(v) if v is not None else None)
+
+
+def split_predict_route(parts: Sequence[str]):
     """Consumer-side parse of an already-split predict message:
-    ``(request_id, row_fields, ctx_or_None, deadline_us_or_None)``.
+    ``(request_id, row_fields, ctx_or_None, deadline_us_or_None,
+    model_tag_or_None)``.
 
     The optional fields ride in order after the id — ``t=...`` then
-    ``d=...``, each independently absent — and each is recognized only
-    when at least one token follows it (a row must remain).  The
-    deadline (ISSUE 17) is absolute epoch microseconds on the
-    :func:`now_us` clock: consumers answer ``<id>,late`` without a
-    device dispatch once it has passed."""
+    ``d=...`` then ``m=...``, each independently absent — and each is
+    recognized only when at least one token follows it (a row must
+    remain).  The deadline (ISSUE 17) is absolute epoch microseconds on
+    the :func:`now_us` clock: consumers answer ``<id>,late`` without a
+    device dispatch once it has passed.  The model tag (ISSUE 18) is
+    ``(name, version_or_None)``: a multi-model router dispatches to that
+    resident model; a single-model service strips it and serves its own
+    model (the tag is advisory, never a feature value)."""
     rid = parts[1]
     i = 2
     ctx = None
     deadline = None
+    model_tag = None
     if len(parts) >= i + 2 and parts[i].startswith(TRACE_FIELD_PREFIX):
         parsed = parse_field(parts[i])
         if parsed is not None:
@@ -239,7 +270,21 @@ def split_predict_deadline(parts: Sequence[str]):
         if d is not None:
             deadline = d
             i += 1
-    return rid, list(parts[i:]), ctx, deadline
+    if len(parts) >= i + 2 and parts[i].startswith(MODEL_FIELD_PREFIX):
+        mt = parse_model(parts[i])
+        if mt is not None:
+            model_tag = mt
+            i += 1
+    return rid, list(parts[i:]), ctx, deadline, model_tag
+
+
+def split_predict_deadline(parts: Sequence[str]):
+    """Consumer-side parse of an already-split predict message:
+    ``(request_id, row_fields, ctx_or_None, deadline_us_or_None)``.
+    A model-routing field is stripped too (multi-model consumers use
+    :func:`split_predict_route`)."""
+    rid, row, ctx, deadline, _ = split_predict_route(parts)
+    return rid, row, ctx, deadline
 
 
 def split_predict(parts: Sequence[str]):
@@ -307,6 +352,37 @@ def stamp_deadline(values: List[str], ttl_ms: float,
         if len(parts) > j + 1 and parse_field(parts[j]) is not None:
             j += 1
         if len(parts) > j + 1 and parse_deadline(parts[j]) is not None:
+            continue
+        if out is None:
+            out = list(values)
+        out[i] = delim.join(parts[:j] + [field] + parts[j:])
+    return out if out is not None else values
+
+
+def stamp_model(values: List[str], model_spec: str,
+                delim: str = ",") -> List[str]:
+    """Stamp every un-stamped predict message in a push batch with a
+    model-routing field (``ps.client.model`` producer knob;
+    ``model_spec`` is ``<name>`` or ``<name>:<version>``).  Rides AFTER
+    trace and deadline fields when present; already-tagged messages keep
+    their original tag (a re-offer must not re-route).  A false-y spec
+    returns the input unchanged (same object)."""
+    if not model_spec:
+        return values
+    if parse_model(MODEL_FIELD_PREFIX + str(model_spec)) is None:
+        raise ValueError(f"bad model spec: {model_spec!r}")
+    field = MODEL_FIELD_PREFIX + str(model_spec)
+    out: Optional[List[str]] = None
+    for i, v in enumerate(values):
+        parts = v.split(delim)
+        if parts[0] not in ("predict", "predictq") or len(parts) < 3:
+            continue
+        j = 2
+        if len(parts) > j + 1 and parse_field(parts[j]) is not None:
+            j += 1
+        if len(parts) > j + 1 and parse_deadline(parts[j]) is not None:
+            j += 1
+        if len(parts) > j + 1 and parse_model(parts[j]) is not None:
             continue
         if out is None:
             out = list(values)

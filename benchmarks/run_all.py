@@ -809,6 +809,159 @@ def _durable_bench(scale):
         shutil.rmtree(jroot, ignore_errors=True)
 
 
+def _multimodel_bench(models, schema, req_rows, scale):
+    """The multi-model router tier (ISSUE 18), four numbers: (a)
+    cross-model executable sharing — compile counts and warm wall time
+    for same-shaped residents with the shared-core table on vs off;
+    (b) mixed-model closed-loop throughput through ONE router with
+    per-tenant p99 off ``model_timers()``; (c) the noisy-neighbor
+    drill — one tenant floods past ITS OWN admission depth while the
+    other's paced trickle must hold near its unflooded p99 (the
+    isolation acceptance number); (d) the deterministic canary split —
+    observed candidate fraction vs the configured percent, re-derived
+    exactly from the request ids alone."""
+    import shutil
+    import tempfile
+
+    from avenir_tpu.serving import predictor as predictor_mod
+    from avenir_tpu.serving.predictor import make_predictor
+    from avenir_tpu.serving.registry import ModelRegistry
+    from avenir_tpu.serving.router import ModelRouter, canary_split
+    from avenir_tpu.serving.service import BatchPolicy
+    from avenir_tpu.utils.tracing import StepTimer
+
+    n_req = max(int(2_000 * scale), 200)
+    reg_dir = tempfile.mkdtemp(prefix="avt_mmreg_")
+    try:
+        reg = ModelRegistry(reg_dir)
+        # the same forest under two tenant names: identical variant /
+        # schema fingerprint / shapes, so the shared-core table should
+        # compile ONE executable set for both residents
+        reg.publish("churn", models, schema=schema)
+        reg.publish("fraud", models, schema=schema)
+
+        pol = BatchPolicy(max_batch=64, max_wait_ms=2.0)
+        predictor_mod._SHARED_CORES.clear()
+        t0 = time.perf_counter()
+        router = ModelRouter(reg, ["churn", "fraud"], policy=pol)
+        warm_shared_s = time.perf_counter() - t0
+        res = router._residents
+        compiles_shared = sum(svcs[0].predictor.compile_count
+                              for svcs in res.values())
+        t0 = time.perf_counter()
+        unshared = [make_predictor(reg.load(m), shared_cores=False).warm()
+                    for m in ("churn", "fraud")]
+        warm_unshared_s = time.perf_counter() - t0
+        sharing = {
+            "residents": 2,
+            "compiles_shared": compiles_shared,
+            "compiles_unshared": sum(p.compile_count for p in unshared),
+            "warm_shared_s": round(warm_shared_s, 3),
+            "warm_unshared_s": round(warm_unshared_s, 3),
+        }
+
+        router.start()
+        try:
+            # (b) mixed closed-loop load, strictly alternating tenants
+            tags = [("churn", None), ("fraud", None)]
+            t0 = time.perf_counter()
+            futs = [router.submit_routed(req_rows[i % len(req_rows)],
+                                         rid=f"mm-{i}",
+                                         model_tag=tags[i % 2])
+                    for i in range(n_req)]
+            for f in futs:
+                f.result(timeout=120)
+            dt = time.perf_counter() - t0
+            mixed = {"n_requests": n_req,
+                     "throughput_req_per_sec": round(n_req / dt, 1)}
+            for m, t in router.model_timers().items():
+                mixed[f"{m}_p99_ms"] = round(
+                    t.percentile_ms("serve.request", 99), 3)
+
+            # (d) the canary split is a pure function of the request id
+            router.install_canary("churn", version=1, percent=10)
+            n_can = min(n_req, 1000)
+            cfuts = [router.submit_routed(req_rows[i % len(req_rows)],
+                                          rid=f"cs-{i}",
+                                          model_tag=("churn", None))
+                     for i in range(n_can)]
+            for f in cfuts:
+                f.result(timeout=120)
+            got = router.counters.get("Model", "churn/CanaryRequests")
+            want = sum(canary_split(f"cs-{i}", 10) for i in range(n_can))
+            canary = {"percent": 10, "n_requests": n_can,
+                      "candidate_requests": got,
+                      "observed_fraction": round(got / n_can, 4),
+                      "rederived_from_ids_match": got == want}
+            router.clear_canary("churn")
+        finally:
+            router.stop()
+
+        # (c) noisy neighbor: fraud is slowed (a sleep per batch, the
+        # bench stand-in for a heavy model) AND capped at depth 4, then
+        # flooded; churn's paced trickle runs before and during
+        class _Throttled:
+            def __init__(self, inner, delay_s):
+                self._inner, self._delay = inner, delay_s
+
+            def warm(self):
+                self._inner.warm()
+                return self
+
+            def predict_rows(self, rows):
+                time.sleep(self._delay)
+                return self._inner.predict_rows(rows)
+
+        n_quiet = max(int(200 * scale), 50)
+        n_flood = max(int(1_000 * scale), 200)
+        router2 = ModelRouter(reg, ["churn", "fraud"],
+                              policy=BatchPolicy(max_batch=16,
+                                                 max_wait_ms=2.0),
+                              model_depths={"fraud": 4})
+        r2 = router2._residents
+        r2["fraud"][0].predictor = _Throttled(r2["fraud"][0].predictor,
+                                              0.02)
+
+        def quiet_pass(prefix):
+            r2["churn"][0].timer = StepTimer(keep_samples=1 << 14)
+            qfuts = []
+            for i in range(n_quiet):
+                qfuts.append(router2.submit_routed(
+                    req_rows[i % len(req_rows)], rid=f"{prefix}-{i}",
+                    model_tag=("churn", None)))
+                time.sleep(0.002)
+            for f in qfuts:
+                f.result(timeout=120)
+            return r2["churn"][0].timer.percentile_ms(
+                "serve.request", 99)
+
+        router2.start()
+        try:
+            base_p99 = quiet_pass("qa")
+            ffuts = [router2.submit_routed(
+                req_rows[i % len(req_rows)], rid=f"fl-{i}",
+                model_tag=("fraud", None)) for i in range(n_flood)]
+            flood_p99 = quiet_pass("qb")
+            for f in ffuts:
+                f.result(timeout=120)
+            noisy = {
+                "flood_requests": n_flood,
+                "fraud_depth": 4,
+                "fraud_shed_busy": router2.counters.get(
+                    "Model", "fraud/Rejected"),
+                "churn_rejected": router2.counters.get(
+                    "Model", "churn/Rejected"),
+                "quiet_p99_ms_alone": round(base_p99, 3),
+                "quiet_p99_ms_under_flood": round(flood_p99, 3),
+            }
+        finally:
+            router2.stop()
+        return {"shared_cores": sharing, "mixed_load": mixed,
+                "noisy_neighbor": noisy, "canary_split": canary}
+    finally:
+        shutil.rmtree(reg_dir, ignore_errors=True)
+
+
 def bench_serve_forest(scale):
     """Online forest serving: micro-batched request loop throughput and
     latency percentiles at several offered loads (plus a closed-loop pass
@@ -975,6 +1128,11 @@ def bench_serve_forest(scale):
     # fraction, plus how long a killed shard's restart replay takes as
     # the journaled backlog deepens
     durable = _durable_bench(scale)
+    # the multi-model router tier (ISSUE 18): executable sharing across
+    # same-shaped residents, mixed-tenant throughput, the noisy-neighbor
+    # p99 isolation drill, and the deterministic canary split — on the
+    # same toy forest (the router/wire path is what is being priced)
+    multimodel = _multimodel_bench(models, schema, req_rows, scale)
     # the int8 quantized serving path (ISSUE 11): publish the forest +
     # budget-pinned quantized sidecar into a scratch registry, replay the
     # same requests through the float and int8 predictors, and read the
@@ -1041,7 +1199,8 @@ def bench_serve_forest(scale):
             "quantized": quantized,
             "fleet_sweep": fleet,
             "horizontal": horizontal,
-            "durable": durable}
+            "durable": durable,
+            "multimodel": multimodel}
 
 
 def bench_wire_codec(scale):

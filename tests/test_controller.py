@@ -675,6 +675,43 @@ def test_registrytool_list_verify_gc(tmp_path, mesh_ctx, capsys):
     assert "TORN" in capsys.readouterr().out
 
 
+@pytest.mark.multimodel
+def test_registrytool_gc_keep_last_applies_per_name(tmp_path, mesh_ctx,
+                                                    capsys):
+    """Multi-model registries (ISSUE 18): ``gc`` without --name sweeps
+    every model, each keeping its OWN newest --keep — and each name's
+    pin protects ITS versions only.  ``list`` flags pin and serving
+    per name."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "registrytool", os.path.join(os.path.dirname(__file__), "..",
+                                     "tools", "registrytool.py"))
+    tool = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tool)
+    reg = small_registry(tmp_path, mesh_ctx, versions=3)   # churn v1..3
+    params = forest_params()
+    table = load_csv_rows(tmp_path)
+    models = build_forest(table, params, mesh_ctx)
+    for _ in range(4):
+        reg.publish("fraud", models, schema=SCHEMA)        # fraud v1..4
+    reg.pin_version(MODEL, 1)
+    reg.pin_version("fraud", 2)
+    base = reg.base_dir
+    assert tool.main(["list", base]) == 0
+    out = capsys.readouterr().out
+    # both names' pin/serving resolve independently in one listing
+    assert "churn: pinned=1 serving=1" in out
+    assert "fraud: pinned=2 serving=2" in out
+    assert "*P" in out                       # pin == serving flags both
+    # one sweep, keep_last PER NAME: each name keeps its own newest 1
+    # plus its own pinned version — churn's pin does not shield fraud
+    assert tool.main(["gc", base, "--keep", "1"]) == 0
+    assert reg.versions(MODEL) == [1, 3]     # own pin + own newest
+    assert reg.versions("fraud") == [2, 4]   # own pin + own newest
+    out = capsys.readouterr().out
+    assert "churn:" in out and "fraud:" in out
+
+
 def test_controller_retires_old_versions_in_loop(tmp_path, mesh_ctx):
     """retire_keep_last in the controller policy GCs after each cycle so
     the publish cadence cannot grow the registry unboundedly."""
@@ -969,3 +1006,185 @@ def test_wire_fleet_link_pushes_addressed_reloads(resp_server):
         assert cli.rpop_many("requestQueue", 10) == ["reload"]
     finally:
         cli.close()
+
+
+# --------------------------------------------------------------------------
+# canary_validate (ISSUE 18): the journaled live-traffic gate
+# --------------------------------------------------------------------------
+
+def start_multimodel_fleet(reg, port, n_workers=2):
+    """The drill fleet, canary-capable: models= puts a ModelRouter in
+    every worker, so the controller's canary verbs actually route."""
+    fleet = ServingFleet(reg, MODEL, buckets=(8, 64),
+                         policy=BatchPolicy(max_batch=16, max_wait_ms=1.0),
+                         n_workers=n_workers, models=[MODEL],
+                         config={"redis.server.port": port})
+    return fleet.start()
+
+
+@pytest.mark.multimodel
+@pytest.mark.faultinject
+def test_chaos_drill_canary_validate_resumes_and_publishes_once(
+        tmp_path, mesh_ctx, resp_server, fault_injector):
+    """Kill the controller AT the canary_validate fault point while a
+    live multi-model fleet drains traffic, then resume: the new
+    controller re-installs the candidate as a live canary (pre-publish —
+    the registry is untouched while the split serves), delayed labels
+    attributed by the SAME deterministic request-id split decide the
+    stage, and the cycle completes with exactly ONE new version."""
+    from avenir_tpu.control.journal import CANARY_VALIDATE
+    from avenir_tpu.io.respq import RespClient
+    reg, params, clean, fresh = build_champion(tmp_path, mesh_ctx)
+    rows = gen_rows(30, seed=77, drifted=True)
+    fleet = start_multimodel_fleet(reg, resp_server.port)
+    feeder = RespClient(port=resp_server.port)
+    try:
+        serve_round(feeder, rows, "pre", 20)
+        ctl = make_controller(reg, params, tmp_path, fresh, fleet=fleet,
+                              canary_outcomes=6, canary_percent=50)
+        ctl.submit_alert(drift_alert())
+        fault_injector("canary_validate@0=raise:RuntimeError")
+        with pytest.raises(RuntimeError, match="injected fault"):
+            ctl.run_pending()
+        # the crash journaled the stage BEFORE any canary was installed:
+        # serving never noticed, the champion answers 100%
+        assert ctl.journal.pending
+        assert ctl.journal.stage == CANARY_VALIDATE
+        serve_round(feeder, rows, "mid", 20)
+        assert fleet.converged_version() == 1
+        assert fleet.canary_state(MODEL) is None
+        faults.uninstall()
+        # a NEW controller resumes: reloads the candidate payload and
+        # re-installs the live canary, then WAITS on outcomes
+        ctl2 = make_controller(reg, params, tmp_path, fresh, fleet=fleet,
+                               canary_outcomes=6, canary_percent=50)
+        waiting = ctl2.run_pending()
+        assert waiting["stage"] == CANARY_VALIDATE
+        assert waiting["canary"]["needed"] == 6
+        assert ctl2.counters.get("Controller", "Resumes") == 1
+        # pre-publish: the candidate serves its split from controller
+        # memory, the registry still holds only the champion
+        assert reg.versions(MODEL) == [1]
+        st = fleet.canary_state(MODEL)
+        assert st is not None and st["percent"] == 50
+        serve_round(feeder, rows, "can", 30)
+        # run_pending during the wait is a no-op, not a re-resume
+        assert ctl2.run_pending() is None
+        # delayed labels arrive; the 6th candidate-arm outcome decides.
+        # predicted == actual -> live accuracy 100 >= the journaled floor
+        card = list(SCHEMA.class_attr_field.cardinality)
+        summary = None
+        for i in range(40):
+            summary = ctl2.record_canary_outcome(f"oc-{i}", card[1],
+                                                 card[1])
+            if summary is not None:
+                break
+        assert summary is not None and summary["outcome"] == PUBLISHED
+        # exactly one new version despite the crash (no double-publish),
+        # and the canary journal block records the verdict evidence
+        assert reg.versions(MODEL) == [1, 2]
+        assert reg.serving_version(MODEL) == 2
+        can = ctl2.journal["canary"]
+        assert can["candidate_accuracy"] == 100
+        assert can["candidate_outcomes"] >= 6
+        assert can["floor"] >= 0 and not can["timed_out"]
+        # canary torn down: the fleet converges onto the published
+        # version and keeps answering
+        assert fleet.canary_state(MODEL) is None
+        deadline = time.monotonic() + 20.0
+        while fleet.converged_version() != 2 \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert fleet.converged_version() == 2
+        serve_round(feeder, rows, "post", 20)
+    finally:
+        fleet.stop()
+        feeder.close()
+
+
+class _FakeCanaryFleet:
+    """The canary verbs alone (duck-typed like the real fleet), with a
+    record_canary_outcome that returns None so the controller exercises
+    its own deterministic-split fallback."""
+
+    def __init__(self):
+        self.installed = None
+        self.cleared = False
+
+    def install_canary(self, mname, version=None, percent=10,
+                       predictor=None, pos_class=None, neg_class=None,
+                       window=32):
+        self.installed = dict(mname=mname, percent=percent,
+                              predictor=predictor, pos_class=pos_class,
+                              neg_class=neg_class, window=window)
+
+    def record_canary_outcome(self, mname, rid, predicted, actual):
+        return None
+
+    def clear_canary(self, mname):
+        self.cleared = True
+
+
+@pytest.mark.multimodel
+def test_canary_refuses_candidate_below_live_floor(tmp_path, mesh_ctx):
+    """Live canary outcomes judge the candidate: all-wrong candidate-arm
+    labels put its live accuracy under the journaled champion floor, the
+    cycle completes REFUSED, the champion keeps 100% and the registry is
+    untouched."""
+    from avenir_tpu.control.journal import CANARY_VALIDATE
+    from avenir_tpu.serving.router import canary_split
+    reg, params, clean, fresh = build_champion(tmp_path, mesh_ctx)
+    fake = _FakeCanaryFleet()
+    ctl = make_controller(reg, params, tmp_path, fresh, fleet=fake,
+                          canary_outcomes=5, canary_percent=50)
+    ctl.submit_alert(drift_alert())
+    waiting = ctl.run_pending()
+    assert waiting["stage"] == CANARY_VALIDATE
+    # the candidate went live pre-publish, classes from the schema card
+    assert fake.installed["percent"] == 50
+    assert fake.installed["predictor"] is not None
+    assert {fake.installed["pos_class"], fake.installed["neg_class"]} \
+        == set(SCHEMA.class_attr_field.cardinality)
+    card = list(SCHEMA.class_attr_field.cardinality)
+    summary = None
+    i = 0
+    with pytest.warns(RuntimeWarning, match="refused at canary"):
+        while summary is None:
+            rid = f"lbl-{i}"
+            i += 1
+            assert i < 100
+            if canary_split(rid, 50):   # candidate arm: always WRONG
+                summary = ctl.record_canary_outcome(rid, card[0], card[1])
+            else:                       # champion arm: always right
+                summary = ctl.record_canary_outcome(rid, card[1], card[1])
+    assert summary["outcome"] == REFUSED
+    assert fake.cleared
+    # champion untouched: no new version, pin and serving stay
+    assert reg.versions(MODEL) == [1]
+    assert reg.serving_version(MODEL) == 1
+    can = ctl.journal["canary"]
+    assert can["candidate_accuracy"] == 0
+    assert can["champion_accuracy"] == 100
+    assert can["floor"] > 0
+    assert ctl.counters.get("Controller", "Refused") == 1
+    # and the next alert opens a fresh cycle (the journal closed clean)
+    assert not ctl.journal.pending
+
+
+@pytest.mark.multimodel
+def test_canary_skips_without_capable_fleet(tmp_path, mesh_ctx):
+    """canary_outcomes > 0 with a fleet link that does not speak the
+    canary verbs (a plain PredictionService): the stage journals WHY it
+    skipped and the cycle publishes on holdout validation alone — a
+    resume replays the same decision instead of inventing a canary."""
+    from avenir_tpu.serving import PredictionService
+    reg, params, clean, fresh = build_champion(tmp_path, mesh_ctx)
+    svc = PredictionService(registry=reg, model_name=MODEL, warm=False)
+    ctl = make_controller(reg, params, tmp_path, fresh, fleet=svc,
+                          canary_outcomes=4)
+    ctl.submit_alert(drift_alert())
+    summary = ctl.run_pending()
+    assert summary["outcome"] == PUBLISHED
+    assert reg.versions(MODEL) == [1, 2]
+    assert ctl.journal["canary"] == {"skipped": True,
+                                     "reason": "no canary-capable fleet"}

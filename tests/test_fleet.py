@@ -386,8 +386,8 @@ def test_two_fleets_one_registry_host_label_disjoint(tmp_path, mesh_ctx,
         assert fa.stats()["host"] == "hostA"
         assert fb.stats()["host"] == "hostB"
         text = mreg.render()
-        a = 'avenir_serving{host="hostA",service="churn-w0",'
-        b = 'avenir_serving{host="hostB",service="churn-w0",'
+        a = 'avenir_serving{host="hostA",service="churn-w0",model="churn",'
+        b = 'avenir_serving{host="hostB",service="churn-w0",model="churn",'
         assert a + 'key="queue_depth"}' in text
         assert b + 'key="queue_depth"}' in text
         # NO rename happened: both kept the bare worker identity, the

@@ -159,6 +159,24 @@ def _deadline_token(rng):
                            "d=+5", "d=1.5", "D=12", "d=0x1f"]))
 
 
+def _model_token(rng):
+    """Wire model-routing field (ISSUE 18).  A valid spelling routes on
+    a models= fleet; on the single-model service under fuzz the python
+    plane uniformly strips the tag and the native plane declines the
+    batch to python (routing is the authoritative plane's job) — replies
+    must stay byte-identical either way.  Near-miss spellings are
+    ordinary feature data by the grammar, both planes."""
+    r = rng.random()
+    if r < 0.25:
+        return "m=forest"
+    if r < 0.40:
+        return f"m=forest:{int(rng.integers(1, 99))}"
+    if r < 0.50:
+        return "m=x.y_z-1"
+    return str(rng.choice(["m=", "m=a:", "m=a:b", "m=a:1:2", "M=a",
+                           "m= a", "m=a b", "m=a:1:"]))
+
+
 def _predict_msg(rng, schema, delim, rid):
     row = [""] * schema.num_columns
     row[0] = f"id{rid}"
@@ -170,6 +188,8 @@ def _predict_msg(rng, schema, delim, rid):
         body.append(_trace_token(rng))
     if rng.random() < 0.25:
         body.append(_deadline_token(rng))
+    if rng.random() < 0.20:
+        body.append(_model_token(rng))
     msg = delim.join(body + row)
     if rng.random() < 0.06:      # truncated mid-row
         msg = msg[:int(rng.integers(8, max(9, len(msg))))]

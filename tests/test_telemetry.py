@@ -450,7 +450,8 @@ def test_prediction_service_stats_snapshot():
     assert st == {"queue_depth": 0, "in_flight": 0, "served": 3,
                   "errors": 1, "batches": 1, "hot_swaps": 0,
                   "rejected": 0, "window_ms": svc.policy.max_wait_ms,
-                  "degraded": None, "model_version": 4, "host": ""}
+                  "degraded": None, "model_version": 4, "host": "",
+                  "model": ""}
     ok, payload = svc.health()
     assert ok and payload["served"] == 3
     svc.mark_degraded("drift: psi over threshold")
@@ -474,13 +475,13 @@ def test_metrics_server_serves_service_gauges_and_healthz():
             srv.url + "/metrics", timeout=10).read().decode()
         samples, types = _parse_prom(text)
         assert types["avenir_serving"] == "gauge"
-        p = 'avenir_serving{host="",service="predictor",'
+        p = 'avenir_serving{host="",service="predictor",model="",'
         assert samples[p + 'key="queue_depth"}'] == 0
         assert samples[p + 'key="served"}'] == 2
         assert samples[p + 'key="model_version"}'] == 2
         assert samples[p + 'key="degraded"}'] == 0
         assert ('avenir_serving_latency_ms{host="",service="predictor",'
-                'step="serve.batch",quantile="p99"}') in samples
+                'model="",step="serve.batch",quantile="p99"}') in samples
         hz = urllib.request.urlopen(srv.url + "/healthz", timeout=10)
         assert hz.status == 200
         assert json.loads(hz.read())["status"] == "ok"
@@ -495,7 +496,7 @@ def test_metrics_server_serves_service_gauges_and_healthz():
         samples, _ = _parse_prom(urllib.request.urlopen(
             srv.url + "/metrics", timeout=10).read().decode())
         assert samples['avenir_serving{host="",service="predictor",'
-                       'key="degraded"}'] == 1
+                       'model="",key="degraded"}'] == 1
         # unknown path: 404, server stays up
         with pytest.raises(urllib.error.HTTPError) as e2:
             urllib.request.urlopen(srv.url + "/nope", timeout=10)
@@ -515,7 +516,7 @@ def test_default_registry_binds_new_services():
         svc.process_batch(["predict,0,x,p"])
         samples, _ = _parse_prom(reg.render())
         assert samples['avenir_serving{host="",service="predictor",'
-                       'key="served"}'] == 1
+                       'model="",key="served"}'] == 1
     finally:
         T.set_default_registry(None)
 

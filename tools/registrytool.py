@@ -3,12 +3,16 @@
 
     python tools/registrytool.py list   <registry-dir> [--name <model>]
     python tools/registrytool.py verify <registry-dir> [--name <model>]
-    python tools/registrytool.py gc     <registry-dir> --name <model>
+    python tools/registrytool.py gc     <registry-dir> [--name <model>]
                                         [--keep 3] [--dry-run]
 
-``list`` prints, per model name, every committed version with its kind,
-intactness, payload files, on-disk bytes, and the pin/serving resolution
-— the operator's view of what a hot-swap refresh would actually load.
+``list`` prints, grouped per model name, every committed version with
+its kind, intactness, payload files, on-disk bytes, and the pin/serving
+resolution — the operator's view of what a hot-swap refresh (or a
+multi-model router's resident set, ISSUE 18) would actually load.  Each
+version line flags ``*`` = the serving resolution and ``P`` = the
+explicit pin, per NAME — N resident models on one fleet means N
+independent pin/serving answers.
 
 ``verify`` probes every version with the registry's own ``is_intact``
 (meta.json parses, every manifest file opens) plus a pin-target check.
@@ -16,9 +20,12 @@ Exit code 0 = all intact, 1 = problems found, 2 = usage error.
 
 ``gc`` retires old versions through ``ModelRegistry.retire`` (keeps the
 newest ``--keep``, never the pinned or serving version, sweeps abandoned
-``.tmp`` publishes).  ``--dry-run`` prints what WOULD go.  This is the
-retention story behind the retrain controller's publish cadence
-(``dtb.retrain.retire.keep.last`` runs the same call in-loop).
+``.tmp`` publishes).  ``--keep`` applies PER NAME: without ``--name``
+every model in the registry is swept independently, each keeping its
+own newest ``--keep`` — one tenant's publish cadence never shrinks a
+co-resident tenant's retention.  ``--dry-run`` prints what WOULD go.
+This is the retention story behind the retrain controller's publish
+cadence (``dtb.retrain.retire.keep.last`` runs the same call in-loop).
 """
 
 from __future__ import annotations
@@ -57,12 +64,15 @@ def cmd_list(args) -> int:
     if not names:
         print(f"no models in {reg.base_dir!r}", file=sys.stderr)
         return 1
+    if len(names) > 1:
+        print(f"{len(names)} model(s) in {reg.base_dir!r} — pin and "
+              f"serving resolve independently per name")
     for name in names:
         pin = reg.pinned_version(name)
         serving = reg.serving_version(name)
         print(f"{name}: pinned={pin if pin is not None else '-'} "
               f"serving={serving if serving is not None else '-'}")
-        print(f"  {'ver':>6} {'intact':>6} {'kind':>8} {'bytes':>10}  "
+        print(f"  {'ver':>6} {'intact':>7} {'kind':>8} {'bytes':>10}  "
               f"files")
         for v in reg.versions(name):
             d = reg.version_dir(name, v)
@@ -74,7 +84,11 @@ def cmd_list(args) -> int:
                 files = meta.get("files") or []
             except Exception:
                 pass
-            mark = "*" if v == serving else " "
+            # '*' = what a refresh serves, 'P' = the explicit pin —
+            # usually the same version, but a pin to a torn version
+            # shows as P on one line and * on the intact fallback
+            mark = ("*" if v == serving else " ") \
+                + ("P" if v == pin else " ")
             print(f"  {v:>5}{mark} {str(reg.is_intact(name, v)):>6} "
                   f"{kind:>8} {_dir_bytes(d):>10}  {' '.join(files)}")
     return 0
@@ -112,22 +126,32 @@ def cmd_verify(args) -> int:
 
 def cmd_gc(args) -> int:
     reg = ModelRegistry(args.registry)
-    versions = reg.versions(args.name)
-    if not versions:
+    names = _names(reg, args.name)
+    if args.name and not reg.versions(args.name):
         print(f"no committed versions of {args.name!r} in "
               f"{reg.base_dir!r}", file=sys.stderr)
         return 1
-    if args.dry_run:
-        # retire(dry_run=True) computes the keep rule — ONE source of
-        # truth, never a re-implementation that can drift from it
-        would = reg.retire(args.name, keep_last=args.keep, dry_run=True)
-        print(f"would retire: {would or 'nothing'} "
-              f"(keep {[v for v in versions if v not in would]}; "
-              f"dead .tmp publishes would be swept)")
-        return 0
-    retired = reg.retire(args.name, keep_last=args.keep)
-    print(f"retired: {retired or 'nothing'} "
-          f"(kept {reg.versions(args.name)})")
+    if not names:
+        print(f"no models in {reg.base_dir!r}", file=sys.stderr)
+        return 1
+    # keep_last applies PER NAME: each resident model keeps its own
+    # newest --keep (minus pin/serving protection) — one noisy tenant's
+    # publish cadence must not evict a quiet co-resident's history
+    for name in names:
+        versions = reg.versions(name)
+        if not versions:
+            continue
+        if args.dry_run:
+            # retire(dry_run=True) computes the keep rule — ONE source
+            # of truth, never a re-implementation that can drift from it
+            would = reg.retire(name, keep_last=args.keep, dry_run=True)
+            print(f"{name}: would retire {would or 'nothing'} "
+                  f"(keep {[v for v in versions if v not in would]}; "
+                  f"dead .tmp publishes would be swept)")
+            continue
+        retired = reg.retire(name, keep_last=args.keep)
+        print(f"{name}: retired {retired or 'nothing'} "
+              f"(kept {reg.versions(name)})")
     return 0
 
 
@@ -144,9 +168,12 @@ def main(argv=None) -> int:
     p.add_argument("registry")
     p.add_argument("--name")
     p.set_defaults(fn=cmd_verify)
-    p = sub.add_parser("gc", help="retire old versions")
+    p = sub.add_parser("gc", help="retire old versions (--keep applies "
+                                  "per model name)")
     p.add_argument("registry")
-    p.add_argument("--name", required=True)
+    p.add_argument("--name",
+                   help="one model; default sweeps EVERY name, each "
+                        "keeping its own newest --keep")
     p.add_argument("--keep", type=int, default=3)
     p.add_argument("--dry-run", action="store_true")
     p.set_defaults(fn=cmd_gc)

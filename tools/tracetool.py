@@ -19,6 +19,9 @@
   the serving-lane breakdown — scale actions with their sensed inputs
   (queue depth, depth derivative, recent p99), hold runs compressed —
   so an operator can replay WHY the fleet scaled (TPU_NOTES §25).
+  Multi-model traces (ISSUE 18) additionally get the per-model table —
+  batches, rows, mean fill, p99 and admission rejections by model
+  label — the per-tenant view of one fleet's device time.
 * **merge** — concatenate N per-process JSONL traces (the shards of one
   run) into ONE ts-sorted Chrome trace JSON; epoch-anchored timestamps
   make shard skew visible as lane offset.  Warns when the inputs carry
@@ -31,12 +34,15 @@
   events (client enqueue -> broker shard -> worker pop -> batch dispatch
   -> reply push) across however many per-process files hold its legs,
   plus the component decomposition carried on the flow finish — the
-  "where did request X spend its 400 ms" answer (TPU_NOTES §27).
+  "where did request X spend its 400 ms" answer (TPU_NOTES §27).  On a
+  multi-model fleet the header names the model the request routed to
+  (the ``m=`` spec off the worker-pop leg).
 * **incident** — a time-window report over the merged traces: autoscaler
   decisions, broker reconnects/shard deaths, controller stage spans and
   decisions, registry publish/pin flips, degradation instants, and the
   sampled-request latency picture (p99 + slowest request ids) before vs
-  after the window midpoint.  ``t0``/``t1`` are epoch seconds (values
+  after the window midpoint, plus the per-model serving table when the
+  window holds multi-model traffic.  ``t0``/``t1`` are epoch seconds (values
   above 1e12 are taken as epoch microseconds, the trace's native unit).
 
 Exit status: 0 on success, 1 on invalid input (schema problems are
@@ -100,6 +106,40 @@ def _print_backend_table(counters_path: str) -> None:
         disp = sites.get(site, "-")
         forms = " ".join(by_site.get(site, [])) or "-"
         print(f"  {site:<24}{disp!s:>12}  {forms}")
+
+
+def _print_model_table(events) -> None:
+    """The per-model (per-tenant) serving breakdown (ISSUE 18): every
+    ``serve.predict`` span carries the model label of the resident that
+    ran it, and ``serve.rejected`` instants carry the tenant whose OWN
+    admission depth shed the request — so a multi-model fleet's trace
+    answers 'which tenant burned the device, which tenant got shed'
+    without the scrape endpoint."""
+    by_model: Dict[str, List[dict]] = defaultdict(list)
+    for e in events:
+        if e.get("ph") == "X" and e.get("name") == "serve.predict" \
+                and isinstance(e.get("ts"), (int, float)):
+            m = str((e.get("args") or {}).get("model") or "")
+            by_model[m].append(e)
+    rejected: Dict[str, int] = defaultdict(int)
+    for e in events:
+        if e.get("ph") == "i" and e.get("name") == "serve.rejected":
+            rejected[str((e.get("args") or {}).get("model") or "")] += 1
+    if not by_model and not rejected:
+        return
+    print("\nper-model serving (serve.predict by model label):")
+    print(f"  {'model':<18}{'batches':>8}{'rows':>8}{'mean fill':>10}"
+          f"{'p99 ms':>9}{'rejected':>10}")
+    for m in sorted(set(by_model) | set(rejected)):
+        evs = by_model.get(m, [])
+        rows = [int((e.get("args") or {}).get("rows", 0)) for e in evs]
+        durs = sorted(float(e.get("dur", 0.0)) / 1e3 for e in evs)
+        p99 = durs[min(len(durs) - 1, int(0.99 * len(durs)))] \
+            if durs else 0.0
+        label = m or "(default)"
+        print(f"  {label:<18}{len(evs):>8}{sum(rows):>8}"
+              f"{(sum(rows) / max(len(evs), 1)):>10.1f}"
+              f"{p99:>9.3f}{rejected.get(m, 0):>10}")
 
 
 def _print_autoscaler_log(events) -> None:
@@ -219,6 +259,7 @@ def cmd_summarize(args) -> int:
             print(f"  pid {pid} tid {tid:<8}{len(evs):>8}{sum(rows):>8}"
                   f"{(sum(rows) / max(len(evs), 1)):>10.1f}"
                   f"{100.0 * frac:>11.0f}%")
+    _print_model_table(events)
     _print_autoscaler_log(events)
     if stalls:
         print(f"\n{len(stalls)} STALL event(s):")
@@ -297,13 +338,19 @@ def _print_request_timeline(events, rid: str) -> None:
     head = f"request {rid}: {len(legs)} flow leg(s)"
     if wire_ms is not None:
         head += f", wire {wire_ms:.3f} ms (enqueue -> reply push)"
+    # the worker-pop leg carries the routed model spec on a multi-model
+    # fleet (ISSUE 18): name or name:version, "" = the default model
+    routed = next((str(e.get("args", {}).get("model"))
+                   for e in legs if e.get("args", {}).get("model")), None)
+    if routed is not None:
+        head += f", routed model {routed}"
     print(head)
     for e in legs:
         a = e.get("args", {}) or {}
         step = a.get("step") or _FLOW_STEPS.get(e["ph"], "?")
         where = " ".join(f"{k}={a[k]}" for k in ("broker", "worker",
-                                                 "host", "rows")
-                         if a.get(k) is not None)
+                                                 "host", "model", "rows")
+                         if a.get(k))
         print(f"  +{(float(e['ts']) - t0) / 1e3:9.3f} ms  "
               f"{e['ph']} {step:<10} lane pid {e.get('pid')} "
               f"tid {e.get('tid')}" + (f"  [{where}]" if where else ""))
@@ -400,6 +447,7 @@ def cmd_incident(args) -> int:
             print(f"  {offs(e)} {a.get('stage', '?')} "
                   f"(cycle {a.get('cycle', '?')}) "
                   f"{float(e.get('dur', 0.0)) / 1e3:.1f} ms")
+    _print_model_table(window)
     _print_autoscaler_log(window)
     # the sampled-request latency picture: completed flows (s + f both
     # inside the merged traces) whose finish lands in the window, split
