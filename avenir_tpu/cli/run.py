@@ -40,6 +40,7 @@ from . import nn_jobs  # noqa: F401  (registers neural-net jobs)
 from . import serving_jobs  # noqa: F401  (registers online-serving jobs)
 from . import monitor_jobs  # noqa: F401  (registers drift-monitoring jobs)
 from . import control_jobs  # noqa: F401  (registers closed-loop control jobs)
+from . import online_jobs  # noqa: F401  (registers online-learning jobs)
 
 
 def file_sha(path: str, full: bool) -> str:

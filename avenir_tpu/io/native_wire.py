@@ -51,7 +51,7 @@ _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "serve_native.cpp")
 _SO = os.path.join(_DIR, "_serve_native.so")
 
-_ABI_VERSION = 3
+_ABI_VERSION = 4
 NO_NATIVE_ENV = "AVENIR_TPU_NO_NATIVE"
 
 _lock = threading.Lock()

@@ -77,12 +77,19 @@ def resolve_durable(value: Optional[str] = None) -> str:
 
 def _lease_rid(value: str, delim: str) -> Optional[str]:
     """The lease identity of a queued value: request messages
-    (``predict``/``predictq``) lease by their id field; anything else
-    (control words like ``stop``/``reload``, malformed lines) has no
-    identity and is delivered destructively, exactly as before."""
+    (``predict``/``predictq``) lease by their id field; reward messages
+    (``reward,<id>,<value>``) lease by ``reward:<id>`` — a verb-scoped
+    key, because a reward for request ``<id>`` must coexist in the
+    pending set with the prediction lease of the same ``<id>`` (the
+    online learner acks predictions by reply id and rewards by the
+    snapshot-gated ``reward:<id>`` token); anything else (control words
+    like ``stop``/``reload``, malformed lines) has no identity and is
+    delivered destructively, exactly as before."""
     parts = value.split(delim, 2)
     if parts[0] in ("predict", "predictq") and len(parts) > 1 and parts[1]:
         return parts[1]
+    if parts[0] == "reward" and len(parts) > 1 and parts[1]:
+        return f"reward:{parts[1]}"
     return None
 
 
@@ -1232,12 +1239,19 @@ class ShardedRespClient:
         return self._ring.lookup(request_id)
 
     def id_of(self, value: str) -> str:
-        """The routing id of a wire message: ``predict,<id>,...`` routes
-        by the id field, anything else (a reply ``<id>,<label>``, a
-        control word) by its first field."""
+        """The routing id of a wire message: ``predict,<id>,...`` and
+        ``reward,<id>,<value>`` route by the id field — a reward MUST
+        land on the shard holding the request it rewards, or the
+        online learner draining that shard never joins them — anything
+        else (a reply ``<id>,<label>``, a control word) by its first
+        field."""
         parts = value.split(self._delim, 2)
-        if parts[0] == "predict" and len(parts) > 1:
+        if parts[0] in ("predict", "reward") and len(parts) > 1:
             return parts[1]
+        if parts[0].startswith("reward:"):
+            # a reward-ack token (``reward:<id>,acked``) must chase the
+            # shard that leased ``reward,<id>,...`` — i.e. <id>'s shard
+            return parts[0][len("reward:"):]
         return parts[0]
 
     def _note_down(self, ep: str, exc: BaseException) -> None:

@@ -297,7 +297,7 @@ extern "C" {
 
 // ABI marker: native_wire.py refuses a stale .so whose ABI predates the
 // binding (belt over the mtime-based rebuild).
-int32_t awp_abi_version() { return 3; }
+int32_t awp_abi_version() { return 4; }
 
 // Parse one drained batch.  `buf` holds all messages joined by `sep`
 // (a byte no wire message may contain — validated here by separator
@@ -401,6 +401,12 @@ int32_t awp_parse(const char* buf, int64_t buf_len, int64_t n_msgs,
         if (verb == "reload") {
             kind_out[m] = MSG_RELOAD;
             ++counts[2];
+        } else if (verb == "reward") {
+            // online-learning outcome rows (reward,<id>,<value>):
+            // python owns reward parsing, the pending-outcome join and
+            // the snapshot-gated ack — the native plane declines the
+            // whole batch, near-misses included (python judges them)
+            return AWP_FALLBACK;
         } else if ((verb == "predict" || verb == "predictq")
                    && n_tok >= 3) {
             const bool quant = (verb.size() == 8);

@@ -1,0 +1,26 @@
+"""The online learning plane (ISSUE 19): serving and learning fused
+into ONE cached device program per served window.
+
+Layers:
+
+* :mod:`.state` — the device-resident learner state (bandit arm
+  statistics, logistic weights, MLP parameters, the threaded PRNG key)
+  and its deterministic byte round trip (registry snapshots must be
+  bit-identical across save/restore).
+* :mod:`.plane` — the fused window program: an absorb → learn → predict
+  :class:`~avenir_tpu.pipeline.compiler.ChunkPipeline` whose carries ARE
+  the learner state, one dispatch per window at the ``online.window``
+  ledger site; plus the host-side pending-outcome table that joins
+  ``reward,<id>,<value>`` wire messages to the decisions they reward.
+* :mod:`.service` — the wire tier: drains one RESP stream of mixed
+  predict/reward traffic, runs windows, answers predictions, and feeds
+  the supervisor.
+
+The supervisor itself (journaled probation, registry snapshot cadence,
+accuracy-floor rollback) lives with the other closed-loop machinery in
+:mod:`avenir_tpu.control.controller` as :class:`OnlineSupervisor`.
+"""
+
+from .plane import OnlineWindowPlane, PendingOutcomeTable  # noqa: F401
+from .service import OnlineLearnerService  # noqa: F401
+from .state import OnlineLearnerConfig, state_from_bytes, state_to_bytes  # noqa: F401

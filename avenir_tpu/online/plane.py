@@ -1,0 +1,345 @@
+"""The fused serve+learn window program + the reward join table.
+
+One served window = ONE device dispatch.  The window pipeline is a
+three-stage :class:`~avenir_tpu.pipeline.compiler.ChunkPipeline`
+(dispatch site ``online.window``) whose carries ARE the learner state:
+
+* ``absorb``  — scatter this window's joined rewards into the
+  device-resident bandit arm statistics (the carry), forwarding the
+  updated arrays downstream;
+* ``learn``   — one SGD step of the logistic weights (and the MLP
+  parameters when configured) on the rewarded rows, calling the SAME
+  gradient bodies the offline trainers jit
+  (``LogisticTrainer._partials_impl`` / ``_combine_impl``,
+  ``nn.mlp.forward_logits``), forwarding the updated weights;
+* ``predict`` — score the window's requests with the JUST-updated
+  state: bandit arm selection through the shared score bodies
+  (``reinforce.online_forms``), logistic probabilities, MLP classes.
+  Carries the threaded PRNG key.
+
+Stage order is absorb → learn → predict deliberately: rewards that
+arrived before the window are absorbed first, so predictions always use
+the freshest state without a second dispatch.
+
+Rewards are joined to the decisions they reward on the HOST, by request
+id, in a bounded :class:`PendingOutcomeTable` with TTL shedding.  The
+join cannot live on device: a reward may arrive any number of windows
+after its request (or never), so the id → (features, decision) map is
+unbounded-in-time state with string keys — exactly what HBM carries are
+wrong for.  The device sees only the joined, padded (arm, value,
+features) rows.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..pipeline.compiler import ONLINE_SITE, ChunkPipeline, Stage
+from .state import OnlineLearnerConfig, init_state, state_from_bytes, \
+    state_to_bytes
+
+DEFAULT_WINDOW_BUCKETS = (8, 64, 256)
+
+_STAGE_VERSION = "1"
+
+
+def _bucket(n: int, buckets: Sequence[int]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+class PendingOutcomeTable:
+    """Bounded id → (features, chosen arm) map awaiting rewards.
+
+    ``put`` on a full table evicts the oldest entry (Evicted); ``join``
+    pops the entry for a reward id (a miss is an orphan — the request
+    was never seen, already rewarded, or already shed); ``shed`` drops
+    entries older than the TTL (Shed).  All three outcomes are counted
+    — a silently vanishing reward would void the learning guarantees.
+    """
+
+    def __init__(self, capacity: int = 4096, ttl_s: float = 300.0,
+                 clock=time.monotonic):
+        if capacity <= 0:
+            raise ValueError(f"capacity must be > 0, got {capacity}")
+        self.capacity = int(capacity)
+        self.ttl_s = float(ttl_s)
+        self._clock = clock
+        self._entries: "OrderedDict[str, Tuple[np.ndarray, Any, float]]" \
+            = OrderedDict()
+        self.evicted = 0
+        self.shed = 0
+        self.orphans = 0
+        self.joined = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def put(self, rid: str, x: np.ndarray, decision: Any) -> None:
+        if rid in self._entries:          # re-decision: newest wins
+            self._entries.pop(rid)
+        elif len(self._entries) >= self.capacity:
+            self._entries.popitem(last=False)
+            self.evicted += 1
+        self._entries[rid] = (x, decision, self._clock())
+
+    def join(self, rid: str) -> Optional[Tuple[np.ndarray, Any]]:
+        ent = self._entries.pop(rid, None)
+        if ent is None:
+            self.orphans += 1
+            return None
+        self.joined += 1
+        return ent[0], ent[1]
+
+    def shed_expired(self) -> int:
+        """Drop entries past the TTL (insertion order == age order)."""
+        if self.ttl_s <= 0:
+            return 0
+        cutoff = self._clock() - self.ttl_s
+        n = 0
+        while self._entries:
+            rid, (_, _, t) = next(iter(self._entries.items()))
+            if t > cutoff:
+                break
+            self._entries.popitem(last=False)
+            n += 1
+        self.shed += n
+        return n
+
+    def stats(self) -> Dict[str, int]:
+        return {"pending": len(self._entries), "joined": self.joined,
+                "orphans": self.orphans, "shed": self.shed,
+                "evicted": self.evicted}
+
+
+class OnlineWindowPlane:
+    """Owns the fused window pipeline + the pending-outcome table.
+
+    ``run_window(requests, rewards)`` is the whole hot path: join the
+    rewards, pad both sides to shape buckets, ONE ``run_chunk``
+    dispatch, one stacked readback, record the new decisions as
+    pending.  Every window with the same (request-bucket,
+    reward-bucket) pair reuses one compiled program via the
+    process-global ProgramCache — a warm service retraces nothing.
+    """
+
+    def __init__(self, config: OnlineLearnerConfig, ctx=None,
+                 cache=None, buckets: Sequence[int] = DEFAULT_WINDOW_BUCKETS,
+                 pending_capacity: int = 4096, pending_ttl_s: float = 300.0,
+                 clock=time.monotonic):
+        self.config = config
+        wanted = tuple(sorted(set(int(b) for b in buckets)))
+        if not wanted or wanted[0] < 1:
+            raise ValueError(f"bad window buckets {buckets!r}")
+        self.pending = PendingOutcomeTable(pending_capacity,
+                                          pending_ttl_s, clock=clock)
+        self.windows = 0
+        self._pipeline = ChunkPipeline(
+            self._build_stages(), ctx=ctx,
+            schema_fp=config.fingerprint(), cache=cache,
+            name="online-window", site=ONLINE_SITE)
+        # the row-sharded upload contract is "row count pre-padded to
+        # the mesh" (shard_rows), so every bucket rounds up to a
+        # multiple of the device count
+        nd = max(int(self._pipeline.ctx.n_devices), 1)
+        self.buckets = tuple(sorted(set(
+            ((b + nd - 1) // nd) * nd for b in wanted)))
+
+    # ---- stage kernels -------------------------------------------------
+    def _build_stages(self) -> List[Stage]:
+        cfg = self.config
+        bandit0, weights0, rng0 = init_state(cfg)
+
+        def absorb_kernel(carry, consts, inputs, upstream):
+            from ..reinforce.online_forms import absorb_rewards
+            counts, totals, total_sqs = absorb_rewards(
+                carry["counts"], carry["totals"], carry["total_sqs"],
+                inputs["r_arm"], inputs["r_val"], inputs["r_mask"])
+            nc = {"counts": counts, "totals": totals,
+                  "total_sqs": total_sqs}
+            return nc, dict(nc)
+
+        def learn_kernel(carry, consts, inputs, upstream):
+            import jax
+            import jax.numpy as jnp
+            from ..regress.logistic import LogisticTrainer
+            X, vals, m = inputs["r_x"], inputs["r_val"], inputs["r_mask"]
+            n = m.sum()
+            any_rows = n > 0
+            # logistic: outcome >= threshold is the positive class; the
+            # gradient bodies are the offline trainer's own (padded rows
+            # are all-zero X, so their x*(y-p) terms vanish)
+            y = (vals >= cfg.threshold).astype(jnp.float32) * m
+            grad_sum, _ll = LogisticTrainer._partials_impl(
+                None, carry["w"], X, y)
+            # padded rows still contribute to _partials_impl's y-p term
+            # through the intercept-free zero rows ONLY via y, which the
+            # mask already zeroed; the intercept column is zeroed on
+            # padded rows by the host prepare
+            w_new = _combine(carry["w"], grad_sum, jnp.maximum(n, 1.0))
+            nc = {"w": jnp.where(any_rows, w_new, carry["w"])}
+            outs = {"w": nc["w"]}
+            if "mlp" in carry:
+                from ..nn.mlp import forward_logits
+                y_cls = jnp.clip(vals.astype(jnp.int32), 0,
+                                 cfg.mlp_classes - 1)
+                Xf = X[:, 1:]             # MLP sees raw features
+
+                def raw_loss(p):
+                    logits = forward_logits(p, Xf)
+                    logp = jax.nn.log_softmax(logits, axis=-1)
+                    ce = -(logp[jnp.arange(Xf.shape[0]), y_cls]
+                           * m).sum()
+                    reg = 0.5 * cfg.l2 * ((p["W1"] ** 2).sum()
+                                          + (p["W2"] ** 2).sum())
+                    return ce + reg
+
+                grads = jax.grad(raw_loss)(carry["mlp"])
+                stepped = jax.tree_util.tree_map(
+                    lambda p, g: p - cfg.learning_rate * g,
+                    carry["mlp"], grads)
+                nc["mlp"] = jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(any_rows, a, b),
+                    stepped, carry["mlp"])
+                outs["mlp"] = nc["mlp"]
+            return nc, outs
+
+        def _combine(w, grad_sum, n):
+            # LogisticTrainer._combine_impl body with this config's
+            # hyper-parameters (the method reads them off self.params)
+            grad = grad_sum - cfg.l2 * w
+            return w + cfg.learning_rate * grad / n
+
+        def predict_kernel(carry, consts, inputs, upstream):
+            import jax
+            import jax.numpy as jnp
+            from ..reinforce.online_forms import bandit_scores
+            X = inputs["x"]
+            key, sub = jax.random.split(carry["key"])
+            scores = bandit_scores(
+                cfg.algorithm, upstream["absorb.counts"],
+                upstream["absorb.totals"], upstream["absorb.total_sqs"],
+                sub, X.shape[0], cfg.temp_constant)
+            outs: Dict[str, Any] = {
+                "arm": jnp.argmax(scores, axis=1).astype(jnp.int32),
+                "prob": jax.nn.sigmoid(X @ upstream["learn.w"]),
+            }
+            if "learn.mlp" in upstream:
+                from ..nn.mlp import forward_logits
+                logits = forward_logits(upstream["learn.mlp"], X[:, 1:])
+                outs["cls"] = jnp.argmax(logits, axis=1).astype(jnp.int32)
+            nc = {"key": key, "step": carry["step"] + 1}
+            return nc, outs
+
+        returns = ("arm", "prob") + (("cls",)
+                                     if "mlp" in weights0 else ())
+        return [
+            Stage(name="absorb", kernel=absorb_kernel,
+                  version=_STAGE_VERSION,
+                  carry_init=lambda: bandit0),
+            Stage(name="learn", kernel=learn_kernel,
+                  version=_STAGE_VERSION,
+                  carry_init=lambda: weights0),
+            Stage(name="predict", kernel=predict_kernel,
+                  version=_STAGE_VERSION,
+                  carry_init=lambda: rng0, returns=returns),
+        ]
+
+    # ---- the window ----------------------------------------------------
+    def run_window(self, requests: Sequence[Tuple[str, np.ndarray]],
+                   rewards: Sequence[Tuple[str, float]]
+                   ) -> Tuple[List[Tuple[str, int, float, int]],
+                              List[Tuple[Tuple[int, float, int], float]]]:
+        """One fused dispatch over a served window.
+
+        ``requests``: (request id, feature row) pairs — the row is the
+        raw numeric feature vector, ``n_features`` wide.
+        ``rewards``: (request id, outcome value) pairs, joined against
+        the pending table; unknown ids count as orphans.
+
+        Returns ``(decisions, outcomes)``: one ``(rid, arm, prob,
+        cls)`` decision per request (cls is -1 without an MLP head),
+        recorded as pending; and one ``(decision, value)`` outcome per
+        successfully joined reward, ``decision`` being the (arm, prob,
+        cls) the rewarded request was answered with — the supervisor's
+        predicted-vs-actual feed.
+        """
+        cfg = self.config
+        W = cfg.design_width
+        joined: List[Tuple[int, float, np.ndarray]] = []
+        outcomes: List[Tuple[Tuple[int, float, int], float]] = []
+        for rid, val in rewards:
+            ent = self.pending.join(rid)
+            if ent is not None:
+                joined.append((ent[1][0], float(val), ent[0]))
+                outcomes.append((ent[1], float(val)))
+        self.pending.shed_expired()
+
+        B = _bucket(max(len(requests), 1), self.buckets)
+        R = _bucket(max(len(joined), 1), self.buckets)
+        x = np.zeros((B, W), np.float32)
+        for i, (_, row) in enumerate(requests):
+            x[i, 0] = 1.0
+            if cfg.n_features:
+                x[i, 1:] = row
+        r_x = np.zeros((R, W), np.float32)
+        r_arm = np.zeros(R, np.int32)
+        r_val = np.zeros(R, np.float32)
+        r_mask = np.zeros(R, np.float32)
+        for i, (arm, val, row) in enumerate(joined):
+            r_x[i] = row
+            r_arm[i] = arm
+            r_val[i] = val
+            r_mask[i] = 1.0
+        inputs = self._pipeline.upload({
+            "x": x, "r_x": r_x, "r_arm": r_arm, "r_val": r_val,
+            "r_mask": r_mask})
+        rets = self._pipeline.run_chunk(inputs)
+        arms = np.asarray(rets["predict.arm"])
+        probs = np.asarray(rets["predict.prob"])
+        cls = np.asarray(rets["predict.cls"]) \
+            if "predict.cls" in rets else None
+        self.windows += 1
+        out = []
+        for i, (rid, row) in enumerate(requests):
+            decision = (int(arms[i]), float(probs[i]),
+                        int(cls[i]) if cls is not None else -1)
+            # the decision row joins its future reward: store the
+            # DESIGN row (intercept set) so the learn stage gets it
+            self.pending.put(rid, x[i].copy(), decision)
+            out.append((rid,) + decision)
+        return out, outcomes
+
+    # ---- state access (supervisor hooks) -------------------------------
+    @property
+    def carries(self):
+        return self._pipeline.carries
+
+    def state_bytes(self) -> bytes:
+        return state_to_bytes(self._pipeline.carries)
+
+    def restore(self, payload: bytes) -> None:
+        template = tuple(init_state(self.config))
+        self._pipeline.install_carries(
+            state_from_bytes(payload, template))
+
+    def logistic_w(self) -> np.ndarray:
+        """The logistic coefficient vector as a host array — the
+        registry snapshot's model payload."""
+        return np.asarray(self._pipeline.carries[1]["w"],
+                          dtype=np.float32)
+
+    def run_stats(self) -> Dict[str, int]:
+        s = self._pipeline.run_stats()
+        s["windows"] = self.windows
+        s.update(self.pending.stats())
+        return s
+
+    def export(self, counters, group: str = "OnlineProgramCache") -> None:
+        self._pipeline.export(counters, group=group)

@@ -1,0 +1,179 @@
+"""Device-resident learner state + its deterministic byte round trip.
+
+The state is the carry tuple of the fused window pipeline — three
+pytrees, one per stage:
+
+* ``bandit``  — per-arm count / reward-sum / reward-sum-sq arrays
+  (``reinforce.online_forms.init_arm_stats``), the device twin of the
+  host learners' ``ActionStat`` table;
+* ``weights`` — the SGD family: the logistic coefficient vector
+  (intercept first, ``regress.logistic`` layout) and, when an MLP head
+  is configured, the ``nn.mlp`` parameter pytree;
+* ``rng``     — the threaded ``jax.random`` key plus the window step
+  counter (randomized selection must be resumable: a restored snapshot
+  replays the SAME key stream).
+
+Serialization is deliberately not ``np.savez``: zip members carry
+timestamps, and the supervisor's rollback contract is BIT-identical
+bytes (snapshot → restore → snapshot must round-trip exactly, and the
+chaos drill compares raw sidecar payloads).  The format is a JSON
+header naming each leaf (path, dtype, shape) followed by the raw
+``tobytes`` payloads in header order.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+_MAGIC = b"AVONL1\n"
+
+
+@dataclass(frozen=True)
+class OnlineLearnerConfig:
+    """Shape of the online learner: which heads exist and their sizes.
+    The config fingerprints the pipeline (stage versions + carry
+    signatures), so two services with the same config share one
+    compiled program through the ProgramCache."""
+
+    actions: Tuple[str, ...]              # bandit arm names (>= 1)
+    n_features: int = 0                   # numeric features per request
+    algorithm: str = "ucb1"               # ucb1 | softMax | sampsonSampler
+    head: str = "bandit"                  # bandit | logistic | mlp
+    temp_constant: float = 0.1            # softMax temperature
+    learning_rate: float = 0.05
+    l2: float = 0.0
+    mlp_hidden: int = 0                   # > 0 adds the MLP head
+    mlp_classes: int = 2
+    pos_label: str = "1"                  # logistic head reply labels
+    neg_label: str = "0"
+    threshold: float = 0.5
+    seed: int = 42
+    labels: Tuple[str, ...] = ()          # mlp head reply labels
+
+    def __post_init__(self):
+        from ..reinforce.online_forms import ONLINE_ALGORITHMS
+        if not self.actions:
+            raise ValueError("OnlineLearnerConfig needs >= 1 action")
+        if self.algorithm not in ONLINE_ALGORITHMS:
+            raise ValueError(
+                f"algorithm {self.algorithm!r} has no device form; "
+                f"known: {ONLINE_ALGORITHMS}")
+        if self.head not in ("bandit", "logistic", "mlp"):
+            raise ValueError(f"unknown head {self.head!r}")
+        if self.head == "mlp" and self.mlp_hidden <= 0:
+            raise ValueError("head='mlp' needs mlp_hidden > 0")
+        if self.mlp_hidden > 0 and self.n_features <= 0:
+            raise ValueError("an MLP head needs n_features > 0")
+
+    @property
+    def n_arms(self) -> int:
+        return len(self.actions)
+
+    @property
+    def design_width(self) -> int:
+        """Logistic design-matrix width: intercept + features."""
+        return self.n_features + 1
+
+    def fingerprint(self) -> str:
+        return (f"online:{self.algorithm}:{self.head}:{self.n_arms}"
+                f":{self.n_features}:{self.mlp_hidden}"
+                f":{self.mlp_classes}")
+
+    def mlp_label(self, idx: int) -> str:
+        if self.labels and idx < len(self.labels):
+            return self.labels[idx]
+        return str(idx)
+
+
+def init_state(config: OnlineLearnerConfig) -> Tuple[Any, Any, Any]:
+    """Fresh carry tuple (bandit, weights, rng) as host arrays — the
+    pipeline uploads them on first dispatch."""
+    import jax
+    from ..reinforce.online_forms import init_arm_stats
+    bandit = init_arm_stats(config.n_arms)
+    weights: Dict[str, Any] = {
+        "w": np.zeros(config.design_width, np.float32)}
+    if config.mlp_hidden > 0:
+        from ..nn.mlp import MLPConfig, init_params
+        mcfg = MLPConfig(hidden_dim=config.mlp_hidden,
+                         n_classes=config.mlp_classes,
+                         seed=config.seed)
+        params = init_params(config.n_features, mcfg)
+        weights["mlp"] = {k: np.asarray(v, np.float32)
+                          for k, v in params.items()}
+    rng = {"key": np.asarray(jax.random.PRNGKey(config.seed)),
+           "step": np.int32(0)}
+    return bandit, weights, rng
+
+
+# ---- deterministic byte round trip ------------------------------------
+
+def _flatten(carries) -> List[Tuple[str, np.ndarray]]:
+    import jax
+    leaves_with_paths = jax.tree_util.tree_flatten_with_path(carries)[0]
+    out = []
+    for path, leaf in leaves_with_paths:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                       for p in path)
+        out.append((key, np.asarray(leaf)))
+    return out
+
+
+def state_to_bytes(carries) -> bytes:
+    """Serialize a carry tuple to deterministic bytes (same state →
+    same bytes, always — the rollback bit-identity pin)."""
+    leaves = _flatten(carries)
+    header = [{"path": k, "dtype": str(a.dtype), "shape": list(a.shape)}
+              for k, a in leaves]
+    hdr = json.dumps(header, sort_keys=True,
+                     separators=(",", ":")).encode()
+    parts = [_MAGIC, struct.pack("<I", len(hdr)), hdr]
+    for _, a in leaves:
+        parts.append(np.ascontiguousarray(a).tobytes())
+    return b"".join(parts)
+
+
+def state_from_bytes(payload: bytes, template) -> Any:
+    """Rebuild a carry tuple from :func:`state_to_bytes` output.  The
+    ``template`` (a freshly-initialized carry tuple of the same config)
+    supplies the tree structure; every leaf must match the serialized
+    dtype/shape or the restore is refused — a silent mismatch would
+    retrace the pipeline or corrupt state."""
+    import jax
+    if not payload.startswith(_MAGIC):
+        raise ValueError("not an online learner state payload")
+    off = len(_MAGIC)
+    (hlen,) = struct.unpack_from("<I", payload, off)
+    off += 4
+    header = json.loads(payload[off:off + hlen].decode())
+    off += hlen
+    t_leaves = _flatten(template)
+    if [h["path"] for h in header] != [k for k, _ in t_leaves]:
+        raise ValueError(
+            f"state layout mismatch: payload has "
+            f"{[h['path'] for h in header]}, template has "
+            f"{[k for k, _ in t_leaves]}")
+    leaves = []
+    for h, (key, t) in zip(header, t_leaves):
+        dt = np.dtype(h["dtype"])
+        shape = tuple(h["shape"])
+        if dt != t.dtype or shape != t.shape:
+            raise ValueError(
+                f"leaf {key!r}: payload {dt}{shape} vs template "
+                f"{t.dtype}{t.shape}")
+        n = dt.itemsize * int(np.prod(shape, dtype=np.int64)) \
+            if shape else dt.itemsize
+        arr = np.frombuffer(payload[off:off + n],
+                            dtype=dt).reshape(shape).copy()
+        off += n
+        leaves.append(arr)
+    if off != len(payload):
+        raise ValueError(f"trailing bytes in state payload "
+                         f"({len(payload) - off})")
+    treedef = jax.tree_util.tree_structure(template)
+    return jax.tree_util.tree_unflatten(treedef, leaves)

@@ -38,6 +38,10 @@ from ..utils.tracing import note_dispatch
 from .cache import ProgramCache, _arg_signature, program_cache
 
 PIPELINE_SITE = "pipeline.chunk"
+# the online learning plane's fused serve+learn window runs through the
+# same compiler but pins its OWN dispatch site (one `Dispatches` ledger
+# row per served window) and span name — ISSUE 19
+ONLINE_SITE = "online.window"
 
 
 @dataclass
@@ -88,7 +92,7 @@ class ChunkPipeline:
     def __init__(self, stages: List[Stage], ctx=None,
                  schema_fp: str = "", mesh_fp: str = "",
                  cache: Optional[ProgramCache] = None,
-                 name: str = "pipeline"):
+                 name: str = "pipeline", site: str = PIPELINE_SITE):
         if not stages:
             raise ValueError("ChunkPipeline needs at least one stage")
         names = [s.name for s in stages]
@@ -99,6 +103,9 @@ class ChunkPipeline:
         self.stages = list(stages)
         self.ctx = ctx or runtime_context()
         self.name = name
+        if site not in (PIPELINE_SITE, ONLINE_SITE):
+            raise ValueError(f"unknown dispatch site {site!r}")
+        self.site = site
         self.schema_fp = schema_fp
         self.mesh_fp = mesh_fp or mesh_fingerprint(self.ctx)
         self.cache = cache if cache is not None else program_cache()
@@ -197,9 +204,16 @@ class ChunkPipeline:
                                              (self._carries, self._consts,
                                               inputs),
                                              on_outcome=self._tally)
-        note_dispatch(1, site=PIPELINE_SITE)
-        with span("pipeline.chunk", cat="pipeline", chunk=self._chunks,
-                  stages=len(self.stages)):
+        note_dispatch(1, site=self.site)
+        # literal span names per site — the §27 taxonomy drift guard
+        # scans call-site literals, so each site spells its own
+        if self.site == ONLINE_SITE:
+            cm = span("online.window", cat="online", chunk=self._chunks,
+                      stages=len(self.stages))
+        else:
+            cm = span("pipeline.chunk", cat="pipeline", chunk=self._chunks,
+                      stages=len(self.stages))
+        with cm:
             self._carries, rets = compiled(self._carries, self._consts,
                                            inputs)
         self._chunks += 1
@@ -213,6 +227,26 @@ class ChunkPipeline:
         for st, c in zip(self.stages, self._carries):
             if st.finish is not None:
                 st.finish(c)
+
+    # ---- carry access (the online plane's snapshot/restore hooks) ----
+    @property
+    def carries(self) -> Tuple[Any, ...]:
+        """The per-stage carry tuple as it stands (device arrays)."""
+        return self._carries
+
+    def install_carries(self, carries: Tuple[Any, ...]) -> None:
+        """Replace every stage's carry (snapshot restore / rollback).
+        The replacement must match the current signature leaf for leaf —
+        a mismatch would silently retrace, so it is refused here."""
+        carries = tuple(carries)
+        if len(carries) != len(self.stages):
+            raise ValueError(f"expected {len(self.stages)} carries, "
+                             f"got {len(carries)}")
+        if _arg_signature(carries) != _arg_signature(self._carries):
+            raise ValueError("carry signature mismatch: restored state "
+                             "does not match the running pipeline's "
+                             "shapes/dtypes")
+        self._carries = carries
 
     # ---- accounting ----
     @property

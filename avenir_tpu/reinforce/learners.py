@@ -25,6 +25,40 @@ import random
 from typing import Dict, List, Optional, Sequence, Tuple
 
 
+# ---- shared score bodies (host + device twins) -------------------------
+#
+# The online plane (avenir_tpu/online/) keeps these learners' arm
+# statistics device-resident and re-evaluates the SAME selection math
+# inside a fused XLA program.  To make host-vs-device parity a pin
+# rather than a hope, the scoring formulas live here as xp-agnostic
+# functions of plain arguments: the host learners call them with python
+# scalars and ``math.*``, the device forms (reinforce/online_forms.py)
+# call them with ``jnp`` arrays and ``jnp.*``.  One body, two callers —
+# a drifting reimplementation cannot pass the parity tests.
+
+def ucb1_upper_bound(mean, count, total_count, *, log=math.log,
+                     sqrt=math.sqrt):
+    """UCB1 upper bound: mean + sqrt(2 ln N / n)
+    (UpperConfidenceBoundOneLearner.java)."""
+    return mean + sqrt(2.0 * log(total_count) / count)
+
+
+def softmax_weight(mean, temp_constant, *, exp=math.exp, minimum=min):
+    """Boltzmann sampling weight: exp(mean / tau), argument clamped at
+    700 before exponentiation (SoftMaxLearner.java:62-90)."""
+    return exp(minimum(mean / temp_constant, 700))
+
+
+def sampson_sample(mean, sigma, count, unit_normal, *, sqrt=math.sqrt):
+    """Thompson posterior draw: mean + (sigma / sqrt(n)) * z, with
+    ``sigma`` the observed std dev already floored at 1.0 for the
+    degenerate no-variance arm (SampsonSamplerLearner.java).  ``z`` is a
+    unit-normal draw supplied by the caller — the host learner feeds
+    ``random.Random.gauss(0, 1)``, the device form a normal from a
+    threaded PRNG key — so the deterministic body stays shared while
+    each side owns its randomness."""
+    return mean + (sigma / sqrt(count)) * unit_normal
+
 
 class ActionStat:
     """chombo SimpleStat equivalent: count / sum / sum of squares."""
@@ -182,8 +216,8 @@ class SampsonSamplerLearner(MultiArmBanditLearner):
             if s.count == 0:
                 v = float("inf") if not self.optimistic else 1e12
             else:
-                v = self.rng.gauss(s.mean, (s.std_dev or 1.0) /
-                                   math.sqrt(s.count))
+                v = sampson_sample(s.mean, s.std_dev or 1.0, s.count,
+                                   self.rng.gauss(0.0, 1.0))
                 if self.optimistic:
                     v = max(v, s.mean)
             if v > best_v:
@@ -274,7 +308,7 @@ class UpperConfidenceBoundOneLearner(MultiArmBanditLearner):
             s = self.stats[act]
             if s.count == 0:
                 return float("inf")
-            return s.mean + math.sqrt(2.0 * math.log(N) / s.count)
+            return ucb1_upper_bound(s.mean, s.count, N)
         return max(self.actions, key=ub)
 
 
@@ -344,7 +378,7 @@ class SoftMaxLearner(MultiArmBanditLearner):
         probs = {}
         for act in self.actions:
             mean = self.stats[act].mean
-            probs[act] = math.exp(min(mean / self.temp_constant, 700))
+            probs[act] = softmax_weight(mean, self.temp_constant)
         return self._sample_distr(probs)
 
 

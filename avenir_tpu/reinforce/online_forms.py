@@ -1,0 +1,104 @@
+"""Device-state forms of the host bandit learners (ISSUE 19).
+
+The host learners (:mod:`.learners`) keep per-arm statistics in python
+objects and decide one action at a time; the online learning plane
+(:mod:`avenir_tpu.online`) keeps the SAME statistics as three ``(A,)``
+``float32`` arrays living in a donated pipeline carry and scores a whole
+served window in one fused program.  The scoring math is not
+re-implemented here: each form calls the shared bodies exported by
+:mod:`.learners` (``ucb1_upper_bound`` / ``softmax_weight`` /
+``sampson_sample``) with ``jnp`` callables, so the host decision path
+and the device window path are the same formula by construction — the
+parity tests pin it bit for bit on float32 inputs.
+
+Randomized selection (softMax, sampsonSampler) threads a
+``jax.random.PRNGKey`` supplied by the caller; the deterministic score
+body stays shared while each side owns its randomness (the host side
+draws from ``random.Random``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from .learners import sampson_sample, softmax_weight, ucb1_upper_bound
+
+# the device-resident subset of the factory's algorithm names
+ONLINE_ALGORITHMS = ("ucb1", "softMax", "sampsonSampler")
+
+
+def init_arm_stats(n_arms: int) -> Dict[str, np.ndarray]:
+    """Fresh per-arm statistics: count / reward sum / reward sum-sq
+    (ActionStat's three fields, vectorized)."""
+    return {
+        "counts": np.zeros(n_arms, np.float32),
+        "totals": np.zeros(n_arms, np.float32),
+        "total_sqs": np.zeros(n_arms, np.float32),
+    }
+
+
+def arm_means(counts, totals):
+    import jax.numpy as jnp
+    return totals / jnp.maximum(counts, 1.0)
+
+
+def arm_sigmas(counts, totals, total_sqs):
+    """ActionStat.std_dev vectorized, with the sampsonSampler's
+    ``std_dev or 1.0`` floor folded in (a no-variance arm samples at
+    unit sigma, exactly the host rule)."""
+    import jax.numpy as jnp
+    mean = arm_means(counts, totals)
+    var = (total_sqs - counts * mean * mean) / jnp.maximum(counts - 1.0,
+                                                           1.0)
+    sd = jnp.sqrt(jnp.maximum(var, 0.0))
+    return jnp.where(sd > 0.0, sd, 1.0)
+
+
+def bandit_scores(algorithm: str, counts, totals, total_sqs, key,
+                  n_rows: int, temp_constant: float = 0.1):
+    """Per-row selection scores ``(n_rows, A)``; the chosen arm of row i
+    is ``argmax(scores[i])``.  Untried arms score +inf — the host
+    learners' try-everything-once rule."""
+    import jax
+    import jax.numpy as jnp
+    mean = arm_means(counts, totals)
+    untried = counts < 0.5
+    if algorithm == "ucb1":
+        N = jnp.maximum(counts.sum(), 1.0)
+        ub = ucb1_upper_bound(mean, jnp.maximum(counts, 1.0), N,
+                              log=jnp.log, sqrt=jnp.sqrt)
+        scores = jnp.where(untried, jnp.inf, ub)
+        return jnp.broadcast_to(scores, (n_rows, counts.shape[0]))
+    if algorithm == "softMax":
+        w = softmax_weight(mean, temp_constant, exp=jnp.exp,
+                           minimum=jnp.minimum)
+        # Gumbel-max draws each row ~ w/sum(w) — the same Boltzmann
+        # distribution _sample_distr walks on the host
+        g = jax.random.gumbel(key, (n_rows, counts.shape[0]),
+                              dtype=mean.dtype)
+        scores = jnp.log(w)[None, :] + g
+        return jnp.where(untried[None, :], jnp.inf, scores)
+    if algorithm == "sampsonSampler":
+        sigma = arm_sigmas(counts, totals, total_sqs)
+        z = jax.random.normal(key, (n_rows, counts.shape[0]),
+                              dtype=mean.dtype)
+        draw = sampson_sample(mean[None, :], sigma[None, :],
+                              jnp.maximum(counts, 1.0)[None, :], z,
+                              sqrt=jnp.sqrt)
+        return jnp.where(untried[None, :], jnp.inf, draw)
+    raise ValueError(f"algorithm {algorithm!r} has no device form; "
+                     f"known: {ONLINE_ALGORITHMS}")
+
+
+def absorb_rewards(counts, totals, total_sqs, arms, rewards, mask):
+    """ActionStat.add vectorized over a padded reward batch: masked
+    scatter-add into the three arm arrays (duplicate arms accumulate —
+    many rewards for one arm in one window all land)."""
+    w = mask.astype(counts.dtype)
+    r = rewards.astype(counts.dtype) * w
+    counts = counts.at[arms].add(w)
+    totals = totals.at[arms].add(r)
+    total_sqs = total_sqs.at[arms].add(rewards.astype(counts.dtype) * r)
+    return counts, totals, total_sqs

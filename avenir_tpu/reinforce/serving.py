@@ -126,14 +126,19 @@ class RedisServingLoop:
     redis.server.port, redis.event.queue, redis.reward.queue,
     redis.action.queue.  A literal 'stop' message on the event queue ends
     :meth:`run` (transport-level control, not part of the bolt contract).
+
+    The transport comes from :func:`io.respq.make_queue_client` — the
+    same factory the serving fleet uses — so the loop inherits its
+    config surface: ``redis.server.endpoints`` listing M shards drains
+    through the consistent-hash ring, single host/port keeps the plain
+    client, byte for byte the old behavior.
     """
 
     def __init__(self, service, config: Optional[Dict] = None):
-        from ..io.respq import RespClient
+        from ..io.respq import make_queue_client
         cfg = dict(config or {})
         self.service = service
-        self.client = RespClient(cfg.get("redis.server.host", "127.0.0.1"),
-                                 int(cfg.get("redis.server.port", 6379)))
+        self.client = make_queue_client(cfg)
         self.event_q = cfg.get("redis.event.queue", "eventQueue")
         self.reward_q = cfg.get("redis.reward.queue", "rewardQueue")
         self.action_q = cfg.get("redis.action.queue", "actionQueue")

@@ -121,7 +121,8 @@ class ServingFleet:
                  wire_native: str = "auto",
                  models: Optional[Sequence] = None,
                  model_depths: Optional[Dict[str, int]] = None,
-                 shared_cores: bool = True):
+                 shared_cores: bool = True,
+                 reward_sink=None):
         # multi-model residency (ISSUE 18): models= lists the resident
         # set ("name" or "name:version" specs); every worker then runs a
         # ModelRouter over N co-resident services instead of one
@@ -160,6 +161,17 @@ class ServingFleet:
         # config) — fleet _ingest keeps its python parse, the codec
         # rides inside each worker's process_batch
         self._wire_native = wire_native
+        # online-learning reward intake (ISSUE 19): a fleet built with a
+        # reward_sink= runs online-capable workers — ``reward,<id>,<v>``
+        # rows drained off the shared request queue route to the sink
+        # through each worker's PredictionService instead of counting
+        # as BadRequests.  One sink serves every worker: the sink (the
+        # online plane's pending-outcome table) is host-side state and
+        # does its own locking.  Unavailable with models= (the router
+        # owns per-model parsing; an online fleet is single-model).
+        if reward_sink is not None and models:
+            raise ValueError("reward_sink= does not combine with models=")
+        self._reward_sink = reward_sink
         self._latency_window = int(latency_window)
         self.idle_sleep_s = float(idle_sleep_s)
         self.max_idle_sleep_s = float(max_idle_sleep_s)
@@ -228,7 +240,8 @@ class ServingFleet:
                       counters=Counters(),
                       timer=StepTimer(keep_samples=self._latency_window),
                       metrics=self._metrics,
-                      wire_native=self._wire_native)
+                      wire_native=self._wire_native,
+                      reward_sink=self._reward_sink)
         if self.predictor_factory is not None:
             return PredictionService(self.predictor_factory(), **common)
         return PredictionService(registry=self.registry,

@@ -217,7 +217,8 @@ class PredictionService:
                  metrics=None,
                  quantized: bool = False,
                  wire_native: str = "auto",
-                 shared_cores: bool = False):
+                 shared_cores: bool = False,
+                 reward_sink=None):
         if predictor is None and (registry is None or model_name is None):
             raise ValueError("need a predictor, or registry= + model_name=")
         if wire_native not in native_wire.MODES:
@@ -274,6 +275,13 @@ class PredictionService:
         # set by mark_degraded (e.g. a drift policy's degrade_action):
         # serving continues, operators see the reason + counter
         self.degraded: Optional[str] = None
+        # online-learning reward intake (ISSUE 19): when a sink is
+        # configured, ``reward,<id>,<value>`` rows drained alongside
+        # predicts are handed to it (a callable taking the message
+        # list) instead of counting as BadRequests; the native codec
+        # declines any batch containing the verb, so the sink only
+        # ever fires from the python path — one judged parse
+        self.reward_sink = reward_sink
         self._swap_lock = threading.Lock()
         if predictor is None:
             predictor = self._load(must=True)
@@ -727,6 +735,7 @@ class PredictionService:
         q_rows: List[tuple] = []
         traced = None
         reload_requested = False
+        reward_msgs: List[str] = []
         q_width = pred.prebinned_width \
             if getattr(pred, "supports_prebinned", False) else 0
         warned_no_prebinned = False
@@ -778,10 +787,19 @@ class PredictionService:
                             q_rows.append(decoded)
                 elif parts[0] == "reload":
                     reload_requested = True
+                elif parts[0] == "reward" and self.reward_sink is not None:
+                    # online reward intake: hand the raw message to the
+                    # sink (it owns arity/value judgement + the join);
+                    # rewards produce no reply line
+                    reward_msgs.append(message)
                 else:
                     self.counters.increment("Serving", "BadRequests")
                     warnings.warn(f"serving: dropping malformed message "
                                   f"{message!r}", RuntimeWarning)
+        if reward_msgs:
+            self.counters.increment("Serving", "RewardsRouted",
+                                    len(reward_msgs))
+            self.reward_sink(reward_msgs)
         if reload_requested and not entries:
             self.refresh()
             return []

@@ -1556,6 +1556,106 @@ def bench_retrain_loop(scale):
         shutil.rmtree(base, ignore_errors=True)
 
 
+def bench_online_learn(scale):
+    """The online learning plane (ISSUE 19): a drifting 3-arm bandit
+    served through the fused serve+learn window program.  Three
+    numbers: (a) post-drift regret slope for the online learner vs an
+    episodic baseline (the SAME UCB1 scoring body, but state frozen
+    between episode boundaries — the retrain-cadence world the fused
+    plane replaces): online must bend back toward the new best arm
+    between the baseline's episodes; (b) warm fused-window wall; (c)
+    its overhead over a predict-only jitted scorer at the same batch —
+    what absorbing rewards + stepping weights costs inside the one
+    dispatch."""
+    jax = _force_platform()
+    import jax.numpy as jnp
+    from avenir_tpu.online.plane import OnlineWindowPlane
+    from avenir_tpu.online.state import OnlineLearnerConfig
+    from avenir_tpu.reinforce.learners import create_learner
+    from avenir_tpu.reinforce.online_forms import bandit_scores
+
+    rng = np.random.default_rng(19)
+    actions = ("a", "b", "c")
+    W = 32
+    n_windows = max(int(80 * scale), 24)
+    half = n_windows // 2
+    episode = 10            # the baseline's retrain cadence (windows)
+    p_pre = np.array([0.2, 0.5, 0.8])
+    p_post = np.array([0.8, 0.5, 0.2])   # drift: best arm flips
+
+    cfg = OnlineLearnerConfig(actions=actions, n_features=0,
+                              algorithm="ucb1", seed=7)
+    plane = OnlineWindowPlane(cfg, buckets=(W,))
+    regret_on = np.zeros(n_windows)
+    pending_rewards = []
+    walls = []
+    for t in range(n_windows):
+        p = p_pre if t < half else p_post
+        reqs = [(f"{t}:{i}", np.zeros(0, np.float32)) for i in range(W)]
+        t0 = time.perf_counter()
+        decisions, _ = plane.run_window(reqs, pending_rewards)
+        walls.append(time.perf_counter() - t0)
+        pending_rewards = []
+        for rid, arm, _prob, _cls in decisions:
+            regret_on[t] += p.max() - p[arm]
+            r = 1.0 if rng.random() < p[arm] else 0.0
+            pending_rewards.append((rid, r))
+
+    # the episodic baseline: same scoring, state applied only at
+    # episode boundaries (decisions inside an episode see stale stats)
+    learner = create_learner("ucb1", list(actions))
+    regret_ep = np.zeros(n_windows)
+    buffered = []
+    for t in range(n_windows):
+        p = p_pre if t < half else p_post
+        if t % episode == 0:
+            for act, r in buffered:
+                learner.set_reward(act, r)
+            buffered = []
+        for _ in range(W):
+            act = learner.next_action()
+            arm = actions.index(act)
+            regret_ep[t] += p.max() - p[arm]
+            buffered.append((act, 1.0 if rng.random() < p[arm] else 0.0))
+
+    # post-drift slope: mean per-window regret over the last quarter
+    q = max(n_windows // 4, 2)
+    slope_on = float(regret_on[-q:].mean())
+    slope_ep = float(regret_ep[-q:].mean())
+
+    # predict-only comparator: score+argmax alone, jitted, same batch
+    carries = plane.carries
+    bandit = jax.tree_util.tree_map(jnp.asarray, carries[0])
+
+    @jax.jit
+    def predict_only(counts, totals, total_sqs, key):
+        s = bandit_scores("ucb1", counts, totals, total_sqs, key, W,
+                          cfg.temp_constant)
+        return jnp.argmax(s, axis=1)
+
+    key = jax.random.PRNGKey(0)
+    predict_only(bandit["counts"], bandit["totals"],
+                 bandit["total_sqs"], key).block_until_ready()
+    t0 = time.perf_counter()
+    reps = 50
+    for _ in range(reps):
+        predict_only(bandit["counts"], bandit["totals"],
+                     bandit["total_sqs"], key).block_until_ready()
+    pred_only_s = (time.perf_counter() - t0) / reps
+    warm = float(np.median(walls[2:]))
+    stats = plane.run_stats()
+    return {"metric": "online_regret_per_window_postdrift",
+            "value": round(slope_on, 3),
+            "episodic_baseline": round(slope_ep, 3),
+            "regret_total_online": round(float(regret_on.sum()), 1),
+            "regret_total_episodic": round(float(regret_ep.sum()), 1),
+            "n_windows": n_windows, "window_rows": W,
+            "fused_window_ms": round(warm * 1e3, 3),
+            "predict_only_ms": round(pred_only_s * 1e3, 3),
+            "fused_overhead_x": round(warm / max(pred_only_s, 1e-9), 2),
+            "retraces": stats["retraces"]}
+
+
 BENCHES = {
     "naive_bayes": bench_naive_bayes,
     "random_forest": bench_random_forest,
@@ -1566,6 +1666,7 @@ BENCHES = {
     "wire_codec": bench_wire_codec,
     "monitor_drift": bench_monitor_drift,
     "retrain_loop": bench_retrain_loop,
+    "online_learn": bench_online_learn,
 }
 
 

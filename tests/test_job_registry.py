@@ -58,6 +58,7 @@ EXPECTED = {
     "org.avenir.regress.LogisticRegressionPredictor":
         "logistic_regression_predictor",
     "org.avenir.control.RetrainController": "retrain_controller",
+    "org.avenir.online.OnlineLearner": "online_learner",
     "org.avenir.reinforce.AuerDeterministic": "auer_deterministic",
     "org.avenir.reinforce.GreedyRandomBandit": "greedy_random_bandit",
     "org.avenir.reinforce.RandomFirstGreedyBandit": "random_first_greedy_bandit",

@@ -177,12 +177,41 @@ def _model_token(rng):
                            "m= a", "m=a b", "m=a:1:"]))
 
 
+def _reward_msg(rng, delim, rid):
+    """Online-learning outcome rows (ISSUE 19).  A well-formed
+    ``reward,<id>,<value>`` makes the native plane decline the whole
+    batch (python owns reward parsing and the pending-outcome join);
+    near-miss spellings — no value field, a non-numeric value, extra
+    arity — are malformed messages on a service without a reward sink,
+    and both planes must judge them identically."""
+    r = rng.random()
+    if r < 0.35:
+        return delim.join(["reward", f"id{rid}",
+                           f"{rng.uniform(-1, 1):.4f}"])
+    if r < 0.50:
+        return delim.join(["reward", f"id{rid}"])          # no value
+    if r < 0.65:
+        return delim.join(["reward", f"id{rid}",
+                           str(rng.choice(["x", "", "nan", "inf",
+                                           "1_0", "--2"]))])
+    if r < 0.80:
+        return delim.join(["reward", f"id{rid}", "0.5", "extra"])
+    return str(rng.choice(["reward", "reward" + delim,
+                           "rewardx" + delim + "1" + delim + "2",
+                           "REWARD" + delim + "a" + delim + "1"]))
+
+
 def _predict_msg(rng, schema, delim, rid):
     row = [""] * schema.num_columns
     row[0] = f"id{rid}"
     for f in schema.fields:
         if f.ordinal:
             row[f.ordinal] = _field_text(rng, f, delim)
+    if rng.random() < 0.05 and schema.num_columns > 1:
+        # reward-shaped FEATURE data: the verb name inside an ordinary
+        # field is a value, not a verb — neither plane may route on it
+        ords = [f.ordinal for f in schema.fields if f.ordinal]
+        row[int(rng.choice(ords))] = "reward"
     body = ["predict", str(rid)]
     if rng.random() < 0.35:
         body.append(_trace_token(rng))
@@ -223,6 +252,8 @@ def _make_batch(rng, schema, delim, q_width):
             msgs.append(_predict_msg(rng, schema, delim, rid))
         elif r < 0.80:
             msgs.append(_predictq_msg(rng, delim, rid, q_width))
+        elif r < 0.84:
+            msgs.append(_reward_msg(rng, delim, rid))
         elif r < 0.86:
             msgs.append(str(rng.choice([
                 "predit" + delim + "typo", "garbage", "", " ",
@@ -301,3 +332,34 @@ def test_clean_batches_really_take_the_native_plane(seed):
     svc_p = PredictionService(DigestPredictor(schema, q_width=q_width),
                               warm=False, wire_native="off")
     assert out == svc_p.process_batch(msgs)
+
+
+def test_reward_batches_decline_to_python():
+    """A batch containing ANY ``reward`` verb must make the native
+    parser decline (python owns reward semantics: the arity/value
+    judgement, the sink hand-off, the pending-outcome join) — and the
+    served replies must stay byte-identical to the pure-python plane."""
+    rng = np.random.default_rng(7100)
+    schema = _random_schema(rng)
+    row = [""] * schema.num_columns
+    row[0] = "id0"
+    for f in schema.fields:
+        if not f.ordinal:
+            continue
+        if f.is_categorical:
+            row[f.ordinal] = str(rng.choice(f.cardinality))
+        elif f.is_numeric:
+            row[f.ordinal] = "1.5"
+        else:
+            row[f.ordinal] = "s"
+    msgs = [",".join(["predict", "0"] + row), "reward,id0,0.75"]
+    svc = PredictionService(DigestPredictor(schema), warm=False,
+                            wire_native="on")
+    codec = svc._wire_codec_for(svc.predictor)
+    assert codec is not None and codec.usable
+    assert codec.parse(msgs) is None      # declined, not mis-parsed
+    out_n, bad_n, req_n, warn_n = _run(svc, msgs)
+    out_p, bad_p, req_p, warn_p = _run(
+        PredictionService(DigestPredictor(schema), warm=False,
+                          wire_native="off"), msgs)
+    assert (out_n, bad_n, req_n, warn_n) == (out_p, bad_p, req_p, warn_p)

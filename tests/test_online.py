@@ -1,0 +1,649 @@
+"""The online learning plane (ISSUE 19): fused serve+learn windows.
+
+Pins, per TPU_NOTES §31:
+
+* one device dispatch per learning-enabled window (the ``online.window``
+  ledger site), warm re-runs retrace nothing;
+* device bandit decisions are bit-parity twins of the host learners'
+  (the shared scoring bodies in reinforce/learners.py);
+* the pending-outcome join never loses a reward silently (orphan /
+  evicted / shed are all counted);
+* snapshot -> restore -> snapshot round-trips bit-identically, and a
+  floor breach rolls device state back to the pinned snapshot;
+* the wire tier: ``reward,<id>,<value>`` leases under ``reward:<id>``,
+  predictions ack by reply, reward acks release on the snapshot
+  cadence — chaos drills kill the worker/supervisor at the
+  ``online_snapshot`` / ``online_restore`` fault points and verify no
+  accepted request or reward is silently dropped.
+"""
+
+import math
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+from avenir_tpu.control.controller import (OnlineSupervisor,
+                                           OnlineSupervisorPolicy)
+from avenir_tpu.control.journal import (ONLINE_PROBATION, ONLINE_SNAPSHOT,
+                                        OnlineJournal)
+from avenir_tpu.core.metrics import Counters
+from avenir_tpu.online.plane import OnlineWindowPlane, PendingOutcomeTable
+from avenir_tpu.online.service import (OnlineLearnerService,
+                                       OnlineRespLoop, reward_ack_token)
+from avenir_tpu.online.state import (OnlineLearnerConfig, init_state,
+                                     state_from_bytes, state_to_bytes)
+from avenir_tpu.serving.registry import ModelRegistry
+from avenir_tpu.utils.tracing import TransferLedger, transfer_ledger
+
+pytestmark = pytest.mark.online
+
+
+def bandit_cfg(**kw):
+    kw.setdefault("actions", ("a", "b", "c"))
+    return OnlineLearnerConfig(**kw)
+
+
+def req(rid, row=()):
+    return (rid, np.asarray(row, np.float32))
+
+
+# --------------------------------------------------------------------------
+# config + state serialization
+# --------------------------------------------------------------------------
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="action"):
+        OnlineLearnerConfig(actions=())
+    with pytest.raises(ValueError, match="device form"):
+        bandit_cfg(algorithm="epsilonGreedy")
+    with pytest.raises(ValueError, match="head"):
+        bandit_cfg(head="forest")
+    with pytest.raises(ValueError, match="mlp_hidden"):
+        bandit_cfg(head="mlp", n_features=4)
+    with pytest.raises(ValueError, match="n_features"):
+        bandit_cfg(head="mlp", mlp_hidden=8)
+
+
+def test_state_bytes_deterministic_and_roundtrip():
+    cfg = bandit_cfg(n_features=3, head="mlp", mlp_hidden=4)
+    s1, s2 = init_state(cfg), init_state(cfg)
+    b1, b2 = state_to_bytes(s1), state_to_bytes(s2)
+    assert b1 == b2                       # same state -> same bytes
+    back = state_from_bytes(b1, init_state(cfg))
+    assert state_to_bytes(back) == b1     # bit-identical round trip
+
+
+def test_state_bytes_refuses_layout_mismatch():
+    small = init_state(bandit_cfg(n_features=2))
+    big_t = init_state(bandit_cfg(n_features=5))
+    with pytest.raises(ValueError, match="payload|template|leaf"):
+        state_from_bytes(state_to_bytes(small), big_t)
+    with pytest.raises(ValueError, match="state payload"):
+        state_from_bytes(b"junkbytes", small)
+
+
+# --------------------------------------------------------------------------
+# pending-outcome table
+# --------------------------------------------------------------------------
+
+def test_pending_table_join_orphan_evict():
+    t = PendingOutcomeTable(capacity=2, ttl_s=0.0)
+    t.put("a", np.zeros(1), (0, 0.5, -1))
+    t.put("b", np.zeros(1), (1, 0.5, -1))
+    t.put("c", np.zeros(1), (2, 0.5, -1))   # full: evicts "a"
+    assert t.evicted == 1 and len(t) == 2
+    assert t.join("a") is None and t.orphans == 1
+    x, dec = t.join("b")
+    assert dec == (1, 0.5, -1) and t.joined == 1
+    assert t.stats() == {"pending": 1, "joined": 1, "orphans": 1,
+                         "shed": 0, "evicted": 1}
+
+
+def test_pending_table_ttl_shedding_uses_injected_clock():
+    now = [0.0]
+    t = PendingOutcomeTable(capacity=8, ttl_s=10.0, clock=lambda: now[0])
+    t.put("a", np.zeros(1), (0, 0.5, -1))
+    now[0] = 5.0
+    t.put("b", np.zeros(1), (1, 0.5, -1))
+    now[0] = 11.0
+    assert t.shed_expired() == 1           # only "a" is past the TTL
+    assert t.join("a") is None             # shed -> orphan on late join
+    assert t.join("b") is not None
+    assert t.shed == 1
+
+
+def test_pending_table_re_decision_newest_wins():
+    t = PendingOutcomeTable(capacity=4, ttl_s=0.0)
+    t.put("a", np.zeros(1), (0, 0.1, -1))
+    t.put("a", np.full(1, 7.0), (2, 0.9, -1))
+    x, dec = t.join("a")
+    assert dec == (2, 0.9, -1) and float(x[0]) == 7.0
+    assert len(t) == 0
+
+
+# --------------------------------------------------------------------------
+# the fused window: one dispatch, warm zero retraces
+# --------------------------------------------------------------------------
+
+def test_one_dispatch_per_window_at_the_online_site():
+    plane = OnlineWindowPlane(bandit_cfg(), buckets=(4,))
+    led = TransferLedger()
+    with transfer_ledger(led):
+        plane.run_window([req("r0"), req("r1")], [])
+    assert led.site_snapshot() == {"online.window": 1}
+    with transfer_ledger(led):
+        plane.run_window([req("r2")], [("r0", 1.0)])
+    assert led.site_snapshot() == {"online.window": 2}
+
+
+def test_warm_windows_retrace_nothing():
+    plane = OnlineWindowPlane(bandit_cfg(n_features=2), buckets=(4,))
+    plane.run_window([req("r0", (0.5, 1.0))], [])
+    cold = plane.run_stats()["retraces"]
+    for t in range(1, 6):
+        plane.run_window([req(f"r{t}", (0.1 * t, -1.0))],
+                         [(f"r{t-1}", 1.0)])
+    s = plane.run_stats()
+    assert s["retraces"] == cold          # every warm window: cache hit
+    assert s["windows"] == 6 and s["joined"] == 5
+
+
+def test_bucket_padding_is_shape_stable_across_window_sizes():
+    plane = OnlineWindowPlane(bandit_cfg(), buckets=(8, 16))
+    plane.run_window([req("a")], [])
+    cold = plane.run_stats()["retraces"]
+    plane.run_window([req(f"b{i}") for i in range(3)], [])   # same bucket
+    assert plane.run_stats()["retraces"] == cold
+    plane.run_window([req(f"c{i}") for i in range(9)], [])   # next bucket
+    assert plane.run_stats()["retraces"] > cold
+
+
+def test_unknown_reward_is_a_counted_orphan_not_a_crash():
+    plane = OnlineWindowPlane(bandit_cfg(), buckets=(4,))
+    decisions, outcomes = plane.run_window([req("r0")],
+                                           [("ghost", 1.0)])
+    assert len(decisions) == 1 and outcomes == []
+    assert plane.run_stats()["orphans"] == 1
+
+
+# --------------------------------------------------------------------------
+# device-vs-host bit parity (the shared scoring bodies)
+# --------------------------------------------------------------------------
+
+def _plant_stats(plane, counts, totals, total_sqs):
+    """Install exact arm statistics into the device carries."""
+    carries = plane.carries
+    bandit = {"counts": np.asarray(counts, np.float32),
+              "totals": np.asarray(totals, np.float32),
+              "total_sqs": np.asarray(total_sqs, np.float32)}
+    plane._pipeline.install_carries((bandit,) + tuple(carries[1:]))
+
+
+def test_ucb1_device_decision_matches_host_learner():
+    from avenir_tpu.reinforce.learners import create_learner
+    actions = ("x", "y", "z")
+    host = create_learner("ucb1", list(actions))
+    rng = np.random.default_rng(5)
+    counts = np.array([7, 3, 11], np.float64)
+    means = np.array([0.4, 0.9, 0.2])
+    for i, a in enumerate(actions):
+        host.set_reward_stats(a, int(counts[i]), float(means[i]),
+                              0.1)
+    plane = OnlineWindowPlane(bandit_cfg(actions=actions), buckets=(4,))
+    totals = counts * means
+    # host total_sq consistent with std 0.1: var = E[x^2]-mean^2
+    total_sqs = counts * (0.1 ** 2 + means ** 2)
+    _plant_stats(plane, counts, totals, total_sqs)
+    decisions, _ = plane.run_window([req("r0")], [])
+    host_choice = host.next_action()
+    assert actions[decisions[0][1]] == host_choice
+
+
+def test_ucb1_shared_body_is_the_host_formula():
+    from avenir_tpu.reinforce.learners import ucb1_upper_bound
+    assert ucb1_upper_bound(0.5, 4, 100) == \
+        0.5 + math.sqrt(2.0 * math.log(100) / 4)
+
+
+def test_softmax_shared_body_is_the_host_formula():
+    from avenir_tpu.reinforce.learners import softmax_weight
+    assert softmax_weight(0.3, 0.1) == math.exp(min(0.3 / 0.1, 700))
+    assert softmax_weight(1e6, 0.001) == math.exp(700)   # overflow clamp
+
+
+def test_sampson_shared_body_is_the_host_formula():
+    from avenir_tpu.reinforce.learners import sampson_sample
+    import random
+    r1, r2 = random.Random(3), random.Random(3)
+    mu, sigma, n = 0.4, 0.25, 9
+    old = r1.gauss(mu, sigma / math.sqrt(n))     # the pre-refactor form
+    new = sampson_sample(mu, sigma, n, r2.gauss(0.0, 1.0))
+    assert old == new                            # BITWISE identical
+
+
+@pytest.mark.parametrize("algorithm", ["ucb1", "softMax",
+                                       "sampsonSampler"])
+def test_absorb_matches_host_reward_accounting(algorithm):
+    """Absorbed device statistics == the host learner's ActionStat
+    accounting for the same reward sequence."""
+    from avenir_tpu.reinforce.learners import create_learner
+    actions = ("x", "y")
+    plane = OnlineWindowPlane(bandit_cfg(actions=actions,
+                                         algorithm=algorithm),
+                              buckets=(4,))
+    host = create_learner(algorithm, list(actions))
+    rewards = [("x", 1.0), ("y", 0.25), ("x", 0.5), ("x", 0.0)]
+    for a, v in rewards:
+        host.set_reward(a, v)
+    # feed the same rewards through decisions pinned to each arm
+    decisions, _ = plane.run_window([req(f"r{i}") for i in
+                                     range(len(rewards))], [])
+    for i, (a, v) in enumerate(rewards):
+        arm = actions.index(a)
+        ent = plane.pending._entries[f"r{i}"]
+        plane.pending._entries[f"r{i}"] = \
+            (ent[0], (arm,) + ent[1][1:], ent[2])
+    plane.run_window([], [(f"r{i}", v) for i, (a, v) in
+                          enumerate(rewards)])
+    bandit = {k: np.asarray(v) for k, v in plane.carries[0].items()}
+    for i, a in enumerate(actions):
+        s = host.stats[a]
+        assert bandit["counts"][i] == s.count
+        np.testing.assert_allclose(bandit["totals"][i], s.total,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(bandit["total_sqs"][i], s.total_sq,
+                                   rtol=1e-6)
+
+
+def test_logistic_head_learns_a_separable_signal():
+    cfg = bandit_cfg(n_features=1, head="logistic", learning_rate=0.5)
+    plane = OnlineWindowPlane(cfg, buckets=(8,))
+    rng = np.random.default_rng(0)
+    prev = []
+    for t in range(60):
+        reqs = []
+        for i in range(8):
+            x = float(rng.uniform(-1, 1))
+            reqs.append((f"{t}:{i}", np.asarray([x], np.float32)))
+        decisions, _ = plane.run_window(reqs, prev)
+        prev = [(rid, 1.0 if float(row[0]) > 0 else 0.0)
+                for (rid, row) in reqs]
+    w = plane.logistic_w()
+    assert w[1] > 1.0                     # feature weight found the sign
+    _, probs = None, None
+    decisions, _ = plane.run_window(
+        [req("hi", (0.9,)), req("lo", (-0.9,))], prev)
+    assert decisions[0][2] > 0.5 > decisions[1][2]
+
+
+# --------------------------------------------------------------------------
+# supervisor: snapshot cadence, rollback, resume, chaos
+# --------------------------------------------------------------------------
+
+def make_supervised(tmp_path, *, snapshot_every=2, floor=0,
+                    floor_window=4, consecutive=1, head="bandit",
+                    n_features=0, counters=None, name="onl"):
+    cfg = bandit_cfg(head=head, n_features=n_features)
+    plane = OnlineWindowPlane(cfg, buckets=(4,))
+    reg = ModelRegistry(os.path.join(str(tmp_path), "registry"))
+    sup = OnlineSupervisor(
+        reg, name, os.path.join(str(tmp_path), "state"),
+        policy=OnlineSupervisorPolicy(
+            snapshot_every=snapshot_every, accuracy_floor=floor,
+            floor_window=floor_window, floor_consecutive=consecutive,
+            pos_class="a", neg_class="b"),
+        counters=counters)
+    svc = OnlineLearnerService(plane, supervisor=sup)
+    return plane, reg, sup, svc
+
+
+def test_attach_pins_the_first_snapshot(tmp_path):
+    plane, reg, sup, svc = make_supervised(tmp_path)
+    assert reg.pinned_version("onl") == 1        # the rollback target
+    assert sup.journal.stage == ONLINE_PROBATION
+    assert reg.read_sidecar("onl", 1, "online_state.bin") == \
+        plane.state_bytes()
+
+
+def test_snapshot_restore_is_bit_identical(tmp_path):
+    plane, reg, sup, svc = make_supervised(tmp_path, snapshot_every=100)
+    svc.process_window(["predict,r0", "predict,r1"])
+    svc.process_window(["reward,r0,1.0", "reward,r1,0.25"])
+    v = sup.snapshot()
+    before = plane.state_bytes()
+    assert reg.read_sidecar("onl", v, "online_state.bin") == before
+    svc.process_window(["predict,r2"])
+    svc.process_window(["reward,r2,1.0"])
+    assert plane.state_bytes() != before         # state moved on
+    sup.rollback()
+    assert plane.state_bytes() == before         # bit-identical restore
+
+
+def test_floor_breach_rolls_back_and_restarts_probation(tmp_path):
+    counters = Counters()
+    plane, reg, sup, svc = make_supervised(
+        tmp_path, snapshot_every=100, floor=90, floor_window=4,
+        counters=counters)
+    pinned = plane.state_bytes()
+    # four wrong outcomes close a probation window under the 90% floor
+    events = sup.on_window(["a", "a", "a", "a"], ["b", "b", "b", "b"])
+    assert "rollback" in events
+    assert plane.state_bytes() == pinned
+    assert counters.get("Online", "FloorBreaches") == 1
+    assert counters.get("Online", "Rollbacks") == 1
+    assert sup.journal.stage == ONLINE_PROBATION
+    assert sup.journal["rollbacks"] == 1
+    # accurate outcomes keep probation quiet
+    assert sup.on_window(["a"] * 4, ["a"] * 4) == {}
+
+
+def test_snapshot_cadence_counts_supervised_windows(tmp_path):
+    plane, reg, sup, svc = make_supervised(tmp_path, snapshot_every=3)
+    assert sup.on_window(["a"], ["a"]) == {}
+    assert sup.on_window(["a"], ["a"]) == {}
+    ev = sup.on_window(["a"], ["a"])
+    assert ev.get("snapshot") == 2               # v1 was the attach pin
+    assert reg.pinned_version("onl") == 2
+
+
+def test_reward_acks_held_until_snapshot_commits(tmp_path):
+    plane, reg, sup, svc = make_supervised(tmp_path, snapshot_every=3)
+    replies, acks = svc.process_window(["predict,r0"])
+    assert replies[0].startswith("r0,")
+    assert acks == []
+    _, acks = svc.process_window(["reward,r0,1.0"])
+    assert acks == []                     # window 2 of cadence 3: held
+    assert svc.stats()["held_acks"] == 1
+    _, acks = svc.process_window(["predict,r1"])
+    assert acks == [reward_ack_token("r0")]      # window 3: snapshot
+    assert svc.stats()["held_acks"] == 0
+
+
+def test_resume_restores_the_pinned_snapshot(tmp_path):
+    plane, reg, sup, svc = make_supervised(tmp_path, snapshot_every=100)
+    svc.process_window(["predict,r0"])
+    svc.process_window(["reward,r0,1.0"])
+    v = sup.snapshot()
+    pinned = plane.state_bytes()
+    svc.process_window(["predict,r1"])
+    svc.process_window(["reward,r1,0.5"])       # un-snapshotted progress
+    # a NEW process: fresh plane + supervisor over the same dirs
+    cfg = bandit_cfg()
+    plane2 = OnlineWindowPlane(cfg, buckets=(4,))
+    sup2 = OnlineSupervisor(
+        reg, "onl", os.path.join(str(tmp_path), "state"),
+        policy=OnlineSupervisorPolicy(snapshot_every=100))
+    OnlineLearnerService(plane2, supervisor=sup2)
+    assert plane2.state_bytes() == pinned       # back to the pin, exactly
+    assert sup2.journal.stage == ONLINE_PROBATION
+
+
+@pytest.mark.faultinject
+def test_chaos_kill_at_snapshot_fault_point(tmp_path, fault_injector):
+    plane, reg, sup, svc = make_supervised(tmp_path, snapshot_every=100)
+    svc.process_window(["predict,r0"])
+    _, acks = svc.process_window(["reward,r0,1.0"])
+    assert acks == []                            # held: no snapshot yet
+    fault_injector("online_snapshot@0=raise:RuntimeError")
+    with pytest.raises(RuntimeError, match="injected fault"):
+        sup.snapshot()
+    # the journal recorded the in-flight snapshot BEFORE the side effect
+    j = OnlineJournal(os.path.join(str(tmp_path), "state"))
+    assert j.stage == ONLINE_SNAPSHOT and j.interrupted
+    # the ack was never released: the reward redelivers, nothing lost
+    assert svc.stats()["held_acks"] == 1
+    from avenir_tpu.core import faults
+    faults.uninstall()
+    # restart: resume restores the attach-time pin (the only committed
+    # snapshot) and re-enters probation; the redelivered reward joins
+    # as a counted orphan (its pending entry died with the process)
+    plane2 = OnlineWindowPlane(bandit_cfg(), buckets=(4,))
+    sup2 = OnlineSupervisor(
+        reg, "onl", os.path.join(str(tmp_path), "state"),
+        policy=OnlineSupervisorPolicy(snapshot_every=100))
+    svc2 = OnlineLearnerService(plane2, supervisor=sup2)
+    assert reg.pinned_version("onl") == 1
+    assert sup2.journal.stage == ONLINE_PROBATION
+    replies, _ = svc2.process_window(["reward,r0,1.0"])
+    assert replies == []
+    assert plane2.run_stats()["orphans"] == 1    # counted, not silent
+
+
+@pytest.mark.faultinject
+def test_chaos_kill_at_restore_fault_point(tmp_path, fault_injector):
+    counters = Counters()
+    plane, reg, sup, svc = make_supervised(
+        tmp_path, snapshot_every=100, floor=90, floor_window=4,
+        counters=counters)
+    pinned = plane.state_bytes()
+    fault_injector("online_restore@0=raise:RuntimeError")
+    with pytest.raises(RuntimeError, match="injected fault"):
+        sup.on_window(["a"] * 4, ["b"] * 4)      # breach -> rollback dies
+    j = OnlineJournal(os.path.join(str(tmp_path), "state"))
+    assert j.interrupted                         # rollback was in flight
+    from avenir_tpu.core import faults
+    faults.uninstall()
+    # restart resumes through the SAME restore path: pinned state wins
+    plane2 = OnlineWindowPlane(bandit_cfg(), buckets=(4,))
+    sup2 = OnlineSupervisor(
+        reg, "onl", os.path.join(str(tmp_path), "state"),
+        policy=OnlineSupervisorPolicy(snapshot_every=100))
+    OnlineLearnerService(plane2, supervisor=sup2)
+    assert plane2.state_bytes() == pinned
+    assert sup2.journal.stage == ONLINE_PROBATION
+
+
+def test_restore_refuses_signature_mismatch():
+    plane = OnlineWindowPlane(bandit_cfg(n_features=2), buckets=(4,))
+    plane.run_window([req("r0", (0.1, 0.2))], [])
+    other = OnlineWindowPlane(bandit_cfg(n_features=3), buckets=(4,))
+    with pytest.raises(ValueError):
+        plane.restore(other.state_bytes())       # silent-retrace guard
+
+
+# --------------------------------------------------------------------------
+# service parsing + the wire tier
+# --------------------------------------------------------------------------
+
+def test_service_strict_parse_counts_near_misses():
+    cfg = bandit_cfg(n_features=2)
+    svc = OnlineLearnerService(OnlineWindowPlane(cfg, buckets=(4,)))
+    bad = ["reward,r0",               # no value
+           "reward,r0,notanum",      # non-numeric value
+           "reward,r0,inf",          # non-finite value
+           "reward,,1.0",            # empty id
+           "reward,r0,1.0,extra",    # arity
+           "predict,r1,0.5",         # short feature row
+           "predict,r2,0.5,x",       # non-numeric feature
+           "bogus,1,2"]
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        replies, acks = svc.process_window(
+            bad + ["predict,r9,0.5,1.5"])
+    assert len(replies) == 1 and replies[0].startswith("r9,")
+    assert acks == []                 # no supervisor -> released...
+    # ...wait: without a supervisor acks release immediately, but the
+    # window had no VALID rewards, so there is nothing to ack
+    assert svc.counters.get("Online", "BadRequests") == len(bad)
+    assert any("malformed" in str(x.message) for x in w)
+
+
+def test_service_without_supervisor_acks_at_window_end():
+    svc = OnlineLearnerService(OnlineWindowPlane(bandit_cfg(),
+                                                 buckets=(4,)))
+    svc.process_window(["predict,r0"])
+    _, acks = svc.process_window(["reward,r0,1.0"])
+    assert acks == [reward_ack_token("r0")]
+
+
+def test_lease_rid_understands_reward():
+    from avenir_tpu.io.respq import _lease_rid
+    assert _lease_rid("reward,r7,0.5", ",") == "reward:r7"
+    assert _lease_rid("predict,r7,1,2", ",") == "r7"
+    assert _lease_rid("reward,", ",") is None
+    assert _lease_rid("reward", ",") is None
+    assert _lease_rid("stop", ",") is None
+
+
+def test_sharded_routing_sends_reward_to_its_requests_shard():
+    from avenir_tpu.io.respq import HashRing, ShardedRespClient
+    eps = ["127.0.0.1:7001", "127.0.0.1:7002", "127.0.0.1:7003"]
+    cli = ShardedRespClient.__new__(ShardedRespClient)
+    cli._delim = ","
+    assert cli.id_of("predict,r42,1,2") == "r42"
+    assert cli.id_of("reward,r42,0.5") == "r42"
+    assert cli.id_of("reward:r42,acked") == "r42"
+    assert cli.id_of("stop") == "stop"
+
+
+def test_wire_e2e_leased_rewards_ack_on_snapshot(tmp_path):
+    from avenir_tpu.io.respq import RespClient, RespServer
+    plane, reg, sup, svc = make_supervised(tmp_path, snapshot_every=2)
+    server = RespServer().start()
+    try:
+        cli = RespClient(port=server.port)
+        loop = OnlineRespLoop(svc, cli, batch=8, lease_s=0.15)
+        cli.lpush_many("requestQueue", ["predict,r0", "predict,r1"])
+        assert loop.run(max_windows=1) == 1
+        # replies landed; predict leases acked by the reply push
+        replies = set()
+        while True:
+            v = cli.rpop("predictionQueue")
+            if v is None:
+                break
+            replies.add(v.split(",")[0])
+        assert replies == {"r0", "r1"}
+        cli.lpush("requestQueue", "reward,r0,1.0")
+        assert loop.run(max_windows=1) == 1      # window 2: snapshot
+        acks = cli.rpop("rewardAckQueue")
+        assert acks == reward_ack_token("r0")
+        import time as _t
+        _t.sleep(0.25)                           # past every lease
+        assert cli.rpop("requestQueue") is None  # acked: no redelivery
+        assert plane.run_stats()["joined"] == 1
+        cli.close()
+    finally:
+        server.stop()
+
+
+def test_wire_e2e_unacked_reward_redelivers_after_lease_expiry(tmp_path):
+    """A worker that dies between absorbing a reward and snapshotting
+    never acked it — the lease expires and the reward redelivers (the
+    no-silent-loss half of the chaos contract)."""
+    from avenir_tpu.io.respq import RespClient, RespServer
+    plane, reg, sup, svc = make_supervised(tmp_path, snapshot_every=100)
+    server = RespServer().start()
+    try:
+        cli = RespClient(port=server.port)
+        loop = OnlineRespLoop(svc, cli, batch=8, lease_s=0.15)
+        cli.lpush("requestQueue", "predict,r0")
+        loop.run(max_windows=1)
+        cli.lpush("requestQueue", "reward,r0,1.0")
+        loop.run(max_windows=1)                  # absorbed, ack HELD
+        assert svc.stats()["held_acks"] == 1
+        assert cli.rpop("rewardAckQueue") is None
+        import time as _t
+        _t.sleep(0.25)                           # past the lease
+        # rpop sweeps expired leases back to the pop end first
+        redelivered = cli.rpop("requestQueue")
+        assert redelivered == "reward,r0,1.0"
+        cli.close()
+    finally:
+        server.stop()
+
+
+def test_wire_stop_flushes_held_acks(tmp_path):
+    from avenir_tpu.io.respq import RespClient, RespServer
+    plane, reg, sup, svc = make_supervised(tmp_path, snapshot_every=100)
+    server = RespServer().start()
+    try:
+        cli = RespClient(port=server.port)
+        loop = OnlineRespLoop(svc, cli, batch=8, lease_s=30.0)
+        cli.lpush_many("requestQueue",
+                       ["predict,r0"])
+        loop.run(max_windows=1)
+        cli.lpush_many("requestQueue", ["reward,r0,1.0", "stop"])
+        loop.run()                               # stop: flush + break
+        assert cli.rpop("rewardAckQueue") == reward_ack_token("r0")
+        assert svc.stats()["held_acks"] == 0
+        cli.close()
+    finally:
+        server.stop()
+
+
+def test_service_export_and_metrics_binding():
+    from avenir_tpu.telemetry.metrics import MetricsRegistry
+    svc = OnlineLearnerService(OnlineWindowPlane(bandit_cfg(),
+                                                 buckets=(4,)))
+    svc.process_window(["predict,r0"])
+    svc.process_window(["reward,r0,1.0"])
+    c = Counters()
+    svc.export(c)
+    assert c.get("Online", "Joined") == 1
+    reg = MetricsRegistry()
+    svc.bind_metrics(reg)
+    text = reg.render()
+    assert "avenir_online_state" in text
+    assert 'key="windows"' in text
+
+
+# --------------------------------------------------------------------------
+# the CLI job
+# --------------------------------------------------------------------------
+
+def test_online_learner_job_inprocess(tmp_path):
+    from avenir_tpu.cli import run  # noqa: F401 -- registers job modules
+    from avenir_tpu.cli.jobs import resolve
+    from avenir_tpu.core.config import Config
+    fn = resolve("onlineLearner")
+    in_path = tmp_path / "in.txt"
+    msgs = []
+    for i in range(6):
+        msgs.append(f"predict,r{i}")
+        if i >= 2:
+            msgs.append(f"reward,r{i-2},1.0")
+    in_path.write_text("\n".join(msgs) + "\n")
+    out_dir = tmp_path / "out"
+    cfg = Config({"ps.online.actions": "a,b",
+                            "ps.online.window.size": "4"})
+    counters = fn(cfg, str(in_path), str(out_dir))
+    out_lines = [ln for f in sorted(out_dir.iterdir())
+                 for ln in f.read_text().splitlines()]
+    assert len(out_lines) == 6
+    assert all(ln.split(",")[1] in ("a", "b") for ln in out_lines)
+    assert counters.get("Online", "Rewards") == 4
+
+
+def test_online_learner_job_resp_supervised(tmp_path):
+    from avenir_tpu.cli import run  # noqa: F401 -- registers job modules
+    from avenir_tpu.cli.jobs import resolve
+    from avenir_tpu.core.config import Config
+    fn = resolve("onlineLearner")
+    in_path = tmp_path / "in.txt"
+    msgs = []
+    for i in range(8):
+        msgs.append(f"predict,r{i}")
+        if i >= 1:
+            msgs.append(f"reward,r{i-1},0.5")
+    msgs.append("stop")
+    in_path.write_text("\n".join(msgs) + "\n")
+    out_dir = tmp_path / "out"
+    reg_dir = tmp_path / "registry"
+    cfg = Config({
+        "ps.online.actions": "a,b,c",
+        "ps.online.window.size": "4",
+        "ps.online.snapshot.every": "1",
+        "ps.transport": "resp",
+        "ps.model.registry.dir": str(reg_dir),
+        "ps.model.name": "onl",
+        "ps.online.state.dir": str(tmp_path / "state")})
+    fn(cfg, str(in_path), str(out_dir))
+    out_lines = [ln for f in sorted(out_dir.iterdir())
+                 for ln in f.read_text().splitlines()]
+    assert len(out_lines) == 8
+    assert [ln.split(",")[0] for ln in out_lines] == \
+        [f"r{i}" for i in range(8)]              # lpush+rpop is FIFO
+    reg = ModelRegistry(str(reg_dir))
+    assert reg.pinned_version("onl") >= 1        # snapshots committed

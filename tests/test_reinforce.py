@@ -271,3 +271,147 @@ def test_exploration_counter_reference_semantics():
     ec.select_next_round(4)   # remaining 0 -> exploration over
     assert not ec.is_in_exploration()
     assert not ec.should_explore(0)
+
+
+def test_exploration_counter_non_wrapping_window():
+    """A batch that fits inside the item set selects one contiguous
+    window; items outside it are not forced."""
+    from avenir_tpu.reinforce.learners import ExplorationCounter
+    ec = ExplorationCounter("g", count=10, exploration_count=6, batch_size=3)
+    ec.select_next_round(1)   # remaining 6 -> beg 6, end 8: no wrap
+    assert ec.selections == [(6, 8)]
+    assert all(ec.should_explore(i) for i in (6, 7, 8))
+    assert not any(ec.should_explore(i) for i in (0, 5, 9))
+    ec.select_next_round(2)   # remaining 3 -> beg 3, end 5
+    assert ec.selections == [(3, 5)]
+    ec.select_next_round(3)   # remaining 0: budget spent exactly
+    assert not ec.is_in_exploration()
+
+
+def test_exploration_counter_batch_spanning_whole_set():
+    """batch_size == count sweeps every item each round until the
+    budget runs out."""
+    from avenir_tpu.reinforce.learners import ExplorationCounter
+    ec = ExplorationCounter("g", count=4, exploration_count=8, batch_size=4)
+    ec.select_next_round(1)   # remaining 8 -> beg 0, end 3
+    assert all(ec.should_explore(i) for i in range(4))
+    ec.select_next_round(2)   # remaining 4 -> beg 0, end 3
+    assert all(ec.should_explore(i) for i in range(4))
+    ec.select_next_round(3)
+    assert not ec.is_in_exploration()
+
+
+def test_min_trial_forces_round_robin_first():
+    """Every arm must reach min.trial pulls before the policy scores
+    (selectActionBasedOnMinTrial)."""
+    learner = create_learner("ucb1", ACTIONS, {"min.trial": 2})
+    picks = []
+    for _ in range(6):
+        a = learner.next_action()
+        picks.append(a)
+        learner.set_reward(a, 0.0 if a != "a" else 1.0)
+    assert picks == ["a", "a", "b", "b", "c", "c"]
+    # budget spent: scoring takes over (all-zero rewards except "a")
+    assert learner.next_action() == "a"
+
+
+def test_ucb1_decide_is_the_shared_scoring_body():
+    """next_action == argmax of ucb1_upper_bound over the same stats —
+    the formula the device twin jit-compiles."""
+    from avenir_tpu.reinforce.learners import ucb1_upper_bound
+    learner = create_learner("ucb1", ACTIONS)
+    counts = {"a": 8, "b": 3, "c": 5}
+    means = {"a": 0.40, "b": 0.55, "c": 0.50}
+    for act in ACTIONS:
+        learner.set_reward_stats(act, counts[act], means[act], 0.05)
+    N = learner.total_trial_count + 1          # the pull being decided
+    expect = max(ACTIONS,
+                 key=lambda act: ucb1_upper_bound(means[act], counts[act],
+                                                  max(N, 1)))
+    assert learner.next_action() == expect
+
+
+def test_ucb1_untried_arm_scores_infinite():
+    learner = create_learner("ucb1", ACTIONS)
+    learner.set_reward_stats("a", 50, 0.99, 0.0)
+    learner.set_reward_stats("c", 50, 0.98, 0.0)
+    assert learner.next_action() == "b"        # count 0 outranks any mean
+
+
+def test_softmax_decide_is_the_shared_weight_body():
+    """Replay the seeded RNG against softmax_weight: the learner's draw
+    must land exactly where the shared body's distribution says."""
+    import random as _random
+    from avenir_tpu.reinforce.learners import softmax_weight
+    learner = create_learner("softMax", ACTIONS,
+                             {"random.seed": 7, "temp.constant": 0.1})
+    means = {"a": 0.2, "b": 0.6, "c": 0.4}
+    for act in ACTIONS:
+        learner.set_reward_stats(act, 5, means[act], 0.0)
+    twin = _random.Random(7)
+    for _ in range(20):
+        probs = {act: softmax_weight(means[act], 0.1) for act in ACTIONS}
+        total = sum(probs.values())
+        r = twin.random() * total
+        acc, expect = 0.0, ACTIONS[-1]
+        for act in ACTIONS:
+            acc += probs[act]
+            if r <= acc:
+                expect = act
+                break
+        assert learner.next_action() == expect
+
+
+def test_sampson_decide_is_the_shared_sample_body():
+    """Same replay for Thompson sampling: rng.gauss draws fed through
+    sampson_sample pick the identical arm."""
+    import math as _math
+    import random as _random
+    from avenir_tpu.reinforce.learners import sampson_sample
+    learner = create_learner("sampsonSampler", ACTIONS, {"random.seed": 11})
+    for act, mean in (("a", 0.3), ("b", 0.5), ("c", 0.4)):
+        learner.set_reward_stats(act, 9, mean, 0.2)
+    twin = _random.Random(11)
+    for _ in range(20):
+        best, best_v = None, -float("inf")
+        for act in ACTIONS:
+            s = learner.stats[act]
+            v = sampson_sample(s.mean, s.std_dev or 1.0, s.count,
+                               twin.gauss(0.0, 1.0))
+            if v > best_v:
+                best, best_v = act, v
+        assert learner.next_action() == best
+
+
+def test_set_reward_accounting_matches_simple_stat():
+    """count / total / total_sq accumulate exactly; mean and std_dev
+    derive the sample statistics."""
+    learner = create_learner("ucb1", ACTIONS)
+    rewards = [0.5, 1.0, 0.25, 0.75]
+    for r in rewards:
+        learner.set_reward("b", r)
+    s = learner.stats["b"]
+    assert s.count == len(rewards)
+    assert s.total == sum(rewards)
+    assert s.total_sq == sum(r * r for r in rewards)
+    assert abs(s.mean - np.mean(rewards)) < 1e-12
+    assert abs(s.std_dev - np.std(rewards, ddof=1)) < 1e-12
+    assert learner.rewarded
+
+
+def test_set_reward_stats_reconstructs_mean_and_std():
+    learner = create_learner("ucb1", ACTIONS)
+    learner.set_reward_stats("a", 10, 0.6, 0.15)
+    s = learner.stats["a"]
+    assert s.count == 10
+    assert abs(s.mean - 0.6) < 1e-12
+    assert abs(s.std_dev - 0.15) < 1e-9
+
+
+def test_next_actions_honors_decision_batch_size():
+    learner = create_learner("softMax", ACTIONS,
+                             {"random.seed": 1, "decision.batch.size": 5})
+    batch = learner.next_actions()
+    assert len(batch) == 5
+    assert set(batch) <= set(ACTIONS)
+    assert learner.total_trial_count == 5

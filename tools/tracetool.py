@@ -425,6 +425,8 @@ def cmd_incident(args) -> int:
         ("registry events", ("registry.publish", "registry.pin",
                              "registry.unpin")),
         ("degradations", ("serving.degraded",)),
+        ("online learning", ("online.snapshot", "online.rollback",
+                             "online.floor_breach")),
         ("collective stalls", ("allreduce.stall",)),
     )
     for title, names in sections:
