@@ -877,11 +877,29 @@ class RetrainController:
         sha = jr["candidate_sha"]
         version = self._find_published(sha)
         if version is None:
-            version = self.registry.publish(
-                self.model_name, models, schema=self.schema,
-                params={"controller_cycle": jr.cycle,
-                        "candidate_sha": sha,
-                        "retrain_mode": jr["mode"]})
+            params = {"controller_cycle": jr.cycle,
+                      "candidate_sha": sha,
+                      "retrain_mode": jr["mode"]}
+            champion = jr["champion_version"]
+            if champion is not None:
+                # O(delta) distribution (ISSUE 20): a retrained candidate
+                # is the champion's child, so publish it WITH a delta
+                # sidecar against the champion — fleet refreshes then
+                # patch only the changed trees instead of re-shipping
+                # the forest.  publish_delta is a full publish plus a
+                # best-effort sidecar: a delta that cannot be built
+                # (kind/schema mismatch) warns and the version still
+                # commits, so this branch never loses a publish.
+                version = self.registry.publish_delta(
+                    self.model_name, models, parent_version=champion,
+                    schema=self.schema, params=params)
+                if self.registry.delta_info(self.model_name,
+                                            version) is not None:
+                    self.counters.increment("Controller", "DeltaPublished")
+            else:
+                version = self.registry.publish(
+                    self.model_name, models, schema=self.schema,
+                    params=params)
             self.counters.increment("Controller", "Published")
         else:
             # a pre-journal crash landed AFTER the commit: adopt it

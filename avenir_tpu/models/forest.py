@@ -477,17 +477,20 @@ def build_forest_from_stream(blocks, schema, params: ForestParams,
     return models
 
 
-def _ensemble_vote_body(vals, codes, lo, hi, num_r, cat_m, cat_r, cls_oh,
-                        wvec, min_odds):
-    """The fused ensemble vote: per-member first-match, weighted vote,
-    argmax + min-odds veto — all on device, one (n,) readback.  A trailing
-    always-match sentinel path per member carries its fallback class, so
-    first-match == the member's predict-with-fallback semantics.  Shared by
-    the batch predict kernel below and the serving layer's per-predictor
-    jit (serving/predictor.py hooks a trace counter around it)."""
+def _member_votes_body(vals, codes, lo, hi, num_r, cat_m, cat_r, cls_oh,
+                       wvec):
+    """The (n, K) weighted vote tally: per-member first-match, one-hot,
+    weighted sum over the member (tree) axis.  A trailing always-match
+    sentinel path per member carries its fallback class, so first-match
+    == the member's predict-with-fallback semantics.
+
+    This half of the vote is what shards over the tree axis: vote counts
+    are sums of integer-valued f32 terms (``stacked_host`` rejects
+    non-small-integer weights), so f32 addition over any tree partition
+    is exact and order-independent — per-shard partial tallies psum'd
+    across a mesh are BIT-identical to the single-device sum."""
     from .tree import _match_ok
     P = cls_oh.shape[1]
-    K = cls_oh.shape[2]
     # the per-member matcher IS tree._match_ok, vmapped over the member
     # axis — one predicate-semantics implementation for both paths
     ok = jax.vmap(
@@ -497,8 +500,15 @@ def _ensemble_vote_body(vals, codes, lo, hi, num_r, cat_m, cat_r, cls_oh,
     ok = ok.transpose(1, 0, 2)                        # (n, T, P)
     first = jnp.argmax(ok, axis=2)                    # (n, T)
     foh = jax.nn.one_hot(first, P, dtype=jnp.float32)
-    votes = jnp.einsum("ntp,tpk,t->nk", foh, cls_oh, wvec,
-                       precision=jax.lax.Precision.HIGHEST)  # (n, K)
+    return jnp.einsum("ntp,tpk,t->nk", foh, cls_oh, wvec,
+                      precision=jax.lax.Precision.HIGHEST)  # (n, K)
+
+
+def _vote_finalize(votes, min_odds):
+    """(n, K) vote tallies -> (n,) int32 vote indices: argmax + the
+    min-odds veto (index K = veto).  Runs on the COMPLETE tally — after
+    the cross-shard merge when the tree axis is sharded."""
+    K = votes.shape[1]
     best = jnp.argmax(votes, axis=1)
     top = votes.max(axis=1)
     second = jnp.where(jax.nn.one_hot(best, K, dtype=bool), -jnp.inf,
@@ -506,6 +516,21 @@ def _ensemble_vote_body(vals, codes, lo, hi, num_r, cat_m, cat_r, cls_oh,
     veto = (min_odds > 1.0) & \
         (top / jnp.maximum(second, 1e-12) <= min_odds)
     return jnp.where(veto, K, best).astype(jnp.int32)
+
+
+def _ensemble_vote_body(vals, codes, lo, hi, num_r, cat_m, cat_r, cls_oh,
+                        wvec, min_odds):
+    """The fused ensemble vote: per-member first-match, weighted vote,
+    argmax + min-odds veto — all on device, one (n,) readback.  Shared by
+    the batch predict kernel below and the serving layer's per-predictor
+    jit (serving/predictor.py hooks a trace counter around it).  The
+    body is the composition of :func:`_member_votes_body` (the tally the
+    tree-sharded serving core computes per shard) and
+    :func:`_vote_finalize` (the post-merge decision) — one vote-math
+    implementation for the single-chip, mesh-sharded, and pallas forms."""
+    return _vote_finalize(
+        _member_votes_body(vals, codes, lo, hi, num_r, cat_m, cat_r,
+                           cls_oh, wvec), min_odds)
 
 
 @functools.lru_cache(maxsize=None)
@@ -539,7 +564,8 @@ class EnsembleModel:
     def __init__(self, models: List[DecisionTreeModel],
                  weights: Optional[Sequence[float]] = None,
                  min_odds_ratio: float = 1.0,
-                 require_odd: bool = True):
+                 require_odd: bool = True,
+                 stack: bool = True):
         if require_odd and weights is None and len(models) % 2 == 0:
             raise ValueError("need odd number of models in ensemble")
         self.models = models
@@ -554,7 +580,10 @@ class EnsembleModel:
         # table for the batch path and the serving layer
         self._lut = np.concatenate([self._cls_arr.astype(object), [None]])
         self._vote_backend = "xla"
-        self._stacked = self._stack_members()
+        # stack=False skips device placement entirely: callers that only
+        # need stacked_host's layout/slices (registry delta publish) must
+        # not pay an upload or touch the runtime mesh
+        self._stacked = self._stack_members() if stack else None
 
     def stacked_host(self):
         """The HOST (numpy) form of the stacked member tensors

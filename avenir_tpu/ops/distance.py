@@ -165,6 +165,24 @@ def _topk_pallas_jit(k: int, metric: str, n_cat: float, denom: float,
 
 
 @functools.lru_cache(maxsize=None)
+def _topk_sharded_jit(k: int, metric: str, n_cat: float, denom: float,
+                      fscale: float, interpret: bool, mesh, axis_name: str):
+    """The mesh-aware pallas form (ops/pallas/topk.topk_scan_sharded):
+    the train axis shards over the mesh, each chip scans its local slice
+    with the same VMEM kernel, ONE packed all_gather + lexicographic
+    k-selection merges — bit-identical to the single-device scan.  The
+    mesh rides in the lru key (a program traced over one mesh must never
+    serve another)."""
+    from .pallas.topk import topk_scan_sharded
+
+    def kernel(tn, toh, rn, roh):
+        return topk_scan_sharded(tn, toh, rn, roh, k, metric, n_cat,
+                                 denom, fscale, mesh, axis_name,
+                                 interpret=interpret)
+    return jax.jit(kernel)
+
+
+@functools.lru_cache(maxsize=None)
 def _pair_concat_jit(n_parts: int):
     """Concatenate the per-chunk (best_d, best_i) part lists in ONE
     dispatch (two eager concatenates would be two)."""
@@ -369,16 +387,31 @@ class DistanceComputer:
         # KernelBackends group under the knn.topk site.
         from .pallas.dispatch import (note_backend, pallas_interpret,
                                       resolve_backend)
-        backend = resolve_backend(ctx.device_platform, ctx.n_devices)
+        # the pallas top-k IS mesh-aware on a single-axis single-process
+        # mesh (train axis shards, one all_gather merges), so auto no
+        # longer downgrades it there; hybrid/multi-process meshes still do
+        single_axis = isinstance(ctx.axis, str)
+        backend = resolve_backend(ctx.device_platform, ctx.n_devices,
+                                  mesh_aware=mesh_on and single_axis,
+                                  site="knn.topk")
         k_loc = min(k, n_train)
+        sharded_knn = (backend == "pallas" and ctx.n_devices > 1
+                       and mesh_on and single_axis)
         if backend == "pallas":
             rn_d, roh_d = self._train_device(
                 "pallas-flat",
                 lambda: (note_h2d(rn.nbytes + roh.nbytes, 2),
                          (jnp.asarray(rn), jnp.asarray(roh)))[1])
-            kernel = _topk_pallas_jit(k_loc, self.metric, self._n_cat,
-                                      self._denom, self._fscale,
-                                      pallas_interpret(ctx.device_platform))
+            if sharded_knn:
+                kernel = _topk_sharded_jit(
+                    k_loc, self.metric, self._n_cat, self._denom,
+                    self._fscale, pallas_interpret(ctx.device_platform),
+                    ctx.mesh, ctx.axis)
+            else:
+                kernel = _topk_pallas_jit(
+                    k_loc, self.metric, self._n_cat, self._denom,
+                    self._fscale,
+                    pallas_interpret(ctx.device_platform))
         else:
             rn_t, roh_t, base_d, nv_d = self._train_device(
                 ("tiled", train_tile, mesh_on), build_tiles)

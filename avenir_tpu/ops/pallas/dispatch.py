@@ -37,6 +37,7 @@ from __future__ import annotations
 import contextlib
 import os
 import threading
+import warnings
 from typing import Iterator, Optional
 
 BACKEND_AUTO = "auto"
@@ -90,17 +91,43 @@ def _runtime():
     return runtime_context()
 
 
+# one-time multi-chip downgrade warning (process flag, not per call site:
+# the point is a single loud line per process, the per-event record lives
+# in the ledger via note_backend)
+_warned_multichip = False
+
+
+def _reset_multichip_warning() -> None:
+    """Test helper: re-arm the one-time multi-chip downgrade warning."""
+    global _warned_multichip
+    with _lock:
+        _warned_multichip = False
+
+
 def resolve_backend(platform: Optional[str] = None,
-                    n_devices: Optional[int] = None) -> str:
+                    n_devices: Optional[int] = None,
+                    mesh_aware: bool = False,
+                    site: Optional[str] = None) -> str:
     """``"xla"`` or ``"pallas"`` for the current request + placement:
-    ``auto`` means pallas only on a SINGLE-chip TPU — everywhere else
-    the composed-op XLA path is the measured winner (off-TPU pallas
-    would run interpreted; on a multi-chip GSPMD mesh the kernels don't
-    speak shard_map yet, so XLA would gather the row axis around every
-    pallas call — TPU_NOTES §24).  An explicit ``xla``/``pallas``
-    selection is always honored.  Callers holding a MeshContext should
-    pass both ``platform`` and ``n_devices`` from it; either omitted
-    falls back to the runtime context."""
+    ``auto`` means pallas on a TPU, EXCEPT multi-chip call sites whose
+    kernel does not yet speak shard_map (``mesh_aware=False``) — there
+    XLA would gather the row axis around every pallas call, so the
+    composed-op path is the measured winner (TPU_NOTES §24).  Mesh-aware
+    call sites (``mesh_aware=True`` — the serving vote's shard-local
+    partial-tally kernel runs inside shard_map, one psum merges it) keep
+    pallas on any chip count.  Off-TPU ``auto`` is always XLA (pallas
+    would run interpreted).  An explicit ``xla``/``pallas`` selection is
+    always honored.
+
+    A forced multi-chip pallas→XLA downgrade is never silent: the first
+    one per process emits a structured ``RuntimeWarning`` and every one
+    lands in the active TransferLedger's ``KernelBackends`` group under
+    ``<site>.xla_downgrade`` (``site`` defaults to ``auto.multichip``).
+
+    Callers holding a MeshContext should pass both ``platform`` and
+    ``n_devices`` from it; either omitted falls back to the runtime
+    context."""
+    global _warned_multichip
     b = kernel_backend()
     if b == BACKEND_AUTO:
         if platform is None:
@@ -109,7 +136,23 @@ def resolve_backend(platform: Optional[str] = None,
             return BACKEND_XLA
         if n_devices is None:
             n_devices = _runtime().n_devices
-        return BACKEND_PALLAS if n_devices == 1 else BACKEND_XLA
+        if n_devices == 1 or mesh_aware:
+            return BACKEND_PALLAS
+        # multi-chip + non-mesh-aware kernel: forced downgrade, loudly
+        note_backend(site or "auto.multichip", "xla_downgrade")
+        if not _warned_multichip:
+            with _lock:
+                first = not _warned_multichip
+                _warned_multichip = True
+            if first:
+                warnings.warn(
+                    f"kernel.backend=auto downgraded pallas->xla at "
+                    f"site={site or 'auto.multichip'!s}: {n_devices} "
+                    f"devices and the kernel is not mesh-aware "
+                    f"(TPU_NOTES §24/§32); set kernel.backend=pallas to "
+                    f"force, or use a mesh-aware call site",
+                    RuntimeWarning, stacklevel=2)
+        return BACKEND_XLA
     return b
 
 

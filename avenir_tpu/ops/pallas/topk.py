@@ -123,3 +123,69 @@ def topk_scan(tn, toh, rn, roh, k: int, metric: str, n_cat: float,
         interpret=interpret,
     )(tn, toh, rn, roh)
     return od[:nt], oi[:nt]
+
+
+def topk_scan_sharded(tn, toh, rn, roh, k: int, metric: str, n_cat: float,
+                      denom: float, fscale: float, mesh, axis_name: str,
+                      interpret: bool = True):
+    """Mesh-aware ``topk_scan``: the TRAIN axis shards over ``mesh``'s
+    ``axis_name``, each shard runs the pallas scan over its local train
+    slice, and ONE all_gather of the (nt, 2k)-packed per-shard best
+    lists feeds a final lexicographic k-selection on every shard.
+
+    Exact, not approximate: every global top-k pair is in its own
+    shard's top-k (distances are per-pair), so the union of per-shard
+    best lists contains the global answer, and the merge reproduces the
+    XLA contract — k smallest (d, global-i), ascending, ties to the
+    lowest train index (local ties resolve low inside each shard and the
+    offsets keep that order globally).  Bit-identical to the
+    single-device scan; pinned in interpret mode by
+    tests/test_pallas_kernels.py.  The (d, i) pair lists ride one
+    collective via an int32<->f32 bitcast pack."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+    n_train = rn.shape[0]
+    S = mesh.shape[axis_name]
+    k = int(k)
+    pad = (-n_train) % S
+    if pad:
+        rn = jnp.pad(rn, ((0, pad), (0, 0)))
+        roh = jnp.pad(roh, ((0, pad), (0, 0)))
+    local_n = (n_train + pad) // S
+
+    def body(tn_l, toh_l, rn_l, roh_l):
+        bd, bi = topk_scan(tn_l, toh_l, rn_l, roh_l, k, metric, n_cat,
+                           denom, fscale, interpret=interpret)
+        off = jax.lax.axis_index(axis_name) * np.int32(local_n)
+        gi = bi + off
+        # shard-pad train rows / unfilled local slots must never win
+        dead = (bi < 0) | (gi >= n_train)
+        bd = jnp.where(dead, jnp.inf, bd)
+        gi = jnp.where(dead, _INT_MAX, gi)
+        nt_l = bd.shape[0]
+        packed = jnp.concatenate(
+            [bd, jax.lax.bitcast_convert_type(gi, jnp.float32)], axis=1)
+        g = jax.lax.all_gather(packed, axis_name, axis=1, tiled=True)
+        g = g.reshape(nt_l, S, 2 * k)
+        cand_d = g[:, :, :k].reshape(nt_l, S * k)
+        cand_i = jax.lax.bitcast_convert_type(
+            g[:, :, k:], jnp.int32).reshape(nt_l, S * k)
+        # same k-step lexicographic selection as the kernel's tile merge
+        nd, ni = [], []
+        for _ in range(k):
+            m = jnp.min(cand_d, axis=1)
+            sel = jnp.min(jnp.where(cand_d == m[:, None], cand_i,
+                                    _INT_MAX), axis=1)
+            nd.append(m)
+            ni.append(sel)
+            hit = (cand_d == m[:, None]) & (cand_i == sel[:, None])
+            cand_d = jnp.where(hit, jnp.inf, cand_d)
+        bd_out = jnp.stack(nd, axis=1)
+        bi_out = jnp.stack(ni, axis=1)
+        # unfilled slots (k > n_train) decode back to the -1 contract
+        return bd_out, jnp.where(jnp.isinf(bd_out), -1, bi_out)
+
+    sh = shard_map(body, mesh=mesh, check_rep=False,
+                   in_specs=(P(), P(), P(axis_name), P(axis_name)),
+                   out_specs=(P(), P()))
+    return sh(tn, toh, rn, roh)

@@ -70,6 +70,52 @@ def _tiled_vote(body, vals, codes, consts, min_odds, interpret: bool):
     return out[:n, 0]
 
 
+def ensemble_partial_votes(vals, codes, lo, hi, num_r, cat_m, cat_r, cls_oh,
+                           wvec, interpret: bool = True):
+    """(n, K) f32 vote tallies — the pallas twin of
+    ``models.forest._member_votes_body`` (same body, tiled).
+
+    This is the mesh-aware serving form: each shard of a tree-sharded
+    mesh runs it over its local member slice, and ONE psum of the (n, K)
+    tallies merges the shards.  Tallies are sums of integer-valued f32
+    terms (``stacked_host`` rejects anything else), so the partial-sum +
+    psum composition is bit-identical to the single-device vote; the
+    min-odds finalize runs post-merge (``_vote_finalize``) outside the
+    kernel."""
+    from ...models.forest import _member_votes_body
+    n = vals.shape[0]
+    K = cls_oh.shape[2]
+    if n == 0:
+        return jnp.zeros((0, K), jnp.float32)
+    consts = (lo, hi, num_r, cat_m, cat_r, cls_oh, wvec)
+    tm = min(ROW_TILE, max(8, ((n + 7) // 8) * 8))
+    pad = (-n) % tm
+    if pad:
+        vals = jnp.concatenate(
+            [vals, jnp.broadcast_to(vals[-1:], (pad,) + vals.shape[1:])])
+        codes = jnp.concatenate(
+            [codes, jnp.broadcast_to(codes[-1:], (pad,) + codes.shape[1:])])
+    grid = (vals.shape[0] // tm,)
+
+    def kernel(v_ref, c_ref, *refs):
+        out_ref = refs[-1]
+        cref = refs[:-1]
+        out_ref[...] = _member_votes_body(v_ref[...], c_ref[...],
+                                          *[r[...] for r in cref])
+
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[pl.BlockSpec((tm, vals.shape[1]), lambda i: (i, 0)),
+                  pl.BlockSpec((tm, codes.shape[1]), lambda i: (i, 0))]
+        + [_full_spec(c.shape) for c in consts],
+        out_specs=pl.BlockSpec((tm, K), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((vals.shape[0], K), jnp.float32),
+        interpret=interpret,
+    )(vals, codes, *consts)
+    return out[:n]
+
+
 def ensemble_vote(vals, codes, lo, hi, num_r, cat_m, cat_r, cls_oh, wvec,
                   min_odds, interpret: bool = True):
     """(n,) int32 vote indices — the pallas twin of

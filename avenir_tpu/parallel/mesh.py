@@ -26,6 +26,9 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
+# model-parallel serving axis: the ensemble vote shards its MEMBER (tree)
+# dimension over this axis (serving/predictor.py), not the row dimension
+TREE_AXIS = "tree"
 
 
 def make_mesh(n_devices: Optional[int] = None,
@@ -36,6 +39,25 @@ def make_mesh(n_devices: Optional[int] = None,
     if n_devices is not None:
         devs = devs[:n_devices]
     return Mesh(np.array(devs), (axis_name,))
+
+
+def tree_mesh(n_shards: Optional[int] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """1-D ``tree``-axis mesh for model-parallel serving: the stacked
+    member tensors shard over it (one tree slice per chip), rows and the
+    merged (n, K) tally replicate.  Distinct axis name ON PURPOSE — a
+    serving core traced over this mesh can never silently reuse a
+    ``data``-axis program (mesh_fingerprint keys the caches)."""
+    return make_mesh(n_devices=n_shards, axis_name=TREE_AXIS,
+                     devices=devices)
+
+
+def worker_device(index: int, devices: Optional[Sequence] = None):
+    """Round-robin device for fleet worker ``index`` — the placement map
+    that stops every worker of a one-host fleet binding chip 0
+    (serving/fleet.py ``device_map="round_robin"``)."""
+    devs = list(devices if devices is not None else jax.devices())
+    return devs[index % len(devs)]
 
 
 _default_mesh: Optional[Mesh] = None

@@ -122,6 +122,7 @@ class ServingFleet:
                  models: Optional[Sequence] = None,
                  model_depths: Optional[Dict[str, int]] = None,
                  shared_cores: bool = True,
+                 device_map: Optional[str] = None,
                  reward_sink=None):
         # multi-model residency (ISSUE 18): models= lists the resident
         # set ("name" or "name:version" specs); every worker then runs a
@@ -172,6 +173,25 @@ class ServingFleet:
         if reward_sink is not None and models:
             raise ValueError("reward_sink= does not combine with models=")
         self._reward_sink = reward_sink
+        # device placement map (ISSUE 20): by default every worker's
+        # registry-built predictor binds the default device (chip 0 on a
+        # multi-chip host).  "round_robin" spreads workers over the
+        # host's chips (worker i -> device i % n, parallel.mesh.
+        # worker_device); "sharded" gives every worker a tree-axis
+        # mesh-sharded core over ALL chips (model-parallel: forests too
+        # big for one chip's HBM) — with shared_cores the N workers
+        # share ONE compiled sharded program and ONE set of resident
+        # shards.  Registry-built predictors only: a predictor_factory
+        # owns its own placement.
+        if device_map not in (None, "round_robin", "sharded"):
+            raise ValueError(
+                "device_map must be None, 'round_robin' or 'sharded', "
+                f"got {device_map!r}")
+        if device_map is not None and predictor_factory is not None:
+            raise ValueError(
+                "device_map= does not combine with predictor_factory= "
+                "(the factory owns placement)")
+        self.device_map = device_map
         self._latency_window = int(latency_window)
         self.idle_sleep_s = float(idle_sleep_s)
         self.max_idle_sleep_s = float(max_idle_sleep_s)
@@ -214,7 +234,18 @@ class ServingFleet:
         self.workers: List[_Worker] = []
 
     # ---- lifecycle ----
-    def _make_service(self, wname: str):
+    def _placement(self, index: int) -> Dict:
+        """device=/serve_mesh= kwargs for worker ``index`` under the
+        fleet's device_map (empty dict = the old default placement)."""
+        if self.device_map == "round_robin":
+            from ..parallel.mesh import worker_device
+            return {"device": worker_device(index)}
+        if self.device_map == "sharded":
+            return {"serve_mesh": True}
+        return {}
+
+    def _make_service(self, wname: str, index: int = 0):
+        placement = self._placement(index)
         if self.models_spec:
             # one router per worker: N resident models, each with its
             # own warm predictor cache, sharing compiled executables
@@ -232,7 +263,8 @@ class ServingFleet:
                                latency_window=self._latency_window,
                                quantized=self._quantized,
                                wire_native=self._wire_native,
-                               shared_cores=self._shared_cores)
+                               shared_cores=self._shared_cores,
+                               **placement)
         common = dict(policy=self.policy, warm=self._warm,
                       delim=self.delim, name=wname,
                       host_label=self.host_label,
@@ -244,11 +276,18 @@ class ServingFleet:
                       reward_sink=self._reward_sink)
         if self.predictor_factory is not None:
             return PredictionService(self.predictor_factory(), **common)
+        if self.device_map == "sharded":
+            # N workers over ONE tree-sharded model: the sharded vote
+            # program is identical across workers (weights are runtime
+            # args), so share the compiled executable instead of
+            # compiling it once per worker
+            common["shared_cores"] = True
         return PredictionService(registry=self.registry,
                                  model_name=self.model_name,
                                  schema=self._schema,
                                  buckets=self._buckets,
-                                 quantized=self._quantized, **common)
+                                 quantized=self._quantized,
+                                 **placement, **common)
 
     def _make_client(self, counters=None):
         from ..io.respq import make_queue_client
@@ -267,7 +306,7 @@ class ServingFleet:
         base = self.model_name or "fleet"
         for i in range(self.n_workers):
             wname = f"{base}-w{i}"
-            w = _Worker(i, wname, self._make_service(wname))
+            w = _Worker(i, wname, self._make_service(wname, i))
             w.service.start()
             w.client = self._make_client(w.service.counters)
             self.workers.append(w)
@@ -284,7 +323,7 @@ class ServingFleet:
     def _add_worker_locked(self) -> "_Worker":
         i = len(self.workers)
         wname = f"{self.model_name or 'fleet'}-w{i}"
-        w = _Worker(i, wname, self._make_service(wname))
+        w = _Worker(i, wname, self._make_service(wname, i))
         w.service.start()
         w.client = self._make_client(w.service.counters)
         self.workers.append(w)

@@ -77,6 +77,15 @@ def _array_fp(arrays) -> tuple:
                  for a in arrays)
 
 
+@jax.jit
+def _delta_patch_jit(cur, upd, idx):
+    """Functional scatter of a delta slice into a resident stacked
+    tensor: NO donation on purpose — serving threads snapshot the
+    predictor outside the swap lock, so the old buffer must stay valid
+    until the argument-tuple swap completes (apply_delta)."""
+    return cur.at[idx].set(upd)
+
+
 class Predictor:
     """Base: tokenized-row requests -> class-label strings, bucketed."""
 
@@ -205,21 +214,46 @@ class ForestPredictor(Predictor):
     responses are exactly what the offline ModelPredictor job would emit
     for the same records — the only difference is who owns the compile
     cache.  ``None`` (min-odds veto) maps to ``ambiguous_label`` by the
-    service layer."""
+    service layer.
+
+    Placement (TPU_NOTES §32): by default the core binds the runtime
+    default device.  ``device=`` pins this predictor's stacked tensors
+    (and each request batch) to one specific chip — the fleet's
+    round-robin worker map.  ``serve_mesh=`` shards the stacked member
+    tensors over the TREE axis of a multi-chip mesh instead (forests too
+    big for one chip's HBM): each chip computes its local members'
+    partial (n, K) vote tally and ONE psum merges them — bit-identical
+    to the single-chip vote because tallies are sums of integer-valued
+    f32 terms.  In every placement the member tensors travel as runtime
+    ARGUMENTS (``self._extra``), never closed-over constants, so (a) the
+    PR 18 shared-core keys still hold and (b) ``apply_delta`` can patch
+    changed trees in place and swap the argument tuple atomically
+    without touching the compiled program."""
 
     kind = FOREST
 
     def __init__(self, path_lists, schema: FeatureSchema,
                  weights: Optional[Sequence[float]] = None,
-                 min_odds_ratio: float = 1.0, quantized=None, **kw):
+                 min_odds_ratio: float = 1.0, quantized=None,
+                 serve_mesh=None, device=None,
+                 tree_shas: Optional[Sequence[str]] = None, **kw):
         super().__init__(schema, **kw)
-        from ..models.forest import EnsembleModel, _ensemble_vote_body
+        from ..models.forest import EnsembleModel
         from ..models.tree import DecisionTreeModel
-        from ..ops.pallas.dispatch import pallas_interpret, resolve_backend
+        if serve_mesh is not None and device is not None:
+            raise ValueError("serve_mesh and device are mutually "
+                             "exclusive placements")
         self.models = [DecisionTreeModel(pl, schema) for pl in path_lists]
         self.single = len(self.models) == 1
         self.quantized = None
         self._core_q = None
+        self._extra = None
+        self._jitted = None
+        self._device = device
+        self._serve_mesh = None
+        self._min_odds = float(min_odds_ratio)
+        self._vote_backend = "xla"
+        self.tree_shas = list(tree_shas) if tree_shas else None
         if self.single:
             if quantized is not None:
                 import warnings
@@ -230,43 +264,24 @@ class ForestPredictor(Predictor):
             self.ensemble = None
             self._core = None
             return
+        mesh = self._resolve_serve_mesh(serve_mesh)
         self.ensemble = EnsembleModel(self.models, weights=weights,
                                       min_odds_ratio=min_odds_ratio,
-                                      require_odd=False)
-        self._vote_backend = resolve_backend()
-        if self.ensemble._stacked is not None:
-            *consts, wvec, _kernel = self.ensemble._stacked
-            min_odds = jnp.float32(min_odds_ratio)
-            if self._vote_backend == "pallas":
-                import functools as _ft
-                from ..ops.pallas.vote import ensemble_vote
-                body = _ft.partial(ensemble_vote,
-                                   interpret=pallas_interpret())
-            else:
-                body = _ensemble_vote_body
-
-            if self.shared_cores:
-                # weights as call args, keyed on the ProgramCache axes:
-                # a co-resident model with the same variant/schema/
-                # buckets/mesh/shape structure reuses this executable
-                extra = (*consts, wvec, min_odds)
-                key = _shared_core_key(
-                    ("forest", self._vote_backend), self.schema,
-                    self.buckets, _array_fp(extra))
-
-                def build():
-                    def core(vals, codes, *cs):
-                        self._note_trace()
-                        return body(vals, codes, *cs)
-                    return jax.jit(core)
-                jitted = _shared_core(key, build)
-                self._core = lambda vals, codes: \
-                    jitted(vals, codes, *extra)
-            else:
-                def core(vals, codes):
-                    self._note_trace()
-                    return body(vals, codes, *consts, wvec, min_odds)
-                self._core = jax.jit(core)
+                                      require_odd=False,
+                                      stack=mesh is None)
+        if mesh is not None and self.ensemble.stacked_host() is None:
+            import warnings
+            warnings.warn(
+                "serve_mesh: ensemble has no stacked device form "
+                "(degenerate member or non-f32-exact bounds); serving "
+                "the host vote path single-chip", RuntimeWarning)
+            mesh = None
+            self.ensemble._stacked = self.ensemble._stack_members()
+        self._serve_mesh = mesh
+        if mesh is not None:
+            self._build_sharded_core(mesh)
+        elif self.ensemble._stacked is not None:
+            self._build_core()
         else:
             # degenerate member / non-f32-exact bounds: the host vote path
             # is exact and compile-free, so bucketing is moot
@@ -293,6 +308,266 @@ class ForestPredictor(Predictor):
                     self._note_trace()
                     return vote(qv, qc)
                 self._core_q = jax.jit(core_q)
+
+    @staticmethod
+    def _resolve_serve_mesh(serve_mesh):
+        """``serve_mesh`` -> a 1-axis Mesh (or None for single-chip):
+        ``True`` = a tree-axis mesh over all devices, an int = over the
+        first n, a Mesh = as given.  A 1-device result degrades to the
+        plain single-chip core (nothing to shard)."""
+        if serve_mesh is None or serve_mesh is False:
+            return None
+        from jax.sharding import Mesh
+        from ..parallel.mesh import tree_mesh
+        if isinstance(serve_mesh, Mesh):
+            mesh = serve_mesh
+        elif serve_mesh is True:
+            mesh = tree_mesh()
+        else:
+            mesh = tree_mesh(int(serve_mesh))
+        if len(mesh.axis_names) != 1:
+            raise ValueError(
+                f"serve_mesh must be a 1-axis mesh, got axes "
+                f"{mesh.axis_names}")
+        return mesh if mesh.devices.size > 1 else None
+
+    def _build_core(self):
+        """The single-device core (optionally pinned to ``device=``):
+        member tensors as runtime args (``self._extra``), vote body
+        dispatched xla/pallas exactly as before."""
+        from ..models.forest import _ensemble_vote_body
+        from ..ops.pallas.dispatch import pallas_interpret, resolve_backend
+        *consts, wvec, _kernel = self.ensemble._stacked
+        min_odds = jnp.float32(self._min_odds)
+        self._vote_backend = resolve_backend()
+        if self._vote_backend == "pallas":
+            import functools as _ft
+            from ..ops.pallas.vote import ensemble_vote
+            body = _ft.partial(ensemble_vote, interpret=pallas_interpret())
+        else:
+            body = _ensemble_vote_body
+        if self._device is not None:
+            consts = [jax.device_put(c, self._device) for c in consts]
+            wvec = jax.device_put(wvec, self._device)
+        self._extra = (*consts, wvec, min_odds)
+        variant = ("forest", self._vote_backend) \
+            if self._device is None \
+            else ("forest", self._vote_backend, self._device.id)
+
+        def build():
+            def core(vals, codes, *cs):
+                self._note_trace()
+                return body(vals, codes, *cs)
+            return jax.jit(core)
+        if self.shared_cores:
+            # weights as call args, keyed on the ProgramCache axes: a
+            # co-resident model with the same variant/schema/buckets/
+            # mesh/shape structure reuses this executable
+            key = _shared_core_key(variant, self.schema, self.buckets,
+                                   _array_fp(self._extra))
+            self._jitted = _shared_core(key, build)
+        else:
+            self._jitted = build()
+        dev = self._device
+        if dev is None:
+            self._core = lambda vals, codes: \
+                self._jitted(vals, codes, *self._extra)
+        else:
+            # request batches follow the model's chip (D2D re-place when
+            # the feature cache staged them on the default device)
+            self._core = lambda vals, codes: \
+                self._jitted(jax.device_put(vals, dev),
+                             jax.device_put(codes, dev), *self._extra)
+
+    def _build_sharded_core(self, mesh):
+        """The mesh-sharded core: member tensors shard over the tree
+        axis (leading T dim, padded to the shard count with zero-weight
+        never-match members), rows/tally replicate.  Each shard computes
+        its local (n, K) partial tally — pallas kernel or XLA body, both
+        mesh-aware — and ONE ``psum`` merges; the min-odds finalize runs
+        on the complete tally.  Bit-identical to the single-chip vote
+        (integer-exact f32 sums commute with the shard partition)."""
+        import functools as _ft
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..models.forest import _member_votes_body, _vote_finalize
+        from ..ops.pallas.dispatch import pallas_interpret, resolve_backend
+        from ..parallel.mesh import runtime_context
+        ax = mesh.axis_names[0]
+        S = int(mesh.devices.size)
+        lo, hi, num_r, cat_m, cat_r, cls_oh = self.ensemble.stacked_host()
+        wv = np.asarray(self.ensemble.weights, np.float32)
+        T = lo.shape[0]
+        padT = (-T) % S
+        if padT:
+            # zero-weight never-match pad members: every predicate row
+            # rejects (lo=+inf restricted), the class one-hot is zero and
+            # the weight is zero — three independent reasons the pad
+            # shard slots contribute exactly 0.0 to the psum'd tally
+            def padm(a, fill):
+                return np.concatenate(
+                    [a, np.full((padT,) + a.shape[1:], fill, a.dtype)])
+            lo = padm(lo, np.inf)
+            hi = padm(hi, -np.inf)
+            num_r = padm(num_r, True)
+            cat_m = padm(cat_m, False)
+            cat_r = padm(cat_r, False)
+            cls_oh = padm(cls_oh, 0.0)
+            wv = padm(wv, 0.0)
+        shard = NamedSharding(mesh, P(ax))
+        repl = NamedSharding(mesh, P())
+        consts = [jax.device_put(a, shard)
+                  for a in (lo, hi, num_r, cat_m, cat_r, cls_oh)]
+        wvec = jax.device_put(wv, shard)
+        min_odds = jax.device_put(np.float32(self._min_odds), repl)
+        platform = runtime_context().device_platform
+        self._vote_backend = resolve_backend(platform, S, mesh_aware=True,
+                                             site="serve.predict")
+        if self._vote_backend == "pallas":
+            from ..ops.pallas.vote import ensemble_partial_votes
+            partial_body = _ft.partial(ensemble_partial_votes,
+                                       interpret=pallas_interpret(platform))
+        else:
+            partial_body = _member_votes_body
+
+        def shard_body(vals, codes, lo, hi, num_r, cat_m, cat_r, cls_oh,
+                       wvec, min_odds):
+            part = partial_body(vals, codes, lo, hi, num_r, cat_m, cat_r,
+                                cls_oh, wvec)
+            votes = jax.lax.psum(part, ax)   # THE one cross-shard merge
+            return _vote_finalize(votes, min_odds)
+        # check_rep=False: pallas_call has no replication rule, and the
+        # out spec is genuinely replicated only after the psum anyway
+        sharded = shard_map(shard_body, mesh=mesh, check_rep=False,
+                            in_specs=(P(), P()) + (P(ax),) * 7 + (P(),),
+                            out_specs=P())
+        self._extra = (*consts, wvec, min_odds)
+
+        def build():
+            def core(vals, codes, *cs):
+                self._note_trace()
+                return sharded(vals, codes, *cs)
+            return jax.jit(core)
+        if self.shared_cores:
+            # the serve mesh is NOT the runtime mesh the shared-core key
+            # fingerprints, so its device set rides in the variant
+            dev_ids = tuple(int(d.id) for d in mesh.devices.flat)
+            key = _shared_core_key(
+                ("forest-sharded", self._vote_backend, S, dev_ids),
+                self.schema, self.buckets, _array_fp(self._extra))
+            self._jitted = _shared_core(key, build)
+        else:
+            self._jitted = build()
+        self._core = lambda vals, codes: \
+            self._jitted(vals, codes, *self._extra)
+        # the batch path (ensemble.predict) and the device gate see the
+        # SAME resident tensors: pad members vote zero, so the padded
+        # stacked form is vote-identical to the unpadded one
+        from ..models.forest import _jitted_ensemble_vote_kernel
+        Tp, P_, F = lo.shape
+        cmax, K = cat_m.shape[3], cls_oh.shape[2]
+        self.ensemble._vote_backend = "xla"
+        self.ensemble._stacked = tuple(consts) + (
+            wvec, _jitted_ensemble_vote_kernel(Tp, P_, F, cmax, K, "xla",
+                                               False))
+
+    # ---- O(delta) hot patch (ISSUE 20) ----
+    def apply_delta(self, dmeta: Dict[str, Any], arrays) -> int:
+        """Patch ONLY the changed trees of the resident model in place:
+        upload each delta slice, scatter it into a fresh functional copy
+        of the stacked tensors, and swap the core's argument tuple
+        atomically at the end — the compiled program is untouched (same
+        shapes, so zero recompiles) and a concurrently-dispatching
+        request thread keeps a fully valid tuple at every instant (no
+        donation: serving snapshots the predictor OUTSIDE the swap lock,
+        so donating a resident buffer could invalidate an in-flight
+        batch — TPU_NOTES §32).  Raises on ANY mismatch — parent sha
+        chain, class vocabulary, slice layout — so the caller falls back
+        to a full-artifact load: never wrong weights.  Returns the H2D
+        bytes moved (∝ changed trees; also recorded to the active
+        TransferLedger)."""
+        import json as _json
+        from ..core.faults import fault_point
+        from ..models.tree import DecisionPathList, DecisionTreeModel
+        from ..utils.tracing import note_h2d
+        if self.single or self.ensemble is None:
+            raise ValueError("delta patch: single-tree predictors reload "
+                             "in full")
+        if self._core is None or self._extra is None:
+            raise ValueError("delta patch needs the stacked device vote "
+                             "path (host-path ensembles reload in full)")
+        if self._core_q is not None:
+            raise ValueError("delta patch: quantized serving rebuilds its "
+                             "int8 sidecar per version; reload in full")
+        parent = list(dmeta.get("parent_tree_shas") or [])
+        if not self.tree_shas or parent != list(self.tree_shas):
+            raise ValueError("delta patch: parent sha chain does not "
+                             "match the resident model")
+        if list(dmeta.get("classes") or []) != list(self.ensemble.classes):
+            raise ValueError("delta patch: class vocabulary mismatch")
+        idx = np.asarray(arrays["idx"], np.int32)
+        T = len(self.models)
+        if idx.size and (idx.min() < 0 or idx.max() >= T):
+            raise ValueError("delta patch: changed-tree index out of "
+                             "range")
+        *consts, wvec, min_odds = self._extra
+        names = ("lo", "hi", "num_r", "cat_m", "cat_r", "cls_oh")
+        for name, cur in zip(names, consts):
+            upd = np.asarray(arrays[name])
+            if upd.shape[1:] != tuple(cur.shape[1:]) or \
+                    upd.shape[0] != idx.size or \
+                    np.dtype(upd.dtype) != np.dtype(cur.dtype):
+                raise ValueError(
+                    f"delta patch: slice {name} layout "
+                    f"{upd.shape}/{upd.dtype} does not match resident "
+                    f"{cur.shape}/{cur.dtype}")
+        new_wv = np.asarray(arrays["wvec"], np.float32)
+        if new_wv.shape != (T,):
+            raise ValueError("delta patch: wvec shape mismatch")
+        changed_trees = dmeta.get("changed_trees") or []
+        if len(changed_trees) != idx.size:
+            raise ValueError("delta patch: changed_trees does not match "
+                             "the index list")
+        moved = 0
+        idx_dev = jnp.asarray(idx)
+        moved += idx.nbytes
+        note_h2d(idx.nbytes)
+        new_consts = []
+        for name, cur in zip(names, consts):
+            # a kill anywhere in this loop leaves self._extra untouched
+            # (old tuple fully valid) — the torn-delta full-load fallback
+            fault_point("swap_patch")
+            upd = np.asarray(arrays[name])
+            note_h2d(upd.nbytes)
+            moved += upd.nbytes
+            new = _delta_patch_jit(cur, jnp.asarray(upd), idx_dev)
+            new_consts.append(jax.device_put(new, cur.sharding))
+        fault_point("swap_patch")
+        # wvec ships whole — (T,) f32 is noise next to any slice — padded
+        # back out to the resident (sharded) length
+        Tp = int(wvec.shape[0])
+        wv_padded = new_wv if Tp == T else \
+            np.concatenate([new_wv, np.zeros(Tp - T, np.float32)])
+        note_h2d(wv_padded.nbytes)
+        moved += wv_padded.nbytes
+        new_wvec = jax.device_put(jnp.asarray(wv_padded), wvec.sharding)
+        # host-side twins: the changed members' DecisionTreeModels (the
+        # host fallback path and _lut stay coherent with the device form)
+        new_models = {
+            int(i): DecisionTreeModel(
+                DecisionPathList.from_json(_json.dumps(tj)), self.schema)
+            for i, tj in zip(idx, changed_trees)}
+        for i, m in new_models.items():
+            self.models[i] = m       # self.models IS ensemble.models
+        self.ensemble.weights = [float(w) for w in new_wv]
+        # atomic swap: one tuple assignment, old arrays stay alive for
+        # any in-flight batch that already snapshotted them
+        self._extra = (*new_consts, new_wvec, min_odds)
+        if self.ensemble._stacked is not None:
+            kernel = self.ensemble._stacked[-1]
+            self.ensemble._stacked = tuple(new_consts) + (new_wvec, kernel)
+        self.tree_shas = list(dmeta["tree_shas"])
+        return moved
 
     def dispatch_prepared(self, prepared):
         """The ASYNC half of predict_prepared: run the host prep and
@@ -332,6 +607,13 @@ class ForestPredictor(Predictor):
                 if dev is not None:
                     note_dispatch(site="serve.predict")
                     note_backend("serve.predict", self._vote_backend)
+                    if self._serve_mesh is not None:
+                        # the sharded core's single psum per batch —
+                        # ledger-pinned as exactly ONE merge dispatch
+                        from ..telemetry import instant
+                        note_dispatch(site="serve.shard_merge")
+                        instant("serve.shard_merge", cat="serving",
+                                shards=int(self._serve_mesh.devices.size))
                     staged.append((True, self._core(*dev), n))
                     continue
                 staged.append(
@@ -518,6 +800,7 @@ def make_predictor(loaded: LoadedModel,
                    schema: Optional[FeatureSchema] = None,
                    buckets: Sequence[int] = DEFAULT_BUCKETS,
                    delim: str = ",", quantized: bool = False,
+                   serve_mesh=None, device=None,
                    **kw) -> Predictor:
     """Registry artifact -> the right Predictor (kind-dispatched), using
     the artifact's embedded schema unless one is passed explicitly.
@@ -525,7 +808,12 @@ def make_predictor(loaded: LoadedModel,
     ``quantized=True`` (forest only — the ``ps.quantized`` knob) loads
     the version's int8 sidecar (serving/quantized.py) and serves the
     budget-pinned quantized vote; a version without an intact sidecar
-    warns and serves the float model — never refuses traffic."""
+    warns and serves the float model — never refuses traffic.
+
+    ``serve_mesh``/``device`` (forest only) select the multi-chip
+    placement — tree-axis model-parallel core or a per-worker chip pin
+    (see ForestPredictor); other kinds warn and serve on the default
+    device."""
     schema = schema or loaded.schema
     if schema is None:
         raise ValueError(
@@ -538,6 +826,13 @@ def make_predictor(loaded: LoadedModel,
             f"ps.quantized: only forest artifacts have a quantized "
             f"serving path (got kind {loaded.kind!r}); serving the "
             f"float model", RuntimeWarning)
+    if (serve_mesh is not None or device is not None) \
+            and loaded.kind != FOREST:
+        import warnings
+        warnings.warn(
+            f"serve_mesh/device placement applies to forest serving "
+            f"only (got kind {loaded.kind!r}); serving on the default "
+            f"device", RuntimeWarning)
     if loaded.kind == FOREST:
         p = loaded.params
         qf = None
@@ -558,6 +853,8 @@ def make_predictor(loaded: LoadedModel,
             weights=p.get("weights"),
             min_odds_ratio=float(p.get("min_odds_ratio", 1.0)),
             quantized=qf,
+            serve_mesh=serve_mesh, device=device,
+            tree_shas=loaded.meta.get("tree_shas"),
             **common, **kw)
     if loaded.kind == BAYES:
         return BayesPredictor(loaded.model, schema, **common, **kw)

@@ -46,6 +46,13 @@ KINDS = (FOREST, BAYES, LOGISTIC, MLP)
 
 META_FILE = "meta.json"
 ARRAYS_FILE = "arrays.npz"
+# O(delta) distribution sidecars (ISSUE 20): a forest version published
+# via publish_delta carries the changed-tree slices + the parent
+# version's per-tree sha chain, so a serving tier resident on the parent
+# patches only what changed instead of re-uploading the whole model
+DELTA_JSON = "delta.json"
+DELTA_NPZ = "delta.npz"
+DELTA_FORMAT_VERSION = 1
 # serving pin: <base>/<name>/serving.json selects the version the serving
 # tier resolves (rollback surface); absent = newest intact, the historic
 # behavior.  Written tmp-then-rename like every other registry artifact.
@@ -87,6 +94,50 @@ class LoadedModel:
 # --------------------------------------------------------------------------
 # kind-specific encode/decode
 # --------------------------------------------------------------------------
+
+def _tree_shas(trees_json: List[Any]) -> List[str]:
+    """Per-tree content shas over the canonical (sorted-key, no-space)
+    JSON form — THE identity the delta chain is keyed on: a delta's
+    recorded parent shas must match the resident model's tree-for-tree
+    before any patch applies (never wrong weights)."""
+    import hashlib
+    return [hashlib.sha256(
+        json.dumps(t, sort_keys=True,
+                   separators=(",", ":")).encode()).hexdigest()
+        for t in trees_json]
+
+
+def _pad_stacked_to(c_host, p_host):
+    """Re-pad a child forest's stacked host tensors into the parent's
+    ``(P, cmax)`` layout so delta slices align with a parent-layout
+    resident.  Raises when the child cannot fit — a changed tree with
+    more paths (or wider categorical sets) than the parent layout holds
+    has no O(delta) form; refresh full-loads instead."""
+    lo, hi, num_r, cat_m, cat_r, cls_oh = c_host
+    T, Pc, F = lo.shape
+    cmax_c, Kc = cat_m.shape[3], cls_oh.shape[2]
+    P, Fp = p_host[0].shape[1], p_host[0].shape[2]
+    cmax, K = p_host[3].shape[3], p_host[5].shape[2]
+    if F != Fp or Kc != K:
+        raise ValueError("feature/class axis changed; patch slices "
+                         "would not align")
+    if Pc > P or cmax_c > cmax:
+        raise ValueError(
+            f"child outgrows the parent stacked layout "
+            f"(P {Pc}>{P} or cmax {cmax_c}>{cmax}); no O(delta) form")
+    # identical fill pattern to EnsembleModel.stacked_host's pad rows:
+    # never-match bounds, unrestricted categoricals, vote-nothing one-hot
+    nlo = np.full((T, P, F), np.inf, np.float32)
+    nhi = np.full((T, P, F), -np.inf, np.float32)
+    nnum = np.ones((T, P, F), dtype=bool)
+    ncm = np.zeros((T, P, F, cmax), dtype=bool)
+    ncr = np.zeros((T, P, F), dtype=bool)
+    ncls = np.zeros((T, P, K), np.float32)
+    nlo[:, :Pc], nhi[:, :Pc], nnum[:, :Pc] = lo, hi, num_r
+    ncm[:, :Pc, :, :cmax_c] = cat_m
+    ncr[:, :Pc], ncls[:, :Pc] = cat_r, cls_oh
+    return nlo, nhi, nnum, ncm, ncr, ncls
+
 
 def _detect_kind(model: Any) -> str:
     from ..models.bayes import NaiveBayesModel
@@ -349,6 +400,29 @@ class ModelRegistry:
                           self.serving_version(name)):
             if protected is not None:
                 keep.add(protected)
+        # the ACTIVE delta window stays intact: a version a consumer can
+        # be told to load next (latest / pinned / serving) may carry a
+        # delta sidecar, and fleets resident on its parent are the ones
+        # mid-O(delta)-swap right now — retiring that parent would orphan
+        # the sidecar of the very version being distributed (registrytool
+        # verify flags exactly that).  Only the DIRECT parent matters: a
+        # grandparent's residents fail the sha-chain gate and full-load
+        # anyway, and every delta child owns full artifacts, so historic
+        # chains never pin the registry open (the controller's cadenced
+        # retire_keep_last must stay bounded even when every publish is
+        # incremental).
+        all_v = set(versions)
+        loadable = {v for v in (versions[-1] if versions else None,
+                                self.pinned_version(name),
+                                self.serving_version(name))
+                    if v is not None}
+        for v in loadable:
+            info = self.delta_info(name, v)
+            if not info:
+                continue
+            p = int(info.get("parent_version", -1))
+            if p in all_v:
+                keep.add(p)
         retired = [v for v in versions if v not in keep]
         if dry_run:
             return retired
@@ -429,6 +503,10 @@ class ModelRegistry:
             # add_sidecar extends it (meta.json itself is implied)
             "files": [ARRAYS_FILE],
         }
+        if kind == FOREST and model_json is not None:
+            # the delta chain's identity axis: every forest version
+            # records its members' content shas at publish time
+            meta["tree_shas"] = _tree_shas(model_json["trees"])
 
         def write_arrays():
             fault_point("registry_publish")
@@ -439,6 +517,146 @@ class ModelRegistry:
         instant("registry.publish", cat="registry", model=name,
                 version=version, kind=kind)
         return version
+
+    # ---- O(delta) distribution (ISSUE 20) ----
+    def publish_delta(self, name: str, model: Any, *,
+                      parent_version: int,
+                      schema: Optional[FeatureSchema] = None,
+                      params: Optional[Dict[str, Any]] = None) -> int:
+        """Publish a forest as the next version PLUS a ``delta.npz`` /
+        ``delta.json`` sidecar pair holding only the trees that changed
+        relative to ``parent_version`` — a serving tier resident on the
+        parent patches O(changed trees) device bytes instead of
+        re-uploading the model (serving/predictor.apply_delta).
+
+        The FULL artifact is always written first (the delta is an
+        overlay, never the only copy), and the sidecar attach is
+        best-effort: any incompatibility — parent torn/retired, member
+        count or class vocabulary changed, a changed tree outgrowing
+        the parent's stacked layout (smaller layouts re-pad fine) —
+        warns and returns the plain full publish; consumers detect the
+        missing sidecar and fall back to full-artifact load.  Returns
+        the new version number either way."""
+        params = dict(params or {})
+        params["delta_parent"] = int(parent_version)
+        version = self.publish(name, model, schema=schema, params=params)
+        try:
+            self._attach_delta(name, version, int(parent_version))
+        except Exception as exc:
+            warnings.warn(
+                f"model {name!r} v{version}: delta sidecar against "
+                f"parent v{parent_version} not attached "
+                f"({type(exc).__name__}: {exc}); consumers will load "
+                f"the full artifact", RuntimeWarning)
+        return version
+
+    def _attach_delta(self, name: str, version: int,
+                      parent_version: int) -> None:
+        """Compute + attach the delta sidecars (raises on any layout or
+        chain mismatch — publish_delta turns that into a warning)."""
+        import io
+        from ..models.forest import EnsembleModel
+        from ..models.tree import DecisionTreeModel
+        if not self.is_intact(name, parent_version):
+            raise ValueError(f"parent v{parent_version} is not intact")
+        child = self.load(name, version)
+        parent = self.load(name, parent_version)
+        if child.kind != FOREST or parent.kind != FOREST:
+            raise ValueError("delta publish is forest-only")
+        child_shas = list(child.meta.get("tree_shas") or [])
+        parent_shas = list(parent.meta.get("tree_shas") or [])
+        if not child_shas or not parent_shas:
+            raise ValueError("parent predates per-tree shas")
+        if len(child_shas) != len(parent_shas):
+            raise ValueError(
+                f"member count changed ({len(parent_shas)} -> "
+                f"{len(child_shas)}); no O(delta) form exists")
+        if child.schema is None:
+            raise ValueError("forest artifact has no embedded schema")
+
+        def host_form(loaded):
+            models = [DecisionTreeModel(pl, loaded.schema)
+                      for pl in loaded.model]
+            ens = EnsembleModel(
+                models, weights=loaded.params.get("weights"),
+                min_odds_ratio=float(
+                    loaded.params.get("min_odds_ratio", 1.0)),
+                require_odd=False, stack=False)
+            return ens, ens.stacked_host()
+        c_ens, c_host = host_form(child)
+        p_ens, p_host = host_form(parent)
+        if c_host is None or p_host is None:
+            raise ValueError("no stacked device form (degenerate member "
+                             "or non-f32-exact bounds)")
+        if c_ens.classes != p_ens.classes:
+            raise ValueError("class vocabulary changed")
+        if any(c.shape[1:] != p.shape[1:]
+               for c, p in zip(c_host, p_host)):
+            # the patch targets a resident stacked in the PARENT's
+            # layout, so re-pad the child slices to the parent's
+            # (P, cmax) — per-tree slots are laid out independently of
+            # the global max (sentinel at the tree's own path count,
+            # never-match / vote-nothing rows after), so padding is
+            # bit-exact.  Only a changed tree that OUTGROWS the parent
+            # layout has no O(delta) form.
+            c_host = _pad_stacked_to(c_host, p_host)
+        changed = [i for i, (cs, ps) in
+                   enumerate(zip(child_shas, parent_shas)) if cs != ps]
+        lo, hi, num_r, cat_m, cat_r, cls_oh = c_host
+        idx = np.asarray(changed, np.int32)
+        buf = io.BytesIO()
+        np.savez(buf, idx=idx, lo=lo[idx], hi=hi[idx], num_r=num_r[idx],
+                 cat_m=cat_m[idx], cat_r=cat_r[idx], cls_oh=cls_oh[idx],
+                 wvec=np.asarray(c_ens.weights, np.float32))
+        trees = child.meta["model_json"]["trees"]
+        dmeta = {
+            "format": DELTA_FORMAT_VERSION,
+            "parent_version": int(parent_version),
+            "parent_tree_shas": parent_shas,
+            "tree_shas": child_shas,
+            "classes": list(c_ens.classes),
+            "n_trees": len(child_shas),
+            "changed": [int(i) for i in changed],
+            "changed_trees": [trees[i] for i in changed],
+            "stacked_shape": {"P": int(lo.shape[1]),
+                              "F": int(lo.shape[2]),
+                              "cmax": int(cat_m.shape[3]),
+                              "K": int(cls_oh.shape[2])},
+        }
+        self.add_sidecar(name, version, {
+            DELTA_NPZ: buf.getvalue(),
+            DELTA_JSON: json.dumps(dmeta).encode(),
+        })
+        instant("registry.delta_publish", cat="registry", model=name,
+                version=version, parent=int(parent_version),
+                changed=len(changed), total=len(child_shas))
+
+    def delta_info(self, name: str, version: int) -> Optional[Dict]:
+        """The parsed ``delta.json`` sidecar, or None when the version
+        carries no (readable) delta — absence means full-artifact load,
+        never an error."""
+        try:
+            return json.loads(
+                self.read_sidecar(name, version, DELTA_JSON))
+        except FileNotFoundError:
+            return None
+        except Exception as exc:
+            warnings.warn(
+                f"model {name!r} v{version}: delta sidecar unreadable "
+                f"({type(exc).__name__}: {exc}); treating as absent",
+                RuntimeWarning)
+            return None
+
+    def load_delta(self, name: str, version: int
+                   ) -> Tuple[Dict, Dict[str, np.ndarray]]:
+        """(delta meta, delta arrays) for a version published with an
+        attached delta sidecar; FileNotFoundError when it has none."""
+        import io
+        dmeta = json.loads(self.read_sidecar(name, version, DELTA_JSON))
+        with np.load(io.BytesIO(
+                self.read_sidecar(name, version, DELTA_NPZ))) as z:
+            arrays = {k: z[k] for k in z.files}
+        return dmeta, arrays
 
     # ---- sidecars ----
     def add_sidecar(self, name: str, version: int,

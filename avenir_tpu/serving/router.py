@@ -162,7 +162,9 @@ class ModelRouter:
                  latency_window: int = 8192,
                  quantized: bool = False,
                  wire_native: str = "auto",
-                 shared_cores: bool = True):
+                 shared_cores: bool = True,
+                 device=None,
+                 serve_mesh=None):
         if not models:
             raise ValueError("ModelRouter needs at least one resident "
                              "model spec")
@@ -179,6 +181,10 @@ class ModelRouter:
         self._quantized = bool(quantized)
         self._wire_native = wire_native
         self._shared_cores = bool(shared_cores)
+        # ISSUE 20 placement: one map for every resident (a router is
+        # one worker — its residents share the worker's chip or mesh)
+        self._device = device
+        self._serve_mesh = serve_mesh
         self._depths = dict(model_depths or {})
         self._lock = threading.Lock()
         # model name -> resident services for that name, spec order
@@ -231,13 +237,17 @@ class ModelRouter:
             return PredictionService(
                 registry=self.registry, model_name=mname,
                 buckets=self._buckets, quantized=self._quantized,
-                shared_cores=self._shared_cores, **common)
+                shared_cores=self._shared_cores,
+                device=self._device, serve_mesh=self._serve_mesh,
+                **common)
         # version-pinned resident: fixed predictor, refresh is a no-op
         loaded = self.registry.load(mname, ver)
         pred = make_predictor(loaded, buckets=self._buckets,
                               delim=self.delim,
                               quantized=self._quantized,
-                              shared_cores=self._shared_cores)
+                              shared_cores=self._shared_cores,
+                              device=self._device,
+                              serve_mesh=self._serve_mesh)
         svc = PredictionService(pred, **common)
         svc.version = ver
         svc.model_name = mname
@@ -359,7 +369,8 @@ class ModelRouter:
             predictor = make_predictor(
                 loaded, buckets=self._buckets, delim=self.delim,
                 quantized=self._quantized,
-                shared_cores=self._shared_cores)
+                shared_cores=self._shared_cores,
+                device=self._device, serve_mesh=self._serve_mesh)
         base = f"{self.name}.{mname}" if self.name else mname
         svc = PredictionService(
             predictor, policy=self._sub_policy(mname), warm=self._warm,
@@ -446,7 +457,8 @@ class ModelRouter:
             predictor = make_predictor(
                 loaded, buckets=self._buckets, delim=self.delim,
                 quantized=self._quantized,
-                shared_cores=self._shared_cores)
+                shared_cores=self._shared_cores,
+                device=self._device, serve_mesh=self._serve_mesh)
         base = f"{self.name}.{mname}" if self.name else mname
         svc = PredictionService(
             predictor, policy=self._sub_policy(mname), warm=self._warm,

@@ -82,7 +82,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-from ..core.faults import with_retry
+from ..core.faults import fault_point, with_retry
 from ..core.metrics import Counters
 from ..io import native_wire
 from ..telemetry import get_default_registry, instant, span
@@ -218,6 +218,8 @@ class PredictionService:
                  quantized: bool = False,
                  wire_native: str = "auto",
                  shared_cores: bool = False,
+                 device=None,
+                 serve_mesh=None,
                  reward_sink=None):
         if predictor is None and (registry is None or model_name is None):
             raise ValueError("need a predictor, or registry= + model_name=")
@@ -239,6 +241,13 @@ class PredictionService:
         # of model identity — N residents with structurally identical
         # programs compile once (serving/predictor.py _SHARED_CORES)
         self._shared_cores = bool(shared_cores)
+        # device placement (ISSUE 20): ``device=`` pins registry-built
+        # forest predictors onto one chip (fleet round-robin spread);
+        # ``serve_mesh=`` shards the vote over a tree-axis mesh instead
+        # (model-parallel serving).  Mutually exclusive, both None = the
+        # old default-device single-chip shape.
+        self._device = device
+        self._serve_mesh = serve_mesh
         self.policy = policy or BatchPolicy()
         self.counters = counters if counters is not None else Counters()
         self.timer = timer if timer is not None else \
@@ -343,7 +352,9 @@ class PredictionService:
         pred = make_predictor(loaded, schema=self._schema,
                               buckets=self._buckets, delim=self.delim,
                               quantized=self._quantized,
-                              shared_cores=self._shared_cores)
+                              shared_cores=self._shared_cores,
+                              device=self._device,
+                              serve_mesh=self._serve_mesh)
         if self._warm:
             pred.warm()
         self.version = latest
@@ -357,17 +368,29 @@ class PredictionService:
         built + warmed off the request path and swapped in atomically
         (in-flight batches finish on the old one).  Returns whether a
         swap happened.  A half-written target is skipped by the registry
-        with a warning — serving stays on the current model."""
+        with a warning — serving stays on the current model.
+
+        O(delta) path (ISSUE 20): when the new version carries a delta
+        sidecar whose parent is the CURRENTLY served version, the resident
+        predictor's device arrays are patched in place (H2D proportional
+        to the changed trees, not the forest) instead of rebuilding from
+        the full artifact.  Any mismatch in the sha chain — or a failure
+        mid-patch — falls back to the full-artifact load below, so a torn
+        delta can never leave wrong weights serving."""
         if self.registry is None:
             return False
         latest = self.registry.serving_version(self.model_name)
         if latest is None or latest == self.version:
             return False
+        if self._try_delta(latest):
+            return True
         loaded = self.registry.load(self.model_name, latest)
         pred = make_predictor(loaded, schema=self._schema,
                               buckets=self._buckets, delim=self.delim,
                               quantized=self._quantized,
-                              shared_cores=self._shared_cores)
+                              shared_cores=self._shared_cores,
+                              device=self._device,
+                              serve_mesh=self._serve_mesh)
         if self._warm:
             pred.warm()
         with self._swap_lock:
@@ -375,6 +398,45 @@ class PredictionService:
             self.version = latest
         self.degraded = None   # a fresh model clears the degraded flag
         self.counters.increment("Serving", "HotSwaps")
+        return True
+
+    def _try_delta(self, latest: int) -> bool:
+        """In-place delta patch onto the resident predictor.  True only
+        when the patch fully applied and ``latest`` is now serving; False
+        means "take the full-load path" (no delta sidecar, wrong parent,
+        predictor without patch support, or a failure mid-apply — the
+        predictor's functional update leaves the old arrays serving in
+        every failure case, so falling through is always safe)."""
+        pred = self.predictor
+        if (self._quantized or pred is None
+                or not hasattr(pred, "apply_delta")):
+            return False
+        dmeta = self.registry.delta_info(self.model_name, latest)
+        if dmeta is None or dmeta.get("parent_version") != self.version:
+            return False
+        try:
+            with self._swap_lock:
+                fault_point("swap_patch")
+                dmeta, arrays = self.registry.load_delta(
+                    self.model_name, latest)
+                moved = pred.apply_delta(dmeta, arrays)
+                self.version = latest
+        except Exception as exc:   # noqa: BLE001 — any tear -> full load
+            self.counters.increment("Serving", "DeltaSwapTorn")
+            import warnings
+            warnings.warn(
+                f"serving: delta patch onto v{self.version} failed "
+                f"({exc}); falling back to full artifact load",
+                RuntimeWarning, stacklevel=2)
+            return False
+        self.degraded = None
+        self.counters.increment("Serving", "HotSwaps")
+        self.counters.increment("Serving", "DeltaSwaps")
+        instant("swap.patch", cat="serving", model=self.model_name or "",
+                version=int(latest),
+                parent=int(dmeta["parent_version"]),
+                changed=len(dmeta.get("changed", ())),
+                h2d_bytes=int(moved))
         return True
 
     def mark_degraded(self, reason: str) -> None:

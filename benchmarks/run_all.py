@@ -962,6 +962,120 @@ def _multimodel_bench(models, schema, req_rows, scale):
         shutil.rmtree(reg_dir, ignore_errors=True)
 
 
+def _multichip_bench(table, schema, req_rows, scale):
+    """The multi-chip tier (ISSUE 20), three numbers: (a) sharded-vote
+    throughput vs tree-axis shard count on the simulated 8-device mesh
+    (byte parity vs the single-chip vote is ASSERTED per point — a
+    diverging shard merge must fail the block, not flatter it); (b) the
+    max-servable-forest estimate — resident stacked bytes per tree read
+    off the real host form against a per-chip HBM budget, single chip
+    vs 8-way tree-sharded; (c) O(delta) distribution — ledger-measured
+    H2D bytes and refresh wall time for 1% / 10% / 100% deltas through
+    the real service refresh path, against the full resident size (the
+    ~15%-of-full-for-a-10%-delta acceptance number)."""
+    import shutil
+    import tempfile
+
+    from avenir_tpu.models.forest import ForestParams, build_forest
+    from avenir_tpu.parallel.mesh import MeshContext
+    from avenir_tpu.serving.predictor import ForestPredictor
+    from avenir_tpu.serving.registry import ModelRegistry
+    from avenir_tpu.serving.service import PredictionService
+    from avenir_tpu.utils.tracing import transfer_ledger
+
+    # 101 trees: 1% of the forest is exactly one tree, so the delta
+    # sweep's smallest point is a real single-tree patch
+    T = 101
+    params = ForestParams(num_trees=T, seed=1)
+    params.tree.max_depth = 4
+    parent = build_forest(table, params, MeshContext())
+    params_d = ForestParams(num_trees=T, seed=2)
+    params_d.tree.max_depth = 4
+    donor = build_forest(table, params_d, MeshContext())
+    n_rows = max(int(1024 * scale), 128)
+    batch = req_rows[:n_rows]
+
+    # (a) throughput vs shard count, parity-asserted
+    ref = None
+    sweep = []
+    for shards in (1, 2, 4, 8):
+        p = ForestPredictor(parent, schema,
+                            serve_mesh=None if shards == 1 else shards,
+                            buckets=(64, 256, 1024)).warm()
+        p.predict_rows(batch)                      # warm the buckets
+        t0 = time.perf_counter()
+        got = p.predict_rows(batch)
+        dt = time.perf_counter() - t0
+        if ref is None:
+            ref = got
+        assert got == ref, f"sharded vote diverged at {shards} shards"
+        # a host with fewer chips degrades the mesh (1-chip meshes drop
+        # to the plain core); report what actually ran
+        eff = (p._serve_mesh.devices.size
+               if p._serve_mesh is not None else 1)
+        sweep.append({"shards": shards, "shards_effective": int(eff),
+                      "rows_per_sec": round(len(batch) / dt, 1)})
+
+    # (b) capacity: resident bytes per tree vs a per-chip HBM budget
+    host = ForestPredictor(parent, schema).ensemble.stacked_host()
+    full_bytes = sum(a.nbytes for a in host)
+    per_tree = full_bytes / T
+    hbm_gib, util = 16, 0.8
+    budget = hbm_gib * (1 << 30) * util
+    max_single = int(budget // per_tree)
+    capacity = {
+        "resident_bytes": full_bytes,
+        "bytes_per_tree": round(per_tree, 1),
+        "hbm_budget_gib": hbm_gib,
+        "hbm_utilization": util,
+        "max_trees_single_chip": max_single,
+        "max_trees_8way_sharded": 8 * max_single,
+    }
+
+    # (c) the delta distribution sweep through the service refresh path
+    reg_dir = tempfile.mkdtemp(prefix="avt_mcreg_")
+    deltas = []
+    try:
+        reg = ModelRegistry(reg_dir)
+        reg.publish("bench", parent, schema=schema)
+        for frac in (0.01, 0.10, 1.00):
+            k = max(1, round(frac * T))
+            child = list(parent)
+            child[:k] = donor[:k]
+            v = reg.publish_delta("bench", child, parent_version=1,
+                                  schema=schema)
+            assert reg.delta_info("bench", v) is not None
+            reg.pin_version("bench", 1)
+            svc = PredictionService(registry=reg, model_name="bench",
+                                    buckets=(64,))
+            reg.clear_pin("bench")
+            with transfer_ledger() as led:
+                t0 = time.perf_counter()
+                assert svc.refresh()
+                swap_s = time.perf_counter() - t0
+            assert svc.counters.get("Serving", "DeltaSwaps") == 1, \
+                "delta refresh fell back to a full load"
+            moved = led.snapshot()["h2d_bytes"]
+            deltas.append({
+                "delta_fraction": frac,
+                "changed_trees": k,
+                "h2d_bytes": moved,
+                "fraction_of_full_resident": round(moved / full_bytes, 4),
+                "swap_ms": round(swap_s * 1e3, 2),
+            })
+        ten_pct = deltas[1]
+        delta_block = {
+            "full_resident_bytes": full_bytes,
+            "sweep": deltas,
+            "le_15pct_for_10pct_delta":
+                ten_pct["fraction_of_full_resident"] <= 0.15,
+        }
+    finally:
+        shutil.rmtree(reg_dir, ignore_errors=True)
+    return {"trees": T, "throughput_vs_shards": sweep,
+            "capacity": capacity, "delta_distribution": delta_block}
+
+
 def bench_serve_forest(scale):
     """Online forest serving: micro-batched request loop throughput and
     latency percentiles at several offered loads (plus a closed-loop pass
@@ -1107,6 +1221,9 @@ def bench_serve_forest(scale):
         "\n".join(",".join(r) for r in rows[:min(n_train, 4000)]), schema)
     fleet_models = build_forest(fleet_table, fleet_params, MeshContext())
     fleet = _fleet_sweep(fleet_models, schema, req_rows, scale)
+    # the multi-chip tier (ISSUE 20): tree-axis sharded serving on the
+    # simulated 8-device mesh + the O(delta) distribution sweep
+    multichip = _multichip_bench(fleet_table, schema, req_rows, scale)
     # the horizontal tier (ISSUE 13): multi-process saturation over the
     # shard ring, the autoscaled 10x spike, the killed-shard drill —
     # all against the same compute-dominated forest, published to a
@@ -1200,7 +1317,8 @@ def bench_serve_forest(scale):
             "fleet_sweep": fleet,
             "horizontal": horizontal,
             "durable": durable,
-            "multimodel": multimodel}
+            "multimodel": multimodel,
+            "multichip": multichip}
 
 
 def bench_wire_codec(scale):

@@ -1,0 +1,417 @@
+"""O(delta) model distribution (ISSUE 20, TPU_NOTES §32).
+
+The contracts under test:
+
+  * ``publish_delta`` writes the FULL artifact plus a ``delta.npz`` /
+    ``delta.json`` sidecar keyed on the parent's per-tree content shas —
+    only the changed trees ride in the sidecar;
+  * a resident service's ``refresh`` patches ONLY the changed device
+    slices (ledger-pinned H2D ∝ delta, ≤15% of the full artifact for a
+    ~10% delta) and the patched model answers byte-identically to a
+    full-artifact load of the same version;
+  * ANY tear — sha-chain mismatch, mid-patch kill at every fault point —
+    falls back to the full-artifact load with a warning: the service
+    never serves wrong weights and never stays behind;
+  * ``retire`` never GCs a parent a live delta chain still needs;
+    ``registrytool verify`` names the broken chains (``orphaned-delta``,
+    ``delta-sha-chain-broken``) without failing the registry;
+  * the retrain controller prefers delta publish when the champion is
+    the candidate's parent;
+  * a delta-swapping fleet and a full-loading fleet converge to byte-
+    identical replies under live load (no request lost/duplicated/wrong
+    while the patch lands).
+"""
+
+import subprocess
+import sys
+import time
+import warnings
+
+import pytest
+
+from avenir_tpu.core.table import encode_rows
+from avenir_tpu.io.respq import RespClient, RespServer
+from avenir_tpu.serving import BatchPolicy, ModelRegistry, ServingFleet
+from avenir_tpu.serving.service import PredictionService
+from avenir_tpu.utils.tracing import transfer_ledger
+from tests.test_fleet import drain_replies, resp_server  # noqa: F401
+from tests.test_serving import (forest_batch_predict, raw_rows_of,
+                                small_forest)
+from tests.test_tree import SCHEMA
+
+pytestmark = [pytest.mark.multichip, pytest.mark.serving]
+
+
+def delta_pair(tmp_path, mesh_ctx, trees=5, changed=(2,), n=400,
+               subdir="reg"):
+    """Registry with v1 (parent) and v2 = publish_delta(child) where the
+    child replaces ``changed`` members; returns everything the tests
+    probe against."""
+    table, parent = small_forest(mesh_ctx, n=n, trees=trees, seed=3)
+    _, other = small_forest(mesh_ctx, n=n, trees=trees, seed=9)
+    child = list(parent)
+    for i in changed:
+        child[i] = other[i]
+    reg = ModelRegistry(str(tmp_path / subdir))
+    v1 = reg.publish("churn", parent, schema=SCHEMA)
+    v2 = reg.publish_delta("churn", child, parent_version=v1, schema=SCHEMA)
+    rows = raw_rows_of(table, 60)
+    enc = encode_rows(rows, SCHEMA)
+    return {
+        "reg": reg, "v1": v1, "v2": v2, "rows": rows,
+        "parent": parent, "child": child,
+        "expect1": forest_batch_predict(parent, enc),
+        "expect2": forest_batch_predict(child, enc),
+    }
+
+
+def service_on_v1(reg, **kw):
+    """A service resident on v1 while v2 is already published — the
+    refresh-from-behind shape every delta test starts from."""
+    reg.pin_version("churn", 1)
+    svc = PredictionService(registry=reg, model_name="churn",
+                            buckets=(8, 64), **kw)
+    reg.clear_pin("churn")
+    assert svc.version == 1
+    return svc
+
+
+# --------------------------------------------------------------------------
+# the sidecar itself
+# --------------------------------------------------------------------------
+
+def test_publish_delta_sidecar_roundtrip(tmp_path, mesh_ctx):
+    ex = delta_pair(tmp_path, mesh_ctx, trees=5, changed=(1, 3))
+    reg = ex["reg"]
+    dmeta = reg.delta_info("churn", ex["v2"])
+    assert dmeta is not None
+    assert dmeta["parent_version"] == ex["v1"]
+    assert dmeta["changed"] == [1, 3]
+    assert dmeta["n_trees"] == 5
+    # the chain identity: parent shas recorded at publish time match the
+    # parent artifact's own stamp, tree for tree
+    pmeta = reg.load("churn", ex["v1"]).meta
+    assert dmeta["parent_tree_shas"] == pmeta["tree_shas"]
+    cmeta = reg.load("churn", ex["v2"]).meta
+    assert dmeta["tree_shas"] == cmeta["tree_shas"]
+    # unchanged members share shas across the chain
+    for i in range(5):
+        same = dmeta["tree_shas"][i] == dmeta["parent_tree_shas"][i]
+        assert same == (i not in (1, 3))
+    _, arrays = reg.load_delta("churn", ex["v2"])
+    assert sorted(arrays) == ["cat_m", "cat_r", "cls_oh", "hi", "idx",
+                              "lo", "num_r", "wvec"]
+    assert list(arrays["idx"]) == [1, 3]
+    # every stacked slice ships only the changed members
+    for k in ("lo", "hi", "num_r", "cat_m", "cat_r", "cls_oh"):
+        assert arrays[k].shape[0] == 2, k
+    # a plain publish carries no sidecar — absence is not an error
+    assert reg.delta_info("churn", ex["v1"]) is None
+
+
+def test_full_publish_has_no_delta_and_parentless_delta_warns(tmp_path,
+                                                              mesh_ctx):
+    """publish_delta onto an incompatible parent (member count changed)
+    still PUBLISHES — the sidecar attach is best-effort and its failure
+    is a warning, never a lost version."""
+    table, m5 = small_forest(mesh_ctx, n=300, trees=5, seed=3)
+    _, m3 = small_forest(mesh_ctx, n=300, trees=3, seed=9)
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    v1 = reg.publish("churn", m5, schema=SCHEMA)
+    with pytest.warns(RuntimeWarning, match="member count changed"):
+        v2 = reg.publish_delta("churn", m3, parent_version=v1,
+                               schema=SCHEMA)
+    assert reg.is_intact("churn", v2)
+    assert reg.delta_info("churn", v2) is None
+    rows = raw_rows_of(table, 30)
+    svc = PredictionService(registry=reg, model_name="churn",
+                            buckets=(8, 64))
+    assert svc.version == v2
+    assert svc.predictor.predict_rows(rows) == \
+        forest_batch_predict(m3, encode_rows(rows, SCHEMA))
+
+
+# --------------------------------------------------------------------------
+# the service refresh fast path: patch, parity, H2D budget
+# --------------------------------------------------------------------------
+
+def test_delta_refresh_patches_and_matches_full_load(tmp_path, mesh_ctx):
+    ex = delta_pair(tmp_path, mesh_ctx)
+    svc = service_on_v1(ex["reg"])
+    assert svc.predictor.predict_rows(ex["rows"]) == ex["expect1"]
+    assert svc.refresh() is True
+    assert svc.version == ex["v2"]
+    assert svc.counters.get("Serving", "DeltaSwaps") == 1
+    assert svc.counters.get("Serving", "HotSwaps") == 1
+    got = svc.predictor.predict_rows(ex["rows"])
+    assert got == ex["expect2"]
+    # byte parity vs a cold full-artifact load of the same version
+    full = PredictionService(registry=ex["reg"], model_name="churn",
+                             buckets=(8, 64))
+    assert full.version == ex["v2"]
+    assert full.counters.get("Serving", "DeltaSwaps") == 0
+    assert full.predictor.predict_rows(ex["rows"]) == got
+
+
+def test_delta_refresh_h2d_budget(tmp_path, mesh_ctx):
+    """The acceptance pin: a ~10% delta (2 of 21 trees) moves ≤15% of
+    the full resident artifact's bytes over H2D, ledger-measured."""
+    ex = delta_pair(tmp_path, mesh_ctx, trees=21, changed=(4, 17))
+    svc = service_on_v1(ex["reg"])
+    stacked = svc.predictor.ensemble.stacked_host()
+    full_bytes = sum(a.nbytes for a in stacked)
+    with transfer_ledger() as led:
+        assert svc.refresh() is True
+    assert svc.counters.get("Serving", "DeltaSwaps") == 1
+    moved = led.snapshot()["h2d_bytes"]
+    assert 0 < moved <= 0.15 * full_bytes, (moved, full_bytes)
+    assert svc.predictor.predict_rows(ex["rows"]) == ex["expect2"]
+
+
+def test_delta_refresh_on_sharded_core(tmp_path, mesh_ctx):
+    """The patch lands on a tree-axis mesh-sharded resident too: slices
+    are re-placed with the shard sharding, replies stay byte-identical,
+    and the compiled sharded core is never rebuilt."""
+    ex = delta_pair(tmp_path, mesh_ctx, trees=13, changed=(0, 7))
+    svc = service_on_v1(ex["reg"], serve_mesh=True)
+    assert svc.predictor._serve_mesh is not None
+    jitted_before = svc.predictor._jitted
+    assert svc.refresh() is True
+    assert svc.counters.get("Serving", "DeltaSwaps") == 1
+    assert svc.predictor._jitted is jitted_before
+    assert svc.predictor.predict_rows(ex["rows"]) == ex["expect2"]
+
+
+def test_delta_pads_into_larger_parent_layout(tmp_path, mesh_ctx):
+    """A retrained child whose trees are SHALLOWER than the parent's
+    still gets a delta: the slices are re-padded into the parent's
+    stacked layout (per-tree slots are laid out independently of the
+    global path max), and the patched resident answers byte-identically
+    to a cold full load of the child."""
+    table, parent = small_forest(mesh_ctx, n=400, trees=5, depth=3, seed=3)
+    _, child = small_forest(mesh_ctx, n=400, trees=5, depth=1, seed=9)
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    reg.publish("churn", parent, schema=SCHEMA)
+    v2 = reg.publish_delta("churn", child, parent_version=1, schema=SCHEMA)
+    dmeta = reg.delta_info("churn", v2)
+    assert dmeta is not None and dmeta["changed"] == [0, 1, 2, 3, 4]
+    svc = service_on_v1(reg)
+    # the sidecar really is in the parent's (bigger) layout
+    p_shape = svc.predictor.ensemble.stacked_host()[0].shape
+    assert dmeta["stacked_shape"]["P"] == p_shape[1]
+    assert svc.refresh() is True
+    assert svc.counters.get("Serving", "DeltaSwaps") == 1
+    rows = raw_rows_of(table, 60)
+    assert svc.predictor.predict_rows(rows) == \
+        forest_batch_predict(child, encode_rows(rows, SCHEMA))
+
+
+# --------------------------------------------------------------------------
+# every tear falls back to the full artifact — never wrong weights
+# --------------------------------------------------------------------------
+
+def test_sha_chain_mismatch_falls_back_to_full_load(tmp_path, mesh_ctx):
+    ex = delta_pair(tmp_path, mesh_ctx)
+    svc = service_on_v1(ex["reg"])
+    # simulate a resident that drifted off the recorded chain
+    svc.predictor.tree_shas = ["0" * 64] * 5
+    with pytest.warns(RuntimeWarning, match="falling back"):
+        assert svc.refresh() is True
+    assert svc.version == ex["v2"]
+    assert svc.counters.get("Serving", "DeltaSwapTorn") == 1
+    assert svc.counters.get("Serving", "DeltaSwaps") == 0
+    assert svc.predictor.predict_rows(ex["rows"]) == ex["expect2"]
+
+
+@pytest.mark.faultinject
+@pytest.mark.parametrize("hit", [0, 3, 7])
+def test_mid_patch_kill_full_load_fallback(tmp_path, mesh_ctx,
+                                           fault_injector, hit):
+    """A kill at EVERY stage of the patch — before it starts (hit 0),
+    mid way through the per-tensor upload loop (hit 3), at the final
+    commit point (hit 7) — leaves the old argument tuple untouched and
+    the same refresh lands v2 via the full-artifact load: consistent
+    model, correct weights, one named counter."""
+    ex = delta_pair(tmp_path, mesh_ctx)
+    svc = service_on_v1(ex["reg"])
+    fault_injector(f"swap_patch@{hit}=raise:RuntimeError")
+    with pytest.warns(RuntimeWarning, match="falling back"):
+        assert svc.refresh() is True
+    assert svc.version == ex["v2"]
+    assert svc.counters.get("Serving", "DeltaSwapTorn") == 1
+    assert svc.counters.get("Serving", "DeltaSwaps") == 0
+    assert svc.predictor.predict_rows(ex["rows"]) == ex["expect2"]
+
+
+# --------------------------------------------------------------------------
+# retention + registrytool: the chain is audited, never load-bearing
+# --------------------------------------------------------------------------
+
+def test_retire_protects_live_delta_parent(tmp_path, mesh_ctx):
+    ex = delta_pair(tmp_path, mesh_ctx)
+    reg = ex["reg"]
+    v3 = reg.publish("churn", ex["parent"], schema=SCHEMA)
+    v4 = reg.publish_delta("churn", ex["child"], parent_version=v3,
+                           schema=SCHEMA)
+    # keep_last=1 keeps v4; v3 must survive too — v4's delta chain
+    # needs it — while the dead chain (v1 <- v2) goes
+    retired = reg.retire("churn", keep_last=1)
+    assert sorted(retired) == [ex["v1"], ex["v2"]]
+    assert reg.versions("churn") == [v3, v4]
+    assert reg.is_intact("churn", v3)
+
+
+def _verify(registry_dir):
+    out = subprocess.run(
+        [sys.executable, "/root/repo/tools/registrytool.py", "verify",
+         str(registry_dir)],
+        capture_output=True, text=True)
+    return out.returncode, out.stdout
+
+
+def test_registrytool_verify_names_broken_chains(tmp_path, mesh_ctx):
+    import json
+    import os
+    import shutil
+    ex = delta_pair(tmp_path, mesh_ctx)
+    reg = ex["reg"]
+    rc, txt = _verify(reg.base_dir)
+    assert rc == 0 and "delta" not in txt and "verified" in txt
+    # tamper the parent's sha stamp: chain-broken is NAMED but the
+    # registry still verifies — full-artifact serving is unaffected
+    meta_path = os.path.join(reg.version_dir("churn", ex["v1"]),
+                             "meta.json")
+    with open(meta_path) as fh:
+        meta = json.load(fh)
+    meta["tree_shas"][0] = "0" * 64
+    with open(meta_path, "w") as fh:
+        json.dump(meta, fh)
+    rc, txt = _verify(reg.base_dir)
+    assert rc == 0
+    assert "delta-sha-chain-broken" in txt
+    assert "1 delta warning(s)" in txt
+    # remove the parent outright: orphaned-delta, still exit 0
+    shutil.rmtree(reg.version_dir("churn", ex["v1"]))
+    rc, txt = _verify(reg.base_dir)
+    assert rc == 0
+    assert "orphaned-delta" in txt
+
+
+# --------------------------------------------------------------------------
+# the controller prefers the delta form when the champion is the parent
+# --------------------------------------------------------------------------
+
+@pytest.mark.controller
+def test_controller_publishes_delta_when_champion_is_parent(tmp_path,
+                                                            mesh_ctx):
+    from avenir_tpu.control import PUBLISHED
+    from tests.test_controller import (MODEL, build_champion, drift_alert,
+                                       make_controller)
+    reg, params, clean, fresh = build_champion(tmp_path, mesh_ctx)
+    ctl = make_controller(reg, params, tmp_path, fresh)
+    assert ctl.submit_alert(drift_alert())
+    summary = ctl.run_pending()
+    assert summary["outcome"] == PUBLISHED
+    assert summary["candidate_version"] == 2
+    # v2 is a full artifact AND carries a delta sidecar chained to the
+    # champion it replaced
+    dmeta = reg.delta_info(MODEL, 2)
+    assert dmeta is not None
+    assert dmeta["parent_version"] == 1
+    assert reg.is_intact(MODEL, 2)
+    c = ctl.counters.as_dict()["Controller"]
+    assert c["Published"] == 1 and c["DeltaPublished"] == 1
+    # provenance params survive the delta form of publish
+    loaded = reg.load(MODEL, 2)
+    assert loaded.params["candidate_sha"]
+    assert loaded.params["retrain_mode"] == "incremental"
+
+
+# --------------------------------------------------------------------------
+# the e2e: delta fleet vs full fleet, byte parity under live load
+# --------------------------------------------------------------------------
+
+def test_delta_fleet_vs_full_fleet_byte_parity_under_load(
+        tmp_path, mesh_ctx, resp_server):  # noqa: F811
+    """Two 2-worker fleets on one broker serve the SAME v1 forest; v2
+    lands as publish_delta on one registry and a plain full publish on
+    the other.  Traffic flows before, during and after the coordinated
+    reload: every request is answered exactly once with a v1-or-v2
+    prediction (in-flight batches finish on the model they started on),
+    and once both fleets converge the replies are byte-identical — the
+    patched tensors ARE the full artifact."""
+    table, parent = small_forest(mesh_ctx, n=300, trees=5, seed=3)
+    _, other = small_forest(mesh_ctx, n=300, trees=5, seed=9)
+    child = list(parent)
+    child[1], child[3] = other[1], other[3]
+    reg_d = ModelRegistry(str(tmp_path / "reg_delta"))
+    reg_f = ModelRegistry(str(tmp_path / "reg_full"))
+    for reg in (reg_d, reg_f):
+        reg.publish("churn", parent, schema=SCHEMA)
+    rows = raw_rows_of(table, 40)
+    enc = encode_rows(rows, SCHEMA)
+    e1 = forest_batch_predict(parent, enc)
+    e2 = forest_batch_predict(child, enc)
+    pol = BatchPolicy(max_batch=8, max_wait_ms=1.0)
+    fleets = {}
+    for tag, reg in (("d", reg_d), ("f", reg_f)):
+        fleets[tag] = ServingFleet(
+            reg, "churn", buckets=(8,), policy=pol, n_workers=2,
+            config={"redis.server.port": resp_server.port,
+                    "redis.request.queue": f"req-{tag}",
+                    "redis.prediction.queue": f"out-{tag}"}).start()
+    feeder = RespClient(port=resp_server.port)
+
+    def push(tag, lo, hi):
+        feeder.lpush_many(f"req-{tag}", [
+            ",".join(["predict", str(i)] + rows[i % 40])
+            for i in range(lo, hi)])
+
+    try:
+        for tag in fleets:
+            push(tag, 0, 100)
+        # v2 lands mid-traffic: delta sidecar on one side, full-only on
+        # the other, then the coordinated reload on both
+        reg_d.publish_delta("churn", child, parent_version=1,
+                            schema=SCHEMA)
+        reg_f.publish("churn", child, schema=SCHEMA)
+        assert reg_d.delta_info("churn", 2) is not None
+        assert reg_f.delta_info("churn", 2) is None
+        for fleet in fleets.values():
+            fleet.request_reload()
+        for tag in fleets:
+            push(tag, 100, 200)
+        got = {tag: drain_replies(feeder, f"out-{tag}", 200)
+               for tag in fleets}
+        for tag, replies in got.items():
+            assert len(replies) == 200, tag          # none lost
+            for i in range(200):
+                labels = replies[str(i)]
+                assert len(labels) == 1, (tag, i)    # none duplicated
+                assert labels[0] in {e1[i % 40], e2[i % 40]}, (tag, i)
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and not all(
+                f.converged_version() == 2 for f in fleets.values()):
+            time.sleep(0.02)
+        for fleet in fleets.values():
+            assert fleet.converged_version() == 2
+        # the delta fleet really took the patch path; the full fleet
+        # really did not
+        d_swaps = sum(w.service.counters.get("Serving", "DeltaSwaps")
+                      for w in fleets["d"].workers)
+        f_swaps = sum(w.service.counters.get("Serving", "DeltaSwaps")
+                      for w in fleets["f"].workers)
+        assert d_swaps >= 1 and f_swaps == 0, (d_swaps, f_swaps)
+        # post-convergence: byte parity between the two fleets AND the
+        # offline oracle
+        for tag in fleets:
+            push(tag, 200, 260)
+        got2 = {tag: drain_replies(feeder, f"out-{tag}", 60)
+                for tag in fleets}
+        assert got2["d"] == got2["f"]
+        for i in range(200, 260):
+            assert got2["d"][str(i)] == [e2[i % 40]]
+    finally:
+        for fleet in fleets.values():
+            fleet.stop()
+        feeder.close()

@@ -1,0 +1,239 @@
+"""Multi-chip model-parallel serving (ISSUE 20, TPU_NOTES §32).
+
+The tentpole contracts under test, all on the CPU-simulated 8-device
+mesh the tier-1 conftest forces:
+
+  * the tree-axis sharded ensemble vote is BIT-IDENTICAL to the
+    single-chip vote — XLA shard body and mesh-aware pallas partial-vote
+    kernel (interpret mode) both — because per-shard tallies are sums of
+    integer-valued f32 terms and one psum merges them;
+  * exactly ONE cross-shard collective per served batch: pinned in the
+    jaxpr (one psum) AND in the ledger (one ``serve.shard_merge``
+    dispatch per device batch);
+  * fleet placement maps: ``device_map="round_robin"`` spreads workers
+    over chips instead of all binding chip 0; ``device_map="sharded"``
+    gives every worker the mesh-sharded core (shared executable);
+  * a forced multi-chip pallas→XLA downgrade at a non-mesh-aware site is
+    never silent — one structured RuntimeWarning per process plus an
+    ``<site>.xla_downgrade`` ledger entry per event.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from avenir_tpu.core.table import encode_rows
+from avenir_tpu.ops.pallas.dispatch import (_reset_multichip_warning,
+                                            force_backend, resolve_backend)
+from avenir_tpu.parallel.mesh import TREE_AXIS, tree_mesh, worker_device
+from avenir_tpu.serving.predictor import ForestPredictor, make_predictor
+from avenir_tpu.serving.registry import ModelRegistry
+from avenir_tpu.serving.service import PredictionService
+from avenir_tpu.serving.fleet import ServingFleet
+from avenir_tpu.utils.tracing import transfer_ledger
+from tests.test_serving import (forest_batch_predict, raw_rows_of,
+                                small_forest)
+from tests.test_tree import SCHEMA
+
+pytestmark = [pytest.mark.multichip, pytest.mark.serving]
+
+
+@pytest.fixture()
+def forest(mesh_ctx):
+    # 13 trees: not a multiple of 8, so the shard pad path is exercised
+    table, models = small_forest(mesh_ctx, n=500, trees=13, seed=3)
+    rows = raw_rows_of(table, 120)
+    expect = forest_batch_predict(models, encode_rows(rows, SCHEMA))
+    return table, models, rows, expect
+
+
+# --------------------------------------------------------------------------
+# sharded vote bit-identity + the one-collective pin
+# --------------------------------------------------------------------------
+
+def test_sharded_vote_bit_identical_to_single_chip(forest):
+    _, models, rows, expect = forest
+    ref = ForestPredictor(models, SCHEMA).warm().predict_rows(rows)
+    assert ref == expect
+    for mesh_spec in (True, 4, tree_mesh(2)):
+        p = ForestPredictor(models, SCHEMA, serve_mesh=mesh_spec).warm()
+        assert p._serve_mesh is not None
+        assert p.predict_rows(rows) == ref, mesh_spec
+
+
+def test_sharded_vote_pallas_parity(forest):
+    """The mesh-aware pallas partial-vote kernel (interpret mode inside
+    shard_map) answers exactly what the XLA shard body answers — which
+    is exactly the single-chip answer."""
+    _, models, rows, _ = forest
+    ref = ForestPredictor(models, SCHEMA).warm().predict_rows(rows)
+    with force_backend("pallas"):
+        p = ForestPredictor(models, SCHEMA, serve_mesh=True).warm()
+        assert p._vote_backend == "pallas"
+        with transfer_ledger() as led:
+            got = p.predict_rows(rows)
+    assert got == ref
+    assert led.backend_snapshot().get("serve.predict.pallas", 0) > 0
+
+
+def test_sharded_core_single_psum_jaxpr_pin(forest):
+    """ONE cross-shard collective per batch, pinned in the traced
+    program itself: the sharded core's jaxpr contains exactly one
+    psum."""
+    from avenir_tpu.models.tree import FeatureCache
+    _, models, rows, _ = forest
+    p = ForestPredictor(models, SCHEMA, serve_mesh=True)
+    table = encode_rows(rows[:8], SCHEMA)
+    vals, codes = p.ensemble.device_inputs(table, FeatureCache())
+    jaxpr = str(jax.make_jaxpr(
+        lambda v, c: p._jitted(v, c, *p._extra))(np.asarray(vals),
+                                                 np.asarray(codes)))
+    assert jaxpr.count("psum") == 1, jaxpr
+
+
+def test_shard_merge_ledger_one_dispatch_per_batch(forest):
+    _, models, rows, _ = forest
+    p = ForestPredictor(models, SCHEMA, serve_mesh=True,
+                        buckets=(64, 256)).warm()
+    with transfer_ledger() as led:
+        p.predict_rows(rows)
+    sites = led.site_snapshot()
+    # every device batch dispatched exactly one shard merge
+    assert sites.get("serve.shard_merge") == sites.get("serve.predict"), \
+        sites
+    assert sites.get("serve.shard_merge", 0) >= 1
+
+
+def test_serve_mesh_and_device_are_exclusive(forest):
+    _, models, _, _ = forest
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        ForestPredictor(models, SCHEMA, serve_mesh=True,
+                        device=jax.devices()[0])
+
+
+def test_device_pinned_predictor_serves_off_default_chip(forest):
+    """device= places the stacked tensors AND each request batch on the
+    given chip; answers stay byte-identical."""
+    _, models, rows, expect = forest
+    dev = worker_device(3)
+    assert dev.id == 3
+    p = ForestPredictor(models, SCHEMA, device=dev).warm()
+    assert p.predict_rows(rows) == expect
+    for arr in p._extra[:-1]:
+        assert list(arr.devices()) == [dev]
+
+
+# --------------------------------------------------------------------------
+# fleet placement maps
+# --------------------------------------------------------------------------
+
+def _fleet_services(tmp_path, mesh_ctx, **fleet_kw):
+    table, models = small_forest(mesh_ctx, n=300, trees=5, seed=3)
+    reg = ModelRegistry(str(tmp_path))
+    reg.publish("churn", models, schema=SCHEMA)
+    rows = raw_rows_of(table, 40)
+    expect = forest_batch_predict(models, encode_rows(rows, SCHEMA))
+    fleet = ServingFleet(reg, "churn", buckets=(8, 64), n_workers=4,
+                         **fleet_kw)
+    svcs = [fleet._make_service(f"churn-w{i}", i) for i in range(4)]
+    return svcs, rows, expect
+
+
+def test_fleet_round_robin_spreads_workers_over_chips(tmp_path, mesh_ctx):
+    svcs, rows, expect = _fleet_services(tmp_path, mesh_ctx,
+                                         device_map="round_robin")
+    devs = [s.predictor._device for s in svcs]
+    assert [d.id for d in devs] == [0, 1, 2, 3]   # not all chip 0
+    for s in svcs:
+        assert s.predictor.predict_rows(rows) == expect
+
+
+def test_fleet_sharded_map_one_shared_executable(tmp_path, mesh_ctx):
+    svcs, rows, expect = _fleet_services(tmp_path, mesh_ctx,
+                                         device_map="sharded")
+    for s in svcs:
+        assert s.predictor._serve_mesh is not None
+        assert s.predictor.predict_rows(rows) == expect
+    # the compiled sharded core is shared: one worker compiled it, the
+    # other three reuse the executable (the PR 18 sharing instrument)
+    assert all(s.predictor._jitted is svcs[0].predictor._jitted
+               for s in svcs[1:])
+
+
+def test_fleet_device_map_validation(tmp_path, mesh_ctx):
+    table, models = small_forest(mesh_ctx, n=200, trees=3, seed=3)
+    reg = ModelRegistry(str(tmp_path))
+    reg.publish("churn", models, schema=SCHEMA)
+    with pytest.raises(ValueError, match="device_map must be"):
+        ServingFleet(reg, "churn", device_map="spread")
+    with pytest.raises(ValueError, match="predictor_factory"):
+        ServingFleet(predictor_factory=lambda: None,
+                     device_map="round_robin")
+
+
+def test_make_predictor_threads_placement(tmp_path, mesh_ctx):
+    table, models = small_forest(mesh_ctx, n=200, trees=5, seed=3)
+    reg = ModelRegistry(str(tmp_path))
+    reg.publish("churn", models, schema=SCHEMA)
+    loaded = reg.load("churn")
+    rows = raw_rows_of(table, 30)
+    ref = make_predictor(loaded).warm().predict_rows(rows)
+    pm = make_predictor(loaded, serve_mesh=True).warm()
+    assert pm._serve_mesh is not None
+    assert pm.predict_rows(rows) == ref
+    pd = make_predictor(loaded, device=worker_device(2)).warm()
+    assert pd._device.id == 2
+    assert pd.predict_rows(rows) == ref
+
+
+# --------------------------------------------------------------------------
+# the multi-chip downgrade is never silent
+# --------------------------------------------------------------------------
+
+def test_multichip_downgrade_warns_once_and_lands_in_ledger():
+    _reset_multichip_warning()
+    with transfer_ledger() as led:
+        with pytest.warns(RuntimeWarning,
+                          match="downgraded pallas->xla"):
+            assert resolve_backend("tpu", 8, site="knn.topk") == "xla"
+        # second event: ledger yes, warning no (one loud line/process)
+        import warnings as _w
+        with _w.catch_warnings():
+            _w.simplefilter("error")
+            assert resolve_backend("tpu", 8, site="knn.topk") == "xla"
+    assert led.backend_snapshot() == {"knn.topk.xla_downgrade": 2}
+    # mesh-aware call sites keep pallas on any chip count
+    assert resolve_backend("tpu", 8, mesh_aware=True) == "pallas"
+    assert resolve_backend("tpu", 1) == "pallas"
+    _reset_multichip_warning()
+
+
+# --------------------------------------------------------------------------
+# service + tree-mesh axis hygiene
+# --------------------------------------------------------------------------
+
+def test_tree_mesh_axis_is_distinct(mesh_ctx):
+    m = tree_mesh(4)
+    assert m.axis_names == (TREE_AXIS,)
+    assert m.devices.size == 4
+    # 1-device serve meshes degrade to the plain single-chip core
+    _, models = small_forest(mesh_ctx, n=200, trees=3, seed=3)
+    p = ForestPredictor(models, SCHEMA, serve_mesh=1)
+    assert p._serve_mesh is None and p._core is not None
+
+
+def test_service_serve_mesh_threading(tmp_path, mesh_ctx):
+    table, models = small_forest(mesh_ctx, n=300, trees=5, seed=3)
+    reg = ModelRegistry(str(tmp_path))
+    reg.publish("churn", models, schema=SCHEMA)
+    rows = raw_rows_of(table, 40)
+    expect = forest_batch_predict(models, encode_rows(rows, SCHEMA))
+    svc = PredictionService(registry=reg, model_name="churn",
+                            buckets=(8, 64), serve_mesh=True)
+    assert svc.predictor._serve_mesh is not None
+    assert svc.predictor.predict_rows(rows) == expect
+    svc2 = PredictionService(registry=reg, model_name="churn",
+                             buckets=(8, 64), device=worker_device(5))
+    assert svc2.predictor._device.id == 5
+    assert svc2.predictor.predict_rows(rows) == expect

@@ -565,3 +565,94 @@ def test_prediction_service_quantized_hot_swap(published, tmp_path):
     assert svc.refresh()
     assert svc.version == v2
     assert svc.predictor.quantized is not None
+
+
+# --------------------------------------------------------------------------
+# mesh-aware kernels (ISSUE 20): sharded top-k scan + partial votes
+# --------------------------------------------------------------------------
+
+@pytest.mark.multichip
+@pytest.mark.parametrize("metric", ["euclidean", "manhattan"])
+def test_topk_scan_sharded_parity(rng, metric):
+    """The tree-of-record: topk_scan_sharded (train axis sharded over
+    the 8-device mesh, per-shard pallas scans, ONE packed all_gather +
+    lexicographic merge) is BIT-identical to the single-device scan —
+    including cross-shard ties, which must break to the lowest GLOBAL
+    train index exactly as the flat scan's stable order does."""
+    import jax.numpy as jnp
+    from avenir_tpu.ops.pallas.topk import topk_scan, topk_scan_sharded
+    from avenir_tpu.parallel.mesh import make_mesh
+    nt, ntr, Fn, Fc, k = 37, 205, 5, 7, 9
+    tn = rng.normal(size=(nt, Fn)).astype(np.float32)
+    toh = (rng.random((nt, Fc)) < 0.3).astype(np.float32)
+    rn = rng.normal(size=(ntr, Fn)).astype(np.float32)
+    roh = (rng.random((ntr, Fc)) < 0.3).astype(np.float32)
+    # duplicate the first half of the train set into the second half:
+    # identical distances land in DIFFERENT shards and the merge must
+    # still answer the lowest global index first
+    rn[ntr // 2:] = rn[:ntr - ntr // 2]
+    roh[ntr // 2:] = roh[:ntr - ntr // 2]
+    args = tuple(jnp.asarray(a) for a in (tn, toh, rn, roh))
+    d1, i1 = topk_scan(*args, k, metric, float(Fc), 1.0, 1.0,
+                       interpret=True)
+    d2, i2 = topk_scan_sharded(*args, k, metric, float(Fc), 1.0, 1.0,
+                               make_mesh(), "data", interpret=True)
+    np.testing.assert_array_equal(np.asarray(d2), np.asarray(d1))
+    np.testing.assert_array_equal(np.asarray(i2), np.asarray(i1))
+
+
+@pytest.mark.multichip
+def test_topk_scan_sharded_k_exceeds_local_shard(rng):
+    """k larger than a shard's local train slice: the per-shard lists
+    clamp and pad with +inf/-1 sentinels, and the merge still recovers
+    the exact global top-k (which IS the whole train set here)."""
+    import jax.numpy as jnp
+    from avenir_tpu.ops.pallas.topk import topk_scan, topk_scan_sharded
+    from avenir_tpu.parallel.mesh import make_mesh
+    nt, ntr, k = 11, 13, 9          # 8 shards -> local slices of 1-2 rows
+    tn = rng.normal(size=(nt, 3)).astype(np.float32)
+    toh = np.zeros((nt, 0), np.float32)
+    rn = rng.normal(size=(ntr, 3)).astype(np.float32)
+    roh = np.zeros((ntr, 0), np.float32)
+    args = tuple(jnp.asarray(a) for a in (tn, toh, rn, roh))
+    d1, i1 = topk_scan(*args, k, "euclidean", 0.0, 1.0, 1.0,
+                       interpret=True)
+    d2, i2 = topk_scan_sharded(*args, k, "euclidean", 0.0, 1.0, 1.0,
+                               make_mesh(), "data", interpret=True)
+    np.testing.assert_array_equal(np.asarray(d2), np.asarray(d1))
+    np.testing.assert_array_equal(np.asarray(i2), np.asarray(i1))
+
+
+@pytest.mark.multichip
+def test_ensemble_partial_votes_pallas_parity(rng):
+    """The serving shard body: the pallas partial-vote kernel equals the
+    XLA ``_member_votes_body`` tallies bitwise, and summing per-tree-
+    chunk partial tallies equals the whole-forest tally bitwise (tallies
+    are integer-valued f32 sums) — the exact property that makes the
+    per-shard-partials + one-psum composition bit-identical to the
+    single-chip vote."""
+    import jax.numpy as jnp
+    from avenir_tpu.models.forest import _member_votes_body
+    from avenir_tpu.ops.pallas.vote import ensemble_partial_votes
+    T, P, F, C, K, n = 16, 4, 3, 5, 3, 41
+    vals = rng.normal(size=(n, F)).astype(np.float32)
+    codes = rng.integers(0, C, size=(n, F)).astype(np.int32)
+    lo = np.sort(rng.normal(size=(T, P, F)).astype(np.float32) - 1, axis=2)
+    hi = lo + 2.0
+    num_r = rng.random((T, P, F)) < 0.5
+    cat_m = rng.random((T, P, F, C)) < 0.7
+    cat_r = rng.random((T, P, F)) < 0.3
+    cls_oh = np.eye(K, dtype=np.float32)[rng.integers(0, K, size=(T, P))]
+    wvec = rng.integers(1, 5, size=(T,)).astype(np.float32)
+    consts = (lo, hi, num_r, cat_m, cat_r, cls_oh, wvec)
+    args = tuple(jnp.asarray(a) for a in (vals, codes) + consts)
+    ref = np.asarray(_member_votes_body(*args))
+    got = np.asarray(ensemble_partial_votes(*args, interpret=True))
+    np.testing.assert_array_equal(got, ref)
+    # chunked tree-axis partial sums == the whole tally, bitwise
+    merged = np.zeros_like(ref)
+    for s in range(0, T, 4):
+        sl = tuple(jnp.asarray(a[s:s + 4]) for a in consts)
+        merged = merged + np.asarray(ensemble_partial_votes(
+            args[0], args[1], *sl, interpret=True))
+    np.testing.assert_array_equal(merged, ref)

@@ -15,8 +15,13 @@ explicit pin, per NAME — N resident models on one fleet means N
 independent pin/serving answers.
 
 ``verify`` probes every version with the registry's own ``is_intact``
-(meta.json parses, every manifest file opens) plus a pin-target check.
-Exit code 0 = all intact, 1 = problems found, 2 = usage error.
+(meta.json parses, every manifest file opens) plus a pin-target check,
+and audits delta sidecars (ISSUE 20): a delta whose parent version is
+gone/torn flags ``orphaned-delta``; one whose recorded parent sha chain
+no longer matches the parent's trees flags ``delta-sha-chain-broken``.
+Both are warnings, not failures — serving always has the full-artifact
+fallback.  Exit code 0 = all intact, 1 = problems found, 2 = usage
+error.
 
 ``gc`` retires old versions through ``ModelRegistry.retire`` (keeps the
 newest ``--keep``, never the pinned or serving version, sweeps abandoned
@@ -109,17 +114,47 @@ def cmd_verify(args) -> int:
             print(f"{name}: NO committed versions")
             problems += 1
             continue
+        vset = set(versions)
+        warned = 0
         for v in versions:
-            if reg.is_intact(name, v):
-                print(f"{name} v{v}: ok")
-            else:
+            if not reg.is_intact(name, v):
                 print(f"{name} v{v}: TORN or unreadable")
                 problems += 1
+                continue
+            # delta-sidecar sha-chain probes (ISSUE 20).  These are
+            # WARNINGS, not problems: a broken chain only disables the
+            # O(delta) fast path — refresh falls back to the version's
+            # own full artifact, which is intact.
+            note = ""
+            dmeta = reg.delta_info(name, v)
+            if dmeta is not None:
+                parent = dmeta.get("parent_version")
+                if parent not in vset or not reg.is_intact(name, parent):
+                    note = (f"  [orphaned-delta: parent v{parent} "
+                            f"missing/torn — delta unusable, full load "
+                            f"serves]")
+                    warned += 1
+                else:
+                    try:
+                        pmeta = reg.load(name, parent).meta
+                        pshas = pmeta.get("tree_shas")
+                    except Exception:
+                        pshas = None
+                    if pshas != dmeta.get("parent_tree_shas"):
+                        note = (f"  [delta-sha-chain-broken: parent "
+                                f"v{parent} trees differ from the "
+                                f"recorded chain — delta unusable, "
+                                f"full load serves]")
+                        warned += 1
+            print(f"{name} v{v}: ok{note}")
         pin = reg.pinned_version(name)
         if pin is not None and not reg.is_intact(name, pin):
             print(f"{name}: pin -> v{pin} whose target is NOT intact "
                   f"(serving falls back to newest intact)")
             problems += 1
+        if warned:
+            print(f"{name}: {warned} delta warning(s) — serving is safe "
+                  f"(full-artifact fallback), delta distribution is not")
     print(f"{'PROBLEMS: %d' % problems if problems else 'verified'}")
     return 1 if problems else 0
 
